@@ -1,0 +1,87 @@
+"""paddle_tpu: a TPU-native deep-learning framework with PaddlePaddle's
+capability surface (see SURVEY.md for the reference blueprint).
+
+Eager tensors execute through a compile-cached XLA dispatch (PJRT); autograd
+is a GradNode graph engine; to_static lowers traced programs to jit'd XLA;
+parallelism is mesh+placements GSPMD with compiled collectives over ICI.
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+import jax as _jax
+
+# int64 is the framework default for indices/labels (paddle parity); floats
+# stay fp32/bf16 via explicit dtype defaults in creation ops.
+_jax.config.update("jax_enable_x64", True)
+
+from ._core.dtype import (DType, bool_, uint8, int8, int16, int32, int64,
+                          float16, bfloat16, float32, float64, complex64,
+                          complex128)
+bool = bool_  # paddle exposes paddle.bool
+from ._core.flags import set_flags, get_flags
+from ._core.tensor import Tensor, to_tensor
+from ._core.autograd import (no_grad, enable_grad, set_grad_enabled,
+                             is_grad_enabled, grad)
+from ._core.random import seed, get_seed
+from ._core import device
+from ._core.device import (CPUPlace, TPUPlace, CustomPlace, set_device,
+                           get_device, device_count, is_compiled_with_cuda,
+                           is_compiled_with_xpu, is_compiled_with_tpu)
+CUDAPlace = TPUPlace  # source-compat alias: "gpu" place maps to the TPU chip
+
+from .ops import *  # noqa: F401,F403
+from .ops import creation, indexing, linalg, manipulation, math, reduction, \
+    search  # noqa: F401
+from .ops.creation import to_tensor  # noqa: F811  (canonical)
+
+from . import autograd  # noqa: E402
+from . import nn  # noqa: E402
+from . import optimizer  # noqa: E402
+from . import io  # noqa: E402
+from . import vision  # noqa: E402
+from . import amp  # noqa: E402
+from . import jit  # noqa: E402
+from . import metric  # noqa: E402
+from . import framework  # noqa: E402
+
+from .framework import save, load  # noqa: E402
+
+
+def disable_static(place=None):
+    return None
+
+
+def enable_static():
+    raise NotImplementedError(
+        "paddle_tpu is dygraph-first; use paddle_tpu.jit.to_static for the "
+        "compiled path")
+
+
+def in_dynamic_mode():
+    return True
+
+
+def is_grad_enabled_():
+    return is_grad_enabled()
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    raise NotImplementedError
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    from .hapi.summary import summary as _summary
+    return _summary(net, input_size, dtypes, input)
+
+
+def get_default_dtype():
+    return "float32"
+
+
+_default_dtype = "float32"
+
+
+def set_default_dtype(d):
+    global _default_dtype
+    _default_dtype = str(d)

@@ -1,0 +1,360 @@
+"""Eager autograd engine.
+
+TPU-native analog of the reference's eager autograd:
+  - AutogradMeta  <- paddle/fluid/eager/autograd_meta.h:61
+  - GradNode      <- paddle/fluid/eager/grad_node_info.h:197 (slot-wise edges)
+  - saved inputs  <- TensorWrapper (tensor_wrapper.h) incl. inplace-version check
+  - run_backward  <- egr::RunBackward, queue + in-degree topological traversal
+                     (paddle/fluid/eager/backward.cc:106,226)
+  - grad()        <- partial-graph paddle.grad (general_grad.h)
+
+Device work stays async on the TPU stream: the engine only orchestrates
+which cached XLA executables run; accumulation itself is a jitted add.
+"""
+from __future__ import annotations
+
+import threading
+from collections import defaultdict, deque
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from . import dispatch
+from .op_registry import OpDef
+
+# ---------------------------------------------------------------- grad mode
+
+_STATE = threading.local()
+
+
+def is_grad_enabled() -> bool:
+    return getattr(_STATE, "grad_enabled", True)
+
+
+def _set_grad_enabled(v: bool):
+    _STATE.grad_enabled = v
+
+
+class no_grad:
+    """Context manager / decorator disabling grad recording (paddle.no_grad)."""
+
+    def __enter__(self):
+        self._prev = is_grad_enabled()
+        _set_grad_enabled(False)
+        return self
+
+    def __exit__(self, *exc):
+        _set_grad_enabled(self._prev)
+        return False
+
+    def __call__(self, fn):
+        def wrapper(*a, **k):
+            with no_grad():
+                return fn(*a, **k)
+        return wrapper
+
+
+class enable_grad:
+    def __enter__(self):
+        self._prev = is_grad_enabled()
+        _set_grad_enabled(True)
+        return self
+
+    def __exit__(self, *exc):
+        _set_grad_enabled(self._prev)
+        return False
+
+
+class set_grad_enabled:
+    def __init__(self, mode: bool):
+        self._mode = bool(mode)
+
+    def __enter__(self):
+        self._prev = is_grad_enabled()
+        _set_grad_enabled(self._mode)
+        return self
+
+    def __exit__(self, *exc):
+        _set_grad_enabled(self._prev)
+        return False
+
+
+# ---------------------------------------------------------------- graph types
+
+class AutogradMeta:
+    """Per-tensor autograd info (autograd_meta.h:61)."""
+
+    __slots__ = ("grad", "grad_node", "out_slot", "hooks", "retain_grads")
+
+    def __init__(self):
+        self.grad = None           # Tensor
+        self.grad_node: Optional["GradNode"] = None
+        self.out_slot: int = 0
+        self.hooks: List = []
+        self.retain_grads = False
+
+
+class _Edge:
+    """Edge from a node input to the producer of that input."""
+    __slots__ = ("kind", "node", "slot", "leaf")
+
+    def __init__(self, kind, node=None, slot=0, leaf=None):
+        self.kind = kind      # 'node' | 'leaf' | None
+        self.node = node
+        self.slot = slot
+        self.leaf = leaf      # weak-ish direct ref to the leaf Tensor
+
+
+class GradNode:
+    """One recorded op application (grad_node_info.h:197)."""
+
+    __slots__ = ("op", "attrs", "saved", "saved_versions", "edges",
+                 "out_shapes", "out_dtypes", "out_hooks", "name", "py_bwd")
+
+    def __init__(self, op: OpDef, attrs, saved, edges, out_shapes, out_dtypes):
+        self.op = op
+        self.attrs = attrs
+        self.saved = saved                  # raw jax values (TensorWrapper)
+        self.saved_versions = None          # filled by record() for inputs
+        self.edges: List[_Edge] = edges     # one per op input
+        self.out_shapes = out_shapes
+        self.out_dtypes = out_dtypes
+        self.out_hooks: Dict[int, List] = {}
+        self.name = op.name if op is not None else "pylayer"
+        self.py_bwd = None                  # set for PyLayer-style nodes
+
+    def apply(self, gouts: Tuple) -> Tuple:
+        if self.py_bwd is not None:
+            return self.py_bwd(gouts)
+        return dispatch.eager_backward(self.op, self.saved, self.attrs, gouts)
+
+
+_accum = jax.jit(jnp.add)
+
+
+def record(op: OpDef, attrs, in_tensors, out_tensors, saved_vals=None):
+    """Record a GradNode linking outputs to inputs (eager_gen.py analog).
+
+    Called by the op executor when grad mode is on and any input requires
+    grad. Integer/bool outputs never require grad.
+    """
+    edges = []
+    versions = []
+    for t in in_tensors:
+        if t is None or t.stop_gradient:
+            edges.append(_Edge(None))
+            versions.append(0)
+            continue
+        meta = t._autograd_meta
+        if meta.grad_node is not None:
+            edges.append(_Edge("node", node=meta.grad_node, slot=meta.out_slot))
+        else:
+            edges.append(_Edge("leaf", leaf=t))
+        versions.append(t._inplace_version)
+
+    saved = tuple(None if t is None else t._value for t in in_tensors) \
+        if saved_vals is None else tuple(saved_vals)
+    node = GradNode(
+        op, attrs, saved, edges,
+        out_shapes=tuple(t.shape for t in out_tensors),
+        out_dtypes=tuple(t._value.dtype for t in out_tensors))
+    node.saved_versions = tuple(versions)
+
+    for i, t in enumerate(out_tensors):
+        if jnp.issubdtype(t._value.dtype, jnp.inexact):
+            t.stop_gradient = False
+            m = t._autograd_meta
+            m.grad_node = node
+            m.out_slot = i
+    return node
+
+
+# ---------------------------------------------------------------- the engine
+
+def _discover(roots: List[GradNode]):
+    """BFS the grad graph; return per-node in-degree (edge reference counts)."""
+    deps: Dict[GradNode, int] = defaultdict(int)
+    visited = set()
+    q = deque(roots)
+    for r in roots:
+        visited.add(id(r))
+        deps[r] += 0
+    id2node = {id(r): r for r in roots}
+    while q:
+        node = q.popleft()
+        for e in node.edges:
+            if e.kind == "node":
+                deps[e.node] += 1
+                if id(e.node) not in visited:
+                    visited.add(id(e.node))
+                    id2node[id(e.node)] = e.node
+                    q.append(e.node)
+    return deps
+
+
+def _zeros_like_slot(node: GradNode, slot: int):
+    return jnp.zeros(node.out_shapes[slot], node.out_dtypes[slot])
+
+
+def run_backward(tensors, grad_tensors=None, retain_graph=False):
+    """loss.backward(): seed roots, traverse, write .grad on leaves
+    (backward.cc:106)."""
+    _engine_run(tensors, grad_tensors, targets=None)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, allow_unused=False):
+    """Partial-graph gradients (paddle.grad / general_grad.h)."""
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True (higher-order eager grad) is not supported; "
+            "use the functional/static path (paddle_tpu.jit) for higher-order "
+            "derivatives via jax.grad composition.")
+    outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    captured = _engine_run(outputs, grad_outputs, targets=list(inputs))
+    from .tensor import Tensor
+    res = []
+    for t in inputs:
+        g = captured.get(id(t))
+        if g is None and not allow_unused:
+            raise RuntimeError(
+                "one of the differentiated tensors appears unused in the "
+                "graph; pass allow_unused=True to return None for it")
+        res.append(None if g is None else Tensor(g, stop_gradient=True))
+    return res
+
+
+def _engine_run(tensors, grad_tensors, targets):
+    from .tensor import Tensor  # local import to avoid cycle
+
+    tensors = tensors if isinstance(tensors, (list, tuple)) else [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    grad_tensors = [g._value if isinstance(g, Tensor) else g
+                    for g in grad_tensors]
+
+    # Target capture maps for paddle.grad mode.
+    capture_by_tensor_id: Dict[int, object] = {}
+    target_slots: Dict[Tuple[int, int], List[int]] = defaultdict(list)
+    target_leaves: Dict[int, List[int]] = defaultdict(list)
+    if targets is not None:
+        for t in targets:
+            m = t._autograd_meta
+            if m.grad_node is not None:
+                target_slots[(id(m.grad_node), m.out_slot)].append(id(t))
+            else:
+                target_leaves[id(t)].append(id(t))
+
+    holders: Dict[int, Dict[int, object]] = defaultdict(dict)  # id(node)->slot->val
+    id2node: Dict[int, GradNode] = {}
+    roots: List[GradNode] = []
+
+    def _leaf_accumulate(t, g):
+        if targets is not None:
+            if id(t) in target_leaves:
+                prev = capture_by_tensor_id.get(id(t))
+                capture_by_tensor_id[id(t)] = g if prev is None else _accum(prev, g)
+            return
+        for hook in t._autograd_meta.hooks:
+            out = hook(Tensor(g, stop_gradient=True))
+            if out is not None:
+                g = out._value if isinstance(out, Tensor) else out
+        meta = t._autograd_meta
+        if meta.grad is None:
+            meta.grad = Tensor(g, stop_gradient=True)
+        else:
+            meta.grad = Tensor(_accum(meta.grad._value, g), stop_gradient=True)
+
+    # Seed.
+    for t, g in zip(tensors, grad_tensors):
+        if t.stop_gradient:
+            raise RuntimeError("tensor has stop_gradient=True; nothing to do "
+                               "in backward()")
+        if g is None:
+            if t._value.size != 1:
+                raise RuntimeError(
+                    "grad can be implicitly created only for scalar outputs; "
+                    "pass grad_tensors for non-scalar roots")
+            g = jnp.ones_like(t._value)
+        meta = t._autograd_meta
+        if meta.grad_node is None:
+            _leaf_accumulate(t, g)
+            continue
+        node, slot = meta.grad_node, meta.out_slot
+        h = holders[id(node)]
+        h[slot] = g if slot not in h else _accum(h[slot], g)
+        if id(node) not in id2node:
+            id2node[id(node)] = node
+            roots.append(node)
+
+    if not roots:
+        return capture_by_tensor_id
+
+    deps = _discover(roots)
+    # Root nodes seeded from user tensors may also be interior (referenced by
+    # other roots); only start with nodes whose in-degree is 0.
+    ready = deque(n for n in roots if deps[n] == 0)
+    pending_roots = {id(n) for n in roots if deps[n] != 0}
+    processed = set()
+
+    while ready:
+        node = ready.popleft()
+        if id(node) in processed:
+            continue
+        processed.add(id(node))
+        h = holders.pop(id(node), {})
+        gouts = []
+        for s in range(len(node.out_shapes)):
+            g = h.get(s)
+            gouts.append(_zeros_like_slot(node, s) if g is None else g)
+        # Slot hooks (tensor.register_hook on non-leaf tensors).
+        for s, hooks in node.out_hooks.items():
+            for hook in hooks:
+                out = hook(Tensor(gouts[s], stop_gradient=True))
+                if out is not None:
+                    gouts[s] = out._value if isinstance(out, Tensor) else out
+        # paddle.grad capture of non-leaf targets.
+        if targets is not None:
+            for s in range(len(gouts)):
+                key = (id(node), s)
+                if key in target_slots:
+                    for tid in target_slots[key]:
+                        prev = capture_by_tensor_id.get(tid)
+                        capture_by_tensor_id[tid] = gouts[s] if prev is None \
+                            else _accum(prev, gouts[s])
+
+        grads = node.apply(tuple(gouts))
+        if len(grads) != len(node.edges):
+            raise RuntimeError(
+                f"op '{node.name}' backward returned {len(grads)} grads for "
+                f"{len(node.edges)} inputs")
+
+        for e, g in zip(node.edges, grads):
+            if e.kind is None:
+                continue
+            if e.kind == "leaf":
+                if g is not None:
+                    _leaf_accumulate(e.leaf, g)
+                continue
+            # the in-degree decrement must happen even for a None grad —
+            # otherwise the producer node stalls and drops contributions
+            # from its other consumers (mirrors backward.cc edge handling)
+            nxt = e.node
+            if g is not None:
+                hh = holders[id(nxt)]
+                hh[e.slot] = g if e.slot not in hh else _accum(hh[e.slot], g)
+            deps[nxt] -= 1
+            if deps[nxt] == 0:
+                ready.append(nxt)
+                pending_roots.discard(id(nxt))
+        # A seeded root that was also interior becomes ready once all its
+        # downstream consumers ran.
+        for rid in list(pending_roots):
+            n = id2node[rid]
+            if deps[n] == 0:
+                pending_roots.discard(rid)
+                ready.append(n)
+
+    return capture_by_tensor_id

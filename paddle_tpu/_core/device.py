@@ -1,0 +1,120 @@
+"""Device / place management.
+
+TPU-native analog of the reference's place + device manager
+(paddle/phi/common/place.h, paddle/phi/backends/device_manager.h:134,
+python/paddle/device/__init__.py:284 set_device). Devices are PJRT devices
+enumerated by JAX; "TPUPlace(i)" maps to jax.devices('tpu')[i].
+"""
+from __future__ import annotations
+
+import jax
+
+
+class Place:
+    device_type = "unknown"
+
+    def __init__(self, device_id: int = 0):
+        self.device_id = int(device_id)
+
+    def __repr__(self):
+        return f"Place({self.device_type}:{self.device_id})"
+
+    def __eq__(self, other):
+        return (isinstance(other, Place)
+                and self.device_type == other.device_type
+                and self.device_id == other.device_id)
+
+    def __hash__(self):
+        return hash((self.device_type, self.device_id))
+
+    def get_device_id(self):
+        return self.device_id
+
+
+class TPUPlace(Place):
+    device_type = "tpu"
+
+
+class CPUPlace(Place):
+    device_type = "cpu"
+
+    def __init__(self):
+        super().__init__(0)
+
+
+class CustomPlace(Place):
+    def __init__(self, dev_type: str, device_id: int = 0):
+        super().__init__(device_id)
+        self.device_type = dev_type
+
+
+_current_device = None
+
+
+def _default_device_str() -> str:
+    backend = jax.default_backend()
+    return f"{backend}:0" if backend != "cpu" else "cpu"
+
+
+def set_device(device: str):
+    """paddle.device.set_device analog ('tpu:0', 'cpu')."""
+    global _current_device
+    _current_device = device
+    return get_device_place(device)
+
+
+def get_device() -> str:
+    return _current_device or _default_device_str()
+
+
+def get_device_place(device: str = None) -> Place:
+    device = device or get_device()
+    if device == "cpu":
+        return CPUPlace()
+    if ":" in device:
+        kind, idx = device.split(":")
+    else:
+        kind, idx = device, 0
+    if kind in ("tpu", "gpu", "xpu", "axon"):
+        return TPUPlace(int(idx)) if kind in ("tpu", "axon") \
+            else CustomPlace(kind, int(idx))
+    return CustomPlace(kind, int(idx))
+
+
+def jax_device(place: Place = None):
+    """Resolve a Place to a jax Device object."""
+    if place is None or isinstance(place, TPUPlace):
+        devs = jax.devices()
+        idx = 0 if place is None else place.device_id
+        return devs[min(idx, len(devs) - 1)]
+    if isinstance(place, CPUPlace):
+        return jax.devices("cpu")[0]
+    return jax.devices()[0]
+
+
+def place_of(value) -> Place:
+    try:
+        dev = next(iter(value.devices()))
+    except Exception:
+        return get_device_place()
+    if dev.platform in ("tpu", "axon"):
+        return TPUPlace(dev.id)
+    if dev.platform == "cpu":
+        return CPUPlace()
+    return CustomPlace(dev.platform, dev.id)
+
+
+def device_count() -> int:
+    return jax.device_count()
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_tpu() -> bool:
+    return True

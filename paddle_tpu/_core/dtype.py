@@ -1,0 +1,95 @@
+"""Data types for paddle_tpu.
+
+TPU-native analog of the reference's dtype enum (paddle/phi/common/data_type.h).
+Dtypes are thin named wrappers over numpy/jax dtypes so user code can write
+``paddle_tpu.float32`` the way Paddle users write ``paddle.float32``.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+
+class DType:
+    """A framework dtype. Compares equal to its string name and numpy dtype."""
+
+    __slots__ = ("name", "np_dtype")
+
+    def __init__(self, name: str, np_dtype):
+        self.name = name
+        self.np_dtype = np.dtype(np_dtype) if np_dtype is not None else None
+
+    def __repr__(self):
+        return f"paddle_tpu.{self.name}"
+
+    def __eq__(self, other):
+        if isinstance(other, DType):
+            return self.name == other.name
+        if isinstance(other, str):
+            return self.name == other or str(self.np_dtype) == other
+        try:
+            return self.np_dtype == np.dtype(other)
+        except TypeError:
+            return NotImplemented
+
+    def __hash__(self):
+        return hash(self.name)
+
+
+bool_ = DType("bool", np.bool_)
+uint8 = DType("uint8", np.uint8)
+int8 = DType("int8", np.int8)
+int16 = DType("int16", np.int16)
+int32 = DType("int32", np.int32)
+int64 = DType("int64", np.int64)
+float16 = DType("float16", np.float16)
+bfloat16 = DType("bfloat16", jnp.bfloat16)
+float32 = DType("float32", np.float32)
+float64 = DType("float64", np.float64)
+complex64 = DType("complex64", np.complex64)
+complex128 = DType("complex128", np.complex128)
+
+_ALL = [bool_, uint8, int8, int16, int32, int64, float16, bfloat16,
+        float32, float64, complex64, complex128]
+_BY_NAME = {d.name: d for d in _ALL}
+_BY_NAME["bool_"] = bool_
+
+_FLOATING = {float16, bfloat16, float32, float64}
+_INTEGER = {uint8, int8, int16, int32, int64}
+_COMPLEX = {complex64, complex128}
+
+
+def to_dtype(d) -> DType:
+    """Coerce str / numpy dtype / DType / jnp dtype to a framework DType."""
+    if d is None:
+        return None
+    if isinstance(d, DType):
+        return d
+    if isinstance(d, str):
+        if d in _BY_NAME:
+            return _BY_NAME[d]
+        return from_np(np.dtype(d))
+    return from_np(d)
+
+
+def from_np(np_dtype) -> DType:
+    name = np.dtype(np_dtype).name
+    if name in _BY_NAME:
+        return _BY_NAME[name]
+    raise TypeError(f"unsupported dtype: {np_dtype!r}")
+
+
+def to_np(d):
+    return to_dtype(d).np_dtype
+
+
+def is_floating_point(d) -> bool:
+    return to_dtype(d) in _FLOATING
+
+
+def is_integer(d) -> bool:
+    return to_dtype(d) in _INTEGER
+
+
+def is_complex(d) -> bool:
+    return to_dtype(d) in _COMPLEX

@@ -1,0 +1,54 @@
+"""Eager op executor: unwrap -> dispatch -> wrap -> record autograd.
+
+This is the analog of the reference's generated `<op>_ad_func` layer
+(paddle/fluid/eager/auto_code_generator/generator/eager_gen.py): run the
+forward through the compile cache, then, if grad is required, create the
+GradNode, capture inputs, and wire slot edges. AMP auto-cast interception
+(amp_auto_cast.h analog) hooks in here too.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .autograd import is_grad_enabled, record
+from .dispatch import eager_forward
+from .op_registry import get_op
+from .tensor import Tensor
+
+
+def _coerce(x):
+    if x is None or isinstance(x, Tensor):
+        return x
+    # jnp.asarray keeps python scalars weakly typed so dtype promotion
+    # matches jax semantics (x_bf16 + 1.0 stays bf16).
+    return Tensor(jnp.asarray(x), stop_gradient=True)
+
+
+def apply(op_name: str, *inputs, **attrs):
+    """Execute a registered op eagerly on Tensors. Returns Tensor or tuple."""
+    op = get_op(op_name)
+    ts = [_coerce(x) for x in inputs]
+    ts = _maybe_amp_cast(op_name, ts)
+    vals = tuple(t._value if t is not None else None for t in ts)
+    out_vals = eager_forward(op, vals, attrs)
+    outs = tuple(Tensor(v) for v in out_vals)
+    if is_grad_enabled() and any(
+            t is not None and not t.stop_gradient for t in ts):
+        record(op, attrs, ts, outs)
+    return outs if op.multi_output else outs[0]
+
+
+# AMP interception is installed by paddle_tpu.amp (kept as a hook here to
+# avoid a hard dependency; see amp/auto_cast.py).
+_amp_hook = None
+
+
+def set_amp_hook(fn):
+    global _amp_hook
+    _amp_hook = fn
+
+
+def _maybe_amp_cast(op_name, ts):
+    if _amp_hook is None:
+        return ts
+    return _amp_hook(op_name, ts)

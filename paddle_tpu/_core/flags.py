@@ -1,0 +1,81 @@
+"""Global runtime flag registry.
+
+TPU-native analog of the reference's exported-flag registry
+(paddle/common/flags.h:242-291 `PHI_DEFINE_EXPORTED_*`, ~187 flags in
+flags.cc) with env-var override and get/set from Python
+(python/paddle/base/framework.py:132,157 set_flags/get_flags).
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict, Iterable, Union
+
+_LOCK = threading.RLock()
+_REGISTRY: Dict[str, "Flag"] = {}
+
+
+class Flag:
+    __slots__ = ("name", "default", "value", "type", "help")
+
+    def __init__(self, name: str, default: Any, help: str = ""):
+        self.name = name
+        self.default = default
+        self.type = type(default)
+        self.help = help
+        env = os.environ.get(name)
+        self.value = _parse(env, self.type) if env is not None else default
+
+
+def _parse(text: str, ty: type):
+    if ty is bool:
+        return text.lower() in ("1", "true", "yes", "on")
+    return ty(text)
+
+
+def define_flag(name: str, default: Any, help: str = "") -> Flag:
+    with _LOCK:
+        if name in _REGISTRY:
+            return _REGISTRY[name]
+        flag = Flag(name, default, help)
+        _REGISTRY[name] = flag
+        return flag
+
+
+def get_flags(flags: Union[str, Iterable[str]]) -> Dict[str, Any]:
+    if isinstance(flags, str):
+        flags = [flags]
+    with _LOCK:
+        out = {}
+        for name in flags:
+            if name not in _REGISTRY:
+                raise ValueError(f"unknown flag: {name}")
+            out[name] = _REGISTRY[name].value
+        return out
+
+
+def set_flags(flags: Dict[str, Any]) -> None:
+    with _LOCK:
+        for name, value in flags.items():
+            if name not in _REGISTRY:
+                raise ValueError(f"unknown flag: {name}")
+            flag = _REGISTRY[name]
+            flag.value = _parse(value, flag.type) if isinstance(value, str) and flag.type is not str else flag.type(value)
+
+
+def flag_value(name: str):
+    return _REGISTRY[name].value
+
+
+# Core flags (analogs of the reference's most-used ones).
+define_flag("FLAGS_check_nan_inf", False,
+            "Scan op outputs for NaN/Inf after each eager op (debug).")
+define_flag("FLAGS_call_stack_level", 1,
+            "Error message verbosity: 0 brief, 1 python stack, 2 full.")
+define_flag("FLAGS_eager_compile_cache_size", 4096,
+            "Max cached compiled executables for eager op dispatch.")
+define_flag("FLAGS_log_compiles", False, "Log XLA compilations of eager ops.")
+define_flag("FLAGS_seed", 0, "Default global random seed.")
+define_flag("FLAGS_tpu_matmul_precision", "default",
+            "Matmul precision: default|high|highest.")
+define_flag("FLAGS_benchmark", False, "Block on every eager op (for timing).")

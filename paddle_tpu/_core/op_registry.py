@@ -1,0 +1,68 @@
+"""Declarative op registry.
+
+TPU-native analog of the reference's kernel registry + YAML op definitions
+(paddle/phi/core/kernel_registry.h, paddle/phi/ops/yaml/ops.yaml:8-18). An op
+here is a pure JAX function (the "kernel body" that XLA compiles for TPU)
+plus metadata: an optional custom backward rule (analog of the `backward:`
+yaml key) and an optional SPMD sharding rule (analog of `spmd_rule:`,
+ops.yaml:97). Forward/backward execution and compile-caching live in
+dispatch.py.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+
+class OpDef:
+    """One registered op.
+
+    fn           : pure function over jax.Arrays: fn(*arrays, **attrs) -> array
+                   or tuple of arrays.
+    bwd          : optional custom VJP: bwd(saved_inputs, gouts, **attrs) ->
+                   tuple of input grads (None allowed). When absent, the
+                   dispatcher derives the VJP with jax.vjp (recompute-style,
+                   like the reference's TensorWrapper + grad kernel pairing).
+    multi_output : fn returns a tuple.
+    spmd_rule    : optional sharding propagation rule (used by distributed).
+    """
+
+    __slots__ = ("name", "fn", "bwd", "multi_output", "spmd_rule", "doc")
+
+    def __init__(self, name: str, fn: Callable, bwd: Optional[Callable] = None,
+                 multi_output: bool = False, spmd_rule=None):
+        self.name = name
+        self.fn = fn
+        self.bwd = bwd
+        self.multi_output = multi_output
+        self.spmd_rule = spmd_rule
+        self.doc = fn.__doc__
+
+
+_OPS: Dict[str, OpDef] = {}
+
+
+def register_op(name: str, fn: Callable = None, *, bwd: Callable = None,
+                multi_output: bool = False, spmd_rule=None):
+    """Register an op. Usable as decorator or direct call."""
+    def _do(f):
+        if name in _OPS:
+            raise ValueError(f"op '{name}' already registered")
+        op = OpDef(name, f, bwd=bwd, multi_output=multi_output,
+                   spmd_rule=spmd_rule)
+        _OPS[name] = op
+        return op
+
+    if fn is None:
+        return _do
+    return _do(fn)
+
+
+def get_op(name: str) -> OpDef:
+    try:
+        return _OPS[name]
+    except KeyError:
+        raise KeyError(f"op '{name}' is not registered") from None
+
+
+def all_ops() -> Dict[str, OpDef]:
+    return dict(_OPS)

@@ -1,0 +1,47 @@
+"""Global RNG state.
+
+TPU-native analog of the reference's generator (paddle/phi/core/generator.h)
+built on threefry key splitting. A single global key is split per random op;
+`paddle_tpu.seed(n)` reseeds. Mesh-axis-consistent RNG for TP dropout (the
+reference's RNGStatesTracker, fleet/layers/mpu/random.py:34) lives in
+paddle_tpu.distributed and folds axis indices into these keys.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+from . import flags
+
+_LOCK = threading.Lock()
+_state = {"key": None, "seed": None}
+
+
+def seed(s: int):
+    with _LOCK:
+        _state["seed"] = int(s)
+        _state["key"] = jax.random.PRNGKey(int(s))
+    return s
+
+
+def get_seed():
+    return _state["seed"]
+
+
+def next_key():
+    """Split the global key; returns a fresh subkey for one random op."""
+    with _LOCK:
+        if _state["key"] is None:
+            _state["seed"] = flags.flag_value("FLAGS_seed")
+            _state["key"] = jax.random.PRNGKey(_state["seed"])
+        _state["key"], sub = jax.random.split(_state["key"])
+        return sub
+
+
+def fold_in(data: int):
+    """Derive a deterministic key from the current seed and `data` without
+    advancing global state (used for per-rank / per-axis derivation)."""
+    base = _state["seed"] if _state["seed"] is not None else \
+        flags.flag_value("FLAGS_seed")
+    return jax.random.fold_in(jax.random.PRNGKey(base), data)

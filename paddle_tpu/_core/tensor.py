@@ -1,0 +1,245 @@
+"""The eager Tensor.
+
+TPU-native analog of paddle::Tensor (paddle/phi/api/include/tensor.h:82) +
+its pybind eager methods (paddle/fluid/pybind/eager_method.cc). The payload
+is a jax.Array living on the TPU via PJRT — device memory management,
+streams, and async execution are PJRT's job (the analog of the reference's
+allocator + DeviceContext stack, SURVEY.md §2a). Autograd state hangs off
+`_autograd_meta` (autograd_meta.h:61).
+
+Most operator methods are monkey-patched onto this class by paddle_tpu.ops
+(mirroring python/paddle's monkey_patch of Tensor methods).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import dtype as dtypes_mod
+from .autograd import AutogradMeta, is_grad_enabled, no_grad, run_backward
+
+
+class Tensor:
+    __slots__ = ("_value", "_stop_gradient", "_autograd_meta",
+                 "_inplace_version", "name", "persistable", "_dist_attr")
+
+    def __init__(self, value, stop_gradient: bool = True, name: str = None):
+        if isinstance(value, Tensor):
+            value = value._value
+        if not isinstance(value, (jax.Array, jax.core.Tracer)):
+            value = jnp.asarray(value)
+        self._value = value
+        self._stop_gradient = bool(stop_gradient)
+        self._autograd_meta = AutogradMeta()
+        self._inplace_version = 0
+        self.name = name
+        self.persistable = False
+        self._dist_attr = None  # set by paddle_tpu.distributed for DistTensor
+
+    # ------------------------------------------------------------- metadata
+    @property
+    def shape(self):
+        return list(self._value.shape)
+
+    @property
+    def ndim(self):
+        return self._value.ndim
+
+    @property
+    def rank(self):
+        return self._value.ndim
+
+    @property
+    def size(self):
+        return int(np.prod(self._value.shape)) if self._value.shape else 1
+
+    @property
+    def dtype(self):
+        return dtypes_mod.from_np(np.dtype(self._value.dtype))
+
+    @property
+    def place(self):
+        from . import device
+        return device.place_of(self._value)
+
+    @property
+    def is_leaf(self):
+        return self._autograd_meta.grad_node is None
+
+    @property
+    def stop_gradient(self):
+        return self._stop_gradient
+
+    @stop_gradient.setter
+    def stop_gradient(self, v: bool):
+        self._stop_gradient = bool(v)
+
+    @property
+    def grad(self) -> Optional["Tensor"]:
+        return self._autograd_meta.grad
+
+    @grad.setter
+    def grad(self, g):
+        if g is not None and not isinstance(g, Tensor):
+            g = Tensor(g, stop_gradient=True)
+        self._autograd_meta.grad = g
+
+    # ------------------------------------------------------------- autograd
+    def backward(self, grad_tensor=None, retain_graph=False):
+        run_backward([self], [grad_tensor], retain_graph=retain_graph)
+
+    def clear_grad(self):
+        self._autograd_meta.grad = None
+
+    clear_gradient = clear_grad
+
+    def register_hook(self, hook):
+        """Register a gradient hook (fires with this tensor's grad during
+        backward). Returns a removable handle."""
+        meta = self._autograd_meta
+        if meta.grad_node is not None:
+            hooks = meta.grad_node.out_hooks.setdefault(meta.out_slot, [])
+        else:
+            hooks = meta.hooks
+        hooks.append(hook)
+
+        class _Handle:
+            def remove(self_h):
+                try:
+                    hooks.remove(hook)
+                except ValueError:
+                    pass
+        return _Handle()
+
+    def detach(self) -> "Tensor":
+        t = Tensor(self._value, stop_gradient=True)
+        t.name = self.name
+        return t
+
+    def detach_(self) -> "Tensor":
+        self._stop_gradient = True
+        self._autograd_meta.grad_node = None
+        return self
+
+    # ------------------------------------------------------------- transfer
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._value)
+
+    def item(self):
+        return self._value.item()
+
+    def tolist(self):
+        return np.asarray(self._value).tolist()
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._value)
+        return a.astype(dtype) if dtype is not None else a
+
+    def __jax_array__(self):
+        return self._value
+
+    def _replace_value_inplace(self, new_value):
+        """In-place mutation: bump version (tensor_wrapper.h safety model)."""
+        self._value = new_value
+        self._inplace_version += 1
+        return self
+
+    def set_value(self, value):
+        if isinstance(value, Tensor):
+            value = value._value
+        value = jnp.asarray(value, dtype=self._value.dtype)
+        if tuple(value.shape) != tuple(self._value.shape):
+            raise ValueError(
+                f"set_value shape mismatch: {value.shape} vs "
+                f"{self._value.shape}")
+        return self._replace_value_inplace(value)
+
+    def copy_(self, other):
+        return self.set_value(other)
+
+    def get_tensor(self):
+        return self
+
+    def clone(self) -> "Tensor":
+        from ..ops import assign
+        return assign(self)
+
+    def to(self, *args, **kwargs):
+        # .to(dtype) / .to(device) minimal support
+        from ..ops import cast
+        for a in list(args) + list(kwargs.values()):
+            try:
+                d = dtypes_mod.to_dtype(a)
+                if d is not None:
+                    return cast(self, d)
+            except TypeError:
+                continue
+        return self
+
+    def block_until_ready(self):
+        jax.block_until_ready(self._value)
+        return self
+
+    # ------------------------------------------------------------- misc
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._value.shape[0]
+
+    def __bool__(self):
+        return bool(self._value)
+
+    def __int__(self):
+        return int(self._value)
+
+    def __float__(self):
+        return float(self._value)
+
+    def __format__(self, spec):
+        if self.ndim == 0:
+            return format(self.item(), spec)
+        return format(str(self), spec)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __repr__(self):
+        grad_info = "" if self._stop_gradient else ", stop_gradient=False"
+        return (f"Tensor(shape={self.shape}, dtype={self.dtype.name}"
+                f"{grad_info},\n       {np.asarray(self._value)!r})")
+
+    def __hash__(self):
+        return id(self)
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True) -> Tensor:
+    """paddle.to_tensor analog (device placement handled by JAX default)."""
+    if isinstance(data, Tensor):
+        val = data._value
+        if dtype is not None:
+            val = val.astype(dtypes_mod.to_np(dtype))
+        return Tensor(val, stop_gradient=stop_gradient)
+    np_dtype = dtypes_mod.to_np(dtype) if dtype is not None else None
+    if isinstance(data, (list, tuple)) and any(
+            isinstance(x, Tensor) for x in jax.tree_util.tree_leaves(data)):
+        data = [x.numpy() if isinstance(x, Tensor) else x for x in data]
+    val = jnp.asarray(data, dtype=np_dtype)
+    if np_dtype is None and val.dtype == jnp.float64:
+        val = val.astype(jnp.float32)  # paddle default is fp32
+    if np_dtype is None and val.dtype == jnp.int64 and not isinstance(
+            data, np.ndarray):
+        # python ints default to int64 in both frameworks; keep as is
+        pass
+    return Tensor(val, stop_gradient=stop_gradient)
+
+
+def _wrap(value, stop_gradient=True) -> Tensor:
+    return Tensor(value, stop_gradient=stop_gradient)
+
+
+def unwrap(x):
+    return x._value if isinstance(x, Tensor) else x

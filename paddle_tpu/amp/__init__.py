@@ -1,0 +1,5 @@
+from .auto_cast import auto_cast, amp_guard, white_list, black_list, \
+    decorate  # noqa: F401
+from .grad_scaler import GradScaler, AmpScaler  # noqa: F401
+
+autocast = auto_cast

@@ -1,0 +1,128 @@
+"""AMP auto-cast (python/paddle/amp/auto_cast.py:1006 analog).
+
+O1: op-allowlist casting at eager dispatch (hook installed into the
+executor, the analog of amp_auto_cast.h interception in generated ad_funcs).
+O2: cast the whole model to bf16/fp16 (`decorate`), keep norms in fp32.
+On TPU the low-precision dtype of choice is bfloat16 — no loss scaling
+needed for bf16 (GradScaler becomes a no-op unless fp16 is forced).
+"""
+from __future__ import annotations
+
+import threading
+
+import jax.numpy as jnp
+
+from .._core import dtype as dm
+from .._core.executor import set_amp_hook
+from .._core.tensor import Tensor
+
+# ops that benefit from low precision (MXU) — matmul/conv family
+WHITE_LIST = {"matmul", "linear", "conv2d", "conv3d", "conv2d_transpose",
+              "einsum_", "bmm_", "sdpa", "dot_"}
+# ops that need fp32 accuracy
+BLACK_LIST = {"exp", "log", "log2", "log10", "log1p", "softmax",
+              "log_softmax", "softmax_ce", "nll_loss_k", "bce_k",
+              "bce_logits_k", "mse_loss_k", "p_norm_", "std_", "var_",
+              "layer_norm", "rms_norm", "group_norm", "bn_apply",
+              "bn_stats", "cumsum_", "logsumexp", "mean", "sum_",
+              "kl_div_k", "erfinv", "pow", "reciprocal", "rsqrt"}
+
+
+def white_list():
+    return set(WHITE_LIST)
+
+
+def black_list():
+    return set(BLACK_LIST)
+
+
+_STATE = threading.local()
+
+
+def _amp_state():
+    return getattr(_STATE, "amp", None)
+
+
+def _hook(op_name, tensors):
+    state = _amp_state()
+    if state is None:
+        return tensors
+    level, target = state
+    if level == "O0":
+        return tensors
+    low = dm.to_np(target)
+    if op_name in WHITE_LIST:
+        out = []
+        for t in tensors:
+            if t is not None and jnp.issubdtype(t._value.dtype,
+                                                jnp.floating) and \
+                    t._value.dtype != low:
+                from ..ops.manipulation import cast
+                t = cast(t, target)
+            out.append(t)
+        return out
+    if op_name in BLACK_LIST:
+        out = []
+        for t in tensors:
+            if t is not None and t._value.dtype in (jnp.bfloat16,
+                                                    jnp.float16):
+                from ..ops.manipulation import cast
+                t = cast(t, "float32")
+            out.append(t)
+        return out
+    return tensors
+
+
+set_amp_hook(_hook)
+
+
+class auto_cast:
+    """Context manager: `with paddle.amp.auto_cast(level='O1'):`"""
+
+    def __init__(self, enable=True, custom_white_list=None,
+                 custom_black_list=None, level="O1", dtype="bfloat16",
+                 use_promote=True):
+        if dtype == "float16":
+            dtype = "float16"
+        self.enable = enable
+        self.level = level if enable else "O0"
+        self.dtype = dtype
+        self.custom_white = set(custom_white_list or ())
+        self.custom_black = set(custom_black_list or ())
+
+    def __enter__(self):
+        self._prev = _amp_state()
+        self._added_w = self.custom_white - WHITE_LIST
+        self._added_b = self.custom_black - BLACK_LIST
+        WHITE_LIST.update(self._added_w)
+        BLACK_LIST.update(self._added_b)
+        _STATE.amp = (self.level, self.dtype) if self.enable else None
+        return self
+
+    def __exit__(self, *exc):
+        _STATE.amp = self._prev
+        WHITE_LIST.difference_update(self._added_w)
+        BLACK_LIST.difference_update(self._added_b)
+        return False
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """O2: cast model params to low precision (norm layers stay fp32 via
+    their own kernels' upcast); optimizer gets multi_precision."""
+    single = not isinstance(models, (list, tuple))
+    model_list = [models] if single else list(models)
+    if level == "O2":
+        for m in model_list:
+            m.astype(dtype)
+    if optimizers is None:
+        return models if single else model_list
+    opt_single = not isinstance(optimizers, (list, tuple))
+    opt_list = [optimizers] if opt_single else list(optimizers)
+    for o in opt_list:
+        o._multi_precision = True
+    return (models if single else model_list), \
+        (optimizers if opt_single else opt_list)

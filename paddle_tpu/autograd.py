@@ -1,0 +1,121 @@
+"""paddle_tpu.autograd — PyLayer, backward, grad.
+
+PyLayer analog of python/paddle/autograd/py_layer.py:282 +
+paddle/fluid/eager/pylayer/: user-defined forward/backward in Python,
+wired into the GradNode engine via a py_bwd node.
+"""
+from __future__ import annotations
+
+from typing import Any, List
+
+from ._core.autograd import GradNode, _Edge, grad, is_grad_enabled, \
+    no_grad, run_backward  # noqa: F401
+from ._core.tensor import Tensor
+
+__all__ = ["PyLayer", "PyLayerContext", "backward", "grad", "no_grad"]
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    run_backward(tensors, grad_tensors, retain_graph)
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved: List[Tensor] = []
+        self.materialize_grads = True
+        self.not_inplace_tensors = ()
+
+    def save_for_backward(self, *tensors):
+        self._saved = list(tensors)
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def saved_tensors(self):
+        return self._saved
+
+    def mark_not_inplace(self, *args):
+        self.not_inplace_tensors = args
+
+    def mark_non_differentiable(self, *args):
+        self._non_diff = getattr(self, "_non_diff", []) + list(args)
+        for t in args:
+            t.stop_gradient = True
+
+    def set_materialize_grads(self, value):
+        self.materialize_grads = bool(value)
+
+
+class PyLayerMeta(type):
+    def __init__(cls, name, bases, attrs):
+        super().__init__(name, bases, attrs)
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+        with no_grad():
+            outs = cls.forward(ctx, *args, **kwargs)
+        single = not isinstance(outs, (tuple, list))
+        out_list = [outs] if single else list(outs)
+        out_tensors = [o for o in out_list if isinstance(o, Tensor)]
+
+        non_diff = {id(t) for t in getattr(ctx, "_non_diff", [])}
+        out_tensors = [t for t in out_tensors if id(t) not in non_diff]
+        if is_grad_enabled() and any(not t.stop_gradient
+                                     for t in tensor_inputs):
+            import jax.numpy as jnp
+            edges = []
+            for t in tensor_inputs:
+                if t.stop_gradient:
+                    edges.append(_Edge(None))
+                else:
+                    meta = t._autograd_meta
+                    if meta.grad_node is not None:
+                        edges.append(_Edge("node", node=meta.grad_node,
+                                           slot=meta.out_slot))
+                    else:
+                        edges.append(_Edge("leaf", leaf=t))
+            node = GradNode(
+                None, {}, (), edges,
+                out_shapes=tuple(tuple(t.shape) for t in out_tensors),
+                out_dtypes=tuple(t._value.dtype for t in out_tensors))
+            node.name = cls.__name__
+
+            def py_bwd(gouts, _ctx=ctx, _cls=cls, _n=len(tensor_inputs)):
+                gts = [Tensor(g, stop_gradient=True) for g in gouts]
+                with no_grad():
+                    res = _cls.backward(_ctx, *gts)
+                res_list = [res] if isinstance(res, Tensor) or res is None \
+                    else list(res)
+                out = []
+                for r in res_list:
+                    out.append(None if r is None else r._value)
+                # pad to input count
+                while len(out) < _n:
+                    out.append(None)
+                return tuple(out)
+
+            node.py_bwd = py_bwd
+            for i, t in enumerate(out_tensors):
+                if jnp.issubdtype(t._value.dtype, jnp.inexact):
+                    t.stop_gradient = False
+                    m = t._autograd_meta
+                    m.grad_node = node
+                    m.out_slot = i
+        return outs
+
+
+class LegacyPyLayer(PyLayer):
+    pass

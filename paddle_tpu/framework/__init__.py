@@ -1,0 +1,54 @@
+"""paddle_tpu.framework — save/load + misc framework surface.
+
+paddle.save/load analog (python/paddle/framework/io.py:773,1020): pickled
+state dicts with tensors materialized to numpy.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from .._core.tensor import Tensor
+
+__all__ = ["save", "load", "seed"]
+
+
+def _to_saveable(obj):
+    if isinstance(obj, Tensor):
+        return {"__tensor__": True, "data": np.asarray(obj._value),
+                "stop_gradient": obj.stop_gradient}
+    if isinstance(obj, dict):
+        return {k: _to_saveable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_to_saveable(v) for v in obj)
+    return obj
+
+
+def _from_saved(obj):
+    if isinstance(obj, dict):
+        if obj.get("__tensor__"):
+            return Tensor(obj["data"], stop_gradient=obj["stop_gradient"])
+        return {k: _from_saved(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_from_saved(v) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_saveable(obj), f, protocol=protocol)
+
+
+def load(path, **configs):
+    with open(path, "rb") as f:
+        return _from_saved(pickle.load(f))
+
+
+def seed(s):
+    from .._core import random as rnd
+    return rnd.seed(s)

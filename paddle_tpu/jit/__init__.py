@@ -1,0 +1,2 @@
+from .api import to_static, not_to_static, ignore_module, save, load, \
+    TranslatedLayer, InputSpec  # noqa: F401

@@ -1,0 +1,247 @@
+"""to_static: the compile path.
+
+Analog of python/paddle/jit/api.py:197 @to_static + SOT/AST capture
+(SURVEY §3.3) rebuilt the XLA-native way: instead of bytecode translation
+to a program IR, the module is functionalized (params/buffers become
+explicit inputs via nn.functional_call) and traced by jax.jit straight to
+StableHLO. The whole forward becomes ONE cached XLA executable; backward is
+a second executable derived by jax.vjp (recompute-style residuals = remat,
+the TPU-friendly memory/compute trade). Guards/recompile-on-shape-change
+come free from jit's signature cache.
+"""
+from __future__ import annotations
+
+import functools
+import os
+import pickle
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .._core.autograd import GradNode, _Edge, is_grad_enabled, no_grad
+from .._core.tensor import Tensor
+from ..nn.layer import Layer, Parameter, functional_call
+
+
+class InputSpec:
+    def __init__(self, shape=None, dtype="float32", name=None,
+                 stop_gradient=False):
+        self.shape = shape
+        self.dtype = dtype
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+
+def _is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def _unwrap_tree(obj):
+    return jax.tree_util.tree_map(
+        lambda x: x._value if isinstance(x, Tensor) else x, obj,
+        is_leaf=_is_tensor)
+
+
+def _wrap_tree(obj):
+    return jax.tree_util.tree_map(
+        lambda x: Tensor(x) if isinstance(
+            x, (jax.Array, jax.core.Tracer, np.ndarray)) else x, obj)
+
+
+class StaticFunction:
+    """Compiled callable wrapping a Layer's forward or a plain function.
+
+    Training works through the eager engine: each call registers ONE fused
+    GradNode whose backward is the jitted VJP over (params, inputs) —
+    forward and backward are each a single cached XLA executable.
+    """
+
+    def __init__(self, fn, layer: Optional[Layer] = None, input_spec=None,
+                 build_strategy=None, backend=None, full_graph=True):
+        self._fn = fn
+        self._layer = layer
+        self._input_spec = input_spec
+        self._fwd_cache: Dict[Any, Callable] = {}
+        self._bwd_cache: Dict[Any, Callable] = {}
+        try:
+            functools.update_wrapper(self, fn)
+        except Exception:
+            pass
+
+    def _make_pure(self, names):
+        layer = self._layer
+        fn = self._fn
+        sf = self
+
+        def pure(svals: List, args, kwargs):
+            targs = _wrap_tree(args)
+            tkwargs = _wrap_tree(kwargs)
+            with no_grad():
+                if layer is not None:
+                    state = dict(zip(names, svals))
+                    # layer.forward currently points at this StaticFunction;
+                    # restore the original bound forward while tracing
+                    layer.forward = fn
+                    try:
+                        out, bufs = functional_call(
+                            layer, state, *targs, return_buffers=True,
+                            **tkwargs)
+                    finally:
+                        layer.forward = sf
+                else:
+                    out = fn(*targs, **tkwargs)
+                    bufs = {}
+            return _unwrap_tree(out), bufs
+        return pure
+
+    def __call__(self, *args, **kwargs):
+        if self._layer is not None:
+            state = self._layer.state_dict()
+            names = list(state.keys())
+            state_tensors = list(state.values())
+        else:
+            names, state_tensors = [], []
+        svals = [t._value for t in state_tensors]
+        avals = _unwrap_tree(args)
+        kwvals = _unwrap_tree(kwargs)
+
+        key = (tuple(names), self._layer.training if self._layer else None)
+        if key not in self._fwd_cache:
+            pure = self._make_pure(names)
+            self._fwd_cache[key] = jax.jit(pure)
+
+            def bwd(svals_, args_, kwargs_, cotangents):
+                def f(s, a, k):
+                    out, _ = pure(s, a, k)
+                    return out
+                _, pull = jax.vjp(f, svals_, args_, kwargs_)
+                return pull(cotangents)
+            self._bwd_cache[key] = jax.jit(bwd)
+
+        out_vals, buf_vals = self._fwd_cache[key](svals, avals, kwvals)
+
+        # write back updated buffers (BN running stats etc.)
+        if buf_vals and self._layer is not None:
+            sd = self._layer.state_dict()
+            for bname, bval in buf_vals.items():
+                t = sd.get(bname)
+                if t is not None and not isinstance(t, Parameter):
+                    t._replace_value_inplace(bval)
+
+        out_leaves, out_tree = jax.tree_util.tree_flatten(out_vals)
+        out_tensors = [Tensor(v) for v in out_leaves]
+
+        arg_tensors = [a for a in jax.tree_util.tree_leaves(
+            (args, kwargs), is_leaf=_is_tensor) if isinstance(a, Tensor)]
+        in_tensors = state_tensors + arg_tensors
+        if is_grad_enabled() and any(not t.stop_gradient
+                                     for t in in_tensors):
+            self._record_grad(key, svals, avals, kwvals, in_tensors,
+                              out_tensors, out_tree)
+        return jax.tree_util.tree_unflatten(out_tree, out_tensors)
+
+    def _record_grad(self, key, svals, avals, kwvals, in_tensors,
+                     out_tensors, out_tree):
+        edges = []
+        for t in in_tensors:
+            if t.stop_gradient:
+                edges.append(_Edge(None))
+            else:
+                meta = t._autograd_meta
+                if meta.grad_node is not None:
+                    edges.append(_Edge("node", node=meta.grad_node,
+                                       slot=meta.out_slot))
+                else:
+                    edges.append(_Edge("leaf", leaf=t))
+        node = GradNode(
+            None, {}, (), edges,
+            out_shapes=tuple(tuple(t.shape) for t in out_tensors),
+            out_dtypes=tuple(t._value.dtype for t in out_tensors))
+        node.name = f"to_static({getattr(self._fn, '__name__', 'fn')})"
+        bwd_exec = self._bwd_cache[key]
+
+        def py_bwd(gouts, _svals=svals, _avals=avals, _kwvals=kwvals,
+                   _tree=out_tree):
+            ct = jax.tree_util.tree_unflatten(_tree, list(gouts))
+            g_state, g_args, g_kwargs = bwd_exec(_svals, _avals, _kwvals, ct)
+            grads = list(g_state) + list(
+                jax.tree_util.tree_leaves((g_args, g_kwargs)))
+            out = []
+            for g in grads:
+                if g is None or (hasattr(g, "dtype")
+                                 and g.dtype == jax.dtypes.float0):
+                    out.append(None)
+                else:
+                    out.append(g)
+            return tuple(out)
+
+        node.py_bwd = py_bwd
+        for i, t in enumerate(out_tensors):
+            if jnp.issubdtype(t._value.dtype, jnp.inexact):
+                t.stop_gradient = False
+                m = t._autograd_meta
+                m.grad_node = node
+                m.out_slot = i
+
+    def concrete_program(self):
+        return None
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, full_graph=True, **kwargs):
+    """Decorator/wrapper: compile a Layer's forward or a function into a
+    cached XLA executable. Usable standalone or inside training loops."""
+    def _build(fn):
+        if isinstance(fn, Layer):
+            sf = StaticFunction(fn.forward, layer=fn, input_spec=input_spec)
+            fn.forward = sf
+            return fn
+        return StaticFunction(fn, layer=None, input_spec=input_spec)
+
+    if function is not None:
+        return _build(function)
+    return _build
+
+
+def not_to_static(fn=None):
+    return fn
+
+
+def ignore_module(modules):
+    pass
+
+
+class TranslatedLayer(Layer):
+    """Deserialized inference layer (fluid/jit/layer.h analog)."""
+
+    def __init__(self, state, forward_fn):
+        super().__init__()
+        self._state = state
+        self._forward_fn = forward_fn
+
+    def forward(self, *args):
+        return self._forward_fn(*args)
+
+
+def save(layer, path, input_spec=None, **configs):
+    """paddle.jit.save analog: persist params + module config. Round-1
+    format: pickled numpy state dict + class info (StableHLO export TBD)."""
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    state = {k: np.asarray(v._value)
+             for k, v in layer.state_dict().items()}
+    with open(path + ".pdiparams", "wb") as f:
+        pickle.dump(state, f)
+    with open(path + ".pdmodel", "wb") as f:
+        pickle.dump({"class": type(layer).__name__}, f)
+
+
+def load(path, **configs):
+    with open(path + ".pdiparams", "rb") as f:
+        pickle.load(f)
+    raise NotImplementedError(
+        "jit.load requires the model class; use paddle_tpu.load for state "
+        "dicts (program deserialization lands with the IR layer)")

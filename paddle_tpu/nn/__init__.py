@@ -1,0 +1,24 @@
+"""paddle_tpu.nn — layers, functionals, initializers."""
+from __future__ import annotations
+
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from .layer import Layer, Parameter, create_parameter, functional_call  # noqa: F401
+from .param_attr import ParamAttr  # noqa: F401
+from .layers_common import *  # noqa: F401,F403
+from .layers_activation import *  # noqa: F401,F403
+from .layers_activation import (  # noqa: F401
+    ReLU, ReLU6, GELU, Sigmoid, Tanh, Silu, Swish, Mish, LeakyReLU, ELU,
+    CELU, SELU, Hardswish, Hardsigmoid, Hardtanh, Hardshrink, Softshrink,
+    Softplus, Softsign, Tanhshrink, ThresholdedReLU, LogSoftmax, GLU,
+    Softmax, PReLU, CrossEntropyLoss, MSELoss, L1Loss, NLLLoss, BCELoss,
+    BCEWithLogitsLoss, SmoothL1Loss, KLDivLoss, MarginRankingLoss)
+from .transformer import (MultiHeadAttention, TransformerEncoderLayer,  # noqa: F401
+                          TransformerEncoder, TransformerDecoderLayer,
+                          TransformerDecoder, Transformer)
+from .clip import ClipGradByNorm, ClipGradByValue, ClipGradByGlobalNorm  # noqa: F401
+from .utils_weight_norm import weight_norm, remove_weight_norm  # noqa: F401
+
+# activations & other tensor methods registered after ops init:
+from ..ops._helper import attach_tensor_methods as _attach
+_attach()

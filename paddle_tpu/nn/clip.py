@@ -1,0 +1,95 @@
+"""Gradient clipping (python/paddle/nn/clip.py analog).
+
+ClipGradByGlobalNorm is the one the hybrid-parallel optimizer re-implements
+across mesh axes (reference hybrid_parallel_optimizer.py:275); the
+distributed variant lives in paddle_tpu.distributed.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .._core.autograd import no_grad
+from .._core.tensor import Tensor
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -float(max)
+
+    @no_grad()
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            out.append((p, Tensor(jnp.clip(g._value, self.min, self.max))))
+        return out
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    @no_grad()
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            norm = jnp.sqrt(jnp.sum(jnp.square(g._value.astype(
+                jnp.float32))))
+            scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12),
+                                1.0)
+            out.append((p, Tensor((g._value.astype(jnp.float32) * scale)
+                                  .astype(g._value.dtype))))
+        return out
+
+
+@jax.jit
+def _global_norm(vals):
+    return jnp.sqrt(sum(jnp.sum(jnp.square(v.astype(jnp.float32)))
+                        for v in vals))
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, group_name="default_group",
+                 auto_skip_clip=False):
+        self.clip_norm = float(clip_norm)
+        self.group_name = group_name
+
+    @no_grad()
+    def __call__(self, params_grads):
+        grads = [g._value for _, g in params_grads if g is not None]
+        if not grads:
+            return params_grads
+        gnorm = _global_norm(grads)
+        scale = jnp.minimum(self.clip_norm / jnp.maximum(gnorm, 1e-12), 1.0)
+        out = []
+        for p, g in params_grads:
+            if g is None or (hasattr(p, "need_clip") and not p.need_clip):
+                out.append((p, g))
+                continue
+            out.append((p, Tensor((g._value.astype(jnp.float32) * scale)
+                                  .astype(g._value.dtype))))
+        return out
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    params = [p for p in parameters if p.grad is not None]
+    if not params:
+        return Tensor(jnp.zeros(()))
+    gnorm = _global_norm([p.grad._value for p in params])
+    scale = jnp.minimum(float(max_norm) / jnp.maximum(gnorm, 1e-12), 1.0)
+    for p in params:
+        p.grad = Tensor(p.grad._value * scale.astype(p.grad._value.dtype))
+    return Tensor(gnorm)

@@ -1,0 +1,171 @@
+"""Activation functionals (python/paddle/nn/functional/activation.py analog
+over the reference's activation phi kernels). Single fused XLA ops."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..._core.executor import apply
+from ..._core.op_registry import register_op
+from ...ops._helper import def_unary
+
+relu = def_unary("relu", jax.nn.relu)
+relu6 = def_unary("relu6", jax.nn.relu6)
+silu = def_unary("silu", jax.nn.silu)
+swish = silu
+softsign = def_unary("softsign", jax.nn.soft_sign)
+sigmoid = def_unary("sigmoid_f", jax.nn.sigmoid)
+tanh_ = def_unary("tanh_f", jnp.tanh)
+mish = def_unary("mish", lambda x: x * jnp.tanh(jax.nn.softplus(x)))
+tanhshrink = def_unary("tanhshrink", lambda x: x - jnp.tanh(x))
+hardswish = def_unary("hardswish", jax.nn.hard_swish)
+hardsigmoid = def_unary("hardsigmoid",
+                        lambda x: jnp.clip(x / 6.0 + 0.5, 0.0, 1.0))
+
+
+def tanh(x, name=None):
+    return tanh_(x)
+
+
+register_op("gelu", lambda x, approximate: jax.nn.gelu(
+    x, approximate=approximate))
+
+
+def gelu(x, approximate=False, name=None):
+    return apply("gelu", x, approximate=bool(approximate))
+
+
+register_op("leaky_relu", lambda x, negative_slope: jax.nn.leaky_relu(
+    x, negative_slope))
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply("leaky_relu", x, negative_slope=float(negative_slope))
+
+
+register_op("elu", lambda x, alpha: jax.nn.elu(x, alpha))
+
+
+def elu(x, alpha=1.0, name=None):
+    return apply("elu", x, alpha=float(alpha))
+
+
+register_op("celu", lambda x, alpha: jax.nn.celu(x, alpha))
+
+
+def celu(x, alpha=1.0, name=None):
+    return apply("celu", x, alpha=float(alpha))
+
+
+register_op("selu", lambda x, scale, alpha: scale * jnp.where(
+    x > 0, x, alpha * jnp.expm1(x)))
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return apply("selu", x, scale=float(scale), alpha=float(alpha))
+
+
+register_op("hardtanh", lambda x, mn, mx: jnp.clip(x, mn, mx))
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return apply("hardtanh", x, mn=float(min), mx=float(max))
+
+
+register_op("hardshrink", lambda x, threshold: jnp.where(
+    jnp.abs(x) > threshold, x, 0.0))
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return apply("hardshrink", x, threshold=float(threshold))
+
+
+register_op("softshrink", lambda x, threshold: jnp.where(
+    x > threshold, x - threshold, jnp.where(x < -threshold, x + threshold,
+                                            0.0)))
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return apply("softshrink", x, threshold=float(threshold))
+
+
+register_op("softplus", lambda x, beta, threshold: jnp.where(
+    x * beta > threshold, x, jax.nn.softplus(x * beta) / beta))
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return apply("softplus", x, beta=float(beta), threshold=float(threshold))
+
+
+register_op("thresholded_relu", lambda x, threshold, value: jnp.where(
+    x > threshold, x, value))
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return apply("thresholded_relu", x, threshold=float(threshold),
+                 value=float(value))
+
+
+register_op("softmax", lambda x, axis: jax.nn.softmax(x, axis=axis))
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    if dtype is not None:
+        from ...ops.manipulation import cast
+        x = cast(x, dtype)
+    return apply("softmax", x, axis=int(axis))
+
+
+register_op("log_softmax", lambda x, axis: jax.nn.log_softmax(x, axis=axis))
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    if dtype is not None:
+        from ...ops.manipulation import cast
+        x = cast(x, dtype)
+    return apply("log_softmax", x, axis=int(axis))
+
+
+register_op("prelu_k", lambda x, w: jnp.where(x >= 0, x, w * x))
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    w = weight
+    if w.size > 1:
+        # per-channel: reshape for broadcast on the channel axis
+        from ...ops.manipulation import reshape
+        if data_format == "NCHW":
+            shape = [1, w.size] + [1] * (x.ndim - 2)
+        else:
+            shape = [1] * (x.ndim - 1) + [w.size]
+        w = reshape(w, shape)
+    return apply("prelu_k", x, w)
+
+
+register_op("glu_k", lambda x, axis: (
+    lambda a, b: a * jax.nn.sigmoid(b))(*jnp.split(x, 2, axis=axis)))
+
+
+def glu(x, axis=-1, name=None):
+    return apply("glu_k", x, axis=int(axis))
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ..._core import random as rnd
+    from ..._core.tensor import Tensor
+    g = Tensor(jax.random.gumbel(rnd.next_key(), tuple(x.shape),
+                                 x._value.dtype))
+    y = softmax((x + g) / temperature, axis=axis)
+    if hard:
+        # straight-through
+        from ...ops.search import argmax
+        from ...ops.creation import zeros_like
+        idx = argmax(y, axis=axis, keepdim=True)
+        from ...ops.search import put_along_axis
+        hard_y = put_along_axis(zeros_like(y), idx, 1.0, axis=axis)
+        y = (hard_y - y).detach() + y
+    return y
+
+
+def silu_(x):
+    return silu(x)

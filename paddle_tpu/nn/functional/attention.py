@@ -1,0 +1,60 @@
+"""Attention functionals.
+
+scaled_dot_product_attention analog of the reference's
+nn/functional/flash_attention.py surface; the XLA path fuses softmax(QK^T)V
+well on TPU, and the Pallas flash kernel (paddle_tpu/ops/pallas) replaces it
+for long sequences.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..._core.executor import apply
+from ..._core.op_registry import register_op
+
+
+def _sdpa_kernel(q, k, v, mask, dropout_key, dropout_p, causal, scale,
+                 training):
+    # shapes: [B, S, H, D] (paddle convention)
+    qh = jnp.swapaxes(q, 1, 2)  # B,H,S,D
+    kh = jnp.swapaxes(k, 1, 2)
+    vh = jnp.swapaxes(v, 1, 2)
+    d = q.shape[-1]
+    s = scale if scale is not None else 1.0 / jnp.sqrt(d).astype(q.dtype)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * s
+    if causal:
+        sq, sk = logits.shape[-2], logits.shape[-1]
+        cmask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        logits = jnp.where(cmask, logits, jnp.array(-1e30, logits.dtype))
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            logits = jnp.where(mask, logits, jnp.array(-1e30, logits.dtype))
+        else:
+            logits = logits + mask
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(
+        q.dtype)
+    if dropout_p > 0.0 and training:
+        keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_p,
+                                    probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p),
+                          jnp.zeros((), probs.dtype))
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vh)
+    return jnp.swapaxes(out, 1, 2)  # back to B,S,H,D
+
+
+register_op("sdpa", _sdpa_kernel)
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, scale=None, name=None):
+    """Inputs [batch, seq, heads, head_dim] like the reference
+    (python/paddle/nn/functional/flash_attention.py)."""
+    from ..._core import random as rnd
+    from ..._core.tensor import Tensor
+    key_arr = Tensor(rnd.next_key()) if (dropout_p > 0.0 and training) \
+        else Tensor(jnp.zeros((2,), jnp.uint32))
+    return apply("sdpa", query, key, value, attn_mask, key_arr,
+                 dropout_p=float(dropout_p), causal=bool(is_causal),
+                 scale=scale, training=bool(training))

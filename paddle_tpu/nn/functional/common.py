@@ -1,0 +1,198 @@
+"""Common functionals: linear, dropout, normalize, interpolate, ...
+
+Analog of python/paddle/nn/functional/common.py. `linear` is the MXU
+workhorse; dropout consumes the global threefry key (key passed as a device
+operand so the compiled executable is reused across steps).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..._core import random as rnd
+from ..._core.executor import apply
+from ..._core.op_registry import register_op
+from ..._core.tensor import Tensor
+from ...ops.manipulation import pad  # noqa: F401  (re-export)
+
+
+def _linear_kernel(x, w, b):
+    out = jnp.matmul(x, w)
+    if b is not None:
+        out = out + b
+    return out
+
+
+register_op("linear", _linear_kernel)
+
+
+def linear(x, weight, bias=None, name=None):
+    return apply("linear", x, weight, bias)
+
+
+def _dropout_kernel(x, key, p, mode):
+    if mode == "upscale_in_train":
+        keep = jax.random.bernoulli(key, 1.0 - p, x.shape)
+        return jnp.where(keep, x / (1.0 - p), jnp.zeros((), x.dtype))
+    keep = jax.random.bernoulli(key, 1.0 - p, x.shape)
+    return jnp.where(keep, x, jnp.zeros((), x.dtype))
+
+
+register_op("dropout_k", _dropout_kernel)
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    if p == 0.0:
+        return x
+    if not training:
+        # reference semantics: downscale_in_infer scales by (1-p) at eval
+        return x if mode == "upscale_in_train" else x * (1.0 - p)
+    if axis is not None:
+        # broadcast dropout along given axes (paddle axis semantics)
+        shape = [1] * x.ndim
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        for a in axes:
+            shape[a] = x.shape[a]
+        key = Tensor(rnd.next_key())
+        mask_src = apply("dropout_k", Tensor(jnp.ones(shape, x._value.dtype)),
+                         key, p=float(p), mode=mode)
+        return x * mask_src
+    key = Tensor(rnd.next_key())
+    return apply("dropout_k", x, key, p=float(p), mode=mode)
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    a = ((1 - p) * (1 + p * alpha_p ** 2)) ** -0.5
+    b = -a * alpha_p * p
+    key = Tensor(rnd.next_key())
+    keep = Tensor(jax.random.bernoulli(key._value, 1.0 - p, tuple(x.shape)))
+    from ...ops.search import where
+    from ...ops.creation import full_like
+    y = where(keep, x, full_like(x, alpha_p))
+    return y * a + b
+
+
+register_op("normalize_k", lambda x, p, axis, eps: x / jnp.maximum(
+    jnp.linalg.norm(x, ord=p, axis=axis, keepdims=True), eps))
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    return apply("normalize_k", x, p=p, axis=int(axis), eps=float(epsilon))
+
+
+def _cos_sim_kernel(x, y, axis, eps):
+    xn = jnp.linalg.norm(x, axis=axis, keepdims=True)
+    yn = jnp.linalg.norm(y, axis=axis, keepdims=True)
+    return jnp.sum(x * y, axis=axis) / jnp.maximum(
+        xn * yn, eps).squeeze(axis)
+
+
+register_op("cosine_similarity_k", _cos_sim_kernel)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    return apply("cosine_similarity_k", x1, x2, axis=int(axis),
+                 eps=float(eps))
+
+
+def _interp_kernel(x, size, mode, align_corners, data_format):
+    if data_format == "NCHW":
+        x = jnp.transpose(x, (0, 2, 3, 1))
+    n, h, w, c = x.shape
+    oh, ow = size
+    method = {"nearest": "nearest", "bilinear": "linear",
+              "bicubic": "cubic", "area": "linear"}[mode]
+    out = jax.image.resize(x, (n, oh, ow, c), method=method)
+    if data_format == "NCHW":
+        out = jnp.transpose(out, (0, 3, 1, 2))
+    return out
+
+
+register_op("interpolate_k", _interp_kernel)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW",
+                name=None):
+    if size is None:
+        if data_format == "NCHW":
+            h, w = x.shape[2], x.shape[3]
+        else:
+            h, w = x.shape[1], x.shape[2]
+        sf = scale_factor if isinstance(scale_factor, (list, tuple)) \
+            else (scale_factor, scale_factor)
+        size = (int(h * sf[0]), int(w * sf[1]))
+    if isinstance(size, Tensor):
+        size = tuple(int(s) for s in size.tolist())
+    return apply("interpolate_k", x, size=tuple(int(s) for s in size),
+                 mode=mode, align_corners=bool(align_corners),
+                 data_format=data_format)
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format="NCHW",
+             name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners,
+                       align_mode, data_format)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    k = label.shape[-1]
+    if prior_dist is not None:
+        return label * (1 - epsilon) + epsilon * prior_dist
+    return label * (1 - epsilon) + epsilon / k
+
+
+def _bilinear_kernel(x1, x2, w, b):
+    # w: [out, in1, in2]
+    out = jnp.einsum("bi,oij,bj->bo", x1, w, x2)
+    if b is not None:
+        out = out + b
+    return out
+
+
+register_op("bilinear_k", _bilinear_kernel)
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    return apply("bilinear_k", x1, x2, weight, bias)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    ks = kernel_sizes if isinstance(kernel_sizes, (list, tuple)) \
+        else [kernel_sizes] * 2
+    st = strides if isinstance(strides, (list, tuple)) else [strides] * 2
+    pd = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 2
+    dl = dilations if isinstance(dilations, (list, tuple)) \
+        else [dilations] * 2
+    return apply("unfold_k", x, ks=tuple(ks), st=tuple(st), pd=tuple(pd),
+                 dl=tuple(dl))
+
+
+def _unfold_kernel(x, ks, st, pd, dl):
+    n, c, h, w = x.shape
+    patches = jax.lax.conv_general_dilated_patches(
+        x, filter_shape=ks, window_strides=st,
+        padding=[(pd[0], pd[0]), (pd[1], pd[1])], rhs_dilation=dl,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    n2, ckk, oh, ow = patches.shape
+    return patches.reshape(n2, ckk, oh * ow)
+
+
+register_op("unfold_k", _unfold_kernel)

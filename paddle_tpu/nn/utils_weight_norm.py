@@ -1,0 +1,52 @@
+"""weight_norm / spectral_norm utilities (python/paddle/nn/utils analog)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .layer import Parameter
+
+
+def _norm_except_t(w, dim):
+    # tensor-op version so autograd flows to v and g
+    from ..ops import math as M, reduction as R
+    axes = tuple(i for i in range(w.ndim) if i != dim)
+    return M.sqrt(R.sum(M.square(w), axis=axes, keepdim=True))
+
+
+def weight_norm(layer, name="weight", dim=0):
+    w = getattr(layer, name)
+    if dim is None:
+        dim = 0
+    g_init = jnp.sqrt(jnp.sum(
+        jnp.square(w._value),
+        axis=tuple(i for i in range(w._value.ndim) if i != dim)))
+    g = Parameter(g_init)
+    v = Parameter(w._value)
+    layer.register_parameter(name + "_g", g)
+    layer.register_parameter(name + "_v", v)
+    del layer._parameters[name]
+
+    def _recompute(self_layer, inputs):
+        shape = [1] * v.ndim
+        shape[dim] = -1
+        normed = v / _norm_except_t(v, dim)
+        new_w = normed * g.reshape(shape)
+        object.__setattr__(layer, name, new_w)
+
+    layer.register_forward_pre_hook(_recompute)
+    _recompute(layer, None)
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    if name + "_g" in layer._parameters:
+        w = getattr(layer, name)
+        layer.register_parameter(name, Parameter(w._value))
+        del layer._parameters[name + "_g"]
+        del layer._parameters[name + "_v"]
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=0):
+    raise NotImplementedError

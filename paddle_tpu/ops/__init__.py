@@ -1,0 +1,121 @@
+"""paddle_tpu.ops — the op surface, re-exported into the top-level package.
+
+Also monkey-patches Tensor with methods and python operators (the analog of
+python/paddle monkey-patching Tensor methods onto the pybind eager tensor).
+"""
+from __future__ import annotations
+
+from .._core.tensor import Tensor, to_tensor
+from . import _helper, creation, indexing, linalg, manipulation, math, \
+    reduction, search  # noqa: F401
+
+from .creation import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .reduction import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+
+from .math import (add, subtract, multiply, divide, floor_divide, mod, pow,
+                   neg, abs, equal, not_equal, greater_than, greater_equal,
+                   less_than, less_equal, logical_and, logical_or,
+                   logical_not, bitwise_and, bitwise_or, bitwise_xor,
+                   bitwise_not)
+from .linalg import matmul
+
+
+def _adopt(self, out):
+    """Adopt a functional result as this tensor's new value (in-place ops)."""
+    self._value = out._value
+    self._autograd_meta = out._autograd_meta
+    self._stop_gradient = out._stop_gradient
+    self._inplace_version += 1
+    return self
+
+
+Tensor._adopt = _adopt
+
+
+# ------------------------------------------------------------- operators
+def _rbin(fn):
+    def op(self, other):
+        other = other if isinstance(other, Tensor) else to_tensor(other)
+        return fn(other, self)
+    return op
+
+
+Tensor.__add__ = lambda s, o: add(s, o)
+Tensor.__radd__ = lambda s, o: add(s, o)
+Tensor.__sub__ = lambda s, o: subtract(s, o)
+Tensor.__rsub__ = _rbin(subtract)
+Tensor.__mul__ = lambda s, o: multiply(s, o)
+Tensor.__rmul__ = lambda s, o: multiply(s, o)
+Tensor.__truediv__ = lambda s, o: divide(s, o)
+Tensor.__rtruediv__ = _rbin(divide)
+Tensor.__floordiv__ = lambda s, o: floor_divide(s, o)
+Tensor.__rfloordiv__ = _rbin(floor_divide)
+Tensor.__mod__ = lambda s, o: mod(s, o)
+Tensor.__rmod__ = _rbin(mod)
+Tensor.__pow__ = lambda s, o: pow(s, o)
+Tensor.__rpow__ = _rbin(pow)
+Tensor.__neg__ = lambda s: neg(s)
+Tensor.__abs__ = lambda s: abs(s)
+Tensor.__matmul__ = lambda s, o: matmul(s, o)
+Tensor.__rmatmul__ = _rbin(matmul)
+Tensor.__eq__ = lambda s, o: equal(s, o)
+Tensor.__ne__ = lambda s, o: not_equal(s, o)
+Tensor.__gt__ = lambda s, o: greater_than(s, o)
+Tensor.__ge__ = lambda s, o: greater_equal(s, o)
+Tensor.__lt__ = lambda s, o: less_than(s, o)
+Tensor.__le__ = lambda s, o: less_equal(s, o)
+Tensor.__and__ = lambda s, o: (logical_and(s, o) if s.dtype == "bool"
+                               else bitwise_and(s, o))
+Tensor.__or__ = lambda s, o: (logical_or(s, o) if s.dtype == "bool"
+                              else bitwise_or(s, o))
+Tensor.__xor__ = lambda s, o: (logical_xor(s, o) if s.dtype == "bool"
+                               else bitwise_xor(s, o))
+Tensor.__invert__ = lambda s: (logical_not(s) if s.dtype == "bool"
+                               else bitwise_not(s))
+Tensor.__hash__ = lambda s: id(s)
+
+from .math import logical_xor  # noqa: E402
+
+# in-place arithmetic (paddle's add_ / subtract_ / scale_ family)
+for _name, _fn in [("add_", add), ("subtract_", subtract),
+                   ("multiply_", multiply), ("divide_", divide),
+                   ("clip_", math.clip), ("scale_", math.scale),
+                   ("exp_", math.exp), ("sqrt_", math.sqrt),
+                   ("rsqrt_", math.rsqrt), ("floor_", math.floor),
+                   ("ceil_", math.ceil), ("reciprocal_", math.reciprocal),
+                   ("round_", math.round), ("abs_", math.abs),
+                   ("tanh_", math.tanh),
+                   ("squeeze_", manipulation.squeeze),
+                   ("unsqueeze_", manipulation.unsqueeze),
+                   ("reshape_", manipulation.reshape),
+                   ("flatten_", manipulation.flatten)]:
+    _helper.make_inplace(_fn, _name)
+
+
+def _fill_(self, value):
+    import jax.numpy as jnp
+    self._value = jnp.full_like(self._value, value)
+    self._inplace_version += 1
+    return self
+
+
+def _zero_(self):
+    return _fill_(self, 0)
+
+
+Tensor.fill_ = _fill_
+Tensor.zero_ = _zero_
+
+# attach all collected tensor methods
+_helper.attach_tensor_methods()
+indexing.install()
+
+# `Tensor.item`/`numpy` etc. already defined on the class.
+Tensor.mean = reduction.mean
+Tensor.cpu = lambda s: s
+Tensor.cuda = lambda s, *a, **k: s
+Tensor.pin_memory = lambda s: s

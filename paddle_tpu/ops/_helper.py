@@ -1,0 +1,58 @@
+"""Helpers to define ops tersely.
+
+The mechanical analog of the reference's YAML->codegen pipeline
+(paddle/phi/ops/yaml/ops.yaml + api_gen.py): each def_* call registers the
+kernel body (pure JAX fn) and returns the user-facing wrapper that routes
+through the eager executor (autograd recording + compile cache).
+"""
+from __future__ import annotations
+
+from .._core.executor import apply
+from .._core.op_registry import register_op
+from .._core.tensor import Tensor
+
+_TENSOR_METHODS = {}
+
+
+def tensor_method(name):
+    """Mark a function to also become a Tensor method."""
+    def deco(fn):
+        _TENSOR_METHODS[name] = fn
+        return fn
+    return deco
+
+
+def attach_tensor_methods():
+    for name, fn in _TENSOR_METHODS.items():
+        setattr(Tensor, name, fn)
+
+
+def def_unary(name, jfn):
+    register_op(name, lambda x, _f=jfn: _f(x))
+
+    def wrapper(x, name=None, _op=name):
+        return apply(_op, x)
+    wrapper.__name__ = name
+    _TENSOR_METHODS[name] = wrapper
+    return wrapper
+
+
+def def_binary(name, jfn):
+    register_op(name, lambda x, y, _f=jfn: _f(x, y))
+
+    def wrapper(x, y, name=None, _op=name):
+        return apply(_op, x, y)
+    wrapper.__name__ = name
+    _TENSOR_METHODS[name] = wrapper
+    return wrapper
+
+
+def make_inplace(fn, name):
+    """Build the `op_` in-place variant: functional result adopted into self
+    (inplace-version bump preserves TensorWrapper safety semantics)."""
+    def inplace(self, *args, **kwargs):
+        out = fn(self, *args, **kwargs)
+        return self._adopt(out)
+    inplace.__name__ = name
+    _TENSOR_METHODS[name] = inplace
+    return inplace

@@ -1,0 +1,242 @@
+"""Tensor creation ops.
+
+Analog of python/paddle/tensor/creation.py + random.py over the reference's
+full/empty/arange/gaussian phi kernels. Creation runs directly on device via
+jnp; random ops consume the global threefry key (paddle_tpu._core.random).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .._core import dtype as dtypes_mod
+from .._core import random as rnd
+from .._core.executor import apply
+from .._core.op_registry import register_op
+from .._core.tensor import Tensor, to_tensor
+from ._helper import tensor_method
+
+__all__ = [
+    "to_tensor", "zeros", "ones", "full", "zeros_like", "ones_like",
+    "full_like", "empty", "empty_like", "arange", "linspace", "logspace",
+    "eye", "diag", "diagflat", "meshgrid", "tril", "triu", "assign",
+    "clone", "numel", "rand", "randn", "uniform", "normal", "standard_normal",
+    "randint", "randint_like", "randperm", "bernoulli", "multinomial",
+    "ones_like", "tril_indices", "triu_indices", "complex",
+]
+
+
+def _np_dtype(dtype, default="float32"):
+    return dtypes_mod.to_np(dtype if dtype is not None else default)
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(tuple(shape), _np_dtype(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(tuple(shape), _np_dtype(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is None:
+        val = jnp.full(tuple(shape), fill_value)
+        if val.dtype == jnp.float64:
+            val = val.astype(jnp.float32)
+        return Tensor(val)
+    return Tensor(jnp.full(tuple(shape), fill_value, _np_dtype(dtype)))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None):
+    d = _np_dtype(dtype) if dtype is not None else x._value.dtype
+    return Tensor(jnp.zeros(x._value.shape, d))
+
+
+def ones_like(x, dtype=None, name=None):
+    d = _np_dtype(dtype) if dtype is not None else x._value.dtype
+    return Tensor(jnp.ones(x._value.shape, d))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    d = _np_dtype(dtype) if dtype is not None else x._value.dtype
+    return Tensor(jnp.full(x._value.shape, fill_value, d))
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    for v in (start, end, step):
+        if isinstance(v, Tensor):
+            raise TypeError("arange with Tensor bounds: pass python scalars")
+    d = dtypes_mod.to_np(dtype) if dtype is not None else None
+    val = jnp.arange(start, end, step, dtype=d)
+    if d is None and val.dtype == jnp.float64:
+        val = val.astype(jnp.float32)
+    return Tensor(val)
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    return Tensor(jnp.linspace(float(start), float(stop), int(num),
+                               dtype=_np_dtype(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return Tensor(jnp.logspace(float(start), float(stop), int(num),
+                               base=base, dtype=_np_dtype(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(num_rows, num_columns, dtype=_np_dtype(dtype)))
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    v = x._value
+    if v.ndim == 1:
+        out = jnp.diag(v, k=offset)
+        if padding_value != 0:
+            mask = jnp.diag(jnp.ones_like(v, dtype=bool), k=offset)
+            out = jnp.where(mask, out, padding_value)
+        return Tensor(out)
+    return Tensor(jnp.diag(v, k=offset))
+
+
+def diagflat(x, offset=0, name=None):
+    return Tensor(jnp.diagflat(x._value, k=offset))
+
+
+def meshgrid(*args, **kwargs):
+    arrays = args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) \
+        else args
+    outs = jnp.meshgrid(*[a._value for a in arrays], indexing="ij")
+    return [Tensor(o) for o in outs]
+
+
+register_op("tril", lambda x, diagonal: jnp.tril(x, k=diagonal))
+register_op("triu", lambda x, diagonal: jnp.triu(x, k=diagonal))
+
+
+@tensor_method("tril")
+def tril(x, diagonal=0, name=None):
+    return apply("tril", x, diagonal=int(diagonal))
+
+
+@tensor_method("triu")
+def triu(x, diagonal=0, name=None):
+    return apply("triu", x, diagonal=int(diagonal))
+
+
+def tril_indices(row, col, offset=0, dtype="int64"):
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=_np_dtype(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    r, c = np.triu_indices(row, offset, col if col is not None else row)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=_np_dtype(dtype)))
+
+
+register_op("assign", lambda x: x + jnp.zeros((), x.dtype) if jnp.issubdtype(
+    x.dtype, jnp.inexact) else jnp.array(x))
+
+
+@tensor_method("clone")
+def assign(x, output=None, name=None):
+    if not isinstance(x, Tensor):
+        x = to_tensor(x)
+    out = apply("assign", x)
+    if output is not None:
+        output._adopt(out)
+        return output
+    return out
+
+
+clone = assign
+
+
+def numel(x, name=None):
+    return Tensor(jnp.asarray(x.size, dtype=jnp.int64))
+
+
+def complex(real, imag, name=None):
+    return apply("complex_make", real, imag)
+
+
+register_op("complex_make", lambda r, i: jax.lax.complex(r, i))
+
+
+# ------------------------------------------------------------------ random
+
+def rand(shape, dtype=None, name=None):
+    return Tensor(jax.random.uniform(rnd.next_key(), tuple(shape),
+                                     _np_dtype(dtype)))
+
+
+def randn(shape, dtype=None, name=None):
+    return Tensor(jax.random.normal(rnd.next_key(), tuple(shape),
+                                    _np_dtype(dtype)))
+
+
+standard_normal = randn
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    key = rnd.next_key() if not seed else jax.random.PRNGKey(seed)
+    return Tensor(jax.random.uniform(key, tuple(shape), _np_dtype(dtype),
+                                     minval=min, maxval=max))
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean._value if isinstance(mean, Tensor) else mean
+        s = std._value if isinstance(std, Tensor) else std
+        out_shape = jnp.broadcast_shapes(
+            np.shape(m), np.shape(s)) if shape is None else tuple(shape)
+        return Tensor(
+            jax.random.normal(rnd.next_key(), out_shape) * s + m)
+    shape = shape if shape is not None else []
+    return Tensor(jax.random.normal(rnd.next_key(), tuple(shape))
+                  * std + mean)
+
+
+def randint(low=0, high=None, shape=(1,), dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    return Tensor(jax.random.randint(rnd.next_key(), tuple(shape), low, high,
+                                     dtype=_np_dtype(dtype, "int64")))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    d = dtype if dtype is not None else x.dtype
+    return randint(low, high, x.shape, d)
+
+
+def randperm(n, dtype="int64", name=None):
+    return Tensor(jax.random.permutation(rnd.next_key(), n)
+                  .astype(_np_dtype(dtype, "int64")))
+
+
+def bernoulli(x, name=None):
+    return Tensor(jax.random.bernoulli(
+        rnd.next_key(), x._value).astype(x._value.dtype))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    probs = x._value
+    logits = jnp.log(jnp.maximum(probs, 1e-30))
+    if replacement:
+        out = jax.random.categorical(rnd.next_key(), logits,
+                                     shape=probs.shape[:-1] + (num_samples,))
+    else:
+        # Gumbel top-k trick for sampling without replacement.
+        g = jax.random.gumbel(rnd.next_key(), probs.shape)
+        _, out = jax.lax.top_k(logits + g, num_samples)
+    return Tensor(out.astype(jnp.int64))

@@ -1,0 +1,114 @@
+"""__getitem__ / __setitem__ with autograd.
+
+Analog of the reference's set_value/slice kernels + eager_method.cc indexing.
+Static indices (ints/slices) become jit attrs; tensor indices are op inputs;
+boolean masks are resolved to integer indices on host (static shapes for
+XLA), then static gather/scatter kernels run on device so grads flow.
+"""
+from __future__ import annotations
+
+import numbers
+
+import jax.numpy as jnp
+import numpy as np
+
+from .._core.executor import apply
+from .._core.op_registry import register_op
+from .._core.tensor import Tensor
+
+
+def _decompose(idx, x_shape):
+    """Split an index into a hashable spec + tensor operands."""
+    if not isinstance(idx, tuple):
+        idx = (idx,)
+    spec = []
+    tensors = []
+    for it in idx:
+        if isinstance(it, Tensor):
+            if it.dtype == "bool":
+                # host sync: bool mask -> integer index tensor
+                nz = np.nonzero(np.asarray(it._value))
+                if len(nz) == 1:
+                    tensors.append(Tensor(jnp.asarray(nz[0])))
+                    spec.append(("tensor", len(tensors) - 1))
+                else:
+                    for comp in nz:
+                        tensors.append(Tensor(jnp.asarray(comp)))
+                        spec.append(("tensor", len(tensors) - 1))
+            else:
+                tensors.append(it)
+                spec.append(("tensor", len(tensors) - 1))
+        elif isinstance(it, slice):
+            spec.append(("slice",
+                         None if it.start is None else int(it.start),
+                         None if it.stop is None else int(it.stop),
+                         None if it.step is None else int(it.step)))
+        elif it is None:
+            spec.append(("newaxis",))
+        elif it is Ellipsis:
+            spec.append(("ellipsis",))
+        elif isinstance(it, numbers.Integral):
+            spec.append(("int", int(it)))
+        elif isinstance(it, (list, np.ndarray)):
+            arr = np.asarray(it)
+            if arr.dtype == np.bool_:
+                nz = np.nonzero(arr)
+                for comp in nz:
+                    tensors.append(Tensor(jnp.asarray(comp)))
+                    spec.append(("tensor", len(tensors) - 1))
+            else:
+                tensors.append(Tensor(jnp.asarray(arr)))
+                spec.append(("tensor", len(tensors) - 1))
+        else:
+            raise TypeError(f"unsupported index element: {it!r}")
+    return tuple(spec), tensors
+
+
+def _rebuild(spec, tvals):
+    key = []
+    for s in spec:
+        kind = s[0]
+        if kind == "tensor":
+            key.append(tvals[s[1]])
+        elif kind == "slice":
+            key.append(slice(s[1], s[2], s[3]))
+        elif kind == "newaxis":
+            key.append(None)
+        elif kind == "ellipsis":
+            key.append(Ellipsis)
+        elif kind == "int":
+            key.append(s[1])
+    return tuple(key)
+
+
+def _getitem_kernel(x, *tvals, spec):
+    return x[_rebuild(spec, tvals)]
+
+
+register_op("getitem_", _getitem_kernel)
+
+
+def _setitem_kernel(x, v, *tvals, spec):
+    return x.at[_rebuild(spec, tvals)].set(jnp.asarray(v).astype(x.dtype))
+
+
+register_op("setitem_", _setitem_kernel)
+
+
+def getitem(x: Tensor, idx):
+    spec, tensors = _decompose(idx, x.shape)
+    return apply("getitem_", x, *tensors, spec=spec)
+
+
+def setitem(x: Tensor, idx, value):
+    spec, tensors = _decompose(idx, x.shape)
+    if not isinstance(value, Tensor):
+        value = Tensor(jnp.asarray(value))
+    out = apply("setitem_", x, value, *tensors, spec=spec)
+    x._adopt(out)
+    return x
+
+
+def install():
+    Tensor.__getitem__ = getitem
+    Tensor.__setitem__ = setitem

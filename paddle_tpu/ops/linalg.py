@@ -1,0 +1,277 @@
+"""Linear algebra ops (python/paddle/tensor/linalg.py analog).
+
+matmul is the MXU workhorse: precision is governed by
+FLAGS_tpu_matmul_precision; keep operands bf16 for peak throughput.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .._core import flags
+from .._core.executor import apply
+from .._core.op_registry import register_op
+from .._core.tensor import Tensor
+from ._helper import tensor_method
+
+
+def _precision():
+    p = flags.flag_value("FLAGS_tpu_matmul_precision")
+    return {"default": lax.Precision.DEFAULT, "high": lax.Precision.HIGH,
+            "highest": lax.Precision.HIGHEST}.get(p, lax.Precision.DEFAULT)
+
+
+def _matmul_kernel(x, y, transpose_x, transpose_y):
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    return jnp.matmul(x, y, precision=_precision())
+
+
+register_op("matmul", _matmul_kernel)
+
+
+@tensor_method("matmul")
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    return apply("matmul", x, y, transpose_x=bool(transpose_x),
+                 transpose_y=bool(transpose_y))
+
+
+@tensor_method("mm")
+def mm(x, y, name=None):
+    return matmul(x, y)
+
+
+@tensor_method("bmm")
+def bmm(x, y, name=None):
+    return matmul(x, y)
+
+
+def mv(x, vec, name=None):
+    return matmul(x, vec)
+
+
+register_op("dot_", lambda x, y: jnp.sum(x * y, axis=-1))
+
+
+@tensor_method("dot")
+def dot(x, y, name=None):
+    return apply("dot_", x, y)
+
+
+register_op("outer_", lambda x, y: jnp.outer(x, y))
+
+
+def outer(x, y, name=None):
+    return apply("outer_", x, y)
+
+
+def _einsum_kernel(*xs, equation):
+    return jnp.einsum(equation, *xs, precision=_precision())
+
+
+register_op("einsum_", _einsum_kernel)
+
+
+def einsum(equation, *operands):
+    return apply("einsum_", *operands, equation=equation)
+
+
+def _norm_kernel(x, p, axis, keepdim):
+    if p == "fro" or (p == 2 and axis is None):
+        return jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=keepdim))
+    if p == float("inf"):
+        return jnp.max(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == float("-inf"):
+        return jnp.min(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == 0:
+        return jnp.sum((x != 0).astype(x.dtype), axis=axis, keepdims=keepdim)
+    if p == 1:
+        return jnp.sum(jnp.abs(x), axis=axis, keepdims=keepdim)
+    return jnp.power(
+        jnp.sum(jnp.power(jnp.abs(x), p), axis=axis, keepdims=keepdim),
+        1.0 / p)
+
+
+register_op("p_norm_", _norm_kernel)
+
+
+@tensor_method("norm")
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    if p is None:
+        p = "fro" if axis is None else 2
+    ax = axis
+    if isinstance(ax, (list, tuple)):
+        ax = tuple(int(a) for a in ax)
+    elif ax is not None:
+        ax = int(ax)
+    return apply("p_norm_", x, p=p, axis=ax, keepdim=bool(keepdim))
+
+
+vector_norm = norm
+
+
+register_op("trace_", lambda x, offset, axis1, axis2: jnp.trace(
+    x, offset=offset, axis1=axis1, axis2=axis2))
+
+
+@tensor_method("trace")
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply("trace_", x, offset=int(offset), axis1=int(axis1),
+                 axis2=int(axis2))
+
+
+register_op("cholesky_", lambda x, upper: (
+    jnp.linalg.cholesky(x).swapaxes(-1, -2).conj() if upper
+    else jnp.linalg.cholesky(x)))
+
+
+@tensor_method("cholesky")
+def cholesky(x, upper=False, name=None):
+    return apply("cholesky_", x, upper=bool(upper))
+
+
+register_op("inverse_", jnp.linalg.inv)
+
+
+@tensor_method("inverse")
+def inv(x, name=None):
+    return apply("inverse_", x)
+
+
+inverse = inv
+
+register_op("solve_", jnp.linalg.solve)
+
+
+def solve(x, y, name=None):
+    return apply("solve_", x, y)
+
+
+register_op("triangular_solve_",
+            lambda x, y, upper, transpose, unitriangular:
+            jax.scipy.linalg.solve_triangular(
+                x, y, lower=not upper, trans=1 if transpose else 0,
+                unit_diagonal=unitriangular))
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
+                     name=None):
+    return apply("triangular_solve_", x, y, upper=bool(upper),
+                 transpose=bool(transpose), unitriangular=bool(unitriangular))
+
+
+register_op("cross_", lambda x, y, axis: jnp.cross(x, y, axis=axis))
+
+
+@tensor_method("cross")
+def cross(x, y, axis=9, name=None):
+    if axis == 9:
+        axis = next((i for i, s in enumerate(x.shape) if s == 3), -1)
+    return apply("cross_", x, y, axis=int(axis))
+
+
+def _svd_kernel(x, full_matrices):
+    return tuple(jnp.linalg.svd(x, full_matrices=full_matrices))
+
+
+register_op("svd_", _svd_kernel, multi_output=True)
+
+
+def svd(x, full_matrices=False, name=None):
+    return apply("svd_", x, full_matrices=bool(full_matrices))
+
+
+def _qr_kernel(x, mode):
+    q, r = jnp.linalg.qr(x, mode=mode)
+    return (q, r)
+
+
+register_op("qr_", _qr_kernel, multi_output=True)
+
+
+def qr(x, mode="reduced", name=None):
+    if mode == "r":
+        return apply("qr_", x, mode="reduced")[1]
+    return apply("qr_", x, mode=mode)
+
+
+register_op("det_", jnp.linalg.det)
+
+
+def det(x, name=None):
+    return apply("det_", x)
+
+
+def _slogdet_kernel(x):
+    sign, logdet = jnp.linalg.slogdet(x)
+    return (sign, logdet)
+
+
+register_op("slogdet_", _slogdet_kernel, multi_output=True)
+
+
+def slogdet(x, name=None):
+    sign, logdet = apply("slogdet_", x)
+    from .manipulation import stack
+    return stack([sign, logdet], axis=0)
+
+
+register_op("eigh_", lambda x, UPLO: tuple(jnp.linalg.eigh(
+    x, symmetrize_input=True)), multi_output=True)
+
+
+def eigh(x, UPLO="L", name=None):
+    return apply("eigh_", x, UPLO=UPLO)
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return eigh(x, UPLO)[0]
+
+
+register_op("pinv_", lambda x, rcond: jnp.linalg.pinv(x, rcond=rcond))
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply("pinv_", x, rcond=float(rcond))
+
+
+register_op("matrix_power_", lambda x, n: jnp.linalg.matrix_power(x, n))
+
+
+def matrix_power(x, n, name=None):
+    return apply("matrix_power_", x, n=int(n))
+
+
+def multi_dot(tensors, name=None):
+    out = tensors[0]
+    for t in tensors[1:]:
+        out = matmul(out, t)
+    return out
+
+
+def matrix_transpose(x, name=None):
+    from .manipulation import transpose
+    perm = list(range(x.ndim))
+    perm[-1], perm[-2] = perm[-2], perm[-1]
+    return transpose(x, perm)
+
+
+def cdist(x, y, p=2.0, name=None):
+    diff = x.unsqueeze(-2) - y.unsqueeze(-3)
+    return norm(diff, p=p, axis=-1)
+
+
+def householder_product(x, tau, name=None):
+    raise NotImplementedError
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return Tensor(jnp.corrcoef(x._value, rowvar=rowvar))
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return Tensor(jnp.cov(x._value, rowvar=rowvar,
+                          ddof=1 if ddof else 0))

@@ -1,0 +1,340 @@
+"""Shape / layout manipulation ops.
+
+Analog of python/paddle/tensor/manipulation.py over the reference's
+reshape/transpose/concat/split/pad phi kernels and the stride/view kernel
+family (paddle/phi/kernels/stride/). XLA has no aliasing views, so "view"
+ops are pure reshapes/slices the compiler folds away (SURVEY.md §7 hard
+parts: stride ops -> copy-on-write semantics).
+"""
+from __future__ import annotations
+
+import numbers
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .._core import dtype as dtypes_mod
+from .._core.executor import apply
+from .._core.op_registry import register_op
+from .._core.tensor import Tensor
+from ._helper import tensor_method
+
+
+def _norm_shape(shape):
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    return tuple(int(s) for s in shape)
+
+
+register_op("reshape", lambda x, shape: jnp.reshape(x, shape))
+
+
+@tensor_method("reshape")
+def reshape(x, shape, name=None):
+    return apply("reshape", x, shape=_norm_shape(shape))
+
+
+register_op("cast", lambda x, dtype: x.astype(dtype))
+
+
+@tensor_method("cast")
+def cast(x, dtype):
+    d = dtypes_mod.to_np(dtype)
+    if x._value.dtype == d:
+        return x
+    return apply("cast", x, dtype=str(d) if d != jnp.bfloat16 else "bfloat16")
+
+
+@tensor_method("astype")
+def astype(x, dtype):
+    return cast(x, dtype)
+
+
+register_op("transpose", lambda x, perm: jnp.transpose(x, perm))
+
+
+@tensor_method("transpose")
+def transpose(x, perm, name=None):
+    return apply("transpose", x, perm=tuple(int(p) for p in perm))
+
+
+@tensor_method("t")
+def t(x, name=None):
+    if x.ndim < 2:
+        return x
+    if x.ndim != 2:
+        raise ValueError("t() expects 0/1/2-D tensor")
+    return transpose(x, [1, 0])
+
+
+register_op("flatten_", lambda x, start, stop: jnp.reshape(
+    x, x.shape[:start] + (-1,) + x.shape[stop + 1:]))
+
+
+@tensor_method("flatten")
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    nd = max(x.ndim, 1)
+    start = start_axis % nd
+    stop = stop_axis % nd
+    return apply("flatten_", x, start=start, stop=stop)
+
+
+register_op("squeeze", lambda x, axes: jnp.squeeze(
+    x, axis=axes if axes else None))
+
+
+@tensor_method("squeeze")
+def squeeze(x, axis=None, name=None):
+    if axis is None:
+        axes = ()
+    else:
+        axes = (axis,) if isinstance(axis, numbers.Integral) else tuple(axis)
+        axes = tuple(a % x.ndim for a in axes)
+        axes = tuple(a for a in axes if x.shape[a] == 1)
+    return apply("squeeze", x, axes=axes)
+
+
+register_op("unsqueeze", lambda x, axes: jnp.expand_dims(x, axes))
+
+
+@tensor_method("unsqueeze")
+def unsqueeze(x, axis, name=None):
+    axes = (axis,) if isinstance(axis, numbers.Integral) else tuple(axis)
+    return apply("unsqueeze", x, axes=axes)
+
+
+def _concat_kernel(*xs, axis):
+    return jnp.concatenate(xs, axis=axis)
+
+
+register_op("concat_", _concat_kernel)
+
+
+def concat(x, axis=0, name=None):
+    xs = list(x)
+    return apply("concat_", *xs, axis=int(axis))
+
+
+def _stack_kernel(*xs, axis):
+    return jnp.stack(xs, axis=axis)
+
+
+register_op("stack_", _stack_kernel)
+
+
+def stack(x, axis=0, name=None):
+    return apply("stack_", *list(x), axis=int(axis))
+
+
+def _split_kernel(x, indices, axis):
+    return tuple(jnp.split(x, indices, axis=axis))
+
+
+register_op("split_", _split_kernel, multi_output=True)
+
+
+@tensor_method("split")
+def split(x, num_or_sections, axis=0, name=None):
+    axis = int(axis) % x.ndim
+    dim = x.shape[axis]
+    if isinstance(num_or_sections, numbers.Integral):
+        n = int(num_or_sections)
+        if dim % n != 0:
+            raise ValueError(f"dim {dim} not divisible by {n}")
+        indices = tuple((dim // n) * i for i in range(1, n))
+    else:
+        sections = [dim - sum(s for s in num_or_sections if s >= 0)
+                    if s < 0 else s for s in num_or_sections]
+        cum = np.cumsum(sections)[:-1]
+        indices = tuple(int(c) for c in cum)
+    outs = apply("split_", x, indices=indices, axis=axis)
+    return list(outs)
+
+
+@tensor_method("chunk")
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def _unbind_kernel(x, axis):
+    return tuple(jnp.moveaxis(x, axis, 0))
+
+
+register_op("unbind_", _unbind_kernel, multi_output=True)
+
+
+@tensor_method("unbind")
+def unbind(x, axis=0):
+    return list(apply("unbind_", x, axis=int(axis) % x.ndim))
+
+
+register_op("tile", lambda x, reps: jnp.tile(x, reps))
+
+
+@tensor_method("tile")
+def tile(x, repeat_times, name=None):
+    return apply("tile", x, reps=_norm_shape(repeat_times))
+
+
+def _expand_kernel(x, shape):
+    return jnp.broadcast_to(x, shape)
+
+
+register_op("expand", _expand_kernel)
+
+
+@tensor_method("expand")
+def expand(x, shape, name=None):
+    shape = list(_norm_shape(shape))
+    # paddle semantics: -1 keeps the original dim
+    nd_off = len(shape) - x.ndim
+    for i, s in enumerate(shape):
+        if s == -1:
+            shape[i] = x.shape[i - nd_off]
+    return apply("expand", x, shape=tuple(shape))
+
+
+@tensor_method("expand_as")
+def expand_as(x, y, name=None):
+    return apply("expand", x, shape=tuple(y.shape))
+
+
+@tensor_method("broadcast_to")
+def broadcast_to(x, shape, name=None):
+    return apply("expand", x, shape=_norm_shape(shape))
+
+
+def broadcast_tensors(inputs, name=None):
+    shape = jnp.broadcast_shapes(*[tuple(t.shape) for t in inputs])
+    return [apply("expand", t, shape=shape) for t in inputs]
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(jnp.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+register_op("flip", lambda x, axes: jnp.flip(x, axes))
+
+
+@tensor_method("flip")
+def flip(x, axis, name=None):
+    axes = (axis,) if isinstance(axis, numbers.Integral) else tuple(axis)
+    return apply("flip", x, axes=axes)
+
+
+register_op("roll_", lambda x, shifts, axes: jnp.roll(x, shifts, axes))
+
+
+@tensor_method("roll")
+def roll(x, shifts, axis=None, name=None):
+    if axis is None:
+        flat = flatten(x)
+        out = apply("roll_", flat, shifts=shifts, axes=0)
+        return reshape(out, x.shape)
+    return apply("roll_", x, shifts=shifts, axes=axis)
+
+
+register_op("repeat_interleave_",
+            lambda x, repeats, axis: jnp.repeat(x, repeats, axis=axis))
+
+
+@tensor_method("repeat_interleave")
+def repeat_interleave(x, repeats, axis=None, name=None):
+    if isinstance(repeats, Tensor):
+        repeats = tuple(repeats.tolist())
+    if axis is None:
+        x = flatten(x)
+        axis = 0
+    return apply("repeat_interleave_", x, repeats=repeats,
+                 axis=int(axis))
+
+
+def _pad_kernel(x, pad_width, mode, value):
+    if mode == "constant":
+        return jnp.pad(x, pad_width, mode="constant", constant_values=value)
+    return jnp.pad(x, pad_width, mode=mode)
+
+
+register_op("pad_", _pad_kernel)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format=None, name=None):
+    """paddle.nn.functional.pad-compatible: `pad` is per-dim [lo, hi] pairs,
+    innermost-last ordering when given flat (like paddle/torch)."""
+    if isinstance(pad, Tensor):
+        pad = pad.tolist()
+    pad = list(pad)
+    nd = x.ndim
+    if len(pad) == 2 * nd:
+        width = tuple((int(pad[2 * i]), int(pad[2 * i + 1]))
+                      for i in range(nd))
+    else:
+        k = len(pad) // 2
+        width = [(0, 0)] * (nd - k)
+        for i in range(k):
+            # flat list pads last dims, reversed pair order (torch/paddle)
+            lo, hi = pad[2 * i], pad[2 * i + 1]
+            width.append((int(lo), int(hi)))
+        # paddle pads from the last dimension backwards
+        head = [(0, 0)] * (nd - k)
+        tail = [(int(pad[2 * i]), int(pad[2 * i + 1]))
+                for i in range(k - 1, -1, -1)]
+        width = tuple(head + tail)
+    mode = {"constant": "constant", "reflect": "reflect",
+            "replicate": "edge", "circular": "wrap"}[mode]
+    return apply("pad_", x, pad_width=width, mode=mode, value=float(value))
+
+
+register_op("diagonal_", lambda x, offset, axis1, axis2: jnp.diagonal(
+    x, offset=offset, axis1=axis1, axis2=axis2))
+
+
+@tensor_method("diagonal")
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply("diagonal_", x, offset=int(offset), axis1=int(axis1),
+                 axis2=int(axis2))
+
+
+register_op("masked_fill_", lambda x, mask, v: jnp.where(mask, v, x))
+
+
+@tensor_method("masked_fill")
+def masked_fill(x, mask, value, name=None):
+    return apply("masked_fill_", x, mask, value)
+
+
+register_op("moveaxis_", lambda x, src, dst: jnp.moveaxis(x, src, dst))
+
+
+@tensor_method("moveaxis")
+def moveaxis(x, source, destination, name=None):
+    return apply("moveaxis_", x, src=source, dst=destination)
+
+
+register_op("as_real", lambda x: jnp.stack([jnp.real(x), jnp.imag(x)], -1))
+register_op("as_complex", lambda x: jax.lax.complex(x[..., 0], x[..., 1]))
+
+
+def as_real(x, name=None):
+    return apply("as_real", x)
+
+
+def as_complex(x, name=None):
+    return apply("as_complex", x)
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    size = index_num // nshards
+    val = input._value
+    out = jnp.where((val // size) == shard_id, val % size, ignore_value)
+    return Tensor(out)
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    return cast(x, shape_or_dtype)
+
+
+view_as = expand_as

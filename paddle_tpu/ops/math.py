@@ -1,0 +1,224 @@
+"""Elementwise math / comparison / logical ops.
+
+Analog of the reference's elementwise + activation phi kernels
+(paddle/phi/kernels/elementwise_*.h, activation_kernel.h) and the python
+surface python/paddle/tensor/math.py. Kernel bodies are jnp/lax calls that
+XLA fuses on TPU.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .._core import dtype as dtypes_mod
+from .._core.executor import apply
+from .._core.op_registry import register_op
+from ._helper import def_unary, def_binary, tensor_method
+
+# --------------------------------------------------------------- unary
+exp = def_unary("exp", jnp.exp)
+expm1 = def_unary("expm1", jnp.expm1)
+log = def_unary("log", jnp.log)
+log2 = def_unary("log2", jnp.log2)
+log10 = def_unary("log10", jnp.log10)
+log1p = def_unary("log1p", jnp.log1p)
+sqrt = def_unary("sqrt", jnp.sqrt)
+rsqrt = def_unary("rsqrt", lax.rsqrt)
+abs = def_unary("abs", jnp.abs)
+absolute = abs
+neg = def_unary("neg", jnp.negative)
+negative = neg
+sign = def_unary("sign", jnp.sign)
+floor = def_unary("floor", jnp.floor)
+ceil = def_unary("ceil", jnp.ceil)
+round = def_unary("round", jnp.round)
+trunc = def_unary("trunc", jnp.trunc)
+frac = def_unary("frac", lambda x: x - jnp.trunc(x))
+sin = def_unary("sin", jnp.sin)
+cos = def_unary("cos", jnp.cos)
+tan = def_unary("tan", jnp.tan)
+asin = def_unary("asin", jnp.arcsin)
+acos = def_unary("acos", jnp.arccos)
+atan = def_unary("atan", jnp.arctan)
+sinh = def_unary("sinh", jnp.sinh)
+cosh = def_unary("cosh", jnp.cosh)
+tanh = def_unary("tanh", jnp.tanh)
+asinh = def_unary("asinh", jnp.arcsinh)
+acosh = def_unary("acosh", jnp.arccosh)
+atanh = def_unary("atanh", jnp.arctanh)
+erf = def_unary("erf", jax.scipy.special.erf)
+erfinv = def_unary("erfinv", jax.scipy.special.erfinv)
+sigmoid = def_unary("sigmoid", jax.nn.sigmoid)
+square = def_unary("square", jnp.square)
+reciprocal = def_unary("reciprocal", jnp.reciprocal)
+logit = def_unary("logit", jax.scipy.special.logit)
+digamma = def_unary("digamma", jax.scipy.special.digamma)
+lgamma = def_unary("lgamma", jax.scipy.special.gammaln)
+conj = def_unary("conj", jnp.conj)
+real = def_unary("real", jnp.real)
+imag = def_unary("imag", jnp.imag)
+isnan = def_unary("isnan", jnp.isnan)
+isinf = def_unary("isinf", jnp.isinf)
+isfinite = def_unary("isfinite", jnp.isfinite)
+
+# --------------------------------------------------------------- binary
+add = def_binary("add", jnp.add)
+subtract = def_binary("subtract", jnp.subtract)
+multiply = def_binary("multiply", jnp.multiply)
+divide = def_binary("divide", jnp.true_divide)
+floor_divide = def_binary("floor_divide", jnp.floor_divide)
+mod = def_binary("mod", jnp.mod)
+remainder = mod
+floor_mod = mod
+pow = def_binary("pow", jnp.power)
+maximum = def_binary("maximum", jnp.maximum)
+minimum = def_binary("minimum", jnp.minimum)
+fmax = def_binary("fmax", jnp.fmax)
+fmin = def_binary("fmin", jnp.fmin)
+atan2 = def_binary("atan2", jnp.arctan2)
+logaddexp = def_binary("logaddexp", jnp.logaddexp)
+heaviside = def_binary("heaviside", jnp.heaviside)
+hypot = def_binary("hypot", lambda x, y: jnp.sqrt(x * x + y * y))
+nextafter = def_binary("nextafter", jnp.nextafter)
+gcd = def_binary("gcd", jnp.gcd)
+lcm = def_binary("lcm", jnp.lcm)
+
+# --------------------------------------------------------------- comparison
+equal = def_binary("equal", lambda x, y: jnp.equal(x, y))
+not_equal = def_binary("not_equal", jnp.not_equal)
+greater_than = def_binary("greater_than", jnp.greater)
+greater_equal = def_binary("greater_equal", jnp.greater_equal)
+less_than = def_binary("less_than", jnp.less)
+less_equal = def_binary("less_equal", jnp.less_equal)
+
+# --------------------------------------------------------------- logical
+logical_and = def_binary("logical_and",
+                         lambda x, y: jnp.logical_and(x, y))
+logical_or = def_binary("logical_or", lambda x, y: jnp.logical_or(x, y))
+logical_xor = def_binary("logical_xor", lambda x, y: jnp.logical_xor(x, y))
+logical_not = def_unary("logical_not", jnp.logical_not)
+bitwise_and = def_binary("bitwise_and", jnp.bitwise_and)
+bitwise_or = def_binary("bitwise_or", jnp.bitwise_or)
+bitwise_xor = def_binary("bitwise_xor", jnp.bitwise_xor)
+bitwise_not = def_unary("bitwise_not", jnp.bitwise_not)
+
+# --------------------------------------------------------------- scale et al
+register_op("scale", lambda x, scale, bias, bias_after_scale:
+            x * scale + bias if bias_after_scale else (x + bias) * scale)
+
+
+@tensor_method("scale")
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    out = apply("scale", x, scale=float(scale), bias=float(bias),
+                bias_after_scale=bool(bias_after_scale))
+    return out
+
+
+register_op("clip", lambda x, lo, hi: jnp.clip(
+    x, None if lo is None else lo, None if hi is None else hi))
+
+
+@tensor_method("clip")
+def clip(x, min=None, max=None, name=None):
+    return apply("clip", x, min, max)
+
+
+register_op("lerp", lambda x, y, w: x + w * (y - x))
+
+
+@tensor_method("lerp")
+def lerp(x, y, weight, name=None):
+    return apply("lerp", x, y, weight)
+
+
+def _cumsum_kernel(x, axis, reverse, dtype):
+    if dtype is not None:
+        x = x.astype(dtype)
+    if reverse:
+        x = jnp.flip(x, axis=axis)
+    out = jnp.cumsum(x, axis=axis)
+    return jnp.flip(out, axis=axis) if reverse else out
+
+
+register_op("cumsum_", _cumsum_kernel)
+
+
+@tensor_method("cumsum")
+def cumsum(x, axis=None, dtype=None, name=None):
+    if axis is None:
+        from .manipulation import flatten
+        x = flatten(x)
+        axis = 0
+    d = None if dtype is None else str(dtypes_mod.to_np(dtype))
+    return apply("cumsum_", x, axis=int(axis), reverse=False, dtype=d)
+
+
+register_op("cumprod_", lambda x, axis: jnp.cumprod(x, axis=axis))
+
+
+@tensor_method("cumprod")
+def cumprod(x, dim=None, dtype=None, name=None):
+    out = apply("cumprod_", x, axis=int(dim))
+    if dtype is not None:
+        from .manipulation import cast
+        out = cast(out, dtype)
+    return out
+
+
+register_op("logcumsumexp_",
+            lambda x, axis: jax.lax.associative_scan(jnp.logaddexp, x,
+                                                     axis=axis))
+
+
+@tensor_method("logcumsumexp")
+def logcumsumexp(x, axis=None, dtype=None, name=None):
+    if axis is None:
+        from .manipulation import flatten
+        x = flatten(x)
+        axis = 0
+    return apply("logcumsumexp_", x, axis=int(axis))
+
+
+def increment(x, value=1.0, name=None):
+    return x._adopt(add(x, value))
+
+
+register_op("stanh", lambda x, scale_a, scale_b: scale_b * jnp.tanh(
+    scale_a * x))
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return apply("stanh", x, scale_a=float(scale_a), scale_b=float(scale_b))
+
+
+register_op("rsqrt_grad_friendly", lambda x: lax.rsqrt(x))
+
+register_op("multiply_no_broadcast", jnp.multiply)
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    from .._core.tensor import Tensor
+    return Tensor(jnp.allclose(x._value, y._value, rtol=rtol, atol=atol,
+                               equal_nan=equal_nan))
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    from .._core.tensor import Tensor
+    return Tensor(jnp.isclose(x._value, y._value, rtol=rtol, atol=atol,
+                              equal_nan=equal_nan))
+
+
+def equal_all(x, y, name=None):
+    from .._core.tensor import Tensor
+    return Tensor(jnp.array_equal(x._value, y._value))
+
+
+register_op("nan_to_num", lambda x, nan, posinf, neginf: jnp.nan_to_num(
+    x, nan=nan, posinf=posinf, neginf=neginf))
+
+
+@tensor_method("nan_to_num")
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return apply("nan_to_num", x, nan=float(nan), posinf=posinf,
+                 neginf=neginf)

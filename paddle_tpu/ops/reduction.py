@@ -1,0 +1,106 @@
+"""Reduction ops (python/paddle/tensor/math.py + stat.py analogs)."""
+from __future__ import annotations
+
+import numbers
+
+import jax.numpy as jnp
+
+from .._core import dtype as dtypes_mod
+from .._core.executor import apply
+from .._core.op_registry import register_op
+from ._helper import tensor_method
+
+
+def _norm_axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, numbers.Integral):
+        return int(axis)
+    if hasattr(axis, "tolist"):
+        axis = axis.tolist()
+    return tuple(int(a) for a in axis)
+
+
+def _def_reduce(name, jfn):
+    register_op(name, lambda x, axis, keepdim, _f=jfn: _f(
+        x, axis=axis, keepdims=keepdim))
+
+    def wrapper(x, axis=None, keepdim=False, name=None, _op=name):
+        return apply(_op, x, axis=_norm_axis(axis), keepdim=bool(keepdim))
+    wrapper.__name__ = name
+    from ._helper import _TENSOR_METHODS
+    _TENSOR_METHODS[name] = wrapper
+    return wrapper
+
+
+_sum_raw = _def_reduce("sum_", jnp.sum)
+mean = _def_reduce("mean", jnp.mean)
+max = _def_reduce("max", jnp.max)
+min = _def_reduce("min", jnp.min)
+amax = _def_reduce("amax", jnp.max)
+amin = _def_reduce("amin", jnp.min)
+prod = _def_reduce("prod", jnp.prod)
+all = _def_reduce("all", jnp.all)
+any = _def_reduce("any", jnp.any)
+logsumexp_raw = _def_reduce("logsumexp",
+                            __import__("jax").scipy.special.logsumexp)
+nansum = _def_reduce("nansum", jnp.nansum)
+nanmean = _def_reduce("nanmean", jnp.nanmean)
+
+
+@tensor_method("sum")
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    out = _sum_raw(x, axis=axis, keepdim=keepdim)
+    if dtype is not None:
+        from .manipulation import cast
+        out = cast(out, dtype)
+    return out
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return logsumexp_raw(x, axis=axis, keepdim=keepdim)
+
+
+register_op("std_", lambda x, axis, keepdim, ddof: jnp.std(
+    x, axis=axis, keepdims=keepdim, ddof=ddof))
+register_op("var_", lambda x, axis, keepdim, ddof: jnp.var(
+    x, axis=axis, keepdims=keepdim, ddof=ddof))
+
+
+@tensor_method("std")
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply("std_", x, axis=_norm_axis(axis), keepdim=bool(keepdim),
+                 ddof=1 if unbiased else 0)
+
+
+@tensor_method("var")
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply("var_", x, axis=_norm_axis(axis), keepdim=bool(keepdim),
+                 ddof=1 if unbiased else 0)
+
+
+register_op("median_", lambda x, axis, keepdim: jnp.median(
+    x, axis=axis, keepdims=keepdim))
+
+
+@tensor_method("median")
+def median(x, axis=None, keepdim=False, name=None):
+    return apply("median_", x, axis=_norm_axis(axis), keepdim=bool(keepdim))
+
+
+register_op("quantile_", lambda x, q, axis, keepdim: jnp.quantile(
+    x, jnp.asarray(q), axis=axis, keepdims=keepdim))
+
+
+def quantile(x, q, axis=None, keepdim=False, name=None):
+    return apply("quantile_", x, q=q, axis=_norm_axis(axis),
+                 keepdim=bool(keepdim))
+
+
+register_op("count_nonzero_", lambda x, axis, keepdim: jnp.count_nonzero(
+    x, axis=axis, keepdims=keepdim).astype(jnp.int64))
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return apply("count_nonzero_", x, axis=_norm_axis(axis),
+                 keepdim=bool(keepdim))

@@ -1,0 +1,336 @@
+"""Search / indexing ops: argmax, gather/scatter, topk, sort, where, ...
+
+Analog of python/paddle/tensor/search.py + the gather/scatter phi kernels.
+Dynamic-result ops (nonzero, masked_select, unique) materialize indices on
+host first (XLA needs static shapes), then reuse static gather kernels so
+autograd still flows — the bucketing/padding policy from SURVEY.md §7.
+"""
+from __future__ import annotations
+
+import numbers
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .._core.executor import apply
+from .._core.op_registry import register_op
+from .._core.tensor import Tensor
+from ._helper import tensor_method
+from .manipulation import flatten, reshape
+
+# ------------------------------------------------------ argmax/argmin (nondiff)
+register_op("argmax_", lambda x, axis, keepdim, dtype: jnp.argmax(
+    x, axis=axis, keepdims=keepdim).astype(dtype))
+register_op("argmin_", lambda x, axis, keepdim, dtype: jnp.argmin(
+    x, axis=axis, keepdims=keepdim).astype(dtype))
+
+
+@tensor_method("argmax")
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    from .._core import dtype as dm
+    return apply("argmax_", x, axis=None if axis is None else int(axis),
+                 keepdim=bool(keepdim), dtype=str(dm.to_np(dtype)))
+
+
+@tensor_method("argmin")
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    from .._core import dtype as dm
+    return apply("argmin_", x, axis=None if axis is None else int(axis),
+                 keepdim=bool(keepdim), dtype=str(dm.to_np(dtype)))
+
+
+# ------------------------------------------------------ gather family
+register_op("take_along_axis_",
+            lambda x, idx, axis: jnp.take_along_axis(x, idx, axis=axis))
+
+
+@tensor_method("take_along_axis")
+def take_along_axis(x, indices, axis, broadcast=True, name=None):
+    return apply("take_along_axis_", x, indices, axis=int(axis))
+
+
+def _put_along_axis_kernel(x, idx, v, axis, reduce):
+    v = jnp.broadcast_to(v, idx.shape).astype(x.dtype)
+    if reduce == "assign":
+        return jnp.put_along_axis(x, idx, v, axis=axis, inplace=False)
+    dims = list(range(x.ndim))
+    # build scatter indices for general reduce
+    grids = jnp.meshgrid(*[jnp.arange(s) for s in idx.shape], indexing="ij")
+    full_idx = [grids[d] for d in dims]
+    full_idx[axis] = idx
+    flat_idx = jnp.stack([g.reshape(-1) for g in full_idx], axis=-1)
+    upd = v.reshape(-1)
+    if reduce == "add":
+        return x.at[tuple(flat_idx[:, d] for d in dims)].add(upd)
+    if reduce == "multiply" or reduce == "mul":
+        return x.at[tuple(flat_idx[:, d] for d in dims)].multiply(upd)
+    raise ValueError(f"unsupported reduce: {reduce}")
+
+
+register_op("put_along_axis_", _put_along_axis_kernel)
+
+
+@tensor_method("put_along_axis")
+def put_along_axis(x, indices, values, axis, reduce="assign",
+                   include_self=True, broadcast=True, name=None):
+    return apply("put_along_axis_", x, indices, values, axis=int(axis),
+                 reduce=reduce)
+
+
+register_op("gather_", lambda x, idx, axis: jnp.take(x, idx, axis=axis))
+
+
+@tensor_method("gather")
+def gather(x, index, axis=0, name=None):
+    if index.ndim == 2 and index.shape[1] == 1:
+        index = flatten(index)
+    return apply("gather_", x, index, axis=int(axis) if not isinstance(
+        axis, Tensor) else int(axis.item()))
+
+
+def _gather_nd_kernel(x, index):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x[idx]
+
+
+register_op("gather_nd_", _gather_nd_kernel)
+
+
+@tensor_method("gather_nd")
+def gather_nd(x, index, name=None):
+    return apply("gather_nd_", x, index)
+
+
+def _scatter_kernel(x, index, updates, overwrite):
+    if index.ndim == 2 and index.shape[-1] == 1:
+        index = index[:, 0]
+    if overwrite:
+        return x.at[index].set(updates.astype(x.dtype))
+    # paddle scatter w/ overwrite=False zeroes target rows then adds
+    zeroed = x.at[index].set(jnp.zeros_like(updates, dtype=x.dtype))
+    return zeroed.at[index].add(updates.astype(x.dtype))
+
+
+register_op("scatter_", _scatter_kernel)
+
+
+@tensor_method("scatter")
+def scatter(x, index, updates, overwrite=True, name=None):
+    return apply("scatter_", x, index, updates, overwrite=bool(overwrite))
+
+
+def _scatter_nd_add_kernel(x, index, updates):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x.at[idx].add(updates.astype(x.dtype))
+
+
+register_op("scatter_nd_add_", _scatter_nd_add_kernel)
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    return apply("scatter_nd_add_", x, index, updates)
+
+
+def scatter_nd(index, updates, shape, name=None):
+    from .creation import zeros
+    zero = zeros(shape, dtype=updates.dtype)
+    return scatter_nd_add(zero, index, updates)
+
+
+register_op("index_select_",
+            lambda x, idx, axis: jnp.take(x, idx, axis=axis))
+
+
+@tensor_method("index_select")
+def index_select(x, index, axis=0, name=None):
+    return apply("index_select_", x, index, axis=int(axis))
+
+
+def _index_sample_kernel(x, index):
+    return jnp.take_along_axis(x, index, axis=1)
+
+
+register_op("index_sample_", _index_sample_kernel)
+
+
+def index_sample(x, index):
+    return apply("index_sample_", x, index)
+
+
+def _index_add_kernel(x, index, value, axis):
+    moved = jnp.moveaxis(x, axis, 0)
+    v = jnp.moveaxis(value, axis, 0).astype(x.dtype)
+    out = moved.at[index].add(v)
+    return jnp.moveaxis(out, 0, axis)
+
+
+register_op("index_add_", _index_add_kernel)
+
+
+@tensor_method("index_add")
+def index_add(x, index, axis, value, name=None):
+    return apply("index_add_", x, index, value, axis=int(axis))
+
+
+def _index_put_kernel(x, v, *idx, accumulate):
+    if accumulate:
+        return x.at[tuple(idx)].add(v.astype(x.dtype))
+    return x.at[tuple(idx)].set(v.astype(x.dtype))
+
+
+register_op("index_put_", _index_put_kernel)
+
+
+@tensor_method("index_put")
+def index_put(x, indices, value, accumulate=False, name=None):
+    return apply("index_put_", x, value, *list(indices),
+                 accumulate=bool(accumulate))
+
+
+# ------------------------------------------------------ topk / sort
+register_op("arg_topk_", lambda x, k, axis, largest: (
+    jax.lax.top_k(jnp.moveaxis(x if largest else -x, axis, -1), k)[1]))
+
+
+def _topk_indices(x, k, axis, largest):
+    idx = apply("arg_topk_", x, k=int(k), axis=axis, largest=bool(largest))
+    # lax.top_k works on the last axis of the moved array; move back
+    return idx
+
+
+@tensor_method("topk")
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    if isinstance(k, Tensor):
+        k = int(k.item())
+    axis = int(axis) % x.ndim
+    idx = _topk_indices(x, k, axis, largest)
+    from .manipulation import moveaxis
+    if axis != x.ndim - 1:
+        idx = moveaxis(idx, -1, axis)
+    values = take_along_axis(x, idx, axis)
+    idx64 = apply("cast", idx, dtype="int64")
+    return values, idx64
+
+
+register_op("argsort_", lambda x, axis, descending: (
+    jnp.argsort(-x if descending else x, axis=axis,
+                stable=True).astype(jnp.int64)))
+
+
+@tensor_method("argsort")
+def argsort(x, axis=-1, descending=False, stable=True, name=None):
+    return apply("argsort_", x, axis=int(axis), descending=bool(descending))
+
+
+@tensor_method("sort")
+def sort(x, axis=-1, descending=False, stable=True, name=None):
+    idx = argsort(x, axis=axis, descending=descending)
+    return take_along_axis(x, idx, axis)
+
+
+def _kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    axis = int(axis) % x.ndim
+    vals = sort(x, axis=axis)
+    idx = argsort(x, axis=axis)
+    from . import manipulation as M
+    take = [slice(None)] * x.ndim
+    take[axis] = slice(k - 1, k)
+    v = vals[tuple(take)]
+    i = idx[tuple(take)]
+    if not keepdim:
+        v, i = v.squeeze(axis), i.squeeze(axis)
+    return v, i
+
+
+kthvalue = _kthvalue
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    v = x.numpy()
+    import scipy.stats  # available via numpy stack; fallback manual
+    raise NotImplementedError("mode is not implemented yet")
+
+
+register_op("searchsorted_",
+            lambda a, v, right: jnp.searchsorted(
+                a, v, side="right" if right else "left").astype(jnp.int64))
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False,
+                 name=None):
+    out = apply("searchsorted_", sorted_sequence, values, right=bool(right))
+    if out_int32:
+        from .manipulation import cast
+        out = cast(out, "int32")
+    return out
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)
+
+
+# ------------------------------------------------------ where / dynamic ops
+register_op("where_", lambda c, x, y: jnp.where(c, x, y))
+
+
+@tensor_method("where")
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return apply("where_", condition, x, y)
+
+
+def nonzero(x, as_tuple=False):
+    """Dynamic-shape: synchronizes with host (documented XLA constraint)."""
+    idx = np.nonzero(np.asarray(x._value))
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i[:, None], dtype=jnp.int64))
+                     for i in idx)
+    return Tensor(jnp.asarray(np.stack(idx, axis=-1), dtype=jnp.int64)) \
+        if idx else Tensor(jnp.zeros((0, x.ndim), jnp.int64))
+
+
+@tensor_method("masked_select")
+def masked_select(x, mask, name=None):
+    """Dynamic-shape: indices resolved on host, gather stays on device so
+    gradients flow through gather_nd."""
+    mval = np.asarray(mask._value)
+    if mval.shape != tuple(x.shape):
+        mval = np.broadcast_to(mval, x.shape)
+    idx = np.stack(np.nonzero(mval), axis=-1)
+    index = Tensor(jnp.asarray(idx, dtype=jnp.int64))
+    return gather_nd(x, index)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    val = np.asarray(x._value)
+    res = np.unique(val, return_index=return_index,
+                    return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(jnp.asarray(res))
+    outs = [Tensor(jnp.asarray(r)) for r in res]
+    return tuple(outs)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False,
+                       axis=None, dtype="int64", name=None):
+    val = np.asarray(x._value)
+    if axis is None:
+        val = val.reshape(-1)
+    n = val.shape[0] if val.ndim else 1
+    keep = np.ones(n, dtype=bool)
+    keep[1:] = np.any(
+        val[1:].reshape(n - 1, -1) != val[:-1].reshape(n - 1, -1), axis=1)
+    out = Tensor(jnp.asarray(val[keep]))
+    results = [out]
+    if return_inverse:
+        inv = np.cumsum(keep) - 1
+        results.append(Tensor(jnp.asarray(inv)))
+    if return_counts:
+        pos = np.flatnonzero(keep)
+        counts = np.diff(np.append(pos, n))
+        results.append(Tensor(jnp.asarray(counts)))
+    return results[0] if len(results) == 1 else tuple(results)

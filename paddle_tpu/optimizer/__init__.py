@@ -1,0 +1,3 @@
+from . import lr  # noqa: F401
+from .optimizer import (Optimizer, SGD, Momentum, Adam, AdamW, Adagrad,  # noqa: F401
+                        RMSProp, Adadelta, Adamax, Lamb)

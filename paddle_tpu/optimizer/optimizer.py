@@ -1,0 +1,426 @@
+"""Optimizers (python/paddle/optimizer analog).
+
+Each step runs ONE fused XLA executable over the whole parameter pytree
+(the TPU-idiomatic replacement for the reference's per-param fused CUDA
+optimizer kernels, e.g. multi_tensor_adam). States live as raw jax arrays;
+parameters are updated in place (payload swap).
+
+Supports multi_precision (fp32 master weights for bf16/fp16 params),
+grad_clip objects, parameter groups with per-group lr / weight_decay, and
+LRScheduler instances.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .._core.autograd import no_grad
+from .._core.tensor import Tensor
+from .lr import LRScheduler
+
+__all__ = ["Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adagrad",
+           "RMSProp", "Adadelta", "Adamax", "Lamb"]
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        self._lr = learning_rate
+        self._grad_clip = grad_clip
+        self._multi_precision = multi_precision
+        self._step_count = 0
+        self._states: Dict[int, Dict[str, jnp.ndarray]] = {}
+        self._master: Dict[int, jnp.ndarray] = {}
+        wd = weight_decay
+        if wd is None:
+            wd = 0.0
+        if hasattr(wd, "_coeff"):  # L2Decay object
+            wd = wd._coeff
+        self._default_wd = float(wd)
+        # parameter groups
+        self._param_groups: List[dict] = []
+        if parameters is None:
+            raise ValueError("parameters must be provided in dygraph mode")
+        params = list(parameters)
+        if params and isinstance(params[0], dict):
+            for g in params:
+                self._param_groups.append({
+                    "params": list(g["params"]),
+                    "learning_rate": float(g.get("learning_rate", 1.0)),
+                    "weight_decay": float(
+                        g["weight_decay"]._coeff if hasattr(
+                            g.get("weight_decay"), "_coeff")
+                        else g.get("weight_decay", self._default_wd)
+                        if g.get("weight_decay") is not None
+                        else self._default_wd),
+                })
+        else:
+            self._param_groups.append({"params": params,
+                                       "learning_rate": 1.0,
+                                       "weight_decay": self._default_wd})
+        self._jit_update = jax.jit(
+            self._fused_update, static_argnames=("wds", "lr_mults"))
+
+    # ------------------------------------------------------------- lr
+    def get_lr(self):
+        if isinstance(self._lr, LRScheduler):
+            return self._lr()
+        return float(self._lr)
+
+    def set_lr(self, value):
+        if isinstance(self._lr, LRScheduler):
+            raise RuntimeError("cannot set_lr when using an LRScheduler")
+        self._lr = float(value)
+
+    def set_lr_scheduler(self, scheduler):
+        self._lr = scheduler
+
+    # ------------------------------------------------------------- step
+    def _all_params(self):
+        out = []
+        for g in self._param_groups:
+            for p in g["params"]:
+                out.append((p, g))
+        return out
+
+    @no_grad()
+    def step(self):
+        pairs = []
+        metas = []
+        for p, g in self._all_params():
+            if p.stop_gradient or p.grad is None:
+                continue
+            pairs.append((p, p.grad))
+            metas.append(g)
+        if not pairs:
+            return
+        if self._grad_clip is not None:
+            pairs = self._grad_clip(pairs)
+        self._step_count += 1
+        lr = jnp.asarray(self.get_lr(), jnp.float32)
+        t = jnp.asarray(self._step_count, jnp.float32)
+
+        pvals, gvals, states = [], [], []
+        for (p, grad), meta in zip(pairs, metas):
+            pid = id(p)
+            if pid not in self._states:
+                self._states[pid] = self._init_state(p)
+                if self._multi_precision and p._value.dtype in (
+                        jnp.bfloat16, jnp.float16):
+                    self._master[pid] = p._value.astype(jnp.float32)
+            master = self._master.get(pid)
+            pvals.append(p._value if master is None else master)
+            gvals.append(grad._value)
+            states.append(self._states[pid])
+
+        wds = tuple(m["weight_decay"] for m in metas)
+        lr_mults = tuple(m["learning_rate"] for m in metas)
+        new_p, new_s = self._jit_update(pvals, gvals, states, lr, t,
+                                        wds=wds, lr_mults=lr_mults)
+        for (p, _), meta, np_, ns in zip(pairs, metas, new_p, new_s):
+            pid = id(p)
+            self._states[pid] = ns
+            if pid in self._master:
+                self._master[pid] = np_
+                p._replace_value_inplace(np_.astype(p._value.dtype))
+            else:
+                p._replace_value_inplace(np_)
+
+    def _fused_update(self, pvals, gvals, states, lr, t, wds, lr_mults):
+        new_p, new_s = [], []
+        for p, g, s, wd, mult in zip(pvals, gvals, states, wds, lr_mults):
+            g = g.astype(p.dtype) if g.dtype != p.dtype else g
+            np_, ns = self._update_one(p, g, s, lr * mult, t, wd)
+            new_p.append(np_)
+            new_s.append(ns)
+        return new_p, new_s
+
+    def _init_state(self, p) -> Dict[str, jnp.ndarray]:
+        return {}
+
+    def _update_one(self, p, g, s, lr, t, wd):
+        raise NotImplementedError
+
+    @no_grad()
+    def clear_grad(self, set_to_zero=True):
+        for p, _ in self._all_params():
+            p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, None
+
+    # ------------------------------------------------------------- state io
+    def state_dict(self):
+        out = {"step": self._step_count}
+        for i, (p, _) in enumerate(self._all_params()):
+            pid = id(p)
+            key = p.name or f"param_{i}"
+            if pid in self._states:
+                for k, v in self._states[pid].items():
+                    out[f"{key}.{k}"] = Tensor(v)
+            if pid in self._master:
+                out[f"{key}.master"] = Tensor(self._master[pid])
+        if isinstance(self._lr, LRScheduler):
+            out["LR_Scheduler"] = self._lr.state_dict()
+        return out
+
+    def set_state_dict(self, state):
+        self._step_count = int(state.get("step", 0))
+        for i, (p, _) in enumerate(self._all_params()):
+            key = p.name or f"param_{i}"
+            st = self._init_state(p)
+            found = False
+            for k in list(st.keys()):
+                sk = f"{key}.{k}"
+                if sk in state:
+                    v = state[sk]
+                    st[k] = v._value if isinstance(v, Tensor) else \
+                        jnp.asarray(v)
+                    found = True
+            if found:
+                self._states[id(p)] = st
+            mk = f"{key}.master"
+            if mk in state:
+                v = state[mk]
+                self._master[id(p)] = v._value if isinstance(v, Tensor) \
+                    else jnp.asarray(v)
+        if isinstance(self._lr, LRScheduler) and "LR_Scheduler" in state:
+            self._lr.set_state_dict(state["LR_Scheduler"])
+
+    set_dict = set_state_dict
+
+
+class SGD(Optimizer):
+    def _update_one(self, p, g, s, lr, t, wd):
+        if wd:
+            g = g + wd * p
+        return p - lr.astype(p.dtype) * g, s
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        self._momentum = float(momentum)
+        self._nesterov = use_nesterov
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+
+    def _init_state(self, p):
+        dt = jnp.float32 if self._multi_precision else p._value.dtype
+        return {"velocity": jnp.zeros(p._value.shape, dt)}
+
+    def _update_one(self, p, g, s, lr, t, wd):
+        if wd:
+            g = g + wd * p
+        v = self._momentum * s["velocity"] + g
+        if self._nesterov:
+            upd = g + self._momentum * v
+        else:
+            upd = v
+        return p - lr.astype(p.dtype) * upd, {"velocity": v}
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 amsgrad=False, name=None):
+        self._b1, self._b2, self._eps = float(beta1), float(beta2), \
+            float(epsilon)
+        self._amsgrad = amsgrad
+        self._decoupled = False
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+
+    def _init_state(self, p):
+        dt = jnp.float32 if self._multi_precision else p._value.dtype
+        s = {"m": jnp.zeros(p._value.shape, dt),
+             "v": jnp.zeros(p._value.shape, dt)}
+        if self._amsgrad:
+            s["vmax"] = jnp.zeros(p._value.shape, dt)
+        return s
+
+    def _update_one(self, p, g, s, lr, t, wd):
+        b1, b2, eps = self._b1, self._b2, self._eps
+        if wd and not self._decoupled:
+            g = g + wd * p
+        m = b1 * s["m"] + (1 - b1) * g
+        v = b2 * s["v"] + (1 - b2) * g * g
+        mhat = m / (1 - b1 ** t).astype(p.dtype)
+        vv = v
+        ns = {"m": m, "v": v}
+        if self._amsgrad:
+            vv = jnp.maximum(s["vmax"], v)
+            ns["vmax"] = vv
+        vhat = vv / (1 - b2 ** t).astype(p.dtype)
+        upd = mhat / (jnp.sqrt(vhat) + eps)
+        if wd and self._decoupled:
+            upd = upd + wd * p
+        return p - lr.astype(p.dtype) * upd, ns
+
+
+class AdamW(Adam):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, amsgrad=False,
+                 name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip, lazy_mode, multi_precision,
+                         amsgrad, name)
+        self._decoupled = True
+        self._apply_decay_fn = apply_decay_param_fun
+        if apply_decay_param_fun is not None:
+            # zero out wd for excluded params by splitting groups
+            for grp in self._param_groups:
+                keep, drop = [], []
+                for p in grp["params"]:
+                    (keep if apply_decay_param_fun(p.name) else drop).append(p)
+                if drop and keep:
+                    grp["params"] = keep
+                    self._param_groups.append({
+                        "params": drop, "learning_rate":
+                        grp["learning_rate"], "weight_decay": 0.0})
+                elif drop:
+                    grp["weight_decay"] = 0.0
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 initial_accumulator_value=0.0, name=None):
+        self._eps = float(epsilon)
+        self._init_acc = float(initial_accumulator_value)
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+
+    def _init_state(self, p):
+        dt = jnp.float32 if self._multi_precision else p._value.dtype
+        return {"acc": jnp.full(p._value.shape, self._init_acc, dt)}
+
+    def _update_one(self, p, g, s, lr, t, wd):
+        if wd:
+            g = g + wd * p
+        acc = s["acc"] + g * g
+        return p - lr.astype(p.dtype) * g / (jnp.sqrt(acc) + self._eps), \
+            {"acc": acc}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        self._rho, self._eps = float(rho), float(epsilon)
+        self._momentum, self._centered = float(momentum), centered
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+
+    def _init_state(self, p):
+        dt = jnp.float32 if self._multi_precision else p._value.dtype
+        s = {"ms": jnp.zeros(p._value.shape, dt),
+             "mom": jnp.zeros(p._value.shape, dt)}
+        if self._centered:
+            s["mg"] = jnp.zeros(p._value.shape, dt)
+        return s
+
+    def _update_one(self, p, g, s, lr, t, wd):
+        if wd:
+            g = g + wd * p
+        ms = self._rho * s["ms"] + (1 - self._rho) * g * g
+        ns = {"ms": ms}
+        if self._centered:
+            mg = self._rho * s["mg"] + (1 - self._rho) * g
+            denom = jnp.sqrt(ms - mg * mg + self._eps)
+            ns["mg"] = mg
+        else:
+            denom = jnp.sqrt(ms + self._eps)
+        mom = self._momentum * s["mom"] + lr.astype(p.dtype) * g / denom
+        ns["mom"] = mom
+        return p - mom, ns
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        self._rho, self._eps = float(rho), float(epsilon)
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+
+    def _init_state(self, p):
+        dt = jnp.float32 if self._multi_precision else p._value.dtype
+        return {"avg_sq": jnp.zeros(p._value.shape, dt),
+                "avg_dx": jnp.zeros(p._value.shape, dt)}
+
+    def _update_one(self, p, g, s, lr, t, wd):
+        if wd:
+            g = g + wd * p
+        avg_sq = self._rho * s["avg_sq"] + (1 - self._rho) * g * g
+        dx = jnp.sqrt((s["avg_dx"] + self._eps) / (avg_sq + self._eps)) * g
+        avg_dx = self._rho * s["avg_dx"] + (1 - self._rho) * dx * dx
+        return p - lr.astype(p.dtype) * dx, \
+            {"avg_sq": avg_sq, "avg_dx": avg_dx}
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        self._b1, self._b2, self._eps = float(beta1), float(beta2), \
+            float(epsilon)
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+
+    def _init_state(self, p):
+        dt = jnp.float32 if self._multi_precision else p._value.dtype
+        return {"m": jnp.zeros(p._value.shape, dt),
+                "u": jnp.zeros(p._value.shape, dt)}
+
+    def _update_one(self, p, g, s, lr, t, wd):
+        if wd:
+            g = g + wd * p
+        m = self._b1 * s["m"] + (1 - self._b1) * g
+        u = jnp.maximum(self._b2 * s["u"], jnp.abs(g))
+        upd = m / ((1 - self._b1 ** t).astype(p.dtype) * (u + self._eps))
+        return p - lr.astype(p.dtype) * upd, {"m": m, "u": u}
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None,
+                 grad_clip=None, exclude_from_weight_decay_fn=None,
+                 multi_precision=False, name=None):
+        self._b1, self._b2, self._eps = float(beta1), float(beta2), \
+            float(epsilon)
+        self._exclude_fn = exclude_from_weight_decay_fn
+        super().__init__(learning_rate, parameters, lamb_weight_decay,
+                         grad_clip, multi_precision, name)
+
+    def _init_state(self, p):
+        dt = jnp.float32 if self._multi_precision else p._value.dtype
+        return {"m": jnp.zeros(p._value.shape, dt),
+                "v": jnp.zeros(p._value.shape, dt)}
+
+    def _update_one(self, p, g, s, lr, t, wd):
+        b1, b2 = self._b1, self._b2
+        m = b1 * s["m"] + (1 - b1) * g
+        v = b2 * s["v"] + (1 - b2) * g * g
+        mhat = m / (1 - b1 ** t).astype(p.dtype)
+        vhat = v / (1 - b2 ** t).astype(p.dtype)
+        r = mhat / (jnp.sqrt(vhat) + self._eps)
+        if wd:
+            r = r + wd * p
+        w_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+        r_norm = jnp.sqrt(jnp.sum(jnp.square(r)))
+        trust = jnp.where((w_norm > 0) & (r_norm > 0),
+                          w_norm / r_norm, 1.0)
+        return p - lr.astype(p.dtype) * trust * r, {"m": m, "v": v}

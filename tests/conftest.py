@@ -1,0 +1,24 @@
+"""Test config: run on a virtual 8-device CPU mesh (the driver validates the
+real-TPU path separately via __graft_entry__). Mirrors the reference's
+fake-device testing approach (phi/backends/custom/fake_cpu_device.h,
+SURVEY.md §4)."""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # override the axon TPU tunnel
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    import paddle_tpu
+    paddle_tpu.seed(2024)
+    yield

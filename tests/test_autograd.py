@@ -1,0 +1,150 @@
+"""Autograd engine tests (mirrors the reference's eager backward tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_simple_backward():
+    x = paddle.to_tensor([2.0, 3.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0, 6.0])
+
+
+def test_chain():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = paddle.exp(x)
+    z = (y * 2).sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 2 * np.exp([1.0, 2.0]),
+                               rtol=1e-5)
+
+
+def test_branching_accumulation():
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    a = x * 2
+    b = x * 3
+    loss = (a + b).sum()
+    loss.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0])
+
+
+def test_matmul_grad():
+    a = paddle.to_tensor(np.random.rand(2, 3).astype(np.float32),
+                         stop_gradient=False)
+    b = paddle.to_tensor(np.random.rand(3, 4).astype(np.float32),
+                         stop_gradient=False)
+    loss = paddle.matmul(a, b).sum()
+    loss.backward()
+    np.testing.assert_allclose(a.grad.numpy(),
+                               np.ones((2, 4)) @ b.numpy().T, rtol=1e-5)
+    np.testing.assert_allclose(b.grad.numpy(),
+                               a.numpy().T @ np.ones((2, 4)), rtol=1e-5)
+
+
+def test_stop_gradient_blocks():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = paddle.to_tensor([2.0], stop_gradient=True)
+    loss = (x * y).sum()
+    loss.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+    assert y.grad is None
+
+
+def test_no_grad():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2
+    assert y.stop_gradient
+
+
+def test_grad_accumulation_across_backwards():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    (x * 2).sum().backward()
+    (x * 3).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0])
+    x.clear_grad()
+    assert x.grad is None
+
+
+def test_paddle_grad_api():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * x
+    (gx,) = paddle.grad(y, x)
+    np.testing.assert_allclose(gx.numpy(), [4.0])
+    # .grad not polluted
+    assert x.grad is None
+
+
+def test_grad_nonleaf_target():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * 3
+    z = y * y
+    (gy,) = paddle.grad(z, y)
+    np.testing.assert_allclose(gy.numpy(), [12.0])
+
+
+def test_register_hook():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    seen = []
+
+    def hook(g):
+        seen.append(g.numpy().copy())
+        return g * 2
+
+    x.register_hook(hook)
+    (x * 5).sum().backward()
+    assert seen and seen[0][0] == pytest.approx(5.0)
+    np.testing.assert_allclose(x.grad.numpy(), [10.0])
+
+
+def test_multi_output_split_grad():
+    x = paddle.to_tensor(np.arange(6, dtype=np.float32), stop_gradient=False)
+    a, b = paddle.split(x, 2)
+    loss = (a * 2).sum() + (b * 3).sum()
+    loss.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2, 2, 2, 3, 3, 3])
+
+
+def test_softmax_ce_grad_matches_numeric():
+    logits = np.random.randn(4, 5).astype(np.float32)
+    labels = np.array([0, 2, 1, 4])
+    x = paddle.to_tensor(logits, stop_gradient=False)
+    loss = paddle.nn.functional.cross_entropy(x, paddle.to_tensor(labels))
+    loss.backward()
+    # numeric check
+    eps = 1e-3
+    g = np.zeros_like(logits)
+    import jax.nn as jnn
+    import jax.numpy as jnp
+
+    def f(arr):
+        lp = np.asarray(jnn.log_softmax(jnp.asarray(arr), axis=-1))
+        return -lp[np.arange(4), labels].mean()
+
+    for i in range(4):
+        for j in range(5):
+            p = logits.copy()
+            p[i, j] += eps
+            m = logits.copy()
+            m[i, j] -= eps
+            g[i, j] = (f(p) - f(m)) / (2 * eps)
+    np.testing.assert_allclose(x.grad.numpy(), g, atol=1e-2)
+
+
+def test_pylayer():
+    class Double(paddle.autograd.PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * 2
+
+        @staticmethod
+        def backward(ctx, gy):
+            return gy * 2
+
+    x = paddle.to_tensor([1.5], stop_gradient=False)
+    y = Double.apply(x)
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])

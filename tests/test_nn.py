@@ -1,0 +1,195 @@
+"""nn layers + functionals (OpTest-style numeric checks vs numpy)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def test_linear():
+    layer = nn.Linear(4, 3)
+    x = paddle.rand([2, 4])
+    y = layer(x)
+    assert y.shape == [2, 3]
+    np.testing.assert_allclose(
+        y.numpy(), x.numpy() @ layer.weight.numpy() + layer.bias.numpy(),
+        rtol=1e-5, atol=1e-6)
+
+
+def test_parameters_enumeration():
+    layer = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    names = [n for n, _ in layer.named_parameters()]
+    assert names == ["0.weight", "0.bias", "2.weight", "2.bias"]
+    assert len(layer.parameters()) == 4
+    assert all(not p.stop_gradient for p in layer.parameters())
+
+
+def test_conv2d_matches_reference():
+    import jax
+    layer = nn.Conv2D(2, 3, 3, stride=1, padding=1)
+    x = paddle.rand([1, 2, 8, 8])
+    y = layer(x)
+    assert y.shape == [1, 3, 8, 8]
+    # check against lax reference directly
+    ref = jax.lax.conv_general_dilated(
+        x.numpy(), layer.weight.numpy(), (1, 1), ((1, 1), (1, 1)),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    ref = ref + layer.bias.numpy().reshape(1, -1, 1, 1)
+    np.testing.assert_allclose(y.numpy(), np.asarray(ref), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_pools():
+    x = paddle.to_tensor(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+    y = F.max_pool2d(x, 2, 2)
+    np.testing.assert_allclose(y.numpy()[0, 0], [[5, 7], [13, 15]])
+    y2 = F.avg_pool2d(x, 2, 2)
+    np.testing.assert_allclose(y2.numpy()[0, 0], [[2.5, 4.5], [10.5, 12.5]])
+    y3 = F.adaptive_avg_pool2d(x, 1)
+    np.testing.assert_allclose(y3.numpy()[0, 0, 0, 0], 7.5)
+
+
+def test_batch_norm_train_eval():
+    bn = nn.BatchNorm2D(3)
+    x = paddle.rand([4, 3, 5, 5])
+    bn.train()
+    y = bn(x)
+    # batch-normalized output should have ~zero mean
+    m = y.numpy().mean(axis=(0, 2, 3))
+    np.testing.assert_allclose(m, np.zeros(3), atol=1e-5)
+    # running stats updated
+    assert not np.allclose(bn._mean.numpy(), np.zeros(3))
+    bn.eval()
+    y2 = bn(x)
+    assert y2.shape == y.shape
+
+
+def test_layer_norm():
+    ln = nn.LayerNorm(8)
+    x = paddle.rand([2, 4, 8])
+    y = ln(x)
+    np.testing.assert_allclose(y.numpy().mean(-1), np.zeros((2, 4)),
+                               atol=1e-5)
+    np.testing.assert_allclose(y.numpy().std(-1), np.ones((2, 4)),
+                               atol=1e-2)
+
+
+def test_rms_norm():
+    rn = nn.RMSNorm(16)
+    x = paddle.rand([2, 16])
+    y = rn(x)
+    rms = np.sqrt((x.numpy() ** 2).mean(-1, keepdims=True) + 1e-6)
+    np.testing.assert_allclose(y.numpy(), x.numpy() / rms, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_embedding_and_grad():
+    emb = nn.Embedding(10, 4)
+    ids = paddle.to_tensor([[1, 2], [3, 1]])
+    out = emb(ids)
+    assert out.shape == [2, 2, 4]
+    out.sum().backward()
+    g = emb.weight.grad.numpy()
+    assert g[1].sum() == pytest.approx(8.0)  # id 1 appears twice
+    assert g[5].sum() == 0.0
+
+
+def test_dropout_modes():
+    x = paddle.ones([1000])
+    y = F.dropout(x, 0.5, training=True)
+    kept = (y.numpy() != 0).mean()
+    assert 0.35 < kept < 0.65
+    np.testing.assert_allclose(y.numpy()[y.numpy() != 0], 2.0)
+    y_eval = F.dropout(x, 0.5, training=False)
+    np.testing.assert_allclose(y_eval.numpy(), x.numpy())
+
+
+def test_activations():
+    x = paddle.to_tensor([-2.0, 0.0, 2.0])
+    np.testing.assert_allclose(F.relu(x).numpy(), [0, 0, 2])
+    np.testing.assert_allclose(F.sigmoid(x).numpy(),
+                               1 / (1 + np.exp([2.0, 0, -2.0])), rtol=1e-5)
+    assert F.gelu(x).shape == [3]
+    assert F.softmax(x).numpy().sum() == pytest.approx(1.0, rel=1e-5)
+
+
+def test_cross_entropy_ignore_index():
+    logits = paddle.rand([4, 5])
+    labels = paddle.to_tensor([0, 1, -100, 2])
+    loss = F.cross_entropy(logits, labels, ignore_index=-100)
+    # manual
+    lp = np.log(np.exp(logits.numpy()) /
+                np.exp(logits.numpy()).sum(-1, keepdims=True))
+    want = -(lp[0, 0] + lp[1, 1] + lp[3, 2]) / 3
+    np.testing.assert_allclose(loss.numpy(), want, rtol=1e-4)
+
+
+def test_losses():
+    x = paddle.to_tensor([[0.5, 0.5]])
+    y = paddle.to_tensor([[1.0, 0.0]])
+    np.testing.assert_allclose(F.mse_loss(x, y).numpy(), 0.25, rtol=1e-6)
+    np.testing.assert_allclose(F.l1_loss(x, y).numpy(), 0.5, rtol=1e-6)
+    b = F.binary_cross_entropy(paddle.to_tensor([0.9]),
+                               paddle.to_tensor([1.0]))
+    np.testing.assert_allclose(b.numpy(), -np.log(0.9), rtol=1e-5)
+
+
+def test_multi_head_attention():
+    mha = nn.MultiHeadAttention(16, 4)
+    x = paddle.rand([2, 6, 16])
+    y = mha(x, x, x)
+    assert y.shape == [2, 6, 16]
+    y.sum().backward()
+    assert mha.q_proj.weight.grad is not None
+
+
+def test_transformer_encoder():
+    layer = nn.TransformerEncoderLayer(d_model=16, nhead=2,
+                                       dim_feedforward=32, dropout=0.0)
+    enc = nn.TransformerEncoder(layer, 2)
+    x = paddle.rand([2, 5, 16])
+    y = enc(x)
+    assert y.shape == [2, 5, 16]
+
+
+def test_state_dict_roundtrip():
+    l1 = nn.Linear(3, 3)
+    l2 = nn.Linear(3, 3)
+    l2.set_state_dict(l1.state_dict())
+    np.testing.assert_allclose(l1.weight.numpy(), l2.weight.numpy())
+
+
+def test_sdpa_causal():
+    q = paddle.rand([1, 4, 2, 8])
+    out = F.scaled_dot_product_attention(q, q, q, is_causal=True)
+    assert out.shape == [1, 4, 2, 8]
+    # first position attends only to itself -> equals v at pos 0
+    np.testing.assert_allclose(out.numpy()[:, 0], q.numpy()[:, 0], rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_flash_attention_api():
+    q = paddle.rand([2, 8, 2, 16])
+    out, _ = F.flash_attention(q, q, q, causal=True)
+    assert out.shape == [2, 8, 2, 16]
+
+
+def test_weight_norm():
+    from paddle_tpu.nn import weight_norm
+    l = nn.Linear(4, 3)
+    weight_norm(l, "weight")
+    x = paddle.rand([2, 4])
+    y = l(x)
+    assert y.shape == [2, 3]
+    assert "weight_g" in dict(l.named_parameters())
+
+
+def test_grad_clip_global_norm():
+    from paddle_tpu.nn import ClipGradByGlobalNorm
+    clip = ClipGradByGlobalNorm(1.0)
+    p = paddle.ones([4])
+    g = paddle.full([4], 10.0)
+    out = clip([(p, g)])
+    gnorm = np.linalg.norm(out[0][1].numpy())
+    assert gnorm == pytest.approx(1.0, rel=1e-4)

@@ -1,0 +1,122 @@
+"""Optimizer numerics + schedulers."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+
+
+def _quadratic_param():
+    p = nn.Parameter(np.asarray([5.0], np.float32))
+    return p
+
+
+def _step(optimizer, p, n=1):
+    for _ in range(n):
+        loss = (p * p).sum()
+        loss.backward()
+        optimizer.step()
+        optimizer.clear_grad()
+
+
+def test_sgd():
+    p = _quadratic_param()
+    o = opt.SGD(learning_rate=0.1, parameters=[p])
+    _step(o, p)
+    np.testing.assert_allclose(p.numpy(), [4.0], rtol=1e-6)
+
+
+def test_momentum_matches_manual():
+    p = _quadratic_param()
+    o = opt.Momentum(learning_rate=0.1, momentum=0.9, parameters=[p])
+    _step(o, p, 2)
+    # manual: v1=10, p=5-1=4 ; v2=0.9*10+8=17, p=4-1.7=2.3
+    np.testing.assert_allclose(p.numpy(), [2.3], rtol=1e-5)
+
+
+def test_adam_converges():
+    p = _quadratic_param()
+    o = opt.Adam(learning_rate=0.5, parameters=[p])
+    _step(o, p, 60)
+    assert abs(p.numpy()[0]) < 0.5
+
+
+def test_adamw_decoupled_decay():
+    p = nn.Parameter(np.asarray([1.0], np.float32))
+    o = opt.AdamW(learning_rate=0.0, weight_decay=0.1, parameters=[p])
+    loss = (p * 0.0).sum()
+    loss.backward()
+    o.step()
+    # lr=0 -> no update at all (decay scaled by lr)
+    np.testing.assert_allclose(p.numpy(), [1.0], rtol=1e-6)
+
+
+def test_param_groups_no_decay():
+    w = nn.Parameter(np.asarray([1.0], np.float32))
+    b = nn.Parameter(np.asarray([1.0], np.float32))
+    o = opt.AdamW(learning_rate=0.1, weight_decay=0.5, parameters=[
+        {"params": [w]},
+        {"params": [b], "weight_decay": 0.0},
+    ])
+    for p in (w, b):
+        p.grad = paddle.to_tensor([0.0])
+    o.step()
+    assert w.numpy()[0] < 1.0   # decayed
+    np.testing.assert_allclose(b.numpy(), [1.0], rtol=1e-6)
+
+
+def test_multi_precision_master_weights():
+    p = nn.Parameter(np.asarray([1.0], np.float32))
+    p._replace_value_inplace(p._value.astype("bfloat16"))
+    o = opt.AdamW(learning_rate=1e-3, parameters=[p], multi_precision=True)
+    p.grad = paddle.to_tensor([0.01], dtype="bfloat16")
+    o.step()
+    assert str(p._value.dtype) == "bfloat16"
+    assert id(p) in o._master
+
+
+def test_lr_scheduler_warmup():
+    sched = opt.lr.LinearWarmup(learning_rate=0.1, warmup_steps=10,
+                                start_lr=0.0, end_lr=0.1)
+    p = _quadratic_param()
+    o = opt.SGD(learning_rate=sched, parameters=[p])
+    lrs = []
+    for _ in range(12):
+        lrs.append(o.get_lr())
+        sched.step()
+    assert lrs[0] == pytest.approx(0.0)
+    assert lrs[5] == pytest.approx(0.05)
+    assert lrs[11] == pytest.approx(0.1)
+
+
+def test_cosine_decay():
+    sched = opt.lr.CosineAnnealingDecay(learning_rate=1.0, T_max=10)
+    vals = []
+    for _ in range(11):
+        vals.append(sched())
+        sched.step()
+    assert vals[0] == pytest.approx(1.0)
+    assert vals[10] == pytest.approx(0.0, abs=1e-6)
+
+
+def test_optimizer_state_roundtrip():
+    p = _quadratic_param()
+    o = opt.Adam(learning_rate=0.1, parameters=[p])
+    _step(o, p, 3)
+    state = o.state_dict()
+    p2 = _quadratic_param()
+    o2 = opt.Adam(learning_rate=0.1, parameters=[p2])
+    o2.set_state_dict(state)
+    assert o2._step_count == 3
+
+
+def test_grad_scaler_bf16_noop_path():
+    from paddle_tpu.amp import GradScaler
+    p = _quadratic_param()
+    o = opt.SGD(learning_rate=0.1, parameters=[p])
+    scaler = GradScaler(enable=False)
+    loss = (p * p).sum()
+    scaler.scale(loss).backward()
+    scaler.step(o)
+    np.testing.assert_allclose(p.numpy(), [4.0], rtol=1e-6)

@@ -1,0 +1,89 @@
+"""Regression tests for review findings (weighted losses, engine edge
+decrement with None grads, dropout infer scaling, PyLayer non-diff)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+
+def test_weighted_cross_entropy():
+    logits = paddle.rand([4, 3])
+    labels = paddle.to_tensor([0, 1, 2, 1])
+    w = paddle.to_tensor([1.0, 2.0, 3.0])
+    loss = F.cross_entropy(logits, labels, weight=w)
+    lp = np.log(np.exp(logits.numpy()) /
+                np.exp(logits.numpy()).sum(-1, keepdims=True))
+    wn = w.numpy()[labels.numpy()]
+    want = (-lp[np.arange(4), labels.numpy()] * wn).sum() / wn.sum()
+    np.testing.assert_allclose(loss.numpy(), want, rtol=1e-4)
+
+
+def test_weighted_nll_and_bce():
+    logp = F.log_softmax(paddle.rand([4, 3]))
+    labels = paddle.to_tensor([0, 1, 2, 1])
+    w = paddle.to_tensor([1.0, 2.0, 3.0])
+    out = F.nll_loss(logp, labels, weight=w)
+    assert out.shape == []
+    x = paddle.to_tensor([0.3, 0.7])
+    y = paddle.to_tensor([0.0, 1.0])
+    bw = paddle.to_tensor([2.0, 0.5])
+    out2 = F.binary_cross_entropy(x, y, weight=bw)
+    want = -(2.0 * np.log(0.7) + 0.5 * np.log(0.7)) / 2
+    np.testing.assert_allclose(out2.numpy(), want, rtol=1e-5)
+    out3 = F.binary_cross_entropy_with_logits(
+        x, y, pos_weight=paddle.to_tensor([2.0, 2.0]))
+    assert out3.shape == []
+
+
+def test_engine_decrements_on_none_grad():
+    # b feeds two consumers; one PyLayer consumer returns None for b's grad.
+    # The other path's (valid) contribution must still flow.
+    class TakeFirst(paddle.autograd.PyLayer):
+        @staticmethod
+        def forward(ctx, u, v):
+            return u * 1.0
+
+        @staticmethod
+        def backward(ctx, g):
+            return g, None
+
+    a = paddle.to_tensor([2.0], stop_gradient=False)
+    b = a * 3           # producer node
+    c = (b * b).sum()   # consumer 1: d/db = 2b = 12
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    d = TakeFirst.apply(x, b).sum()  # consumer 2: grad for b is None
+    (c + d).backward()
+    np.testing.assert_allclose(a.grad.numpy(), [36.0])  # 12 * 3
+    np.testing.assert_allclose(x.grad.numpy(), [1.0])
+
+
+def test_dropout_downscale_in_infer():
+    x = paddle.ones([8])
+    y = F.dropout(x, 0.25, training=False, mode="downscale_in_infer")
+    np.testing.assert_allclose(y.numpy(), np.full(8, 0.75), rtol=1e-6)
+
+
+def test_pylayer_mark_non_differentiable():
+    class WithAux(paddle.autograd.PyLayer):
+        @staticmethod
+        def forward(ctx, u):
+            aux = u * 100.0
+            ctx.mark_non_differentiable(aux)
+            return u * 2.0, aux
+
+        @staticmethod
+        def backward(ctx, g):
+            return g * 2.0
+
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y, aux = WithAux.apply(x)
+    assert aux.stop_gradient
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+
+def test_instance_norm_nhwc():
+    x = paddle.rand([2, 6, 5, 4])  # N H W C with C=4
+    y = F.instance_norm(x, data_format="NHWC")
+    assert y.shape == [2, 6, 5, 4]

@@ -1,0 +1,99 @@
+"""Tensor basics: creation, dtype, operators, indexing, inplace."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_to_tensor_basics():
+    t = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    assert t.shape == [2, 2]
+    assert t.dtype == paddle.float32
+    assert t.stop_gradient
+    np.testing.assert_allclose(t.numpy(), [[1, 2], [3, 4]])
+
+
+def test_dtypes():
+    assert paddle.to_tensor([1, 2]).dtype == paddle.int64 or \
+        paddle.to_tensor([1, 2]).dtype == paddle.int32
+    t = paddle.to_tensor([1.0], dtype="bfloat16")
+    assert t.dtype == paddle.bfloat16
+    t2 = t.astype("float32")
+    assert t2.dtype == paddle.float32
+
+
+def test_operators():
+    x = paddle.to_tensor([1.0, 2.0, 3.0])
+    y = paddle.to_tensor([4.0, 5.0, 6.0])
+    np.testing.assert_allclose((x + y).numpy(), [5, 7, 9])
+    np.testing.assert_allclose((x * y).numpy(), [4, 10, 18])
+    np.testing.assert_allclose((y - x).numpy(), [3, 3, 3])
+    np.testing.assert_allclose((y / x).numpy(), [4, 2.5, 2])
+    np.testing.assert_allclose((x ** 2).numpy(), [1, 4, 9])
+    np.testing.assert_allclose((-x).numpy(), [-1, -2, -3])
+    np.testing.assert_allclose((1.0 - x).numpy(), [0, -1, -2])
+    assert bool((x < y).all())
+
+
+def test_scalar_promotion_keeps_weak_types():
+    x = paddle.to_tensor([1.0], dtype="bfloat16")
+    assert (x + 1.0).dtype == paddle.bfloat16
+    assert (x * 2).dtype == paddle.bfloat16
+
+
+def test_matmul_operator():
+    a = paddle.ones([2, 3])
+    b = paddle.ones([3, 4])
+    c = a @ b
+    assert c.shape == [2, 4]
+    np.testing.assert_allclose(c.numpy(), np.full((2, 4), 3.0))
+
+
+def test_getitem_setitem():
+    x = paddle.arange(12, dtype="float32").reshape([3, 4])
+    np.testing.assert_allclose(x[1].numpy(), [4, 5, 6, 7])
+    np.testing.assert_allclose(x[:, 1].numpy(), [1, 5, 9])
+    np.testing.assert_allclose(x[1:, ::2].numpy(), [[4, 6], [8, 10]])
+    idx = paddle.to_tensor([0, 2])
+    np.testing.assert_allclose(x[idx].numpy(), [[0, 1, 2, 3], [8, 9, 10, 11]])
+    x[0, 0] = 100.0
+    assert x.numpy()[0, 0] == 100.0
+    # boolean mask
+    m = paddle.to_tensor([True, False, True])
+    np.testing.assert_allclose(x[m].shape, [2, 4])
+
+
+def test_inplace_ops():
+    x = paddle.ones([3])
+    x.add_(paddle.ones([3]))
+    np.testing.assert_allclose(x.numpy(), [2, 2, 2])
+    x.scale_(2.0)
+    np.testing.assert_allclose(x.numpy(), [4, 4, 4])
+    assert x._inplace_version >= 2
+
+
+def test_clone_detach():
+    x = paddle.ones([2])
+    x.stop_gradient = False
+    y = x.clone()
+    assert not y.stop_gradient
+    z = x.detach()
+    assert z.stop_gradient
+
+
+def test_item_and_len():
+    x = paddle.to_tensor([[1.0, 2.0]])
+    assert len(x) == 1
+    assert paddle.to_tensor(3.5).item() == pytest.approx(3.5)
+
+
+def test_cast_and_creation():
+    assert paddle.zeros([2, 3]).shape == [2, 3]
+    assert paddle.ones([2], dtype="int32").dtype == paddle.int32
+    assert paddle.full([2], 7).numpy().tolist() == [7, 7]
+    assert paddle.arange(5).shape == [5]
+    e = paddle.eye(3)
+    np.testing.assert_allclose(e.numpy(), np.eye(3))
+    assert paddle.linspace(0, 1, 5).shape == [5]
+    assert paddle.rand([4, 4]).shape == [4, 4]
+    assert paddle.randint(0, 10, [3]).dtype == paddle.int64
