@@ -1,0 +1,78 @@
+"""Benchmark: GPT pretraining tokens/sec/chip on the local TPU.
+
+Flagship = compiled functional trainer (paddle_tpu.models.gpt
+build_train_step): full fwd+bwd(+remat)+AdamW fused into one XLA program,
+bf16 compute + fp32 master weights.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Baseline convention (BASELINE.md): the operative target is >=0.8x the
+per-chip MFU of an A100+NCCL Megatron-style run (~40% MFU for GPT at this
+scale), i.e. target MFU 0.32. vs_baseline = measured_MFU / 0.32.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import dataclasses
+
+    from paddle_tpu.models.gpt import GPT_CONFIGS, build_train_step
+
+    model = os.environ.get("BENCH_MODEL", "gpt2-medium")
+    batch = int(os.environ.get("BENCH_BATCH", "8"))
+    seq = int(os.environ.get("BENCH_SEQ", "1024"))
+    steps = int(os.environ.get("BENCH_STEPS", "20"))
+
+    config = dataclasses.replace(GPT_CONFIGS[model],
+                                 max_position_embeddings=seq)
+
+    init_fn, step = build_train_step(config, mesh=None, lr=1e-4,
+                                     remat=True)
+    state = init_fn(0)
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(0, config.vocab_size, (batch, seq)),
+                         jnp.int32)
+    labels = jnp.asarray(rng.randint(0, config.vocab_size, (batch, seq)),
+                         jnp.int32)
+
+    # warmup/compile
+    state, loss = step(state, tokens, labels)
+    jax.block_until_ready(loss)
+
+    t0 = time.time()
+    for _ in range(steps):
+        state, loss = step(state, tokens, labels)
+    jax.block_until_ready(loss)
+    dt = (time.time() - t0) / steps
+
+    tokens_per_sec = batch * seq / dt
+
+    # params for MFU: 12*L*h^2 (attn+mlp) + embeddings
+    h, L, v = config.hidden_size, config.num_layers, config.vocab_size
+    n_params = 12 * L * h * h + v * h + config.max_position_embeddings * h
+    # fwd+bwd+remat ~= 6*N*tokens * (1 + remat fwd extra 1/3) -> use 6N
+    # plus attention flops: 12*L*s*h per token fwd -> *3 for bwd-ish
+    flops_per_token = 6 * n_params + 12 * L * seq * h
+    achieved = flops_per_token * tokens_per_sec
+    peak = {"tpu": 197e12, "cpu": 1e12}.get(jax.default_backend(), 197e12)
+    mfu = achieved / peak
+    target_mfu = 0.32  # 0.8 x (~0.40 A100+NCCL MFU)
+
+    print(json.dumps({
+        "metric": f"{model} pretrain tokens/sec/chip (b{batch} s{seq} "
+                  f"bf16 remat fused-adamw)",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / target_mfu, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

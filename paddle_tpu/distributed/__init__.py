@@ -1,0 +1,40 @@
+"""paddle_tpu.distributed — mesh/placements/DistTensor, communication,
+fleet, sharding, pipeline, checkpoint (SURVEY §2e rebuilt TPU-native)."""
+from __future__ import annotations
+
+from .placements import Placement, Replicate, Shard, Partial  # noqa: F401
+from .mesh import (ProcessMesh, auto_mesh, get_mesh, set_mesh,  # noqa: F401
+                   init_device_mesh)
+from .api import (DistAttr, shard_tensor, reshard, dtensor_from_local,  # noqa: F401
+                  dtensor_to_local, unshard_dtensor, shard_layer,
+                  placements_to_spec)
+from .parallel_env import (ParallelEnv, get_rank, get_world_size,  # noqa: F401
+                           init_parallel_env, is_initialized,
+                           destroy_process_group)
+from .communication import (ReduceOp, Group, new_group, get_group,  # noqa: F401
+                            all_reduce, all_gather, all_gather_object,
+                            broadcast, broadcast_object_list, reduce,
+                            reduce_scatter, scatter, alltoall, all_to_all,
+                            send, recv, isend, irecv, barrier, wait,
+                            get_backend, stream)
+from .parallel import DataParallel  # noqa: F401
+from . import fleet  # noqa: F401
+from . import checkpoint  # noqa: F401
+from .checkpoint import save_state_dict, load_state_dict  # noqa: F401
+from . import sharding  # noqa: F401
+from .sharding import group_sharded_parallel, save_group_sharded_model  # noqa: F401
+from .pipeline import (PipelineLayer, PipelineParallel, LayerDesc,  # noqa: F401
+                       SharedLayerDesc, PipelineParallelWithInterleave)
+from .fleet.recompute import recompute, recompute_sequential  # noqa: F401
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """paddle.distributed.spawn analog. Single-controller TPU runtime
+    executes SPMD programs over all local devices from ONE process, so
+    spawning per-device processes is unnecessary; run func directly."""
+    func(*args)
+
+
+def launch():
+    from .launch.main import main
+    main()

@@ -1,0 +1,181 @@
+"""Semi-auto parallel API: shard_tensor / reshard / shard_layer / DistAttr.
+
+Analog of python/paddle/distributed/auto_parallel/api.py (shard_tensor:220,
+reshard:797, shard_layer:908, dtensor_from_local:725) over GSPMD: a
+DistTensor is a Tensor whose payload is a jax.Array laid out by a
+NamedSharding derived from (ProcessMesh, placements); reshard is a
+device_put to a new sharding (XLA plans the collective transfer — the
+engine behind the reference's reshard function registry,
+phi/core/distributed/auto_parallel/reshard/*).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
+
+from .._core.tensor import Tensor
+from ..nn.layer import Layer, Parameter
+from .mesh import ProcessMesh
+from .placements import Partial, Placement, Replicate, Shard
+
+
+class DistAttr:
+    """(mesh, placements) pair hung on Tensor._dist_attr
+    (TensorDistAttr analog, dist_attr.h)."""
+
+    __slots__ = ("process_mesh", "placements")
+
+    def __init__(self, process_mesh: ProcessMesh,
+                 placements: Sequence[Placement]):
+        self.process_mesh = process_mesh
+        self.placements = list(placements)
+
+    def __repr__(self):
+        return (f"DistAttr(mesh={self.process_mesh}, "
+                f"placements={self.placements})")
+
+
+def placements_to_spec(placements: Sequence[Placement], mesh: ProcessMesh,
+                       ndim: int) -> PartitionSpec:
+    """placements are per-MESH-dim (paddle convention): placements[i]
+    describes how mesh axis i is used."""
+    entries: List = [None] * ndim
+    for mesh_dim, p in enumerate(placements):
+        if isinstance(p, Shard):
+            d = p.dim
+            axis_name = mesh.dim_names[mesh_dim]
+            if entries[d] is None:
+                entries[d] = axis_name
+            elif isinstance(entries[d], tuple):
+                entries[d] = entries[d] + (axis_name,)
+            else:
+                entries[d] = (entries[d], axis_name)
+    return PartitionSpec(*entries)
+
+
+def shard_tensor(x, mesh: ProcessMesh, placements: Sequence[Placement],
+                 dtype=None, place=None, stop_gradient=None) -> Tensor:
+    """Distribute a (replicated) tensor onto `mesh` with `placements`."""
+    if not isinstance(x, Tensor):
+        x = Tensor(jax.numpy.asarray(x))
+    spec = placements_to_spec(placements, mesh, x.ndim)
+    sharding = mesh.named_sharding(spec)
+    val = jax.device_put(x._value, sharding)
+    if isinstance(x, Parameter):
+        out = x  # shard parameters in place so layers keep identity
+        out._value = val
+    else:
+        out = Tensor(val, stop_gradient=x.stop_gradient
+                     if stop_gradient is None else stop_gradient)
+    out._dist_attr = DistAttr(mesh, placements)
+    return out
+
+
+def reshard(x: Tensor, mesh: ProcessMesh,
+            placements: Sequence[Placement]) -> Tensor:
+    """Convert between distributions; XLA emits the minimal collective
+    (the {r,s,p}x{r,s,p} + nd-mesh reshard matrix of the reference,
+    reshard_function_registry.cc, collapses into device_put)."""
+    cur = x._dist_attr
+    if cur is not None and any(p.is_partial() for p in cur.placements):
+        raise NotImplementedError(
+            "eager tensors never hold Partial state (XLA resolves Partial "
+            "inside compiled programs); a Partial dist_attr here indicates "
+            "a mis-annotated tensor")
+    val = x._value
+    spec = placements_to_spec(placements, mesh, x.ndim)
+    new_val = jax.device_put(val, mesh.named_sharding(spec))
+    if any(p.is_partial() for p in placements):
+        raise NotImplementedError(
+            "resharding TO a Partial placement is not supported eagerly; "
+            "Partial arises inside compiled programs where XLA manages it")
+    out = Tensor(new_val, stop_gradient=x.stop_gradient)
+    out._dist_attr = DistAttr(mesh, placements)
+    if not x.stop_gradient:
+        # identity-with-layout-change: flows gradient through unchanged
+        from .._core.autograd import record
+        from .._core.op_registry import get_op
+        record(get_op("assign"), {}, [x], [out])
+    return out
+
+
+def dtensor_from_local(local, mesh: ProcessMesh,
+                       placements: Sequence[Placement]) -> Tensor:
+    """Assemble a DistTensor from per-rank local shards. Single-controller
+    eager: `local` is this controller's shard for each mesh position it
+    owns; for Shard placements the local value IS the shard and we build
+    the global array from all addressable devices' locals (api.py:725)."""
+    if isinstance(local, Tensor):
+        lval = local._value
+    else:
+        lval = jax.numpy.asarray(local)
+    global_shape = list(lval.shape)
+    for mesh_dim, p in enumerate(placements):
+        if isinstance(p, Shard):
+            global_shape[p.dim] *= mesh.shape[mesh_dim]
+    spec = placements_to_spec(placements, mesh, lval.ndim)
+    sharding = mesh.named_sharding(spec)
+    jm = mesh.jax_mesh()
+    n_dev = int(np.prod(jm.devices.shape))
+    # single-controller: replicate this local onto each device's shard slot
+    arrs = [jax.device_put(lval, d) for d in jm.devices.flatten()]
+    out_val = jax.make_array_from_single_device_arrays(
+        tuple(global_shape), sharding,
+        _order_shards(arrs, sharding, tuple(global_shape)))
+    t = Tensor(out_val, stop_gradient=getattr(local, "stop_gradient", True))
+    t._dist_attr = DistAttr(mesh, placements)
+    return t
+
+
+def _order_shards(arrs, sharding, global_shape):
+    # device order of addressable shards expected by
+    # make_array_from_single_device_arrays
+    dev_to_arr = {d: a for d, a in zip(
+        sharding.mesh.devices.flatten(), arrs)}
+    out = []
+    for idx, dev in enumerate(sharding.addressable_devices):
+        out.append(dev_to_arr[dev])
+    return out
+
+
+def dtensor_to_local(x: Tensor, mesh=None, placements=None) -> Tensor:
+    """Return this controller's local shard (rank 0 view)."""
+    shards = x._value.addressable_shards
+    return Tensor(shards[0].data, stop_gradient=x.stop_gradient)
+
+
+def unshard_dtensor(x: Tensor) -> Tensor:
+    """Gather to a fully replicated dense tensor."""
+    attr = x._dist_attr
+    if attr is None:
+        return x
+    return reshard(x, attr.process_mesh,
+                   [Replicate()] * len(attr.placements))
+
+
+def shard_layer(layer: Layer, process_mesh: ProcessMesh,
+                shard_fn=None, input_fn=None, output_fn=None) -> Layer:
+    """Shard a layer's parameters over `process_mesh` (api.py:908). With no
+    shard_fn, parameters replicate (dp-style); shard_fn(name, layer, mesh)
+    applies per-layer placements."""
+    if shard_fn is None:
+        def shard_fn(name, sublayer, mesh):
+            for pname, p in list(sublayer._parameters.items()):
+                if p is not None and p._dist_attr is None:
+                    shard_tensor(p, mesh, [Replicate()] * mesh.ndim)
+    for name, sub in layer.named_sublayers(include_self=True):
+        shard_fn(name, sub, process_mesh)
+    if input_fn is not None:
+        layer.register_forward_pre_hook(
+            lambda l, inp: input_fn(inp, process_mesh))
+    if output_fn is not None:
+        layer.register_forward_post_hook(
+            lambda l, inp, out: output_fn(out, process_mesh))
+    return layer
+
+
+def get_placement_of(x: Tensor):
+    return None if x._dist_attr is None else x._dist_attr.placements

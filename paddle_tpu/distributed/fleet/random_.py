@@ -1,0 +1,80 @@
+"""RNG state tracker for TP-consistent dropout.
+
+Analog of fleet/layers/mpu/random.py:34 RNGStatesTracker: named RNG states
+so dropout inside/outside TP regions uses different-but-deterministic
+streams. TPU-native: states are threefry keys derived by folding the
+mp-rank into the base seed.
+"""
+from __future__ import annotations
+
+import jax
+
+from ..._core import random as rnd
+from .topology import get_hybrid_communicate_group
+
+MODEL_PARALLEL_RNG = "model_parallel_rng"
+
+
+class RNGStatesTracker:
+    def __init__(self):
+        self.states = {}
+        self.seeds = set()
+
+    def reset(self):
+        self.states = {}
+        self.seeds = set()
+
+    def add(self, name, seed):
+        if seed in self.seeds:
+            raise ValueError(f"seed {seed} already added")
+        if name in self.states:
+            raise ValueError(f"state {name} already added")
+        self.seeds.add(seed)
+        self.states[name] = jax.random.PRNGKey(seed)
+
+    def rng_state(self, name=MODEL_PARALLEL_RNG):
+        """Context manager: swap the global key for the named stream."""
+        tracker = self
+
+        class _Ctx:
+            def __enter__(self_c):
+                if name not in tracker.states:
+                    raise ValueError(f"state {name} not added")
+                self_c._saved = rnd._state["key"]
+                rnd._state["key"] = tracker.states[name]
+                return self_c
+
+            def __exit__(self_c, *exc):
+                tracker.states[name] = rnd._state["key"]
+                rnd._state["key"] = self_c._saved
+                return False
+        return _Ctx()
+
+    def get_states_tracker(self):
+        return dict(self.states)
+
+    def set_states_tracker(self, states):
+        self.states = dict(states)
+
+
+_tracker = RNGStatesTracker()
+
+
+def get_rng_state_tracker():
+    return _tracker
+
+
+def model_parallel_random_seed(seed=None):
+    """Derive local + mp streams (random.py model_parallel_random_seed):
+    the mp stream folds in the mp-rank so dropout differs across mp shards
+    only where it must."""
+    import random as pyrand
+    hcg = get_hybrid_communicate_group()
+    mp_rank = hcg.get_model_parallel_rank() if hcg else 0
+    base = seed if seed is not None else pyrand.randint(0, 2 ** 31 - 1)
+    local_seed = base + 1024 + mp_rank
+    global_seed = base
+    _tracker.reset()
+    rnd.seed(global_seed)
+    _tracker.add(MODEL_PARALLEL_RNG, local_seed)
+    return local_seed, global_seed

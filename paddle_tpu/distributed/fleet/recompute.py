@@ -1,0 +1,121 @@
+"""Activation recompute (gradient checkpointing).
+
+Analog of fleet/recompute/recompute.py:128 RecomputeFunction + :630
+recompute_sequential. TPU-native: in the compiled path this is
+jax.checkpoint (rematerialization XLA schedules natively); the eager path
+records ONE GradNode whose backward re-runs the function with grad enabled
+— saving activations memory exactly like the reference's PyLayer.
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+from ..._core.autograd import GradNode, _Edge, enable_grad, \
+    is_grad_enabled, no_grad
+from ..._core.tensor import Tensor
+from .random_ import get_rng_state_tracker
+
+
+def recompute(function, *args, **kwargs):
+    preserve_rng = kwargs.pop("preserve_rng_state", True)
+    use_reentrant = kwargs.pop("use_reentrant", True)
+    if not is_grad_enabled():
+        return function(*args, **kwargs)
+
+    import jax.numpy as jnp
+    from ..._core import random as rnd
+
+    tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+    saved_key = rnd._state["key"]
+
+    with no_grad():
+        outs = function(*args, **kwargs)
+    single = not isinstance(outs, (tuple, list))
+    out_list = [outs] if single else list(outs)
+    out_tensors = [o for o in out_list if isinstance(o, Tensor)]
+
+    if not any(not t.stop_gradient for t in tensor_inputs):
+        return outs
+
+    edges = []
+    for t in tensor_inputs:
+        if t.stop_gradient:
+            edges.append(_Edge(None))
+        else:
+            meta = t._autograd_meta
+            if meta.grad_node is not None:
+                edges.append(_Edge("node", node=meta.grad_node,
+                                   slot=meta.out_slot))
+            else:
+                edges.append(_Edge("leaf", leaf=t))
+    node = GradNode(None, {}, (), edges,
+                    out_shapes=tuple(tuple(t.shape) for t in out_tensors),
+                    out_dtypes=tuple(t._value.dtype for t in out_tensors))
+    node.name = "recompute"
+
+    def py_bwd(gouts):
+        # re-run forward with grad, restoring the RNG stream so dropout
+        # masks match (recompute_hybrid.py RNG tracker semantics)
+        detached = []
+        for a in args:
+            if isinstance(a, Tensor):
+                d = a.detach()
+                d.stop_gradient = a.stop_gradient
+                detached.append(d)
+            else:
+                detached.append(a)
+        if preserve_rng:
+            prev_key = rnd._state["key"]
+            rnd._state["key"] = saved_key
+        try:
+            with enable_grad():
+                re_outs = function(*detached, **kwargs)
+        finally:
+            if preserve_rng:
+                rnd._state["key"] = prev_key
+        re_list = [re_outs] if not isinstance(re_outs, (tuple, list)) \
+            else list(re_outs)
+        re_tensors = [o for o in re_list if isinstance(o, Tensor)]
+        # full backward over the re-run graph: parameters captured by the
+        # function's closure receive their grads via normal leaf
+        # accumulation; detached args collect theirs locally
+        from ..._core.autograd import run_backward
+        roots = [t for t in re_tensors if not t.stop_gradient]
+        root_grads = [Tensor(g) for g, t in zip(gouts, re_tensors)
+                      if not t.stop_gradient]
+        run_backward(roots, root_grads)
+        out = []
+        for a in detached:
+            if isinstance(a, Tensor):
+                out.append(None if a.grad is None else a.grad._value)
+        return tuple(out)
+
+    node.py_bwd = py_bwd
+    for i, t in enumerate(out_tensors):
+        if jnp.issubdtype(t._value.dtype, jnp.inexact):
+            t.stop_gradient = False
+            m = t._autograd_meta
+            m.grad_node = node
+            m.out_slot = i
+    return outs
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """recompute.py:630 — apply recompute over chunks of a Sequential."""
+    segments = ctx.get("segments", 1) if isinstance(ctx, dict) else 1
+    layers = list(functions)
+    n = len(layers)
+    per = max(n // segments, 1)
+
+    def run_chunk(chunk):
+        def fn(x):
+            for l in chunk:
+                x = l(x)
+            return x
+        return fn
+
+    x = args[0]
+    for i in range(0, n, per):
+        chunk = layers[i:i + per]
+        x = recompute(run_chunk(chunk), x)
+    return x

@@ -1,0 +1,124 @@
+"""python -m paddle_tpu.distributed.launch — the distributed launcher.
+
+Analog of python/paddle/distributed/launch (main.py:23,
+controllers/collective.py:22 CollectiveController.build_pod): resolve the
+node list, export per-process env (PADDLE_TRAINER_ID /
+PADDLE_TRAINER_ENDPOINTS / PADDLE_TRAINERS_NUM — :76-139), spawn and watch
+workers, restart/propagate failures.
+
+TPU-native shape: one controller PROCESS per host drives all local chips
+(single-controller SPMD), so `--nproc_per_node` defaults to 1 — unlike the
+reference's one-proc-per-GPU. Multi-host jobs launch this once per host
+(or via --ips) and workers meet through jax.distributed
+(init_parallel_env). --nproc_per_node > 1 is supported for CPU-simulated
+multi-process testing (the reference's multi-process-on-one-host test
+pattern, SURVEY §4).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+
+
+def _parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="launch a distributed training job")
+    p.add_argument("--nnodes", type=int, default=1)
+    p.add_argument("--node_rank", type=int, default=int(
+        os.environ.get("PADDLE_NODE_RANK", 0)))
+    p.add_argument("--master", type=str,
+                   default=os.environ.get("PADDLE_MASTER", ""),
+                   help="host:port of rank-0 rendezvous")
+    p.add_argument("--nproc_per_node", type=int, default=1)
+    p.add_argument("--ips", type=str, default="",
+                   help="comma-separated host list (informational)")
+    p.add_argument("--devices", type=str, default="",
+                   help="accepted for reference-CLI compat; the TPU "
+                        "runtime drives all local chips from one process")
+    p.add_argument("--log_dir", type=str, default="log")
+    p.add_argument("--job_id", type=str, default="default")
+    p.add_argument("script", type=str)
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def _worker_env(args, local_rank: int, world: int, endpoints):
+    env = dict(os.environ)
+    rank = args.node_rank * args.nproc_per_node + local_rank
+    env.update({
+        "PADDLE_TRAINER_ID": str(rank),
+        "PADDLE_TRAINERS_NUM": str(world),
+        "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
+        "PADDLE_CURRENT_ENDPOINT": endpoints[rank] if rank < len(endpoints)
+        else "",
+        "PADDLE_JOB_ID": args.job_id,
+    })
+    return env
+
+
+def main(argv=None):
+    args = _parse_args(argv)
+    world = args.nnodes * args.nproc_per_node
+    master = args.master or "127.0.0.1:6170"
+    host, port = (master.split(":") + ["6170"])[:2]
+    endpoints = []
+    for n in range(args.nnodes):
+        for p_ in range(args.nproc_per_node):
+            endpoints.append(f"{host}:{int(port) + n * args.nproc_per_node + p_}")
+
+    if world == 1:
+        # single process: exec in-place (fast path, no fork)
+        os.environ.update(_worker_env(args, 0, 1, endpoints))
+        sys.argv = [args.script] + args.script_args
+        import runpy
+        runpy.run_path(args.script, run_name="__main__")
+        return 0
+
+    os.makedirs(args.log_dir, exist_ok=True)
+    procs = []
+    for lr in range(args.nproc_per_node):
+        env = _worker_env(args, lr, world, endpoints)
+        log = open(os.path.join(
+            args.log_dir, f"workerlog.{args.node_rank}.{lr}"), "w")
+        procs.append((subprocess.Popen(
+            [sys.executable, args.script] + args.script_args, env=env,
+            stdout=log, stderr=subprocess.STDOUT), log))
+
+    # watch loop (controllers/controller.py:87 analog): first failure
+    # tears the pod down
+    rc = 0
+    try:
+        while procs:
+            alive = []
+            for proc, log in procs:
+                r = proc.poll()
+                if r is None:
+                    alive.append((proc, log))
+                elif r != 0:
+                    rc = r
+                    raise RuntimeError(
+                        f"worker pid {proc.pid} exited with {r}")
+            procs = alive
+            time.sleep(0.5)
+    except (RuntimeError, KeyboardInterrupt):
+        for proc, _ in procs:
+            try:
+                proc.send_signal(signal.SIGTERM)
+            except OSError:
+                pass
+        for proc, _ in procs:
+            proc.wait()
+        rc = rc or 1
+    finally:
+        for _, log in procs:
+            log.close()
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,55 @@
+"""DataParallel wrapper.
+
+Analog of python/paddle/distributed/parallel.py:219 DataParallel + the C++
+Reducer (fluid/distributed/collective/reducer.cc). TPU-native: the gradient
+"fused allreduce" is GSPMD's job once the training step runs under pjit
+with dp-sharded inputs; this wrapper provides the API surface, broadcasts
+initial params across dp ranks (trivial single-controller), and scales
+gradients by 1/dp_world when running host-driven.
+"""
+from __future__ import annotations
+
+from .._core.tensor import Tensor
+from ..nn.layer import Layer
+from .parallel_env import get_world_size, init_parallel_env
+
+
+class DataParallel(Layer):
+    def __init__(self, layers: Layer, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        self.find_unused_parameters = find_unused_parameters
+        self._nranks = group.nranks if group is not None else \
+            get_world_size()
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, **kwargs):
+        return self._layers.set_state_dict(state_dict, **kwargs)
+
+    def scale_loss(self, loss):
+        # grads are averaged by the compiled psum in the pjit path; in the
+        # host-driven path the reference scales loss by 1/nranks
+        # (hybrid_parallel_util.py:282)
+        if self._nranks > 1:
+            return loss / self._nranks
+        return loss
+
+    def no_sync(self):
+        class _NoSync:
+            def __enter__(self):
+                return self
+
+            def __exit__(self, *a):
+                return False
+        return _NoSync()
+
+    @property
+    def _sublayers(self):
+        return self._layers

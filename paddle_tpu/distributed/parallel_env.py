@@ -1,0 +1,93 @@
+"""Parallel environment: rank/world bookkeeping + multi-host init.
+
+Analog of python/paddle/distributed/parallel.py (init_parallel_env:978,
+ParallelEnv:677). TPU-native: instead of TCPStore -> NCCL unique-id
+exchange, multi-host init is jax.distributed.initialize (PJRT handles DCN
+rendezvous); the TCPStore (csrc/tcpstore) remains for framework-level
+coordination (elastic, launch, checkpoints).
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+_initialized = False
+
+
+def _env_int(name, default):
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+class ParallelEnv:
+    def __init__(self):
+        self.rank = _env_int("PADDLE_TRAINER_ID", 0)
+        self.world_size = _env_int("PADDLE_TRAINERS_NUM", 1)
+        self.device_id = _env_int("FLAGS_selected_tpus", 0)
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        self.trainer_endpoints = eps.split(",") if eps else []
+        self.current_endpoint = os.environ.get(
+            "PADDLE_CURRENT_ENDPOINT",
+            self.trainer_endpoints[self.rank]
+            if self.rank < len(self.trainer_endpoints) else "127.0.0.1:6170")
+
+    @property
+    def local_rank(self):
+        return self.rank
+
+    @property
+    def nranks(self):
+        return self.world_size
+
+    @property
+    def dev_id(self):
+        return self.device_id
+
+
+def get_rank(group=None) -> int:
+    if group is not None:
+        return group.get_group_rank(ParallelEnv().rank)
+    return ParallelEnv().rank
+
+
+def get_world_size(group=None) -> int:
+    if group is not None:
+        return group.nranks
+    return ParallelEnv().world_size
+
+
+def init_parallel_env():
+    """Connect this host into the job. Single host: no-op beyond env
+    parsing. Multi-host (PADDLE_TRAINERS_NUM>1 with endpoints):
+    jax.distributed.initialize wires PJRT across DCN — the analog of the
+    reference's TCPStore + ProcessGroupNCCL bring-up (parallel.py:1134)."""
+    global _initialized
+    if _initialized:
+        return ParallelEnv()
+    env = ParallelEnv()
+    if env.world_size > 1 and not jax.process_count() > 1:
+        coordinator = env.trainer_endpoints[0] if env.trainer_endpoints \
+            else "127.0.0.1:8476"
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator,
+                num_processes=env.world_size,
+                process_id=env.rank)
+        except Exception as e:  # pragma: no cover - needs real multihost
+            raise RuntimeError(
+                f"multi-host init failed (coordinator {coordinator}): {e}")
+    _initialized = True
+    return env
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def destroy_process_group(group=None):
+    global _initialized
+    _initialized = False

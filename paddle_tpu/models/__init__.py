@@ -1,0 +1,3 @@
+from .gpt import (GPTConfig, GPTModel, GPTForPretraining,  # noqa: F401
+                  GPTPretrainingCriterion, build_train_step,
+                  init_gpt_params)

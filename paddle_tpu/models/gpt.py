@@ -1,0 +1,446 @@
+"""GPT model family — the flagship (BASELINE.md configs 4/5 shape).
+
+Two execution paths, mirroring the reference's dygraph/static split:
+
+1. Eager Layer path (`GPTModel`, `GPTForPretraining`): built from fleet TP
+   layers (VocabParallelEmbedding / Column/RowParallelLinear — the
+   mp_layers.py analogs) so weights carry mp sharding annotations.
+
+2. Compiled functional trainer (`build_train_step`): the TPU-native
+   "static graph with parallel passes" (SURVEY §3.5) — ONE jitted XLA
+   program per training step:
+     - per-block params stacked [L, ...] and scanned (lax.scan) — compile
+       time O(1) in depth;
+     - jax.checkpoint per block = the reference's recompute pass;
+     - GSPMD shardings: dp over batch, mp over hidden (Megatron layout:
+       qkv/mlp-in column-sharded, proj/mlp-out row-sharded, embeddings
+       vocab-sharded), sp (sequence parallel) shards the activation seq
+       dim between blocks, ZeRO-style optimizer-state sharding over dp;
+     - fused AdamW update in the same program (no separate optimizer
+       dispatch) with bf16 params + fp32 master weights.
+
+Reference parity: python/paddle/distributed/fleet/layers/mpu/mp_layers.py,
+semi_auto_llama.py test topology (test/auto_parallel/hybrid_strategy/),
+GPT-3 config table from the reference's megatron-style examples.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .. import nn
+from ..nn import functional as F
+from .._core.tensor import Tensor
+from ..distributed.fleet.mp_layers import (ColumnParallelLinear,
+                                           RowParallelLinear,
+                                           VocabParallelEmbedding)
+
+
+@dataclasses.dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: Optional[int] = None
+    max_position_embeddings: int = 1024
+    hidden_dropout_prob: float = 0.0
+    attention_dropout_prob: float = 0.0
+    layer_norm_eps: float = 1e-5
+    initializer_range: float = 0.02
+    use_flash_attention: bool = True
+    use_recompute: bool = False
+    dtype: str = "bfloat16"
+
+    @property
+    def ffn(self):
+        return self.intermediate_size or 4 * self.hidden_size
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_heads
+
+
+# configs matching the reference's model table
+GPT_CONFIGS = {
+    "gpt2-small": GPTConfig(hidden_size=768, num_layers=12, num_heads=12),
+    "gpt2-medium": GPTConfig(hidden_size=1024, num_layers=24, num_heads=16),
+    "gpt3-1.3b": GPTConfig(hidden_size=2048, num_layers=24, num_heads=32,
+                           max_position_embeddings=2048),
+    "gpt3-6.7b": GPTConfig(hidden_size=4096, num_layers=32, num_heads=32,
+                           max_position_embeddings=2048),
+}
+
+
+# =====================================================================
+# Eager Layer path
+# =====================================================================
+
+class GPTEmbeddings(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.word_embeddings = VocabParallelEmbedding(
+            config.vocab_size, config.hidden_size)
+        self.position_embeddings = nn.Embedding(
+            config.max_position_embeddings, config.hidden_size)
+        self.dropout = nn.Dropout(config.hidden_dropout_prob)
+
+    def forward(self, input_ids, position_ids=None):
+        from ..ops.creation import arange
+        if position_ids is None:
+            position_ids = arange(input_ids.shape[1], dtype="int64")
+        h = self.word_embeddings(input_ids) \
+            + self.position_embeddings(position_ids)
+        return self.dropout(h)
+
+
+class GPTDecoderLayer(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        h = config.hidden_size
+        self.ln_1 = nn.LayerNorm(h, config.layer_norm_eps)
+        self.qkv_proj = ColumnParallelLinear(h, 3 * h, gather_output=False)
+        self.out_proj = RowParallelLinear(h, h)
+        self.ln_2 = nn.LayerNorm(h, config.layer_norm_eps)
+        self.mlp_in = ColumnParallelLinear(h, config.ffn,
+                                           gather_output=False)
+        self.mlp_out = RowParallelLinear(config.ffn, h)
+        self.config = config
+        self.attn_dropout = nn.Dropout(config.attention_dropout_prob)
+        self.dropout = nn.Dropout(config.hidden_dropout_prob)
+
+    def forward(self, x, attn_mask=None):
+        c = self.config
+        residual = x
+        y = self.ln_1(x)
+        qkv = self.qkv_proj(y)
+        b, s = qkv.shape[0], qkv.shape[1]
+        qkv = qkv.reshape([b, s, 3, c.num_heads, c.head_dim])
+        q, k, v = qkv.unbind(axis=2)
+        attn, _ = F.flash_attention(q, k, v,
+                                    dropout=c.attention_dropout_prob,
+                                    causal=True, training=self.training)
+        attn = attn.reshape([b, s, c.hidden_size])
+        x = residual + self.dropout(self.out_proj(attn))
+        residual = x
+        y = self.ln_2(x)
+        y = self.mlp_out(F.gelu(self.mlp_in(y), approximate=True))
+        return residual + self.dropout(y)
+
+
+class GPTModel(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.embeddings = GPTEmbeddings(config)
+        self.layers = nn.LayerList(
+            [GPTDecoderLayer(config) for _ in range(config.num_layers)])
+        self.ln_f = nn.LayerNorm(config.hidden_size, config.layer_norm_eps)
+
+    def forward(self, input_ids, position_ids=None, attention_mask=None):
+        x = self.embeddings(input_ids, position_ids)
+        for i, layer in enumerate(self.layers):
+            if self.config.use_recompute and self.training:
+                from ..distributed.fleet.recompute import recompute
+                x = recompute(layer, x)
+            else:
+                x = layer(x)
+        return self.ln_f(x)
+
+
+class GPTForPretraining(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.gpt = GPTModel(config)
+
+    def forward(self, input_ids, position_ids=None):
+        h = self.gpt(input_ids, position_ids)
+        # tied lm head: logits = h @ W_emb^T
+        from ..ops.linalg import matmul
+        w = self.gpt.embeddings.word_embeddings.weight
+        return matmul(h, w, transpose_y=True)
+
+
+class GPTPretrainingCriterion(nn.Layer):
+    def forward(self, logits, labels, loss_mask=None):
+        loss = F.cross_entropy(logits, labels, reduction="none")
+        if loss_mask is not None:
+            from ..ops.reduction import sum as psum
+            flat = loss_mask.reshape(loss.shape)
+            return psum(loss * flat) / psum(flat)
+        from ..ops.reduction import mean
+        return mean(loss)
+
+
+# =====================================================================
+# Compiled functional trainer (the perf path)
+# =====================================================================
+
+def init_gpt_params(config: GPTConfig, seed: int = 0) -> Dict[str, Any]:
+    """Initialize params as a pytree with per-block arrays stacked on a
+    leading layer axis [L, ...] (the scan layout)."""
+    key = jax.random.PRNGKey(seed)
+    h, f_, L = config.hidden_size, config.ffn, config.num_layers
+    v, s_max = config.vocab_size, config.max_position_embeddings
+    std = config.initializer_range
+    dt = jnp.dtype(config.dtype)
+    ks = jax.random.split(key, 8)
+
+    def norm(k, shape, scale=std):
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dt)
+
+    params = {
+        "wte": norm(ks[0], (v, h)),
+        "wpe": norm(ks[1], (s_max, h)),
+        "blocks": {
+            "ln1_g": jnp.ones((L, h), dt), "ln1_b": jnp.zeros((L, h), dt),
+            "qkv_w": norm(ks[2], (L, h, 3 * h)),
+            "qkv_b": jnp.zeros((L, 3 * h), dt),
+            "proj_w": norm(ks[3], (L, h, h),
+                           scale=std / math.sqrt(2 * L)),
+            "proj_b": jnp.zeros((L, h), dt),
+            "ln2_g": jnp.ones((L, h), dt), "ln2_b": jnp.zeros((L, h), dt),
+            "fc_w": norm(ks[4], (L, h, f_)),
+            "fc_b": jnp.zeros((L, f_), dt),
+            "fo_w": norm(ks[5], (L, f_, h),
+                         scale=std / math.sqrt(2 * L)),
+            "fo_b": jnp.zeros((L, h), dt),
+        },
+        "lnf_g": jnp.ones((h,), dt),
+        "lnf_b": jnp.zeros((h,), dt),
+    }
+    return params
+
+
+def param_specs(config: GPTConfig, dp: str = "dp", mp: str = "mp",
+                zero_axis: Optional[str] = None) -> Dict[str, Any]:
+    """GSPMD PartitionSpecs per param (Megatron TP layout). zero_axis, when
+    set, additionally shards the 'long' dim of otherwise-replicated params
+    for ZeRO-3 style param sharding."""
+    def spec(*entries):
+        return P(*entries)
+
+    blocks = {
+        "ln1_g": spec(None, None), "ln1_b": spec(None, None),
+        "qkv_w": spec(None, None, mp), "qkv_b": spec(None, mp),
+        "proj_w": spec(None, mp, None), "proj_b": spec(None, None),
+        "ln2_g": spec(None, None), "ln2_b": spec(None, None),
+        "fc_w": spec(None, None, mp), "fc_b": spec(None, mp),
+        "fo_w": spec(None, mp, None), "fo_b": spec(None, None),
+    }
+    return {
+        "wte": spec(mp, None),
+        "wpe": spec(None, None),
+        "blocks": blocks,
+        "lnf_g": spec(None), "lnf_b": spec(None),
+    }
+
+
+def _ln(x, g, b, eps):
+    xf = x.astype(jnp.float32)
+    mu = xf.mean(-1, keepdims=True)
+    var = ((xf - mu) ** 2).mean(-1, keepdims=True)
+    return ((xf - mu) * jax.lax.rsqrt(var + eps)).astype(x.dtype) * g + b
+
+
+def _block(x, blk, config: GPTConfig, mesh_axes, sp_sharding=None):
+    """One decoder block, pure jnp. x: [B, S, H]. With sp=True the
+    residual-stream activations are sharded along the sequence dim over the
+    mp axis (Megatron-SP, sequence_parallel_utils.py analog) — GSPMD turns
+    the boundary into the all-gather/reduce-scatter pair."""
+    c = config
+    b, s, h = x.shape
+    if sp_sharding is not None:
+        x = jax.lax.with_sharding_constraint(x, sp_sharding)
+    y = _ln(x, blk["ln1_g"], blk["ln1_b"], c.layer_norm_eps)
+    qkv = jnp.einsum("bsh,hk->bsk", y, blk["qkv_w"]) + blk["qkv_b"]
+    qkv = qkv.reshape(b, s, 3, c.num_heads, c.head_dim)
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    q = jnp.swapaxes(q, 1, 2)  # B,H,S,D
+    k = jnp.swapaxes(k, 1, 2)
+    v = jnp.swapaxes(v, 1, 2)
+    scale = 1.0 / math.sqrt(c.head_dim)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    mask = jnp.tril(jnp.ones((s, s), bool))
+    logits = jnp.where(mask, logits, jnp.array(-1e30, logits.dtype))
+    probs = jax.nn.softmax(logits.astype(jnp.float32), -1).astype(x.dtype)
+    attn = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+    attn = jnp.swapaxes(attn, 1, 2).reshape(b, s, h)
+    proj = jnp.einsum("bsh,hk->bsk", attn, blk["proj_w"]) + blk["proj_b"]
+    x = x + proj
+    y = _ln(x, blk["ln2_g"], blk["ln2_b"], c.layer_norm_eps)
+    y = jnp.einsum("bsh,hf->bsf", y, blk["fc_w"]) + blk["fc_b"]
+    y = jax.nn.gelu(y, approximate=True)
+    y = jnp.einsum("bsf,fh->bsh", y, blk["fo_w"]) + blk["fo_b"]
+    out = x + y
+    if sp_sharding is not None:
+        out = jax.lax.with_sharding_constraint(out, sp_sharding)
+    return out
+
+
+def gpt_forward(params, tokens, config: GPTConfig, mesh_axes=None,
+                remat=True, sp_sharding=None):
+    """Pure forward: tokens [B, S] int32 -> logits [B, S, V]."""
+    b, s = tokens.shape
+    x = params["wte"][tokens] + params["wpe"][:s]
+    x = x.astype(jnp.dtype(config.dtype))
+
+    blk_fn = functools.partial(_block, config=config, mesh_axes=mesh_axes,
+                               sp_sharding=sp_sharding)
+    if remat:
+        blk_fn = jax.checkpoint(blk_fn)
+
+    def scan_body(carry, blk):
+        return blk_fn(carry, blk), None
+
+    x, _ = jax.lax.scan(scan_body, x, params["blocks"])
+    x = _ln(x, params["lnf_g"], params["lnf_b"], config.layer_norm_eps)
+    logits = jnp.einsum("bsh,vh->bsv", x, params["wte"])
+    return logits
+
+
+def gpt_loss(params, tokens, labels, config: GPTConfig, mesh_axes=None,
+             remat=True, sp_sharding=None):
+    logits = gpt_forward(params, tokens, config, mesh_axes, remat,
+                         sp_sharding)
+    logits = logits.astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, -1)
+    picked = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    return -picked.mean()
+
+
+def build_train_step(config: GPTConfig, mesh: Optional[Mesh] = None,
+                     lr: float = 3e-4, wd: float = 0.1, b1: float = 0.9,
+                     b2: float = 0.95, zero1: bool = True,
+                     seq_shard: bool = False, remat: bool = True):
+    """Build (init_fn, step_fn) — step is ONE compiled XLA program:
+    fwd + bwd (remat'd scan) + AdamW, with dp/mp/sp/ZeRO1 shardings when
+    `mesh` has those axes. Donation keeps params/opt-state in place."""
+    specs = param_specs(config)
+
+    def to_sharding(spec_tree):
+        if mesh is None:
+            return None
+        return jax.tree_util.tree_map(
+            lambda sp: NamedSharding(mesh, sp), spec_tree,
+            is_leaf=lambda x: isinstance(x, P))
+
+    # ZeRO-1 (zero1=True): fp32 master + adam moments are additionally
+    # sharded over the dp axis on the first dim that is unsharded and
+    # divisible by dp (sharding-stage-1 analog: each dp rank keeps 1/dp of
+    # optimizer state; XLA all-gathers the updated master where needed).
+    param_shapes = jax.eval_shape(lambda: init_gpt_params(config, 0))
+
+    def _opt_spec_one(sp: P, shape):
+        if not zero1 or mesh is None or "dp" not in mesh.axis_names:
+            return sp
+        dp_size = mesh.shape["dp"]
+        entries = list(sp) + [None] * (len(shape) - len(sp))
+        for i, (e, dim) in enumerate(zip(entries, shape)):
+            if e is None and dim % dp_size == 0 and dim >= dp_size:
+                entries[i] = "dp"
+                return P(*entries)
+        return sp
+
+    opt_specs = jax.tree_util.tree_map(
+        lambda sp, sh: _opt_spec_one(sp, sh.shape), specs, param_shapes,
+        is_leaf=lambda x: isinstance(x, P))
+
+    def init_fn(seed=0):
+        params = init_gpt_params(config, seed)
+        # copy=True: with fp32 params astype would alias the same buffer,
+        # which breaks donation (same buffer donated twice)
+        master = jax.tree_util.tree_map(
+            lambda p: jnp.array(p, dtype=jnp.float32, copy=True), params)
+        m = jax.tree_util.tree_map(jnp.zeros_like, master)
+        v = jax.tree_util.tree_map(jnp.zeros_like, master)
+        state = {"params": params, "master": master, "m": m, "v": v,
+                 "step": jnp.zeros((), jnp.int32)}
+        if mesh is not None:
+            sharding = {
+                "params": to_sharding(specs),
+                "master": to_sharding(opt_specs),
+                "m": to_sharding(opt_specs),
+                "v": to_sharding(opt_specs),
+                "step": NamedSharding(mesh, P()),
+            }
+            state = jax.device_put(state, sharding)
+        return state
+
+    sp_sharding = None
+    if seq_shard and mesh is not None and "mp" in mesh.axis_names \
+            and "dp" in mesh.axis_names:
+        sp_sharding = NamedSharding(mesh, P("dp", "mp", None))
+
+    # decay only matrix weights + embeddings; LayerNorm gains/biases and
+    # bias vectors are excluded (Megatron/reference convention)
+    _DECAY_KEYS = {"wte", "wpe", "qkv_w", "proj_w", "fc_w", "fo_w"}
+
+    def _wd_mask_tree():
+        return {
+            "wte": True, "wpe": True,
+            "blocks": {k: (k in _DECAY_KEYS)
+                       for k in ["ln1_g", "ln1_b", "qkv_w", "qkv_b",
+                                 "proj_w", "proj_b", "ln2_g", "ln2_b",
+                                 "fc_w", "fc_b", "fo_w", "fo_b"]},
+            "lnf_g": False, "lnf_b": False,
+        }
+
+    def step_fn(state, tokens, labels):
+        loss, grads = jax.value_and_grad(gpt_loss)(
+            state["params"], tokens, labels, config, remat=remat,
+            sp_sharding=sp_sharding)
+        step = state["step"] + 1
+        t = step.astype(jnp.float32)
+
+        def upd(p_master, g, m, v, use_wd):
+            g = g.astype(jnp.float32)
+            m2 = b1 * m + (1 - b1) * g
+            v2 = b2 * v + (1 - b2) * g * g
+            mhat = m2 / (1 - b1 ** t)
+            vhat = v2 / (1 - b2 ** t)
+            decay = wd * p_master if use_wd else 0.0
+            new_master = p_master - lr * (
+                mhat / (jnp.sqrt(vhat) + 1e-8) + decay)
+            return new_master, m2, v2
+
+        flat_master, tree = jax.tree_util.tree_flatten(state["master"])
+        flat_g = jax.tree_util.tree_leaves(grads)
+        flat_m = jax.tree_util.tree_leaves(state["m"])
+        flat_v = jax.tree_util.tree_leaves(state["v"])
+        flat_wd = jax.tree_util.tree_leaves(_wd_mask_tree())
+        outs = [upd(pm, g, m, v, w) for pm, g, m, v, w in
+                zip(flat_master, flat_g, flat_m, flat_v, flat_wd)]
+        new_master = jax.tree_util.tree_unflatten(
+            tree, [o[0] for o in outs])
+        new_m = jax.tree_util.tree_unflatten(tree, [o[1] for o in outs])
+        new_v = jax.tree_util.tree_unflatten(tree, [o[2] for o in outs])
+        new_params = jax.tree_util.tree_map(
+            lambda pm, p: pm.astype(p.dtype), new_master, state["params"])
+        return {"params": new_params, "master": new_master, "m": new_m,
+                "v": new_v, "step": step}, loss
+
+    if mesh is not None:
+        data_spec = P("dp", None)
+        state_shardings = {
+            "params": to_sharding(specs),
+            "master": to_sharding(opt_specs),
+            "m": to_sharding(opt_specs), "v": to_sharding(opt_specs),
+            "step": NamedSharding(mesh, P())}
+        jstep = jax.jit(
+            step_fn,
+            in_shardings=(state_shardings,
+                          NamedSharding(mesh, data_spec),
+                          NamedSharding(mesh, data_spec)),
+            out_shardings=(state_shardings, NamedSharding(mesh, P())),
+            donate_argnums=(0,))
+    else:
+        jstep = jax.jit(step_fn, donate_argnums=(0,))
+    return init_fn, jstep
