@@ -44,6 +44,7 @@ from . import amp  # noqa: E402
 from . import jit  # noqa: E402
 from . import metric  # noqa: E402
 from . import framework  # noqa: E402
+from . import incubate  # noqa: E402
 
 from .framework import save, load  # noqa: E402
 

@@ -1,0 +1,5 @@
+"""Incubating APIs (reference: python/paddle/incubate) — fused kernels and
+experimental distributed pieces that graduate into the stable namespace."""
+from . import nn  # noqa: F401
+
+__all__ = ["nn"]

@@ -1,0 +1,49 @@
+"""Fused functional ops (reference: python/paddle/incubate/nn/functional —
+fused_rms_norm, swiglu, fused_rotary_position_embedding, fused_moe,
+block_multihead_attention). TPU backing is the Pallas kernel layer
+(paddle_tpu/ops/pallas) instead of the reference's hand-written CUDA under
+paddle/phi/kernels/fusion/gpu."""
+from __future__ import annotations
+
+from ....ops.pallas import (swiglu, fused_rotary_position_embedding)
+from ....ops.pallas import rms_norm as _rms_norm
+
+
+def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
+                   begin_norm_axis=-1, bias=None, residual=None,
+                   quant_scale=-1, quant_round_type=0, quant_max_bound=0,
+                   quant_min_bound=0):
+    """Reference fused_rms_norm returns (out, residual_out); residual/bias
+    are pre-norm adds fused into the kernel epilogue."""
+    h = x
+    if bias is not None:
+        h = h + bias
+    if residual is not None:
+        h = h + residual
+    out = _rms_norm(h, norm_weight, epsilon)
+    if norm_bias is not None:
+        out = out + norm_bias
+    return out, (h if residual is not None else None)
+
+
+def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5,
+                     begin_norm_axis=-1, bias=None, residual=None):
+    from ....nn import functional as F
+    h = x
+    if bias is not None:
+        h = h + bias
+    if residual is not None:
+        h = h + residual
+    out = F.layer_norm(h, h.shape[begin_norm_axis:] if begin_norm_axis != -1
+                       else [h.shape[-1]], norm_weight, norm_bias, epsilon)
+    return out, (h if residual is not None else None)
+
+
+def fused_moe(*args, **kwargs):
+    from ....incubate.distributed.models.moe.moe_layer import fused_moe \
+        as _fm
+    return _fm(*args, **kwargs)
+
+
+__all__ = ["fused_rms_norm", "fused_layer_norm", "swiglu",
+           "fused_rotary_position_embedding", "fused_moe"]

@@ -243,6 +243,16 @@ def param_specs(config: GPTConfig, dp: str = "dp", mp: str = "mp",
     }
 
 
+def _use_flash_kernel(config: GPTConfig, seq: int, mesh_axes) -> bool:
+    """Pallas flash attention on the single-chip compiled path. The kernel
+    is opaque to GSPMD propagation, so the sharded path keeps the einsum
+    attention (XLA partitions it by head) until the shard_map wrapper
+    lands; mesh_axes None == single chip."""
+    return (config.use_flash_attention and mesh_axes is None
+            and jax.default_backend() == "tpu" and seq % 128 == 0
+            and seq >= 256)
+
+
 def _ln(x, g, b, eps):
     xf = x.astype(jnp.float32)
     mu = xf.mean(-1, keepdims=True)
@@ -267,11 +277,16 @@ def _block(x, blk, config: GPTConfig, mesh_axes, sp_sharding=None):
     k = jnp.swapaxes(k, 1, 2)
     v = jnp.swapaxes(v, 1, 2)
     scale = 1.0 / math.sqrt(c.head_dim)
-    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
-    mask = jnp.tril(jnp.ones((s, s), bool))
-    logits = jnp.where(mask, logits, jnp.array(-1e30, logits.dtype))
-    probs = jax.nn.softmax(logits.astype(jnp.float32), -1).astype(x.dtype)
-    attn = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+    if _use_flash_kernel(c, s, mesh_axes):
+        from ..ops.pallas.flash_attention import mha_forward
+        attn = mha_forward(q, k, v, causal=True, scale=scale)
+    else:
+        logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+        mask = jnp.tril(jnp.ones((s, s), bool))
+        logits = jnp.where(mask, logits, jnp.array(-1e30, logits.dtype))
+        probs = jax.nn.softmax(logits.astype(jnp.float32), -1).astype(
+            x.dtype)
+        attn = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
     attn = jnp.swapaxes(attn, 1, 2).reshape(b, s, h)
     proj = jnp.einsum("bsh,hk->bsk", attn, blk["proj_w"]) + blk["proj_b"]
     x = x + proj
@@ -395,8 +410,8 @@ def build_train_step(config: GPTConfig, mesh: Optional[Mesh] = None,
 
     def step_fn(state, tokens, labels):
         loss, grads = jax.value_and_grad(gpt_loss)(
-            state["params"], tokens, labels, config, remat=remat,
-            sp_sharding=sp_sharding)
+            state["params"], tokens, labels, config, mesh_axes=mesh,
+            remat=remat, sp_sharding=sp_sharding)
         step = state["step"] + 1
         t = step.astype(jnp.float32)
 

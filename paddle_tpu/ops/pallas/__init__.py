@@ -1,0 +1,18 @@
+"""Pallas TPU kernel layer.
+
+This is the TPU-native replacement for two reference subsystems at once:
+the dynloaded CUDA flash-attention library
+(paddle/phi/backends/dynload/flashattn.cc) and the hand-fused CUDA kernels
+under paddle/phi/kernels/fusion/gpu (fused_attention, fused_rms_norm,
+swiglu, rope). Instead of NVRTC/CINN codegen, hot ops are written directly
+against the TPU memory hierarchy (HBM -> VMEM -> MXU/VPU) with
+jax.experimental.pallas; everything falls back to the fused XLA path off-TPU
+(interpret mode keeps the kernels testable on the CPU mesh).
+"""
+from .flash_attention import flash_attention, mha_forward
+from .fused import rms_norm, swiglu, fused_rotary_position_embedding
+
+__all__ = [
+    "flash_attention", "mha_forward", "rms_norm", "swiglu",
+    "fused_rotary_position_embedding",
+]

@@ -1,0 +1,320 @@
+"""Flash attention as a Pallas TPU kernel with a custom VJP.
+
+Online-softmax blocked attention (the same math the reference reaches via
+the dynloaded flashattn CUDA lib, paddle/phi/backends/dynload/flashattn.cc;
+surface at python/paddle/nn/functional/flash_attention.py). Forward streams
+K/V blocks through VMEM against a resident Q block, carrying (m, l, acc)
+accumulators; backward is the standard two-kernel split (dKV over key
+blocks, dQ over query blocks) using the saved log-sum-exp rows.
+
+Layout inside the kernels is [batch*heads, seq, head_dim]; the public entry
+takes paddle's [batch, seq, heads, head_dim]. Logit math is fp32 on the MXU
+(preferred_element_type), IO dtype is whatever the caller passes (bf16 on
+TPU). Off-TPU the kernels run in interpret mode so the CPU test mesh
+exercises identical code.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _block_sizes(sq: int, sk: int, d: int):
+    bq = min(512, sq) if sq % 512 == 0 else min(128, sq)
+    bk = min(512, sk) if sk % 512 == 0 else min(128, sk)
+    if sq % bq:
+        bq = sq  # small/ragged: single block (wrapper pads first)
+    if sk % bk:
+        bk = sk
+    return bq, bk
+
+
+# ---------------------------------------------------------------- forward
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, scale,
+                block_k, kv_len, q_offset):
+    qi = pl.program_id(1)
+    q = q_ref[0]                                    # [bq, d]
+    bq, d = q.shape
+    sk_pad = k_ref.shape[1]
+    nkb = sk_pad // block_k
+
+    q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+
+    def body(j, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(j * block_k, block_k), :]          # [bk, d]
+        v = v_ref[0, pl.ds(j * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale       # [bq, bk]
+        k_pos = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, block_k), 1)
+        mask = k_pos < kv_len
+        if causal:
+            mask &= k_pos <= q_pos + q_offset
+        s = jnp.where(mask, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + jnp.sum(p, axis=-1)
+        acc_new = acc * alpha[:, None] + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((bq,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq,), jnp.float32)
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+    if causal:
+        # keys beyond the last valid diagonal block never contribute
+        last = (qi * bq + bq - 1) + q_offset
+        nkb_eff = jnp.minimum((last // block_k) + 1, nkb)
+    else:
+        nkb_eff = nkb
+    m, l, acc = jax.lax.fori_loop(0, nkb_eff, body, (m0, l0, acc0))
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    lse_ref[0] = m + jnp.log(l_safe)
+
+
+def _fwd(q, k, v, causal, scale, block_q, block_k, kv_len, q_offset):
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    grid = (bh, sq // block_q)
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, causal=causal, scale=scale,
+                          block_k=block_k, kv_len=kv_len, q_offset=q_offset),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, sq), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q, k, v)
+    return out, lse
+
+
+# ---------------------------------------------------------------- backward
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, *, causal, scale, block_q, kv_len, q_offset):
+    kj = pl.program_id(1)
+    k = k_ref[0]                                    # [bk, d]
+    v = v_ref[0]
+    bk, d = k.shape
+    sq = q_ref.shape[1]
+    nqb = sq // block_q
+    k_pos = kj * bk + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(i * block_q, block_q), :]
+        do = do_ref[0, pl.ds(i * block_q, block_q), :]
+        lse = lse_ref[0, pl.ds(i * block_q, block_q)]
+        delta = delta_ref[0, pl.ds(i * block_q, block_q)]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale       # [bq, bk]
+        q_pos = i * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, bk), 0)
+        mask = k_pos < kv_len
+        if causal:
+            mask &= k_pos <= q_pos + q_offset
+        p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)   # [bq, bk]
+        dv_new = dv + jax.lax.dot_general(
+            p, do.astype(jnp.float32), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)               # [bk, d]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)               # [bq, bk]
+        ds = p * (dp - delta[:, None]) * scale
+        dk_new = dk + jax.lax.dot_general(
+            ds, q.astype(jnp.float32), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)               # [bk, d]
+        return dk_new, dv_new
+
+    if causal:
+        # query rows before this key block's first diagonal see none of it
+        first = jnp.maximum((kj * bk - q_offset) // block_q, 0)
+    else:
+        first = 0
+    dk0 = jnp.zeros((bk, d), jnp.float32)
+    dv0 = jnp.zeros((bk, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(first, nqb, body, (dk0, dv0))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+               causal, scale, block_k, kv_len, q_offset):
+    qi = pl.program_id(1)
+    q = q_ref[0]
+    do = do_ref[0]
+    lse = lse_ref[0]
+    delta = delta_ref[0]
+    bq, d = q.shape
+    sk = k_ref.shape[1]
+    nkb = sk // block_k
+    q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+
+    def body(j, dq):
+        k = k_ref[0, pl.ds(j * block_k, block_k), :]
+        v = v_ref[0, pl.ds(j * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        k_pos = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, block_k), 1)
+        mask = k_pos < kv_len
+        if causal:
+            mask &= k_pos <= q_pos + q_offset
+        p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        return dq + jax.lax.dot_general(
+            ds, k.astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        last = (qi * bq + bq - 1) + q_offset
+        nkb_eff = jnp.minimum((last // block_k) + 1, nkb)
+    else:
+        nkb_eff = nkb
+    dq = jax.lax.fori_loop(0, nkb_eff, body, jnp.zeros((bq, d), jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _bwd(q, k, v, out, lse, do, causal, scale, block_q, block_k, kv_len,
+         q_offset):
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)                                   # [bh, sq]
+    qspec = pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0))
+    full_q = pl.BlockSpec((1, sq, d), lambda b, j: (b, 0, 0))
+    full_row = pl.BlockSpec((1, sq), lambda b, j: (b, 0))
+    kspec = pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0))
+    full_k = pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0))
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, causal=causal, scale=scale,
+                          block_q=block_q, kv_len=kv_len, q_offset=q_offset),
+        grid=(bh, sk // block_k),
+        in_specs=[full_q, kspec, kspec, full_q, full_row, full_row],
+        out_specs=[kspec, kspec],
+        out_shape=[jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+                   jax.ShapeDtypeStruct((bh, sk, d), v.dtype)],
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+
+    rowspec = pl.BlockSpec((1, block_q), lambda b, i: (b, i))
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, causal=causal, scale=scale,
+                          block_k=block_k, kv_len=kv_len, q_offset=q_offset),
+        grid=(bh, sq // block_q),
+        in_specs=[qspec, full_k, full_k, qspec, rowspec, rowspec],
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------- public
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _mha(q, k, v, causal, scale):
+    out, _ = _mha_fwd(q, k, v, causal, scale)[0], None
+    return out
+
+
+def _mha_fwd(q, k, v, causal, scale):
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    bq, bk = _block_sizes(sq, sk, d)
+    out, lse = _fwd(q, k, v, causal, scale, bq, bk, kv_len=sk,
+                    q_offset=sk - sq)
+    return out, (q, k, v, out, lse)
+
+
+def _mha_bwd(causal, scale, res, do):
+    q, k, v, out, lse = res
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    bq, bk = _block_sizes(sq, sk, d)
+    dq, dk, dv = _bwd(q, k, v, out, lse, do, causal, scale, bq, bk,
+                      kv_len=sk, q_offset=sk - sq)
+    return dq, dk, dv
+
+
+_mha.defvjp(_mha_fwd, _mha_bwd)
+
+
+def mha_forward(q, k, v, causal=False, scale=None):
+    """Differentiable blocked attention on [BH or B,H fused, S, D] arrays.
+
+    Accepts [B, H, S, D] or [BH, S, D]; returns the same rank it was given.
+    """
+    squeeze = q.ndim == 4
+    if squeeze:
+        b, h, sq, d = q.shape
+        q = q.reshape(b * h, sq, d)
+        k = k.reshape(b * h, k.shape[2], d)
+        v = v.reshape(b * h, v.shape[2], d)
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    out = _mha(q, k, v, bool(causal), float(scale))
+    if squeeze:
+        out = out.reshape(b, h, sq, d)
+    return out
+
+
+def _fa_kernel_body(q, k, v, causal, scale):
+    # paddle layout [B, S, H, D] -> [BH, S, D]
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    qt = jnp.swapaxes(q, 1, 2).reshape(b * h, sq, d)
+    kt = jnp.swapaxes(k, 1, 2).reshape(b * h, sk, d)
+    vt = jnp.swapaxes(v, 1, 2).reshape(b * h, sk, d)
+    out = _mha(qt, kt, vt, causal, scale)
+    return jnp.swapaxes(out.reshape(b, h, sq, d), 1, 2)
+
+
+def flash_attention(query, key, value, causal=False, scale=None):
+    """Public entry on framework Tensors (or raw arrays), paddle layout
+    [batch, seq, heads, head_dim]. Seq lens must tile by 128 (the nn
+    wrapper falls back to fused-XLA SDPA otherwise)."""
+    from ..._core.executor import apply
+    from ..._core.op_registry import all_ops, register_op
+    if "flash_attention" not in all_ops():
+        register_op("flash_attention", _fa_kernel_body)
+    d = (query.shape[-1])
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    sq, sk = query.shape[1], key.shape[1]
+    if sq % 128 or sk % 128:
+        raise ValueError(f"flash_attention pallas kernel needs seq % 128 == 0"
+                         f" (got q={sq}, k={sk})")
+    return apply("flash_attention", query, key, value, causal=bool(causal),
+                 scale=float(scale))
