@@ -26,6 +26,10 @@ from .sharding import group_sharded_parallel, save_group_sharded_model  # noqa: 
 from .pipeline import (PipelineLayer, PipelineParallel, LayerDesc,  # noqa: F401
                        SharedLayerDesc, PipelineParallelWithInterleave)
 from .fleet.recompute import recompute, recompute_sequential  # noqa: F401
+from . import context_parallel  # noqa: F401
+from .context_parallel import (ring_attention, ulysses_attention,  # noqa: F401
+                               ring_attention_global,
+                               ulysses_attention_global)
 
 
 def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):

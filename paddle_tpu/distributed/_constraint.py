@@ -1,0 +1,41 @@
+"""Shared sharding-constraint-as-op machinery.
+
+Single home for the "annotate one tensor dim onto one mesh axis" pattern
+used by TP (feature dim on 'mp') and SP (sequence dim on 'mp') layers —
+the GSPMD analog of the reference's hand-issued _c_identity/_c_concat/
+_c_split collectives (fleet/layers/mpu/mp_ops.py). Dims other than the
+constrained one are left UNCONSTRAINED so XLA keeps whatever sharding the
+surrounding program gives them (e.g. batch over 'dp')."""
+from __future__ import annotations
+
+import jax
+from jax.sharding import PartitionSpec
+
+from .._core.tensor import Tensor
+from .mesh import get_mesh
+
+_U = PartitionSpec.UNCONSTRAINED
+
+
+def constrain_dim(t: Tensor, dim: int, axis: str = "mp",
+                  shard: bool = True) -> Tensor:
+    """Under trace with a global mesh carrying ``axis``: constrain ``dim``
+    of ``t`` to Shard(axis) (shard=True) or replicated (shard=False),
+    leaving other dims unconstrained. Identity otherwise (eager / no mesh /
+    axis absent — the reference's degenerate degree-1 case)."""
+    mesh = get_mesh()
+    if mesh is None or axis not in mesh.dim_names:
+        return t
+    if not isinstance(t._value, jax.core.Tracer):
+        return t
+    entries = [_U] * t.ndim
+    entries[dim % t.ndim] = axis if shard else None
+    spec = PartitionSpec(*entries)
+    from .._core.executor import apply
+    from .._core.op_registry import _OPS, register_op
+    key = (f"shard_constraint_{axis}_{dim % t.ndim}_"
+           f"{'s' if shard else 'r'}_{t.ndim}")
+    if key not in _OPS:
+        register_op(key, lambda x, _s=spec:
+                    jax.lax.with_sharding_constraint(x, _s))
+    return apply(key, t)

@@ -18,14 +18,50 @@ _fleet_initialized = False
 _strategy: DistributedStrategy = None
 
 
+from . import sequence_parallel_utils  # noqa: E402
+from .sequence_parallel_utils import (  # noqa: F401
+    ColumnSequenceParallelLinear, RowSequenceParallelLinear,
+    mark_as_sequence_parallel_parameter,
+    register_sequence_parallel_allreduce_hooks)
+
+
+class SegmentParallel:
+    """meta_parallel/segment_parallel.py:26 analog: wrapper for a model
+    whose activations are sequence-sharded on the sep axis; params stay
+    replicated over sep (GSPMD broadcast is implicit)."""
+
+    def __init__(self, layers, hcg=None, **kwargs):
+        self._layers = layers
+        self._hcg = hcg
+
+    def __getattr__(self, item):
+        return getattr(self.__dict__["_layers"], item)
+
+    def __call__(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+
 class _MetaParallelNS:
     ColumnParallelLinear = ColumnParallelLinear
     RowParallelLinear = RowParallelLinear
     VocabParallelEmbedding = VocabParallelEmbedding
     ParallelCrossEntropy = ParallelCrossEntropy
+    ColumnSequenceParallelLinear = ColumnSequenceParallelLinear
+    RowSequenceParallelLinear = RowSequenceParallelLinear
+    SegmentParallel = SegmentParallel
 
 
 meta_parallel = _MetaParallelNS()
+
+
+class _FleetUtilsNS:
+    sequence_parallel_utils = sequence_parallel_utils
+
+
+utils = _FleetUtilsNS()
 
 
 def init(role_maker=None, is_collective=True, strategy=None, log_level="INFO"):

@@ -118,30 +118,20 @@ class RowParallelLinear(Layer):
 
     def forward(self, x):
         out = F.linear(x, self.weight, self.bias)
+        if self._skip_output_constraint:
+            return out
         out = _constraint_last_dim(out, replicate=True)
         return out
+
+    _skip_output_constraint = False
 
 
 def _constraint_last_dim(t: Tensor, replicate: bool):
     """with_sharding_constraint on the feature dim under trace; identity
     eagerly outside a mesh context (the GSPMD analog of _c_identity /
     _c_concat in mp_ops.py)."""
-    mesh = get_mesh()
-    if mesh is None or "mp" not in mesh.dim_names:
-        return t
-    if not isinstance(t._value, jax.core.Tracer):
-        return t
-    entries = [None] * t.ndim
-    if not replicate:
-        entries[-1] = "mp"
-    spec = PartitionSpec(*entries)
-    from ..._core.executor import apply
-    from ..._core.op_registry import _OPS, register_op
-    key = f"shard_constraint_{'r' if replicate else 's'}_{t.ndim}"
-    if key not in _OPS:
-        register_op(key, lambda x, _s=spec:
-                    jax.lax.with_sharding_constraint(x, _s))
-    return apply(key, t)
+    from .._constraint import constrain_dim
+    return constrain_dim(t, -1, "mp", shard=not replicate)
 
 
 class ParallelCrossEntropy(Layer):

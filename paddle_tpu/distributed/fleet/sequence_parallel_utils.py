@@ -1,0 +1,133 @@
+"""Megatron-style sequence parallelism utilities.
+
+Analog of fleet/utils/sequence_parallel_utils.py: ScatterOp:85 /
+GatherOp:97 / AllGatherOp:110 / ReduceScatterOp:120 PyLayers,
+mark_as_sequence_parallel_parameter:148, ColumnSequenceParallelLinear:429,
+RowSequenceParallelLinear:564.
+
+TPU-native semantics: between TP ops the activations are sharded along the
+sequence dim on the 'mp' mesh axis. Under pjit/GSPMD the scatter/gather
+pairs the reference issues by hand become sharding constraints — XLA
+materialises the same reduce-scatter/all-gather (over ICI) with comm fused
+into the adjoining matmuls. Eagerly (no mesh, mp==1) every op is identity,
+matching the reference's degenerate case.
+"""
+from __future__ import annotations
+
+from ..._core.tensor import Tensor
+from .mp_layers import ColumnParallelLinear, RowParallelLinear
+from .._constraint import constrain_dim
+
+_SEQ_DIM = 0  # reference keeps [s, b, h] layout in the SP region
+
+
+def _constraint_seq(t: Tensor, shard: bool, seq_dim: int = _SEQ_DIM):
+    """Annotate the sequence dim as Shard('mp') (shard=True) or replicated
+    (shard=False); other dims stay unconstrained (batch keeps its dp
+    sharding). Identity eagerly / without an mp mesh axis."""
+    return constrain_dim(t, seq_dim, "mp", shard=shard)
+
+
+class ScatterOp:
+    """Split along the sequence dim across mp ranks (reference :85). Under
+    GSPMD: constrain seq dim to Shard('mp')."""
+
+    @staticmethod
+    def apply(input, seq_dim: int = _SEQ_DIM):
+        return _constraint_seq(input, shard=True, seq_dim=seq_dim)
+
+
+class GatherOp:
+    """All-gather along the sequence dim (reference :97)."""
+
+    @staticmethod
+    def apply(input, seq_dim: int = _SEQ_DIM):
+        return _constraint_seq(input, shard=False, seq_dim=seq_dim)
+
+
+class AllGatherOp:
+    """All-gather whose backward is reduce-scatter (reference :110); same
+    forward annotation as GatherOp, AD provides the transpose."""
+
+    @staticmethod
+    def apply(input):
+        return _constraint_seq(input, shard=False)
+
+
+class ReduceScatterOp:
+    """Reduce-scatter whose backward is all-gather (reference :120)."""
+
+    @staticmethod
+    def apply(input):
+        return _constraint_seq(input, shard=True)
+
+
+def scatter(input, seq_dim: int = _SEQ_DIM):
+    return ScatterOp.apply(input, seq_dim)
+
+
+def all_gather(input):
+    return AllGatherOp.apply(input)
+
+
+def reduce_scatter(input):
+    return ReduceScatterOp.apply(input)
+
+
+def mark_as_sequence_parallel_parameter(parameter):
+    """Tag a parameter as living in the SP region (reference :148): its
+    gradient needs an mp-axis all-reduce, which GSPMD derives from the
+    replicated annotation — the tag is kept for parity/introspection."""
+    parameter.sequence_parallel = True
+    return parameter
+
+
+def is_sequence_parallel_parameter(parameter):
+    return getattr(parameter, "sequence_parallel", False)
+
+
+def register_sequence_parallel_allreduce_hooks(model, accumulation_steps=1,
+                                               fuse_sequence_parallel_allreduce=False):
+    """Reference :192 registers backward hooks to allreduce SP-parameter
+    grads over mp. Under GSPMD the compiled backward already emits that
+    collective, so this is a no-op kept for API parity."""
+    return model
+
+
+class ColumnSequenceParallelLinear(ColumnParallelLinear):
+    """ColumnParallelLinear whose input arrives sequence-sharded
+    (reference :429): all-gather(seq) -> matmul with out-dim sharded."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=None, gather_output=False,
+                 fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__(in_features, out_features, weight_attr=weight_attr,
+                         has_bias=has_bias, gather_output=gather_output,
+                         fuse_matmul_bias=fuse_matmul_bias,
+                         mp_group=mp_group, name=name)
+
+    def forward(self, x):
+        x = GatherOp.apply(x)          # all-gather sequence
+        return super().forward(x)
+
+
+class RowSequenceParallelLinear(RowParallelLinear):
+    """RowParallelLinear whose output is reduce-scattered back onto the
+    sequence dim (reference :564). Skips the parent's replicate-all output
+    constraint so XLA lowers partial-matmul + seq constraint to a single
+    reduce-scatter instead of all-reduce + re-shard."""
+
+    _skip_output_constraint = True
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=True,
+                 fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__(in_features, out_features, weight_attr=weight_attr,
+                         has_bias=has_bias,
+                         input_is_parallel=input_is_parallel,
+                         fuse_matmul_bias=fuse_matmul_bias,
+                         mp_group=mp_group, name=name)
+
+    def forward(self, x):
+        out = super().forward(x)
+        return ScatterOp.apply(out)    # reduce-scatter onto sequence

@@ -24,7 +24,8 @@ class Parameter(Tensor):
     """Trainable tensor (python/paddle/base/framework.py EagerParamBase)."""
 
     __slots__ = ("trainable", "optimize_attr", "regularizer",
-                 "do_model_average", "need_clip", "is_distributed")
+                 "do_model_average", "need_clip", "is_distributed",
+                 "sequence_parallel")
 
     def __init__(self, value, trainable=True, name=None):
         super().__init__(value, stop_gradient=not trainable, name=name)
