@@ -27,6 +27,7 @@ from .pipeline import (PipelineLayer, PipelineParallel, LayerDesc,  # noqa: F401
                        SharedLayerDesc, PipelineParallelWithInterleave)
 from .fleet.recompute import recompute, recompute_sequential  # noqa: F401
 from . import context_parallel  # noqa: F401
+from . import utils  # noqa: F401
 from .context_parallel import (ring_attention, ulysses_attention,  # noqa: F401
                                ring_attention_global,
                                ulysses_attention_global)

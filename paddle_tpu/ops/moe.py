@@ -1,0 +1,163 @@
+"""Mixture-of-Experts functional core (TPU-native).
+
+The reference's MoE stack (incubate/distributed/models/moe/moe_layer.py:261,
+gates under moe/gate/, all-to-all dispatch via global_scatter/global_gather,
+fused kernel incubate/nn/functional/fused_moe.py) is CUDA-centric: ragged
+token dispatch with index scatter/gather. On TPU the idiomatic form is the
+GShard/Switch dense-dispatch formulation: fixed expert capacity C, one-hot
+dispatch/combine tensors, and einsum dispatch so everything is static-shaped
+and lands on the MXU; under GSPMD an 'ep'-sharded expert dim lowers the
+dispatch einsums to the same all-to-all the reference issues by hand.
+
+Shapes: tokens x [S, M] (leading group/batch dims folded by callers),
+logits [S, E], dispatch/combine [S, E, C], expert weights stacked [E, ...].
+Everything is differentiable jnp; usable eagerly (registered ops) and under
+jit/pjit.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+# ------------------------------------------------------------------ gating
+
+def _capacity(s: int, e: int, k: int, capacity_factor: float,
+              capacity: Optional[int]) -> int:
+    if capacity is not None:
+        return max(int(capacity), 1)
+    return max(int(s * k * capacity_factor / e + 0.999999), 1)
+
+
+def top2_gating(logits, capacity_factor: float = 1.25,
+                capacity: Optional[int] = None):
+    """GShard top-2 gating (moe/gate/gshard_gate.py analog).
+
+    logits [S, E] -> (combine [S, E, C], dispatch bool [S, E, C], aux_loss).
+    aux_loss is the GShard load-balance loss: E * mean(me * ce).
+    """
+    s, e = logits.shape
+    c = _capacity(s, e, 2, capacity_factor, capacity)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)  # [S,E]
+
+    g1_idx = jnp.argmax(probs, axis=-1)                          # [S]
+    mask1 = jax.nn.one_hot(g1_idx, e, dtype=probs.dtype)         # [S,E]
+    probs2 = probs * (1.0 - mask1)
+    g2_idx = jnp.argmax(probs2, axis=-1)
+    mask2 = jax.nn.one_hot(g2_idx, e, dtype=probs.dtype)
+
+    # load-balance aux loss over the top-1 assignment
+    me = jnp.mean(probs, axis=0)                                 # [E]
+    ce = jnp.mean(mask1, axis=0)
+    aux_loss = jnp.sum(me * ce) * e
+
+    # positions within each expert's buffer (top-1 tokens first)
+    pos1 = jnp.cumsum(mask1, axis=0) * mask1 - mask1             # [S,E]
+    mask1 = mask1 * (pos1 < c)
+    pos2 = (jnp.cumsum(mask2, axis=0) - mask2
+            + jnp.sum(mask1, axis=0, keepdims=True))
+    mask2 = mask2 * (pos2 < c)
+    pos2 = pos2 * mask2
+
+    g1 = jnp.sum(probs * mask1, axis=-1)                         # [S]
+    g2 = jnp.sum(probs * mask2, axis=-1)
+    denom = jnp.maximum(g1 + g2, 1e-9)
+    g1, g2 = g1 / denom, g2 / denom
+
+    loc1 = jnp.sum(pos1 * mask1, axis=-1).astype(jnp.int32)      # [S]
+    loc2 = jnp.sum(pos2, axis=-1).astype(jnp.int32)
+    oh_c1 = jax.nn.one_hot(loc1, c, dtype=probs.dtype)           # [S,C]
+    oh_c2 = jax.nn.one_hot(loc2, c, dtype=probs.dtype)
+    combine = (g1[:, None, None] * mask1[:, :, None] * oh_c1[:, None, :]
+               + g2[:, None, None] * mask2[:, :, None] * oh_c2[:, None, :])
+    dispatch = combine > 0.0
+    return combine, dispatch, aux_loss
+
+
+def top1_gating(logits, capacity_factor: float = 1.25,
+                capacity: Optional[int] = None, jitter_eps: float = 0.0,
+                rng=None):
+    """Switch-Transformer top-1 gating (moe/gate/switch_gate.py analog)."""
+    s, e = logits.shape
+    c = _capacity(s, e, 1, capacity_factor, capacity)
+    if jitter_eps > 0.0 and rng is not None:
+        noise = jax.random.uniform(rng, logits.shape, jnp.float32,
+                                   1.0 - jitter_eps, 1.0 + jitter_eps)
+        logits = logits * noise
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    idx = jnp.argmax(probs, axis=-1)
+    mask = jax.nn.one_hot(idx, e, dtype=probs.dtype)
+    me = jnp.mean(probs, axis=0)
+    ce = jnp.mean(mask, axis=0)
+    aux_loss = jnp.sum(me * ce) * e
+    pos = jnp.cumsum(mask, axis=0) * mask - mask
+    mask = mask * (pos < c)
+    gate = jnp.sum(probs * mask, axis=-1)
+    loc = jnp.sum(pos * mask, axis=-1).astype(jnp.int32)
+    oh_c = jax.nn.one_hot(loc, c, dtype=probs.dtype)
+    combine = gate[:, None, None] * mask[:, :, None] * oh_c[:, None, :]
+    dispatch = combine > 0.0
+    return combine, dispatch, aux_loss
+
+
+# ------------------------------------------------------------ dispatch/ffn
+
+def moe_dispatch(x, dispatch):
+    """x [S, M], dispatch [S, E, C] -> expert inputs [E, C, M] (einsum =
+    the TPU-native global_scatter)."""
+    return jnp.einsum("sec,sm->ecm", dispatch.astype(x.dtype), x)
+
+
+def moe_combine(expert_out, combine):
+    """expert_out [E, C, M], combine [S, E, C] -> [S, M] (global_gather)."""
+    return jnp.einsum("sec,ecm->sm", combine.astype(expert_out.dtype),
+                      expert_out)
+
+
+def moe_ffn(x, gate_w, w0, b0, w1, b1, *, k: int = 2,
+            capacity_factor: float = 1.25, capacity: Optional[int] = None,
+            activation: str = "gelu"):
+    """Full MoE FFN block: gating + dispatch + grouped expert MLP + combine.
+
+    x [S, M]; gate_w [M, E]; stacked expert weights w0 [E, M, H],
+    b0 [E, H], w1 [E, H, M], b1 [E, M]. Returns (out [S, M], aux_loss).
+    The grouped matmuls keep E as a batched einsum dim — one large MXU op;
+    sharding w0/w1 on E over the 'ep' mesh axis makes GSPMD insert the
+    dispatch all-to-alls.
+    """
+    logits = x @ gate_w.astype(x.dtype)
+    if k == 1:
+        combine, dispatch, aux = top1_gating(logits, capacity_factor,
+                                             capacity)
+    else:
+        combine, dispatch, aux = top2_gating(logits, capacity_factor,
+                                             capacity)
+    xe = moe_dispatch(x, dispatch)                    # [E, C, M]
+    h = jnp.einsum("ecm,emh->ech", xe, w0.astype(x.dtype)) \
+        + b0[:, None, :].astype(x.dtype)
+    act = getattr(jax.nn, activation)
+    h = act(h)
+    ye = jnp.einsum("ech,ehm->ecm", h, w1.astype(x.dtype)) \
+        + b1[:, None, :].astype(x.dtype)
+    out = moe_combine(ye, combine.astype(x.dtype))
+    return out, aux.astype(jnp.float32)
+
+
+# -------------------------------------------------- eager op registration
+
+def _register():
+    from .._core.op_registry import register_op
+
+    register_op("moe_gate_top2", top2_gating, multi_output=True)
+    register_op("moe_gate_top1",
+                lambda logits, capacity_factor=1.25, capacity=None:
+                top1_gating(logits, capacity_factor, capacity),
+                multi_output=True)
+    register_op("moe_dispatch", moe_dispatch)
+    register_op("moe_combine", moe_combine)
+    register_op("fused_moe", moe_ffn, multi_output=True)
+
+
+_register()
