@@ -25,6 +25,9 @@ from . import sharding  # noqa: F401
 from .sharding import group_sharded_parallel, save_group_sharded_model  # noqa: F401
 from .pipeline import (PipelineLayer, PipelineParallel, LayerDesc,  # noqa: F401
                        SharedLayerDesc, PipelineParallelWithInterleave)
+from . import pipeline_compiled  # noqa: F401
+from .pipeline_compiled import (spmd_pipeline, pipelined_trunk,  # noqa: F401
+                                FThenB, OneFOneB, VPP, ZeroBubble)
 from .fleet.recompute import recompute, recompute_sequential  # noqa: F401
 from . import context_parallel  # noqa: F401
 from . import utils  # noqa: F401

@@ -220,20 +220,22 @@ def init_gpt_params(config: GPTConfig, seed: int = 0) -> Dict[str, Any]:
 
 
 def param_specs(config: GPTConfig, dp: str = "dp", mp: str = "mp",
-                zero_axis: Optional[str] = None) -> Dict[str, Any]:
+                zero_axis: Optional[str] = None,
+                pp: Optional[str] = None) -> Dict[str, Any]:
     """GSPMD PartitionSpecs per param (Megatron TP layout). zero_axis, when
     set, additionally shards the 'long' dim of otherwise-replicated params
-    for ZeRO-3 style param sharding."""
+    for ZeRO-3 style param sharding. pp, when set, shards the stacked layer
+    dim of blocks over the pipeline axis (compiled PP)."""
     def spec(*entries):
         return P(*entries)
 
     blocks = {
-        "ln1_g": spec(None, None), "ln1_b": spec(None, None),
-        "qkv_w": spec(None, None, mp), "qkv_b": spec(None, mp),
-        "proj_w": spec(None, mp, None), "proj_b": spec(None, None),
-        "ln2_g": spec(None, None), "ln2_b": spec(None, None),
-        "fc_w": spec(None, None, mp), "fc_b": spec(None, mp),
-        "fo_w": spec(None, mp, None), "fo_b": spec(None, None),
+        "ln1_g": spec(pp, None), "ln1_b": spec(pp, None),
+        "qkv_w": spec(pp, None, mp), "qkv_b": spec(pp, mp),
+        "proj_w": spec(pp, mp, None), "proj_b": spec(pp, None),
+        "ln2_g": spec(pp, None), "ln2_b": spec(pp, None),
+        "fc_w": spec(pp, None, mp), "fc_b": spec(pp, mp),
+        "fo_w": spec(pp, mp, None), "fo_b": spec(pp, None),
     }
     return {
         "wte": spec(mp, None),
@@ -301,30 +303,36 @@ def _block(x, blk, config: GPTConfig, mesh_axes, sp_sharding=None):
 
 
 def gpt_forward(params, tokens, config: GPTConfig, mesh_axes=None,
-                remat=True, sp_sharding=None):
-    """Pure forward: tokens [B, S] int32 -> logits [B, S, V]."""
+                remat=True, sp_sharding=None, pp_trunk=None):
+    """Pure forward: tokens [B, S] int32 -> logits [B, S, V]. pp_trunk,
+    when given (distributed.pipeline_compiled.pipelined_trunk), replaces
+    the layer scan with the compiled pp-axis pipeline."""
     b, s = tokens.shape
     x = params["wte"][tokens] + params["wpe"][:s]
     x = x.astype(jnp.dtype(config.dtype))
 
-    blk_fn = functools.partial(_block, config=config, mesh_axes=mesh_axes,
-                               sp_sharding=sp_sharding)
-    if remat:
-        blk_fn = jax.checkpoint(blk_fn)
+    if pp_trunk is not None:
+        x = pp_trunk(params["blocks"], x)
+    else:
+        blk_fn = functools.partial(_block, config=config,
+                                   mesh_axes=mesh_axes,
+                                   sp_sharding=sp_sharding)
+        if remat:
+            blk_fn = jax.checkpoint(blk_fn)
 
-    def scan_body(carry, blk):
-        return blk_fn(carry, blk), None
+        def scan_body(carry, blk):
+            return blk_fn(carry, blk), None
 
-    x, _ = jax.lax.scan(scan_body, x, params["blocks"])
+        x, _ = jax.lax.scan(scan_body, x, params["blocks"])
     x = _ln(x, params["lnf_g"], params["lnf_b"], config.layer_norm_eps)
     logits = jnp.einsum("bsh,vh->bsv", x, params["wte"])
     return logits
 
 
 def gpt_loss(params, tokens, labels, config: GPTConfig, mesh_axes=None,
-             remat=True, sp_sharding=None):
+             remat=True, sp_sharding=None, pp_trunk=None):
     logits = gpt_forward(params, tokens, config, mesh_axes, remat,
-                         sp_sharding)
+                         sp_sharding, pp_trunk=pp_trunk)
     logits = logits.astype(jnp.float32)
     logp = jax.nn.log_softmax(logits, -1)
     picked = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
@@ -334,11 +342,36 @@ def gpt_loss(params, tokens, labels, config: GPTConfig, mesh_axes=None,
 def build_train_step(config: GPTConfig, mesh: Optional[Mesh] = None,
                      lr: float = 3e-4, wd: float = 0.1, b1: float = 0.9,
                      b2: float = 0.95, zero1: bool = True,
-                     seq_shard: bool = False, remat: bool = True):
+                     seq_shard: bool = False, remat: bool = True,
+                     pp_microbatches: Optional[int] = None):
     """Build (init_fn, step_fn) — step is ONE compiled XLA program:
     fwd + bwd (remat'd scan) + AdamW, with dp/mp/sp/ZeRO1 shardings when
-    `mesh` has those axes. Donation keeps params/opt-state in place."""
-    specs = param_specs(config)
+    `mesh` has those axes. A 'pp' mesh axis (size>1) engages the compiled
+    collective-permute pipeline (pipeline_compiled.py) over the stacked
+    layer dim. Donation keeps params/opt-state in place."""
+    pp_size = (mesh.shape.get("pp", 1) if mesh is not None else 1)
+    use_pp = pp_size > 1
+    if use_pp and config.num_layers % pp_size:
+        raise ValueError(f"num_layers {config.num_layers} not divisible "
+                         f"by pp {pp_size}")
+    specs = param_specs(config, pp="pp" if use_pp else None)
+    if mesh is not None:
+        # drop references to axes the mesh doesn't have (e.g. dp-pp mesh
+        # without tensor parallelism)
+        def _filter(sp: P):
+            return P(*(e if e in mesh.axis_names else None for e in sp))
+        specs = jax.tree_util.tree_map(
+            _filter, specs, is_leaf=lambda x: isinstance(x, P))
+
+    pp_trunk = None
+    if use_pp:
+        from ..distributed.pipeline_compiled import pipelined_trunk
+        n_micro = pp_microbatches or 2 * pp_size
+        blk_fn = functools.partial(_block, config=config, mesh_axes=mesh,
+                                   sp_sharding=None)
+        pp_trunk = pipelined_trunk(
+            lambda x, blk: blk_fn(x, blk), mesh, n_micro, axis_name="pp",
+            remat=remat)
 
     def to_sharding(spec_tree):
         if mesh is None:
@@ -411,7 +444,7 @@ def build_train_step(config: GPTConfig, mesh: Optional[Mesh] = None,
     def step_fn(state, tokens, labels):
         loss, grads = jax.value_and_grad(gpt_loss)(
             state["params"], tokens, labels, config, mesh_axes=mesh,
-            remat=remat, sp_sharding=sp_sharding)
+            remat=remat, sp_sharding=sp_sharding, pp_trunk=pp_trunk)
         step = state["step"] + 1
         t = step.astype(jnp.float32)
 

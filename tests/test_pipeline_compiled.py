@@ -1,0 +1,122 @@
+"""Compiled pipeline parallelism (pp mesh axis, collective-permute
+streaming) — parity against the sequential layer scan."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from paddle_tpu.distributed.pipeline_compiled import (pipelined_trunk,
+                                                      spmd_pipeline)
+
+
+def _mesh(shape, names):
+    n = int(np.prod(shape))
+    devs = np.asarray(jax.devices()[:n]).reshape(shape)
+    return Mesh(devs, names)
+
+
+def test_spmd_pipeline_matches_sequential():
+    """8 affine 'layers' over 4 stages, 4 micro-batches."""
+    rng = np.random.RandomState(0)
+    L, mb_n, mb, h = 8, 4, 2, 16
+    w = jnp.asarray(rng.randn(L, h, h) * 0.3, jnp.float32)
+    b = jnp.asarray(rng.randn(L, h) * 0.1, jnp.float32)
+    x = jnp.asarray(rng.randn(mb_n * mb, h), jnp.float32)
+
+    def block(a, blk):
+        wi, bi = blk
+        return jnp.tanh(a @ wi + bi)
+
+    # sequential reference
+    ref = x
+    for i in range(L):
+        ref = block(ref, (w[i], b[i]))
+
+    mesh = _mesh((4,), ("pp",))
+    trunk = pipelined_trunk(block, mesh, num_microbatches=mb_n,
+                            axis_name="pp", remat=False)
+    out = trunk((w, b), x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5,
+                               atol=2e-5)
+
+
+def test_spmd_pipeline_grad_matches_sequential():
+    rng = np.random.RandomState(1)
+    L, mb_n, mb, h = 4, 2, 2, 8
+    w = jnp.asarray(rng.randn(L, h, h) * 0.3, jnp.float32)
+    b = jnp.asarray(rng.randn(L, h) * 0.1, jnp.float32)
+    x = jnp.asarray(rng.randn(mb_n * mb, h), jnp.float32)
+
+    def block(a, blk):
+        wi, bi = blk
+        return jnp.tanh(a @ wi + bi)
+
+    def seq_loss(params, x):
+        w, b = params
+        a = x
+        for i in range(L):
+            a = block(a, (w[i], b[i]))
+        return jnp.sum(a ** 2)
+
+    mesh = _mesh((2,), ("pp",))
+    trunk = pipelined_trunk(block, mesh, num_microbatches=mb_n,
+                            axis_name="pp", remat=True)
+
+    def pp_loss(params, x):
+        return jnp.sum(trunk(params, x) ** 2)
+
+    g_ref = jax.grad(seq_loss)((w, b), x)
+    g_pp = jax.grad(pp_loss)((w, b), x)
+    for a, b_ in zip(jax.tree_util.tree_leaves(g_ref),
+                     jax.tree_util.tree_leaves(g_pp)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=5e-4, atol=5e-4)
+
+
+def test_gpt_train_step_dp_pp_matches_single():
+    """One full GPT train step on a dp2 x pp2 mesh == single-device step."""
+    from paddle_tpu.models.gpt import GPTConfig, build_train_step
+
+    config = GPTConfig(vocab_size=64, hidden_size=32, num_layers=4,
+                       num_heads=4, max_position_embeddings=32,
+                       dtype="float32")
+    rng = np.random.RandomState(2)
+    tokens = jnp.asarray(rng.randint(0, 64, (8, 16)), jnp.int32)
+    labels = jnp.asarray(rng.randint(0, 64, (8, 16)), jnp.int32)
+
+    init_s, step_s = build_train_step(config, mesh=None, lr=1e-3,
+                                      remat=False)
+    state_s = init_s(0)
+    state_s, loss_s = step_s(state_s, tokens, labels)
+
+    mesh = _mesh((2, 2), ("dp", "pp"))
+    init_p, step_p = build_train_step(config, mesh=mesh, lr=1e-3,
+                                      remat=False, pp_microbatches=4)
+    state_p = init_p(0)
+    state_p, loss_p = step_p(state_p, tokens, labels)
+
+    np.testing.assert_allclose(float(loss_p), float(loss_s), rtol=1e-4)
+    # params after the step agree too
+    w_s = np.asarray(state_s["params"]["blocks"]["fc_w"])
+    w_p = np.asarray(state_p["params"]["blocks"]["fc_w"])
+    np.testing.assert_allclose(w_p, w_s, rtol=1e-4, atol=1e-5)
+
+
+def test_gpt_train_step_dp_pp_mp_3d():
+    """3-D dp x pp x mp mesh compiles and runs one step."""
+    from paddle_tpu.models.gpt import GPTConfig, build_train_step
+
+    config = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                       num_heads=4, max_position_embeddings=32,
+                       dtype="float32")
+    rng = np.random.RandomState(3)
+    tokens = jnp.asarray(rng.randint(0, 64, (4, 16)), jnp.int32)
+    labels = jnp.asarray(rng.randint(0, 64, (4, 16)), jnp.int32)
+    mesh = _mesh((2, 2, 2), ("dp", "pp", "mp"))
+    init_fn, step_fn = build_train_step(config, mesh=mesh, lr=1e-3,
+                                        remat=True, pp_microbatches=2)
+    state = init_fn(0)
+    state, loss = step_fn(state, tokens, labels)
+    assert np.isfinite(float(loss))
