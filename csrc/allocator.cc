@@ -1,0 +1,160 @@
+// Auto-growth best-fit host allocator.
+//
+// Native analog of the reference's default allocator strategy
+// (paddle/phi/core/memory/allocation/auto_growth_best_fit_allocator.cc):
+// carve allocations from large chunks, best-fit over a size-ordered free
+// map, coalesce neighbors on free, grow by max(chunk, aligned request)
+// when no block fits. Device memory belongs to PJRT/XLA on TPU; this pool
+// serves host staging buffers (input pipeline, checkpoint IO) where malloc
+// churn and page faults would stall the feed path.
+#include "pt_common.h"
+
+#include <cstdlib>
+#include <map>
+#include <mutex>
+#include <unordered_map>
+#include <vector>
+
+namespace pt {
+namespace {
+
+constexpr size_t kAlign = 256;
+
+size_t align_up(size_t n) { return (n + kAlign - 1) & ~(kAlign - 1); }
+
+class AutoGrowthBestFit {
+ public:
+  explicit AutoGrowthBestFit(size_t chunk_size)
+      : chunk_size_(align_up(chunk_size ? chunk_size : (64u << 20))) {}
+
+  ~AutoGrowthBestFit() {
+    for (void* c : chunks_) std::free(c);
+  }
+
+  void* Alloc(size_t size) {
+    size = align_up(size ? size : kAlign);
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = free_by_size_.lower_bound(size);
+    if (it == free_by_size_.end()) {
+      size_t grow = std::max(chunk_size_, size);
+      void* chunk = std::aligned_alloc(kAlign, grow);
+      if (!chunk) {
+        set_last_error("allocator: aligned_alloc of " +
+                       std::to_string(grow) + " bytes failed");
+        return nullptr;
+      }
+      chunks_.push_back(chunk);
+      reserved_ += grow;
+      it = InsertFree(static_cast<char*>(chunk), grow);
+    }
+    char* base = it->second;
+    size_t block = it->first;
+    EraseFree(it);
+    if (block > size + kAlign) {  // split
+      InsertFree(base + size, block - size);
+      block = size;
+    }
+    allocated_[base] = block;
+    in_use_ += block;
+    return base;
+  }
+
+  bool Free(void* p) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = allocated_.find(static_cast<char*>(p));
+    if (it == allocated_.end()) {
+      set_last_error("allocator: free of unknown pointer");
+      return false;
+    }
+    char* base = it->first;
+    size_t size = it->second;
+    allocated_.erase(it);
+    in_use_ -= size;
+    // coalesce with free neighbors
+    auto right = free_by_addr_.find(base + size);
+    if (right != free_by_addr_.end()) {
+      size += right->second;
+      EraseFreeByAddr(right);
+    }
+    if (!free_by_addr_.empty()) {
+      auto left = free_by_addr_.lower_bound(base);
+      if (left != free_by_addr_.begin()) {
+        --left;
+        if (left->first + left->second == base) {
+          base = left->first;
+          size += left->second;
+          EraseFreeByAddr(left);
+        }
+      }
+    }
+    InsertFree(base, size);
+    return true;
+  }
+
+  void Stats(uint64_t* in_use, uint64_t* reserved) const {
+    std::lock_guard<std::mutex> g(mu_);
+    *in_use = in_use_;
+    *reserved = reserved_;
+  }
+
+ private:
+  using FreeBySize = std::multimap<size_t, char*>;
+
+  FreeBySize::iterator InsertFree(char* base, size_t size) {
+    auto it = free_by_size_.emplace(size, base);
+    free_by_addr_[base] = size;
+    return it;
+  }
+
+  void EraseFree(FreeBySize::iterator it) {
+    free_by_addr_.erase(it->second);
+    free_by_size_.erase(it);
+  }
+
+  void EraseFreeByAddr(std::map<char*, size_t>::iterator it) {
+    auto range = free_by_size_.equal_range(it->second);
+    for (auto i = range.first; i != range.second; ++i) {
+      if (i->second == it->first) {
+        free_by_size_.erase(i);
+        break;
+      }
+    }
+    free_by_addr_.erase(it);
+  }
+
+  size_t chunk_size_;
+  mutable std::mutex mu_;
+  FreeBySize free_by_size_;
+  std::map<char*, size_t> free_by_addr_;
+  std::unordered_map<char*, size_t> allocated_;
+  std::vector<void*> chunks_;
+  uint64_t in_use_ = 0;
+  uint64_t reserved_ = 0;
+};
+
+}  // namespace
+}  // namespace pt
+
+using pt::AutoGrowthBestFit;
+
+PT_EXPORT void* pt_alloc_create(uint64_t chunk_size) {
+  return new AutoGrowthBestFit(static_cast<size_t>(chunk_size));
+}
+
+PT_EXPORT void pt_alloc_destroy(void* h) {
+  delete static_cast<AutoGrowthBestFit*>(h);
+}
+
+PT_EXPORT void* pt_alloc_malloc(void* h, uint64_t size) {
+  return static_cast<AutoGrowthBestFit*>(h)->Alloc(
+      static_cast<size_t>(size));
+}
+
+PT_EXPORT int pt_alloc_free(void* h, void* p) {
+  return static_cast<AutoGrowthBestFit*>(h)->Free(p) ? 0 : -1;
+}
+
+PT_EXPORT void pt_alloc_stats(void* h, uint64_t* in_use,
+                              uint64_t* reserved) {
+  static_cast<AutoGrowthBestFit*>(h)->Stats(in_use, reserved);
+}

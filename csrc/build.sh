@@ -1,0 +1,11 @@
+#!/bin/sh
+# Build libpaddle_tpu_rt.so (native runtime: tcp_store, allocator,
+# data_feed, flags). Invoked by paddle_tpu._core.native on demand.
+set -e
+cd "$(dirname "$0")"
+mkdir -p build
+g++ -std=c++17 -O2 -fPIC -shared -pthread \
+    -fvisibility=hidden \
+    pt_error.cc tcp_store.cc allocator.cc data_feed.cc flags.cc \
+    -o build/libpaddle_tpu_rt.so
+echo "built csrc/build/libpaddle_tpu_rt.so"

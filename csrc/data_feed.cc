@@ -1,0 +1,168 @@
+// Threaded prefetching token-data feed.
+//
+// Native analog of the reference's C++ DataFeed/Dataset input pipeline
+// (paddle/fluid/framework/data_feed.h, data_set.h) and the multiprocess
+// DataLoader workers (python/paddle/io/dataloader/dataloader_iter.py:368):
+// a worker thread mmap-reads a flat binary token file (int32), cuts
+// shuffled fixed-length windows, and keeps a bounded ring of ready
+// [batch, seq_len+1] buffers so the accelerator never waits on the host.
+#include "pt_common.h"
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <atomic>
+#include <condition_variable>
+#include <deque>
+#include <mutex>
+#include <random>
+#include <thread>
+#include <vector>
+
+namespace pt {
+namespace {
+
+class TokenFeed {
+ public:
+  TokenFeed(const std::string& path, int64_t seq_len, int64_t batch,
+            bool shuffle, uint64_t seed, int depth)
+      : seq_len_(seq_len),
+        batch_(batch),
+        shuffle_(shuffle),
+        rng_(seed),
+        depth_(depth > 0 ? depth : 4) {
+    fd_ = ::open(path.c_str(), O_RDONLY);
+    if (fd_ < 0) {
+      set_last_error("data_feed: cannot open " + path);
+      return;
+    }
+    struct stat st{};
+    ::fstat(fd_, &st);
+    file_bytes_ = static_cast<size_t>(st.st_size);
+    n_tokens_ = file_bytes_ / sizeof(int32_t);
+    if (n_tokens_ < static_cast<size_t>(seq_len_ + 1)) {
+      set_last_error("data_feed: file too small for seq_len");
+      ::close(fd_);
+      fd_ = -1;
+      return;
+    }
+    map_ = static_cast<const int32_t*>(
+        ::mmap(nullptr, file_bytes_, PROT_READ, MAP_PRIVATE, fd_, 0));
+    if (map_ == MAP_FAILED) {
+      set_last_error("data_feed: mmap failed");
+      map_ = nullptr;
+      ::close(fd_);
+      fd_ = -1;
+      return;
+    }
+    ::madvise(const_cast<int32_t*>(map_), file_bytes_, MADV_SEQUENTIAL);
+    n_windows_ = (n_tokens_ - 1) / seq_len_;
+    worker_ = std::thread([this] { Produce(); });
+  }
+
+  bool ok() const { return map_ != nullptr; }
+  int64_t num_windows() const { return static_cast<int64_t>(n_windows_); }
+
+  // copy the next ready batch ([batch, seq_len+1] int32) into out
+  bool Next(int32_t* out) {
+    std::unique_lock<std::mutex> g(mu_);
+    cv_consumer_.wait(g,
+                      [&] { return stopping_.load() || !ready_.empty(); });
+    if (stopping_.load() && ready_.empty()) return false;
+    std::vector<int32_t> buf = std::move(ready_.front());
+    ready_.pop_front();
+    g.unlock();
+    cv_producer_.notify_one();
+    std::memcpy(out, buf.data(), buf.size() * sizeof(int32_t));
+    return true;
+  }
+
+  ~TokenFeed() {
+    stopping_.store(true);
+    cv_producer_.notify_all();
+    cv_consumer_.notify_all();
+    if (worker_.joinable()) worker_.join();
+    if (map_) ::munmap(const_cast<int32_t*>(map_), file_bytes_);
+    if (fd_ >= 0) ::close(fd_);
+  }
+
+ private:
+  void Produce() {
+    const size_t row = static_cast<size_t>(seq_len_) + 1;
+    std::vector<size_t> order(n_windows_);
+    for (size_t i = 0; i < n_windows_; ++i) order[i] = i;
+    size_t cursor = n_windows_;  // trigger (re)shuffle on first use
+    while (!stopping_.load()) {
+      std::vector<int32_t> buf(static_cast<size_t>(batch_) * row);
+      for (int64_t b = 0; b < batch_; ++b) {
+        if (cursor >= n_windows_) {
+          if (shuffle_) {
+            std::shuffle(order.begin(), order.end(), rng_);
+          }
+          cursor = 0;
+        }
+        size_t start = order[cursor++] * static_cast<size_t>(seq_len_);
+        // window overlaps next token for labels; clamp to file end
+        if (start + row > n_tokens_) start = n_tokens_ - row;
+        std::memcpy(buf.data() + static_cast<size_t>(b) * row,
+                    map_ + start, row * sizeof(int32_t));
+      }
+      std::unique_lock<std::mutex> g(mu_);
+      cv_producer_.wait(g, [&] {
+        return stopping_.load() ||
+               ready_.size() < static_cast<size_t>(depth_);
+      });
+      if (stopping_.load()) return;
+      ready_.push_back(std::move(buf));
+      g.unlock();
+      cv_consumer_.notify_one();
+    }
+  }
+
+  int64_t seq_len_, batch_;
+  bool shuffle_;
+  std::mt19937_64 rng_;
+  int depth_;
+  int fd_ = -1;
+  size_t file_bytes_ = 0;
+  size_t n_tokens_ = 0;
+  size_t n_windows_ = 0;
+  const int32_t* map_ = nullptr;
+
+  std::thread worker_;
+  std::atomic<bool> stopping_{false};
+  std::mutex mu_;
+  std::condition_variable cv_producer_, cv_consumer_;
+  std::deque<std::vector<int32_t>> ready_;
+};
+
+}  // namespace
+}  // namespace pt
+
+using pt::TokenFeed;
+
+PT_EXPORT void* pt_feed_create(const char* path, int64_t seq_len,
+                               int64_t batch, int shuffle, uint64_t seed,
+                               int depth) {
+  auto* f = new TokenFeed(path, seq_len, batch, shuffle != 0, seed, depth);
+  if (!f->ok()) {
+    delete f;
+    return nullptr;
+  }
+  return f;
+}
+
+PT_EXPORT int64_t pt_feed_num_windows(void* h) {
+  return static_cast<TokenFeed*>(h)->num_windows();
+}
+
+PT_EXPORT int pt_feed_next(void* h, int32_t* out) {
+  return static_cast<TokenFeed*>(h)->Next(out) ? 0 : -1;
+}
+
+PT_EXPORT void pt_feed_destroy(void* h) {
+  delete static_cast<TokenFeed*>(h);
+}

@@ -1,0 +1,60 @@
+// Global runtime flag registry with environment override.
+//
+// Native analog of the reference's exported-flag system
+// (paddle/common/flags.h:242 PHI_DEFINE_EXPORTED_* macro family,
+// flags_native.cc): string-keyed registry, values overridable from the
+// environment as PT_FLAGS_<name>, queried from both C++ subsystems and
+// Python (paddle_tpu.set_flags/get_flags bridge).
+#include "pt_common.h"
+
+#include <cstdlib>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+
+namespace pt {
+namespace {
+
+std::mutex g_mu;
+std::unordered_map<std::string, std::string>& Registry() {
+  static std::unordered_map<std::string, std::string> r;
+  return r;
+}
+
+}  // namespace
+}  // namespace pt
+
+PT_EXPORT int pt_flag_define(const char* name, const char* default_value) {
+  std::lock_guard<std::mutex> g(pt::g_mu);
+  auto& r = pt::Registry();
+  if (r.count(name)) return -1;
+  std::string env_key = std::string("PT_FLAGS_") + name;
+  const char* env = std::getenv(env_key.c_str());
+  r[name] = env ? env : default_value;
+  return 0;
+}
+
+PT_EXPORT int pt_flag_set(const char* name, const char* value) {
+  std::lock_guard<std::mutex> g(pt::g_mu);
+  auto& r = pt::Registry();
+  auto it = r.find(name);
+  if (it == r.end()) {
+    pt::set_last_error(std::string("unknown flag: ") + name);
+    return -1;
+  }
+  it->second = value;
+  return 0;
+}
+
+PT_EXPORT int64_t pt_flag_get(const char* name, char* buf,
+                              int64_t buf_len) {
+  std::lock_guard<std::mutex> g(pt::g_mu);
+  auto& r = pt::Registry();
+  auto it = r.find(name);
+  if (it == r.end()) return -1;
+  int64_t n = static_cast<int64_t>(it->second.size());
+  if (buf && buf_len > n) {
+    std::memcpy(buf, it->second.c_str(), n + 1);
+  }
+  return n;
+}

@@ -1,0 +1,28 @@
+// Common helpers for the paddle_tpu native runtime library.
+//
+// TPU-native C++ runtime substrate: the pieces of the reference that live
+// in C++ around the accelerator compute path (SURVEY.md §2a/§2e) —
+// rendezvous store (paddle/phi/core/distributed/store/tcp_store.h:121),
+// host allocator (paddle/phi/core/memory/allocation/, auto_growth strategy),
+// data feed (paddle/fluid/framework/data_feed.h), flag registry
+// (paddle/common/flags.h:242). Compute stays on XLA; this library serves
+// the host side: multi-host rendezvous, staging memory, input pipeline.
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+
+#if defined(_WIN32)
+#error "POSIX only"
+#endif
+
+#define PT_EXPORT extern "C" __attribute__((visibility("default")))
+
+namespace pt {
+
+// last error message, per-thread
+std::string& last_error();
+void set_last_error(const std::string& msg);
+
+}  // namespace pt

@@ -1,0 +1,14 @@
+#include "pt_common.h"
+
+namespace pt {
+
+std::string& last_error() {
+  static thread_local std::string err;
+  return err;
+}
+
+void set_last_error(const std::string& msg) { last_error() = msg; }
+
+}  // namespace pt
+
+PT_EXPORT const char* pt_last_error() { return pt::last_error().c_str(); }

@@ -1,0 +1,386 @@
+// TCPStore: TCP key-value rendezvous for multi-host jobs.
+//
+// Native analog of the reference's TCPStore
+// (paddle/phi/core/distributed/store/tcp_store.h:121, socket.cpp): master
+// rank runs the server; every rank connects a client; collectives'
+// unique-id exchange, barrier-by-key, and elastic membership ride on
+// set/get/add/wait. Protocol: 1-byte command, u32-length-prefixed key and
+// value; WAIT blocks on a condition variable server-side.
+#include "pt_common.h"
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdio>
+#include <map>
+#include <mutex>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace pt {
+namespace {
+
+enum Cmd : uint8_t { kSet = 0, kGet = 1, kAdd = 2, kWait = 3, kPing = 4 };
+
+bool send_all(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t w = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (w <= 0) return false;
+    p += w;
+    n -= static_cast<size_t>(w);
+  }
+  return true;
+}
+
+bool recv_all(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool send_u32(int fd, uint32_t v) {
+  uint32_t nv = htonl(v);
+  return send_all(fd, &nv, 4);
+}
+
+bool recv_u32(int fd, uint32_t* v) {
+  uint32_t nv;
+  if (!recv_all(fd, &nv, 4)) return false;
+  *v = ntohl(nv);
+  return true;
+}
+
+bool send_bytes(int fd, const void* data, uint32_t n) {
+  return send_u32(fd, n) && (n == 0 || send_all(fd, data, n));
+}
+
+bool recv_bytes(int fd, std::vector<uint8_t>* out) {
+  uint32_t n;
+  if (!recv_u32(fd, &n)) return false;
+  out->resize(n);
+  return n == 0 || recv_all(fd, out->data(), n);
+}
+
+class StoreServer {
+ public:
+  explicit StoreServer(int port) : port_(port) {}
+
+  bool Start() {
+    listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd_ < 0) {
+      set_last_error("socket() failed");
+      return false;
+    }
+    int one = 1;
+    ::setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons(static_cast<uint16_t>(port_));
+    if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr),
+               sizeof(addr)) != 0) {
+      set_last_error("bind() failed on port " + std::to_string(port_));
+      ::close(listen_fd_);
+      return false;
+    }
+    if (port_ == 0) {  // ephemeral: report the picked port
+      socklen_t len = sizeof(addr);
+      ::getsockname(listen_fd_, reinterpret_cast<sockaddr*>(&addr), &len);
+      port_ = ntohs(addr.sin_port);
+    }
+    ::listen(listen_fd_, 128);
+    accept_thread_ = std::thread([this] { AcceptLoop(); });
+    return true;
+  }
+
+  void Stop() {
+    stopping_.store(true);
+    if (listen_fd_ >= 0) {
+      ::shutdown(listen_fd_, SHUT_RDWR);
+      ::close(listen_fd_);
+    }
+    if (accept_thread_.joinable()) accept_thread_.join();
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      for (int fd : client_fds_) {
+        ::shutdown(fd, SHUT_RDWR);
+        ::close(fd);
+      }
+      client_fds_.clear();
+    }
+    for (auto& t : client_threads_)
+      if (t.joinable()) t.join();
+    client_threads_.clear();
+  }
+
+  int port() const { return port_; }
+
+  ~StoreServer() { Stop(); }
+
+ private:
+  void AcceptLoop() {
+    while (!stopping_.load()) {
+      int fd = ::accept(listen_fd_, nullptr, nullptr);
+      if (fd < 0) break;
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      std::lock_guard<std::mutex> g(mu_);
+      client_fds_.push_back(fd);
+      client_threads_.emplace_back([this, fd] { ClientLoop(fd); });
+    }
+  }
+
+  void ClientLoop(int fd) {
+    while (!stopping_.load()) {
+      uint8_t cmd;
+      if (!recv_all(fd, &cmd, 1)) break;
+      std::vector<uint8_t> key_raw;
+      if (!recv_bytes(fd, &key_raw)) break;
+      std::string key(key_raw.begin(), key_raw.end());
+      if (cmd == kSet) {
+        std::vector<uint8_t> val;
+        if (!recv_bytes(fd, &val)) break;
+        {
+          std::lock_guard<std::mutex> g(data_mu_);
+          data_[key] = std::move(val);
+        }
+        cv_.notify_all();
+        if (!send_u32(fd, 0)) break;
+      } else if (cmd == kGet || cmd == kWait) {
+        uint32_t timeout_ms;
+        if (!recv_u32(fd, &timeout_ms)) break;
+        std::unique_lock<std::mutex> g(data_mu_);
+        bool ok = cv_.wait_for(
+            g, std::chrono::milliseconds(timeout_ms),
+            [&] { return stopping_.load() || data_.count(key) > 0; });
+        if (!ok || stopping_.load()) {
+          g.unlock();
+          uint8_t status = 1;  // timeout
+          if (!send_all(fd, &status, 1)) break;
+          continue;
+        }
+        uint8_t status = 0;
+        std::vector<uint8_t> val = (cmd == kGet) ? data_[key]
+                                                 : std::vector<uint8_t>{};
+        g.unlock();
+        if (!send_all(fd, &status, 1)) break;
+        if (cmd == kGet && !send_bytes(fd, val.data(),
+                                       static_cast<uint32_t>(val.size())))
+          break;
+      } else if (cmd == kAdd) {
+        int64_t delta;
+        if (!recv_all(fd, &delta, 8)) break;
+        int64_t result;
+        {
+          std::lock_guard<std::mutex> g(data_mu_);
+          auto& val = data_[key];
+          int64_t cur = 0;
+          if (val.size() == 8) std::memcpy(&cur, val.data(), 8);
+          cur += delta;
+          val.resize(8);
+          std::memcpy(val.data(), &cur, 8);
+          result = cur;
+        }
+        cv_.notify_all();
+        if (!send_all(fd, &result, 8)) break;
+      } else if (cmd == kPing) {
+        if (!send_u32(fd, 0)) break;
+      } else {
+        break;
+      }
+    }
+    ::close(fd);
+  }
+
+  int port_;
+  int listen_fd_ = -1;
+  std::atomic<bool> stopping_{false};
+  std::thread accept_thread_;
+  std::mutex mu_;
+  std::vector<int> client_fds_;
+  std::vector<std::thread> client_threads_;
+
+  std::mutex data_mu_;
+  std::condition_variable cv_;
+  std::unordered_map<std::string, std::vector<uint8_t>> data_;
+};
+
+class StoreClient {
+ public:
+  bool Connect(const std::string& host, int port, int timeout_ms) {
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::milliseconds(timeout_ms);
+    while (true) {
+      fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+      sockaddr_in addr{};
+      addr.sin_family = AF_INET;
+      addr.sin_port = htons(static_cast<uint16_t>(port));
+      if (::inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1) {
+        // resolve "localhost" minimal path
+        if (host == "localhost")
+          ::inet_pton(AF_INET, "127.0.0.1", &addr.sin_addr);
+        else {
+          set_last_error("inet_pton failed for " + host);
+          ::close(fd_);
+          return false;
+        }
+      }
+      if (::connect(fd_, reinterpret_cast<sockaddr*>(&addr),
+                    sizeof(addr)) == 0) {
+        int one = 1;
+        ::setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        return true;
+      }
+      ::close(fd_);
+      if (std::chrono::steady_clock::now() > deadline) {
+        set_last_error("connect timeout to " + host + ":" +
+                       std::to_string(port));
+        return false;
+      }
+      std::this_thread::sleep_for(std::chrono::milliseconds(50));
+    }
+  }
+
+  bool Set(const std::string& key, const void* data, uint32_t n) {
+    std::lock_guard<std::mutex> g(mu_);
+    uint8_t cmd = kSet;
+    if (!send_all(fd_, &cmd, 1) ||
+        !send_bytes(fd_, key.data(), static_cast<uint32_t>(key.size())) ||
+        !send_bytes(fd_, data, n))
+      return fail("set send");
+    uint32_t status;
+    return recv_u32(fd_, &status) || fail("set recv");
+  }
+
+  // blocking get with timeout; returns -1 on timeout/error
+  int64_t Get(const std::string& key, std::vector<uint8_t>* out,
+              uint32_t timeout_ms) {
+    std::lock_guard<std::mutex> g(mu_);
+    uint8_t cmd = kGet;
+    if (!send_all(fd_, &cmd, 1) ||
+        !send_bytes(fd_, key.data(), static_cast<uint32_t>(key.size())) ||
+        !send_u32(fd_, timeout_ms))
+      return fail("get send") ? -1 : -1;
+    uint8_t status;
+    if (!recv_all(fd_, &status, 1)) return -1;
+    if (status != 0) {
+      set_last_error("get('" + key + "') timed out");
+      return -1;
+    }
+    if (!recv_bytes(fd_, out)) return -1;
+    return static_cast<int64_t>(out->size());
+  }
+
+  bool Wait(const std::string& key, uint32_t timeout_ms) {
+    std::lock_guard<std::mutex> g(mu_);
+    uint8_t cmd = kWait;
+    if (!send_all(fd_, &cmd, 1) ||
+        !send_bytes(fd_, key.data(), static_cast<uint32_t>(key.size())) ||
+        !send_u32(fd_, timeout_ms))
+      return fail("wait send");
+    uint8_t status;
+    if (!recv_all(fd_, &status, 1)) return fail("wait recv");
+    if (status != 0) {
+      set_last_error("wait('" + key + "') timed out");
+      return false;
+    }
+    return true;
+  }
+
+  bool Add(const std::string& key, int64_t delta, int64_t* result) {
+    std::lock_guard<std::mutex> g(mu_);
+    uint8_t cmd = kAdd;
+    if (!send_all(fd_, &cmd, 1) ||
+        !send_bytes(fd_, key.data(), static_cast<uint32_t>(key.size())) ||
+        !send_all(fd_, &delta, 8))
+      return fail("add send");
+    return recv_all(fd_, result, 8) || fail("add recv");
+  }
+
+  ~StoreClient() {
+    if (fd_ >= 0) ::close(fd_);
+  }
+
+ private:
+  bool fail(const char* what) {
+    set_last_error(std::string("tcp_store client: ") + what + " failed");
+    return false;
+  }
+  int fd_ = -1;
+  std::mutex mu_;
+};
+
+}  // namespace
+}  // namespace pt
+
+using pt::StoreClient;
+using pt::StoreServer;
+
+PT_EXPORT void* pt_store_server_start(int port) {
+  auto* s = new StoreServer(port);
+  if (!s->Start()) {
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+
+PT_EXPORT int pt_store_server_port(void* h) {
+  return static_cast<StoreServer*>(h)->port();
+}
+
+PT_EXPORT void pt_store_server_stop(void* h) {
+  delete static_cast<StoreServer*>(h);
+}
+
+PT_EXPORT void* pt_store_client_connect(const char* host, int port,
+                                        int timeout_ms) {
+  auto* c = new StoreClient();
+  if (!c->Connect(host, port, timeout_ms)) {
+    delete c;
+    return nullptr;
+  }
+  return c;
+}
+
+PT_EXPORT void pt_store_client_close(void* h) {
+  delete static_cast<StoreClient*>(h);
+}
+
+PT_EXPORT int pt_store_set(void* h, const char* key, const void* data,
+                           uint32_t n) {
+  return static_cast<StoreClient*>(h)->Set(key, data, n) ? 0 : -1;
+}
+
+// Returns value length (copied into buf up to buf_len) or -1.
+PT_EXPORT int64_t pt_store_get(void* h, const char* key, void* buf,
+                               int64_t buf_len, uint32_t timeout_ms) {
+  std::vector<uint8_t> out;
+  int64_t n = static_cast<StoreClient*>(h)->Get(key, &out, timeout_ms);
+  if (n < 0) return -1;
+  if (buf && buf_len >= n) std::memcpy(buf, out.data(), n);
+  return n;
+}
+
+PT_EXPORT int pt_store_wait(void* h, const char* key, uint32_t timeout_ms) {
+  return static_cast<StoreClient*>(h)->Wait(key, timeout_ms) ? 0 : -1;
+}
+
+PT_EXPORT int64_t pt_store_add(void* h, const char* key, int64_t delta) {
+  int64_t result = 0;
+  if (!static_cast<StoreClient*>(h)->Add(key, delta, &result)) return -1;
+  return result;
+}

@@ -1,0 +1,115 @@
+"""ctypes bridge to the native runtime library (csrc/).
+
+The reference keeps its runtime substrate in C++ (SURVEY.md §2a/§2e); here
+the TPU-native equivalents — TCPStore rendezvous, auto-growth best-fit
+host allocator, prefetching data feed, flag registry — live in
+csrc/libpaddle_tpu_rt.so, built on first use with g++ (no pybind: plain C
+ABI + ctypes, the same dlopen shape as the reference's custom-device
+plugin ABI, paddle/phi/backends/device_ext.h:96)."""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_lib = None
+_lib_lock = threading.Lock()
+_CSRC = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "csrc")
+_SO = os.path.join(_CSRC, "build", "libpaddle_tpu_rt.so")
+_SOURCES = ("pt_error.cc", "tcp_store.cc", "allocator.cc", "data_feed.cc",
+            "flags.cc", "pt_common.h")
+
+
+def _needs_build() -> bool:
+    if not os.path.exists(_SO):
+        return True
+    so_mtime = os.path.getmtime(_SO)
+    for s in _SOURCES:
+        p = os.path.join(_CSRC, s)
+        if os.path.exists(p) and os.path.getmtime(p) > so_mtime:
+            return True
+    return False
+
+
+def _build():
+    subprocess.run(["sh", os.path.join(_CSRC, "build.sh")], check=True,
+                   capture_output=True)
+
+
+def _bind(lib):
+    c = ctypes
+    lib.pt_last_error.restype = c.c_char_p
+    lib.pt_store_server_start.restype = c.c_void_p
+    lib.pt_store_server_start.argtypes = [c.c_int]
+    lib.pt_store_server_port.restype = c.c_int
+    lib.pt_store_server_port.argtypes = [c.c_void_p]
+    lib.pt_store_server_stop.argtypes = [c.c_void_p]
+    lib.pt_store_client_connect.restype = c.c_void_p
+    lib.pt_store_client_connect.argtypes = [c.c_char_p, c.c_int, c.c_int]
+    lib.pt_store_client_close.argtypes = [c.c_void_p]
+    lib.pt_store_set.restype = c.c_int
+    lib.pt_store_set.argtypes = [c.c_void_p, c.c_char_p, c.c_void_p,
+                                 c.c_uint32]
+    lib.pt_store_get.restype = c.c_int64
+    lib.pt_store_get.argtypes = [c.c_void_p, c.c_char_p, c.c_void_p,
+                                 c.c_int64, c.c_uint32]
+    lib.pt_store_wait.restype = c.c_int
+    lib.pt_store_wait.argtypes = [c.c_void_p, c.c_char_p, c.c_uint32]
+    lib.pt_store_add.restype = c.c_int64
+    lib.pt_store_add.argtypes = [c.c_void_p, c.c_char_p, c.c_int64]
+
+    lib.pt_alloc_create.restype = c.c_void_p
+    lib.pt_alloc_create.argtypes = [c.c_uint64]
+    lib.pt_alloc_destroy.argtypes = [c.c_void_p]
+    lib.pt_alloc_malloc.restype = c.c_void_p
+    lib.pt_alloc_malloc.argtypes = [c.c_void_p, c.c_uint64]
+    lib.pt_alloc_free.restype = c.c_int
+    lib.pt_alloc_free.argtypes = [c.c_void_p, c.c_void_p]
+    lib.pt_alloc_stats.argtypes = [c.c_void_p,
+                                   c.POINTER(c.c_uint64),
+                                   c.POINTER(c.c_uint64)]
+
+    lib.pt_feed_create.restype = c.c_void_p
+    lib.pt_feed_create.argtypes = [c.c_char_p, c.c_int64, c.c_int64,
+                                   c.c_int, c.c_uint64, c.c_int]
+    lib.pt_feed_num_windows.restype = c.c_int64
+    lib.pt_feed_num_windows.argtypes = [c.c_void_p]
+    lib.pt_feed_next.restype = c.c_int
+    lib.pt_feed_next.argtypes = [c.c_void_p, c.c_void_p]
+    lib.pt_feed_destroy.argtypes = [c.c_void_p]
+
+    lib.pt_flag_define.restype = c.c_int
+    lib.pt_flag_define.argtypes = [c.c_char_p, c.c_char_p]
+    lib.pt_flag_set.restype = c.c_int
+    lib.pt_flag_set.argtypes = [c.c_char_p, c.c_char_p]
+    lib.pt_flag_get.restype = c.c_int64
+    lib.pt_flag_get.argtypes = [c.c_char_p, c.c_char_p, c.c_int64]
+    return lib
+
+
+def get_lib(required: bool = False) -> Optional[ctypes.CDLL]:
+    """Load (building if stale) the native runtime; None when the
+    toolchain is unavailable and required=False."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        try:
+            if _needs_build():
+                _build()
+            _lib = _bind(ctypes.CDLL(_SO))
+        except Exception:
+            if required:
+                raise
+            return None
+    return _lib
+
+
+def last_error() -> str:
+    lib = get_lib()
+    return lib.pt_last_error().decode() if lib is not None else ""

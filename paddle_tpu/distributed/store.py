@@ -1,0 +1,119 @@
+"""TCPStore rendezvous (native-backed).
+
+Python surface of the reference's TCPStore
+(phi/core/distributed/store/tcp_store.h:121; Python handle created at
+parallel.py:1134 core.create_or_get_global_tcp_store). Rank 0 hosts the
+C++ server (csrc/tcp_store.cc); every rank connects a C++ client. Used for
+multi-host bring-up: exchanging coordinator addresses before
+jax.distributed.initialize, barrier-by-key, elastic membership."""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from .._core import native
+
+
+class TCPStore:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 is_master: bool = False, world_size: int = 1,
+                 timeout: float = 300.0):
+        self._lib = native.get_lib(required=True)
+        self._server = None
+        self._timeout_ms = int(timeout * 1000)
+        if is_master:
+            self._server = self._lib.pt_store_server_start(port)
+            if not self._server:
+                raise RuntimeError(
+                    f"TCPStore server failed: {native.last_error()}")
+            port = self._lib.pt_store_server_port(self._server)
+        self.host = host
+        self.port = port
+        self.world_size = world_size
+        self._client = self._lib.pt_store_client_connect(
+            host.encode(), port, self._timeout_ms)
+        if not self._client:
+            self._close_server()
+            raise RuntimeError(
+                f"TCPStore connect failed: {native.last_error()}")
+
+    # ------------------------------------------------------------- KV API
+    def set(self, key: str, value) -> None:
+        data = value.encode() if isinstance(value, str) else bytes(value)
+        if self._lib.pt_store_set(self._client, key.encode(), data,
+                                  len(data)) != 0:
+            raise RuntimeError(f"TCPStore.set failed: "
+                               f"{native.last_error()}")
+
+    def get(self, key: str) -> bytes:
+        import ctypes
+        n = self._lib.pt_store_get(self._client, key.encode(), None, 0,
+                                   self._timeout_ms)
+        if n < 0:
+            raise RuntimeError(f"TCPStore.get('{key}') failed: "
+                               f"{native.last_error()}")
+        buf = ctypes.create_string_buffer(int(n))
+        n2 = self._lib.pt_store_get(self._client, key.encode(), buf, n,
+                                    self._timeout_ms)
+        if n2 < 0:
+            raise RuntimeError(f"TCPStore.get('{key}') failed: "
+                               f"{native.last_error()}")
+        return buf.raw[:n2]
+
+    def add(self, key: str, amount: int = 1) -> int:
+        r = self._lib.pt_store_add(self._client, key.encode(), amount)
+        if r < 0 and native.last_error():
+            raise RuntimeError(f"TCPStore.add failed: "
+                               f"{native.last_error()}")
+        return int(r)
+
+    def wait(self, key: str, timeout: Optional[float] = None) -> None:
+        ms = int((timeout or self._timeout_ms / 1000) * 1000)
+        if self._lib.pt_store_wait(self._client, key.encode(), ms) != 0:
+            raise RuntimeError(f"TCPStore.wait('{key}') timed out")
+
+    def barrier(self, key: str = "barrier", timeout: Optional[float] = None):
+        """All world_size ranks arrive, then proceed (barrier-by-key, the
+        reference's store-barrier pattern)."""
+        arrived = self.add(f"__bar/{key}/count", 1)
+        if arrived >= self.world_size:
+            self.set(f"__bar/{key}/done", b"1")
+        self.wait(f"__bar/{key}/done", timeout)
+
+    # ---------------------------------------------------------- lifecycle
+    def _close_server(self):
+        if self._server:
+            self._lib.pt_store_server_stop(self._server)
+            self._server = None
+
+    def close(self):
+        if getattr(self, "_client", None):
+            self._lib.pt_store_client_close(self._client)
+            self._client = None
+        self._close_server()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def create_or_get_global_tcp_store() -> TCPStore:
+    """parallel.py:1134 analog: build the job-wide store from the standard
+    env (MASTER_ADDR/MASTER_PORT or PADDLE_MASTER, PADDLE_TRAINER_ID)."""
+    global _global_store
+    if _global_store is not None:
+        return _global_store
+    master = os.environ.get("PADDLE_MASTER") or "{}:{}".format(
+        os.environ.get("MASTER_ADDR", "127.0.0.1"),
+        os.environ.get("MASTER_PORT", "6170"))
+    host, port = master.rsplit(":", 1)
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    world = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    _global_store = TCPStore(host, int(port), is_master=(rank == 0),
+                             world_size=world)
+    return _global_store
+
+
+_global_store: Optional[TCPStore] = None

@@ -282,3 +282,8 @@ class DataLoader:
         th.join()
         if err:
             raise err[0]
+
+
+from .token_feed import NativeTokenLoader  # noqa: E402,F401
+
+__all__.append("NativeTokenLoader")

@@ -1,0 +1,170 @@
+"""Native runtime library (csrc/): TCPStore rendezvous, auto-growth
+best-fit allocator, prefetching token feed, flag registry. Mirrors the
+reference's C++-unit-test coverage of tcp_store/allocator (SURVEY §4,
+test/cpp)."""
+import ctypes
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from paddle_tpu._core import native
+
+lib = native.get_lib()
+pytestmark = pytest.mark.skipif(lib is None,
+                                reason="native toolchain unavailable")
+
+
+# ---------------------------------------------------------------- tcpstore
+
+def test_tcp_store_set_get_add_roundtrip():
+    from paddle_tpu.distributed.store import TCPStore
+    master = TCPStore("127.0.0.1", 0, is_master=True, world_size=1,
+                      timeout=10)
+    port = master.port
+    master.set("alpha", b"hello")
+    assert master.get("alpha") == b"hello"
+    assert master.add("ctr", 3) == 3
+    assert master.add("ctr", 4) == 7
+
+    worker = TCPStore("127.0.0.1", port, is_master=False, world_size=1,
+                      timeout=10)
+    assert worker.get("alpha") == b"hello"
+    worker.set("beta", "from-worker")
+    assert master.get("beta") == b"from-worker"
+    worker.close()
+    master.close()
+
+
+def test_tcp_store_wait_blocks_until_set():
+    from paddle_tpu.distributed.store import TCPStore
+    master = TCPStore("127.0.0.1", 0, is_master=True, world_size=1,
+                      timeout=10)
+    worker = TCPStore("127.0.0.1", master.port, is_master=False,
+                      world_size=1, timeout=10)
+    got = {}
+
+    def waiter():
+        got["v"] = worker.get("late-key")
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    master.set("late-key", b"now")
+    t.join(timeout=10)
+    assert got["v"] == b"now"
+    worker.close()
+    master.close()
+
+
+def test_tcp_store_barrier_two_ranks():
+    from paddle_tpu.distributed.store import TCPStore
+    master = TCPStore("127.0.0.1", 0, is_master=True, world_size=2,
+                      timeout=10)
+    worker = TCPStore("127.0.0.1", master.port, is_master=False,
+                      world_size=2, timeout=10)
+    done = []
+
+    def rank1():
+        worker.barrier("b0", timeout=10)
+        done.append(1)
+
+    t = threading.Thread(target=rank1)
+    t.start()
+    master.barrier("b0", timeout=10)
+    t.join(timeout=10)
+    assert done == [1]
+    worker.close()
+    master.close()
+
+
+# --------------------------------------------------------------- allocator
+
+def test_allocator_alloc_free_coalesce():
+    h = lib.pt_alloc_create(1 << 20)
+    ptrs = [lib.pt_alloc_malloc(h, 1000) for _ in range(100)]
+    assert all(ptrs)
+    assert len(set(ptrs)) == 100
+    in_use = ctypes.c_uint64()
+    reserved = ctypes.c_uint64()
+    lib.pt_alloc_stats(h, ctypes.byref(in_use), ctypes.byref(reserved))
+    assert in_use.value >= 100 * 1000
+    assert reserved.value >= in_use.value
+    for p in ptrs:
+        assert lib.pt_alloc_free(h, p) == 0
+    lib.pt_alloc_stats(h, ctypes.byref(in_use), ctypes.byref(reserved))
+    assert in_use.value == 0
+    # coalesced: a big allocation must fit in the freed (merged) space
+    big = lib.pt_alloc_malloc(h, 90 * 1000)
+    assert big
+    lib.pt_alloc_stats(h, ctypes.byref(in_use), ctypes.byref(reserved))
+    assert reserved.value == (1 << 20)  # no growth needed
+    lib.pt_alloc_destroy(h)
+
+
+def test_allocator_writes_are_usable_memory():
+    h = lib.pt_alloc_create(1 << 16)
+    p = lib.pt_alloc_malloc(h, 4096)
+    arr = (ctypes.c_uint8 * 4096).from_address(p)
+    arr[:] = bytes(range(256)) * 16
+    assert bytes(arr[:256]) == bytes(range(256))
+    lib.pt_alloc_free(h, p)
+    lib.pt_alloc_destroy(h)
+
+
+def test_allocator_free_unknown_pointer_errors():
+    h = lib.pt_alloc_create(1 << 16)
+    assert lib.pt_alloc_free(h, 0xdead0) == -1
+    lib.pt_alloc_destroy(h)
+
+
+# --------------------------------------------------------------- data feed
+
+def test_native_token_loader(tmp_path):
+    from paddle_tpu.io.token_feed import NativeTokenLoader
+    tokens = np.arange(10000, dtype=np.int32)
+    path = tmp_path / "tokens.bin"
+    tokens.tofile(path)
+    seq, bs = 128, 4
+    loader = NativeTokenLoader(str(path), seq, bs, shuffle=False, seed=0)
+    assert loader.num_windows == (10000 - 1) // seq
+    x, y = loader.next()
+    assert x.shape == (bs, seq) and y.shape == (bs, seq)
+    # labels are inputs shifted by one (consecutive windows, no shuffle)
+    np.testing.assert_array_equal(y[:, :-1], x[:, 1:])
+    np.testing.assert_array_equal(x[0], tokens[:seq])
+    np.testing.assert_array_equal(x[1], tokens[seq:2 * seq])
+    # windows cover the file without repetition until epoch end
+    seen = {int(r[0]) for r in x}
+    for _ in range(5):
+        x2, _ = loader.next()
+        seen |= {int(r[0]) for r in x2}
+    assert len(seen) == 24  # 6 batches * 4 rows, all distinct windows
+    loader.close()
+
+
+def test_native_token_loader_shuffled_epoch_is_permutation(tmp_path):
+    from paddle_tpu.io.token_feed import NativeTokenLoader
+    seq, bs = 16, 2
+    n_tok = 16 * 20 + 1
+    tokens = np.arange(n_tok, dtype=np.int32)
+    path = tmp_path / "t.bin"
+    tokens.tofile(path)
+    loader = NativeTokenLoader(str(path), seq, bs, shuffle=True, seed=7)
+    starts = []
+    for _ in range(10):  # one epoch = 20 windows = 10 batches
+        x, _ = loader.next()
+        starts.extend(int(r[0]) for r in x)
+    assert sorted(starts) == [i * seq for i in range(20)]
+    loader.close()
+
+
+# ------------------------------------------------------------------- flags
+
+def test_native_flag_registry():
+    assert lib.pt_flag_define(b"check_nan_inf", b"false") in (0, -1)
+    assert lib.pt_flag_set(b"check_nan_inf", b"true") == 0
+    buf = ctypes.create_string_buffer(64)
+    n = lib.pt_flag_get(b"check_nan_inf", buf, 64)
+    assert n == 4 and buf.value == b"true"
+    assert lib.pt_flag_set(b"no_such_flag", b"x") == -1
