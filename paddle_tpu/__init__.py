@@ -45,6 +45,8 @@ from . import jit  # noqa: E402
 from . import metric  # noqa: E402
 from . import framework  # noqa: E402
 from . import incubate  # noqa: E402
+from . import hapi  # noqa: E402
+from .hapi import Model  # noqa: E402
 
 from .framework import save, load  # noqa: E402
 
@@ -68,7 +70,8 @@ def is_grad_enabled_():
 
 
 def flops(net, input_size, custom_ops=None, print_detail=False):
-    raise NotImplementedError
+    from .hapi.dynamic_flops import flops as _flops
+    return _flops(net, input_size, custom_ops, print_detail)
 
 
 def summary(net, input_size=None, dtypes=None, input=None):

@@ -1,0 +1,7 @@
+"""paddle_tpu.hapi — high-level Model API (python/paddle/hapi analog)."""
+from . import callbacks  # noqa: F401
+from .callbacks import (Callback, EarlyStopping, LRScheduler,  # noqa: F401
+                        ModelCheckpoint, ProgBarLogger)
+from .dynamic_flops import flops  # noqa: F401
+from .model import Model  # noqa: F401
+from .summary import summary  # noqa: F401

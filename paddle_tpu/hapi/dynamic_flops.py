@@ -1,0 +1,93 @@
+"""paddle.flops (hapi/dynamic_flops.py analog): FLOPs estimation by
+forward hooks on leaf layers, with per-type counting rules."""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from .._core.tensor import Tensor
+from .. import nn
+
+
+def _numel(shape):
+    return int(np.prod([d for d in shape if d is not None])) if shape \
+        else 0
+
+
+def _count_linear(layer, inp, out):
+    in_f = layer.weight.shape[0]
+    return _numel(out.shape) * in_f
+
+
+def _count_conv(layer, inp, out):
+    w = layer.weight  # [out_c, in_c/groups, *k]
+    kernel_ops = _numel(w.shape[1:])
+    return _numel(out.shape) * kernel_ops
+
+
+def _count_norm(layer, inp, out):
+    return 2 * _numel(inp.shape)
+
+
+def _count_act(layer, inp, out):
+    return _numel(out.shape)
+
+
+_RULES = []
+
+
+def _build_rules():
+    if _RULES:
+        return _RULES
+    _RULES.extend([
+        (nn.Linear, _count_linear),
+        (getattr(nn, "Conv2D", ()), _count_conv),
+        (getattr(nn, "Conv1D", ()), _count_conv),
+        (getattr(nn, "BatchNorm2D", ()), _count_norm),
+        (getattr(nn, "LayerNorm", ()), _count_norm),
+        (getattr(nn, "ReLU", ()), _count_act),
+        (getattr(nn, "GELU", ()), _count_act),
+        (getattr(nn, "Sigmoid", ()), _count_act),
+    ])
+    return _RULES
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """Return total multiply-add FLOPs for one forward pass."""
+    rules = list(_build_rules())
+    if custom_ops:
+        rules = [(k, v) for k, v in custom_ops.items()] + rules
+
+    total = {"flops": 0}
+    handles = []
+
+    def make_hook(counter):
+        def hook(layer, inp, out):
+            i0 = inp[0] if isinstance(inp, (list, tuple)) else inp
+            o0 = out[0] if isinstance(out, (list, tuple)) else out
+            total["flops"] += counter(layer, i0, o0)
+        return hook
+
+    for _, sub in net.named_sublayers():
+        if list(sub.sublayers()):
+            continue
+        for cls, counter in rules:
+            if cls and isinstance(sub, cls):
+                handles.append(sub.register_forward_post_hook(
+                    make_hook(counter)))
+                break
+
+    x = Tensor(np.zeros(input_size, np.float32))
+    was_training = getattr(net, "training", True)
+    net.eval()
+    try:
+        net(x)
+    finally:
+        if was_training:
+            net.train()
+        for h in handles:
+            h.remove()
+    if print_detail:
+        print(f"Total FLOPs: {total['flops']:,}")
+    return total["flops"]

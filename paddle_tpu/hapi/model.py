@@ -1,0 +1,244 @@
+"""paddle.Model — the high-level train/eval/predict loop.
+
+Analog of python/paddle/hapi/model.py:1472 (Model; .fit:2200, .save/.load/
+.summary). Dygraph-mode engine over the eager runtime: train_batch does
+forward/loss/backward/step; fit drives epochs + callbacks; prepare wires
+optimizer/loss/metrics. The reference's static-graph dual mode maps to the
+jit path (wrap the network with paddle_tpu.jit.to_static before Model)."""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import List, Optional
+
+import numpy as np
+
+from .._core.autograd import no_grad
+from .._core.tensor import Tensor
+from ..io import DataLoader, Dataset
+from ..metric import Metric
+from .callbacks import EarlyStopping, config_callbacks
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self.stop_training = False
+
+    # ------------------------------------------------------------ prepare
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = _to_list(metrics)
+        for m in self._metrics:
+            if not isinstance(m, Metric):
+                raise TypeError(f"metric must be paddle.metric.Metric, "
+                                f"got {type(m)}")
+        return self
+
+    # ------------------------------------------------------- batch engine
+    def _compute_loss(self, outputs, labels):
+        if callable(self._loss) and not isinstance(self._loss, Tensor):
+            return self._loss(outputs, *_to_list(labels))
+        raise ValueError("loss not set; call prepare(loss=...)")
+
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        inputs = _to_list(inputs)
+        outputs = self.network(*inputs)
+        loss = self._compute_loss(outputs, labels)
+        loss.backward()
+        if update:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        metrics = self._update_metrics(outputs, labels)
+        return ([float(loss.numpy())], metrics) if metrics else \
+            [float(loss.numpy())]
+
+    @no_grad()
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        outputs = self.network(*_to_list(inputs))
+        loss = self._compute_loss(outputs, labels)
+        metrics = self._update_metrics(outputs, labels)
+        return ([float(loss.numpy())], metrics) if metrics else \
+            [float(loss.numpy())]
+
+    @no_grad()
+    def predict_batch(self, inputs):
+        self.network.eval()
+        return self.network(*_to_list(inputs))
+
+    def _update_metrics(self, outputs, labels):
+        res = []
+        for m in self._metrics:
+            stats = m.compute(outputs, *_to_list(labels))
+            m.update(*[np.asarray(s.numpy() if isinstance(s, Tensor)
+                                  else s) for s in _to_list(stats)])
+            res.append(m.accumulate())
+        return res
+
+    # ----------------------------------------------------------- fit/eval
+    def _make_loader(self, data, batch_size, shuffle):
+        if data is None or isinstance(data, DataLoader):
+            return data
+        if isinstance(data, Dataset):
+            return DataLoader(data, batch_size=batch_size or 1,
+                              shuffle=shuffle)
+        return data  # iterable of batches
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1,
+            epochs=1, eval_freq=1, log_freq=10, save_dir=None,
+            save_freq=1, verbose=2, drop_last=False, shuffle=True,
+            num_workers=0, callbacks=None, accumulate_grad_batches=1,
+            num_iters=None):
+        """hapi/model.py:2200 — epoch/step loop with callbacks."""
+        loader = self._make_loader(train_data, batch_size, shuffle)
+        steps = len(loader) if hasattr(loader, "__len__") else None
+        cbks = config_callbacks(callbacks, model=self,
+                                batch_size=batch_size, epochs=epochs,
+                                steps=steps, log_freq=log_freq,
+                                verbose=verbose, save_freq=save_freq,
+                                save_dir=save_dir,
+                                metrics=[m.name() for m in self._metrics])
+        early = [c for c in cbks.callbacks
+                 if isinstance(c, EarlyStopping)]
+        cbks.on_train_begin()
+        self.stop_training = False
+        it_count = 0
+        for epoch in range(epochs):
+            cbks.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            accum = 0
+            for step, batch in enumerate(loader):
+                cbks.on_train_batch_begin(step)
+                inputs, labels = self._split_batch(batch)
+                accum += 1
+                update = accum % accumulate_grad_batches == 0
+                out = self.train_batch(inputs, labels, update=update)
+                logs = self._pack_logs(out)
+                cbks.on_train_batch_end(step, logs)
+                it_count += 1
+                if num_iters is not None and it_count >= num_iters:
+                    self.stop_training = True
+                    break
+            cbks.on_epoch_end(epoch, logs)
+            if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self._run_eval(eval_data, batch_size, cbks)
+                for c in early:
+                    if c.stop_training:
+                        self.stop_training = True
+            if self.stop_training:
+                break
+        cbks.on_train_end()
+
+    def _run_eval(self, eval_data, batch_size, cbks):
+        loader = self._make_loader(eval_data, batch_size, False)
+        cbks.on_eval_begin()
+        for m in self._metrics:
+            m.reset()
+        logs = {}
+        losses = []
+        for step, batch in enumerate(loader):
+            cbks.on_eval_batch_begin(step)
+            inputs, labels = self._split_batch(batch)
+            out = self.eval_batch(inputs, labels)
+            logs = self._pack_logs(out)
+            losses.append(logs["loss"][0])
+            cbks.on_eval_batch_end(step, logs)
+        logs["loss"] = [float(np.mean(losses))] if losses else [0.0]
+        cbks.on_eval_end(logs)
+        return logs
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_iters=None):
+        cbks = config_callbacks(callbacks, model=self, verbose=verbose,
+                                metrics=[m.name() for m in self._metrics])
+        logs = self._run_eval(eval_data, batch_size, cbks)
+        result = {"loss": logs["loss"]}
+        for m in self._metrics:
+            result[m.name()] = m.accumulate()
+        return result
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, verbose=1, callbacks=None):
+        loader = self._make_loader(test_data, batch_size, False)
+        outputs = []
+        for batch in loader:
+            inputs, _ = self._split_batch(batch, has_labels=False)
+            out = self.predict_batch(inputs)
+            outputs.append([o.numpy() for o in _to_list(out)])
+        if stack_outputs:
+            n_out = len(outputs[0])
+            return [np.concatenate([b[i] for b in outputs])
+                    for i in range(n_out)]
+        return outputs
+
+    def _split_batch(self, batch, has_labels=True):
+        batch = _to_list(batch)
+        if len(batch) == 1:
+            return batch, None
+        if not has_labels:
+            # predict: when an inputs spec exists use its arity, else
+            # follow the reference convention that (x, y) data feeds x
+            n_in = len(_to_list(self._inputs)) if self._inputs else \
+                len(batch) - (1 if self._loss is not None else 0)
+            n_in = max(n_in, 1)
+            return batch[:n_in], None
+        return batch[:-1], batch[-1]
+
+    @staticmethod
+    def _pack_logs(out):
+        if isinstance(out, tuple):
+            losses, metrics = out
+            return {"loss": losses, "metrics": metrics}
+        return {"loss": out}
+
+    # ---------------------------------------------------------- save/load
+    def save(self, path, training=True):
+        """paddle.Model.save: <path>.pdparams (+ .pdopt when training)."""
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        from .. import save as _save
+        _save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            state = getattr(self._optimizer, "state_dict", lambda: {})()
+            with open(path + ".pdopt", "wb") as f:
+                pickle.dump({k: (np.asarray(v.numpy())
+                                 if isinstance(v, Tensor) else v)
+                             for k, v in state.items()}, f, protocol=4)
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from .. import load as _load
+        self.network.set_state_dict(_load(path + ".pdparams"))
+        opt_path = path + ".pdopt"
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(opt_path):
+            with open(opt_path, "rb") as f:
+                state = pickle.load(f)
+            if hasattr(self._optimizer, "set_state_dict"):
+                self._optimizer.set_state_dict(state)
+        return self
+
+    # -------------------------------------------------------------- misc
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters()
+
+    def summary(self, input_size=None, dtype=None):
+        from .summary import summary
+        return summary(self.network, input_size, dtypes=dtype)

@@ -1,0 +1,73 @@
+"""paddle.summary (hapi/model_summary.py analog): layer table with output
+shapes and parameter counts, collected via forward post-hooks."""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from .._core.tensor import Tensor
+from ..nn.layer import Layer
+
+
+def summary(net: Layer, input_size=None, dtypes=None, input=None):
+    if input is None:
+        if input_size is None:
+            raise ValueError("summary needs input_size or input")
+        sizes = input_size if isinstance(input_size, list) and \
+            isinstance(input_size[0], (list, tuple)) else [input_size]
+        dts = dtypes if isinstance(dtypes, (list, tuple)) else \
+            [dtypes or "float32"] * len(sizes)
+        inputs = [Tensor(np.zeros([d if d is not None else 1
+                                   for d in s],
+                                  np.dtype(dt) if dt != "float32"
+                                  else np.float32))
+                  for s, dt in zip(sizes, dts)]
+    else:
+        inputs = input if isinstance(input, (list, tuple)) else [input]
+
+    rows = []
+    handles = []
+
+    def make_hook(name, layer):
+        def hook(l, inp, out):
+            out0 = out[0] if isinstance(out, (list, tuple)) else out
+            shape = list(out0.shape) if hasattr(out0, "shape") else []
+            n_params = sum(int(np.prod(p.shape))
+                           for p in l.parameters(include_sublayers=False))
+            rows.append((f"{type(l).__name__}-{len(rows) + 1}", shape,
+                         n_params))
+        return hook
+
+    for name, sub in net.named_sublayers():
+        if not list(sub.sublayers()):  # leaves only
+            handles.append(sub.register_forward_post_hook(
+                make_hook(name, sub)))
+
+    was_training = getattr(net, "training", True)
+    net.eval()
+    try:
+        net(*inputs)
+    finally:
+        if was_training:
+            net.train()
+        for h in handles:
+            h.remove()
+
+    total = sum(int(np.prod(p.shape)) for p in net.parameters())
+    trainable = sum(int(np.prod(p.shape)) for p in net.parameters()
+                    if getattr(p, "trainable", True))
+
+    w_name, w_shape = 28, 24
+    lines = ["-" * 70,
+             f"{'Layer (type)':<{w_name}}{'Output Shape':<{w_shape}}"
+             f"{'Param #':>12}", "=" * 70]
+    for name, shape, n in rows:
+        lines.append(f"{name:<{w_name}}{str(shape):<{w_shape}}{n:>12,}")
+    lines += ["=" * 70,
+              f"Total params: {total:,}",
+              f"Trainable params: {trainable:,}",
+              f"Non-trainable params: {total - trainable:,}",
+              "-" * 70]
+    print("\n".join(lines))
+    return {"total_params": total, "trainable_params": trainable}
