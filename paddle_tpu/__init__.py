@@ -47,6 +47,14 @@ from . import framework  # noqa: E402
 from . import incubate  # noqa: E402
 from . import hapi  # noqa: E402
 from .hapi import Model  # noqa: E402
+# "from . import linalg" would find the ops.linalg attribute bound above
+# and skip the submodule import — load the namespace module explicitly
+import importlib as _importlib  # noqa: E402
+linalg = _importlib.import_module(".linalg", __name__)
+from . import fft  # noqa: E402
+from . import signal  # noqa: E402
+from . import distribution  # noqa: E402
+from . import sparse  # noqa: E402
 
 from .framework import save, load  # noqa: E402
 

@@ -1,0 +1,416 @@
+"""paddle.distribution (python/paddle/distribution analog): probability
+distributions with sample/rsample/log_prob/entropy/kl_divergence.
+
+Sampling draws from the framework RNG (paddle_tpu.seed) via jax.random;
+density math is jnp compiled by XLA."""
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional, Tuple, Type
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .._core import random as rnd
+from .._core.tensor import Tensor
+
+
+def _val(x):
+    if isinstance(x, Tensor):
+        return x._value
+    return jnp.asarray(x, jnp.float32) if not isinstance(
+        x, (jax.Array,)) else x
+
+
+def _key():
+    return rnd.next_key()
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    def sample(self, shape=()):
+        from ..autograd import no_grad
+        with no_grad():
+            return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return Tensor(jnp.exp(self.log_prob(value)._value))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _val(loc)
+        self.scale = _val(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(self.loc, self.batch_shape))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to(self.scale ** 2, self.batch_shape))
+
+    @property
+    def stddev(self):
+        return Tensor(jnp.broadcast_to(self.scale, self.batch_shape))
+
+    def rsample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        eps = jax.random.normal(_key(), shape, jnp.float32)
+        return Tensor(self.loc + self.scale * eps)
+
+    def log_prob(self, value):
+        v = _val(value)
+        var = self.scale ** 2
+        return Tensor(-((v - self.loc) ** 2) / (2 * var)
+                      - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+    def entropy(self):
+        e = 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale)
+        return Tensor(jnp.broadcast_to(e, self.batch_shape))
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.base = Normal(loc, scale)
+        super().__init__(self.base.batch_shape)
+        self.loc = self.base.loc
+        self.scale = self.base.scale
+
+    @property
+    def mean(self):
+        return Tensor(jnp.exp(self.loc + self.scale ** 2 / 2))
+
+    @property
+    def variance(self):
+        s2 = self.scale ** 2
+        return Tensor((jnp.exp(s2) - 1) * jnp.exp(2 * self.loc + s2))
+
+    def rsample(self, shape=()):
+        return Tensor(jnp.exp(self.base.rsample(shape)._value))
+
+    def log_prob(self, value):
+        v = _val(value)
+        return Tensor(self.base.log_prob(Tensor(jnp.log(v)))._value
+                      - jnp.log(v))
+
+    def entropy(self):
+        return Tensor(self.base.entropy()._value + self.loc)
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _val(low)
+        self.high = _val(high)
+        super().__init__(jnp.broadcast_shapes(self.low.shape,
+                                              self.high.shape))
+
+    @property
+    def mean(self):
+        return Tensor((self.low + self.high) / 2)
+
+    @property
+    def variance(self):
+        return Tensor((self.high - self.low) ** 2 / 12)
+
+    def rsample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        u = jax.random.uniform(_key(), shape, jnp.float32)
+        return Tensor(self.low + (self.high - self.low) * u)
+
+    def log_prob(self, value):
+        v = _val(value)
+        inside = (v >= self.low) & (v < self.high)
+        lp = -jnp.log(self.high - self.low)
+        return Tensor(jnp.where(inside, lp, -jnp.inf))
+
+    def entropy(self):
+        return Tensor(jnp.broadcast_to(jnp.log(self.high - self.low),
+                                       self.batch_shape))
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs=None, logits=None, name=None):
+        if (probs is None) == (logits is None):
+            raise ValueError("pass exactly one of probs/logits")
+        if probs is not None:
+            self.probs = _val(probs)
+            self.logits = jnp.log(self.probs) - jnp.log1p(-self.probs)
+        else:
+            self.logits = _val(logits)
+            self.probs = jax.nn.sigmoid(self.logits)
+        super().__init__(self.probs.shape)
+
+    @property
+    def mean(self):
+        return Tensor(self.probs)
+
+    @property
+    def variance(self):
+        return Tensor(self.probs * (1 - self.probs))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        return Tensor(jax.random.bernoulli(
+            _key(), self.probs, shape).astype(jnp.float32))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _val(value)
+        return Tensor(v * jnp.log(jnp.clip(self.probs, 1e-12))
+                      + (1 - v) * jnp.log(jnp.clip(1 - self.probs,
+                                                   1e-12)))
+
+    def entropy(self):
+        p = jnp.clip(self.probs, 1e-12, 1 - 1e-12)
+        return Tensor(-(p * jnp.log(p) + (1 - p) * jnp.log(1 - p)))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits=None, probs=None, name=None):
+        if logits is not None:
+            self.logits = jax.nn.log_softmax(_val(logits), -1)
+        else:
+            self.logits = jnp.log(jnp.clip(_val(probs), 1e-12))
+            self.logits = jax.nn.log_softmax(self.logits, -1)
+        self.probs = jnp.exp(self.logits)
+        super().__init__(self.probs.shape[:-1])
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        return Tensor(jax.random.categorical(
+            _key(), self.logits, shape=shape).astype(jnp.int64))
+
+    def log_prob(self, value):
+        v = _val(value).astype(jnp.int32)
+        return Tensor(jnp.take_along_axis(
+            self.logits, v[..., None], axis=-1)[..., 0])
+
+    def entropy(self):
+        return Tensor(-jnp.sum(self.probs * self.logits, -1))
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs = _val(probs)
+        super().__init__(self.probs.shape[:-1],
+                         (self.probs.shape[-1],))
+
+    @property
+    def mean(self):
+        return Tensor(self.total_count * self.probs)
+
+    @property
+    def variance(self):
+        return Tensor(self.total_count * self.probs * (1 - self.probs))
+
+    def sample(self, shape=()):
+        n_cat = self.probs.shape[-1]
+        logits = jnp.log(jnp.clip(self.probs, 1e-12))
+        draws = jax.random.categorical(
+            _key(), logits,
+            shape=tuple(shape) + self.batch_shape + (self.total_count,))
+        counts = jax.nn.one_hot(draws, n_cat).sum(-2)
+        return Tensor(counts.astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = _val(value)
+        logp = jnp.log(jnp.clip(self.probs, 1e-12))
+        from jax.scipy.special import gammaln
+        return Tensor(gammaln(v.sum(-1) + 1) - gammaln(v + 1).sum(-1)
+                      + (v * logp).sum(-1))
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _val(alpha)
+        self.beta = _val(beta)
+        super().__init__(jnp.broadcast_shapes(self.alpha.shape,
+                                              self.beta.shape))
+
+    @property
+    def mean(self):
+        return Tensor(self.alpha / (self.alpha + self.beta))
+
+    @property
+    def variance(self):
+        a, b = self.alpha, self.beta
+        return Tensor(a * b / ((a + b) ** 2 * (a + b + 1)))
+
+    def rsample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        return Tensor(jax.random.beta(_key(), self.alpha, self.beta,
+                                      shape))
+
+    def log_prob(self, value):
+        from jax.scipy.special import betaln
+        v = _val(value)
+        return Tensor((self.alpha - 1) * jnp.log(v)
+                      + (self.beta - 1) * jnp.log1p(-v)
+                      - betaln(self.alpha, self.beta))
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = _val(concentration)
+        self.rate = _val(rate)
+        super().__init__(jnp.broadcast_shapes(self.concentration.shape,
+                                              self.rate.shape))
+
+    @property
+    def mean(self):
+        return Tensor(self.concentration / self.rate)
+
+    @property
+    def variance(self):
+        return Tensor(self.concentration / self.rate ** 2)
+
+    def rsample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        return Tensor(jax.random.gamma(_key(), self.concentration, shape)
+                      / self.rate)
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+        v = _val(value)
+        a, b = self.concentration, self.rate
+        return Tensor(a * jnp.log(b) + (a - 1) * jnp.log(v) - b * v
+                      - gammaln(a))
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration, name=None):
+        self.concentration = _val(concentration)
+        super().__init__(self.concentration.shape[:-1],
+                         (self.concentration.shape[-1],))
+
+    @property
+    def mean(self):
+        return Tensor(self.concentration
+                      / self.concentration.sum(-1, keepdims=True))
+
+    def rsample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        return Tensor(jax.random.dirichlet(_key(), self.concentration,
+                                           shape))
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+        v = _val(value)
+        a = self.concentration
+        return Tensor(((a - 1) * jnp.log(v)).sum(-1)
+                      + gammaln(a.sum(-1)) - gammaln(a).sum(-1))
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _val(loc)
+        self.scale = _val(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(self.loc, self.batch_shape))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to(2 * self.scale ** 2,
+                                       self.batch_shape))
+
+    def rsample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        return Tensor(self.loc + self.scale * jax.random.laplace(
+            _key(), shape, jnp.float32))
+
+    def log_prob(self, value):
+        v = _val(value)
+        return Tensor(-jnp.abs(v - self.loc) / self.scale
+                      - jnp.log(2 * self.scale))
+
+    def entropy(self):
+        return Tensor(jnp.broadcast_to(1 + jnp.log(2 * self.scale),
+                                       self.batch_shape))
+
+
+# ------------------------------------------------------------------- KL
+
+_KL_REGISTRY: Dict[Tuple[Type, Type], callable] = {}
+
+
+def register_kl(type_p, type_q):
+    def deco(fn):
+        _KL_REGISTRY[(type_p, type_q)] = fn
+        return fn
+    return deco
+
+
+def kl_divergence(p: Distribution, q: Distribution) -> Tensor:
+    fn = _KL_REGISTRY.get((type(p), type(q)))
+    if fn is None:
+        raise NotImplementedError(
+            f"kl_divergence({type(p).__name__}, {type(q).__name__})")
+    return fn(p, q)
+
+
+@register_kl(Normal, Normal)
+def _kl_normal(p, q):
+    var_ratio = (p.scale / q.scale) ** 2
+    t1 = ((p.loc - q.loc) / q.scale) ** 2
+    return Tensor(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical(p, q):
+    return Tensor(jnp.sum(p.probs * (p.logits - q.logits), -1))
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform(p, q):
+    res = jnp.log((q.high - q.low) / (p.high - p.low))
+    out = jnp.where((q.low <= p.low) & (p.high <= q.high), res, jnp.inf)
+    return Tensor(out)
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli(p, q):
+    pa = jnp.clip(p.probs, 1e-12, 1 - 1e-12)
+    qa = jnp.clip(q.probs, 1e-12, 1 - 1e-12)
+    return Tensor(pa * (jnp.log(pa) - jnp.log(qa))
+                  + (1 - pa) * (jnp.log1p(-pa) - jnp.log1p(-qa)))

@@ -1,0 +1,80 @@
+"""paddle.fft (python/paddle/fft.py analog): discrete Fourier transforms.
+
+Kernel bodies are jnp.fft calls compiled by XLA; on TPU, FFTs lower to the
+XLA Fft HLO. Norm conventions ("backward"/"ortho"/"forward") match the
+reference/numpy semantics.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ._core.executor import apply
+from ._core.op_registry import _OPS, register_op
+from ._core.tensor import Tensor
+
+
+def _def(name, jfn):
+    if name not in _OPS:
+        register_op(name, jfn)
+
+    def wrapper(x, *args, **kwargs):
+        kwargs.pop("name", None)
+        return apply(name, x, **_norm_kwargs(jfn, args, kwargs))
+
+    wrapper.__name__ = name
+    return wrapper
+
+
+def _norm_kwargs(jfn, args, kwargs):
+    # map positional (n/axes, axis, norm) by the jnp signature order
+    import inspect
+    params = [p for p in inspect.signature(jfn).parameters][1:]
+    out = dict(kwargs)
+    for p, a in zip(params, args):
+        out[p] = a
+    return out
+
+
+fft = _def("fft_fft", lambda x, n=None, axis=-1, norm="backward":
+           jnp.fft.fft(x, n, axis, norm))
+ifft = _def("fft_ifft", lambda x, n=None, axis=-1, norm="backward":
+            jnp.fft.ifft(x, n, axis, norm))
+rfft = _def("fft_rfft", lambda x, n=None, axis=-1, norm="backward":
+            jnp.fft.rfft(x, n, axis, norm))
+irfft = _def("fft_irfft", lambda x, n=None, axis=-1, norm="backward":
+             jnp.fft.irfft(x, n, axis, norm))
+hfft = _def("fft_hfft", lambda x, n=None, axis=-1, norm="backward":
+            jnp.fft.hfft(x, n, axis, norm))
+ihfft = _def("fft_ihfft", lambda x, n=None, axis=-1, norm="backward":
+             jnp.fft.ihfft(x, n, axis, norm))
+fft2 = _def("fft_fft2", lambda x, s=None, axes=(-2, -1), norm="backward":
+            jnp.fft.fft2(x, s, axes, norm))
+ifft2 = _def("fft_ifft2", lambda x, s=None, axes=(-2, -1), norm="backward":
+             jnp.fft.ifft2(x, s, axes, norm))
+rfft2 = _def("fft_rfft2", lambda x, s=None, axes=(-2, -1), norm="backward":
+             jnp.fft.rfft2(x, s, axes, norm))
+irfft2 = _def("fft_irfft2",
+              lambda x, s=None, axes=(-2, -1), norm="backward":
+              jnp.fft.irfft2(x, s, axes, norm))
+fftn = _def("fft_fftn", lambda x, s=None, axes=None, norm="backward":
+            jnp.fft.fftn(x, s, axes, norm))
+ifftn = _def("fft_ifftn", lambda x, s=None, axes=None, norm="backward":
+             jnp.fft.ifftn(x, s, axes, norm))
+rfftn = _def("fft_rfftn", lambda x, s=None, axes=None, norm="backward":
+             jnp.fft.rfftn(x, s, axes, norm))
+irfftn = _def("fft_irfftn", lambda x, s=None, axes=None, norm="backward":
+              jnp.fft.irfftn(x, s, axes, norm))
+fftshift = _def("fft_fftshift", lambda x, axes=None:
+                jnp.fft.fftshift(x, axes))
+ifftshift = _def("fft_ifftshift", lambda x, axes=None:
+                 jnp.fft.ifftshift(x, axes))
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    return Tensor(jnp.fft.fftfreq(n, d).astype(
+        jnp.dtype(dtype) if dtype else jnp.float32))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    return Tensor(jnp.fft.rfftfreq(n, d).astype(
+        jnp.dtype(dtype) if dtype else jnp.float32))

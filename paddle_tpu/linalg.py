@@ -1,0 +1,53 @@
+"""paddle.linalg namespace (python/paddle/linalg.py analog): re-exports
+the linear-algebra ops plus decompositions not in the tensor namespace."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ._core.executor import apply
+from ._core.op_registry import _OPS, register_op
+from .ops.linalg import (bmm, cdist, cholesky, corrcoef, cov, cross,  # noqa: F401
+                         det, dot, eigh, eigvalsh, householder_product,
+                         inv, matmul, matrix_power, matrix_transpose,
+                         multi_dot, mv, norm, outer, pinv, qr, slogdet,
+                         solve, svd, trace, triangular_solve)
+
+
+def _def(name, jfn, multi_output=False):
+    if name not in _OPS:
+        register_op(name, jfn, multi_output=multi_output)
+
+    def wrapper(x, *args, **kwargs):
+        kwargs.pop("name", None)
+        return apply(name, x, *args, **kwargs)
+
+    wrapper.__name__ = name
+    return wrapper
+
+
+eig = _def("linalg_eig", lambda x: tuple(jnp.linalg.eig(x)),
+           multi_output=True)
+eigvals = _def("linalg_eigvals", jnp.linalg.eigvals)
+matrix_rank = _def("linalg_matrix_rank",
+                   lambda x, tol=None, hermitian=False:
+                   jnp.linalg.matrix_rank(x, tol=tol))
+cond = _def("linalg_cond", lambda x, p=None: jnp.linalg.cond(x, p=p))
+lu = _def("linalg_lu",
+          lambda x, pivot=True: _lu_impl(x), multi_output=True)
+lstsq = _def("linalg_lstsq",
+             lambda x, y, rcond=None, driver=None:
+             tuple(jnp.linalg.lstsq(x, y, rcond=rcond)),
+             multi_output=True)
+vector_norm = _def("linalg_vector_norm",
+                   lambda x, p=2.0, axis=None, keepdim=False:
+                   jnp.linalg.norm(x, ord=p, axis=axis, keepdims=keepdim))
+matrix_norm = _def("linalg_matrix_norm",
+                   lambda x, p="fro", axis=(-2, -1), keepdim=False:
+                   jnp.linalg.norm(x, ord=p, axis=tuple(axis),
+                                   keepdims=keepdim))
+
+
+def _lu_impl(x):
+    import jax.scipy.linalg as jsl
+    lu_mat, piv = jsl.lu_factor(x)
+    return lu_mat, piv.astype(jnp.int32)

@@ -1,0 +1,109 @@
+"""paddle.signal (python/paddle/signal.py analog): stft/istft."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ._core.executor import apply
+from ._core.op_registry import _OPS, register_op
+from ._core.tensor import Tensor
+
+
+def _stft_kernel(x, window, n_fft, hop_length, center, normalized,
+                 onesided):
+    if center:
+        pad = n_fft // 2
+        pad_width = [(0, 0)] * (x.ndim - 1) + [(pad, pad)]
+        x = jnp.pad(x, pad_width, mode="reflect")
+    n = x.shape[-1]
+    n_frames = 1 + (n - n_fft) // hop_length
+    idx = (jnp.arange(n_frames)[:, None] * hop_length
+           + jnp.arange(n_fft)[None, :])
+    frames = x[..., idx]                       # [..., frames, n_fft]
+    if window is not None:
+        frames = frames * window
+    spec = (jnp.fft.rfft(frames, axis=-1) if onesided
+            else jnp.fft.fft(frames, axis=-1))
+    if normalized:
+        spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+    return jnp.swapaxes(spec, -1, -2)          # [..., freq, frames]
+
+
+def _istft_kernel(x, window, n_fft, hop_length, center, normalized,
+                  onesided, length):
+    spec = jnp.swapaxes(x, -1, -2)             # [..., frames, freq]
+    if normalized:
+        spec = spec * jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+    frames = (jnp.fft.irfft(spec, n=n_fft, axis=-1) if onesided
+              else jnp.fft.ifft(spec, axis=-1).real)
+    if window is None:
+        window = jnp.ones((n_fft,), frames.dtype)
+    frames = frames * window
+    n_frames = frames.shape[-2]
+    out_len = n_fft + hop_length * (n_frames - 1)
+    shape = frames.shape[:-2] + (out_len,)
+    out = jnp.zeros(shape, frames.dtype)
+    win_sq = jnp.zeros((out_len,), frames.dtype)
+    for i in range(n_frames):
+        sl = slice(i * hop_length, i * hop_length + n_fft)
+        out = out.at[..., sl].add(frames[..., i, :])
+        win_sq = win_sq.at[sl].add(window * window)
+    out = out / jnp.maximum(win_sq, 1e-10)
+    if center:
+        pad = n_fft // 2
+        out = out[..., pad:out_len - pad]
+    if length is not None:
+        out = out[..., :length]
+    return out
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    """Short-time Fourier transform (signal.py stft): returns
+    [..., n_fft//2+1 (or n_fft), num_frames] complex."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is not None:
+        w = window._value if isinstance(window, Tensor) else jnp.asarray(
+            window)
+        if win_length < n_fft:  # center-pad window to n_fft
+            lp = (n_fft - win_length) // 2
+            w = jnp.pad(w, (lp, n_fft - win_length - lp))
+    else:
+        w = None
+    kw = dict(n_fft=n_fft, hop_length=hop_length, center=center,
+              normalized=normalized, onesided=onesided)
+    if w is None:
+        key = "signal_stft_nowin"
+        if key not in _OPS:
+            register_op(key, lambda x, **k: _stft_kernel(x, None, **k))
+        return apply(key, x, **kw)
+    key = "signal_stft"
+    if key not in _OPS:
+        register_op(key, _stft_kernel)
+    return apply(key, x, Tensor(w), **kw)
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is not None:
+        w = window._value if isinstance(window, Tensor) else jnp.asarray(
+            window)
+        if win_length < n_fft:
+            lp = (n_fft - win_length) // 2
+            w = jnp.pad(w, (lp, n_fft - win_length - lp))
+        key = "signal_istft"
+        if key not in _OPS:
+            register_op(key, _istft_kernel)
+        return apply(key, x, Tensor(w), n_fft=n_fft,
+                     hop_length=hop_length, center=center,
+                     normalized=normalized, onesided=onesided,
+                     length=length)
+    key = "signal_istft_nowin"
+    if key not in _OPS:
+        register_op(key, lambda x, **kw: _istft_kernel(x, None, **kw))
+    return apply(key, x, n_fft=n_fft, hop_length=hop_length, center=center,
+                 normalized=normalized, onesided=onesided, length=length)

@@ -1,0 +1,200 @@
+"""paddle.sparse (python/paddle/sparse analog; storage classes mirror
+phi's SparseCooTensor/SparseCsrTensor, paddle/phi/core/sparse_coo_tensor.h).
+
+TPU-native stance: sparse storage lives host/HBM as (indices, values)
+arrays with STATIC nnz (XLA needs static shapes); compute lowers to
+gather/segment-sum which XLA maps to one-hot matmuls / scatters on the
+MXU. Round-1 surface: COO/CSR construction, to_dense/to_sparse, elementwise
+add/mul on aligned sparsity, sparse @ dense matmul, relu."""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .._core.tensor import Tensor
+
+
+class SparseCooTensor:
+    """indices [sparse_ndim, nnz] int64, values [nnz, *dense_dims]."""
+
+    def __init__(self, indices, values, shape):
+        self.indices = indices if isinstance(indices, Tensor) else Tensor(
+            jnp.asarray(indices))
+        self.values = values if isinstance(values, Tensor) else Tensor(
+            jnp.asarray(values))
+        self._shape = list(shape)
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    def nnz(self):
+        return int(self.indices.shape[1])
+
+    def to_dense(self) -> Tensor:
+        idx = self.indices._value
+        vals = self.values._value
+        dense = jnp.zeros(tuple(self._shape), vals.dtype)
+        return Tensor(dense.at[tuple(idx)].add(vals))
+
+    def to_sparse_csr(self) -> "SparseCsrTensor":
+        if len(self._shape) != 2:
+            raise ValueError("CSR requires 2-D")
+        idx = np.asarray(self.indices._value)
+        vals = self.values._value
+        order = np.lexsort((idx[1], idx[0]))
+        rows, cols = idx[0][order], idx[1][order]
+        crows = np.zeros(self._shape[0] + 1, np.int64)
+        np.add.at(crows, rows + 1, 1)
+        crows = np.cumsum(crows)
+        return SparseCsrTensor(Tensor(jnp.asarray(crows)),
+                               Tensor(jnp.asarray(cols)),
+                               Tensor(vals[jnp.asarray(order)]),
+                               self._shape)
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self._shape}, "
+                f"nnz={self.nnz()})")
+
+
+class SparseCsrTensor:
+    def __init__(self, crows, cols, values, shape):
+        self.crows = crows if isinstance(crows, Tensor) else Tensor(
+            jnp.asarray(crows))
+        self.cols = cols if isinstance(cols, Tensor) else Tensor(
+            jnp.asarray(cols))
+        self.values = values if isinstance(values, Tensor) else Tensor(
+            jnp.asarray(values))
+        self._shape = list(shape)
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    def nnz(self):
+        return int(self.cols.shape[0])
+
+    def to_sparse_coo(self, sparse_dim=2) -> SparseCooTensor:
+        crows = np.asarray(self.crows._value)
+        rows = np.repeat(np.arange(self._shape[0]), np.diff(crows))
+        idx = jnp.stack([jnp.asarray(rows, jnp.int64),
+                         self.cols._value.astype(jnp.int64)])
+        return SparseCooTensor(Tensor(idx), self.values, self._shape)
+
+    def to_dense(self) -> Tensor:
+        return self.to_sparse_coo().to_dense()
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self._shape}, "
+                f"nnz={self.nnz()})")
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      place=None, stop_gradient=True):
+    indices = Tensor(jnp.asarray(
+        indices._value if isinstance(indices, Tensor) else indices,
+        jnp.int64))
+    values = values if isinstance(values, Tensor) else Tensor(
+        jnp.asarray(values))
+    if shape is None:
+        shape = [int(d) + 1 for d in np.asarray(
+            jnp.max(indices._value, axis=1))]
+        shape += list(values.shape[1:])
+    return SparseCooTensor(indices, values, shape)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
+                      stop_gradient=True):
+    return SparseCsrTensor(crows, cols, values, shape)
+
+
+def is_same_shape(x, y):
+    return list(x.shape) == list(y.shape)
+
+
+def _coo_aligned(x: SparseCooTensor, y: SparseCooTensor):
+    return (x.indices.shape == y.indices.shape and bool(
+        jnp.all(x.indices._value == y.indices._value)))
+
+
+def add(x, y):
+    if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
+        if _coo_aligned(x, y):
+            return SparseCooTensor(x.indices,
+                                   Tensor(x.values._value
+                                          + y.values._value), x.shape)
+        idx = jnp.concatenate([x.indices._value, y.indices._value], 1)
+        vals = jnp.concatenate([x.values._value, y.values._value])
+        return SparseCooTensor(Tensor(idx), Tensor(vals), x.shape)
+    raise TypeError("sparse.add expects SparseCooTensor operands")
+
+
+def multiply(x, y):
+    if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor) \
+            and _coo_aligned(x, y):
+        return SparseCooTensor(x.indices,
+                               Tensor(x.values._value * y.values._value),
+                               x.shape)
+    raise TypeError("sparse.multiply expects aligned SparseCooTensors")
+
+
+def matmul(x, y: Tensor) -> Tensor:
+    """sparse [M, K] @ dense [K, N] -> dense [M, N] via gather +
+    segment-sum (static-shape TPU path)."""
+    if isinstance(x, SparseCsrTensor):
+        x = x.to_sparse_coo()
+    if not isinstance(x, SparseCooTensor):
+        raise TypeError("sparse.matmul expects a sparse lhs")
+    rows = x.indices._value[0]
+    cols = x.indices._value[1]
+    dense = y._value if isinstance(y, Tensor) else jnp.asarray(y)
+    contrib = x.values._value[:, None] * dense[cols]      # [nnz, N]
+    out = jax.ops.segment_sum(contrib, rows,
+                              num_segments=x.shape[0])
+    return Tensor(out)
+
+
+def masked_matmul(x: Tensor, y: Tensor, mask):
+    """dense @ dense evaluated only at mask's sparsity (csr/coo)."""
+    coo = mask.to_sparse_coo() if isinstance(mask, SparseCsrTensor) \
+        else mask
+    rows = coo.indices._value[0]
+    cols = coo.indices._value[1]
+    xv = x._value
+    yv = y._value
+    vals = jnp.einsum("nk,nk->n", xv[rows], yv[:, cols].T)
+    return SparseCooTensor(coo.indices, Tensor(vals), coo.shape)
+
+
+class _SparseNNFunctional:
+    @staticmethod
+    def relu(x):
+        if isinstance(x, (SparseCooTensor,)):
+            return SparseCooTensor(x.indices,
+                                   Tensor(jnp.maximum(
+                                       x.values._value, 0)), x.shape)
+        return Tensor(jnp.maximum(x._value, 0))
+
+    @staticmethod
+    def softmax(x, axis=-1):
+        if isinstance(x, SparseCsrTensor):
+            coo = x.to_sparse_coo()
+            rows = coo.indices._value[0]
+            vals = coo.values._value
+            mx = jax.ops.segment_max(vals, rows,
+                                     num_segments=coo.shape[0])
+            e = jnp.exp(vals - mx[rows])
+            s = jax.ops.segment_sum(e, rows, num_segments=coo.shape[0])
+            return SparseCsrTensor(x.crows, x.cols,
+                                   Tensor(e / s[rows]), x.shape)
+        raise TypeError("sparse softmax expects csr")
+
+
+nn = _SparseNNFunctional()

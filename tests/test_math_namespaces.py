@@ -1,0 +1,173 @@
+"""paddle.linalg / fft / signal / distribution / sparse surfaces
+(SURVEY §2f rows) — numeric checks vs numpy/scipy conventions."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+# ------------------------------------------------------------------ linalg
+
+def test_linalg_namespace_ops():
+    rng = np.random.RandomState(0)
+    a = rng.randn(4, 4).astype(np.float32)
+    spd = a @ a.T + 4 * np.eye(4, dtype=np.float32)
+    t = paddle.to_tensor(spd)
+    chol = paddle.linalg.cholesky(t)
+    np.testing.assert_allclose(np.asarray(chol.numpy()) @
+                               np.asarray(chol.numpy()).T, spd, rtol=1e-4,
+                               atol=1e-4)
+    assert int(paddle.linalg.matrix_rank(t).numpy()) == 4
+    c = paddle.linalg.cond(t)
+    assert float(c.numpy()) > 1.0
+    lu, piv = paddle.linalg.lu(t)
+    assert lu.shape == [4, 4] and piv.shape == [4]
+    w = paddle.linalg.eigvals(t)
+    assert w.shape == [4]
+
+
+def test_linalg_lstsq():
+    rng = np.random.RandomState(1)
+    a = rng.randn(6, 3).astype(np.float32)
+    x_true = rng.randn(3, 2).astype(np.float32)
+    b = a @ x_true
+    out = paddle.linalg.lstsq(paddle.to_tensor(a), paddle.to_tensor(b))
+    np.testing.assert_allclose(out[0].numpy(), x_true, rtol=1e-3,
+                               atol=1e-3)
+
+
+# --------------------------------------------------------------------- fft
+
+def test_fft_roundtrip_and_parity():
+    rng = np.random.RandomState(2)
+    x = rng.randn(8, 16).astype(np.float32)
+    t = paddle.to_tensor(x)
+    f = paddle.fft.fft(t)
+    np.testing.assert_allclose(np.asarray(f.numpy()), np.fft.fft(x),
+                               rtol=1e-4, atol=1e-4)
+    back = paddle.fft.ifft(f)
+    np.testing.assert_allclose(np.asarray(back.numpy()).real, x,
+                               rtol=1e-4, atol=1e-4)
+    rf = paddle.fft.rfft(t)
+    np.testing.assert_allclose(np.asarray(rf.numpy()), np.fft.rfft(x),
+                               rtol=1e-4, atol=1e-4)
+    f2 = paddle.fft.fft2(t)
+    np.testing.assert_allclose(np.asarray(f2.numpy()), np.fft.fft2(x),
+                               rtol=1e-4, atol=1e-4)
+    fr = paddle.fft.fftfreq(16, d=0.5)
+    np.testing.assert_allclose(fr.numpy(), np.fft.fftfreq(16, 0.5),
+                               rtol=1e-6)
+    sh = paddle.fft.fftshift(t)
+    np.testing.assert_allclose(sh.numpy(), np.fft.fftshift(x), rtol=1e-6)
+
+
+def test_fft_grad_flows():
+    x = paddle.to_tensor(np.random.RandomState(3).randn(16).astype(
+        np.float32), stop_gradient=False)
+    y = paddle.fft.rfft(x)
+    loss = (y.abs() ** 2).sum()
+    loss.backward()
+    assert x.grad is not None
+    assert np.isfinite(x.grad.numpy()).all()
+
+
+# ------------------------------------------------------------------ signal
+
+def test_stft_istft_roundtrip():
+    rng = np.random.RandomState(4)
+    x = rng.randn(2, 512).astype(np.float32)
+    t = paddle.to_tensor(x)
+    n_fft, hop = 64, 16
+    win = paddle.to_tensor(np.hanning(n_fft).astype(np.float32))
+    spec = paddle.signal.stft(t, n_fft, hop_length=hop, window=win)
+    assert list(spec.shape) == [2, n_fft // 2 + 1,
+                                1 + 512 // hop]
+    rec = paddle.signal.istft(spec, n_fft, hop_length=hop, window=win,
+                              length=512)
+    # interior parity (edges lose energy to windowing)
+    np.testing.assert_allclose(np.asarray(rec.numpy())[:, 64:-64],
+                               x[:, 64:-64], rtol=1e-3, atol=1e-3)
+
+
+# ------------------------------------------------------------ distribution
+
+def test_normal_distribution_moments_and_kl():
+    import paddle_tpu.distribution as D
+    paddle.seed(0)
+    p = D.Normal(0.0, 1.0)
+    q = D.Normal(1.0, 2.0)
+    s = p.sample((20000,))
+    assert abs(float(s.numpy().mean())) < 0.05
+    assert abs(float(s.numpy().std()) - 1.0) < 0.05
+    lp = p.log_prob(paddle.to_tensor(0.0))
+    np.testing.assert_allclose(float(lp.numpy()),
+                               -0.5 * np.log(2 * np.pi), rtol=1e-5)
+    kl = D.kl_divergence(p, q)
+    expected = np.log(2.0) + (1 + 1) / (2 * 4) - 0.5
+    np.testing.assert_allclose(float(kl.numpy()), expected, rtol=1e-5)
+
+
+def test_categorical_bernoulli_uniform():
+    import paddle_tpu.distribution as D
+    paddle.seed(0)
+    c = D.Categorical(probs=paddle.to_tensor([0.2, 0.3, 0.5]))
+    s = c.sample((5000,))
+    freqs = np.bincount(np.asarray(s.numpy()), minlength=3) / 5000
+    np.testing.assert_allclose(freqs, [0.2, 0.3, 0.5], atol=0.05)
+    assert float(c.entropy().numpy()) > 0
+
+    b = D.Bernoulli(probs=0.3)
+    np.testing.assert_allclose(float(b.mean.numpy()), 0.3, rtol=1e-6)
+
+    u = D.Uniform(0.0, 2.0)
+    assert float(u.entropy().numpy()) == pytest.approx(np.log(2.0))
+    assert float(u.log_prob(paddle.to_tensor(1.0)).numpy()) == \
+        pytest.approx(-np.log(2.0))
+
+
+def test_gamma_beta_dirichlet_sampling():
+    import paddle_tpu.distribution as D
+    paddle.seed(0)
+    g = D.Gamma(2.0, 3.0)
+    s = g.sample((20000,))
+    np.testing.assert_allclose(float(s.numpy().mean()), 2 / 3, atol=0.05)
+    be = D.Beta(2.0, 2.0)
+    np.testing.assert_allclose(float(be.mean.numpy()), 0.5, rtol=1e-6)
+    d = D.Dirichlet(paddle.to_tensor([1.0, 2.0, 3.0]))
+    s = d.sample((1000,))
+    np.testing.assert_allclose(np.asarray(s.numpy()).sum(-1), 1.0,
+                               rtol=1e-4)
+
+
+# ------------------------------------------------------------------ sparse
+
+def test_sparse_coo_roundtrip_and_matmul():
+    import paddle_tpu.sparse as sparse
+    indices = [[0, 1, 2], [1, 2, 0]]
+    values = [1.0, 2.0, 3.0]
+    s = sparse.sparse_coo_tensor(indices, values, shape=[3, 3])
+    dense = s.to_dense()
+    expected = np.zeros((3, 3), np.float32)
+    expected[0, 1], expected[1, 2], expected[2, 0] = 1, 2, 3
+    np.testing.assert_allclose(dense.numpy(), expected)
+
+    y = paddle.to_tensor(np.eye(3, dtype=np.float32) * 2)
+    out = sparse.matmul(s, y)
+    np.testing.assert_allclose(out.numpy(), expected @ (np.eye(3) * 2))
+
+    csr = s.to_sparse_csr()
+    np.testing.assert_allclose(csr.to_dense().numpy(), expected)
+    assert csr.nnz() == 3
+
+    r = sparse.nn.relu(sparse.sparse_coo_tensor(
+        indices, [-1.0, 2.0, -3.0], shape=[3, 3]))
+    np.testing.assert_allclose(np.asarray(r.values.numpy()), [0, 2, 0])
+
+
+def test_sparse_add_aligned():
+    import paddle_tpu.sparse as sparse
+    idx = [[0, 1], [1, 0]]
+    a = sparse.sparse_coo_tensor(idx, [1.0, 2.0], shape=[2, 2])
+    b = sparse.sparse_coo_tensor(idx, [3.0, 4.0], shape=[2, 2])
+    c = sparse.add(a, b)
+    np.testing.assert_allclose(np.asarray(c.values.numpy()), [4.0, 6.0])
