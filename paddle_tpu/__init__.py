@@ -55,6 +55,7 @@ from . import fft  # noqa: E402
 from . import signal  # noqa: E402
 from . import distribution  # noqa: E402
 from . import sparse  # noqa: E402
+from . import profiler  # noqa: E402
 
 from .framework import save, load  # noqa: E402
 

@@ -30,12 +30,26 @@ def apply(op_name: str, *inputs, **attrs):
     ts = [_coerce(x) for x in inputs]
     ts = _maybe_amp_cast(op_name, ts)
     vals = tuple(t._value if t is not None else None for t in ts)
-    out_vals = eager_forward(op, vals, attrs)
+    if _profile_cb is not None:
+        with _profile_cb(op_name):
+            out_vals = eager_forward(op, vals, attrs)
+    else:
+        out_vals = eager_forward(op, vals, attrs)
     outs = tuple(Tensor(v) for v in out_vals)
     if is_grad_enabled() and any(
             t is not None and not t.stop_gradient for t in ts):
         record(op, attrs, ts, outs)
     return outs if op.multi_output else outs[0]
+
+
+# Profiler instrumentation hook (host tracer RecordEvent per op; installed
+# by paddle_tpu.profiler, the eager_gen.py:326 dispatch-event analog).
+_profile_cb = None
+
+
+def set_profile_cb(fn):
+    global _profile_cb
+    _profile_cb = fn
 
 
 # AMP interception is installed by paddle_tpu.amp (kept as a hook here to

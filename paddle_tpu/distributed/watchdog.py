@@ -1,0 +1,113 @@
+"""Comm/step watchdog (CommTaskManager analog,
+phi/core/distributed/comm_task_manager.h:37,52).
+
+The reference runs a background thread that times out stuck NCCL
+collectives and dumps comm state. Under the compiled-collective runtime
+individual collectives aren't host-visible, so the watchdog guards the
+unit that is: the training step (and any host-driven transfer). Register
+a task, heartbeat it each step; on timeout the watchdog fires its handler
+(default: dump stacks of all threads + raise in the waiting thread on the
+next check)."""
+from __future__ import annotations
+
+import sys
+import threading
+import time
+import traceback
+from typing import Callable, Dict, Optional
+
+
+class CommTask:
+    def __init__(self, name: str, timeout: float):
+        self.name = name
+        self.timeout = timeout
+        self.last_beat = time.monotonic()
+        self.timed_out = False
+
+
+def _dump_stacks() -> str:
+    lines = []
+    for tid, frame in sys._current_frames().items():
+        lines.append(f"--- thread {tid} ---")
+        lines.extend(l.rstrip() for l in traceback.format_stack(frame))
+    return "\n".join(lines)
+
+
+class CommTaskManager:
+    def __init__(self, check_interval: float = 1.0,
+                 on_timeout: Optional[Callable] = None):
+        self._tasks: Dict[str, CommTask] = {}
+        self._lock = threading.Lock()
+        self._interval = check_interval
+        self._on_timeout = on_timeout or self._default_handler
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _default_handler(self, task: CommTask):
+        sys.stderr.write(
+            f"[watchdog] task '{task.name}' exceeded {task.timeout}s "
+            f"without a heartbeat; host stacks:\n{_dump_stacks()}\n")
+
+    # ------------------------------------------------------------- tasks
+    def register(self, name: str, timeout: float = 1800.0) -> CommTask:
+        with self._lock:
+            t = CommTask(name, timeout)
+            self._tasks[name] = t
+        self._ensure_thread()
+        return t
+
+    def heartbeat(self, name: str):
+        with self._lock:
+            t = self._tasks.get(name)
+            if t is not None:
+                t.last_beat = time.monotonic()
+                if t.timed_out:
+                    t.timed_out = False  # recovered
+
+    def deregister(self, name: str):
+        with self._lock:
+            self._tasks.pop(name, None)
+
+    def timed_out(self, name: str) -> bool:
+        with self._lock:
+            t = self._tasks.get(name)
+            return bool(t and t.timed_out)
+
+    # ----------------------------------------------------------- thread
+    def _ensure_thread(self):
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(target=self._loop, daemon=True)
+            self._thread.start()
+
+    def _loop(self):
+        while not self._stop.wait(self._interval):
+            now = time.monotonic()
+            fired = []
+            with self._lock:
+                for t in self._tasks.values():
+                    if not t.timed_out and \
+                            now - t.last_beat > t.timeout:
+                        t.timed_out = True
+                        fired.append(t)
+            for t in fired:
+                try:
+                    self._on_timeout(t)
+                except Exception:
+                    pass
+
+    def shutdown(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
+_manager: Optional[CommTaskManager] = None
+
+
+def get_comm_task_manager() -> CommTaskManager:
+    global _manager
+    if _manager is None:
+        _manager = CommTaskManager()
+    return _manager

@@ -1,0 +1,232 @@
+"""paddle.profiler (python/paddle/profiler/profiler.py:358 analog).
+
+Host tracer: RecordEvent instrumentation collecting (name, tid, t0, t1)
+host events — the analog of the reference's HostTracer
+(paddle/fluid/platform/profiler/event_tracing.h). Device tracer: on TPU,
+the CUPTI role (cuda_tracer.cc) is played by jax.profiler (XLA/xplane
+traces for TensorBoard). Scheduler states and chrome-trace export mirror
+profiler.py:89 (make_scheduler) and chrometracing_logger.cc.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from enum import Enum
+from typing import Callable, List, Optional
+
+from .statistic import SortedKeys, StatisticData, summary as _summary
+
+__all__ = ["Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
+           "make_scheduler", "export_chrome_tracing", "SortedKeys",
+           "load_profiler_result"]
+
+
+class ProfilerState(Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class ProfilerTarget(Enum):
+    CPU = 0
+    GPU = 1
+    XPU = 2
+    CUSTOM_DEVICE = 3
+    TPU = 4
+
+
+_events_lock = threading.Lock()
+_events: List[dict] = []
+_recording = False
+
+
+class RecordEvent:
+    """User-scope host event (profiler/utils.py RecordEvent analog)."""
+
+    def __init__(self, name: str, event_type=None):
+        self.name = name
+        self._t0 = None
+
+    def begin(self):
+        self._t0 = time.perf_counter_ns()
+
+    def end(self):
+        if self._t0 is None or not _recording:
+            return
+        t1 = time.perf_counter_ns()
+        with _events_lock:
+            _events.append({
+                "name": self.name,
+                "tid": threading.get_ident() & 0xFFFF,
+                "ts": self._t0 / 1000.0,       # us, chrome convention
+                "dur": (t1 - self._t0) / 1000.0,
+            })
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+def make_scheduler(*, closed: int, ready: int, record: int,
+                   repeat: int = 0, skip_first: int = 0) -> Callable:
+    """profiler.py:89 state machine: skip_first -> [closed -> ready ->
+    record(last step returns)] cycling `repeat` times (0 = forever)."""
+    period = closed + ready + record
+
+    def scheduler(step: int) -> ProfilerState:
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        if repeat > 0 and s >= repeat * period:
+            return ProfilerState.CLOSED
+        pos = s % period
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == period - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return scheduler
+
+
+def _default_scheduler(step: int) -> ProfilerState:
+    return ProfilerState.RECORD
+
+
+def export_chrome_tracing(dir_name: str, worker_name: str = None):
+    """on_trace_ready factory writing chrome trace json (reference
+    chrometracing_logger.cc output shape)."""
+    os.makedirs(dir_name, exist_ok=True)
+
+    def handler(prof: "Profiler"):
+        name = worker_name or f"worker_{os.getpid()}"
+        path = os.path.join(
+            dir_name, f"{name}_step{prof.step_num}.pt.trace.json")
+        prof.export(path)
+
+    return handler
+
+
+class Profiler:
+    def __init__(self, *, targets=None, scheduler=None,
+                 on_trace_ready=None, timer_only: bool = False,
+                 record_shapes: bool = False, profile_memory: bool = False,
+                 with_flops: bool = False, emit_nvtx: bool = False):
+        self.targets = targets or [ProfilerTarget.CPU]
+        if scheduler is None:
+            self.scheduler = _default_scheduler
+        elif isinstance(scheduler, (tuple, list)):
+            lo, hi = scheduler
+            self.scheduler = make_scheduler(closed=max(lo, 0), ready=0,
+                                            record=hi - lo, repeat=1)
+        else:
+            self.scheduler = scheduler
+        self.on_trace_ready = on_trace_ready
+        self.timer_only = timer_only
+        self.step_num = 0
+        self.current_state = ProfilerState.CLOSED
+        self._device_tracing = False
+        self._tb_dir = None
+
+    # ---------------------------------------------------------- lifecycle
+    def start(self):
+        global _recording
+        with _events_lock:
+            _events.clear()
+        self.current_state = self.scheduler(self.step_num)
+        _recording = self.current_state in (
+            ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN)
+        from .._core import executor
+        executor.set_profile_cb(lambda name: RecordEvent(f"op::{name}"))
+        self._maybe_device_trace()
+        return self
+
+    def stop(self):
+        global _recording
+        _recording = False
+        from .._core import executor
+        executor.set_profile_cb(None)
+        self._stop_device_trace()
+        if self.on_trace_ready is not None:
+            self.on_trace_ready(self)
+
+    def step(self, num_samples: Optional[int] = None):
+        prev = self.current_state
+        self.step_num += 1
+        self.current_state = self.scheduler(self.step_num)
+        global _recording
+        if prev == ProfilerState.RECORD_AND_RETURN and \
+                self.on_trace_ready is not None:
+            self.on_trace_ready(self)
+        _recording = self.current_state in (
+            ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # ------------------------------------------------------- device trace
+    def _maybe_device_trace(self):
+        if self.timer_only or ProfilerTarget.TPU not in self.targets:
+            return
+        try:
+            import jax
+            self._tb_dir = os.environ.get("PADDLE_PROFILER_TB_DIR",
+                                          "/tmp/paddle_tpu_profile")
+            jax.profiler.start_trace(self._tb_dir)
+            self._device_tracing = True
+        except Exception:
+            self._device_tracing = False
+
+    def _stop_device_trace(self):
+        if self._device_tracing:
+            try:
+                import jax
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self._device_tracing = False
+
+    # ------------------------------------------------------------ exports
+    def events(self) -> List[dict]:
+        with _events_lock:
+            return list(_events)
+
+    def export(self, path: str, format: str = "json"):
+        trace = {
+            "traceEvents": [
+                {"name": e["name"], "ph": "X", "pid": os.getpid(),
+                 "tid": e["tid"], "ts": e["ts"], "dur": e["dur"],
+                 "cat": "host"}
+                for e in self.events()
+            ],
+            "displayTimeUnit": "ms",
+        }
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(trace, f)
+        return path
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms"):
+        return _summary(self.events(), sorted_by=sorted_by,
+                        time_unit=time_unit)
+
+
+def load_profiler_result(filename: str):
+    with open(filename) as f:
+        return json.load(f)

@@ -1,0 +1,104 @@
+"""Profiler (host tracer, scheduler states, chrome export, op events),
+NaN/Inf checker flag, comm watchdog (SURVEY §5 aux subsystems)."""
+import json
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import profiler as prof
+
+
+def test_record_event_and_summary(capsys):
+    p = prof.Profiler(targets=[prof.ProfilerTarget.CPU])
+    p.start()
+    with prof.RecordEvent("my_section"):
+        time.sleep(0.01)
+    with prof.RecordEvent("my_section"):
+        time.sleep(0.005)
+    p.stop()
+    events = p.events()
+    names = [e["name"] for e in events]
+    assert names.count("my_section") == 2
+    report = p.summary()
+    assert "my_section" in report
+
+
+def test_profiler_captures_op_events():
+    p = prof.Profiler(targets=[prof.ProfilerTarget.CPU])
+    p.start()
+    x = paddle.to_tensor(np.ones((8, 8), np.float32))
+    y = paddle.matmul(x, x)
+    _ = y.numpy()
+    p.stop()
+    op_names = {e["name"] for e in p.events() if
+                e["name"].startswith("op::")}
+    assert any("matmul" in n for n in op_names)
+
+
+def test_scheduler_state_machine():
+    sched = prof.make_scheduler(closed=1, ready=1, record=2, repeat=1,
+                                skip_first=1)
+    states = [sched(i) for i in range(6)]
+    S = prof.ProfilerState
+    assert states == [S.CLOSED, S.CLOSED, S.READY, S.RECORD,
+                      S.RECORD_AND_RETURN, S.CLOSED]
+
+
+def test_chrome_trace_export(tmp_path):
+    p = prof.Profiler()
+    p.start()
+    with prof.RecordEvent("traced"):
+        pass
+    p.stop()
+    path = p.export(str(tmp_path / "trace.json"))
+    with open(path) as f:
+        trace = json.load(f)
+    assert any(e["name"] == "traced" for e in trace["traceEvents"])
+    loaded = prof.load_profiler_result(path)
+    assert "traceEvents" in loaded
+
+
+def test_profiler_step_cycle_fires_on_trace_ready(tmp_path):
+    fired = []
+    p = prof.Profiler(
+        scheduler=prof.make_scheduler(closed=0, ready=0, record=2,
+                                      repeat=1),
+        on_trace_ready=lambda pr: fired.append(pr.step_num))
+    p.start()
+    for _ in range(2):
+        with prof.RecordEvent("step_work"):
+            pass
+        p.step()
+    p.stop()
+    assert fired  # RECORD_AND_RETURN boundary triggered the handler
+
+
+def test_nan_inf_checker_flag():
+    paddle.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        x = paddle.to_tensor(np.array([1.0, 0.0], np.float32))
+        with pytest.raises(FloatingPointError):
+            _ = paddle.log(paddle.to_tensor(
+                np.array([-1.0], np.float32)))
+    finally:
+        paddle.set_flags({"FLAGS_check_nan_inf": False})
+
+
+def test_comm_watchdog_times_out_and_recovers():
+    from paddle_tpu.distributed.watchdog import CommTaskManager
+    fired = []
+    mgr = CommTaskManager(check_interval=0.05,
+                          on_timeout=lambda t: fired.append(t.name))
+    mgr.register("step", timeout=0.15)
+    for _ in range(3):  # heartbeats keep it alive
+        time.sleep(0.05)
+        mgr.heartbeat("step")
+    assert not fired
+    time.sleep(0.4)  # stop heartbeating -> fires
+    assert fired == ["step"]
+    assert mgr.timed_out("step")
+    mgr.heartbeat("step")  # recovery clears the flag
+    assert not mgr.timed_out("step")
+    mgr.shutdown()
