@@ -1,0 +1,3 @@
+"""Auto-parallel (semi-auto) package: Engine + strategy (SURVEY §2e
+auto-parallel static rows; reference python/paddle/distributed/auto_parallel)."""
+from .engine import Engine, Strategy, to_static  # noqa: F401

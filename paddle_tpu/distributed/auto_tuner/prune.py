@@ -1,0 +1,37 @@
+"""Candidate pruning rules (distributed/auto_tuner/prune.py analog)."""
+from __future__ import annotations
+
+from typing import Dict, List
+
+from .cost_model import estimate_memory
+
+
+def _divisible(config: Dict) -> bool:
+    world = config.get("world_size", 1)
+    dp = config.get("dp_degree", 1)
+    mp = config.get("mp_degree", 1)
+    pp = config.get("pp_degree", 1)
+    if dp * mp * pp != world:
+        return False
+    if config.get("num_layers", 1) % pp:
+        return False
+    if config.get("num_heads", mp) % mp:
+        return False
+    if config.get("hidden_size", mp) % mp:
+        return False
+    gb = config.get("global_batch_size", 1)
+    if gb % dp:
+        return False
+    return True
+
+
+def _fits_memory(config: Dict) -> bool:
+    cap = config.get("hbm_bytes", 16e9) * 0.9
+    return estimate_memory(config) <= cap
+
+
+RULES = [_divisible, _fits_memory]
+
+
+def prune_candidates(candidates: List[Dict]) -> List[Dict]:
+    return [c for c in candidates if all(r(c) for r in RULES)]

@@ -1,0 +1,24 @@
+"""Search algorithms over parallel configs (auto_tuner/search.py analog)."""
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List
+
+from .prune import prune_candidates
+
+
+class GridSearch:
+    """Cartesian product of the tunable axes, pruned by feasibility."""
+
+    def __init__(self, space: Dict[str, List], base: Dict = None):
+        self.space = space
+        self.base = base or {}
+
+    def candidates(self) -> List[Dict]:
+        keys = list(self.space)
+        out = []
+        for combo in itertools.product(*(self.space[k] for k in keys)):
+            c = dict(self.base)
+            c.update(zip(keys, combo))
+            out.append(c)
+        return prune_candidates(out)

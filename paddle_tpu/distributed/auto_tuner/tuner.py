@@ -1,0 +1,52 @@
+"""AutoTuner (distributed/auto_tuner/tuner.py analog): search dp/mp/pp/
+micro-batch configs by cost model, optionally refined with measured trial
+runs."""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+from .cost_model import estimate_memory, estimate_step_cost
+from .search import GridSearch
+
+
+class AutoTuner:
+    def __init__(self, model_config: Dict, world_size: int,
+                 tune_space: Optional[Dict] = None,
+                 trial_fn: Optional[Callable[[Dict], float]] = None,
+                 max_trials: int = 0):
+        """trial_fn(config) -> measured seconds/step; when given, the top
+        `max_trials` cost-model candidates are measured and re-ranked."""
+        base = dict(model_config)
+        base["world_size"] = world_size
+        degrees = [d for d in (1, 2, 4, 8, 16, 32, 64)
+                   if d <= world_size]
+        self.search = GridSearch(
+            tune_space or {"dp_degree": degrees, "mp_degree": degrees,
+                           "pp_degree": degrees},
+            base=base)
+        self.trial_fn = trial_fn
+        self.max_trials = max_trials
+        self.history: List[Dict] = []
+
+    def tune(self) -> Dict:
+        ranked = []
+        for c in self.search.candidates():
+            cost = estimate_step_cost(c)
+            ranked.append((cost, c))
+        if not ranked:
+            raise RuntimeError("no feasible parallel config for this "
+                               "model/world size")
+        # deterministic tie-break: prefer less model parallelism
+        ranked.sort(key=lambda t: (t[0], t[1].get("mp_degree", 1),
+                                   t[1].get("pp_degree", 1)))
+        self.history = [
+            {"config": c, "predicted_cost": cost,
+             "predicted_memory": estimate_memory(c)}
+            for cost, c in ranked]
+        if self.trial_fn and self.max_trials > 0:
+            measured = []
+            for cost, c in ranked[:self.max_trials]:
+                measured.append((self.trial_fn(c), c))
+            measured.sort(key=lambda t: t[0])
+            return measured[0][1]
+        return ranked[0][1]

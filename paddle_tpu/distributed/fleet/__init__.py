@@ -18,6 +18,7 @@ _fleet_initialized = False
 _strategy: DistributedStrategy = None
 
 
+from . import elastic  # noqa: E402
 from . import sequence_parallel_utils  # noqa: E402
 from .sequence_parallel_utils import (  # noqa: F401
     ColumnSequenceParallelLinear, RowSequenceParallelLinear,

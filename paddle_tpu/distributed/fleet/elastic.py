@@ -1,0 +1,137 @@
+"""Elastic training manager (fleet/elastic/manager.py:125 analog).
+
+The reference registers nodes in etcd, watches for faults, and relaunches
+with re-ranked envs (PADDLE_ELASTIC_* at manager.py:128-145). Here the
+registry is the native TCPStore (csrc/tcp_store.cc) instead of etcd:
+nodes heartbeat under __elastic/node/<id>; the master scans heartbeats,
+detects joins/leaves against [min_np, max_np], and publishes a new
+membership epoch that every node adopts (re-rank + restart hook)."""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from ..store import TCPStore
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class ElasticManager:
+    def __init__(self, node_id: str, store: TCPStore,
+                 min_np: int = 1, max_np: int = -1,
+                 heartbeat_interval: float = 0.5,
+                 node_timeout: float = 2.0,
+                 on_membership_change: Optional[Callable] = None):
+        self.node_id = node_id
+        self.store = store
+        self.min_np = min_np
+        self.max_np = max_np if max_np > 0 else 10 ** 9
+        self.interval = heartbeat_interval
+        self.node_timeout = node_timeout
+        self.on_membership_change = on_membership_change
+        self.epoch = 0
+        self.members: List[str] = []
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+
+    # ----------------------------------------------------------- node side
+    def register(self):
+        """Join the registry and start heartbeating."""
+        self._beat()
+        t = threading.Thread(target=self._heartbeat_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def _beat(self):
+        self.store.set(f"__elastic/node/{self.node_id}",
+                       json.dumps({"t": time.time()}))
+
+    def _heartbeat_loop(self):
+        while not self._stop.wait(self.interval):
+            try:
+                self._beat()
+            except Exception:
+                return
+
+    def current_membership(self) -> Dict:
+        try:
+            raw = self.store.get("__elastic/membership")
+            return json.loads(raw.decode())
+        except Exception:
+            return {"epoch": 0, "members": []}
+
+    def my_rank(self) -> int:
+        m = self.current_membership()
+        try:
+            return m["members"].index(self.node_id)
+        except ValueError:
+            return -1
+
+    # --------------------------------------------------------- master side
+    def watch(self, known_nodes: List[str]):
+        """Master: scan heartbeats, publish membership epochs on change.
+        known_nodes seeds the candidate set; new nodes announce themselves
+        via the __elastic/announce counter key."""
+        self._known = set(known_nodes)
+        t = threading.Thread(target=self._watch_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def announce(self):
+        """New node: make the master aware of this node id."""
+        seq = self.store.add("__elastic/announce_count", 1)
+        self.store.set(f"__elastic/announce/{seq}", self.node_id)
+
+    def _alive(self, node: str) -> bool:
+        try:
+            raw = self.store.get(f"__elastic/node/{node}")
+            return time.time() - json.loads(raw.decode())["t"] \
+                < self.node_timeout
+        except Exception:
+            return False
+
+    def _watch_loop(self):
+        last: List[str] = []
+        announced = 0
+        while not self._stop.wait(self.interval):
+            try:
+                cnt = self.store.add("__elastic/announce_count", 0)
+                while announced < cnt:  # adopt announced node ids
+                    announced += 1
+                    nid = self.store.get(
+                        f"__elastic/announce/{announced}").decode()
+                    self._known.add(nid)
+                alive = sorted(n for n in self._known if self._alive(n))
+                if alive != last and len(alive) >= self.min_np:
+                    self.epoch += 1
+                    self.members = alive[:self.max_np]
+                    self.store.set("__elastic/membership", json.dumps(
+                        {"epoch": self.epoch, "members": self.members}))
+                    last = alive
+                    if self.on_membership_change:
+                        self.on_membership_change(self.epoch,
+                                                  self.members)
+            except Exception:
+                return
+
+    def add_known_node(self, node_id: str):
+        self._known.add(node_id)
+
+    def shutdown(self):
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=2)
+        self._threads.clear()
+
+
+def enable_elastic(args=None):
+    return os.environ.get("PADDLE_ELASTIC_SERVER") is not None

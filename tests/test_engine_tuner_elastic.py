@@ -1,0 +1,132 @@
+"""Auto-parallel Engine, auto-tuner search/prune/cost model, elastic
+manager over the native TCPStore (SURVEY §2e auto-parallel static,
+auto-tuner, elastic rows)."""
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.io import Dataset
+
+
+class _Data(Dataset):
+    def __init__(self, n=32):
+        rng = np.random.RandomState(0)
+        self.x = rng.randn(n, 8).astype(np.float32)
+        w = np.random.RandomState(42).randn(8, 4).astype(np.float32)
+        self.y = (self.x @ w).astype(np.float32)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+    def __len__(self):
+        return len(self.x)
+
+
+def test_engine_fit_evaluate_predict():
+    from paddle_tpu.distributed.auto_parallel import Engine, Strategy
+    net = nn.Linear(8, 4)
+    opt = paddle.optimizer.Adam(0.01, parameters=net.parameters())
+    strategy = Strategy({"gradient_merge": {"enable": True,
+                                            "k_steps": 2}})
+    engine = Engine(model=net, loss=nn.MSELoss(), optimizer=opt,
+                    strategy=strategy)
+    hist = engine.fit(_Data(64), batch_size=8, epochs=5)
+    first_epoch = np.mean(hist["loss"][:8])
+    last_epoch = np.mean(hist["loss"][-8:])
+    assert last_epoch < first_epoch
+    res = engine.evaluate(_Data(16), batch_size=8)
+    assert res["loss"][0] < first_epoch
+    outs = engine.predict(_Data(16), batch_size=8)
+    assert len(outs) == 2
+
+
+def test_engine_save_load(tmp_path):
+    from paddle_tpu.distributed.auto_parallel import to_static
+    net = nn.Linear(8, 4)
+    opt = paddle.optimizer.Adam(0.01, parameters=net.parameters())
+    engine = to_static(net, loss=nn.MSELoss(), optimizer=opt)
+    engine.fit(_Data(16), batch_size=8, epochs=1)
+    engine.save(str(tmp_path / "m"))
+    w0 = net.weight.numpy().copy()
+    net.weight.set_value(paddle.zeros([8, 4]))
+    engine.load(str(tmp_path / "m"))
+    np.testing.assert_allclose(net.weight.numpy(), w0)
+
+
+def test_auto_tuner_picks_feasible_config():
+    from paddle_tpu.distributed.auto_tuner import AutoTuner
+    model_cfg = dict(hidden_size=2048, num_layers=24, num_heads=16,
+                     vocab_size=50304, seq_len=1024,
+                     global_batch_size=64, hbm_bytes=16e9)
+    tuner = AutoTuner(model_cfg, world_size=8)
+    best = tuner.tune()
+    assert best["dp_degree"] * best["mp_degree"] * best["pp_degree"] == 8
+    assert tuner.history  # full ranked candidates retained
+    # every surviving candidate respects divisibility + memory
+    from paddle_tpu.distributed.auto_tuner import estimate_memory
+    for h in tuner.history:
+        assert estimate_memory(h["config"]) <= 16e9 * 0.9
+
+
+def test_auto_tuner_prunes_oversized_model():
+    from paddle_tpu.distributed.auto_tuner.prune import prune_candidates
+    # 1 chip, model too big for 16GB -> pruned out
+    cands = [dict(world_size=1, dp_degree=1, mp_degree=1, pp_degree=1,
+                  hidden_size=12288, num_layers=96, num_heads=96,
+                  vocab_size=50304, seq_len=2048, global_batch_size=1,
+                  hbm_bytes=16e9)]
+    assert prune_candidates(cands) == []
+
+
+def test_auto_tuner_trial_fn_reranks():
+    from paddle_tpu.distributed.auto_tuner import AutoTuner
+    model_cfg = dict(hidden_size=512, num_layers=8, num_heads=8,
+                     vocab_size=1024, seq_len=256, global_batch_size=16,
+                     hbm_bytes=16e9)
+    # trial function that perversely prefers max mp
+    tuner = AutoTuner(model_cfg, world_size=4,
+                      trial_fn=lambda c: 1.0 / c["mp_degree"],
+                      max_trials=8)
+    best = tuner.tune()
+    assert best["mp_degree"] == max(
+        h["config"]["mp_degree"] for h in tuner.history[:8])
+
+
+def test_elastic_membership_and_scale_events():
+    from paddle_tpu.distributed.fleet.elastic import ElasticManager
+    from paddle_tpu.distributed.store import TCPStore
+    if __import__("paddle_tpu._core.native", fromlist=["get_lib"]) \
+            .get_lib() is None:
+        pytest.skip("native lib unavailable")
+    master_store = TCPStore("127.0.0.1", 0, is_master=True, world_size=1,
+                            timeout=10)
+    changes = []
+    master = ElasticManager("node0", master_store, min_np=1,
+                            heartbeat_interval=0.05, node_timeout=0.5,
+                            on_membership_change=lambda e, m:
+                            changes.append(list(m)))
+    master.register()
+    master.watch(["node0"])
+    time.sleep(0.3)
+    assert changes and changes[-1] == ["node0"]
+
+    # a second node joins via announce
+    store1 = TCPStore("127.0.0.1", master_store.port, is_master=False,
+                      world_size=1, timeout=10)
+    node1 = ElasticManager("node1", store1, heartbeat_interval=0.05)
+    node1.register()
+    node1.announce()
+    time.sleep(0.5)
+    assert changes[-1] == ["node0", "node1"]
+    assert node1.my_rank() == 1
+
+    # node1 dies -> scale-in event
+    node1.shutdown()
+    time.sleep(1.2)
+    assert changes[-1] == ["node0"]
+    master.shutdown()
+    store1.close()
+    master_store.close()
