@@ -1,0 +1,199 @@
+"""BERT / ERNIE encoder family — functional TPU-compiled path.
+
+ERNIE-3.0-base is architecturally a BERT encoder (12L/768H/12A) with
+task-specific pretraining; the driver baseline tracks ERNIE tokens/sec/chip
+(BASELINE.md config 5). Same compiled-trainer machinery as gpt/llama:
+stacked-layer scan + remat, TP specs on mp, ZeRO-1 over dp; pretraining
+objective here is masked-LM (the throughput-relevant part)."""
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .trainer import build_adamw_train_step
+
+
+@dataclasses.dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    initializer_range: float = 0.02
+    dtype: str = "bfloat16"
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_heads
+
+
+BERT_CONFIGS = {
+    "bert-tiny": BertConfig(vocab_size=1024, hidden_size=128,
+                            num_layers=2, num_heads=2,
+                            intermediate_size=512,
+                            max_position_embeddings=128),
+    "bert-base": BertConfig(),
+    "ernie-3.0-base": BertConfig(vocab_size=40000),
+    "bert-large": BertConfig(hidden_size=1024, num_layers=24,
+                             num_heads=16, intermediate_size=4096),
+}
+
+
+def init_bert_params(config: BertConfig, seed: int = 0) -> Dict:
+    key = jax.random.PRNGKey(seed)
+    c = config
+    h, f, L = c.hidden_size, c.intermediate_size, c.num_layers
+    dt = jnp.dtype(c.dtype)
+    std = c.initializer_range
+    ks = jax.random.split(key, 8)
+
+    def norm(k, shape, scale=std):
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dt)
+
+    return {
+        "wte": norm(ks[0], (c.vocab_size, h)),
+        "wpe": norm(ks[1], (c.max_position_embeddings, h)),
+        "wtype": norm(ks[2], (c.type_vocab_size, h)),
+        "emb_ln_g": jnp.ones((h,), dt), "emb_ln_b": jnp.zeros((h,), dt),
+        "blocks": {
+            "qkv_w": norm(ks[3], (L, h, 3 * h)),
+            "qkv_b": jnp.zeros((L, 3 * h), dt),
+            "proj_w": norm(ks[4], (L, h, h),
+                           scale=std / math.sqrt(2 * L)),
+            "proj_b": jnp.zeros((L, h), dt),
+            "ln1_g": jnp.ones((L, h), dt), "ln1_b": jnp.zeros((L, h), dt),
+            "fc_w": norm(ks[5], (L, h, f)), "fc_b": jnp.zeros((L, f), dt),
+            "fo_w": norm(ks[6], (L, f, h),
+                         scale=std / math.sqrt(2 * L)),
+            "fo_b": jnp.zeros((L, h), dt),
+            "ln2_g": jnp.ones((L, h), dt), "ln2_b": jnp.zeros((L, h), dt),
+        },
+        "mlm_w": norm(ks[7], (h, h)), "mlm_b": jnp.zeros((h,), dt),
+        "mlm_ln_g": jnp.ones((h,), dt), "mlm_ln_b": jnp.zeros((h,), dt),
+    }
+
+
+def param_specs(config: BertConfig) -> Dict:
+    blocks = {
+        "qkv_w": P(None, None, "mp"), "qkv_b": P(None, "mp"),
+        "proj_w": P(None, "mp", None), "proj_b": P(None, None),
+        "ln1_g": P(None, None), "ln1_b": P(None, None),
+        "fc_w": P(None, None, "mp"), "fc_b": P(None, "mp"),
+        "fo_w": P(None, "mp", None), "fo_b": P(None, None),
+        "ln2_g": P(None, None), "ln2_b": P(None, None),
+    }
+    return {
+        "wte": P("mp", None), "wpe": P(None, None), "wtype": P(None, None),
+        "emb_ln_g": P(None), "emb_ln_b": P(None),
+        "blocks": blocks,
+        "mlm_w": P(None, None), "mlm_b": P(None),
+        "mlm_ln_g": P(None), "mlm_ln_b": P(None),
+    }
+
+
+def wd_mask(config: BertConfig) -> Dict:
+    dec = {"qkv_w", "proj_w", "fc_w", "fo_w"}
+    return {
+        "wte": True, "wpe": True, "wtype": True,
+        "emb_ln_g": False, "emb_ln_b": False,
+        "blocks": {k: (k in dec) for k in
+                   ["qkv_w", "qkv_b", "proj_w", "proj_b", "ln1_g",
+                    "ln1_b", "fc_w", "fc_b", "fo_w", "fo_b", "ln2_g",
+                    "ln2_b"]},
+        "mlm_w": True, "mlm_b": False,
+        "mlm_ln_g": False, "mlm_ln_b": False,
+    }
+
+
+def _ln(x, g, b, eps):
+    xf = x.astype(jnp.float32)
+    mu = xf.mean(-1, keepdims=True)
+    var = ((xf - mu) ** 2).mean(-1, keepdims=True)
+    return ((xf - mu) * jax.lax.rsqrt(var + eps)).astype(x.dtype) * g + b
+
+
+def _block(x, blk, config: BertConfig, attn_mask=None):
+    """Post-norm encoder block (BERT convention). x [B, S, H];
+    attn_mask [B, 1, 1, S] additive or None."""
+    c = config
+    b, s, h = x.shape
+    qkv = jnp.einsum("bsh,hk->bsk", x, blk["qkv_w"]) + blk["qkv_b"]
+    qkv = qkv.reshape(b, s, 3, c.num_heads, c.head_dim)
+    q = jnp.swapaxes(qkv[:, :, 0], 1, 2)
+    k = jnp.swapaxes(qkv[:, :, 1], 1, 2)
+    v = jnp.swapaxes(qkv[:, :, 2], 1, 2)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(c.head_dim)
+    if attn_mask is not None:
+        logits = logits + attn_mask
+    probs = jax.nn.softmax(logits.astype(jnp.float32), -1).astype(x.dtype)
+    attn = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+    attn = jnp.swapaxes(attn, 1, 2).reshape(b, s, h)
+    attn = jnp.einsum("bsh,hk->bsk", attn, blk["proj_w"]) + blk["proj_b"]
+    x = _ln(x + attn, blk["ln1_g"], blk["ln1_b"], c.layer_norm_eps)
+    y = jnp.einsum("bsh,hf->bsf", x, blk["fc_w"]) + blk["fc_b"]
+    y = jax.nn.gelu(y, approximate=True)
+    y = jnp.einsum("bsf,fh->bsh", y, blk["fo_w"]) + blk["fo_b"]
+    return _ln(x + y, blk["ln2_g"], blk["ln2_b"], c.layer_norm_eps)
+
+
+def bert_encode(params, tokens, token_type_ids=None, attention_mask=None,
+                config: BertConfig = None, remat=True):
+    b, s = tokens.shape
+    c = config
+    x = params["wte"][tokens] + params["wpe"][:s]
+    if token_type_ids is not None:
+        x = x + params["wtype"][token_type_ids]
+    else:
+        x = x + params["wtype"][0]
+    x = _ln(x.astype(jnp.dtype(c.dtype)), params["emb_ln_g"],
+            params["emb_ln_b"], c.layer_norm_eps)
+    add_mask = None
+    if attention_mask is not None:
+        add_mask = (1.0 - attention_mask[:, None, None, :].astype(
+            jnp.float32)) * -1e30
+
+    fn = functools.partial(_block, config=c, attn_mask=add_mask)
+    if remat:
+        fn = jax.checkpoint(fn)
+    x, _ = jax.lax.scan(lambda carry, blk: (fn(carry, blk), None), x,
+                        params["blocks"])
+    return x
+
+
+def bert_mlm_logits(params, tokens, config: BertConfig, remat=True,
+                    attention_mask=None):
+    x = bert_encode(params, tokens, None, attention_mask, config, remat)
+    x = jnp.einsum("bsh,hk->bsk", x, params["mlm_w"]) + params["mlm_b"]
+    x = jax.nn.gelu(x, approximate=True)
+    x = _ln(x, params["mlm_ln_g"], params["mlm_ln_b"],
+            config.layer_norm_eps)
+    return jnp.einsum("bsh,vh->bsv", x, params["wte"])
+
+
+def bert_mlm_loss(params, tokens, labels, config: BertConfig, remat=True):
+    """labels: -100 for unmasked positions (ignored), else target id."""
+    logits = bert_mlm_logits(params, tokens, config, remat)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+    safe = jnp.maximum(labels, 0)
+    picked = jnp.take_along_axis(logp, safe[..., None], -1)[..., 0]
+    mask = (labels >= 0).astype(jnp.float32)
+    return -(picked * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+
+def build_train_step(config: BertConfig, mesh: Optional[Mesh] = None,
+                     lr: float = 1e-4, remat: bool = True, **adamw):
+    loss = functools.partial(bert_mlm_loss, config=config, remat=remat)
+    return build_adamw_train_step(
+        lambda p, t, l: loss(p, t, l),
+        functools.partial(init_bert_params, config),
+        param_specs(config), wd_mask(config), mesh=mesh, lr=lr, **adamw)

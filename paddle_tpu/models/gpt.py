@@ -348,20 +348,15 @@ def build_train_step(config: GPTConfig, mesh: Optional[Mesh] = None,
     fwd + bwd (remat'd scan) + AdamW, with dp/mp/sp/ZeRO1 shardings when
     `mesh` has those axes. A 'pp' mesh axis (size>1) engages the compiled
     collective-permute pipeline (pipeline_compiled.py) over the stacked
-    layer dim. Donation keeps params/opt-state in place."""
+    layer dim. Delegates the optimizer/sharding machinery to
+    models.trainer.build_adamw_train_step."""
+    from .trainer import build_adamw_train_step
+
     pp_size = (mesh.shape.get("pp", 1) if mesh is not None else 1)
     use_pp = pp_size > 1
     if use_pp and config.num_layers % pp_size:
         raise ValueError(f"num_layers {config.num_layers} not divisible "
                          f"by pp {pp_size}")
-    specs = param_specs(config, pp="pp" if use_pp else None)
-    if mesh is not None:
-        # drop references to axes the mesh doesn't have (e.g. dp-pp mesh
-        # without tensor parallelism)
-        def _filter(sp: P):
-            return P(*(e if e in mesh.axis_names else None for e in sp))
-        specs = jax.tree_util.tree_map(
-            _filter, specs, is_leaf=lambda x: isinstance(x, P))
 
     pp_trunk = None
     if use_pp:
@@ -373,55 +368,6 @@ def build_train_step(config: GPTConfig, mesh: Optional[Mesh] = None,
             lambda x, blk: blk_fn(x, blk), mesh, n_micro, axis_name="pp",
             remat=remat)
 
-    def to_sharding(spec_tree):
-        if mesh is None:
-            return None
-        return jax.tree_util.tree_map(
-            lambda sp: NamedSharding(mesh, sp), spec_tree,
-            is_leaf=lambda x: isinstance(x, P))
-
-    # ZeRO-1 (zero1=True): fp32 master + adam moments are additionally
-    # sharded over the dp axis on the first dim that is unsharded and
-    # divisible by dp (sharding-stage-1 analog: each dp rank keeps 1/dp of
-    # optimizer state; XLA all-gathers the updated master where needed).
-    param_shapes = jax.eval_shape(lambda: init_gpt_params(config, 0))
-
-    def _opt_spec_one(sp: P, shape):
-        if not zero1 or mesh is None or "dp" not in mesh.axis_names:
-            return sp
-        dp_size = mesh.shape["dp"]
-        entries = list(sp) + [None] * (len(shape) - len(sp))
-        for i, (e, dim) in enumerate(zip(entries, shape)):
-            if e is None and dim % dp_size == 0 and dim >= dp_size:
-                entries[i] = "dp"
-                return P(*entries)
-        return sp
-
-    opt_specs = jax.tree_util.tree_map(
-        lambda sp, sh: _opt_spec_one(sp, sh.shape), specs, param_shapes,
-        is_leaf=lambda x: isinstance(x, P))
-
-    def init_fn(seed=0):
-        params = init_gpt_params(config, seed)
-        # copy=True: with fp32 params astype would alias the same buffer,
-        # which breaks donation (same buffer donated twice)
-        master = jax.tree_util.tree_map(
-            lambda p: jnp.array(p, dtype=jnp.float32, copy=True), params)
-        m = jax.tree_util.tree_map(jnp.zeros_like, master)
-        v = jax.tree_util.tree_map(jnp.zeros_like, master)
-        state = {"params": params, "master": master, "m": m, "v": v,
-                 "step": jnp.zeros((), jnp.int32)}
-        if mesh is not None:
-            sharding = {
-                "params": to_sharding(specs),
-                "master": to_sharding(opt_specs),
-                "m": to_sharding(opt_specs),
-                "v": to_sharding(opt_specs),
-                "step": NamedSharding(mesh, P()),
-            }
-            state = jax.device_put(state, sharding)
-        return state
-
     sp_sharding = None
     if seq_shard and mesh is not None and "mp" in mesh.axis_names \
             and "dp" in mesh.axis_names:
@@ -430,65 +376,21 @@ def build_train_step(config: GPTConfig, mesh: Optional[Mesh] = None,
     # decay only matrix weights + embeddings; LayerNorm gains/biases and
     # bias vectors are excluded (Megatron/reference convention)
     _DECAY_KEYS = {"wte", "wpe", "qkv_w", "proj_w", "fc_w", "fo_w"}
+    wd_mask = {
+        "wte": True, "wpe": True,
+        "blocks": {k: (k in _DECAY_KEYS)
+                   for k in ["ln1_g", "ln1_b", "qkv_w", "qkv_b",
+                             "proj_w", "proj_b", "ln2_g", "ln2_b",
+                             "fc_w", "fc_b", "fo_w", "fo_b"]},
+        "lnf_g": False, "lnf_b": False,
+    }
 
-    def _wd_mask_tree():
-        return {
-            "wte": True, "wpe": True,
-            "blocks": {k: (k in _DECAY_KEYS)
-                       for k in ["ln1_g", "ln1_b", "qkv_w", "qkv_b",
-                                 "proj_w", "proj_b", "ln2_g", "ln2_b",
-                                 "fc_w", "fc_b", "fo_w", "fo_b"]},
-            "lnf_g": False, "lnf_b": False,
-        }
+    def loss_fn(params, tokens, labels):
+        return gpt_loss(params, tokens, labels, config, mesh_axes=mesh,
+                        remat=remat, sp_sharding=sp_sharding,
+                        pp_trunk=pp_trunk)
 
-    def step_fn(state, tokens, labels):
-        loss, grads = jax.value_and_grad(gpt_loss)(
-            state["params"], tokens, labels, config, mesh_axes=mesh,
-            remat=remat, sp_sharding=sp_sharding, pp_trunk=pp_trunk)
-        step = state["step"] + 1
-        t = step.astype(jnp.float32)
-
-        def upd(p_master, g, m, v, use_wd):
-            g = g.astype(jnp.float32)
-            m2 = b1 * m + (1 - b1) * g
-            v2 = b2 * v + (1 - b2) * g * g
-            mhat = m2 / (1 - b1 ** t)
-            vhat = v2 / (1 - b2 ** t)
-            decay = wd * p_master if use_wd else 0.0
-            new_master = p_master - lr * (
-                mhat / (jnp.sqrt(vhat) + 1e-8) + decay)
-            return new_master, m2, v2
-
-        flat_master, tree = jax.tree_util.tree_flatten(state["master"])
-        flat_g = jax.tree_util.tree_leaves(grads)
-        flat_m = jax.tree_util.tree_leaves(state["m"])
-        flat_v = jax.tree_util.tree_leaves(state["v"])
-        flat_wd = jax.tree_util.tree_leaves(_wd_mask_tree())
-        outs = [upd(pm, g, m, v, w) for pm, g, m, v, w in
-                zip(flat_master, flat_g, flat_m, flat_v, flat_wd)]
-        new_master = jax.tree_util.tree_unflatten(
-            tree, [o[0] for o in outs])
-        new_m = jax.tree_util.tree_unflatten(tree, [o[1] for o in outs])
-        new_v = jax.tree_util.tree_unflatten(tree, [o[2] for o in outs])
-        new_params = jax.tree_util.tree_map(
-            lambda pm, p: pm.astype(p.dtype), new_master, state["params"])
-        return {"params": new_params, "master": new_master, "m": new_m,
-                "v": new_v, "step": step}, loss
-
-    if mesh is not None:
-        data_spec = P("dp", None)
-        state_shardings = {
-            "params": to_sharding(specs),
-            "master": to_sharding(opt_specs),
-            "m": to_sharding(opt_specs), "v": to_sharding(opt_specs),
-            "step": NamedSharding(mesh, P())}
-        jstep = jax.jit(
-            step_fn,
-            in_shardings=(state_shardings,
-                          NamedSharding(mesh, data_spec),
-                          NamedSharding(mesh, data_spec)),
-            out_shardings=(state_shardings, NamedSharding(mesh, P())),
-            donate_argnums=(0,))
-    else:
-        jstep = jax.jit(step_fn, donate_argnums=(0,))
-    return init_fn, jstep
+    return build_adamw_train_step(
+        loss_fn, functools.partial(init_gpt_params, config),
+        param_specs(config, pp="pp" if use_pp else None), wd_mask,
+        mesh=mesh, lr=lr, wd=wd, b1=b1, b2=b2, zero1=zero1)

@@ -13,6 +13,8 @@ from .layers_activation import (  # noqa: F401
     Softplus, Softsign, Tanhshrink, ThresholdedReLU, LogSoftmax, GLU,
     Softmax, PReLU, CrossEntropyLoss, MSELoss, L1Loss, NLLLoss, BCELoss,
     BCEWithLogitsLoss, SmoothL1Loss, KLDivLoss, MarginRankingLoss)
+from .rnn import (RNN, BiRNN, GRU, GRUCell, LSTM, LSTMCell,  # noqa: F401
+                  RNNCellBase, SimpleRNN, SimpleRNNCell)
 from .transformer import (MultiHeadAttention, TransformerEncoderLayer,  # noqa: F401
                           TransformerEncoder, TransformerDecoderLayer,
                           TransformerDecoder, Transformer)

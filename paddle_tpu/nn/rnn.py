@@ -1,0 +1,287 @@
+"""Recurrent layers (python/paddle/nn/layer/rnn.py analog): cells
+(SimpleRNNCell/LSTMCell/GRUCell), single-direction RNN and BiRNN drivers,
+and the stacked SimpleRNN/LSTM/GRU user layers.
+
+TPU note: the time loop runs as a Python loop of compiled ops eagerly;
+under paddle_tpu.jit.to_static the whole unrolled (or scanned) sequence
+becomes one XLA program. Gate matmuls are fused per step ([i,f,g,o] in one
+[H, 4H] product) so each step is MXU-shaped.
+"""
+from __future__ import annotations
+
+import math
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from .._core.tensor import Tensor
+from . import functional as F
+from . import initializer as I
+from .layer import Layer, create_parameter
+
+
+def _uniform_init(fan):
+    k = 1.0 / math.sqrt(fan) if fan > 0 else 0.0
+    return I.Uniform(-k, k)
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype="float32",
+                           init_value=0.0, batch_dim_idx=0):
+        import paddle_tpu as paddle
+        batch = batch_ref.shape[batch_dim_idx]
+        return paddle.full([batch, self.hidden_size], init_value, dtype)
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+        init = _uniform_init(hidden_size)
+        self.weight_ih = create_parameter([hidden_size, input_size],
+                                          attr=weight_ih_attr,
+                                          default_initializer=init)
+        self.weight_hh = create_parameter([hidden_size, hidden_size],
+                                          attr=weight_hh_attr,
+                                          default_initializer=init)
+        self.bias_ih = create_parameter([hidden_size], attr=bias_ih_attr,
+                                        is_bias=True,
+                                        default_initializer=init)
+        self.bias_hh = create_parameter([hidden_size], attr=bias_hh_attr,
+                                        is_bias=True,
+                                        default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        import paddle_tpu as paddle
+        if states is None:
+            states = self.get_initial_states(inputs)
+        pre_h = states
+        z = paddle.matmul(inputs, self.weight_ih, transpose_y=True) \
+            + self.bias_ih \
+            + paddle.matmul(pre_h, self.weight_hh, transpose_y=True) \
+            + self.bias_hh
+        act = paddle.tanh if self.activation == "tanh" else F.relu
+        h = act(z)
+        return h, h
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 proj_size=0, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        init = _uniform_init(hidden_size)
+        self.weight_ih = create_parameter([4 * hidden_size, input_size],
+                                          attr=weight_ih_attr,
+                                          default_initializer=init)
+        self.weight_hh = create_parameter([4 * hidden_size, hidden_size],
+                                          attr=weight_hh_attr,
+                                          default_initializer=init)
+        self.bias_ih = create_parameter([4 * hidden_size],
+                                        attr=bias_ih_attr, is_bias=True,
+                                        default_initializer=init)
+        self.bias_hh = create_parameter([4 * hidden_size],
+                                        attr=bias_hh_attr, is_bias=True,
+                                        default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        import paddle_tpu as paddle
+        if states is None:
+            h = self.get_initial_states(inputs)
+            c = self.get_initial_states(inputs)
+        else:
+            h, c = states
+        gates = paddle.matmul(inputs, self.weight_ih, transpose_y=True) \
+            + self.bias_ih \
+            + paddle.matmul(h, self.weight_hh, transpose_y=True) \
+            + self.bias_hh
+        i, f, g, o = paddle.split(gates, 4, axis=-1)
+        i = F.sigmoid(i)
+        f = F.sigmoid(f)
+        g = paddle.tanh(g)
+        o = F.sigmoid(o)
+        c_new = f * c + i * g
+        h_new = o * paddle.tanh(c_new)
+        return h_new, (h_new, c_new)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        init = _uniform_init(hidden_size)
+        self.weight_ih = create_parameter([3 * hidden_size, input_size],
+                                          attr=weight_ih_attr,
+                                          default_initializer=init)
+        self.weight_hh = create_parameter([3 * hidden_size, hidden_size],
+                                          attr=weight_hh_attr,
+                                          default_initializer=init)
+        self.bias_ih = create_parameter([3 * hidden_size],
+                                        attr=bias_ih_attr, is_bias=True,
+                                        default_initializer=init)
+        self.bias_hh = create_parameter([3 * hidden_size],
+                                        attr=bias_hh_attr, is_bias=True,
+                                        default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        import paddle_tpu as paddle
+        if states is None:
+            states = self.get_initial_states(inputs)
+        pre_h = states
+        x_gates = paddle.matmul(inputs, self.weight_ih,
+                                transpose_y=True) + self.bias_ih
+        h_gates = paddle.matmul(pre_h, self.weight_hh,
+                                transpose_y=True) + self.bias_hh
+        xr, xz, xc = paddle.split(x_gates, 3, axis=-1)
+        hr, hz, hc = paddle.split(h_gates, 3, axis=-1)
+        r = F.sigmoid(xr + hr)
+        z = F.sigmoid(xz + hz)
+        c = paddle.tanh(xc + r * hc)
+        h = (1.0 - z) * c + z * pre_h   # paddle gate convention
+        return h, h
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+class RNN(Layer):
+    """Run a cell over the time dim (rnn.py RNN wrapper)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        import paddle_tpu as paddle
+        x = inputs if self.time_major else paddle.transpose(
+            inputs, [1, 0, 2])
+        steps = x.shape[0]
+        order = range(steps - 1, -1, -1) if self.is_reverse \
+            else range(steps)
+        states = initial_states
+        outs: List[Optional[Tensor]] = [None] * steps
+        for t in order:
+            out, states = self.cell(x[t], states)
+            outs[t] = out
+        y = paddle.stack(outs, axis=0)
+        if not self.time_major:
+            y = paddle.transpose(y, [1, 0, 2])
+        return y, states
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        import paddle_tpu as paddle
+        st_fw, st_bw = (initial_states if initial_states is not None
+                        else (None, None))
+        y_fw, s_fw = self.rnn_fw(inputs, st_fw)
+        y_bw, s_bw = self.rnn_bw(inputs, st_bw)
+        return paddle.concat([y_fw, y_bw], axis=-1), (s_fw, s_bw)
+
+
+class _RNNBase(Layer):
+    _CELL = None
+    _STATE_PAIR = False
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation=None, weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        if direction in ("bidirect", "bidirectional"):
+            self.num_directions = 2
+        elif direction == "forward":
+            self.num_directions = 1
+        else:
+            raise ValueError(f"direction must be forward/bidirect, got "
+                             f"{direction}")
+        self.direction = direction
+
+        kw = dict(weight_ih_attr=weight_ih_attr,
+                  weight_hh_attr=weight_hh_attr, bias_ih_attr=bias_ih_attr,
+                  bias_hh_attr=bias_hh_attr)
+        if activation is not None:
+            kw["activation"] = activation
+        layers = []
+        for ln in range(num_layers):
+            in_sz = input_size if ln == 0 else \
+                hidden_size * self.num_directions
+            if self.num_directions == 2:
+                layers.append(BiRNN(self._CELL(in_sz, hidden_size, **kw),
+                                    self._CELL(in_sz, hidden_size, **kw),
+                                    time_major=time_major))
+            else:
+                layers.append(RNN(self._CELL(in_sz, hidden_size, **kw),
+                                  time_major=time_major))
+        from .layers_common import LayerList
+        self._layers = LayerList(layers)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        import paddle_tpu as paddle
+        x = inputs
+        finals = []
+        for ln, rnn_l in enumerate(self._layers):
+            x, st = rnn_l(x, None)
+            finals.append(st)
+            if self.dropout > 0 and ln < self.num_layers - 1:
+                x = F.dropout(x, self.dropout, training=self.training)
+        # pack final states [num_layers*num_directions, B, H]
+        if self._STATE_PAIR:
+            hs, cs = [], []
+            for st in finals:
+                pairs = st if self.num_directions == 2 else (st,)
+                for h, c in pairs:
+                    hs.append(h)
+                    cs.append(c)
+            state = (paddle.stack(hs, 0), paddle.stack(cs, 0))
+        else:
+            hs = []
+            for st in finals:
+                items = st if self.num_directions == 2 else (st,)
+                for h in items:
+                    hs.append(h)
+            state = paddle.stack(hs, 0)
+        return x, state
+
+
+class SimpleRNN(_RNNBase):
+    _CELL = SimpleRNNCell
+
+
+class LSTM(_RNNBase):
+    _CELL = LSTMCell
+    _STATE_PAIR = True
+
+
+class GRU(_RNNBase):
+    _CELL = GRUCell

@@ -1,0 +1,96 @@
+"""RNN layer zoo (nn/layer/rnn.py analog): cell math vs numpy reference,
+driver shapes, bidirectional, multi-layer, gradients."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def _sig(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def test_lstm_cell_matches_numpy():
+    paddle.seed(0)
+    cell = nn.LSTMCell(4, 8)
+    x = np.random.RandomState(0).randn(2, 4).astype(np.float32)
+    h0 = np.zeros((2, 8), np.float32)
+    c0 = np.zeros((2, 8), np.float32)
+    out, (h, c) = cell(paddle.to_tensor(x),
+                       (paddle.to_tensor(h0), paddle.to_tensor(c0)))
+
+    wih = cell.weight_ih.numpy()
+    whh = cell.weight_hh.numpy()
+    bih = cell.bias_ih.numpy()
+    bhh = cell.bias_hh.numpy()
+    gates = x @ wih.T + bih + h0 @ whh.T + bhh
+    i, f, g, o = np.split(gates, 4, axis=-1)
+    c_ref = _sig(f) * c0 + _sig(i) * np.tanh(g)
+    h_ref = _sig(o) * np.tanh(c_ref)
+    np.testing.assert_allclose(h.numpy(), h_ref, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(c.numpy(), c_ref, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(out.numpy(), h_ref, rtol=1e-5, atol=1e-5)
+
+
+def test_gru_cell_matches_numpy():
+    paddle.seed(0)
+    cell = nn.GRUCell(4, 6)
+    x = np.random.RandomState(1).randn(3, 4).astype(np.float32)
+    h0 = np.random.RandomState(2).randn(3, 6).astype(np.float32)
+    out, h = cell(paddle.to_tensor(x), paddle.to_tensor(h0))
+
+    xg = x @ cell.weight_ih.numpy().T + cell.bias_ih.numpy()
+    hg = h0 @ cell.weight_hh.numpy().T + cell.bias_hh.numpy()
+    xr, xz, xc = np.split(xg, 3, -1)
+    hr, hz, hc = np.split(hg, 3, -1)
+    r, z = _sig(xr + hr), _sig(xz + hz)
+    c = np.tanh(xc + r * hc)
+    h_ref = (1 - z) * c + z * h0
+    np.testing.assert_allclose(h.numpy(), h_ref, rtol=1e-5, atol=1e-5)
+
+
+def test_lstm_layer_shapes_and_grad():
+    paddle.seed(0)
+    lstm = nn.LSTM(8, 16, num_layers=2)
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(4, 5, 8).astype(np.float32),
+        stop_gradient=False)
+    y, (h, c) = lstm(x)
+    assert tuple(y.shape) == (4, 5, 16)
+    assert tuple(h.shape) == (2, 4, 16)
+    assert tuple(c.shape) == (2, 4, 16)
+    y.sum().backward()
+    assert lstm._layers[0].cell.weight_ih.grad is not None
+    assert x.grad is not None
+
+
+def test_bidirectional_gru_shapes():
+    paddle.seed(0)
+    gru = nn.GRU(8, 16, direction="bidirect")
+    x = paddle.to_tensor(np.ones((2, 7, 8), np.float32))
+    y, h = gru(x)
+    assert tuple(y.shape) == (2, 7, 32)
+    assert tuple(h.shape) == (2, 2, 16)
+
+
+def test_simple_rnn_reverse_consistency():
+    """Reversed input through a reverse RNN == forward RNN reversed."""
+    paddle.seed(0)
+    cell = nn.SimpleRNNCell(4, 8)
+    fw = nn.RNN(cell)
+    bw = nn.RNN(cell, is_reverse=True)
+    x = np.random.RandomState(3).randn(2, 5, 4).astype(np.float32)
+    y_fw, _ = fw(paddle.to_tensor(x[:, ::-1].copy()))
+    y_bw, _ = bw(paddle.to_tensor(x))
+    np.testing.assert_allclose(np.asarray(y_bw.numpy())[:, ::-1],
+                               y_fw.numpy(), rtol=1e-5, atol=1e-5)
+
+
+def test_time_major_lstm():
+    paddle.seed(0)
+    lstm = nn.LSTM(4, 8, time_major=True)
+    x = paddle.to_tensor(np.ones((5, 2, 4), np.float32))  # [T, B, D]
+    y, (h, c) = lstm(x)
+    assert tuple(y.shape) == (5, 2, 8)
+    assert tuple(h.shape) == (1, 2, 8)
