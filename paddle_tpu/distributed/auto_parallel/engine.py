@@ -69,11 +69,14 @@ class Engine:
         if get_mesh() is None:
             # degenerate single-chip mesh keeps the flow uniform
             set_mesh(ProcessMesh(np.array([0]), ["dp"]))
-        if self._strategy.recompute.enable and self._model is not None:
-            from ..fleet.recompute import recompute_sequential
-            self._model._engine_recompute = True
         self._prepared = True
         return self
+
+    def _forward(self, *inputs):
+        if self._strategy.recompute.enable:
+            from ..fleet.recompute import recompute
+            return recompute(self._model, *inputs)
+        return self._model(*inputs)
 
     def _loader(self, data, batch_size):
         if isinstance(data, DataLoader) or data is None:
@@ -102,14 +105,14 @@ class Engine:
         loader = self._loader(train_data, batch_size)
         k_steps = max(self._strategy.gradient_merge.k_steps, 1) if \
             self._strategy.gradient_merge.enable else 1
-        history = {"loss": []}
-        step = 0
+        history = {"loss": [], "eval_loss": []}
+        total_step = 0
         for epoch in range(epochs):
             accum = 0
-            for batch in loader:
+            for epoch_step, batch in enumerate(loader):
                 inputs, labels = batch[:-1], batch[-1]
                 with self._amp_ctx():
-                    out = self._model(*inputs)
+                    out = self._forward(*inputs)
                     loss = self._loss(out, labels)
                 (loss / k_steps).backward()
                 accum += 1
@@ -117,12 +120,23 @@ class Engine:
                     self._optimizer.step()
                     self._optimizer.clear_grad()
                 history["loss"].append(float(loss.numpy()))
-                step += 1
-                if verbose and step % log_freq == 0:
+                total_step += 1
+                if verbose and total_step % log_freq == 0:
                     print(f"[AutoParallel Engine] epoch {epoch} step "
-                          f"{step} loss {history['loss'][-1]:.4f}")
-                if steps_per_epoch and step >= steps_per_epoch:
+                          f"{total_step} loss "
+                          f"{history['loss'][-1]:.4f}")
+                if steps_per_epoch and epoch_step + 1 >= steps_per_epoch:
                     break
+            if accum % k_steps:
+                # flush tail micro-batches so partial merges don't bleed
+                # into the next epoch's first merge group
+                self._optimizer.step()
+                self._optimizer.clear_grad()
+            if valid_data is not None and (epoch + 1) % max(valid_freq,
+                                                           1) == 0:
+                res = self.evaluate(valid_data, batch_size=batch_size,
+                                    steps=valid_steps, verbose=verbose)
+                history["eval_loss"].append(res["loss"][0])
         self.history = history
         return history
 

@@ -56,11 +56,18 @@ class ElasticManager:
                        json.dumps({"t": time.time()}))
 
     def _heartbeat_loop(self):
+        failures = 0
         while not self._stop.wait(self.interval):
             try:
                 self._beat()
+                failures = 0
             except Exception:
-                return
+                # transient store errors must not kill the heartbeat (a
+                # dead heartbeat thread gets the node falsely evicted);
+                # give up only after sustained failure
+                failures += 1
+                if failures > 20:
+                    return
 
     def current_membership(self) -> Dict:
         try:
@@ -102,6 +109,7 @@ class ElasticManager:
     def _watch_loop(self):
         last: List[str] = []
         announced = 0
+        failures = 0
         while not self._stop.wait(self.interval):
             try:
                 cnt = self.store.add("__elastic/announce_count", 0)
@@ -120,8 +128,13 @@ class ElasticManager:
                     if self.on_membership_change:
                         self.on_membership_change(self.epoch,
                                                   self.members)
+                failures = 0
             except Exception:
-                return
+                # keep watching through transient store errors; a dead
+                # watcher silently freezes membership for the whole job
+                failures += 1
+                if failures > 20:
+                    return
 
     def add_known_node(self, node_id: str):
         self._known.add(node_id)

@@ -62,7 +62,7 @@ def init_llama_params(config: LlamaConfig, seed: int = 0) -> Dict:
     kvh = c.kv_heads * c.head_dim
     dt = jnp.dtype(c.dtype)
     std = c.initializer_range
-    ks = jax.random.split(key, 8)
+    ks = jax.random.split(key, 9)
 
     def norm(k, shape, scale=std):
         return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dt)
@@ -84,7 +84,7 @@ def init_llama_params(config: LlamaConfig, seed: int = 0) -> Dict:
         "lnf_g": jnp.ones((h,), dt),
     }
     if not c.tie_embeddings:
-        params["lm_head"] = norm(ks[0], (c.vocab_size, h))
+        params["lm_head"] = norm(ks[8], (c.vocab_size, h))
     return params
 
 

@@ -246,12 +246,34 @@ class _RNNBase(Layer):
         from .layers_common import LayerList
         self._layers = LayerList(layers)
 
+    def _layer_initial_states(self, initial_states, ln):
+        """Slice the packed [num_layers*num_directions, B, H] states down
+        to layer ln's per-cell states (paddle packing convention)."""
+        if initial_states is None:
+            return None
+        nd = self.num_directions
+
+        def pick(t, idx):
+            return t[idx]
+
+        if self._STATE_PAIR:
+            h, c = initial_states
+            if nd == 2:
+                return ((pick(h, 2 * ln), pick(c, 2 * ln)),
+                        (pick(h, 2 * ln + 1), pick(c, 2 * ln + 1)))
+            return (pick(h, ln), pick(c, ln))
+        h = initial_states
+        if nd == 2:
+            return (pick(h, 2 * ln), pick(h, 2 * ln + 1))
+        return pick(h, ln)
+
     def forward(self, inputs, initial_states=None, sequence_length=None):
         import paddle_tpu as paddle
         x = inputs
         finals = []
         for ln, rnn_l in enumerate(self._layers):
-            x, st = rnn_l(x, None)
+            x, st = rnn_l(x, self._layer_initial_states(initial_states,
+                                                        ln))
             finals.append(st)
             if self.dropout > 0 and ln < self.num_layers - 1:
                 x = F.dropout(x, self.dropout, training=self.training)

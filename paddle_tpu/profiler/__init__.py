@@ -167,8 +167,14 @@ class Profiler:
         if prev == ProfilerState.RECORD_AND_RETURN and \
                 self.on_trace_ready is not None:
             self.on_trace_ready(self)
+        was_recording = _recording
         _recording = self.current_state in (
             ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN)
+        if _recording and not was_recording:
+            # new record cycle: drop the previous cycle's events so each
+            # exported trace covers exactly one cycle
+            with _events_lock:
+                _events.clear()
 
     def __enter__(self):
         return self.start()

@@ -130,3 +130,14 @@ def test_elastic_membership_and_scale_events():
     master.shutdown()
     store1.close()
     master_store.close()
+
+
+def test_engine_steps_per_epoch_and_validation():
+    from paddle_tpu.distributed.auto_parallel import Engine
+    net = nn.Linear(8, 4)
+    opt = paddle.optimizer.Adam(0.01, parameters=net.parameters())
+    engine = Engine(model=net, loss=nn.MSELoss(), optimizer=opt)
+    hist = engine.fit(_Data(64), valid_data=_Data(16), batch_size=8,
+                      epochs=3, steps_per_epoch=2, valid_freq=1)
+    assert len(hist["loss"]) == 6        # 2 steps x 3 epochs
+    assert len(hist["eval_loss"]) == 3   # validated each epoch

@@ -122,3 +122,12 @@ def test_ernie_config_registered():
     from paddle_tpu.models.bert import BERT_CONFIGS
     c = BERT_CONFIGS["ernie-3.0-base"]
     assert c.hidden_size == 768 and c.num_layers == 12
+
+
+def test_llama_untied_head_differs_from_embedding():
+    from paddle_tpu.models.llama import LlamaConfig, init_llama_params
+    c = LlamaConfig(vocab_size=64, hidden_size=32, intermediate_size=64,
+                    num_layers=1, num_heads=2, tie_embeddings=False,
+                    dtype="float32")
+    p = init_llama_params(c, 0)
+    assert not np.allclose(np.asarray(p["lm_head"]), np.asarray(p["wte"]))

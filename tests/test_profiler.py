@@ -102,3 +102,20 @@ def test_comm_watchdog_times_out_and_recovers():
     mgr.heartbeat("step")  # recovery clears the flag
     assert not mgr.timed_out("step")
     mgr.shutdown()
+
+
+def test_profiler_cycles_do_not_accumulate_events():
+    p = prof.Profiler(scheduler=prof.make_scheduler(
+        closed=1, ready=0, record=1, repeat=3))
+    p.start()
+    counts = []
+    for i in range(6):
+        with prof.RecordEvent("work"):
+            pass
+        if p.current_state == prof.ProfilerState.RECORD_AND_RETURN:
+            counts.append(len([e for e in p.events()
+                               if e["name"] == "work"]))
+        p.step()
+    p.stop()
+    # each record cycle saw exactly its own single event
+    assert counts and all(c == 1 for c in counts)

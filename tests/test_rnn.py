@@ -94,3 +94,18 @@ def test_time_major_lstm():
     y, (h, c) = lstm(x)
     assert tuple(y.shape) == (5, 2, 8)
     assert tuple(h.shape) == (1, 2, 8)
+
+
+def test_lstm_respects_initial_states():
+    paddle.seed(0)
+    lstm = nn.LSTM(4, 8, num_layers=2)
+    x = paddle.to_tensor(np.ones((2, 3, 4), np.float32))
+    h0 = paddle.to_tensor(np.full((2, 2, 8), 0.5, np.float32))
+    c0 = paddle.to_tensor(np.full((2, 2, 8), 0.5, np.float32))
+    y0, _ = lstm(x)
+    y1, _ = lstm(x, (h0, c0))
+    assert not np.allclose(y0.numpy(), y1.numpy())
+    # zero initial states == default
+    z = paddle.to_tensor(np.zeros((2, 2, 8), np.float32))
+    y2, _ = lstm(x, (z, z))
+    np.testing.assert_allclose(y0.numpy(), y2.numpy(), rtol=1e-6)
