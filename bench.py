@@ -42,14 +42,15 @@ def main():
     labels = jnp.asarray(rng.randint(0, config.vocab_size, (batch, seq)),
                          jnp.int32)
 
-    # warmup/compile
+    # warmup/compile (float() is a hard sync: block_until_ready alone
+    # does not reliably block through the axon remote-TPU tunnel)
     state, loss = step(state, tokens, labels)
-    jax.block_until_ready(loss)
+    float(loss)
 
     t0 = time.time()
     for _ in range(steps):
         state, loss = step(state, tokens, labels)
-    jax.block_until_ready(loss)
+    float(loss)
     dt = (time.time() - t0) / steps
 
     tokens_per_sec = batch * seq / dt

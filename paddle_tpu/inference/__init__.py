@@ -1,0 +1,118 @@
+"""paddle.inference (paddle/fluid/inference analog: AnalysisPredictor,
+analysis_predictor.h:101).
+
+TPU-native deployment: a predictor wraps a jit-saved model
+(paddle_tpu.jit.save format), compiles the forward once per input
+signature under jax.jit (the analog of the reference's IR optimization +
+engine selection), and serves zero-copy in/out handles."""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .._core.tensor import Tensor
+
+
+class Config:
+    """inference.Config analog (api/paddle_analysis_config.h surface)."""
+
+    def __init__(self, prog_file: Optional[str] = None,
+                 params_file: Optional[str] = None):
+        # jit.save writes one artifact; prog_file is the path prefix
+        self.model_path = prog_file
+        self._use_tpu = True
+        self._memory_pool_mb = 0
+        self._enable_profile = False
+        self._ir_optim = True
+
+    def set_model(self, prog_file, params_file=None):
+        self.model_path = prog_file
+
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        self._memory_pool_mb = memory_pool_init_size_mb  # TPU: no-op
+
+    def disable_gpu(self):
+        self._use_tpu = False
+
+    def switch_ir_optim(self, flag=True):
+        self._ir_optim = flag  # XLA always optimizes; kept for parity
+
+    def enable_profile(self):
+        self._enable_profile = True
+
+    def enable_memory_optim(self):
+        pass
+
+
+class _IOHandle:
+    """Zero-copy tensor handle (ZeroCopyTensor analog)."""
+
+    def __init__(self):
+        self._value: Optional[np.ndarray] = None
+
+    def copy_from_cpu(self, arr: np.ndarray):
+        self._value = np.asarray(arr)
+
+    def reshape(self, shape):
+        if self._value is None:
+            self._value = np.zeros(shape, np.float32)
+        else:
+            self._value = self._value.reshape(shape)
+
+    def copy_to_cpu(self) -> np.ndarray:
+        return self._value
+
+    def shape(self):
+        return list(self._value.shape) if self._value is not None else []
+
+
+class Predictor:
+    def __init__(self, config: Config):
+        from ..jit.api import load as jit_load
+        self.config = config
+        self._layer = jit_load(config.model_path)
+        self._inputs: Dict[str, _IOHandle] = {"x": _IOHandle()}
+        self._outputs: Dict[str, _IOHandle] = {"out": _IOHandle()}
+
+    def get_input_names(self) -> List[str]:
+        return list(self._inputs)
+
+    def get_output_names(self) -> List[str]:
+        return list(self._outputs)
+
+    def get_input_handle(self, name: str) -> _IOHandle:
+        return self._inputs[name]
+
+    def get_output_handle(self, name: str) -> _IOHandle:
+        return self._outputs[name]
+
+    def run(self, inputs: Optional[List[np.ndarray]] = None):
+        """Execute; with `inputs` given returns outputs directly (new-style
+        predictor.run(list) API), else uses the bound handles."""
+        if inputs is not None:
+            for h, a in zip(self._inputs.values(), inputs):
+                h.copy_from_cpu(np.asarray(a))
+        args = [Tensor(h.copy_to_cpu()) for h in self._inputs.values()]
+        out = self._layer(*args)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        for h, o in zip(self._outputs.values(), outs):
+            h.copy_from_cpu(np.asarray(o.numpy()))
+        return [h.copy_to_cpu() for h in self._outputs.values()]
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)
+
+
+class PredictorPool:
+    def __init__(self, config: Config, size: int = 1):
+        self._predictors = [Predictor(config) for _ in range(size)]
+
+    def retrieve(self, idx: int) -> Predictor:
+        return self._predictors[idx]
+
+
+def get_version() -> str:
+    from .. import __version__
+    return __version__

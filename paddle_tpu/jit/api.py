@@ -226,22 +226,91 @@ class TranslatedLayer(Layer):
 
 
 def save(layer, path, input_spec=None, **configs):
-    """paddle.jit.save analog: persist params + module config. Round-1
-    format: pickled numpy state dict + class info (StableHLO export TBD)."""
+    """paddle.jit.save analog (jit/api.py save): persist params
+    (.pdiparams) + the traced program as serialized StableHLO via
+    jax.export (.pdmodel) — the TPU-native form of the reference's saved
+    inference program (fluid/jit/layer.h + serialized ProgramDesc).
+
+    input_spec: list of InputSpec (shape/dtype) or example Tensors; when
+    omitted, the layer must have been called at least once is NOT assumed
+    — specs are required."""
+    import jax
+    import jax.numpy as jnp
+    from jax import export as jax_export
+
+    from ..nn.layer import functional_call
+
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
-    state = {k: np.asarray(v._value)
-             for k, v in layer.state_dict().items()}
+
+    state = layer.state_dict()
+    names = list(state.keys())
+    np_state = {k: np.asarray(v._value) for k, v in state.items()}
     with open(path + ".pdiparams", "wb") as f:
-        pickle.dump(state, f)
+        pickle.dump(np_state, f)
+
+    if input_spec is None:
+        raise ValueError("jit.save needs input_spec (shapes/dtypes or "
+                         "example tensors) to trace the program")
+    examples = []
+    scope = jax_export.SymbolicScope()
+    sym_count = 0
+    for spec in input_spec:
+        if isinstance(spec, InputSpec):
+            shape = []
+            for s in spec.shape:
+                if s is None:  # dynamic dim -> symbolic (polymorphic)
+                    shape.append(jax_export.symbolic_shape(
+                        f"_d{sym_count}", scope=scope)[0])
+                    sym_count += 1
+                else:
+                    shape.append(s)
+            examples.append(jax.ShapeDtypeStruct(tuple(shape),
+                                                 jnp.dtype(spec.dtype)))
+        elif isinstance(spec, Tensor):
+            examples.append(spec._value)
+        else:
+            examples.append(jnp.asarray(spec))
+
+    fwd = layer.forward
+    if isinstance(fwd, StaticFunction):
+        fwd = fwd._fn
+
+    def pure(svals, *arrays):
+        st = dict(zip(names, [Tensor(v) for v in svals]))
+        targs = tuple(Tensor(a) for a in arrays)
+        orig = layer.forward
+        layer.forward = fwd
+        try:
+            out = functional_call(layer, st, *targs)
+        finally:
+            layer.forward = orig
+        return _unwrap_tree(out)
+
+    svals = [jnp.asarray(v) for v in np_state.values()]
+    exported = jax_export.export(jax.jit(pure))(svals, *examples)
     with open(path + ".pdmodel", "wb") as f:
-        pickle.dump({"class": type(layer).__name__}, f)
+        f.write(exported.serialize())
 
 
 def load(path, **configs):
+    """paddle.jit.load analog: deserialize the StableHLO program + params
+    into a TranslatedLayer (no Python class needed)."""
+    from jax import export as jax_export
+
     with open(path + ".pdiparams", "rb") as f:
-        pickle.load(f)
-    raise NotImplementedError(
-        "jit.load requires the model class; use paddle_tpu.load for state "
-        "dicts (program deserialization lands with the IR layer)")
+        np_state = pickle.load(f)
+    with open(path + ".pdmodel", "rb") as f:
+        exported = jax_export.deserialize(f.read())
+
+    import jax.numpy as jnp
+    svals = [jnp.asarray(v) for v in np_state.values()]
+
+    def forward_fn(*args):
+        arrays = [a._value if isinstance(a, Tensor) else jnp.asarray(a)
+                  for a in args]
+        out = exported.call(svals, *arrays)
+        return _wrap_tree(out)
+
+    return TranslatedLayer(np_state, forward_fn)

