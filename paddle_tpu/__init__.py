@@ -59,6 +59,7 @@ from . import profiler  # noqa: E402
 from . import quantization  # noqa: E402
 from . import inference  # noqa: E402
 from . import onnx  # noqa: E402
+from . import audio  # noqa: E402
 
 from .framework import save, load  # noqa: E402
 

@@ -1,0 +1,7 @@
+"""paddle.audio (python/paddle/audio analog): feature extraction built on
+paddle_tpu.signal.stft — Spectrogram, MelSpectrogram, LogMelSpectrogram,
+MFCC layers and the mel/window functional helpers."""
+from __future__ import annotations
+
+from . import features  # noqa: F401
+from . import functional  # noqa: F401

@@ -1,0 +1,115 @@
+"""paddle.audio.functional analog: mel scale conversions, filterbanks,
+windows, dct."""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from .._core.tensor import Tensor
+
+
+def hz_to_mel(freq, htk: bool = False):
+    scalar = not isinstance(freq, (Tensor, np.ndarray, list))
+    f = np.asarray(freq.numpy() if isinstance(freq, Tensor) else freq,
+                   np.float32)
+    if htk:
+        mel = 2595.0 * np.log10(1.0 + f / 700.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        mel = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        mel = np.where(f >= min_log_hz,
+                       min_log_mel + np.log(np.maximum(f, 1e-10)
+                                            / min_log_hz) / logstep, mel)
+    return float(mel) if scalar else Tensor(jnp.asarray(mel))
+
+
+def mel_to_hz(mel, htk: bool = False):
+    scalar = not isinstance(mel, (Tensor, np.ndarray, list))
+    m = np.asarray(mel.numpy() if isinstance(mel, Tensor) else mel,
+                   np.float32)
+    if htk:
+        hz = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        hz = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        hz = np.where(m >= min_log_mel,
+                      min_log_hz * np.exp(logstep * (m - min_log_mel)),
+                      hz)
+    return float(hz) if scalar else Tensor(jnp.asarray(hz))
+
+
+def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False,
+                    dtype="float32"):
+    m_min = hz_to_mel(f_min, htk)
+    m_max = hz_to_mel(f_max, htk)
+    mels = np.linspace(m_min, m_max, n_mels)
+    return Tensor(jnp.asarray(
+        np.asarray([mel_to_hz(float(m), htk) for m in mels], np.float32)))
+
+
+def fft_frequencies(sr, n_fft, dtype="float32"):
+    return Tensor(jnp.asarray(
+        np.linspace(0, sr / 2, 1 + n_fft // 2).astype(np.float32)))
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
+                         htk=False, norm="slaney", dtype="float32"):
+    """Mel filterbank [n_mels, 1 + n_fft//2] (librosa/slaney convention)."""
+    f_max = f_max or sr / 2
+    fftfreqs = np.asarray(fft_frequencies(sr, n_fft).numpy())
+    melfreqs = np.asarray(
+        mel_frequencies(n_mels + 2, f_min, f_max, htk).numpy())
+    fdiff = np.diff(melfreqs)
+    ramps = melfreqs[:, None] - fftfreqs[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = np.maximum(0, np.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (melfreqs[2:n_mels + 2] - melfreqs[:n_mels])
+        weights *= enorm[:, None]
+    return Tensor(jnp.asarray(weights.astype(np.float32)))
+
+
+def power_to_db(spect, ref_value=1.0, amin=1e-10, top_db=80.0):
+    x = spect._value if isinstance(spect, Tensor) else jnp.asarray(spect)
+    log_spec = 10.0 * jnp.log10(jnp.maximum(x, amin))
+    log_spec = log_spec - 10.0 * math.log10(max(ref_value, amin))
+    if top_db is not None:
+        log_spec = jnp.maximum(log_spec, log_spec.max() - top_db)
+    return Tensor(log_spec)
+
+
+def create_dct(n_mfcc, n_mels, norm="ortho", dtype="float32"):
+    """DCT-II matrix [n_mels, n_mfcc]."""
+    n = np.arange(n_mels)
+    k = np.arange(n_mfcc)[:, None]
+    dct = np.cos(math.pi / n_mels * (n + 0.5) * k)     # [n_mfcc, n_mels]
+    if norm == "ortho":
+        dct[0] *= 1.0 / math.sqrt(2)
+        dct *= math.sqrt(2.0 / n_mels)
+    else:
+        dct *= 2.0
+    return Tensor(jnp.asarray(dct.T.astype(np.float32)))
+
+
+def get_window(window: str, win_length: int, fftbins=True, dtype="float32"):
+    w = {"hann": np.hanning, "hamming": np.hamming,
+         "blackman": np.blackman, "bartlett": np.bartlett}
+    if window == "rect" or window == "boxcar":
+        arr = np.ones(win_length)
+    elif window in w:
+        # periodic (fftbins) windows: sample N+1 then drop the last
+        arr = w[window](win_length + 1)[:-1] if fftbins else \
+            w[window](win_length)
+    else:
+        raise ValueError(f"unsupported window {window}")
+    return Tensor(jnp.asarray(arr.astype(np.float32)))

@@ -1,0 +1,198 @@
+"""Parameter-server core (fluid/distributed/ps + the_one_ps.py analog).
+
+The reference's PS is a brpc service with dense/sparse tables and
+optimizer-on-server (ps/table/, brpc_ps_client.cc). TPU-native round-1
+scope: the table/accessor layer with the same pull/push semantics —
+dense tables (np arrays, server-side SGD/Adagrad), sparse tables
+(on-demand embedding rows, the SelectedRows use case) — thread-safe for
+the single-controller runtime where trainer threads (hogwild-style,
+device_worker.h) share one server. Multi-host transport rides the native
+TCPStore (csrc/tcp_store.cc) in a later round; the table API is the
+stable contract."""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class Accessor:
+    """Server-side optimizer (ps/table accessor analog)."""
+
+    def __init__(self, kind: str = "sgd", lr: float = 0.01,
+                 init_std: float = 0.01):
+        self.kind = kind
+        self.lr = lr
+        self.init_std = init_std
+
+    def init_rows(self, n_rows: int, dim: int, rng: np.random.RandomState):
+        return (rng.randn(n_rows, dim) * self.init_std).astype(np.float32)
+
+    def apply(self, value: np.ndarray, grad: np.ndarray,
+              state: Optional[np.ndarray]):
+        if self.kind == "sgd":
+            value -= self.lr * grad
+            return state
+        if self.kind == "adagrad":
+            if state is None:
+                state = np.zeros_like(value)
+            state += grad * grad
+            value -= self.lr * grad / (np.sqrt(state) + 1e-10)
+            return state
+        raise ValueError(f"unknown accessor {self.kind}")
+
+
+class DenseTable:
+    def __init__(self, name: str, shape, accessor: Accessor):
+        self.name = name
+        rng = np.random.RandomState(hash(name) % (2 ** 31))
+        self.value = (rng.randn(*shape) * accessor.init_std).astype(
+            np.float32)
+        self.accessor = accessor
+        self._state: Optional[np.ndarray] = None
+        self._lock = threading.Lock()
+
+    def pull(self) -> np.ndarray:
+        with self._lock:
+            return self.value.copy()
+
+    def push(self, grad: np.ndarray):
+        with self._lock:
+            self._state = self.accessor.apply(self.value,
+                                              grad.astype(np.float32),
+                                              self._state)
+
+
+class SparseTable:
+    """id -> row embedding table with on-demand row creation (the
+    SelectedRows/large-vocab use case, ps/table/memory_sparse_table)."""
+
+    def __init__(self, name: str, dim: int, accessor: Accessor):
+        self.name = name
+        self.dim = dim
+        self.accessor = accessor
+        self._rows: Dict[int, np.ndarray] = {}
+        self._states: Dict[int, np.ndarray] = {}
+        self._rng = np.random.RandomState(hash(name) % (2 ** 31))
+        self._lock = threading.Lock()
+
+    def pull(self, ids: np.ndarray) -> np.ndarray:
+        ids = np.asarray(ids).reshape(-1)
+        out = np.empty((len(ids), self.dim), np.float32)
+        with self._lock:
+            for i, ident in enumerate(ids):
+                key = int(ident)
+                if key not in self._rows:
+                    self._rows[key] = self.accessor.init_rows(
+                        1, self.dim, self._rng)[0]
+                out[i] = self._rows[key]
+        return out
+
+    def push(self, ids: np.ndarray, grads: np.ndarray):
+        ids = np.asarray(ids).reshape(-1)
+        grads = np.asarray(grads).reshape(len(ids), self.dim)
+        with self._lock:
+            # accumulate duplicate ids before applying (reference merges
+            # gradients per key server-side)
+            acc: Dict[int, np.ndarray] = {}
+            for ident, g in zip(ids, grads):
+                key = int(ident)
+                acc[key] = acc.get(key, 0.0) + g
+            for key, g in acc.items():
+                if key not in self._rows:
+                    self._rows[key] = self.accessor.init_rows(
+                        1, self.dim, self._rng)[0]
+                row = self._rows[key][None]
+                st = self._states.get(key)
+                st_new = self.accessor.apply(row, g[None], st)
+                self._rows[key] = row[0]
+                if st_new is not None:
+                    self._states[key] = st_new
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._rows)
+
+
+class ParameterServer:
+    """Table registry + pull/push entry points (the_one_ps TheOnePSRuntime
+    role, brpc service surface collapsed to direct calls)."""
+
+    def __init__(self):
+        self._dense: Dict[str, DenseTable] = {}
+        self._sparse: Dict[str, SparseTable] = {}
+
+    def register_dense_table(self, name, shape, accessor=None):
+        self._dense[name] = DenseTable(name, shape,
+                                       accessor or Accessor())
+        return self._dense[name]
+
+    def register_sparse_table(self, name, dim, accessor=None):
+        self._sparse[name] = SparseTable(name, dim,
+                                         accessor or Accessor())
+        return self._sparse[name]
+
+    def pull_dense(self, name):
+        return self._dense[name].pull()
+
+    def push_dense(self, name, grad):
+        self._dense[name].push(grad)
+
+    def pull_sparse(self, name, ids):
+        return self._sparse[name].pull(ids)
+
+    def push_sparse(self, name, ids, grads):
+        self._sparse[name].push(ids, grads)
+
+    def save(self, path: str):
+        import pickle
+        with open(path, "wb") as f:
+            pickle.dump({
+                "dense": {k: v.value for k, v in self._dense.items()},
+                "sparse": {k: (v.dim, v._rows)
+                           for k, v in self._sparse.items()},
+            }, f, protocol=4)
+
+    def load(self, path: str):
+        import pickle
+        with open(path, "rb") as f:
+            data = pickle.load(f)
+        for k, val in data["dense"].items():
+            if k in self._dense:
+                self._dense[k].value = val
+        for k, (dim, rows) in data["sparse"].items():
+            if k in self._sparse:
+                self._sparse[k]._rows = rows
+
+
+_global_server: Optional[ParameterServer] = None
+
+
+def get_parameter_server() -> ParameterServer:
+    global _global_server
+    if _global_server is None:
+        _global_server = ParameterServer()
+    return _global_server
+
+
+class DistributedEmbedding:
+    """Worker-side embedding over a PS sparse table (distributed lookup
+    table / c_embedding analog): lookup pulls rows, backward pushes row
+    grads."""
+
+    def __init__(self, name: str, dim: int, server=None, lr=0.01):
+        self.server = server or get_parameter_server()
+        self.name = name
+        self.dim = dim
+        if name not in self.server._sparse:
+            self.server.register_sparse_table(name, dim,
+                                              Accessor("sgd", lr))
+
+    def forward(self, ids: np.ndarray) -> np.ndarray:
+        ids = np.asarray(ids)
+        rows = self.server.pull_sparse(self.name, ids)
+        return rows.reshape(*ids.shape, self.dim)
+
+    def backward(self, ids: np.ndarray, grad: np.ndarray):
+        self.server.push_sparse(self.name, ids, grad)

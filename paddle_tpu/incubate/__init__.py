@@ -2,5 +2,6 @@
 experimental distributed pieces that graduate into the stable namespace."""
 from . import nn  # noqa: F401
 from . import distributed  # noqa: F401
+from . import asp  # noqa: F401
 
-__all__ = ["nn", "distributed"]
+__all__ = ["nn", "distributed", "asp"]

@@ -1,0 +1,107 @@
+"""incubate.asp — automatic structured (2:4) sparsity
+(python/paddle/incubate/asp analog).
+
+Workflow parity: `decorate(optimizer)` wraps step() to re-apply masks
+after each update; `prune_model(model)` computes 2:4 masks (keep the two
+largest-magnitude weights in every group of four along the input dim) and
+zeroes the weights. On TPU the masked matmuls run dense on the MXU (2:4 is
+an NVIDIA sparse-tensor-core format); the API preserves the training
+recipe so sparsified checkpoints transfer."""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..._core.tensor import Tensor
+from ... import nn
+
+_supported_layers = [nn.Linear]
+_masks: Dict[int, jnp.ndarray] = {}
+_excluded: set = set()
+
+
+def set_excluded_layers(param_names, main_program=None):
+    for n in (param_names or []):
+        _excluded.add(n)
+
+
+def reset_excluded_layers(main_program=None):
+    _excluded.clear()
+
+
+def add_supported_layer(layer_type):
+    if layer_type not in _supported_layers:
+        _supported_layers.append(layer_type)
+
+
+def _mask_2_4(w: np.ndarray) -> np.ndarray:
+    """2:4 mask along the last dim (pad to multiple of 4 internally)."""
+    orig = w.shape
+    flat = w.reshape(-1, orig[-1])
+    n = flat.shape[-1]
+    pad = (-n) % 4
+    if pad:
+        flat = np.pad(flat, ((0, 0), (0, pad)))
+    g = flat.reshape(flat.shape[0], -1, 4)
+    order = np.argsort(-np.abs(g), axis=-1)
+    mask = np.zeros_like(g)
+    np.put_along_axis(mask, order[..., :2], 1.0, axis=-1)
+    mask = mask.reshape(flat.shape[0], -1)[:, :n]
+    return mask.reshape(orig)
+
+
+def check_mask_2_4(mat: np.ndarray) -> bool:
+    """Every aligned group of 4 (last dim) has <= 2 nonzeros."""
+    n = mat.shape[-1]
+    pad = (-n) % 4
+    flat = mat.reshape(-1, n)
+    if pad:
+        flat = np.pad(flat, ((0, 0), (0, pad)))
+    g = flat.reshape(flat.shape[0], -1, 4)
+    return bool(np.all((np.abs(g) > 0).sum(-1) <= 2))
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """Compute and apply 2:4 masks to all supported layers' weights."""
+    pruned = {}
+    for name, sub in model.named_sublayers():
+        if not any(isinstance(sub, t) for t in _supported_layers):
+            continue
+        if name in _excluded or getattr(sub.weight, "name", None) in \
+                _excluded:
+            continue
+        w = np.asarray(sub.weight.numpy())
+        mask = _mask_2_4(w)
+        sub.weight.set_value(Tensor(jnp.asarray(w * mask)))
+        _masks[id(sub.weight)] = jnp.asarray(mask)
+        pruned[name] = mask
+    return pruned
+
+
+class ASPOptimizerWrapper:
+    """decorate(optimizer) result: step() re-applies masks so pruned
+    weights stay zero through training (asp/asp.py OptimizerWithSparsity
+    analog)."""
+
+    def __init__(self, optimizer):
+        self._inner = optimizer
+
+    def __getattr__(self, item):
+        return getattr(self.__dict__["_inner"], item)
+
+    def step(self):
+        self._inner.step()
+        for p, _ in self._inner._all_params():
+            mask = _masks.get(id(p))
+            if mask is not None:
+                p._value = p._value * mask
+
+    def clear_grad(self, set_to_zero=True):
+        self._inner.clear_grad(set_to_zero)
+
+
+def decorate(optimizer):
+    return ASPOptimizerWrapper(optimizer)

@@ -1,0 +1,142 @@
+"""paddle.audio features, incubate.asp 2:4 sparsity, PS table core
+(SURVEY §2e PS row, §2f audio, incubate.asp)."""
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+# ------------------------------------------------------------------- audio
+
+def test_mel_conversions_roundtrip():
+    from paddle_tpu.audio import functional as AF
+    for hz in (100.0, 440.0, 4000.0):
+        mel = AF.hz_to_mel(hz)
+        back = AF.mel_to_hz(mel)
+        assert abs(back - hz) / hz < 1e-4
+
+
+def test_fbank_matrix_shape_and_coverage():
+    from paddle_tpu.audio import functional as AF
+    fb = AF.compute_fbank_matrix(16000, 512, n_mels=40)
+    assert tuple(fb.shape) == (40, 257)
+    arr = np.asarray(fb.numpy())
+    assert (arr >= 0).all()
+    assert (arr.sum(axis=1) > 0).all()   # every filter has support
+
+
+def test_spectrogram_and_melspectrogram_shapes():
+    from paddle_tpu.audio.features import (LogMelSpectrogram,
+                                           MelSpectrogram, MFCC,
+                                           Spectrogram)
+    paddle.seed(0)
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(2, 2048).astype(np.float32))
+    spec = Spectrogram(n_fft=256, hop_length=128)(x)
+    assert list(spec.shape) == [2, 129, 17]
+    assert (spec.numpy() >= 0).all()
+    mel = MelSpectrogram(sr=16000, n_fft=256, hop_length=128,
+                         n_mels=32)(x)
+    assert list(mel.shape) == [2, 32, 17]
+    logmel = LogMelSpectrogram(sr=16000, n_fft=256, hop_length=128,
+                               n_mels=32)(x)
+    assert np.isfinite(logmel.numpy()).all()
+    mfcc = MFCC(sr=16000, n_mfcc=13, n_fft=256, hop_length=128,
+                n_mels=32)(x)
+    assert list(mfcc.shape) == [2, 13, 17]
+
+
+# --------------------------------------------------------------------- asp
+
+def test_asp_prune_and_decorated_step_keeps_sparsity():
+    from paddle_tpu.incubate import asp
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+    masks = asp.prune_model(net)
+    assert len(masks) == 2
+    w = net[0].weight.numpy()
+    assert asp.check_mask_2_4(np.asarray(w))
+    # ~50% zeros
+    assert 0.45 < (np.asarray(w) == 0).mean() < 0.55
+
+    opt = asp.decorate(paddle.optimizer.SGD(
+        learning_rate=0.1, parameters=net.parameters()))
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(8, 16).astype(np.float32))
+    y = paddle.to_tensor(np.random.RandomState(1).randint(0, 4, (8,)))
+    for _ in range(3):
+        loss = nn.functional.cross_entropy(net(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    # sparsity pattern survives optimizer updates
+    assert asp.check_mask_2_4(np.asarray(net[0].weight.numpy()))
+    assert (np.asarray(net[0].weight.numpy()) == 0).mean() > 0.45
+
+
+# ---------------------------------------------------------------------- ps
+
+def test_ps_dense_table_pull_push():
+    from paddle_tpu.distributed.ps import Accessor, ParameterServer
+    ps = ParameterServer()
+    ps.register_dense_table("w", (4, 4), Accessor("sgd", lr=0.5))
+    w0 = ps.pull_dense("w")
+    g = np.ones((4, 4), np.float32)
+    ps.push_dense("w", g)
+    np.testing.assert_allclose(ps.pull_dense("w"), w0 - 0.5, rtol=1e-6)
+
+
+def test_ps_sparse_table_on_demand_rows_and_merge():
+    from paddle_tpu.distributed.ps import Accessor, ParameterServer
+    ps = ParameterServer()
+    t = ps.register_sparse_table("emb", 8, Accessor("sgd", lr=1.0))
+    rows = ps.pull_sparse("emb", np.array([5, 9, 5]))
+    assert rows.shape == (3, 8)
+    np.testing.assert_allclose(rows[0], rows[2])   # same id, same row
+    assert t.size() == 2
+    # duplicate-id grads merge server-side
+    before = ps.pull_sparse("emb", np.array([5]))[0]
+    ps.push_sparse("emb", np.array([5, 5]),
+                   np.ones((2, 8), np.float32))
+    after = ps.pull_sparse("emb", np.array([5]))[0]
+    np.testing.assert_allclose(after, before - 2.0, rtol=1e-5)
+
+
+def test_ps_hogwild_threads_and_save_load(tmp_path):
+    from paddle_tpu.distributed.ps import ParameterServer
+    ps = ParameterServer()
+    ps.register_dense_table("w", (2, 2))
+
+    def worker():
+        for _ in range(50):
+            ps.push_dense("w", np.full((2, 2), 0.01, np.float32))
+
+    ts = [threading.Thread(target=worker) for _ in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    # 200 pushes of lr*0.01 each applied atomically
+    path = str(tmp_path / "ps.pkl")
+    ps.save(path)
+    ps2 = ParameterServer()
+    ps2.register_dense_table("w", (2, 2))
+    ps2.load(path)
+    np.testing.assert_allclose(ps2.pull_dense("w"), ps.pull_dense("w"))
+
+
+def test_distributed_embedding_lookup_update():
+    from paddle_tpu.distributed.ps import (DistributedEmbedding,
+                                           ParameterServer)
+    ps = ParameterServer()
+    emb = DistributedEmbedding("vocab", 4, server=ps, lr=1.0)
+    ids = np.array([[1, 2], [3, 1]])
+    out = emb.forward(ids)
+    assert out.shape == (2, 2, 4)
+    emb.backward(ids, np.ones((2, 2, 4), np.float32))
+    out2 = emb.forward(np.array([1]))
+    # id 1 appeared twice -> grad 2 applied with lr 1
+    np.testing.assert_allclose(out2[0], out[0, 0] - 2.0, rtol=1e-5)
