@@ -60,22 +60,32 @@ from . import quantization  # noqa: E402
 from . import inference  # noqa: E402
 from . import onnx  # noqa: E402
 from . import audio  # noqa: E402
+from . import static  # noqa: E402
 
 from .framework import save, load  # noqa: E402
 
 
 def disable_static(place=None):
+    from . import static as _static
+    _static.disable_static()
     return None
 
 
 def enable_static():
-    raise NotImplementedError(
-        "paddle_tpu is dygraph-first; use paddle_tpu.jit.to_static for the "
-        "compiled path")
+    from . import static as _static
+    _static.enable_static()
 
 
 def in_dynamic_mode():
-    return True
+    from . import static as _static
+    return not _static.in_static_mode()
+
+
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    from .nn.layer import create_parameter as _cp
+    return _cp(shape, dtype=dtype, name=name, attr=attr, is_bias=is_bias,
+               default_initializer=default_initializer)
 
 
 def is_grad_enabled_():

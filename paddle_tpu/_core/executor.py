@@ -25,9 +25,13 @@ def _coerce(x):
 
 
 def apply(op_name: str, *inputs, **attrs):
-    """Execute a registered op eagerly on Tensors. Returns Tensor or tuple."""
+    """Execute a registered op eagerly on Tensors. Returns Tensor or tuple.
+    Under paddle.static (enable_static), records the op into the current
+    Program instead (the ProgramDesc/PIR build path, SURVEY L9/L14)."""
     op = get_op(op_name)
     ts = [_coerce(x) for x in inputs]
+    if _static_recorder is not None:
+        return _static_recorder(op_name, ts, attrs)
     ts = _maybe_amp_cast(op_name, ts)
     vals = tuple(t._value if t is not None else None for t in ts)
     if _profile_cb is not None:
@@ -40,6 +44,17 @@ def apply(op_name: str, *inputs, **attrs):
             t is not None and not t.stop_gradient for t in ts):
         record(op, attrs, ts, outs)
     return outs if op.multi_output else outs[0]
+
+
+# Static-graph recorder (installed by paddle_tpu.static.enable_static):
+# when set, apply() records ops into the current Program instead of
+# executing them.
+_static_recorder = None
+
+
+def set_static_recorder(fn):
+    global _static_recorder
+    _static_recorder = fn
 
 
 # Profiler instrumentation hook (host tracer RecordEvent per op; installed

@@ -1,0 +1,269 @@
+"""paddle.static — declarative graph mode (reference L9/L14 analog:
+base/framework.py Program, executor.py:1237 Executor).
+
+TPU-native mini-IR: under ``enable_static()`` every op call records an
+OpNode into the current Program instead of executing (shape/dtype inferred
+with jax.eval_shape — the InferMeta role), and ``Executor.run`` compiles
+the recorded graph into ONE jitted XLA callable per (program, feed
+signature) — the StandaloneExecutor/PirInterpreter role collapsed onto
+XLA. Dygraph Tensors captured by the graph (parameters, constants) become
+compile-time closures."""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .._core import executor as _exec
+from .._core.op_registry import get_op
+from .._core.tensor import Tensor
+
+_state = threading.local()
+
+
+def _st():
+    if not hasattr(_state, "static_mode"):
+        _state.static_mode = False
+        _state.main_program = None
+        _state.startup_program = None
+    return _state
+
+
+class Variable(Tensor):
+    """Graph placeholder (framework.py Variable analog). Carries
+    shape/dtype metadata; no payload until Executor.run feeds it."""
+
+    def __init__(self, name, shape, dtype, program, source=None):
+        # dummy zero payload keeps Tensor invariants (never read at run)
+        super().__init__(jnp.zeros([0], jnp.dtype(dtype)),
+                         stop_gradient=True, name=name)
+        self.var_shape = list(shape)
+        self.var_dtype = jnp.dtype(dtype)
+        self.program = program
+        self.source = source  # None = feed var; else producing OpNode
+
+    def __repr__(self):
+        return (f"static.Variable(name={self.name}, "
+                f"shape={self.var_shape}, dtype={self.var_dtype})")
+
+
+class OpNode:
+    __slots__ = ("op_name", "attrs", "inputs", "outputs")
+
+    def __init__(self, op_name, attrs, inputs, outputs):
+        self.op_name = op_name
+        self.attrs = attrs
+        self.inputs = inputs      # list of Variable | Tensor(const)
+        self.outputs = outputs    # list of Variable
+
+
+class Program:
+    """Recorded op graph (framework.py Program / pir Program analog)."""
+
+    _counter = 0
+
+    def __init__(self):
+        Program._counter += 1
+        self.id = Program._counter
+        self.ops: List[OpNode] = []
+        self.feed_vars: List[Variable] = []
+        self._version = 0
+
+    def clone(self, for_test=False):
+        return self
+
+    def global_block(self):
+        return self
+
+    def __repr__(self):
+        lines = [f"Program(id={self.id}, ops={len(self.ops)})"]
+        for op in self.ops:
+            lines.append(f"  {op.op_name}{tuple(op.attrs.items())}")
+        return "\n".join(lines)
+
+
+def default_main_program() -> Program:
+    st = _st()
+    if st.main_program is None:
+        st.main_program = Program()
+    return st.main_program
+
+
+def default_startup_program() -> Program:
+    st = _st()
+    if st.startup_program is None:
+        st.startup_program = Program()
+    return st.startup_program
+
+
+class program_guard:
+    def __init__(self, main_program, startup_program=None):
+        self.main = main_program
+        self.startup = startup_program
+
+    def __enter__(self):
+        st = _st()
+        self._old = (st.main_program, st.startup_program)
+        st.main_program = self.main
+        if self.startup is not None:
+            st.startup_program = self.startup
+        return self.main
+
+    def __exit__(self, *exc):
+        st = _st()
+        st.main_program, prev_startup = self._old[0], self._old[1]
+        st.startup_program = prev_startup
+        return False
+
+
+# ------------------------------------------------------------- mode switch
+
+def enable_static():
+    _st().static_mode = True
+    _exec.set_static_recorder(_record_op)
+
+
+def disable_static():
+    _st().static_mode = False
+    _exec.set_static_recorder(None)
+
+
+def in_static_mode() -> bool:
+    return _st().static_mode
+
+
+def data(name: str, shape, dtype="float32", lod_level=0) -> Variable:
+    """paddle.static.data: declare a feed placeholder."""
+    prog = default_main_program()
+    var = Variable(name, shape, dtype, prog)
+    prog.feed_vars.append(var)
+    return var
+
+
+# ---------------------------------------------------------------- recorder
+
+def _record_op(op_name: str, ts: List[Optional[Tensor]],
+               attrs: Dict[str, Any]):
+    """Called by the eager executor instead of running the kernel when
+    static mode is on. Returns output placeholder(s)."""
+    prog = default_main_program()
+    op = get_op(op_name)
+
+    def aval(t):
+        if t is None:
+            return None
+        if isinstance(t, Variable):
+            shape = [1 if d in (None, -1) else d for d in t.var_shape]
+            return jax.ShapeDtypeStruct(tuple(shape), t.var_dtype)
+        return t._value
+
+    avals = [aval(t) for t in ts]
+    out_shape = jax.eval_shape(
+        lambda *xs: op.fn(*xs, **attrs), *avals)
+    multi = op.multi_output
+    out_list = out_shape if multi else (out_shape,)
+    node = OpNode(op_name, attrs, list(ts), [])
+    outs = []
+    for i, o in enumerate(jax.tree_util.tree_leaves(out_list)):
+        v = Variable(f"tmp_{prog.id}_{len(prog.ops)}_{i}", list(o.shape),
+                     o.dtype, prog, source=node)
+        outs.append(v)
+    node.outputs = outs
+    prog.ops.append(node)
+    prog._version += 1
+    return tuple(outs) if multi else outs[0]
+
+
+# ----------------------------------------------------------------- executor
+
+class Executor:
+    """executor.py:1237 analog: compile the Program once per feed
+    signature, then run."""
+
+    def __init__(self, place=None):
+        self.place = place
+        self._cache: Dict[Any, Any] = {}
+
+    def run(self, program: Optional[Program] = None, feed=None,
+            fetch_list=None, return_numpy=True):
+        program = program or default_main_program()
+        feed = feed or {}
+        fetch_list = fetch_list or []
+        if not program.ops and not fetch_list:
+            return []   # startup program: parameters already initialized
+
+        key = (program.id, program._version,
+               tuple(sorted(feed.keys())),
+               tuple(id(v) for v in fetch_list))
+        fn = self._cache.get(key)
+        if fn is None:
+            fn = jax.jit(self._build_callable(program, list(feed.keys()),
+                                              fetch_list))
+            self._cache[key] = fn
+        feed_vals = [jnp.asarray(feed[k]) for k in sorted(feed.keys())]
+        outs = fn(*feed_vals)
+        if return_numpy:
+            return [np.asarray(o) for o in outs]
+        return [Tensor(o) for o in outs]
+
+    def _build_callable(self, program: Program, feed_names: List[str],
+                        fetch_list):
+        def replay(*feed_vals):
+            env: Dict[int, Any] = {}
+            by_name = dict(zip(sorted(feed_names), feed_vals))
+            for var in program.feed_vars:
+                if var.name in by_name:
+                    env[id(var)] = by_name[var.name]
+
+            def value_of(t):
+                if t is None:
+                    return None
+                if isinstance(t, Variable):
+                    if id(t) not in env:
+                        raise KeyError(
+                            f"feed missing for var '{t.name}'")
+                    return env[id(t)]
+                return t._value   # captured dygraph tensor (parameter)
+
+            for node in program.ops:
+                op = get_op(node.op_name)
+                vals = [value_of(t) for t in node.inputs]
+                out = op.fn(*vals, **node.attrs)
+                outs = jax.tree_util.tree_leaves(
+                    out if op.multi_output else (out,))
+                for var, o in zip(node.outputs, outs):
+                    env[id(var)] = o
+            return tuple(value_of(v) for v in fetch_list)
+
+        return replay
+
+
+# convenience namespace parity
+class _StaticNN:
+    @staticmethod
+    def fc(x, size, num_flatten_dims=1, activation=None, name=None):
+        import paddle_tpu as paddle
+        in_dim = int(np.prod(
+            (x.var_shape if isinstance(x, Variable) else x.shape)
+            [num_flatten_dims:]))
+        w = paddle.create_parameter([in_dim, size], "float32")
+        b = paddle.create_parameter([size], "float32", is_bias=True)
+        out = paddle.matmul(x, w) + b
+        if activation == "relu":
+            from ..nn import functional as F
+            out = F.relu(out)
+        return out
+
+
+nn = _StaticNN()
+
+
+class InputSpec:
+    def __init__(self, shape=None, dtype="float32", name=None):
+        self.shape = shape
+        self.dtype = dtype
+        self.name = name

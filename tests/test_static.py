@@ -1,0 +1,94 @@
+"""paddle.static graph mode: Program recording, Executor compile+run,
+program_guard, static.nn.fc, dygraph parity (SURVEY L9/L10/L14)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+@pytest.fixture(autouse=True)
+def _leave_dynamic():
+    yield
+    paddle.disable_static()
+
+
+def test_static_program_records_and_runs():
+    paddle.enable_static()
+    from paddle_tpu import static
+    main = static.Program()
+    startup = static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [None, 4], "float32")
+        y = paddle.matmul(x, paddle.to_tensor(
+            np.eye(4, dtype=np.float32) * 2))
+        z = y + 1.0
+    assert len(main.ops) >= 2
+    paddle.disable_static()
+
+    exe = static.Executor()
+    exe.run(startup)
+    xs = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+    out, = exe.run(main, feed={"x": xs}, fetch_list=[z])
+    np.testing.assert_allclose(out, xs * 2 + 1, rtol=1e-5)
+
+
+def test_static_matches_dygraph():
+    """Same network, static vs dygraph — identical outputs."""
+    rng = np.random.RandomState(1)
+    w_np = rng.randn(8, 4).astype(np.float32)
+    x_np = rng.randn(5, 8).astype(np.float32)
+
+    # dygraph
+    ref = np.tanh(x_np @ w_np).sum(axis=1)
+
+    paddle.enable_static()
+    from paddle_tpu import static
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 8], "float32")
+        h = paddle.tanh(paddle.matmul(x, paddle.to_tensor(w_np)))
+        s = h.sum(axis=1)
+    paddle.disable_static()
+    out, = static.Executor().run(main, feed={"x": x_np}, fetch_list=[s])
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+
+def test_static_executor_cache_and_refeed():
+    paddle.enable_static()
+    from paddle_tpu import static
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 2], "float32")
+        y = x * 3.0
+    paddle.disable_static()
+    exe = static.Executor()
+    a, = exe.run(main, feed={"x": np.ones((2, 2), np.float32)},
+                 fetch_list=[y])
+    b, = exe.run(main, feed={"x": np.full((2, 2), 2.0, np.float32)},
+                 fetch_list=[y])
+    np.testing.assert_allclose(a, 3.0)
+    np.testing.assert_allclose(b, 6.0)
+    assert len(exe._cache) == 1   # same signature -> one compiled program
+
+
+def test_static_nn_fc():
+    paddle.seed(0)
+    paddle.enable_static()
+    from paddle_tpu import static
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 6], "float32")
+        out = static.nn.fc(x, 3, activation="relu")
+    paddle.disable_static()
+    res, = static.Executor().run(
+        main, feed={"x": np.ones((2, 6), np.float32)}, fetch_list=[out])
+    assert res.shape == (2, 3)
+    assert (res >= 0).all()
+
+
+def test_in_dynamic_mode_flag():
+    assert paddle.in_dynamic_mode()
+    paddle.enable_static()
+    assert not paddle.in_dynamic_mode()
+    paddle.disable_static()
+    assert paddle.in_dynamic_mode()
