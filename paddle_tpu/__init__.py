@@ -61,6 +61,7 @@ from . import inference  # noqa: E402
 from . import onnx  # noqa: E402
 from . import audio  # noqa: E402
 from . import static  # noqa: E402
+from . import text  # noqa: E402
 
 from .framework import save, load  # noqa: E402
 

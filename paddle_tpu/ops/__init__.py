@@ -7,6 +7,11 @@ from __future__ import annotations
 
 from .._core.tensor import Tensor, to_tensor
 from . import moe  # noqa: F401  (registers moe ops)
+from . import extra  # noqa: F401
+from .extra import (angle, bincount, copysign, diff, frexp, histogram,  # noqa: F401
+                    kron, ldexp, nanmedian, polar, renorm, rot90,
+                    select_scatter, take, tensordot, trapezoid, unfold,
+                    vander)
 from . import _helper, creation, indexing, linalg, manipulation, math, \
     reduction, search  # noqa: F401
 

@@ -1,0 +1,153 @@
+"""Long-tail tensor ops (python/paddle/tensor/math.py / manipulation.py
+coverage completion): kernels are jnp calls compiled by XLA."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .._core.executor import apply
+from .._core.op_registry import register_op
+from ._helper import def_binary, def_unary, tensor_method
+
+angle = def_unary("angle", jnp.angle)
+copysign = def_binary("copysign", jnp.copysign)
+ldexp = def_binary("ldexp", lambda x, y: jnp.ldexp(x, y.astype(jnp.int32)))
+kron = def_binary("kron", jnp.kron)
+polar = def_binary("polar", lambda abs_, angle_:
+                   abs_ * jnp.exp(1j * angle_.astype(jnp.float32)))
+
+register_op("bincount",
+            lambda x, weights=None, length=1:
+            jnp.bincount(x.astype(jnp.int32), weights, length=length))
+register_op("diff", lambda x, n=1, axis=-1: jnp.diff(x, n=n, axis=axis))
+register_op("rot90", lambda x, k=1, axes=(0, 1):
+            jnp.rot90(x, k=k, axes=tuple(axes)))
+register_op("vander", lambda x, n=None, increasing=False:
+            jnp.vander(x, N=n, increasing=increasing))
+register_op("trapezoid", lambda y, x=None, dx=1.0, axis=-1:
+            jax.scipy.integrate.trapezoid(y, x=x, dx=dx, axis=axis))
+register_op("nanmedian", lambda x, axis=None, keepdim=False:
+            jnp.nanmedian(x, axis=axis, keepdims=keepdim))
+register_op("histogram_op", lambda x, bins=100, min=0.0, max=0.0:
+            jnp.histogram(
+                x, bins=bins,
+                range=None if min == 0.0 and max == 0.0
+                else (min, max))[0])
+register_op("take_op", lambda x, index, mode="raise":
+            jnp.take(x.reshape(-1), index.astype(jnp.int32),
+                     mode="clip" if mode == "clip" else "wrap"))
+register_op("tensordot_op", lambda x, y, axes=2:
+            jnp.tensordot(x, y, axes=axes))
+register_op("renorm_op", lambda x, p=2.0, axis=0, max_norm=1.0:
+            _renorm(x, p, axis, max_norm))
+register_op("frexp", lambda x: tuple(jnp.frexp(x)), multi_output=True)
+register_op("select_scatter_op", lambda x, values, axis=0, index=0:
+            _select_scatter(x, values, axis, index))
+register_op("unfold_op", lambda x, axis=0, size=1, step=1:
+            _unfold(x, axis, size, step))
+
+
+def _renorm(x, p, axis, max_norm):
+    axes = tuple(i for i in range(x.ndim) if i != axis % x.ndim)
+    norms = jnp.sum(jnp.abs(x) ** p, axis=axes, keepdims=True) ** (1.0 / p)
+    factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+    return x * factor
+
+
+def _select_scatter(x, values, axis, index):
+    idx = [slice(None)] * x.ndim
+    idx[axis % x.ndim] = index
+    return x.at[tuple(idx)].set(values)
+
+
+def _unfold(x, axis, size, step):
+    """paddle unfold: [..., n_windows, ..., size] with window content as
+    the LAST dim."""
+    axis = axis % x.ndim
+    windows = jnp.stack(
+        [jax.lax.dynamic_slice_in_dim(x, s, size, axis)
+         for s in range(0, x.shape[axis] - size + 1, step)],
+        axis=axis)
+    return jnp.moveaxis(windows, axis + 1, -1)
+
+
+@tensor_method("bincount")
+def bincount(x, weights=None, minlength=0, name=None):
+    # output length is data-dependent (max(x)+1) — resolved host-side so
+    # the kernel stays static-shaped for XLA (SURVEY §7 dynamic shapes)
+    import numpy as np
+    import jax as _jax
+    val = x._value if hasattr(x, "_value") else x
+    if isinstance(val, _jax.core.Tracer):
+        if minlength <= 0:
+            raise ValueError("bincount under trace needs minlength (its "
+                             "output length is data-dependent)")
+        length = minlength
+    else:
+        mx = int(np.asarray(val).max()) + 1 if val.size else 0
+        length = max(mx, minlength, 1)
+    return apply("bincount", x, weights, length=length)
+
+
+@tensor_method("diff")
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    return apply("diff", x, n=n, axis=axis)
+
+
+@tensor_method("rot90")
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply("rot90", x, k=k, axes=tuple(axes))
+
+
+def vander(x, n=None, increasing=False, name=None):
+    return apply("vander", x, n=n, increasing=increasing)
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    if x is not None:
+        return apply("trapezoid", y, x, axis=axis)
+    return apply("trapezoid", y, dx=1.0 if dx is None else dx, axis=axis)
+
+
+@tensor_method("nanmedian")
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    return apply("nanmedian", x, axis=axis, keepdim=keepdim)
+
+
+@tensor_method("histogram")
+def histogram(input, bins=100, min=0, max=0, weight=None, density=False,
+              name=None):
+    return apply("histogram_op", input, bins=bins, min=float(min),
+                 max=float(max))
+
+
+@tensor_method("take")
+def take(x, index, mode="raise", name=None):
+    return apply("take_op", x, index, mode=mode)
+
+
+def tensordot(x, y, axes=2, name=None):
+    if isinstance(axes, (list, tuple)):
+        axes = tuple(tuple(a) if isinstance(a, (list, tuple)) else a
+                     for a in axes)
+    return apply("tensordot_op", x, y, axes=axes)
+
+
+@tensor_method("renorm")
+def renorm(x, p, axis, max_norm, name=None):
+    return apply("renorm_op", x, p=float(p), axis=axis,
+                 max_norm=float(max_norm))
+
+
+@tensor_method("frexp")
+def frexp(x, name=None):
+    return apply("frexp", x)
+
+
+def select_scatter(x, values, axis, index, name=None):
+    return apply("select_scatter_op", x, values, axis=axis, index=index)
+
+
+@tensor_method("unfold")
+def unfold(x, axis, size, step, name=None):
+    return apply("unfold_op", x, axis=axis, size=size, step=step)

@@ -1,0 +1,99 @@
+"""paddle.text analog (python/paddle/text): text datasets + ViterbiDecoder.
+
+Datasets mirror the reference's lazy-download surface with local/synthetic
+fallbacks (zero-egress environment); ViterbiDecoder is the real CRF
+decode op."""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .._core.tensor import Tensor
+from ..nn.layer import Layer
+
+
+class ViterbiDecoder(Layer):
+    """CRF Viterbi decode (text/viterbi_decode.py analog).
+
+    transitions [T, T]; forward(potentials [B, L, T], lengths [B]) ->
+    (scores [B], paths [B, L]).
+    """
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        super().__init__()
+        self.transitions = transitions if isinstance(transitions, Tensor) \
+            else Tensor(jnp.asarray(transitions))
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths):
+        trans = self.transitions._value.astype(jnp.float32)
+        emis = potentials._value.astype(jnp.float32)
+        lens = lengths._value if isinstance(lengths, Tensor) else \
+            jnp.asarray(lengths)
+        scores, paths = _viterbi(emis, trans, lens)
+        return Tensor(scores), Tensor(paths)
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True, name=None):
+    dec = ViterbiDecoder(transition_params, include_bos_eos_tag)
+    return dec(potentials, lengths)
+
+
+def _viterbi(emis, trans, lens):
+    b, L, t = emis.shape
+
+    def decode_one(bi):
+        ln = jnp.clip(lens[bi], 1, L)
+        # score at final valid step
+        def fwd(carry, i):
+            score = carry
+            cand = score[:, None] + trans
+            nxt = jnp.max(cand, axis=0) + emis[bi, i]
+            nxt = jnp.where(i < ln, nxt, score)
+            return nxt, jnp.argmax(cand, axis=0)
+        score, bks = jax.lax.scan(fwd, emis[bi, 0], jnp.arange(1, L))
+        last = jnp.argmax(score)
+        final_score = jnp.max(score)
+
+        def back_step(carry, i):
+            tag = carry
+            prev = bks[i][tag]
+            tag = jnp.where(i < ln - 1, prev, tag)
+            return tag, tag
+        _, path_rev = jax.lax.scan(back_step, last,
+                                   jnp.arange(L - 2, -1, -1))
+        path = jnp.concatenate([path_rev[::-1], jnp.array([last])])
+        return final_score, path
+
+    scores, paths = jax.vmap(decode_one)(jnp.arange(b))
+    return scores, paths.astype(jnp.int64)
+
+
+class _SyntheticTextDataset:
+    """Offline stand-in for the downloadable text datasets (Imdb, Conll05
+    etc.): deterministic synthetic token sequences + labels."""
+
+    def __init__(self, mode="train", n=256, seq_len=64, vocab=1000,
+                 classes=2, seed=0):
+        rng = np.random.RandomState(seed + (0 if mode == "train" else 1))
+        self.data = rng.randint(1, vocab, (n, seq_len)).astype(np.int64)
+        self.labels = rng.randint(0, classes, (n,)).astype(np.int64)
+
+    def __getitem__(self, i):
+        return self.data[i], self.labels[i]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Imdb(_SyntheticTextDataset):
+    pass
+
+
+class Movielens(_SyntheticTextDataset):
+    pass

@@ -97,3 +97,40 @@ def test_cast_and_creation():
     assert paddle.linspace(0, 1, 5).shape == [5]
     assert paddle.rand([4, 4]).shape == [4, 4]
     assert paddle.randint(0, 10, [3]).dtype == paddle.int64
+
+
+def test_extra_long_tail_ops():
+    import numpy as np
+    import paddle_tpu as paddle
+    x = paddle.to_tensor(np.array([1, 2, 2, 3, 3, 3]))
+    np.testing.assert_array_equal(paddle.bincount(x).numpy(),
+                                  [0, 1, 2, 3])
+    d = paddle.diff(paddle.to_tensor(np.array([1.0, 3.0, 6.0],
+                                              np.float32)))
+    np.testing.assert_allclose(d.numpy(), [2.0, 3.0])
+    k = paddle.kron(paddle.to_tensor(np.eye(2, dtype=np.float32)),
+                    paddle.to_tensor(np.ones((2, 2), np.float32)))
+    assert tuple(k.shape) == (4, 4)
+    r = paddle.rot90(paddle.to_tensor(np.arange(4).reshape(2, 2)))
+    np.testing.assert_array_equal(r.numpy(), [[1, 3], [0, 2]])
+    t = paddle.tensordot(
+        paddle.to_tensor(np.ones((2, 3), np.float32)),
+        paddle.to_tensor(np.ones((3, 4), np.float32)), axes=1)
+    assert tuple(t.shape) == (2, 4)
+    m, e = paddle.frexp(paddle.to_tensor(np.array([8.0], np.float32)))
+    np.testing.assert_allclose(m.numpy() * 2.0 ** e.numpy(), [8.0])
+    h = paddle.histogram(paddle.to_tensor(
+        np.array([0.1, 0.5, 0.9], np.float32)), bins=2, min=0, max=1)
+    assert int(h.numpy().sum()) == 3
+    u = paddle.unfold(paddle.to_tensor(np.arange(6).astype(np.float32)),
+                      0, 3, 1)
+    assert tuple(u.shape) == (4, 3)
+    v = paddle.vander(paddle.to_tensor(np.array([1.0, 2.0], np.float32)),
+                      n=3)
+    assert tuple(v.shape) == (2, 3)
+    nm = paddle.nanmedian(paddle.to_tensor(
+        np.array([1.0, np.nan, 3.0], np.float32)))
+    assert float(nm.numpy()) == 2.0
+    tz = paddle.trapezoid(paddle.to_tensor(
+        np.array([1.0, 1.0, 1.0], np.float32)))
+    assert float(tz.numpy()) == 2.0
