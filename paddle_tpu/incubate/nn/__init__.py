@@ -1,3 +1,7 @@
 from . import functional  # noqa: F401
+from .layers import (FusedFeedForward, FusedLinear,  # noqa: F401
+                     FusedMultiHeadAttention,
+                     FusedTransformerEncoderLayer)
 
-__all__ = ["functional"]
+__all__ = ["functional", "FusedLinear", "FusedMultiHeadAttention",
+           "FusedFeedForward", "FusedTransformerEncoderLayer"]

@@ -1,0 +1,155 @@
+"""incubate.nn fused layers (python/paddle/incubate/nn/layer analogs):
+FusedMultiHeadAttention, FusedFeedForward, FusedTransformerEncoderLayer,
+FusedLinear. On TPU "fused" means one XLA program with the Pallas flash /
+fused kernels on the hot path — the role the reference fills with
+hand-written CUDA under phi/kernels/fusion/gpu."""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+from paddle_tpu._core.tensor import Tensor
+from paddle_tpu import nn
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.nn.layer import Layer, create_parameter
+
+
+class FusedLinear(Layer):
+    """fused_linear analog: matmul+bias in one kernel (XLA fuses)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, transpose_weight=False, name=None):
+        super().__init__()
+        self.transpose_weight = transpose_weight
+        shape = [out_features, in_features] if transpose_weight else \
+            [in_features, out_features]
+        self.weight = create_parameter(
+            shape, attr=weight_attr, default_initializer=I.XavierNormal())
+        self.bias = create_parameter([out_features], attr=bias_attr,
+                                     is_bias=True) \
+            if bias_attr is not False else None
+
+    def forward(self, x):
+        import paddle_tpu as paddle
+        w = paddle.transpose(self.weight, [1, 0]) if \
+            self.transpose_weight else self.weight
+        return F.linear(x, w, self.bias)
+
+
+class FusedMultiHeadAttention(Layer):
+    """fused_attention analog (phi/kernels/fusion/gpu/
+    fused_attention_kernel.cu role): pre/post-LN + qkv proj + SDPA (flash
+    kernel when eligible) + out proj + residual, one compiled region."""
+
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5,
+                 attn_dropout_rate=0.5, kdim=None, vdim=None,
+                 normalize_before=False, need_weights=False,
+                 qkv_weight_attr=None, qkv_bias_attr=None,
+                 linear_weight_attr=None, linear_bias_attr=None,
+                 pre_ln_scale_attr=None, pre_ln_bias_attr=None,
+                 ln_scale_attr=None, ln_bias_attr=None, epsilon=1e-5,
+                 nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.normalize_before = normalize_before
+        self.dropout_rate = dropout_rate
+        self.attn_dropout_rate = attn_dropout_rate
+        self.epsilon = epsilon
+        self.qkv_weight = create_parameter(
+            [embed_dim, 3 * embed_dim], attr=qkv_weight_attr,
+            default_initializer=I.XavierNormal())
+        self.qkv_bias = create_parameter([3 * embed_dim],
+                                         attr=qkv_bias_attr, is_bias=True)
+        self.linear_weight = create_parameter(
+            [embed_dim, embed_dim], attr=linear_weight_attr,
+            default_initializer=I.XavierNormal())
+        self.linear_bias = create_parameter([embed_dim],
+                                            attr=linear_bias_attr,
+                                            is_bias=True)
+        self.ln = nn.LayerNorm(embed_dim, epsilon=epsilon)
+
+    def forward(self, x, attn_mask=None, cache=None):
+        import paddle_tpu as paddle
+        residual = x
+        if self.normalize_before:
+            x = self.ln(x)
+        b, s, _ = x.shape
+        qkv = F.linear(x, self.qkv_weight, self.qkv_bias)
+        qkv = paddle.reshape(qkv, [b, s, 3, self.num_heads,
+                                   self.head_dim])
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        from paddle_tpu.nn.functional.attention import \
+            scaled_dot_product_attention
+        out = scaled_dot_product_attention(
+            q, k, v, attn_mask, self.attn_dropout_rate, False,
+            self.training)
+        out = paddle.reshape(out, [b, s, self.embed_dim])
+        out = F.linear(out, self.linear_weight, self.linear_bias)
+        out = F.dropout(out, self.dropout_rate, training=self.training)
+        out = residual + out
+        if not self.normalize_before:
+            out = self.ln(out)
+        return out
+
+
+class FusedFeedForward(Layer):
+    """fused_feedforward analog: LN + fc1 + act + fc2 + residual."""
+
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 epsilon=1e-5, activation="relu", act_dropout_rate=None,
+                 normalize_before=False, linear1_weight_attr=None,
+                 linear1_bias_attr=None, linear2_weight_attr=None,
+                 linear2_bias_attr=None, ln1_scale_attr=None,
+                 ln1_bias_attr=None, ln2_scale_attr=None,
+                 ln2_bias_attr=None, nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        self.normalize_before = normalize_before
+        self.dropout_rate = dropout_rate
+        self.act_dropout_rate = act_dropout_rate if act_dropout_rate \
+            is not None else dropout_rate
+        self.activation = activation
+        self.linear1 = nn.Linear(d_model, dim_feedforward,
+                                 weight_attr=linear1_weight_attr,
+                                 bias_attr=linear1_bias_attr)
+        self.linear2 = nn.Linear(dim_feedforward, d_model,
+                                 weight_attr=linear2_weight_attr,
+                                 bias_attr=linear2_bias_attr)
+        self.ln = nn.LayerNorm(d_model, epsilon=epsilon)
+
+    def forward(self, x):
+        residual = x
+        if self.normalize_before:
+            x = self.ln(x)
+        act = getattr(F, self.activation)
+        h = act(self.linear1(x))
+        h = F.dropout(h, self.act_dropout_rate, training=self.training)
+        h = self.linear2(h)
+        h = F.dropout(h, self.dropout_rate, training=self.training)
+        out = residual + h
+        if not self.normalize_before:
+            out = self.ln(out)
+        return out
+
+
+class FusedTransformerEncoderLayer(Layer):
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None,
+                 act_dropout_rate=None, normalize_before=False,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        self.fused_attn = FusedMultiHeadAttention(
+            d_model, nhead,
+            dropout_rate=dropout_rate,
+            attn_dropout_rate=attn_dropout_rate if attn_dropout_rate
+            is not None else dropout_rate,
+            normalize_before=normalize_before)
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate=dropout_rate,
+            activation=activation, act_dropout_rate=act_dropout_rate,
+            normalize_before=normalize_before)
+
+    def forward(self, src, src_mask=None, cache=None):
+        return self.ffn(self.fused_attn(src, src_mask))

@@ -1,3 +1,4 @@
 from . import lr  # noqa: F401
+from .lbfgs import LBFGS  # noqa: F401
 from .optimizer import (Optimizer, SGD, Momentum, Adam, AdamW, Adagrad,  # noqa: F401
                         RMSProp, Adadelta, Adamax, Lamb)

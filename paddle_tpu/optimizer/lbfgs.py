@@ -1,0 +1,117 @@
+"""LBFGS optimizer (python/paddle/optimizer/lbfgs.py analog): limited-
+memory BFGS with two-loop recursion and optional strong-Wolfe line search
+(simplified backtracking here). Closure-based step API."""
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from .._core.tensor import Tensor
+from .optimizer import Optimizer
+
+
+class LBFGS(Optimizer):
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9,
+                 history_size=100, line_search_fn=None, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate=learning_rate,
+                         parameters=parameters,
+                         weight_decay=weight_decay, grad_clip=grad_clip,
+                         name=name)
+        self.max_iter = max_iter
+        self.max_eval = max_eval or max_iter * 5 // 4
+        self.tolerance_grad = tolerance_grad
+        self.tolerance_change = tolerance_change
+        self.history_size = history_size
+        self.line_search_fn = line_search_fn
+        self._s: List[np.ndarray] = []
+        self._y: List[np.ndarray] = []
+        self._prev_flat: Optional[np.ndarray] = None
+        self._prev_grad: Optional[np.ndarray] = None
+
+    # ----------------------------------------------------------- helpers
+    def _params(self):
+        return [p for g in self._param_groups for p in g["params"]]
+
+    def _flat(self, arrs):
+        return np.concatenate([np.asarray(a).ravel() for a in arrs])
+
+    def _gather(self):
+        ps = self._params()
+        flat = self._flat([p._value for p in ps])
+        grads = []
+        for p in ps:
+            g = p.grad
+            grads.append(np.zeros(np.prod(p.shape)) if g is None
+                         else np.asarray(g._value).ravel())
+        return flat, np.concatenate(grads)
+
+    def _scatter(self, flat):
+        ofs = 0
+        for p in self._params():
+            n = int(np.prod(p.shape))
+            p._value = jnp.asarray(
+                flat[ofs:ofs + n].reshape(p.shape),
+                dtype=p._value.dtype)
+            ofs += n
+
+    def _direction(self, grad):
+        """Two-loop recursion over (s, y) history."""
+        q = grad.copy()
+        alphas = []
+        for s, y in zip(reversed(self._s), reversed(self._y)):
+            rho = 1.0 / max(float(y @ s), 1e-10)
+            a = rho * (s @ q)
+            alphas.append((a, rho, s, y))
+            q -= a * y
+        if self._s:
+            s, y = self._s[-1], self._y[-1]
+            q *= float(s @ y) / max(float(y @ y), 1e-10)
+        for a, rho, s, y in reversed(alphas):
+            b = rho * (y @ q)
+            q += (a - b) * s
+        return -q
+
+    # -------------------------------------------------------------- step
+    def step(self, closure: Optional[Callable] = None):
+        """closure() -> loss Tensor, re-evaluating model + grads."""
+        if closure is None:
+            raise ValueError("LBFGS.step needs a closure returning the "
+                             "loss")
+        loss = closure()
+        for it in range(self.max_iter):
+            flat, grad = self._gather()
+            if np.max(np.abs(grad)) <= self.tolerance_grad:
+                break
+            if self._prev_flat is not None:
+                s = flat - self._prev_flat
+                y = grad - self._prev_grad
+                if float(y @ s) > 1e-10:
+                    self._s.append(s)
+                    self._y.append(y)
+                    if len(self._s) > self.history_size:
+                        self._s.pop(0)
+                        self._y.pop(0)
+            d = self._direction(grad)
+            self._prev_flat, self._prev_grad = flat.copy(), grad.copy()
+
+            lr = self.get_lr()
+            # backtracking line search on the closure
+            t = lr
+            f0 = float(loss.numpy())
+            gtd = float(grad @ d)
+            for _ in range(10):
+                self._scatter(flat + t * d)
+                self.clear_grad()
+                loss = closure()
+                if float(loss.numpy()) <= f0 + 1e-4 * t * gtd:
+                    break
+                t *= 0.5
+            if np.max(np.abs(t * d)) <= self.tolerance_change:
+                break
+        self._step_count += 1
+        return loss

@@ -1,0 +1,59 @@
+"""LBFGS optimizer (closure API) + incubate.nn fused layers."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def test_lbfgs_converges_on_quadratic():
+    paddle.seed(0)
+    # minimize ||Ax - b||^2 — LBFGS should nail it in a few iters
+    rng = np.random.RandomState(0)
+    A = rng.randn(10, 4).astype(np.float32)
+    b = rng.randn(10).astype(np.float32)
+    x = paddle.to_tensor(np.zeros(4, np.float32), stop_gradient=False)
+    from paddle_tpu.nn.layer import Parameter
+    p = Parameter(x._value, trainable=True)
+    opt = paddle.optimizer.LBFGS(learning_rate=1.0, max_iter=30,
+                                 parameters=[p])
+    At = paddle.to_tensor(A)
+    bt = paddle.to_tensor(b)
+
+    def closure():
+        opt.clear_grad()
+        r = paddle.matmul(At, p) - bt
+        loss = (r * r).sum()
+        loss.backward()
+        return loss
+
+    loss = opt.step(closure)
+    x_star = np.linalg.lstsq(A, b, rcond=None)[0]
+    np.testing.assert_allclose(p.numpy(), x_star, rtol=1e-3, atol=1e-3)
+
+
+def test_fused_layers_forward_backward():
+    paddle.seed(0)
+    from paddle_tpu.incubate.nn import (FusedFeedForward, FusedLinear,
+                                        FusedMultiHeadAttention,
+                                        FusedTransformerEncoderLayer)
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(2, 8, 16).astype(np.float32),
+        stop_gradient=False)
+    attn = FusedMultiHeadAttention(16, 4, dropout_rate=0.0,
+                                   attn_dropout_rate=0.0)
+    out = attn(x)
+    assert tuple(out.shape) == (2, 8, 16)
+    ffn = FusedFeedForward(16, 32, dropout_rate=0.0)
+    out2 = ffn(out)
+    assert tuple(out2.shape) == (2, 8, 16)
+    out2.sum().backward()
+    assert attn.qkv_weight.grad is not None
+
+    enc = FusedTransformerEncoderLayer(16, 4, 32, dropout_rate=0.0)
+    enc.eval()
+    y = enc(x)
+    assert tuple(y.shape) == (2, 8, 16)
+
+    lin = FusedLinear(16, 8, transpose_weight=True)
+    assert tuple(lin(x).shape) == (2, 8, 8)
