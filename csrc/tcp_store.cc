@@ -9,6 +9,7 @@
 #include "pt_common.h"
 
 #include <arpa/inet.h>
+#include <netdb.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <sys/socket.h>
@@ -27,7 +28,9 @@
 namespace pt {
 namespace {
 
-enum Cmd : uint8_t { kSet = 0, kGet = 1, kAdd = 2, kWait = 3, kPing = 4 };
+enum Cmd : uint8_t {
+  kSet = 0, kGet = 1, kAdd = 2, kWait = 3, kPing = 4, kDel = 5
+};
 
 bool send_all(int fd, const void* buf, size_t n) {
   const char* p = static_cast<const char*>(buf);
@@ -108,6 +111,12 @@ class StoreServer {
 
   void Stop() {
     stopping_.store(true);
+    {
+      // wake any kWait waiters blocked on the condition variable so
+      // client threads can exit instead of sleeping out their timeout
+      std::lock_guard<std::mutex> g(data_mu_);
+      cv_.notify_all();
+    }
     if (listen_fd_ >= 0) {
       ::shutdown(listen_fd_, SHUT_RDWR);
       ::close(listen_fd_);
@@ -196,6 +205,12 @@ class StoreServer {
         }
         cv_.notify_all();
         if (!send_all(fd, &result, 8)) break;
+      } else if (cmd == kDel) {
+        {
+          std::lock_guard<std::mutex> g(data_mu_);
+          data_.erase(key);
+        }
+        if (!send_u32(fd, 0)) break;
       } else if (cmd == kPing) {
         if (!send_u32(fd, 0)) break;
       } else {
@@ -223,21 +238,27 @@ class StoreClient {
   bool Connect(const std::string& host, int port, int timeout_ms) {
     auto deadline = std::chrono::steady_clock::now() +
                     std::chrono::milliseconds(timeout_ms);
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (::inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1) {
+      // not an IPv4 literal: resolve via getaddrinfo (hostnames, FQDNs)
+      addrinfo hints{};
+      hints.ai_family = AF_INET;
+      hints.ai_socktype = SOCK_STREAM;
+      addrinfo* res = nullptr;
+      int rc = ::getaddrinfo(host.c_str(), nullptr, &hints, &res);
+      if (rc != 0 || res == nullptr) {
+        set_last_error("getaddrinfo failed for " + host + ": " +
+                       gai_strerror(rc));
+        return false;
+      }
+      addr.sin_addr =
+          reinterpret_cast<sockaddr_in*>(res->ai_addr)->sin_addr;
+      ::freeaddrinfo(res);
+    }
     while (true) {
       fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
-      sockaddr_in addr{};
-      addr.sin_family = AF_INET;
-      addr.sin_port = htons(static_cast<uint16_t>(port));
-      if (::inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1) {
-        // resolve "localhost" minimal path
-        if (host == "localhost")
-          ::inet_pton(AF_INET, "127.0.0.1", &addr.sin_addr);
-        else {
-          set_last_error("inet_pton failed for " + host);
-          ::close(fd_);
-          return false;
-        }
-      }
       if (::connect(fd_, reinterpret_cast<sockaddr*>(&addr),
                     sizeof(addr)) == 0) {
         int one = 1;
@@ -298,6 +319,16 @@ class StoreClient {
       return false;
     }
     return true;
+  }
+
+  bool Del(const std::string& key) {
+    std::lock_guard<std::mutex> g(mu_);
+    uint8_t cmd = kDel;
+    if (!send_all(fd_, &cmd, 1) ||
+        !send_bytes(fd_, key.data(), static_cast<uint32_t>(key.size())))
+      return fail("del send");
+    uint32_t status;
+    return recv_u32(fd_, &status) || fail("del recv");
   }
 
   bool Add(const std::string& key, int64_t delta, int64_t* result) {
@@ -373,6 +404,10 @@ PT_EXPORT int64_t pt_store_get(void* h, const char* key, void* buf,
   if (n < 0) return -1;
   if (buf && buf_len >= n) std::memcpy(buf, out.data(), n);
   return n;
+}
+
+PT_EXPORT int pt_store_del(void* h, const char* key) {
+  return static_cast<StoreClient*>(h)->Del(key) ? 0 : -1;
 }
 
 PT_EXPORT int pt_store_wait(void* h, const char* key, uint32_t timeout_ms) {

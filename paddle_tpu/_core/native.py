@@ -58,6 +58,8 @@ def _bind(lib):
                                  c.c_int64, c.c_uint32]
     lib.pt_store_wait.restype = c.c_int
     lib.pt_store_wait.argtypes = [c.c_void_p, c.c_char_p, c.c_uint32]
+    lib.pt_store_del.restype = c.c_int
+    lib.pt_store_del.argtypes = [c.c_void_p, c.c_char_p]
     lib.pt_store_add.restype = c.c_int64
     lib.pt_store_add.argtypes = [c.c_void_p, c.c_char_p, c.c_int64]
 

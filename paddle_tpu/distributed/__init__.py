@@ -14,9 +14,9 @@ from .parallel_env import (ParallelEnv, get_rank, get_world_size,  # noqa: F401
 from .communication import (ReduceOp, Group, new_group, get_group,  # noqa: F401
                             all_reduce, all_gather, all_gather_object,
                             broadcast, broadcast_object_list, reduce,
-                            reduce_scatter, scatter, alltoall, all_to_all,
-                            send, recv, isend, irecv, barrier, wait,
-                            get_backend, stream)
+                            reduce_scatter, scatter, gather, alltoall,
+                            all_to_all, send, recv, isend, irecv, barrier,
+                            wait, get_backend, stream)
 from .parallel import DataParallel  # noqa: F401
 from . import fleet  # noqa: F401
 from . import checkpoint  # noqa: F401

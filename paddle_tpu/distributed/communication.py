@@ -7,21 +7,21 @@ ProcessGroup stack (process_group.h:130-246). TPU-native split
 - INSIDE compiled programs (the hot path) collectives are XLA ops over ICI
   — emitted by GSPMD from sharding annotations or written explicitly with
   shard_map in paddle_tpu.distributed.shard_map_ops.
-- HOST-DRIVEN eager collectives here operate on the single-controller
-  device mesh: implemented as jitted shard_map programs over the group's
-  mesh axis. With world_size==1 they degenerate to identity (same as the
-  reference's single-process groups).
+- HOST-DRIVEN eager collectives here run over the store-backed
+  ProcessGroup (process_group.py): after init_parallel_env every trainer
+  process can all_reduce/broadcast/send/recv host tensors through the
+  TCPStore transport — the gloo-analog fallback the reference keeps for
+  CPU tensors and control-plane traffic. With world_size==1 they
+  degenerate to identity (same as the reference's single-process groups).
 
-Cross-host process groups ride jax.distributed (PJRT DCN) once
-init_parallel_env has connected hosts via the TCPStore rendezvous.
+Cross-host in-graph collectives ride jax.distributed (PJRT DCN) once
+init_parallel_env has connected hosts (PADDLE_USE_JAX_DIST=1).
 """
 from __future__ import annotations
 
-import functools
 from typing import List, Optional
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 from .._core.tensor import Tensor
@@ -37,7 +37,8 @@ class ReduceOp:
 
 class Group:
     """A communication group = a set of ranks (new_group analog,
-    collective.py:195)."""
+    collective.py:195). ``pg`` is the store-backed transport; None until
+    init_parallel_env (single-process groups never need one)."""
 
     _next_id = [0]
 
@@ -47,10 +48,15 @@ class Group:
         self.id = Group._next_id[0]
         Group._next_id[0] += 1
         self.name = name or f"group_{self.id}"
+        self.pg = pg
 
     @property
     def world_size(self):
         return self.nranks
+
+    @property
+    def process_group(self):
+        return self.pg
 
     def get_group_rank(self, rank):
         return self.ranks.index(rank) if rank in self.ranks else -1
@@ -66,16 +72,39 @@ _groups = {}
 def _get_default_group() -> Group:
     global _default_group
     if _default_group is None:
-        from .parallel_env import get_world_size
-        _default_group = Group(list(range(get_world_size())))
+        from .parallel_env import get_default_process_group, get_world_size
+        _default_group = Group(list(range(get_world_size())),
+                               pg=get_default_process_group())
+    elif _default_group.pg is None and _default_group.nranks > 1:
+        from .parallel_env import get_default_process_group
+        _default_group.pg = get_default_process_group()
     return _default_group
 
 
+# Wire-protocol group ids: bumped ONLY by new_group (never by lazy
+# default-group creation) so the '__pg/<gid>/...' store namespace agrees
+# across ranks as long as new_group calls happen in the same order —
+# the reference contract. gid 0 is the default group.
+_next_pg_gid = [1]
+
+
 def new_group(ranks=None, backend=None, timeout=None) -> Group:
+    """Create a subgroup. Must be called by every rank in the job in the
+    same order (reference contract, collective.py:195) so group ids — the
+    store key namespace — agree across ranks."""
+    from .parallel_env import ParallelEnv, get_default_process_group, \
+        get_world_size
     if ranks is None:
-        from .parallel_env import get_world_size
         ranks = list(range(get_world_size()))
-    g = Group(ranks)
+    gid = _next_pg_gid[0]
+    _next_pg_gid[0] += 1
+    pg = None
+    default_pg = get_default_process_group()
+    if default_pg is not None and len(ranks) > 1:
+        from .process_group import ProcessGroup
+        pg = ProcessGroup(default_pg.store, ParallelEnv().rank, ranks,
+                          gid=gid)
+    g = Group(ranks, pg=pg)
     _groups[g.id] = g
     return g
 
@@ -101,16 +130,49 @@ def _single(group):
     return g.nranks <= 1
 
 
+def _pg(group):
+    g = group or _get_default_group()
+    if g.pg is None:
+        raise RuntimeError(
+            "multi-process collectives need init_parallel_env() first "
+            "(PADDLE_TRAINERS_NUM>1 with a TCPStore rendezvous)")
+    if g.pg.rank < 0:
+        raise RuntimeError(
+            f"rank {g.pg.global_rank} is not a member of {g}")
+    return g.pg
+
+
+def _grank(group, rank: int, what: str) -> int:
+    """Translate a global rank to a group rank, rejecting non-members
+    immediately instead of hanging on a store key nobody serves."""
+    g = group or _get_default_group()
+    gr = g.get_group_rank(rank)
+    if gr < 0:
+        raise ValueError(
+            f"{what}={rank} is not a member of {g}")
+    return gr
+
+
+def _np(t):
+    return t.numpy() if isinstance(t, Tensor) else np.asarray(t)
+
+
+def _wrap_like(arr: np.ndarray, like) -> Tensor:
+    t = Tensor(np.ascontiguousarray(arr))
+    if isinstance(like, Tensor):
+        t._stop_gradient = like.stop_gradient
+    return t
+
+
 # --------------------------------------------------------------- collectives
 def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group=None, sync_op=True):
-    """In-place all-reduce. Single-process identity; compiled path uses
-    psum via GSPMD/shard_map."""
+    """In-place all-reduce. Compiled path uses psum via GSPMD/shard_map;
+    eager multi-process path rides the store-backed ProcessGroup."""
     if _single(group):
         return tensor
-    raise NotImplementedError(
-        "host-driven multi-process all_reduce requires "
-        "init_parallel_env(multi-host); in-graph collectives are compiled "
-        "via sharding annotations")
+    out = _pg(group).all_reduce(_np(tensor), op)
+    tensor._adopt(_wrap_like(out, tensor))
+    return tensor
 
 
 def all_gather(tensor_list: List, tensor: Tensor, group=None, sync_op=True):
@@ -118,33 +180,43 @@ def all_gather(tensor_list: List, tensor: Tensor, group=None, sync_op=True):
         tensor_list.append(tensor.clone() if isinstance(tensor, Tensor)
                            else tensor)
         return tensor_list
-    raise NotImplementedError
+    parts = _pg(group).all_gather(_np(tensor))
+    tensor_list.extend(_wrap_like(p, tensor) for p in parts)
+    return tensor_list
 
 
 def all_gather_object(object_list, obj, group=None):
     if _single(group):
         object_list.append(obj)
         return object_list
-    raise NotImplementedError
+    object_list.extend(_pg(group).all_gather_object(obj))
+    return object_list
 
 
 def broadcast(tensor: Tensor, src=0, group=None, sync_op=True):
     if _single(group):
         return tensor
-    raise NotImplementedError
+    out = _pg(group).broadcast(_np(tensor), _grank(group, src, 'src'))
+    tensor._adopt(_wrap_like(out, tensor))
+    return tensor
 
 
 def broadcast_object_list(object_list, src=0, group=None):
     if _single(group):
         return object_list
-    raise NotImplementedError
+    synced = _pg(group).broadcast_object(list(object_list),
+                                         _grank(group, src, 'src'))
+    object_list[:] = synced
+    return object_list
 
 
 def reduce(tensor: Tensor, dst=0, op=ReduceOp.SUM, group=None,
            sync_op=True):
     if _single(group):
         return tensor
-    raise NotImplementedError
+    out = _pg(group).reduce(_np(tensor), _grank(group, dst, 'dst'), op)
+    tensor._adopt(_wrap_like(out, tensor))
+    return tensor
 
 
 def reduce_scatter(tensor: Tensor, tensor_list, op=ReduceOp.SUM, group=None,
@@ -153,7 +225,9 @@ def reduce_scatter(tensor: Tensor, tensor_list, op=ReduceOp.SUM, group=None,
         t = tensor_list[0]
         tensor._adopt(t.clone())
         return tensor
-    raise NotImplementedError
+    out = _pg(group).reduce_scatter([_np(t) for t in tensor_list], op)
+    tensor._adopt(_wrap_like(out, tensor))
+    return tensor
 
 
 def scatter(tensor: Tensor, tensor_list=None, src=0, group=None,
@@ -162,27 +236,50 @@ def scatter(tensor: Tensor, tensor_list=None, src=0, group=None,
         if tensor_list:
             tensor._adopt(tensor_list[0].clone())
         return tensor
-    raise NotImplementedError
+    parts = [_np(t) for t in tensor_list] if tensor_list else None
+    out = _pg(group).scatter(parts, _grank(group, src, 'src'))
+    tensor._adopt(_wrap_like(out, tensor))
+    return tensor
+
+
+def gather(tensor: Tensor, gather_list=None, dst=0, group=None,
+           sync_op=True):
+    if _single(group):
+        if gather_list is not None:
+            gather_list.append(tensor.clone())
+        return gather_list
+    parts = _pg(group).gather(_np(tensor), _grank(group, dst, 'dst'))
+    if parts is not None and gather_list is not None:
+        gather_list.extend(_wrap_like(p, tensor) for p in parts)
+    return gather_list
 
 
 def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
     if _single(group):
         out_tensor_list.extend(t.clone() for t in in_tensor_list)
         return out_tensor_list
-    raise NotImplementedError
+    parts = _pg(group).all_to_all([_np(t) for t in in_tensor_list])
+    out_tensor_list.extend(_wrap_like(p, in_tensor_list[0]) for p in parts)
+    return out_tensor_list
 
 
 all_to_all = alltoall
 
 
 def send(tensor: Tensor, dst=0, group=None, sync_op=True):
-    raise NotImplementedError(
-        "host-driven P2P requires multi-host runtime; the pipeline "
-        "engine uses compiled ppermute (paddle_tpu.distributed.pipeline)")
+    g = group or _get_default_group()
+    if g.nranks <= 1:
+        raise RuntimeError("send needs a multi-process group")
+    _pg(group).send(_np(tensor), _grank(group, dst, 'dst'))
 
 
 def recv(tensor: Tensor, src=0, group=None, sync_op=True):
-    raise NotImplementedError
+    g = group or _get_default_group()
+    if g.nranks <= 1:
+        raise RuntimeError("recv needs a multi-process group")
+    out = _pg(group).recv(_grank(group, src, 'src'))
+    tensor._adopt(_wrap_like(out, tensor))
+    return tensor
 
 
 def isend(tensor, dst=0, group=None):
@@ -196,7 +293,7 @@ def irecv(tensor, src=0, group=None):
 def barrier(group=None):
     if _single(group):
         return
-    raise NotImplementedError
+    _pg(group).barrier()
 
 
 def wait(tensor, group=None, use_calc_stream=True):

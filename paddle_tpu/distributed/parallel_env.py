@@ -61,27 +61,48 @@ def get_world_size(group=None) -> int:
 
 
 def init_parallel_env():
-    """Connect this host into the job. Single host: no-op beyond env
-    parsing. Multi-host (PADDLE_TRAINERS_NUM>1 with endpoints):
-    jax.distributed.initialize wires PJRT across DCN — the analog of the
-    reference's TCPStore + ProcessGroupNCCL bring-up (parallel.py:1134)."""
-    global _initialized
+    """Connect this process into the job (parallel.py:978 analog).
+
+    Single process: no-op beyond env parsing. Multi-process
+    (PADDLE_TRAINERS_NUM>1): every rank joins the TCPStore rendezvous
+    (rank 0 hosts the server) and a default ProcessGroup is created over
+    it — the store-transport analog of the reference's TCPStore +
+    ProcessGroupNCCL bring-up (parallel.py:1134). When
+    PADDLE_USE_JAX_DIST=1 the ranks additionally wire PJRT across DCN via
+    jax.distributed.initialize so in-graph collectives span hosts."""
+    global _initialized, _default_pg
     if _initialized:
         return ParallelEnv()
     env = ParallelEnv()
-    if env.world_size > 1 and not jax.process_count() > 1:
-        coordinator = env.trainer_endpoints[0] if env.trainer_endpoints \
-            else "127.0.0.1:8476"
-        try:
-            jax.distributed.initialize(
-                coordinator_address=coordinator,
-                num_processes=env.world_size,
-                process_id=env.rank)
-        except Exception as e:  # pragma: no cover - needs real multihost
-            raise RuntimeError(
-                f"multi-host init failed (coordinator {coordinator}): {e}")
+    if env.world_size > 1:
+        if os.environ.get("PADDLE_USE_JAX_DIST") == "1" \
+                and not jax.process_count() > 1:
+            coordinator = env.trainer_endpoints[0] \
+                if env.trainer_endpoints else "127.0.0.1:8476"
+            try:
+                jax.distributed.initialize(
+                    coordinator_address=coordinator,
+                    num_processes=env.world_size,
+                    process_id=env.rank)
+            except Exception as e:  # pragma: no cover - real multihost
+                raise RuntimeError(
+                    f"multi-host init failed ({coordinator}): {e}")
+        from .process_group import ProcessGroup
+        from .store import create_or_get_global_tcp_store
+        store = create_or_get_global_tcp_store()
+        _default_pg = ProcessGroup(store, env.rank,
+                                   list(range(env.world_size)), gid=0)
     _initialized = True
     return env
+
+
+_default_pg = None
+
+
+def get_default_process_group():
+    """The store-backed default ProcessGroup, or None before
+    init_parallel_env (or in single-process mode)."""
+    return _default_pg
 
 
 def is_initialized() -> bool:

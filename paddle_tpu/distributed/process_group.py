@@ -1,0 +1,248 @@
+"""Host-driven multi-process collective backend (ProcessGroup).
+
+TPU-native analog of the reference's ProcessGroup stack
+(paddle/phi/core/distributed/collective/process_group.h:130-246 and
+process_group_gloo.cc): every trainer process joins a TCPStore rendezvous
+(csrc/tcp_store.cc) and eager collectives move host tensors through the
+store — the gloo-analog fallback transport. The hot path stays in-graph
+(XLA collectives over ICI emitted by GSPMD/shard_map); this backend serves
+the framework-level eager surface: gradient sync outside jit, object
+broadcast, checkpoint coordination, send/recv for host-driven pipelines.
+
+Wire format per tensor: a small npy-like header (dtype, shape) + raw
+bytes. Keys are namespaced ``__pg/<gid>/<seq>/...``; every collective
+bumps a per-group sequence number (all ranks execute the same collective
+sequence, the same contract the reference's ProcessGroup relies on), and
+the last rank out deletes the round's keys so the store doesn't grow with
+training steps.
+"""
+from __future__ import annotations
+
+import json
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+_REDUCE_FNS = {
+    "sum": lambda acc, x: acc + x,
+    "max": np.maximum,
+    "min": np.minimum,
+    "prod": lambda acc, x: acc * x,
+    "avg": lambda acc, x: acc + x,  # divided by nranks at the end
+}
+
+
+def _encode(arr: np.ndarray) -> bytes:
+    # custom header (not np.save): supports ml_dtypes like bfloat16
+    arr = np.ascontiguousarray(arr)
+    head = json.dumps({"dtype": arr.dtype.name,
+                       "shape": list(arr.shape)}).encode()
+    return len(head).to_bytes(4, "little") + head + arr.tobytes()
+
+
+def _lookup_dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _decode(data: bytes) -> np.ndarray:
+    n = int.from_bytes(data[:4], "little")
+    head = json.loads(data[4:4 + n].decode())
+    dt = _lookup_dtype(head["dtype"])
+    return np.frombuffer(data[4 + n:], dtype=dt).reshape(head["shape"])
+
+
+class ProcessGroup:
+    """A set of ranks sharing a store-backed collective transport.
+
+    ``ranks`` are global ranks; collectives address peers by group rank.
+    All ranks in the group must execute the same collective sequence
+    (process_group.h contract).
+    """
+
+    def __init__(self, store, global_rank: int, ranks: Sequence[int],
+                 gid: int = 0, timeout: Optional[float] = None):
+        self.store = store
+        self.ranks = list(ranks)
+        self.gid = gid
+        self.global_rank = global_rank
+        self.rank = self.ranks.index(global_rank) \
+            if global_rank in self.ranks else -1
+        self.size = len(self.ranks)
+        self.timeout = timeout
+        self._seq = 0
+        self._barrier_round = 0
+        self._p2p_seq = {}  # (src_grank, dst_grank) -> seq
+
+    # ------------------------------------------------------------ plumbing
+    def _next(self) -> str:
+        self._seq += 1
+        return f"__pg/{self.gid}/{self._seq}"
+
+    def _publish(self, base: str, arr: np.ndarray, tag=None) -> None:
+        tag = self.rank if tag is None else tag
+        self.store.set(f"{base}/{tag}", _encode(arr))
+
+    def _fetch(self, base: str, tag) -> np.ndarray:
+        return _decode(self.store.get(f"{base}/{tag}"))
+
+    def _retire(self, base: str, keys: List[str]) -> None:
+        """Mark this rank done with the round; last rank deletes keys."""
+        done = self.store.add(f"{base}/__done", 1)
+        if done >= self.size:
+            for k in keys:
+                self.store.delete(k)
+            self.store.delete(f"{base}/__done")
+
+    # ---------------------------------------------------------- collectives
+    def all_gather(self, arr: np.ndarray) -> List[np.ndarray]:
+        base = self._next()
+        self._publish(base, arr)
+        out = [self._fetch(base, r) for r in range(self.size)]
+        self._retire(base, [f"{base}/{r}" for r in range(self.size)])
+        return out
+
+    def all_reduce(self, arr: np.ndarray, op: str = "sum") -> np.ndarray:
+        parts = self.all_gather(arr)
+        fn = _REDUCE_FNS[op]
+        acc = parts[0].astype(np.float64) if op in ("sum", "avg", "prod") \
+            and np.issubdtype(parts[0].dtype, np.floating) else parts[0]
+        for p in parts[1:]:
+            acc = fn(acc, p)
+        if op == "avg":
+            acc = acc / self.size
+        return np.asarray(acc, dtype=arr.dtype)
+
+    def broadcast(self, arr: np.ndarray, src: int) -> np.ndarray:
+        base = self._next()
+        if self.rank == src:
+            self._publish(base, arr, tag="src")
+        out = self._fetch(base, "src")
+        self._retire(base, [f"{base}/src"])
+        return out
+
+    def reduce(self, arr: np.ndarray, dst: int, op: str = "sum"):
+        # all ranks publish once; only dst fetches + reduces
+        # (process_group.h Reduce semantics, O(n*M) store traffic)
+        base = self._next()
+        self._publish(base, arr)
+        out = arr
+        if self.rank == dst:
+            fn = _REDUCE_FNS[op]
+            acc = self._fetch(base, 0)
+            if op in ("sum", "avg", "prod") and \
+                    np.issubdtype(acc.dtype, np.floating):
+                acc = acc.astype(np.float64)
+            for r in range(1, self.size):
+                acc = fn(acc, self._fetch(base, r))
+            if op == "avg":
+                acc = acc / self.size
+            out = np.asarray(acc, dtype=arr.dtype)
+        self._retire(base, [f"{base}/{r}" for r in range(self.size)])
+        return out
+
+    def reduce_scatter(self, parts: Sequence[np.ndarray],
+                       op: str = "sum") -> np.ndarray:
+        """parts: one array per group rank; returns the reduction of every
+        rank's parts[self.rank]."""
+        base = self._next()
+        for r, p in enumerate(parts):
+            self._publish(base, np.asarray(p), tag=f"{self.rank}_{r}")
+        fn = _REDUCE_FNS[op]
+        acc = self._fetch(base, f"0_{self.rank}")
+        for r in range(1, self.size):
+            acc = fn(acc, self._fetch(base, f"{r}_{self.rank}"))
+        if op == "avg":
+            acc = acc / self.size
+        keys = [f"{base}/{s}_{r}" for s in range(self.size)
+                for r in range(self.size)]
+        self._retire(base, keys)
+        return np.asarray(acc, dtype=np.asarray(parts[0]).dtype)
+
+    def scatter(self, parts: Optional[Sequence[np.ndarray]],
+                src: int) -> np.ndarray:
+        base = self._next()
+        if self.rank == src:
+            for r, p in enumerate(parts):
+                self._publish(base, np.asarray(p), tag=r)
+        out = self._fetch(base, self.rank)
+        self._retire(base, [f"{base}/{r}" for r in range(self.size)])
+        return out
+
+    def gather(self, arr: np.ndarray, dst: int):
+        base = self._next()
+        self._publish(base, arr)
+        out = None
+        if self.rank == dst:
+            out = [self._fetch(base, r) for r in range(self.size)]
+        self._retire(base, [f"{base}/{r}" for r in range(self.size)])
+        return out
+
+    def all_to_all(self, parts: Sequence[np.ndarray]) -> List[np.ndarray]:
+        base = self._next()
+        for r, p in enumerate(parts):
+            self._publish(base, np.asarray(p), tag=f"{self.rank}_{r}")
+        out = [self._fetch(base, f"{r}_{self.rank}")
+               for r in range(self.size)]
+        keys = [f"{base}/{s}_{r}" for s in range(self.size)
+                for r in range(self.size)]
+        self._retire(base, keys)
+        return out
+
+    # -------------------------------------------------------------- P2P
+    def send(self, arr: np.ndarray, dst: int) -> None:
+        """dst is a group rank. Keyed by an independent per-(src,dst)
+        sequence so P2P does not have to be globally ordered across the
+        group (p2p_communication.py analog)."""
+        pair = (self.rank, dst)
+        seq = self._p2p_seq.get(pair, 0)
+        self._p2p_seq[pair] = seq + 1
+        key = f"__pg/{self.gid}/p2p/{self.rank}_{dst}/{seq}"
+        self.store.set(key, _encode(np.asarray(arr)))
+
+    def recv(self, src: int) -> np.ndarray:
+        pair = (src, self.rank)
+        seq = self._p2p_seq.get(pair, 0)
+        self._p2p_seq[pair] = seq + 1
+        key = f"__pg/{self.gid}/p2p/{src}_{self.rank}/{seq}"
+        out = _decode(self.store.get(key))
+        self.store.delete(key)
+        return out
+
+    # ------------------------------------------------------------ control
+    def barrier(self) -> None:
+        """Group barrier: counts to the GROUP size (store.barrier counts
+        to the global world size and would deadlock on subgroups).
+        Reusable via a local round counter; last rank out cleans up."""
+        rnd = self._barrier_round
+        self._barrier_round += 1
+        base = f"__pg/{self.gid}/bar/{rnd}"
+        arrived = self.store.add(f"{base}/count", 1)
+        if arrived >= self.size:
+            self.store.set(f"{base}/done", b"1")
+        self.store.wait(f"{base}/done", self.timeout)
+        left = self.store.add(f"{base}/left", 1)
+        if left >= self.size:
+            for suffix in ("count", "done", "left"):
+                self.store.delete(f"{base}/{suffix}")
+
+    def broadcast_object(self, obj, src: int):
+        import pickle
+        base = self._next()
+        if self.rank == src:
+            self.store.set(f"{base}/obj", pickle.dumps(obj))
+        data = self.store.get(f"{base}/obj")
+        self._retire(base, [f"{base}/obj"])
+        return pickle.loads(data)
+
+    def all_gather_object(self, obj) -> list:
+        import pickle
+        base = self._next()
+        self.store.set(f"{base}/{self.rank}", pickle.dumps(obj))
+        out = [pickle.loads(self.store.get(f"{base}/{r}"))
+               for r in range(self.size)]
+        self._retire(base, [f"{base}/{r}" for r in range(self.size)])
+        return out

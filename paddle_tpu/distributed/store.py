@@ -21,6 +21,7 @@ class TCPStore:
         self._lib = native.get_lib(required=True)
         self._server = None
         self._timeout_ms = int(timeout * 1000)
+        self._barrier_rounds = {}
         if is_master:
             self._server = self._lib.pt_store_server_start(port)
             if not self._server:
@@ -72,13 +73,29 @@ class TCPStore:
         if self._lib.pt_store_wait(self._client, key.encode(), ms) != 0:
             raise RuntimeError(f"TCPStore.wait('{key}') timed out")
 
+    def delete(self, key: str) -> None:
+        if self._lib.pt_store_del(self._client, key.encode()) != 0:
+            raise RuntimeError(f"TCPStore.delete failed: "
+                               f"{native.last_error()}")
+
     def barrier(self, key: str = "barrier", timeout: Optional[float] = None):
         """All world_size ranks arrive, then proceed (barrier-by-key, the
-        reference's store-barrier pattern)."""
-        arrived = self.add(f"__bar/{key}/count", 1)
+        reference's store-barrier pattern).
+
+        Reusable: every use of a key gets a fresh round number (all ranks
+        call barrier the same number of times, so local counters agree),
+        and the last rank out deletes the round's keys."""
+        rnd = self._barrier_rounds.get(key, 0)
+        self._barrier_rounds[key] = rnd + 1
+        base = f"__bar/{key}/{rnd}"
+        arrived = self.add(f"{base}/count", 1)
         if arrived >= self.world_size:
-            self.set(f"__bar/{key}/done", b"1")
-        self.wait(f"__bar/{key}/done", timeout)
+            self.set(f"{base}/done", b"1")
+        self.wait(f"{base}/done", timeout)
+        left = self.add(f"{base}/left", 1)
+        if left >= self.world_size:
+            for suffix in ("count", "done", "left"):
+                self.delete(f"{base}/{suffix}")
 
     # ---------------------------------------------------------- lifecycle
     def _close_server(self):
