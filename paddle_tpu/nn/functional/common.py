@@ -111,14 +111,62 @@ def cosine_similarity(x1, x2, axis=1, eps=1e-8):
                  eps=float(eps))
 
 
+def _lin_1d_align(x, out_len, axis):
+    """Linear resize along one axis with align_corners=True semantics:
+    src = i * (in-1)/(out-1) (jax.image.resize only does half-pixel)."""
+    in_len = x.shape[axis]
+    if out_len == 1 or in_len == 1:
+        return jnp.take(x, jnp.zeros(out_len, jnp.int32), axis=axis)
+    pos = jnp.linspace(0.0, in_len - 1.0, out_len)
+    lo = jnp.clip(jnp.floor(pos).astype(jnp.int32), 0, in_len - 1)
+    hi = jnp.clip(lo + 1, 0, in_len - 1)
+    frac = (pos - lo).astype(x.dtype)
+    shape = [1] * x.ndim
+    shape[axis] = out_len
+    frac = frac.reshape(shape)
+    xlo = jnp.take(x, lo, axis=axis)
+    xhi = jnp.take(x, hi, axis=axis)
+    return xlo * (1 - frac) + xhi * frac
+
+
+def _cubic_1d_align(x, out_len, axis, A=-0.75):
+    """Keys-cubic resize along one axis, align_corners=True sampling
+    (src = i*(in-1)/(out-1)), edge-clamped taps like the reference."""
+    in_len = x.shape[axis]
+    if out_len == 1 or in_len == 1:
+        return jnp.take(x, jnp.zeros(out_len, jnp.int32), axis=axis)
+    pos = jnp.linspace(0.0, in_len - 1.0, out_len)
+    base = jnp.floor(pos).astype(jnp.int32)
+    f = (pos - base).astype(x.dtype)
+    # Keys kernel weights at distances 1+f, f, 1-f, 2-f
+    def near(d):
+        return ((A + 2) * d - (A + 3)) * d * d + 1
+    def far(d):
+        return A * (((d - 5) * d + 8) * d - 4)
+    ws = [far(1 + f), near(f), near(1 - f), far(2 - f)]
+    out = None
+    shape = [1] * x.ndim
+    shape[axis] = out_len
+    for tap, w in zip((-1, 0, 1, 2), ws):
+        idx = jnp.clip(base + tap, 0, in_len - 1)
+        term = jnp.take(x, idx, axis=axis) * w.reshape(shape)
+        out = term if out is None else out + term
+    return out
+
+
 def _interp_kernel(x, size, mode, align_corners, data_format):
     if data_format == "NCHW":
         x = jnp.transpose(x, (0, 2, 3, 1))
     n, h, w, c = x.shape
     oh, ow = size
-    method = {"nearest": "nearest", "bilinear": "linear",
-              "bicubic": "cubic", "area": "linear"}[mode]
-    out = jax.image.resize(x, (n, oh, ow, c), method=method)
+    if align_corners and mode in ("bilinear", "linear", "trilinear"):
+        out = _lin_1d_align(_lin_1d_align(x, oh, 1), ow, 2)
+    elif align_corners and mode == "bicubic":
+        out = _cubic_1d_align(_cubic_1d_align(x, oh, 1), ow, 2)
+    else:
+        method = {"nearest": "nearest", "bilinear": "linear",
+                  "bicubic": "cubic", "area": "linear"}[mode]
+        out = jax.image.resize(x, (n, oh, ow, c), method=method)
     if data_format == "NCHW":
         out = jnp.transpose(out, (0, 3, 1, 2))
     return out
@@ -130,6 +178,11 @@ register_op("interpolate_k", _interp_kernel)
 def interpolate(x, size=None, scale_factor=None, mode="nearest",
                 align_corners=False, align_mode=0, data_format="NCHW",
                 name=None):
+    if align_corners and mode in ("nearest", "area"):
+        # same contract as the reference interpolate: align_corners only
+        # pairs with linear/cubic sampling
+        raise ValueError(
+            f"align_corners=True is incompatible with mode='{mode}'")
     if size is None:
         if data_format == "NCHW":
             h, w = x.shape[2], x.shape[3]

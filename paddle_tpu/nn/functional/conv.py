@@ -7,6 +7,7 @@ from __future__ import annotations
 
 import numbers
 
+import jax.numpy as jnp
 from jax import lax
 
 from ..._core.executor import apply
@@ -76,26 +77,33 @@ def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
 
 def _conv_transpose_kernel(x, w, b, stride, padding, output_padding,
                            dilation, groups, dims, fmt):
+    # paddle transpose-conv weight layout: [in, out/groups, *k] (IO...).
+    # lax.conv_general_dilated has no transpose_kernel arg, so build the
+    # equivalent forward kernel explicitly: per-group swap of in/out
+    # channels plus a spatial flip, then a fractionally-strided conv
+    # (lhs_dilation=stride).
+    k_sp = tuple(w.shape[2:2 + dims])
+    cin, coutg = w.shape[0], w.shape[1]
+    wk = w.reshape((groups, cin // groups, coutg) + k_sp)
+    wk = jnp.swapaxes(wk, 1, 2)
+    wk = wk.reshape((groups * coutg, cin // groups) + k_sp)
+    wk = jnp.flip(wk, axis=tuple(range(2, 2 + dims)))
     if fmt == "NCHW":
-        dn = ("NCHW", "IOHW", "NCHW") if dims == 2 else ("NCW", "IOW", "NCW")
+        dn = ("NCHW", "OIHW", "NCHW") if dims == 2 else ("NCW", "OIW", "NCW")
     else:
         dn = ("NHWC", "HWIO", "NHWC")
-        w = w.transpose(2, 3, 0, 1)
-    # paddle weight layout for transpose conv: [in, out/groups, kH, kW] (IOHW)
+        wk = wk.transpose(tuple(range(2, 2 + dims)) + (1, 0))
     pads = []
-    kernel_spatial = w.shape[2:2 + dims] if fmt == "NCHW" else w.shape[:dims]
     for i in range(dims):
-        k = (kernel_spatial[i] - 1) * dilation[i] + 1
+        k = (k_sp[i] - 1) * dilation[i] + 1
         if isinstance(padding, str):
             raise ValueError("string padding unsupported for conv_transpose")
         lo, hi = padding[i]
         pads.append((k - 1 - lo, k - 1 - hi + output_padding[i]))
     out = lax.conv_general_dilated(
-        x, w if groups == 1 else w,
-        window_strides=(1,) * dims, padding=pads,
+        x, wk, window_strides=(1,) * dims, padding=pads,
         lhs_dilation=stride, rhs_dilation=dilation, dimension_numbers=dn,
-        feature_group_count=groups,
-        transpose_kernel=True)
+        feature_group_count=groups)
     if b is not None:
         if fmt == "NCHW":
             out = out + b.reshape((1, -1) + (1,) * dims)

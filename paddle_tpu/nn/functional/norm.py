@@ -8,6 +8,8 @@ rms_norm matches the reference's fused kernel surface
 from __future__ import annotations
 
 import jax.numpy as jnp
+import numpy as np
+from jax import lax
 
 from ..._core.executor import apply
 from ..._core.op_registry import register_op
@@ -147,6 +149,26 @@ def instance_norm(x, running_mean=None, running_var=None, weight=None,
                  groups=int(c), eps=float(eps), fmt=data_format)
 
 
+def _lrn_kernel(x, size, alpha, beta, k, fmt):
+    # ImageNet-paper LRN over the channel window (nn/functional/norm.py
+    # local_response_norm in the reference): x / (k + alpha*mean(x^2))^beta
+    # pre-pad size//2, post-pad (size-1)//2 — the reference's split for
+    # even windows
+    ax = 1 if fmt.startswith("NC") else x.ndim - 1
+    win = [1] * x.ndim
+    win[ax] = size
+    pads = [(0, 0)] * x.ndim
+    pads[ax] = (size // 2, (size - 1) // 2)
+    ssum = lax.reduce_window(x * x, np.array(0, x.dtype), lax.add,
+                             tuple(win), (1,) * x.ndim, tuple(pads))
+    return x / (k + alpha * ssum / size) ** beta
+
+
+register_op("local_response_norm_k", _lrn_kernel)
+
+
 def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
                         data_format="NCHW", name=None):
-    raise NotImplementedError
+    return apply("local_response_norm_k", x, size=int(size),
+                 alpha=float(alpha), beta=float(beta), k=float(k),
+                 fmt=data_format)

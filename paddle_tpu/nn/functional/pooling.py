@@ -5,6 +5,7 @@ from __future__ import annotations
 import numbers
 
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from ..._core.executor import apply
@@ -62,11 +63,14 @@ def _avg_pool_kernel(x, ksize, stride, padding, fmt, dims, exclusive):
         strides = (1,) + stride + (1,)
         pads = ((0, 0),) + padding + ((0, 0),) if not isinstance(
             padding, str) else padding
-    zero = jnp.array(0, x.dtype)
+    # init must be a host literal (np scalar, NOT jnp.array): under jit a
+    # device constant defeats the monoid detection and reduce_window loses
+    # its transpose rule, breaking the backward pass
+    zero = np.array(0, x.dtype)
     summed = lax.reduce_window(x, zero, lax.add, window, strides, pads)
     if exclusive and not isinstance(padding, str):
         ones = jnp.ones_like(x)
-        counts = lax.reduce_window(ones, jnp.array(0, x.dtype), lax.add,
+        counts = lax.reduce_window(ones, np.array(0, x.dtype), lax.add,
                                    window, strides, pads)
         return summed / counts
     denom = 1

@@ -98,19 +98,26 @@ def eye(num_rows, num_columns=None, dtype=None, name=None):
     return Tensor(jnp.eye(num_rows, num_columns, dtype=_np_dtype(dtype)))
 
 
-def diag(x, offset=0, padding_value=0, name=None):
-    v = x._value
-    if v.ndim == 1:
-        out = jnp.diag(v, k=offset)
+def _diag_k(x, offset, padding_value):
+    if x.ndim == 1:
+        out = jnp.diag(x, k=offset)
         if padding_value != 0:
-            mask = jnp.diag(jnp.ones_like(v, dtype=bool), k=offset)
+            mask = jnp.diag(jnp.ones_like(x, dtype=bool), k=offset)
             out = jnp.where(mask, out, padding_value)
-        return Tensor(out)
-    return Tensor(jnp.diag(v, k=offset))
+        return out
+    return jnp.diag(x, k=offset)
+
+
+register_op("diag_", _diag_k)
+register_op("diagflat_", lambda x, offset: jnp.diagflat(x, k=offset))
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    return apply("diag_", x, offset=offset, padding_value=padding_value)
 
 
 def diagflat(x, offset=0, name=None):
-    return Tensor(jnp.diagflat(x._value, k=offset))
+    return apply("diagflat_", x, offset=offset)
 
 
 def meshgrid(*args, **kwargs):

@@ -1,0 +1,273 @@
+"""Table-driven per-op tests through the OpTest harness (op_test.py).
+
+Burn-down of the reference's per-op test files (test/legacy_test/
+test_*_op.py backed by op_test.py): each CASE drives a public API through
+check_output (vs NumPy/SciPy) and, where differentiable, check_grad
+(analytic autograd vs central differences).
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+import scipy.special as sps
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from op_test import check_grad, check_output
+
+RNG = np.random.RandomState(7)
+
+
+def any_(*s):
+    return RNG.uniform(-2.0, 2.0, s).astype("float32")
+
+
+def pos(*s):
+    return RNG.uniform(0.3, 3.0, s).astype("float32")
+
+
+def unit(*s):  # open (-1, 1), away from the edges
+    return RNG.uniform(-0.9, 0.9, s).astype("float32")
+
+
+def prob(*s):  # open (0, 1)
+    return RNG.uniform(0.05, 0.95, s).astype("float32")
+
+
+def gt1(*s):
+    return RNG.uniform(1.1, 3.0, s).astype("float32")
+
+
+def nonzero(*s):
+    x = RNG.uniform(0.5, 2.0, s) * RNG.choice([-1.0, 1.0], s)
+    return x.astype("float32")
+
+
+def ints(*s, lo=0, hi=8):
+    return RNG.randint(lo, hi, s).astype("int32")
+
+
+def bools(*s):
+    return RNG.rand(*s) > 0.5
+
+
+class Case:
+    def __init__(self, name, api, inputs, ref, attrs=None, grad=True,
+                 wrt=None, rtol=1e-4, atol=1e-5, gtol=5e-3, gdelta=5e-3):
+        self.name, self.api, self.inputs, self.ref = name, api, inputs, ref
+        self.attrs, self.grad, self.wrt = attrs or {}, grad, wrt
+        self.rtol, self.atol, self.gtol = rtol, atol, gtol
+        self.gdelta = gdelta
+
+
+def U(name, ref, gen=any_, grad=True, api=None, shape=(3, 4), **kw):
+    """Unary elementwise op."""
+    return Case(name, api or getattr(paddle, name), [gen(*shape)], ref,
+                grad=grad, **kw)
+
+
+def B(name, ref, gx=any_, gy=any_, grad=True, api=None, **kw):
+    """Binary elementwise op with a broadcast (3,4)x(4,) pair."""
+    return Case(name, api or getattr(paddle, name),
+                [gx(3, 4), gy(4)], ref, grad=grad, **kw)
+
+
+CASES = [
+    # ---------------------------------------------- unary math (ops.yaml)
+    U("abs", np.abs, gen=nonzero),
+    U("acos", np.arccos, gen=unit),
+    U("acosh", np.arccosh, gen=gt1),
+    U("asin", np.arcsin, gen=unit),
+    U("asinh", np.arcsinh),
+    U("atan", np.arctan),
+    U("atanh", np.arctanh, gen=unit),
+    U("ceil", np.ceil, grad=False),
+    U("cos", np.cos),
+    U("cosh", np.cosh),
+    U("digamma", sps.digamma, gen=pos),
+    U("erf", sps.erf),
+    U("erfinv", sps.erfinv, gen=unit),
+    U("exp", np.exp),
+    U("expm1", np.expm1),
+    U("floor", np.floor, grad=False),
+    U("frac", lambda x: x - np.trunc(x), gen=nonzero),
+    U("lgamma", sps.gammaln, gen=pos),
+    U("log", np.log, gen=pos),
+    U("log10", np.log10, gen=pos),
+    U("log1p", np.log1p, gen=pos),
+    U("log2", np.log2, gen=pos),
+    U("logit", sps.logit, gen=prob),
+    U("neg", np.negative),
+    U("reciprocal", np.reciprocal, gen=pos),
+    U("round", np.round, grad=False),
+    U("rsqrt", lambda x: 1.0 / np.sqrt(x), gen=pos),
+    U("sigmoid", sps.expit),
+    U("sign", np.sign, gen=nonzero, grad=False),
+    U("sin", np.sin),
+    U("sinh", np.sinh),
+    U("sqrt", np.sqrt, gen=pos),
+    U("square", np.square),
+    U("stanh", lambda x, scale_a=0.67, scale_b=1.7159:
+      scale_b * np.tanh(scale_a * x)),
+    U("tan", np.tan, gen=unit),
+    U("tanh", np.tanh),
+    U("trunc", np.trunc, gen=nonzero, grad=False),
+    U("angle", np.angle, gen=nonzero, grad=False),
+    U("conj", np.conj),
+    U("isfinite", np.isfinite, grad=False),
+    U("isinf", np.isinf, grad=False),
+    U("isnan", np.isnan, grad=False),
+    Case("nan_to_num", paddle.nan_to_num,
+         [np.array([[1.0, np.nan, np.inf], [-np.inf, 2.0, 3.0]], "float32")],
+         np.nan_to_num, grad=False),
+    Case("scale", paddle.scale, [any_(3, 4)],
+         lambda x, scale, bias: x * scale + bias,
+         attrs={"scale": 2.5, "bias": 0.5}),
+    Case("increment", paddle.increment, [any_(1)],
+         lambda x, value: x + value, attrs={"value": 2.0}),
+    Case("clip", paddle.clip, [any_(3, 4)],
+         lambda x, min, max: np.clip(x, min, max),
+         attrs={"min": -1.0, "max": 1.0}),
+    Case("logical_not", paddle.logical_not, [bools(3, 4)],
+         np.logical_not, grad=False),
+    Case("bitwise_not", paddle.bitwise_not, [ints(3, 4)],
+         np.bitwise_not, grad=False),
+
+    # ----------------------------------------------------- binary math
+    B("add", np.add),
+    B("subtract", np.subtract),
+    B("multiply", np.multiply),
+    B("divide", np.divide, gy=nonzero),
+    B("pow", lambda x, y: np.power(x, y), gx=pos),
+    B("maximum", np.maximum),
+    B("minimum", np.minimum),
+    B("fmax", np.fmax),
+    B("fmin", np.fmin),
+    B("atan2", np.arctan2, gx=nonzero, gy=nonzero),
+    B("hypot", np.hypot, gx=nonzero, gy=nonzero),
+    B("copysign", np.copysign, gy=nonzero, grad=False),
+    B("heaviside", np.heaviside, gx=nonzero, grad=False),
+    B("logaddexp", np.logaddexp),
+    B("nextafter", np.nextafter, grad=False),
+    B("floor_divide", np.floor_divide, gy=nonzero, grad=False),
+    B("mod", lambda x, y: np.mod(x, y), gy=pos, grad=False),
+    B("remainder", lambda x, y: np.mod(x, y), gy=pos, grad=False),
+    Case("ldexp", paddle.ldexp, [any_(3, 4), ints(3, 4, lo=-2, hi=3)],
+         lambda x, y: np.ldexp(x, y), grad=False),
+    Case("lcm", paddle.lcm, [ints(3, 4, lo=1, hi=12),
+                             ints(3, 4, lo=1, hi=12)],
+         np.lcm, grad=False),
+    Case("gcd", paddle.gcd, [ints(3, 4, lo=1, hi=12),
+                             ints(3, 4, lo=1, hi=12)],
+         np.gcd, grad=False),
+    Case("lerp", paddle.lerp, [any_(3, 4), any_(3, 4), prob(3, 4)],
+         lambda x, y, w: x + w * (y - x)),
+
+    # ------------------------------------------------------- comparisons
+    B("equal", np.equal, grad=False),
+    B("not_equal", np.not_equal, grad=False),
+    B("greater_equal", np.greater_equal, grad=False),
+    B("greater_than", np.greater, grad=False),
+    B("less_equal", np.less_equal, grad=False),
+    B("less_than", np.less, grad=False),
+    Case("logical_and", paddle.logical_and, [bools(3, 4), bools(3, 4)],
+         np.logical_and, grad=False),
+    Case("logical_or", paddle.logical_or, [bools(3, 4), bools(3, 4)],
+         np.logical_or, grad=False),
+    Case("logical_xor", paddle.logical_xor, [bools(3, 4), bools(3, 4)],
+         np.logical_xor, grad=False),
+    Case("bitwise_and", paddle.bitwise_and, [ints(3, 4), ints(3, 4)],
+         np.bitwise_and, grad=False),
+    Case("bitwise_or", paddle.bitwise_or, [ints(3, 4), ints(3, 4)],
+         np.bitwise_or, grad=False),
+    Case("bitwise_xor", paddle.bitwise_xor, [ints(3, 4), ints(3, 4)],
+         np.bitwise_xor, grad=False),
+    Case("isclose", paddle.isclose, [any_(3, 4), any_(3, 4)],
+         np.isclose, grad=False),
+    Case("allclose", paddle.allclose, [any_(3, 4), any_(3, 4)],
+         np.allclose, grad=False),
+    Case("equal_all", paddle.equal_all, [any_(3, 4), any_(3, 4)],
+         np.array_equal, grad=False),
+
+    # -------------------------------------------------------- reductions
+    Case("sum", paddle.sum, [any_(3, 4)], lambda x: np.sum(x)),
+    Case("sum_axis", paddle.sum, [any_(3, 4)],
+         lambda x, axis, keepdim: np.sum(x, axis=axis, keepdims=keepdim),
+         attrs={"axis": 1, "keepdim": True}),
+    Case("mean", paddle.mean, [any_(3, 4)], lambda x: np.mean(x)),
+    Case("mean_axis", paddle.mean, [any_(3, 4)],
+         lambda x, axis: np.mean(x, axis=axis), attrs={"axis": 0}),
+    Case("prod", paddle.prod, [pos(3, 4)], lambda x: np.prod(x),
+         gtol=1e-2),
+    Case("max", paddle.max, [any_(3, 4)], lambda x: np.max(x)),
+    Case("min", paddle.min, [any_(3, 4)], lambda x: np.min(x)),
+    Case("amax", paddle.amax, [any_(3, 4)],
+         lambda x, axis: np.max(x, axis=axis), attrs={"axis": 1}),
+    Case("amin", paddle.amin, [any_(3, 4)],
+         lambda x, axis: np.min(x, axis=axis), attrs={"axis": 1}),
+    Case("logsumexp", paddle.logsumexp, [any_(3, 4)],
+         lambda x: sps.logsumexp(x)),
+    Case("std", paddle.std, [any_(3, 4)], lambda x: np.std(x, ddof=1)),
+    Case("var", paddle.var, [any_(3, 4)], lambda x: np.var(x, ddof=1)),
+    Case("median", paddle.median, [any_(3, 5)], lambda x: np.median(x),
+         grad=False),
+    Case("nanmean", paddle.nanmean,
+         [np.array([[1.0, np.nan, 3.0], [4.0, 5.0, np.nan]], "float32")],
+         lambda x: np.nanmean(x), grad=False),
+    Case("nansum", paddle.nansum,
+         [np.array([[1.0, np.nan, 3.0], [4.0, 5.0, np.nan]], "float32")],
+         lambda x: np.nansum(x), grad=False),
+    Case("nanmedian", paddle.nanmedian,
+         [np.array([[1.0, np.nan, 3.0, 7.0], [4.0, 5.0, np.nan, 2.0]],
+                   "float32")],
+         lambda x: np.nanmedian(x), grad=False),
+    Case("all", paddle.all, [bools(3, 4)], lambda x: np.all(x),
+         grad=False),
+    Case("any", paddle.any, [bools(3, 4)], lambda x: np.any(x),
+         grad=False),
+    Case("count_nonzero", paddle.count_nonzero, [ints(3, 4, lo=0, hi=3)],
+         lambda x: np.count_nonzero(x), grad=False),
+    Case("numel", paddle.numel, [any_(3, 4)], lambda x: x.size,
+         grad=False),
+    Case("quantile", paddle.quantile, [any_(3, 5)],
+         lambda x, q: np.quantile(x, q).astype("float32"),
+         attrs={"q": 0.5}, grad=False),
+    Case("cumsum", paddle.cumsum, [any_(3, 4)],
+         lambda x, axis: np.cumsum(x, axis=axis), attrs={"axis": 1}),
+    Case("cumprod", paddle.cumprod, [pos(3, 4)],
+         lambda x, dim: np.cumprod(x, axis=dim), attrs={"dim": 1},
+         gtol=1e-2),
+    Case("logcumsumexp", paddle.logcumsumexp, [any_(3, 4)],
+         lambda x, axis: np.log(np.cumsum(np.exp(x), axis=axis)),
+         attrs={"axis": 1}),
+    Case("trapezoid", paddle.trapezoid, [any_(5)],
+         lambda y: np.trapezoid(y)),
+    Case("diff", paddle.diff, [any_(3, 5)],
+         lambda x: np.diff(x)),
+]
+
+
+def _ids():
+    seen = {}
+    out = []
+    for c in CASES:
+        n = seen.get(c.name, 0)
+        seen[c.name] = n + 1
+        out.append(c.name if n == 0 else f"{c.name}#{n}")
+    return out
+
+
+@pytest.mark.parametrize("case", CASES, ids=_ids())
+def test_forward(case):
+    check_output(case.api, case.inputs, attrs=case.attrs, ref=case.ref,
+                 rtol=case.rtol, atol=case.atol)
+
+
+GRAD_CASES = [c for c in CASES if c.grad]
+
+
+@pytest.mark.parametrize("case", GRAD_CASES,
+                         ids=[c.name for c in GRAD_CASES])
+def test_grad(case):
+    check_grad(case.api, case.inputs, attrs=case.attrs, wrt=case.wrt,
+               max_relative_error=case.gtol, delta=case.gdelta)

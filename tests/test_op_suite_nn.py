@@ -1,0 +1,277 @@
+"""Per-op tests: nn.functional activations / losses / norms / conv / pool.
+
+Same OpTest harness; torch (CPU) is the oracle where NumPy has no
+closed form (reference: test/legacy_test/test_activation_op.py,
+test_conv2d_op.py, test_cross_entropy_loss.py, ...).
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+import scipy.special as sps
+import torch
+import torch.nn.functional as tF
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from op_test import check_grad, check_output
+from test_op_suite import Case, any_, ints, nonzero, pos, prob
+
+
+def _t(fn):
+    """Wrap a torch functional as a NumPy reference."""
+    def ref(*arrays, **attrs):
+        out = fn(*[torch.from_numpy(np.asarray(a).copy())
+                   for a in arrays], **attrs)
+        if isinstance(out, (tuple, list)):
+            return [o.numpy() for o in out]
+        return out.numpy()
+    return ref
+
+
+def NF(name, ref, gen=any_, shape=(3, 4), grad=True, attrs=None, **kw):
+    return Case(name, getattr(F, name), [gen(*shape)], ref, grad=grad,
+                attrs=attrs, **kw)
+
+
+CASES = [
+    # ------------------------------------------------------- activations
+    NF("relu", lambda x: np.maximum(x, 0), gen=nonzero),
+    NF("relu6", lambda x: np.clip(x, 0, 6), gen=nonzero),
+    NF("elu", _t(tF.elu)),
+    NF("celu", _t(tF.celu)),
+    NF("selu", _t(tF.selu)),
+    NF("silu", _t(tF.silu)),
+    NF("swish", _t(tF.silu)),
+    NF("mish", _t(tF.mish)),
+    NF("gelu", _t(tF.gelu), rtol=1e-3, atol=1e-4),
+    NF("hardshrink", _t(tF.hardshrink), gen=nonzero),
+    NF("hardsigmoid", lambda x: np.clip(x / 6 + 0.5, 0, 1), gen=nonzero),
+    NF("hardswish", _t(tF.hardswish), gen=nonzero),
+    NF("hardtanh", _t(tF.hardtanh), gen=nonzero),
+    NF("leaky_relu", lambda x, negative_slope=0.01:
+       np.where(x > 0, x, negative_slope * x), gen=nonzero),
+    NF("log_softmax", _t(lambda x: tF.log_softmax(x, dim=-1))),
+    NF("softmax", _t(lambda x: tF.softmax(x, dim=-1))),
+    NF("softplus", _t(tF.softplus)),
+    NF("softshrink", _t(tF.softshrink), gen=nonzero),
+    NF("softsign", lambda x: x / (1 + np.abs(x)), gen=nonzero),
+    NF("tanhshrink", _t(tF.tanhshrink)),
+    NF("thresholded_relu", lambda x, threshold=1.0:
+       np.where(x > threshold, x, 0.0), gen=nonzero),
+    NF("glu", _t(lambda x: tF.glu(x, dim=-1))),
+    NF("prelu", None, grad=False),  # signature checked in test_nn
+    Case("prelu", F.prelu, [any_(3, 4), np.array([0.25], "float32")],
+         lambda x, w: np.where(x > 0, x, w * x)),
+    Case("gumbel_softmax_shape",
+         lambda x: F.gumbel_softmax(x, temperature=1.0).sum(-1),
+         [any_(3, 4)], lambda x: np.ones(3, "float32"), grad=False),
+
+    # ------------------------------------------------------------ losses
+    Case("mse_loss", F.mse_loss, [any_(4, 3), any_(4, 3)],
+         _t(tF.mse_loss)),
+    Case("l1_loss", F.l1_loss, [any_(4, 3), any_(4, 3)],
+         _t(tF.l1_loss), gtol=1e-2),
+    Case("smooth_l1_loss", F.smooth_l1_loss, [any_(4, 3), any_(4, 3)],
+         _t(tF.smooth_l1_loss)),
+    Case("kl_div", F.kl_div, [np.log(prob(4, 3)), prob(4, 3)],
+         _t(lambda x, t: tF.kl_div(x, t, reduction="mean")),
+         rtol=1e-3),
+    Case("binary_cross_entropy", F.binary_cross_entropy,
+         [prob(4, 3), prob(4, 3)], _t(tF.binary_cross_entropy)),
+    Case("binary_cross_entropy_with_logits",
+         F.binary_cross_entropy_with_logits,
+         [any_(4, 3), prob(4, 3)],
+         _t(tF.binary_cross_entropy_with_logits)),
+    Case("cross_entropy", F.cross_entropy,
+         [any_(4, 5), np.array([0, 2, 4, 1])],
+         _t(lambda x, t: tF.cross_entropy(x, t.long())), wrt=[0]),
+    Case("cross_entropy_soft",
+         lambda x, t: F.cross_entropy(x, t, soft_label=True),
+         [any_(4, 5), sps.softmax(any_(4, 5), axis=-1)],
+         _t(lambda x, t: tF.cross_entropy(x, t)), wrt=[0]),
+    Case("nll_loss", F.nll_loss,
+         [np.log(prob(4, 5)), np.array([0, 2, 4, 1])],
+         _t(lambda x, t: tF.nll_loss(x, t.long())), wrt=[0]),
+    Case("softmax_with_cross_entropy", F.softmax_with_cross_entropy,
+         [any_(4, 5), np.array([[0], [2], [4], [1]])],
+         lambda x, t: -np.take_along_axis(
+             np.log(sps.softmax(x, -1)), t, -1), wrt=[0]),
+    Case("margin_ranking_loss", F.margin_ranking_loss,
+         [any_(4), any_(4), np.array([1., -1., 1., -1.], "float32")],
+         _t(lambda a, b, l: tF.margin_ranking_loss(a, b, l)),
+         wrt=[0, 1], gtol=1e-2),
+    Case("cosine_embedding_loss", F.cosine_embedding_loss,
+         [any_(4, 3), any_(4, 3), np.array([1, -1, 1, -1], "int32")],
+         _t(lambda a, b, l: tF.cosine_embedding_loss(a, b, l.long())),
+         wrt=[0, 1], rtol=1e-3, gtol=1e-2),
+    Case("sigmoid_focal_loss",
+         lambda x, l: F.sigmoid_focal_loss(x, l, reduction="mean"),
+         [any_(4, 3), (prob(4, 3) > 0.5).astype("float32")],
+         None, wrt=[0]),
+    Case("label_smooth", F.label_smooth,
+         [np.eye(4, 5, dtype="float32")],
+         lambda x, epsilon=0.1: x * (1 - epsilon) + epsilon / 5,
+         grad=False),
+    Case("cosine_similarity", F.cosine_similarity,
+         [any_(4, 3), any_(4, 3)],
+         _t(lambda a, b: tF.cosine_similarity(a, b)), rtol=1e-3,
+         gtol=1e-2),
+
+    # ------------------------------------------------------------- norms
+    Case("layer_norm",
+         lambda x, w, b: F.layer_norm(x, normalized_shape=[4], weight=w,
+                                      bias=b),
+         [any_(3, 4), pos(4), any_(4)],
+         _t(lambda x, w, b: tF.layer_norm(x, [4], w, b)), rtol=1e-3,
+         atol=1e-4, gtol=1e-2),
+    Case("rms_norm",
+         lambda x, w: F.rms_norm(x, w),
+         [any_(3, 4), pos(4)],
+         lambda x, w: (x / np.sqrt((x ** 2).mean(-1, keepdims=True)
+                                   + 1e-6)) * w,
+         rtol=1e-3, atol=1e-4, gtol=1e-2),
+    Case("normalize", F.normalize, [any_(3, 4)],
+         _t(lambda x: tF.normalize(x)), rtol=1e-3, gtol=1e-2),
+    Case("batch_norm_eval",
+         lambda x, rm, rv, w, b: F.batch_norm(
+             x, rm, rv, weight=w, bias=b, training=False),
+         [any_(4, 3), any_(3), pos(3), pos(3), any_(3)],
+         _t(lambda x, rm, rv, w, b:
+            tF.batch_norm(x, rm, rv, w, b, False)),
+         rtol=1e-3, atol=1e-4, wrt=[0], gtol=1e-2),
+    Case("group_norm",
+         lambda x, w, b: F.group_norm(x, num_groups=2, weight=w, bias=b),
+         [any_(2, 4, 3, 3), pos(4), any_(4)],
+         _t(lambda x, w, b: tF.group_norm(x, 2, w, b)), rtol=1e-3,
+         atol=1e-4, wrt=[0], gtol=1e-2),
+    Case("instance_norm", F.instance_norm, [any_(2, 3, 4, 4)],
+         _t(lambda x: tF.instance_norm(x)), rtol=1e-3, atol=1e-4,
+         gtol=2e-2),
+    Case("local_response_norm",
+         lambda x: F.local_response_norm(x, size=5),
+         [pos(2, 4, 3, 3)],
+         _t(lambda x: tF.local_response_norm(x, size=5)), rtol=1e-3,
+         grad=False),
+
+    # -------------------------------------------------------- conv / pool
+    Case("conv2d", F.conv2d, [any_(2, 3, 6, 6), any_(4, 3, 3, 3)],
+         _t(tF.conv2d), rtol=1e-3, atol=1e-4, gtol=1e-2),
+    Case("conv2d_stride_pad",
+         lambda x, w, b: F.conv2d(x, w, bias=b, stride=2, padding=1),
+         [any_(2, 3, 6, 6), any_(4, 3, 3, 3), any_(4)],
+         _t(lambda x, w, b: tF.conv2d(x, w, b, stride=2, padding=1)),
+         rtol=1e-3, atol=1e-4, gtol=1e-2),
+    Case("conv2d_group",
+         lambda x, w: F.conv2d(x, w, groups=2),
+         [any_(2, 4, 5, 5), any_(6, 2, 3, 3)],
+         _t(lambda x, w: tF.conv2d(x, w, groups=2)), rtol=1e-3,
+         atol=1e-4, gtol=1e-2),
+    Case("conv1d", F.conv1d, [any_(2, 3, 8), any_(4, 3, 3)],
+         _t(tF.conv1d), rtol=1e-3, atol=1e-4, gtol=1e-2),
+    Case("conv3d", F.conv3d, [any_(1, 2, 4, 4, 4), any_(3, 2, 2, 2, 2)],
+         _t(tF.conv3d), rtol=1e-3, atol=1e-4, gtol=1e-2),
+    Case("conv2d_transpose", F.conv2d_transpose,
+         [any_(2, 3, 4, 4), any_(3, 4, 3, 3)],
+         _t(tF.conv_transpose2d), rtol=1e-3, atol=1e-4, gtol=1e-2),
+    Case("max_pool2d",
+         lambda x: F.max_pool2d(x, kernel_size=2, stride=2),
+         [any_(2, 3, 6, 6)],
+         _t(lambda x: tF.max_pool2d(x, 2, 2)), gtol=1e-2),
+    Case("avg_pool2d",
+         lambda x: F.avg_pool2d(x, kernel_size=2, stride=2),
+         [any_(2, 3, 6, 6)],
+         _t(lambda x: tF.avg_pool2d(x, 2, 2)), gtol=1e-2),
+    Case("max_pool1d",
+         lambda x: F.max_pool1d(x, kernel_size=2, stride=2),
+         [any_(2, 3, 8)],
+         _t(lambda x: tF.max_pool1d(x, 2, 2)), gtol=1e-2),
+    Case("avg_pool1d",
+         lambda x: F.avg_pool1d(x, kernel_size=2, stride=2),
+         [any_(2, 3, 8)],
+         _t(lambda x: tF.avg_pool1d(x, 2, 2)), gtol=1e-2),
+    Case("adaptive_avg_pool2d",
+         lambda x: F.adaptive_avg_pool2d(x, output_size=2),
+         [any_(2, 3, 6, 6)],
+         _t(lambda x: tF.adaptive_avg_pool2d(x, 2)), gtol=1e-2),
+    Case("adaptive_max_pool2d",
+         lambda x: F.adaptive_max_pool2d(x, output_size=2),
+         [any_(2, 3, 6, 6)],
+         _t(lambda x: tF.adaptive_max_pool2d(x, 2)), gtol=1e-2),
+    Case("unfold_im2col",
+         lambda x: F.unfold(x, kernel_sizes=2),
+         [any_(2, 3, 4, 4)],
+         _t(lambda x: tF.unfold(x, 2)), gtol=1e-2),
+
+    # ------------------------------------------------- misc nn functional
+    Case("linear", F.linear, [any_(3, 4), any_(4, 5), any_(5)],
+         lambda x, w, b: x @ w + b),
+    Case("embedding",
+         lambda idx, w: F.embedding(idx, w),
+         [np.array([0, 2, 1]), any_(5, 4)],
+         lambda idx, w: w[idx], wrt=[1]),
+    Case("one_hot", F.one_hot, [np.array([0, 2, 1])],
+         lambda x, num_classes: np.eye(num_classes, dtype="float32")[x],
+         attrs={"num_classes": 4}, grad=False),
+    Case("bilinear", F.bilinear,
+         [any_(3, 4), any_(3, 5), any_(2, 4, 5)],
+         _t(lambda a, b, w: tF.bilinear(a, b, w)), rtol=1e-3,
+         atol=1e-4, wrt=[0, 1, 2], gtol=1e-2),
+    Case("pad_nn",
+         lambda x: F.pad(x, [1, 1], mode="replicate",
+                         data_format="NCL"),
+         [any_(2, 3, 5)],
+         _t(lambda x: tF.pad(x, (1, 1), mode="replicate")), grad=False),
+    Case("interpolate_nearest",
+         lambda x: F.interpolate(x, scale_factor=2, mode="nearest"),
+         [any_(2, 3, 4, 4)],
+         _t(lambda x: tF.interpolate(x, scale_factor=2,
+                                     mode="nearest")), gtol=1e-2),
+    Case("interpolate_bilinear",
+         lambda x: F.interpolate(x, size=[6, 6], mode="bilinear",
+                                 align_corners=True),
+         [any_(2, 3, 4, 4)],
+         _t(lambda x: tF.interpolate(x, size=(6, 6), mode="bilinear",
+                                     align_corners=True)),
+         rtol=1e-3, atol=1e-4, gtol=1e-2),
+    Case("scaled_dot_product_attention",
+         F.scaled_dot_product_attention,
+         [any_(2, 5, 2, 4), any_(2, 5, 2, 4), any_(2, 5, 2, 4)],
+         _t(lambda q, k, v: tF.scaled_dot_product_attention(
+             q.permute(0, 2, 1, 3), k.permute(0, 2, 1, 3),
+             v.permute(0, 2, 1, 3)).permute(0, 2, 1, 3)),
+         rtol=1e-3, atol=1e-4, gtol=1e-2),
+    Case("dropout_eval",
+         lambda x: F.dropout(x, p=0.5, training=False),
+         [any_(3, 4)], lambda x: x),
+]
+
+CASES = [c for c in CASES if not (c.name == "prelu" and c.ref is None)]
+
+
+def _ids(cases):
+    seen = {}
+    out = []
+    for c in cases:
+        n = seen.get(c.name, 0)
+        seen[c.name] = n + 1
+        out.append(c.name if n == 0 else f"{c.name}#{n}")
+    return out
+
+
+FWD = [c for c in CASES if c.ref is not None]
+
+
+@pytest.mark.parametrize("case", FWD, ids=_ids(FWD))
+def test_forward(case):
+    check_output(case.api, case.inputs, attrs=case.attrs, ref=case.ref,
+                 rtol=case.rtol, atol=case.atol)
+
+
+GRAD = [c for c in CASES if c.grad]
+
+
+@pytest.mark.parametrize("case", GRAD, ids=_ids(GRAD))
+def test_grad(case):
+    check_grad(case.api, case.inputs, attrs=case.attrs, wrt=case.wrt,
+               max_relative_error=case.gtol, delta=case.gdelta)

@@ -1,0 +1,344 @@
+"""Per-op tests: manipulation / creation / linalg / indexing / search.
+
+Continuation of test_op_suite.py over the same OpTest harness
+(reference: test/legacy_test/test_{reshape,concat,gather,...}_op.py).
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import check_grad, check_output
+from test_op_suite import Case, any_, ints, nonzero, pos
+
+CASES = [
+    # ------------------------------------------------------ manipulation
+    Case("reshape", paddle.reshape, [any_(3, 4)],
+         lambda x, shape: np.reshape(x, shape), attrs={"shape": [2, 6]}),
+    Case("transpose", paddle.transpose, [any_(2, 3, 4)],
+         lambda x, perm: np.transpose(x, perm), attrs={"perm": [2, 0, 1]}),
+    Case("t", paddle.t, [any_(3, 4)], lambda x: x.T),
+    Case("flatten", paddle.flatten, [any_(2, 3, 4)],
+         lambda x: x.reshape(-1)),
+    Case("squeeze", paddle.squeeze, [any_(3, 1, 4)],
+         lambda x, axis: np.squeeze(x, axis), attrs={"axis": 1}),
+    Case("unsqueeze", paddle.unsqueeze, [any_(3, 4)],
+         lambda x, axis: np.expand_dims(x, axis), attrs={"axis": 1}),
+    Case("concat", lambda *ts, axis=0: paddle.concat(list(ts), axis=axis),
+         [any_(2, 4), any_(3, 4)],
+         lambda *xs, axis=0: np.concatenate(xs, axis=axis)),
+    Case("stack", lambda *ts, axis=0: paddle.stack(list(ts), axis=axis),
+         [any_(3, 4), any_(3, 4)],
+         lambda *xs, axis=0: np.stack(xs, axis=axis)),
+    Case("split", paddle.split, [any_(6, 4)],
+         lambda x, num_or_sections: np.split(x, num_or_sections),
+         attrs={"num_or_sections": 3}),
+    Case("chunk", paddle.chunk, [any_(6, 4)],
+         lambda x, chunks: np.split(x, chunks), attrs={"chunks": 2}),
+    Case("unbind", paddle.unbind, [any_(3, 4)],
+         lambda x: [x[i] for i in range(3)]),
+    Case("tile", paddle.tile, [any_(2, 3)],
+         lambda x, repeat_times: np.tile(x, repeat_times),
+         attrs={"repeat_times": [2, 2]}),
+    Case("expand", paddle.expand, [any_(1, 4)],
+         lambda x, shape: np.broadcast_to(x, shape),
+         attrs={"shape": [3, 4]}),
+    Case("broadcast_to", paddle.broadcast_to, [any_(1, 4)],
+         lambda x, shape: np.broadcast_to(x, shape),
+         attrs={"shape": [3, 4]}),
+    Case("flip", paddle.flip, [any_(3, 4)],
+         lambda x, axis: np.flip(x, axis), attrs={"axis": [0]}),
+    Case("roll", paddle.roll, [any_(3, 4)],
+         lambda x, shifts, axis: np.roll(x, shifts, axis),
+         attrs={"shifts": 2, "axis": 1}),
+    Case("rot90", paddle.rot90, [any_(3, 4)],
+         lambda x: np.rot90(x)),
+    Case("moveaxis", paddle.moveaxis, [any_(2, 3, 4)],
+         lambda x, source, destination:
+         np.moveaxis(x, source, destination),
+         attrs={"source": 0, "destination": 2}),
+    Case("repeat_interleave", paddle.repeat_interleave, [any_(3, 4)],
+         lambda x, repeats, axis: np.repeat(x, repeats, axis),
+         attrs={"repeats": 2, "axis": 1}),
+    Case("pad", paddle.pad, [any_(3, 4)],
+         lambda x, pad: np.pad(x, [(0, 0), (1, 2)]),
+         attrs={"pad": [1, 2]}),
+    Case("tril", paddle.tril, [any_(4, 4)], np.tril),
+    Case("triu", paddle.triu, [any_(4, 4)], np.triu),
+    Case("diag", paddle.diag, [any_(4)], np.diag),
+    Case("diagflat", paddle.diagflat, [any_(2, 2)],
+         lambda x: np.diagflat(x)),
+    Case("diagonal", paddle.diagonal, [any_(3, 4)],
+         lambda x: np.diagonal(x)),
+    Case("trace", paddle.trace, [any_(3, 4)], lambda x: np.trace(x)),
+    Case("kron", paddle.kron, [any_(2, 2), any_(2, 3)], np.kron),
+    Case("rotate_flip_cast", paddle.cast, [any_(3, 4)],
+         lambda x, dtype: x.astype(dtype), attrs={"dtype": "float64"},
+         grad=False),
+    Case("masked_fill", paddle.masked_fill,
+         [any_(3, 4), np.array([[True, False, True, False]] * 3)],
+         lambda x, m, value: np.where(m, value, x),
+         attrs={"value": -5.0}, wrt=[0]),
+    Case("masked_select", paddle.masked_select,
+         [any_(3, 4), np.array([[True, False, True, False]] * 3)],
+         lambda x, m: x[m], wrt=[0]),
+    Case("where", paddle.where,
+         [np.array([[True, False, True, False]] * 3), any_(3, 4),
+          any_(3, 4)],
+         lambda c, x, y: np.where(c, x, y), wrt=[1, 2]),
+    Case("as_complex_real", paddle.as_complex, [any_(3, 4, 2)],
+         lambda x: x[..., 0] + 1j * x[..., 1], grad=False),
+    Case("real", paddle.real,
+         [(any_(3, 4) + 1j * any_(3, 4)).astype("complex64")],
+         np.real, grad=False),
+    Case("imag", paddle.imag,
+         [(any_(3, 4) + 1j * any_(3, 4)).astype("complex64")],
+         np.imag, grad=False),
+    Case("unfold_seq", paddle.unfold, [any_(8)],
+         lambda x, axis, size, step:
+         np.stack([x[i:i + size] for i in range(0, 5, step)]),
+         attrs={"axis": 0, "size": 4, "step": 2}),
+    Case("shard_index", paddle.shard_index, [ints(4, 1, lo=0, hi=20)],
+         lambda x, index_num, nshards, shard_id:
+         np.where((x // (index_num // nshards)) == shard_id,
+                  x % (index_num // nshards), -1),
+         attrs={"index_num": 20, "nshards": 2, "shard_id": 0},
+         grad=False),
+
+    # --------------------------------------------------------- creation
+    Case("ones", lambda: paddle.ones([3, 4]), [],
+         lambda: np.ones((3, 4), "float32"), grad=False),
+    Case("zeros", lambda: paddle.zeros([3, 4]), [],
+         lambda: np.zeros((3, 4), "float32"), grad=False),
+    Case("full", lambda: paddle.full([3, 4], 2.5), [],
+         lambda: np.full((3, 4), 2.5, "float32"), grad=False),
+    Case("arange", lambda: paddle.arange(1, 10, 2), [],
+         lambda: np.arange(1, 10, 2), grad=False),
+    Case("linspace", lambda: paddle.linspace(0, 1, 5), [],
+         lambda: np.linspace(0, 1, 5, dtype="float32"), grad=False),
+    Case("logspace", lambda: paddle.logspace(0, 2, 3), [],
+         lambda: np.logspace(0, 2, 3, dtype="float32"), grad=False),
+    Case("eye", lambda: paddle.eye(3, 4), [],
+         lambda: np.eye(3, 4, dtype="float32"), grad=False),
+    Case("ones_like", paddle.ones_like, [any_(3, 4)], np.ones_like,
+         grad=False),
+    Case("zeros_like", paddle.zeros_like, [any_(3, 4)], np.zeros_like,
+         grad=False),
+    Case("full_like", paddle.full_like, [any_(3, 4)],
+         lambda x, fill_value: np.full_like(x, fill_value),
+         attrs={"fill_value": 7.0}, grad=False),
+    Case("tril_indices", lambda: paddle.tril_indices(4, 4, 0), [],
+         lambda: np.stack(np.tril_indices(4, 0, 4)), grad=False),
+    Case("triu_indices", lambda: paddle.triu_indices(4, 4, 0), [],
+         lambda: np.stack(np.triu_indices(4, 0, 4)), grad=False),
+    Case("meshgrid", lambda x, y: paddle.meshgrid(x, y),
+         [any_(3), any_(4)],
+         lambda x, y: list(np.meshgrid(x, y, indexing="ij")), grad=False),
+    Case("vander", paddle.vander, [pos(4)],
+         lambda x: np.vander(x), grad=False),
+    Case("diag_embed_complex", paddle.complex, [any_(3, 4), any_(3, 4)],
+         lambda re, im: re + 1j * im, grad=False),
+    Case("polar", paddle.polar, [pos(3, 4), any_(3, 4)],
+         lambda r, t: r * np.cos(t) + 1j * r * np.sin(t), grad=False,
+         rtol=1e-4, atol=1e-5),
+
+    # ----------------------------------------------------------- linalg
+    Case("matmul", paddle.matmul, [any_(3, 4), any_(4, 5)], np.matmul),
+    Case("bmm", paddle.bmm, [any_(2, 3, 4), any_(2, 4, 5)], np.matmul),
+    Case("mm", paddle.mm, [any_(3, 4), any_(4, 5)], np.matmul),
+    Case("mv", paddle.mv, [any_(3, 4), any_(4)], np.matmul),
+    Case("dot", paddle.dot, [any_(4), any_(4)], np.dot),
+    Case("outer", paddle.outer, [any_(3), any_(4)], np.outer),
+    Case("cross", paddle.cross, [any_(3, 3), any_(3, 3)],
+         lambda x, y, axis: np.cross(x, y, axis=axis), attrs={"axis": 1}),
+    Case("norm_fro", paddle.norm, [any_(3, 4)],
+         lambda x: np.linalg.norm(x)),
+    Case("vector_norm", paddle.vector_norm, [any_(3, 4)],
+         lambda x, p: np.linalg.norm(x.reshape(-1), ord=p),
+         attrs={"p": 3.0}),
+    Case("det", paddle.det, [any_(3, 3) + 2 * np.eye(3, dtype="float32")],
+         np.linalg.det, gtol=1e-2),
+    Case("slogdet", paddle.slogdet,
+         [any_(3, 3) + 3 * np.eye(3, dtype="float32")],
+         lambda x: np.stack(np.linalg.slogdet(x)).astype("float32"),
+         grad=False),
+    Case("inverse", paddle.inverse,
+         [any_(3, 3) + 3 * np.eye(3, dtype="float32")],
+         np.linalg.inv, gtol=1e-2),
+    Case("pinv", paddle.pinv, [any_(4, 3)], np.linalg.pinv, grad=False,
+         rtol=1e-3, atol=1e-4),
+    Case("matrix_power", paddle.matrix_power, [any_(3, 3)],
+         lambda x, n: np.linalg.matrix_power(x, n), attrs={"n": 3},
+         gtol=1e-2),
+    Case("matrix_transpose", paddle.matrix_transpose, [any_(2, 3, 4)],
+         lambda x: np.swapaxes(x, -1, -2)),
+    Case("multi_dot", lambda *ts: paddle.multi_dot(list(ts)),
+         [any_(3, 4), any_(4, 5), any_(5, 2)],
+         lambda *xs: np.linalg.multi_dot(xs)),
+    Case("cholesky", paddle.cholesky,
+         [np.array(np.eye(3) * 4 + 0.5, "float32")],
+         np.linalg.cholesky, grad=False),
+    Case("solve", paddle.solve,
+         [any_(3, 3) + 3 * np.eye(3, dtype="float32"), any_(3, 2)],
+         np.linalg.solve, gtol=1e-2),
+    Case("triangular_solve", paddle.triangular_solve,
+         [np.tril(pos(3, 3)) + np.eye(3, dtype="float32"), any_(3, 2)],
+         lambda a, b, upper=False:
+         np.linalg.solve(np.tril(a), b), attrs={"upper": False},
+         grad=False),
+    Case("cdist", paddle.cdist, [any_(3, 4), any_(5, 4)],
+         lambda x, y: np.sqrt(
+             ((x[:, None, :] - y[None, :, :]) ** 2).sum(-1)),
+         grad=False, rtol=1e-3, atol=1e-4),
+    Case("householder_product", paddle.householder_product,
+         [any_(4, 3), pos(3)], None, grad=False),
+    Case("tensordot", paddle.tensordot, [any_(3, 4), any_(4, 5)],
+         lambda x, y, axes: np.tensordot(x, y, axes=axes),
+         attrs={"axes": 1}),
+    Case("einsum",
+         lambda x, y: paddle.einsum("ij,jk->ik", x, y),
+         [any_(3, 4), any_(4, 5)], np.matmul),
+    Case("cov", paddle.cov, [any_(3, 5)], lambda x: np.cov(x),
+         grad=False, rtol=1e-3, atol=1e-4),
+    Case("corrcoef", paddle.corrcoef, [any_(3, 5)],
+         lambda x: np.corrcoef(x), grad=False, rtol=1e-3, atol=1e-4),
+
+    # ------------------------------------------------- indexing / search
+    Case("gather", paddle.gather, [any_(5, 3), np.array([0, 2, 4])],
+         lambda x, idx: x[idx], wrt=[0]),
+    Case("gather_nd", paddle.gather_nd,
+         [any_(3, 4), np.array([[0, 1], [2, 3]])],
+         lambda x, idx: x[tuple(idx.T)], wrt=[0]),
+    Case("index_select", paddle.index_select,
+         [any_(5, 3), np.array([0, 2])],
+         lambda x, index, axis: np.take(x, index, axis),
+         attrs={"axis": 0}, wrt=[0]),
+    Case("index_sample", paddle.index_sample,
+         [any_(3, 5), np.array([[0, 2], [1, 3], [4, 0]])],
+         lambda x, idx: np.take_along_axis(x, idx, 1), wrt=[0]),
+    Case("take", paddle.take, [any_(3, 4), np.array([0, 5, 11])],
+         lambda x, idx: x.reshape(-1)[idx], wrt=[0]),
+    Case("take_along_axis", paddle.take_along_axis,
+         [any_(3, 4), np.array([[0], [1], [2]])],
+         lambda x, idx, axis: np.take_along_axis(x, idx, axis),
+         attrs={"axis": 1}, wrt=[0]),
+    Case("index_add",
+         lambda x, index, value: paddle.index_add(x, index, 0, value),
+         [any_(5, 3), np.array([0, 2]), any_(2, 3)],
+         lambda x, index, value: _np_index_add(x, index, value, 0),
+         wrt=[0, 2]),
+    Case("put_along_axis", paddle.put_along_axis,
+         [any_(3, 4), np.array([[0], [1], [2]]), any_(3, 1)],
+         lambda arr, indices, values, axis:
+         _np_put_along(arr, indices, values, axis), attrs={"axis": 1},
+         wrt=[0]),
+    Case("scatter", paddle.scatter,
+         [any_(5, 3), np.array([0, 2]), any_(2, 3)],
+         lambda x, index, updates: _np_scatter(x, index, updates),
+         wrt=[0, 2]),
+    Case("scatter_nd_add", paddle.scatter_nd_add,
+         [any_(5, 3), np.array([[0], [2]]), any_(2, 3)],
+         lambda x, index, updates:
+         _np_index_add(x, index[:, 0], updates, 0), wrt=[0, 2]),
+    Case("select_scatter", paddle.select_scatter,
+         [any_(3, 4), any_(4)],
+         lambda x, v, axis, index: _np_select_scatter(x, v, axis, index),
+         attrs={"axis": 0, "index": 1}, wrt=[0, 1]),
+    Case("argmax", paddle.argmax, [any_(3, 4)],
+         lambda x: np.argmax(x), grad=False),
+    Case("argmin", paddle.argmin, [any_(3, 4)],
+         lambda x: np.argmin(x), grad=False),
+    Case("argsort", paddle.argsort, [any_(3, 4)],
+         lambda x, axis: np.argsort(x, axis=axis, kind="stable"),
+         attrs={"axis": 1}, grad=False),
+    # well-separated values: numeric diff near sort ties is invalid
+    Case("sort", paddle.sort,
+         [np.linspace(-3, 3, 12, dtype="float32")
+          .reshape(3, 4)[:, ::-1].copy()],
+         lambda x, axis: np.sort(x, axis=axis), attrs={"axis": 1}),
+    Case("topk", paddle.topk, [any_(3, 6)],
+         lambda x, k: (np.sort(x, axis=-1)[:, ::-1][:, :k],
+                       np.argsort(-x, axis=-1, kind="stable")[:, :k]),
+         attrs={"k": 2}, grad=False),
+    Case("kthvalue", paddle.kthvalue, [any_(3, 6)],
+         lambda x, k: (np.sort(x, axis=-1)[:, k - 1],
+                       np.argsort(x, axis=-1, kind="stable")[:, k - 1]),
+         attrs={"k": 2}, grad=False),
+    Case("mode", paddle.mode, [ints(3, 5, lo=0, hi=3).astype("float32")],
+         None, grad=False),
+    Case("nonzero", paddle.nonzero, [np.array([[1, 0], [0, 3]], "f4")],
+         lambda x: np.stack(np.nonzero(x), 1), grad=False),
+    Case("searchsorted", paddle.searchsorted,
+         [np.sort(any_(8)), any_(5)],
+         lambda s, v: np.searchsorted(s, v), grad=False),
+    Case("bucketize", paddle.bucketize, [any_(5), np.sort(any_(4))],
+         lambda x, s: np.searchsorted(s, x), grad=False),
+    Case("bincount", paddle.bincount, [ints(10, lo=0, hi=5)],
+         lambda x: np.bincount(x), grad=False),
+    Case("histogram", paddle.histogram, [pos(20)],
+         lambda x, bins, min, max:
+         np.histogram(x, bins=bins, range=(min, max))[0],
+         attrs={"bins": 4, "min": 0.0, "max": 3.0}, grad=False),
+    Case("unique", paddle.unique, [ints(10, lo=0, hi=4)],
+         lambda x: np.unique(x), grad=False),
+    Case("unique_consecutive", paddle.unique_consecutive,
+         [np.array([1, 1, 2, 2, 3, 1, 1], "int32")],
+         lambda x: np.array([1, 2, 3, 1], "int32"), grad=False),
+]
+
+
+def _np_index_add(x, index, value, axis):
+    out = x.copy()
+    np.add.at(out, tuple([slice(None)] * axis + [index]), value)
+    return out
+
+
+def _np_put_along(arr, indices, values, axis):
+    out = arr.copy()
+    np.put_along_axis(out, indices, values, axis)
+    return out
+
+
+def _np_scatter(x, index, updates):
+    out = x.copy()
+    out[index] = updates
+    return out
+
+
+def _np_select_scatter(x, v, axis, index):
+    out = x.copy()
+    sl = [slice(None)] * x.ndim
+    sl[axis] = index
+    out[tuple(sl)] = v
+    return out
+
+
+def _ids():
+    seen = {}
+    out = []
+    for c in CASES:
+        n = seen.get(c.name, 0)
+        seen[c.name] = n + 1
+        out.append(c.name if n == 0 else f"{c.name}#{n}")
+    return out
+
+
+FWD_CASES = [c for c in CASES if c.ref is not None]
+
+
+@pytest.mark.parametrize("case", FWD_CASES,
+                         ids=[c.name for c in FWD_CASES])
+def test_forward(case):
+    check_output(case.api, case.inputs, attrs=case.attrs, ref=case.ref,
+                 rtol=case.rtol, atol=case.atol)
+
+
+GRAD_CASES = [c for c in CASES if c.grad]
+
+
+@pytest.mark.parametrize("case", GRAD_CASES,
+                         ids=[c.name for c in GRAD_CASES])
+def test_grad(case):
+    check_grad(case.api, case.inputs, attrs=case.attrs, wrt=case.wrt,
+               max_relative_error=case.gtol, delta=case.gdelta)
