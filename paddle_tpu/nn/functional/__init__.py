@@ -6,5 +6,6 @@ from .norm import *  # noqa: F401,F403
 from .loss import *  # noqa: F401,F403
 from .input import *  # noqa: F401,F403
 from .attention import *  # noqa: F401,F403
+from .extended import *  # noqa: F401,F403
 from .flash_attention import flash_attention, flashmask_attention, \
     scaled_dot_product_attention  # noqa: F401

@@ -1,0 +1,703 @@
+"""Long-tail nn.functional ops (reference ops.yaml + nn/functional/*):
+grid_sample, affine_grid, fold, pixel_(un)shuffle, channel_shuffle,
+temporal_shift, sequence_mask, maxout, rrelu, lp_pool2d, 3D pooling,
+conv3d_transpose, max_pool2d with indices, max_unpool2d, extra losses.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..._core import random as rnd
+from ..._core.executor import apply
+from ..._core.op_registry import register_op
+from ..._core.tensor import Tensor
+
+__all__ = [
+    "grid_sample", "affine_grid", "fold", "pixel_shuffle",
+    "pixel_unshuffle", "channel_shuffle", "temporal_shift",
+    "sequence_mask", "maxout", "rrelu", "lp_pool2d", "avg_pool3d",
+    "max_pool3d", "conv3d_transpose", "max_unpool2d", "huber_loss",
+    "hinge_loss", "log_loss", "square_error_cost", "dice_loss",
+    "npair_loss", "ctc_loss", "gaussian_nll_loss", "poisson_nll_loss",
+    "triplet_margin_loss", "triplet_margin_with_distance_loss",
+    "multi_label_soft_margin_loss", "soft_margin_loss", "adaptive_log_softmax_with_loss",
+    "hsigmoid_loss", "pairwise_distance", "fold", "zeropad2d",
+]
+
+
+# -------------------------------------------------------------- sampling
+def _grid_sample_kernel(x, grid, mode, padding_mode, align_corners):
+    # x: [N,C,H,W]; grid: [N,Ho,Wo,2] in [-1,1] (xy order)
+    n, c, h, w = x.shape
+    gx, gy = grid[..., 0], grid[..., 1]
+    if align_corners:
+        fx = (gx + 1) * 0.5 * (w - 1)
+        fy = (gy + 1) * 0.5 * (h - 1)
+    else:
+        fx = ((gx + 1) * w - 1) * 0.5
+        fy = ((gy + 1) * h - 1) * 0.5
+
+    def reflect(p, lo, hi):
+        # triangle wave between lo and hi
+        rng_ = jnp.maximum(hi - lo, 1e-6)
+        g = (p - lo) % (2 * rng_)
+        return lo + rng_ - jnp.abs(g - rng_)
+
+    def sample(ix, iy):
+        inb = (ix >= 0) & (ix < w) & (iy >= 0) & (iy < h)
+        cx = jnp.clip(ix, 0, w - 1)
+        cy = jnp.clip(iy, 0, h - 1)
+        # vals[n, ho, wo, c]
+        vals = x[jnp.arange(n)[:, None, None], :, cy, cx]
+        if padding_mode == "zeros":
+            vals = jnp.where(inb[..., None], vals, 0.0)
+        return vals
+
+    if padding_mode == "border":
+        fx = jnp.clip(fx, 0, w - 1)
+        fy = jnp.clip(fy, 0, h - 1)
+    elif padding_mode == "reflection":
+        if align_corners:
+            fx = reflect(fx, 0.0, w - 1.0)
+            fy = reflect(fy, 0.0, h - 1.0)
+        else:
+            fx = jnp.clip(reflect(fx, -0.5, w - 0.5), 0, w - 1)
+            fy = jnp.clip(reflect(fy, -0.5, h - 0.5), 0, h - 1)
+
+    if mode == "nearest":
+        out = sample(jnp.round(fx).astype(jnp.int32),
+                     jnp.round(fy).astype(jnp.int32))
+    else:  # bilinear
+        x0 = jnp.floor(fx).astype(jnp.int32)
+        y0 = jnp.floor(fy).astype(jnp.int32)
+        x1, y1 = x0 + 1, y0 + 1
+        wx = (fx - x0)[..., None]
+        wy = (fy - y0)[..., None]
+        out = (sample(x0, y0) * (1 - wx) * (1 - wy) +
+               sample(x1, y0) * wx * (1 - wy) +
+               sample(x0, y1) * (1 - wx) * wy +
+               sample(x1, y1) * wx * wy)
+    return jnp.transpose(out, (0, 3, 1, 2))
+
+
+register_op("grid_sample_k", _grid_sample_kernel)
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    return apply("grid_sample_k", x, grid, mode=mode,
+                 padding_mode=padding_mode,
+                 align_corners=bool(align_corners))
+
+
+def _affine_grid_kernel(theta, oshape, align_corners):
+    n, _, h, w = oshape
+
+    def axis_coords(size):
+        if align_corners:
+            return jnp.linspace(-1.0, 1.0, size)
+        step = 2.0 / size
+        return jnp.linspace(-1.0 + step / 2, 1.0 - step / 2, size)
+
+    ys = axis_coords(h)
+    xs = axis_coords(w)
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    base = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)  # [H,W,3]
+    # theta: [N,2,3]
+    return jnp.einsum("hwk,nck->nhwc", base, theta)
+
+
+register_op("affine_grid_k", _affine_grid_kernel)
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    if isinstance(out_shape, Tensor):
+        out_shape = [int(v) for v in out_shape.tolist()]
+    return apply("affine_grid_k", theta, oshape=tuple(out_shape),
+                 align_corners=bool(align_corners))
+
+
+# ------------------------------------------------------ shuffles / shifts
+register_op("pixel_shuffle_k", lambda x, r: _pixel_shuffle(x, r))
+register_op("pixel_unshuffle_k", lambda x, r: _pixel_unshuffle(x, r))
+register_op("channel_shuffle_k", lambda x, g: _channel_shuffle(x, g))
+
+
+def _pixel_shuffle(x, r):
+    n, c, h, w = x.shape
+    x = x.reshape(n, c // (r * r), r, r, h, w)
+    x = jnp.transpose(x, (0, 1, 4, 2, 5, 3))
+    return x.reshape(n, c // (r * r), h * r, w * r)
+
+
+def _pixel_unshuffle(x, r):
+    n, c, h, w = x.shape
+    x = x.reshape(n, c, h // r, r, w // r, r)
+    x = jnp.transpose(x, (0, 1, 3, 5, 2, 4))
+    return x.reshape(n, c * r * r, h // r, w // r)
+
+
+def _channel_shuffle(x, g):
+    n, c, h, w = x.shape
+    x = x.reshape(n, g, c // g, h, w)
+    x = jnp.transpose(x, (0, 2, 1, 3, 4))
+    return x.reshape(n, c, h, w)
+
+
+def _require_nchw(data_format, what):
+    if not data_format.startswith("NC"):
+        raise ValueError(
+            f"{what}: only NCHW data_format is implemented, "
+            f"got '{data_format}'")
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    _require_nchw(data_format, "pixel_shuffle")
+    return apply("pixel_shuffle_k", x, r=int(upscale_factor))
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    _require_nchw(data_format, "pixel_unshuffle")
+    return apply("pixel_unshuffle_k", x, r=int(downscale_factor))
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    _require_nchw(data_format, "channel_shuffle")
+    return apply("channel_shuffle_k", x, g=int(groups))
+
+
+def _temporal_shift_kernel(x, seg_num, shift_ratio):
+    nt, c, h, w = x.shape
+    n = nt // seg_num
+    x = x.reshape(n, seg_num, c, h, w)
+    fold_ = int(c * shift_ratio)
+    left = jnp.concatenate(
+        [x[:, 1:, :fold_], jnp.zeros_like(x[:, :1, :fold_])], axis=1)
+    right = jnp.concatenate(
+        [jnp.zeros_like(x[:, :1, fold_:2 * fold_]),
+         x[:, :-1, fold_:2 * fold_]], axis=1)
+    rest = x[:, :, 2 * fold_:]
+    return jnp.concatenate([left, right, rest], axis=2).reshape(
+        nt, c, h, w)
+
+
+register_op("temporal_shift_k", _temporal_shift_kernel)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
+                   name=None):
+    _require_nchw(data_format, "temporal_shift")
+    return apply("temporal_shift_k", x, seg_num=int(seg_num),
+                 shift_ratio=float(shift_ratio))
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    lens = x._value
+    m = int(maxlen) if maxlen is not None else int(jnp.max(lens))
+    mask = jnp.arange(m)[None, :] < lens[..., None]
+    return Tensor(mask.astype(dtype))
+
+
+# -------------------------------------------------- activations / pooling
+register_op("maxout_k", lambda x, groups, axis: _maxout(x, groups, axis))
+
+
+def _maxout(x, groups, axis):
+    shape = list(x.shape)
+    c = shape[axis]
+    new = shape[:axis] + [c // groups, groups] + shape[axis + 1:]
+    return jnp.max(x.reshape(new), axis=axis + 1)
+
+
+def maxout(x, groups, axis=1, name=None):
+    return apply("maxout_k", x, groups=int(groups), axis=int(axis))
+
+
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=False, name=None):
+    if training:
+        a = jax.random.uniform(rnd.next_key(), x.shape, jnp.float32,
+                               lower, upper).astype(x._value.dtype)
+        return Tensor(jnp.where(x._value >= 0, x._value, a * x._value),
+                      stop_gradient=x.stop_gradient)
+    mid = (lower + upper) / 2.0
+    return Tensor(jnp.where(x._value >= 0, x._value, mid * x._value),
+                  stop_gradient=x.stop_gradient)
+
+
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCHW", name=None):
+    from .pooling import avg_pool2d
+    p = float(norm_type)
+    powered = x ** p
+    pooled = avg_pool2d(powered, kernel_size, stride=stride,
+                        padding=padding, ceil_mode=ceil_mode,
+                        data_format=data_format)
+    ks = kernel_size if isinstance(kernel_size, (list, tuple)) \
+        else (kernel_size, kernel_size)
+    count = ks[0] * ks[1]
+    return (pooled * count) ** (1.0 / p)
+
+
+def _triple(v):
+    return tuple(v) if isinstance(v, (list, tuple)) else (int(v),) * 3
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None,
+               data_format="NCDHW", name=None):
+    ksize = _triple(kernel_size)
+    stride = _triple(stride if stride is not None else kernel_size)
+    pad = _triple(padding)
+    return apply("avg_pool_nd", x, ksize=ksize, stride=stride,
+                 padding=tuple((p, p) for p in pad),
+                 ceil_mode=bool(ceil_mode), fmt=data_format,
+                 exclusive=bool(exclusive), divisor=divisor_override)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCDHW", name=None):
+    ksize = _triple(kernel_size)
+    stride = _triple(stride if stride is not None else kernel_size)
+    pad = _triple(padding)
+    op = "max_pool_nd_index" if return_mask else "max_pool_nd"
+    return apply(op, x, ksize=ksize, stride=stride,
+                 padding=tuple((p, p) for p in pad),
+                 ceil_mode=bool(ceil_mode), fmt=data_format,
+                 with_index=bool(return_mask))
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     data_format="NCDHW", output_size=None, name=None):
+    _require_nchw(data_format, "conv3d_transpose")
+    s = _triple(stride)
+    d = _triple(dilation)
+    op_ = _triple(output_padding)
+    p = _triple(padding)
+    if output_size is not None:
+        # derive the output_padding that realizes the requested size
+        spatial = list(output_size)[-3:]
+        op_ = []
+        for i in range(3):
+            k = (weight.shape[2 + i] - 1) * d[i] + 1
+            default = (x.shape[2 + i] - 1) * s[i] - 2 * p[i] + k
+            extra = int(spatial[i]) - default
+            if not 0 <= extra < s[i]:
+                raise ValueError(
+                    f"conv3d_transpose: output_size[{i}]={spatial[i]} "
+                    f"unreachable (default {default}, stride {s[i]})")
+            op_.append(extra)
+        op_ = tuple(op_)
+    return apply("conv3d_transpose_k", x, weight, bias, stride=s,
+                 padding=tuple((pp, pp) for pp in p), output_padding=op_,
+                 dilation=d, groups=int(groups))
+
+
+def _conv3d_transpose_kernel(x, w, b, stride, padding, output_padding,
+                             dilation, groups):
+    k_sp = tuple(w.shape[2:5])
+    cin, coutg = w.shape[0], w.shape[1]
+    wk = w.reshape((groups, cin // groups, coutg) + k_sp)
+    wk = jnp.swapaxes(wk, 1, 2)
+    wk = wk.reshape((groups * coutg, cin // groups) + k_sp)
+    wk = jnp.flip(wk, axis=(2, 3, 4))
+    pads = []
+    for i in range(3):
+        k = (k_sp[i] - 1) * dilation[i] + 1
+        lo, hi = padding[i]
+        pads.append((k - 1 - lo, k - 1 - hi + output_padding[i]))
+    out = lax.conv_general_dilated(
+        x, wk, window_strides=(1, 1, 1), padding=pads,
+        lhs_dilation=stride, rhs_dilation=dilation,
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+        feature_group_count=groups)
+    if b is not None:
+        out = out + b.reshape(1, -1, 1, 1, 1)
+    return out
+
+
+register_op("conv3d_transpose_k", _conv3d_transpose_kernel)
+
+
+def _max_unpool2d_kernel(x, indices, oh, ow):
+    n, c = x.shape[0], x.shape[1]
+    flat_idx = indices.reshape(n, c, -1)
+    vals = x.reshape(n, c, -1)
+    out = jnp.zeros((n, c, oh * ow), x.dtype)
+    out = out.at[jnp.arange(n)[:, None, None],
+                 jnp.arange(c)[None, :, None], flat_idx].set(vals)
+    return out.reshape(n, c, oh, ow)
+
+
+register_op("max_unpool2d_k", _max_unpool2d_kernel)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    """Inverse of max_pool2d(return_mask=True): scatter pooled values
+    back to their argmax positions."""
+    _require_nchw(data_format, "max_unpool2d")
+    ks = kernel_size if isinstance(kernel_size, (list, tuple)) else \
+        (kernel_size, kernel_size)
+    st = stride if stride is not None else ks
+    st = st if isinstance(st, (list, tuple)) else (st, st)
+    n, c, h, w = x.shape
+    pad = padding if isinstance(padding, (list, tuple)) \
+        else (padding, padding)
+    oh = (h - 1) * st[0] - 2 * pad[0] + ks[0]
+    ow = (w - 1) * st[1] - 2 * pad[1] + ks[1]
+    if output_size is not None:
+        oh, ow = output_size[-2], output_size[-1]
+    return apply("max_unpool2d_k", x, indices, oh=int(oh), ow=int(ow))
+
+
+# ------------------------------------------------------------------ fold
+def _fold_kernel(x, oshape, ksizes, strides, pads, dilations):
+    # x: [N, C*kh*kw, L] -> [N, C, H, W] (col2im, inverse of unfold)
+    n, ckk, L = x.shape
+    kh, kw = ksizes
+    c = ckk // (kh * kw)
+    oh, ow = oshape
+    eh = (oh + 2 * pads[0] - (dilations[0] * (kh - 1) + 1)) \
+        // strides[0] + 1
+    ew = (ow + 2 * pads[1] - (dilations[1] * (kw - 1) + 1)) \
+        // strides[1] + 1
+    cols = x.reshape(n, c, kh, kw, eh, ew)
+    out = jnp.zeros((n, c, oh + 2 * pads[0], ow + 2 * pads[1]), x.dtype)
+    for i in range(kh):
+        for j in range(kw):
+            ys = i * dilations[0]
+            xs = j * dilations[1]
+            out = out.at[:, :, ys:ys + eh * strides[0]:strides[0],
+                         xs:xs + ew * strides[1]:strides[1]].add(
+                cols[:, :, i, j])
+    return out[:, :, pads[0]:pads[0] + oh, pads[1]:pads[1] + ow]
+
+
+register_op("fold_k", _fold_kernel)
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0,
+         dilations=1, name=None):
+    def _pair(v):
+        return tuple(v) if isinstance(v, (list, tuple)) else (int(v),) * 2
+    return apply("fold_k", x, oshape=_pair(output_sizes),
+                 ksizes=_pair(kernel_sizes), strides=_pair(strides),
+                 pads=_pair(paddings), dilations=_pair(dilations))
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    from .common import pad as _pad
+    return _pad(x, padding, mode="constant", value=0.0,
+                data_format=data_format)
+
+
+# ---------------------------------------------------------------- losses
+def _reduce_loss(loss, reduction):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+register_op("huber_loss_k", lambda x, y, delta, reduction: _reduce_loss(
+    jnp.where(jnp.abs(x - y) <= delta, 0.5 * (x - y) ** 2,
+              delta * (jnp.abs(x - y) - 0.5 * delta)), reduction))
+register_op("hinge_loss_k", lambda logit, label: jnp.maximum(
+    0.0, 1.0 - (2.0 * label - 1.0) * logit))
+register_op("log_loss_k", lambda input, label, epsilon:
+            -label * jnp.log(input + epsilon)
+            - (1 - label) * jnp.log(1 - input + epsilon))
+register_op("square_error_cost_k", lambda input, label:
+            (input - label) ** 2)
+
+
+def huber_loss(input, label, delta=1.0, reduction="mean", name=None):
+    return apply("huber_loss_k", input, label, delta=float(delta),
+                 reduction=reduction)
+
+
+def hinge_loss(input, label, name=None):
+    return apply("hinge_loss_k", input, label)
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    return apply("log_loss_k", input, label, epsilon=float(epsilon))
+
+
+def square_error_cost(input, label):
+    return apply("square_error_cost_k", input, label)
+
+
+register_op("dice_loss_k", lambda input, label, epsilon: _dice(
+    input, label, epsilon))
+
+
+def _dice(input, label, epsilon):
+    reduce_dims = tuple(range(1, input.ndim))
+    inse = jnp.sum(input * label, axis=reduce_dims)
+    dice_denominator = jnp.sum(input, axis=reduce_dims) + jnp.sum(
+        label, axis=reduce_dims)
+    return jnp.mean(1.0 - 2.0 * inse / (dice_denominator + epsilon))
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    lbl = label._value
+    if jnp.issubdtype(lbl.dtype, jnp.integer):
+        # class-index labels -> one-hot over the last input axis
+        # (reference dice_loss converts via one_hot)
+        if lbl.shape and lbl.shape[-1] == 1:
+            lbl = jnp.squeeze(lbl, -1)
+        lbl = jax.nn.one_hot(lbl, input.shape[-1],
+                             dtype=input._value.dtype)
+    lbl = Tensor(jnp.broadcast_to(lbl, tuple(input.shape)))
+    return apply("dice_loss_k", input, lbl, epsilon=float(epsilon))
+
+
+def _npair_kernel(a, p, lbl, l2_reg):
+    batch = a.shape[0]
+    sim = a @ p.T
+    lbl = lbl.reshape(-1)
+    same = (lbl[:, None] == lbl[None, :]).astype(a.dtype)
+    same = same / jnp.sum(same, axis=1, keepdims=True)
+    xent = -jnp.sum(same * jax.nn.log_softmax(sim, axis=1), axis=1)
+    # reference npair_loss: l2loss * 0.25 * l2_reg (loss.py:403,417)
+    reg = 0.25 * l2_reg * (jnp.sum(a * a) + jnp.sum(p * p)) / batch
+    return jnp.mean(xent) + reg
+
+
+register_op("npair_loss_k", _npair_kernel)
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
+    return apply("npair_loss_k", anchor, positive, labels,
+                 l2_reg=float(l2_reg))
+
+
+register_op("pairwise_distance_k", lambda x, y, p, epsilon, keepdim:
+            jnp.linalg.norm(x - y + epsilon, ord=p, axis=-1,
+                            keepdims=keepdim))
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False,
+                      name=None):
+    return apply("pairwise_distance_k", x, y, p=float(p),
+                 epsilon=float(epsilon), keepdim=bool(keepdim))
+
+
+register_op("soft_margin_loss_k", lambda x, y, reduction: _reduce_loss(
+    jnp.log1p(jnp.exp(-y * x)), reduction))
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    return apply("soft_margin_loss_k", input, label, reduction=reduction)
+
+
+def _mlsm_kernel(x, y, w, reduction):
+    loss = -(y * jax.nn.log_sigmoid(x) + (1 - y) * jax.nn.log_sigmoid(-x))
+    loss = loss.mean(axis=-1)
+    if w is not None:
+        loss = loss * w
+    return _reduce_loss(loss, reduction)
+
+
+register_op("multi_label_soft_margin_loss_k", _mlsm_kernel)
+
+
+def multi_label_soft_margin_loss(input, label, weight=None,
+                                 reduction="mean", name=None):
+    return apply("multi_label_soft_margin_loss_k", input, label, weight,
+                 reduction=reduction)
+
+
+def _triplet_kernel(x, pos_, neg, margin, p, epsilon, swap, reduction):
+    dp = jnp.linalg.norm(x - pos_ + epsilon, ord=p, axis=-1)
+    dn = jnp.linalg.norm(x - neg + epsilon, ord=p, axis=-1)
+    if swap:
+        dn2 = jnp.linalg.norm(pos_ - neg + epsilon, ord=p, axis=-1)
+        dn = jnp.minimum(dn, dn2)
+    return _reduce_loss(jnp.maximum(dp - dn + margin, 0.0), reduction)
+
+
+register_op("triplet_margin_loss_k", _triplet_kernel)
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean",
+                        name=None):
+    return apply("triplet_margin_loss_k", input, positive, negative,
+                 margin=float(margin), p=float(p), epsilon=float(epsilon),
+                 swap=bool(swap), reduction=reduction)
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean",
+                                      name=None):
+    if distance_function is None:
+        return triplet_margin_loss(input, positive, negative,
+                                   margin=margin, swap=swap,
+                                   reduction=reduction)
+    from ...ops.math import maximum, minimum
+    dp = distance_function(input, positive)
+    dn = distance_function(input, negative)
+    if swap:
+        dn = minimum(dn, distance_function(positive, negative))
+    hinge = maximum(dp - dn + margin, dp * 0.0)
+    if reduction == "mean":
+        return hinge.mean()
+    if reduction == "sum":
+        return hinge.sum()
+    return hinge
+
+
+def _gaussian_nll_kernel(x, y, var, full, epsilon, reduction):
+    var = jnp.maximum(var, epsilon)
+    loss = 0.5 * (jnp.log(var) + (x - y) ** 2 / var)
+    if full:
+        loss = loss + 0.5 * jnp.log(jnp.asarray(2 * jnp.pi, var.dtype))
+    return _reduce_loss(loss, reduction)
+
+
+register_op("gaussian_nll_loss_k", _gaussian_nll_kernel)
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    return apply("gaussian_nll_loss_k", input, label, variance,
+                 full=bool(full), epsilon=float(epsilon),
+                 reduction=reduction)
+
+
+def _poisson_nll_kernel(x, y, log_input, full, epsilon, reduction):
+    if log_input:
+        loss = jnp.exp(x) - y * x
+    else:
+        loss = x - y * jnp.log(x + epsilon)
+    if full:
+        stirling = y * jnp.log(y) - y + 0.5 * jnp.log(2 * jnp.pi * y)
+        loss = loss + jnp.where(y > 1, stirling, 0.0)
+    return _reduce_loss(loss, reduction)
+
+
+register_op("poisson_nll_loss_k", _poisson_nll_kernel)
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False,
+                     epsilon=1e-8, reduction="mean", name=None):
+    return apply("poisson_nll_loss_k", input, label,
+                 log_input=bool(log_input), full=bool(full),
+                 epsilon=float(epsilon), reduction=reduction)
+
+
+def _ctc_loss_kernel(log_probs, labels, input_lengths, label_lengths,
+                     blank, reduction):
+    lp = jax.nn.log_softmax(log_probs, axis=-1)
+    lbl = labels.astype(jnp.int32)
+    T, N, C = lp.shape
+    S = lbl.shape[1]
+    # extended label sequence with blanks: length 2S+1
+    ext = jnp.full((N, 2 * S + 1), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(lbl)
+    ext_len = 2 * label_lengths.astype(jnp.int32) + 1
+    neg_inf = jnp.asarray(-1e30, lp.dtype)
+    alpha0 = jnp.full((N, 2 * S + 1), neg_inf, lp.dtype)
+    alpha0 = alpha0.at[:, 0].set(lp[0, :, blank])
+    alpha0 = alpha0.at[:, 1].set(
+        jnp.where(S > 0, lp[0, jnp.arange(N), ext[:, 1]], neg_inf))
+
+    def logaddexp(a, b):
+        m = jnp.maximum(a, b)
+        return m + jnp.log1p(jnp.exp(-jnp.abs(a - b)))
+
+    same_as_prev2 = jnp.concatenate(
+        [jnp.ones((N, 2), bool),
+         ext[:, 2:] == ext[:, :-2]], axis=1)
+
+    def step(alpha, lp_t):
+        shift1 = jnp.concatenate(
+            [jnp.full((N, 1), neg_inf, lp.dtype), alpha[:, :-1]], axis=1)
+        shift2 = jnp.concatenate(
+            [jnp.full((N, 2), neg_inf, lp.dtype), alpha[:, :-2]], axis=1)
+        shift2 = jnp.where(same_as_prev2, neg_inf, shift2)
+        a = logaddexp(logaddexp(alpha, shift1), shift2)
+        emit = jnp.take_along_axis(lp_t, ext, axis=1)
+        return a + emit, None
+
+    def masked_step(carry, inp):
+        alpha, t = carry
+        new, _ = step(alpha, inp)
+        t1 = t + 1
+        keep = (t1 < input_lengths.astype(jnp.int32))[:, None]
+        return (jnp.where(keep, new, alpha), t1), None
+
+    (alphaT, _), _ = lax.scan(masked_step, (alpha0, jnp.zeros((), jnp.int32)),
+                              lp[1:])
+    idx_last = ext_len - 1
+    ll = logaddexp(
+        jnp.take_along_axis(alphaT, idx_last[:, None], axis=1)[:, 0],
+        jnp.take_along_axis(alphaT, jnp.maximum(idx_last - 1, 0)[:, None],
+                            axis=1)[:, 0])
+    loss = -ll
+    if reduction == "mean":
+        loss = jnp.mean(loss / label_lengths.astype(lp.dtype))
+    elif reduction == "sum":
+        loss = jnp.sum(loss)
+    return loss
+
+
+register_op("ctc_loss_k", _ctc_loss_kernel)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC forward-backward loss, compiled as a lax.scan over time
+    (reference warpctc op). log_probs: [T, N, C] raw logits (normalized
+    inside); labels: [N, S]."""
+    return apply("ctc_loss_k", log_probs, labels, input_lengths,
+                 label_lengths, blank=int(blank), reduction=reduction)
+
+
+def _hsigmoid_kernel(x, lbl_in, w, bias, num_classes):
+    lbl = lbl_in.reshape(-1)
+    code_len = int(np.ceil(np.log2(max(num_classes, 2)))) + 1
+    # heap walk: leaves are num_classes..2*num_classes-1, internal nodes
+    # 1..num_classes-1; path length varies per leaf, so mask terms once
+    # the walk passes the root (cur < 2)
+    loss = 0.0
+    cur = lbl + num_classes
+    for _ in range(code_len):
+        valid = (cur >= 2).astype(x.dtype)
+        code = (cur % 2).astype(x.dtype)
+        parent = cur // 2
+        node = jnp.maximum(parent - 1, 0)
+        logit = jnp.sum(x * w[node], axis=-1)
+        if bias is not None:
+            logit = logit + bias.reshape(-1)[node]
+        term = -(code * jax.nn.log_sigmoid(logit)
+                 + (1 - code) * jax.nn.log_sigmoid(-logit))
+        loss = loss + valid * term
+        cur = parent
+    return loss.reshape(-1, 1)  # per-sample [N, 1] like the reference
+
+
+register_op("hsigmoid_loss_k", _hsigmoid_kernel)
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Default-tree hierarchical sigmoid loss (reference hsigmoid_loss):
+    complete binary tree over classes, O(log C) sigmoid terms."""
+    return apply("hsigmoid_loss_k", input, label, weight, bias,
+                 num_classes=int(num_classes))
+
+
+def adaptive_log_softmax_with_loss(input, label, head_weight, tail_weights,
+                                   cutoffs, head_bias=None, name=None):
+    raise NotImplementedError(
+        "adaptive_log_softmax_with_loss: use nn.AdaptiveLogSoftmaxWithLoss")

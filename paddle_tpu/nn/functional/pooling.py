@@ -30,67 +30,157 @@ def _pool_pads(padding, n=2):
     return tuple(tuple(int(q) for q in p) for p in padding)
 
 
-def _max_pool_kernel(x, ksize, stride, padding, fmt, dims):
-    if fmt == "NCHW":
-        window = (1, 1) + ksize
-        strides = (1, 1) + stride
-        pads = ((0, 0), (0, 0)) + padding if not isinstance(padding, str) \
-            else padding
-    else:
-        window = (1,) + ksize + (1,)
-        strides = (1,) + stride + (1,)
-        pads = ((0, 0),) + padding + ((0, 0),) if not isinstance(
-            padding, str) else padding
-    # init must be a literal for JAX to recognize reduce_window_max's VJP
+def _nchw(x, fmt):
+    """Move channels-last input to [N, C, *S]; returns (x, undo)."""
+    if fmt.startswith("NC"):
+        return x, None
+    nd = x.ndim
+    perm = (0, nd - 1) + tuple(range(1, nd - 1))
+    inv = (0,) + tuple(range(2, nd)) + (1,)
+    return jnp.transpose(x, perm), inv
+
+
+def _resolve_pads(padding, in_sizes, ksize, stride):
+    if isinstance(padding, str):
+        if padding == "VALID":
+            return tuple((0, 0) for _ in ksize)
+        pads = []  # SAME
+        for L, k, s_ in zip(in_sizes, ksize, stride):
+            o = -(-L // s_)
+            tot = max(0, (o - 1) * s_ + k - L)
+            pads.append((tot // 2, tot - tot // 2))
+        return tuple(pads)
+    return padding
+
+
+def _pool_geometry(in_sizes, ksize, stride, pads, ceil_mode):
+    """Output sizes + extra high-side padding implementing ceil_mode."""
+    outs, extras = [], []
+    for L, k, s_, (pl, ph) in zip(in_sizes, ksize, stride, pads):
+        eff = L + pl + ph - k
+        o = (-(-eff // s_) if ceil_mode else eff // s_) + 1
+        if ceil_mode and (o - 1) * s_ >= L + pl:
+            # windows starting in the right padding are dropped
+            # (torch/paddle ceil_mode rule)
+            o -= 1
+        extras.append(max(0, (o - 1) * s_ + k - (L + pl + ph)))
+        outs.append(o)
+    return outs, extras
+
+
+def _max_pool_nd(x, ksize, stride, padding, ceil_mode, fmt, with_index):
+    x, undo = _nchw(x, fmt)
+    d = len(ksize)
     if jnp.issubdtype(x.dtype, jnp.floating):
-        init = -jnp.inf
+        neg = np.array(-np.inf, x.dtype)
     else:
-        init = int(jnp.iinfo(x.dtype).min)
-    return lax.reduce_window(x, init, lax.max, window, strides, pads)
-
-
-register_op("max_pool2d", _max_pool_kernel)
-
-
-def _avg_pool_kernel(x, ksize, stride, padding, fmt, dims, exclusive):
-    if fmt == "NCHW":
-        window = (1, 1) + ksize
-        strides = (1, 1) + stride
-        pads = ((0, 0), (0, 0)) + padding if not isinstance(padding, str) \
-            else padding
+        neg = np.array(np.iinfo(np.dtype(x.dtype)).min, x.dtype)
+    in_sizes = x.shape[2:]
+    padding = _resolve_pads(padding, in_sizes, ksize, stride)
+    outs, extras = _pool_geometry(in_sizes, ksize, stride, padding,
+                                  ceil_mode)
+    padcfg = [(0, 0), (0, 0)] + [
+        (pl, ph + e) for (pl, ph), e in zip(padding, extras)]
+    xp = jnp.pad(x, padcfg, constant_values=neg)
+    out = lax.reduce_window(xp, neg, lax.max, (1, 1) + tuple(ksize),
+                            (1, 1) + tuple(stride),
+                            ((0, 0),) * (d + 2))
+    if not with_index:
+        return out if undo is None else jnp.transpose(out, undo)
+    # argmax within each window via extracted patches -> flat input index.
+    # patches are conv-based, so pad with a finite large-negative value:
+    # 0 * -inf in the identity conv would poison patches with NaN
+    if jnp.issubdtype(x.dtype, jnp.floating):
+        big_neg = np.array(np.finfo(np.dtype(x.dtype)).min, x.dtype)
     else:
-        window = (1,) + ksize + (1,)
-        strides = (1,) + stride + (1,)
-        pads = ((0, 0),) + padding + ((0, 0),) if not isinstance(
-            padding, str) else padding
+        big_neg = neg
+    xp_idx = jnp.pad(x, padcfg, constant_values=big_neg)
+    patches = lax.conv_general_dilated_patches(
+        xp_idx, tuple(ksize), tuple(stride), ((0, 0),) * d)
+    n, c = x.shape[0], x.shape[1]
+    kprod = 1
+    for k in ksize:
+        kprod *= k
+    patches = patches.reshape((n, c, kprod) + tuple(outs))
+    rel = jnp.argmax(patches, axis=2)
+    # decompose rel (row-major over ksize) into per-dim offsets, build
+    # the flat index over the UNPADDED input
+    flat = jnp.zeros_like(rel)
+    rem = rel
+    coords = []
+    for i in range(d - 1, -1, -1):
+        coords.append(rem % ksize[i])
+        rem = rem // ksize[i]
+    coords = coords[::-1]
+    for i in range(d):
+        oidx = jnp.arange(outs[i]).reshape(
+            (1, 1) + tuple(outs[i] if j == i else 1 for j in range(d)))
+        pos = oidx * stride[i] + coords[i] - padding[i][0]
+        pos = jnp.clip(pos, 0, in_sizes[i] - 1)
+        tail = 1
+        for j in range(i + 1, d):
+            tail *= in_sizes[j]
+        flat = flat + pos * tail
+    out_final = out if undo is None else jnp.transpose(out, undo)
+    idx_final = flat if undo is None else jnp.transpose(flat, undo)
+    return out_final, idx_final.astype(jnp.int32)
+
+
+def _avg_pool_nd(x, ksize, stride, padding, ceil_mode, fmt, exclusive,
+                 divisor):
+    x, undo = _nchw(x, fmt)
+    d = len(ksize)
     # init must be a host literal (np scalar, NOT jnp.array): under jit a
     # device constant defeats the monoid detection and reduce_window loses
     # its transpose rule, breaking the backward pass
     zero = np.array(0, x.dtype)
-    summed = lax.reduce_window(x, zero, lax.add, window, strides, pads)
-    if exclusive and not isinstance(padding, str):
-        ones = jnp.ones_like(x)
-        counts = lax.reduce_window(ones, np.array(0, x.dtype), lax.add,
-                                   window, strides, pads)
-        return summed / counts
-    denom = 1
-    for k in ksize:
-        denom *= k
-    return summed / denom
+    in_sizes = x.shape[2:]
+    padding = _resolve_pads(padding, in_sizes, ksize, stride)
+    outs, extras = _pool_geometry(in_sizes, ksize, stride, padding,
+                                  ceil_mode)
+    window = (1, 1) + tuple(ksize)
+    strides = (1, 1) + tuple(stride)
+    nopad = ((0, 0),) * (d + 2)
+    padcfg = [(0, 0), (0, 0)] + [
+        (pl, ph + e) for (pl, ph), e in zip(padding, extras)]
+    xp = jnp.pad(x, padcfg)
+    summed = lax.reduce_window(xp, zero, lax.add, window, strides, nopad)
+    if divisor is not None:
+        out = summed / divisor
+    else:
+        ones_shape = (1, 1) + tuple(in_sizes)
+        ones = jnp.ones(ones_shape, x.dtype)
+        if exclusive:
+            # count only real cells (count_include_pad=False)
+            onesp = jnp.pad(ones, padcfg)
+        else:
+            # count real + symmetric-pad cells, not the ceil extension
+            onesp = jnp.pad(ones, [(0, 0), (0, 0)] + [
+                (pl, ph) for (pl, ph), _ in zip(padding, extras)],
+                constant_values=1)
+            onesp = jnp.pad(onesp, [(0, 0), (0, 0)] + [
+                (0, e) for _, e in zip(padding, extras)])
+        counts = lax.reduce_window(onesp, zero, lax.add, window, strides,
+                                   nopad)
+        out = summed / jnp.maximum(counts, 1)
+    return out if undo is None else jnp.transpose(out, undo)
 
 
-register_op("avg_pool2d", _avg_pool_kernel)
+register_op("max_pool_nd", _max_pool_nd)
+register_op("max_pool_nd_index",
+            lambda *a, **k: _max_pool_nd(*a, **k),
+            multi_output=True)
+register_op("avg_pool_nd", _avg_pool_nd)
 
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                return_mask=False, data_format="NCHW", name=None):
     ksize = _pair(kernel_size)
     stride = ksize if stride is None else _pair(stride)
-    out = apply("max_pool2d", x, ksize=ksize, stride=stride,
-                padding=_pool_pads(padding), fmt=data_format, dims=2)
-    if return_mask:
-        raise NotImplementedError("return_mask not supported on TPU path")
-    return out
+    op = "max_pool_nd_index" if return_mask else "max_pool_nd"
+    return apply(op, x, ksize=ksize, stride=stride,
+                 padding=_pool_pads(padding), ceil_mode=bool(ceil_mode),
+                 fmt=data_format, with_index=bool(return_mask))
 
 
 def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
@@ -98,9 +188,10 @@ def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                name=None):
     ksize = _pair(kernel_size)
     stride = ksize if stride is None else _pair(stride)
-    return apply("avg_pool2d", x, ksize=ksize, stride=stride,
-                 padding=_pool_pads(padding), fmt=data_format, dims=2,
-                 exclusive=bool(exclusive))
+    return apply("avg_pool_nd", x, ksize=ksize, stride=stride,
+                 padding=_pool_pads(padding), ceil_mode=bool(ceil_mode),
+                 fmt=data_format, exclusive=bool(exclusive),
+                 divisor=divisor_override)
 
 
 def max_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
@@ -112,8 +203,15 @@ def max_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
     if not isinstance(pad, str):
         pad = (pad[0], (0, 0))
     x4 = unsqueeze(x, 3)  # N, C, L, 1
-    out = apply("max_pool2d", x4, ksize=ksize, stride=stride1, padding=pad,
-                fmt="NCHW", dims=2)
+    if return_mask:
+        out, idx = apply("max_pool_nd_index", x4, ksize=ksize,
+                         stride=stride1, padding=pad,
+                         ceil_mode=bool(ceil_mode), fmt="NCHW",
+                         with_index=True)
+        return squeeze(out, 3), squeeze(idx, 3)
+    out = apply("max_pool_nd", x4, ksize=ksize, stride=stride1,
+                padding=pad, ceil_mode=bool(ceil_mode), fmt="NCHW",
+                with_index=False)
     return squeeze(out, 3)
 
 
@@ -126,8 +224,9 @@ def avg_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
     if not isinstance(pad, str):
         pad = (pad[0], (0, 0))
     x4 = unsqueeze(x, 3)
-    out = apply("avg_pool2d", x4, ksize=ksize, stride=stride1, padding=pad,
-                fmt="NCHW", dims=2, exclusive=bool(exclusive))
+    out = apply("avg_pool_nd", x4, ksize=ksize, stride=stride1,
+                padding=pad, ceil_mode=bool(ceil_mode), fmt="NCHW",
+                exclusive=bool(exclusive), divisor=None)
     return squeeze(out, 3)
 
 

@@ -14,6 +14,15 @@ from .extra import (angle, bincount, copysign, diff, frexp, histogram,  # noqa: 
                     vander)
 from . import _helper, creation, indexing, linalg, manipulation, math, \
     reduction, search  # noqa: F401
+from . import math_ext  # noqa: F401
+from .math_ext import (addmm, baddbmm, cummax, cummin, i0, i0e, i1,  # noqa: F401
+                       i1e, gammaln, polygamma, gammainc, gammaincc, dist,
+                       cholesky_solve, svdvals, diag_embed, fill_diagonal,
+                       fill_diagonal_, multiplex, slice,
+                       strided_slice, crop, unstack, reverse, is_empty,
+                       bitwise_left_shift, bitwise_right_shift, reduce_as,
+                       clip_by_norm, squared_l2_norm, l1_norm, poisson,
+                       binomial, standard_gamma, dirichlet, exponential_)
 
 from .creation import *  # noqa: F401,F403
 from .linalg import *  # noqa: F401,F403

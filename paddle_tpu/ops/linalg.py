@@ -265,7 +265,9 @@ def cdist(x, y, p=2.0, name=None):
 
 
 def householder_product(x, tau, name=None):
-    raise NotImplementedError
+    """Q from Householder reflectors (geqrf layout), ops.yaml
+    householder_product."""
+    return apply("householder_product_", x, tau)
 
 
 def corrcoef(x, rowvar=True, name=None):

@@ -1,0 +1,334 @@
+"""Long-tail math/tensor ops (reference paddle/phi/ops/yaml/ops.yaml:
+addmm, baddbmm, cummax/cummin, Bessel i0/i0e/i1/i1e, polygamma,
+gammaln/gammainc/gammaincc, dist, cholesky_solve, svdvals, diag_embed,
+fill_diagonal, multiplex, slice/strided_slice, crop, bit shifts,
+reduce_as, clip_by_norm, l1/squared_l2 norms, random distributions)."""
+from __future__ import annotations
+
+import builtins
+
+import jax
+import jax.numpy as jnp
+import jax.scipy.special as jss
+from jax import lax
+
+from .._core import random as rnd
+from .._core.executor import apply
+from .._core.op_registry import register_op
+from .._core.tensor import Tensor
+from ._helper import def_binary, def_unary, tensor_method
+
+# --------------------------------------------------- blas-style composites
+register_op("addmm_", lambda inp, x, y, beta, alpha:
+            beta * inp + alpha * (x @ y))
+register_op("baddbmm_", lambda inp, x, y, beta, alpha:
+            beta * inp + alpha * jnp.matmul(x, y))
+
+
+@tensor_method("addmm")
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return apply("addmm_", input, x, y, beta=float(beta),
+                 alpha=float(alpha))
+
+
+def baddbmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return apply("baddbmm_", input, x, y, beta=float(beta),
+                 alpha=float(alpha))
+
+
+# ----------------------------------------------------- cumulative min/max
+def _cummaxmin(x, axis, op):
+    axis = axis % x.ndim
+    val = op(x, axis=axis)
+    # indices: position of the running extremum along axis
+    eq = x == val
+    ar = jnp.arange(x.shape[axis]).reshape(
+        [-1 if i == (axis % x.ndim) else 1 for i in range(x.ndim)])
+    idx = jax.lax.cummax(jnp.where(eq, ar, -1), axis=axis)
+    return val, idx.astype(jnp.int64)
+
+
+register_op("cummax_", lambda x, axis: _cummaxmin(x, axis, lax.cummax),
+            multi_output=True)
+register_op("cummin_", lambda x, axis: _cummaxmin(x, axis, lax.cummin),
+            multi_output=True)
+
+
+@tensor_method("cummax")
+def cummax(x, axis=-1, dtype="int64", name=None):
+    return apply("cummax_", x, axis=int(axis))
+
+
+@tensor_method("cummin")
+def cummin(x, axis=-1, dtype="int64", name=None):
+    return apply("cummin_", x, axis=int(axis))
+
+
+# ------------------------------------------------------ special functions
+i0 = def_unary("i0", jss.i0)
+i0e = def_unary("i0e", jss.i0e)
+i1 = def_unary("i1", jss.i1)
+i1e = def_unary("i1e", jss.i1e)
+gammaln = def_unary("gammaln", jss.gammaln)
+
+register_op("polygamma_", lambda x, n: jss.polygamma(n, x))
+register_op("gammainc_", jss.gammainc)
+register_op("gammaincc_", jss.gammaincc)
+
+
+@tensor_method("polygamma")
+def polygamma(x, n, name=None):
+    return apply("polygamma_", x, n=int(n))
+
+
+def gammainc(x, y, name=None):
+    return apply("gammainc_", x, y)
+
+
+def gammaincc(x, y, name=None):
+    return apply("gammaincc_", x, y)
+
+
+# ------------------------------------------------------------- distances
+register_op("dist_", lambda x, y, p: jnp.linalg.norm(
+    (x - y).reshape(-1), ord=p))
+
+
+def dist(x, y, p=2.0, name=None):
+    return apply("dist_", x, y, p=float(p))
+
+
+# ---------------------------------------------------------------- linalg
+register_op("cholesky_solve_", lambda x, y, upper:
+            jax.scipy.linalg.cho_solve((y, not upper), x))
+register_op("svdvals_", lambda x: jnp.linalg.svd(x, compute_uv=False))
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    """Solve A X = B given the Cholesky factor ``y`` of A (B is ``x``)."""
+    return apply("cholesky_solve_", x, y, upper=bool(upper))
+
+
+def svdvals(x, name=None):
+    return apply("svdvals_", x)
+
+
+def _householder_product_2d(x, tau):
+    m, n = x.shape[-2], x.shape[-1]
+    k = tau.shape[-1]  # may be < n: only k reflectors exist
+    eye = jnp.eye(m, dtype=x.dtype)
+
+    def body(q, i):
+        v = jnp.where(jnp.arange(m) < i, 0.0,
+                      jnp.where(jnp.arange(m) == i, 1.0, x[:, i]))
+        h = eye - tau[i] * jnp.outer(v, v)
+        return q @ h, None
+
+    q, _ = lax.scan(body, eye, jnp.arange(k))
+    return q[:, :n]
+
+
+def _householder_product_kernel(x, tau):
+    if x.ndim == 2:
+        return _householder_product_2d(x, tau)
+    batch = x.shape[:-2]
+    xf = x.reshape((-1,) + x.shape[-2:])
+    tf = tau.reshape((-1, tau.shape[-1]))
+    qf = jax.vmap(_householder_product_2d)(xf, tf)
+    return qf.reshape(batch + qf.shape[-2:])
+
+
+register_op("householder_product_", _householder_product_kernel)
+
+# -------------------------------------------------------- diagonal tools
+register_op("diag_embed_", lambda x, offset, dim1, dim2: _diag_embed(
+    x, offset, dim1, dim2))
+
+
+def _diag_embed(x, offset, dim1, dim2):
+    n = x.shape[-1] + abs(offset)
+    out = jnp.zeros(x.shape[:-1] + (n, n), x.dtype)
+    rows = jnp.arange(x.shape[-1]) + max(-offset, 0)
+    cols = jnp.arange(x.shape[-1]) + max(offset, 0)
+    out = out.at[..., rows, cols].set(x)
+    # move the two new axes to dim1/dim2
+    nd = out.ndim
+    perm = [i for i in range(nd) if i < nd - 2]
+    d1, d2 = dim1 % nd, dim2 % nd
+    order = []
+    k = 0
+    for i in range(nd):
+        if i == d1:
+            order.append(nd - 2)
+        elif i == d2:
+            order.append(nd - 1)
+        else:
+            order.append(perm[k])
+            k += 1
+    return jnp.transpose(out, order)
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None):
+    return apply("diag_embed_", x, offset=int(offset), dim1=int(dim1),
+                 dim2=int(dim2))
+
+
+def _fill_diagonal_kernel(x, value, offset, wrap):
+    if x.ndim > 2:
+        # space diagonal x[i,i,...,i] (torch/numpy fill_diagonal ndim>2)
+        n = min(x.shape)
+        idx = jnp.arange(n)
+        return x.at[tuple(idx for _ in range(x.ndim))].set(
+            jnp.asarray(value, x.dtype))
+    h, w = x.shape[-2], x.shape[-1]
+    rows = jnp.arange(h)[:, None]
+    cols = jnp.arange(w)[None, :]
+    if wrap and h > w:
+        # numpy wrap semantics: the diagonal restarts every w+1 rows
+        mask = (rows % (w + 1)) == cols
+    else:
+        mask = (cols - rows) == offset
+    return jnp.where(mask, jnp.asarray(value, x.dtype), x)
+
+
+register_op("fill_diagonal_", _fill_diagonal_kernel)
+
+
+def fill_diagonal(x, value, offset=0, wrap=False, name=None):
+    return apply("fill_diagonal_", x, value=float(value),
+                 offset=int(offset), wrap=bool(wrap))
+
+
+def fill_diagonal_(x, value, offset=0, wrap=False, name=None):
+    return x._adopt(fill_diagonal(x, value, offset, wrap))
+
+
+# ------------------------------------------------------- select / slicing
+def _multiplex_kernel(index, *ins):
+    stacked = jnp.stack(ins, axis=0)  # [k, N, ...]
+    idx = index.reshape(-1).astype(jnp.int32)
+    return stacked[idx, jnp.arange(stacked.shape[1])]
+
+
+register_op("multiplex_", _multiplex_kernel)
+
+
+def multiplex(inputs, index, name=None):
+    """Row-wise select: out[i] = inputs[index[i]][i] (ops.yaml multiplex)."""
+    return apply("multiplex_", index, *inputs)
+
+
+register_op("strided_slice_", lambda x, spec: x[
+    tuple(builtins.slice(*s) for s in spec)])
+
+
+def slice(input, axes, starts, ends, name=None):
+    return strided_slice(input, axes, starts, ends,
+                         [1] * len(list(axes)))
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    spec = [(None, None, None)] * x.ndim
+    for ax, st, en, sd in zip(axes, starts, ends, strides):
+        spec[ax] = (int(st), int(en), int(sd))
+    return apply("strided_slice_", x, spec=tuple(spec))
+
+
+register_op("crop_", lambda x, offsets, shape: lax.dynamic_slice(
+    x, offsets, shape))
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    offsets = list(offsets) if offsets is not None else [0] * x.ndim
+    shape = list(shape) if shape is not None else [-1] * x.ndim
+    # -1/None means "to the end" from the offset (reference crop)
+    shape = [x.shape[i] - offsets[i] if s in (-1, None) else int(s)
+             for i, s in enumerate(shape)]
+    return apply("crop_", x, offsets=tuple(int(o) for o in offsets),
+                 shape=tuple(shape))
+
+
+def unstack(x, axis=0, num=None, name=None):
+    from .manipulation import unbind
+    return unbind(x, axis=axis)
+
+
+def reverse(x, axis, name=None):
+    from .manipulation import flip
+    return flip(x, axis)
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(x.size == 0))
+
+
+# ------------------------------------------------------------ bit shifts
+bitwise_left_shift = def_binary("bitwise_left_shift", jnp.left_shift)
+bitwise_right_shift = def_binary("bitwise_right_shift", jnp.right_shift)
+
+
+# ----------------------------------------------------------- norm family
+def _reduce_as_kernel(x, tshape):
+    axes = []
+    off = x.ndim - len(tshape)
+    for i in range(x.ndim):
+        if i < off:
+            axes.append(i)
+        elif tshape[i - off] == 1 and x.shape[i] != 1:
+            axes.append(i)
+    out = jnp.sum(x, axis=tuple(axes), keepdims=True) if axes else x
+    return out.reshape(tshape)
+
+
+register_op("reduce_as_", _reduce_as_kernel)
+
+
+def reduce_as(x, target, name=None):
+    """Sum x down to target's shape (ops.yaml reduce_as)."""
+    return apply("reduce_as_", x, tshape=tuple(target.shape))
+
+
+register_op("clip_by_norm_", lambda x, max_norm: x * jnp.minimum(
+    1.0, max_norm / jnp.maximum(jnp.linalg.norm(x.reshape(-1)), 1e-12)))
+register_op("squared_l2_norm_", lambda x: jnp.sum(x * x).reshape(1))
+register_op("l1_norm_", lambda x: jnp.sum(jnp.abs(x)))
+
+
+def clip_by_norm(x, max_norm, name=None):
+    return apply("clip_by_norm_", x, max_norm=float(max_norm))
+
+
+def squared_l2_norm(x, name=None):
+    return apply("squared_l2_norm_", x)
+
+
+def l1_norm(x, name=None):
+    return apply("l1_norm_", x)
+
+
+# ---------------------------------------------------- random distributions
+def poisson(x, name=None):
+    return Tensor(jax.random.poisson(rnd.next_key(), x._value).astype(
+        x._value.dtype))
+
+
+def binomial(count, prob, name=None):
+    c = count._value if isinstance(count, Tensor) else jnp.asarray(count)
+    p = prob._value if isinstance(prob, Tensor) else jnp.asarray(prob)
+    return Tensor(jax.random.binomial(rnd.next_key(), c.astype("float32"),
+                                      p).astype("int64"))
+
+
+def standard_gamma(x, name=None):
+    return Tensor(jax.random.gamma(rnd.next_key(), x._value))
+
+
+def dirichlet(concentration, name=None):
+    return Tensor(jax.random.dirichlet(rnd.next_key(),
+                                       concentration._value))
+
+
+def exponential_(x, lam=1.0, name=None):
+    sample = jax.random.exponential(
+        rnd.next_key(), x.shape, x._value.dtype) / lam
+    return x._adopt(Tensor(sample))

@@ -126,3 +126,14 @@ def check_grad(api, inputs, attrs=None, wrt=None, delta=5e-3,
             f"{getattr(api, '__name__', api)}: max rel err {rel:.2e} "
             f"(analytic={agrad.reshape(-1)[:5]}, "
             f"numeric={ngrad.reshape(-1)[:5]})")
+
+
+def case_ids(cases):
+    """Unique pytest ids for a Case table (duplicate names get #n)."""
+    seen = {}
+    out = []
+    for c in cases:
+        n = seen.get(c.name, 0)
+        seen[c.name] = n + 1
+        out.append(c.name if n == 0 else f"{c.name}#{n}")
+    return out

@@ -13,7 +13,7 @@ import scipy.special as sps
 
 import paddle_tpu as paddle
 import paddle_tpu.nn.functional as F
-from op_test import check_grad, check_output
+from op_test import case_ids, check_grad, check_output
 
 RNG = np.random.RandomState(7)
 
@@ -49,6 +49,14 @@ def ints(*s, lo=0, hi=8):
 
 def bools(*s):
     return RNG.rand(*s) > 0.5
+
+
+def uniq(*s):
+    """All-distinct values: numeric grad checks of max-like ops are
+    invalid near ties, and tie incidence depends on RNG draw order."""
+    n = int(np.prod(s))
+    vals = np.linspace(-2.0, 2.0, n, dtype="float32")
+    return np.random.RandomState(5).permutation(vals).reshape(s)
 
 
 class Case:
@@ -247,17 +255,7 @@ CASES = [
 ]
 
 
-def _ids():
-    seen = {}
-    out = []
-    for c in CASES:
-        n = seen.get(c.name, 0)
-        seen[c.name] = n + 1
-        out.append(c.name if n == 0 else f"{c.name}#{n}")
-    return out
-
-
-@pytest.mark.parametrize("case", CASES, ids=_ids())
+@pytest.mark.parametrize("case", CASES, ids=case_ids(CASES))
 def test_forward(case):
     check_output(case.api, case.inputs, attrs=case.attrs, ref=case.ref,
                  rtol=case.rtol, atol=case.atol)
@@ -266,8 +264,7 @@ def test_forward(case):
 GRAD_CASES = [c for c in CASES if c.grad]
 
 
-@pytest.mark.parametrize("case", GRAD_CASES,
-                         ids=[c.name for c in GRAD_CASES])
+@pytest.mark.parametrize("case", GRAD_CASES, ids=case_ids(GRAD_CASES))
 def test_grad(case):
     check_grad(case.api, case.inputs, attrs=case.attrs, wrt=case.wrt,
                max_relative_error=case.gtol, delta=case.gdelta)

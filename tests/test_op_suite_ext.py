@@ -1,0 +1,261 @@
+"""Per-op tests for the long-tail batch: math_ext + nn.functional
+extended ops (reference ops.yaml burn-down), via the OpTest harness with
+torch/SciPy oracles."""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+import scipy.special as sps
+import torch
+import torch.nn.functional as tF
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from op_test import case_ids, check_grad, check_output
+from test_op_suite import Case, any_, ints, nonzero, pos, prob, uniq
+from test_op_suite_nn import _t
+
+CASES = [
+    # ------------------------------------------------------- math_ext
+    Case("addmm", paddle.addmm, [any_(3, 5), any_(3, 4), any_(4, 5)],
+         lambda i, x, y: i + x @ y),
+    Case("baddbmm", paddle.baddbmm,
+         [any_(2, 3, 5), any_(2, 3, 4), any_(2, 4, 5)],
+         lambda i, x, y: i + np.matmul(x, y)),
+    Case("cummax", paddle.cummax, [any_(3, 5)],
+         _t(lambda x: tuple(torch.cummax(x, dim=-1))), grad=False),
+    Case("cummin", paddle.cummin, [any_(3, 5)],
+         _t(lambda x: tuple(torch.cummin(x, dim=-1))), grad=False),
+    Case("i0", paddle.i0, [any_(3, 4)], sps.i0, rtol=1e-3),
+    Case("i0e", paddle.i0e, [any_(3, 4)], sps.i0e, rtol=1e-3),
+    Case("i1", paddle.i1, [any_(3, 4)], sps.i1, rtol=1e-3),
+    Case("i1e", paddle.i1e, [any_(3, 4)], sps.i1e, rtol=1e-3),
+    Case("gammaln", paddle.gammaln, [pos(3, 4)], sps.gammaln,
+         rtol=1e-3),
+    Case("polygamma", paddle.polygamma, [pos(3, 4)],
+         lambda x, n: sps.polygamma(n, x), attrs={"n": 1}, rtol=1e-3,
+         grad=False),
+    Case("gammainc", paddle.gammainc, [pos(3, 4), pos(3, 4)],
+         sps.gammainc, rtol=1e-3, grad=False),
+    Case("gammaincc", paddle.gammaincc, [pos(3, 4), pos(3, 4)],
+         sps.gammaincc, rtol=1e-3, grad=False),
+    Case("dist", paddle.dist, [any_(3, 4), any_(3, 4)],
+         lambda x, y: np.linalg.norm((x - y).reshape(-1)), gtol=1e-2),
+    Case("diag_embed", paddle.diag_embed, [any_(2, 3)],
+         _t(torch.diag_embed)),
+    Case("fill_diagonal",
+         lambda x: paddle.fill_diagonal(x, 9.0),
+         [any_(4, 4)],
+         lambda x: np.where(np.eye(4, dtype=bool), 9.0, x), wrt=[0]),
+    Case("multiplex",
+         lambda a, b, idx: paddle.multiplex([a, b], idx),
+         [any_(4, 3), any_(4, 3), np.array([[0], [1], [0], [1]])],
+         lambda a, b, idx: np.where(idx == 0, a, b), wrt=[0, 1]),
+    Case("slice_api",
+         lambda x: paddle.slice(x, [0, 1], [0, 1], [2, 3]),
+         [any_(3, 4)], lambda x: x[0:2, 1:3]),
+    Case("strided_slice",
+         lambda x: paddle.strided_slice(x, [1], [0], [4], [2]),
+         [any_(3, 4)], lambda x: x[:, 0:4:2]),
+    Case("crop",
+         lambda x: paddle.crop(x, shape=[2, 2], offsets=[1, 1]),
+         [any_(4, 4)], lambda x: x[1:3, 1:3]),
+    Case("unstack", paddle.unstack, [any_(3, 4)],
+         lambda x: [x[i] for i in range(3)]),
+    Case("reverse", lambda x: paddle.reverse(x, [0]), [any_(3, 4)],
+         lambda x: np.flip(x, 0)),
+    Case("bitwise_left_shift", paddle.bitwise_left_shift,
+         [ints(3, 4), ints(3, 4, lo=0, hi=3)], np.left_shift,
+         grad=False),
+    Case("bitwise_right_shift", paddle.bitwise_right_shift,
+         [ints(3, 4, lo=0, hi=64), ints(3, 4, lo=0, hi=3)],
+         np.right_shift, grad=False),
+    Case("reduce_as",
+         lambda x, t: paddle.reduce_as(x, t),
+         [any_(3, 4), np.zeros(4, "float32")],
+         lambda x, t: x.sum(0), wrt=[0]),
+    Case("clip_by_norm", paddle.clip_by_norm, [any_(3, 4)],
+         lambda x, max_norm:
+         x * min(1.0, max_norm / np.linalg.norm(x.reshape(-1))),
+         attrs={"max_norm": 1.0}, gtol=1e-2),
+    Case("squared_l2_norm", paddle.squared_l2_norm, [any_(3, 4)],
+         lambda x: np.array([np.sum(x * x)])),
+    Case("l1_norm", paddle.l1_norm, [nonzero(3, 4)],
+         lambda x: np.sum(np.abs(x))),
+    Case("cholesky_solve",
+         lambda b, l: paddle.cholesky_solve(b, l),
+         [any_(3, 2),
+          np.linalg.cholesky(np.eye(3) * 4 + 0.5).astype("float32")],
+         lambda b, l: np.linalg.solve(l @ l.T, b), rtol=1e-3,
+         atol=1e-4, wrt=[0], gtol=1e-2),
+    Case("svdvals", paddle.svdvals, [any_(4, 3)],
+         lambda x: np.linalg.svd(x, compute_uv=False), rtol=1e-3,
+         grad=False),
+    Case("householder_product", paddle.householder_product,
+         [any_(4, 3), pos(3)],
+         _t(lambda a, tau: torch.linalg.householder_product(a, tau)),
+         rtol=1e-3, atol=1e-4, grad=False),
+
+    # ------------------------------------------------- extended functional
+    Case("grid_sample", F.grid_sample,
+         [any_(2, 3, 5, 5),
+          (np.random.RandomState(3).rand(2, 4, 4, 2) * 2 - 1)
+          .astype("float32")],
+         _t(lambda x, g: tF.grid_sample(x, g, align_corners=True)),
+         rtol=1e-3, atol=1e-4, wrt=[0, 1], gtol=2e-2),
+    Case("affine_grid",
+         lambda t: F.affine_grid(t, [2, 3, 4, 5]),
+         [any_(2, 2, 3)],
+         _t(lambda t: tF.affine_grid(t, (2, 3, 4, 5),
+                                     align_corners=True)),
+         rtol=1e-3, atol=1e-4, gtol=1e-2),
+    Case("pixel_shuffle", lambda x: F.pixel_shuffle(x, 2),
+         [any_(2, 8, 3, 3)],
+         _t(lambda x: tF.pixel_shuffle(x, 2))),
+    Case("pixel_unshuffle", lambda x: F.pixel_unshuffle(x, 2),
+         [any_(2, 2, 6, 6)],
+         _t(lambda x: tF.pixel_unshuffle(x, 2))),
+    Case("channel_shuffle", lambda x: F.channel_shuffle(x, 4),
+         [any_(2, 8, 3, 3)],
+         _t(lambda x: tF.channel_shuffle(x, 4))),
+    Case("fold", lambda x: F.fold(x, [4, 4], [2, 2]),
+         [any_(2, 12, 9)],
+         _t(lambda x: tF.fold(x, (4, 4), (2, 2))), gtol=1e-2),
+    Case("temporal_shift", lambda x: F.temporal_shift(x, 2),
+         [any_(4, 8, 3, 3)], None, grad=True),
+    Case("maxout", lambda x: F.maxout(x, 2), [uniq(2, 4, 3, 3)],
+         lambda x: x.reshape(2, 2, 2, 3, 3).max(2), gtol=1e-2),
+    Case("avg_pool3d", lambda x: F.avg_pool3d(x, 2, 2),
+         [any_(2, 3, 4, 4, 4)],
+         _t(lambda x: tF.avg_pool3d(x, 2, 2)), gtol=1e-2),
+    Case("max_pool3d", lambda x: F.max_pool3d(x, 2, 2),
+         [uniq(2, 3, 4, 4, 4)],
+         _t(lambda x: tF.max_pool3d(x, 2, 2)), gtol=1e-2),
+    Case("conv3d_transpose",
+         lambda x, w: F.conv3d_transpose(x, w, stride=2),
+         [any_(1, 2, 3, 3, 3), any_(2, 3, 2, 2, 2)],
+         _t(lambda x, w: tF.conv_transpose3d(x, w, stride=2)),
+         rtol=1e-3, atol=1e-4, gtol=1e-2),
+    Case("lp_pool2d", lambda x: F.lp_pool2d(x, 2.0, 2, 2),
+         [pos(2, 3, 4, 4)],
+         _t(lambda x: tF.lp_pool2d(x, 2.0, 2, 2)), rtol=1e-3,
+         gtol=1e-2),
+    Case("huber_loss", F.huber_loss, [any_(4, 3), any_(4, 3)],
+         _t(tF.huber_loss)),
+    Case("hinge_loss", F.hinge_loss,
+         [any_(4, 3), (prob(4, 3) > 0.5).astype("float32")],
+         lambda x, y: np.maximum(0, 1 - (2 * y - 1) * x), grad=False),
+    Case("log_loss", F.log_loss,
+         [prob(4, 1), (prob(4, 1) > 0.5).astype("float32")],
+         lambda x, y, epsilon=1e-4:
+         -y * np.log(x + epsilon) - (1 - y) * np.log(1 - x + epsilon)),
+    Case("square_error_cost", F.square_error_cost,
+         [any_(4, 3), any_(4, 3)], lambda x, y: (x - y) ** 2),
+    Case("soft_margin_loss", F.soft_margin_loss,
+         [any_(4, 3),
+          ((prob(4, 3) > 0.5).astype("float32") * 2 - 1)],
+         _t(tF.soft_margin_loss), wrt=[0]),
+    Case("multi_label_soft_margin_loss",
+         F.multi_label_soft_margin_loss,
+         [any_(4, 3), (prob(4, 3) > 0.5).astype("float32")],
+         _t(tF.multilabel_soft_margin_loss), rtol=1e-3, wrt=[0],
+         gtol=1e-2),
+    Case("triplet_margin_loss", F.triplet_margin_loss,
+         [any_(4, 3), any_(4, 3), any_(4, 3)],
+         _t(tF.triplet_margin_loss), rtol=1e-3, gtol=1e-2),
+    Case("gaussian_nll_loss", F.gaussian_nll_loss,
+         [any_(4, 3), any_(4, 3), pos(4, 3)],
+         _t(tF.gaussian_nll_loss), rtol=1e-3, wrt=[0, 1], gtol=1e-2),
+    Case("poisson_nll_loss", F.poisson_nll_loss,
+         [any_(4, 3), pos(4, 3)],
+         _t(tF.poisson_nll_loss), rtol=1e-3, wrt=[0], gtol=1e-2),
+    Case("pairwise_distance", F.pairwise_distance,
+         [any_(4, 3), any_(4, 3)],
+         _t(lambda x, y: tF.pairwise_distance(x, y)), rtol=1e-3,
+         gtol=1e-2),
+]
+
+
+def test_ctc_loss_matches_torch():
+    r = np.random.RandomState(0)
+    T, N, C, S = 6, 2, 5, 3
+    logits = r.randn(T, N, C).astype("float32")
+    labels = r.randint(1, C, (N, S)).astype("int32")
+    ilen, llen = np.array([6, 5]), np.array([3, 2])
+    mine = float(F.ctc_loss(
+        paddle.to_tensor(logits), paddle.to_tensor(labels),
+        paddle.to_tensor(ilen), paddle.to_tensor(llen)).numpy())
+    ref = float(tF.ctc_loss(
+        torch.from_numpy(logits).log_softmax(-1),
+        torch.from_numpy(labels.astype("int64")),
+        torch.from_numpy(ilen), torch.from_numpy(llen),
+        reduction="mean").numpy())
+    assert abs(mine - ref) < 1e-3
+
+
+def test_grid_sample_padding_modes():
+    r = np.random.RandomState(0)
+    x = r.randn(2, 3, 5, 5).astype("float32")
+    g = (r.rand(2, 4, 4, 2).astype("float32") * 2 - 1) * 1.4  # out-of-bounds
+    for mode in ("bilinear", "nearest"):
+        for pm in ("zeros", "border", "reflection"):
+            for ac in (True, False):
+                m = F.grid_sample(paddle.to_tensor(x), paddle.to_tensor(g),
+                                  mode=mode, padding_mode=pm,
+                                  align_corners=ac).numpy()
+                t = tF.grid_sample(torch.from_numpy(x), torch.from_numpy(g),
+                                   mode=mode, padding_mode=pm,
+                                   align_corners=ac).numpy()
+                np.testing.assert_allclose(
+                    m, t, rtol=1e-4, atol=1e-5,
+                    err_msg=f"{mode}/{pm}/align={ac}")
+
+
+def test_random_distribution_ops():
+    rate = paddle.to_tensor(np.full((2000,), 4.0, "float32"))
+    s = paddle.poisson(rate).numpy()
+    assert abs(s.mean() - 4.0) < 0.3
+    g = paddle.standard_gamma(rate).numpy()
+    assert abs(g.mean() - 4.0) < 0.3
+    d = paddle.dirichlet(paddle.to_tensor(np.ones((64, 5), "float32")))
+    np.testing.assert_allclose(d.numpy().sum(-1), np.ones(64), rtol=1e-5)
+    b = paddle.binomial(paddle.to_tensor(np.full((2000,), 10.0, "float32")),
+                        paddle.to_tensor(np.full((2000,), 0.4, "float32")))
+    assert abs(b.numpy().mean() - 4.0) < 0.3
+    x = paddle.to_tensor(np.zeros((2000,), "float32"))
+    paddle.exponential_(x, lam=2.0)
+    assert abs(x.numpy().mean() - 0.5) < 0.1
+
+
+def test_sequence_mask_and_unpool():
+    m = F.sequence_mask(paddle.to_tensor(np.array([2, 4, 1])), maxlen=5)
+    np.testing.assert_array_equal(
+        m.numpy(),
+        np.array([[1, 1, 0, 0, 0], [1, 1, 1, 1, 0], [1, 0, 0, 0, 0]]))
+    # max_unpool2d inverts torch max_pool2d w/ indices
+    r = np.random.RandomState(0)
+    x = r.randn(1, 2, 4, 4).astype("float32")
+    tv, ti = tF.max_pool2d(torch.from_numpy(x), 2, 2,
+                           return_indices=True)
+    mine = F.max_unpool2d(paddle.to_tensor(tv.numpy()),
+                          paddle.to_tensor(ti.numpy()), 2, 2).numpy()
+    ref = tF.max_unpool2d(tv, ti, 2, 2).numpy()
+    np.testing.assert_allclose(mine, ref)
+
+
+FWD = [c for c in CASES if c.ref is not None]
+
+
+@pytest.mark.parametrize("case", FWD, ids=case_ids(FWD))
+def test_forward(case):
+    check_output(case.api, case.inputs, attrs=case.attrs, ref=case.ref,
+                 rtol=case.rtol, atol=case.atol)
+
+
+GRAD = [c for c in CASES if c.grad]
+
+
+@pytest.mark.parametrize("case", GRAD, ids=case_ids(GRAD))
+def test_grad(case):
+    check_grad(case.api, case.inputs, attrs=case.attrs, wrt=case.wrt,
+               max_relative_error=case.gtol, delta=case.gdelta)

@@ -14,8 +14,8 @@ import torch.nn.functional as tF
 
 import paddle_tpu as paddle
 import paddle_tpu.nn.functional as F
-from op_test import check_grad, check_output
-from test_op_suite import Case, any_, ints, nonzero, pos, prob
+from op_test import case_ids, check_grad, check_output
+from test_op_suite import Case, any_, ints, nonzero, pos, prob, uniq
 
 
 def _t(fn):
@@ -176,7 +176,7 @@ CASES = [
          _t(tF.conv_transpose2d), rtol=1e-3, atol=1e-4, gtol=1e-2),
     Case("max_pool2d",
          lambda x: F.max_pool2d(x, kernel_size=2, stride=2),
-         [any_(2, 3, 6, 6)],
+         [uniq(2, 3, 6, 6)],
          _t(lambda x: tF.max_pool2d(x, 2, 2)), gtol=1e-2),
     Case("avg_pool2d",
          lambda x: F.avg_pool2d(x, kernel_size=2, stride=2),
@@ -184,7 +184,7 @@ CASES = [
          _t(lambda x: tF.avg_pool2d(x, 2, 2)), gtol=1e-2),
     Case("max_pool1d",
          lambda x: F.max_pool1d(x, kernel_size=2, stride=2),
-         [any_(2, 3, 8)],
+         [uniq(2, 3, 8)],
          _t(lambda x: tF.max_pool1d(x, 2, 2)), gtol=1e-2),
     Case("avg_pool1d",
          lambda x: F.avg_pool1d(x, kernel_size=2, stride=2),
@@ -196,7 +196,7 @@ CASES = [
          _t(lambda x: tF.adaptive_avg_pool2d(x, 2)), gtol=1e-2),
     Case("adaptive_max_pool2d",
          lambda x: F.adaptive_max_pool2d(x, output_size=2),
-         [any_(2, 3, 6, 6)],
+         [uniq(2, 3, 6, 6)],
          _t(lambda x: tF.adaptive_max_pool2d(x, 2)), gtol=1e-2),
     Case("unfold_im2col",
          lambda x: F.unfold(x, kernel_sizes=2),
@@ -249,20 +249,10 @@ CASES = [
 CASES = [c for c in CASES if not (c.name == "prelu" and c.ref is None)]
 
 
-def _ids(cases):
-    seen = {}
-    out = []
-    for c in cases:
-        n = seen.get(c.name, 0)
-        seen[c.name] = n + 1
-        out.append(c.name if n == 0 else f"{c.name}#{n}")
-    return out
-
-
 FWD = [c for c in CASES if c.ref is not None]
 
 
-@pytest.mark.parametrize("case", FWD, ids=_ids(FWD))
+@pytest.mark.parametrize("case", FWD, ids=case_ids(FWD))
 def test_forward(case):
     check_output(case.api, case.inputs, attrs=case.attrs, ref=case.ref,
                  rtol=case.rtol, atol=case.atol)
@@ -271,7 +261,7 @@ def test_forward(case):
 GRAD = [c for c in CASES if c.grad]
 
 
-@pytest.mark.parametrize("case", GRAD, ids=_ids(GRAD))
+@pytest.mark.parametrize("case", GRAD, ids=case_ids(GRAD))
 def test_grad(case):
     check_grad(case.api, case.inputs, attrs=case.attrs, wrt=case.wrt,
                max_relative_error=case.gtol, delta=case.gdelta)

@@ -9,7 +9,7 @@ import numpy as np
 import pytest
 
 import paddle_tpu as paddle
-from op_test import check_grad, check_output
+from op_test import case_ids, check_grad, check_output
 from test_op_suite import Case, any_, ints, nonzero, pos
 
 CASES = [
@@ -191,8 +191,6 @@ CASES = [
          lambda x, y: np.sqrt(
              ((x[:, None, :] - y[None, :, :]) ** 2).sum(-1)),
          grad=False, rtol=1e-3, atol=1e-4),
-    Case("householder_product", paddle.householder_product,
-         [any_(4, 3), pos(3)], None, grad=False),
     Case("tensordot", paddle.tensordot, [any_(3, 4), any_(4, 5)],
          lambda x, y, axes: np.tensordot(x, y, axes=axes),
          attrs={"axes": 1}),
@@ -314,21 +312,10 @@ def _np_select_scatter(x, v, axis, index):
     return out
 
 
-def _ids():
-    seen = {}
-    out = []
-    for c in CASES:
-        n = seen.get(c.name, 0)
-        seen[c.name] = n + 1
-        out.append(c.name if n == 0 else f"{c.name}#{n}")
-    return out
-
-
 FWD_CASES = [c for c in CASES if c.ref is not None]
 
 
-@pytest.mark.parametrize("case", FWD_CASES,
-                         ids=[c.name for c in FWD_CASES])
+@pytest.mark.parametrize("case", FWD_CASES, ids=case_ids(FWD_CASES))
 def test_forward(case):
     check_output(case.api, case.inputs, attrs=case.attrs, ref=case.ref,
                  rtol=case.rtol, atol=case.atol)
@@ -337,8 +324,7 @@ def test_forward(case):
 GRAD_CASES = [c for c in CASES if c.grad]
 
 
-@pytest.mark.parametrize("case", GRAD_CASES,
-                         ids=[c.name for c in GRAD_CASES])
+@pytest.mark.parametrize("case", GRAD_CASES, ids=case_ids(GRAD_CASES))
 def test_grad(case):
     check_grad(case.api, case.inputs, attrs=case.attrs, wrt=case.wrt,
                max_relative_error=case.gtol, delta=case.gdelta)

@@ -21,6 +21,10 @@ def _worker():
     rank = int(os.environ["PADDLE_TRAINER_ID"])
     world = int(os.environ["PADDLE_TRAINERS_NUM"])
 
+    # JAX_PLATFORMS=cpu env alone is NOT enough: the axon TPU plugin
+    # overrides it, and N workers sharing one TPU tunnel deadlock
+    import jax
+    jax.config.update("jax_platforms", "cpu")
     import paddle_tpu as paddle
     import paddle_tpu.distributed as dist
 
