@@ -28,6 +28,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import math
+import os
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -246,13 +247,17 @@ def param_specs(config: GPTConfig, dp: str = "dp", mp: str = "mp",
 
 
 def _use_flash_kernel(config: GPTConfig, seq: int, mesh_axes) -> bool:
-    """Pallas flash attention on the single-chip compiled path. The kernel
-    is opaque to GSPMD propagation, so the sharded path keeps the einsum
-    attention (XLA partitions it by head) until the shard_map wrapper
-    lands; mesh_axes None == single chip."""
-    return (config.use_flash_attention and mesh_axes is None
-            and jax.default_backend() == "tpu" and seq % 128 == 0
-            and seq >= 256)
+    """Pallas flash attention. Single-chip path calls the kernel
+    directly; the sharded path goes through mha_spmd, whose
+    custom_partitioning rule keeps batch/head sharding and gathers
+    seq/head_dim (so it composes with GSPMD and the compiled pp
+    shard_map). Off-TPU the kernel only runs in interpret mode when
+    PT_FLASH_INTERPRET=1 (CPU mesh tests / multichip dryrun)."""
+    if not config.use_flash_attention or seq % 128:
+        return False
+    if jax.default_backend() == "tpu":
+        return seq >= 256
+    return os.environ.get("PT_FLASH_INTERPRET") == "1"
 
 
 def _ln(x, g, b, eps):
@@ -280,8 +285,12 @@ def _block(x, blk, config: GPTConfig, mesh_axes, sp_sharding=None):
     v = jnp.swapaxes(v, 1, 2)
     scale = 1.0 / math.sqrt(c.head_dim)
     if _use_flash_kernel(c, s, mesh_axes):
-        from ..ops.pallas.flash_attention import mha_forward
-        attn = mha_forward(q, k, v, causal=True, scale=scale)
+        if mesh_axes is not None:
+            from ..ops.pallas.flash_attention import mha_spmd
+            attn = mha_spmd(q, k, v, causal=True, scale=scale)
+        else:
+            from ..ops.pallas.flash_attention import mha_forward
+            attn = mha_forward(q, k, v, causal=True, scale=scale)
     else:
         logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
         mask = jnp.tril(jnp.ones((s, s), bool))

@@ -341,3 +341,137 @@ def flash_attention(query, key, value, causal=False, scale=None):
                          f" (got q={sq}, k={sk})")
     return apply("flash_attention", query, key, value, causal=bool(causal),
                  scale=float(scale))
+
+
+# ------------------------------------------------ SPMD (GSPMD-composable)
+# custom_partitioning teaches the partitioner that the kernel shards
+# freely over batch/head and needs seq/head_dim replicated — the TPU
+# analog of the reference wiring flash-attn into its SPMD rules
+# (phi/infermeta/spmd_rules). Composes with the compiled pp shard_map
+# (partial-manual: dp/mp stay GSPMD-managed inside the pp body).
+from jax.experimental.custom_partitioning import custom_partitioning
+from jax.sharding import NamedSharding, PartitionSpec as _P
+
+
+_WARNED_REPLICATED = False
+
+
+def _bh_spec(arg_shapes, mesh):
+    sh = arg_shapes[0].sharding
+    spec = getattr(sh, "spec", None)
+    if spec is None:
+        # GSPMDSharding (e.g. inside the compiled-pp partial-manual
+        # shard_map): recover a PartitionSpec over the mesh, else
+        # replicate (correct, just less parallel)
+        try:
+            from jax._src.sharding_impls import parse_flatten_op_sharding
+            parsed = parse_flatten_op_sharding(
+                sh._to_xla_hlo_sharding(len(arg_shapes[0].shape)), mesh)[0]
+            spec = parsed.get_partition_spec()
+        except Exception:
+            global _WARNED_REPLICATED
+            if not _WARNED_REPLICATED:
+                _WARNED_REPLICATED = True
+                import warnings
+                warnings.warn(
+                    "mha_spmd: could not recover a PartitionSpec from "
+                    f"{type(sh).__name__}; flash attention will run "
+                    "fully replicated over batch/head on this call site")
+            spec = _P()
+    b = spec[0] if len(spec) > 0 else None
+    h = spec[1] if len(spec) > 1 else None
+    return b, h
+
+
+def _fwd4(q, k, v, causal, scale):
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bq, bk = _block_sizes(sq, sk, d)
+    out, lse = _fwd(q.reshape(b * h, sq, d), k.reshape(b * h, sk, d),
+                    v.reshape(b * h, sk, d), causal, scale, bq, bk,
+                    kv_len=sk, q_offset=sk - sq)
+    return out.reshape(b, h, sq, d), lse.reshape(b, h, sq, 1)
+
+
+def _bwd4(q, k, v, out, lse, do, causal, scale):
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bq, bk = _block_sizes(sq, sk, d)
+    dq, dk, dv = _bwd(q.reshape(b * h, sq, d), k.reshape(b * h, sk, d),
+                      v.reshape(b * h, sk, d), out.reshape(b * h, sq, d),
+                      lse.reshape(b * h, sq, 1), do.reshape(b * h, sq, d),
+                      causal, scale, bq, bk, kv_len=sk, q_offset=sk - sq)
+    return (dq.reshape(b, h, sq, d), dk.reshape(b, h, sk, d),
+            dv.reshape(b, h, sk, d))
+
+
+def _make_partitioned(fn, n_arrays, n_outs, rule):
+    p = custom_partitioning(fn, static_argnums=(n_arrays, n_arrays + 1))
+
+    def infer(causal, scale, mesh, arg_shapes, result_shape):
+        b, h = _bh_spec(arg_shapes, mesh)
+        sh4 = NamedSharding(mesh, _P(b, h, None, None))
+        return (sh4,) * n_outs if n_outs > 1 else sh4
+
+    def part(causal, scale, mesh, arg_shapes, result_shape):
+        b, h = _bh_spec(arg_shapes, mesh)
+        sh4 = NamedSharding(mesh, _P(b, h, None, None))
+        args = (sh4,) * n_arrays
+        outs = (sh4,) * n_outs if n_outs > 1 else sh4
+
+        def lower(*arrays):
+            return fn(*arrays, causal, scale)
+
+        return mesh, lower, outs, args
+
+    # Shardy propagation: b/h shard freely, seq/head_dim factors must be
+    # replicated at the kernel boundary. The rule builder is private jax
+    # API; guard it so a future rename only disables the Shardy path
+    # instead of breaking `import paddle_tpu.ops.pallas` for everyone.
+    try:
+        from jax._src.custom_partitioning_sharding_rule import \
+            str_to_sdy_sharding_rule
+        sdy_rule = str_to_sdy_sharding_rule(
+            rule, need_replication_factors=("i", "j", "k", "l"))
+    except Exception:  # pragma: no cover - jax-version dependent
+        sdy_rule = None
+    p.def_partition(infer_sharding_from_operands=infer, partition=part,
+                    sharding_rule=sdy_rule)
+    return p
+
+
+_FWD_RULE = "b h i j, b h k j, b h k j -> b h i j, b h i l"
+_BWD_RULE = ("b h i j, b h k j, b h k j, b h i j, b h i l, b h i j "
+             "-> b h i j, b h k j, b h k j")
+
+
+_fwd4_p = _make_partitioned(_fwd4, 3, 2, _FWD_RULE)
+_bwd4_p = _make_partitioned(_bwd4, 6, 3, _BWD_RULE)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def mha_spmd(q, k, v, causal=False, scale=None):
+    """Flash attention on sharded [B, H, S, D] arrays under jit/GSPMD:
+    b/h partitioning preserved, s/d gathered. Use on the multi-chip
+    model path (models/gpt.py); single-chip callers use mha_forward."""
+    out, _ = _mha_spmd_fwd(q, k, v, causal, scale)
+    return out
+
+
+def _mha_spmd_fwd(q, k, v, causal, scale):
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    out, lse = _fwd4_p(q, k, v, bool(causal), float(scale))
+    return out, (q, k, v, out, lse)
+
+
+def _mha_spmd_bwd(causal, scale, res, do):
+    q, k, v, out, lse = res
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    dq, dk, dv = _bwd4_p(q, k, v, out, lse, do, bool(causal),
+                         float(scale))
+    return dq, dk, dv
+
+
+mha_spmd.defvjp(_mha_spmd_fwd, _mha_spmd_bwd)
