@@ -24,7 +24,8 @@ from .checkpoint import save_state_dict, load_state_dict  # noqa: F401
 from . import sharding  # noqa: F401
 from .sharding import group_sharded_parallel, save_group_sharded_model  # noqa: F401
 from .pipeline import (PipelineLayer, PipelineParallel, LayerDesc,  # noqa: F401
-                       SharedLayerDesc, PipelineParallelWithInterleave)
+                       SharedLayerDesc, PipelineParallelWithInterleave,
+                       DistPipelineRuntime)
 from . import pipeline_compiled  # noqa: F401
 from .pipeline_compiled import (spmd_pipeline, pipelined_trunk,  # noqa: F401
                                 FThenB, OneFOneB, VPP, ZeroBubble)

@@ -175,6 +175,129 @@ class PipelineParallel(Layer):
         return total
 
 
+class DistPipelineRuntime:
+    """Host-driven multi-process pipeline schedules over the store-backed
+    ProcessGroup transport — the reference's PipelineParallel runtime
+    architecture (pipeline_parallel.py:684 forward_backward_pipeline /
+    1F1B; p2p activations via pp_utils/p2p_communication.py:52, here
+    ProcessGroup.send/recv).
+
+    Each rank owns one stage (a Layer). ``train_batch`` runs the chosen
+    schedule; FThenB stashes all M micro-batch activations before any
+    backward, 1F1B caps in-flight stashes at num_stages - stage_id, which
+    is the measurable memory win (``max_inflight`` / ``max_stash_bytes``).
+    """
+
+    def __init__(self, stage_layer: Layer, group, loss_fn,
+                 num_microbatches: int, schedule: str = "1F1B"):
+        self.stage = stage_layer
+        self.group = group
+        self.pg = group.pg
+        self.rank = self.pg.rank
+        self.num_stages = self.pg.size
+        self.loss_fn = loss_fn
+        self.m = int(num_microbatches)
+        if schedule not in ("1F1B", "FThenB"):
+            raise ValueError(f"unknown schedule {schedule}")
+        self.schedule = schedule
+        self.is_first = self.rank == 0
+        self.is_last = self.rank == self.num_stages - 1
+        # stash + memory accounting
+        self._stash = {}
+        self.max_inflight = 0
+        self.max_stash_bytes = 0
+
+    # ------------------------------------------------------------ plumbing
+    def _track(self):
+        self.max_inflight = max(self.max_inflight, len(self._stash))
+        live = 0
+        for x_in, out in self._stash.values():
+            for t in (x_in, out):
+                if t is not None:
+                    live += t.size * t._value.dtype.itemsize
+        self.max_stash_bytes = max(self.max_stash_bytes, live)
+
+    def _forward_micro(self, i, micro_in, label):
+        import numpy as np
+        if self.is_first:
+            x_in = micro_in.detach()  # do not mutate the caller's tensor
+        else:
+            arr = self.pg.recv(self.rank - 1)
+            x_in = Tensor(np.ascontiguousarray(arr), stop_gradient=False)
+        out = self.stage(x_in)
+        if self.is_last:
+            loss = self.loss_fn(out, label) / self.m
+            self._stash[i] = (x_in, loss)
+            self._track()
+            return loss
+        self._stash[i] = (x_in, out)
+        self._track()
+        self.pg.send(out.numpy(), self.rank + 1)
+        return None
+
+    def _backward_micro(self, i):
+        x_in, out = self._stash.pop(i)
+        if self.is_last:
+            out.backward()  # out is the scaled loss
+        else:
+            dout = self.pg.recv(self.rank + 1)
+            from .._core.autograd import run_backward
+            run_backward([out], [Tensor(dout)])
+        if not self.is_first:
+            # keep the P2P protocol symmetric: the upstream rank recvs
+            # unconditionally, so a disconnected input sends zeros
+            if x_in.grad is not None:
+                self.pg.send(x_in.grad.numpy(), self.rank - 1)
+            else:
+                import numpy as np
+                self.pg.send(np.zeros(x_in.shape, "float32"),
+                             self.rank - 1)
+
+    # ------------------------------------------------------------ schedule
+    def train_batch(self, micro_inputs=None, micro_labels=None):
+        """Run one batch. Rank 0 supplies micro_inputs (list of M input
+        Tensors); the last rank supplies micro_labels. Returns the batch
+        loss on the last rank (None elsewhere)."""
+        m = self.m
+        if self.is_first and (micro_inputs is None
+                              or len(micro_inputs) != m):
+            raise ValueError(
+                f"rank 0 needs exactly num_microbatches={m} micro_inputs, "
+                f"got {None if micro_inputs is None else len(micro_inputs)}")
+        if self.is_last and (micro_labels is None
+                             or len(micro_labels) != m):
+            raise ValueError(
+                f"last rank needs exactly num_microbatches={m} "
+                f"micro_labels, got "
+                f"{None if micro_labels is None else len(micro_labels)}")
+        losses = []
+
+        def fwd(i):
+            x = micro_inputs[i] if self.is_first else None
+            y = micro_labels[i] if self.is_last else None
+            loss = self._forward_micro(i, x, y)
+            if loss is not None:
+                losses.append(float(loss.numpy()))
+
+        if self.schedule == "FThenB":
+            for i in range(m):
+                fwd(i)
+            for i in range(m):
+                self._backward_micro(i)
+        else:  # 1F1B (pipeline_parallel.py:684)
+            warmup = min(self.num_stages - self.rank - 1, m)
+            for i in range(warmup):
+                fwd(i)
+            for j in range(m - warmup):
+                fwd(warmup + j)
+                self._backward_micro(j)
+            for j in range(m - warmup, m):
+                self._backward_micro(j)
+
+        self.pg.barrier()
+        return sum(losses) if self.is_last else None
+
+
 class PipelineParallelWithInterleave(PipelineParallel):
     """VPP variant (pipeline_parallel.py:1308) — same numerics host-side;
     virtual-stage interleaving is a compiled-path schedule choice."""

@@ -48,7 +48,11 @@ def spmd_pipeline(stage_fn: Callable, x_mb, axis_name: str = "pp"):
         inp = jax.lax.dynamic_index_in_dim(
             x_mb, jnp.clip(t, 0, m - 1), 0, keepdims=False)
         cur = jnp.where(rank == 0, inp, state)
-        out = stage_fn(cur)
+        # bubble ticks (t outside [rank, rank+m)) skip the stage compute:
+        # lax.cond lowers to an HLO conditional, so idle ranks run the
+        # identity branch instead of burning stage FLOPs on garbage
+        valid = jnp.logical_and(t >= rank, t < rank + m)
+        out = jax.lax.cond(valid, stage_fn, lambda a: a, cur)
         widx = jnp.clip(t - (n - 1), 0, m - 1)
         prev = jax.lax.dynamic_index_in_dim(outputs, widx, 0,
                                             keepdims=False)
@@ -131,8 +135,11 @@ class FThenB(PipelineSchedule):
 
 
 class OneFOneB(PipelineSchedule):
-    """1F1B (pipeline_parallel.py:684): identical numerics to FThenB; the
-    early-backward memory saving is achieved here by remat + donation."""
+    """1F1B (pipeline_parallel.py:684): identical numerics to FThenB. The
+    compiled path gets its memory control from remat + donation; the
+    host-driven multi-process runtime (pipeline.DistPipelineRuntime)
+    implements the real 1F1B stash cap (peak in-flight activations
+    num_stages instead of num_microbatches)."""
     name = "1F1B"
 
 

