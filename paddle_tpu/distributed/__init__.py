@@ -22,7 +22,9 @@ from . import fleet  # noqa: F401
 from . import checkpoint  # noqa: F401
 from .checkpoint import save_state_dict, load_state_dict  # noqa: F401
 from . import sharding  # noqa: F401
-from .sharding import group_sharded_parallel, save_group_sharded_model  # noqa: F401
+from .sharding import (group_sharded_parallel,  # noqa: F401
+                       save_group_sharded_model, DygraphShardingOptimizer,
+                       DygraphShardingStage3)
 from .pipeline import (PipelineLayer, PipelineParallel, LayerDesc,  # noqa: F401
                        SharedLayerDesc, PipelineParallelWithInterleave,
                        DistPipelineRuntime)

@@ -156,6 +156,288 @@ def group_sharded_parallel(model, optimizer, level, scaler=None, group=None,
     raise ValueError(f"unknown group_sharded level: {level}")
 
 
+# --------------------------------------------------------------------------
+# Eager multi-process ZeRO over the store-backed ProcessGroup: the
+# mechanics the reference hand-codes in meta_parallel/sharding
+# (DygraphShardingOptimizer stage 1/2, group_sharded_stage3.py).
+# Param-wise ownership, greedy size-balanced, like the reference's
+# _partition_parameters (dygraph_sharding_optimizer.py).
+
+def _require_pg(group):
+    """Resolve the store-backed ProcessGroup or fail with a clear error
+    (same contract as communication._pg)."""
+    from .communication import _get_default_group
+    g = group or _get_default_group()
+    if g.pg is None:
+        raise RuntimeError(
+            "eager ZeRO sharding needs a multi-process ProcessGroup: "
+            "call init_parallel_env() first (PADDLE_TRAINERS_NUM>1)")
+    return g.pg
+
+
+class _ShardedGlobalNormClip:
+    """Group-aware ClipGradByGlobalNorm: all-reduces the partial squared
+    norms so each owner clips with the true global norm."""
+
+    def __init__(self, inner_clip, pg):
+        self._inner = inner_clip
+        self._pg = pg
+        self.clip_norm = inner_clip.clip_norm
+
+    def __call__(self, params_grads):
+        import jax.numpy as jnp
+        import numpy as np
+        from .._core.tensor import Tensor
+        local_sq = 0.0
+        for _, g in params_grads:
+            if g is not None:
+                local_sq += float(jnp.sum(
+                    g._value.astype(jnp.float32) ** 2))
+        global_sq = float(self._pg.all_reduce(
+            np.asarray([local_sq], "float64"), op="sum")[0])
+        gnorm = max(global_sq ** 0.5, 1e-12)
+        scale = min(self.clip_norm / gnorm, 1.0)
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            out.append((p, Tensor((g._value.astype(jnp.float32) * scale)
+                                  .astype(g._value.dtype))))
+        return out
+
+
+def _assign_owners(params, nranks):
+    """Greedy size-balanced param->rank assignment."""
+    sizes = [0] * nranks
+    owners = {}
+    order = sorted(range(len(params)), key=lambda i: -params[i].size)
+    for i in order:
+        r = sizes.index(min(sizes))
+        owners[id(params[i])] = r
+        sizes[r] += params[i].size
+    return owners
+
+
+class DygraphShardingOptimizer:
+    """Stage 1/2 optimizer wrapper for the eager multi-process runtime
+    (dygraph_sharding_optimizer.py analog).
+
+    step():
+      1. every gradient is reduced (avg) to its owner rank — the
+         reduce-into-shards step of ZeRO-2; non-owners drop their grads,
+      2. the inner optimizer updates only owned params, so moments/master
+         weights materialize for ~1/N of the model per rank (ZeRO-1),
+      3. updated params are broadcast back from their owners.
+
+    offload=True keeps the (owned) optimizer states on host as numpy
+    arrays between steps — the host-offload mode of the reference's
+    group_sharded API.
+    """
+
+    def __init__(self, optimizer, group=None, offload=False):
+        self._inner = optimizer
+        self._group = group
+        self._pg = _require_pg(group)
+        self._offload = bool(offload)
+        # participation is decided by stop_gradient ONLY (static and
+        # identical across ranks) so the collective sequence can never
+        # diverge between ranks
+        self._params = [p for p, _ in optimizer._all_params()
+                        if not p.stop_gradient]
+        self._owners = _assign_owners(self._params, self._pg.size)
+        # grad clipping must see the GLOBAL norm even though each rank
+        # holds only its owned grads (reference sharding optimizer
+        # all-reduces the partial squared norms)
+        if getattr(optimizer, "_grad_clip", None) is not None and \
+                hasattr(optimizer._grad_clip, "clip_norm"):
+            optimizer._grad_clip = _ShardedGlobalNormClip(
+                optimizer._grad_clip, self._pg)
+
+    @property
+    def inner_opt(self):
+        return self._inner
+
+    def owned(self, p) -> bool:
+        return self._owners[id(p)] == self._pg.rank
+
+    def step(self):
+        import jax.numpy as jnp
+        import numpy as np
+        pg = self._pg
+        # 1) reduce grads into owners; free the rest (ZeRO-2). Ranks with
+        # a missing grad (data-dependent paths) contribute zeros plus a
+        # has-grad counter piggybacked on the same payload, keeping the
+        # collective sequence symmetric across ranks.
+        for p in self._params:
+            owner = self._owners[id(p)]
+            grad = p.grad
+            flat = grad.numpy().astype("float32").reshape(-1) \
+                if grad is not None else np.zeros(p.size, "float32")
+            payload = np.concatenate([flat, [1.0 if grad is not None
+                                             else 0.0]])
+            reduced = pg.reduce(payload, dst=owner, op="sum")
+            if pg.rank == owner:
+                count = reduced[-1]
+                if count > 0:
+                    avg = (reduced[:-1] / count).reshape(p.shape) \
+                        .astype(p.grad.numpy().dtype if grad is not None
+                                else "float32")
+                    if grad is not None:
+                        grad._adopt(Tensor(np.ascontiguousarray(avg)))
+                    else:
+                        p.grad = Tensor(np.ascontiguousarray(avg))
+            else:
+                p.clear_grad()
+        # 2) inner optimizer sees grads only on owned params (ZeRO-1)
+        if self._offload:
+            self._states_to_device()
+        self._inner.step()
+        if self._offload:
+            self._states_to_host()
+        # 3) param sync: owners broadcast their updated params
+        # (frozen params never change, so they are not in self._params
+        # and generate no traffic)
+        for p in self._params:
+            owner = self._owners[id(p)]
+            synced = pg.broadcast(p.numpy(), src=owner)
+            if pg.rank != owner:
+                p._replace_value_inplace(
+                    jnp.asarray(np.ascontiguousarray(synced)))
+
+    def _states_to_host(self):
+        import numpy as np
+        for pid, st in self._inner._states.items():
+            self._inner._states[pid] = {
+                k: np.asarray(v) for k, v in st.items()}
+        for pid, m in getattr(self._inner, "_master", {}).items():
+            self._inner._master[pid] = np.asarray(m)
+
+    def _states_to_device(self):
+        import jax.numpy as jnp
+        for pid, st in self._inner._states.items():
+            self._inner._states[pid] = {
+                k: jnp.asarray(v) for k, v in st.items()}
+        for pid, m in getattr(self._inner, "_master", {}).items():
+            self._inner._master[pid] = jnp.asarray(m)
+
+    def state_bytes(self) -> int:
+        """Bytes of optimizer state held on this rank (1/N check)."""
+        total = 0
+        for st in self._inner._states.values():
+            for v in st.values():
+                total += v.size * v.dtype.itemsize
+        return total
+
+    def clear_grad(self, **kw):
+        self._inner.clear_grad()
+
+    def __getattr__(self, item):
+        return getattr(self._inner, item)
+
+
+class DygraphShardingStage3(Layer):
+    """Stage 3 (parameter sharding) for the eager multi-process runtime
+    (group_sharded_stage3.py analog): each rank persistently stores only
+    its owned parameters; the others are released to empty placeholders
+    between steps. ``materialize()`` broadcasts non-owned params from
+    their owners (the gather-at-use), ``release()`` frees them again.
+    forward() materializes automatically; after backward, call
+    ``step_and_release()`` (which steps the wrapped sharded optimizer —
+    never the raw inner optimizer, or grads apply unsharded and ranks
+    diverge) — the training loop shape of the reference's stage-3
+    wrapper."""
+
+    def __init__(self, layer, optimizer=None, group=None, offload=False,
+                 **kwargs):
+        super().__init__()
+        self._layers = layer
+        self._group = group
+        self._pg = _require_pg(group)
+        params = list(layer.parameters())
+        self._all_params_list = params
+        self._owners = _assign_owners(params, self._pg.size)
+        self._shapes = {id(p): (tuple(p.shape), p._value.dtype)
+                        for p in params}
+        self._materialized = True
+        if optimizer is not None and not isinstance(
+                optimizer, DygraphShardingOptimizer):
+            optimizer = DygraphShardingOptimizer(optimizer, group,
+                                                 offload=offload)
+        self._sharded_optim = optimizer
+
+    @property
+    def sharded_optimizer(self):
+        """The wrapped DygraphShardingOptimizer — step through THIS (or
+        step_and_release), never the raw inner optimizer, or grads are
+        applied unsharded and ranks silently diverge."""
+        return self._sharded_optim
+        self.release()
+
+    def owned(self, p) -> bool:
+        return self._owners[id(p)] == self._pg.rank
+
+    def materialize(self):
+        """Gather-at-use: broadcast non-owned params from owners."""
+        import jax.numpy as jnp
+        import numpy as np
+        if self._materialized:
+            return
+        for p in self._all_params_list:
+            owner = self._owners[id(p)]
+            if self._pg.rank == owner:
+                self._pg.broadcast(p.numpy(), src=owner)
+            else:
+                shape, dtype = self._shapes[id(p)]
+                got = self._pg.broadcast(
+                    np.zeros(shape, dtype), src=owner)
+                p._replace_value_inplace(
+                    jnp.asarray(np.ascontiguousarray(got)))
+        self._materialized = True
+
+    def release(self):
+        """Free non-owned params to empty placeholders (1/N persistent
+        parameter memory per rank)."""
+        import jax.numpy as jnp
+        for p in self._all_params_list:
+            if not self.owned(p):
+                _, dtype = self._shapes[id(p)]
+                p._replace_value_inplace(jnp.zeros((0,), dtype))
+        self._materialized = False
+
+    def param_bytes(self) -> int:
+        """Bytes of parameter storage currently held on this rank."""
+        total = 0
+        for p in self._all_params_list:
+            total += p._value.size * p._value.dtype.itemsize
+        return total
+
+    def forward(self, *args, **kwargs):
+        self.materialize()
+        return self._layers(*args, **kwargs)
+
+    def step_and_release(self):
+        """Convenience: sharded optimizer step, then drop non-owned
+        params until the next forward."""
+        if self._sharded_optim is None:
+            raise RuntimeError(
+                "DygraphShardingStage3 was built without an optimizer; "
+                "pass one at construction or step the wrapped "
+                "DygraphShardingOptimizer yourself")
+        self._sharded_optim.step()
+        self.release()
+
+    def state_dict(self, *a, **k):
+        self.materialize()
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, sd, **k):
+        self.materialize()
+        out = self._layers.set_state_dict(sd, **k)
+        self.release()
+        return out
+
+
 def save_group_sharded_model(model, output, optimizer=None):
     import os
     from ..framework import save
