@@ -1,0 +1,87 @@
+"""Tensor-parallel semantic ops (mpu/mp_ops.py analog).
+
+vocab_parallel_cross_entropy == the reference's
+c_softmax_with_cross_entropy (fleet/layers/mpu/mp_ops.py:77-385): the
+softmax-cross-entropy over a vocab-sharded classifier computed WITHOUT
+ever materializing the full [B, S, V] logits. Each mp shard projects the
+hidden states onto its vocab slice and three cheap collectives (max,
+sum-exp, picked-logit) complete the loss — the TPU form uses a
+partial-manual shard_map over the mp axis so dp/pp/sp placement stays
+with GSPMD.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ...nn.layer import Layer
+
+
+def vocab_parallel_softmax_cross_entropy(hidden, vocab_weight, labels,
+                                         mesh: Mesh, axis: str = "mp"):
+    """Per-token loss [B, S] from hidden [B, S, H] (mp-replicated) and a
+    vocab-sharded classifier weight [V, H] (dim 0 over ``axis``), raw
+    arrays in, under jit. Full logits never exist: each shard holds
+    [B, S, V/mp]."""
+
+    def f(h, w, y):
+        n = lax.psum(1, axis)
+        r = lax.axis_index(axis)
+        vshard = w.shape[0]
+        logits = jnp.einsum("bsh,vh->bsv", h, w).astype(jnp.float32)
+        # global max for a stable softmax; gradient-free (the shift
+        # cancels in softmax), and pmax has no autodiff rule anyway
+        gmax = lax.pmax(
+            lax.stop_gradient(jnp.max(logits, axis=-1)), axis)
+        shifted = logits - gmax[..., None]
+        sumexp = lax.psum(jnp.sum(jnp.exp(shifted), axis=-1), axis)
+        # the label's (shifted) logit lives on exactly one shard
+        lo = r * vshard
+        is_local = jnp.logical_and(y >= lo, y < lo + vshard)
+        idx = jnp.clip(y - lo, 0, vshard - 1)
+        picked = jnp.take_along_axis(shifted, idx[..., None],
+                                     axis=-1)[..., 0]
+        picked = lax.psum(jnp.where(is_local, picked, 0.0), axis)
+        return jnp.log(sumexp) - picked
+
+    if mesh is None or axis not in mesh.axis_names \
+            or mesh.shape[axis] <= 1:
+        logits = jnp.einsum("bsh,vh->bsv", hidden,
+                            vocab_weight).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, -1)
+        return -jnp.take_along_axis(logp, labels[..., None],
+                                    axis=-1)[..., 0]
+
+    return jax.shard_map(f, mesh=mesh,
+                         in_specs=(P(), P(axis, None), P()),
+                         out_specs=P(), axis_names={axis},
+                         check_vma=False)(hidden, vocab_weight, labels)
+
+
+class ParallelCrossEntropy(Layer):
+    """mpu.ParallelCrossEntropy surface: consumes vocab-PARALLEL logits
+    (eager Tensors already sharded over the model-parallel group) or, on
+    the single-controller path, a (hidden, weight) pair via
+    vocab_parallel_softmax_cross_entropy. Reference:
+    fleet/layers/mpu/mp_layers.py ParallelCrossEntropy."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self.group = mp_group
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):
+        from ..._core.tensor import Tensor
+        logits = input._value.astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, -1)
+        lbl = label._value
+        if lbl.ndim == logits.ndim:
+            lbl = lbl[..., 0]
+        picked = jnp.take_along_axis(
+            logp, lbl[..., None].astype(jnp.int32), axis=-1)[..., 0]
+        loss = -picked
+        if self.ignore_index >= 0:
+            loss = jnp.where(lbl == self.ignore_index, 0.0, loss)
+        return Tensor(loss[..., None], stop_gradient=input.stop_gradient)

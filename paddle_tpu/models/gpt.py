@@ -267,7 +267,8 @@ def _ln(x, g, b, eps):
     return ((xf - mu) * jax.lax.rsqrt(var + eps)).astype(x.dtype) * g + b
 
 
-def _block(x, blk, config: GPTConfig, mesh_axes, sp_sharding=None):
+def _block(x, blk, config: GPTConfig, mesh_axes, sp_sharding=None,
+           in_manual_pp=False):
     """One decoder block, pure jnp. x: [B, S, H]. With sp=True the
     residual-stream activations are sharded along the sequence dim over the
     mp axis (Megatron-SP, sequence_parallel_utils.py analog) — GSPMD turns
@@ -284,14 +285,21 @@ def _block(x, blk, config: GPTConfig, mesh_axes, sp_sharding=None):
     k = jnp.swapaxes(k, 1, 2)
     v = jnp.swapaxes(v, 1, 2)
     scale = 1.0 / math.sqrt(c.head_dim)
+    attn = None
     if _use_flash_kernel(c, s, mesh_axes):
-        if mesh_axes is not None:
+        if mesh_axes is not None and in_manual_pp:
+            # compiled-pp manual region: nested shard_map dispatch owned
+            # by the op module; None => indivisible, use einsum below
+            from ..ops.pallas.flash_attention import mha_manual
+            attn = mha_manual(q, k, v, mesh_axes, causal=True,
+                              scale=scale)
+        elif mesh_axes is not None:
             from ..ops.pallas.flash_attention import mha_spmd
             attn = mha_spmd(q, k, v, causal=True, scale=scale)
         else:
             from ..ops.pallas.flash_attention import mha_forward
             attn = mha_forward(q, k, v, causal=True, scale=scale)
-    else:
+    if attn is None:
         logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
         mask = jnp.tril(jnp.ones((s, s), bool))
         logits = jnp.where(mask, logits, jnp.array(-1e30, logits.dtype))
@@ -312,7 +320,8 @@ def _block(x, blk, config: GPTConfig, mesh_axes, sp_sharding=None):
 
 
 def gpt_forward(params, tokens, config: GPTConfig, mesh_axes=None,
-                remat=True, sp_sharding=None, pp_trunk=None):
+                remat=True, sp_sharding=None, pp_trunk=None,
+                return_hidden=False):
     """Pure forward: tokens [B, S] int32 -> logits [B, S, V]. pp_trunk,
     when given (distributed.pipeline_compiled.pipelined_trunk), replaces
     the layer scan with the compiled pp-axis pipeline."""
@@ -334,12 +343,30 @@ def gpt_forward(params, tokens, config: GPTConfig, mesh_axes=None,
 
         x, _ = jax.lax.scan(scan_body, x, params["blocks"])
     x = _ln(x, params["lnf_g"], params["lnf_b"], config.layer_norm_eps)
+    if return_hidden:
+        return x
     logits = jnp.einsum("bsh,vh->bsv", x, params["wte"])
     return logits
 
 
 def gpt_loss(params, tokens, labels, config: GPTConfig, mesh_axes=None,
              remat=True, sp_sharding=None, pp_trunk=None):
+    """Mean LM loss. With an mp>1 mesh the head goes through
+    vocab-parallel softmax-cross-entropy (mp_ops.py:77-385 analog):
+    wte is vocab-sharded over mp, so the full [B, S, V] logits are never
+    materialized — each shard computes [B, S, V/mp] and three small
+    collectives finish the loss."""
+    if mesh_axes is not None and "mp" in mesh_axes.axis_names \
+            and mesh_axes.shape["mp"] > 1 \
+            and config.vocab_size % mesh_axes.shape["mp"] == 0:
+        from ..distributed.fleet.mp_ops import \
+            vocab_parallel_softmax_cross_entropy
+        hidden = gpt_forward(params, tokens, config, mesh_axes, remat,
+                             sp_sharding, pp_trunk=pp_trunk,
+                             return_hidden=True)
+        loss = vocab_parallel_softmax_cross_entropy(
+            hidden, params["wte"], labels, mesh_axes, axis="mp")
+        return loss.mean()
     logits = gpt_forward(params, tokens, config, mesh_axes, remat,
                          sp_sharding, pp_trunk=pp_trunk)
     logits = logits.astype(jnp.float32)
@@ -372,7 +399,7 @@ def build_train_step(config: GPTConfig, mesh: Optional[Mesh] = None,
         from ..distributed.pipeline_compiled import pipelined_trunk
         n_micro = pp_microbatches or 2 * pp_size
         blk_fn = functools.partial(_block, config=config, mesh_axes=mesh,
-                                   sp_sharding=None)
+                                   sp_sharding=None, in_manual_pp=True)
         pp_trunk = pipelined_trunk(
             lambda x, blk: blk_fn(x, blk), mesh, n_micro, axis_name="pp",
             remat=remat)

@@ -475,3 +475,24 @@ def _mha_spmd_bwd(causal, scale, res, do):
 
 
 mha_spmd.defvjp(_mha_spmd_fwd, _mha_spmd_bwd)
+
+
+def mha_manual(q, k, v, mesh, causal=False, scale=None):
+    """Flash dispatch for partial-manual regions (compiled-pp bodies),
+    where custom_partitioning sees an empty mesh: shard batch over 'dp'
+    and heads over 'mp' with a nested shard_map on the CONTEXT abstract
+    mesh. Returns None when no axis is shardable (indivisible batch or
+    heads) — the caller must fall back to a GSPMD-friendly path."""
+    axes = tuple(
+        a for a, dim in (("dp", q.shape[0]), ("mp", q.shape[1]))
+        if a in mesh.axis_names and mesh.shape[a] > 1
+        and dim % mesh.shape[a] == 0)
+    if not axes:
+        return None
+    spec = _P("dp" if "dp" in axes else None,
+              "mp" if "mp" in axes else None, None, None)
+    ctx_mesh = jax.sharding.get_abstract_mesh()
+    return jax.shard_map(
+        functools.partial(mha_forward, causal=causal, scale=scale),
+        mesh=ctx_mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        axis_names=set(axes), check_vma=False)(q, k, v)

@@ -1,0 +1,120 @@
+"""Vocab-parallel softmax-cross-entropy (fleet.mp_ops) + RNG tracker.
+
+Reference: fleet/layers/mpu/mp_ops.py:77-385 c_softmax_with_cross_entropy
+and mpu/random.py:34 RNGStatesTracker (VERDICT r2 missing #4 / task #7).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from paddle_tpu.distributed.fleet.mp_ops import \
+    vocab_parallel_softmax_cross_entropy
+
+VOCAB = 50_000
+H = 64
+B, S = 2, 16
+
+
+def _mesh():
+    return Mesh(np.array(jax.devices()[:8]).reshape(1, 8), ("dp", "mp"))
+
+
+def _inputs():
+    r = np.random.RandomState(0)
+    hidden = jnp.asarray(r.randn(B, S, H).astype("float32"))
+    w = jnp.asarray(r.randn(VOCAB, H).astype("float32") * 0.05)
+    labels = jnp.asarray(r.randint(0, VOCAB, (B, S)))
+    return hidden, w, labels
+
+
+def _full_reference(hidden, w, labels):
+    logits = jnp.einsum("bsh,vh->bsv", hidden, w).astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, -1)
+    return -jnp.take_along_axis(logp, labels[..., None], -1)[..., 0]
+
+
+def test_matches_full_logits_loss_and_grads():
+    mesh = _mesh()
+    hidden, w, labels = _inputs()
+    wd = jax.device_put(w, NamedSharding(mesh, P("mp", None)))
+
+    def vp_loss(h, w):
+        return vocab_parallel_softmax_cross_entropy(
+            h, w, labels, mesh, axis="mp").mean()
+
+    def ref_loss(h, w):
+        return _full_reference(h, w, labels).mean()
+
+    lv, (gh, gw) = jax.jit(jax.value_and_grad(vp_loss, argnums=(0, 1)))(
+        hidden, wd)
+    lr, (rh, rw) = jax.value_and_grad(ref_loss, argnums=(0, 1))(hidden, w)
+    assert abs(float(lv) - float(lr)) / abs(float(lr)) < 1e-6
+    np.testing.assert_allclose(np.asarray(gh), np.asarray(rh),
+                               rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(gw), np.asarray(rw),
+                               rtol=1e-4, atol=1e-7)
+
+
+def test_full_logits_never_materialize():
+    """The compiled HLO must not contain a [B, S, V] tensor — only the
+    per-shard [B, S, V/mp]."""
+    mesh = _mesh()
+    hidden, w, labels = _inputs()
+    wd = jax.device_put(w, NamedSharding(mesh, P("mp", None)))
+
+    def vp_loss(h, w):
+        return vocab_parallel_softmax_cross_entropy(
+            h, w, labels, mesh, axis="mp").mean()
+
+    hlo = jax.jit(vp_loss).lower(hidden, wd).compile().as_text()
+    full = f"{B},{S},{VOCAB}"
+    shard = f"{B},{S},{VOCAB // 8}"
+    assert shard in hlo, "expected per-shard logits in HLO"
+    assert full not in hlo, "full-vocab logits were materialized"
+
+
+def test_gpt_train_step_uses_vocab_parallel_head():
+    """Loss parity: mp-sharded train step (vocab-parallel CE head) vs a
+    single-device run of the same model."""
+    from paddle_tpu.models.gpt import GPTConfig, build_train_step
+    cfg = GPTConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                    num_heads=4, max_position_embeddings=64,
+                    dtype="float32")
+    tokens = jnp.zeros((4, 32), jnp.int32)
+    labels = jnp.ones((4, 32), jnp.int32)
+
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4), ("dp", "mp"))
+    init_fn, step = build_train_step(cfg, mesh, lr=1e-3, remat=True)
+    state = init_fn(0)
+    _, loss_mp = step(state, tokens, labels)
+
+    init1, step1 = build_train_step(cfg, None, lr=1e-3, remat=True)
+    state1 = init1(0)
+    _, loss_1 = step1(state1, tokens, labels)
+    assert abs(float(loss_mp) - float(loss_1)) < 1e-4
+
+
+def test_rng_tracker_streams_differ_and_reproduce():
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.fleet.random_ import (
+        MODEL_PARALLEL_RNG, get_rng_state_tracker,
+        model_parallel_random_seed)
+
+    model_parallel_random_seed(1234)
+    tracker = get_rng_state_tracker()
+    x = paddle.ones([64, 64])
+
+    import paddle_tpu.nn.functional as F
+    with tracker.rng_state(MODEL_PARALLEL_RNG):
+        m1 = F.dropout(x, p=0.5, training=True).numpy()
+    out_global = F.dropout(x, p=0.5, training=True).numpy()
+    # distinct streams
+    assert not np.array_equal(m1, out_global)
+    # reseeding reproduces both streams exactly
+    model_parallel_random_seed(1234)
+    with tracker.rng_state(MODEL_PARALLEL_RNG):
+        m1b = F.dropout(x, p=0.5, training=True).numpy()
+    out_globalb = F.dropout(x, p=0.5, training=True).numpy()
+    np.testing.assert_array_equal(m1, m1b)
+    np.testing.assert_array_equal(out_global, out_globalb)
