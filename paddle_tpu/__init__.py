@@ -11,9 +11,13 @@ __version__ = "0.1.0"
 
 import jax as _jax
 
-# int64 is the framework default for indices/labels (paddle parity); floats
-# stay fp32/bf16 via explicit dtype defaults in creation ops.
-_jax.config.update("jax_enable_x64", True)
+# int64 is the framework default for indices/labels (paddle parity).
+# PT_ENABLE_X64=0 turns the jax x64 mode off (TPU-friendly: int64 is
+# emulated and fp64 unsupported on TPU); boundary ops then map
+# int64/float64 down to 32-bit at the framework edge.
+import os as _os
+_X64 = _os.environ.get("PT_ENABLE_X64", "1") == "1"
+_jax.config.update("jax_enable_x64", _X64)
 
 from ._core.dtype import (DType, bool_, uint8, int8, int16, int32, int64,
                           float16, bfloat16, float32, float64, complex64,

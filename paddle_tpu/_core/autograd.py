@@ -14,6 +14,7 @@ which cached XLA executables run; accumulation itself is a jitted add.
 from __future__ import annotations
 
 import threading
+import weakref
 from collections import defaultdict, deque
 from typing import Dict, List, Optional, Tuple
 
@@ -110,7 +111,8 @@ class GradNode:
     """One recorded op application (grad_node_info.h:197)."""
 
     __slots__ = ("op", "attrs", "saved", "saved_versions", "edges",
-                 "out_shapes", "out_dtypes", "out_hooks", "name", "py_bwd")
+                 "out_shapes", "out_dtypes", "out_hooks", "name", "py_bwd",
+                 "in_refs", "freed")
 
     def __init__(self, op: OpDef, attrs, saved, edges, out_shapes, out_dtypes):
         self.op = op
@@ -123,11 +125,43 @@ class GradNode:
         self.out_hooks: Dict[int, List] = {}
         self.name = op.name if op is not None else "pylayer"
         self.py_bwd = None                  # set for PyLayer-style nodes
+        self.in_refs = None                 # weakrefs for version checks
+        self.freed = False                  # saved buffers released
+
+    def _check_versions(self):
+        """TensorWrapper safety (tensor_wrapper.h): an input mutated
+        in-place after being saved for backward corrupts gradients —
+        fail loudly instead."""
+        if self.in_refs is None or self.saved_versions is None:
+            return
+        for i, ref in enumerate(self.in_refs):
+            t = ref() if ref is not None else None
+            if t is not None and \
+                    t._inplace_version != self.saved_versions[i]:
+                raise RuntimeError(
+                    f"a variable needed for the backward of op "
+                    f"'{self.name}' (input {i}) was modified by an "
+                    f"inplace operation (saved version "
+                    f"{self.saved_versions[i]}, current "
+                    f"{t._inplace_version}); clone() it before the "
+                    f"inplace update")
 
     def apply(self, gouts: Tuple) -> Tuple:
+        if self.freed:
+            raise RuntimeError(
+                "trying to run backward through the graph a second time "
+                "(saved activations already freed); call "
+                "backward(retain_graph=True) if you need to")
+        self._check_versions()
         if self.py_bwd is not None:
             return self.py_bwd(gouts)
         return dispatch.eager_backward(self.op, self.saved, self.attrs, gouts)
+
+    def free(self):
+        """Release saved activations after backward (retain_graph=False
+        semantics, the reference's buffer release in backward.cc)."""
+        self.saved = None
+        self.freed = True
 
 
 _accum = jax.jit(jnp.add)
@@ -144,7 +178,7 @@ def record(op: OpDef, attrs, in_tensors, out_tensors, saved_vals=None):
     for t in in_tensors:
         if t is None or t.stop_gradient:
             edges.append(_Edge(None))
-            versions.append(0)
+            versions.append(0 if t is None else t._inplace_version)
             continue
         meta = t._autograd_meta
         if meta.grad_node is not None:
@@ -160,6 +194,8 @@ def record(op: OpDef, attrs, in_tensors, out_tensors, saved_vals=None):
         out_shapes=tuple(t.shape for t in out_tensors),
         out_dtypes=tuple(t._value.dtype for t in out_tensors))
     node.saved_versions = tuple(versions)
+    node.in_refs = tuple(
+        None if t is None else weakref.ref(t) for t in in_tensors)
 
     for i, t in enumerate(out_tensors):
         if jnp.issubdtype(t._value.dtype, jnp.inexact):
@@ -199,8 +235,11 @@ def _zeros_like_slot(node: GradNode, slot: int):
 
 def run_backward(tensors, grad_tensors=None, retain_graph=False):
     """loss.backward(): seed roots, traverse, write .grad on leaves
-    (backward.cc:106)."""
-    _engine_run(tensors, grad_tensors, targets=None)
+    (backward.cc:106). retain_graph=False frees saved activations as
+    the walk consumes them; a second backward over the same graph then
+    raises instead of silently recomputing."""
+    _engine_run(tensors, grad_tensors, targets=None,
+                retain_graph=bool(retain_graph))
 
 
 def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
@@ -213,7 +252,9 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
             "derivatives via jax.grad composition.")
     outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
     inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
-    captured = _engine_run(outputs, grad_outputs, targets=list(inputs))
+    captured = _engine_run(outputs, grad_outputs, targets=list(inputs),
+                           retain_graph=bool(retain_graph)
+                           if retain_graph is not None else False)
     from .tensor import Tensor
     res = []
     for t in inputs:
@@ -226,7 +267,7 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
     return res
 
 
-def _engine_run(tensors, grad_tensors, targets):
+def _engine_run(tensors, grad_tensors, targets, retain_graph=False):
     from .tensor import Tensor  # local import to avoid cycle
 
     tensors = tensors if isinstance(tensors, (list, tuple)) else [tensors]
@@ -326,6 +367,8 @@ def _engine_run(tensors, grad_tensors, targets):
                             else _accum(prev, gouts[s])
 
         grads = node.apply(tuple(gouts))
+        if not retain_graph:
+            node.free()
         if len(grads) != len(node.edges):
             raise RuntimeError(
                 f"op '{node.name}' backward returned {len(grads)} grads for "

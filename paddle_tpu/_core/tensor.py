@@ -24,7 +24,8 @@ from .autograd import AutogradMeta, is_grad_enabled, no_grad, run_backward
 
 class Tensor:
     __slots__ = ("_value", "_stop_gradient", "_autograd_meta",
-                 "_inplace_version", "name", "persistable", "_dist_attr")
+                 "_inplace_version", "name", "persistable", "_dist_attr",
+                 "__weakref__")
 
     def __init__(self, value, stop_gradient: bool = True, name: str = None):
         if isinstance(value, Tensor):

@@ -213,6 +213,63 @@ def default_collate_fn(batch):
     return batch
 
 
+def _np_collate(batch):
+    """Collate with numpy leaves — the worker-process form (workers
+    should avoid jax: forked children inherit the XLA runtime; Tensor
+    samples are read back via .numpy() as a best effort).
+    default_collate_fn == _tree_to_tensor(_np_collate(batch))."""
+    sample = batch[0]
+    if isinstance(sample, (tuple, list)):
+        transposed = list(zip(*batch))
+        return [_np_collate(list(items)) for items in transposed]
+    if isinstance(sample, dict):
+        return {k: _np_collate([b[k] for b in batch]) for k in sample}
+    if isinstance(sample, Tensor):
+        return np.stack([s.numpy() for s in batch])
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch)
+    if isinstance(sample, (int, np.integer)):
+        return np.asarray(batch, dtype=np.int64)
+    if isinstance(sample, (float, np.floating)):
+        return np.asarray(batch, dtype=np.float32)
+    return batch
+
+
+def _tree_to_np(obj):
+    if isinstance(obj, Tensor):
+        return obj.numpy()
+    if isinstance(obj, (tuple, list)):
+        return [_tree_to_np(o) for o in obj]
+    if isinstance(obj, dict):
+        return {k: _tree_to_np(v) for k, v in obj.items()}
+    return obj
+
+
+def _tree_to_tensor(obj):
+    if isinstance(obj, np.ndarray):
+        return Tensor(obj)
+    if isinstance(obj, (tuple, list)):
+        return [_tree_to_tensor(o) for o in obj]
+    if isinstance(obj, dict):
+        return {k: _tree_to_tensor(v) for k, v in obj.items()}
+    return obj
+
+
+def _mp_worker_loop(dataset, collate, index_q, data_q):
+    """Worker process body (dataloader_iter.py:368 analog): pull batch
+    index lists, build + collate the batch host-side, push numpy."""
+    while True:
+        item = index_q.get()
+        if item is None:
+            break
+        seq, idx = item
+        try:
+            out = collate([dataset[j] for j in idx])
+            data_q.put((seq, _tree_to_np(out), None))
+        except Exception as e:  # surfaced in the parent
+            data_q.put((seq, None, f"{type(e).__name__}: {e}"))
+
+
 class DataLoader:
     def __init__(self, dataset, feed_list=None, places=None,
                  return_list=True, batch_sampler=None, batch_size=1,
@@ -223,6 +280,7 @@ class DataLoader:
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
+        self.timeout = timeout or 0
         self.prefetch = max(prefetch_factor, 1) if use_buffer_reader else 0
         if batch_sampler is not None:
             self.batch_sampler = batch_sampler
@@ -254,6 +312,12 @@ class DataLoader:
             yield self.collate_fn([self.dataset[i] for i in batch_idx])
 
     def __iter__(self):
+        if self.num_workers and self.num_workers > 0:
+            yield from self._mp_iter()
+            return
+        yield from self._thread_iter()
+
+    def _thread_iter(self):
         if self.prefetch == 0:
             yield from self._produce()
             return
@@ -282,6 +346,70 @@ class DataLoader:
         th.join()
         if err:
             raise err[0]
+
+    def _mp_iter(self):
+        """num_workers>0: real worker PROCESSES (the reference's
+        multiprocess DataLoader, io/dataloader/dataloader_iter.py:368).
+        Batches are built + collated in forked children with numpy only
+        and re-wrapped as Tensors here; output order is preserved."""
+        import multiprocessing as mp
+        if isinstance(self.dataset, IterableDataset):
+            # iterable datasets cannot be index-sharded across workers
+            # (reference splits via worker_info; not implemented) —
+            # fall back to the threaded prefetch path
+            import warnings
+            warnings.warn("DataLoader: num_workers>0 with an "
+                          "IterableDataset falls back to threaded "
+                          "prefetch")
+            yield from self._thread_iter()
+            return
+        ctx = mp.get_context("fork")
+        index_q = ctx.Queue()
+        data_q = ctx.Queue()
+        user_collate = self.collate_fn
+        if user_collate is default_collate_fn:
+            collate = _np_collate
+        else:
+            collate = user_collate
+        procs = [ctx.Process(target=_mp_worker_loop,
+                             args=(self.dataset, collate, index_q,
+                                   data_q), daemon=True)
+                 for _ in range(self.num_workers)]
+        for p in procs:
+            p.start()
+        n_batches = 0
+        try:
+            for batch_idx in self.batch_sampler:
+                index_q.put((n_batches, list(batch_idx)))
+                n_batches += 1
+            for _ in procs:
+                index_q.put(None)
+            import queue as _queue
+            pending = {}
+            want = 0
+            deadline = getattr(self, "timeout", None) or 120.0
+            while want < n_batches:
+                try:
+                    seq, data, err = data_q.get(timeout=deadline)
+                except _queue.Empty:
+                    dead = [p.pid for p in procs if not p.is_alive()]
+                    raise RuntimeError(
+                        f"DataLoader timed out after {deadline}s waiting "
+                        f"for batch {want}"
+                        + (f"; worker(s) {dead} died" if dead else ""))
+                if err is not None:
+                    raise RuntimeError(
+                        f"DataLoader worker failed on batch {seq}: {err}")
+                pending[seq] = data
+                while want in pending:
+                    yield _tree_to_tensor(pending.pop(want))
+                    want += 1
+        finally:
+            for p in procs:
+                if p.is_alive():
+                    p.terminate()
+            for p in procs:
+                p.join(timeout=5)
 
 
 from .token_feed import NativeTokenLoader  # noqa: E402,F401

@@ -65,6 +65,22 @@ class StaticFunction:
         self._input_spec = input_spec
         self._fwd_cache: Dict[Any, Callable] = {}
         self._bwd_cache: Dict[Any, Callable] = {}
+        self._dy2st_note = None
+        # dy2static pass: rewrite tensor control flow into
+        # lax.cond/while via convert_operators (program_translator.py
+        # analog); on transform failure keep the original function and
+        # surface the reason if tracing later hits tensor control flow
+        try:
+            import inspect as _inspect
+            from .dy2static import ast_transform
+            if _inspect.ismethod(fn):
+                raw = ast_transform(fn.__func__)
+                if raw is not fn.__func__:
+                    self._fn = raw.__get__(fn.__self__)
+            else:
+                self._fn = ast_transform(fn)
+        except Exception as e:  # keep eager semantics; explain later
+            self._dy2st_note = f"{type(e).__name__}: {e}"
         try:
             functools.update_wrapper(self, fn)
         except Exception:
@@ -120,7 +136,18 @@ class StaticFunction:
                 return pull(cotangents)
             self._bwd_cache[key] = jax.jit(bwd)
 
-        out_vals, buf_vals = self._fwd_cache[key](svals, avals, kwvals)
+        try:
+            out_vals, buf_vals = self._fwd_cache[key](svals, avals, kwvals)
+        except jax.errors.TracerBoolConversionError as e:
+            note = f" (dy2static transform failed: {self._dy2st_note})" \
+                if self._dy2st_note else ""
+            raise RuntimeError(
+                "to_static: the function branches on a tensor value that "
+                "is only known at run time. Supported fixes: keep the "
+                "control flow in a form the dy2static transformer can "
+                "convert (plain if/while assigning local variables), use "
+                "paddle.where / lax.cond style ops, or run the model "
+                f"eagerly.{note}") from e
 
         # write back updated buffers (BN running stats etc.)
         if buf_vals and self._layer is not None:
@@ -225,6 +252,62 @@ class TranslatedLayer(Layer):
         return self._forward_fn(*args)
 
 
+def _lookup_dtype(name):
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _save_param_file(path, np_state):
+    """safetensors-style container: 8-byte header length, json header
+    (name -> dtype/shape/offsets), raw buffers. No pickle: loading
+    cannot execute code."""
+    import json
+    metas = {}
+    blobs = []
+    off = 0
+    for k, v in np_state.items():
+        b = np.ascontiguousarray(v).tobytes()
+        metas[k] = {"dtype": v.dtype.name, "shape": list(v.shape),
+                    "offsets": [off, off + len(b)]}
+        blobs.append(b)
+        off += len(b)
+    head = json.dumps(metas).encode()
+    with open(path, "wb") as f:
+        f.write(len(head).to_bytes(8, "little"))
+        f.write(head)
+        for b in blobs:
+            f.write(b)
+
+
+def _load_param_file(path):
+    import json
+    with open(path, "rb") as f:
+        data = f.read()
+    try:
+        n = int.from_bytes(data[:8], "little")
+        metas = json.loads(data[8:8 + n].decode())
+    except Exception:
+        # legacy pickle container (pre-r3): refuse unless opted in —
+        # unpickling executes arbitrary code
+        if os.environ.get("PT_ALLOW_PICKLE_LOAD") == "1":
+            return pickle.loads(data)
+        raise RuntimeError(
+            f"{path} is a legacy pickle parameter file; loading pickle "
+            "can execute arbitrary code. Re-save with jit.save, or set "
+            "PT_ALLOW_PICKLE_LOAD=1 if you trust this file")
+    base = 8 + n
+    out = {}
+    for k, m in metas.items():
+        lo, hi = m["offsets"]
+        arr = np.frombuffer(data[base + lo:base + hi],
+                            dtype=_lookup_dtype(m["dtype"]))
+        out[k] = arr.reshape(m["shape"]).copy()
+    return out
+
+
 def save(layer, path, input_spec=None, **configs):
     """paddle.jit.save analog (jit/api.py save): persist params
     (.pdiparams) + the traced program as serialized StableHLO via
@@ -247,8 +330,7 @@ def save(layer, path, input_spec=None, **configs):
     state = layer.state_dict()
     names = list(state.keys())
     np_state = {k: np.asarray(v._value) for k, v in state.items()}
-    with open(path + ".pdiparams", "wb") as f:
-        pickle.dump(np_state, f)
+    _save_param_file(path + ".pdiparams", np_state)
 
     if input_spec is None:
         raise ValueError("jit.save needs input_spec (shapes/dtypes or "
@@ -299,8 +381,7 @@ def load(path, **configs):
     into a TranslatedLayer (no Python class needed)."""
     from jax import export as jax_export
 
-    with open(path + ".pdiparams", "rb") as f:
-        np_state = pickle.load(f)
+    np_state = _load_param_file(path + ".pdiparams")
     with open(path + ".pdmodel", "rb") as f:
         exported = jax_export.deserialize(f.read())
 

@@ -1,0 +1,391 @@
+"""Dynamic-to-static control-flow conversion (dy2static).
+
+Analog of the reference's AST transformer + convert_operators
+(python/paddle/jit/dy2static/program_translator.py,
+convert_operators.py): ``ast_transform(fn)`` rewrites ``if``/``while``
+statements into calls to ``convert_ifelse``/``convert_while_loop``;
+those decide AT RUNTIME whether the predicate is a traced tensor (use
+``lax.cond``/``lax.while_loop`` so both branches live in the compiled
+graph) or a plain Python bool (run the branch directly) — the same
+always-rewrite / runtime-dispatch design the reference uses.
+
+Supported v1 surface: ``if``/``elif``/``else`` and ``while`` whose
+bodies assign ordinary local names (no ``return``/``break``/
+``continue`` inside converted blocks — those raise a clear
+transform-time error so nothing silently specializes).
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from .._core.tensor import Tensor
+
+
+# ------------------------------------------------------------- runtime ops
+def _is_traced(x) -> bool:
+    v = x._value if isinstance(x, Tensor) else x
+    return isinstance(v, jax.core.Tracer)
+
+
+def _raw(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
+class _Undefined:
+    """Placeholder for names not yet bound before a converted block
+    (the reference's UndefinedVar)."""
+
+    _inst = None
+
+    def __new__(cls):
+        if cls._inst is None:
+            cls._inst = super().__new__(cls)
+        return cls._inst
+
+    def __repr__(self):
+        return "<dy2static undefined>"
+
+
+UNDEF = _Undefined()
+
+
+def convert_ifelse(pred, true_fn: Callable, false_fn: Callable, vars_,
+                   both_assigned=None):
+    """Reference convert_operators.convert_ifelse: traced predicate ->
+    lax.cond over functionalized branches; Python bool -> direct call.
+    ``both_assigned[i]`` (from static analysis) marks vars bound by BOTH
+    branches; vars unbound before the if and bound in only one branch
+    are branch-local — they are dropped from the compiled conditional's
+    outputs and stay undefined afterwards."""
+    if not _is_traced(pred):
+        return true_fn(vars_) if bool(_raw(pred)) else false_fn(vars_)
+
+    n = len(vars_)
+    both = both_assigned or (True,) * n
+
+    def _arrayish(v):
+        # python scalars/None/containers pass through by closure so a
+        # branch-invariant int stays an int after the conditional
+        return v is not UNDEF and (isinstance(v, Tensor)
+                                   or hasattr(v, "dtype"))
+
+    # slots that survive the conditional: defined before it, or bound
+    # by both branches
+    keep = [i for i in range(n) if vars_[i] is not UNDEF or both[i]]
+
+    def _wrap(fn):
+        def f(op_vars):
+            it = iter(op_vars)
+            full = tuple(Tensor(next(it)) if _arrayish(v) else v
+                         for v in vars_)
+            out = fn(full)
+            res = []
+            for i in keep:
+                o = out[i]
+                if o is UNDEF:
+                    raise RuntimeError(
+                        "dy2static: a result of a tensor-dependent if "
+                        "is bound in only one branch; both branches of "
+                        "a compiled conditional must produce it")
+                res.append(_raw(o) if isinstance(o, Tensor) else o)
+            return tuple(res)
+        return f
+
+    # non-array locals (None, lists, ...) pass through by closure; if a
+    # branch rebinds them to arrays they become cond outputs
+    operands = tuple(_raw(v) for v in vars_ if _arrayish(v))
+    outs = jax.lax.cond(_raw(pred), _wrap(true_fn), _wrap(false_fn),
+                        operands)
+    full = [UNDEF] * n
+    for i, o in zip(keep, outs):
+        full[i] = Tensor(o) if hasattr(o, "dtype") else o
+    return tuple(full)
+
+
+def convert_while_loop(cond_fn: Callable, body_fn: Callable, vars_):
+    """Traced condition -> lax.while_loop (forward-only, like the
+    reference's while_op); Python condition -> plain loop."""
+    first = cond_fn(vars_)
+    if _is_traced(first) and any(v is UNDEF for v in vars_):
+        raise RuntimeError(
+            "dy2static: a variable mutated by a tensor-dependent while "
+            "is not defined before the loop")
+    if not _is_traced(first):
+        while bool(_raw(cond_fn(vars_))):
+            vars_ = body_fn(vars_)
+        return vars_
+
+    def _cond(raw_vars):
+        wrapped = tuple(Tensor(v) for v in raw_vars)
+        return _raw(cond_fn(wrapped))
+
+    def _body(raw_vars):
+        wrapped = tuple(Tensor(v) for v in raw_vars)
+        return tuple(_raw(o) for o in body_fn(wrapped))
+
+    raw_vars = tuple(_raw(v) for v in vars_)
+    outs = jax.lax.while_loop(_cond, _body, raw_vars)
+    return tuple(Tensor(o) for o in outs)
+
+
+def convert_logical_and(a_fn, b_fn):
+    a = a_fn()
+    if _is_traced(a):
+        return Tensor(jnp.logical_and(_raw(a), _raw(b_fn())))
+    return b_fn() if bool(_raw(a)) else a
+
+
+def convert_logical_or(a_fn, b_fn):
+    a = a_fn()
+    if _is_traced(a):
+        return Tensor(jnp.logical_or(_raw(a), _raw(b_fn())))
+    return a if bool(_raw(a)) else b_fn()
+
+
+# --------------------------------------------------------- AST transformer
+class _AssignedNames(ast.NodeVisitor):
+    def __init__(self):
+        self.names = set()
+
+    def visit_Name(self, node):
+        if isinstance(node.ctx, (ast.Store, ast.Del)):
+            self.names.add(node.id)
+
+    def visit_AugAssign(self, node):
+        if isinstance(node.target, ast.Name):
+            self.names.add(node.target.id)
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node):
+        pass  # do not descend into nested defs
+
+
+def _assigned(stmts) -> set:
+    v = _AssignedNames()
+    for s in stmts:
+        v.visit(s)
+    return v.names
+
+
+class _Unsupported(ast.NodeVisitor):
+    def __init__(self):
+        self.found = None
+
+    def visit_FunctionDef(self, node):
+        pass  # synthetic branch fns from inner conversions contain Return
+
+    def visit_AsyncFunctionDef(self, node):
+        pass
+
+    def generic_visit(self, node):
+        if isinstance(node, (ast.Return, ast.Break, ast.Continue)):
+            self.found = type(node).__name__
+        super().generic_visit(node)
+
+
+def _check_supported(stmts, kind):
+    v = _Unsupported()
+    for s in stmts:
+        v.visit(s)
+    if v.found:
+        raise NotImplementedError(
+            f"dy2static: '{v.found.lower()}' inside a converted {kind} "
+            "block is not supported; restructure so the block only "
+            "assigns variables (reference dy2static return-transform "
+            "not implemented)")
+
+
+class _ControlFlowTransformer(ast.NodeTransformer):
+    """Rewrite if/while into convert_ifelse/convert_while_loop calls."""
+
+    def __init__(self):
+        self._n = 0
+
+    def _uid(self):
+        self._n += 1
+        return self._n
+
+    def _make_branch_fn(self, name, body, var_names):
+        """def name(__dy2st_vars): (v1, ..) = __dy2st_vars; BODY;
+        return (v1, ...)"""
+        arg = ast.arg(arg="__dy2st_vars")
+        unpack = ast.Assign(
+            targets=[ast.Tuple(
+                elts=[ast.Name(id=v, ctx=ast.Store())
+                      for v in var_names],
+                ctx=ast.Store())],
+            value=ast.Name(id="__dy2st_vars", ctx=ast.Load()))
+        ret = ast.Return(value=ast.Tuple(
+            elts=[ast.Name(id=v, ctx=ast.Load()) for v in var_names],
+            ctx=ast.Load()))
+        return ast.FunctionDef(
+            name=name,
+            args=ast.arguments(posonlyargs=[], args=[arg], kwonlyargs=[],
+                               kw_defaults=[], defaults=[]),
+            body=[unpack] + body + [ret],
+            decorator_list=[])
+
+    @staticmethod
+    def _guard_inits(var_names):
+        """try: v / except NameError: v = UNDEF — lets branch-local
+        names flow through the functionalized call."""
+        out = []
+        for v in var_names:
+            out.append(ast.Try(
+                body=[ast.Expr(value=ast.Name(id=v, ctx=ast.Load()))],
+                handlers=[ast.ExceptHandler(
+                    type=ast.Name(id="NameError", ctx=ast.Load()),
+                    name=None,
+                    body=[ast.Assign(
+                        targets=[ast.Name(id=v, ctx=ast.Store())],
+                        value=ast.Name(id="__dy2st_UNDEF",
+                                       ctx=ast.Load()))])],
+                orelse=[], finalbody=[]))
+        return out
+
+    @staticmethod
+    def _cleanup(var_names):
+        """if v is UNDEF: del v — restore NameError semantics for names
+        the taken branch did not bind."""
+        out = []
+        for v in var_names:
+            out.append(ast.If(
+                test=ast.Compare(
+                    left=ast.Name(id=v, ctx=ast.Load()),
+                    ops=[ast.Is()],
+                    comparators=[ast.Name(id="__dy2st_UNDEF",
+                                          ctx=ast.Load())]),
+                body=[ast.Delete(targets=[
+                    ast.Name(id=v, ctx=ast.Del())])],
+                orelse=[]))
+        return out
+
+    def visit_If(self, node):
+        node = self.generic_visit(node)
+        _check_supported(node.body + node.orelse, "if")
+        uid = self._uid()
+        body_set = _assigned(node.body)
+        else_set = _assigned(node.orelse)
+        var_names = sorted(body_set | else_set)
+        both_mask = [v in body_set and v in else_set for v in var_names]
+        if not var_names:
+            var_names = ["__dy2st_dummy"]
+            init = [ast.Assign(
+                targets=[ast.Name(id="__dy2st_dummy", ctx=ast.Store())],
+                value=ast.Constant(value=0))]
+        else:
+            init = self._guard_inits(var_names)
+        tname, fname = f"__dy2st_true_{uid}", f"__dy2st_false_{uid}"
+        true_fn = self._make_branch_fn(tname, list(node.body), var_names)
+        false_fn = self._make_branch_fn(
+            fname, list(node.orelse) or [ast.Pass()], var_names)
+        call = ast.Assign(
+            targets=[ast.Tuple(
+                elts=[ast.Name(id=v, ctx=ast.Store())
+                      for v in var_names],
+                ctx=ast.Store())],
+            value=ast.Call(
+                func=ast.Name(id="__dy2st_convert_ifelse", ctx=ast.Load()),
+                args=[node.test,
+                      ast.Name(id=tname, ctx=ast.Load()),
+                      ast.Name(id=fname, ctx=ast.Load()),
+                      ast.Tuple(elts=[ast.Name(id=v, ctx=ast.Load())
+                                      for v in var_names],
+                                ctx=ast.Load()),
+                      ast.Tuple(elts=[ast.Constant(value=b)
+                                      for b in both_mask],
+                                ctx=ast.Load())],
+                keywords=[]))
+        cleanup = [] if var_names == ["__dy2st_dummy"] \
+            else self._cleanup(var_names)
+        return init + [true_fn, false_fn, call] + cleanup
+
+    def visit_While(self, node):
+        node = self.generic_visit(node)
+        _check_supported(node.body, "while")
+        if node.orelse:
+            raise NotImplementedError("dy2static: while/else unsupported")
+        uid = self._uid()
+        var_names = sorted(_assigned(node.body))
+        if not var_names:
+            raise NotImplementedError(
+                "dy2static: while body assigns no variables")
+        init = self._guard_inits(var_names)
+        cname, bname = f"__dy2st_cond_{uid}", f"__dy2st_body_{uid}"
+        cond_fn = self._make_branch_fn(
+            cname, [], var_names)
+        # cond returns the test instead of the vars tuple
+        cond_fn.body[-1] = ast.Return(value=node.test)
+        body_fn = self._make_branch_fn(bname, list(node.body), var_names)
+        call = ast.Assign(
+            targets=[ast.Tuple(
+                elts=[ast.Name(id=v, ctx=ast.Store())
+                      for v in var_names],
+                ctx=ast.Store())],
+            value=ast.Call(
+                func=ast.Name(id="__dy2st_convert_while",
+                              ctx=ast.Load()),
+                args=[ast.Name(id=cname, ctx=ast.Load()),
+                      ast.Name(id=bname, ctx=ast.Load()),
+                      ast.Tuple(elts=[ast.Name(id=v, ctx=ast.Load())
+                                      for v in var_names],
+                                ctx=ast.Load())],
+                keywords=[]))
+        return init + [cond_fn, body_fn, call] + \
+            self._cleanup(var_names)
+
+
+def ast_transform(fn: Callable) -> Callable:
+    """Rewrite fn's tensor control flow; returns the converted function
+    (or fn unchanged when there is nothing to convert). Raises
+    NotImplementedError for constructs the transformer cannot express
+    (loud, never a silent specialization)."""
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+    except (OSError, TypeError):
+        return fn
+    tree = ast.parse(src)
+    fdef = tree.body[0]
+    # drop only to_static-ish decorators (avoid double-wrapping);
+    # other decorators keep their behavior in the converted function
+    def _is_to_static(d):
+        target = d.func if isinstance(d, ast.Call) else d
+        name = getattr(target, "attr", None) or getattr(target, "id", "")
+        return "to_static" in str(name)
+
+    if isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        fdef.decorator_list = [d for d in fdef.decorator_list
+                               if not _is_to_static(d)]
+    has_flow = any(isinstance(n, (ast.If, ast.While))
+                   for n in ast.walk(tree))
+    if not has_flow:
+        return fn
+    new_tree = _ControlFlowTransformer().visit(tree)
+    ast.fix_missing_locations(new_tree)
+    code = compile(new_tree, filename=f"<dy2static {fn.__qualname__}>",
+                   mode="exec")
+    glb = dict(fn.__globals__)
+    glb["__dy2st_convert_ifelse"] = convert_ifelse
+    glb["__dy2st_convert_while"] = convert_while_loop
+    glb["__dy2st_UNDEF"] = UNDEF
+    # rebind closure-free; closures are re-bound below if present
+    if fn.__closure__:
+        # rebuild free variables as globals snapshot (common case:
+        # self via bound method is handled by the caller passing it)
+        for name, cell in zip(fn.__code__.co_freevars, fn.__closure__):
+            try:
+                glb[name] = cell.cell_contents
+            except ValueError:
+                pass
+    loc = {}
+    exec(code, glb, loc)
+    new_fn = loc[fn.__name__]
+    functools.update_wrapper(new_fn, fn)
+    return new_fn

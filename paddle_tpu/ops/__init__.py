@@ -40,11 +40,23 @@ from .linalg import matmul
 
 
 def _adopt(self, out):
-    """Adopt a functional result as this tensor's new value (in-place ops)."""
+    """Adopt a functional result as this tensor's new value (in-place ops).
+
+    The version bump invalidates OTHER nodes that saved this tensor (the
+    tensor_wrapper.h inplace check in autograd), but the node that
+    produced ``out`` itself recorded the pre-mutation value — sync its
+    recorded version so the op's own backward stays valid."""
     self._value = out._value
     self._autograd_meta = out._autograd_meta
     self._stop_gradient = out._stop_gradient
     self._inplace_version += 1
+    node = self._autograd_meta.grad_node
+    if node is not None and node.saved_versions is not None \
+            and node.in_refs is not None:
+        node.saved_versions = tuple(
+            self._inplace_version
+            if (ref is not None and ref() is self) else v
+            for ref, v in zip(node.in_refs, node.saved_versions))
     return self
 
 

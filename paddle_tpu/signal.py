@@ -9,11 +9,11 @@ from ._core.tensor import Tensor
 
 
 def _stft_kernel(x, window, n_fft, hop_length, center, normalized,
-                 onesided):
+                 onesided, pad_mode="reflect"):
     if center:
         pad = n_fft // 2
         pad_width = [(0, 0)] * (x.ndim - 1) + [(pad, pad)]
-        x = jnp.pad(x, pad_width, mode="reflect")
+        x = jnp.pad(x, pad_width, mode=pad_mode)
     n = x.shape[-1]
     n_frames = 1 + (n - n_fft) // hop_length
     idx = (jnp.arange(n_frames)[:, None] * hop_length
@@ -71,8 +71,11 @@ def stft(x, n_fft, hop_length=None, win_length=None, window=None,
             w = jnp.pad(w, (lp, n_fft - win_length - lp))
     else:
         w = None
+    if pad_mode not in ("reflect", "constant"):
+        raise ValueError(f"stft: unsupported pad_mode '{pad_mode}'")
     kw = dict(n_fft=n_fft, hop_length=hop_length, center=center,
-              normalized=normalized, onesided=onesided)
+              normalized=normalized, onesided=onesided,
+              pad_mode=pad_mode)
     if w is None:
         key = "signal_stft_nowin"
         if key not in _OPS:

@@ -87,3 +87,73 @@ def test_instance_norm_nhwc():
     x = paddle.rand([2, 6, 5, 4])  # N H W C with C=4
     y = F.instance_norm(x, data_format="NHWC")
     assert y.shape == [2, 6, 5, 4]
+
+
+def test_dataloader_multiprocess_workers():
+    """num_workers>0 builds batches in real worker processes
+    (dataloader_iter.py:368 analog), order-preserving, Tensor output."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.io import DataLoader, Dataset
+
+    class Ds(Dataset):
+        def __len__(self):
+            return 20
+
+        def __getitem__(self, i):
+            return np.full((3,), i, "float32"), np.int64(i)
+
+    dl = DataLoader(Ds(), batch_size=4, num_workers=2)
+    seen = []
+    for x, y in dl:
+        assert x.shape == [4, 3]
+        seen.extend(int(v) for v in y.numpy())
+    assert seen == list(range(20))
+
+    # worker exceptions surface in the parent
+    class Bad(Ds):
+        def __getitem__(self, i):
+            if i == 7:
+                raise ValueError("boom")
+            return super().__getitem__(i)
+
+    import pytest
+    with pytest.raises(RuntimeError, match="worker failed"):
+        list(DataLoader(Bad(), batch_size=4, num_workers=2))
+
+
+def test_stft_pad_mode_constant():
+    import numpy as np
+    import torch
+    import paddle_tpu as paddle
+    from paddle_tpu.signal import stft
+    x = np.random.RandomState(0).randn(2, 256).astype("float32")
+    for pm in ("reflect", "constant"):
+        mine = stft(paddle.to_tensor(x), n_fft=64, pad_mode=pm).numpy()
+        ref = torch.stft(torch.from_numpy(x), 64, return_complex=True,
+                         pad_mode=pm).numpy()
+        np.testing.assert_allclose(mine, ref, rtol=1e-3, atol=1e-4)
+
+
+def test_jit_save_params_not_pickle():
+    """jit.save parameter files must not be pickle (arbitrary-code
+    execution on load); the container is a json-header + raw-bytes
+    format."""
+    import pickle
+    import tempfile, os
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.jit import save, load, InputSpec
+
+    net = nn.Linear(4, 2)
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "m")
+        save(net, path, input_spec=[InputSpec([None, 4], "float32")])
+        raw = open(path + ".pdiparams", "rb").read()
+        with __import__("pytest").raises(Exception):
+            pickle.loads(raw)  # not a pickle stream
+        loaded = load(path)
+        x = paddle.to_tensor(np.ones((3, 4), "float32"))
+        np.testing.assert_allclose(loaded(x).numpy(), net(x).numpy(),
+                                   rtol=1e-5)
