@@ -233,6 +233,24 @@ def _zeros_like_slot(node: GradNode, slot: int):
     return jnp.zeros(node.out_shapes[slot], node.out_dtypes[slot])
 
 
+_post_backward_callbacks = []
+
+
+def register_post_backward_callback(fn):
+    """Run ``fn()`` after every completed ``backward()`` walk — the hook
+    the DataParallel Reducer uses to fire its bucketed gradient
+    all-reduce once all local grads exist (reducer.cc finalize analog).
+    Returns a deregistration callable."""
+    _post_backward_callbacks.append(fn)
+
+    def _remove():
+        try:
+            _post_backward_callbacks.remove(fn)
+        except ValueError:
+            pass
+    return _remove
+
+
 def run_backward(tensors, grad_tensors=None, retain_graph=False):
     """loss.backward(): seed roots, traverse, write .grad on leaves
     (backward.cc:106). retain_graph=False frees saved activations as
@@ -240,6 +258,8 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False):
     raises instead of silently recomputing."""
     _engine_run(tensors, grad_tensors, targets=None,
                 retain_graph=bool(retain_graph))
+    for cb in list(_post_backward_callbacks):
+        cb()
 
 
 def grad(outputs, inputs, grad_outputs=None, retain_graph=None,

@@ -1,17 +1,26 @@
-"""DataParallel wrapper.
+"""DataParallel wrapper with a real gradient Reducer.
 
-Analog of python/paddle/distributed/parallel.py:219 DataParallel + the C++
-Reducer (fluid/distributed/collective/reducer.cc). TPU-native: the gradient
-"fused allreduce" is GSPMD's job once the training step runs under pjit
-with dp-sharded inputs; this wrapper provides the API surface, broadcasts
-initial params across dp ranks (trivial single-controller), and scales
-gradients by 1/dp_world when running host-driven.
+Analog of python/paddle/distributed/parallel.py:219 DataParallel + the
+C++ Reducer (fluid/distributed/collective/reducer.cc). Two regimes:
+
+- Compiled/pjit path: gradient averaging is GSPMD's psum once the train
+  step runs with dp-sharded inputs — the wrapper is only API surface.
+- Eager multi-process path (after init_parallel_env with world>1): at
+  construction parameters are broadcast from rank 0 so replicas start
+  identical, and a post-backward Reducer averages gradients across the
+  group in size-capped fused buckets (one collective per bucket, the
+  reducer.cc bucketing scheme) — unless inside ``no_sync()``.
 """
 from __future__ import annotations
 
+import weakref
+
+import numpy as np
+
+from .._core.autograd import register_post_backward_callback
 from .._core.tensor import Tensor
 from ..nn.layer import Layer
-from .parallel_env import get_world_size, init_parallel_env
+from .parallel_env import get_default_process_group, get_world_size
 
 
 class DataParallel(Layer):
@@ -21,9 +30,92 @@ class DataParallel(Layer):
         super().__init__()
         self._layers = layers
         self.find_unused_parameters = find_unused_parameters
-        self._nranks = group.nranks if group is not None else \
-            get_world_size()
+        self._group = group
+        self._pg = group.pg if group is not None \
+            else get_default_process_group()
+        self._nranks = group.nranks if group is not None \
+            else get_world_size()
+        self._grad_sync_enabled = True
+        # bucket size in MB (comm_buffer_size, parallel.py:219 default)
+        self._bucket_bytes = int(comm_buffer_size) * 1024 * 1024
+        self._unregister = None
+        self._synced_grad_ids = {}
+        if self._pg is not None and self._nranks > 1:
+            self._sync_params_from_rank0()
+            # weakref: a discarded wrapper must not be pinned forever by
+            # the global callback list, and its dead callback self-removes
+            ref = weakref.ref(self)
 
+            def _cb():
+                dp = ref()
+                if dp is None:
+                    unreg()
+                    return
+                dp._reduce_gradients()
+
+            self._unregister = unreg = register_post_backward_callback(_cb)
+
+    # ------------------------------------------------------------ reducer
+    def _sync_params_from_rank0(self):
+        """Replicas must start identical (parallel.py
+        sync_params_buffers analog)."""
+        import jax.numpy as jnp
+        for p in self._layers.parameters():
+            synced = self._pg.broadcast(p.numpy(), src=0)
+            if self._pg.rank != 0:
+                p._replace_value_inplace(
+                    jnp.asarray(np.ascontiguousarray(synced)))
+
+    def _buckets(self, params):
+        """Size-capped fused buckets, grouped by gradient dtype so no
+        precision is lost in the concat (reducer.cc groups by dtype)."""
+        by_dtype = {}
+        for p in params:
+            b = p.grad.numpy()
+            by_dtype.setdefault(b.dtype.name, []).append((p, b))
+        for group in by_dtype.values():
+            bucket, size = [], 0
+            for p, b in group:
+                bucket.append((p, b))
+                size += b.size * b.dtype.itemsize
+                if size >= self._bucket_bytes:
+                    yield bucket
+                    bucket, size = [], 0
+            if bucket:
+                yield bucket
+
+    def _reduce_gradients(self):
+        """Fused bucketed all-reduce (avg) of local gradients
+        (reducer.cc MarkGroupReady/FusedAllReduceSchedule analog). Only
+        grads NEW since the last sync participate, so a backward() on an
+        unrelated graph (e.g. the other model of a GAN) does not re-reduce
+        this model's grads. All ranks must still run the same number of
+        grad-producing backwards — the usual collective contract."""
+        if not self._grad_sync_enabled or self._pg is None \
+                or self._nranks <= 1:
+            return
+        params = []
+        for p in self._layers.parameters():
+            if p.stop_gradient or p.grad is None:
+                continue
+            if self._synced_grad_ids.get(id(p)) == id(p.grad):
+                continue  # unchanged since last sync
+            params.append(p)
+        if not params:
+            return
+        for bucket in self._buckets(params):
+            dt = bucket[0][1].dtype
+            flat = np.concatenate([b.reshape(-1) for _, b in bucket])
+            reduced = self._pg.all_reduce(flat, op="avg")
+            off = 0
+            for p, b in bucket:
+                n = b.size
+                avg = reduced[off:off + n].reshape(b.shape).astype(dt)
+                p.grad._adopt(Tensor(np.ascontiguousarray(avg)))
+                self._synced_grad_ids[id(p)] = id(p.grad)
+                off += n
+
+    # -------------------------------------------------------------- API
     def forward(self, *inputs, **kwargs):
         return self._layers(*inputs, **kwargs)
 
@@ -34,19 +126,22 @@ class DataParallel(Layer):
         return self._layers.set_state_dict(state_dict, **kwargs)
 
     def scale_loss(self, loss):
-        # grads are averaged by the compiled psum in the pjit path; in the
-        # host-driven path the reference scales loss by 1/nranks
-        # (hybrid_parallel_util.py:282)
-        if self._nranks > 1:
-            return loss / self._nranks
+        # the reducer averages grads, so loss scaling is identity (the
+        # reference scales only when its reducer sums instead)
         return loss
 
     def no_sync(self):
+        """Skip gradient sync inside the context (gradient accumulation,
+        parallel.py no_sync)."""
+        dp = self
+
         class _NoSync:
             def __enter__(self):
+                dp._grad_sync_enabled = False
                 return self
 
             def __exit__(self, *a):
+                dp._grad_sync_enabled = True
                 return False
         return _NoSync()
 

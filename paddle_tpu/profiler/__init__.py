@@ -136,12 +136,14 @@ class Profiler:
         self.current_state = ProfilerState.CLOSED
         self._device_tracing = False
         self._tb_dir = None
+        self._device_events: List[dict] = []
 
     # ---------------------------------------------------------- lifecycle
     def start(self):
         global _recording
         with _events_lock:
             _events.clear()
+        self._device_events = []  # never mix cycles if a capture fails
         self.current_state = self.scheduler(self.step_num)
         _recording = self.current_state in (
             ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN)
@@ -201,14 +203,55 @@ class Profiler:
             try:
                 import jax
                 jax.profiler.stop_trace()
+                self._ingest_device_trace()
             except Exception:
                 pass
             self._device_tracing = False
+
+    def _ingest_device_trace(self):
+        """Parse the captured XLA xplane into per-kernel device events
+        (the role of the reference's cuda_tracer.cc ingesting CUPTI
+        activity records): planes/lines/events via
+        jax.profiler.ProfileData, merged into the chrome trace under
+        cat='device'."""
+        import glob
+        import jax
+        files = sorted(glob.glob(self._tb_dir + "/**/*.xplane.pb",
+                                 recursive=True), key=os.path.getmtime)
+        if not files:
+            return
+        pd = jax.profiler.ProfileData.from_file(files[-1])
+        out = []
+        for plane in pd.planes:
+            for line in plane.lines:
+                if line.name == "python":
+                    continue  # the host tracer already covers Python
+                tid = f"{plane.name}/{line.name}"
+                for e in line.events:
+                    out.append({"name": e.name, "tid": tid,
+                                "ts": e.start_ns / 1000.0,
+                                "dur": e.duration_ns / 1000.0,
+                                "cat": "device"})
+        self._device_events = out
 
     # ------------------------------------------------------------ exports
     def events(self) -> List[dict]:
         with _events_lock:
             return list(_events)
+
+    def device_events(self) -> List[dict]:
+        return list(getattr(self, "_device_events", []))
+
+    def device_summary(self):
+        """Aggregate device kernel durations by name (profiler_statistic
+        kernel view analog): {name: {calls, total_us}} sorted by time."""
+        agg = {}
+        for e in self.device_events():
+            a = agg.setdefault(e["name"], {"calls": 0, "total_us": 0.0})
+            a["calls"] += 1
+            a["total_us"] += e["dur"]
+        return dict(sorted(agg.items(),
+                           key=lambda kv: -kv[1]["total_us"]))
 
     def export(self, path: str, format: str = "json"):
         trace = {
@@ -217,6 +260,11 @@ class Profiler:
                  "tid": e["tid"], "ts": e["ts"], "dur": e["dur"],
                  "cat": "host"}
                 for e in self.events()
+            ] + [
+                {"name": e["name"], "ph": "X", "pid": os.getpid(),
+                 "tid": e["tid"], "ts": e["ts"], "dur": e["dur"],
+                 "cat": "device"}
+                for e in self.device_events()
             ],
             "displayTimeUnit": "ms",
         }

@@ -1,0 +1,128 @@
+"""DataParallel gradient Reducer over the store-backed ProcessGroup.
+
+Reference: parallel.py:219 DataParallel + reducer.cc bucketed fused
+all-reduce. Two real trainer processes with different data must produce
+identical averaged gradients equal to a single-process run over both
+batches, including through no_sync gradient accumulation.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORLD = 2
+DIM = 8
+
+
+def _data():
+    r = np.random.RandomState(3)
+    return (r.randn(WORLD, 4, DIM).astype("float32"),
+            r.randn(WORLD, 4, DIM).astype("float32"))
+
+
+def _build(paddle, nn):
+    paddle.seed(21)
+    return nn.Sequential(nn.Linear(DIM, 16), nn.Tanh(),
+                         nn.Linear(16, DIM))
+
+
+def _reference():
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+    model = _build(paddle, nn)
+    X, Y = _data()
+    for r in range(WORLD):
+        loss = F.mse_loss(model(paddle.to_tensor(X[r])),
+                          paddle.to_tensor(Y[r])) / WORLD
+        loss.backward()
+    return [p.grad.numpy() for p in model.parameters()]
+
+
+def _worker():
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+    import paddle_tpu.distributed as dist
+
+    dist.init_parallel_env()
+    paddle.seed(100 + rank)  # deliberately different init per rank:
+    model = _build(paddle, nn) if rank == 0 else _build(paddle, nn)
+    if rank == 1:  # perturb before wrapping; DP must re-sync from rank 0
+        for p in model.parameters():
+            p._replace_value_inplace(p._value + 1.0)
+    # tiny bucket size forces multiple fused buckets
+    dp = dist.DataParallel(model, comm_buffer_size=1e-6)
+    dp._bucket_bytes = 128  # ~32 floats per bucket
+
+    X, Y = _data()
+    x = paddle.to_tensor(X[rank])
+    y = paddle.to_tensor(Y[rank])
+    # avg-reducing grads already divides by world size: the per-rank
+    # loss stays unscaled (DDP semantics)
+    loss = F.mse_loss(dp(x), y)
+    loss.backward()
+    grads = [p.grad.numpy().tolist() for p in model.parameters()]
+
+    # no_sync: grads stay local (differ across ranks)
+    model2 = _build(paddle, nn)
+    dp2 = dist.DataParallel(model2)
+    with dp2.no_sync():
+        loss2 = F.mse_loss(dp2(x), y)
+        loss2.backward()
+    local_g0 = model2.parameters()[0].grad.numpy()
+
+    report = {"rank": rank, "grads": grads,
+              "local_norm": float(np.linalg.norm(local_g0))}
+    print("DP-REPORT:" + json.dumps(report), flush=True)
+
+
+def test_reducer_matches_single_process():
+    ref_grads = _reference()
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    procs = []
+    for rank in range(WORLD):
+        env = dict(os.environ)
+        env.update({
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(WORLD),
+            "MASTER_ADDR": "127.0.0.1",
+            "MASTER_PORT": str(port),
+            "JAX_PLATFORMS": "cpu",
+            "PT_DP_WORKER": "1",
+            "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    reports = {}
+    for rank, p in enumerate(procs):
+        out, _ = p.communicate(timeout=240)
+        assert p.returncode == 0, f"rank {rank} rc={p.returncode}:\n{out}"
+        for line in out.splitlines():
+            if line.startswith("DP-REPORT:"):
+                rep = json.loads(line[len("DP-REPORT:"):])
+                reports[rep["rank"]] = rep
+    assert len(reports) == WORLD
+    # both ranks hold identical averaged grads == single-process reference
+    for r in range(WORLD):
+        for got, want in zip(reports[r]["grads"], ref_grads):
+            np.testing.assert_allclose(np.asarray(got, "float32"), want,
+                                       rtol=1e-5, atol=1e-6)
+    # no_sync grads stayed local (rank batches differ -> norms differ)
+    assert abs(reports[0]["local_norm"] - reports[1]["local_norm"]) > 1e-6
+
+
+if __name__ == "__main__" and os.environ.get("PT_DP_WORKER") == "1":
+    _worker()

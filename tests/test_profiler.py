@@ -119,3 +119,28 @@ def test_profiler_cycles_do_not_accumulate_events():
     p.stop()
     # each record cycle saw exactly its own single event
     assert counts and all(c == 1 for c in counts)
+
+
+def test_device_trace_ingestion(tmp_path, monkeypatch):
+    """XLA xplane events are parsed into the chrome trace
+    (cuda_tracer.cc-role: device-side kernel records, VERDICT r2 #10)."""
+    import json
+    import os
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.profiler import Profiler, ProfilerTarget
+
+    monkeypatch.setenv("PADDLE_PROFILER_TB_DIR", str(tmp_path / "tb"))
+    prof = Profiler(targets=[ProfilerTarget.CPU, ProfilerTarget.TPU])
+    prof.start()
+    x = paddle.to_tensor(np.random.randn(128, 128).astype("float32"))
+    float(paddle.matmul(x, x).sum().numpy())
+    prof.stop()
+
+    devs = prof.device_events()
+    assert devs, "no device events ingested"
+    summ = prof.device_summary()
+    assert summ and all("total_us" in v for v in summ.values())
+    path = prof.export(str(tmp_path / "trace.json"))
+    cats = {e["cat"] for e in json.load(open(path))["traceEvents"]}
+    assert {"host", "device"} <= cats
