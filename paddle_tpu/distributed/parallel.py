@@ -71,7 +71,10 @@ class DataParallel(Layer):
         precision is lost in the concat (reducer.cc groups by dtype)."""
         by_dtype = {}
         for p in params:
-            b = p.grad.numpy()
+            if p.grad is not None:
+                b = p.grad.numpy()
+            else:  # in the agreed union but locally unused: zeros
+                b = np.zeros(tuple(p.shape), np.dtype(p._value.dtype))
             by_dtype.setdefault(b.dtype.name, []).append((p, b))
         for group in by_dtype.values():
             bucket, size = [], 0
@@ -84,23 +87,36 @@ class DataParallel(Layer):
             if bucket:
                 yield bucket
 
+    def _fresh_since_last_sync(self, p):
+        """A grad is fresh unless it is the exact tensor (identity AND
+        inplace-version) we last synced. Versions are bumped by _adopt at
+        sync time, so a recycled id() of a freed grad can't alias a stale
+        entry (the fresh tensor starts at version 0)."""
+        rec = self._synced_grad_ids.get(id(p))
+        return rec is None or rec != (id(p.grad),
+                                      p.grad._inplace_version)
+
     def _reduce_gradients(self):
         """Fused bucketed all-reduce (avg) of local gradients
         (reducer.cc MarkGroupReady/FusedAllReduceSchedule analog). Only
         grads NEW since the last sync participate, so a backward() on an
         unrelated graph (e.g. the other model of a GAN) does not re-reduce
-        this model's grads. All ranks must still run the same number of
-        grad-producing backwards — the usual collective contract."""
+        this model's grads. Participation is agreed across ranks first
+        (union of per-rank fresh sets), so rank-divergent control flow /
+        unused parameters keep the collective sequence symmetric — a rank
+        without a fresh grad contributes its existing grad or zeros
+        (find_unused_parameters semantics, reducer.cc
+        MarkVarReadyInCallback for unused vars)."""
         if not self._grad_sync_enabled or self._pg is None \
                 or self._nranks <= 1:
             return
-        params = []
-        for p in self._layers.parameters():
-            if p.stop_gradient or p.grad is None:
-                continue
-            if self._synced_grad_ids.get(id(p)) == id(p.grad):
-                continue  # unchanged since last sync
-            params.append(p)
+        trainable = [p for p in self._layers.parameters()
+                     if not p.stop_gradient]
+        mask = np.array(
+            [1 if (p.grad is not None and self._fresh_since_last_sync(p))
+             else 0 for p in trainable], dtype=np.float32)
+        union = self._pg.all_reduce(mask, op="max")
+        params = [p for p, u in zip(trainable, union) if u > 0]
         if not params:
             return
         for bucket in self._buckets(params):
@@ -111,8 +127,12 @@ class DataParallel(Layer):
             for p, b in bucket:
                 n = b.size
                 avg = reduced[off:off + n].reshape(b.shape).astype(dt)
-                p.grad._adopt(Tensor(np.ascontiguousarray(avg)))
-                self._synced_grad_ids[id(p)] = id(p.grad)
+                if p.grad is None:
+                    p.grad = Tensor(np.ascontiguousarray(avg))
+                else:
+                    p.grad._adopt(Tensor(np.ascontiguousarray(avg)))
+                self._synced_grad_ids[id(p)] = (id(p.grad),
+                                                p.grad._inplace_version)
                 off += n
 
     # -------------------------------------------------------------- API
@@ -137,11 +157,12 @@ class DataParallel(Layer):
 
         class _NoSync:
             def __enter__(self):
+                self._prev = dp._grad_sync_enabled
                 dp._grad_sync_enabled = False
                 return self
 
             def __exit__(self, *a):
-                dp._grad_sync_enabled = True
+                dp._grad_sync_enabled = self._prev
                 return False
         return _NoSync()
 

@@ -149,7 +149,8 @@ class Profiler:
             ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN)
         from .._core import executor
         executor.set_profile_cb(lambda name: RecordEvent(f"op::{name}"))
-        self._maybe_device_trace()
+        if _recording:
+            self._maybe_device_trace()
         return self
 
     def stop(self):
@@ -166,17 +167,23 @@ class Profiler:
         self.step_num += 1
         self.current_state = self.scheduler(self.step_num)
         global _recording
-        if prev == ProfilerState.RECORD_AND_RETURN and \
-                self.on_trace_ready is not None:
-            self.on_trace_ready(self)
+        if prev == ProfilerState.RECORD_AND_RETURN:
+            # cycle boundary: pull the device trace in NOW so the per-cycle
+            # export carries this cycle's device events, not none
+            self._stop_device_trace()
+            if self.on_trace_ready is not None:
+                self.on_trace_ready(self)
         was_recording = _recording
         _recording = self.current_state in (
             ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN)
-        if _recording and not was_recording:
+        if _recording and (not was_recording
+                           or prev == ProfilerState.RECORD_AND_RETURN):
             # new record cycle: drop the previous cycle's events so each
             # exported trace covers exactly one cycle
             with _events_lock:
                 _events.clear()
+            self._device_events = []
+            self._maybe_device_trace()
 
     def __enter__(self):
         return self.start()
@@ -193,6 +200,11 @@ class Profiler:
             import jax
             self._tb_dir = os.environ.get("PADDLE_PROFILER_TB_DIR",
                                           "/tmp/paddle_tpu_profile")
+            # xplane stamps wall-clock ns; host events use perf_counter ns.
+            # Sample both clocks at trace start so device events can be
+            # rebased onto the host timeline at ingest.
+            self._clock_offset_us = (time.time_ns()
+                                     - time.perf_counter_ns()) / 1000.0
             jax.profiler.start_trace(self._tb_dir)
             self._device_tracing = True
         except Exception:
@@ -227,9 +239,10 @@ class Profiler:
                 if line.name == "python":
                     continue  # the host tracer already covers Python
                 tid = f"{plane.name}/{line.name}"
+                offset = getattr(self, "_clock_offset_us", 0.0)
                 for e in line.events:
                     out.append({"name": e.name, "tid": tid,
-                                "ts": e.start_ns / 1000.0,
+                                "ts": e.start_ns / 1000.0 - offset,
                                 "dur": e.duration_ns / 1000.0,
                                 "cat": "device"})
         self._device_events = out

@@ -1,0 +1,240 @@
+"""SPMD rule unit tests — pure propagation logic, no devices.
+
+Mirrors the reference's test/auto_parallel/spmd_rules/test_*_rule.py suite
+(e.g. test_matmul_rule.py, test_embedding_rule.py,
+test_cross_entropy_with_softmax_rule.py).
+"""
+import pytest
+
+from paddle_tpu.distributed.auto_parallel import spmd_rules as R
+from paddle_tpu.distributed.placements import Partial, Replicate, Shard
+
+
+def A(dims, partial=None):
+    return R.TensorDistAttr(dims, partial)
+
+
+# ----------------------------------------------------------------- matmul
+class TestMatmul:
+    def test_row_col(self):
+        # x[m,k] sharded m on axis0; y[k,n] sharded n on axis1
+        (xi, yi), (out,) = R.resolve("matmul", [A([0, -1]), A([-1, 1])])
+        assert out.dims_mapping == [0, 1]
+        assert out.partial_status == {}
+
+    def test_contracted_partial(self):
+        # k sharded on axis 0 in both -> out partial(sum) on axis0
+        (xi, yi), (out,) = R.resolve("matmul", [A([-1, 0]), A([0, -1])])
+        assert out.dims_mapping == [-1, -1]
+        assert out.partial_status == {0: "sum"}
+
+    def test_conflict_resolution(self):
+        # x says k on axis0, y says k on axis1: first wins, y reshards
+        (xi, yi), (out,) = R.resolve("matmul", [A([-1, 0]), A([1, -1])])
+        assert yi.dims_mapping == [0, -1]
+        assert out.partial_status == {0: "sum"}
+
+    def test_transpose_y(self):
+        # y[n,k] transposed; n sharded axis1
+        (xi, yi), (out,) = R.resolve(
+            "matmul", [A([0, -1]), A([1, -1])], transpose_y=True)
+        assert out.dims_mapping == [0, 1]
+
+    def test_batched_broadcast(self):
+        # x[b,m,k] batch-sharded, y[k,n]
+        (xi, yi), (out,) = R.resolve(
+            "matmul", [A([0, -1, -1]), A([-1, 1])])
+        assert out.dims_mapping == [0, -1, 1]
+
+    def test_axis_reuse_blocked(self):
+        # m and n both claim axis0 -> second use dropped
+        (xi, yi), (out,) = R.resolve("matmul", [A([0, -1]), A([-1, 0])])
+        assert out.dims_mapping == [0, -1]
+
+
+# -------------------------------------------------------------- embedding
+class TestEmbedding:
+    def test_vocab_parallel_partial(self):
+        # weight vocab-sharded on axis 0 -> output partial(sum) on axis0
+        (ii, wi), (out,) = R.resolve("embedding", [A([-1, -1]), A([0, -1])])
+        assert out.dims_mapping == [-1, -1, -1]
+        assert out.partial_status == {0: "sum"}
+
+    def test_hidden_shard_flows(self):
+        (ii, wi), (out,) = R.resolve("embedding", [A([0, -1]), A([-1, 1])])
+        assert out.dims_mapping == [0, -1, 1]
+        assert out.partial_status == {}
+
+
+# --------------------------------------------------------------- softmax CE
+class TestSoftmaxCrossEntropy:
+    def test_vocab_sharded_loss_partial(self):
+        (li, lb), (loss, sm) = R.resolve(
+            "softmax_with_cross_entropy",
+            [A([-1, -1, 0]), A([-1, -1, -1])])
+        assert loss.dims_mapping == [-1, -1, -1]
+        assert loss.partial_status == {0: "sum"}
+        assert sm.dims_mapping == [-1, -1, 0]
+
+    def test_batch_shard_flows(self):
+        (li, lb), (loss, sm) = R.resolve(
+            "cross_entropy_with_softmax",
+            [A([0, -1, -1]), A([0, -1, -1])])
+        assert loss.dims_mapping == [0, -1, -1]
+        assert loss.partial_status == {}
+
+
+# -------------------------------------------------------------- reductions
+class TestReduction:
+    def test_sum_sharded_axis_partial(self):
+        (xi,), (out,) = R.resolve("sum", [A([0, -1])], axis=0)
+        assert out.dims_mapping == [-1]
+        assert out.partial_status == {0: "sum"}
+
+    def test_max_reduce_type(self):
+        (xi,), (out,) = R.resolve("max", [A([0, 1])], axis=1)
+        assert out.partial_status == {1: "max"}
+        assert out.dims_mapping == [0]
+
+    def test_keepdim(self):
+        (xi,), (out,) = R.resolve("mean", [A([0, 1])], axis=1,
+                                  keepdim=True)
+        assert out.dims_mapping == [0, -1]
+
+    def test_full_reduce(self):
+        (xi,), (out,) = R.resolve("sum", [A([0, 1])])
+        assert out.dims_mapping == []
+        assert set(out.partial_status) == {0, 1}
+
+
+# ------------------------------------------------------------- elementwise
+class TestElementwise:
+    def test_merge(self):
+        (xi, yi), (out,) = R.resolve("add", [A([0, -1]), A([-1, 1])])
+        assert out.dims_mapping == [0, 1]
+        assert xi.dims_mapping == [0, 1]
+
+    def test_broadcast(self):
+        # y rank-1 right-aligned against x rank-3
+        (xi, yi), (out,) = R.resolve("multiply", [A([0, -1, 1]), A([-1])])
+        assert out.dims_mapping == [0, -1, 1]
+        assert yi.dims_mapping == [1]
+
+    def test_partial_cleared_on_inferred_inputs(self):
+        (xi, yi), (out,) = R.resolve(
+            "add", [A([0, -1], {1: "sum"}), A([0, -1])])
+        assert xi.partial_status == {}
+
+    def test_where_ternary(self):
+        (ci, xi, yi), (out,) = R.resolve(
+            "where", [A([0, -1]), A([0, -1]), A([-1, 1])])
+        assert out.dims_mapping == [0, 1]
+
+
+# ------------------------------------------------------------ shape ops
+class TestShapeOps:
+    def test_reshape_merge_dims(self):
+        # [b(s0), s, h] -> [b*s, h]: leading group dim keeps sharding
+        (xi,), (out,) = R.resolve(
+            "reshape", [A([0, -1, 1])], x_shape=[4, 8, 16],
+            shape=[32, 16])
+        assert out.dims_mapping == [0, 1]
+
+    def test_reshape_split_dims(self):
+        # [bs(s0), h] -> [b, s, h]
+        (xi,), (out,) = R.resolve(
+            "reshape", [A([0, 1])], x_shape=[32, 16], shape=[4, 8, 16])
+        assert out.dims_mapping == [0, -1, 1]
+
+    def test_reshape_minus_one(self):
+        (xi,), (out,) = R.resolve(
+            "reshape", [A([0, -1])], x_shape=[4, 6], shape=[-1])
+        assert out.dims_mapping == [0]
+
+    def test_transpose(self):
+        (xi,), (out,) = R.resolve("transpose", [A([0, -1, 1])],
+                                  perm=[2, 0, 1])
+        assert out.dims_mapping == [1, 0, -1]
+
+    def test_split_unshards_axis(self):
+        (xi,), outs = R.resolve("split", [A([0, 1])], axis=0, num=3)
+        assert xi.dims_mapping == [-1, 1]
+        assert len(outs) == 3
+        assert outs[0].dims_mapping == [-1, 1]
+
+    def test_concat_axis_replicated(self):
+        inferred, (out,) = R.resolve(
+            "concat", [A([0, 1]), A([0, 1])], axis=1)
+        assert out.dims_mapping == [0, -1]
+
+    def test_slice(self):
+        (xi,), (out,) = R.resolve("slice", [A([0, 1])], axes=[1])
+        assert out.dims_mapping == [0, -1]
+
+    def test_stack(self):
+        inferred, (out,) = R.resolve("stack", [A([0, 1]), A([0, 1])],
+                                     axis=0)
+        assert out.dims_mapping == [-1, 0, 1]
+
+
+# ------------------------------------------------------------ norm/softmax
+class TestNormAndSoftmax:
+    def test_layer_norm_replicates_norm_dims(self):
+        (xi, wi, bi), (out,) = R.resolve(
+            "layer_norm", [A([0, -1, 1]), A([-1]), A([-1])],
+            begin_norm_axis=2)
+        assert out.dims_mapping == [0, -1, -1]
+
+    def test_softmax_axis(self):
+        (xi,), (out,) = R.resolve("softmax", [A([0, 1])], axis=-1)
+        assert out.dims_mapping == [0, -1]
+
+    def test_flash_attention(self):
+        q = A([0, -1, 1, -1])  # batch on dp axis, heads on mp axis
+        k = A([0, -1, 1, -1])
+        v = A([0, -1, 1, -1])
+        inferred, (out,) = R.resolve("flash_attention", [q, k, v])
+        assert out.dims_mapping == [0, -1, 1, -1]
+
+
+# ------------------------------------------------------------ conversions
+class TestConversions:
+    def test_from_placements(self):
+        attr = R.from_placements([Shard(0), Replicate(), Partial()], 2)
+        assert attr.dims_mapping == [0, -1]
+        assert attr.partial_status == {2: "sum"}
+
+    def test_round_trip(self):
+        pl = [Shard(1), Partial("sum"), Replicate()]
+        attr = R.from_placements(pl, 3)
+        back = R.to_placements(attr, 3)
+        assert back == pl
+
+    def test_partition_spec(self):
+        attr = A([1, -1, 0])
+        spec = R.to_partition_spec(attr, ["dp", "mp"])
+        assert tuple(spec) == ("mp", None, "dp")
+
+    def test_partition_spec_trailing_none_trimmed(self):
+        spec = R.to_partition_spec(A([0, -1, -1]), ["dp", "mp"])
+        assert tuple(spec) == ("dp",)
+
+
+# ------------------------------------------------------------ registry
+class TestRegistry:
+    def test_rule_count_meaningful(self):
+        # reference registers 121 rule bindings (spmd_rules/rules.cc)
+        assert len(R.registered_rules()) >= 100
+
+    def test_unknown_op_defaults_to_replicated(self):
+        inferred, (out,) = R.resolve("no_such_op", [A([0, 1])])
+        assert inferred[0].dims_mapping == [-1, -1]
+        assert out.dims_mapping == [-1, -1]
+
+    def test_unary_family(self):
+        (xi,), (out,) = R.resolve("gelu", [A([0, 1])])
+        assert out.dims_mapping == [0, 1]
+
+    def test_notation_rank_mismatch_raises(self):
+        with pytest.raises(ValueError):
+            R.infer_einsum("mk,kn->mn", A([0]), A([-1, -1]))
