@@ -320,14 +320,15 @@ def matmul_rule(x: TensorDistAttr, y: TensorDistAttr,
 
 
 @register_spmd_rule("embedding")
-def embedding_rule(ids: TensorDistAttr, w: TensorDistAttr, **attrs):
+def embedding_rule(w: TensorDistAttr, ids: TensorDistAttr, **attrs):
     """Vocab-parallel embedding: weight row-sharded (vocab dim on axis a)
     -> output Partial(sum) on a, masked-lookup semantics
-    (ref: embedding_spmd_rule.cc + mpu/mp_ops.py:77 _c_lookup_table)."""
+    (ref: embedding_spmd_rule.cc + mpu/mp_ops.py:77 _c_lookup_table).
+    Arg order matches the registered op: (weight, ids)."""
     nd = ids.ndim
     ids_nota = _LETTERS[:nd]
-    eq = f"{ids_nota},vh->{ids_nota}h"
-    return infer_einsum(eq, ids, w)
+    eq = f"vh,{ids_nota}->{ids_nota}h"
+    return infer_einsum(eq, w, ids)
 
 
 @register_spmd_rule(["softmax_with_cross_entropy",
@@ -452,12 +453,20 @@ def softmax_rule(x: TensorDistAttr, axis=-1, **attrs):
 @register_spmd_rule("flash_attention")
 def flash_attention_rule(q: TensorDistAttr, k: TensorDistAttr,
                          v: TensorDistAttr, causal=False, **attrs):
-    """[b, s, h, d]: batch + heads shardable; seq sharding on q maps to
-    ring/blockwise attention (context_parallel.py), so q.seq may stay
-    sharded while k/v seq must gather (ref: flash_attn rule file +
-    flash_attention.py:562)."""
+    """[b, s, h, d]: batch + heads shardable; q.seq sharding maps to
+    ring/blockwise attention (context_parallel.py). Softmax is NOT
+    sum-decomposable over kv-seq or head-dim, so those dims are forced
+    replicated rather than emitted as Partial — a planner must gather
+    them (ref: flash_attn rule file + flash_attention.py:562)."""
     eq = "bshd,bthd,bthd->bshd"
-    return infer_einsum(eq, q, k, v)
+    inferred, (out,) = infer_einsum(eq, q, k, v)
+    for attr, nota in zip(inferred, ("bshd", "bthd", "bthd")):
+        for i, letter in enumerate(nota):
+            if letter in ("t", "d"):
+                attr.dims_mapping[i] = -1
+    out.dims_mapping[3] = -1
+    out.partial_status = {}
+    return inferred, [out]
 
 
 @register_spmd_rule("dropout")
@@ -475,8 +484,6 @@ def squeeze_rule(x: TensorDistAttr, axis=None, out_ndim=None, **attrs):
 def gather_rule(x: TensorDistAttr, index: TensorDistAttr, axis=0, **attrs):
     dims = list(x.dims_mapping)
     dims[axis % x.ndim] = -1
-    out = [dims[a] if a != axis % x.ndim else -1
-           for a in range(x.ndim)][:x.ndim]
     out_nd = index.ndim + x.ndim - 1
     return ([TensorDistAttr(dims), TensorDistAttr([-1] * index.ndim)],
             [TensorDistAttr([-1] * out_nd)])

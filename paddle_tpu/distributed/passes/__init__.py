@@ -1,0 +1,118 @@
+"""Distributed program passes (python/paddle/distributed/passes/ analog).
+
+The reference rewrites rank-local programs with a pass family
+(auto_parallel_sharding, auto_parallel_recompute, pipeline_scheduler_pass,
+sequence_parallel_optimization…). On TPU the rank-local rewrite is GSPMD's
+job: one global program + sharding annotations compiles to per-device
+executables with collectives inserted by XLA. What remains pass-shaped —
+and lives here — is the planning layer that decides those annotations:
+
+- ShardingCompletionPass: the completion.py analog. Given seed placements
+  on feeds/parameters, propagate TensorDistAttr through every recorded op
+  with the per-op SPMD rules (spmd_rules.py) and attach a NamedSharding to
+  each intermediate; the executor turns those into
+  with_sharding_constraint, i.e. the Partitioner's role collapses onto
+  GSPMD (auto_parallel/static/completion.py + partitioner.py).
+
+Gradient-merge / recompute / amp rewrites live where they are real in this
+build: the compiled trainer specs (models/trainer), jax.checkpoint
+(fleet recompute), and the IR AutoMixedPrecisionPass respectively.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+from ...ir.pass_base import Pass, Workspace
+from ..auto_parallel import spmd_rules as R
+from ..mesh import ProcessMesh
+from ..placements import Placement
+
+
+class DistContext:
+    """Holds the mesh and the per-Variable dist attrs decided so far
+    (auto_parallel/static/dist_context.py analog)."""
+
+    def __init__(self, mesh: ProcessMesh):
+        self.mesh = mesh
+        self.attrs: Dict[int, R.TensorDistAttr] = {}
+
+    def shard(self, var, placements: Sequence[Placement]):
+        """Seed a placement decision for a feed var or captured param."""
+        if hasattr(var, "var_shape"):       # static.Variable placeholder
+            ndim = len(var.var_shape)
+        elif hasattr(var, "ndim"):
+            ndim = var.ndim
+        else:
+            ndim = len(var.shape)
+        self.attrs[id(var)] = R.from_placements(placements, ndim)
+        return self
+
+    def attr_of(self, var) -> Optional[R.TensorDistAttr]:
+        return self.attrs.get(id(var))
+
+
+class ShardingCompletionPass(Pass):
+    """Forward dist-attr propagation over the recorded graph."""
+
+    name = "auto_parallel_completion"
+
+    def __init__(self, ctx: DistContext):
+        self.ctx = ctx
+
+    def _attr_for(self, ws, t):
+        from ...static import Variable
+        if t is None:
+            return None
+        if isinstance(t, Variable):
+            t = ws.resolve(t)
+        a = self.ctx.attrs.get(id(t))
+        if a is not None:
+            return a
+        ndim = (len(t.var_shape) if hasattr(t, "var_shape")
+                else (t.ndim if hasattr(t, "ndim")
+                      else getattr(t, "ndim", 0)))
+        return R.TensorDistAttr([-1] * ndim)
+
+    def run(self, ws: Workspace, protected: frozenset) -> bool:
+        mesh = self.ctx.mesh
+        jmesh = mesh.jax_mesh()
+        from jax.sharding import NamedSharding
+        changed = False
+        from ...static import Variable
+        for node in ws.ops:
+            in_attrs = [self._attr_for(ws, t) for t in node.inputs
+                        if t is not None]
+            if not in_attrs:
+                continue
+            attrs = dict(node.attrs)
+            if node.op_name == "reshape" and isinstance(
+                    node.inputs[0], Variable):
+                attrs.setdefault("x_shape", node.inputs[0].var_shape)
+            try:
+                inferred, outs = R.resolve(node.op_name, in_attrs, **attrs)
+            except Exception:
+                inferred, outs = R.default_replicated(*in_attrs)
+            for var, attr in zip(node.outputs, outs):
+                if attr.ndim != len(var.var_shape):
+                    continue  # rule lacked shape info; leave unplaced
+                self.ctx.attrs[id(var)] = attr
+                # only constrain materialized (non-partial) placements;
+                # a Partial tensor must stay unreduced until its consumer
+                # (GSPMD resolves the pending psum there)
+                if not attr.partial_status and not attr.is_replicated():
+                    spec = R.to_partition_spec(attr, mesh.dim_names)
+                    ws.shardings[id(var)] = NamedSharding(jmesh, spec)
+                    changed = True
+        return changed
+
+
+def apply_completion(program, mesh: ProcessMesh,
+                     seed_placements: Dict) -> DistContext:
+    """Convenience: build a DistContext seeded with {var: placements}."""
+    ctx = DistContext(mesh)
+    for var, pl in seed_placements.items():
+        ctx.shard(var, pl)
+    return ctx
+
+
+__all__ = ["DistContext", "ShardingCompletionPass", "apply_completion"]

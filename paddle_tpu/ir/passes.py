@@ -1,0 +1,336 @@
+"""Stock general passes (fluid/pir/transforms/general/ analogs)."""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from .._core.flags import define_flag
+from .._core.op_registry import get_op
+from .pass_base import Pass, Workspace
+from .pattern_rewrite import PatternRewriter, RewritePattern
+
+define_flag("FLAGS_apply_ir_passes", True,
+            "run the IR pass pipeline when compiling static Programs")
+
+# ops whose results are not pure functions of their inputs — never fold,
+# dedupe, or reorder across these (pir marks these via op traits)
+_IMPURE_MARKERS = ("rand", "dropout", "uniform", "normal", "bernoulli",
+                   "poisson", "multinomial", "exponential", "seed",
+                   "print", "assign_out", "share_data")
+
+
+def _is_impure(op_name: str) -> bool:
+    return any(m in op_name for m in _IMPURE_MARKERS)
+
+
+def _value_of_const(ws: Workspace, t) -> Any:
+    """Concrete value of a non-Variable input, or _NOT_CONST."""
+    from ..static import Variable
+    t = ws.resolve(t) if isinstance(t, Variable) else t
+    if isinstance(t, Variable):
+        return ws.const_env.get(id(t), _NOT_CONST)
+    if t is None:
+        return None
+    if hasattr(t, "_value"):  # eager Tensor captured by the graph
+        return t._value
+    return t  # raw array injected by an earlier fold
+
+
+class _NotConst:
+    def __repr__(self):
+        return "<not-const>"
+
+
+_NOT_CONST = _NotConst()
+
+
+class ConstantFoldingPass(Pass):
+    """Evaluate ops whose inputs are all compile-time constants
+    (constant_folding_pass.cc)."""
+
+    name = "constant_folding"
+
+    def run(self, ws: Workspace, protected: frozenset) -> bool:
+        changed = False
+        for node in list(ws.ops):
+            if _is_impure(node.op_name):
+                continue
+            vals = [_value_of_const(ws, t) for t in node.inputs]
+            if any(v is _NOT_CONST for v in vals):
+                continue
+            op = get_op(node.op_name)
+            out = op.fn(*vals, **node.attrs)
+            outs = jax.tree_util.tree_leaves(
+                out if op.multi_output else (out,))
+            for var, v in zip(node.outputs, outs):
+                ws.replace_all_uses(var, v)
+            ws.ops.remove(node)
+            changed = True
+        return changed
+
+
+class DeadCodeEliminationPass(Pass):
+    """Drop ops none of whose outputs reach a protected (fetched) value
+    (dead_code_elimination_pass.cc)."""
+
+    name = "dead_code_elimination"
+
+    def run(self, ws: Workspace, protected: frozenset) -> bool:
+        from ..static import Variable
+        live = set(protected)
+        # a protected var may have been aliased to another op's output
+        # (CSE): that output must stay computable
+        for src_id in protected:
+            if src_id in ws.aliases:
+                tgt = ws.resolve(ws.aliases[src_id])
+                if isinstance(tgt, Variable):
+                    live.add(id(tgt))
+        changed = False
+        for node in reversed(list(ws.ops)):
+            out_ids = {id(o) for o in node.outputs}
+            if (out_ids & live) or _is_impure(node.op_name):
+                for t in node.inputs:
+                    if isinstance(t, Variable):
+                        live.add(id(t))
+                        tt = ws.resolve(t)
+                        if isinstance(tt, Variable):
+                            live.add(id(tt))
+            else:
+                ws.ops.remove(node)
+                changed = True
+        return changed
+
+
+def _attr_key(attrs):
+    def norm(v):
+        if isinstance(v, (list, tuple)):
+            return tuple(norm(x) for x in v)
+        if isinstance(v, dict):
+            return tuple(sorted((k, norm(x)) for k, x in v.items()))
+        return v
+    try:
+        return tuple(sorted((k, norm(v)) for k, v in attrs.items()))
+    except TypeError:
+        return None  # unhashable attr: skip CSE for this node
+
+
+class CommonSubexpressionEliminationPass(Pass):
+    """Dedupe identical pure ops on identical inputs
+    (common_subexpression_elimination_pass.cc)."""
+
+    name = "cse"
+
+    def run(self, ws: Workspace, protected: frozenset) -> bool:
+        from ..static import Variable
+
+        import numpy as np
+
+        def input_key(t):
+            t2 = ws.resolve(t) if isinstance(t, Variable) else t
+            if isinstance(t2, Variable) and id(t2) in ws.const_env:
+                t2 = ws.const_env[id(t2)]
+            if t2 is None:
+                return None
+            if isinstance(t2, Variable):
+                return id(t2)
+            # captured constants: structural equality for small payloads
+            # (each python scalar coerces to a fresh Tensor, so identity
+            # would never match)
+            v = t2._value if hasattr(t2, "_value") else t2
+            if getattr(v, "size", 1 << 30) <= 4096:
+                a = np.asarray(v)
+                return ("const", a.dtype.str, a.shape, a.tobytes())
+            return id(t2)
+
+        seen = {}
+        changed = False
+        for node in list(ws.ops):
+            if _is_impure(node.op_name):
+                continue
+            akey = _attr_key(node.attrs)
+            if akey is None:
+                continue
+            key = (node.op_name, akey,
+                   tuple(input_key(t) for t in node.inputs))
+            first = seen.get(key)
+            if first is None:
+                seen[key] = node
+                continue
+            for old, new in zip(node.outputs, first.outputs):
+                ws.replace_all_uses(old, new)
+            ws.ops.remove(node)
+            changed = True
+        return changed
+
+
+# --------------------------------------------------------------- AMP pass
+
+_AMP_WHITELIST = ("matmul", "conv2d", "einsum", "bmm", "mm", "addmm",
+                  "flash_attention")
+
+
+class AutoMixedPrecisionPass(Pass):
+    """Cast float32 inputs of MXU-bound ops to bfloat16
+    (auto_mixed_precision_pass.cc; O1 semantics of amp/auto_cast.py —
+    bf16 is the TPU tensor-core dtype the way fp16 is CUDA's)."""
+
+    name = "auto_mixed_precision"
+
+    def __init__(self, dtype="bfloat16"):
+        self.dtype = dtype
+
+    def run(self, ws: Workspace, protected: frozenset) -> bool:
+        from ..static import OpNode, Variable
+        target = jnp.dtype(self.dtype)
+        casted = {}
+        changed = False
+        for node in list(ws.ops):
+            if node.op_name not in _AMP_WHITELIST:
+                continue
+            for i, t in enumerate(node.inputs):
+                t_res = ws.resolve(t) if isinstance(t, Variable) else t
+                if isinstance(t_res, Variable):
+                    if id(t_res) in ws.const_env:
+                        v = ws.const_env[id(t_res)]
+                        if v.dtype == jnp.float32:
+                            node.inputs[i] = v.astype(target)
+                            changed = True
+                        continue
+                    if t_res.var_dtype != jnp.float32:
+                        continue
+                    cv = casted.get(id(t_res))
+                    if cv is None:
+                        cast_node = OpNode(
+                            "cast", {"dtype": self.dtype}, [t_res], [])
+                        cv = Variable(f"{t_res.name}.cast_{self.dtype}",
+                                      t_res.var_shape, target,
+                                      t_res.program, source=cast_node)
+                        cast_node.outputs = [cv]
+                        ws.ops.insert(ws.ops.index(node), cast_node)
+                        casted[id(t_res)] = cv
+                    node.inputs[i] = cv
+                    changed = True
+                elif t_res is not None:
+                    v = t_res._value if hasattr(t_res, "_value") else t_res
+                    if hasattr(v, "dtype") and v.dtype == jnp.float32:
+                        node.inputs[i] = jnp.asarray(v).astype(target)
+                        changed = True
+        return changed
+
+
+# ------------------------------------------------------- cleanup patterns
+
+
+def _dtype_of(t):
+    from ..static import Variable
+    if isinstance(t, Variable):
+        return jnp.dtype(t.var_dtype)
+    v = t._value if hasattr(t, "_value") else t
+    return jnp.dtype(v.dtype)
+
+
+def _lossless_cast(src_dtype, mid_dtype) -> bool:
+    """True iff every value of src survives a round trip through mid —
+    the condition under which cast(cast(x, mid), b) == cast(x, b)."""
+    src, mid = jnp.dtype(src_dtype), jnp.dtype(mid_dtype)
+    if src == mid:
+        return True
+    try:
+        import numpy as np
+        return np.can_cast(src, mid, casting="safe")
+    except TypeError:
+        return False  # bf16 & friends numpy can't rank: don't fold
+
+
+class FoldDoubleCast(RewritePattern):
+    """cast(cast(x, a), b) -> cast(x, b), only when the inner cast is
+    lossless for x's dtype (a narrowing inner cast — f32->f16->f32,
+    float->int truncation — changes values and must be kept)."""
+
+    root_ops = ("cast",)
+
+    def match_and_rewrite(self, node, rw) -> bool:
+        from ..static import Variable
+        src = node.inputs[0]
+        if not isinstance(src, Variable):
+            return False
+        src = rw.ws.resolve(src)
+        if not isinstance(src, Variable):
+            return False
+        producer = rw.producer_of(src)
+        if producer is None or producer.op_name != "cast":
+            return False
+        inner_src = producer.inputs[0]
+        if isinstance(inner_src, Variable):
+            inner_src = rw.ws.resolve(inner_src)
+            if not isinstance(inner_src, Variable) and not hasattr(
+                    inner_src, "dtype"):
+                return False
+        if not _lossless_cast(_dtype_of(inner_src), _dtype_of(src)):
+            return False
+        node.inputs[0] = producer.inputs[0]
+        rw.changed = True
+        return True
+
+
+class DropIdentityCast(RewritePattern):
+    """cast(x, dtype_of_x) -> x."""
+
+    root_ops = ("cast",)
+
+    def match_and_rewrite(self, node, rw) -> bool:
+        from ..static import Variable
+        src = node.inputs[0]
+        if src is None:
+            return False
+        if isinstance(src, Variable):
+            resolved = rw.ws.resolve(src)
+            if not isinstance(resolved, Variable):
+                return False
+        if jnp.dtype(node.attrs.get("dtype")) != _dtype_of(
+                rw.ws.resolve(src) if isinstance(src, Variable) else src):
+            return False
+        rw.replace_op(node, [src])
+        return True
+
+
+class FuseScaleScale(RewritePattern):
+    """scale(scale(x, s1), s2) with zero biases -> scale(x, s1*s2)."""
+
+    root_ops = ("scale",)
+
+    def match_and_rewrite(self, node, rw) -> bool:
+        from ..static import Variable
+        if node.attrs.get("bias", 0.0) != 0.0:
+            return False
+        src = node.inputs[0]
+        if not isinstance(src, Variable):
+            return False
+        src = rw.ws.resolve(src)
+        producer = rw.producer_of(src)
+        if (producer is None or producer.op_name != "scale"
+                or producer.attrs.get("bias", 0.0) != 0.0):
+            return False
+        node.inputs[0] = producer.inputs[0]
+        node.attrs["scale"] = (node.attrs.get("scale", 1.0)
+                               * producer.attrs.get("scale", 1.0))
+        rw.changed = True
+        return True
+
+
+def default_pass_manager(amp: bool = False):
+    """The standard static-compile pipeline (the role of
+    executor.py _add_feed_fetch_ops + pir pass registry defaults)."""
+    from .pass_base import PassManager
+    passes = [
+        ConstantFoldingPass(),
+        PatternRewriter([FoldDoubleCast(), DropIdentityCast(),
+                         FuseScaleScale()]),
+        CommonSubexpressionEliminationPass(),
+        DeadCodeEliminationPass(),
+    ]
+    if amp:
+        passes.insert(0, AutoMixedPrecisionPass())
+    return PassManager(passes, iterate_to_fixpoint=True, max_iters=4)

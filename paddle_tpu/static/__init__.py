@@ -189,33 +189,49 @@ class Executor:
         self._cache: Dict[Any, Any] = {}
 
     def run(self, program: Optional[Program] = None, feed=None,
-            fetch_list=None, return_numpy=True):
+            fetch_list=None, return_numpy=True, extra_passes=None):
         program = program or default_main_program()
         feed = feed or {}
         fetch_list = fetch_list or []
         if not program.ops and not fetch_list:
             return []   # startup program: parameters already initialized
 
-        key = (program.id, program._version,
+        from .._core.flags import get_flags
+        passes_on = get_flags(
+            "FLAGS_apply_ir_passes")["FLAGS_apply_ir_passes"]
+        key = (program.id, program._version, passes_on,
                tuple(sorted(feed.keys())),
-               tuple(id(v) for v in fetch_list))
-        fn = self._cache.get(key)
+               tuple(id(v) for v in fetch_list),
+               tuple(id(p) for p in (extra_passes or ())))
+        entry = self._cache.get(key)
+        fn = entry[0] if entry else None
         if fn is None:
-            fn = jax.jit(self._build_callable(program, list(feed.keys()),
+            # compile-time pass pipeline on a workspace copy (the pir
+            # PassManager stage of executor.py _ExecutorCache); the
+            # recorded Program itself is never mutated
+            from ..ir import Workspace, default_pass_manager
+            ws = Workspace(program)
+            protected = [v for v in fetch_list if isinstance(v, Variable)]
+            if passes_on:
+                default_pass_manager().run(ws, protected=protected)
+            for p in (extra_passes or ()):
+                p.run(ws, frozenset(id(v) for v in protected))
+            fn = jax.jit(self._build_callable(ws, list(feed.keys()),
                                               fetch_list))
-            self._cache[key] = fn
+            # keep the pass objects alive alongside the entry so the
+            # id()-based key can't alias a freed pass object
+            self._cache[key] = (fn, tuple(extra_passes or ()))
         feed_vals = [jnp.asarray(feed[k]) for k in sorted(feed.keys())]
         outs = fn(*feed_vals)
         if return_numpy:
             return [np.asarray(o) for o in outs]
         return [Tensor(o) for o in outs]
 
-    def _build_callable(self, program: Program, feed_names: List[str],
-                        fetch_list):
+    def _build_callable(self, ws, feed_names: List[str], fetch_list):
         def replay(*feed_vals):
             env: Dict[int, Any] = {}
             by_name = dict(zip(sorted(feed_names), feed_vals))
-            for var in program.feed_vars:
+            for var in ws.feed_vars:
                 if var.name in by_name:
                     env[id(var)] = by_name[var.name]
 
@@ -223,19 +239,29 @@ class Executor:
                 if t is None:
                     return None
                 if isinstance(t, Variable):
-                    if id(t) not in env:
-                        raise KeyError(
-                            f"feed missing for var '{t.name}'")
-                    return env[id(t)]
-                return t._value   # captured dygraph tensor (parameter)
+                    t = ws.resolve(t)   # CSE may have aliased it
+                if isinstance(t, Variable):
+                    if id(t) in env:
+                        return env[id(t)]
+                    if id(t) in ws.const_env:  # folded to a constant
+                        return ws.const_env[id(t)]
+                    raise KeyError(f"feed missing for var '{t.name}'")
+                if hasattr(t, "_value"):
+                    return t._value   # captured dygraph tensor (parameter)
+                return t              # constant injected by a pass
 
-            for node in program.ops:
+            for node in ws.ops:
                 op = get_op(node.op_name)
                 vals = [value_of(t) for t in node.inputs]
                 out = op.fn(*vals, **node.attrs)
                 outs = jax.tree_util.tree_leaves(
                     out if op.multi_output else (out,))
                 for var, o in zip(node.outputs, outs):
+                    ns = ws.shardings.get(id(var))
+                    if ns is not None:
+                        # completion-pass placement: GSPMD inserts the
+                        # collectives to honor it
+                        o = jax.lax.with_sharding_constraint(o, ns)
                     env[id(var)] = o
             return tuple(value_of(v) for v in fetch_list)
 

@@ -1,0 +1,242 @@
+"""IR pass infrastructure tests (analog of the reference's test/ir/ pass
+suites: constant_folding, CSE, DCE, AMP pass program-diff tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+from paddle_tpu.ir import (
+    AutoMixedPrecisionPass,
+    CommonSubexpressionEliminationPass,
+    ConstantFoldingPass,
+    DeadCodeEliminationPass,
+    PassManager,
+    PatternRewriter,
+    Workspace,
+    default_pass_manager,
+)
+from paddle_tpu.ir.passes import (
+    DropIdentityCast,
+    FoldDoubleCast,
+    FuseScaleScale,
+)
+
+
+@pytest.fixture
+def static_mode():
+    static.enable_static()
+    yield
+    static.disable_static()
+
+
+def _record(fn, feeds):
+    """Record fn into a fresh Program; returns (program, feed_vars, outs)."""
+    prog = static.Program()
+    with static.program_guard(prog):
+        vars_ = {name: static.data(name, shape, dtype)
+                 for name, (shape, dtype) in feeds.items()}
+        outs = fn(vars_)
+    return prog, vars_, outs
+
+
+class TestConstantFolding:
+    def test_folds_constant_chain(self, static_mode):
+        def build(v):
+            a = paddle.to_tensor(np.ones((2, 2), np.float32))
+            b = a + a            # constant: foldable
+            return v["x"] + b
+
+        prog, _, out = _record(build, {"x": ([2, 2], "float32")})
+        ws = Workspace(prog)
+        assert len(ws.ops) == 2
+        changed = ConstantFoldingPass().run(ws, frozenset())
+        assert changed
+        assert len(ws.ops) == 1  # only x + const remains
+
+    def test_numerics_unchanged(self, static_mode):
+        def build(v):
+            c = paddle.to_tensor(np.full((3,), 2.0, np.float32))
+            return (v["x"] * (c + c)) - c
+
+        prog, _, out = _record(build, {"x": ([3], "float32")})
+        exe = static.Executor()
+        x = np.array([1.0, 2.0, 3.0], np.float32)
+        (res,) = exe.run(prog, feed={"x": x}, fetch_list=[out])
+        np.testing.assert_allclose(res, x * 4.0 - 2.0, rtol=1e-6)
+
+
+class TestDCE:
+    def test_removes_unfetched_branch(self, static_mode):
+        def build(v):
+            used = v["x"] + 1.0
+            _unused = v["x"] * 123.0   # dead: never fetched
+            return used
+
+        prog, _, out = _record(build, {"x": ([2], "float32")})
+        ws = Workspace(prog)
+        n_before = len(ws.ops)
+        changed = DeadCodeEliminationPass().run(
+            ws, frozenset([id(out)]))
+        assert changed
+        assert len(ws.ops) < n_before
+        assert all(n.op_name != "multiply" for n in ws.ops)
+
+    def test_keeps_transitive_deps(self, static_mode):
+        def build(v):
+            a = v["x"] + 1.0
+            b = a * 2.0
+            return b
+
+        prog, _, out = _record(build, {"x": ([2], "float32")})
+        ws = Workspace(prog)
+        DeadCodeEliminationPass().run(ws, frozenset([id(out)]))
+        assert len(ws.ops) == 2
+
+
+class TestCSE:
+    def test_dedupes_identical_ops(self, static_mode):
+        def build(v):
+            a = v["x"] + 1.0
+            b = v["x"] + 1.0   # identical
+            return a * b
+
+        prog, _, out = _record(build, {"x": ([2], "float32")})
+        ws = Workspace(prog)
+        changed = CommonSubexpressionEliminationPass().run(
+            ws, frozenset([id(out)]))
+        assert changed
+        adds = [n for n in ws.ops if n.op_name == "add"]
+        assert len(adds) == 1
+
+    def test_random_ops_not_deduped(self, static_mode):
+        # impure ops (dropout/random family) must never be deduped even
+        # with identical inputs/attrs — build the nodes directly since
+        # creation ops execute eagerly rather than recording
+        def build(v):
+            return v["x"] + 1.0
+
+        prog, vars_, out = _record(build, {"x": ([2, 2], "float32")})
+        x = vars_["x"]
+        n1 = static.OpNode("dropout_rng", {"p": 0.5}, [x],
+                           [static.Variable("d1", [2, 2], "float32", prog)])
+        n2 = static.OpNode("dropout_rng", {"p": 0.5}, [x],
+                           [static.Variable("d2", [2, 2], "float32", prog)])
+        prog.ops += [n1, n2]
+        ws = Workspace(prog)
+        CommonSubexpressionEliminationPass().run(ws, frozenset([id(out)]))
+        impure = [n for n in ws.ops if n.op_name == "dropout_rng"]
+        assert len(impure) == 2
+
+    def test_cse_numerics_via_executor(self, static_mode):
+        def build(v):
+            a = v["x"] + 1.0
+            b = v["x"] + 1.0
+            return a * b
+
+        prog, _, out = _record(build, {"x": ([2], "float32")})
+        exe = static.Executor()
+        x = np.array([2.0, 3.0], np.float32)
+        (res,) = exe.run(prog, feed={"x": x}, fetch_list=[out])
+        np.testing.assert_allclose(res, (x + 1) ** 2, rtol=1e-6)
+
+
+class TestPatterns:
+    def test_lossless_double_cast_folded(self, static_mode):
+        def build(v):
+            y = v["x"].cast("float32")   # widening: lossless for f16
+            return y.cast("float16")
+
+        prog, _, out = _record(build, {"x": ([2], "float16")})
+        ws = Workspace(prog)
+        pm = PassManager([
+            PatternRewriter([FoldDoubleCast(), DropIdentityCast()]),
+            DeadCodeEliminationPass()],
+            iterate_to_fixpoint=True)
+        pm.run(ws, protected=[out])
+        # cast(cast(x_f16, f32), f16) -> cast(x, f16) -> dropped (identity)
+        assert all(n.op_name != "cast" for n in ws.ops)
+
+    def test_narrowing_double_cast_kept(self, static_mode):
+        # f32 -> f16 -> f32 rounds values; folding would change numerics
+        def build(v):
+            return v["x"].cast("float16").cast("float32")
+
+        prog, _, out = _record(build, {"x": ([2], "float32")})
+        ws = Workspace(prog)
+        pm = PassManager([
+            PatternRewriter([FoldDoubleCast(), DropIdentityCast()]),
+            DeadCodeEliminationPass()],
+            iterate_to_fixpoint=True)
+        pm.run(ws, protected=[out])
+        casts = [n for n in ws.ops if n.op_name == "cast"]
+        assert len(casts) == 2
+
+    def test_scale_scale_fused(self, static_mode):
+        def build(v):
+            return v["x"].scale(2.0).scale(3.0)
+
+        prog, _, out = _record(build, {"x": ([2], "float32")})
+        ws = Workspace(prog)
+        pm = PassManager([PatternRewriter([FuseScaleScale()]),
+                          DeadCodeEliminationPass()],
+                         iterate_to_fixpoint=True)
+        pm.run(ws, protected=[out])
+        scales = [n for n in ws.ops if n.op_name == "scale"]
+        assert len(scales) == 1
+        assert scales[0].attrs["scale"] == pytest.approx(6.0)
+
+    def test_fused_numerics(self, static_mode):
+        def build(v):
+            return v["x"].scale(2.0).scale(3.0)
+
+        prog, _, out = _record(build, {"x": ([2], "float32")})
+        exe = static.Executor()
+        x = np.array([1.0, -1.0], np.float32)
+        (res,) = exe.run(prog, feed={"x": x}, fetch_list=[out])
+        np.testing.assert_allclose(res, x * 6.0, rtol=1e-6)
+
+
+class TestAMPPass:
+    def test_matmul_inputs_cast_to_bf16(self, static_mode):
+        def build(v):
+            w = paddle.to_tensor(np.ones((4, 4), np.float32))
+            return paddle.matmul(v["x"], w)
+
+        prog, _, out = _record(build, {"x": ([2, 4], "float32")})
+        ws = Workspace(prog)
+        changed = AutoMixedPrecisionPass().run(ws, frozenset([id(out)]))
+        assert changed
+        assert any(n.op_name == "cast" for n in ws.ops)
+        import jax.numpy as jnp
+        mm = [n for n in ws.ops if n.op_name == "matmul"][0]
+        # constant weight cast eagerly; variable input via cast node
+        w_in = mm.inputs[1]
+        assert (w_in.dtype if hasattr(w_in, "dtype")
+                else w_in._value.dtype) == jnp.bfloat16
+
+
+class TestEndToEnd:
+    def test_full_pipeline_matches_eager(self, static_mode):
+        def build(v):
+            c = paddle.to_tensor(np.full((4,), 0.5, np.float32))
+            a = v["x"] * (c + c)        # foldable subexpr
+            b = v["x"] * (c + c)        # CSE twin
+            dead = v["x"] - 42.0        # dead
+            return a + b
+
+        prog, _, out = _record(build, {"x": ([4], "float32")})
+        exe = static.Executor()
+        x = np.arange(4, dtype=np.float32)
+        (res,) = exe.run(prog, feed={"x": x}, fetch_list=[out])
+        np.testing.assert_allclose(res, 2 * x, rtol=1e-6)
+
+    def test_pass_stats_recorded(self, static_mode):
+        def build(v):
+            return v["x"] + 1.0
+
+        prog, _, out = _record(build, {"x": ([2], "float32")})
+        pm = default_pass_manager()
+        pm.run(Workspace(prog), protected=[out])
+        assert pm.stats
+        names = {s["pass"] for s in pm.stats}
+        assert "dead_code_elimination" in names

@@ -56,12 +56,13 @@ class TestMatmul:
 class TestEmbedding:
     def test_vocab_parallel_partial(self):
         # weight vocab-sharded on axis 0 -> output partial(sum) on axis0
-        (ii, wi), (out,) = R.resolve("embedding", [A([-1, -1]), A([0, -1])])
+        # (op arg order is (weight, ids))
+        (wi, ii), (out,) = R.resolve("embedding", [A([0, -1]), A([-1, -1])])
         assert out.dims_mapping == [-1, -1, -1]
         assert out.partial_status == {0: "sum"}
 
     def test_hidden_shard_flows(self):
-        (ii, wi), (out,) = R.resolve("embedding", [A([0, -1]), A([-1, 1])])
+        (wi, ii), (out,) = R.resolve("embedding", [A([-1, 1]), A([0, -1])])
         assert out.dims_mapping == [0, -1, 1]
         assert out.partial_status == {}
 
@@ -195,6 +196,17 @@ class TestNormAndSoftmax:
         v = A([0, -1, 1, -1])
         inferred, (out,) = R.resolve("flash_attention", [q, k, v])
         assert out.dims_mapping == [0, -1, 1, -1]
+
+    def test_flash_attention_kv_seq_never_partial(self):
+        # softmax is not sum-decomposable over kv-seq: a sharded k/v seq
+        # must come back as a gather (replicated), never Partial(sum)
+        q = A([-1, -1, -1, -1])
+        k = A([-1, 0, -1, -1])
+        v = A([-1, 0, -1, -1])
+        (qi, ki, vi), (out,) = R.resolve("flash_attention", [q, k, v])
+        assert out.partial_status == {}
+        assert ki.dims_mapping == [-1, -1, -1, -1]
+        assert vi.dims_mapping == [-1, -1, -1, -1]
 
 
 # ------------------------------------------------------------ conversions
