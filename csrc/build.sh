@@ -6,6 +6,6 @@ cd "$(dirname "$0")"
 mkdir -p build
 g++ -std=c++17 -O2 -fPIC -shared -pthread \
     -fvisibility=hidden \
-    pt_error.cc tcp_store.cc allocator.cc data_feed.cc flags.cc \
+    pt_error.cc tcp_store.cc allocator.cc data_feed.cc flags.cc comm_context.cc \
     -o build/libpaddle_tpu_rt.so
 echo "built csrc/build/libpaddle_tpu_rt.so"

@@ -70,6 +70,18 @@ from . import text  # noqa: E402
 from .framework import save, load  # noqa: E402
 
 
+def DataParallel(layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+    """paddle.DataParallel (python/paddle/distributed/parallel.py:219);
+    thin re-export so the top-level name matches the reference."""
+    from .distributed.parallel import DataParallel as _DP
+    return _DP(layers, strategy=strategy,
+               comm_buffer_size=comm_buffer_size,
+               last_comm_buffer_size=last_comm_buffer_size,
+               find_unused_parameters=find_unused_parameters, group=group)
+
+
 def disable_static(place=None):
     from . import static as _static
     _static.disable_static()

@@ -20,7 +20,7 @@ _CSRC = os.path.join(os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__)))), "csrc")
 _SO = os.path.join(_CSRC, "build", "libpaddle_tpu_rt.so")
 _SOURCES = ("pt_error.cc", "tcp_store.cc", "allocator.cc", "data_feed.cc",
-            "flags.cc", "pt_common.h")
+            "flags.cc", "comm_context.cc", "pt_common.h")
 
 
 def _needs_build() -> bool:
@@ -89,6 +89,33 @@ def _bind(lib):
     lib.pt_flag_set.argtypes = [c.c_char_p, c.c_char_p]
     lib.pt_flag_get.restype = c.c_int64
     lib.pt_flag_get.argtypes = [c.c_char_p, c.c_char_p, c.c_int64]
+
+    lib.ptcc_create.restype = c.c_void_p
+    lib.ptcc_create.argtypes = [c.c_int, c.c_int]
+    lib.ptcc_listen_port.restype = c.c_int
+    lib.ptcc_listen_port.argtypes = [c.c_void_p]
+    lib.ptcc_connect.restype = c.c_int
+    lib.ptcc_connect.argtypes = [c.c_void_p, c.c_char_p]
+    lib.ptcc_all_reduce.restype = c.c_int
+    lib.ptcc_all_reduce.argtypes = [c.c_void_p, c.c_void_p, c.c_int64,
+                                    c.c_int, c.c_int]
+    lib.ptcc_reduce_scatter.restype = c.c_int
+    lib.ptcc_reduce_scatter.argtypes = [c.c_void_p, c.c_void_p,
+                                        c.c_void_p, c.c_int64, c.c_int,
+                                        c.c_int]
+    lib.ptcc_all_gather.restype = c.c_int
+    lib.ptcc_all_gather.argtypes = [c.c_void_p, c.c_void_p, c.c_void_p,
+                                    c.c_int64]
+    lib.ptcc_broadcast.restype = c.c_int
+    lib.ptcc_broadcast.argtypes = [c.c_void_p, c.c_void_p, c.c_int64,
+                                   c.c_int]
+    lib.ptcc_send.restype = c.c_int
+    lib.ptcc_send.argtypes = [c.c_void_p, c.c_void_p, c.c_int64, c.c_int]
+    lib.ptcc_recv.restype = c.c_int
+    lib.ptcc_recv.argtypes = [c.c_void_p, c.c_void_p, c.c_int64, c.c_int]
+    lib.ptcc_barrier.restype = c.c_int
+    lib.ptcc_barrier.argtypes = [c.c_void_p]
+    lib.ptcc_destroy.argtypes = [c.c_void_p]
     return lib
 
 

@@ -63,6 +63,8 @@ class ProcessGroup:
     (process_group.h contract).
     """
 
+    _cc_instances = {}  # gid -> count (deterministic across ranks)
+
     def __init__(self, store, global_rank: int, ranks: Sequence[int],
                  gid: int = 0, timeout: Optional[float] = None):
         self.store = store
@@ -76,6 +78,27 @@ class ProcessGroup:
         self._seq = 0
         self._barrier_round = 0
         self._p2p_seq = {}  # (src_grank, dst_grank) -> seq
+        # native socket transport (csrc/comm_context.cc): ring collectives
+        # over a direct TCP mesh instead of KV-store hops. Group creation
+        # is collective and ordered, so the per-gid instance counter
+        # agrees across ranks (comm_context_manager.h contract). The
+        # transport choice itself is negotiated collectively — all ranks
+        # or none — and a second mesh isolates unordered P2P traffic from
+        # the ring collectives' byte streams.
+        self._cc = None
+        self._ccp = None
+        import os
+        if (self.rank >= 0 and self.size > 1
+                and os.environ.get("PADDLE_NATIVE_COMM", "1") != "0"):
+            inst = ProcessGroup._cc_instances.get(gid, 0)
+            ProcessGroup._cc_instances[gid] = inst + 1
+            from .comm_context import CommContext
+            self._cc = CommContext.create_negotiated(
+                store, self.rank, self.size, key=f"__cc/{gid}/{inst}")
+            if self._cc is not None:
+                self._ccp = CommContext(
+                    store, self.rank, self.size,
+                    key=f"__cc/{gid}/{inst}/p2p")
 
     # ------------------------------------------------------------ plumbing
     def _next(self) -> str:
@@ -97,8 +120,41 @@ class ProcessGroup:
                 self.store.delete(k)
             self.store.delete(f"{base}/__done")
 
+    # ------------------------------------------------- native transport
+    def _cc_send_blob(self, dst: int, blob: bytes, ctx=None) -> None:
+        cc = ctx or self._cc
+        cc.send(np.array([len(blob)], np.int64), dst)
+        cc.send(np.frombuffer(blob, np.uint8), dst)
+
+    def _cc_recv_blob(self, src: int, ctx=None) -> bytes:
+        cc = ctx or self._cc
+        ln = np.empty(1, np.int64)
+        cc.recv_into(ln, src)
+        buf = np.empty(int(ln[0]), np.uint8)
+        cc.recv_into(buf, src)
+        return buf.tobytes()
+
+    def _cc_all_gather_blobs(self, blob: bytes) -> List[bytes]:
+        """Variable-size all-gather: ring-gather the lengths, pad to max,
+        ring-gather the payloads."""
+        lens = self._cc.all_gather(np.array([len(blob)], np.int64))
+        mx = int(max(int(ln[0]) for ln in lens))
+        blobs = self._cc.all_gather_bytes(blob + b"\0" * (mx - len(blob)))
+        return [blobs[r][:int(lens[r][0])] for r in range(self.size)]
+
+    def _cc_broadcast_blob(self, blob, root: int) -> bytes:
+        ln = np.array([len(blob) if blob is not None else 0], np.int64)
+        ln_raw = self._cc.broadcast_bytes(
+            ln.tobytes() if self.rank == root else None, root, 8)
+        n = int(np.frombuffer(ln_raw, np.int64)[0])
+        return self._cc.broadcast_bytes(
+            blob if self.rank == root else None, root, n)
+
     # ---------------------------------------------------------- collectives
     def all_gather(self, arr: np.ndarray) -> List[np.ndarray]:
+        if self._cc is not None:
+            return [_decode(b)
+                    for b in self._cc_all_gather_blobs(_encode(arr))]
         base = self._next()
         self._publish(base, arr)
         out = [self._fetch(base, r) for r in range(self.size)]
@@ -106,6 +162,8 @@ class ProcessGroup:
         return out
 
     def all_reduce(self, arr: np.ndarray, op: str = "sum") -> np.ndarray:
+        if self._cc is not None:
+            return self._cc.all_reduce(np.asarray(arr), op)
         parts = self.all_gather(arr)
         fn = _REDUCE_FNS[op]
         acc = parts[0].astype(np.float64) if op in ("sum", "avg", "prod") \
@@ -117,6 +175,9 @@ class ProcessGroup:
         return np.asarray(acc, dtype=arr.dtype)
 
     def broadcast(self, arr: np.ndarray, src: int) -> np.ndarray:
+        if self._cc is not None:
+            blob = _encode(np.asarray(arr)) if self.rank == src else None
+            return _decode(self._cc_broadcast_blob(blob, src))
         base = self._next()
         if self.rank == src:
             self._publish(base, arr, tag="src")
@@ -125,6 +186,9 @@ class ProcessGroup:
         return out
 
     def reduce(self, arr: np.ndarray, dst: int, op: str = "sum"):
+        if self._cc is not None:
+            out = self._cc.all_reduce(np.asarray(arr), op)
+            return out if self.rank == dst else arr
         # all ranks publish once; only dst fetches + reduces
         # (process_group.h Reduce semantics, O(n*M) store traffic)
         base = self._next()
@@ -148,6 +212,14 @@ class ProcessGroup:
                        op: str = "sum") -> np.ndarray:
         """parts: one array per group rank; returns the reduction of every
         rank's parts[self.rank]."""
+        if self._cc is not None and len(
+                {np.asarray(p).size for p in parts}) == 1:
+            # the ring algorithm needs equal chunks; unequal parts (legal
+            # in the API) take the store path below
+            flat = np.concatenate(
+                [np.ascontiguousarray(p).reshape(-1) for p in parts])
+            out = self._cc.reduce_scatter(flat, op)
+            return out.reshape(np.asarray(parts[self.rank]).shape)
         base = self._next()
         for r, p in enumerate(parts):
             self._publish(base, np.asarray(p), tag=f"{self.rank}_{r}")
@@ -164,6 +236,13 @@ class ProcessGroup:
 
     def scatter(self, parts: Optional[Sequence[np.ndarray]],
                 src: int) -> np.ndarray:
+        if self._cc is not None:
+            if self.rank == src:
+                for r in range(self.size):
+                    if r != src:
+                        self._cc_send_blob(r, _encode(np.asarray(parts[r])))
+                return np.asarray(parts[src])
+            return _decode(self._cc_recv_blob(src))
         base = self._next()
         if self.rank == src:
             for r, p in enumerate(parts):
@@ -173,6 +252,13 @@ class ProcessGroup:
         return out
 
     def gather(self, arr: np.ndarray, dst: int):
+        if self._cc is not None:
+            if self.rank != dst:
+                self._cc_send_blob(dst, _encode(np.asarray(arr)))
+                return None
+            return [np.asarray(arr) if r == dst
+                    else _decode(self._cc_recv_blob(r))
+                    for r in range(self.size)]
         base = self._next()
         self._publish(base, arr)
         out = None
@@ -182,6 +268,32 @@ class ProcessGroup:
         return out
 
     def all_to_all(self, parts: Sequence[np.ndarray]) -> List[np.ndarray]:
+        if self._cc is not None:
+            # step-wise permutation exchange; the paired send runs on a
+            # thread (ctypes releases the GIL) so opposite directions of
+            # each step proceed concurrently and cycles can't deadlock
+            import threading
+            out: List[Optional[np.ndarray]] = [None] * self.size
+            out[self.rank] = np.asarray(parts[self.rank])
+            for step in range(1, self.size):
+                dst = (self.rank + step) % self.size
+                src = (self.rank - step) % self.size
+                blob = _encode(np.asarray(parts[dst]))
+                send_err = []
+
+                def _send():
+                    try:
+                        self._cc_send_blob(dst, blob)
+                    except Exception as e:  # surface on the main thread
+                        send_err.append(e)
+
+                t = threading.Thread(target=_send)
+                t.start()
+                out[src] = _decode(self._cc_recv_blob(src))
+                t.join()
+                if send_err:
+                    raise send_err[0]
+            return out
         base = self._next()
         for r, p in enumerate(parts):
             self._publish(base, np.asarray(p), tag=f"{self.rank}_{r}")
@@ -197,6 +309,12 @@ class ProcessGroup:
         """dst is a group rank. Keyed by an independent per-(src,dst)
         sequence so P2P does not have to be globally ordered across the
         group (p2p_communication.py analog)."""
+        if self._ccp is not None:
+            # dedicated p2p mesh: unordered-vs-collectives traffic never
+            # shares a byte stream with the ring collectives
+            self._cc_send_blob(dst, _encode(np.asarray(arr)),
+                               ctx=self._ccp)
+            return
         pair = (self.rank, dst)
         seq = self._p2p_seq.get(pair, 0)
         self._p2p_seq[pair] = seq + 1
@@ -204,6 +322,8 @@ class ProcessGroup:
         self.store.set(key, _encode(np.asarray(arr)))
 
     def recv(self, src: int) -> np.ndarray:
+        if self._ccp is not None:
+            return _decode(self._cc_recv_blob(src, ctx=self._ccp))
         pair = (src, self.rank)
         seq = self._p2p_seq.get(pair, 0)
         self._p2p_seq[pair] = seq + 1
@@ -217,6 +337,9 @@ class ProcessGroup:
         """Group barrier: counts to the GROUP size (store.barrier counts
         to the global world size and would deadlock on subgroups).
         Reusable via a local round counter; last rank out cleans up."""
+        if self._cc is not None:
+            self._cc.barrier()
+            return
         rnd = self._barrier_round
         self._barrier_round += 1
         base = f"__pg/{self.gid}/bar/{rnd}"
@@ -231,6 +354,9 @@ class ProcessGroup:
 
     def broadcast_object(self, obj, src: int):
         import pickle
+        if self._cc is not None:
+            blob = pickle.dumps(obj) if self.rank == src else None
+            return pickle.loads(self._cc_broadcast_blob(blob, src))
         base = self._next()
         if self.rank == src:
             self.store.set(f"{base}/obj", pickle.dumps(obj))
@@ -240,6 +366,9 @@ class ProcessGroup:
 
     def all_gather_object(self, obj) -> list:
         import pickle
+        if self._cc is not None:
+            return [pickle.loads(b) for b in
+                    self._cc_all_gather_blobs(pickle.dumps(obj))]
         base = self._next()
         self.store.set(f"{base}/{self.rank}", pickle.dumps(obj))
         out = [pickle.loads(self.store.get(f"{base}/{r}"))

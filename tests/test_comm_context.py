@@ -1,0 +1,181 @@
+"""Native socket collective engine tests (csrc/comm_context.cc).
+
+Spawns real processes, builds a CommContext over the TCPStore rendezvous
+and checks every ring collective against NumPy — including payloads well
+past kernel socket buffers (the duplex interleave) and bf16 upcast
+reduction. One extra ProcessGroup run forces PADDLE_NATIVE_COMM=0 so the
+store fallback stays covered. Mirrors the reference's comm-context layer
+tests under test/cpp/phi/core/distributed/."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORLD = 3
+
+
+def _worker():
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    world = int(os.environ["PADDLE_TRAINERS_NUM"])
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from paddle_tpu.distributed.store import TCPStore
+    from paddle_tpu.distributed.comm_context import CommContext
+
+    store = TCPStore(os.environ["MASTER_ADDR"],
+                     int(os.environ["MASTER_PORT"]),
+                     is_master=(rank == 0), world_size=world)
+    cc = CommContext(store, rank, world, key="__cc_test/0")
+
+    # --- all_reduce, big payload (4 MB > socket buffers -> duplex) ---
+    n = 1 << 20
+    big = np.full(n, float(rank + 1), np.float32)
+    out = cc.all_reduce(big, "sum")
+    np.testing.assert_allclose(
+        out, np.full(n, sum(range(1, world + 1)), np.float32))
+
+    # --- all_reduce ops on int64 ---
+    v = np.arange(10, dtype=np.int64) + rank
+    np.testing.assert_array_equal(
+        cc.all_reduce(v, "max"), np.arange(10, dtype=np.int64) + world - 1)
+    np.testing.assert_array_equal(
+        cc.all_reduce(v, "min"), np.arange(10, dtype=np.int64))
+
+    # --- bf16 reduction upcasts + restores ---
+    import ml_dtypes
+    b = np.full(8, 0.5, ml_dtypes.bfloat16) * (rank + 1)
+    rb = cc.all_reduce(b, "sum")
+    assert rb.dtype == b.dtype
+    np.testing.assert_allclose(
+        rb.astype(np.float32),
+        np.full(8, 0.5 * sum(range(1, world + 1)), np.float32))
+
+    # --- reduce_scatter ---
+    flat = np.arange(world * 6, dtype=np.float32) + 100 * rank
+    part = cc.reduce_scatter(flat, "sum")
+    expect = sum(np.arange(world * 6, dtype=np.float32) + 100 * r
+                 for r in range(world))
+    np.testing.assert_allclose(
+        part, expect[rank * 6:(rank + 1) * 6])
+
+    # --- all_gather ---
+    outs = cc.all_gather(np.full((2, 2), rank, np.int32))
+    for r, o in enumerate(outs):
+        np.testing.assert_array_equal(o, np.full((2, 2), r, np.int32))
+
+    # --- broadcast (root 1) ---
+    payload = b"hello-from-1" if rank == 1 else None
+    got = cc.broadcast_bytes(payload, 1, 12)
+    assert got == b"hello-from-1"
+
+    # --- p2p ring: send to next, recv from prev ---
+    nxt, prv = (rank + 1) % world, (rank - 1) % world
+    msg = np.array([rank * 11.0], np.float64)
+    if rank % 2 == 0:
+        cc.send(msg, nxt)
+        got = cc.recv_into(np.empty(1, np.float64), prv)
+    else:
+        got = cc.recv_into(np.empty(1, np.float64), prv)
+        cc.send(msg, nxt)
+    assert got[0] == prv * 11.0
+
+    # --- barrier ---
+    for _ in range(3):
+        cc.barrier()
+
+    print(f"CCWORKER-{rank}-OK", flush=True)
+
+
+def _spawn(extra_env=None):
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    procs = []
+    for rank in range(WORLD):
+        env = dict(os.environ)
+        env.update({
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(WORLD),
+            "MASTER_ADDR": "localhost",
+            "MASTER_PORT": str(port),
+            "JAX_PLATFORMS": "cpu",
+            "PT_CC_WORKER": "1",
+            "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+        })
+        env.update(extra_env or {})
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    outs = []
+    try:
+        for rank, p in enumerate(procs):
+            out, _ = p.communicate(timeout=240)
+            outs.append((rank, p.returncode, out))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    return outs
+
+
+def test_native_comm_context():
+    for rank, rc, out in _spawn():
+        assert rc == 0, f"rank {rank} failed (rc={rc}):\n{out}"
+        assert f"CCWORKER-{rank}-OK" in out
+
+
+def test_store_fallback_still_works():
+    """PADDLE_NATIVE_COMM=0 must route ProcessGroup through the store."""
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    procs = []
+    for rank in range(2):
+        env = dict(os.environ)
+        env.update({
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": "2",
+            "MASTER_ADDR": "127.0.0.1",
+            "MASTER_PORT": str(port),
+            "JAX_PLATFORMS": "cpu",
+            "PT_CC_FALLBACK_WORKER": "1",
+            "PADDLE_NATIVE_COMM": "0",
+            "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    for rank, p in enumerate(procs):
+        out, _ = p.communicate(timeout=240)
+        assert p.returncode == 0, f"rank {rank} (rc={p.returncode}):\n{out}"
+        assert "FALLBACK-OK" in out
+
+
+def _fallback_worker():
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    dist.init_parallel_env()
+    from paddle_tpu.distributed.parallel_env import \
+        get_default_process_group
+    pg = get_default_process_group()
+    assert pg._cc is None, "native transport must be disabled"
+    t = paddle.to_tensor(np.full((3,), float(rank + 1), np.float32))
+    dist.all_reduce(t)
+    np.testing.assert_allclose(t.numpy(), np.full((3,), 3.0, np.float32))
+    print("FALLBACK-OK", flush=True)
+
+
+if __name__ == "__main__" and os.environ.get("PT_CC_WORKER") == "1":
+    _worker()
+if __name__ == "__main__" and os.environ.get(
+        "PT_CC_FALLBACK_WORKER") == "1":
+    _fallback_worker()
