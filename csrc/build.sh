@@ -6,6 +6,10 @@ cd "$(dirname "$0")"
 mkdir -p build
 g++ -std=c++17 -O2 -fPIC -shared -pthread \
     -fvisibility=hidden \
-    pt_error.cc tcp_store.cc allocator.cc data_feed.cc flags.cc comm_context.cc \
-    -o build/libpaddle_tpu_rt.so
-echo "built csrc/build/libpaddle_tpu_rt.so"
+    pt_error.cc tcp_store.cc allocator.cc data_feed.cc flags.cc \
+    comm_context.cc device_plugin.cc \
+    -ldl -o build/libpaddle_tpu_rt.so
+# fake custom-device plugin (contract-test backend, fake_cpu_device.h analog)
+g++ -std=c++17 -O2 -fPIC -shared \
+    fake_device.cc -o build/libpt_fake_device.so
+echo "built csrc/build/libpaddle_tpu_rt.so + libpt_fake_device.so"

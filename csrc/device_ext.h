@@ -1,0 +1,84 @@
+// Custom-device plugin C ABI.
+//
+// TPU-native analog of the reference's out-of-tree device seam
+// (paddle/phi/backends/device_ext.h:96 C_DeviceInterface — 67 C_Status
+// fn pointers incl. xccl_* collective hooks at :557-657 — loaded via
+// DeviceManager::LoadCustomRuntimeLib, device_manager.h:298). A vendor
+// ships one .so exporting PT_InitDevicePlugin; the runtime dlopens it and
+// drives devices through this table. PJRT plays this role for real TPU
+// silicon; this ABI exists for the same reasons the reference keeps one
+// anyway: fake devices for contract tests, host-staging backends, and
+// vendor fabrics that want the framework's runtime without XLA.
+//
+// All functions return PT_STATUS_OK (0) on success. Unused slots may be
+// null; the loader validates the required core set.
+#pragma once
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#define PT_DEVICE_ABI_VERSION 1
+
+typedef enum {
+  PT_STATUS_OK = 0,
+  PT_STATUS_FAILED = 1,
+  PT_STATUS_INVALID = 2,
+} PT_Status;
+
+typedef struct PT_Stream_st* PT_Stream;
+typedef struct PT_Event_st* PT_Event;
+
+typedef struct {
+  size_t abi_version;           // must be PT_DEVICE_ABI_VERSION
+  const char* device_type;      // e.g. "fake_cpu"
+
+  // ------------------------------------------------------ device control
+  PT_Status (*init)(void);
+  PT_Status (*deinit)(void);
+  PT_Status (*get_device_count)(int* count);
+  PT_Status (*set_device)(int device);
+  PT_Status (*get_device)(int* device);
+
+  // ------------------------------------------------------------- memory
+  PT_Status (*device_malloc)(int device, void** ptr, size_t size);
+  PT_Status (*device_free)(int device, void* ptr);
+  PT_Status (*memcpy_h2d)(int device, void* dst, const void* src,
+                          size_t size);
+  PT_Status (*memcpy_d2h)(int device, void* dst, const void* src,
+                          size_t size);
+  PT_Status (*memcpy_d2d)(int device, void* dst, const void* src,
+                          size_t size);
+  PT_Status (*device_mem_stats)(int device, size_t* total, size_t* free_);
+
+  // ------------------------------------------------------------ streams
+  PT_Status (*stream_create)(int device, PT_Stream* stream);
+  PT_Status (*stream_destroy)(int device, PT_Stream stream);
+  PT_Status (*stream_synchronize)(int device, PT_Stream stream);
+
+  // ------------------------------------------------------------- events
+  PT_Status (*event_create)(int device, PT_Event* event);
+  PT_Status (*event_destroy)(int device, PT_Event event);
+  PT_Status (*event_record)(int device, PT_Stream stream, PT_Event event);
+  PT_Status (*event_synchronize)(int device, PT_Event event);
+
+  // ------------------------------- collective hooks (xccl_* analog)
+  // Optional: a fabric plugin implements these; the framework routes
+  // host-driven collectives for this device type through them.
+  PT_Status (*ccl_all_reduce)(int device, void* data, size_t count,
+                              int dtype /*0=f32,1=f64,2=i32,3=i64*/,
+                              int op /*0=sum,1=max,2=min,3=prod*/);
+  PT_Status (*ccl_broadcast)(int device, void* data, size_t nbytes,
+                             int root);
+} PT_DeviceInterface;
+
+// The single symbol a plugin must export:
+//   PT_Status PT_InitDevicePlugin(PT_DeviceInterface* iface);
+typedef PT_Status (*PT_InitDevicePluginFn)(PT_DeviceInterface*);
+
+#ifdef __cplusplus
+}  // extern "C"
+#endif

@@ -1,0 +1,224 @@
+// Custom-device plugin loader + registry.
+//
+// Analog of DeviceManager::Register + LoadCustomRuntimeLib
+// (paddle/phi/backends/device_manager.h:134,298, custom_device.cc:42
+// wrapping the plugin table into a DeviceInterface). dlopens a vendor
+// .so, resolves PT_InitDevicePlugin, validates the required slots, and
+// exposes the table to Python through a flat C surface.
+#include <dlfcn.h>
+
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+
+#include "device_ext.h"
+#include "pt_common.h"
+
+namespace {
+
+struct Plugin {
+  void* dl = nullptr;
+  PT_DeviceInterface iface{};
+  bool initialized = false;
+};
+
+std::mutex g_mu;
+std::map<std::string, Plugin>& registry() {
+  static std::map<std::string, Plugin> r;
+  return r;
+}
+
+bool validate(const PT_DeviceInterface& i) {
+  return i.abi_version == PT_DEVICE_ABI_VERSION && i.device_type &&
+         i.init && i.get_device_count && i.device_malloc && i.device_free &&
+         i.memcpy_h2d && i.memcpy_d2h;
+}
+
+Plugin* find(const char* dev_type) {
+  auto it = registry().find(dev_type ? dev_type : "");
+  return it == registry().end() ? nullptr : &it->second;
+}
+
+}  // namespace
+
+// Returns the registered device_type name, or null on failure.
+PT_EXPORT const char* pt_plugin_load(const char* path) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  void* dl = dlopen(path, RTLD_NOW | RTLD_LOCAL);
+  if (!dl) {
+    pt::set_last_error(std::string("dlopen: ") + dlerror());
+    return nullptr;
+  }
+  auto init_fn = reinterpret_cast<PT_InitDevicePluginFn>(
+      dlsym(dl, "PT_InitDevicePlugin"));
+  if (!init_fn) {
+    pt::set_last_error("plugin lacks PT_InitDevicePlugin");
+    dlclose(dl);
+    return nullptr;
+  }
+  PT_DeviceInterface iface{};
+  if (init_fn(&iface) != PT_STATUS_OK || !validate(iface)) {
+    pt::set_last_error("plugin init failed or ABI invalid");
+    dlclose(dl);
+    return nullptr;
+  }
+  // duplicate check BEFORE init(): re-loading the same .so shares its
+  // globals with the live registration, so init/deinit on the duplicate
+  // would tear down the first handle's state
+  auto it = registry().find(iface.device_type);
+  if (it != registry().end()) {
+    dlclose(dl);
+    return it->second.iface.device_type;
+  }
+  if (iface.init() != PT_STATUS_OK) {
+    pt::set_last_error("plugin device init failed");
+    dlclose(dl);
+    return nullptr;
+  }
+  Plugin p;
+  p.dl = dl;
+  p.iface = iface;
+  p.initialized = true;
+  auto res = registry().emplace(iface.device_type, p);
+  return res.first->second.iface.device_type;
+}
+
+PT_EXPORT int pt_plugin_device_count(const char* dev_type) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  Plugin* p = find(dev_type);
+  if (!p) return -1;
+  int n = 0;
+  return p->iface.get_device_count(&n) == PT_STATUS_OK ? n : -1;
+}
+
+PT_EXPORT void* pt_plugin_malloc(const char* dev_type, int device,
+                                 uint64_t size) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  Plugin* p = find(dev_type);
+  if (!p) return nullptr;
+  void* ptr = nullptr;
+  if (p->iface.device_malloc(device, &ptr, size) != PT_STATUS_OK)
+    return nullptr;
+  return ptr;
+}
+
+PT_EXPORT int pt_plugin_free(const char* dev_type, int device, void* ptr) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  Plugin* p = find(dev_type);
+  return p && p->iface.device_free(device, ptr) == PT_STATUS_OK ? 0 : -1;
+}
+
+PT_EXPORT int pt_plugin_memcpy(const char* dev_type, int device, void* dst,
+                               const void* src, uint64_t size, int kind
+                               /*0=h2d,1=d2h,2=d2d*/) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  Plugin* p = find(dev_type);
+  if (!p) return -1;
+  PT_Status (*fn)(int, void*, const void*, size_t) =
+      kind == 0 ? p->iface.memcpy_h2d
+                : kind == 1 ? p->iface.memcpy_d2h : p->iface.memcpy_d2d;
+  if (!fn) return -1;
+  return fn(device, dst, src, size) == PT_STATUS_OK ? 0 : -1;
+}
+
+PT_EXPORT int pt_plugin_mem_stats(const char* dev_type, int device,
+                                  uint64_t* total, uint64_t* free_) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  Plugin* p = find(dev_type);
+  if (!p || !p->iface.device_mem_stats) return -1;
+  size_t t = 0, f = 0;
+  if (p->iface.device_mem_stats(device, &t, &f) != PT_STATUS_OK) return -1;
+  *total = t;
+  *free_ = f;
+  return 0;
+}
+
+// One stream round-trip: create, record+sync an event, destroy — the
+// contract smoke the fake-device test drives.
+PT_EXPORT int pt_plugin_stream_check(const char* dev_type, int device) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  Plugin* p = find(dev_type);
+  if (!p || !p->iface.stream_create) return -1;
+  PT_Stream s = nullptr;
+  PT_Event e = nullptr;
+  if (p->iface.stream_create(device, &s) != PT_STATUS_OK) return -1;
+  int rc = 0;
+  // every event slot is optional per the header: guard each pointer
+  if (p->iface.event_create && p->iface.event_record &&
+      p->iface.event_synchronize &&
+      (p->iface.event_create(device, &e) != PT_STATUS_OK ||
+       p->iface.event_record(device, s, e) != PT_STATUS_OK ||
+       p->iface.event_synchronize(device, e) != PT_STATUS_OK))
+    rc = -1;
+  if (e && p->iface.event_destroy) p->iface.event_destroy(device, e);
+  if (p->iface.stream_synchronize &&
+      p->iface.stream_synchronize(device, s) != PT_STATUS_OK)
+    rc = -1;
+  if (p->iface.stream_destroy) p->iface.stream_destroy(device, s);
+  return rc;
+}
+
+PT_EXPORT int pt_plugin_ccl_all_reduce(const char* dev_type, int device,
+                                       void* data, uint64_t count,
+                                       int dtype, int op) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  Plugin* p = find(dev_type);
+  if (!p || !p->iface.ccl_all_reduce) return -1;
+  return p->iface.ccl_all_reduce(device, data, count, dtype, op) ==
+                 PT_STATUS_OK
+             ? 0
+             : -1;
+}
+
+// ---------------------------------------------------------------------
+// Custom-op extension point (paddle/extension.h + custom_operator.cc
+// analog): a .so exports PT_CUSTOM_OP(name) functions operating on host
+// buffers; Python wires them in as ops (eager + jax.pure_callback under
+// jit). Signature: int fn(const void** ins, const int64_t* in_sizes,
+// int n_in, void* out, int64_t out_size)
+// where sizes are element counts of float32 buffers.
+typedef int (*PT_CustomOpFn)(const void**, const int64_t*, int, void*,
+                             int64_t);
+
+namespace {
+std::mutex g_op_mu;
+std::map<std::string, PT_CustomOpFn>& op_registry() {
+  static std::map<std::string, PT_CustomOpFn> r;
+  return r;
+}
+}  // namespace
+
+PT_EXPORT int pt_custom_op_load(const char* path, const char* name) {
+  std::lock_guard<std::mutex> lk(g_op_mu);
+  void* dl = dlopen(path, RTLD_NOW | RTLD_LOCAL);
+  if (!dl) {
+    pt::set_last_error(std::string("dlopen: ") + dlerror());
+    return -1;
+  }
+  std::string sym = std::string("pt_op_") + name;
+  auto fn = reinterpret_cast<PT_CustomOpFn>(dlsym(dl, sym.c_str()));
+  if (!fn) {
+    pt::set_last_error("custom op symbol not found: " + sym);
+    dlclose(dl);
+    return -1;
+  }
+  op_registry()[name] = fn;
+  return 0;
+}
+
+PT_EXPORT int pt_custom_op_call(const char* name, const void** ins,
+                                const int64_t* in_sizes, int n_in,
+                                void* out, int64_t out_size) {
+  PT_CustomOpFn fn = nullptr;
+  {
+    std::lock_guard<std::mutex> lk(g_op_mu);
+    auto it = op_registry().find(name);
+    if (it == op_registry().end()) {
+      pt::set_last_error("custom op not registered");
+      return -1;
+    }
+    fn = it->second;
+  }
+  return fn(ins, in_sizes, n_in, out, out_size);
+}

@@ -1,0 +1,139 @@
+// Fake custom device plugin: host memory masquerading as two devices.
+//
+// Analog of the reference's in-tree fake backend for contract tests
+// (paddle/phi/backends/custom/fake_cpu_device.h, exercised by
+// test/custom_runtime/test_custom_cpu_plugin.py): proves the plugin ABI
+// end-to-end without hardware. Built as its own .so (libpt_fake_device)
+// and dlopened through pt_plugin_load.
+#include <cstdlib>
+#include <cstring>
+#include <map>
+
+#include "device_ext.h"
+
+namespace {
+
+constexpr int kNumDevices = 2;
+int g_current = 0;
+size_t g_used[kNumDevices] = {0, 0};
+std::map<void*, std::pair<int, size_t>> g_allocs;  // ptr -> (dev, size)
+constexpr size_t kCapacity = 1ull << 30;
+
+bool bad_dev(int d) { return d < 0 || d >= kNumDevices; }
+
+PT_Status f_init(void) { return PT_STATUS_OK; }
+PT_Status f_deinit(void) { return PT_STATUS_OK; }
+
+PT_Status f_count(int* n) {
+  *n = kNumDevices;
+  return PT_STATUS_OK;
+}
+
+PT_Status f_set(int d) {
+  if (d < 0 || d >= kNumDevices) return PT_STATUS_INVALID;
+  g_current = d;
+  return PT_STATUS_OK;
+}
+
+PT_Status f_get(int* d) {
+  *d = g_current;
+  return PT_STATUS_OK;
+}
+
+PT_Status f_malloc(int d, void** ptr, size_t n) {
+  if (bad_dev(d)) return PT_STATUS_INVALID;
+  *ptr = std::malloc(n);
+  if (!*ptr) return PT_STATUS_FAILED;
+  g_used[d] += n;
+  g_allocs[*ptr] = {d, n};
+  return PT_STATUS_OK;
+}
+
+PT_Status f_free(int d, void* ptr) {
+  if (bad_dev(d)) return PT_STATUS_INVALID;
+  auto it = g_allocs.find(ptr);
+  if (it != g_allocs.end()) {
+    g_used[it->second.first] -= it->second.second;
+    g_allocs.erase(it);
+  }
+  std::free(ptr);
+  return PT_STATUS_OK;
+}
+
+PT_Status f_h2d(int, void* dst, const void* src, size_t n) {
+  std::memcpy(dst, src, n);
+  return PT_STATUS_OK;
+}
+
+PT_Status f_d2h(int, void* dst, const void* src, size_t n) {
+  std::memcpy(dst, src, n);
+  return PT_STATUS_OK;
+}
+
+PT_Status f_d2d(int, void* dst, const void* src, size_t n) {
+  std::memcpy(dst, src, n);
+  return PT_STATUS_OK;
+}
+
+PT_Status f_stats(int d, size_t* total, size_t* free_) {
+  if (bad_dev(d)) return PT_STATUS_INVALID;
+  *total = kCapacity;
+  *free_ = g_used[d] > kCapacity ? 0 : kCapacity - g_used[d];
+  return PT_STATUS_OK;
+}
+
+// streams/events: host is synchronous; handles are opaque tags
+PT_Status f_stream_create(int, PT_Stream* s) {
+  *s = reinterpret_cast<PT_Stream>(new int(0));
+  return PT_STATUS_OK;
+}
+PT_Status f_stream_destroy(int, PT_Stream s) {
+  delete reinterpret_cast<int*>(s);
+  return PT_STATUS_OK;
+}
+PT_Status f_stream_sync(int, PT_Stream) { return PT_STATUS_OK; }
+PT_Status f_event_create(int, PT_Event* e) {
+  *e = reinterpret_cast<PT_Event>(new int(0));
+  return PT_STATUS_OK;
+}
+PT_Status f_event_destroy(int, PT_Event e) {
+  delete reinterpret_cast<int*>(e);
+  return PT_STATUS_OK;
+}
+PT_Status f_event_record(int, PT_Stream, PT_Event) { return PT_STATUS_OK; }
+PT_Status f_event_sync(int, PT_Event) { return PT_STATUS_OK; }
+
+// single-process "collective": identity (world of one fake fabric)
+PT_Status f_all_reduce(int, void*, size_t, int, int) {
+  return PT_STATUS_OK;
+}
+PT_Status f_broadcast(int, void*, size_t, int) { return PT_STATUS_OK; }
+
+}  // namespace
+
+extern "C" __attribute__((visibility("default"))) PT_Status
+PT_InitDevicePlugin(PT_DeviceInterface* i) {
+  i->abi_version = PT_DEVICE_ABI_VERSION;
+  i->device_type = "fake_cpu";
+  i->init = f_init;
+  i->deinit = f_deinit;
+  i->get_device_count = f_count;
+  i->set_device = f_set;
+  i->get_device = f_get;
+  i->device_malloc = f_malloc;
+  i->device_free = f_free;
+  i->memcpy_h2d = f_h2d;
+  i->memcpy_d2h = f_d2h;
+  i->memcpy_d2d = f_d2d;
+  i->device_mem_stats = f_stats;
+  i->stream_create = f_stream_create;
+  i->stream_destroy = f_stream_destroy;
+  i->stream_synchronize = f_stream_sync;
+  i->event_create = f_event_create;
+  i->event_destroy = f_event_destroy;
+  i->event_record = f_event_record;
+  i->event_synchronize = f_event_sync;
+  i->ccl_all_reduce = f_all_reduce;
+  i->ccl_broadcast = f_broadcast;
+  return PT_STATUS_OK;
+}

@@ -66,6 +66,7 @@ from . import onnx  # noqa: E402
 from . import audio  # noqa: E402
 from . import static  # noqa: E402
 from . import text  # noqa: E402
+from . import utils  # noqa: E402
 
 from .framework import save, load  # noqa: E402
 

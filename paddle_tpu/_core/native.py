@@ -19,17 +19,20 @@ _lib_lock = threading.Lock()
 _CSRC = os.path.join(os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__)))), "csrc")
 _SO = os.path.join(_CSRC, "build", "libpaddle_tpu_rt.so")
-_SOURCES = ("pt_error.cc", "tcp_store.cc", "allocator.cc", "data_feed.cc",
-            "flags.cc", "comm_context.cc", "pt_common.h")
+def _sources():
+    # derived, not duplicated: every .cc/.h under csrc/ participates in
+    # staleness so build.sh and this list cannot silently diverge
+    import glob
+    return (glob.glob(os.path.join(_CSRC, "*.cc"))
+            + glob.glob(os.path.join(_CSRC, "*.h")))
 
 
 def _needs_build() -> bool:
     if not os.path.exists(_SO):
         return True
     so_mtime = os.path.getmtime(_SO)
-    for s in _SOURCES:
-        p = os.path.join(_CSRC, s)
-        if os.path.exists(p) and os.path.getmtime(p) > so_mtime:
+    for p in _sources():
+        if os.path.getmtime(p) > so_mtime:
             return True
     return False
 
@@ -116,6 +119,35 @@ def _bind(lib):
     lib.ptcc_barrier.restype = c.c_int
     lib.ptcc_barrier.argtypes = [c.c_void_p]
     lib.ptcc_destroy.argtypes = [c.c_void_p]
+
+    lib.pt_plugin_load.restype = c.c_char_p
+    lib.pt_plugin_load.argtypes = [c.c_char_p]
+    lib.pt_plugin_device_count.restype = c.c_int
+    lib.pt_plugin_device_count.argtypes = [c.c_char_p]
+    lib.pt_plugin_malloc.restype = c.c_void_p
+    lib.pt_plugin_malloc.argtypes = [c.c_char_p, c.c_int, c.c_uint64]
+    lib.pt_plugin_free.restype = c.c_int
+    lib.pt_plugin_free.argtypes = [c.c_char_p, c.c_int, c.c_void_p]
+    lib.pt_plugin_memcpy.restype = c.c_int
+    lib.pt_plugin_memcpy.argtypes = [c.c_char_p, c.c_int, c.c_void_p,
+                                     c.c_void_p, c.c_uint64, c.c_int]
+    lib.pt_plugin_mem_stats.restype = c.c_int
+    lib.pt_plugin_mem_stats.argtypes = [c.c_char_p, c.c_int,
+                                        c.POINTER(c.c_uint64),
+                                        c.POINTER(c.c_uint64)]
+    lib.pt_plugin_stream_check.restype = c.c_int
+    lib.pt_plugin_stream_check.argtypes = [c.c_char_p, c.c_int]
+    lib.pt_plugin_ccl_all_reduce.restype = c.c_int
+    lib.pt_plugin_ccl_all_reduce.argtypes = [c.c_char_p, c.c_int,
+                                             c.c_void_p, c.c_uint64,
+                                             c.c_int, c.c_int]
+    lib.pt_custom_op_load.restype = c.c_int
+    lib.pt_custom_op_load.argtypes = [c.c_char_p, c.c_char_p]
+    lib.pt_custom_op_call.restype = c.c_int
+    lib.pt_custom_op_call.argtypes = [c.c_char_p,
+                                      c.POINTER(c.c_void_p),
+                                      c.POINTER(c.c_int64), c.c_int,
+                                      c.c_void_p, c.c_int64]
     return lib
 
 

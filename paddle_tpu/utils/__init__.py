@@ -1,0 +1,13 @@
+"""paddle.utils (python/paddle/utils analog): cpp extension loading,
+custom-device plugins, environment self-check."""
+from . import cpp_extension  # noqa: F401
+from .cpp_extension import (  # noqa: F401
+    CustomDevice,
+    get_all_custom_device_type,
+    load_custom_device_lib,
+    load_op_library,
+)
+from .install_check import run_check  # noqa: F401
+
+__all__ = ["run_check", "cpp_extension", "load_custom_device_lib",
+           "get_all_custom_device_type", "load_op_library", "CustomDevice"]

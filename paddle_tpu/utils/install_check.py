@@ -1,0 +1,60 @@
+"""paddle.utils.run_check (python/paddle/utils/install_check.py:215
+analog): a self-test a user runs after install — single-device fwd/bwd
+numerics, then a sharded matmul across every visible device."""
+from __future__ import annotations
+
+import numpy as np
+
+
+def _check_single():
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(4, 8).astype(np.float32),
+        stop_gradient=False)
+    w = paddle.to_tensor(
+        np.random.RandomState(1).randn(8, 4).astype(np.float32),
+        stop_gradient=False)
+    y = F.relu(paddle.matmul(x, w))
+    loss = y.sum()
+    loss.backward()
+    ref = np.maximum(x.numpy() @ w.numpy(), 0).sum()
+    np.testing.assert_allclose(float(loss.numpy()), ref, rtol=1e-4)
+    assert x.grad is not None and w.grad is not None
+    return True
+
+
+def _check_all_devices(n: int):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    devs = np.asarray(jax.devices()[:n])
+    mesh = Mesh(devs, ("dp",))
+    x = jnp.ones((n * 2, 8), jnp.float32)
+    xs = jax.device_put(x, NamedSharding(mesh, PartitionSpec("dp")))
+    w = jnp.ones((8, 4), jnp.float32)
+    out = jax.jit(lambda a, b: a @ b)(xs, w)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.full((n * 2, 4), 8.0, np.float32))
+    return True
+
+
+def run_check():
+    """Prints the same kind of report the reference does
+    (install_check.py: 'PaddlePaddle is installed successfully!...')."""
+    import jax
+    import paddle_tpu
+
+    n = len(jax.devices())
+    plat = jax.devices()[0].platform
+    _check_single()
+    print(f"PaddleTPU works on 1 {plat} device.")
+    if n > 1:
+        _check_all_devices(n)
+        print(f"PaddleTPU works on {n} {plat} devices "
+              f"(sharded matmul verified).")
+    print("PaddleTPU is installed successfully! Let's start deep "
+          "learning with PaddleTPU now.")
+    return True
