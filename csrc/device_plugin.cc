@@ -40,6 +40,17 @@ Plugin* find(const char* dev_type) {
   return it == registry().end() ? nullptr : &it->second;
 }
 
+// Copy the fn-pointer table out under the lock, call outside it: a bulk
+// memcpy must not serialize every other plugin call process-wide.
+// Registry entries are never erased, so the copied table stays valid.
+bool iface_of(const char* dev_type, PT_DeviceInterface* out) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  Plugin* p = find(dev_type);
+  if (!p) return false;
+  *out = p->iface;
+  return true;
+}
+
 }  // namespace
 
 // Returns the registered device_type name, or null on failure.
@@ -85,50 +96,44 @@ PT_EXPORT const char* pt_plugin_load(const char* path) {
 }
 
 PT_EXPORT int pt_plugin_device_count(const char* dev_type) {
-  std::lock_guard<std::mutex> lk(g_mu);
-  Plugin* p = find(dev_type);
-  if (!p) return -1;
+  PT_DeviceInterface i{};
+  if (!iface_of(dev_type, &i)) return -1;
   int n = 0;
-  return p->iface.get_device_count(&n) == PT_STATUS_OK ? n : -1;
+  return i.get_device_count(&n) == PT_STATUS_OK ? n : -1;
 }
 
 PT_EXPORT void* pt_plugin_malloc(const char* dev_type, int device,
                                  uint64_t size) {
-  std::lock_guard<std::mutex> lk(g_mu);
-  Plugin* p = find(dev_type);
-  if (!p) return nullptr;
+  PT_DeviceInterface i{};
+  if (!iface_of(dev_type, &i)) return nullptr;
   void* ptr = nullptr;
-  if (p->iface.device_malloc(device, &ptr, size) != PT_STATUS_OK)
-    return nullptr;
+  if (i.device_malloc(device, &ptr, size) != PT_STATUS_OK) return nullptr;
   return ptr;
 }
 
 PT_EXPORT int pt_plugin_free(const char* dev_type, int device, void* ptr) {
-  std::lock_guard<std::mutex> lk(g_mu);
-  Plugin* p = find(dev_type);
-  return p && p->iface.device_free(device, ptr) == PT_STATUS_OK ? 0 : -1;
+  PT_DeviceInterface i{};
+  if (!iface_of(dev_type, &i)) return -1;
+  return i.device_free(device, ptr) == PT_STATUS_OK ? 0 : -1;
 }
 
 PT_EXPORT int pt_plugin_memcpy(const char* dev_type, int device, void* dst,
                                const void* src, uint64_t size, int kind
                                /*0=h2d,1=d2h,2=d2d*/) {
-  std::lock_guard<std::mutex> lk(g_mu);
-  Plugin* p = find(dev_type);
-  if (!p) return -1;
+  PT_DeviceInterface i{};
+  if (!iface_of(dev_type, &i)) return -1;
   PT_Status (*fn)(int, void*, const void*, size_t) =
-      kind == 0 ? p->iface.memcpy_h2d
-                : kind == 1 ? p->iface.memcpy_d2h : p->iface.memcpy_d2d;
+      kind == 0 ? i.memcpy_h2d : kind == 1 ? i.memcpy_d2h : i.memcpy_d2d;
   if (!fn) return -1;
   return fn(device, dst, src, size) == PT_STATUS_OK ? 0 : -1;
 }
 
 PT_EXPORT int pt_plugin_mem_stats(const char* dev_type, int device,
                                   uint64_t* total, uint64_t* free_) {
-  std::lock_guard<std::mutex> lk(g_mu);
-  Plugin* p = find(dev_type);
-  if (!p || !p->iface.device_mem_stats) return -1;
+  PT_DeviceInterface i{};
+  if (!iface_of(dev_type, &i) || !i.device_mem_stats) return -1;
   size_t t = 0, f = 0;
-  if (p->iface.device_mem_stats(device, &t, &f) != PT_STATUS_OK) return -1;
+  if (i.device_mem_stats(device, &t, &f) != PT_STATUS_OK) return -1;
   *total = t;
   *free_ = f;
   return 0;
@@ -137,36 +142,32 @@ PT_EXPORT int pt_plugin_mem_stats(const char* dev_type, int device,
 // One stream round-trip: create, record+sync an event, destroy — the
 // contract smoke the fake-device test drives.
 PT_EXPORT int pt_plugin_stream_check(const char* dev_type, int device) {
-  std::lock_guard<std::mutex> lk(g_mu);
-  Plugin* p = find(dev_type);
-  if (!p || !p->iface.stream_create) return -1;
+  PT_DeviceInterface i{};
+  if (!iface_of(dev_type, &i) || !i.stream_create) return -1;
   PT_Stream s = nullptr;
   PT_Event e = nullptr;
-  if (p->iface.stream_create(device, &s) != PT_STATUS_OK) return -1;
+  if (i.stream_create(device, &s) != PT_STATUS_OK) return -1;
   int rc = 0;
   // every event slot is optional per the header: guard each pointer
-  if (p->iface.event_create && p->iface.event_record &&
-      p->iface.event_synchronize &&
-      (p->iface.event_create(device, &e) != PT_STATUS_OK ||
-       p->iface.event_record(device, s, e) != PT_STATUS_OK ||
-       p->iface.event_synchronize(device, e) != PT_STATUS_OK))
+  if (i.event_create && i.event_record && i.event_synchronize &&
+      (i.event_create(device, &e) != PT_STATUS_OK ||
+       i.event_record(device, s, e) != PT_STATUS_OK ||
+       i.event_synchronize(device, e) != PT_STATUS_OK))
     rc = -1;
-  if (e && p->iface.event_destroy) p->iface.event_destroy(device, e);
-  if (p->iface.stream_synchronize &&
-      p->iface.stream_synchronize(device, s) != PT_STATUS_OK)
+  if (e && i.event_destroy) i.event_destroy(device, e);
+  if (i.stream_synchronize &&
+      i.stream_synchronize(device, s) != PT_STATUS_OK)
     rc = -1;
-  if (p->iface.stream_destroy) p->iface.stream_destroy(device, s);
+  if (i.stream_destroy) i.stream_destroy(device, s);
   return rc;
 }
 
 PT_EXPORT int pt_plugin_ccl_all_reduce(const char* dev_type, int device,
                                        void* data, uint64_t count,
                                        int dtype, int op) {
-  std::lock_guard<std::mutex> lk(g_mu);
-  Plugin* p = find(dev_type);
-  if (!p || !p->iface.ccl_all_reduce) return -1;
-  return p->iface.ccl_all_reduce(device, data, count, dtype, op) ==
-                 PT_STATUS_OK
+  PT_DeviceInterface i{};
+  if (!iface_of(dev_type, &i) || !i.ccl_all_reduce) return -1;
+  return i.ccl_all_reduce(device, data, count, dtype, op) == PT_STATUS_OK
              ? 0
              : -1;
 }

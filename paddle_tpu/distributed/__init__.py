@@ -40,6 +40,7 @@ from . import auto_parallel  # noqa: F401
 from .auto_parallel import Engine, Strategy, to_static  # noqa: F401
 from . import auto_tuner  # noqa: F401
 from . import ps  # noqa: F401
+from . import rpc  # noqa: F401
 from .context_parallel import (ring_attention, ulysses_attention,  # noqa: F401
                                ring_attention_global,
                                ulysses_attention_global)

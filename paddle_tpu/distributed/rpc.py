@@ -1,0 +1,202 @@
+"""paddle.distributed.rpc (python/paddle/distributed/rpc/ analog).
+
+The reference runs RPC over brpc (fluid/distributed/rpc); here each
+worker runs a socket server thread, workers discover each other through
+the TCPStore rendezvous (MASTER_ADDR/PORT, same envs as the reference,
+rpc/internal.py), and calls move pickled (fn, args, kwargs) frames.
+API parity: init_rpc / rpc_sync / rpc_async / get_worker_info /
+get_all_worker_infos / get_current_worker_info / shutdown.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import threading
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Dict, List, Optional
+
+__all__ = ["init_rpc", "shutdown", "rpc_sync", "rpc_async",
+           "get_worker_info", "get_all_worker_infos",
+           "get_current_worker_info", "WorkerInfo"]
+
+
+class WorkerInfo:
+    def __init__(self, name: str, rank: int, ip: str, port: int):
+        self.name = name
+        self.rank = rank
+        self.ip = ip
+        self.port = port
+
+    def __repr__(self):
+        return (f"WorkerInfo(name={self.name}, rank={self.rank}, "
+                f"ip={self.ip}, port={self.port})")
+
+
+_state = {}
+
+
+def _recv_exact(conn: socket.socket, n: int) -> bytes:
+    chunks = []
+    while n > 0:
+        b = conn.recv(n)
+        if not b:
+            raise ConnectionError("rpc peer closed")
+        chunks.append(b)
+        n -= len(b)
+    return b"".join(chunks)
+
+
+def _send_frame(conn: socket.socket, payload: bytes) -> None:
+    conn.sendall(struct.pack("<Q", len(payload)) + payload)
+
+
+def _recv_frame(conn: socket.socket) -> bytes:
+    (n,) = struct.unpack("<Q", _recv_exact(conn, 8))
+    return _recv_exact(conn, n)
+
+
+def _serve(server_sock: socket.socket, pool: ThreadPoolExecutor):
+    """Accept loop: one request-response per connection (the reference's
+    RequestHandler role, paddle/fluid/distributed/rpc/rpc_agent.cc)."""
+    while True:
+        try:
+            conn, _ = server_sock.accept()
+        except OSError:
+            return  # server closed: shutdown
+        pool.submit(_handle, conn)
+
+
+def _handle(conn: socket.socket):
+    try:
+        with conn:
+            fn, args, kwargs = pickle.loads(_recv_frame(conn))
+            try:
+                result = ("ok", fn(*args, **kwargs))
+            except Exception as e:  # ship the remote exception back
+                result = ("err", e)
+            _send_frame(conn, pickle.dumps(result))
+    except Exception:
+        pass  # connection torn down mid-call; caller sees the error
+
+
+def init_rpc(name: str, rank: Optional[int] = None,
+             world_size: Optional[int] = None,
+             master_endpoint: Optional[str] = None):
+    """Start this worker's server and rendezvous all workers
+    (rpc/internal.py init_rpc analog: TCPStore keyed exchange)."""
+    from .store import TCPStore
+
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", 0)) \
+        if rank is None else rank
+    world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", 1)) \
+        if world_size is None else world_size
+    if master_endpoint is None:
+        master_endpoint = (os.environ.get("MASTER_ADDR", "127.0.0.1") + ":"
+                           + os.environ.get("MASTER_PORT", "0"))
+    host, port = master_endpoint.rsplit(":", 1)
+
+    server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    server.bind(("0.0.0.0", 0))
+    server.listen(64)
+    my_port = server.getsockname()[1]
+    my_ip = os.environ.get("PADDLE_LOCAL_IP", "127.0.0.1")
+
+    pool = ThreadPoolExecutor(max_workers=8,
+                              thread_name_prefix="rpc-handler")
+    thread = threading.Thread(target=_serve, args=(server, pool),
+                              daemon=True, name="rpc-server")
+    thread.start()
+
+    store = TCPStore(host, int(port), is_master=(rank == 0),
+                     world_size=world_size)
+    store.set(f"__rpc/{rank}",
+              pickle.dumps(WorkerInfo(name, rank, my_ip, my_port)))
+    workers: Dict[str, WorkerInfo] = {}
+    for r in range(world_size):
+        wi = pickle.loads(store.get(f"__rpc/{r}"))
+        workers[wi.name] = wi
+
+    _state.update({
+        "server": server, "thread": thread, "pool": pool,
+        "store": store, "workers": workers, "rank": rank, "name": name,
+        "futures_pool": ThreadPoolExecutor(
+            max_workers=8, thread_name_prefix="rpc-client"),
+    })
+
+
+def _call(to: str, fn, args, kwargs, timeout):
+    workers = _state.get("workers")
+    if workers is None:
+        raise RuntimeError("init_rpc has not been called")
+    wi = workers.get(to)
+    if wi is None:
+        raise ValueError(f"unknown rpc worker: {to}")
+    with socket.create_connection((wi.ip, wi.port),
+                                  timeout=timeout or None) as conn:
+        _send_frame(conn, pickle.dumps((fn, args or (), kwargs or {})))
+        status, payload = pickle.loads(_recv_frame(conn))
+    if status == "err":
+        raise payload
+    return payload
+
+
+def rpc_sync(to: str, fn, args=None, kwargs=None, timeout=180.0):
+    """Blocking remote call (rpc/api.py rpc_sync)."""
+    return _call(to, fn, args, kwargs, timeout)
+
+
+def rpc_async(to: str, fn, args=None, kwargs=None,
+              timeout=180.0) -> Future:
+    """Future-returning remote call (rpc/api.py rpc_async; .wait() /
+    .result() both work, Future API)."""
+    fut = _state["futures_pool"].submit(_call, to, fn, args, kwargs,
+                                        timeout)
+    fut.wait = fut.result  # paddle's FutureWrapper exposes wait()
+    return fut
+
+
+def get_worker_info(name: str) -> WorkerInfo:
+    return _state["workers"][name]
+
+
+def get_all_worker_infos() -> List[WorkerInfo]:
+    return sorted(_state["workers"].values(), key=lambda w: w.rank)
+
+
+def get_current_worker_info() -> WorkerInfo:
+    return _state["workers"][_state["name"]]
+
+
+def shutdown():
+    """Barrier-synchronized teardown: nobody closes their server while a
+    peer may still call them (rpc/api.py shutdown semantics)."""
+    if not _state:
+        return
+    store = _state["store"]
+    world = len(_state["workers"])
+    rank = _state["rank"]
+    import time
+
+    def _count_up(key):
+        store.add(key, 1)
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if store.add(key, 0) >= world:
+                return
+            time.sleep(0.02)
+
+    # two phases: everyone agrees to stop, then everyone acknowledges
+    # having SEEN the agreement — only then may rank 0 (the store server
+    # owner) tear down, so no peer's final poll races a dead server
+    _count_up("__rpc/shutdown")
+    if rank == 0:
+        _count_up("__rpc/ack")
+    else:
+        store.add("__rpc/ack", 1)
+    _state["server"].close()
+    _state["pool"].shutdown(wait=False)
+    _state["futures_pool"].shutdown(wait=False)
+    _state.clear()

@@ -46,6 +46,28 @@ class Variable(Tensor):
         self.program = program
         self.source = source  # None = feed var; else producing OpNode
 
+    # metadata reflects the DECLARED shape, not the dummy payload —
+    # user code like `y.shape[0]` must work while tracing
+    @property
+    def shape(self):
+        return list(self.var_shape)
+
+    @property
+    def ndim(self):
+        return len(self.var_shape)
+
+    @property
+    def size(self):
+        out = 1
+        for d in self.var_shape:
+            out *= (1 if d in (None, -1) else d)
+        return out
+
+    @property
+    def dtype(self):
+        from .._core import dtype as dtypes_mod
+        return dtypes_mod.from_np(np.dtype(self.var_dtype))
+
     def __repr__(self):
         return (f"static.Variable(name={self.name}, "
                 f"shape={self.var_shape}, dtype={self.var_dtype})")

@@ -1,0 +1,271 @@
+"""paddle.distributed.rpc + paddle.onnx.export tests.
+
+RPC mirrors the reference's test/rpc suite (rpc_sync/rpc_async/worker
+infos/remote exceptions over real processes). ONNX export is validated by
+round-tripping the hand-encoded protobuf through the wire reader and
+numerically re-executing the graph with a tiny NumPy interpreter.
+"""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ===================================================================== rpc
+
+def _rpc_worker():
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from paddle_tpu.distributed import rpc
+
+    rpc.init_rpc(name=f"worker{rank}")
+
+    infos = rpc.get_all_worker_infos()
+    assert [w.name for w in infos] == ["worker0", "worker1"]
+    assert rpc.get_current_worker_info().rank == rank
+    assert rpc.get_worker_info("worker0").rank == 0
+
+    peer = f"worker{(rank + 1) % 2}"
+    # sync call
+    assert rpc.rpc_sync(peer, _remote_add, args=(3, 4)) == 7
+    # async call
+    fut = rpc.rpc_async(peer, _remote_add, args=(10,),
+                        kwargs={"y": 5})
+    assert fut.wait() == 15
+    # numpy payloads
+    arr = rpc.rpc_sync(peer, _remote_scale,
+                       args=(np.arange(6, dtype=np.float32), 2.0))
+    np.testing.assert_allclose(arr, np.arange(6, dtype=np.float32) * 2)
+    # remote exception propagates with its type
+    try:
+        rpc.rpc_sync(peer, _remote_boom)
+        raise AssertionError("expected ValueError")
+    except ValueError as e:
+        assert "boom" in str(e)
+    # self-call works too
+    assert rpc.rpc_sync(f"worker{rank}", _remote_add, args=(1, 1)) == 2
+
+    rpc.shutdown()
+    print(f"RPCWORKER-{rank}-OK", flush=True)
+
+
+def _remote_add(x, y=0):
+    return x + y
+
+
+def _remote_scale(a, s):
+    return a * s
+
+
+def _remote_boom():
+    raise ValueError("boom")
+
+
+def test_rpc_two_workers():
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    procs = []
+    for rank in range(2):
+        env = dict(os.environ)
+        env.update({
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": "2",
+            "MASTER_ADDR": "127.0.0.1",
+            "MASTER_PORT": str(port),
+            "JAX_PLATFORMS": "cpu",
+            "PT_RPC_WORKER": "1",
+            "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    for rank, p in enumerate(procs):
+        out, _ = p.communicate(timeout=240)
+        assert p.returncode == 0, f"rank {rank} rc={p.returncode}:\n{out}"
+        assert f"RPCWORKER-{rank}-OK" in out
+
+
+# ==================================================================== onnx
+
+def _np_run(model, feeds):
+    """Tiny NumPy interpreter over the loaded onnx dict."""
+    env = dict(model["initializers"])
+    env.update(feeds)
+
+    def softmax(x, axis):
+        e = np.exp(x - x.max(axis=axis, keepdims=True))
+        return e / e.sum(axis=axis, keepdims=True)
+
+    for n in model["nodes"]:
+        i = [env[k] for k in n["inputs"]]
+        t = n["op_type"]
+        if t == "MatMul":
+            r = i[0] @ i[1]
+        elif t == "Gemm":
+            a = i[0].T if n["attrs"].get("transA") else i[0]
+            b = i[1].T if n["attrs"].get("transB") else i[1]
+            r = a @ b
+            if len(i) > 2:
+                r = r + i[2]
+        elif t == "Add":
+            r = i[0] + i[1]
+        elif t == "Relu":
+            r = np.maximum(i[0], 0)
+        elif t == "Softmax":
+            ax = n["attrs"].get("axis", -1)
+            ax = ax if isinstance(ax, int) else -1
+            r = softmax(i[0], ax)
+        elif t == "Reshape":
+            r = i[0].reshape([int(d) for d in i[1]])
+        elif t == "Transpose":
+            r = np.transpose(i[0], n["attrs"]["perm"])
+        else:
+            raise NotImplementedError(t)
+        env[n["outputs"][0]] = r
+    return [env[o] for o in model["outputs"]]
+
+
+class TestOnnxExport:
+    def test_mlp_round_trip(self, tmp_path):
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+        from paddle_tpu import onnx as ponnx
+        from paddle_tpu.static import InputSpec
+
+        paddle.seed(3)
+        net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(),
+                            nn.Linear(16, 4), nn.Softmax())
+        path = ponnx.export(net, str(tmp_path / "mlp"),
+                            input_spec=[InputSpec([2, 8], "float32")])
+        assert path.endswith(".onnx")
+
+        model = ponnx.load_model(path)
+        assert model["producer"] == "paddle_tpu"
+        assert model["opset"] == 13
+        assert len(model["inputs"]) == 1
+        assert len(model["outputs"]) == 1
+        op_types = [n["op_type"] for n in model["nodes"]]
+        assert "Gemm" in op_types and "Relu" in op_types \
+            and "Softmax" in op_types
+        # weights travel as initializers
+        assert len(model["initializers"]) >= 4
+
+        # numeric parity: NumPy-interpret the onnx graph vs eager
+        x = np.random.RandomState(0).randn(2, 8).astype(np.float32)
+        (got,) = _np_run(model, {model["inputs"][0]: x})
+        ref = net(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+    def test_reshape_transpose(self, tmp_path):
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+        from paddle_tpu import onnx as ponnx
+        from paddle_tpu.static import InputSpec
+
+        class Net(nn.Layer):
+            def forward(self, x):
+                y = paddle.reshape(x, [4, 6])
+                return paddle.transpose(y, [1, 0])
+
+        path = ponnx.export(Net(), str(tmp_path / "rt"),
+                            input_spec=[InputSpec([2, 12], "float32")])
+        model = ponnx.load_model(path)
+        x = np.arange(24, dtype=np.float32).reshape(2, 12)
+        (got,) = _np_run(model, {model["inputs"][0]: x})
+        np.testing.assert_array_equal(got, x.reshape(4, 6).T)
+
+    def test_cnn_pool_flatten_and_pads_order(self, tmp_path):
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+        from paddle_tpu import onnx as ponnx
+        from paddle_tpu.static import InputSpec
+
+        class CNN(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.conv = nn.Conv2D(1, 4, 3, padding=[1, 2])
+                self.pool = nn.MaxPool2D(2)
+                self.fc = nn.Linear(4 * 4 * 5, 10)
+
+            def forward(self, x):
+                y = paddle.nn.functional.relu(self.conv(x))
+                y = self.pool(y)
+                y = paddle.flatten(y, start_axis=1)
+                return self.fc(y)
+
+        path = ponnx.export(CNN(), str(tmp_path / "cnn"),
+                            input_spec=[InputSpec([2, 1, 8, 8],
+                                                  "float32")])
+        m = ponnx.load_model(path)
+        ops = [n["op_type"] for n in m["nodes"]]
+        assert "MaxPool" in ops and "Flatten" in ops and "Conv" in ops
+        conv = [n for n in m["nodes"] if n["op_type"] == "Conv"][0]
+        # ONNX pads are (all begins, all ends): [hb, wb, he, we]
+        assert conv["attrs"]["pads"] == [1, 2, 1, 2]
+
+    def test_rank3_linear_decomposes_to_matmul_add(self, tmp_path):
+        import paddle_tpu.nn as nn
+        from paddle_tpu import onnx as ponnx
+        from paddle_tpu.static import InputSpec
+
+        class Seq(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(6, 3)
+
+            def forward(self, x):
+                return self.fc(x)   # [b, s, f]: Gemm is rank-2-only
+
+        path = ponnx.export(Seq(), str(tmp_path / "seq"),
+                            input_spec=[InputSpec([2, 5, 6], "float32")])
+        m = ponnx.load_model(path)
+        assert [n["op_type"] for n in m["nodes"]] == ["MatMul", "Add"]
+
+    def test_layer_norm_raises_opset(self, tmp_path):
+        import paddle_tpu.nn as nn
+        from paddle_tpu import onnx as ponnx
+        from paddle_tpu.static import InputSpec
+
+        class LN(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.ln = nn.LayerNorm(6, epsilon=1e-12)
+
+            def forward(self, x):
+                return self.ln(x)
+
+        path = ponnx.export(LN(), str(tmp_path / "ln"),
+                            input_spec=[InputSpec([2, 6], "float32")])
+        m = ponnx.load_model(path)
+        assert m["opset"] >= 17  # LayerNormalization needs opset 17
+
+    def test_unmapped_op_raises_with_name(self, tmp_path):
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+        from paddle_tpu import onnx as ponnx
+        from paddle_tpu.static import InputSpec
+
+        class Net(nn.Layer):
+            def forward(self, x):
+                return paddle.cumsum(x, axis=0)
+
+        with pytest.raises(NotImplementedError, match="cumsum"):
+            ponnx.export(Net(), str(tmp_path / "bad"),
+                         input_spec=[InputSpec([2, 3], "float32")])
+
+    def test_missing_input_spec_raises(self, tmp_path):
+        import paddle_tpu.nn as nn
+        from paddle_tpu import onnx as ponnx
+        with pytest.raises(ValueError):
+            ponnx.export(nn.Linear(2, 2), str(tmp_path / "x"))
+
+
+if __name__ == "__main__" and os.environ.get("PT_RPC_WORKER") == "1":
+    _rpc_worker()
