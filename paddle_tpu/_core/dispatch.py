@@ -42,10 +42,11 @@ def attrs_key(attrs: Dict[str, Any]):
 
 
 def fwd_callable(op: OpDef, attrs: Dict[str, Any]):
-    key = (op.name, attrs_key(attrs))
+    backend = jax.default_backend()  # kernel-key Backend component
+    key = (op.name, backend, attrs_key(attrs))
     fn = _FWD_CACHE.get(key)
     if fn is None:
-        fn = jax.jit(functools.partial(op.fn, **attrs))
+        fn = jax.jit(functools.partial(op.kernel_for(backend), **attrs))
         _FWD_CACHE[key] = fn
     return fn
 
@@ -62,14 +63,17 @@ def eager_forward(op: OpDef, vals: Tuple, attrs: Dict[str, Any]) -> Tuple:
 
 
 def bwd_callable(op: OpDef, attrs: Dict[str, Any]):
-    key = (op.name, attrs_key(attrs))
+    backend = jax.default_backend()
+    key = (op.name, backend, attrs_key(attrs))
     fn = _BWD_CACHE.get(key)
     if fn is not None:
         return fn
     if op.bwd is not None:
         fn = jax.jit(functools.partial(op.bwd, **attrs))
     else:
-        fwd = functools.partial(op.fn, **attrs)
+        # differentiate the SAME body the forward ran (variant-aware) so
+        # fwd/bwd numerics always pair up
+        fwd = functools.partial(op.kernel_for(backend), **attrs)
 
         def _vjp(saved, gouts, _fwd=fwd, _multi=op.multi_output):
             _, pull = jax.vjp(_fwd, *saved)

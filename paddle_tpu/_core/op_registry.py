@@ -26,7 +26,8 @@ class OpDef:
     spmd_rule    : optional sharding propagation rule (used by distributed).
     """
 
-    __slots__ = ("name", "fn", "bwd", "multi_output", "spmd_rule", "doc")
+    __slots__ = ("name", "fn", "bwd", "multi_output", "spmd_rule", "doc",
+                 "variants")
 
     def __init__(self, name: str, fn: Callable, bwd: Optional[Callable] = None,
                  multi_output: bool = False, spmd_rule=None):
@@ -36,6 +37,14 @@ class OpDef:
         self.multi_output = multi_output
         self.spmd_rule = spmd_rule
         self.doc = fn.__doc__
+        # backend name -> kernel body override. The default fn is the
+        # generic XLA lowering; a variant is the analog of a per-backend
+        # kernel registration (kernel_registry.h PD_REGISTER_KERNEL with
+        # a Backend key) — e.g. a Pallas body under "tpu" only.
+        self.variants: Dict[str, Callable] = {}
+
+    def kernel_for(self, backend: str) -> Callable:
+        return self.variants.get(backend, self.fn)
 
 
 _OPS: Dict[str, OpDef] = {}
@@ -51,6 +60,28 @@ def register_op(name: str, fn: Callable = None, *, bwd: Callable = None,
                    spmd_rule=spmd_rule)
         _OPS[name] = op
         return op
+
+    if fn is None:
+        return _do
+    return _do(fn)
+
+
+def register_kernel(name: str, backend: str, fn: Callable = None):
+    """Register a per-backend kernel body for an existing op (the
+    KernelFactory multi-backend shape: same op key, backend-selected
+    body — kernel_factory.h:316 SelectKernelOrThrowError)."""
+    def _do(f):
+        op = _OPS.get(name)
+        if op is None:
+            raise ValueError(f"op '{name}' not registered")
+        op.variants[backend] = f
+        # drop stale compiled entries so a late registration takes
+        # effect even for (op, backend, attrs) keys that already ran
+        from . import dispatch
+        for cache in (dispatch._FWD_CACHE, dispatch._BWD_CACHE):
+            for key in [k for k in cache if k[0] == name]:
+                del cache[key]
+        return f
 
     if fn is None:
         return _do

@@ -76,7 +76,16 @@ def _handle(conn: socket.socket):
                 result = ("ok", fn(*args, **kwargs))
             except Exception as e:  # ship the remote exception back
                 result = ("err", e)
-            _send_frame(conn, pickle.dumps(result))
+            try:
+                payload = pickle.dumps(result)
+            except Exception as e:
+                # unpicklable result/exception: degrade to a picklable
+                # description instead of dropping the reply frame
+                payload = pickle.dumps(
+                    ("err", RuntimeError(
+                        f"rpc result not picklable: {result!r} "
+                        f"({e!r})")))
+            _send_frame(conn, payload)
     except Exception:
         pass  # connection torn down mid-call; caller sees the error
 
@@ -93,8 +102,10 @@ def init_rpc(name: str, rank: Optional[int] = None,
     world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", 1)) \
         if world_size is None else world_size
     if master_endpoint is None:
+        # same default port as create_or_get_global_tcp_store; port 0
+        # could never rendezvous (peers can't learn an ephemeral port)
         master_endpoint = (os.environ.get("MASTER_ADDR", "127.0.0.1") + ":"
-                           + os.environ.get("MASTER_PORT", "0"))
+                           + os.environ.get("MASTER_PORT", "6170"))
     host, port = master_endpoint.rsplit(":", 1)
 
     server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)

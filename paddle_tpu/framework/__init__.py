@@ -52,3 +52,12 @@ def load(path, **configs):
 def seed(s):
     from .._core import random as rnd
     return rnd.seed(s)
+
+
+from .tensor_types import (  # noqa: E402,F401
+    SelectedRows, StringTensor, TensorArray,
+    array_length, array_read, array_write, create_array,
+)
+
+__all__ += ["SelectedRows", "TensorArray", "StringTensor", "create_array",
+            "array_write", "array_read", "array_length"]

@@ -61,7 +61,8 @@ class ConstantFoldingPass(Pass):
             if any(v is _NOT_CONST for v in vals):
                 continue
             op = get_op(node.op_name)
-            out = op.fn(*vals, **node.attrs)
+            out = op.kernel_for(jax.default_backend())(*vals,
+                                                       **node.attrs)
             outs = jax.tree_util.tree_leaves(
                 out if op.multi_output else (out,))
             for var, v in zip(node.outputs, outs):

@@ -154,7 +154,7 @@ def _onnx_pads(padding, what):
     return ([b for b, _ in pairs] + [e for _, e in pairs])
 
 
-def _lower_node(node, rank_of, idx):
+def _lower_node(node, rank_of, shape_of, idx):
     """Recorded mini-IR op -> list of ONNX node specs
     {op_type, extra_inputs?, attrs, const_inputs?}. Multi-spec entries
     chain through a fresh intermediate edge (decompositions)."""
@@ -210,22 +210,33 @@ def _lower_node(node, rank_of, idx):
         return [{"op_type": "Concat",
                  "attrs": {"axis": int(a.get("axis", 0))}}]
     if op == "flatten_":
-        stop = a.get("stop", -1)
-        nd = rank_of(node.inputs[0])
-        if stop not in (-1, nd - 1):
-            raise NotImplementedError(
-                "paddle_tpu.onnx.export: flatten with stop_axis != -1 "
-                "has no ONNX Flatten equivalent")
-        return [{"op_type": "Flatten",
-                 "attrs": {"axis": int(a.get("start", 1))}}]
+        # ONNX Flatten always yields rank 2, paddle's preserves leading
+        # dims — lower to Reshape with the statically known output shape
+        shape = shape_of(node.inputs[0])
+        nd = len(shape)
+        start = int(a.get("start", 0)) % nd
+        stop = int(a.get("stop", -1)) % nd
+        mid = 1
+        for d in shape[start:stop + 1]:
+            mid *= int(d)
+        out_shape = [int(d) for d in shape[:start]] + [mid] \
+            + [int(d) for d in shape[stop + 1:]]
+        return [{"op_type": "Reshape", "attrs": {},
+                 "const_inputs": [np.asarray(out_shape, np.int64)]}]
     if op in ("mean", "sum_"):
+        # axes travel as a const INPUT: ReduceSum-13 / ReduceMean-18
+        # moved axes off the attribute form
         ax = a.get("axis")
         attrs_ = {"keepdims": int(bool(a.get("keepdim", False)))}
+        spec = {"op_type": "ReduceMean" if op == "mean" else "ReduceSum",
+                "attrs": attrs_}
         if ax is not None:
-            attrs_["axes"] = [int(ax)] if isinstance(
+            axes = [int(ax)] if isinstance(
                 ax, (int, np.integer)) else [int(x) for x in ax]
-        return [{"op_type": "ReduceMean" if op == "mean"
-                 else "ReduceSum", "attrs": attrs_}]
+            spec["const_inputs"] = [np.asarray(axes, np.int64)]
+            if op == "mean":
+                spec["min_opset"] = 18
+        return [spec]
     if op in ("max_pool_nd", "avg_pool_nd"):
         if a.get("fmt", "NCHW") != "NCHW" or len(a["ksize"]) != 2:
             raise NotImplementedError(
@@ -281,8 +292,10 @@ def export(layer, path: str, input_spec=None, opset_version: int = 13,
                         spec, static.Variable):
                     shape, dtype = spec.shape, spec._value.dtype.name
                 else:
+                    from ._core import dtype as dtypes_mod
                     shape = spec.shape
-                    dtype = str(getattr(spec, "dtype", "float32"))
+                    dtype = np.dtype(dtypes_mod.to_np(
+                        getattr(spec, "dtype", "float32"))).name
                 name = getattr(spec, "name", None) or f"x{i}"
                 v = static.data(name, shape, dtype)
                 feeds.append(v)
@@ -317,17 +330,23 @@ def export(layer, path: str, input_spec=None, opset_version: int = 13,
             return len(t.var_shape)
         return np.asarray(t._value).ndim
 
+    def shape_of(t):
+        if isinstance(t, static.Variable):
+            return list(t.var_shape)
+        return list(np.asarray(t._value).shape)
+
     nodes: List[bytes] = []
     needed_opset = opset_version
     for i, node in enumerate(prog.ops):
-        specs = _lower_node(node, rank_of, i)
+        specs = _lower_node(node, rank_of, shape_of, i)
         in_names = [name_of(t) for t in node.inputs if t is not None]
         out_names = [name_of(o) for o in node.outputs]
         prev_out = None
         for j, spec in enumerate(specs):
             op_type = spec["op_type"]
             needed_opset = max(needed_opset,
-                               _OP_MIN_OPSET.get(op_type, 0))
+                               _OP_MIN_OPSET.get(op_type, 0),
+                               spec.get("min_opset", 0))
             if j == 0:
                 ins = in_names[:spec.get("n_inputs", len(in_names))]
             else:  # chained decomposition step

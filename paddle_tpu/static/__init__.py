@@ -272,10 +272,14 @@ class Executor:
                     return t._value   # captured dygraph tensor (parameter)
                 return t              # constant injected by a pass
 
+            import jax as _jax
+            backend = _jax.default_backend()
             for node in ws.ops:
                 op = get_op(node.op_name)
                 vals = [value_of(t) for t in node.inputs]
-                out = op.fn(*vals, **node.attrs)
+                # variant-aware: compiled replay must run the same
+                # per-backend body eager dispatch would
+                out = op.kernel_for(backend)(*vals, **node.attrs)
                 outs = jax.tree_util.tree_leaves(
                     out if op.multi_output else (out,))
                 for var, o in zip(node.outputs, outs):

@@ -205,10 +205,16 @@ class TestOnnxExport:
                                                   "float32")])
         m = ponnx.load_model(path)
         ops = [n["op_type"] for n in m["nodes"]]
-        assert "MaxPool" in ops and "Flatten" in ops and "Conv" in ops
+        # flatten lowers to Reshape (ONNX Flatten is rank-2-only while
+        # paddle's preserves leading dims)
+        assert "MaxPool" in ops and "Reshape" in ops and "Conv" in ops
         conv = [n for n in m["nodes"] if n["op_type"] == "Conv"][0]
         # ONNX pads are (all begins, all ends): [hb, wb, he, we]
         assert conv["attrs"]["pads"] == [1, 2, 1, 2]
+        # the reshape's target shape is a const initializer
+        rs = [n for n in m["nodes"] if n["op_type"] == "Reshape"][0]
+        tgt = m["initializers"][rs["inputs"][1]]
+        assert tgt.tolist() == [2, 80]
 
     def test_rank3_linear_decomposes_to_matmul_add(self, tmp_path):
         import paddle_tpu.nn as nn

@@ -1,0 +1,120 @@
+"""SelectedRows / TensorArray / StringTensor + backend-keyed kernels.
+
+Mirrors the reference's type-level tests (test/cpp/phi selected_rows
+tests, test/legacy_test/test_lod_tensor_array.py) and the multi-backend
+registry shape (kernel_registry.h Backend key)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.framework import (
+    SelectedRows,
+    StringTensor,
+    TensorArray,
+    array_length,
+    array_read,
+    array_write,
+    create_array,
+)
+
+
+class TestSelectedRows:
+    def test_to_dense(self):
+        v = paddle.to_tensor(np.array([[1., 2.], [3., 4.]], np.float32))
+        sr = SelectedRows(rows=[5, 1], value=v, height=8)
+        assert sr.shape == [8, 2]
+        dense = sr.to_dense().numpy()
+        assert dense.shape == (8, 2)
+        np.testing.assert_array_equal(dense[5], [1., 2.])
+        np.testing.assert_array_equal(dense[1], [3., 4.])
+        np.testing.assert_array_equal(dense[0], [0., 0.])
+
+    def test_merge_accumulates_duplicates(self):
+        v = paddle.to_tensor(np.array([[1.], [2.], [10.]], np.float32))
+        sr = SelectedRows(rows=[3, 3, 0], value=v, height=4)
+        m = sr.merge()
+        assert m.rows == [0, 3]
+        np.testing.assert_array_equal(m.value.numpy(), [[10.], [3.]])
+
+    def test_row_mismatch_raises(self):
+        v = paddle.to_tensor(np.zeros((2, 3), np.float32))
+        with pytest.raises(ValueError):
+            SelectedRows(rows=[0], value=v, height=4)
+
+
+class TestTensorArray:
+    def test_write_read_length(self):
+        arr = create_array()
+        for i in range(3):
+            array_write(paddle.to_tensor(
+                np.full((2,), float(i), np.float32)), i, arr)
+        assert array_length(arr) == 3
+        np.testing.assert_array_equal(array_read(arr, 1).numpy(),
+                                      [1., 1.])
+
+    def test_stack_concat(self):
+        arr = TensorArray([paddle.to_tensor(np.ones((2, 3), np.float32)),
+                           paddle.to_tensor(np.zeros((2, 3), np.float32))])
+        assert arr.stack().shape == [2, 2, 3]
+        assert arr.concat(axis=0).shape == [4, 3]
+
+    def test_pop_and_iter(self):
+        arr = TensorArray()
+        arr.append(paddle.to_tensor(np.ones((1,), np.float32)))
+        arr.append(paddle.to_tensor(np.zeros((1,), np.float32)))
+        popped = arr.pop()
+        assert float(popped.numpy()[0]) == 0.0
+        assert len(list(arr)) == 1
+
+
+class TestStringTensor:
+    def test_transforms(self):
+        st = StringTensor([["Hello ", "World"], ["Foo", " Bar"]])
+        assert st.shape == [2, 2]
+        assert st.lower().numpy()[0, 0] == "hello "
+        assert st.upper().numpy()[1, 0] == "FOO"
+        assert st.strip().numpy()[0, 0] == "Hello"
+
+    def test_indexing(self):
+        st = StringTensor(["a", "b", "c"])
+        assert st[1] == "b"
+        assert st[:2].shape == [2]
+
+
+class TestBackendKeyedKernels:
+    def test_variant_selected_for_current_backend(self):
+        import jax
+        from paddle_tpu._core.executor import apply
+        from paddle_tpu._core.op_registry import (
+            get_op, register_kernel, register_op)
+
+        register_op("bk_probe", lambda x: x + 1.0)
+        backend = jax.default_backend()
+        register_kernel("bk_probe", backend, lambda x: x + 100.0)
+        register_kernel("bk_probe", "no_such_backend",
+                        lambda x: x - 999.0)
+        out = apply("bk_probe", paddle.to_tensor(
+            np.zeros((2,), np.float32)))
+        np.testing.assert_array_equal(out.numpy(), [100., 100.])
+        assert get_op("bk_probe").kernel_for("other") is not None
+
+    def test_variant_grad_pairs_with_variant_fwd(self):
+        import jax
+        from paddle_tpu._core.executor import apply
+        from paddle_tpu._core.op_registry import (
+            register_kernel, register_op)
+
+        register_op("bk_grad_probe", lambda x: x * 2.0)
+        register_kernel("bk_grad_probe", jax.default_backend(),
+                        lambda x: x * 3.0)
+        x = paddle.to_tensor(np.ones((2,), np.float32),
+                             stop_gradient=False)
+        y = apply("bk_grad_probe", x)
+        y.sum().backward()
+        # grad must be of the VARIANT body (3.0), not the generic (2.0)
+        np.testing.assert_array_equal(x.grad.numpy(), [3., 3.])
+
+    def test_kernel_for_unknown_op_raises(self):
+        from paddle_tpu._core.op_registry import register_kernel
+        with pytest.raises(ValueError):
+            register_kernel("never_registered_op", "cpu", lambda x: x)
