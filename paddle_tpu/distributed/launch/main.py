@@ -42,14 +42,19 @@ def _parse_args(argv=None):
                         "runtime drives all local chips from one process")
     p.add_argument("--log_dir", type=str, default="log")
     p.add_argument("--job_id", type=str, default="default")
+    p.add_argument("--max_restarts", type=int, default=int(
+        os.environ.get("PADDLE_ELASTIC_FAULT_TOLERANC_LEVEL", 0)) or 0,
+        help="relaunch the pod up to N times on worker failure "
+             "(elastic manager restart behavior)")
     p.add_argument("script", type=str)
     p.add_argument("script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
 
 
-def _worker_env(args, local_rank: int, world: int, endpoints):
+def _worker_env(args, node_rank: int, local_rank: int, world: int,
+                endpoints, epoch: int):
     env = dict(os.environ)
-    rank = args.node_rank * args.nproc_per_node + local_rank
+    rank = node_rank * args.nproc_per_node + local_rank
     env.update({
         "PADDLE_TRAINER_ID": str(rank),
         "PADDLE_TRAINERS_NUM": str(world),
@@ -57,67 +62,169 @@ def _worker_env(args, local_rank: int, world: int, endpoints):
         "PADDLE_CURRENT_ENDPOINT": endpoints[rank] if rank < len(endpoints)
         else "",
         "PADDLE_JOB_ID": args.job_id,
+        "PADDLE_RESTART_COUNT": str(epoch),
     })
+    # workers rendezvous on the first trainer endpoint (distinct from the
+    # launcher's own master store) unless the caller pinned one
+    if "MASTER_ADDR" not in os.environ and endpoints:
+        env["MASTER_ADDR"] = endpoints[0].rsplit(":", 1)[0]
+        env["MASTER_PORT"] = endpoints[0].rsplit(":", 1)[1]
     return env
+
+
+def _spawn_pod(args, node_rank: int, world: int, endpoints, epoch: int):
+    os.makedirs(args.log_dir, exist_ok=True)
+    procs = []
+    for lr in range(args.nproc_per_node):
+        env = _worker_env(args, node_rank, lr, world, endpoints, epoch)
+        log = open(os.path.join(
+            args.log_dir,
+            f"workerlog.{node_rank}.{lr}.e{epoch}"), "w")
+        procs.append((subprocess.Popen(
+            [sys.executable, args.script] + args.script_args, env=env,
+            stdout=log, stderr=subprocess.STDOUT), log))
+    return procs
+
+
+def _kill_pod(procs):
+    for proc, _ in procs:
+        if proc.poll() is None:
+            try:
+                proc.send_signal(signal.SIGTERM)
+            except OSError:
+                pass
+    deadline = time.time() + 10
+    for proc, _ in procs:
+        try:
+            proc.wait(timeout=max(0.1, deadline - time.time()))
+        except subprocess.TimeoutExpired:
+            proc.kill()
+    for _, log in procs:
+        log.close()
+
+
+def _watch_pod(procs, master=None, epoch: int = 0):
+    """Poll until the pod finishes. Returns (rc, failed): first non-zero
+    exit fails the pod; with a master, a REMOTE node's failure signal
+    also tears this pod down (controllers/controller.py:87 watch +
+    elastic fault broadcast)."""
+    last_remote_check = 0.0
+    while procs:
+        alive = []
+        for proc, log in procs:
+            r = proc.poll()
+            if r is None:
+                alive.append((proc, log))
+            elif r != 0:
+                return r, True
+            else:
+                log.close()  # finished worker: release the handle now
+        procs[:] = alive
+        now = time.time()
+        if master is not None and now - last_remote_check > 2.0:
+            last_remote_check = now
+            if master.poll_failure(epoch):
+                return 1, True
+        time.sleep(0.3)
+    return 0, False
+
+
+def _node_host(master_host: str) -> str:
+    """This node's advertised address (NOT the master's — a remote
+    machine registering the master host would rendezvous against the
+    wrong box)."""
+    ip = os.environ.get("PADDLE_LOCAL_IP") or os.environ.get("POD_IP")
+    if ip:
+        return ip
+    if master_host in ("127.0.0.1", "localhost"):
+        return "127.0.0.1"  # single-machine (simulated multi-node)
+    import socket as _socket
+    try:
+        # UDP connect picks the outbound interface without sending
+        s = _socket.socket(_socket.AF_INET, _socket.SOCK_DGRAM)
+        s.connect((master_host, 1))
+        ip = s.getsockname()[0]
+        s.close()
+        return ip
+    except OSError:
+        return _socket.gethostbyname(_socket.gethostname())
 
 
 def main(argv=None):
     args = _parse_args(argv)
     world = args.nnodes * args.nproc_per_node
-    master = args.master or "127.0.0.1:6170"
-    host, port = (master.split(":") + ["6170"])[:2]
-    endpoints = []
-    for n in range(args.nnodes):
-        for p_ in range(args.nproc_per_node):
-            endpoints.append(f"{host}:{int(port) + n * args.nproc_per_node + p_}")
+    master_ep = args.master or "127.0.0.1:6170"
+    host, port = (master_ep.split(":") + ["6170"])[:2]
 
     if world == 1:
         # single process: exec in-place (fast path, no fork)
-        os.environ.update(_worker_env(args, 0, 1, endpoints))
+        endpoints = [f"{host}:{port}"]
+        os.environ.update(_worker_env(args, 0, 0, 1, endpoints, 0))
         sys.argv = [args.script] + args.script_args
         import runpy
         runpy.run_path(args.script, run_name="__main__")
         return 0
 
-    os.makedirs(args.log_dir, exist_ok=True)
-    procs = []
-    for lr in range(args.nproc_per_node):
-        env = _worker_env(args, lr, world, endpoints)
-        log = open(os.path.join(
-            args.log_dir, f"workerlog.{args.node_rank}.{lr}"), "w")
-        procs.append((subprocess.Popen(
-            [sys.executable, args.script] + args.script_args, env=env,
-            stdout=log, stderr=subprocess.STDOUT), log))
+    # multi-node rendezvous through the store master; single-node jobs
+    # skip it and use static port arithmetic
+    master = None
+    if args.nnodes > 1:
+        from .master import Master
+        master = Master(f"{host}:{port}", args.job_id,
+                        is_master=(args.node_rank == 0),
+                        world_nodes=args.nnodes)
 
-    # watch loop (controllers/controller.py:87 analog): first failure
-    # tears the pod down
-    rc = 0
-    try:
-        while procs:
-            alive = []
-            for proc, log in procs:
-                r = proc.poll()
-                if r is None:
-                    alive.append((proc, log))
-                elif r != 0:
-                    rc = r
-                    raise RuntimeError(
-                        f"worker pid {proc.pid} exited with {r}")
-            procs = alive
-            time.sleep(0.5)
-    except (RuntimeError, KeyboardInterrupt):
-        for proc, _ in procs:
-            try:
-                proc.send_signal(signal.SIGTERM)
-            except OSError:
-                pass
-        for proc, _ in procs:
-            proc.wait()
-        rc = rc or 1
-    finally:
-        for _, log in procs:
-            log.close()
-    return rc
+    epoch = 0
+    while True:
+        if master is not None:
+            # re-registration order fixes node ranks for THIS epoch:
+            # rerank-on-restart for free; each node advertises its OWN
+            # address
+            my_ep = (f"{_node_host(host)}:"
+                     f"{int(port) + 1 + args.node_rank * 100}")
+            node_rank = master.register_node(epoch, my_ep,
+                                             args.nproc_per_node)
+            peers = master.wait_peers(epoch)
+            from .master import global_endpoints
+            endpoints = global_endpoints(peers)
+        else:
+            node_rank = args.node_rank
+            endpoints = [
+                f"{host}:{int(port) + n * args.nproc_per_node + p_}"
+                for n in range(args.nnodes)
+                for p_ in range(args.nproc_per_node)]
+
+        procs = _spawn_pod(args, node_rank, world, endpoints, epoch)
+        rc, failed = _watch_pod(procs, master, epoch)
+        _kill_pod(procs)
+        if not failed:
+            if master is None:
+                return 0
+            # a clean node must stay in the coordination protocol: if a
+            # peer fails this epoch, everyone restarts together —
+            # otherwise the survivors would wait 300s for a node that
+            # already returned
+            master.signal_done(epoch)
+            deadline = time.time() + 600
+            while True:
+                if master.poll_done(epoch) >= args.nnodes:
+                    master.ack_exit(is_owner=(args.node_rank == 0))
+                    return 0
+                if master.poll_failure(epoch):
+                    failed, rc = True, 1
+                    break
+                if time.time() > deadline:
+                    print("[launch] timed out waiting for peer nodes "
+                          "to finish", file=sys.stderr)
+                    return 1
+                time.sleep(0.5)
+        if master is not None:
+            master.signal_failure(epoch)
+        if epoch >= args.max_restarts:
+            return rc or 1
+        epoch += 1
+        print(f"[launch] pod failed (rc={rc}); restart "
+              f"{epoch}/{args.max_restarts}", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -211,16 +211,41 @@ def _lower_node(node, rank_of, shape_of, idx):
                  "attrs": {"axis": int(a.get("axis", 0))}}]
     if op == "flatten_":
         # ONNX Flatten always yields rank 2, paddle's preserves leading
-        # dims — lower to Reshape with the statically known output shape
+        # dims — lower to Reshape. Dynamic dims: leading ones keep their
+        # index, so Reshape's 0 (copy-from-input) expresses them; at most
+        # one -1 covers a dynamic collapsed group or trailing dim.
         shape = shape_of(node.inputs[0])
         nd = len(shape)
         start = int(a.get("start", 0)) % nd
         stop = int(a.get("stop", -1)) % nd
-        mid = 1
-        for d in shape[start:stop + 1]:
-            mid *= int(d)
-        out_shape = [int(d) for d in shape[:start]] + [mid] \
-            + [int(d) for d in shape[stop + 1:]]
+
+        def dyn(d):
+            return d in (None, -1)
+
+        out_shape: List[int] = [0 if dyn(d) else int(d)
+                                for d in shape[:start]]
+        group = shape[start:stop + 1]
+        if any(dyn(d) for d in group):
+            out_shape.append(-1)
+            minus_used = True
+        else:
+            mid = 1
+            for d in group:
+                mid *= int(d)
+            out_shape.append(mid)
+            minus_used = False
+        for d in shape[stop + 1:]:
+            if dyn(d):
+                # index shifted: 0 would copy the wrong input dim
+                if minus_used:
+                    raise NotImplementedError(
+                        "paddle_tpu.onnx.export: flatten with multiple "
+                        "dynamic dims after the collapsed range is not "
+                        "expressible as one ONNX Reshape")
+                out_shape.append(-1)
+                minus_used = True
+            else:
+                out_shape.append(int(d))
         return [{"op_type": "Reshape", "attrs": {},
                  "const_inputs": [np.asarray(out_shape, np.int64)]}]
     if op in ("mean", "sum_"):
@@ -234,8 +259,8 @@ def _lower_node(node, rank_of, shape_of, idx):
             axes = [int(ax)] if isinstance(
                 ax, (int, np.integer)) else [int(x) for x in ax]
             spec["const_inputs"] = [np.asarray(axes, np.int64)]
-            if op == "mean":
-                spec["min_opset"] = 18
+            # axes-as-input exists from ReduceSum-13 / ReduceMean-18
+            spec["min_opset"] = 18 if op == "mean" else 13
         return [spec]
     if op in ("max_pool_nd", "avg_pool_nd"):
         if a.get("fmt", "NCHW") != "NCHW" or len(a["ksize"]) != 2:
