@@ -1,0 +1,132 @@
+"""Multi-config benchmark suite filling the BASELINE.md table.
+
+Separate from bench.py (the driver's single headline metric): runs the
+reference-shaped configs on the local chip and prints one JSON line per
+row. Select with BENCH_ROWS=1,2,3 (default all).
+
+Row 1  LeNet/MNIST eager dynamic-graph   steps/sec
+Row 2  ResNet-50 @to_static AMP(bf16)    images/sec/chip
+Row 3  BERT-base pretrain-style step     tokens/sec/chip
+(Rows 4-5 — multi-chip GPT/ERNIE hybrids — need a pod; their single-chip
+proxies are bench.py's headline + the dryrun_multichip compile check.)
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+
+def _timeit(fn, steps, warmup=3):
+    """Per-step host fetch (np.asarray) as the sync fence. Over the axon
+    transport block_until_ready returns eagerly, and queuing many
+    donated steps before one fetch degrades badly — per-step fetch is
+    the conservative, reproducible regime (numbers are lower bounds: a
+    local runtime without the tunnel's host-sync latency runs faster)."""
+    import numpy as np
+    for _ in range(warmup):
+        np.asarray(fn())
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        np.asarray(fn())
+    return (time.perf_counter() - t0) / steps
+
+
+def bench_lenet():
+    """Row 1: eager dygraph LeNet on synthetic MNIST batches."""
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.vision.models import LeNet
+
+    paddle.seed(0)
+    model = LeNet()
+    opt = paddle.optimizer.Adam(1e-3, parameters=model.parameters())
+    rng = np.random.RandomState(0)
+    batch = 128
+    x = paddle.to_tensor(rng.randn(batch, 1, 28, 28).astype(np.float32))
+    y = paddle.to_tensor(rng.randint(0, 10, (batch,)).astype(np.int64))
+
+    def step():
+        loss = F.cross_entropy(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss._value
+
+    sec = _timeit(step, steps=30, warmup=5)
+    return {"metric": "LeNet MNIST dygraph (b128 eager fwd+bwd+adam)",
+            "value": round(1.0 / sec, 1), "unit": "steps/s"}
+
+
+def bench_resnet50():
+    """Row 2: ResNet-50 @to_static with bf16 autocast (AMP role):
+    fwd and bwd each one XLA executable, fused-momentum a third."""
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.vision.models import resnet50
+
+    paddle.seed(0)
+    model = resnet50()
+    net = paddle.jit.to_static(model)
+    opt = paddle.optimizer.Momentum(0.1, parameters=model.parameters())
+    batch = int(os.environ.get("BENCH_RN50_BATCH", "64"))
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(batch, 3, 224, 224).astype(np.float32))
+    y = paddle.to_tensor(rng.randint(0, 1000, (batch,)).astype(np.int64))
+
+    def step():
+        with paddle.amp.auto_cast(level="O1"):
+            loss = F.cross_entropy(net(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss._value
+
+    sec = _timeit(step, steps=10, warmup=3)
+    return {"metric":
+            f"ResNet-50 @to_static train (b{batch} amp-bf16 fused-mom)",
+            "value": round(batch / sec, 1), "unit": "images/s"}
+
+
+def bench_bert():
+    """Row 3: BERT-base MLM pretrain step (compiled trainer)."""
+    import numpy as np
+    import jax.numpy as jnp
+    from paddle_tpu.models.bert import BERT_CONFIGS, build_train_step
+
+    config = BERT_CONFIGS["bert-base"]
+    batch = int(os.environ.get("BENCH_BERT_BATCH", "16"))
+    seq = int(os.environ.get("BENCH_BERT_SEQ", "512"))
+    init_fn, step = build_train_step(config, mesh=None, lr=1e-4)
+    state = init_fn(0)
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(
+        rng.randint(0, config.vocab_size, (batch, seq)), jnp.int32)
+    labels = jnp.asarray(
+        rng.randint(0, config.vocab_size, (batch, seq)), jnp.int32)
+
+    holder = {"state": state}
+
+    def one():
+        holder["state"], loss = step(holder["state"], tokens, labels)
+        return loss
+
+    sec = _timeit(one, steps=15, warmup=3)
+    return {"metric": f"BERT-base MLM pretrain (b{batch} s{seq} bf16)",
+            "value": round(batch * seq / sec, 1), "unit": "tokens/s"}
+
+
+def main():
+    rows = os.environ.get("BENCH_ROWS", "1,2,3").split(",")
+    table = {"1": bench_lenet, "2": bench_resnet50, "3": bench_bert}
+    for r in rows:
+        r = r.strip()
+        out = table[r]()
+        out["row"] = int(r)
+        print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -181,10 +181,15 @@ def main(argv=None):
             # rerank-on-restart for free; each node advertises its OWN
             # address
             my_ep = (f"{_node_host(host)}:"
-                     f"{int(port) + 1 + args.node_rank * 100}")
+                     f"{int(port) + 1 + args.node_rank * args.nproc_per_node}")
             node_rank = master.register_node(epoch, my_ep,
                                              args.nproc_per_node)
             peers = master.wait_peers(epoch)
+            if any(np_ != args.nproc_per_node for _, np_ in peers):
+                # rank/world arithmetic assumes a homogeneous pod
+                print("[launch] nproc_per_node differs across nodes: "
+                      f"{[np_ for _, np_ in peers]}", file=sys.stderr)
+                return 1
             from .master import global_endpoints
             endpoints = global_endpoints(peers)
         else:
@@ -195,7 +200,13 @@ def main(argv=None):
                 for p_ in range(args.nproc_per_node)]
 
         procs = _spawn_pod(args, node_rank, world, endpoints, epoch)
-        rc, failed = _watch_pod(procs, master, epoch)
+        try:
+            rc, failed = _watch_pod(procs, master, epoch)
+        except KeyboardInterrupt:
+            _kill_pod(procs)  # Ctrl-C must not orphan the workers
+            if master is not None:
+                master.signal_failure(epoch)
+            return 130
         _kill_pod(procs)
         if not failed:
             if master is None:
@@ -221,6 +232,11 @@ def main(argv=None):
         if master is not None:
             master.signal_failure(epoch)
         if epoch >= args.max_restarts:
+            if master is not None:
+                # terminal-failure fence (mirror of the clean-exit ack):
+                # the store owner must outlive every peer's next failure
+                # poll, or survivors never learn the job is dead
+                master.ack_exit(is_owner=(args.node_rank == 0))
             return rc or 1
         epoch += 1
         print(f"[launch] pod failed (rc={rc}); restart "

@@ -132,8 +132,15 @@ class StaticFunction:
                 def f(s, a, k):
                     out, _ = pure(s, a, k)
                     return out
-                _, pull = jax.vjp(f, svals_, args_, kwargs_)
-                return pull(cotangents)
+                primals, pull = jax.vjp(f, svals_, args_, kwargs_)
+                # downstream eager ops (e.g. an AMP'd loss) may hand back
+                # cotangents in a different float dtype than the compiled
+                # forward produced — cast to the primal dtype
+                cot = jax.tree_util.tree_map(
+                    lambda c, p: c.astype(p.dtype)
+                    if hasattr(c, "astype") and c.dtype != p.dtype else c,
+                    cotangents, primals)
+                return pull(cot)
             self._bwd_cache[key] = jax.jit(bwd)
 
         try:

@@ -132,3 +132,24 @@ def test_static_python_control_flow_untouched():
         x = _data(-1.0)
         np.testing.assert_allclose(static(x).numpy(), ref(x).numpy(),
                                    rtol=1e-5, atol=1e-6)
+
+
+def test_to_static_with_amp_loss_backward():
+    """An AMP'd loss hands bf16 cotangents back to the compiled forward's
+    f32 outputs; the jitted VJP must cast instead of rejecting them."""
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+
+    paddle.seed(0)
+    model = nn.Linear(8, 4)
+    net = paddle.jit.to_static(model)
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(2, 8).astype(np.float32))
+    y = paddle.to_tensor(np.array([1, 3], np.int64))
+    with paddle.amp.auto_cast(level="O1"):
+        loss = F.cross_entropy(net(x), y)
+    loss.backward()
+    assert model.weight.grad is not None
+    assert np.isfinite(model.weight.grad.numpy()).all()
