@@ -147,3 +147,46 @@ def is_first_worker():
 def barrier_worker():
     from ..communication import barrier
     barrier()
+
+
+# ------------------------------------------------------------- PS mode
+# fleet's parameter-server surface (fleet.py init_server/run_server/
+# init_worker/stop_worker), delegating to the RPC-backed PS service
+# (ps/service.py — brpc_ps_server/client analog).
+
+_ps_client = None
+
+
+def init_server(*model_dirs, **kwargs):
+    """Prepare the server role. A model path, when given, preloads THIS
+    server's shard ('{path}.shard{PADDLE_PSERVER_ID}' — the file layout
+    PsClient.save writes); load recreates tables as needed."""
+    if model_dirs:
+        import os as _os
+        from ..ps import get_parameter_server
+        sid = int(_os.environ.get("PADDLE_PSERVER_ID", 0))
+        get_parameter_server().load(f"{model_dirs[0]}.shard{sid}")
+    return True
+
+
+def run_server(timeout: float = 86400.0):
+    from ..ps import service
+    return service.run_server(timeout=timeout)
+
+
+def init_worker():
+    global _ps_client
+    from ..ps import service
+    _ps_client = service.init_worker()
+    return _ps_client
+
+
+def ps_client():
+    return _ps_client
+
+
+def stop_worker():
+    global _ps_client
+    from ..ps import service
+    service.stop_worker()
+    _ps_client = None

@@ -186,9 +186,13 @@ def main(argv=None):
                                              args.nproc_per_node)
             peers = master.wait_peers(epoch)
             if any(np_ != args.nproc_per_node for _, np_ in peers):
-                # rank/world arithmetic assumes a homogeneous pod
+                # rank/world arithmetic assumes a homogeneous pod; fence
+                # the exit so a peer mid-rendezvous doesn't hit a dead
+                # store
                 print("[launch] nproc_per_node differs across nodes: "
                       f"{[np_ for _, np_ in peers]}", file=sys.stderr)
+                master.signal_failure(epoch)
+                master.ack_exit(is_owner=(args.node_rank == 0))
                 return 1
             from .master import global_endpoints
             endpoints = global_endpoints(peers)
@@ -206,6 +210,7 @@ def main(argv=None):
             _kill_pod(procs)  # Ctrl-C must not orphan the workers
             if master is not None:
                 master.signal_failure(epoch)
+                master.ack_exit(is_owner=(args.node_rank == 0))
             return 130
         _kill_pod(procs)
         if not failed:

@@ -155,15 +155,20 @@ class ParameterServer:
             }, f, protocol=4)
 
     def load(self, path: str):
+        """Restore tables, creating any that are not registered yet —
+        a server preloading a checkpoint has no tables at startup
+        (they otherwise register lazily on first trainer RPC)."""
         import pickle
         with open(path, "rb") as f:
             data = pickle.load(f)
         for k, val in data["dense"].items():
-            if k in self._dense:
-                self._dense[k].value = val
+            if k not in self._dense:
+                self.register_dense_table(k, list(val.shape))
+            self._dense[k].value = val
         for k, (dim, rows) in data["sparse"].items():
-            if k in self._sparse:
-                self._sparse[k]._rows = rows
+            if k not in self._sparse:
+                self.register_sparse_table(k, dim)
+            self._sparse[k]._rows = rows
 
 
 _global_server: Optional[ParameterServer] = None

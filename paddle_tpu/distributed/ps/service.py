@@ -1,0 +1,213 @@
+"""Distributed parameter-server service: real server processes + RPC.
+
+Analog of the reference's brpc PS runtime (fluid/distributed/ps/:
+brpc_ps_server.cc / brpc_ps_client.cc + python the_one_ps.py): table
+storage and accessors stay in ps/__init__.py (the table layer); this
+module puts them behind real processes. Servers host table SHARDS and
+serve pull/push over paddle_tpu.distributed.rpc; clients route — sparse
+ids by `id % n_servers` (the reference's hash sharding), dense tables by
+name hash — and reassemble.
+
+Roles follow the reference's env contract: TRAINING_ROLE/PSERVER vs
+TRAINER, PADDLE_PSERVER_ENDPOINTS (the_one_ps.py env parsing).
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from . import Accessor, ParameterServer, get_parameter_server
+from .. import rpc
+
+# ------------------------------------------------------------- handlers
+# module-level so rpc can pickle them by reference; they run IN the
+# server process against its own table storage
+
+
+def _srv_register_dense(name, shape, kind, lr):
+    ps = get_parameter_server()
+    if name not in ps._dense:   # idempotent: a second trainer's
+        ps.register_dense_table(name, shape,   # register must not reset
+                                Accessor(kind=kind, lr=lr))
+    return True
+
+
+def _srv_register_sparse(name, dim, kind, lr):
+    ps = get_parameter_server()
+    if name not in ps._sparse:
+        ps.register_sparse_table(name, dim, Accessor(kind=kind, lr=lr))
+    return True
+
+
+def _srv_pull_dense(name):
+    return get_parameter_server().pull_dense(name)
+
+
+def _srv_push_dense(name, grad):
+    get_parameter_server().push_dense(name, grad)
+    return True
+
+
+def _srv_pull_sparse(name, ids):
+    return get_parameter_server().pull_sparse(name, ids)
+
+
+def _srv_push_sparse(name, ids, grads):
+    get_parameter_server().push_sparse(name, ids, grads)
+    return True
+
+
+def _srv_save(path):
+    get_parameter_server().save(path)
+    return True
+
+
+def _srv_load(path):
+    get_parameter_server().load(path)
+    return True
+
+
+def _srv_ping():
+    return "pong"
+
+
+# --------------------------------------------------------------- server
+
+def run_server(name: Optional[str] = None, timeout: float = 86400.0):
+    """Blocking PS server loop (fleet.run_server / brpc_ps_server.cc
+    Start). Servers take global rpc ranks [0, n_servers), trainers
+    [n_servers, n_servers+n_trainers). The server joins the world then
+    parks in the shutdown barrier — its rpc handler threads keep serving
+    pull/push until every trainer calls stop_worker()."""
+    env = ps_env()
+    sid = int(os.environ.get("PADDLE_PSERVER_ID",
+                             os.environ.get("PADDLE_TRAINER_ID", 0)))
+    world = env["n_servers"] + env["n_trainers"]
+    rpc.init_rpc(name or f"ps{sid}", rank=sid, world_size=world)
+    clean = rpc.shutdown(timeout=timeout)
+    if not clean:
+        raise TimeoutError(
+            "ps server: shutdown barrier timed out — a participant died "
+            "before calling stop_worker(); table state was NOT saved")
+    return clean
+
+
+def _srv_stop():
+    return True
+
+
+# --------------------------------------------------------------- client
+
+class PsClient:
+    """Worker-side routing client (brpc_ps_client.cc role)."""
+
+    def __init__(self, server_names: Sequence[str]):
+        self.servers = list(server_names)
+        self.n = len(self.servers)
+        if self.n == 0:
+            raise ValueError("no PS servers")
+
+    # routing ----------------------------------------------------------
+    def _dense_owner(self, name: str) -> str:
+        # stable across processes — builtin hash() is seed-randomized
+        # per interpreter and would scatter one table over many servers
+        import zlib
+        return self.servers[zlib.crc32(name.encode()) % self.n]
+
+    # dense ------------------------------------------------------------
+    def register_dense_table(self, name, shape, kind="sgd", lr=0.01):
+        rpc.rpc_sync(self._dense_owner(name), _srv_register_dense,
+                     args=(name, list(shape), kind, lr))
+
+    def pull_dense(self, name) -> np.ndarray:
+        return rpc.rpc_sync(self._dense_owner(name), _srv_pull_dense,
+                            args=(name,))
+
+    def push_dense(self, name, grad: np.ndarray):
+        rpc.rpc_sync(self._dense_owner(name), _srv_push_dense,
+                     args=(name, np.asarray(grad)))
+
+    # sparse -----------------------------------------------------------
+    def register_sparse_table(self, name, dim, kind="sgd", lr=0.01):
+        for s in self.servers:   # every shard owns part of the id space
+            rpc.rpc_sync(s, _srv_register_sparse,
+                         args=(name, dim, kind, lr))
+
+    def pull_sparse(self, name, ids: np.ndarray) -> np.ndarray:
+        """Shard ids by id %% n_servers, pull each shard, reassemble in
+        the caller's order."""
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        shard = ids % self.n
+        futs = []
+        for s in range(self.n):
+            sel = ids[shard == s]
+            futs.append(rpc.rpc_async(self.servers[s], _srv_pull_sparse,
+                                      args=(name, sel)))
+        parts = [f.wait() for f in futs]
+        # SparseTable.pull returns (0, dim) even for empty id sets, so
+        # the dim is always recoverable from any part
+        dim = parts[0].shape[1]
+        out = np.empty((ids.shape[0], dim), np.float32)
+        for s in range(self.n):
+            out[shard == s] = parts[s]
+        return out
+
+    def push_sparse(self, name, ids: np.ndarray, grads: np.ndarray):
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        grads = np.asarray(grads, np.float32)
+        shard = ids % self.n
+        futs = []
+        for s in range(self.n):
+            sel = shard == s
+            futs.append(rpc.rpc_async(
+                self.servers[s], _srv_push_sparse,
+                args=(name, ids[sel], grads[sel])))
+        for f in futs:
+            f.wait()
+
+    # control ----------------------------------------------------------
+    def save(self, path: str):
+        for i, s in enumerate(self.servers):
+            rpc.rpc_sync(s, _srv_save, args=(f"{path}.shard{i}",))
+
+    def load(self, path: str):
+        for i, s in enumerate(self.servers):
+            rpc.rpc_sync(s, _srv_load, args=(f"{path}.shard{i}",))
+
+    def ping(self) -> bool:
+        return all(rpc.rpc_sync(s, _srv_ping) == "pong"
+                   for s in self.servers)
+
+
+# ------------------------------------------------------------ fleet glue
+
+def ps_env():
+    """Parse the reference's PS env contract (the_one_ps.py)."""
+    role = os.environ.get("TRAINING_ROLE",
+                          os.environ.get("PADDLE_TRAINING_ROLE",
+                                         "TRAINER")).upper()
+    n_servers = int(os.environ.get("PADDLE_PSERVERS_NUM", "1"))
+    n_trainers = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    return {"role": role, "n_servers": n_servers,
+            "n_trainers": n_trainers,
+            "is_server": role == "PSERVER",
+            "server_names": [f"ps{i}" for i in range(n_servers)]}
+
+
+def init_worker(worker_name: Optional[str] = None) -> PsClient:
+    """Trainer-side: join the rpc world, return a routing client
+    (fleet.init_worker)."""
+    env = ps_env()
+    tid = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+    world = env["n_servers"] + env["n_trainers"]
+    rpc.init_rpc(worker_name or f"trainer{tid}",
+                 rank=env["n_servers"] + tid, world_size=world)
+    return PsClient(env["server_names"])
+
+
+def stop_worker():
+    """fleet.stop_worker: leave the rpc world (servers return from
+    run_server once every participant arrives at the barrier)."""
+    rpc.shutdown()

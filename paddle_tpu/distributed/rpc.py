@@ -181,9 +181,11 @@ def get_current_worker_info() -> WorkerInfo:
     return _state["workers"][_state["name"]]
 
 
-def shutdown():
+def shutdown(timeout: float = 60.0):
     """Barrier-synchronized teardown: nobody closes their server while a
-    peer may still call them (rpc/api.py shutdown semantics)."""
+    peer may still call them (rpc/api.py shutdown semantics). A PS
+    server parks here with a long timeout while its handler threads keep
+    serving."""
     if not _state:
         return
     store = _state["store"]
@@ -191,18 +193,19 @@ def shutdown():
     rank = _state["rank"]
     import time
 
-    def _count_up(key):
+    def _count_up(key) -> bool:
         store.add(key, 1)
-        deadline = time.time() + 60
+        deadline = time.time() + timeout
         while time.time() < deadline:
             if store.add(key, 0) >= world:
-                return
+                return True
             time.sleep(0.02)
+        return False
 
     # two phases: everyone agrees to stop, then everyone acknowledges
     # having SEEN the agreement — only then may rank 0 (the store server
     # owner) tear down, so no peer's final poll races a dead server
-    _count_up("__rpc/shutdown")
+    reached = _count_up("__rpc/shutdown")
     if rank == 0:
         _count_up("__rpc/ack")
     else:
@@ -211,3 +214,6 @@ def shutdown():
     _state["pool"].shutdown(wait=False)
     _state["futures_pool"].shutdown(wait=False)
     _state.clear()
+    # False = barrier timed out (a participant died before shutdown);
+    # a PS server uses this to report it quit on timeout, not cleanly
+    return reached
