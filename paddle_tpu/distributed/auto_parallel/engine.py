@@ -182,11 +182,49 @@ class Engine:
         self._model.set_state_dict(_load(path + ".pdparams"))
         return self
 
-    def cost(self, mode="train"):
-        """Rough cost model hook (reference engine.cost); delegates to the
-        auto_tuner cost model on the current config."""
-        from ..auto_tuner.cost_model import estimate_step_cost
-        return estimate_step_cost({})
+    def cost(self, mode="train", **overrides):
+        """Predicted (seconds/step, bytes/chip) for THIS model on the
+        current mesh (reference engine.cost / engine.py:cost): the real
+        parameter count and the mesh's dp/mp/pp degrees feed the
+        auto_tuner analytic model; kwargs override any knob."""
+        from ..auto_tuner.cost_model import (estimate_memory,
+                                             estimate_step_cost)
+        cfg = {}
+        mesh = get_mesh()
+        if mesh is not None:
+            for axis, size in zip(mesh.dim_names, mesh.shape):
+                if axis in ("dp", "mp", "pp"):
+                    cfg[f"{axis}_degree"] = int(size)
+        n = self._n_params()
+        if n:
+            cfg["n_params"] = n
+        cfg.update(overrides)
+        return {"step_time": estimate_step_cost(cfg),
+                "memory": estimate_memory(cfg)}
+
+    def _n_params(self) -> int:
+        if self._model is None:
+            return 0
+        return int(sum(p.size for p in self._model.parameters()))
+
+    def tune(self, world_size=None, tune_space=None, max_trials=0,
+             run_trials=False):
+        """Search parallel configs for this model (reference
+        auto_tuner entry): analytic ranking, optionally refined by real
+        subprocess trial jobs."""
+        import jax
+
+        from ..auto_tuner import AutoTuner, measure_step_time
+        cfg = {}
+        n = self._n_params()
+        if n:
+            cfg["n_params"] = n
+        tuner = AutoTuner(
+            cfg, world_size or len(jax.devices()),
+            tune_space=tune_space,
+            trial_fn=measure_step_time if run_trials else None,
+            max_trials=max_trials)
+        return tuner.tune()
 
 
 def to_static(layer=None, loader=None, loss=None, optimizer=None,

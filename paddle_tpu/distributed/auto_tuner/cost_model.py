@@ -37,7 +37,8 @@ def estimate_memory(config: Dict) -> float:
     zero = config.get("sharding_stage", 0)
     recompute = config.get("recompute", True)
 
-    n_params = 12 * L * h * h + 2 * v * h
+    # a measured parameter count beats the decoder-LLM formula
+    n_params = config.get("n_params") or (12 * L * h * h + 2 * v * h)
     shard = mp * pp * (dp if zero >= 1 else 1)
     # bf16 params + fp32 master/m/v (16 bytes/param when ZeRO shards all)
     param_bytes = n_params * 2 / (mp * pp)
@@ -61,11 +62,10 @@ def estimate_step_cost(config: Dict) -> float:
     pp = config.get("pp_degree", 1)
     micro = config.get("pp_microbatches", 2 * pp)
 
-    flops = 6 * gb * s * (12 * L * h * h + v * h)   # fwd+bwd matmul FLOPs
+    n_params = config.get("n_params") or (12 * L * h * h + 2 * v * h)
+    flops = 6 * gb * s * n_params    # fwd+bwd matmul FLOPs (6N rule)
     compute_t = flops / (dp * mp * pp) / (c["chip_flops"] * c["mfu"])
-
     # dp grad allreduce (ring) + mp per-layer allreduce volumes
-    n_params = 12 * L * h * h + 2 * v * h
     dp_comm = 2 * n_params * 2 * (dp - 1) / dp / c["ici_bandwidth"] \
         if dp > 1 else 0.0
     mp_comm = (4 * L * gb / dp * s * h * 2 * (mp - 1) / mp

@@ -19,7 +19,8 @@ def _divisible(config: Dict) -> bool:
         return False
     if config.get("hidden_size", mp) % mp:
         return False
-    gb = config.get("global_batch_size", 1)
+    # unspecified batch: assume at least one micro-batch per dp replica
+    gb = config.get("global_batch_size") or dp
     if gb % dp:
         return False
     return True

@@ -1,0 +1,126 @@
+"""Trial-job runner: measure real step times for candidate parallel
+configs. Like the reference's auto_tuner (which launches trial JOBS and
+reads their timings), each trial runs in its own subprocess: a config
+that OOMs or trips a compiler abort kills only its trial and scores
++inf, never the tuner. The trial itself is a pjit'd mini training step
+on the actual device mesh — the same SPMD program shape the full job
+would compile.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+
+def measure_step_time(config: Dict, steps: int = 5, warmup: int = 2,
+                      timeout: float = 300.0) -> float:
+    """Run one trial job in a subprocess; +inf on any failure."""
+    payload = dict(config, _steps=steps, _warmup=warmup)
+    env = dict(os.environ)
+    env["PT_TRIAL_CONFIG"] = json.dumps(payload)
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m",
+             "paddle_tpu.distributed.auto_tuner.trial_runner"],
+            env=env, capture_output=True, text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        return float("inf")
+    for line in reversed(proc.stdout.strip().splitlines() or [""]):
+        if line.startswith("PT_TRIAL_SECONDS="):
+            try:
+                return float(line.split("=", 1)[1])
+            except ValueError:
+                return float("inf")
+    return float("inf")
+
+
+def _measure_in_process(config: Dict, steps: int = 5,
+                        warmup: int = 2) -> float:
+    """Build the flagship train step under `config`'s dp/mp/pp degrees
+    on the real device set and measure seconds/step. Returns +inf when
+    the config cannot be built (OOM / infeasible mesh) so the tuner
+    naturally deprioritizes it — the reference's failed-trial path."""
+    import dataclasses
+
+    import jax
+
+    from ...models.gpt import GPT_CONFIGS, GPTConfig, build_train_step
+    from ..mesh import auto_mesh
+
+    dp = int(config.get("dp_degree", 1))
+    mp = int(config.get("mp_degree", 1))
+    pp = int(config.get("pp_degree", 1))
+    n = dp * mp * pp
+    if n > len(jax.devices()):
+        return float("inf")
+    try:
+        # bf16 only on real TPU: XLA:CPU check-fails compiling some
+        # sharded bf16 programs (the multichip dryrun avoids it too)
+        dtype = "bfloat16" if jax.default_backend() == "tpu" \
+            else "float32"
+        model_cfg = GPTConfig(
+            vocab_size=int(config.get("vocab_size", 8192)),
+            hidden_size=int(config.get("hidden_size", 256)),
+            num_layers=int(config.get("num_layers", 4)),
+            num_heads=int(config.get("num_heads", 8)),
+            max_position_embeddings=int(config.get("seq_len", 256)),
+            dtype=dtype)
+        mesh_axes = [("dp", dp)]
+        if pp > 1:
+            mesh_axes.append(("pp", pp))
+        mesh_axes.append(("mp", mp))
+        pm = auto_mesh(*[d for _, d in mesh_axes],
+                       dim_names=[nm for nm, _ in mesh_axes])
+        mesh = pm.jax_mesh()
+        init_fn, step = build_train_step(model_cfg, mesh=mesh, lr=1e-4,
+                                         remat=bool(config.get(
+                                             "recompute", True)))
+        state = init_fn(0)
+        gb = int(config.get("global_batch_size", max(8, dp)))
+        seq = int(config.get("seq_len", 256))
+        rng = np.random.RandomState(0)
+        tokens = np.asarray(rng.randint(0, model_cfg.vocab_size,
+                                        (gb, seq)), np.int32)
+        labels = np.asarray(rng.randint(0, model_cfg.vocab_size,
+                                        (gb, seq)), np.int32)
+
+        def one():
+            nonlocal state
+            state, loss = step(state, tokens, labels)
+            return loss
+
+        for _ in range(warmup):
+            np.asarray(one())   # fetch = hard sync (bench convention)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            np.asarray(one())
+        return (time.perf_counter() - t0) / steps
+    except Exception:
+        return float("inf")
+
+
+def _main():
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        # env alone is not enough where a device plugin overrides it;
+        # the config update must land before any backend init
+        import jax
+        jax.config.update("jax_platforms", plat.split(",")[0])
+    cfg = json.loads(os.environ["PT_TRIAL_CONFIG"])
+    steps = int(cfg.pop("_steps", 5))
+    warmup = int(cfg.pop("_warmup", 2))
+    sec = _measure_in_process(cfg, steps=steps, warmup=warmup)
+    print(f"PT_TRIAL_SECONDS={sec}", flush=True)
+
+
+if __name__ == "__main__":
+    _main()

@@ -210,7 +210,10 @@ def main(argv=None):
             _kill_pod(procs)  # Ctrl-C must not orphan the workers
             if master is not None:
                 master.signal_failure(epoch)
-                master.ack_exit(is_owner=(args.node_rank == 0))
+                # peers take the restart path and may never ack: bound
+                # the owner's grace period instead of the 60s default
+                master.ack_exit(is_owner=(args.node_rank == 0),
+                                timeout=5.0)
             return 130
         _kill_pod(procs)
         if not failed:

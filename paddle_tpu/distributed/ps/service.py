@@ -25,19 +25,32 @@ from .. import rpc
 # module-level so rpc can pickle them by reference; they run IN the
 # server process against its own table storage
 
+import threading as _threading
+
+_register_lock = _threading.Lock()  # rpc handlers run in a thread pool
+
 
 def _srv_register_dense(name, shape, kind, lr):
     ps = get_parameter_server()
-    if name not in ps._dense:   # idempotent: a second trainer's
-        ps.register_dense_table(name, shape,   # register must not reset
-                                Accessor(kind=kind, lr=lr))
+    with _register_lock:  # check+register must be atomic (TOCTOU)
+        if name not in ps._dense:
+            ps.register_dense_table(name, shape,
+                                    Accessor(kind=kind, lr=lr))
+        else:
+            # re-register (second trainer, or a checkpoint-preloaded
+            # table): keep the VALUES but honor the requested optimizer
+            ps._dense[name].accessor = Accessor(kind=kind, lr=lr)
     return True
 
 
 def _srv_register_sparse(name, dim, kind, lr):
     ps = get_parameter_server()
-    if name not in ps._sparse:
-        ps.register_sparse_table(name, dim, Accessor(kind=kind, lr=lr))
+    with _register_lock:
+        if name not in ps._sparse:
+            ps.register_sparse_table(name, dim,
+                                     Accessor(kind=kind, lr=lr))
+        else:
+            ps._sparse[name].accessor = Accessor(kind=kind, lr=lr)
     return True
 
 

@@ -207,7 +207,15 @@ def shutdown(timeout: float = 60.0):
     # owner) tear down, so no peer's final poll races a dead server
     reached = _count_up("__rpc/shutdown")
     if rank == 0:
-        _count_up("__rpc/ack")
+        if reached:
+            # phase 1 succeeded so all peers are alive: acks arrive
+            # promptly — a bounded wait, never another full `timeout`
+            saved = timeout
+            timeout = min(saved, 60.0)
+            reached = _count_up("__rpc/ack") and reached
+            timeout = saved
+        else:
+            store.add("__rpc/ack", 1)  # don't double the hang on failure
     else:
         store.add("__rpc/ack", 1)
     _state["server"].close()

@@ -47,14 +47,25 @@ def _trainer_main():
     ids = np.array([0, 1, 2, 3, 10, 11], np.int64)  # even->ps0, odd->ps1
     rows = client.pull_sparse("emb", ids)
     assert rows.shape == (6, 3)
-    # push a deterministic grad on trainer 0 only; barrier via ping
+    # table-based barrier: BOTH baselines must exist before trainer 0
+    # pushes, or a slow trainer's baseline would already include the
+    # update. sgd lr=1 on a [1] table: each push of grad -1 adds +1.
+    import time
+    client.register_dense_table("baseline_bar", [1], kind="sgd", lr=1.0)
+    client.push_dense("baseline_bar", -np.ones(1, np.float32))
     if tid == 0:
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            # wait until both trainers bumped the barrier
+            lvl = client.pull_dense("baseline_bar")
+            if lvl[0] >= 2.0 - 0.5:  # init value is ~0 (std small)
+                break
+            time.sleep(0.05)
         client.push_sparse("emb", np.array([2], np.int64),
                            -np.ones((1, 3), np.float32))
     # both trainers converge on seeing the update; trainers are not
     # phase-synchronized (staggered process startup), so the window must
     # cover a slow peer's whole warmup
-    import time
     deadline = time.time() + 120
     while time.time() < deadline:
         after = client.pull_sparse("emb", np.array([2], np.int64))
