@@ -219,6 +219,8 @@ class Engine:
         n = self._n_params()
         if n:
             cfg["n_params"] = n
+        if run_trials and max_trials <= 0:
+            max_trials = 3   # "run trials" must actually run some
         tuner = AutoTuner(
             cfg, world_size or len(jax.devices()),
             tune_space=tune_space,

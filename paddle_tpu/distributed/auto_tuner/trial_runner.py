@@ -49,11 +49,9 @@ def _measure_in_process(config: Dict, steps: int = 5,
     on the real device set and measure seconds/step. Returns +inf when
     the config cannot be built (OOM / infeasible mesh) so the tuner
     naturally deprioritizes it — the reference's failed-trial path."""
-    import dataclasses
-
     import jax
 
-    from ...models.gpt import GPT_CONFIGS, GPTConfig, build_train_step
+    from ...models.gpt import GPTConfig, build_train_step
     from ..mesh import auto_mesh
 
     dp = int(config.get("dp_degree", 1))

@@ -5,3 +5,67 @@ from . import distributed  # noqa: F401
 from . import asp  # noqa: F401
 
 __all__ = ["nn", "distributed", "asp"]
+
+
+def _make_segment(op_name, jax_fn_name, zero_fill_empty):
+    from .._core.executor import apply
+    from .._core.op_registry import register_op
+
+    def kernel(data, ids, num_segments):
+        import jax
+        import jax.numpy as jnp
+        fn = getattr(jax.ops, jax_fn_name)
+        out = fn(data, ids, num_segments=num_segments)
+        if zero_fill_empty:
+            # jax fills empty segments with the dtype's +-extreme (inf
+            # or iinfo min/max); the reference fills 0 — detect empties
+            # by member count so int dtypes are handled too
+            ones = jnp.ones(ids.shape[:1], jnp.int32)
+            count = jax.ops.segment_sum(ones, ids,
+                                        num_segments=num_segments)
+            shape = (num_segments,) + (1,) * (data.ndim - 1)
+            out = jnp.where(count.reshape(shape) > 0, out,
+                            jnp.zeros((), out.dtype))
+        return out
+
+    register_op(op_name, kernel)
+
+    def api(data, segment_ids, name=None):
+        """paddle.incubate.segment_* (segment_pool op family)."""
+        import numpy as np
+        n = int(np.asarray(segment_ids._value).max()) + 1 \
+            if segment_ids.size else 0
+        return apply(op_name, data, segment_ids, num_segments=n)
+
+    return api
+
+
+segment_sum = _make_segment("segment_sum", "segment_sum", False)
+segment_max = _make_segment("segment_max", "segment_max", True)
+segment_min = _make_segment("segment_min", "segment_min", True)
+
+
+def segment_mean(data, segment_ids, name=None):
+    """Mean over segments (segment_pool MEAN)."""
+    import jax.numpy as jnp
+    from .._core.executor import apply
+    from .._core.op_registry import get_op, register_op
+    try:
+        get_op("segment_mean")
+    except Exception:
+        def kernel(data, ids, num_segments):
+            import jax
+            s = jax.ops.segment_sum(data, ids, num_segments=num_segments)
+            ones = jnp.ones(ids.shape[:1] + (1,) * (data.ndim - 1),
+                            data.dtype)
+            c = jax.ops.segment_sum(ones, ids,
+                                    num_segments=num_segments)
+            return s / jnp.maximum(c, 1)
+        register_op("segment_mean", kernel)
+    import numpy as np
+    n = int(np.asarray(segment_ids._value).max()) + 1 \
+        if segment_ids.size else 0
+    return apply("segment_mean", data, segment_ids, num_segments=n)
+
+
+__all__ += ["segment_sum", "segment_mean", "segment_max", "segment_min"]

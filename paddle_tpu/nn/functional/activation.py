@@ -169,3 +169,13 @@ def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
 
 def silu_(x):
     return silu(x)
+
+
+@register_op("log_sigmoid")
+def _log_sigmoid_kernel(x):
+    return jax.nn.log_sigmoid(x)
+
+
+def log_sigmoid(x, name=None):
+    """F.log_sigmoid (activation.py log_sigmoid; ops.yaml logsigmoid)."""
+    return apply("log_sigmoid", x)

@@ -266,3 +266,65 @@ def cosine_embedding_loss(input1, input2, label, margin=0.0,
     if reduction == "sum":
         return R.sum(out)
     return out
+
+
+def _margin_ce_kernel(logits, label, margin1, margin2, margin3, scale):
+    """ArcFace-family margin softmax (margin_cross_entropy_kernel.cu,
+    mp_ops margin_cross_entropy): cos(m1*theta + m2) - m3 on the target
+    class, scaled softmax CE. Single-group version; the mp-sharded
+    variant runs under the vocab-parallel CE machinery."""
+    theta = jnp.arccos(jnp.clip(logits, -1.0 + 1e-7, 1.0 - 1e-7))
+    n = logits.shape[0]
+    onehot = jax.nn.one_hot(label, logits.shape[1], dtype=logits.dtype)
+    adj = jnp.cos(margin1 * theta + margin2) - margin3
+    out = jnp.where(onehot > 0, adj, logits) * scale
+    logp = jax.nn.log_softmax(out, axis=-1)
+    loss = -jnp.take_along_axis(logp, label[:, None], axis=1)
+    return loss, jax.nn.softmax(out, axis=-1)
+
+
+register_op("margin_cross_entropy", _margin_ce_kernel, multi_output=True)
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean",
+                         name=None):
+    if group is not None and group is not False:
+        raise NotImplementedError(
+            "margin_cross_entropy: model-parallel group support requires "
+            "the vocab-parallel CE path; shard logits there instead")
+    loss, softmax = apply("margin_cross_entropy", logits, label,
+                          margin1=float(margin1), margin2=float(margin2),
+                          margin3=float(margin3), scale=float(scale))
+    if reduction == "mean":
+        loss = loss.mean()
+    elif reduction == "sum":
+        loss = loss.sum()
+    return (loss, softmax) if return_softmax else loss
+
+
+def _gather_tree_kernel(ids, parents):
+    """Beam-search backtrack (gather_tree_kernel.cc): ids/parents
+    [T, B, W] -> full predicted sequences by walking parent pointers
+    from the last step backwards (lax.scan, not a python loop)."""
+    t = ids.shape[0]
+
+    def step(beam, i):
+        # beam: [B, W] current beam index per slot at time i+1
+        idx = t - 1 - i
+        cur = jnp.take_along_axis(ids[idx], beam, axis=1)
+        parent = jnp.take_along_axis(parents[idx], beam, axis=1)
+        return parent, cur
+
+    init = jnp.broadcast_to(jnp.arange(ids.shape[2])[None, :],
+                            ids.shape[1:])
+    _, rev = jax.lax.scan(step, init, jnp.arange(t))
+    return jnp.flip(rev, axis=0)
+
+
+register_op("gather_tree", _gather_tree_kernel)
+
+
+def gather_tree(ids, parents):
+    return apply("gather_tree", ids, parents)

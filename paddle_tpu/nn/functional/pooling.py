@@ -288,3 +288,38 @@ def adaptive_avg_pool1d(x, output_size, name=None):
     from ...ops.manipulation import unsqueeze, squeeze
     out = adaptive_avg_pool2d(unsqueeze(x, 3), (int(output_size), 1))
     return squeeze(out, 3)
+
+
+def _max_unpool2d_kernel(x, indices, out_h, out_w):
+    """Scatter pooled values back to their argmax positions
+    (unpool_kernel.cc): x/indices [N,C,H,W], indices flat into out
+    H*W."""
+    n, c, h, w = x.shape
+    flat_x = x.reshape(n, c, -1)
+    flat_i = indices.reshape(n, c, -1)
+    out = jnp.zeros((n, c, out_h * out_w), x.dtype)
+    out = jax.vmap(jax.vmap(
+        lambda o, idx, v: o.at[idx].set(v)))(out, flat_i, flat_x)
+    return out.reshape(n, c, out_h, out_w)
+
+
+register_op("max_unpool2d", _max_unpool2d_kernel)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    """F.max_unpool2d (vision decode path; pairs with
+    max_pool2d(..., return_mask=True))."""
+    if data_format != "NCHW":
+        raise NotImplementedError("max_unpool2d: NCHW only")
+    ks = _pair(kernel_size)
+    st = _pair(stride) if stride is not None else ks
+    if output_size is not None:
+        out_h, out_w = int(output_size[-2]), int(output_size[-1])
+    else:
+        h, w = x.shape[-2], x.shape[-1]
+        pad = _pair(padding)
+        out_h = (h - 1) * st[0] - 2 * pad[0] + ks[0]
+        out_w = (w - 1) * st[1] - 2 * pad[1] + ks[1]
+    return apply("max_unpool2d", x, indices, out_h=int(out_h),
+                 out_w=int(out_w))

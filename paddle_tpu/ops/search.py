@@ -334,3 +334,35 @@ def unique_consecutive(x, return_inverse=False, return_counts=False,
         counts = np.diff(np.append(pos, n))
         results.append(Tensor(jnp.asarray(counts)))
     return results[0] if len(results) == 1 else tuple(results)
+
+
+def _top_p_kernel(x, ps, seed):
+    """Nucleus sampling (top_p_sampling op): keep the smallest
+    probability mass >= p per row, renormalize, sample one id."""
+    sorted_p, sorted_idx = jax.lax.top_k(x, x.shape[-1])
+    cum = jnp.cumsum(sorted_p, axis=-1)
+    # keep tokens while the mass BEFORE them is < p (always >= 1 token)
+    keep = (cum - sorted_p) < ps[..., None]
+    filt = jnp.where(keep, sorted_p, 0.0)
+    filt = filt / filt.sum(axis=-1, keepdims=True)
+    key = jax.random.PRNGKey(seed)
+    choice = jax.random.categorical(key, jnp.log(filt + 1e-20), axis=-1)
+    ids = jnp.take_along_axis(sorted_idx, choice[..., None], axis=-1)
+    probs = jnp.take_along_axis(filt, choice[..., None], axis=-1)
+    return probs, ids.astype(jnp.int64)
+
+
+register_op("top_p_sampling", _top_p_kernel, multi_output=True)
+
+
+def top_p_sampling(x, ps, threshold=None, seed=None, name=None, **kw):
+    """paddle.tensor.top_p_sampling: x [B, V] probabilities, ps [B]
+    per-row nucleus mass. Returns (sampled_probs, sampled_ids)."""
+    if seed is None or seed < 0:
+        # fresh randomness per call (Paddle's seed=-1 semantics), still
+        # reproducible under paddle.seed: fold the split global key
+        from .._core import random as _rnd
+        key = _rnd.next_key()
+        seed = int(np.asarray(
+            jax.random.key_data(key)).ravel()[-1]) & 0x7FFFFFFF
+    return apply("top_p_sampling", x, ps, seed=int(seed))

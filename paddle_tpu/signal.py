@@ -110,3 +110,57 @@ def istft(x, n_fft, hop_length=None, win_length=None, window=None,
         register_op(key, lambda x, **kw: _istft_kernel(x, None, **kw))
     return apply(key, x, n_fft=n_fft, hop_length=hop_length, center=center,
                  normalized=normalized, onesided=onesided, length=length)
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Slice overlapping frames (signal.py frame / frame op): last-axis
+    input [..., N] -> [..., frame_length, num_frames] (axis=-1)."""
+    from ._core.executor import apply
+    n = x.shape[-1]
+    if frame_length > n:
+        raise ValueError(
+            f"frame_length ({frame_length}) exceeds signal length ({n})")
+    return apply("signal_frame", x, frame_length=int(frame_length),
+                 hop_length=int(hop_length), axis=int(axis))
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """Inverse of frame (overlap_add op): [..., frame_length, n_frames]
+    -> [..., output_len] with overlapping frames summed."""
+    from ._core.executor import apply
+    return apply("signal_overlap_add", x, hop_length=int(hop_length),
+                 axis=int(axis))
+
+
+def _frame_kernel(x, frame_length, hop_length, axis):
+    import jax.numpy as jnp
+    if axis not in (-1, x.ndim - 1):
+        raise NotImplementedError("frame: only axis=-1 supported")
+    n = x.shape[-1]
+    num = 1 + (n - frame_length) // hop_length
+    starts = jnp.arange(num) * hop_length
+    idx = starts[None, :] + jnp.arange(frame_length)[:, None]
+    return x[..., idx]   # [..., frame_length, num]
+
+
+def _overlap_add_kernel(x, hop_length, axis):
+    import jax.numpy as jnp
+    if axis not in (-1, x.ndim - 1):
+        raise NotImplementedError("overlap_add: only axis=-1 supported")
+    fl, num = x.shape[-2], x.shape[-1]
+    out_len = (num - 1) * hop_length + fl
+    starts = jnp.arange(num) * hop_length
+    idx = starts[None, :] + jnp.arange(fl)[:, None]   # [fl, num]
+    flat_idx = idx.reshape(-1)
+    vals = x.reshape(x.shape[:-2] + (-1,))
+    zero = jnp.zeros(x.shape[:-2] + (out_len,), x.dtype)
+    return zero.at[..., flat_idx].add(vals)
+
+
+def _register_frame_ops():
+    from ._core.op_registry import register_op
+    register_op("signal_frame", _frame_kernel)
+    register_op("signal_overlap_add", _overlap_add_kernel)
+
+
+_register_frame_ops()

@@ -1,0 +1,341 @@
+"""paddle.vision.ops — detection operators.
+
+Analogs of the reference's detection kernels (phi/kernels: roi_align,
+roi_pool, nms, box_coder, prior_box, yolo_box; python surface
+python/paddle/vision/ops.py). TPU-native shapes: everything is
+fixed-shape, mask-based math — NMS returns a keep mask computed by a
+triangular suppression sweep (lax.fori-style, compiles to one program)
+instead of a dynamic-length index list.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .._core.executor import apply
+from .._core.op_registry import register_op
+
+__all__ = ["roi_align", "roi_pool", "nms", "box_coder", "prior_box",
+           "yolo_box"]
+
+
+# ----------------------------------------------------------- roi align
+
+def _roi_align_kernel(x, boxes, boxes_num, pooled_height, pooled_width,
+                      spatial_scale, sampling_ratio, aligned):
+    """x: [N,C,H,W]; boxes: [R,4] (x1,y1,x2,y2); boxes_num: [N] rois per
+    image. Bilinear sampling at sampling_ratio^2 points per bin
+    (roi_align_kernel.cc semantics)."""
+    n, c, h, w = x.shape
+    r = boxes.shape[0]
+    # map each roi to its image index from boxes_num
+    img_idx = jnp.repeat(jnp.arange(n), boxes_num, axis=0,
+                         total_repeat_length=r)
+    offset = 0.5 if aligned else 0.0
+    x1 = boxes[:, 0] * spatial_scale - offset
+    y1 = boxes[:, 1] * spatial_scale - offset
+    x2 = boxes[:, 2] * spatial_scale - offset
+    y2 = boxes[:, 3] * spatial_scale - offset
+    roi_w = x2 - x1
+    roi_h = y2 - y1
+    if not aligned:
+        roi_w = jnp.maximum(roi_w, 1.0)
+        roi_h = jnp.maximum(roi_h, 1.0)
+    bin_w = roi_w / pooled_width
+    bin_h = roi_h / pooled_height
+    s = sampling_ratio if sampling_ratio > 0 else 2
+    # sample grid per bin: [ph, pw, s, s] offsets
+    py = (jnp.arange(pooled_height)[:, None, None, None]
+          + (jnp.arange(s)[None, None, :, None] + 0.5) / s)
+    px = (jnp.arange(pooled_width)[None, :, None, None]
+          + (jnp.arange(s)[None, None, None, :] + 0.5) / s)
+    # absolute coords per roi: [R, ph, pw, s, s]
+    yy = y1[:, None, None, None, None] + py[None] * \
+        bin_h[:, None, None, None, None]
+    xx = x1[:, None, None, None, None] + px[None] * \
+        bin_w[:, None, None, None, None]
+
+    def bilinear(img, ys, xs):
+        # img [C,H,W]; ys/xs [...]: gather 4 corners
+        y0 = jnp.clip(jnp.floor(ys), 0, h - 1)
+        x0 = jnp.clip(jnp.floor(xs), 0, w - 1)
+        y1_ = jnp.clip(y0 + 1, 0, h - 1)
+        x1_ = jnp.clip(x0 + 1, 0, w - 1)
+        wy = jnp.clip(ys, 0, h - 1) - y0
+        wx = jnp.clip(xs, 0, w - 1) - x0
+        y0i, x0i = y0.astype(jnp.int32), x0.astype(jnp.int32)
+        y1i, x1i = y1_.astype(jnp.int32), x1_.astype(jnp.int32)
+        v00 = img[:, y0i, x0i]
+        v01 = img[:, y0i, x1i]
+        v10 = img[:, y1i, x0i]
+        v11 = img[:, y1i, x1i]
+        return (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx
+                + v10 * wy * (1 - wx) + v11 * wy * wx)
+
+    def per_roi(i):
+        img = x[img_idx[i]]
+        vals = bilinear(img, yy[i], xx[i])     # [C, ph, pw, s, s]
+        return vals.mean(axis=(-1, -2))        # [C, ph, pw]
+
+    return jax.vmap(per_roi)(jnp.arange(r))
+
+
+register_op("roi_align", _roi_align_kernel)
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    oh, ow = (output_size, output_size) if isinstance(output_size, int) \
+        else tuple(output_size)
+    return apply("roi_align", x, boxes, boxes_num,
+                 pooled_height=int(oh), pooled_width=int(ow),
+                 spatial_scale=float(spatial_scale),
+                 sampling_ratio=int(sampling_ratio),
+                 aligned=bool(aligned))
+
+
+def _roi_pool_kernel(x, boxes, boxes_num, pooled_height, pooled_width,
+                     spatial_scale):
+    """Max pooling over quantized roi bins (roi_pool_kernel.cc)."""
+    n, c, h, w = x.shape
+    r = boxes.shape[0]
+    img_idx = jnp.repeat(jnp.arange(n), boxes_num, axis=0,
+                         total_repeat_length=r)
+    x1 = jnp.round(boxes[:, 0] * spatial_scale).astype(jnp.int32)
+    y1 = jnp.round(boxes[:, 1] * spatial_scale).astype(jnp.int32)
+    x2 = jnp.round(boxes[:, 2] * spatial_scale).astype(jnp.int32)
+    y2 = jnp.round(boxes[:, 3] * spatial_scale).astype(jnp.int32)
+    roi_w = jnp.maximum(x2 - x1 + 1, 1)
+    roi_h = jnp.maximum(y2 - y1 + 1, 1)
+
+    ys = jnp.arange(h)
+    xs = jnp.arange(w)
+
+    def per_roi(i):
+        img = x[img_idx[i]]                      # [C,H,W]
+        # bin index of every pixel for this roi, or -1 outside
+        by = ((ys - y1[i]) * pooled_height) // roi_h[i]
+        bx = ((xs - x1[i]) * pooled_width) // roi_w[i]
+        in_y = (ys >= y1[i]) & (ys <= y2[i])
+        in_x = (xs >= x1[i]) & (xs <= x2[i])
+        by = jnp.where(in_y, jnp.clip(by, 0, pooled_height - 1), -1)
+        bx = jnp.where(in_x, jnp.clip(bx, 0, pooled_width - 1), -1)
+        onehot_y = (by[:, None] == jnp.arange(pooled_height)[None, :])
+        onehot_x = (bx[:, None] == jnp.arange(pooled_width)[None, :])
+        # [C,H,W] -> [C,ph,pw] max over member pixels
+        masked = jnp.where(
+            (onehot_y.T[None, :, :, None, None]
+             & onehot_x.T[None, None, None, :, :]),
+            img[:, None, :, None, :],
+            -jnp.inf)  # [C, ph, H, pw, W]
+        out = masked.max(axis=(2, 4))
+        return jnp.where(jnp.isfinite(out), out, 0.0)
+
+    return jax.vmap(per_roi)(jnp.arange(r))
+
+
+register_op("roi_pool", _roi_pool_kernel)
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+             name=None):
+    oh, ow = (output_size, output_size) if isinstance(output_size, int) \
+        else tuple(output_size)
+    return apply("roi_pool", x, boxes, boxes_num, pooled_height=int(oh),
+                 pooled_width=int(ow),
+                 spatial_scale=float(spatial_scale))
+
+
+# ----------------------------------------------------------------- nms
+
+def _iou_matrix(boxes):
+    x1, y1, x2, y2 = (boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3])
+    area = jnp.maximum(x2 - x1, 0) * jnp.maximum(y2 - y1, 0)
+    ix1 = jnp.maximum(x1[:, None], x1[None, :])
+    iy1 = jnp.maximum(y1[:, None], y1[None, :])
+    ix2 = jnp.minimum(x2[:, None], x2[None, :])
+    iy2 = jnp.minimum(y2[:, None], y2[None, :])
+    inter = jnp.maximum(ix2 - ix1, 0) * jnp.maximum(iy2 - iy1, 0)
+    union = area[:, None] + area[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def _nms_kernel(boxes, scores, iou_threshold):
+    """Greedy NMS as a fixed-shape suppression sweep: process boxes in
+    score order; keep a box iff no higher-scored KEPT box overlaps it
+    past the threshold (nms_kernel.cc semantics, lax.scan not python)."""
+    order = jnp.argsort(-scores)
+    b = boxes[order]
+    iou = _iou_matrix(b)
+    n = b.shape[0]
+
+    def body(i, keep):
+        # keep[i] = no kept j<i with iou > thr
+        sup = (iou[i] > iou_threshold) & keep & (jnp.arange(n) < i)
+        return keep.at[i].set(~jnp.any(sup))
+
+    keep_sorted = jax.lax.fori_loop(0, n, body, jnp.ones(n, bool))
+    # map back to original indices, score-ordered like the reference
+    kept_idx = jnp.where(keep_sorted, order, n)
+    return jnp.sort(jnp.where(keep_sorted,
+                              jnp.arange(n), n)), kept_idx, keep_sorted
+
+
+register_op("nms_mask", lambda boxes, scores, iou_threshold:
+            _nms_kernel(boxes, scores, iou_threshold)[2],)
+
+
+def nms(boxes, scores=None, iou_threshold=0.3, top_k=None,
+        category_idxs=None, categories=None, name=None):
+    """Returns kept box indices in descending-score order (vision/ops.py
+    nms). Fixed-shape mask computed on device; the final index
+    compaction is a host-side gather (dynamic shapes don't compile)."""
+    from .._core.tensor import Tensor
+    if scores is None:
+        scores = Tensor(jnp.ones((boxes.shape[0],), jnp.float32))
+    keep_mask = apply("nms_mask", boxes, scores,
+                      iou_threshold=float(iou_threshold))
+    mask = np.asarray(keep_mask._value)
+    sc = np.asarray(scores._value)
+    idx = np.nonzero(mask[np.argsort(-sc)])[0]
+    kept = np.argsort(-sc)[idx]
+    if top_k is not None:
+        kept = kept[:top_k]
+    return Tensor(jnp.asarray(kept.astype(np.int64)))
+
+
+# ------------------------------------------------------------ box coder
+
+def _box_coder_kernel(prior_box, prior_var, target_box, code_type,
+                      box_normalized):
+    """encode_center_size / decode_center_size (box_coder_kernel.cc)."""
+    norm = 0.0 if box_normalized else 1.0
+    pw = prior_box[:, 2] - prior_box[:, 0] + norm
+    ph = prior_box[:, 3] - prior_box[:, 1] + norm
+    pcx = prior_box[:, 0] + pw * 0.5
+    pcy = prior_box[:, 1] + ph * 0.5
+    if code_type == "encode_center_size":
+        tw = target_box[:, 2] - target_box[:, 0] + norm
+        th = target_box[:, 3] - target_box[:, 1] + norm
+        tcx = target_box[:, 0] + tw * 0.5
+        tcy = target_box[:, 1] + th * 0.5
+        dx = (tcx - pcx) / pw
+        dy = (tcy - pcy) / ph
+        dw = jnp.log(tw / pw)
+        dh = jnp.log(th / ph)
+        out = jnp.stack([dx, dy, dw, dh], axis=1)
+        return out / prior_var if prior_var is not None else out
+    # decode
+    t = target_box * prior_var if prior_var is not None else target_box
+    cx = t[:, 0] * pw + pcx
+    cy = t[:, 1] * ph + pcy
+    bw = jnp.exp(t[:, 2]) * pw
+    bh = jnp.exp(t[:, 3]) * ph
+    return jnp.stack([cx - bw * 0.5, cy - bh * 0.5,
+                      cx + bw * 0.5 - norm, cy + bh * 0.5 - norm],
+                     axis=1)
+
+
+register_op("box_coder", _box_coder_kernel)
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0, name=None):
+    return apply("box_coder", prior_box, prior_box_var, target_box,
+                 code_type=code_type, box_normalized=bool(box_normalized))
+
+
+# ------------------------------------------------------------ prior box
+
+def prior_box(input, image, min_sizes, max_sizes=None,
+              aspect_ratios=(1.0,), variance=(0.1, 0.1, 0.2, 0.2),
+              flip=False, clip=False, steps=(0.0, 0.0), offset=0.5,
+              name=None):
+    """SSD prior boxes (prior_box_kernel.cc): returns (boxes [H,W,P,4],
+    variances [H,W,P,4]) for P anchors per cell."""
+    from .._core.tensor import Tensor
+    fh, fw = int(input.shape[2]), int(input.shape[3])
+    ih, iw = int(image.shape[2]), int(image.shape[3])
+    step_h = steps[1] or ih / fh
+    step_w = steps[0] or iw / fw
+    ratios = list(aspect_ratios)
+    if flip:
+        ratios += [1.0 / r for r in aspect_ratios if r != 1.0]
+    whs = []
+    for ms in min_sizes:
+        for r in ratios:
+            whs.append((ms * (r ** 0.5), ms / (r ** 0.5)))
+        if max_sizes:
+            mx = max_sizes[min_sizes.index(ms)]
+            whs.append(((ms * mx) ** 0.5, (ms * mx) ** 0.5))
+    whs = jnp.asarray(whs, jnp.float32)         # [P, 2]
+    cy = (jnp.arange(fh) + offset) * step_h
+    cx = (jnp.arange(fw) + offset) * step_w
+    cxg, cyg = jnp.meshgrid(cx, cy)             # [H, W]
+    c = jnp.stack([cxg, cyg], -1)[:, :, None, :]   # [H,W,1,2]
+    half = whs[None, None] * 0.5                   # [1,1,P,2]
+    mins = (c - half) / jnp.asarray([iw, ih], jnp.float32)
+    maxs = (c + half) / jnp.asarray([iw, ih], jnp.float32)
+    boxes = jnp.concatenate([mins, maxs], axis=-1)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variance, jnp.float32),
+                           boxes.shape)
+    return Tensor(boxes), Tensor(var)
+
+
+# ------------------------------------------------------------- yolo box
+
+def _yolo_box_kernel(x, img_size, anchors, class_num, conf_thresh,
+                     downsample_ratio, clip_bbox, scale_x_y):
+    """Decode YOLOv3 head output (yolo_box_kernel.cc): x [N, A*(5+C),
+    H, W] -> boxes [N, A*H*W, 4], scores [N, A*H*W, C]."""
+    n, _, h, w = x.shape
+    a = len(anchors) // 2
+    anc = jnp.asarray(anchors, jnp.float32).reshape(a, 2)
+    x = x.reshape(n, a, 5 + class_num, h, w)
+    gx = jnp.arange(w, dtype=jnp.float32)
+    gy = jnp.arange(h, dtype=jnp.float32)
+    bias = 0.5 * (scale_x_y - 1.0)
+    cx = (jax.nn.sigmoid(x[:, :, 0]) * scale_x_y - bias
+          + gx[None, None, None, :]) / w
+    cy = (jax.nn.sigmoid(x[:, :, 1]) * scale_x_y - bias
+          + gy[None, None, :, None]) / h
+    input_w = downsample_ratio * w
+    input_h = downsample_ratio * h
+    bw = jnp.exp(x[:, :, 2]) * anc[None, :, 0, None, None] / input_w
+    bh = jnp.exp(x[:, :, 3]) * anc[None, :, 1, None, None] / input_h
+    conf = jax.nn.sigmoid(x[:, :, 4])
+    probs = jax.nn.sigmoid(x[:, :, 5:]) * conf[:, :, None]
+    imh = img_size[:, 0].astype(jnp.float32)[:, None, None, None]
+    imw = img_size[:, 1].astype(jnp.float32)[:, None, None, None]
+    x1 = (cx - bw * 0.5) * imw
+    y1 = (cy - bh * 0.5) * imh
+    x2 = (cx + bw * 0.5) * imw
+    y2 = (cy + bh * 0.5) * imh
+    if clip_bbox:
+        x1 = jnp.clip(x1, 0, imw - 1)
+        y1 = jnp.clip(y1, 0, imh - 1)
+        x2 = jnp.clip(x2, 0, imw - 1)
+        y2 = jnp.clip(y2, 0, imh - 1)
+    boxes = jnp.stack([x1, y1, x2, y2], -1).reshape(n, -1, 4)
+    mask = (conf > conf_thresh)[..., None]
+    scores = jnp.where(mask, probs.transpose(0, 1, 3, 4, 2),
+                       0.0).reshape(n, -1, class_num)
+    return boxes, scores
+
+
+register_op("yolo_box", _yolo_box_kernel, multi_output=True)
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, clip_bbox=True, scale_x_y=1.0, name=None,
+             iou_aware=False, iou_aware_factor=0.5):
+    return apply("yolo_box", x, img_size, anchors=tuple(anchors),
+                 class_num=int(class_num),
+                 conf_thresh=float(conf_thresh),
+                 downsample_ratio=int(downsample_ratio),
+                 clip_bbox=bool(clip_bbox), scale_x_y=float(scale_x_y))

@@ -1,0 +1,185 @@
+"""Detection ops + new long-tail ops vs NumPy references.
+
+Mirrors the reference's op tests for roi_align/roi_pool/nms/box_coder/
+prior_box/yolo_box (test/legacy_test/test_roi_align_op.py etc.) plus
+the sampling/segment/signal additions."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.vision import ops as vops
+
+
+def T(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+class TestRoiAlign:
+    def test_identity_roi(self):
+        # whole-image roi, aligned=True, 1 sample/bin: sample points land
+        # exactly on pixel coords, so the output reproduces the input
+        x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        boxes = np.array([[0.0, 0.0, 4.0, 4.0]], np.float32)
+        out = vops.roi_align(T(x), T(boxes), T(np.array([1])), 4,
+                             spatial_scale=1.0, sampling_ratio=1,
+                             aligned=True)
+        np.testing.assert_allclose(out.numpy()[0, 0], x[0, 0], atol=1e-5)
+
+    def test_multi_image_routing(self):
+        x = np.stack([np.zeros((1, 4, 4), np.float32),
+                      np.ones((1, 4, 4), np.float32)])
+        boxes = np.array([[0, 0, 4, 4], [0, 0, 4, 4]], np.float32)
+        out = vops.roi_align(T(x), T(boxes), T(np.array([1, 1])), 2)
+        assert out.numpy()[0].max() == 0.0
+        np.testing.assert_allclose(out.numpy()[1], 1.0)
+
+
+class TestRoiPool:
+    def test_max_in_bins(self):
+        x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        boxes = np.array([[0, 0, 3, 3]], np.float32)
+        out = vops.roi_pool(T(x), T(boxes), T(np.array([1])), 2)
+        # quantized bins over the full image: maxima of quadrants
+        np.testing.assert_array_equal(out.numpy()[0, 0],
+                                      [[5., 7.], [13., 15.]])
+
+
+class TestNMS:
+    def test_suppression(self):
+        boxes = np.array([[0, 0, 10, 10],
+                          [1, 1, 10, 10],    # heavy overlap with 0
+                          [20, 20, 30, 30]], np.float32)
+        scores = np.array([0.9, 0.8, 0.7], np.float32)
+        kept = vops.nms(T(boxes), T(scores), iou_threshold=0.5)
+        assert kept.numpy().tolist() == [0, 2]
+
+    def test_no_suppression_below_threshold(self):
+        boxes = np.array([[0, 0, 10, 10], [1, 1, 10, 10]], np.float32)
+        scores = np.array([0.5, 0.9], np.float32)
+        kept = vops.nms(T(boxes), T(scores), iou_threshold=0.95)
+        assert sorted(kept.numpy().tolist()) == [0, 1]
+        # descending score order
+        assert kept.numpy().tolist()[0] == 1
+
+    def test_top_k(self):
+        boxes = np.array([[0, 0, 1, 1], [5, 5, 6, 6],
+                          [10, 10, 11, 11]], np.float32)
+        scores = np.array([0.1, 0.9, 0.5], np.float32)
+        kept = vops.nms(T(boxes), T(scores), 0.5, top_k=2)
+        assert kept.numpy().tolist() == [1, 2]
+
+
+class TestBoxCoder:
+    def test_encode_decode_round_trip(self):
+        prior = np.array([[0, 0, 10, 10], [5, 5, 20, 25]], np.float32)
+        var = np.full((2, 4), 0.1, np.float32)
+        target = np.array([[1, 1, 11, 12], [4, 6, 22, 24]], np.float32)
+        enc = vops.box_coder(T(prior), T(var), T(target),
+                             "encode_center_size")
+        dec = vops.box_coder(T(prior), T(var), T(enc.numpy()),
+                             "decode_center_size")
+        np.testing.assert_allclose(dec.numpy(), target, atol=1e-3)
+
+
+class TestPriorBox:
+    def test_shapes_and_range(self):
+        feat = paddle.to_tensor(np.zeros((1, 8, 4, 4), np.float32))
+        img = paddle.to_tensor(np.zeros((1, 3, 32, 32), np.float32))
+        boxes, var = vops.prior_box(feat, img, min_sizes=[8.0],
+                                    aspect_ratios=[1.0, 2.0], flip=True,
+                                    clip=True)
+        assert boxes.shape[:2] == [4, 4]
+        assert boxes.shape[3] == 4
+        b = boxes.numpy()
+        assert b.min() >= 0.0 and b.max() <= 1.0
+        assert var.shape == boxes.shape
+
+
+class TestYoloBox:
+    def test_decode_shapes(self):
+        n, a, c, h, w = 1, 2, 3, 4, 4
+        x = np.random.RandomState(0).randn(
+            n, a * (5 + c), h, w).astype(np.float32)
+        img = np.array([[64, 64]], np.int32)
+        boxes, scores = vops.yolo_box(T(x), T(img),
+                                      anchors=[10, 13, 16, 30],
+                                      class_num=c, conf_thresh=0.0,
+                                      downsample_ratio=16)
+        assert boxes.shape == [n, a * h * w, 4]
+        assert scores.shape == [n, a * h * w, c]
+        assert np.isfinite(boxes.numpy()).all()
+
+
+class TestSamplingAndSegments:
+    def test_top_p_sampling(self):
+        probs = np.array([[0.9, 0.05, 0.03, 0.02],
+                          [0.01, 0.01, 0.97, 0.01]], np.float32)
+        ps = np.array([0.5, 0.5], np.float32)
+        p_out, ids = paddle.top_p_sampling(T(probs), T(ps), seed=7)
+        # with p=0.5 only the dominant token survives
+        assert ids.numpy().reshape(-1).tolist() == [0, 2]
+        np.testing.assert_allclose(p_out.numpy().reshape(-1), 1.0)
+
+    def test_segment_ops(self):
+        d = T(np.array([[1., 2.], [3., 4.], [5., 6.]], np.float32))
+        ids = T(np.array([0, 0, 1], np.int32))
+        np.testing.assert_array_equal(
+            paddle.incubate.segment_sum(d, ids).numpy(),
+            [[4., 6.], [5., 6.]])
+        np.testing.assert_array_equal(
+            paddle.incubate.segment_mean(d, ids).numpy(),
+            [[2., 3.], [5., 6.]])
+        np.testing.assert_array_equal(
+            paddle.incubate.segment_min(d, ids).numpy(),
+            [[1., 2.], [5., 6.]])
+
+
+class TestSignalFrameOps:
+    def test_frame_overlap_add_round_trip(self):
+        x = np.arange(12, dtype=np.float32)
+        f = paddle.signal.frame(T(x), 4, 4)   # non-overlapping
+        assert f.shape == [4, 3]
+        r = paddle.signal.overlap_add(f, 4)
+        np.testing.assert_array_equal(r.numpy(), x)
+
+    def test_overlap_doubles(self):
+        x = np.ones(8, np.float32)
+        f = paddle.signal.frame(T(x), 4, 2)
+        r = paddle.signal.overlap_add(f, 2).numpy()
+        assert r[0] == 1.0 and r[3] == 2.0   # interior overlapped twice
+
+
+class TestMiscNewOps:
+    def test_log_sigmoid(self):
+        x = np.array([-2.0, 0.0, 3.0], np.float32)
+        np.testing.assert_allclose(
+            F.log_sigmoid(T(x)).numpy(),
+            np.log(1 / (1 + np.exp(-x))), rtol=1e-5)
+
+    def test_margin_cross_entropy_reduces_target_logit(self):
+        logits = np.array([[0.8, 0.1], [0.2, 0.9]], np.float32)
+        label = np.array([0, 1], np.int64)
+        loss_m = F.margin_cross_entropy(T(logits), T(label),
+                                        margin2=0.5, scale=8.0)
+        loss_0 = F.margin_cross_entropy(T(logits), T(label),
+                                        margin2=0.0, margin3=0.0,
+                                        scale=8.0)
+        assert float(loss_m.numpy()) > float(loss_0.numpy())
+
+    def test_gather_tree(self):
+        # T=3, B=1, W=2 beams
+        ids = np.array([[[1, 2]], [[3, 4]], [[5, 6]]], np.int64)
+        parents = np.array([[[0, 0]], [[0, 0]], [[1, 0]]], np.int64)
+        out = F.gather_tree(T(ids), T(parents)).numpy()
+        # beam 0 at t=2 came from parent 1 at t=1 (id 4), parent 0 at t=0
+        assert out[:, 0, 0].tolist() == [1, 4, 5]
+
+    def test_max_unpool2d_inverts_pool(self):
+        x = np.random.RandomState(0).randn(1, 1, 4, 4).astype(np.float32)
+        pooled, idx = F.max_pool2d(T(x), 2, return_mask=True)
+        restored = F.max_unpool2d(pooled, idx, 2)
+        assert restored.shape == [1, 1, 4, 4]
+        # restored holds the maxima at their original positions
+        assert np.isclose(restored.numpy().max(), x.max())
+        assert (restored.numpy() != 0).sum() == 4
