@@ -190,3 +190,6 @@ def stop_worker():
     from ..ps import service
     service.stop_worker()
     _ps_client = None
+
+
+from . import metrics  # noqa: E402,F401

@@ -1,14 +1,77 @@
 """HybridParallelOptimizer + HybridParallelGradScaler.
 
 Analog of fleet/meta_optimizers/dygraph_optimizer/hybrid_parallel_optimizer
-.py:275. On TPU the cross-axis grad sync (mp/sep allreduce, dp fused
-allreduce) is compiled into the step by GSPMD when training runs under
-pjit; this wrapper keeps the API + the hybrid-aware global-norm clip
-semantics for the host-driven path.
+.py:275. Under pjit the cross-axis grad sync is compiled into the step by
+GSPMD; this wrapper implements the EAGER multi-process mechanics:
+
+- replicated (non-`is_distributed`) parameter grads are averaged across
+  the mp (and sep) group before the update — TP ranks compute them from
+  identical math but different activation shards, so without the sync
+  the replicas drift (reference fused_allreduce_gradients over the mp
+  group, hybrid_parallel_util.py:282);
+- ClipGradByGlobalNorm is rewritten hybrid-aware: squared norms of
+  `is_distributed` (TP-sharded) params are summed ACROSS the mp group —
+  each rank holds a distinct shard — while replicated params count once
+  (reference HybridParallelClipGrad, hybrid_parallel_optimizer.py:60).
 """
 from __future__ import annotations
 
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..._core.autograd import no_grad
+from ..._core.tensor import Tensor
 from ...amp.grad_scaler import GradScaler
+from ...nn.clip import ClipGradByGlobalNorm
+
+
+def _group_pg(group):
+    pg = getattr(group, "pg", None)
+    return pg if pg is not None and pg.size > 1 else None
+
+
+class HybridParallelClipGrad:
+    """Global-norm clip across hybrid groups
+    (hybrid_parallel_optimizer.py:60 HybridParallelClipGrad)."""
+
+    def __init__(self, clip_norm: float, hcg):
+        self.clip_norm = float(clip_norm)
+        self._hcg = hcg
+
+    @no_grad()
+    def __call__(self, params_grads):
+        dist_sq = jnp.zeros((), jnp.float32)
+        repl_sq = jnp.zeros((), jnp.float32)
+        for p, g in params_grads:
+            if g is None:
+                continue
+            sq = jnp.sum(g._value.astype(jnp.float32) ** 2)
+            if getattr(p, "is_distributed", False):
+                dist_sq = dist_sq + sq
+            else:
+                repl_sq = repl_sq + sq
+        # shards of TP params live on different mp ranks: sum across
+        pg = None
+        if self._hcg is not None and \
+                self._hcg.get_model_parallel_world_size() > 1:
+            pg = _group_pg(self._hcg.get_model_parallel_group())
+        if pg is not None:
+            dist_sq = jnp.asarray(pg.all_reduce(
+                np.asarray(dist_sq, np.float32), op="sum"))
+        gnorm = jnp.sqrt(dist_sq + repl_sq)
+        scale = jnp.minimum(self.clip_norm / jnp.maximum(gnorm, 1e-12),
+                            1.0)
+        out = []
+        for p, g in params_grads:
+            if g is None or (hasattr(p, "need_clip")
+                             and not p.need_clip):
+                out.append((p, g))
+                continue
+            out.append((p, Tensor(
+                (g._value.astype(jnp.float32) * scale)
+                .astype(g._value.dtype))))
+        return out
 
 
 class HybridParallelOptimizer:
@@ -16,11 +79,50 @@ class HybridParallelOptimizer:
         self._inner_opt = optimizer
         self._hcg = hcg
         self._strategy = strategy
+        # rewrap a plain global-norm clip with the hybrid-aware one
+        # (the reference does exactly this substitution)
+        clip = getattr(optimizer, "_grad_clip", None)
+        if isinstance(clip, ClipGradByGlobalNorm) and hcg is not None \
+                and hcg.get_model_parallel_world_size() > 1:
+            optimizer._grad_clip = HybridParallelClipGrad(
+                clip.clip_norm, hcg)
 
     def __getattr__(self, item):
         return getattr(self._inner_opt, item)
 
+    # ---------------------------------------------------------- mechanics
+    def _replicated_params(self):
+        for group in self._inner_opt._param_groups:
+            for p in group["params"]:
+                if not p.stop_gradient and p.grad is not None and \
+                        not getattr(p, "is_distributed", False):
+                    yield p
+
+    def _sync_replicated_grads(self):
+        """Average non-distributed grads over mp (and sep) groups —
+        fused_allreduce_gradients(list, hcg) analog."""
+        if self._hcg is None:
+            return
+        for get_ws, get_group in (
+                (self._hcg.get_model_parallel_world_size,
+                 self._hcg.get_model_parallel_group),
+                (self._hcg.get_sep_parallel_world_size,
+                 self._hcg.get_sep_parallel_group)):
+            try:
+                if get_ws() <= 1:
+                    continue
+                pg = _group_pg(get_group())
+            except Exception:
+                continue
+            if pg is None:
+                continue
+            for p in self._replicated_params():
+                avg = pg.all_reduce(p.grad.numpy(), op="avg")
+                p.grad._adopt(Tensor(jnp.asarray(
+                    np.ascontiguousarray(avg))))
+
     def step(self):
+        self._sync_replicated_grads()
         self._inner_opt.step()
 
     def clear_grad(self, set_to_zero=True):
@@ -29,7 +131,11 @@ class HybridParallelOptimizer:
     clear_gradients = clear_grad
 
     def minimize(self, loss, **kwargs):
-        return self._inner_opt.minimize(loss, **kwargs)
+        # backward FIRST, then the wrapper's step so the fresh grads get
+        # the mp/sep sync (delegating to inner minimize would run the
+        # inner step on unsynced grads)
+        loss.backward()
+        self.step()
 
     def state_dict(self):
         return self._inner_opt.state_dict()
@@ -49,3 +155,23 @@ class HybridParallelGradScaler(GradScaler):
         else:
             super().__init__(**kwargs)
         self._hcg = hcg
+
+    def unscale_(self, optimizer):
+        """Base unscale, then agree found_inf across the mp group: a
+        NaN/Inf on ANY rank must skip the step on EVERY rank, or
+        replicas diverge (reference allreduce of found_inf in
+        HybridParallelGradScaler). step() reads self._found_inf, so the
+        agreement slots into the base flow here."""
+        super().unscale_(optimizer)
+        if self._hcg is None:
+            return
+        try:
+            pg = _group_pg(self._hcg.get_model_parallel_group())
+        except Exception:
+            pg = None
+        if pg is None:
+            return
+        agg = pg.all_reduce(
+            np.asarray([1.0 if self._found_inf else 0.0], np.float32),
+            op="max")
+        self._found_inf = bool(agg[0] > 0)

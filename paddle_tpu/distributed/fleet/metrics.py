@@ -1,0 +1,72 @@
+"""Fleet distributed metrics (fleet/metrics/metric.py analog): metric
+pieces computed per rank, reduced across the data-parallel group so
+every worker reports the GLOBAL value — sum/max/min/mean over scalars,
+and a distributed AUC from locally accumulated confusion histograms."""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+def _pg(group=None):
+    if group is not None and getattr(group, "pg", None) is not None:
+        return group.pg
+    from ..parallel_env import get_default_process_group
+    return get_default_process_group()
+
+
+def _reduce(value, op, group=None):
+    arr = np.asarray(value, np.float64)
+    pg = _pg(group)
+    if pg is None or pg.size <= 1:
+        return arr
+    return pg.all_reduce(arr, op=op)
+
+
+def sum(value, group=None):  # noqa: A001 (reference uses these names)
+    """Global sum of a per-worker scalar/array (metric.py sum)."""
+    return _reduce(value, "sum", group)
+
+
+def max(value, group=None):  # noqa: A001
+    return _reduce(value, "max", group)
+
+
+def min(value, group=None):  # noqa: A001
+    return _reduce(value, "min", group)
+
+
+def mean(value, group=None):
+    return _reduce(value, "avg", group)
+
+
+def acc(correct, total, group=None):
+    """Global accuracy from per-worker (correct, total) counts."""
+    c = _reduce(np.asarray([correct], np.float64), "sum", group)
+    t = _reduce(np.asarray([total], np.float64), "sum", group)
+    return float(c[0] / np.maximum(t[0], 1.0))
+
+
+def auc(stat_pos, stat_neg, group=None):
+    """Distributed AUC (metric.py auc): per-worker positive/negative
+    score histograms (as produced by paddle.metric.Auc's buckets) are
+    summed across workers, then the trapezoidal AUC is computed on the
+    global histogram."""
+    pos = _reduce(np.asarray(stat_pos, np.float64), "sum", group)
+    neg = _reduce(np.asarray(stat_neg, np.float64), "sum", group)
+    # walk buckets from highest score to lowest, accumulating TP/FP
+    tot_pos = 0.0
+    tot_neg = 0.0
+    area = 0.0
+    for i in range(len(pos) - 1, -1, -1):
+        new_pos = tot_pos + pos[i]
+        new_neg = tot_neg + neg[i]
+        area += (new_neg - tot_neg) * (tot_pos + new_pos) / 2.0
+        tot_pos, tot_neg = new_pos, new_neg
+    if tot_pos == 0 or tot_neg == 0:
+        return 0.5
+    return float(area / (tot_pos * tot_neg))
+
+
+__all__ = ["sum", "max", "min", "mean", "acc", "auc"]

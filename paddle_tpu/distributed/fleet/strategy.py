@@ -19,6 +19,17 @@ class _Bag(dict):
 
 
 class DistributedStrategy:
+    def __setattr__(self, k, v):
+        # partial assignment of a *_configs dict MERGES into the defaults
+        # (the reference's protobuf-backed strategy semantics:
+        # strategy.hybrid_configs = {"mp_degree": 2} keeps other keys)
+        cur = self.__dict__.get(k)
+        if isinstance(cur, _Bag) and isinstance(v, dict) \
+                and not isinstance(v, _Bag):
+            cur.update(v)
+            return
+        object.__setattr__(self, k, v)
+
     def __init__(self):
         self.amp = False
         self.amp_configs = _Bag(init_loss_scaling=32768.0, use_pure_bf16=False,

@@ -165,7 +165,8 @@ def _iou_matrix(boxes):
 def _nms_kernel(boxes, scores, iou_threshold):
     """Greedy NMS as a fixed-shape suppression sweep: process boxes in
     score order; keep a box iff no higher-scored KEPT box overlaps it
-    past the threshold (nms_kernel.cc semantics, lax.scan not python)."""
+    past the threshold (nms_kernel.cc semantics, lax.fori not python).
+    Returns the keep mask in SCORE-SORTED order."""
     order = jnp.argsort(-scores)
     b = boxes[order]
     iou = _iou_matrix(b)
@@ -176,31 +177,35 @@ def _nms_kernel(boxes, scores, iou_threshold):
         sup = (iou[i] > iou_threshold) & keep & (jnp.arange(n) < i)
         return keep.at[i].set(~jnp.any(sup))
 
-    keep_sorted = jax.lax.fori_loop(0, n, body, jnp.ones(n, bool))
-    # map back to original indices, score-ordered like the reference
-    kept_idx = jnp.where(keep_sorted, order, n)
-    return jnp.sort(jnp.where(keep_sorted,
-                              jnp.arange(n), n)), kept_idx, keep_sorted
+    return jax.lax.fori_loop(0, n, body, jnp.ones(n, bool))
 
 
-register_op("nms_mask", lambda boxes, scores, iou_threshold:
-            _nms_kernel(boxes, scores, iou_threshold)[2],)
+register_op("nms_mask", _nms_kernel)
 
 
 def nms(boxes, scores=None, iou_threshold=0.3, top_k=None,
         category_idxs=None, categories=None, name=None):
     """Returns kept box indices in descending-score order (vision/ops.py
-    nms). Fixed-shape mask computed on device; the final index
-    compaction is a host-side gather (dynamic shapes don't compile)."""
+    nms). With category_idxs, suppression is per category (boxes of
+    different classes never suppress each other) via the standard
+    coordinate-offset trick. The fixed-shape mask is computed on device;
+    the final index compaction is a host-side gather (dynamic shapes
+    don't compile)."""
     from .._core.tensor import Tensor
     if scores is None:
         scores = Tensor(jnp.ones((boxes.shape[0],), jnp.float32))
-    keep_mask = apply("nms_mask", boxes, scores,
+    nms_boxes = boxes
+    if category_idxs is not None:
+        # shift each category into a disjoint coordinate region
+        span = jnp.max(boxes._value) - jnp.min(boxes._value) + 1.0
+        off = (category_idxs._value.astype(jnp.float32) * span)[:, None]
+        nms_boxes = Tensor(boxes._value + off)
+    keep_mask = apply("nms_mask", nms_boxes, scores,
                       iou_threshold=float(iou_threshold))
+    # mask is in score-sorted order: map positions back through argsort
     mask = np.asarray(keep_mask._value)
-    sc = np.asarray(scores._value)
-    idx = np.nonzero(mask[np.argsort(-sc)])[0]
-    kept = np.argsort(-sc)[idx]
+    order = np.argsort(-np.asarray(scores._value))
+    kept = order[np.nonzero(mask)[0]]
     if top_k is not None:
         kept = kept[:top_k]
     return Tensor(jnp.asarray(kept.astype(np.int64)))
@@ -334,6 +339,10 @@ register_op("yolo_box", _yolo_box_kernel, multi_output=True)
 def yolo_box(x, img_size, anchors, class_num, conf_thresh,
              downsample_ratio, clip_bbox=True, scale_x_y=1.0, name=None,
              iou_aware=False, iou_aware_factor=0.5):
+    if iou_aware:
+        raise NotImplementedError(
+            "yolo_box: iou_aware=True uses the A*(6+C) channel layout, "
+            "which this decoder does not support yet")
     return apply("yolo_box", x, img_size, anchors=tuple(anchors),
                  class_num=int(class_num),
                  conf_thresh=float(conf_thresh),

@@ -1,0 +1,96 @@
+"""Hybrid optimizer + fleet metrics multi-process tests."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _worker():
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    import paddle_tpu.distributed as dist
+    import paddle_tpu.distributed.fleet as fleet
+    from paddle_tpu.distributed.fleet.hybrid_optimizer import (
+        HybridParallelClipGrad, HybridParallelOptimizer)
+
+    dist.init_parallel_env()
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 2,
+                               "pp_degree": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+    hcg = fleet.get_hybrid_communicate_group_()
+    assert hcg.get_model_parallel_world_size() == 2
+
+    paddle.seed(3)
+    layer = nn.Linear(4, 4)           # replicated param
+    tp_w = paddle.create_parameter([4, 2], "float32")
+    tp_w.is_distributed = True        # TP shard: distinct per rank
+    opt = paddle.optimizer.SGD(
+        0.1, parameters=list(layer.parameters()) + [tp_w],
+        grad_clip=nn.ClipGradByGlobalNorm(1.0))
+    hopt = HybridParallelOptimizer(opt, hcg=hcg)
+    assert isinstance(opt._grad_clip, HybridParallelClipGrad)
+
+    # make replicated grads DIFFER across mp ranks on purpose
+    x = paddle.to_tensor(
+        np.full((2, 4), float(rank + 1), np.float32))
+    loss = (layer(x) * tp_w.sum()).sum()
+    loss.backward()
+    g_before = layer.weight.grad.numpy().copy()
+    hopt.step()
+    # after step, replicated weights must be identical across ranks
+    pg = hcg.get_model_parallel_group().pg
+    ws = pg.all_gather(layer.weight.numpy())
+    np.testing.assert_allclose(ws[0], ws[1], atol=1e-6)
+
+    # distributed metrics
+    from paddle_tpu.distributed.fleet import metrics as M
+    assert float(M.sum(np.asarray([rank + 1.0]))[0]) == 3.0
+    assert M.acc(correct=80 + rank * 10, total=100) == \
+        (80 + 90) / 200
+    # distributed AUC: worker histograms combine to the global one
+    pos = np.zeros(10); neg = np.zeros(10)
+    if rank == 0:
+        pos[9] = 5          # high-score positives
+    else:
+        neg[0] = 5          # low-score negatives
+    assert M.auc(pos, neg) == 1.0
+    print(f"HYBRID-{rank}-OK", flush=True)
+
+
+def test_hybrid_optimizer_and_metrics():
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    procs = []
+    for rank in range(2):
+        env = dict(os.environ)
+        env.update({
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": "2",
+            "MASTER_ADDR": "127.0.0.1",
+            "MASTER_PORT": str(port),
+            "JAX_PLATFORMS": "cpu",
+            "PT_HYBRID_WORKER": "1",
+            "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True))
+    for rank, p in enumerate(procs):
+        out, _ = p.communicate(timeout=240)
+        assert p.returncode == 0, f"rank {rank} rc={p.returncode}:\n{out}"
+        assert f"HYBRID-{rank}-OK" in out
+
+
+if __name__ == "__main__" and os.environ.get("PT_HYBRID_WORKER") == "1":
+    _worker()

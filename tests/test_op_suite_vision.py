@@ -69,6 +69,33 @@ class TestNMS:
         kept = vops.nms(T(boxes), T(scores), 0.5, top_k=2)
         assert kept.numpy().tolist() == [1, 2]
 
+    def test_input_not_in_score_order(self):
+        # regression: the device mask is score-sorted; mapping it back
+        # through argsort must keep the right ORIGINAL indices
+        boxes = np.array([[1, 1, 10, 10],     # suppressed by box 1
+                          [0, 0, 10, 10],     # best score
+                          [20, 20, 30, 30]], np.float32)
+        scores = np.array([0.5, 0.9, 0.7], np.float32)
+        kept = vops.nms(T(boxes), T(scores), 0.5)
+        assert kept.numpy().tolist() == [1, 2]
+
+    def test_per_category_no_cross_suppression(self):
+        boxes = np.array([[0, 0, 10, 10],
+                          [1, 1, 10, 10]], np.float32)   # heavy overlap
+        scores = np.array([0.9, 0.8], np.float32)
+        cats = np.array([0, 1], np.int64)                # different class
+        kept = vops.nms(T(boxes), T(scores), 0.5,
+                        category_idxs=T(cats), categories=[0, 1])
+        assert sorted(kept.numpy().tolist()) == [0, 1]
+
+    def test_yolo_iou_aware_raises(self):
+        with pytest.raises(NotImplementedError):
+            vops.yolo_box(T(np.zeros((1, 14, 4, 4), np.float32)),
+                          T(np.array([[64, 64]], np.int32)),
+                          anchors=[10, 13, 16, 30], class_num=2,
+                          conf_thresh=0.1, downsample_ratio=8,
+                          iou_aware=True)
+
 
 class TestBoxCoder:
     def test_encode_decode_round_trip(self):
