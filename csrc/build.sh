@@ -7,7 +7,7 @@ mkdir -p build
 g++ -std=c++17 -O2 -fPIC -shared -pthread \
     -fvisibility=hidden \
     pt_error.cc tcp_store.cc allocator.cc data_feed.cc flags.cc \
-    comm_context.cc device_plugin.cc \
+    comm_context.cc device_plugin.cc jit_layer.cc \
     -ldl -o build/libpaddle_tpu_rt.so
 # fake custom-device plugin (contract-test backend, fake_cpu_device.h analog)
 g++ -std=c++17 -O2 -fPIC -shared \

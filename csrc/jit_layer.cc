@@ -1,0 +1,264 @@
+// C++ JIT layer: the deployable saved-model container.
+//
+// Analog of the reference's C++ jit layer (paddle/fluid/jit/layer.h +
+// compilation_unit.cc): owns a serialized program + parameters and hands
+// both to an execution engine. Here the program is serialized StableHLO
+// (jit.save's .pdmodel) and execution is PJRT via jax.export on the
+// Python side; this container owns the ARTIFACT — it memory-maps the
+// .pdiparams safetensors-style file (8-byte header length, JSON header,
+// raw buffers), parses the header with a built-in minimal JSON reader
+// (no third-party deps), validates offsets, and serves zero-copy
+// parameter views plus the program bytes through a C ABI.
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstring>
+#include <fstream>
+#include <string>
+#include <vector>
+
+#include "pt_common.h"
+
+namespace {
+
+struct ParamMeta {
+  std::string name;
+  std::string dtype;
+  std::vector<int64_t> shape;
+  uint64_t begin = 0;
+  uint64_t end = 0;
+};
+
+struct JitLayer {
+  int fd = -1;
+  void* map = nullptr;
+  size_t map_size = 0;
+  const char* data = nullptr;  // start of raw buffers
+  std::vector<ParamMeta> params;
+  std::vector<char> program;   // .pdmodel bytes
+
+  ~JitLayer() {
+    if (map) munmap(map, map_size);
+    if (fd >= 0) close(fd);
+  }
+};
+
+// ---- minimal JSON reader for the restricted header schema -----------
+struct Cursor {
+  const char* p;
+  const char* end;
+  bool fail = false;
+
+  void ws() {
+    while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' ||
+                       *p == '\r'))
+      ++p;
+  }
+  bool eat(char c) {
+    ws();
+    if (p < end && *p == c) {
+      ++p;
+      return true;
+    }
+    fail = true;
+    return false;
+  }
+  bool peek(char c) {
+    ws();
+    return p < end && *p == c;
+  }
+  std::string str() {
+    ws();
+    std::string out;
+    if (p >= end || *p != '"') {
+      fail = true;
+      return out;
+    }
+    ++p;
+    while (p < end && *p != '"') {
+      if (*p == '\\' && p + 1 < end) ++p;  // unescape minimally
+      out.push_back(*p++);
+    }
+    if (p < end) ++p;
+    return out;
+  }
+  int64_t num() {
+    ws();
+    int64_t sign = 1;
+    if (p < end && *p == '-') {
+      sign = -1;
+      ++p;
+    }
+    int64_t v = 0;
+    bool any = false;
+    while (p < end && *p >= '0' && *p <= '9') {
+      v = v * 10 + (*p - '0');
+      ++p;
+      any = true;
+    }
+    if (!any) fail = true;
+    return sign * v;
+  }
+};
+
+bool parse_header(const char* buf, size_t n,
+                  std::vector<ParamMeta>* out) {
+  Cursor c{buf, buf + n};
+  if (!c.eat('{')) return false;
+  if (c.peek('}')) {
+    c.eat('}');
+    return !c.fail;
+  }
+  while (true) {
+    ParamMeta m;
+    m.name = c.str();
+    if (!c.eat(':') || !c.eat('{')) return false;
+    while (true) {
+      std::string key = c.str();
+      if (!c.eat(':')) return false;
+      if (key == "dtype") {
+        m.dtype = c.str();
+      } else if (key == "shape") {
+        if (!c.eat('[')) return false;
+        if (!c.peek(']')) {
+          while (true) {
+            m.shape.push_back(c.num());
+            if (c.peek(']')) break;
+            if (!c.eat(',')) return false;
+          }
+        }
+        c.eat(']');
+      } else if (key == "offsets") {
+        if (!c.eat('[')) return false;
+        m.begin = static_cast<uint64_t>(c.num());
+        if (!c.eat(',')) return false;
+        m.end = static_cast<uint64_t>(c.num());
+        if (!c.eat(']')) return false;
+      } else {
+        return false;  // unknown key: refuse rather than misparse
+      }
+      if (c.peek('}')) {
+        c.eat('}');
+        break;
+      }
+      if (!c.eat(',')) return false;
+    }
+    out->push_back(std::move(m));
+    if (c.peek('}')) {
+      c.eat('}');
+      break;
+    }
+    if (!c.eat(',')) return false;
+  }
+  return !c.fail;
+}
+
+}  // namespace
+
+// path_prefix: the jit.save path; opens <prefix>.pdiparams (mmap) and
+// <prefix>.pdmodel (read).
+PT_EXPORT void* pt_jit_open(const char* path_prefix) {
+  auto layer = new JitLayer();
+  std::string params_path = std::string(path_prefix) + ".pdiparams";
+  layer->fd = open(params_path.c_str(), O_RDONLY);
+  if (layer->fd < 0) {
+    pt::set_last_error("jit: cannot open " + params_path);
+    delete layer;
+    return nullptr;
+  }
+  struct stat st {};
+  fstat(layer->fd, &st);
+  layer->map_size = static_cast<size_t>(st.st_size);
+  if (layer->map_size < 8) {
+    pt::set_last_error("jit: param file too small");
+    delete layer;
+    return nullptr;
+  }
+  layer->map = mmap(nullptr, layer->map_size, PROT_READ, MAP_PRIVATE,
+                    layer->fd, 0);
+  if (layer->map == MAP_FAILED) {
+    layer->map = nullptr;
+    pt::set_last_error("jit: mmap failed");
+    delete layer;
+    return nullptr;
+  }
+  const char* base = static_cast<const char*>(layer->map);
+  uint64_t head_len = 0;
+  memcpy(&head_len, base, 8);  // little-endian host assumed (POSIX x86/arm)
+  // map_size >= 8 checked above; this form cannot wrap on crafted input
+  if (head_len > layer->map_size - 8) {
+    pt::set_last_error("jit: corrupt header length");
+    delete layer;
+    return nullptr;
+  }
+  if (!parse_header(base + 8, head_len, &layer->params)) {
+    pt::set_last_error("jit: header parse failed");
+    delete layer;
+    return nullptr;
+  }
+  layer->data = base + 8 + head_len;
+  size_t payload = layer->map_size - 8 - head_len;
+  for (const auto& m : layer->params) {
+    if (m.end < m.begin || m.end > payload) {
+      pt::set_last_error("jit: parameter offsets out of bounds: " +
+                         m.name);
+      delete layer;
+      return nullptr;
+    }
+  }
+  std::ifstream prog(std::string(path_prefix) + ".pdmodel",
+                     std::ios::binary);
+  if (prog) {
+    layer->program.assign(std::istreambuf_iterator<char>(prog),
+                          std::istreambuf_iterator<char>());
+  }
+  return layer;
+}
+
+PT_EXPORT int pt_jit_num_params(void* h) {
+  return static_cast<int>(static_cast<JitLayer*>(h)->params.size());
+}
+
+PT_EXPORT const char* pt_jit_param_name(void* h, int i) {
+  auto* l = static_cast<JitLayer*>(h);
+  if (i < 0 || i >= static_cast<int>(l->params.size())) return nullptr;
+  return l->params[i].name.c_str();
+}
+
+PT_EXPORT const char* pt_jit_param_dtype(void* h, int i) {
+  auto* l = static_cast<JitLayer*>(h);
+  if (i < 0 || i >= static_cast<int>(l->params.size())) return nullptr;
+  return l->params[i].dtype.c_str();
+}
+
+// writes up to max_dims dims; returns ndim
+PT_EXPORT int pt_jit_param_shape(void* h, int i, int64_t* dims,
+                                 int max_dims) {
+  auto* l = static_cast<JitLayer*>(h);
+  if (i < 0 || i >= static_cast<int>(l->params.size())) return -1;
+  const auto& s = l->params[i].shape;
+  for (int d = 0; d < static_cast<int>(s.size()) && d < max_dims; ++d)
+    dims[d] = s[d];
+  return static_cast<int>(s.size());
+}
+
+// zero-copy view into the mmap; size_out gets the byte length
+PT_EXPORT const void* pt_jit_param_data(void* h, int i,
+                                        uint64_t* size_out) {
+  auto* l = static_cast<JitLayer*>(h);
+  if (i < 0 || i >= static_cast<int>(l->params.size())) return nullptr;
+  const auto& m = l->params[i];
+  *size_out = m.end - m.begin;
+  return l->data + m.begin;
+}
+
+PT_EXPORT const void* pt_jit_program(void* h, uint64_t* size_out) {
+  auto* l = static_cast<JitLayer*>(h);
+  *size_out = l->program.size();
+  return l->program.empty() ? "" : l->program.data();
+}
+
+PT_EXPORT void pt_jit_close(void* h) { delete static_cast<JitLayer*>(h); }

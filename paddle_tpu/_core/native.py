@@ -174,3 +174,30 @@ def get_lib(required: bool = False) -> Optional[ctypes.CDLL]:
 def last_error() -> str:
     lib = get_lib()
     return lib.pt_last_error().decode() if lib is not None else ""
+
+
+def bind_jit(lib):
+    """ctypes signatures for the C++ jit layer (bound lazily: only the
+    inference path needs them)."""
+    import ctypes as c
+    if getattr(lib, "_jit_bound", False):
+        return lib
+    lib.pt_jit_open.restype = c.c_void_p
+    lib.pt_jit_open.argtypes = [c.c_char_p]
+    lib.pt_jit_num_params.restype = c.c_int
+    lib.pt_jit_num_params.argtypes = [c.c_void_p]
+    lib.pt_jit_param_name.restype = c.c_char_p
+    lib.pt_jit_param_name.argtypes = [c.c_void_p, c.c_int]
+    lib.pt_jit_param_dtype.restype = c.c_char_p
+    lib.pt_jit_param_dtype.argtypes = [c.c_void_p, c.c_int]
+    lib.pt_jit_param_shape.restype = c.c_int
+    lib.pt_jit_param_shape.argtypes = [c.c_void_p, c.c_int,
+                                       c.POINTER(c.c_int64), c.c_int]
+    lib.pt_jit_param_data.restype = c.c_void_p
+    lib.pt_jit_param_data.argtypes = [c.c_void_p, c.c_int,
+                                      c.POINTER(c.c_uint64)]
+    lib.pt_jit_program.restype = c.c_void_p
+    lib.pt_jit_program.argtypes = [c.c_void_p, c.POINTER(c.c_uint64)]
+    lib.pt_jit_close.argtypes = [c.c_void_p]
+    lib._jit_bound = True
+    return lib

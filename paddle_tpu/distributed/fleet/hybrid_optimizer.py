@@ -51,15 +51,25 @@ class HybridParallelClipGrad:
                 dist_sq = dist_sq + sq
             else:
                 repl_sq = repl_sq + sq
-        # shards of TP params live on different mp ranks: sum across
-        pg = None
+        # shards of TP params live on different mp ranks: sum across mp
+        # FIRST (replicated params are identical over mp — count once)
         if self._hcg is not None and \
                 self._hcg.get_model_parallel_world_size() > 1:
             pg = _group_pg(self._hcg.get_model_parallel_group())
-        if pg is not None:
-            dist_sq = jnp.asarray(pg.all_reduce(
-                np.asarray(dist_sq, np.float32), op="sum"))
-        gnorm = jnp.sqrt(dist_sq + repl_sq)
+            if pg is not None:
+                dist_sq = jnp.asarray(pg.all_reduce(
+                    np.asarray(dist_sq, np.float32), op="sum"))
+        total_sq = dist_sq + repl_sq
+        # pipeline stages hold DISJOINT params: sum the whole thing
+        # across the pp group too (reference clips by the one global
+        # norm, not a per-stage norm)
+        if self._hcg is not None and \
+                self._hcg.get_pipe_parallel_world_size() > 1:
+            ppg = _group_pg(self._hcg.get_pipe_parallel_group())
+            if ppg is not None:
+                total_sq = jnp.asarray(ppg.all_reduce(
+                    np.asarray(total_sq, np.float32), op="sum"))
+        gnorm = jnp.sqrt(total_sq)
         scale = jnp.minimum(self.clip_norm / jnp.maximum(gnorm, 1e-12),
                             1.0)
         out = []
@@ -80,10 +90,12 @@ class HybridParallelOptimizer:
         self._hcg = hcg
         self._strategy = strategy
         # rewrap a plain global-norm clip with the hybrid-aware one
-        # (the reference does exactly this substitution)
+        # (the reference does exactly this substitution); mp shards AND
+        # pp stages both need the cross-group norm
         clip = getattr(optimizer, "_grad_clip", None)
         if isinstance(clip, ClipGradByGlobalNorm) and hcg is not None \
-                and hcg.get_model_parallel_world_size() > 1:
+                and (hcg.get_model_parallel_world_size() > 1
+                     or hcg.get_pipe_parallel_world_size() > 1):
             optimizer._grad_clip = HybridParallelClipGrad(
                 clip.clip_norm, hcg)
 
@@ -99,8 +111,11 @@ class HybridParallelOptimizer:
                     yield p
 
     def _sync_replicated_grads(self):
-        """Average non-distributed grads over mp (and sep) groups —
-        fused_allreduce_gradients(list, hcg) analog."""
+        """Average non-distributed grads over mp (and sep) groups.
+        FUSED: all replicated grads of one dtype flatten into a single
+        buffer per collective (fused_allreduce_gradients analog — the
+        same bucketing the DataParallel Reducer uses), so step latency
+        does not scale with parameter count."""
         if self._hcg is None:
             return
         for get_ws, get_group in (
@@ -116,10 +131,21 @@ class HybridParallelOptimizer:
                 continue
             if pg is None:
                 continue
+            by_dtype = {}
             for p in self._replicated_params():
-                avg = pg.all_reduce(p.grad.numpy(), op="avg")
-                p.grad._adopt(Tensor(jnp.asarray(
-                    np.ascontiguousarray(avg))))
+                g = p.grad.numpy()
+                by_dtype.setdefault(g.dtype.name, []).append((p, g))
+            for group in by_dtype.values():
+                flat = np.concatenate([g.reshape(-1) for _, g in group])
+                avg = pg.all_reduce(flat, op="avg")
+                off = 0
+                for p, g in group:
+                    n = g.size
+                    p.grad._adopt(Tensor(jnp.asarray(
+                        np.ascontiguousarray(
+                            avg[off:off + n].reshape(g.shape)
+                            .astype(g.dtype)))))
+                    off += n
 
     def step(self):
         self._sync_replicated_grads()
@@ -130,12 +156,15 @@ class HybridParallelOptimizer:
 
     clear_gradients = clear_grad
 
-    def minimize(self, loss, **kwargs):
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
         # backward FIRST, then the wrapper's step so the fresh grads get
         # the mp/sep sync (delegating to inner minimize would run the
-        # inner step on unsynced grads)
+        # inner step on unsynced grads); same (ops, params_grads) tuple
+        # contract as the inner optimizer
         loss.backward()
         self.step()
+        return None, None
 
     def state_dict(self):
         return self._inner_opt.state_dict()
@@ -165,13 +194,17 @@ class HybridParallelGradScaler(GradScaler):
         super().unscale_(optimizer)
         if self._hcg is None:
             return
-        try:
-            pg = _group_pg(self._hcg.get_model_parallel_group())
-        except Exception:
-            pg = None
-        if pg is None:
-            return
-        agg = pg.all_reduce(
-            np.asarray([1.0 if self._found_inf else 0.0], np.float32),
-            op="max")
-        self._found_inf = bool(agg[0] > 0)
+        # agree across BOTH axes that partition the model: an Inf on any
+        # mp shard or any pp stage must skip the step everywhere
+        for get_group in (self._hcg.get_model_parallel_group,
+                          self._hcg.get_pipe_parallel_group):
+            try:
+                pg = _group_pg(get_group())
+            except Exception:
+                pg = None
+            if pg is None:
+                continue
+            agg = pg.all_reduce(
+                np.asarray([1.0 if self._found_inf else 0.0],
+                           np.float32), op="max")
+            self._found_inf = bool(agg[0] > 0)

@@ -346,7 +346,10 @@ def save(layer, path, input_spec=None, **configs):
     scope = jax_export.SymbolicScope()
     sym_count = 0
     for spec in input_spec:
-        if isinstance(spec, InputSpec):
+        # accept this module's InputSpec AND paddle.static.InputSpec
+        # (the reference treats them as one class) via duck typing
+        if not isinstance(spec, Tensor) and hasattr(spec, "shape") \
+                and hasattr(spec, "dtype"):
             shape = []
             for s in spec.shape:
                 if s is None:  # dynamic dim -> symbolic (polymorphic)
@@ -385,12 +388,31 @@ def save(layer, path, input_spec=None, **configs):
 
 def load(path, **configs):
     """paddle.jit.load analog: deserialize the StableHLO program + params
-    into a TranslatedLayer (no Python class needed)."""
+    into a TranslatedLayer (no Python class needed). The artifact is
+    opened through the C++ jit container (csrc/jit_layer.cc — mmapped
+    zero-copy params, validated offsets, fluid/jit/layer.h role); the
+    pure-Python reader remains the fallback when the native toolchain is
+    unavailable."""
     from jax import export as jax_export
 
-    np_state = _load_param_file(path + ".pdiparams")
-    with open(path + ".pdmodel", "rb") as f:
-        exported = jax_export.deserialize(f.read())
+    np_state = None
+    program = None
+    container = None
+    if configs.get("use_native_container", True):
+        try:
+            from .native_layer import NativeJitLayer
+            container = NativeJitLayer(path)
+            np_state = container.state_dict()
+            program = container.program_bytes()
+        except Exception:
+            np_state = None
+            container = None
+    if np_state is None:
+        np_state = _load_param_file(path + ".pdiparams")
+    if program is None:
+        with open(path + ".pdmodel", "rb") as f:
+            program = f.read()
+    exported = jax_export.deserialize(program)
 
     import jax.numpy as jnp
     svals = [jnp.asarray(v) for v in np_state.values()]
@@ -401,4 +423,10 @@ def load(path, **configs):
         out = exported.call(svals, *arrays)
         return _wrap_tree(out)
 
-    return TranslatedLayer(np_state, forward_fn)
+    layer = TranslatedLayer(np_state, forward_fn)
+    if container is not None:
+        # np_state holds zero-copy views into the container's mmap: the
+        # container must outlive every retained view (else munmap ->
+        # use-after-free on the next read)
+        object.__setattr__(layer, "_native_container", container)
+    return layer

@@ -33,9 +33,11 @@ def test_inference_predictor_roundtrip(tmp_path):
     np.testing.assert_allclose(out2[0], ref, rtol=1e-5, atol=1e-5)
 
 
-def test_onnx_export_gated():
+def test_onnx_export_requires_input_spec():
+    # the exporter is real now (paddle_tpu/onnx.py); it still demands
+    # input_spec since shapes define the exported graph
     net = nn.Linear(4, 2)
-    with pytest.raises(NotImplementedError):
+    with pytest.raises(ValueError, match="input_spec"):
         paddle.onnx.export(net, "/tmp/m")
 
 

@@ -1,0 +1,70 @@
+"""C++ jit layer container tests (csrc/jit_layer.cc over the jit.save
+artifact — the fluid/jit/layer.h deployable-container role)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.jit.native_layer import NativeJitLayer
+from paddle_tpu.static import InputSpec
+
+
+@pytest.fixture(scope="module")
+def saved(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("jit") / "model")
+    paddle.seed(9)
+    net = nn.Sequential(nn.Linear(6, 8), nn.ReLU(), nn.Linear(8, 3))
+    paddle.jit.save(net, path,
+                    input_spec=[InputSpec([None, 6], "float32")])
+    return path, net
+
+
+class TestNativeContainer:
+    def test_params_zero_copy_match(self, saved):
+        path, net = saved
+        c = NativeJitLayer(path)
+        state = c.state_dict()
+        ref = {k: np.asarray(v._value)
+               for k, v in net.state_dict().items()}
+        assert set(state) == set(ref)
+        for k in ref:
+            np.testing.assert_array_equal(state[k], ref[k])
+        # views are read-only (mmap PROT_READ)
+        with pytest.raises(ValueError):
+            state[list(state)[0]][...] = 0
+
+    def test_program_bytes_deserialize(self, saved):
+        path, _ = saved
+        c = NativeJitLayer(path)
+        blob = c.program_bytes()
+        assert len(blob) > 0
+        from jax import export as jax_export
+        exported = jax_export.deserialize(blob)  # must be valid
+        assert exported is not None
+
+    def test_load_through_container_matches_eager(self, saved):
+        path, net = saved
+        loaded = paddle.jit.load(path)   # native container path
+        x = np.random.RandomState(0).randn(4, 6).astype(np.float32)
+        got = loaded(paddle.to_tensor(x)).numpy()
+        ref = net(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+    def test_missing_artifact_raises(self, tmp_path):
+        with pytest.raises(RuntimeError, match="cannot open"):
+            NativeJitLayer(str(tmp_path / "nope"))
+
+    def test_corrupt_header_rejected(self, tmp_path):
+        bad = tmp_path / "bad.pdiparams"
+        bad.write_bytes((1 << 40).to_bytes(8, "little") + b"junk")
+        with pytest.raises(RuntimeError):
+            NativeJitLayer(str(tmp_path / "bad"))
+
+    def test_out_of_bounds_offsets_rejected(self, tmp_path):
+        import json
+        head = json.dumps({"w": {"dtype": "float32", "shape": [4],
+                                 "offsets": [0, 99999]}}).encode()
+        f = tmp_path / "oob.pdiparams"
+        f.write_bytes(len(head).to_bytes(8, "little") + head + b"\0" * 8)
+        with pytest.raises(RuntimeError, match="out of bounds"):
+            NativeJitLayer(str(tmp_path / "oob"))
