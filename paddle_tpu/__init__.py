@@ -130,3 +130,4 @@ _default_dtype = "float32"
 def set_default_dtype(d):
     global _default_dtype
     _default_dtype = str(d)
+from . import base  # noqa: E402

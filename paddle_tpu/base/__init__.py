@@ -1,0 +1,29 @@
+"""paddle.base — error types + enforce helpers.
+
+Analog of the reference's error system (paddle/common/enforce.h
+PADDLE_ENFORCE* macros + common::errors error builders, surfaced to
+Python as paddle.base.core.EnforceNotMet and typed subclasses). Errors
+carry the op/API context frame the way the reference's
+FLAGS_call_stack_level error summaries do.
+"""
+from . import core  # noqa: F401
+from .core import (  # noqa: F401
+    EnforceNotMet,
+    InvalidArgumentError,
+    NotFoundError,
+    OutOfRangeError,
+    PreconditionNotMetError,
+    ResourceExhaustedError,
+    UnavailableError,
+    UnimplementedError,
+    enforce,
+    enforce_eq,
+    enforce_gt,
+    enforce_shape_match,
+)
+
+__all__ = ["core", "EnforceNotMet", "InvalidArgumentError",
+           "NotFoundError", "OutOfRangeError", "PreconditionNotMetError",
+           "ResourceExhaustedError", "UnavailableError",
+           "UnimplementedError", "enforce", "enforce_eq", "enforce_gt",
+           "enforce_shape_match"]

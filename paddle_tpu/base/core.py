@@ -1,0 +1,97 @@
+"""Typed framework errors + enforce helpers (enforce.h / errors.h
+analog). The class names and hierarchy mirror common::errors so user
+code catching paddle.base.core.<Error> ports directly."""
+from __future__ import annotations
+
+import traceback
+from typing import Any, Sequence
+
+__all__ = ["EnforceNotMet", "InvalidArgumentError", "NotFoundError",
+           "OutOfRangeError", "PreconditionNotMetError",
+           "ResourceExhaustedError", "UnavailableError",
+           "UnimplementedError", "enforce", "enforce_eq", "enforce_gt",
+           "enforce_shape_match"]
+
+
+class EnforceNotMet(RuntimeError):
+    """Base framework error (enforce.h EnforceNotMet): message + the
+    user-code frame that triggered it (the call_stack_level=1 summary)."""
+
+    def __init__(self, message: str, context: str = ""):
+        frame = _user_frame()
+        parts = [message]
+        if context:
+            parts.append(f"  [Hint: {context}]")
+        if frame:
+            parts.append(f"  [operator < {frame} > error]")
+        super().__init__("\n".join(parts))
+        self.message = message
+        self.context = context
+
+
+class InvalidArgumentError(EnforceNotMet, ValueError):
+    pass
+
+
+class NotFoundError(EnforceNotMet, KeyError):
+    pass
+
+
+class OutOfRangeError(EnforceNotMet, IndexError):
+    pass
+
+
+class PreconditionNotMetError(EnforceNotMet):
+    pass
+
+
+class ResourceExhaustedError(EnforceNotMet, MemoryError):
+    pass
+
+
+class UnavailableError(EnforceNotMet):
+    pass
+
+
+class UnimplementedError(EnforceNotMet, NotImplementedError):
+    pass
+
+
+def _user_frame() -> str:
+    """Innermost stack frame outside paddle_tpu — what the user called.
+    Only the module filter skips frames (no fixed-depth slicing: direct
+    raises and enforce() have different intermediate depths)."""
+    for f in reversed(traceback.extract_stack()[:-1]):
+        if "paddle_tpu" not in (f.filename or ""):
+            return f"{f.filename}:{f.lineno} {f.name}"
+    return ""
+
+
+def enforce(cond: Any, message: str, context: str = "",
+            error_cls=None):
+    """PADDLE_ENFORCE analog: raise a typed framework error when the
+    condition is false."""
+    if not cond:
+        raise (error_cls or PreconditionNotMetError)(message, context)
+
+
+def enforce_eq(a, b, message: str = "", context: str = ""):
+    if a != b:
+        raise InvalidArgumentError(
+            message or f"expected equality, got {a!r} != {b!r}", context)
+
+
+def enforce_gt(a, b, message: str = "", context: str = ""):
+    if not a > b:
+        raise InvalidArgumentError(
+            message or f"expected {a!r} > {b!r}", context)
+
+
+def enforce_shape_match(shape_a: Sequence, shape_b: Sequence,
+                        message: str = "", context: str = ""):
+    """Broadcast-unaware exact shape check with a detailed message
+    (the common InferMeta error shape)."""
+    if list(shape_a) != list(shape_b):
+        raise InvalidArgumentError(
+            message or (f"shape mismatch: {list(shape_a)} vs "
+                        f"{list(shape_b)}"), context)

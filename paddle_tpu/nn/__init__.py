@@ -19,8 +19,9 @@ from .transformer import (MultiHeadAttention, TransformerEncoderLayer,  # noqa: 
                           TransformerEncoder, TransformerDecoderLayer,
                           TransformerDecoder, Transformer)
 from .clip import ClipGradByNorm, ClipGradByValue, ClipGradByGlobalNorm  # noqa: F401
-from .utils_weight_norm import weight_norm, remove_weight_norm  # noqa: F401
+from .utils import weight_norm, remove_weight_norm, spectral_norm  # noqa: F401
 
 # activations & other tensor methods registered after ops init:
 from ..ops._helper import attach_tensor_methods as _attach
 _attach()
+from . import utils  # noqa: F401

@@ -1,0 +1,159 @@
+"""paddle.nn.utils (python/paddle/nn/utils/ analog): weight
+reparameterizations and parameter flattening."""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from .._core.tensor import Tensor
+from .layer import Layer
+
+__all__ = ["weight_norm", "remove_weight_norm", "spectral_norm",
+           "parameters_to_vector", "vector_to_parameters"]
+
+
+def _norm_except_dim(v, dim):
+    if dim == -1:
+        return jnp.sqrt(jnp.sum(v * v))
+    axes = tuple(d for d in range(v.ndim) if d != dim)
+    return jnp.sqrt(jnp.sum(v * v, axis=axes, keepdims=True))
+
+
+def weight_norm(layer: Layer, name: str = "weight", dim: int = 0):
+    """Reparameterize `name` as g * v/||v|| (weight_norm.py analog):
+    v and g become the trainable parameters (g a vector over `dim`,
+    paddle's convention); the effective weight is recomputed in a
+    forward-pre hook so autograd flows into both."""
+    import paddle_tpu as paddle
+
+    if dim is None:
+        dim = 0
+    w = getattr(layer, name)
+    wv = w._value
+    axes = tuple(i for i in range(wv.ndim) if i != dim)
+    g0 = jnp.sqrt(jnp.sum(jnp.square(wv), axis=axes))
+    v = paddle.create_parameter(list(wv.shape), str(wv.dtype))
+    v._replace_value_inplace(jnp.asarray(wv))
+    g = paddle.create_parameter(list(g0.shape), str(wv.dtype))
+    g._replace_value_inplace(jnp.asarray(g0))
+    layer.add_parameter(f"{name}_v", v)
+    layer.add_parameter(f"{name}_g", g)
+    # the original weight becomes derived state, not a parameter
+    if name in layer._parameters:
+        del layer._parameters[name]
+
+    def _derived_weight():
+        # built from framework ops so backward reaches v and g
+        vv = v * v
+        ax = [d for d in range(v.ndim) if d != dim]
+        nrm = vv.sum(axis=ax, keepdim=True) ** 0.5
+        shape = [1] * v.ndim
+        shape[dim] = -1
+        return (v / nrm) * g.reshape(shape)
+
+    def recompute(lyr, inputs):
+        object.__setattr__(lyr, name, _derived_weight())
+        return None
+
+    handle = layer.register_forward_pre_hook(
+        lambda lyr, inputs: recompute(lyr, inputs))
+    layer.__dict__.setdefault("_weight_norm_hooks", {})[name] = \
+        (handle, v, g, dim)
+    recompute(layer, None)
+    return layer
+
+
+def remove_weight_norm(layer: Layer, name: str = "weight"):
+    """Fold g*v/||v|| back into a single parameter AND remove the hook
+    (a surviving hook would keep overwriting the restored parameter
+    every forward, silently disconnecting it from training)."""
+    import paddle_tpu as paddle
+
+    hooks = layer.__dict__.get("_weight_norm_hooks", {})
+    if name not in hooks:
+        return layer
+    handle, v, g, dim = hooks.pop(name)
+    try:
+        handle.remove()
+    except Exception:
+        pass
+    axes = tuple(i for i in range(v._value.ndim) if i != dim)
+    norm = jnp.sqrt(jnp.sum(jnp.square(v._value), axis=axes,
+                            keepdims=True))
+    shape = [1] * v._value.ndim
+    shape[dim] = -1
+    eff = (v._value / jnp.maximum(norm, 1e-12)) * \
+        g._value.reshape(shape)
+    w = paddle.create_parameter(list(eff.shape), str(eff.dtype))
+    w._replace_value_inplace(jnp.asarray(eff))
+    for pname in (f"{name}_v", f"{name}_g"):
+        if pname in layer._parameters:
+            del layer._parameters[pname]
+    if name in layer.__dict__:
+        del layer.__dict__[name]  # drop the derived attribute shadow
+    layer.add_parameter(name, w)
+    return layer
+
+
+def spectral_norm(layer: Layer, name: str = "weight", n_power_iterations=1,
+                  eps: float = 1e-12, dim: int = 0):
+    """Divide the weight by its largest singular value, estimated with
+    power iteration on buffers u/v (spectral_norm_hook.py analog)."""
+    w = getattr(layer, name)
+    wv = np.asarray(w._value)
+    mat = np.moveaxis(wv, dim, 0).reshape(wv.shape[dim], -1)
+    rng = np.random.RandomState(0)
+    u = rng.randn(mat.shape[0]).astype(np.float32)
+    u /= np.linalg.norm(u) + eps
+    state = {"u": jnp.asarray(u)}
+
+    def hook(lyr, inputs):
+        # always iterate on the ORIGINAL weight: the visible attribute
+        # is already normalized after the first call, and sigma of a
+        # normalized matrix is ~1 (would undo the normalization)
+        base0 = lyr._parameters.get(f"{name}_orig")
+        wval = base0._value
+        m = jnp.moveaxis(wval, dim, 0).reshape(wval.shape[dim], -1)
+        u_ = state["u"]
+        # v from the cached u first: n_power_iterations=0 reuses it
+        v_ = m.T @ u_
+        v_ = v_ / (jnp.linalg.norm(v_) + eps)
+        for _ in range(n_power_iterations):
+            u_ = m @ v_
+            u_ = u_ / (jnp.linalg.norm(u_) + eps)
+            v_ = m.T @ u_
+            v_ = v_ / (jnp.linalg.norm(v_) + eps)
+        state["u"] = u_
+        sigma = u_ @ m @ v_
+        base = lyr._parameters.get(f"{name}_orig")
+        eff = base / sigma
+        object.__setattr__(lyr, name, eff)
+        return None
+
+    # keep the original as the trainable parameter
+    layer.add_parameter(f"{name}_orig", w)
+    if name in layer._parameters:
+        del layer._parameters[name]
+    layer.register_forward_pre_hook(lambda lyr, inputs: hook(lyr, inputs))
+    hook(layer, None)
+    return layer
+
+
+def parameters_to_vector(parameters, name=None) -> Tensor:
+    """Flatten parameters into one 1-D tensor (utils/transform_parameters
+    parameters_to_vector)."""
+    vals = [jnp.ravel(p._value) for p in parameters]
+    return Tensor(jnp.concatenate(vals))
+
+
+def vector_to_parameters(vec: Tensor, parameters, name=None):
+    """Write slices of `vec` back into the parameters."""
+    off = 0
+    v = vec._value
+    for p in parameters:
+        n = int(np.prod(p.shape)) if p.shape else 1
+        p._replace_value_inplace(
+            jnp.reshape(v[off:off + n], tuple(p.shape)))
+        off += n
+    return parameters

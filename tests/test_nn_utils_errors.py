@@ -1,0 +1,95 @@
+"""nn.utils reparameterizations + paddle.base error system."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.base import core as bcore
+
+
+class TestWeightNorm:
+    def test_effective_weight_and_grads(self):
+        paddle.seed(0)
+        lin = nn.Linear(4, 3)
+        w0 = lin.weight.numpy().copy()
+        nn.utils.weight_norm(lin, "weight", dim=0)
+        assert "weight_v" in dict(lin.named_parameters())
+        assert "weight_g" in dict(lin.named_parameters())
+        x = paddle.to_tensor(np.random.RandomState(1).randn(2, 4)
+                             .astype(np.float32))
+        out = lin(x)
+        # reparameterized weight initially equals the original
+        np.testing.assert_allclose(out.numpy(), x.numpy() @ w0,
+                                   rtol=1e-4, atol=1e-5)
+        loss = out.sum()
+        loss.backward()
+        params = dict(lin.named_parameters())
+        assert params["weight_v"].grad is not None
+        assert params["weight_g"].grad is not None
+
+    def test_remove_weight_norm_folds_back(self):
+        paddle.seed(0)
+        lin = nn.Linear(4, 3)
+        w0 = lin.weight.numpy().copy()
+        nn.utils.weight_norm(lin, "weight")
+        nn.utils.remove_weight_norm(lin, "weight")
+        names = dict(lin.named_parameters())
+        assert "weight_v" not in names and "weight" in names
+        np.testing.assert_allclose(names["weight"].numpy(), w0,
+                                   rtol=1e-5, atol=1e-6)
+
+
+class TestSpectralNorm:
+    def test_unit_spectral_radius(self):
+        paddle.seed(0)
+        lin = nn.Linear(6, 6)
+        nn.utils.spectral_norm(lin, "weight", n_power_iterations=20)
+        x = paddle.to_tensor(np.eye(6, dtype=np.float32))
+        lin(x)  # trigger hook
+        w_eff = np.asarray(lin.weight._value)
+        s = np.linalg.svd(w_eff, compute_uv=False)
+        assert abs(s[0] - 1.0) < 0.05
+
+
+class TestParamVector:
+    def test_round_trip(self):
+        paddle.seed(0)
+        lin = nn.Linear(3, 2)
+        vec = nn.utils.parameters_to_vector(lin.parameters())
+        assert vec.shape == [3 * 2 + 2]
+        doubled = paddle.to_tensor(vec.numpy() * 2.0)
+        nn.utils.vector_to_parameters(doubled, lin.parameters())
+        vec2 = nn.utils.parameters_to_vector(lin.parameters())
+        np.testing.assert_allclose(vec2.numpy(), vec.numpy() * 2.0,
+                                   rtol=1e-6)
+
+
+class TestErrors:
+    def test_hierarchy_and_catchability(self):
+        with pytest.raises(ValueError):         # typed multiple-inherit
+            raise bcore.InvalidArgumentError("bad arg")
+        with pytest.raises(bcore.EnforceNotMet):
+            raise bcore.OutOfRangeError("index 9 out of range")
+        with pytest.raises(NotImplementedError):
+            raise bcore.UnimplementedError("later")
+
+    def test_enforce_helpers(self):
+        bcore.enforce(True, "fine")
+        with pytest.raises(bcore.PreconditionNotMetError):
+            bcore.enforce(False, "not fine")
+        with pytest.raises(bcore.InvalidArgumentError, match="equality"):
+            bcore.enforce_eq(1, 2)
+        with pytest.raises(bcore.InvalidArgumentError,
+                           match="shape mismatch"):
+            bcore.enforce_shape_match([2, 3], [3, 2])
+
+    def test_message_carries_user_frame_and_hint(self):
+        try:
+            bcore.enforce(False, "boom", context="check your input")
+        except bcore.EnforceNotMet as e:
+            msg = str(e)
+            assert "boom" in msg and "Hint: check your input" in msg
+            assert "test_nn_utils_errors.py" in msg  # user frame, not ours
+
+    def test_paddle_base_namespace(self):
+        assert paddle.base.core.EnforceNotMet is bcore.EnforceNotMet
