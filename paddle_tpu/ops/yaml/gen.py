@@ -1,0 +1,224 @@
+"""Declarative op schema + generator (paddle/phi/ops/yaml analog).
+
+The reference defines ops declaratively (ops.yaml:8-18 schema: args /
+output / infer_meta / kernel / spmd_rule / backward) and generates the
+C++ API, autograd nodes and Python bindings from it. The TPU-native
+split: kernel BODIES are jax functions registered at import (XLA is the
+codegen), so what the YAML layer owns here is the same METADATA the
+reference's owns —
+
+- the schema of record for an op: signature, output arity, spmd_rule
+  binding, backward pairing;
+- consistency enforcement: every YAML entry must agree with the live
+  registry (op exists, multi_output matches, the bound spmd_rule is
+  registered) — the role of the reference's generator-time checks;
+- API generation: `generate_wrappers()` emits the public functional
+  wrapper for each entry from its declared signature (the python_c_gen
+  role), used by paddle_tpu.ops.generated.
+
+Schema (ops.yaml in this directory; each `args:` spec is ONE line —
+the reader is line-based):
+
+    - op: matmul
+      args: (x: Tensor, y: Tensor, transpose_x: bool = false, transpose_y: bool = false)
+      output: Tensor
+      spmd_rule: matmul
+      backward: auto          # VJP derived from the forward body
+"""
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, List, Optional
+
+_YAML = os.path.join(os.path.dirname(__file__), "ops.yaml")
+
+_TYPES = {"Tensor", "bool", "int", "float", "str", "int[]", "float[]"}
+
+
+class OpEntry:
+    def __init__(self, name: str):
+        self.name = name
+        self.tensor_args: List[str] = []
+        self.attrs: List[tuple] = []   # (name, type, default-or-None)
+        self.n_outputs = 1
+        self.spmd_rule: Optional[str] = None
+        self.backward = "auto"
+
+    def __repr__(self):
+        return (f"OpEntry({self.name}, tensors={self.tensor_args}, "
+                f"attrs={[a[0] for a in self.attrs]}, "
+                f"out={self.n_outputs})")
+
+
+def _parse_args(text: str, entry: OpEntry):
+    # "(x: Tensor, axis: int = -1, keepdim: bool = false)"
+    inner = text.strip()
+    if inner.startswith("("):
+        inner = inner[1:-1]
+    if not inner.strip():
+        return
+    for piece in re.split(r",(?![^\[]*\])", inner):
+        piece = piece.strip()
+        m = re.match(r"(\w+)\s*:\s*([\w\[\]]+)(?:\s*=\s*(.+))?$", piece)
+        if not m:
+            raise ValueError(
+                f"ops.yaml: bad arg spec '{piece}' in op {entry.name}")
+        arg, ty, default = m.group(1), m.group(2), m.group(3)
+        if ty not in _TYPES:
+            raise ValueError(
+                f"ops.yaml: unknown type '{ty}' in op {entry.name}")
+        if ty == "Tensor":
+            if default is not None:
+                raise ValueError(
+                    f"ops.yaml: Tensor arg '{arg}' cannot default")
+            entry.tensor_args.append(arg)
+        else:
+            entry.attrs.append((arg, ty, default))
+
+
+def load_schema(path: str = _YAML) -> Dict[str, OpEntry]:
+    """Tiny purpose-built reader for the restricted YAML subset the
+    schema uses (list of flat mappings) — same spirit as the reference's
+    parse_utils.py which also hand-parses its op yaml."""
+    entries: Dict[str, OpEntry] = {}
+    cur: Optional[OpEntry] = None
+    with open(path) as f:
+        for ln, raw in enumerate(f, 1):
+            line = raw.rstrip("\n")
+            if not line.strip() or line.strip().startswith("#"):
+                continue
+            m = re.match(r"-\s*op\s*:\s*(\w+)\s*$", line.strip()) \
+                if line.lstrip().startswith("-") else None
+            if m:
+                cur = OpEntry(m.group(1))
+                entries[cur.name] = cur
+                continue
+            if cur is None:
+                raise ValueError(f"ops.yaml:{ln}: key before first op")
+            key, _, val = line.strip().partition(":")
+            key, val = key.strip(), val.strip()
+            if key == "args":
+                _parse_args(val, cur)
+            elif key == "output":
+                cur.n_outputs = 1 if val == "Tensor" else \
+                    len(val.split(","))
+            elif key == "spmd_rule":
+                cur.spmd_rule = val
+            elif key == "backward":
+                cur.backward = val
+            else:
+                raise ValueError(f"ops.yaml:{ln}: unknown key '{key}'")
+    return entries
+
+
+def validate(entries: Optional[Dict[str, OpEntry]] = None) -> List[str]:
+    """Cross-check the schema against the LIVE registry; returns a list
+    of problems (empty = consistent). The generator-time error class of
+    the reference's codegen."""
+    from ..._core.op_registry import _OPS
+    from ...distributed.auto_parallel.spmd_rules import _RULES
+
+    import inspect
+
+    entries = entries or load_schema()
+    problems = []
+    for e in entries.values():
+        op = _OPS.get(e.name)
+        if op is None:
+            problems.append(f"{e.name}: not in the runtime registry")
+            continue
+        if bool(op.multi_output) != (e.n_outputs > 1):
+            problems.append(
+                f"{e.name}: multi_output mismatch (yaml {e.n_outputs} "
+                f"outputs, registry multi_output={op.multi_output})")
+        # runtime resolution is BY OP NAME (spmd_rules.resolve): a
+        # binding naming any other registered rule would validate but
+        # silently disagree with live behavior
+        if e.spmd_rule is not None:
+            if e.spmd_rule not in _RULES:
+                problems.append(f"{e.name}: spmd_rule '{e.spmd_rule}' "
+                                f"is not registered")
+            elif e.spmd_rule != e.name:
+                problems.append(
+                    f"{e.name}: spmd_rule '{e.spmd_rule}' cannot bind — "
+                    f"runtime resolves rules by op name")
+        # attr names must exist in the kernel signature, or the wrapper
+        # TypeErrors at first call instead of at generation time
+        try:
+            kernel_params = [p for p in
+                             inspect.signature(op.fn).parameters
+                             if not p.startswith("_")]
+        except (TypeError, ValueError):
+            kernel_params = None
+        if kernel_params is not None:
+            if len(e.tensor_args) > len(kernel_params):
+                problems.append(
+                    f"{e.name}: {len(e.tensor_args)} tensor args but "
+                    f"kernel takes {len(kernel_params)} params")
+            for a, _, _ in e.attrs:
+                if a not in kernel_params:
+                    problems.append(
+                        f"{e.name}: attr '{a}' is not a kernel "
+                        f"parameter ({kernel_params})")
+    return problems
+
+
+def generate_wrappers(entries: Optional[Dict[str, OpEntry]] = None) -> str:
+    """Emit python source for functional wrappers (python_c_gen.py
+    role): signature from the declared args, body = apply(op, ...)."""
+    entries = entries or load_schema()
+    lines = ['"""AUTO-GENERATED by paddle_tpu.ops.yaml.gen — do not',
+             'edit. Regenerate with python -m paddle_tpu.ops.yaml.gen."""',
+             "from .._core.executor import apply",
+             "", ""]
+
+    def pydefault(ty, d):
+        # an attr WITHOUT a yaml default is REQUIRED: fabricating a
+        # zero-default would silently corrupt calls (clip(x) clamping
+        # everything to [0, 0])
+        if d is None:
+            return None
+        if ty == "str":
+            return repr(d.strip("'\""))
+        return {"false": "False", "true": "True"}.get(d, d)
+
+    for e in entries.values():
+        attr_params = []
+        for a, ty, d in e.attrs:
+            pd = pydefault(ty, d)
+            attr_params.append(a if pd is None else f"{a}={pd}")
+        # attrs are keyword-only: required attrs may follow defaulted
+        # ones in declared order without breaking Python's ordering rule
+        params = list(e.tensor_args)
+        if attr_params:
+            params += ["*"] + attr_params + ["name=None"]
+        else:
+            params += ["name=None"]
+        kwargs = ", ".join(f"{a}={a}" for a, _, _ in e.attrs)
+        call_args = ", ".join(e.tensor_args)
+        sep = ", " if kwargs else ""
+        lines += [
+            f"def {e.name}({', '.join(params)}):",
+            f'    """Generated from ops.yaml (op: {e.name})."""',
+            f"    return apply('{e.name}', {call_args}{sep}{kwargs})",
+            "", ""]
+    return "\n".join(lines)
+
+
+def write_generated(path: Optional[str] = None) -> str:
+    path = path or os.path.join(os.path.dirname(__file__), "..",
+                                "generated.py")
+    problems = validate()
+    if problems:
+        raise ValueError("ops.yaml inconsistent with registry:\n  "
+                         + "\n  ".join(problems))
+    src = generate_wrappers()
+    with open(path, "w") as f:
+        f.write(src)
+    return os.path.abspath(path)
+
+
+if __name__ == "__main__":
+    out = write_generated()
+    print(f"wrote {out}")
