@@ -112,19 +112,39 @@ struct CommContext {
   }
 };
 
+// Max consecutive 60s poll timeouts with zero progress before a
+// transfer is declared dead (peer SIGSTOPped / network partition). A
+// peer that dies WITH a socket close is caught immediately by recv==0;
+// this bounds the case where it dies without one. Overridable via
+// PT_COMM_IDLE_POLL_LIMIT for ranks whose peers may lag a long time
+// before entering a collective (e.g. very large first-compile skews).
+static int max_idle_polls() {
+  static int v = [] {
+    const char* e = getenv("PT_COMM_IDLE_POLL_LIMIT");
+    int n = e ? atoi(e) : 0;
+    return n > 0 ? n : 10;
+  }();
+  return v;
+}
+
 // Blocking-with-poll full write/read on a (possibly nonblocking) fd.
 bool write_full(int fd, const void* buf, size_t n) {
   const char* p = static_cast<const char*>(buf);
+  int idle = 0;
   while (n > 0) {
     ssize_t w = ::send(fd, p, n, MSG_NOSIGNAL);
     if (w > 0) {
       p += w;
       n -= static_cast<size_t>(w);
+      idle = 0;
       continue;
     }
     if (w < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
       struct pollfd pf{fd, POLLOUT, 0};
-      poll(&pf, 1, 60000);
+      if (poll(&pf, 1, 60000) == 0 && ++idle >= max_idle_polls()) {
+        pt::set_last_error("ptcc: write stalled (peer unresponsive)");
+        return false;
+      }
       continue;
     }
     if (w < 0 && errno == EINTR) continue;
@@ -135,16 +155,21 @@ bool write_full(int fd, const void* buf, size_t n) {
 
 bool read_full(int fd, void* buf, size_t n) {
   char* p = static_cast<char*>(buf);
+  int idle = 0;
   while (n > 0) {
     ssize_t r = ::recv(fd, p, n, 0);
     if (r > 0) {
       p += r;
       n -= static_cast<size_t>(r);
+      idle = 0;
       continue;
     }
     if (r < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
       struct pollfd pf{fd, POLLIN, 0};
-      poll(&pf, 1, 60000);
+      if (poll(&pf, 1, 60000) == 0 && ++idle >= max_idle_polls()) {
+        pt::set_last_error("ptcc: read stalled (peer unresponsive)");
+        return false;
+      }
       continue;
     }
     if (r < 0 && errno == EINTR) continue;
@@ -160,6 +185,7 @@ bool duplex(int send_fd, const void* sbuf, size_t sn, int recv_fd,
             void* rbuf, size_t rn) {
   const char* sp = static_cast<const char*>(sbuf);
   char* rp = static_cast<char*>(rbuf);
+  int idle = 0;
   while (sn > 0 || rn > 0) {
     struct pollfd pf[2];
     int k = 0;
@@ -172,7 +198,13 @@ bool duplex(int send_fd, const void* sbuf, size_t sn, int recv_fd,
       ri = k;
       pf[k++] = {recv_fd, POLLIN, 0};
     }
-    if (poll(pf, k, 60000) < 0 && errno != EINTR) return false;
+    int pr = poll(pf, k, 60000);
+    if (pr < 0 && errno != EINTR) return false;
+    if (pr == 0 && ++idle >= max_idle_polls()) {
+      pt::set_last_error("ptcc: duplex stalled (peer unresponsive)");
+      return false;
+    }
+    if (pr > 0) idle = 0;
     if (si >= 0 && (pf[si].revents & (POLLOUT | POLLERR | POLLHUP))) {
       ssize_t w = ::send(send_fd, sp, sn, MSG_NOSIGNAL);
       if (w > 0) {
@@ -210,24 +242,25 @@ bool resolve_connect(const std::string& host, int port, int* fd_out) {
   int fd = -1;
   bool connected = false;
   for (struct addrinfo* ai = res; ai && !connected; ai = ai->ai_next) {
-    fd = socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
-    if (fd < 0) continue;
-    // retry while the peer's listener may not be up yet
+    // retry while the peer's listener may not be up yet; POSIX leaves a
+    // socket in an unspecified state after a failed connect(), so make a
+    // fresh one each attempt instead of reusing the fd
     for (int tries = 0; tries < 600; ++tries) {
+      fd = socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+      if (fd < 0) break;
       if (::connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) {
         connected = true;
         break;
       }
-      if (errno == ECONNREFUSED || errno == ETIMEDOUT ||
-          errno == EHOSTUNREACH) {
+      int cerr = errno;  // close() may clobber errno
+      close(fd);
+      fd = -1;
+      if (cerr == ECONNREFUSED || cerr == ETIMEDOUT ||
+          cerr == EHOSTUNREACH) {
         usleep(100000);
         continue;
       }
       break;  // non-retryable: try the next addrinfo entry
-    }
-    if (!connected) {
-      close(fd);
-      fd = -1;
     }
   }
   freeaddrinfo(res);

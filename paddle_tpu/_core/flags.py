@@ -79,3 +79,5 @@ define_flag("FLAGS_seed", 0, "Default global random seed.")
 define_flag("FLAGS_tpu_matmul_precision", "default",
             "Matmul precision: default|high|highest.")
 define_flag("FLAGS_benchmark", False, "Block on every eager op (for timing).")
+define_flag("FLAGS_apply_ir_passes", True,
+            "run the IR pass pipeline when compiling static Programs")

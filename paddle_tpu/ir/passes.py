@@ -6,13 +6,12 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
-from .._core.flags import define_flag
 from .._core.op_registry import get_op
 from .pass_base import Pass, Workspace
 from .pattern_rewrite import PatternRewriter, RewritePattern
 
-define_flag("FLAGS_apply_ir_passes", True,
-            "run the IR pass pipeline when compiling static Programs")
+# FLAGS_apply_ir_passes is defined with the core flags
+# (_core/flags.py) so static mode works without importing this module.
 
 # ops whose results are not pure functions of their inputs — never fold,
 # dedupe, or reorder across these (pir marks these via op traits)

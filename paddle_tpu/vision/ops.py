@@ -204,7 +204,9 @@ def nms(boxes, scores=None, iou_threshold=0.3, top_k=None,
                       iou_threshold=float(iou_threshold))
     # mask is in score-sorted order: map positions back through argsort
     mask = np.asarray(keep_mask._value)
-    order = np.argsort(-np.asarray(scores._value))
+    # stable sort so the host permutation matches jnp.argsort (stable) in
+    # the kernel even when scores tie
+    order = np.argsort(-np.asarray(scores._value), kind="stable")
     kept = order[np.nonzero(mask)[0]]
     if top_k is not None:
         kept = kept[:top_k]
