@@ -131,3 +131,13 @@ def set_default_dtype(d):
     global _default_dtype
     _default_dtype = str(d)
 from . import base  # noqa: E402
+
+# ---- ops.yaml system-of-record enforcement (end of package init, when
+# the registry is fully populated): every import-time-registered op must
+# have a schema entry and no non-lazy entry may dangle. register_op
+# already rejects unknown names at registration time; this closes the
+# stale direction. Skipped only under the bootstrap escape hatch used by
+# ops.yaml.bootstrap to draft entries for a new op.
+if not _os.environ.get("PADDLE_TPU_BOOTSTRAP"):
+    from .ops.yaml.gen import check_complete as _check_schema_complete
+    _check_schema_complete()

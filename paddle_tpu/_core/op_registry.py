@@ -27,15 +27,17 @@ class OpDef:
     """
 
     __slots__ = ("name", "fn", "bwd", "multi_output", "spmd_rule", "doc",
-                 "variants")
+                 "variants", "custom")
 
     def __init__(self, name: str, fn: Callable, bwd: Optional[Callable] = None,
-                 multi_output: bool = False, spmd_rule=None):
+                 multi_output: bool = False, spmd_rule=None,
+                 custom: bool = False):
         self.name = name
         self.fn = fn
         self.bwd = bwd
         self.multi_output = multi_output
         self.spmd_rule = spmd_rule
+        self.custom = custom
         self.doc = fn.__doc__
         # backend name -> kernel body override. The default fn is the
         # generic XLA lowering; a variant is the analog of a per-backend
@@ -49,15 +51,53 @@ class OpDef:
 
 _OPS: Dict[str, OpDef] = {}
 
+_SCHEMA_NAMES = None
+
+
+def _schema_names():
+    """Names declared in ops.yaml (the system of record). Parsed directly
+    from the file — no import of the yaml package — so enforcement can
+    run during early package init without cycles."""
+    global _SCHEMA_NAMES
+    if _SCHEMA_NAMES is None:
+        import os
+        import re
+        path = os.path.join(os.path.dirname(__file__), os.pardir,
+                            "ops", "yaml", "ops.yaml")
+        names = set()
+        with open(path) as f:
+            for line in f:
+                m = re.match(r"-\s*op\s*:\s*(\w+)", line.strip())
+                if m:
+                    names.add(m.group(1))
+        _SCHEMA_NAMES = names
+    return _SCHEMA_NAMES
+
 
 def register_op(name: str, fn: Callable = None, *, bwd: Callable = None,
-                multi_output: bool = False, spmd_rule=None):
-    """Register an op. Usable as decorator or direct call."""
+                multi_output: bool = False, spmd_rule=None,
+                custom: bool = False):
+    """Register an op. Usable as decorator or direct call.
+
+    Framework ops (custom=False) MUST have an entry in ops/yaml/ops.yaml
+    — the declarative schema is the system of record, as in the
+    reference where every op is declared in phi/ops/yaml/ops.yaml:8-18
+    and codegen fails on mismatch. Out-of-tree ops (cpp_extension /
+    incubate custom python ops / tests) pass custom=True.
+    """
     def _do(f):
         if name in _OPS:
             raise ValueError(f"op '{name}' already registered")
+        import os
+        if (not custom and name not in _schema_names()
+                and not os.environ.get("PADDLE_TPU_BOOTSTRAP")):
+            raise ValueError(
+                f"op '{name}' has no ops.yaml entry — the declarative "
+                f"schema (paddle_tpu/ops/yaml/ops.yaml) is the system "
+                f"of record; add an entry (see ops.yaml.bootstrap) or "
+                f"register with custom=True for out-of-tree ops")
         op = OpDef(name, f, bwd=bwd, multi_output=multi_output,
-                   spmd_rule=spmd_rule)
+                   spmd_rule=spmd_rule, custom=custom)
         _OPS[name] = op
         return op
 

@@ -36,6 +36,9 @@ def constrain_dim(t: Tensor, dim: int, axis: str = "mp",
     key = (f"shard_constraint_{axis}_{dim % t.ndim}_"
            f"{'s' if shard else 'r'}_{t.ndim}")
     if key not in _OPS:
+        # synthetic per-(axis,dim,mode,rank) op family — generated names
+        # can't be enumerated in ops.yaml, so registered as custom
         register_op(key, lambda x, _s=spec:
-                    jax.lax.with_sharding_constraint(x, _s))
+                    jax.lax.with_sharding_constraint(x, _s),
+                    custom=True)
     return apply(key, t)

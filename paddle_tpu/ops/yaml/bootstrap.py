@@ -1,0 +1,249 @@
+"""Draft ops.yaml entries from the live registry (dev tool).
+
+The schema (ops.yaml) is the system of record; this tool exists to keep
+it honest when the registry grows: it reconstructs each op's declared
+signature from three evidence sources, strongest first —
+
+1. a dynamic call trace (JSON produced by the tests/trace_ops pytest
+   plugin: exact tensor arity + attr names/types observed at run time),
+2. a static AST scan of every literal `apply("op", ...)` call site in
+   the package (tensor args are positional, attrs are keywords — the
+   dispatch contract, _core/executor.py:27),
+3. the kernel function's inspect.signature (params without defaults
+   default to tensor inputs; defaulted params to attrs).
+
+Entries already present in ops.yaml are preserved verbatim (they may
+carry hand-written notes). New drafts sourced ONLY from (3) are marked
+`# sig-only` for review.
+
+Usage: python -m paddle_tpu.ops.yaml.bootstrap [--write]
+"""
+from __future__ import annotations
+
+import ast
+import inspect
+import json
+import os
+import sys
+from typing import Dict, List, Optional, Tuple
+
+_PKG = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_YAML = os.path.join(os.path.dirname(__file__), "ops.yaml")
+
+
+def scan_call_sites(pkg_root: str = _PKG) -> Dict[str, List[Tuple]]:
+    """op -> list of (npos, has_star, {kw: unparse(value)})."""
+    calls: Dict[str, List[Tuple]] = {}
+    for root, _, files in os.walk(pkg_root):
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(root, fn)
+            try:
+                tree = ast.parse(open(path).read())
+            except SyntaxError:
+                continue
+            for node in ast.walk(tree):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Name)
+                        and node.func.id == "apply"
+                        and node.args
+                        and isinstance(node.args[0], ast.Constant)
+                        and isinstance(node.args[0].value, str)):
+                    continue
+                op = node.args[0].value
+                npos, star = 0, False
+                for a in node.args[1:]:
+                    if isinstance(a, ast.Starred):
+                        star = True
+                    else:
+                        npos += 1
+                kw = {k.arg: ast.unparse(k.value)
+                      for k in node.keywords if k.arg}
+                calls.setdefault(op, []).append((npos, star, kw))
+    return calls
+
+
+def _seq_elem_type(vals) -> str:
+    kinds = set()
+    for v in vals:
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            return "any"
+        kinds.add("float" if isinstance(v, float) else "int")
+    if kinds == {"int"}:
+        return "int[]"
+    if kinds <= {"int", "float"}:
+        return "float[]"
+    return "any"
+
+
+def _attr_type_from_value(v) -> str:
+    if isinstance(v, bool):
+        return "bool"
+    if isinstance(v, int):
+        return "int"
+    if isinstance(v, float):
+        return "float"
+    if isinstance(v, str):
+        return "str"
+    if isinstance(v, (list, tuple)):
+        return _seq_elem_type(v)
+    return "any"
+
+
+_TRACE_TYPE = {"bool": "bool", "int": "int", "float": "float", "str": "str",
+               "seq[int]": "int[]", "seq[float]": "float[]",
+               "seq[float|int]": "float[]"}
+
+
+def _yaml_default(v) -> Optional[str]:
+    if v is inspect.Parameter.empty:
+        return None
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, (int, float)):
+        return repr(v)
+    if isinstance(v, str):
+        return repr(v)
+    if v is None:
+        return "None"
+    if isinstance(v, tuple):
+        return repr(v)
+    return None  # unrepresentable -> required
+
+
+def draft_entry(name: str, op, sites, trace) -> Tuple[str, bool]:
+    """Returns (yaml text, sig_only). Evidence precedence:
+    trace > AST sites > signature."""
+    try:
+        params = inspect.signature(op.fn).parameters
+    except (TypeError, ValueError):
+        params = {}
+    plist = [(n, p) for n, p in params.items() if not n.startswith("_")
+             and p.kind != inspect.Parameter.VAR_KEYWORD]
+    has_varargs = any(p.kind == inspect.Parameter.VAR_POSITIONAL
+                      for _, p in plist)
+    plist = [(n, p) for n, p in plist
+             if p.kind != inspect.Parameter.VAR_POSITIONAL]
+
+    attr_names: List[str] = []
+    npos_seen: Optional[int] = None
+    optional_pos: set = set()
+    kwtypes: Dict[str, str] = {}
+    sig_only = True
+    if trace:
+        sig_only = False
+        shapes = trace["shapes"]   # [[npos, [kw, ...]], ...]
+        npos_seen = max(s[0] for s in shapes)
+        for s in shapes:
+            for k in s[1]:
+                if k not in attr_names:
+                    attr_names.append(k)
+        optional_pos = set(trace.get("optional_pos", []))
+        for k, kinds in trace.get("kwtypes", {}).items():
+            kinds = [k2 for k2 in kinds if k2 != "None"]
+            if len(kinds) == 1 and kinds[0] in _TRACE_TYPE:
+                kwtypes[k] = _TRACE_TYPE[kinds[0]]
+            else:
+                kwtypes[k] = "any"
+    elif sites:
+        sig_only = False
+        npos_seen = max(s[0] for s in sites)
+        for _, _, kw in sites:
+            for k in kw:
+                if k not in attr_names:
+                    attr_names.append(k)
+
+    # classify each kernel param
+    tensor_args: List[Tuple[str, str]] = []
+    attrs: List[Tuple[str, str, Optional[str]]] = []
+    for i, (n, p) in enumerate(plist):
+        is_attr = n in attr_names or (
+            npos_seen is not None and i >= npos_seen and not has_varargs)
+        if npos_seen is None:
+            # signature-only: defaulted params are attrs
+            is_attr = p.default is not inspect.Parameter.empty
+        if is_attr:
+            ty = kwtypes.get(n)
+            if ty is None and p.default is not inspect.Parameter.empty \
+                    and p.default is not None:
+                ty = _attr_type_from_value(p.default)
+            attrs.append((n, ty or "any", _yaml_default(p.default)))
+        else:
+            kind = "?" if (i in optional_pos
+                           or p.default is None) else ""
+            tensor_args.append((n, kind))
+    if has_varargs:
+        # variadic tensor tail (e.g. multiplex_'s *inputs)
+        va = [n for n, p in params.items()
+              if p.kind == inspect.Parameter.VAR_POSITIONAL]
+        tensor_args.append((va[0], "[]"))
+
+    parts = [f"{n}: Tensor{k}" for n, k in tensor_args]
+    for n, ty, d in attrs:
+        parts.append(f"{n}: {ty}" + (f" = {d}" if d is not None else ""))
+    out = "Tensor, Tensor" if op.multi_output else "Tensor"
+    if trace and trace.get("n_outputs"):
+        out = ", ".join(["Tensor"] * trace["n_outputs"])
+    lines = [f"- op: {name}"]
+    if sig_only:
+        lines[0] += "   # sig-only"
+    lines.append(f"  args: ({', '.join(parts)})")
+    lines.append(f"  output: {out}")
+    if op.spmd_rule is not None or _has_named_rule(name):
+        lines.append(f"  spmd_rule: {name}")
+    lines.append(
+        f"  backward: {'custom' if op.bwd is not None else 'auto'}")
+    return "\n".join(lines), sig_only
+
+
+def _has_named_rule(name: str) -> bool:
+    from ...distributed.auto_parallel.spmd_rules import _RULES
+    return name in _RULES
+
+
+def main(write: bool = False):
+    os.environ["PADDLE_TPU_BOOTSTRAP"] = "1"  # registry precedes schema here
+    import paddle_tpu  # noqa: F401  (fills the registry)
+    from ..._core.op_registry import _OPS
+    from .gen import load_schema
+
+    existing_names = set(load_schema())
+    sites = scan_call_sites()
+    trace_path = os.environ.get("TRACE_OPS_JSON", "/tmp/op_trace.json")
+    trace = {}
+    if os.path.exists(trace_path):
+        trace = json.load(open(trace_path))
+
+    # group new entries by defining module for readability
+    groups: Dict[str, List[str]] = {}
+    n_sig_only = 0
+    for name in sorted(_OPS):
+        if name in existing_names:
+            continue
+        op = _OPS[name]
+        text, sig_only = draft_entry(name, op, sites.get(name),
+                                     trace.get(name))
+        n_sig_only += bool(sig_only)
+        mod = getattr(op.fn, "__module__", None) or "unknown"
+        groups.setdefault(mod, []).append(text)
+
+    chunks = []
+    for mod in sorted(groups):
+        chunks.append(f"# ---- {mod}")
+        chunks.extend(groups[mod])
+    body = "\n\n".join(chunks) + "\n"
+    n_new = sum(len(v) for v in groups.values())
+    print(f"{n_new} drafted ({n_sig_only} sig-only), "
+          f"{len(existing_names)} preserved", file=sys.stderr)
+    if write:
+        with open(_YAML, "a") as f:
+            f.write("\n" + body)
+        print(f"appended to {_YAML}", file=sys.stderr)
+    else:
+        print(body)
+
+
+if __name__ == "__main__":
+    main(write="--write" in sys.argv)

@@ -33,22 +33,44 @@ from typing import Dict, List, Optional
 
 _YAML = os.path.join(os.path.dirname(__file__), "ops.yaml")
 
-_TYPES = {"Tensor", "bool", "int", "float", "str", "int[]", "float[]"}
+# Tensor   — required tensor input
+# Tensor?  — optional tensor input (wrapper default None)
+# Tensor[] — variadic tensor inputs (wrapper *args; must be last tensor)
+# any      — opaque attr (nested tuples, dtype objects, …): passed through
+_TYPES = {"Tensor", "Tensor?", "Tensor[]",
+          "bool", "int", "float", "str", "int[]", "float[]", "any"}
 
 
 class OpEntry:
     def __init__(self, name: str):
         self.name = name
-        self.tensor_args: List[str] = []
+        self.tensor_args: List[tuple] = []  # (name, kind: ''|'?'|'[]')
         self.attrs: List[tuple] = []   # (name, type, default-or-None)
         self.n_outputs = 1
         self.spmd_rule: Optional[str] = None
         self.backward = "auto"
+        self.lazy = False  # registered on first call, not at import
 
     def __repr__(self):
         return (f"OpEntry({self.name}, tensors={self.tensor_args}, "
                 f"attrs={[a[0] for a in self.attrs]}, "
                 f"out={self.n_outputs})")
+
+
+def _split_args(inner: str):
+    """Split on top-level commas only (depth-aware, so nested tuple
+    defaults like `spec: any = ((1, 2), (3, 4))` stay whole)."""
+    pieces, depth, start = [], 0, 0
+    for i, ch in enumerate(inner):
+        if ch in "([":
+            depth += 1
+        elif ch in ")]":
+            depth -= 1
+        elif ch == "," and depth == 0:
+            pieces.append(inner[start:i])
+            start = i + 1
+    pieces.append(inner[start:])
+    return pieces
 
 
 def _parse_args(text: str, entry: OpEntry):
@@ -58,9 +80,9 @@ def _parse_args(text: str, entry: OpEntry):
         inner = inner[1:-1]
     if not inner.strip():
         return
-    for piece in re.split(r",(?![^\[]*\])", inner):
+    for piece in _split_args(inner):
         piece = piece.strip()
-        m = re.match(r"(\w+)\s*:\s*([\w\[\]]+)(?:\s*=\s*(.+))?$", piece)
+        m = re.match(r"(\w+)\s*:\s*([\w\[\]\?]+)(?:\s*=\s*(.+))?$", piece)
         if not m:
             raise ValueError(
                 f"ops.yaml: bad arg spec '{piece}' in op {entry.name}")
@@ -68,11 +90,19 @@ def _parse_args(text: str, entry: OpEntry):
         if ty not in _TYPES:
             raise ValueError(
                 f"ops.yaml: unknown type '{ty}' in op {entry.name}")
-        if ty == "Tensor":
+        if ty.startswith("Tensor"):
             if default is not None:
                 raise ValueError(
                     f"ops.yaml: Tensor arg '{arg}' cannot default")
-            entry.tensor_args.append(arg)
+            if entry.attrs:
+                raise ValueError(
+                    f"ops.yaml: tensor arg '{arg}' after attrs in op "
+                    f"{entry.name}")
+            kind = ty[len("Tensor"):]
+            if kind == "[]" and any(k == "[]" for _, k in entry.tensor_args):
+                raise ValueError(
+                    f"ops.yaml: two variadic tensor args in op {entry.name}")
+            entry.tensor_args.append((arg, kind))
         else:
             entry.attrs.append((arg, ty, default))
 
@@ -88,7 +118,7 @@ def load_schema(path: str = _YAML) -> Dict[str, OpEntry]:
             line = raw.rstrip("\n")
             if not line.strip() or line.strip().startswith("#"):
                 continue
-            m = re.match(r"-\s*op\s*:\s*(\w+)\s*$", line.strip()) \
+            m = re.match(r"-\s*op\s*:\s*(\w+)\s*(?:#.*)?$", line.strip()) \
                 if line.lstrip().startswith("-") else None
             if m:
                 cur = OpEntry(m.group(1))
@@ -107,6 +137,8 @@ def load_schema(path: str = _YAML) -> Dict[str, OpEntry]:
                 cur.spmd_rule = val
             elif key == "backward":
                 cur.backward = val
+            elif key == "lazy":
+                cur.lazy = val.lower() == "true"
             else:
                 raise ValueError(f"ops.yaml:{ln}: unknown key '{key}'")
     return entries
@@ -126,7 +158,8 @@ def validate(entries: Optional[Dict[str, OpEntry]] = None) -> List[str]:
     for e in entries.values():
         op = _OPS.get(e.name)
         if op is None:
-            problems.append(f"{e.name}: not in the runtime registry")
+            if not e.lazy:
+                problems.append(f"{e.name}: not in the runtime registry")
             continue
         if bool(op.multi_output) != (e.n_outputs > 1):
             problems.append(
@@ -143,25 +176,72 @@ def validate(entries: Optional[Dict[str, OpEntry]] = None) -> List[str]:
                 problems.append(
                     f"{e.name}: spmd_rule '{e.spmd_rule}' cannot bind — "
                     f"runtime resolves rules by op name")
+        # backward mode must agree with the registry: 'custom' iff the
+        # op registered its own VJP, 'auto' iff the dispatcher derives it
+        if e.backward == "custom" and op.bwd is None:
+            problems.append(f"{e.name}: backward 'custom' but no "
+                            f"registered bwd")
+        if e.backward == "auto" and op.bwd is not None:
+            problems.append(f"{e.name}: backward 'auto' but op has a "
+                            f"custom bwd (declare 'custom')")
         # attr names must exist in the kernel signature, or the wrapper
         # TypeErrors at first call instead of at generation time
         try:
-            kernel_params = [p for p in
-                             inspect.signature(op.fn).parameters
-                             if not p.startswith("_")]
+            params = inspect.signature(op.fn).parameters
+            kernel_params = [p for p in params if not p.startswith("_")]
+            has_varargs = any(
+                p.kind == inspect.Parameter.VAR_POSITIONAL
+                for p in params.values())
         except (TypeError, ValueError):
             kernel_params = None
+            has_varargs = False
         if kernel_params is not None:
-            if len(e.tensor_args) > len(kernel_params):
+            n_fixed = len([1 for _, k in e.tensor_args if k != "[]"])
+            if n_fixed > len(kernel_params) and not has_varargs:
                 problems.append(
-                    f"{e.name}: {len(e.tensor_args)} tensor args but "
+                    f"{e.name}: {n_fixed} tensor args but "
                     f"kernel takes {len(kernel_params)} params")
+            if any(k == "[]" for _, k in e.tensor_args) and not has_varargs:
+                problems.append(
+                    f"{e.name}: variadic Tensor[] arg but kernel has "
+                    f"no *args")
             for a, _, _ in e.attrs:
                 if a not in kernel_params:
                     problems.append(
                         f"{e.name}: attr '{a}' is not a kernel "
                         f"parameter ({kernel_params})")
     return problems
+
+
+def check_complete(entries: Optional[Dict[str, OpEntry]] = None) -> None:
+    """Import-time system-of-record enforcement: EVERY runtime-registered
+    op must have a YAML entry and vice versa (the reference fails codegen
+    when ops.yaml and the kernel registry disagree). Raises on mismatch —
+    adding an op without a schema entry is an error by construction."""
+    from ..._core.op_registry import _OPS
+
+    entries = entries if entries is not None else load_schema()
+    registered_custom = {n for n, op in _OPS.items()
+                         if getattr(op, "custom", False)}
+    missing = sorted(set(_OPS) - set(entries) - registered_custom)
+    stale = sorted(n for n in set(entries) - set(_OPS)
+                   if not entries[n].lazy)
+    if missing or stale:
+        msg = []
+        if missing:
+            msg.append(f"{len(missing)} registered op(s) missing from "
+                       f"ops.yaml: {', '.join(missing[:10])}"
+                       + ("…" if len(missing) > 10 else ""))
+        if stale:
+            msg.append(f"{len(stale)} ops.yaml entr(ies) not in the "
+                       f"registry: {', '.join(stale[:10])}"
+                       + ("…" if len(stale) > 10 else ""))
+        raise RuntimeError(
+            "ops.yaml is the system of record and disagrees with the "
+            "runtime registry — " + "; ".join(msg)
+            + ". Add/remove the schema entry (paddle_tpu/ops/yaml/"
+            "ops.yaml); `python -m paddle_tpu.ops.yaml.bootstrap` drafts "
+            "entries from the live registry.")
 
 
 def generate_wrappers(entries: Optional[Dict[str, OpEntry]] = None) -> str:
@@ -171,6 +251,10 @@ def generate_wrappers(entries: Optional[Dict[str, OpEntry]] = None) -> str:
     lines = ['"""AUTO-GENERATED by paddle_tpu.ops.yaml.gen — do not',
              'edit. Regenerate with python -m paddle_tpu.ops.yaml.gen."""',
              "from .._core.executor import apply",
+             "",
+             "# sentinel for required tensor args that syntactically",
+             "# follow an optional (Tensor?) arg",
+             "_REQUIRED = object()",
              "", ""]
 
     def pydefault(ty, d):
@@ -188,21 +272,47 @@ def generate_wrappers(entries: Optional[Dict[str, OpEntry]] = None) -> str:
         for a, ty, d in e.attrs:
             pd = pydefault(ty, d)
             attr_params.append(a if pd is None else f"{a}={pd}")
+        params, call_args, req_checks = [], [], []
+        seen_opt = False
+        for t, kind in e.tensor_args:
+            if kind == "?":
+                params.append(f"{t}=None")
+                call_args.append(t)
+                seen_opt = True
+            elif kind == "[]":
+                params.append(f"*{t}")
+                call_args.append(f"*{t}")
+            elif seen_opt:
+                # required tensor after an optional one: sentinel default
+                # keeps the def legal, the check keeps it required
+                params.append(f"{t}=_REQUIRED")
+                call_args.append(t)
+                req_checks.append(t)
+            else:
+                params.append(t)
+                call_args.append(t)
+        variadic = any(k == "[]" for _, k in e.tensor_args)
         # attrs are keyword-only: required attrs may follow defaulted
         # ones in declared order without breaking Python's ordering rule
-        params = list(e.tensor_args)
         if attr_params:
-            params += ["*"] + attr_params + ["name=None"]
+            params += ([] if variadic else ["*"]) + attr_params \
+                + ["name=None"]
+        elif variadic:
+            params += ["name=None"]
         else:
             params += ["name=None"]
         kwargs = ", ".join(f"{a}={a}" for a, _, _ in e.attrs)
-        call_args = ", ".join(e.tensor_args)
+        call = ", ".join(call_args)
         sep = ", " if kwargs else ""
-        lines += [
-            f"def {e.name}({', '.join(params)}):",
-            f'    """Generated from ops.yaml (op: {e.name})."""',
-            f"    return apply('{e.name}', {call_args}{sep}{kwargs})",
-            "", ""]
+        lines.append(f"def {e.name}({', '.join(params)}):")
+        lines.append(f'    """Generated from ops.yaml (op: {e.name})."""')
+        for t in req_checks:
+            lines.append(f"    if {t} is _REQUIRED:")
+            lines.append(f"        raise TypeError("
+                         f"\"{e.name}() missing required argument: "
+                         f"'{t}'\")")
+        lines += [f"    return apply('{e.name}', {call}{sep}{kwargs})",
+                  "", ""]
     return "\n".join(lines)
 
 

@@ -157,7 +157,7 @@ def load_op_library(path: str, op_name: str,
             *xs)
 
     from .._core.op_registry import register_op
-    register_op(op_name, op_fn)
+    register_op(op_name, op_fn, custom=True)
 
     from .._core.executor import apply
 

@@ -88,7 +88,7 @@ class TestBackendKeyedKernels:
         from paddle_tpu._core.op_registry import (
             get_op, register_kernel, register_op)
 
-        register_op("bk_probe", lambda x: x + 1.0)
+        register_op("bk_probe", lambda x: x + 1.0, custom=True)
         backend = jax.default_backend()
         register_kernel("bk_probe", backend, lambda x: x + 100.0)
         register_kernel("bk_probe", "no_such_backend",
@@ -104,7 +104,7 @@ class TestBackendKeyedKernels:
         from paddle_tpu._core.op_registry import (
             register_kernel, register_op)
 
-        register_op("bk_grad_probe", lambda x: x * 2.0)
+        register_op("bk_grad_probe", lambda x: x * 2.0, custom=True)
         register_kernel("bk_grad_probe", jax.default_backend(),
                         lambda x: x * 3.0)
         x = paddle.to_tensor(np.ones((2,), np.float32),
