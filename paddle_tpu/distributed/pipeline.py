@@ -175,7 +175,67 @@ class PipelineParallel(Layer):
         return total
 
 
-class DistPipelineRuntime:
+class _HostPipeBase:
+    """Shared plumbing for the host-driven multi-process pipeline
+    runtimes (1F1B/FThenB, VPP, ZeroBubble): ProcessGroup wiring, stash
+    + memory accounting, zero-grad P2P fallback, and micro-batch count
+    validation — one implementation so the schedules can't drift."""
+
+    def __init__(self, group, loss_fn, num_microbatches: int):
+        self.group = group
+        self.pg = group.pg
+        self.rank = self.pg.rank
+        self.num_stages = self.pg.size
+        self.P = self.pg.size
+        self.loss_fn = loss_fn
+        self.m = int(num_microbatches)
+        self._stash = {}
+        self.max_inflight = 0
+        self.max_stash_bytes = 0
+
+    def _track(self, extra=()):
+        n = len(self._stash) + sum(len(d) for d in extra)
+        self.max_inflight = max(self.max_inflight, n)
+        live = 0
+        for d in (self._stash,) + tuple(extra):
+            for vals in d.values():
+                for t in vals:
+                    if t is None:
+                        continue
+                    if hasattr(t, "_value"):
+                        live += t.size * t._value.dtype.itemsize
+                    elif hasattr(t, "nbytes"):
+                        live += t.nbytes
+        self.max_stash_bytes = max(self.max_stash_bytes, live)
+
+    def _grad_payload(self, x_in):
+        """Input grad to send upstream; zeros keep the P2P protocol
+        symmetric when the input turned out disconnected."""
+        import numpy as np
+        if x_in.grad is not None:
+            return x_in.grad.numpy()
+        return np.zeros(x_in.shape,
+                        np.asarray(x_in._value).dtype)
+
+    def _check_micros(self, micro_inputs, micro_labels, need_inputs,
+                      need_labels):
+        """Fail fast on a bad micro count — mid-schedule IndexErrors
+        would leave peer ranks blocked in recv until the dist timeout."""
+        if need_inputs and (micro_inputs is None
+                            or len(micro_inputs) != self.m):
+            raise ValueError(
+                f"rank {self.rank} needs exactly num_microbatches="
+                f"{self.m} micro_inputs, got "
+                f"{None if micro_inputs is None else len(micro_inputs)}")
+        if need_labels and (micro_labels is None
+                            or len(micro_labels) != self.m):
+            raise ValueError(
+                f"rank {self.rank} needs exactly num_microbatches="
+                f"{self.m} micro_labels, got "
+                f"{None if micro_labels is None else len(micro_labels)}")
+
+
+class DistPipelineRuntime(_HostPipeBase):
     """Host-driven multi-process pipeline schedules over the store-backed
     ProcessGroup transport — the reference's PipelineParallel runtime
     architecture (pipeline_parallel.py:684 forward_backward_pipeline /
@@ -190,32 +250,13 @@ class DistPipelineRuntime:
 
     def __init__(self, stage_layer: Layer, group, loss_fn,
                  num_microbatches: int, schedule: str = "1F1B"):
+        super().__init__(group, loss_fn, num_microbatches)
         self.stage = stage_layer
-        self.group = group
-        self.pg = group.pg
-        self.rank = self.pg.rank
-        self.num_stages = self.pg.size
-        self.loss_fn = loss_fn
-        self.m = int(num_microbatches)
         if schedule not in ("1F1B", "FThenB"):
             raise ValueError(f"unknown schedule {schedule}")
         self.schedule = schedule
         self.is_first = self.rank == 0
         self.is_last = self.rank == self.num_stages - 1
-        # stash + memory accounting
-        self._stash = {}
-        self.max_inflight = 0
-        self.max_stash_bytes = 0
-
-    # ------------------------------------------------------------ plumbing
-    def _track(self):
-        self.max_inflight = max(self.max_inflight, len(self._stash))
-        live = 0
-        for x_in, out in self._stash.values():
-            for t in (x_in, out):
-                if t is not None:
-                    live += t.size * t._value.dtype.itemsize
-        self.max_stash_bytes = max(self.max_stash_bytes, live)
 
     def _forward_micro(self, i, micro_in, label):
         import numpy as np
@@ -244,14 +285,7 @@ class DistPipelineRuntime:
             from .._core.autograd import run_backward
             run_backward([out], [Tensor(dout)])
         if not self.is_first:
-            # keep the P2P protocol symmetric: the upstream rank recvs
-            # unconditionally, so a disconnected input sends zeros
-            if x_in.grad is not None:
-                self.pg.send(x_in.grad.numpy(), self.rank - 1)
-            else:
-                import numpy as np
-                self.pg.send(np.zeros(x_in.shape, "float32"),
-                             self.rank - 1)
+            self.pg.send(self._grad_payload(x_in), self.rank - 1)
 
     # ------------------------------------------------------------ schedule
     def train_batch(self, micro_inputs=None, micro_labels=None):
@@ -259,17 +293,8 @@ class DistPipelineRuntime:
         Tensors); the last rank supplies micro_labels. Returns the batch
         loss on the last rank (None elsewhere)."""
         m = self.m
-        if self.is_first and (micro_inputs is None
-                              or len(micro_inputs) != m):
-            raise ValueError(
-                f"rank 0 needs exactly num_microbatches={m} micro_inputs, "
-                f"got {None if micro_inputs is None else len(micro_inputs)}")
-        if self.is_last and (micro_labels is None
-                             or len(micro_labels) != m):
-            raise ValueError(
-                f"last rank needs exactly num_microbatches={m} "
-                f"micro_labels, got "
-                f"{None if micro_labels is None else len(micro_labels)}")
+        self._check_micros(micro_inputs, micro_labels,
+                           self.is_first, self.is_last)
         losses = []
 
         def fwd(i):
@@ -299,6 +324,294 @@ class DistPipelineRuntime:
 
 
 class PipelineParallelWithInterleave(PipelineParallel):
-    """VPP variant (pipeline_parallel.py:1308) — same numerics host-side;
-    virtual-stage interleaving is a compiled-path schedule choice."""
-    pass
+    """VPP single-controller wrapper (pipeline_parallel.py:1308).
+
+    Enforces the interleave contract (accumulate_steps must be a
+    multiple of num_stages and ≥ 2·num_stages,
+    pipeline_parallel.py:1367) and segments the model into
+    num_stages × num_virtual_pipeline_stages virtual chunks. On a
+    single controller the chunks run in dependency order (numerics are
+    schedule-independent); the real interleaved schedule across
+    processes is DistPipelineRuntimeVPP below.
+    """
+
+    def __init__(self, layers: PipelineLayer, hcg=None, strategy=None,
+                 num_virtual_pipeline_stages: int = 2):
+        super().__init__(layers, hcg=hcg, strategy=strategy)
+        self.num_model_chunks = int(num_virtual_pipeline_stages)
+        stages = layers._num_stages
+        if self.accumulate_steps % stages != 0 \
+                or self.accumulate_steps < 2 * stages:
+            raise ValueError(
+                f"interleaved pipeline needs accumulate_steps "
+                f"({self.accumulate_steps}) to be a multiple of "
+                f"num_stages ({stages}) and >= 2*num_stages")
+        # virtual stage bounds: num_stages * chunks uniform segments
+        n = len(layers.run_functions)
+        v = stages * self.num_model_chunks
+        per = max(n // v, 1)
+        self._virtual_bounds = [
+            (i * per, (i + 1) * per if i < v - 1 else n)
+            for i in range(v)]
+
+    def virtual_stage_layers(self, stage_id: int, chunk_id: int):
+        """Layers of virtual stage chunk_id*num_stages + stage_id."""
+        v = chunk_id * self._layers._num_stages + stage_id
+        lo, hi = self._virtual_bounds[v]
+        return self._layers.run_functions[lo:hi]
+
+
+def _interleave_schedule(rank: int, pp_size: int, num_chunks: int,
+                         num_micro: int):
+    """Per-rank action list for interleaved 1F1B (VPP).
+
+    The unit mapping is the reference's virtual-pp-rank computation
+    (pipeline_parallel.py:1308 _get_virtual_pp_rank): forward unit k
+    maps to chunk (k % (P*C)) // P and micro (k // (P*C)) * P + k % P;
+    backward chunks run in reverse. Warmup = (P-r-1)*2 + (C-1)*P units.
+    Returns [("F"|"B", chunk, micro), ...].
+    """
+    P, C, m = pp_size, num_chunks, num_micro
+    # the reference's interleave contract (pipeline_parallel.py:1367)
+    if m % P != 0 or m < 2 * P:
+        raise ValueError(
+            f"interleave needs num_microbatches ({m}) to be a multiple "
+            f"of pp group size ({P}) and >= 2*pp")
+    total = m * C
+
+    def funit(k):
+        g = k % (P * C)
+        return g // P, (k // (P * C)) * P + k % P
+
+    def bunit(k):
+        g = k % (P * C)
+        return C - 1 - g // P, (k // (P * C)) * P + k % P
+
+    warmup = min(total, (P - rank - 1) * 2 + (C - 1) * P)
+    acts = [("F",) + funit(k) for k in range(warmup)]
+    for j in range(total - warmup):
+        acts.append(("F",) + funit(warmup + j))
+        acts.append(("B",) + bunit(j))
+    for j in range(total - warmup, total):
+        acts.append(("B",) + bunit(j))
+    return acts
+
+
+class DistPipelineRuntimeVPP(_HostPipeBase):
+    """Host-driven interleaved-1F1B (VPP) runtime over real processes.
+
+    Each rank owns ``num_chunks`` model chunks; virtual stage
+    v = chunk*P + rank. Activations flow rank r → (r+1)%P (the %P
+    wraparound carries chunk transitions last-rank → rank 0), gradients
+    the reverse — the reference's four-directions P2P
+    (four_directions_p2p_communication.py). Per directed pair the
+    send/recv sequences are FIFO-consistent projections of the global
+    interleave schedule, so blocking P2P cannot deadlock.
+    """
+
+    def __init__(self, chunk_layers: List[Layer], group, loss_fn,
+                 num_microbatches: int):
+        super().__init__(group, loss_fn, num_microbatches)
+        self.chunks = list(chunk_layers)
+        self.C = len(self.chunks)
+        self.V = self.P * self.C
+
+    def _vstage(self, chunk):
+        return chunk * self.P + self.rank
+
+    def _forward(self, chunk, i, micro_inputs, micro_labels, losses):
+        import numpy as np
+        v = self._vstage(chunk)
+        if v == 0:
+            x_in = micro_inputs[i].detach()
+        else:
+            arr = self.pg.recv((self.rank - 1) % self.P)
+            x_in = Tensor(np.ascontiguousarray(arr), stop_gradient=False)
+        out = self.chunks[chunk](x_in)
+        if v == self.V - 1:
+            loss = self.loss_fn(out, micro_labels[i]) / self.m
+            self._stash[(chunk, i)] = (x_in, loss)
+            self._track()
+            losses.append(float(loss.numpy()))
+        else:
+            self._stash[(chunk, i)] = (x_in, out)
+            self._track()
+            self.pg.send(out.numpy(), (self.rank + 1) % self.P)
+
+    def _backward(self, chunk, i):
+        import numpy as np
+        v = self._vstage(chunk)
+        x_in, out = self._stash.pop((chunk, i))
+        if v == self.V - 1:
+            out.backward()  # out is the scaled loss
+        else:
+            dout = self.pg.recv((self.rank + 1) % self.P)
+            from .._core.autograd import run_backward
+            run_backward([out], [Tensor(dout)])
+        if v > 0:
+            self.pg.send(self._grad_payload(x_in),
+                         (self.rank - 1) % self.P)
+
+    def train_batch(self, micro_inputs=None, micro_labels=None):
+        """Returns the batch loss on the rank owning the last virtual
+        stage (= last rank), None elsewhere."""
+        self._check_micros(micro_inputs, micro_labels,
+                           self.rank == 0, self.rank == self.P - 1)
+        losses: List[float] = []
+        acts = _interleave_schedule(self.rank, self.P, self.C, self.m)
+        for kind, chunk, i in acts:
+            if kind == "F":
+                self._forward(chunk, i, micro_inputs, micro_labels,
+                              losses)
+            else:
+                self._backward(chunk, i)
+        self.pg.barrier()
+        return sum(losses) if losses else None
+
+
+def _zero_bubble_schedule(rank: int, pp_size: int, num_micro: int):
+    """Per-rank ZB-H1 action list (pipeline_zero_bubble.py:62,151).
+
+    Splits each micro-batch backward into B (activation grad — unblocks
+    the upstream rank) and W (weight grad — pure local work). W units
+    are deferred by the rank's warmup depth so they fill the cooldown
+    bubble that 1F1B leaves idle. Returns [("F"|"B"|"W", micro), ...].
+    """
+    P, m = pp_size, num_micro
+    wf = min(P - rank - 1, m)
+    delay = P - rank - 1
+    acts = [("F", i) for i in range(wf)]
+    w_done = 0
+    for j in range(m - wf):
+        acts.append(("F", wf + j))
+        acts.append(("B", j))
+        if j >= delay:
+            acts.append(("W", w_done))
+            w_done += 1
+    for j in range(m - wf, m):
+        acts.append(("B", j))
+        if w_done < m:
+            acts.append(("W", w_done))
+            w_done += 1
+    while w_done < m:
+        acts.append(("W", w_done))
+        w_done += 1
+    return acts
+
+
+class DistPipelineRuntimeZB(_HostPipeBase):
+    """Host-driven ZeroBubble (ZB-H1) pipeline over real processes.
+
+    The reference implements ZeroBubble as a pipeline-scheduler pass
+    splitting matmul_grad into its activation-grad and weight-grad
+    matmuls (passes/pipeline_scheduler_pass/pipeline_zero_bubble.py:62).
+    The TPU-native split is at the stage level via two jitted VJPs over
+    the stage's pure function f(params, x):
+
+      B(i): dx   = vjp(f wrt x    only)(dout)   — sent upstream at once
+      W(i): dpar = vjp(f wrt params only)(dout) — deferred into bubbles
+
+    Requesting a cotangent subset makes XLA compile only that half of
+    the transpose; each call re-runs the stage forward for residuals
+    (rematerialisation — the standard TPU trade of FLOPs for schedule
+    freedom). Gradients accumulate into param.grad at W time, so the
+    optimizer step must follow the full schedule, exactly as in the
+    reference where W ops are reordered before opt.
+    """
+
+    def __init__(self, stage_layer: Layer, group, loss_fn,
+                 num_microbatches: int):
+        import jax
+        import jax.numpy as jnp
+
+        super().__init__(group, loss_fn, num_microbatches)
+        self.stage = stage_layer
+        self.is_first = self.rank == 0
+        self.is_last = self.rank == self.P - 1
+        self._params = list(stage_layer.parameters())
+        # _stash: i -> (x_val, None) until B; _w_stash: i -> (x_val,
+        # dout_or_label) until W
+        self._w_stash = {}
+        self.executed: List[tuple] = []  # action trace for tests
+
+        if self.is_last:
+            self._fwd = jax.jit(
+                lambda pv, xv, yv: self._run_pure(pv, xv, yv))
+            self._bx = jax.jit(lambda pv, xv, yv: jax.vjp(
+                lambda x_: self._run_pure(pv, x_, yv),
+                xv)[1](jnp.float32(1.0))[0])
+            self._bw = jax.jit(lambda pv, xv, yv: jax.vjp(
+                lambda p_: self._run_pure(p_, xv, yv),
+                pv)[1](jnp.float32(1.0))[0])
+        else:
+            self._fwd = jax.jit(lambda pv, xv: self._run_pure(pv, xv))
+            self._bx = jax.jit(lambda pv, xv, g: jax.vjp(
+                lambda x_: self._run_pure(pv, x_), xv)[1](g)[0])
+            self._bw = jax.jit(lambda pv, xv, g: jax.vjp(
+                lambda p_: self._run_pure(p_, xv), pv)[1](g)[0])
+
+    def _run_pure(self, pvals, xv, yv=None):
+        """Stage forward as a pure function of (param values, input):
+        temporarily rebinds parameter storage, runs the eager layer
+        under no_grad (the dispatcher's jits inline under the outer
+        trace), and restores."""
+        from .._core.autograd import no_grad
+        old = [p._value for p in self._params]
+        for p, v in zip(self._params, pvals):
+            p._value = v
+        try:
+            with no_grad():
+                out = self.stage(Tensor(xv))
+                if yv is not None:
+                    out = self.loss_fn(out, Tensor(yv)) / self.m
+            return out._value
+        finally:
+            for p, o in zip(self._params, old):
+                p._value = o
+
+    def train_batch(self, micro_inputs=None, micro_labels=None):
+        import numpy as np
+
+        self._check_micros(micro_inputs, micro_labels,
+                           self.is_first, self.is_last)
+        pv = [p._value for p in self._params]
+        labels = micro_labels
+        losses: List[float] = []
+        for kind, i in _zero_bubble_schedule(self.rank, self.P, self.m):
+            self.executed.append((kind, i))
+            if kind == "F":
+                if self.is_first:
+                    xv = micro_inputs[i]._value
+                else:
+                    xv = np.ascontiguousarray(
+                        self.pg.recv(self.rank - 1))
+                if self.is_last:
+                    out = self._fwd(pv, xv, labels[i]._value)
+                    losses.append(float(out))
+                else:
+                    out = self._fwd(pv, xv)
+                    self.pg.send(np.asarray(out), self.rank + 1)
+                self._stash[i] = (xv, None)
+                self._track((self._w_stash,))
+            elif kind == "B":
+                xv, _ = self._stash.pop(i)
+                if self.is_last:
+                    g = labels[i]._value  # the loss closure's label
+                    dx = self._bx(pv, xv, g)
+                else:
+                    g = np.ascontiguousarray(self.pg.recv(self.rank + 1))
+                    dx = self._bx(pv, xv, g)
+                if not self.is_first:
+                    self.pg.send(np.asarray(dx), self.rank - 1)
+                self._w_stash[i] = (xv, g)
+                self._track((self._w_stash,))
+            else:  # W
+                xv, g = self._w_stash.pop(i)
+                dparams = self._bw(pv, xv, g)
+                for p, dp in zip(self._params, dparams):
+                    if p.grad is None:
+                        p.grad = Tensor(dp)
+                    else:
+                        p.grad = Tensor(p.grad._value + dp)
+        self.pg.barrier()
+        return sum(losses) if self.is_last else None

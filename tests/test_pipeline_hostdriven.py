@@ -161,5 +161,159 @@ def test_schedules_match_reference_and_1f1b_saves_memory():
             < results["FThenB"][0]["max_stash_bytes"])
 
 
+def _single_process_reference_4stage():
+    """Ground truth for the VPP test: 4 relu(Linear) virtual stages."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+
+    paddle.seed(7)
+    stages = [nn.Linear(DIM, DIM) for _ in range(4)]
+    x, y = _make_inputs()
+    total = None
+    for i in range(M):
+        xi = paddle.to_tensor(x[i * MB:(i + 1) * MB])
+        yi = paddle.to_tensor(y[i * MB:(i + 1) * MB])
+        h = xi
+        for s in stages:
+            h = F.relu(s(h))
+        loss = F.mse_loss(h, yi) / M
+        loss.backward()
+        total = float(loss.numpy()) + (total or 0.0)
+    grads = [p.grad.numpy() for s in stages for p in s.parameters()]
+    return total, grads
+
+
+def _worker_vpp():
+    """2 ranks x 2 chunks = 4 virtual stages, interleaved 1F1B."""
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed.pipeline import DistPipelineRuntimeVPP
+
+    dist.init_parallel_env()
+    paddle.seed(7)
+    lins = [nn.Linear(DIM, DIM) for _ in range(4)]
+
+    class Stage(nn.Layer):
+        def __init__(self, lin):
+            super().__init__()
+            self.lin = lin
+
+        def forward(self, x):
+            return F.relu(self.lin(x))
+
+    # vstage v = chunk*P + rank: rank0 owns lins[0],lins[2]
+    chunks = [Stage(lins[rank]), Stage(lins[rank + WORLD])]
+    group = dist.new_group(list(range(WORLD)))
+    runtime = DistPipelineRuntimeVPP(
+        chunks, group, loss_fn=F.mse_loss, num_microbatches=M)
+
+    x, y = _make_inputs()
+    micro_x = [paddle.to_tensor(x[i * MB:(i + 1) * MB]) for i in range(M)]
+    micro_y = [paddle.to_tensor(y[i * MB:(i + 1) * MB]) for i in range(M)]
+    loss = runtime.train_batch(micro_inputs=micro_x, micro_labels=micro_y)
+
+    report = {
+        "rank": rank,
+        "loss": loss,
+        "max_inflight": runtime.max_inflight,
+        "grads": [[p.grad.numpy().tolist() for p in c.parameters()]
+                  for c in chunks],
+    }
+    print("PIPE-REPORT:" + json.dumps(report), flush=True)
+
+
+def _worker_zb():
+    """ZeroBubble over the same 2-stage model as the 1F1B test."""
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed.pipeline import DistPipelineRuntimeZB
+
+    dist.init_parallel_env()
+    paddle.seed(7)
+    s0 = nn.Linear(DIM, DIM)
+    s1 = nn.Linear(DIM, DIM)
+
+    class Stage(nn.Layer):
+        def __init__(self, lin):
+            super().__init__()
+            self.lin = lin
+
+        def forward(self, x):
+            return F.relu(self.lin(x))
+
+    stage = Stage(s0 if rank == 0 else s1)
+    group = dist.new_group(list(range(WORLD)))
+    runtime = DistPipelineRuntimeZB(
+        stage, group, loss_fn=F.mse_loss, num_microbatches=M)
+
+    x, y = _make_inputs()
+    micro_x = [paddle.to_tensor(x[i * MB:(i + 1) * MB]) for i in range(M)]
+    micro_y = [paddle.to_tensor(y[i * MB:(i + 1) * MB]) for i in range(M)]
+    loss = runtime.train_batch(micro_inputs=micro_x, micro_labels=micro_y)
+
+    report = {
+        "rank": rank,
+        "loss": loss,
+        "executed": runtime.executed,
+        "grads": [p.grad.numpy().tolist() for p in stage.parameters()],
+    }
+    print("PIPE-REPORT:" + json.dumps(report), flush=True)
+
+
+def test_vpp_interleave_matches_reference():
+    ref_loss, ref_grads = _single_process_reference_4stage()
+    reports = _launch("VPP")
+    assert abs(reports[1]["loss"] - ref_loss) < 1e-5
+    # grads per virtual stage: vstage v = c*P + r owns lins[v]
+    per = len(ref_grads) // 4
+    for rank in range(WORLD):
+        for c in range(2):
+            v = c * WORLD + rank
+            got = [np.asarray(g, "float32")
+                   for g in reports[rank]["grads"][c]]
+            for g, r in zip(got, ref_grads[v * per:(v + 1) * per]):
+                np.testing.assert_allclose(
+                    g, r, rtol=1e-5, atol=1e-6,
+                    err_msg=f"VPP rank{rank} chunk{c}")
+
+
+def test_zero_bubble_matches_reference_and_defers_weight_grads():
+    ref_loss, ref_grads = _single_process_reference()
+    n_s0 = len(ref_grads) // 2
+    reports = _launch("ZB")
+    assert abs(reports[1]["loss"] - ref_loss) < 1e-5
+    for rank, lo, hi in [(0, 0, n_s0), (1, n_s0, len(ref_grads))]:
+        got = [np.asarray(g, "float32") for g in reports[rank]["grads"]]
+        for g, r in zip(got, ref_grads[lo:hi]):
+            np.testing.assert_allclose(g, r, rtol=1e-5, atol=1e-6,
+                                       err_msg=f"ZB r{rank}")
+    # the zero-bubble property on rank 0: W(0) is deferred past B(1) —
+    # dx for later micros is produced (and sent upstream) before the
+    # first weight grad is computed
+    ex = [tuple(a) for a in reports[0]["executed"]]
+    assert ex.index(("W", 0)) > ex.index(("B", 1)), ex
+    # every W runs, and the schedule ends with all weight grads done
+    assert sorted(i for k, i in ex if k == "W") == list(range(M))
+
+
 if __name__ == "__main__" and os.environ.get("PT_PP_WORKER") == "1":
-    _worker()
+    sched = os.environ["PT_PP_SCHEDULE"]
+    if sched == "VPP":
+        _worker_vpp()
+    elif sched == "ZB":
+        _worker_zb()
+    else:
+        _worker()
