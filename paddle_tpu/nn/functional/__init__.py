@@ -8,4 +8,5 @@ from .input import *  # noqa: F401,F403
 from .attention import *  # noqa: F401,F403
 from .extended import *  # noqa: F401,F403
 from .flash_attention import flash_attention, flashmask_attention, \
+    flash_attn_qkvpacked, flash_attn_unpadded, \
     scaled_dot_product_attention  # noqa: F401

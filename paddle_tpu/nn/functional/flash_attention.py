@@ -27,6 +27,26 @@ def _pallas_available():
     return _USE_PALLAS
 
 
+# Per-kernel sticky disable: a deterministic kernel failure (lowering
+# error, unsupported shape) would otherwise silently pay the full
+# build-then-raise cost and degrade to the O(T^2) dense path on EVERY
+# call with no indication the fast path is gone.
+_KERNEL_STATE = {}
+
+
+def _kernel_failed(name: str, exc: Exception) -> None:
+    import warnings
+    warnings.warn(
+        f"pallas {name} kernel failed ({type(exc).__name__}: {exc}); "
+        f"falling back to the dense O(T^2) reference path for the rest "
+        f"of this process", RuntimeWarning, stacklevel=3)
+    _KERNEL_STATE[name] = False
+
+
+def _kernel_enabled(name: str) -> bool:
+    return _KERNEL_STATE.get(name, True)
+
+
 def flash_attention(query, key, value, dropout=0.0, causal=False,
                     return_softmax=False, fixed_seed_offset=None,
                     rng_name="", training=True, name=None):
@@ -51,9 +71,32 @@ def flashmask_attention(query, key, value, startend_row_indices=None,
                         return_softmax_lse=False, return_seed_offset=False,
                         fixed_seed_offset=None, rng_name="", training=True,
                         name=None):
-    """Sparse-mask flash attention. Round-1 support: causal + window;
-    startend_row_indices converted to a dense additive mask (small-seq
-    fallback; the Pallas kernel handles block-sparse natively later)."""
+    """Sparse-mask flash attention (reference flashmask_attention:1299).
+
+    Default path is the block-sparse Pallas kernel
+    (ops/pallas/flash_varlen.py): key blocks whose columns ban the whole
+    query block are skipped, no [S, S] mask is ever built. The dense
+    additive-mask conversion below stays as the numerics reference
+    (and the fallback for dropout / window_size)."""
+    if (startend_row_indices is not None and _pallas_available()
+            and dropout == 0.0 and window_size is None
+            and _kernel_enabled("flashmask")):
+        try:
+            from ...ops.pallas.flash_varlen import \
+                flashmask_attention_pallas
+            return flashmask_attention_pallas(
+                query, key, value, startend_row_indices, causal=causal)
+        except Exception as e:
+            _kernel_failed("flashmask", e)
+    return flashmask_attention_dense(
+        query, key, value, startend_row_indices, dropout, causal,
+        training)
+
+
+def flashmask_attention_dense(query, key, value, startend_row_indices=None,
+                              dropout=0.0, causal=True, training=True,
+                              *unused, **unused_kw):
+    """Dense-mask reference path (O(S^2) memory — test oracle only)."""
     mask = None
     if startend_row_indices is not None:
         mask = _flashmask_to_dense(query, startend_row_indices, causal)
@@ -101,18 +144,39 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
                         causal=False, return_softmax=False,
                         fixed_seed_offset=None, rng_name="", training=True,
                         name=None):
-    """Varlen attention: ragged batches packed as [total_tokens, H, D] with
-    cu_seqlens. Implemented by segment-mask over the packed sequence
-    (bucketing/padding policy per SURVEY.md §7 hard parts)."""
+    """Varlen attention (reference flash_attn_unpadded:756): ragged
+    batches packed as [total_tokens, H, D] with cu_seqlens. Default path
+    is the block-sparse Pallas kernel (per-query-block key-block bounds
+    from cu_seqlens — O(T·block) memory); the dense segment-mask below
+    stays as the numerics reference / dropout fallback."""
+    if _pallas_available() and dropout == 0.0 and not return_softmax \
+            and _kernel_enabled("varlen"):
+        try:
+            from ...ops.pallas.flash_varlen import flash_attn_varlen
+            out = flash_attn_varlen(query, key, value, cu_seqlens_q,
+                                    cu_seqlens_k, scale=scale,
+                                    causal=causal)
+            return out, None
+        except Exception as e:
+            _kernel_failed("varlen", e)
+    return flash_attn_unpadded_dense(
+        query, key, value, cu_seqlens_q, cu_seqlens_k, max_seqlen_q,
+        max_seqlen_k, scale, dropout, causal, training)
+
+
+def flash_attn_unpadded_dense(query, key, value, cu_seqlens_q,
+                              cu_seqlens_k, max_seqlen_q, max_seqlen_k,
+                              scale, dropout=0.0, causal=False,
+                              training=True):
+    """Dense segment-mask reference path (O(T^2) — test oracle only)."""
     from ..._core.tensor import Tensor
-    q, k, v = query._value, key._value, value._value
     cu_q = cu_seqlens_q._value
-    tq = q.shape[0]
+    tq = query.shape[0]
     seg_q = jnp.cumsum(
         jnp.zeros(tq, jnp.int32).at[cu_q[1:-1]].add(1)) \
         if cu_q.shape[0] > 2 else jnp.zeros(tq, jnp.int32)
     cu_k = cu_seqlens_k._value
-    tk = k.shape[0]
+    tk = key.shape[0]
     seg_k = jnp.cumsum(
         jnp.zeros(tk, jnp.int32).at[cu_k[1:-1]].add(1)) \
         if cu_k.shape[0] > 2 else jnp.zeros(tk, jnp.int32)
@@ -121,10 +185,11 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
         pos_q = jnp.arange(tq) - jnp.take(cu_q, seg_q)
         pos_k = jnp.arange(tk) - jnp.take(cu_k, seg_k)
         mask = mask & (pos_k[None, :] <= pos_q[:, None])
-    qb = Tensor(q[None])  # [1, tq, H, D]
-    kb = Tensor(k[None])
-    vb = Tensor(v[None])
+    # stay on the Tensor graph so the oracle is differentiable too
+    qb = query.unsqueeze(0)  # [1, tq, H, D]
+    kb = key.unsqueeze(0)
+    vb = value.unsqueeze(0)
     mb = Tensor(mask[None, None])
     out = scaled_dot_product_attention(qb, kb, vb, mb, dropout, False,
                                        training, scale=scale)
-    return out[0], None
+    return out.squeeze(0), None

@@ -1,0 +1,161 @@
+"""Block-sparse varlen + flashmask Pallas kernels vs the dense reference.
+
+The dense paths (flash_attn_unpadded_dense / flashmask_attention_dense)
+build the full [T, T] mask and are the numerics oracle; the Pallas
+kernels must match them (fwd and grads) while doing block-skipped work.
+Mirrors the reference's flash-attention unit tests
+(test/legacy_test/test_flash_attention.py style: same inputs through
+both paths, allclose).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.nn.functional.flash_attention import (
+    flash_attn_unpadded_dense, flashmask_attention_dense)
+
+
+def _t(x, stop_gradient=True):
+    t = paddle.to_tensor(np.asarray(x, "float32"))
+    t.stop_gradient = stop_gradient
+    return t
+
+
+def _varlen_case(seqlens_q, seqlens_k, h=2, d=16, causal=False, seed=0):
+    r = np.random.RandomState(seed)
+    tq, tk = sum(seqlens_q), sum(seqlens_k)
+    q = r.randn(tq, h, d).astype("float32") * 0.5
+    k = r.randn(tk, h, d).astype("float32") * 0.5
+    v = r.randn(tk, h, d).astype("float32") * 0.5
+    cu_q = np.cumsum([0] + list(seqlens_q)).astype("int32")
+    cu_k = np.cumsum([0] + list(seqlens_k)).astype("int32")
+    scale = 1.0 / np.sqrt(d)
+
+    def run(path):
+        qt, kt, vt = _t(q, False), _t(k, False), _t(v, False)
+        cuq, cuk = _t(cu_q), _t(cu_k)
+        cuq._value = cuq._value.astype("int32")
+        cuk._value = cuk._value.astype("int32")
+        if path == "dense":
+            out = flash_attn_unpadded_dense(
+                qt, kt, vt, cuq, cuk, max(seqlens_q), max(seqlens_k),
+                scale, causal=causal)[0]
+        else:
+            from paddle_tpu.ops.pallas.flash_varlen import \
+                flash_attn_varlen
+            out = flash_attn_varlen(qt, kt, vt, cuq, cuk, scale=scale,
+                                    causal=causal)
+        loss = (out * out).sum()
+        loss.backward()
+        return (np.asarray(out.numpy()), np.asarray(qt.grad.numpy()),
+                np.asarray(kt.grad.numpy()), np.asarray(vt.grad.numpy()))
+
+    return run
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_varlen_matches_dense(causal):
+    run = _varlen_case([5, 9, 3], [5, 9, 3], causal=causal)
+    o_d, dq_d, dk_d, dv_d = run("dense")
+    o_p, dq_p, dk_p, dv_p = run("pallas")
+    np.testing.assert_allclose(o_p, o_d, rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(dq_p, dq_d, rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(dk_p, dk_d, rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(dv_p, dv_d, rtol=2e-4, atol=2e-4)
+
+
+def test_varlen_cross_lengths():
+    # kv lengths differ from q lengths (cross attention), non-causal
+    run = _varlen_case([4, 6], [7, 5], causal=False, seed=3)
+    o_d, dq_d, dk_d, dv_d = run("dense")
+    o_p, dq_p, dk_p, dv_p = run("pallas")
+    np.testing.assert_allclose(o_p, o_d, rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(dv_p, dv_d, rtol=2e-4, atol=2e-4)
+
+
+def test_varlen_block_spanning():
+    # total tokens > one 128 block so the block-bound logic is exercised
+    run = _varlen_case([70, 90, 40], [70, 90, 40], h=1, d=8, causal=True,
+                       seed=5)
+    o_d, dq_d, dk_d, dv_d = run("dense")
+    o_p, dq_p, dk_p, dv_p = run("pallas")
+    np.testing.assert_allclose(o_p, o_d, rtol=3e-4, atol=3e-4)
+    np.testing.assert_allclose(dq_p, dq_d, rtol=3e-4, atol=3e-4)
+    np.testing.assert_allclose(dk_p, dk_d, rtol=3e-4, atol=3e-4)
+    np.testing.assert_allclose(dv_p, dv_d, rtol=3e-4, atol=3e-4)
+
+
+def _flashmask_case(b=1, s=24, h=2, d=16, cols=1, causal=True, seed=0):
+    r = np.random.RandomState(seed)
+    q = r.randn(b, s, h, d).astype("float32") * 0.5
+    k = r.randn(b, s, h, d).astype("float32") * 0.5
+    v = r.randn(b, s, h, d).astype("float32") * 0.5
+    # LT semantics: key col j banned for query rows >= start[j]
+    # (and < end[j] when cols == 2). Keep col 0 visible everywhere so no
+    # query row is FULLY banned — on fully-banned rows the flash kernel
+    # returns zeros (l == 0) while the dense-softmax oracle degenerates
+    # to uniform attention; both are out-of-contract inputs.
+    start = r.randint(1, s + 1, size=(b, h, s, 1)).astype("int32")
+    start[:, :, 0, :] = s + 1
+    if cols == 2:
+        extra = r.randint(0, 5, size=(b, h, s, 1)).astype("int32")
+        end = np.minimum(start + extra, s + 1)
+        idx = np.concatenate([start, end], axis=-1)
+    else:
+        idx = start
+
+    def run(path):
+        qt, kt, vt = _t(q, False), _t(k, False), _t(v, False)
+        it = paddle.to_tensor(idx)
+        if path == "dense":
+            out = flashmask_attention_dense(qt, kt, vt, it, causal=causal)
+        else:
+            from paddle_tpu.ops.pallas.flash_varlen import \
+                flashmask_attention_pallas
+            out = flashmask_attention_pallas(qt, kt, vt, it,
+                                             causal=causal)
+        loss = (out * out).sum()
+        loss.backward()
+        return (np.asarray(out.numpy()), np.asarray(qt.grad.numpy()),
+                np.asarray(kt.grad.numpy()), np.asarray(vt.grad.numpy()))
+
+    return run
+
+
+@pytest.mark.parametrize("cols", [1, 2])
+def test_flashmask_matches_dense(cols):
+    run = _flashmask_case(cols=cols)
+    o_d, dq_d, dk_d, dv_d = run("dense")
+    o_p, dq_p, dk_p, dv_p = run("pallas")
+    np.testing.assert_allclose(o_p, o_d, rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(dq_p, dq_d, rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(dk_p, dk_d, rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(dv_p, dv_d, rtol=2e-4, atol=2e-4)
+
+
+def test_flashmask_broadcast_heads_block_spanning():
+    # head-broadcast indices + S spanning >1 block of 128
+    run = _flashmask_case(b=1, s=160, h=2, d=8, cols=1, causal=True,
+                          seed=7)
+    o_d, dq_d, dk_d, dv_d = run("dense")
+    o_p, dq_p, dk_p, dv_p = run("pallas")
+    np.testing.assert_allclose(o_p, o_d, rtol=3e-4, atol=3e-4)
+    np.testing.assert_allclose(dq_p, dq_d, rtol=3e-4, atol=3e-4)
+
+
+def test_functional_surface_uses_pallas():
+    """flash_attn_unpadded routes to the kernel and agrees with dense."""
+    r = np.random.RandomState(1)
+    tq = 12
+    q = _t(r.randn(tq, 2, 16).astype("float32") * 0.5)
+    k = _t(r.randn(tq, 2, 16).astype("float32") * 0.5)
+    v = _t(r.randn(tq, 2, 16).astype("float32") * 0.5)
+    cu = paddle.to_tensor(np.array([0, 5, 12], "int32"))
+    out, _ = F.flash_attn_unpadded(q, k, v, cu, cu, 7, 7,
+                                   1.0 / 4.0, causal=True)
+    dense, _ = flash_attn_unpadded_dense(q, k, v, cu, cu, 7, 7, 1.0 / 4.0,
+                                         causal=True)
+    np.testing.assert_allclose(np.asarray(out.numpy()),
+                               np.asarray(dense.numpy()),
+                               rtol=2e-4, atol=2e-4)
