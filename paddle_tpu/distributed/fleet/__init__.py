@@ -119,6 +119,11 @@ def distributed_model(model):
         return model
     if mode == "data_parallel":
         return DataParallel(model)
+    if mode == "model_parallel":
+        from ..parallel_env import is_initialized
+        if is_initialized():
+            from .mp_layers import TensorParallel
+            return TensorParallel(model, hcg)
     return model
 
 

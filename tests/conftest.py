@@ -80,9 +80,11 @@ def pytest_collection_modifyitems(config, items):
 
 
 @pytest.hookimpl(hookwrapper=True)
-def pytest_runtest_call(item):
+def pytest_runtest_protocol(item, nextitem):
     # SIGALRM-based timeout (tests run in the main thread); vendored
-    # because pip installs are unavailable in this environment.
+    # because pip installs are unavailable in this environment. Wraps
+    # the whole protocol so fixture setup/teardown hangs (rendezvous,
+    # trainer-process spawns) are bounded too, not just the call phase.
     mark = item.get_closest_marker("timeout")
     if mark and mark.args:
         limit = int(mark.args[0])

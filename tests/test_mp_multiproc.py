@@ -1,0 +1,212 @@
+"""Eager multi-process tensor parallelism (VERDICT r3 missing #5).
+
+Two real trainer processes each hold one mp shard of an
+embedding -> column-parallel -> gelu -> row-parallel -> vocab-parallel
+head model; the host-driven mpu collectives (mp_identity / mp_allreduce
+/ mp_concat / mp_split / mp_lookup_table / mp_softmax_cross_entropy,
+fleet/layers/mpu/mp_ops.py:77-385 analogs) must reproduce the
+single-process full model exactly: same loss, and each rank's shard
+grads equal the matching slice of the full-model grads.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORLD = 2
+B, S = 2, 6
+VOCAB, H, FF = 12, 8, 16
+
+
+def _weights():
+    r = np.random.RandomState(3)
+    return {
+        "emb": (r.randn(VOCAB, H) * 0.2).astype("float32"),
+        "w_col": (r.randn(H, FF) * 0.2).astype("float32"),
+        "b_col": (r.randn(FF) * 0.1).astype("float32"),
+        "w_row": (r.randn(FF, H) * 0.2).astype("float32"),
+        "b_row": (r.randn(H) * 0.1).astype("float32"),
+        "w_head": (r.randn(H, VOCAB) * 0.2).astype("float32"),
+    }
+
+
+def _data():
+    r = np.random.RandomState(5)
+    ids = r.randint(0, VOCAB, size=(B, S)).astype("int64")
+    labels = r.randint(0, VOCAB, size=(B, S)).astype("int64")
+    labels[0, 0] = -100  # padded token: must be masked by ignore_index
+    return ids, labels
+
+
+def _single_process_reference():
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+
+    w = {k: paddle.to_tensor(v) for k, v in _weights().items()}
+    for t in w.values():
+        t.stop_gradient = False
+    ids, labels = _data()
+    ids_t = paddle.to_tensor(ids)
+    h = F.embedding(ids_t, w["emb"])
+    h = F.gelu(F.linear(h, w["w_col"], w["b_col"]))
+    h = F.linear(h, w["w_row"], w["b_row"])
+    logits = F.linear(h, w["w_head"], None)
+    loss = F.cross_entropy(logits, paddle.to_tensor(labels),
+                           reduction="none").mean()
+    loss.backward()
+    return float(loss.numpy()), {k: t.grad.numpy() for k, t in w.items()}
+
+
+def _worker():
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    import paddle_tpu.distributed.fleet as fleet
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.distributed.fleet.mp_layers import (
+        ColumnParallelLinear, ParallelCrossEntropy, RowParallelLinear,
+        VocabParallelEmbedding)
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": WORLD,
+                               "pp_degree": 1, "sharding_degree": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+
+    emb = VocabParallelEmbedding(VOCAB, H)
+    col = ColumnParallelLinear(H, FF, gather_output=False)
+    row = RowParallelLinear(FF, H, input_is_parallel=True)
+    head = ColumnParallelLinear(H, VOCAB, has_bias=False,
+                                gather_output=False)
+    ce = ParallelCrossEntropy()
+
+    # shard-assign the SAME full weights the reference uses
+    w = _weights()
+    vper, fper = VOCAB // WORLD, FF // WORLD
+    emb.weight.set_value(w["emb"][rank * vper:(rank + 1) * vper])
+    col.weight.set_value(w["w_col"][:, rank * fper:(rank + 1) * fper])
+    col.bias.set_value(w["b_col"][rank * fper:(rank + 1) * fper])
+    row.weight.set_value(w["w_row"][rank * fper:(rank + 1) * fper])
+    row.bias.set_value(w["b_row"])
+    head.weight.set_value(w["w_head"][:, rank * vper:(rank + 1) * vper])
+
+    ids, labels = _data()
+    h = emb(paddle.to_tensor(ids))
+    h = F.gelu(col(h))
+    h = row(h)
+    logits_local = head(h)
+    # labels with the paddle-convention trailing unit dim must work too
+    loss = ce(logits_local,
+              paddle.to_tensor(labels[..., None])).mean()
+    loss.backward()
+
+    report = {
+        "rank": rank,
+        "loss": float(loss.numpy()),
+        "grads": {
+            "emb": emb.weight.grad.numpy().tolist(),
+            "w_col": col.weight.grad.numpy().tolist(),
+            "b_col": col.bias.grad.numpy().tolist(),
+            "w_row": row.weight.grad.numpy().tolist(),
+            "b_row": row.bias.grad.numpy().tolist(),
+            "w_head": head.weight.grad.numpy().tolist(),
+        },
+    }
+    print("MP-REPORT:" + json.dumps(report), flush=True)
+
+
+def _launch():
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    procs = []
+    for rank in range(WORLD):
+        env = dict(os.environ)
+        env.update({
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(WORLD),
+            "MASTER_ADDR": "127.0.0.1",
+            "MASTER_PORT": str(port),
+            "JAX_PLATFORMS": "cpu",
+            "PT_MP_WORKER": "1",
+            "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    reports = {}
+    for rank, p in enumerate(procs):
+        out, _ = p.communicate(timeout=240)
+        assert p.returncode == 0, f"rank {rank} rc={p.returncode}:\n{out}"
+        for line in out.splitlines():
+            if line.startswith("MP-REPORT:"):
+                rep = json.loads(line[len("MP-REPORT:"):])
+                reports[rep["rank"]] = rep
+    assert len(reports) == WORLD
+    return reports
+
+
+def test_eager_mp_matches_single_process():
+    ref_loss, ref_g = _single_process_reference()
+    reports = _launch()
+    vper, fper = VOCAB // WORLD, FF // WORLD
+    for rank in range(WORLD):
+        rep = reports[rank]
+        assert abs(rep["loss"] - ref_loss) < 1e-5, \
+            (rep["loss"], ref_loss)
+        g = {k: np.asarray(v, "float32") for k, v in rep["grads"].items()}
+        np.testing.assert_allclose(
+            g["emb"], ref_g["emb"][rank * vper:(rank + 1) * vper],
+            rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(
+            g["w_col"], ref_g["w_col"][:, rank * fper:(rank + 1) * fper],
+            rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(
+            g["b_col"], ref_g["b_col"][rank * fper:(rank + 1) * fper],
+            rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(
+            g["w_row"], ref_g["w_row"][rank * fper:(rank + 1) * fper],
+            rtol=1e-5, atol=1e-6)
+        # row bias is replicated: full grad on every rank
+        np.testing.assert_allclose(g["b_row"], ref_g["b_row"],
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(
+            g["w_head"], ref_g["w_head"][:, rank * vper:(rank + 1) * vper],
+            rtol=1e-5, atol=1e-6)
+
+
+def test_mp_without_mesh_or_group_raises():
+    """VERDICT r3 weak #10: mp degree > 1 with neither regime must fail
+    loudly, not silently run un-sharded."""
+    from paddle_tpu.distributed.fleet import mp_layers
+    from paddle_tpu.distributed.fleet.mp_layers import ColumnParallelLinear
+
+    class _FakeHCG:
+        def get_model_parallel_world_size(self):
+            return 2
+
+        def get_model_parallel_rank(self):
+            return 0
+
+        def get_model_parallel_group(self):
+            return None
+
+    orig = mp_layers.get_hybrid_communicate_group
+    mp_layers.get_hybrid_communicate_group = lambda: _FakeHCG()
+    try:
+        with pytest.raises(RuntimeError, match="un-sharded"):
+            ColumnParallelLinear(8, 16)
+    finally:
+        mp_layers.get_hybrid_communicate_group = orig
+
+
+if __name__ == "__main__" and os.environ.get("PT_MP_WORKER") == "1":
+    _worker()
