@@ -50,6 +50,36 @@ def _wrap_tree(obj):
             x, (jax.Array, jax.core.Tracer, np.ndarray)) else x, obj)
 
 
+def _is_guard_static(leaf) -> bool:
+    """Python bool/int/str leaves are guarded compile-time constants
+    (SOT guard semantics); arrays and floats stay dynamic (floats are
+    commonly per-call values — guarding them would retrace per value)."""
+    return isinstance(leaf, (bool, int, str)) and not hasattr(leaf, "dtype")
+
+
+def _static_partition(vals):
+    """Split a raw-value tree into (dynamic leaves, treedef, static
+    signature). The static signature is hashable and joins the compile
+    cache key."""
+    leaves, treedef = jax.tree_util.tree_flatten(vals)
+    dyn, static = [], []
+    for i, leaf in enumerate(leaves):
+        if _is_guard_static(leaf):
+            static.append((i, leaf))
+        else:
+            dyn.append(leaf)
+    return dyn, treedef, tuple(static)
+
+
+def _restore_static(treedef, static, dyn):
+    """Inverse of _static_partition given the dynamic leaves."""
+    static_at = dict(static)
+    it = iter(dyn)
+    leaves = [static_at[i] if i in static_at else next(it)
+              for i in range(treedef.num_leaves)]
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
 class StaticFunction:
     """Compiled callable wrapping a Layer's forward or a plain function.
 
@@ -123,16 +153,31 @@ class StaticFunction:
         avals = _unwrap_tree(args)
         kwvals = _unwrap_tree(kwargs)
 
-        key = (tuple(names), self._layer.training if self._layer else None)
+        # Input-signature GUARDS (the SOT guard.py role): Python
+        # bool/int/str leaves are compile-time constants — they join the
+        # cache key, and a changed value retraces instead of crashing on
+        # tensor control flow. Arrays (and floats) stay dynamic.
+        a_dyn, a_def, a_static = _static_partition(avals)
+        k_dyn, k_def, k_static = _static_partition(kwvals)
+
+        key = (tuple(names),
+               self._layer.training if self._layer else None,
+               a_def, k_def, a_static, k_static)
         if key not in self._fwd_cache:
             pure = self._make_pure(names)
-            self._fwd_cache[key] = jax.jit(pure)
 
-            def bwd(svals_, args_, kwargs_, cotangents):
+            def pure_dyn(s, ad, kd, _a=(a_def, a_static),
+                         _k=(k_def, k_static)):
+                return pure(s, _restore_static(_a[0], _a[1], ad),
+                            _restore_static(_k[0], _k[1], kd))
+
+            self._fwd_cache[key] = jax.jit(pure_dyn)
+
+            def bwd(svals_, a_dyn_, k_dyn_, cotangents):
                 def f(s, a, k):
-                    out, _ = pure(s, a, k)
+                    out, _ = pure_dyn(s, a, k)
                     return out
-                primals, pull = jax.vjp(f, svals_, args_, kwargs_)
+                primals, pull = jax.vjp(f, svals_, a_dyn_, k_dyn_)
                 # downstream eager ops (e.g. an AMP'd loss) may hand back
                 # cotangents in a different float dtype than the compiled
                 # forward produced — cast to the primal dtype
@@ -144,7 +189,7 @@ class StaticFunction:
             self._bwd_cache[key] = jax.jit(bwd)
 
         try:
-            out_vals, buf_vals = self._fwd_cache[key](svals, avals, kwvals)
+            out_vals, buf_vals = self._fwd_cache[key](svals, a_dyn, k_dyn)
         except jax.errors.TracerBoolConversionError as e:
             note = f" (dy2static transform failed: {self._dy2st_note})" \
                 if self._dy2st_note else ""
@@ -167,17 +212,23 @@ class StaticFunction:
         out_leaves, out_tree = jax.tree_util.tree_flatten(out_vals)
         out_tensors = [Tensor(v) for v in out_leaves]
 
-        arg_tensors = [a for a in jax.tree_util.tree_leaves(
-            (args, kwargs), is_leaf=_is_tensor) if isinstance(a, Tensor)]
+        orig_leaves = [a for a in jax.tree_util.tree_leaves(
+            (args, kwargs), is_leaf=_is_tensor)]
+        arg_tensors = [a for a in orig_leaves if isinstance(a, Tensor)]
+        # which DYNAMIC leaves came from Tensors (grad alignment below)
+        dyn_is_tensor = tuple(
+            isinstance(a, Tensor) for a in orig_leaves
+            if not _is_guard_static(a._value if isinstance(a, Tensor)
+                                    else a))
         in_tensors = state_tensors + arg_tensors
         if is_grad_enabled() and any(not t.stop_gradient
                                      for t in in_tensors):
-            self._record_grad(key, svals, avals, kwvals, in_tensors,
-                              out_tensors, out_tree)
+            self._record_grad(key, svals, a_dyn, k_dyn, dyn_is_tensor,
+                              in_tensors, out_tensors, out_tree)
         return jax.tree_util.tree_unflatten(out_tree, out_tensors)
 
-    def _record_grad(self, key, svals, avals, kwvals, in_tensors,
-                     out_tensors, out_tree):
+    def _record_grad(self, key, svals, a_dyn, k_dyn, dyn_is_tensor,
+                     in_tensors, out_tensors, out_tree):
         edges = []
         for t in in_tensors:
             if t.stop_gradient:
@@ -196,12 +247,15 @@ class StaticFunction:
         node.name = f"to_static({getattr(self._fn, '__name__', 'fn')})"
         bwd_exec = self._bwd_cache[key]
 
-        def py_bwd(gouts, _svals=svals, _avals=avals, _kwvals=kwvals,
+        def py_bwd(gouts, _svals=svals, _a=a_dyn, _k=k_dyn,
                    _tree=out_tree):
             ct = jax.tree_util.tree_unflatten(_tree, list(gouts))
-            g_state, g_args, g_kwargs = bwd_exec(_svals, _avals, _kwvals, ct)
-            grads = list(g_state) + list(
-                jax.tree_util.tree_leaves((g_args, g_kwargs)))
+            g_state, g_args, g_kwargs = bwd_exec(_svals, _a, _k, ct)
+            g_dyn = list(jax.tree_util.tree_leaves((g_args, g_kwargs)))
+            # grads align with in_tensors: keep only the dynamic-leaf
+            # grads whose original leaf was a Tensor
+            grads = list(g_state) + [
+                g for g, ist in zip(g_dyn, dyn_is_tensor) if ist]
             out = []
             for g in grads:
                 if g is None or (hasattr(g, "dtype")

@@ -9,10 +9,14 @@ those decide AT RUNTIME whether the predicate is a traced tensor (use
 graph) or a plain Python bool (run the branch directly) — the same
 always-rewrite / runtime-dispatch design the reference uses.
 
-Supported v1 surface: ``if``/``elif``/``else`` and ``while`` whose
-bodies assign ordinary local names (no ``return``/``break``/
-``continue`` inside converted blocks — those raise a clear
-transform-time error so nothing silently specializes).
+Supported surface: ``if``/``elif``/``else``, ``while``, ``for`` over
+``range(...)`` / tensors / sequences (desugared to ``while``), and
+``return`` / ``break`` / ``continue`` inside converted blocks via the
+reference's flag-and-guard rewrites (dy2static return_transformer /
+break_continue_transformer): the statement becomes a flag assignment,
+every following statement is guarded on the flag, and loop conditions
+are augmented with it — so a tensor-dependent early exit lowers to
+``lax.cond``/``lax.while_loop`` exactly like any other assignment.
 """
 from __future__ import annotations
 
@@ -101,26 +105,75 @@ def convert_ifelse(pred, true_fn: Callable, false_fn: Callable, vars_,
     # non-array locals (None, lists, ...) pass through by closure; if a
     # branch rebinds them to arrays they become cond outputs
     operands = tuple(_raw(v) for v in vars_ if _arrayish(v))
-    outs = jax.lax.cond(_raw(pred), _wrap(true_fn), _wrap(false_fn),
-                        operands)
+    tf, ff = _wrap(true_fn), _wrap(false_fn)
+    tf, ff = _coerce_branch_outputs(tf, ff, operands)
+    outs = jax.lax.cond(_raw(pred), tf, ff, operands)
     full = [UNDEF] * n
     for i, o in zip(keep, outs):
         full[i] = Tensor(o) if hasattr(o, "dtype") else o
     return tuple(full)
 
 
+def _coerce_branch_outputs(tf, ff, operands):
+    """lax.cond needs both branches to yield the same pytree/avals, but
+    a guard flag or return value may be bound to an array in only one
+    branch (the other keeps its Python None/scalar). Those slots are
+    GUARDED — their value in the untaken branch is never read — so the
+    weaker side is promoted to a matching array (None -> zeros, scalar
+    -> full)."""
+    try:
+        t_avals = jax.eval_shape(tf, operands)
+        f_avals = jax.eval_shape(ff, operands)
+    except Exception:
+        return tf, ff  # let lax.cond produce its own diagnostics
+
+    def target(a, b):
+        # pick the array side when exactly one side is array-shaped
+        a_arr, b_arr = hasattr(a, "dtype"), hasattr(b, "dtype")
+        if a_arr and not b_arr:
+            return a
+        if b_arr and not a_arr:
+            return b
+        return None
+
+    specs = [target(a, b) for a, b in zip(t_avals, f_avals)]
+    if not any(s is not None for s in specs):
+        return tf, ff
+
+    def fix(fn):
+        def f(op_vars):
+            out = list(fn(op_vars))
+            for i, spec in enumerate(specs):
+                if spec is None or hasattr(out[i], "dtype"):
+                    continue
+                if out[i] is None:
+                    out[i] = jnp.zeros(spec.shape, spec.dtype)
+                elif isinstance(out[i], (bool, int, float)):
+                    out[i] = jnp.full(spec.shape, out[i], spec.dtype)
+            return tuple(out)
+        return f
+
+    return fix(tf), fix(ff)
+
+
 def convert_while_loop(cond_fn: Callable, body_fn: Callable, vars_):
     """Traced condition -> lax.while_loop (forward-only, like the
-    reference's while_op); Python condition -> plain loop."""
-    first = cond_fn(vars_)
-    if _is_traced(first) and any(v is UNDEF for v in vars_):
+    reference's while_op); Python condition -> plain loop. A loop may
+    START Python (e.g. static trip count) and turn traced mid-flight
+    when a break/return flag becomes a cond output — the eager loop
+    re-checks and hands the current state to lax.while_loop."""
+    while True:
+        c = cond_fn(vars_)
+        if _is_traced(c):
+            break
+        if not bool(_raw(c)):
+            return vars_
+        vars_ = body_fn(vars_)
+
+    if any(v is UNDEF for v in vars_):
         raise RuntimeError(
             "dy2static: a variable mutated by a tensor-dependent while "
             "is not defined before the loop")
-    if not _is_traced(first):
-        while bool(_raw(cond_fn(vars_))):
-            vars_ = body_fn(vars_)
-        return vars_
 
     def _cond(raw_vars):
         wrapped = tuple(Tensor(v) for v in raw_vars)
@@ -135,6 +188,50 @@ def convert_while_loop(cond_fn: Callable, body_fn: Callable, vars_):
     return tuple(Tensor(o) for o in outs)
 
 
+def convert_not(x):
+    """Boolean not over Tensor or Python value (the guard flags flow
+    through here when traced)."""
+    if isinstance(x, Tensor) or hasattr(x, "dtype"):
+        return Tensor(jnp.logical_not(_raw(x)))
+    return not x
+
+
+def convert_len(x):
+    """len() for the for-loop desugar: Tensor -> leading dim (a static
+    Python int, so the loop unrolls under trace); sequences -> len()."""
+    if isinstance(x, Tensor) or hasattr(x, "shape"):
+        return x.shape[0]
+    return len(x)
+
+
+def convert_index(x, i):
+    """x[i] with a possibly-traced index."""
+    if isinstance(x, Tensor):
+        return Tensor(jnp.take(_raw(x), jnp.asarray(_raw(i)), axis=0))
+    if hasattr(x, "dtype"):
+        return jnp.take(x, jnp.asarray(_raw(i)), axis=0)
+    if _is_traced(i):
+        raise NotImplementedError(
+            "dy2static: tensor-dependent index into a Python sequence")
+    return x[int(_raw(i))]
+
+
+def convert_range_len(start, stop, step):
+    """Trip count of range(start, stop, step) over Tensors or ints
+    (tensor stop -> traced count -> lax.while_loop)."""
+    if any(_is_traced(v) or isinstance(v, Tensor) for v in
+           (start, stop, step)):
+        s0, s1, st = (_raw(v) for v in (start, stop, step))
+        n = (s1 - s0 + st + jnp.where(st > 0, -1, 1)) // st
+        return Tensor(jnp.maximum(n, 0))
+    return max((stop - start + step + (-1 if step > 0 else 1)) // step, 0)
+
+
+def convert_range_item(start, step, i):
+    out = _raw(start) + _raw(i) * _raw(step)
+    return Tensor(out) if _is_traced(i) or isinstance(i, Tensor) else out
+
+
 def convert_logical_and(a_fn, b_fn):
     a = a_fn()
     if _is_traced(a):
@@ -147,6 +244,281 @@ def convert_logical_or(a_fn, b_fn):
     if _is_traced(a):
         return Tensor(jnp.logical_or(_raw(a), _raw(b_fn())))
     return a if bool(_raw(a)) else b_fn()
+
+
+# ------------------------------------------------- flag/guard AST helpers
+
+def _name_load(n):
+    return ast.Name(id=n, ctx=ast.Load())
+
+
+def _name_store(n):
+    return ast.Name(id=n, ctx=ast.Store())
+
+
+def _assign(name, value):
+    return ast.Assign(targets=[_name_store(name)], value=value)
+
+
+def _call(fn_name, *args):
+    return ast.Call(func=_name_load(fn_name), args=list(args),
+                    keywords=[])
+
+
+def _lambda0(expr):
+    return ast.Lambda(
+        args=ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                           kw_defaults=[], defaults=[]),
+        body=expr)
+
+
+def _sets_any(stmt, names) -> bool:
+    """Does stmt (recursively, skipping nested defs) bind any of names?"""
+    for node in ast.walk(stmt):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store) \
+                and node.id in names:
+            return True
+    return False
+
+
+def _guard_rest(stmts, flag_names, process=None):
+    """The reference's guard rewrite: after any statement that may set
+    an exit flag, wrap the remaining statements of the block in
+    ``if __dy2st_not(flag_or): ...`` so they are skipped once the flag
+    fires (return_transformer / break_continue_transformer)."""
+    process = process or (lambda s: s)
+    out = []
+    for idx, s in enumerate(stmts):
+        s2 = process(s)
+        items = s2 if isinstance(s2, list) else [s2]
+        out.extend(items)
+        if any(_sets_any(it, flag_names) for it in items) \
+                and idx + 1 < len(stmts):
+            rest = _guard_rest(stmts[idx + 1:], flag_names, process)
+            test = _flag_clear_test(flag_names)
+            out.append(ast.If(test=test, body=rest, orelse=[]))
+            break
+    return out
+
+
+def _flag_clear_test(flag_names):
+    """__dy2st_not(f1) [and __dy2st_not(f2)] as a convert-aware expr."""
+    names = sorted(flag_names)
+    test = _call("__dy2st_not", _name_load(names[0]))
+    for n in names[1:]:
+        test = _call("__dy2st_convert_and", _lambda0(test),
+                     _lambda0(_call("__dy2st_not", _name_load(n))))
+    return test
+
+
+class _ForToWhile(ast.NodeTransformer):
+    """Desugar ``for`` into index-based ``while`` (the reference's loop
+    transformer): range() iterates by start/step arithmetic, tensors and
+    sequences by convert_index. A Python-int trip count unrolls under
+    trace; a traced count becomes lax.while_loop via convert_while."""
+
+    def __init__(self):
+        self._n = 0
+
+    def visit_FunctionDef(self, node):
+        if getattr(node, "_dy2st_root", False):
+            return self.generic_visit(node)
+        return node  # don't descend into nested defs
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = lambda self, node: node  # noqa: E731
+
+    def visit_For(self, node):
+        node = self.generic_visit(node)
+        if node.orelse:
+            raise NotImplementedError("dy2static: for/else unsupported")
+        self._n += 1
+        k = self._n
+        i_v, n_v, it_v = (f"__dy2st_i_{k}", f"__dy2st_n_{k}",
+                          f"__dy2st_it_{k}")
+        pre = []
+        is_range = (isinstance(node.iter, ast.Call)
+                    and isinstance(node.iter.func, ast.Name)
+                    and node.iter.func.id == "range")
+        if is_range:
+            rargs = node.iter.args
+            start = rargs[0] if len(rargs) > 1 else ast.Constant(value=0)
+            stop = rargs[1] if len(rargs) > 1 else rargs[0]
+            step = rargs[2] if len(rargs) > 2 else ast.Constant(value=1)
+            st_v, sp_v = f"__dy2st_start_{k}", f"__dy2st_step_{k}"
+            pre += [_assign(st_v, start), _assign(sp_v, step),
+                    _assign(n_v, _call("__dy2st_range_len",
+                                       _name_load(st_v), stop,
+                                       _name_load(sp_v)))]
+            item = _call("__dy2st_range_item", _name_load(st_v),
+                         _name_load(sp_v), _name_load(i_v))
+        else:
+            pre += [_assign(it_v, node.iter),
+                    _assign(n_v, _call("__dy2st_len", _name_load(it_v)))]
+            item = _call("__dy2st_index", _name_load(it_v),
+                         _name_load(i_v))
+        pre.append(_assign(i_v, ast.Constant(value=0)))
+        bind = ast.Assign(targets=[node.target], value=item)
+        bump = _assign(i_v, ast.BinOp(left=_name_load(i_v),
+                                      op=ast.Add(),
+                                      right=ast.Constant(value=1)))
+        # bump BEFORE the user body: a `continue` guard must skip the
+        # body's tail, never the index advance (else: infinite loop)
+        loop = ast.While(
+            test=ast.Compare(left=_name_load(i_v), ops=[ast.Lt()],
+                             comparators=[_name_load(n_v)]),
+            body=[bind, bump] + list(node.body),
+            orelse=[])
+        return pre + [loop]
+
+
+def _always_returns(stmts) -> bool:
+    """Conservative: every path through stmts ends in return."""
+    for s in stmts:
+        if isinstance(s, ast.Return):
+            return True
+        if isinstance(s, ast.If) and s.orelse \
+                and _always_returns(s.body) \
+                and _always_returns(s.orelse):
+            return True
+    return False
+
+
+def _absorb_after_return(stmts):
+    """Move the statements FOLLOWING an always-returning ``if`` into its
+    ``else`` (the reference's early-return restructure): afterwards both
+    branches bind the return value, so the flag transform produces a
+    lax.cond whose branches agree."""
+    out = []
+    for idx, s in enumerate(stmts):
+        if isinstance(s, ast.If):
+            s.body = _absorb_after_return(s.body)
+            s.orelse = _absorb_after_return(s.orelse)
+            rest = stmts[idx + 1:]
+            if rest and _always_returns(s.body):
+                s.orelse = _absorb_after_return(
+                    list(s.orelse) + [r for r in rest])
+                out.append(s)
+                return out
+        elif isinstance(s, ast.While):
+            s.body = _absorb_after_return(s.body)
+        out.append(s)
+    return out
+
+
+class _ReturnTransformer(ast.NodeTransformer):
+    """``return X`` anywhere inside control flow becomes
+    ``__dy2st_ret = True; __dy2st_val = X`` with every following
+    statement guarded and loop conditions augmented — the reference's
+    return_transformer."""
+
+    FLAG, VAL = "__dy2st_ret", "__dy2st_val"
+
+    def run(self, fdef):
+        has_inner_return = any(
+            isinstance(n, ast.Return)
+            for stmt in fdef.body
+            if isinstance(stmt, (ast.If, ast.While, ast.For))
+            for n in ast.walk(stmt))
+        if not has_inner_return:
+            return fdef
+        body = self._block(_absorb_after_return(fdef.body))
+        fdef.body = [
+            _assign(self.FLAG, ast.Constant(value=False)),
+            _assign(self.VAL, ast.Constant(value=None)),
+        ] + body + [ast.Return(value=_name_load(self.VAL))]
+        return fdef
+
+    def _block(self, stmts):
+        return _guard_rest(stmts, {self.FLAG}, self._stmt)
+
+    def _stmt(self, s):
+        if isinstance(s, ast.Return):
+            return [_assign(self.FLAG, ast.Constant(value=True)),
+                    _assign(self.VAL, s.value or ast.Constant(value=None))]
+        if isinstance(s, ast.If):
+            s.body = self._block(s.body)
+            s.orelse = self._block(s.orelse)
+            return s
+        if isinstance(s, ast.While):
+            s.body = self._block(s.body)
+            if any(_sets_any(b, {self.FLAG}) for b in s.body):
+                s.test = _call("__dy2st_convert_and",
+                               _lambda0(_call("__dy2st_not",
+                                              _name_load(self.FLAG))),
+                               _lambda0(s.test))
+            return s
+        return s
+
+
+class _BreakContinueTransformer(ast.NodeTransformer):
+    """``break``/``continue`` become per-loop flags with guarded tails;
+    ``break`` also augments the loop condition — the reference's
+    break_continue_transformer."""
+
+    def __init__(self):
+        self._n = 0
+
+    def visit_FunctionDef(self, node):
+        if getattr(node, "_dy2st_root", False):
+            return self.generic_visit(node)
+        return node
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = lambda self, node: node  # noqa: E731
+
+    def visit_While(self, node):
+        # inner loops first so each break binds to ITS loop
+        node = self.generic_visit(node)
+        has_brk = self._has(node.body, ast.Break)
+        has_cnt = self._has(node.body, ast.Continue)
+        if not (has_brk or has_cnt):
+            return node
+        self._n += 1
+        brk = f"__dy2st_brk_{self._n}"
+        cnt = f"__dy2st_cnt_{self._n}"
+        flags = set()
+        if has_brk:
+            flags.add(brk)
+        if has_cnt:
+            flags.add(cnt)
+
+        def repl(s):
+            if isinstance(s, ast.Break):
+                return [_assign(brk, ast.Constant(value=True))]
+            if isinstance(s, ast.Continue):
+                return [_assign(cnt, ast.Constant(value=True))]
+            if isinstance(s, ast.If):
+                s.body = _guard_rest(s.body, flags, repl)
+                s.orelse = _guard_rest(s.orelse, flags, repl)
+                return s
+            return s
+
+        body = _guard_rest(node.body, flags, repl)
+        pre = []
+        if has_cnt:
+            body = [_assign(cnt, ast.Constant(value=False))] + body
+            # also bind before the loop: every name a tensor-dependent
+            # while mutates must exist at loop entry
+            pre.append(_assign(cnt, ast.Constant(value=False)))
+        if has_brk:
+            pre.append(_assign(brk, ast.Constant(value=False)))
+            node.test = _call("__dy2st_convert_and",
+                              _lambda0(_call("__dy2st_not",
+                                             _name_load(brk))),
+                              _lambda0(node.test))
+        node.body = body
+        return pre + [node] if pre else node
+
+    @staticmethod
+    def _has(stmts, kind):
+        for s in stmts:
+            for n in ast.walk(s):
+                if isinstance(n, kind):
+                    # don't count nested loops' breaks (generic_visit
+                    # already rewrote them) or nested defs
+                    return True
+        return False
 
 
 # --------------------------------------------------------- AST transformer
@@ -363,10 +735,18 @@ def ast_transform(fn: Callable) -> Callable:
     if isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
         fdef.decorator_list = [d for d in fdef.decorator_list
                                if not _is_to_static(d)]
-    has_flow = any(isinstance(n, (ast.If, ast.While))
+    has_flow = any(isinstance(n, (ast.If, ast.While, ast.For))
                    for n in ast.walk(tree))
     if not has_flow:
         return fn
+    # pass pipeline (program_translator.py transformer order): desugar
+    # for -> while, then return-flags, then break/continue-flags, then
+    # if/while -> lax.cond/while_loop
+    fdef._dy2st_root = True
+    tree = _ForToWhile().visit(tree)
+    if isinstance(fdef, ast.FunctionDef):
+        _ReturnTransformer().run(fdef)
+    tree = _BreakContinueTransformer().visit(tree)
     new_tree = _ControlFlowTransformer().visit(tree)
     ast.fix_missing_locations(new_tree)
     code = compile(new_tree, filename=f"<dy2static {fn.__qualname__}>",
@@ -375,6 +755,12 @@ def ast_transform(fn: Callable) -> Callable:
     glb["__dy2st_convert_ifelse"] = convert_ifelse
     glb["__dy2st_convert_while"] = convert_while_loop
     glb["__dy2st_UNDEF"] = UNDEF
+    glb["__dy2st_not"] = convert_not
+    glb["__dy2st_convert_and"] = convert_logical_and
+    glb["__dy2st_len"] = convert_len
+    glb["__dy2st_index"] = convert_index
+    glb["__dy2st_range_len"] = convert_range_len
+    glb["__dy2st_range_item"] = convert_range_item
     # rebind closure-free; closures are re-bound below if present
     if fn.__closure__:
         # rebuild free variables as globals snapshot (common case:
