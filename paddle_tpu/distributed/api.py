@@ -70,32 +70,38 @@ def shard_tensor(x, mesh: ProcessMesh, placements: Sequence[Placement],
     else:
         out = Tensor(val, stop_gradient=x.stop_gradient
                      if stop_gradient is None else stop_gradient)
+        if not out.stop_gradient:
+            # identity-with-layout-change: keep the autograd edge
+            from .._core.autograd import record
+            from .._core.op_registry import get_op
+            record(get_op("assign"), {}, [x], [out])
     out._dist_attr = DistAttr(mesh, placements)
     return out
 
 
 def reshard(x: Tensor, mesh: ProcessMesh,
             placements: Sequence[Placement]) -> Tensor:
-    """Convert between distributions; XLA emits the minimal collective
-    (the {r,s,p}x{r,s,p} + nd-mesh reshard matrix of the reference,
-    reshard_function_registry.cc, collapses into device_put)."""
+    """Convert between distributions via the explicit reshard function
+    registry (the {r,s,p}x{r,s,p} + nd-mesh + cross-mesh matrix of the
+    reference, reshard_function_registry.cc): each pairwise transition
+    is owned by a registered function — layout moves lower to
+    device_put (XLA emits the collective), Partial transitions carry
+    real sum semantics over stacked pending contributions."""
+    from .auto_parallel.reshard_functions import reshard_value
     cur = x._dist_attr
-    if cur is not None and any(p.is_partial() for p in cur.placements):
-        raise NotImplementedError(
-            "eager tensors never hold Partial state (XLA resolves Partial "
-            "inside compiled programs); a Partial dist_attr here indicates "
-            "a mis-annotated tensor")
-    val = x._value
-    spec = placements_to_spec(placements, mesh, x.ndim)
-    new_val = jax.device_put(val, mesh.named_sharding(spec))
-    if any(p.is_partial() for p in placements):
-        raise NotImplementedError(
-            "resharding TO a Partial placement is not supported eagerly; "
-            "Partial arises inside compiled programs where XLA manages it")
+    src_mesh = cur.process_mesh if cur is not None else mesh
+    src_placements = list(cur.placements) if cur is not None else \
+        [Replicate()] * len(placements)
+    new_val, fn = reshard_value(x._value, src_mesh, src_placements,
+                                mesh, placements)
     out = Tensor(new_val, stop_gradient=x.stop_gradient)
     out._dist_attr = DistAttr(mesh, placements)
-    if not x.stop_gradient:
-        # identity-with-layout-change: flows gradient through unchanged
+    layout_only = not any(p.is_partial() for p in src_placements) \
+        and not any(p.is_partial() for p in placements)
+    if not x.stop_gradient and layout_only:
+        # identity-with-layout-change (covers pairwise, nd-mesh and
+        # cross-mesh moves): flows gradient through unchanged. Partial
+        # transitions change shape/semantics and stay grad-opaque.
         from .._core.autograd import record
         from .._core.op_registry import get_op
         record(get_op("assign"), {}, [x], [out])

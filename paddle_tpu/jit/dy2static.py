@@ -60,19 +60,35 @@ class _Undefined:
 UNDEF = _Undefined()
 
 
+def _raw_tree(o):
+    """Unwrap Tensors inside containers (tuple returns etc.) so branch
+    outputs are jax-abstractable pytrees."""
+    return jax.tree_util.tree_map(
+        lambda v: v._value if isinstance(v, Tensor) else v, o,
+        is_leaf=lambda v: isinstance(v, Tensor))
+
+
+def _wrap_tree_out(o):
+    return jax.tree_util.tree_map(
+        lambda v: Tensor(v) if hasattr(v, "dtype") else v, o)
+
+
 def convert_ifelse(pred, true_fn: Callable, false_fn: Callable, vars_,
-                   both_assigned=None):
+                   both_assigned=None, names=None):
     """Reference convert_operators.convert_ifelse: traced predicate ->
     lax.cond over functionalized branches; Python bool -> direct call.
     ``both_assigned[i]`` (from static analysis) marks vars bound by BOTH
     branches; vars unbound before the if and bound in only one branch
     are branch-local — they are dropped from the compiled conditional's
-    outputs and stay undefined afterwards."""
+    outputs and stay undefined afterwards. ``names`` lets the output
+    coercion distinguish synthesized guard slots (__dy2st_*) from user
+    variables."""
     if not _is_traced(pred):
         return true_fn(vars_) if bool(_raw(pred)) else false_fn(vars_)
 
     n = len(vars_)
     both = both_assigned or (True,) * n
+    names = names or ("",) * n
 
     def _arrayish(v):
         # python scalars/None/containers pass through by closure so a
@@ -98,7 +114,7 @@ def convert_ifelse(pred, true_fn: Callable, false_fn: Callable, vars_,
                         "dy2static: a result of a tensor-dependent if "
                         "is bound in only one branch; both branches of "
                         "a compiled conditional must produce it")
-                res.append(_raw(o) if isinstance(o, Tensor) else o)
+                res.append(_raw_tree(o))
             return tuple(res)
         return f
 
@@ -106,50 +122,70 @@ def convert_ifelse(pred, true_fn: Callable, false_fn: Callable, vars_,
     # branch rebinds them to arrays they become cond outputs
     operands = tuple(_raw(v) for v in vars_ if _arrayish(v))
     tf, ff = _wrap(true_fn), _wrap(false_fn)
-    tf, ff = _coerce_branch_outputs(tf, ff, operands)
+    keep_names = [names[i] if i < len(names) else "" for i in keep]
+    tf, ff = _coerce_branch_outputs(tf, ff, operands, keep_names)
     outs = jax.lax.cond(_raw(pred), tf, ff, operands)
     full = [UNDEF] * n
     for i, o in zip(keep, outs):
-        full[i] = Tensor(o) if hasattr(o, "dtype") else o
+        full[i] = _wrap_tree_out(o)
     return tuple(full)
 
 
-def _coerce_branch_outputs(tf, ff, operands):
-    """lax.cond needs both branches to yield the same pytree/avals, but
-    a guard flag or return value may be bound to an array in only one
-    branch (the other keeps its Python None/scalar). Those slots are
-    GUARDED — their value in the untaken branch is never read — so the
-    weaker side is promoted to a matching array (None -> zeros, scalar
-    -> full)."""
+def _coerce_branch_outputs(tf, ff, operands, names):
+    """lax.cond needs both branches to yield the same pytree/avals.
+    SYNTHESIZED guard slots (__dy2st_ret/__dy2st_val/...) may be bound
+    to an array in only one branch — those slots are flag-guarded, their
+    value in the untaken branch is never read, so the weaker side is
+    promoted to a matching array (None -> zeros, scalar -> full). A USER
+    variable with the same mismatch is a real semantic divergence and
+    raises a clear error instead of silently changing None to zeros."""
     try:
         t_avals = jax.eval_shape(tf, operands)
         f_avals = jax.eval_shape(ff, operands)
     except Exception:
         return tf, ff  # let lax.cond produce its own diagnostics
 
-    def target(a, b):
-        # pick the array side when exactly one side is array-shaped
-        a_arr, b_arr = hasattr(a, "dtype"), hasattr(b, "dtype")
-        if a_arr and not b_arr:
+    def _arr_side(a, b):
+        # the pytree side with array leaves, when the other has none
+        a_leaves = [x for x in jax.tree_util.tree_leaves(a)
+                    if hasattr(x, "dtype")]
+        b_leaves = [x for x in jax.tree_util.tree_leaves(b)
+                    if hasattr(x, "dtype")]
+        if a_leaves and not b_leaves:
             return a
-        if b_arr and not a_arr:
+        if b_leaves and not a_leaves:
             return b
         return None
 
-    specs = [target(a, b) for a, b in zip(t_avals, f_avals)]
+    specs = [_arr_side(a, b) for a, b in zip(t_avals, f_avals)]
     if not any(s is not None for s in specs):
         return tf, ff
+    for i, spec in enumerate(specs):
+        if spec is not None and not names[i].startswith("__dy2st_"):
+            raise RuntimeError(
+                f"dy2static: variable '{names[i]}' is bound to a tensor "
+                "in only one branch of a tensor-dependent if; both "
+                "branches of a compiled conditional must bind it to "
+                "compatible values (bind a same-shaped tensor in the "
+                "other branch, or branch on a Python condition)")
 
     def fix(fn):
         def f(op_vars):
             out = list(fn(op_vars))
             for i, spec in enumerate(specs):
-                if spec is None or hasattr(out[i], "dtype"):
+                if spec is None:
+                    continue
+                has_arr = any(hasattr(x, "dtype") for x in
+                              jax.tree_util.tree_leaves(out[i]))
+                if has_arr:
                     continue
                 if out[i] is None:
-                    out[i] = jnp.zeros(spec.shape, spec.dtype)
+                    out[i] = jax.tree_util.tree_map(
+                        lambda s: jnp.zeros(s.shape, s.dtype), spec)
                 elif isinstance(out[i], (bool, int, float)):
-                    out[i] = jnp.full(spec.shape, out[i], spec.dtype)
+                    out[i] = jax.tree_util.tree_map(
+                        lambda s, v=out[i]: jnp.full(s.shape, v, s.dtype),
+                        spec)
             return tuple(out)
         return f
 
@@ -194,6 +230,17 @@ def convert_not(x):
     if isinstance(x, Tensor) or hasattr(x, "dtype"):
         return Tensor(jnp.logical_not(_raw(x)))
     return not x
+
+
+def convert_materialize(x):
+    """Iterables without len()/indexing (enumerate, zip, generators,
+    dict views) are materialized to a list so the index-based desugar
+    can drive them; sized+indexable objects and tensors pass through."""
+    if isinstance(x, Tensor) or hasattr(x, "shape"):
+        return x
+    if hasattr(x, "__len__") and hasattr(x, "__getitem__"):
+        return x
+    return list(x)
 
 
 def convert_len(x):
@@ -353,7 +400,8 @@ class _ForToWhile(ast.NodeTransformer):
             item = _call("__dy2st_range_item", _name_load(st_v),
                          _name_load(sp_v), _name_load(i_v))
         else:
-            pre += [_assign(it_v, node.iter),
+            pre += [_assign(it_v, _call("__dy2st_materialize",
+                                        node.iter)),
                     _assign(n_v, _call("__dy2st_len", _name_load(it_v)))]
             item = _call("__dy2st_index", _name_load(it_v),
                          _name_load(i_v))
@@ -568,10 +616,11 @@ def _check_supported(stmts, kind):
         v.visit(s)
     if v.found:
         raise NotImplementedError(
-            f"dy2static: '{v.found.lower()}' inside a converted {kind} "
-            "block is not supported; restructure so the block only "
-            "assigns variables (reference dy2static return-transform "
-            "not implemented)")
+            f"dy2static: '{v.found.lower()}' inside this converted "
+            f"{kind} block could not be rewritten by the return/break/"
+            "continue transformers (it sits in a nesting they do not "
+            "reach, e.g. try/with); restructure so the block only "
+            "assigns variables")
 
 
 class _ControlFlowTransformer(ast.NodeTransformer):
@@ -673,6 +722,9 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                                 ctx=ast.Load()),
                       ast.Tuple(elts=[ast.Constant(value=b)
                                       for b in both_mask],
+                                ctx=ast.Load()),
+                      ast.Tuple(elts=[ast.Constant(value=v)
+                                      for v in var_names],
                                 ctx=ast.Load())],
                 keywords=[]))
         cleanup = [] if var_names == ["__dy2st_dummy"] \
@@ -758,6 +810,7 @@ def ast_transform(fn: Callable) -> Callable:
     glb["__dy2st_not"] = convert_not
     glb["__dy2st_convert_and"] = convert_logical_and
     glb["__dy2st_len"] = convert_len
+    glb["__dy2st_materialize"] = convert_materialize
     glb["__dy2st_index"] = convert_index
     glb["__dy2st_range_len"] = convert_range_len
     glb["__dy2st_range_item"] = convert_range_item

@@ -205,6 +205,60 @@ def test_tensor_break_in_python_trip_count_loop():
     assert abs(float(out.numpy()) - 3.0) < 1e-6
 
 
+def test_for_over_enumerate_zip_dict():
+    """Review regression: non-sized iterables are materialized."""
+    x = paddle.to_tensor(np.ones((2, 2), "float32"))
+
+    def enum_fn(t):
+        s = t.sum() * 0.0
+        for i, v in enumerate([1.0, 2.0]):
+            s = s + v * float(i + 1)
+        return s
+
+    def zip_fn(t):
+        s = t.sum() * 0.0
+        for a, b in zip([1.0, 2.0], [3.0, 4.0]):
+            s = s + a * b
+        return s
+
+    assert abs(float(paddle.jit.to_static(enum_fn)(x).numpy()) - 5.0) \
+        < 1e-6
+    assert abs(float(paddle.jit.to_static(zip_fn)(x).numpy()) - 11.0) \
+        < 1e-6
+
+
+def test_tuple_return_in_tensor_branch():
+    """Review regression: container returns flow as pytrees through
+    lax.cond."""
+    x = paddle.to_tensor(np.full((2, 2), -1.0, "float32"))
+
+    def tup_fn(t):
+        if t.mean() > 0:
+            return t * 2.0, t + 1.0
+        return t, t
+
+    a, b = paddle.jit.to_static(tup_fn)(x)
+    np.testing.assert_allclose(a.numpy(), x.numpy())
+    np.testing.assert_allclose(b.numpy(), x.numpy())
+
+
+def test_user_var_single_branch_binding_raises_clearly():
+    """Review regression: a user variable bound to a tensor in only one
+    branch must error (not silently become zeros)."""
+    x = paddle.to_tensor(np.full((2, 2), -1.0, "float32"))
+
+    def bad_fn(t):
+        y = None
+        if t.mean() > 0:
+            y = t * 2.0
+        if y is None:
+            return t - 1.0
+        return y
+
+    with pytest.raises(RuntimeError, match="one branch"):
+        paddle.jit.to_static(bad_fn)(x)
+
+
 def test_python_value_guards_retrace():
     """SOT-style input guards: a python scalar arg is a compile-time
     constant; a new value retraces instead of crashing (guard.py role)."""
