@@ -237,3 +237,35 @@ def to_static(layer=None, loader=None, loss=None, optimizer=None,
                strategy=strategy)
     e.prepare()
     return e
+
+
+# ---------------------------------------------------- static partitioning
+def _engine_build_rank_programs(self, program, fetch_var,
+                                mesh: Optional[ProcessMesh] = None,
+                                seed_placements=None):
+    """The reference Engine's build path (engine.py _build ->
+    completion -> Partitioner -> passes): run the strategy program
+    passes + completion over the recorded static Program, then emit one
+    rank-local program per mesh coordinate. Returns
+    (rank_programs, workspace, dist_ctx)."""
+    from ...ir import Workspace
+    from ..passes import (DistContext, ShardingCompletionPass,
+                          build_strategy_passes)
+    from .partitioner import Partitioner
+
+    mesh = mesh or get_mesh()
+    if mesh is None:
+        raise ValueError("build_rank_programs needs a ProcessMesh")
+    ctx = DistContext(mesh)
+    for var, pl in (seed_placements or {}).items():
+        ctx.shard(var, pl)
+    ws = Workspace(program)
+    protected = frozenset([id(fetch_var)])
+    for p in build_strategy_passes(self._strategy):
+        p.run(ws, protected)
+    ShardingCompletionPass(ctx).run(ws, protected)
+    parts = Partitioner(ctx, mesh).partition_all(ws)
+    return parts, ws, ctx
+
+
+Engine.build_rank_programs = _engine_build_rank_programs

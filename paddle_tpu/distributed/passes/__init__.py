@@ -14,9 +14,11 @@ and lives here — is the planning layer that decides those annotations:
   with_sharding_constraint, i.e. the Partitioner's role collapses onto
   GSPMD (auto_parallel/static/completion.py + partitioner.py).
 
-Gradient-merge / recompute / amp rewrites live where they are real in this
-build: the compiled trainer specs (models/trainer), jax.checkpoint
-(fleet recompute), and the IR AutoMixedPrecisionPass respectively.
+The strategy program passes live at the bottom of this module:
+GradientMergePass (1/k loss rescale + k-step contract in ws.meta, the
+accumulation loop itself is Engine.fit's job), RecomputeProgramPass
+(remat segments the static Executor wraps in jax.checkpoint), and the
+IR AutoMixedPrecisionPass reused for amp.
 """
 from __future__ import annotations
 
@@ -116,3 +118,114 @@ def apply_completion(program, mesh: ProcessMesh,
 
 
 __all__ = ["DistContext", "ShardingCompletionPass", "apply_completion"]
+
+
+# ------------------------------------------------ strategy program passes
+# The reference's distributed program-pass family
+# (passes/auto_parallel_amp.py, auto_parallel_gradient_merge.py,
+# auto_parallel_recompute.py), runnable from Engine strategies through
+# Executor.run(extra_passes=...).
+
+class GradientMergePass(Pass):
+    """auto_parallel_gradient_merge.py analog: rewrite the program so
+    one micro-step contributes loss/k (avg mode), and record the
+    accumulation contract in ws.meta for the runner (which steps the
+    optimizer every k micro-batches)."""
+
+    name = "auto_parallel_gradient_merge"
+
+    def __init__(self, k_steps: int, avg: bool = True):
+        self.k = int(k_steps)
+        self.avg = bool(avg)
+
+    def run(self, ws, protected) -> bool:
+        if self.k <= 1:
+            return False
+        meta = getattr(ws, "meta", None)
+        if meta is None:
+            ws.meta = meta = {}
+        if "gradient_merge" in meta:
+            return False  # idempotent under fixpoint pass managers
+        applied = []
+        from ...static import OpNode, Variable
+        if self.avg and ws.ops:
+            # scale every protected (fetched-loss) output by 1/k, using
+            # the producer-rename idiom: the producer writes a fresh
+            # @RAW var and a scale op re-materializes the ORIGINAL
+            # variable, so no alias cycles and the fetch is untouched
+            for loss in list(protected_vars(ws, protected)):
+                if any(any(t is loss for t in n.inputs)
+                       for n in ws.ops):
+                    continue  # only a terminal loss is safe to rescale
+                raw = Variable(f"{loss.name}@RAW", loss.var_shape,
+                               loss.var_dtype, ws.program)
+                for n in ws.ops:
+                    for i, o in enumerate(n.outputs):
+                        if o is loss:
+                            n.outputs[i] = raw
+                ws.ops.append(OpNode(
+                    "scale", {"scale": 1.0 / self.k, "bias": 0.0,
+                              "bias_after_scale": True}, [raw], [loss]))
+                applied.append(loss.name)
+        # honest contract: record whether the 1/k average actually
+        # landed (a consumed loss cannot be terminally rescaled)
+        meta["gradient_merge"] = {
+            "k_steps": self.k, "avg": self.avg,
+            "avg_applied": bool(applied) if self.avg else False,
+            "scaled_losses": applied}
+        return True
+
+
+class RecomputeProgramPass(Pass):
+    """auto_parallel_recompute.py analog: segment the op stream into
+    recompute regions recorded in ws.meta["remat_segments"]; a compiled
+    runner wraps each region in jax.checkpoint so its activations are
+    rematerialized in backward instead of stashed."""
+
+    name = "auto_parallel_recompute"
+
+    def __init__(self, segments: int = 2):
+        self.segments = max(int(segments), 1)
+
+    def run(self, ws, protected) -> bool:
+        n = len(ws.ops)
+        if n == 0:
+            return False
+        meta = getattr(ws, "meta", None)
+        if meta is None:
+            ws.meta = meta = {}
+        per = max(-(-n // self.segments), 1)
+        meta["remat_segments"] = [
+            (i, min(i + per, n)) for i in range(0, n, per)]
+        return True
+
+
+def protected_vars(ws, protected):
+    from ...static import Variable
+    for node in ws.ops:
+        for var in node.outputs:
+            if id(var) in protected and isinstance(var, Variable):
+                yield var
+
+
+def build_strategy_passes(strategy, dist_ctx=None):
+    """Engine-strategy -> program-pass pipeline (the reference builds
+    the same list in engine.py _apply_pre_optimization)."""
+    passes = []
+    if getattr(strategy.amp, "enable", False):
+        from ...ir.passes import AutoMixedPrecisionPass
+        passes.append(AutoMixedPrecisionPass(
+            dtype=strategy.amp.dtype or "bfloat16"))
+    if getattr(strategy.recompute, "enable", False):
+        passes.append(RecomputeProgramPass())
+    if getattr(strategy.gradient_merge, "enable", False):
+        passes.append(GradientMergePass(
+            strategy.gradient_merge.k_steps,
+            avg=strategy.gradient_merge.get("avg", True)))
+    if dist_ctx is not None:
+        passes.append(ShardingCompletionPass(dist_ctx))
+    return passes
+
+
+__all__ += ["GradientMergePass", "RecomputeProgramPass",
+            "build_strategy_passes"]

@@ -257,14 +257,14 @@ class Executor:
                 if var.name in by_name:
                     env[id(var)] = by_name[var.name]
 
-            def value_of(t):
+            def value_of(t, e):
                 if t is None:
                     return None
                 if isinstance(t, Variable):
                     t = ws.resolve(t)   # CSE may have aliased it
                 if isinstance(t, Variable):
-                    if id(t) in env:
-                        return env[id(t)]
+                    if id(t) in e:
+                        return e[id(t)]
                     if id(t) in ws.const_env:  # folded to a constant
                         return ws.const_env[id(t)]
                     raise KeyError(f"feed missing for var '{t.name}'")
@@ -274,22 +274,56 @@ class Executor:
 
             import jax as _jax
             backend = _jax.default_backend()
-            for node in ws.ops:
-                op = get_op(node.op_name)
-                vals = [value_of(t) for t in node.inputs]
-                # variant-aware: compiled replay must run the same
-                # per-backend body eager dispatch would
-                out = op.kernel_for(backend)(*vals, **node.attrs)
-                outs = jax.tree_util.tree_leaves(
-                    out if op.multi_output else (out,))
-                for var, o in zip(node.outputs, outs):
-                    ns = ws.shardings.get(id(var))
-                    if ns is not None:
-                        # completion-pass placement: GSPMD inserts the
-                        # collectives to honor it
-                        o = jax.lax.with_sharding_constraint(o, ns)
-                    env[id(var)] = o
-            return tuple(value_of(v) for v in fetch_list)
+
+            def run_ops(nodes, e):
+                for node in nodes:
+                    op = get_op(node.op_name)
+                    vals = [value_of(t, e) for t in node.inputs]
+                    # variant-aware: compiled replay must run the same
+                    # per-backend body eager dispatch would
+                    out = op.kernel_for(backend)(*vals, **node.attrs)
+                    outs = jax.tree_util.tree_leaves(
+                        out if op.multi_output else (out,))
+                    for var, o in zip(node.outputs, outs):
+                        ns = ws.shardings.get(id(var))
+                        if ns is not None:
+                            # completion-pass placement: GSPMD inserts
+                            # the collectives to honor it
+                            o = jax.lax.with_sharding_constraint(o, ns)
+                        e[id(var)] = o
+
+            segments = getattr(ws, "meta", {}).get("remat_segments")
+            if not segments:
+                run_ops(ws.ops, env)
+            else:
+                # RecomputeProgramPass regions: each segment replays
+                # under jax.checkpoint, so its intermediate activations
+                # are rematerialized (not stashed) when this compiled
+                # callable is differentiated
+                def seg_keys(nodes, keys):
+                    out, seen = list(keys), set(keys)
+                    for node in nodes:
+                        for var in node.outputs:
+                            if id(var) not in seen:
+                                seen.add(id(var))
+                                out.append(id(var))
+                    return out
+
+                for lo, hi in segments:
+                    nodes = ws.ops[lo:hi]
+                    keys = sorted(env)
+                    out_keys = seg_keys(nodes, keys)
+
+                    def seg(vals, _nodes=nodes, _keys=keys,
+                            _out=out_keys):
+                        e = dict(zip(_keys, vals))
+                        run_ops(_nodes, e)
+                        return [e[k] for k in _out]
+
+                    seg_vals = _jax.checkpoint(seg)(
+                        [env[k] for k in keys])
+                    env = dict(zip(out_keys, seg_vals))
+            return tuple(value_of(v, env) for v in fetch_list)
 
         return replay
 
