@@ -47,6 +47,7 @@ from . import vision  # noqa: E402
 from . import amp  # noqa: E402
 from . import jit  # noqa: E402
 from . import metric  # noqa: E402
+from . import strings  # noqa: E402
 from . import framework  # noqa: E402
 from . import incubate  # noqa: E402
 from . import hapi  # noqa: E402

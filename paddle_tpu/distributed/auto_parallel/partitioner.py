@@ -188,7 +188,7 @@ class Partitioner:
 
 def run_partitioned(rank_programs: Sequence[RankProgram], ws, mesh,
                     global_feeds: Dict[str, np.ndarray],
-                    fetch_var, ctx) -> np.ndarray:
+                    fetch_var, ctx, pp_dim: str = "pp") -> np.ndarray:
     """Execute every rank's program lock-step and stitch the fetch back
     to its global value (the dryrun composition of the per-rank
     programs; host-driven analog of the reference's multi-rank Plan)."""
@@ -287,11 +287,10 @@ def run_partitioned(rank_programs: Sequence[RankProgram], ws, mesh,
                 elif op.kind == "recv":
                     # sender = same coord with pp index = op.peer's stage
                     src_coord = dict(rp.coord)
-                    pp_name = [n for n in names if n == "pp"]
-                    if pp_name:
-                        src_coord["pp"] = op.peer
+                    if pp_dim in names:
+                        src_coord[pp_dim] = op.peer
                     src = flat_rank(src_coord)
-                    key = (src, rp.coord.get("pp", 0), id(op.var))
+                    key = (src, rp.coord.get(pp_dim, 0), id(op.var))
                     if key not in mailbox:
                         break
                     env[id(op.var)] = mailbox[key]

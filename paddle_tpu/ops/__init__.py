@@ -15,6 +15,15 @@ from .extra import (angle, bincount, copysign, diff, frexp, histogram,  # noqa: 
 from . import _helper, creation, indexing, linalg, manipulation, math, \
     reduction, search  # noqa: F401
 from . import math_ext  # noqa: F401
+from . import parity  # noqa: F401  (reference-parity op batch)
+from .parity import (fused_bias_act, fused_dropout_add,  # noqa: F401
+                     fused_softmax_mask,
+                     fused_softmax_mask_upper_triangle,
+                     fused_gemm_epilogue, skip_layernorm,
+                     fused_bias_dropout_residual_layer_norm,
+                     fused_linear_param_grad_add, as_strided, view_dtype,
+                     view_slice, trans_layout, index_select_strided,
+                     fill_diagonal_tensor)
 from .math_ext import (addmm, baddbmm, cummax, cummin, i0, i0e, i1,  # noqa: F401
                        i1e, gammaln, polygamma, gammainc, gammaincc, dist,
                        cholesky_solve, svdvals, diag_embed, fill_diagonal,

@@ -85,17 +85,24 @@ def arange(start=0, end=None, step=1, dtype=None, name=None):
 
 
 def linspace(start, stop, num, dtype=None, name=None):
-    return Tensor(jnp.linspace(float(start), float(stop), int(num),
-                               dtype=_np_dtype(dtype)))
+    from .._core.executor import apply
+    return apply("linspace_k", start=float(start), stop=float(stop),
+                 num=int(num), dtype=str(jnp.dtype(_np_dtype(dtype))))
 
 
 def logspace(start, stop, num, base=10.0, dtype=None, name=None):
-    return Tensor(jnp.logspace(float(start), float(stop), int(num),
-                               base=base, dtype=_np_dtype(dtype)))
+    from .._core.executor import apply
+    return apply("logspace_k", start=float(start), stop=float(stop),
+                 num=int(num), base=float(base),
+                 dtype=str(jnp.dtype(_np_dtype(dtype))))
 
 
 def eye(num_rows, num_columns=None, dtype=None, name=None):
-    return Tensor(jnp.eye(num_rows, num_columns, dtype=_np_dtype(dtype)))
+    from .._core.executor import apply
+    return apply("eye_k", n=int(num_rows),
+                 m=int(num_columns if num_columns is not None
+                       else num_rows),
+                 dtype=str(jnp.dtype(_np_dtype(dtype))))
 
 
 def _diag_k(x, offset, padding_value):

@@ -198,20 +198,17 @@ register_op("multiply_no_broadcast", jnp.multiply)
 
 
 def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
-    from .._core.tensor import Tensor
-    return Tensor(jnp.allclose(x._value, y._value, rtol=rtol, atol=atol,
-                               equal_nan=equal_nan))
+    return apply("allclose_k", x, y, rtol=float(rtol), atol=float(atol),
+                 equal_nan=bool(equal_nan))
 
 
 def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
-    from .._core.tensor import Tensor
-    return Tensor(jnp.isclose(x._value, y._value, rtol=rtol, atol=atol,
-                              equal_nan=equal_nan))
+    return apply("isclose_k", x, y, rtol=float(rtol), atol=float(atol),
+                 equal_nan=bool(equal_nan))
 
 
 def equal_all(x, y, name=None):
-    from .._core.tensor import Tensor
-    return Tensor(jnp.array_equal(x._value, y._value))
+    return apply("equal_all_k", x, y)
 
 
 register_op("nan_to_num", lambda x, nan, posinf, neginf: jnp.nan_to_num(

@@ -247,9 +247,18 @@ kthvalue = _kthvalue
 
 
 def mode(x, axis=-1, keepdim=False, name=None):
-    v = x.numpy()
-    import scipy.stats  # available via numpy stack; fallback manual
-    raise NotImplementedError("mode is not implemented yet")
+    """Most frequent value along axis (ops.yaml mode); ties resolve to
+    the smallest value, index is the last occurrence."""
+    from .manipulation import transpose
+    nd = x.ndim
+    axis = axis % nd
+    perm = [i for i in range(nd) if i != axis] + [axis]
+    xt = transpose(x, perm) if axis != nd - 1 else x
+    values, idx = apply("mode_k", xt)
+    if keepdim:
+        values = values.unsqueeze(axis)
+        idx = idx.unsqueeze(axis)
+    return values, idx
 
 
 register_op("searchsorted_",

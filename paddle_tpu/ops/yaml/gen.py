@@ -303,7 +303,8 @@ def generate_wrappers(entries: Optional[Dict[str, OpEntry]] = None) -> str:
             params += ["name=None"]
         kwargs = ", ".join(f"{a}={a}" for a, _, _ in e.attrs)
         call = ", ".join(call_args)
-        sep = ", " if kwargs else ""
+        inner = ", ".join(p for p in (call, kwargs) if p)
+        head = f"'{e.name}', {inner}" if inner else f"'{e.name}'"
         lines.append(f"def {e.name}({', '.join(params)}):")
         lines.append(f'    """Generated from ops.yaml (op: {e.name})."""')
         for t in req_checks:
@@ -311,8 +312,7 @@ def generate_wrappers(entries: Optional[Dict[str, OpEntry]] = None) -> str:
             lines.append(f"        raise TypeError("
                          f"\"{e.name}() missing required argument: "
                          f"'{t}'\")")
-        lines += [f"    return apply('{e.name}', {call}{sep}{kwargs})",
-                  "", ""]
+        lines += [f"    return apply({head})", "", ""]
     return "\n".join(lines)
 
 

@@ -309,8 +309,10 @@ class Executor:
                                 out.append(id(var))
                     return out
 
+                covered = 0
                 for lo, hi in segments:
                     nodes = ws.ops[lo:hi]
+                    covered = max(covered, hi)
                     keys = sorted(env)
                     out_keys = seg_keys(nodes, keys)
 
@@ -323,6 +325,10 @@ class Executor:
                     seg_vals = _jax.checkpoint(seg)(
                         [env[k] for k in keys])
                     env = dict(zip(out_keys, seg_vals))
+                # ops appended AFTER the segments were computed (e.g. a
+                # later pass's scale op) still run, un-checkpointed
+                if covered < len(ws.ops):
+                    run_ops(ws.ops[covered:], env)
             return tuple(value_of(v, env) for v in fetch_list)
 
         return replay

@@ -1,0 +1,43 @@
+"""paddle.strings-style ops over StringTensor (strings_ops.yaml analog:
+empty / empty_like / lower / upper).
+
+String payloads are host-side numpy object arrays (XLA has no string
+dtype — same reason the reference keeps strings kernels on CPU), so
+these run eagerly on the StringTensor container from
+framework/tensor_types rather than through the jit dispatch registry.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .framework.tensor_types import StringTensor
+
+
+def _data(x):
+    if isinstance(x, StringTensor):
+        return x.numpy() if hasattr(x, "numpy") else np.asarray(x._data)
+    return np.asarray(x, dtype=object)
+
+
+def empty(shape, name=None) -> StringTensor:
+    """strings_ops.yaml empty: a StringTensor of empty strings."""
+    arr = np.full(tuple(int(s) for s in shape), "", dtype=object)
+    return StringTensor(arr)
+
+
+def empty_like(x, name=None) -> StringTensor:
+    return empty(_data(x).shape)
+
+
+def lower(x, use_utf8_encoding=True, name=None) -> StringTensor:
+    """strings_ops.yaml lower (delegates to StringTensor._map)."""
+    if not isinstance(x, StringTensor):
+        x = StringTensor(_data(x))
+    return x._map(lambda s: s.lower())
+
+
+def upper(x, use_utf8_encoding=True, name=None) -> StringTensor:
+    """strings_ops.yaml upper (delegates to StringTensor._map)."""
+    if not isinstance(x, StringTensor):
+        x = StringTensor(_data(x))
+    return x._map(lambda s: s.upper())

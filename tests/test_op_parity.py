@@ -1,0 +1,302 @@
+"""Reference-parity op batch tests (fused / strided-view / creation /
+metric / decoding families, VERDICT r3 missing #10) through the OpTest
+harness: forward vs NumPy + analytic-vs-numerical grads."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.ops import parity as P
+from op_test import check_grad, check_output
+
+R = np.random.RandomState(0)
+
+
+def _r(*shape):
+    return R.randn(*shape).astype("float32")
+
+
+# ------------------------------------------------------------- fused ops
+def test_fused_bias_act():
+    x, b = _r(4, 8), _r(8)
+    from scipy.special import erf  # noqa: F401  # not used; numpy gelu below
+
+    def ref(x, b, act_method="gelu"):
+        h = x + b
+        return 0.5 * h * (1.0 + np.tanh(
+            np.sqrt(2.0 / np.pi) * (h + 0.044715 * h ** 3)))
+
+    check_output(P.fused_bias_act, [x, b], {"act_method": "gelu"}, ref,
+                 rtol=2e-3, atol=2e-3)
+    check_grad(P.fused_bias_act, [x, b], {"act_method": "relu"})
+
+
+def test_fused_softmax_mask_and_triu():
+    x = _r(2, 3, 4, 4)
+    mask = (R.rand(2, 1, 4, 4) > 0.5).astype("float32") * -1e9
+
+    def ref(x, mask):
+        e = np.exp(x + mask - (x + mask).max(-1, keepdims=True))
+        return e / e.sum(-1, keepdims=True)
+
+    check_output(P.fused_softmax_mask, [x, mask], None, ref)
+
+    def ref_triu(x):
+        t = x.shape[-1]
+        m = np.where(np.arange(t)[None, :] <= np.arange(t)[:, None],
+                     0.0, -1e9)
+        return ref(x, m)
+
+    check_output(P.fused_softmax_mask_upper_triangle, [x], None, ref_triu)
+    check_grad(P.fused_softmax_mask_upper_triangle, [x])
+
+
+def test_fused_gemm_epilogue_and_skip_layernorm():
+    x, y, b = _r(4, 6), _r(6, 8), _r(8)
+
+    def ref(x, y, b, activation="relu"):
+        return np.maximum(x @ y + b, 0.0)
+
+    check_output(P.fused_gemm_epilogue, [x, y, b],
+                 {"activation": "relu"}, ref, rtol=1e-4)
+    check_grad(P.fused_gemm_epilogue, [x, y, b], {"activation": "none"})
+
+    s, w, bb = _r(4, 8), _r(8), _r(8)
+
+    def ref_ln(x, s, w, bb, epsilon=1e-5):
+        h = x + s
+        mu = h.mean(-1, keepdims=True)
+        var = h.var(-1, keepdims=True)
+        return (h - mu) / np.sqrt(var + epsilon) * w + bb
+
+    check_output(P.skip_layernorm, [_r(4, 8), s, w, bb], None, ref_ln,
+                 rtol=1e-4, atol=1e-4)
+
+
+def test_fused_linear_param_grad_add_accumulates():
+    x, dout = _r(5, 3), _r(5, 7)
+    dw0, db0 = _r(3, 7), _r(7)
+    dw, db = P.fused_linear_param_grad_add(
+        paddle.to_tensor(x), paddle.to_tensor(dout),
+        paddle.to_tensor(dw0), paddle.to_tensor(db0))
+    np.testing.assert_allclose(dw.numpy(), dw0 + x.T @ dout, rtol=1e-4)
+    np.testing.assert_allclose(db.numpy(), db0 + dout.sum(0), rtol=1e-4)
+
+
+def test_fused_dropout_add_eval_and_train():
+    x, y = _r(64, 64), _r(64, 64)
+    out = P.fused_dropout_add(paddle.to_tensor(x), paddle.to_tensor(y),
+                              p=0.5, training=False)
+    np.testing.assert_allclose(out.numpy(), x + y, rtol=1e-6)
+    out = P.fused_dropout_add(paddle.to_tensor(x), paddle.to_tensor(y),
+                              p=0.5, training=True)
+    kept = np.asarray(out.numpy()) - y
+    frac = float((np.abs(kept) > 1e-7).mean())
+    assert 0.3 < frac < 0.7  # ~half survive
+
+
+# ------------------------------------------------------- strided / view
+def test_as_strided_matches_numpy():
+    x = _r(4, 6)
+
+    def ref(x, shape=(3, 2), stride=(6, 2), offset=1):
+        return np.lib.stride_tricks.as_strided(
+            x.reshape(-1)[offset:], shape, [s * 4 for s in stride]).copy()
+
+    check_output(P.as_strided, [x],
+                 {"shape": (3, 2), "stride": (6, 2), "offset": 1}, ref)
+
+
+def test_view_dtype_roundtrip_and_slice():
+    x = _r(4, 8)
+    v = P.view_dtype(paddle.to_tensor(x), "int32")
+    assert str(v.numpy().dtype) == "int32"
+    back = P.view_dtype(v, "float32")
+    np.testing.assert_array_equal(back.numpy(), x)
+
+    out = P.view_slice(paddle.to_tensor(x), [1, 2], [3, 7])
+    np.testing.assert_array_equal(out.numpy(), x[1:3, 2:7])
+
+
+def test_trans_layout_and_index_select_strided():
+    x = _r(2, 3, 4)
+    out = P.trans_layout(paddle.to_tensor(x), [0, 2, 1])
+    np.testing.assert_array_equal(out.numpy(), x.transpose(0, 2, 1))
+    idx = np.array([2, 0], "int32")
+    out = P.index_select_strided(paddle.to_tensor(x),
+                                 paddle.to_tensor(idx), axis=1)
+    np.testing.assert_array_equal(out.numpy(), x[:, [2, 0]])
+
+
+def test_fill_diagonal_tensor():
+    x = _r(4, 4)
+    y = np.arange(4, dtype="float32")
+    out = P.fill_diagonal_tensor(paddle.to_tensor(x),
+                                 paddle.to_tensor(y))
+    want = x.copy()
+    np.fill_diagonal(want, y)
+    np.testing.assert_array_equal(out.numpy(), want)
+
+
+# ------------------------------------------- creation / compare rewires
+def test_creation_ops_via_registry():
+    np.testing.assert_array_equal(paddle.eye(3).numpy(), np.eye(3))
+    np.testing.assert_allclose(
+        paddle.linspace(0.0, 1.0, 5).numpy(), np.linspace(0, 1, 5))
+    np.testing.assert_allclose(
+        paddle.logspace(0.0, 2.0, 3).numpy(), np.logspace(0, 2, 3),
+        rtol=1e-5)
+    # these now record into static programs (the registry payoff)
+    import paddle_tpu.static as static
+    paddle.enable_static()
+    try:
+        prog = static.Program()
+        with static.program_guard(prog):
+            e = paddle.eye(4)
+        assert prog.ops and prog.ops[-1].op_name == "eye_k"
+    finally:
+        paddle.disable_static()
+
+
+def test_compare_ops_via_registry():
+    x = _r(3, 3)
+    assert bool(paddle.allclose(paddle.to_tensor(x),
+                                paddle.to_tensor(x.copy())).numpy())
+    assert bool(paddle.equal_all(paddle.to_tensor(x),
+                                 paddle.to_tensor(x.copy())).numpy())
+    got = paddle.isclose(paddle.to_tensor(x),
+                         paddle.to_tensor(x + 1e-9)).numpy()
+    assert got.all()
+
+
+def test_mode_real_implementation():
+    x = np.array([[1., 3., 3., 2.], [5., 5., 4., 4.]], "float32")
+    values, idx = paddle.mode(paddle.to_tensor(x))
+    np.testing.assert_array_equal(values.numpy(), [3.0, 4.0])
+    # index points at an occurrence of the mode in the original tensor
+    for r in range(2):
+        assert x[r, int(idx.numpy()[r])] == values.numpy()[r]
+
+
+# ----------------------------------------------- sequence / misc / moe
+def test_sequence_mask_and_shard_index():
+    lens = np.array([2, 0, 3], "int32")
+    out = P.sequence_mask(paddle.to_tensor(lens), maxlen=4)
+    want = np.array([[1, 1, 0, 0], [0, 0, 0, 0], [1, 1, 1, 0]], "int64")
+    np.testing.assert_array_equal(out.numpy(), want)
+
+    ids = np.array([0, 5, 9, 13], "int64")
+    out = P.shard_index(paddle.to_tensor(ids), index_num=16, nshards=2,
+                        shard_id=1)
+    np.testing.assert_array_equal(out.numpy(), [-1, -1, 1, 5])
+
+
+def test_label_smooth_and_gumbel_softmax():
+    x = np.eye(4, dtype="float32")
+    out = P.label_smooth(paddle.to_tensor(x), epsilon=0.1)
+    np.testing.assert_allclose(out.numpy(),
+                               x * 0.9 + 0.1 / 4, rtol=1e-6)
+    logits = _r(6, 5)
+    y = P.gumbel_softmax(paddle.to_tensor(logits), hard=True)
+    arr = y.numpy()
+    np.testing.assert_allclose(arr.sum(-1), np.ones(6), rtol=1e-5)
+    assert ((arr == arr.max(-1, keepdims=True)).sum(-1) == 1).all()
+
+
+def test_moe_aux_ops():
+    ids = paddle.to_tensor(np.array([0, 1, 1, 2, 1], "int64"))
+    cnt = P.number_count(ids, upper_range=4)
+    np.testing.assert_array_equal(cnt.numpy(), [1, 3, 1, 0])
+
+    gate = paddle.to_tensor(np.array([0, 1, 1, 1, 2], "int64"))
+    cap = paddle.to_tensor(np.array([1, 2, 1], "int64"))
+    pruned = P.prune_gate_by_capacity(gate, cap, n_expert=3)
+    np.testing.assert_array_equal(pruned.numpy(), [0, 1, 1, -1, 2])
+
+
+def test_partial_sum_concat_shuffle_channel():
+    a, b = _r(3, 6), _r(3, 6)
+    out = P.partial_sum([paddle.to_tensor(a), paddle.to_tensor(b)],
+                        start_index=1, length=3)
+    np.testing.assert_allclose(out.numpy(), a[:, 1:4] + b[:, 1:4],
+                               rtol=1e-6)
+    out = P.partial_concat([paddle.to_tensor(a), paddle.to_tensor(b)],
+                           start_index=0, length=2)
+    np.testing.assert_allclose(
+        out.numpy(), np.concatenate([a[:, :2], b[:, :2]], -1), rtol=1e-6)
+
+    x = _r(2, 4, 3, 3)
+    out = P.shuffle_channel(paddle.to_tensor(x), group=2)
+    want = x.reshape(2, 2, 2, 3, 3).transpose(0, 2, 1, 3, 4).reshape(
+        2, 4, 3, 3)
+    np.testing.assert_array_equal(out.numpy(), want)
+
+
+def test_interp_variants():
+    x = _r(1, 2, 4, 4)
+    out = P.bilinear_interp(paddle.to_tensor(x), (8, 8))
+    assert out.shape == [1, 2, 8, 8]
+    # nearest upsample 2x == pixel repetition
+    out = P.nearest_interp(paddle.to_tensor(x), (8, 8))
+    np.testing.assert_allclose(
+        out.numpy(), x.repeat(2, axis=2).repeat(2, axis=3), rtol=1e-6)
+
+
+def test_metric_ops():
+    topk = paddle.to_tensor(np.array([[0, 2], [1, 3], [2, 0]], "int64"))
+    label = paddle.to_tensor(np.array([2, 0, 1], "int64"))
+    acc = P.accuracy_op(topk, label)
+    np.testing.assert_allclose(float(acc.numpy()), 1.0 / 3.0, rtol=1e-6)
+
+    pred = paddle.to_tensor(np.array(
+        [[0.9, 0.1], [0.3, 0.7], [0.6, 0.4], [0.2, 0.8]], "float32"))
+    label = paddle.to_tensor(np.array([[0], [1], [0], [1]], "int64"))
+    auc = float(P.auc_op(pred, label).numpy())
+    assert auc == pytest.approx(1.0, abs=0.02)  # perfectly separable
+
+
+def test_edit_distance_and_viterbi():
+    hyp = paddle.to_tensor(np.array([[1, 2, 3, 0]], "int64"))
+    ref = paddle.to_tensor(np.array([[1, 3, 3, 4]], "int64"))
+    hl = paddle.to_tensor(np.array([3], "int32"))
+    rl = paddle.to_tensor(np.array([4], "int32"))
+    d = P.edit_distance(hyp, ref, hl, rl)
+    # "123" vs "1334": substitute 2->3, insert 4 => 2
+    np.testing.assert_allclose(d.numpy(), [2.0])
+
+    pots = paddle.to_tensor(np.array(
+        [[[1.0, 0.0], [0.0, 2.0], [3.0, 0.0]]], "float32"))
+    trans = paddle.to_tensor(np.zeros((2, 2), "float32"))
+    lens = paddle.to_tensor(np.array([3], "int64"))
+    score, path = P.viterbi_decode(pots, trans, lens)
+    np.testing.assert_array_equal(path.numpy(), [[0, 1, 0]])
+    np.testing.assert_allclose(score.numpy(), [6.0])
+
+
+def test_gru_unit_shapes_and_range():
+    x, h = _r(3, 4), _r(3, 5)
+    wu, wr, wc = _r(9, 5), _r(9, 5), _r(9, 5)
+    out = P.gru_unit(*[paddle.to_tensor(v) for v in (x, h, wu, wr, wc)])
+    assert out.shape == [3, 5]
+    check_grad(P.gru_unit, [x, h, wu, wr, wc])
+
+
+def test_box_ops():
+    boxes = paddle.to_tensor(np.array(
+        [[-5.0, 2.0, 30.0, 40.0]], "float32"))
+    im = paddle.to_tensor(np.array([20.0, 25.0, 1.0], "float32"))
+    out = P.box_clip(boxes, im)
+    np.testing.assert_array_equal(out.numpy(), [[0.0, 2.0, 24.0, 19.0]])
+
+
+# ------------------------------------------------------------- strings
+def test_strings_namespace():
+    from paddle_tpu import strings
+    st = strings.empty([2, 2])
+    assert st.shape == [2, 2] and st.numpy()[0, 0] == ""
+    lo = strings.lower(np.array([["AbC", "DE"]], dtype=object))
+    np.testing.assert_array_equal(lo.numpy(),
+                                  np.array([["abc", "de"]], object))
+    up = strings.upper(lo)
+    np.testing.assert_array_equal(up.numpy(),
+                                  np.array([["ABC", "DE"]], object))
+    assert strings.empty_like(up).shape == [1, 2]

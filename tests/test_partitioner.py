@@ -144,6 +144,26 @@ def test_executor_honors_remat_segments():
     np.testing.assert_allclose(got, plain, rtol=1e-6)
 
 
+def test_executor_runs_ops_appended_after_remat_segments():
+    """Review regression: GradientMergePass appends its scale op after
+    RecomputeProgramPass computed its segments — the tail op must still
+    run."""
+    from paddle_tpu.distributed.passes import (GradientMergePass,
+                                               RecomputeProgramPass)
+    prog, x, params, out = _record_mlp()
+    feed = _feed()
+    plain = _global_reference(prog, out, feed)
+    paddle.enable_static()
+    try:
+        exe = static.Executor()
+        got = exe.run(prog, feed=feed, fetch_list=[out],
+                      extra_passes=[RecomputeProgramPass(segments=2),
+                                    GradientMergePass(4)])[0]
+    finally:
+        paddle.disable_static()
+    np.testing.assert_allclose(got, plain / 4.0, rtol=1e-6)
+
+
 def test_gradient_merge_honest_meta_when_loss_consumed():
     """If the fetched value feeds another op, the 1/k rescale cannot be
     applied terminally and the meta must say so."""
