@@ -48,23 +48,40 @@ def get_flags(flags: Union[str, Iterable[str]]) -> Dict[str, Any]:
     with _LOCK:
         out = {}
         for name in flags:
-            if name not in _REGISTRY:
+            key = _resolve(name)
+            if key not in _REGISTRY:
                 raise ValueError(f"unknown flag: {name}")
-            out[name] = _REGISTRY[name].value
+            out[name] = _REGISTRY[key].value
         return out
 
 
 def set_flags(flags: Dict[str, Any]) -> None:
     with _LOCK:
         for name, value in flags.items():
-            if name not in _REGISTRY:
+            key = _resolve(name)
+            if key not in _REGISTRY:
                 raise ValueError(f"unknown flag: {name}")
-            flag = _REGISTRY[name]
+            flag = _REGISTRY[key]
             flag.value = _parse(value, flag.type) if isinstance(value, str) and flag.type is not str else flag.type(value)
 
 
+# reference-name aliases: the subset of the reference's ~187 PHI flags
+# (paddle/common/flags.cc) with a live TPU-native equivalent maps here
+# so get/set accept the reference spelling. Flags whose job is absorbed
+# by XLA/PJRT (allocator fractions, cudnn autotune, stream pools) have
+# no entry — silently accepting them would be cosmetic.
+_ALIASES = {
+    "FLAGS_fuse_parameter_memory_size": "FLAGS_fuse_buffer_size_mb",
+    "FLAGS_pg_timeout": "FLAGS_comm_task_timeout_s",
+}
+
+
+def _resolve(name: str) -> str:
+    return _ALIASES.get(name, name)
+
+
 def flag_value(name: str):
-    return _REGISTRY[name].value
+    return _REGISTRY[_resolve(name)].value
 
 
 # Core flags (analogs of the reference's most-used ones).
@@ -81,3 +98,40 @@ define_flag("FLAGS_tpu_matmul_precision", "default",
 define_flag("FLAGS_benchmark", False, "Block on every eager op (for timing).")
 define_flag("FLAGS_apply_ir_passes", True,
             "run the IR pass pipeline when compiling static Programs")
+
+# ---- distributed runtime knobs (each read by a live consumer)
+define_flag("FLAGS_fuse_buffer_size_mb", 25,
+            "DataParallel gradient-fusion bucket size in MB "
+            "(reducer comm_buffer_size default).")
+define_flag("FLAGS_comm_task_timeout_s", 1800.0,
+            "CommTaskManager watchdog timeout per collective (the "
+            "reference's FLAGS_pg_timeout role).")
+define_flag("FLAGS_comm_idle_poll_limit", 10,
+            "Native collective engine: consecutive 60s zero-progress "
+            "polls before a transfer is declared dead.")
+define_flag("FLAGS_tcp_store_timeout_s", 300.0,
+            "TCPStore client connect/get timeout in seconds.")
+define_flag("FLAGS_launch_max_restarts", 0,
+            "Launcher: restarts-with-rerank before giving up "
+            "(elastic manager behavior).")
+
+# ---- compile / memory knobs
+define_flag("FLAGS_recompute_segments", 2,
+            "Default segment count for the recompute program pass "
+            "(jax.checkpoint regions).")
+define_flag("FLAGS_amp_dtype", "bfloat16",
+            "Default auto-cast dtype for amp O1/O2 (bf16 is the TPU "
+            "tensor-core dtype the way fp16 is CUDA's).")
+define_flag("FLAGS_flash_block_q", 512,
+            "Pallas flash-attention max query block size.")
+define_flag("FLAGS_flash_block_k", 512,
+            "Pallas flash-attention max key block size.")
+
+# ---- io / misc
+define_flag("FLAGS_dataloader_num_workers", 0,
+            "Default DataLoader worker count when not passed.")
+define_flag("FLAGS_profiler_dir", "",
+            "Directory for chrome-trace exports ('' = cwd).")
+
+
+

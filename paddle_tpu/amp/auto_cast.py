@@ -80,8 +80,11 @@ class auto_cast:
     """Context manager: `with paddle.amp.auto_cast(level='O1'):`"""
 
     def __init__(self, enable=True, custom_white_list=None,
-                 custom_black_list=None, level="O1", dtype="bfloat16",
+                 custom_black_list=None, level="O1", dtype=None,
                  use_promote=True):
+        if dtype is None:
+            from .._core.flags import flag_value
+            dtype = flag_value("FLAGS_amp_dtype")
         if dtype == "float16":
             dtype = "float16"
         self.enable = enable

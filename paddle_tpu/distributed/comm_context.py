@@ -33,6 +33,12 @@ class CommContext:
     """One mesh of sockets for one (group, instance)."""
 
     def __init__(self, store, rank: int, world: int, key: str):
+        import os
+        from .._core.flags import flag_value
+        # the native engine reads its stall bound from the env at first
+        # transfer; export the flag so set_flags reaches C++
+        os.environ.setdefault("PT_COMM_IDLE_POLL_LIMIT",
+                              str(flag_value("FLAGS_comm_idle_poll_limit")))
         self._lib = native.get_lib(required=True)
         self._h = self._lib.ptcc_create(rank, world)
         if not self._h:

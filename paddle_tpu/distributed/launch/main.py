@@ -42,8 +42,10 @@ def _parse_args(argv=None):
                         "runtime drives all local chips from one process")
     p.add_argument("--log_dir", type=str, default="log")
     p.add_argument("--job_id", type=str, default="default")
+    from ..._core.flags import flag_value
     p.add_argument("--max_restarts", type=int, default=int(
-        os.environ.get("PADDLE_ELASTIC_FAULT_TOLERANC_LEVEL", 0)) or 0,
+        os.environ.get("PADDLE_ELASTIC_FAULT_TOLERANC_LEVEL",
+                       flag_value("FLAGS_launch_max_restarts"))) or 0,
         help="relaunch the pod up to N times on worker failure "
              "(elastic manager restart behavior)")
     p.add_argument("script", type=str)

@@ -24,7 +24,7 @@ from .parallel_env import get_default_process_group, get_world_size
 
 
 class DataParallel(Layer):
-    def __init__(self, layers: Layer, strategy=None, comm_buffer_size=25,
+    def __init__(self, layers: Layer, strategy=None, comm_buffer_size=None,
                  last_comm_buffer_size=1, find_unused_parameters=False,
                  group=None):
         super().__init__()
@@ -36,7 +36,11 @@ class DataParallel(Layer):
         self._nranks = group.nranks if group is not None \
             else get_world_size()
         self._grad_sync_enabled = True
-        # bucket size in MB (comm_buffer_size, parallel.py:219 default)
+        # bucket size in MB (comm_buffer_size, parallel.py:219 default;
+        # FLAGS_fuse_buffer_size_mb when not passed)
+        if comm_buffer_size is None:
+            from .._core.flags import flag_value
+            comm_buffer_size = flag_value("FLAGS_fuse_buffer_size_mb")
         self._bucket_bytes = int(comm_buffer_size) * 1024 * 1024
         self._unregister = None
         self._synced_grad_ids = {}

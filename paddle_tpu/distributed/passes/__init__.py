@@ -184,7 +184,10 @@ class RecomputeProgramPass(Pass):
 
     name = "auto_parallel_recompute"
 
-    def __init__(self, segments: int = 2):
+    def __init__(self, segments: int = None):
+        if segments is None:
+            from ..._core.flags import flag_value
+            segments = flag_value("FLAGS_recompute_segments")
         self.segments = max(int(segments), 1)
 
     def run(self, ws, protected) -> bool:

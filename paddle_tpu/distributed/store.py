@@ -17,7 +17,10 @@ from .._core import native
 class TCPStore:
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  is_master: bool = False, world_size: int = 1,
-                 timeout: float = 300.0):
+                 timeout: float = None):
+        if timeout is None:
+            from .._core.flags import flag_value
+            timeout = float(flag_value("FLAGS_tcp_store_timeout_s"))
         self._lib = native.get_lib(required=True)
         self._server = None
         self._timeout_ms = int(timeout * 1000)

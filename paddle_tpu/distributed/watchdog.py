@@ -49,7 +49,11 @@ class CommTaskManager:
             f"without a heartbeat; host stacks:\n{_dump_stacks()}\n")
 
     # ------------------------------------------------------------- tasks
-    def register(self, name: str, timeout: float = 1800.0) -> CommTask:
+    def register(self, name: str, timeout: float = None) -> CommTask:
+
+        if timeout is None:
+            from .._core.flags import flag_value
+            timeout = float(flag_value("FLAGS_comm_task_timeout_s"))
         with self._lock:
             t = CommTask(name, timeout)
             self._tasks[name] = t

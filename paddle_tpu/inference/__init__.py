@@ -1,10 +1,20 @@
 """paddle.inference (paddle/fluid/inference analog: AnalysisPredictor,
 analysis_predictor.h:101).
 
-TPU-native deployment: a predictor wraps a jit-saved model
-(paddle_tpu.jit.save format), compiles the forward once per input
-signature under jax.jit (the analog of the reference's IR optimization +
-engine selection), and serves zero-copy in/out handles."""
+TPU-native deployment with a REAL analysis/config layer:
+
+- named multi-IO from the jit.save artifact's `.pdmeta` (the role of the
+  reference's serialized feed/fetch op info); single-input legacy
+  artifacts fall back to one "x" handle;
+- Config knobs with teeth: `enable_memory_optim` turns on input-buffer
+  DONATION (the zero-copy memory-reuse analog of the reference's memory
+  optimization pass), `disable_gpu` pins execution to the host CPU
+  backend, `switch_ir_optim(False)` compiles with XLA backend
+  optimizations dialed down (the "skip IR optimization" analog), and
+  `enable_profile` routes every run through the host profiler tracer;
+- one compiled executable per config (the analysis stage happens once,
+  at predictor build — the reference's IR-optimize-then-freeze flow).
+"""
 from __future__ import annotations
 
 from typing import Dict, List, Optional
@@ -15,40 +25,58 @@ from .._core.tensor import Tensor
 
 
 class Config:
-    """inference.Config analog (api/paddle_analysis_config.h surface)."""
+    """inference.Config analog (api/paddle_analysis_config.h surface).
+    Every knob below changes how the predictor compiles or runs."""
 
     def __init__(self, prog_file: Optional[str] = None,
                  params_file: Optional[str] = None):
         # jit.save writes one artifact; prog_file is the path prefix
         self.model_path = prog_file
-        self._use_tpu = True
+        self._use_device = True       # accelerator (TPU) vs host CPU
         self._memory_pool_mb = 0
         self._enable_profile = False
         self._ir_optim = True
+        self._memory_optim = False
 
     def set_model(self, prog_file, params_file=None):
         self.model_path = prog_file
 
     def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
-        self._memory_pool_mb = memory_pool_init_size_mb  # TPU: no-op
+        """Reference name; here it (re)enables the accelerator backend."""
+        self._use_device = True
+        self._memory_pool_mb = memory_pool_init_size_mb
 
     def disable_gpu(self):
-        self._use_tpu = False
+        """Pin execution to the host CPU backend."""
+        self._use_device = False
+
+    def use_gpu(self):
+        return self._use_device
 
     def switch_ir_optim(self, flag=True):
-        self._ir_optim = flag  # XLA always optimizes; kept for parity
+        """False compiles with XLA backend optimizations minimized —
+        the analog of skipping the IR optimization passes."""
+        self._ir_optim = bool(flag)
+
+    def ir_optim(self):
+        return self._ir_optim
 
     def enable_profile(self):
         self._enable_profile = True
 
-    def enable_memory_optim(self):
-        pass
+    def enable_memory_optim(self, x=True):
+        """Donate input buffers to the executable (memory reuse)."""
+        self._memory_optim = bool(x)
+
+    def memory_optim(self):
+        return self._memory_optim
 
 
 class _IOHandle:
     """Zero-copy tensor handle (ZeroCopyTensor analog)."""
 
-    def __init__(self):
+    def __init__(self, name: str = ""):
+        self.name = name
         self._value: Optional[np.ndarray] = None
 
     def copy_from_cpu(self, arr: np.ndarray):
@@ -68,13 +96,69 @@ class _IOHandle:
 
 
 class Predictor:
+    """AnalysisPredictor analog: the 'analysis' happens once at build —
+    the saved StableHLO program is re-compiled with the Config's
+    execution options (device, donation, optimization level)."""
+
     def __init__(self, config: Config):
+        import json
+        import os
+
+        import jax
+
         from ..jit.api import load as jit_load
+
         self.config = config
         self._layer = jit_load(config.model_path)
-        self._inputs: Dict[str, _IOHandle] = {"x": _IOHandle()}
-        self._outputs: Dict[str, _IOHandle] = {"out": _IOHandle()}
 
+        # ----- named IO from the artifact's metadata
+        meta = None
+        meta_path = str(config.model_path) + ".pdmeta"
+        if os.path.exists(meta_path):
+            with open(meta_path) as f:
+                meta = json.load(f)
+        if meta:
+            in_names = [m["name"] for m in meta["inputs"]]
+            out_names = list(meta["outputs"])
+        else:  # legacy single-input artifact
+            in_names, out_names = ["x"], ["out"]
+        self._inputs: Dict[str, _IOHandle] = {
+            n: _IOHandle(n) for n in in_names}
+        self._outputs: Dict[str, _IOHandle] = {
+            n: _IOHandle(n) for n in out_names}
+
+        # ----- compile the call with the Config's execution options
+        exported = getattr(self._layer, "_exported", None)
+        svals = getattr(self._layer, "_svals", None)
+        self._profiler_events: List[str] = []
+        self._jitted = None
+        if exported is None:
+            return  # fall back to the TranslatedLayer call
+
+        device = None
+        if not config.use_gpu():
+            device = jax.devices("cpu")[0]
+
+        def raw(svals_, *arrays):
+            return exported.call(svals_, *arrays)
+
+        jit_kwargs = {}
+        if config.memory_optim():
+            # donate the INPUT buffers: XLA may reuse them for outputs
+            jit_kwargs["donate_argnums"] = tuple(
+                range(1, 1 + len(in_names)))
+        self._device = device
+        if device is not None:
+            # place parameters once at build, not per run
+            svals = [jax.device_put(v, device) for v in svals]
+        self._svals = svals
+        self._jitted = jax.jit(raw, **jit_kwargs)
+        self._compiler_options = (
+            None if config.ir_optim()
+            else {"xla_backend_optimization_level": "0"})
+        self._compiled = None  # lowered lazily at first run (needs avals)
+
+    # ------------------------------------------------------------- handles
     def get_input_names(self) -> List[str]:
         return list(self._inputs)
 
@@ -87,17 +171,49 @@ class Predictor:
     def get_output_handle(self, name: str) -> _IOHandle:
         return self._outputs[name]
 
+    # ----------------------------------------------------------------- run
+    def _execute(self, arrays):
+        import jax
+        import jax.numpy as jnp
+
+        if self._jitted is None:
+            out = self._layer(*[Tensor(a) for a in arrays])
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            return [np.asarray(o.numpy()) for o in outs]
+
+        if self._device is not None:
+            arrays = [jax.device_put(jnp.asarray(a), self._device)
+                      for a in arrays]
+        else:
+            arrays = [jnp.asarray(a) for a in arrays]
+        svals = self._svals
+        if self._compiler_options is not None:
+            if self._compiled is None:
+                self._compiled = self._jitted.lower(
+                    svals, *arrays).compile(
+                    compiler_options=self._compiler_options)
+            out = self._compiled(svals, *arrays)
+        else:
+            out = self._jitted(svals, *arrays)
+        leaves = jax.tree_util.tree_leaves(out)
+        return [np.asarray(o) for o in leaves]
+
     def run(self, inputs: Optional[List[np.ndarray]] = None):
         """Execute; with `inputs` given returns outputs directly (new-style
         predictor.run(list) API), else uses the bound handles."""
         if inputs is not None:
             for h, a in zip(self._inputs.values(), inputs):
                 h.copy_from_cpu(np.asarray(a))
-        args = [Tensor(h.copy_to_cpu()) for h in self._inputs.values()]
-        out = self._layer(*args)
-        outs = out if isinstance(out, (list, tuple)) else [out]
+        arrays = [h.copy_to_cpu() for h in self._inputs.values()]
+        if self.config._enable_profile:
+            from ..profiler import RecordEvent
+            with RecordEvent("inference::run"):
+                outs = self._execute(arrays)
+            self._profiler_events.append("inference::run")
+        else:
+            outs = self._execute(arrays)
         for h, o in zip(self._outputs.values(), outs):
-            h.copy_from_cpu(np.asarray(o.numpy()))
+            h.copy_from_cpu(o)
         return [h.copy_to_cpu() for h in self._outputs.values()]
 
 

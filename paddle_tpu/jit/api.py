@@ -439,6 +439,21 @@ def save(layer, path, input_spec=None, **configs):
     with open(path + ".pdmodel", "wb") as f:
         f.write(exported.serialize())
 
+    # IO metadata for the inference AnalysisPredictor (named multi-IO,
+    # the role of the reference's serialized feed/fetch op info)
+    import json as _json
+    in_meta = []
+    for i, spec in enumerate(input_spec):
+        nm = getattr(spec, "name", None) or f"x{i}"
+        shp = [(-1 if not isinstance(d, int) else int(d))
+               for d in getattr(spec, "shape", examples[i].shape)]
+        dt = str(jnp.dtype(getattr(spec, "dtype", examples[i].dtype)))
+        in_meta.append({"name": nm, "shape": shp, "dtype": dt})
+    n_out = len(jax.tree_util.tree_leaves(exported.out_avals))
+    with open(path + ".pdmeta", "w") as f:
+        _json.dump({"inputs": in_meta,
+                    "outputs": [f"out{i}" for i in range(n_out)]}, f)
+
 
 def load(path, **configs):
     """paddle.jit.load analog: deserialize the StableHLO program + params
@@ -478,6 +493,11 @@ def load(path, **configs):
         return _wrap_tree(out)
 
     layer = TranslatedLayer(np_state, forward_fn)
+    # expose the compiled artifact so the inference AnalysisPredictor
+    # can rebuild the call with its own execution options (donation,
+    # device, compiler options)
+    object.__setattr__(layer, "_exported", exported)
+    object.__setattr__(layer, "_svals", svals)
     if container is not None:
         # np_state holds zero-copy views into the container's mmap: the
         # container must outlive every retained view (else munmap ->

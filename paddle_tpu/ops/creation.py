@@ -203,9 +203,14 @@ standard_normal = randn
 
 
 def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    from .._core.executor import apply
+    from .manipulation import cast
     key = rnd.next_key() if not seed else jax.random.PRNGKey(seed)
-    return Tensor(jax.random.uniform(key, tuple(shape), _np_dtype(dtype),
-                                     minval=min, maxval=max))
+    out = apply("uniform_k", Tensor(key), shape=tuple(int(s) for s in shape),
+                lo=float(min), hi=float(max))
+    dt = _np_dtype(dtype)
+    return cast(out, str(np.dtype(dt))) if np.dtype(dt) != np.float32 \
+        else out
 
 
 def normal(mean=0.0, std=1.0, shape=None, name=None):
@@ -222,10 +227,14 @@ def normal(mean=0.0, std=1.0, shape=None, name=None):
 
 
 def randint(low=0, high=None, shape=(1,), dtype=None, name=None):
+    from .._core.executor import apply
+    from .manipulation import cast
     if high is None:
         low, high = 0, low
-    return Tensor(jax.random.randint(rnd.next_key(), tuple(shape), low, high,
-                                     dtype=_np_dtype(dtype, "int64")))
+    out = apply("randint_k", Tensor(rnd.next_key()), low=int(low),
+                high=int(high), shape=tuple(int(s) for s in shape))
+    dt = np.dtype(_np_dtype(dtype, "int64"))
+    return cast(out, str(dt)) if dt != np.int64 else out
 
 
 def randint_like(x, low=0, high=None, dtype=None, name=None):
@@ -234,23 +243,21 @@ def randint_like(x, low=0, high=None, dtype=None, name=None):
 
 
 def randperm(n, dtype="int64", name=None):
-    return Tensor(jax.random.permutation(rnd.next_key(), n)
-                  .astype(_np_dtype(dtype, "int64")))
+    from .._core.executor import apply
+    from .manipulation import cast
+    out = apply("randperm_k", Tensor(rnd.next_key()), n=int(n))
+    dt = np.dtype(_np_dtype(dtype, "int64"))
+    return cast(out, str(dt)) if dt != np.int64 else out
 
 
 def bernoulli(x, name=None):
-    return Tensor(jax.random.bernoulli(
-        rnd.next_key(), x._value).astype(x._value.dtype))
+    from .._core.executor import apply
+    return apply("bernoulli_k", x, Tensor(rnd.next_key()))
 
 
 def multinomial(x, num_samples=1, replacement=False, name=None):
-    probs = x._value
-    logits = jnp.log(jnp.maximum(probs, 1e-30))
-    if replacement:
-        out = jax.random.categorical(rnd.next_key(), logits,
-                                     shape=probs.shape[:-1] + (num_samples,))
-    else:
-        # Gumbel top-k trick for sampling without replacement.
-        g = jax.random.gumbel(rnd.next_key(), probs.shape)
-        _, out = jax.lax.top_k(logits + g, num_samples)
-    return Tensor(out.astype(jnp.int64))
+    from .._core.executor import apply
+    from .manipulation import cast
+    out = apply("multinomial_k", x, Tensor(rnd.next_key()),
+                num=int(num_samples), replacement=bool(replacement))
+    return cast(out, "int64")

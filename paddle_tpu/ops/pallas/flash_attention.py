@@ -37,8 +37,11 @@ def _no_x64():
 
 
 def _block_sizes(sq: int, sk: int, d: int):
-    bq = min(512, sq) if sq % 512 == 0 else min(128, sq)
-    bk = min(512, sk) if sk % 512 == 0 else min(128, sk)
+    from ..._core.flags import flag_value
+    cap_q = int(flag_value("FLAGS_flash_block_q"))
+    cap_k = int(flag_value("FLAGS_flash_block_k"))
+    bq = min(cap_q, sq) if sq % cap_q == 0 else min(128, sq)
+    bk = min(cap_k, sk) if sk % cap_k == 0 else min(128, sk)
     if sq % bq:
         bq = sq  # small/ragged: single block (wrapper pads first)
     if sk % bk:

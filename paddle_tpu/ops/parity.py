@@ -35,18 +35,26 @@ def fused_bias_act(x, bias, act_method="gelu", name=None):
     return apply("fused_bias_act", x, bias, act=str(act_method))
 
 
-register_op("fused_dropout_add",
-            lambda x, y, key, p, training:
-            jnp.where(jax.random.bernoulli(key, 1.0 - p, x.shape),
-                      x / (1.0 - p), 0.0) + y
-            if training and p > 0.0 else x + y)
+def _fused_dropout_add(x, y, key, p, training, mode):
+    if training and p > 0.0:
+        keep = jax.random.bernoulli(key, 1.0 - p, x.shape)
+        if mode == "upscale_in_train":
+            return jnp.where(keep, x / (1.0 - p), 0.0) + y
+        return jnp.where(keep, x, 0.0) + y      # downscale_in_infer
+    if not training and mode == "downscale_in_infer" and p > 0.0:
+        return x * (1.0 - p) + y
+    return x + y
+
+
+register_op("fused_dropout_add", _fused_dropout_add)
 
 
 def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",
                       name=None):
-    """fused_ops.yaml fused_dropout_add: dropout(x) + y in one kernel."""
+    """fused_ops.yaml fused_dropout_add: dropout(x) + y in one kernel
+    (both dropout_impl modes honored)."""
     return apply("fused_dropout_add", x, y, Tensor(rnd.next_key()),
-                 p=float(p), training=bool(training))
+                 p=float(p), training=bool(training), mode=str(mode))
 
 
 def _softmax_mask(x, mask):
@@ -207,8 +215,24 @@ def as_strided(x, shape, stride, offset=0, name=None):
                  offset=int(offset))
 
 
-register_op("view_dtype", lambda x, dtype: lax.bitcast_convert_type(
-    x, jnp.dtype(dtype)))
+def _view_dtype(x, dtype):
+    dt = jnp.dtype(dtype)
+    src, dst = x.dtype.itemsize, dt.itemsize
+    if src == dst:
+        return lax.bitcast_convert_type(x, dt)
+    if src > dst:      # narrowing: last dim grows by src//dst
+        out = lax.bitcast_convert_type(x, dt)   # [..., last, src//dst]
+        return out.reshape(x.shape[:-1] + (x.shape[-1] * (src // dst),))
+    k = dst // src     # widening: last dim must divide by k
+    if x.shape[-1] % k:
+        raise ValueError(
+            f"view_dtype: last dim {x.shape[-1]} not divisible by "
+            f"{k} for {x.dtype} -> {dt}")
+    grouped = x.reshape(x.shape[:-1] + (x.shape[-1] // k, k))
+    return lax.bitcast_convert_type(grouped, dt)
+
+
+register_op("view_dtype", _view_dtype)
 
 
 def view_dtype(x, dtype, name=None):
@@ -593,12 +617,21 @@ register_op("gaussian_k",
 register_op("bernoulli_k",
             lambda x, key: jax.random.bernoulli(key, x)
             .astype(x.dtype))
-register_op("multinomial_k",
-            lambda x, key, num, replacement: jax.random.categorical(
-                key, jnp.log(jnp.clip(x, 1e-30)), shape=(num,)
-                + x.shape[:-1]).T if x.ndim > 1 else
-            jax.random.categorical(
-                key, jnp.log(jnp.clip(x, 1e-30)), shape=(num,)))
+def _multinomial(x, key, num, replacement):
+    logits = jnp.log(jnp.clip(x, 1e-30))
+    if replacement:
+        if x.ndim > 1:
+            return jax.random.categorical(
+                key, logits, shape=(num,) + x.shape[:-1]).T
+        return jax.random.categorical(key, logits, shape=(num,))
+    # without replacement: Gumbel top-k (exact for categorical w/o repl)
+    g = -jnp.log(-jnp.log(jax.random.uniform(
+        key, x.shape, minval=1e-20, maxval=1.0)))
+    _, idx = lax.top_k(logits + g, num)
+    return idx
+
+
+register_op("multinomial_k", _multinomial)
 
 
 # ----------------------------------------------------- metric op family
@@ -683,19 +716,30 @@ def edit_distance(hyps, refs, hyps_len, refs_len, normalized=False,
 
 
 def _viterbi(potentials, trans, lengths):
-    # scores [B, T, N], trans [N, N] -> best path [B, T] + score
+    # scores [B, T, N], trans [N, N] -> best path [B, T] + score.
+    # Steps at t >= lengths[b] leave sample b's score untouched and
+    # record identity backpointers, so ragged batches decode correctly
+    # (padded path tail repeats the final tag).
     b, t, n = potentials.shape
+    if lengths is None:
+        lengths = jnp.full((b,), t, jnp.int32)
 
-    def step(carry, emit):
+    def step(carry, inp):
         score = carry                      # [B, N]
+        emit, tstep = inp
         cand = score[:, :, None] + trans[None]   # [B, N, N]
         best = jnp.max(cand, axis=1) + emit
         back = jnp.argmax(cand, axis=1)
-        return best, back
+        active = (tstep < lengths)[:, None]
+        ident = jnp.broadcast_to(jnp.arange(n)[None, :], (b, n))
+        return (jnp.where(active, best, score),
+                jnp.where(active, back, ident))
 
     score0 = potentials[:, 0]
-    score, backs = lax.scan(step, score0,
-                            jnp.moveaxis(potentials[:, 1:], 1, 0))
+    score, backs = lax.scan(
+        step, score0,
+        (jnp.moveaxis(potentials[:, 1:], 1, 0),
+         jnp.arange(1, t)))
     last = jnp.argmax(score, axis=-1)
 
     def walk(carry, back):

@@ -102,9 +102,12 @@ def _default_scheduler(step: int) -> ProfilerState:
     return ProfilerState.RECORD
 
 
-def export_chrome_tracing(dir_name: str, worker_name: str = None):
+def export_chrome_tracing(dir_name: str = None, worker_name: str = None):
     """on_trace_ready factory writing chrome trace json (reference
     chrometracing_logger.cc output shape)."""
+    if dir_name is None:
+        from .._core.flags import flag_value
+        dir_name = flag_value("FLAGS_profiler_dir") or "."
     os.makedirs(dir_name, exist_ok=True)
 
     def handler(prof: "Profiler"):
