@@ -27,7 +27,8 @@ from .sharding import (group_sharded_parallel,  # noqa: F401
                        DygraphShardingStage3)
 from .pipeline import (PipelineLayer, PipelineParallel, LayerDesc,  # noqa: F401
                        SharedLayerDesc, PipelineParallelWithInterleave,
-                       DistPipelineRuntime)
+                       DistPipelineRuntime, DistPipelineRuntimeVPP,
+                       DistPipelineRuntimeZB, build_pipeline_runtime)
 from . import pipeline_compiled  # noqa: F401
 from .pipeline_compiled import (spmd_pipeline, pipelined_trunk,  # noqa: F401
                                 FThenB, OneFOneB, VPP, ZeroBubble)

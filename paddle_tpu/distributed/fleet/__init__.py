@@ -113,8 +113,24 @@ def distributed_model(model):
     from ..pipeline import PipelineParallel
     from ...nn.layer import Layer
     if mode == "pipeline":
-        from ..pipeline import PipelineLayer
+        from ..parallel_env import get_world_size, is_initialized
+        from ..pipeline import PipelineLayer, build_pipeline_runtime
         if isinstance(model, PipelineLayer):
+            if is_initialized() and get_world_size() > 1:
+                # host-driven multi-process: this rank keeps its stage
+                # and the strategy's schedule_mode picks the runtime
+                # (FThenB / 1F1B / VPP / ZeroBubble — the
+                # pipeline_scheduler_pass role)
+                from ...nn.layers_common import Sequential
+                cfg = _strategy.pipeline_configs if _strategy else {}
+                stage_id = hcg.get_stage_id()
+                stage = Sequential(*model.stage_layers(stage_id))
+                group = hcg.get_pipe_parallel_group()
+                return build_pipeline_runtime(
+                    stage, group, model._loss_fn,
+                    cfg.get("accumulate_steps", 1) if cfg else 1,
+                    schedule=cfg.get("schedule_mode", "1F1B")
+                    if cfg else "1F1B")
             return PipelineParallel(model, hcg, _strategy)
         return model
     if mode == "data_parallel":

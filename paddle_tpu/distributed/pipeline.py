@@ -615,3 +615,38 @@ class DistPipelineRuntimeZB(_HostPipeBase):
                         p.grad = Tensor(p.grad._value + dp)
         self.pg.barrier()
         return sum(losses) if self.is_last else None
+
+
+def build_pipeline_runtime(stage_layers, group, loss_fn,
+                           num_microbatches, schedule="1F1B"):
+    """Schedule-mode dispatch for the host-driven runtimes (the
+    pipeline_scheduler_pass role: FThenB / 1F1B / VPP / ZeroBubble by
+    strategy.pipeline_configs['schedule_mode']).
+
+    ``stage_layers``: ONE Layer (this rank's stage) for FThenB/1F1B/
+    ZeroBubble, or a LIST of chunk Layers for VPP.
+    """
+    mode = str(schedule)
+    if mode not in ("VPP", "Interleave", "interleave") \
+            and isinstance(stage_layers, (list, tuple)):
+        raise ValueError(
+            f"schedule_mode '{schedule}' takes ONE stage Layer per "
+            "rank; a chunk list is only valid for VPP")
+    if mode in ("FThenB", "F-then-B"):
+        return DistPipelineRuntime(stage_layers, group, loss_fn,
+                                   num_microbatches, schedule="FThenB")
+    if mode == "1F1B":
+        return DistPipelineRuntime(stage_layers, group, loss_fn,
+                                   num_microbatches, schedule="1F1B")
+    if mode in ("VPP", "Interleave", "interleave"):
+        if not isinstance(stage_layers, (list, tuple)):
+            raise ValueError(
+                "VPP needs a list of model-chunk Layers per rank "
+                "(virtual stage v = chunk*P + rank)")
+        return DistPipelineRuntimeVPP(list(stage_layers), group, loss_fn,
+                                      num_microbatches)
+    if mode in ("ZeroBubble", "ZBH1", "ZB"):
+        return DistPipelineRuntimeZB(stage_layers, group, loss_fn,
+                                     num_microbatches)
+    raise ValueError(f"unknown pipeline schedule_mode '{schedule}' "
+                     "(FThenB | 1F1B | VPP | ZeroBubble)")

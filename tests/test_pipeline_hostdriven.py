@@ -61,7 +61,7 @@ def _worker():
     import paddle_tpu.nn as nn
     import paddle_tpu.nn.functional as F
     import paddle_tpu.distributed as dist
-    from paddle_tpu.distributed.pipeline import DistPipelineRuntime
+    from paddle_tpu.distributed.pipeline import build_pipeline_runtime
 
     dist.init_parallel_env()
     paddle.seed(7)
@@ -80,7 +80,7 @@ def _worker():
 
     stage = Stage(s0 if rank == 0 else s1)
     group = dist.new_group(list(range(WORLD)))
-    runtime = DistPipelineRuntime(
+    runtime = build_pipeline_runtime(
         stage, group, loss_fn=F.mse_loss, num_microbatches=M,
         schedule=schedule)
 
@@ -195,7 +195,7 @@ def _worker_vpp():
     import paddle_tpu.nn as nn
     import paddle_tpu.nn.functional as F
     import paddle_tpu.distributed as dist
-    from paddle_tpu.distributed.pipeline import DistPipelineRuntimeVPP
+    from paddle_tpu.distributed.pipeline import build_pipeline_runtime
 
     dist.init_parallel_env()
     paddle.seed(7)
@@ -212,8 +212,9 @@ def _worker_vpp():
     # vstage v = chunk*P + rank: rank0 owns lins[0],lins[2]
     chunks = [Stage(lins[rank]), Stage(lins[rank + WORLD])]
     group = dist.new_group(list(range(WORLD)))
-    runtime = DistPipelineRuntimeVPP(
-        chunks, group, loss_fn=F.mse_loss, num_microbatches=M)
+    runtime = build_pipeline_runtime(
+        chunks, group, loss_fn=F.mse_loss, num_microbatches=M,
+        schedule="VPP")
 
     x, y = _make_inputs()
     micro_x = [paddle.to_tensor(x[i * MB:(i + 1) * MB]) for i in range(M)]
@@ -239,7 +240,7 @@ def _worker_zb():
     import paddle_tpu.nn as nn
     import paddle_tpu.nn.functional as F
     import paddle_tpu.distributed as dist
-    from paddle_tpu.distributed.pipeline import DistPipelineRuntimeZB
+    from paddle_tpu.distributed.pipeline import build_pipeline_runtime
 
     dist.init_parallel_env()
     paddle.seed(7)
@@ -256,8 +257,9 @@ def _worker_zb():
 
     stage = Stage(s0 if rank == 0 else s1)
     group = dist.new_group(list(range(WORLD)))
-    runtime = DistPipelineRuntimeZB(
-        stage, group, loss_fn=F.mse_loss, num_microbatches=M)
+    runtime = build_pipeline_runtime(
+        stage, group, loss_fn=F.mse_loss, num_microbatches=M,
+        schedule="ZeroBubble")
 
     x, y = _make_inputs()
     micro_x = [paddle.to_tensor(x[i * MB:(i + 1) * MB]) for i in range(M)]
@@ -309,11 +311,64 @@ def test_zero_bubble_matches_reference_and_defers_weight_grads():
     assert sorted(i for k, i in ex if k == "W") == list(range(M))
 
 
+def _worker_facade():
+    """fleet.distributed_model wires PipelineLayer -> schedule runtime."""
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed.fleet as fleet
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.distributed.pipeline import (DistPipelineRuntimeZB,
+                                                 PipelineLayer)
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1,
+                               "pp_degree": WORLD, "sharding_degree": 1}
+    strategy.pipeline_configs = {"accumulate_steps": M,
+                                 "micro_batch_size": MB,
+                                 "schedule_mode": "ZeroBubble"}
+    fleet.init(is_collective=True, strategy=strategy)
+    paddle.seed(7)
+    layers = PipelineLayer([nn.Linear(DIM, DIM), nn.Linear(DIM, DIM)],
+                           num_stages=WORLD, loss_fn=F.mse_loss)
+    runtime = fleet.distributed_model(layers)
+    assert isinstance(runtime, DistPipelineRuntimeZB), type(runtime)
+    x, y = _make_inputs()
+    micro_x = [paddle.to_tensor(x[i * MB:(i + 1) * MB]) for i in range(M)]
+    micro_y = [paddle.to_tensor(y[i * MB:(i + 1) * MB]) for i in range(M)]
+    loss = runtime.train_batch(micro_inputs=micro_x, micro_labels=micro_y)
+    print("PIPE-REPORT:" + json.dumps({"rank": rank, "loss": loss}),
+          flush=True)
+
+
+def test_fleet_facade_builds_schedule_runtime():
+    """strategy.pipeline_configs['schedule_mode'] really reaches the
+    host-driven runtime through fleet.distributed_model."""
+    reports = _launch("FACADE")
+    losses = [r["loss"] for r in reports.values() if r["loss"] is not None]
+    assert len(losses) == 1 and losses[0] > 0.0
+
+
 if __name__ == "__main__" and os.environ.get("PT_PP_WORKER") == "1":
     sched = os.environ["PT_PP_SCHEDULE"]
     if sched == "VPP":
         _worker_vpp()
     elif sched == "ZB":
         _worker_zb()
+    elif sched == "FACADE":
+        _worker_facade()
     else:
         _worker()
+
+
+def test_schedule_mode_factory_dispatch():
+    """strategy.pipeline_configs['schedule_mode'] reaches the runtimes
+    through build_pipeline_runtime (pipeline_scheduler_pass role)."""
+    import pytest
+    from paddle_tpu.distributed.pipeline import build_pipeline_runtime
+    with pytest.raises(ValueError, match="list of model-chunk"):
+        build_pipeline_runtime(object(), None, None, 4, schedule="VPP")
+    with pytest.raises(ValueError, match="unknown pipeline"):
+        build_pipeline_runtime(object(), None, None, 4, schedule="nope")
