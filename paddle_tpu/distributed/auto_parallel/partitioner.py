@@ -296,7 +296,8 @@ def run_partitioned(rank_programs: Sequence[RankProgram], ws, mesh,
                     env[id(op.var)] = mailbox[key]
                 ptrs[r] += 1
                 progress = True
-    stuck = [r for r in ptrs if ptrs[r] < len(rank_programs[r].ops)]
+    prog_of = {flat_rank(rp.coord): rp for rp in rank_programs}
+    stuck = [r for r in ptrs if ptrs[r] < len(prog_of[r].ops)]
     if stuck:
         raise RuntimeError(f"composed run deadlocked at {stuck}")
 

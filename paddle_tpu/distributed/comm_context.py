@@ -24,6 +24,27 @@ _DTYPE_CODE = {"float32": 0, "float64": 1, "int32": 2, "int64": 3,
 _OP_CODE = {"sum": 0, "max": 1, "min": 2, "prod": 3, "avg": 0}
 
 
+# the last value THIS module wrote to the env; any other value found
+# there was pinned by the operator and wins over the flag
+_LAST_EXPORTED_POLL_LIMIT = None
+
+
+def _export_poll_limit():
+    """The native engine reads its stall bound from the env at first
+    transfer. Re-export the flag on EVERY engine construction so
+    set_flags calls made at any point before building an engine take
+    effect; a PT_COMM_IDLE_POLL_LIMIT value the operator set themselves
+    (detected as: present and not what we last exported) wins."""
+    global _LAST_EXPORTED_POLL_LIMIT
+    from .._core.flags import flag_value
+    cur = os.environ.get("PT_COMM_IDLE_POLL_LIMIT")
+    if cur is not None and cur != _LAST_EXPORTED_POLL_LIMIT:
+        return
+    val = str(flag_value("FLAGS_comm_idle_poll_limit"))
+    os.environ["PT_COMM_IDLE_POLL_LIMIT"] = val
+    _LAST_EXPORTED_POLL_LIMIT = val
+
+
 def _advertised_host() -> str:
     return os.environ.get("PADDLE_LOCAL_IP",
                           os.environ.get("POD_IP", "127.0.0.1"))
@@ -33,12 +54,7 @@ class CommContext:
     """One mesh of sockets for one (group, instance)."""
 
     def __init__(self, store, rank: int, world: int, key: str):
-        import os
-        from .._core.flags import flag_value
-        # the native engine reads its stall bound from the env at first
-        # transfer; export the flag so set_flags reaches C++
-        os.environ.setdefault("PT_COMM_IDLE_POLL_LIMIT",
-                              str(flag_value("FLAGS_comm_idle_poll_limit")))
+        _export_poll_limit()
         self._lib = native.get_lib(required=True)
         self._h = self._lib.ptcc_create(rank, world)
         if not self._h:

@@ -16,9 +16,6 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ...nn.layer import Layer
-
-
 def vocab_parallel_softmax_cross_entropy(hidden, vocab_weight, labels,
                                          mesh: Mesh, axis: str = "mp"):
     """Per-token loss [B, S] from hidden [B, S, H] (mp-replicated) and a
@@ -60,32 +57,9 @@ def vocab_parallel_softmax_cross_entropy(hidden, vocab_weight, labels,
                          check_vma=False)(hidden, vocab_weight, labels)
 
 
-class ParallelCrossEntropy(Layer):
-    """mpu.ParallelCrossEntropy surface: consumes vocab-PARALLEL logits
-    (eager Tensors already sharded over the model-parallel group) or, on
-    the single-controller path, a (hidden, weight) pair via
-    vocab_parallel_softmax_cross_entropy. Reference:
-    fleet/layers/mpu/mp_layers.py ParallelCrossEntropy."""
-
-    def __init__(self, mp_group=None, name=None, ignore_index=-100):
-        super().__init__()
-        self.group = mp_group
-        self.ignore_index = ignore_index
-
-    def forward(self, input, label):
-        from ..._core.tensor import Tensor
-        logits = input._value.astype(jnp.float32)
-        logp = jax.nn.log_softmax(logits, -1)
-        lbl = label._value
-        if lbl.ndim == logits.ndim:
-            lbl = lbl[..., 0]
-        picked = jnp.take_along_axis(
-            logp, lbl[..., None].astype(jnp.int32), axis=-1)[..., 0]
-        loss = -picked
-        if self.ignore_index >= 0:
-            loss = jnp.where(lbl == self.ignore_index, 0.0, loss)
-        return Tensor(loss[..., None], stop_gradient=input.stop_gradient)
-
+# The ParallelCrossEntropy layer lives in mp_layers.py (exported via
+# fleet); it delegates to mp_softmax_cross_entropy below for the eager
+# multi-process regime and to GSPMD cross_entropy otherwise.
 
 # ===================== eager multi-process collective primitives ========
 # The host-driven forms of the reference's mpu collectives

@@ -660,7 +660,9 @@ def _flashmask_body(q, k, v, startend, scale, causal):
     if idx.shape[-1] > 1:
         en = idx[..., 1].reshape(b * h, sk)
     else:
-        en = jnp.full_like(st, sk_pad + 1)
+        # open-ended ban: use int32 max, not sk_pad + 1, so query rows
+        # beyond the key length (sq > sk) are still inside the interval
+        en = jnp.full_like(st, jnp.iinfo(jnp.int32).max)
     # padded key columns: banned everywhere via kv_len; padded query rows
     # produce zeros (l == 0) and are sliced off
     st = _pad_to(st, sk_pad, 1)[..., None]

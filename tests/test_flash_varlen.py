@@ -159,3 +159,35 @@ def test_functional_surface_uses_pallas():
     np.testing.assert_allclose(np.asarray(out.numpy()),
                                np.asarray(dense.numpy()),
                                rtol=2e-4, atol=2e-4)
+
+
+def test_flashmask_start_only_rectangular_sq_gt_sk():
+    """Regression: start-only ('infinite end') bans must cover query rows
+    beyond the key length. With sq > sk, an end sentinel of sk_pad + 1
+    would let rows q_pos > sk_pad escape the ban; the sentinel is now
+    int32 max. Oracle computed densely in-test (the dense reference path
+    assumes square S)."""
+    r = np.random.RandomState(11)
+    b, h, sq, sk, d = 1, 1, 12, 4, 8
+    q = r.randn(b, sq, h, d).astype("float32") * 0.5
+    k = r.randn(b, sk, h, d).astype("float32") * 0.5
+    v = r.randn(b, sk, h, d).astype("float32") * 0.5
+    # every key col banned from row 2 on, except col 0 (always visible,
+    # so no query row is fully banned)
+    start = np.full((b, h, sk, 1), 2, "int32")
+    start[:, :, 0, :] = sq + 1
+
+    from paddle_tpu.ops.pallas.flash_varlen import flashmask_attention_pallas
+    out = flashmask_attention_pallas(
+        _t(q), _t(k), _t(v), paddle.to_tensor(start), causal=False)
+
+    scale = 1.0 / np.sqrt(d)
+    logits = np.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    q_pos = np.arange(sq)[None, None, :, None]
+    ban = q_pos >= start[:, :, None, :, 0]  # open-ended interval
+    logits = np.where(ban, -np.inf, logits)
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    ref = np.einsum("bhqk,bkhd->bqhd", p, v)
+    np.testing.assert_allclose(np.asarray(out.numpy()), ref,
+                               rtol=3e-4, atol=3e-4)

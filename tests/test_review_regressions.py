@@ -157,3 +157,40 @@ def test_jit_save_params_not_pickle():
         x = paddle.to_tensor(np.ones((3, 4), "float32"))
         np.testing.assert_allclose(loaded(x).numpy(), net(x).numpy(),
                                    rtol=1e-5)
+
+
+def test_comm_poll_limit_flag_reexported_per_engine():
+    """set_flags after importing comm_context must still reach the native
+    engine: the env export happens per engine construction, not once at
+    import (r4 advisor finding)."""
+    import os
+    import paddle_tpu.distributed.comm_context as cc
+    from paddle_tpu._core.flags import set_flags, flag_value
+
+    old = flag_value("FLAGS_comm_idle_poll_limit")
+    saved_env = os.environ.pop("PT_COMM_IDLE_POLL_LIMIT", None)
+    saved_last = cc._LAST_EXPORTED_POLL_LIMIT
+    cc._LAST_EXPORTED_POLL_LIMIT = None
+    try:
+        set_flags({"FLAGS_comm_idle_poll_limit": 3})
+        cc._export_poll_limit()
+        assert os.environ["PT_COMM_IDLE_POLL_LIMIT"] == "3"
+        set_flags({"FLAGS_comm_idle_poll_limit": 7})
+        cc._export_poll_limit()
+        assert os.environ["PT_COMM_IDLE_POLL_LIMIT"] == "7"
+        # an env var the operator pinned (even after import) wins
+        os.environ["PT_COMM_IDLE_POLL_LIMIT"] = "42"
+        set_flags({"FLAGS_comm_idle_poll_limit": 9})
+        cc._export_poll_limit()
+        assert os.environ["PT_COMM_IDLE_POLL_LIMIT"] == "42"
+        # deleting the pinned value hands control back to the flag
+        del os.environ["PT_COMM_IDLE_POLL_LIMIT"]
+        cc._export_poll_limit()
+        assert os.environ["PT_COMM_IDLE_POLL_LIMIT"] == "9"
+    finally:
+        cc._LAST_EXPORTED_POLL_LIMIT = saved_last
+        set_flags({"FLAGS_comm_idle_poll_limit": old})
+        if saved_env is None:
+            os.environ.pop("PT_COMM_IDLE_POLL_LIMIT", None)
+        else:
+            os.environ["PT_COMM_IDLE_POLL_LIMIT"] = saved_env
