@@ -290,6 +290,11 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
 def _engine_run(tensors, grad_tensors, targets, retain_graph=False):
     from .tensor import Tensor  # local import to avoid cycle
 
+    # a pending lazy capture must land before the walk: the fused
+    # segment GradNodes are only wired in at flush
+    from . import lazy
+    lazy.flush_active("backward")
+
     tensors = tensors if isinstance(tensors, (list, tuple)) else [tensors]
     if grad_tensors is None:
         grad_tensors = [None] * len(tensors)

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
+from . import lazy
 from .autograd import is_grad_enabled, record
 from .dispatch import eager_forward
 from .op_registry import get_op
@@ -33,6 +34,19 @@ def apply(op_name: str, *inputs, **attrs):
     if _static_recorder is not None:
         return _static_recorder(op_name, ts, attrs)
     ts = _maybe_amp_cast(op_name, ts)
+    ctx = lazy.current_context()
+    if ctx is not None:
+        try:
+            outs = ctx.record(op, ts, attrs)
+        except Exception:
+            # un-capturable op (data-dependent shapes, host-side body):
+            # graph break — run what's pending, then this op eagerly
+            ctx.flush("record_fallback:" + op_name)
+        else:
+            # cap-flush OUTSIDE the handler: a segment that fails to
+            # compile/run must raise, not be mistaken for a bad op
+            ctx.maybe_cap_flush()
+            return outs if op.multi_output else outs[0]
     vals = tuple(t._value if t is not None else None for t in ts)
     if _profile_cb is not None:
         with _profile_cb(op_name):

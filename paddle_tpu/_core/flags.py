@@ -115,6 +115,10 @@ define_flag("FLAGS_launch_max_restarts", 0,
             "Launcher: restarts-with-rerank before giving up "
             "(elastic manager behavior).")
 
+define_flag("FLAGS_lazy_max_segment_ops", 256,
+            "Lazy fusion window: pending ops per segment before a forced "
+            "flush (caps XLA program size and peak trace memory).")
+
 # ---- compile / memory knobs
 define_flag("FLAGS_recompute_segments", 2,
             "Default segment count for the recompute program pass "

@@ -1,0 +1,506 @@
+"""Lazy op-capture engine: the eager fusion window + SOT graph builder.
+
+Two reference roles land here, rebuilt the XLA way:
+
+- the *fusion buffer / lazy trace window* the reference gets from CUDA
+  stream asynchrony (per-op kernels queue on a stream; the host runs
+  ahead): under `lazy_guard()` eager ops are RECORDED instead of
+  dispatched one executable at a time, and a whole pending segment runs
+  as ONE jitted XLA program the first time any concrete value is needed.
+  This removes per-op dispatch latency and lets XLA fuse across op
+  boundaries (SURVEY §7 hard part #1).
+- the *FunctionGraph* under SOT-style bytecode capture
+  (python/paddle/jit/sot/symbolic/symbolic_context.py role): jit/sot's
+  OpcodeExecutor runs user bytecode under this context; every framework
+  op joins the graph, and any graph break (print, .numpy(), a
+  data-dependent branch) is just a flush — the remaining trace resumes
+  into a new segment automatically.
+
+Materialization triggers: reading `Tensor._value` (property), exiting
+the guard, `backward()`, or the segment hitting
+FLAGS_lazy_max_segment_ops. Shape/dtype/ndim metadata reads answer from
+the recorded aval WITHOUT materializing.
+
+Compiled segments are cached by a structural signature (op names, attrs,
+wiring, input avals), so steady-state replays cost one cache lookup and
+one XLA execution per segment.
+"""
+from __future__ import annotations
+
+import functools
+import weakref
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from . import dispatch
+from .op_registry import OpDef
+
+_SEG_CACHE: Dict[Tuple, Any] = {}
+_AVAL_CACHE: Dict[Tuple, Tuple] = {}
+
+
+class LazyRef:
+    """Placeholder payload for one output of one pending op."""
+
+    _is_lazy_ref = True
+    __slots__ = ("ctx", "op_idx", "slot", "aval", "requires_grad",
+                 "trefs", "__weakref__")
+
+    def __init__(self, ctx, op_idx, slot, aval, requires_grad):
+        self.ctx = ctx
+        self.op_idx = op_idx
+        self.slot = slot
+        self.aval = aval              # jax.ShapeDtypeStruct
+        self.requires_grad = requires_grad
+        self.trefs: List = []         # weakrefs to Tensors aliasing this
+
+    def add_tref(self, tensor):
+        self.trefs.append(weakref.ref(tensor))
+
+    def materialize(self):
+        self.ctx.flush()
+
+
+class _PendingOp:
+    __slots__ = ("op", "attrs", "wiring", "out_refs", "n_outs")
+
+    def __init__(self, op, attrs, wiring, out_refs):
+        self.op = op
+        self.attrs = attrs
+        self.wiring = wiring          # per input: ("in", i) | ("op", j, s) | None
+        self.out_refs = out_refs      # list[LazyRef]
+        self.n_outs = len(out_refs)
+
+
+def _aval_of(x):
+    # weak_type MUST survive: python scalars are weak (x64 mode makes
+    # them f64-weak) and weak+f32 promotes to f32, not f64
+    return jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                weak_type=getattr(x, "weak_type", False))
+
+
+def _out_avals(op: OpDef, attrs, in_avals):
+    from .dispatch import attrs_key
+    backend = jax.default_backend()
+    key = (op.name, backend, attrs_key(attrs),
+           tuple((tuple(a.shape), str(a.dtype), a.weak_type)
+                 if a is not None else None for a in in_avals))
+    hit = _AVAL_CACHE.get(key)
+    if hit is None:
+        fn = functools.partial(op.kernel_for(backend), **attrs)
+        out = jax.eval_shape(fn, *in_avals)
+        outs = out if op.multi_output else (out,)
+        hit = tuple(jax.tree_util.tree_leaves(outs))
+        if len(hit) != len(outs):
+            # nested outputs: treat as un-capturable
+            raise TypeError(f"op {op.name} has nested outputs")
+        _AVAL_CACHE[key] = hit
+    return hit
+
+
+class CaptureContext:
+    """One lazy trace. Ops recorded since the last flush form the current
+    segment; flush() compiles + runs it as one XLA executable."""
+
+    def __init__(self, max_segment_ops: Optional[int] = None):
+        from . import flags
+        self.pending: List[_PendingOp] = []
+        # graph inputs of the CURRENT segment: id(tensor) -> index
+        self._in_ids: Dict[int, int] = {}
+        self._in_tensors: List = []   # strong refs (cleared per segment)
+        self._in_vals: List = []
+        self.max_ops = max_segment_ops if max_segment_ops is not None \
+            else flags.flag_value("FLAGS_lazy_max_segment_ops")
+        # stats for tests / profiling
+        self.segments_run = 0
+        self.ops_recorded = 0
+        self.breaks: List[str] = []
+
+    # ---------------------------------------------------------- recording
+    def _input_index(self, tensor) -> int:
+        idx = self._in_ids.get(id(tensor))
+        if idx is None:
+            idx = len(self._in_vals)
+            self._in_ids[id(tensor)] = idx
+            self._in_tensors.append(tensor)
+            self._in_vals.append(tensor._payload)
+        return idx
+
+    def record(self, op: OpDef, ts, attrs):
+        """Record one op application; returns out Tensors (lazy)."""
+        from .autograd import is_grad_enabled
+        from .tensor import Tensor
+
+        wiring = []
+        in_avals = []
+        req = False
+        for t in ts:
+            if t is None:
+                wiring.append(None)
+                in_avals.append(None)
+                continue
+            p = t._payload
+            if getattr(p, "_is_lazy_ref", False):
+                if p.ctx is self and p.op_idx is not None:
+                    wiring.append(("op", p.op_idx, p.slot))
+                    in_avals.append(p.aval)
+                    req = req or p.requires_grad
+                    continue
+                # lazy value from another context: materialize it
+                p.materialize()
+                p = t._payload
+            wiring.append(("in", self._input_index(t)))
+            in_avals.append(_aval_of(p))
+            req = req or (not t.stop_gradient)
+
+        out_avals = _out_avals(op, attrs, in_avals)
+        req = req and is_grad_enabled()
+        op_idx = len(self.pending)
+        out_refs = []
+        outs = []
+        for s, aval in enumerate(out_avals):
+            inexact = jnp.issubdtype(aval.dtype, jnp.inexact)
+            ref = LazyRef(self, op_idx, s, aval, req and inexact)
+            t = _lazy_tensor(ref, stop_gradient=not (req and inexact))
+            out_refs.append(ref)
+            outs.append(t)
+        self.pending.append(_PendingOp(op, dict(attrs), tuple(wiring),
+                                       out_refs))
+        self.ops_recorded += 1
+        return tuple(outs)
+
+    def maybe_cap_flush(self):
+        """Called by the executor AFTER a successful record, outside its
+        record-fallback handler, so a failing segment execution surfaces
+        instead of being swallowed as an 'uncapturable op'."""
+        if len(self.pending) >= self.max_ops:
+            self.flush("segment_cap")
+
+    # ------------------------------------------------------------- flush
+    def flush(self, reason: str = "materialize"):
+        if not self.pending:
+            return
+        pending = self.pending
+        in_tensors = self._in_tensors
+        in_vals = self._in_vals
+
+        # live outputs: lazy refs some Tensor still aliases
+        live: List[Tuple[int, int]] = []
+        live_refs: List[LazyRef] = []
+        for j, pop in enumerate(pending):
+            for ref in pop.out_refs:
+                if any(r() is not None for r in ref.trefs):
+                    live.append((j, ref.slot))
+                    live_refs.append(ref)
+
+        sig = _segment_signature(pending, in_vals, live)
+        runner = _SEG_CACHE.get(sig)
+        if runner is None:
+            runner = jax.jit(_build_segment_fn(pending, live))
+            _SEG_CACHE[sig] = runner
+        # run BEFORE clearing state: a compile/run failure must leave the
+        # trace intact (and surface the real error), not lose it
+        out_vals = runner(list(in_vals))
+        self.pending = []
+        self._in_ids = {}
+        self._in_tensors = []
+        self._in_vals = []
+        self.breaks.append(reason)
+        self.segments_run += 1
+
+        # bind concrete values into every alive aliasing Tensor
+        out_tensors = []
+        for ref, val in zip(live_refs, out_vals):
+            ts = [r() for r in ref.trefs]
+            ts = [t for t in ts if t is not None]
+            for t in ts:
+                t._payload = val
+            out_tensors.append(ts[0] if ts else None)
+
+        self._register_grad(pending, live, live_refs, out_tensors,
+                            in_tensors, in_vals, sig)
+
+        if self.on_flush is not None:
+            self.on_flush(self, reason, pending, live, live_refs,
+                          in_tensors, in_vals, sig, out_tensors)
+
+    on_flush = None  # observer hook (jit/sot records segment structure)
+
+    # ----------------------------------------------------------- autograd
+    def _register_grad(self, pending, live, live_refs, out_tensors,
+                       in_tensors, in_vals, sig):
+        register_segment_grad(pending, live, live_refs, out_tensors,
+                              in_tensors, in_vals, sig)
+
+
+def register_segment_grad(pending, live, live_refs, out_tensors,
+                          in_tensors, in_vals, sig):
+    """Wire ONE fused GradNode for an executed segment. live_refs only
+    needs .aval / .requires_grad (LazyRef or a replay meta)."""
+    from .autograd import GradNode, _Edge
+    # NOTE deliberately no is_grad_enabled() check here: grad intent was
+    # decided at RECORD time (ref.requires_grad), matching eager
+    # semantics — a flush that happens to run inside no_grad (e.g. a
+    # logging read) must not drop gradients for ops recorded outside it
+    grad_in = [i for i, t in enumerate(in_tensors)
+               if not t.stop_gradient
+               and jnp.issubdtype(in_vals[i].dtype, jnp.inexact)]
+    grad_out = [k for k, ref in enumerate(live_refs)
+                if ref.requires_grad]
+    if not grad_in or not grad_out:
+        return
+
+    gi = set(grad_in)
+    edges = []
+    versions = []
+    refs = []
+    for i, t in enumerate(in_tensors):
+        if i not in gi:
+            edges.append(_Edge(None))
+            versions.append(t._inplace_version)
+            refs.append(None)
+            continue
+        meta = t._autograd_meta
+        if meta.grad_node is not None:
+            edges.append(_Edge("node", node=meta.grad_node,
+                               slot=meta.out_slot))
+        else:
+            edges.append(_Edge("leaf", leaf=t))
+        versions.append(t._inplace_version)
+        refs.append(weakref.ref(t))
+
+    node = GradNode(
+        None, {}, tuple(in_vals), edges,
+        out_shapes=tuple(tuple(r.aval.shape) for r in live_refs),
+        out_dtypes=tuple(r.aval.dtype for r in live_refs))
+    node.name = "lazy_segment"
+    node.saved_versions = tuple(versions)
+    node.in_refs = tuple(refs)
+
+    bwd = _segment_bwd(sig, pending, live, tuple(grad_in))
+
+    def py_bwd(gouts, _saved=tuple(in_vals), _bwd=bwd,
+               _refs=live_refs, _n=len(grad_in)):
+        cts = []
+        for g, ref in zip(gouts, _refs):
+            if g is None:
+                cts.append(jnp.zeros(ref.aval.shape, ref.aval.dtype))
+            elif hasattr(g, "astype") and g.dtype != ref.aval.dtype:
+                cts.append(g.astype(ref.aval.dtype))
+            else:
+                cts.append(g)
+        grads = _bwd(list(_saved), tuple(cts))
+        out = []
+        for g in grads:
+            if g is None or (hasattr(g, "dtype")
+                             and g.dtype == jax.dtypes.float0):
+                out.append(None)
+            else:
+                out.append(g)
+        return tuple(out)
+
+    # edges cover every segment input; py_bwd returns grads aligned
+    # with them (None for stop-gradient slots)
+    def py_bwd_full(gouts, _inner=py_bwd, _n_in=len(in_tensors),
+                    _grad_in=tuple(grad_in)):
+        grads = _inner(gouts)
+        out = [None] * _n_in
+        for g, i in zip(grads, _grad_in):
+            out[i] = g
+        return tuple(out)
+
+    node.py_bwd = py_bwd_full
+
+    for k, t in enumerate(out_tensors):
+        if k in grad_out and t is not None:
+            m = t._autograd_meta
+            if m.grad_node is None:
+                t.stop_gradient = False
+                m.grad_node = node
+                m.out_slot = k
+
+
+def _segment_signature(pending, in_vals, live):
+    from .dispatch import attrs_key
+    ops_sig = tuple(
+        (p.op.name, attrs_key(p.attrs), p.wiring, p.n_outs)
+        for p in pending)
+    in_sig = tuple((tuple(v.shape), str(v.dtype),
+                    bool(getattr(v, "weak_type", False)))
+                   for v in in_vals)
+    return (jax.default_backend(), ops_sig, in_sig, tuple(live))
+
+
+def _build_segment_fn(pending, live):
+    backend = jax.default_backend()
+    steps = []
+    for p in pending:
+        steps.append((functools.partial(p.op.kernel_for(backend),
+                                        **p.attrs),
+                      p.wiring, p.op.multi_output))
+
+    def seg_fn(inputs):
+        vals: List[Tuple] = []
+        for fn, wiring, multi in steps:
+            ins = []
+            for w in wiring:
+                if w is None:
+                    ins.append(None)
+                elif w[0] == "in":
+                    ins.append(inputs[w[1]])
+                else:
+                    ins.append(vals[w[1]][w[2]])
+            out = fn(*ins)
+            vals.append(tuple(out) if multi else (out,))
+        return [vals[j][s] for (j, s) in live]
+
+    return seg_fn
+
+
+_SEG_BWD_CACHE: Dict[Tuple, Any] = {}
+
+
+def _segment_bwd(sig, pending, live, grad_in: Tuple[int, ...]):
+    key = (sig, grad_in)
+    fn = _SEG_BWD_CACHE.get(key)
+    if fn is None:
+        seg = _build_segment_fn(pending, live)
+
+        def bwd(inputs, cts, _seg=seg, _gi=grad_in):
+            def f(*gvals):
+                full = list(inputs)
+                for v, i in zip(gvals, _gi):
+                    full[i] = v
+                return _seg(full)
+            _, pull = jax.vjp(f, *[inputs[i] for i in _gi])
+            return pull(list(cts))
+
+        fn = jax.jit(bwd)
+        _SEG_BWD_CACHE[key] = fn
+    return fn
+
+
+def _lazy_tensor(ref: LazyRef, stop_gradient=True):
+    from .tensor import Tensor
+    t = object.__new__(Tensor)
+    t._payload = ref
+    t._stop_gradient = stop_gradient
+    from .autograd import AutogradMeta
+    t._autograd_meta = AutogradMeta()
+    t._inplace_version = 0
+    t.name = None
+    t.persistable = False
+    t._dist_attr = None
+    ref.add_tref(t)
+    return t
+
+
+class _RefMeta:
+    """Replay stand-in for LazyRef (register_segment_grad contract)."""
+    __slots__ = ("aval", "requires_grad")
+
+    def __init__(self, aval, requires_grad):
+        self.aval = aval
+        self.requires_grad = requires_grad
+
+
+class ReplayableSegment:
+    """A captured segment that can be re-executed directly on fresh input
+    tensors — the compiled body of jit/sot's guarded fast path. Built
+    from a CaptureContext flush event; replay skips recording entirely:
+    fetch inputs, run the cached executable, wrap outputs, register the
+    fused GradNode."""
+
+    def __init__(self, pending, live, live_refs, in_vals, sig):
+        self.pending = pending
+        self.live = live
+        self.metas = [_RefMeta(r.aval, r.requires_grad) for r in live_refs]
+        self.sig = sig
+        self.in_avals = tuple((tuple(v.shape), str(v.dtype))
+                              for v in in_vals)
+        # which inputs fed grad-requiring chains at capture (replay must
+        # see the same stop_gradient mask to reuse the vjp wiring)
+        self.grad_mask = None
+
+    def run(self, in_tensors):
+        from .tensor import Tensor
+        in_vals = [t._value for t in in_tensors]
+        got = tuple((tuple(v.shape), str(v.dtype)) for v in in_vals)
+        if got != self.in_avals:
+            raise _ReplayMismatch("input avals changed")
+        runner = _SEG_CACHE.get(self.sig)
+        if runner is None:
+            runner = jax.jit(_build_segment_fn(self.pending, self.live))
+            _SEG_CACHE[self.sig] = runner
+        out_vals = runner(list(in_vals))
+        outs = []
+        for meta, val in zip(self.metas, out_vals):
+            outs.append(Tensor(val, stop_gradient=not meta.requires_grad))
+        register_segment_grad(self.pending, self.live, self.metas, outs,
+                              in_tensors, in_vals, self.sig)
+        return outs
+
+
+class _ReplayMismatch(Exception):
+    pass
+
+
+# --------------------------------------------------------------- the guard
+_ACTIVE: List[CaptureContext] = []
+
+
+def current_context() -> Optional[CaptureContext]:
+    return _ACTIVE[-1] if _ACTIVE else None
+
+
+def flush_active(reason: str = "materialize"):
+    if _ACTIVE:
+        _ACTIVE[-1].flush(reason)
+
+
+class lazy_guard:
+    """Context manager enabling the lazy fusion window.
+
+    with paddle_tpu.framework.lazy_guard() as ctx:
+        ... eager code; ops fuse into XLA segments ...
+    # exiting flushes everything pending
+    """
+
+    def __init__(self, max_segment_ops: Optional[int] = None):
+        self._max = max_segment_ops
+        self.ctx: Optional[CaptureContext] = None
+
+    def __enter__(self) -> CaptureContext:
+        self.ctx = CaptureContext(self._max)
+        _ACTIVE.append(self.ctx)
+        return self.ctx
+
+    def __exit__(self, et, ev, tb):
+        _ACTIVE.pop()
+        if et is None:
+            self.ctx.flush("guard_exit")
+        else:
+            # error path: still materialize what was recorded — tensors
+            # computed before the error are valid (eager would have
+            # them), and leaving them lazy would poison later reads.
+            # Suppress secondary failures during unwind.
+            try:
+                self.ctx.flush("guard_error")
+            except Exception:
+                self.ctx.pending = []
+                self.ctx._in_ids = {}
+                self.ctx._in_tensors = []
+                self.ctx._in_vals = []
+        return False
+
+
+def segment_cache_size() -> int:
+    return len(_SEG_CACHE)
+
+
+def clear_segment_cache():
+    _SEG_CACHE.clear()
+    _SEG_BWD_CACHE.clear()
+    _AVAL_CACHE.clear()

@@ -23,16 +23,20 @@ from .autograd import AutogradMeta, is_grad_enabled, no_grad, run_backward
 
 
 class Tensor:
-    __slots__ = ("_value", "_stop_gradient", "_autograd_meta",
+    __slots__ = ("_payload", "_stop_gradient", "_autograd_meta",
                  "_inplace_version", "name", "persistable", "_dist_attr",
                  "__weakref__")
 
     def __init__(self, value, stop_gradient: bool = True, name: str = None):
         if isinstance(value, Tensor):
-            value = value._value
-        if not isinstance(value, (jax.Array, jax.core.Tracer)):
+            value = value._payload
+        if getattr(value, "_is_lazy_ref", False):
+            # alias a pending lazy value (keeps the fusion window open:
+            # wrapping/detaching a lazy tensor must not force a flush)
+            value.add_tref(self)
+        elif not isinstance(value, (jax.Array, jax.core.Tracer)):
             value = jnp.asarray(value)
-        self._value = value
+        self._payload = value
         self._stop_gradient = bool(stop_gradient)
         self._autograd_meta = AutogradMeta()
         self._inplace_version = 0
@@ -40,26 +44,50 @@ class Tensor:
         self.persistable = False
         self._dist_attr = None  # set by paddle_tpu.distributed for DistTensor
 
+    # ----------------------------------------------------------- raw value
+    @property
+    def _value(self):
+        """The raw jax payload. Reading it while a lazy capture is pending
+        MATERIALIZES the pending segment (one compiled XLA execution) —
+        the flush point of the fusion window / SOT graph break."""
+        v = self._payload
+        if getattr(v, "_is_lazy_ref", False):
+            v.materialize()
+            v = self._payload
+            if getattr(v, "_is_lazy_ref", False):
+                raise RuntimeError("lazy value failed to materialize")
+        return v
+
+    @_value.setter
+    def _value(self, new):
+        self._payload = new
+
     # ------------------------------------------------------------- metadata
     @property
     def shape(self):
-        return list(self._value.shape)
+        return list(self._meta_aval().shape)
+
+    def _meta_aval(self):
+        """shape/dtype metadata WITHOUT materializing a lazy payload."""
+        v = self._payload
+        return v.aval if getattr(v, "_is_lazy_ref", False) else v
 
     @property
     def ndim(self):
-        return self._value.ndim
+        return len(self._meta_aval().shape)
 
     @property
     def rank(self):
-        return self._value.ndim
+        return self.ndim
 
     @property
     def size(self):
-        return int(np.prod(self._value.shape)) if self._value.shape else 1
+        shp = self._meta_aval().shape
+        return int(np.prod(shp)) if shp else 1
 
     @property
     def dtype(self):
-        return dtypes_mod.from_np(np.dtype(self._value.dtype))
+        return dtypes_mod.from_np(np.dtype(self._meta_aval().dtype))
 
     @property
     def place(self):
@@ -116,7 +144,7 @@ class Tensor:
         return _Handle()
 
     def detach(self) -> "Tensor":
-        t = Tensor(self._value, stop_gradient=True)
+        t = Tensor(self._payload, stop_gradient=True)
         t.name = self.name
         return t
 
@@ -188,7 +216,7 @@ class Tensor:
     def __len__(self):
         if self.ndim == 0:
             raise TypeError("len() of a 0-d tensor")
-        return self._value.shape[0]
+        return self._meta_aval().shape[0]
 
     def __bool__(self):
         return bool(self._value)

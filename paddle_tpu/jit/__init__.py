@@ -1,2 +1,3 @@
 from .api import to_static, not_to_static, ignore_module, save, load, \
     TranslatedLayer, InputSpec  # noqa: F401
+from . import sot  # noqa: F401  (bytecode capture, reference jit/sot)

@@ -280,8 +280,22 @@ class StaticFunction:
 def to_static(function=None, input_spec=None, build_strategy=None,
               backend=None, full_graph=True, **kwargs):
     """Decorator/wrapper: compile a Layer's forward or a function into a
-    cached XLA executable. Usable standalone or inside training loops."""
+    cached XLA executable. Usable standalone or inside training loops.
+
+    full_graph=True (default): whole-function jax.jit trace — tensor
+    control flow must be convertible (dy2static) or a hard error, like
+    the reference's AST path.
+    full_graph=False: SOT bytecode capture with graph-break FALLBACK
+    (jit/sot): unsupported constructs run eagerly between compiled
+    segments instead of raising (reference jit/api.py:197 semantics).
+    """
     def _build(fn):
+        if not full_graph:
+            from .sot import symbolic_translate
+            if isinstance(fn, Layer):
+                fn.forward = symbolic_translate(fn.forward)
+                return fn
+            return symbolic_translate(fn)
         if isinstance(fn, Layer):
             sf = StaticFunction(fn.forward, layer=fn, input_spec=input_spec)
             fn.forward = sf

@@ -108,6 +108,11 @@ class Layer:
         self._buffers[name] = tensor
         if not persistable:
             self._non_persistable_buffer_names.add(name)
+        if tensor is not None:
+            # the tensor itself carries the flag (reference Tensor
+            # semantics); jit/sot uses it to tell long-lived state from
+            # per-call temporaries when binding fast-path inputs
+            tensor.persistable = persistable
         object.__setattr__(self, name, tensor)
 
     def register_parameter(self, name, param):

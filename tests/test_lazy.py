@@ -1,0 +1,149 @@
+"""Lazy fusion window (_core/lazy.py): eager ops recorded into compiled
+XLA segments, materialized on demand.
+
+The role pair in the reference: the CUDA stream's async run-ahead (per-op
+kernels queue while the host advances) + SOT's FunctionGraph. Checks:
+correctness vs eager, laziness (metadata reads don't flush), graph
+breaks, autograd through fused segment nodes, segment cache replay, and
+the FLAGS_lazy_max_segment_ops cap.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu._core import lazy
+
+
+def _is_lazy(t):
+    return getattr(t._payload, "_is_lazy_ref", False)
+
+
+def test_fuses_and_matches_eager():
+    x = paddle.to_tensor(np.random.RandomState(0).randn(4, 8)
+                         .astype("float32"))
+    ref = (F.relu(x * 2.0) + 1.0).sum()
+    with lazy.lazy_guard() as ctx:
+        out = (F.relu(x * 2.0) + 1.0).sum()
+        assert _is_lazy(out)
+    assert ctx.segments_run == 1
+    assert ctx.ops_recorded >= 4
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-6)
+
+
+def test_metadata_reads_do_not_flush():
+    x = paddle.to_tensor(np.zeros((3, 5), "float32"))
+    with lazy.lazy_guard():
+        y = x * 2.0
+        assert y.shape == [3, 5]
+        assert y.ndim == 2
+        assert y.dtype == paddle.float32
+        assert len(y) == 3
+        assert _is_lazy(y), "metadata reads must not materialize"
+        _ = float(y.sum().numpy())
+        assert not _is_lazy(y)
+
+
+def test_value_access_is_a_graph_break():
+    x = paddle.to_tensor(np.ones((2, 2), "float32"))
+    with lazy.lazy_guard() as ctx:
+        a = x + 1.0
+        _ = a.numpy()           # break
+        b = a * 3.0
+        _ = b.numpy()           # break
+    assert ctx.segments_run == 2
+    np.testing.assert_allclose(b.numpy(), (np.ones((2, 2)) + 1) * 3)
+
+
+def test_autograd_through_segments():
+    r = np.random.RandomState(1)
+    x = paddle.to_tensor(r.randn(4, 8).astype("float32"))
+    w = paddle.to_tensor(r.randn(8, 8).astype("float32"))
+    w.stop_gradient = False
+    loss = F.relu(paddle.matmul(x, w)).sum()
+    loss.backward()
+    g_ref = w.grad.numpy().copy()
+    w.clear_grad()
+
+    with lazy.lazy_guard():
+        loss = F.relu(paddle.matmul(x, w)).sum()
+    loss.backward()
+    np.testing.assert_allclose(w.grad.numpy(), g_ref, rtol=1e-5)
+    w.clear_grad()
+
+    # break mid-graph: grads chain across two fused segment nodes
+    with lazy.lazy_guard() as ctx:
+        h = paddle.matmul(x, w)
+        _ = h.numpy()
+        loss = F.relu(h).sum()
+    loss.backward()
+    assert ctx.segments_run == 2
+    np.testing.assert_allclose(w.grad.numpy(), g_ref, rtol=1e-5)
+    w.clear_grad()
+
+
+def test_train_step_parity():
+    r = np.random.RandomState(2)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    xb = paddle.to_tensor(r.randn(4, 8).astype("float32"))
+    opt = paddle.optimizer.SGD(0.1, parameters=net.parameters())
+    st0 = {k: v.numpy().copy() for k, v in net.state_dict().items()}
+
+    loss = (net(xb) ** 2).sum()
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+    st_eager = {k: v.numpy().copy() for k, v in net.state_dict().items()}
+
+    net.set_state_dict({k: paddle.to_tensor(v) for k, v in st0.items()})
+    with lazy.lazy_guard():
+        loss = (net(xb) ** 2).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    for k in st_eager:
+        np.testing.assert_allclose(net.state_dict()[k].numpy(),
+                                   st_eager[k], rtol=2e-5, atol=1e-6)
+
+
+def test_segment_cache_replay():
+    x = paddle.to_tensor(np.random.RandomState(3).randn(4, 4)
+                         .astype("float32"))
+
+    def run():
+        with lazy.lazy_guard() as ctx:
+            out = F.relu(x * 2.0).sum()
+        return float(out.numpy()), ctx
+
+    v1, _ = run()
+    n0 = lazy.segment_cache_size()
+    v2, c2 = run()
+    assert lazy.segment_cache_size() == n0
+    assert v1 == v2 and c2.segments_run == 1
+
+
+def test_segment_cap_flag():
+    from paddle_tpu._core.flags import set_flags, flag_value
+    old = flag_value("FLAGS_lazy_max_segment_ops")
+    set_flags({"FLAGS_lazy_max_segment_ops": 4})
+    try:
+        x = paddle.to_tensor(np.ones((2,), "float32"))
+        with lazy.lazy_guard() as ctx:
+            y = x
+            for _ in range(10):
+                y = y + 1.0
+        assert ctx.segments_run >= 2, "cap must split the trace"
+        np.testing.assert_allclose(y.numpy(), np.ones((2,)) + 10)
+    finally:
+        set_flags({"FLAGS_lazy_max_segment_ops": old})
+
+
+def test_uncapturable_op_falls_back():
+    """An op whose shape inference needs concrete data (eval_shape fails)
+    breaks the graph and runs eagerly instead of raising."""
+    x = paddle.to_tensor(np.array([1.0, -2.0, 3.0], "float32"))
+    ref = paddle.nonzero(F.relu(x)).numpy()
+    with lazy.lazy_guard():
+        out = paddle.nonzero(F.relu(x))
+    np.testing.assert_allclose(out.numpy(), ref)
