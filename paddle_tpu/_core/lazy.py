@@ -133,29 +133,42 @@ class CaptureContext:
         from .autograd import is_grad_enabled
         from .tensor import Tensor
 
-        wiring = []
+        # pass 1: resolve avals WITHOUT mutating the input record, so a
+        # failing aval inference (un-capturable op) leaves no ghost
+        # inputs behind for the record-fallback path to drag along
+        resolved = []
         in_avals = []
         req = False
         for t in ts:
             if t is None:
-                wiring.append(None)
+                resolved.append(None)
                 in_avals.append(None)
                 continue
             p = t._payload
             if getattr(p, "_is_lazy_ref", False):
                 if p.ctx is self and p.op_idx is not None:
-                    wiring.append(("op", p.op_idx, p.slot))
+                    resolved.append(("op", p.op_idx, p.slot))
                     in_avals.append(p.aval)
                     req = req or p.requires_grad
                     continue
                 # lazy value from another context: materialize it
                 p.materialize()
                 p = t._payload
-            wiring.append(("in", self._input_index(t)))
+            resolved.append(("ext", t))
             in_avals.append(_aval_of(p))
             req = req or (not t.stop_gradient)
 
         out_avals = _out_avals(op, attrs, in_avals)
+
+        # pass 2 (cannot fail): register external inputs + build wiring
+        wiring = []
+        for r in resolved:
+            if r is None:
+                wiring.append(None)
+            elif r[0] == "ext":
+                wiring.append(("in", self._input_index(r[1])))
+            else:
+                wiring.append(r)
         req = req and is_grad_enabled()
         op_idx = len(self.pending)
         out_refs = []
@@ -181,6 +194,11 @@ class CaptureContext:
     # ------------------------------------------------------------- flush
     def flush(self, reason: str = "materialize"):
         if not self.pending:
+            # nothing recorded, but clear any input registrations a
+            # partially-failed record may have left behind
+            self._in_ids = {}
+            self._in_tensors = []
+            self._in_vals = []
             return
         pending = self.pending
         in_tensors = self._in_tensors
@@ -210,14 +228,18 @@ class CaptureContext:
         self.breaks.append(reason)
         self.segments_run += 1
 
-        # bind concrete values into every alive aliasing Tensor
+        # bind concrete values into every alive aliasing Tensor; the
+        # grad node attaches to a grad-REQUIRING alias — a detach()ed
+        # alias must never have its stop_gradient flipped back
         out_tensors = []
         for ref, val in zip(live_refs, out_vals):
             ts = [r() for r in ref.trefs]
             ts = [t for t in ts if t is not None]
             for t in ts:
                 t._payload = val
-            out_tensors.append(ts[0] if ts else None)
+            grad_ts = [t for t in ts if not t.stop_gradient]
+            out_tensors.append(grad_ts[0] if grad_ts
+                               else (ts[0] if ts else None))
 
         self._register_grad(pending, live, live_refs, out_tensors,
                             in_tensors, in_vals, sig)
@@ -314,10 +336,9 @@ def register_segment_grad(pending, live, live_refs, out_tensors,
     node.py_bwd = py_bwd_full
 
     for k, t in enumerate(out_tensors):
-        if k in grad_out and t is not None:
+        if k in grad_out and t is not None and not t.stop_gradient:
             m = t._autograd_meta
             if m.grad_node is None:
-                t.stop_gradient = False
                 m.grad_node = node
                 m.out_slot = k
 

@@ -214,14 +214,15 @@ def prescan(code) -> Optional[str]:
     return None
 
 
-_PRESCAN_CACHE: Dict[int, Optional[str]] = {}
+# keyed by the code OBJECT (hashable, compared by value): id() keys
+# could be reused after GC and hand a stale verdict to new code
+_PRESCAN_CACHE: Dict[Any, Optional[str]] = {}
 
 
 def prescan_cached(code) -> Optional[str]:
-    key = id(code)
-    if key not in _PRESCAN_CACHE:
-        _PRESCAN_CACHE[key] = prescan(code)
-    return _PRESCAN_CACHE[key]
+    if code not in _PRESCAN_CACHE:
+        _PRESCAN_CACHE[code] = prescan(code)
+    return _PRESCAN_CACHE[code]
 
 
 # --------------------------------------------------------------- session
@@ -462,11 +463,14 @@ class OpcodeExecutor:
                 a = pop()
                 r = (uv(a) is uv(b)) ^ bool(ins.arg)
                 # `x is None` on a tracked value: record the None-ness,
-                # not the exact value
+                # not the exact value; identity tests on tracked OBJECTS
+                # specialize on the object -> id guard
                 for t in (a, b):
                     if isinstance(t, Tracked):
                         for src in t.leaves:
                             s.guards.add(src, "none", t.value is None)
+                    elif isinstance(t, TrackedObj):
+                        s.guards.add(t.source, "id", id(t.value))
                 push(r)
             elif op == "CONTAINS_OP":
                 b = pop()
@@ -787,10 +791,12 @@ class OpcodeExecutor:
             s.guard_tracked(v)
             return bool(v.value)
         if isinstance(v, TrackedObj):
-            real = v.value
-            if hasattr(real, "__len__"):
-                s.unguardable = "truthiness of tracked container"
-            return bool(real)
+            # object truthiness (len, custom __bool__) cannot be guarded
+            # re-fetchably — refuse the fast path rather than replay a
+            # stale branch direction
+            s.unguardable = (f"truthiness of tracked "
+                             f"{type(v.value).__name__}")
+            return bool(v.value)
         return bool(v)
 
     def _call(self, argc):
@@ -868,10 +874,10 @@ class _CacheEntry:
     marker that this function must be re-interpreted per call."""
 
     __slots__ = ("guards", "segment", "in_bindings", "grad_mask",
-                 "out_tree", "out_specs", "hits")
+                 "out_tree", "out_specs", "hits", "grad_mode")
 
     def __init__(self, guards, segment, in_bindings, grad_mask,
-                 out_tree, out_specs):
+                 out_tree, out_specs, grad_mode):
         self.guards = guards
         self.segment = segment          # lazy.ReplayableSegment
         self.in_bindings = in_bindings  # ("source", src)|("tensor", t)
@@ -879,6 +885,10 @@ class _CacheEntry:
         self.out_tree = out_tree
         self.out_specs = out_specs
         self.hits = 0
+        # grad intent is baked into the compiled segment at capture; an
+        # entry captured under no_grad must not serve a training call
+        # (and vice versa) — the caller checks this like a guard
+        self.grad_mode = grad_mode
 
     def run(self, fn, args, kwargs):
         from ..._core.tensor import Tensor
@@ -926,8 +936,11 @@ class SotFunction:
         # sources address the FLAT call: for bound methods self is arg 0
         eval_args = (fn.__self__,) + args if inspect.ismethod(fn) \
             else args
+        from ..._core.autograd import is_grad_enabled
+        grad_now = is_grad_enabled()
         for entry in self._entries:
-            if entry.guards.check_all(fn, eval_args, kwargs):
+            if entry.grad_mode == grad_now \
+                    and entry.guards.check_all(fn, eval_args, kwargs):
                 try:
                     out = entry.run(fn, eval_args, kwargs)
                     self.stats["fast_hits"] += 1
@@ -1044,11 +1057,12 @@ class SotFunction:
                 # unsound — no fast path
                 return None
 
+        from ..._core.autograd import is_grad_enabled
         segment = lazy.ReplayableSegment(pending, live, live_refs,
                                          in_vals, sig)
         return _CacheEntry(session.guards, segment, bindings,
                            tuple(t.stop_gradient for t in in_tensors),
-                           tree, specs)
+                           tree, specs, is_grad_enabled())
 
 
 def _is_scalar_const(t) -> bool:

@@ -50,6 +50,7 @@ class OpEntry:
         self.spmd_rule: Optional[str] = None
         self.backward = "auto"
         self.lazy = False  # registered on first call, not at import
+        self.layouts: Optional[List[str]] = None  # sparse_ops.yaml only
 
     def __repr__(self):
         return (f"OpEntry({self.name}, tensors={self.tensor_args}, "
@@ -139,6 +140,8 @@ def load_schema(path: str = _YAML) -> Dict[str, OpEntry]:
                 cur.backward = val
             elif key == "lazy":
                 cur.lazy = val.lower() == "true"
+            elif key == "layouts":
+                cur.layouts = [p.strip() for p in val.split(",")]
             else:
                 raise ValueError(f"ops.yaml:{ln}: unknown key '{key}'")
     return entries

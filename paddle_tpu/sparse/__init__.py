@@ -1,11 +1,21 @@
-"""paddle.sparse (python/paddle/sparse analog; storage classes mirror
-phi's SparseCooTensor/SparseCsrTensor, paddle/phi/core/sparse_coo_tensor.h).
+"""paddle.sparse: COO/CSR sparse tensors + the declarative sparse op
+family (python/paddle/sparse + paddle/phi/kernels/sparse analog).
 
-TPU-native stance: sparse storage lives host/HBM as (indices, values)
-arrays with STATIC nnz (XLA needs static shapes); compute lowers to
-gather/segment-sum which XLA maps to one-hot matmuls / scatters on the
-MXU. Round-1 surface: COO/CSR construction, to_dense/to_sparse, elementwise
-add/mul on aligned sparsity, sparse @ dense matmul, relu."""
+Storage classes mirror phi's SparseCooTensor/SparseCsrTensor
+(paddle/phi/core/sparse_coo_tensor.h, sparse_csr_tensor.h): component
+Tensors with STATIC nnz. The op family is declared in
+`paddle_tpu/ops/yaml/sparse_ops.yaml` (the reference's sparse_ops.yaml
+role, 40 ops there) and registered per layout in registry.py; kernel
+bodies (kernels.py) are compositions over the DENSE op registry, so
+- autograd flows through the values component via the ordinary eager
+  engine (grad checks in tests/test_sparse_ops.py),
+- XLA lowers gather/segment-sum to MXU-friendly one-hot matmuls,
+- index structure is resolved host-side (static shapes).
+
+Public surface: the schema's ops as functions here (paddle.sparse.abs,
+.add, .matmul, .masked_matmul, ...), methods on the storage classes,
+and sparse.nn layers (ReLU/LeakyReLU/Softmax/BatchNorm).
+"""
 from __future__ import annotations
 
 from typing import Optional, Sequence
@@ -15,6 +25,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from .._core.tensor import Tensor
+from . import registry as _registry
+from .registry import (all_sparse_ops, dispatch, get_sparse_op,
+                       register_sparse_op)
 
 
 class SparseCooTensor:
@@ -35,29 +48,44 @@ class SparseCooTensor:
     def dtype(self):
         return self.values.dtype
 
+    @property
+    def stop_gradient(self):
+        return self.values.stop_gradient
+
+    @stop_gradient.setter
+    def stop_gradient(self, v):
+        self.values.stop_gradient = v
+
     def nnz(self):
         return int(self.indices.shape[1])
 
+    def is_sparse_coo(self):
+        return True
+
+    def is_sparse_csr(self):
+        return False
+
     def to_dense(self) -> Tensor:
-        idx = self.indices._value
-        vals = self.values._value
-        dense = jnp.zeros(tuple(self._shape), vals.dtype)
-        return Tensor(dense.at[tuple(idx)].add(vals))
+        return dispatch("to_dense", self)
 
     def to_sparse_csr(self) -> "SparseCsrTensor":
-        if len(self._shape) != 2:
-            raise ValueError("CSR requires 2-D")
-        idx = np.asarray(self.indices._value)
-        vals = self.values._value
-        order = np.lexsort((idx[1], idx[0]))
-        rows, cols = idx[0][order], idx[1][order]
-        crows = np.zeros(self._shape[0] + 1, np.int64)
-        np.add.at(crows, rows + 1, 1)
-        crows = np.cumsum(crows)
-        return SparseCsrTensor(Tensor(jnp.asarray(crows)),
-                               Tensor(jnp.asarray(cols)),
-                               Tensor(vals[jnp.asarray(order)]),
-                               self._shape)
+        return dispatch("to_sparse_csr", self)
+
+    def coalesce(self) -> "SparseCooTensor":
+        return dispatch("coalesce", self)
+
+    def transpose(self, perm):
+        return dispatch("transpose", self, perm=list(perm))
+
+    def reshape(self, shape):
+        return dispatch("reshape", self, shape=list(shape))
+
+    def backward(self, *a, **kw):
+        return self.values.backward(*a, **kw)
+
+    @property
+    def grad(self):
+        return self.values.grad
 
     def __repr__(self):
         return (f"SparseCooTensor(shape={self._shape}, "
@@ -78,18 +106,42 @@ class SparseCsrTensor:
     def shape(self):
         return list(self._shape)
 
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    @property
+    def stop_gradient(self):
+        return self.values.stop_gradient
+
+    @stop_gradient.setter
+    def stop_gradient(self, v):
+        self.values.stop_gradient = v
+
     def nnz(self):
         return int(self.cols.shape[0])
 
+    def is_sparse_coo(self):
+        return False
+
+    def is_sparse_csr(self):
+        return True
+
     def to_sparse_coo(self, sparse_dim=2) -> SparseCooTensor:
-        crows = np.asarray(self.crows._value)
-        rows = np.repeat(np.arange(self._shape[0]), np.diff(crows))
-        idx = jnp.stack([jnp.asarray(rows, jnp.int64),
-                         self.cols._value.astype(jnp.int64)])
-        return SparseCooTensor(Tensor(idx), self.values, self._shape)
+        return dispatch("to_sparse_coo", self, sparse_dim=sparse_dim)
 
     def to_dense(self) -> Tensor:
-        return self.to_sparse_coo().to_dense()
+        return dispatch("to_dense", self)
+
+    def transpose(self, perm):
+        return dispatch("transpose", self, perm=list(perm))
+
+    def backward(self, *a, **kw):
+        return self.values.backward(*a, **kw)
+
+    @property
+    def grad(self):
+        return self.values.grad
 
     def __repr__(self):
         return (f"SparseCsrTensor(shape={self._shape}, "
@@ -103,6 +155,9 @@ def sparse_coo_tensor(indices, values, shape=None, dtype=None,
         jnp.int64))
     values = values if isinstance(values, Tensor) else Tensor(
         jnp.asarray(values))
+    if dtype is not None:
+        from .._core import dtype as dtypes_mod
+        values = Tensor(values._value.astype(dtypes_mod.to_np(dtype)))
     if shape is None:
         shape = [int(d) + 1 for d in np.asarray(
             jnp.max(indices._value, axis=1))]
@@ -112,6 +167,11 @@ def sparse_coo_tensor(indices, values, shape=None, dtype=None,
 
 def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
                       stop_gradient=True):
+    values = values if isinstance(values, Tensor) else Tensor(
+        jnp.asarray(values))
+    if dtype is not None:
+        from .._core import dtype as dtypes_mod
+        values = Tensor(values._value.astype(dtypes_mod.to_np(dtype)))
     return SparseCsrTensor(crows, cols, values, shape)
 
 
@@ -119,82 +179,167 @@ def is_same_shape(x, y):
     return list(x.shape) == list(y.shape)
 
 
-def _coo_aligned(x: SparseCooTensor, y: SparseCooTensor):
-    return (x.indices.shape == y.indices.shape and bool(
-        jnp.all(x.indices._value == y.indices._value)))
+# ------------------------------------------------------------ registration
+from . import kernels as _k
+
+_UNARY = ["abs", "sin", "sinh", "tan", "tanh", "asin", "asinh", "atan",
+          "atanh", "acos", "acosh", "sqrt", "square", "log1p", "expm1",
+          "relu", "relu6", "leaky_relu", "pow", "scale"]
+
+# dense kernels carry no python defaults (those live in the generated
+# wrappers) — fill them from the sparse schema's declared defaults
+_ATTR_DEFAULTS = {
+    "leaky_relu": {"negative_slope": 0.01},
+    "pow": {"factor": 1.0},
+    "scale": {"scale": 1.0, "bias": 0.0, "bias_after_scale": True},
+}
+
+for _name in _UNARY:
+    _coo, _csr = _k.make_unary(_name, _ATTR_DEFAULTS.get(_name))
+    register_sparse_op(_name, coo=_coo, csr=_csr)
+
+register_sparse_op("cast", coo=_k.cast_coo, csr=_k.cast_csr)
+register_sparse_op("isnan", coo=_k.isnan_coo, csr=_k.isnan_csr)
+register_sparse_op("add", coo=_k.add_coo, csr=_k.add_csr)
+register_sparse_op("subtract", coo=_k.subtract_coo, csr=_k.subtract_csr)
+register_sparse_op("multiply", coo=_k.multiply_coo, csr=_k.multiply_csr)
+register_sparse_op("divide", coo=_k.divide_coo, csr=_k.divide_csr)
+register_sparse_op("divide_scalar", coo=_k.divide_scalar_coo,
+                   csr=_k.divide_scalar_csr)
+register_sparse_op("matmul", coo=_k.matmul_coo, csr=_k.matmul_csr)
+register_sparse_op("masked_matmul", coo=_k.masked_matmul_coo,
+                   csr=_k.masked_matmul_csr)
+register_sparse_op("addmm", coo=_k.addmm_coo, csr=_k.addmm_csr)
+register_sparse_op("mv", coo=_k.mv_coo, csr=_k.mv_csr)
+register_sparse_op("sum", coo=_k.sum_coo, csr=_k.sum_csr)
+register_sparse_op("softmax", coo=_k.softmax_coo, csr=_k.softmax_csr)
+register_sparse_op("fused_attention", csr=_k.fused_attention_csr)
+register_sparse_op("sparse_coo_tensor",
+                   coo=_k.sparse_coo_tensor_kernel)
+register_sparse_op("to_dense", coo=_k.to_dense_coo, csr=_k.to_dense_csr)
+register_sparse_op("to_sparse_coo", coo=lambda x, sparse_dim=2: x,
+                   csr=_k.csr_to_coo)
+register_sparse_op("to_sparse_csr", coo=_k.coo_to_csr,
+                   csr=lambda x: x)
+register_sparse_op("values", coo=_k.values_coo, csr=_k.values_csr)
+register_sparse_op("indices", coo=_k.indices_coo)
+register_sparse_op("coalesce", coo=_k.coalesce_coo)
+register_sparse_op("transpose", coo=_k.transpose_coo,
+                   csr=_k.transpose_csr)
+register_sparse_op("reshape", coo=_k.reshape_coo)
+register_sparse_op("mask_as", coo=_k.mask_as_coo, csr=_k.mask_as_csr)
+register_sparse_op("full_like", coo=_k.full_like_coo,
+                   csr=_k.full_like_csr)
+register_sparse_op("slice", coo=_k.slice_coo)
+
+# two-way drift check: schema <-> registry (ops.yaml contract)
+_registry.check_complete()
 
 
-def add(x, y):
-    if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
-        if _coo_aligned(x, y):
-            return SparseCooTensor(x.indices,
-                                   Tensor(x.values._value
-                                          + y.values._value), x.shape)
-        idx = jnp.concatenate([x.indices._value, y.indices._value], 1)
-        vals = jnp.concatenate([x.values._value, y.values._value])
-        return SparseCooTensor(Tensor(idx), Tensor(vals), x.shape)
-    raise TypeError("sparse.add expects SparseCooTensor operands")
+# --------------------------------------------- public functional surface
+def _make_public(name):
+    def fn(x, *args, **kwargs):
+        return dispatch(name, x, *args, **kwargs)
+    fn.__name__ = name
+    fn.__qualname__ = f"sparse.{name}"
+    fn.__doc__ = (f"paddle.sparse.{name} (sparse_ops.yaml entry "
+                  f"'{name}'; reference sparse_ops.yaml analog).")
+    return fn
 
 
-def multiply(x, y):
-    if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor) \
-            and _coo_aligned(x, y):
-        return SparseCooTensor(x.indices,
-                               Tensor(x.values._value * y.values._value),
-                               x.shape)
-    raise TypeError("sparse.multiply expects aligned SparseCooTensors")
+for _name in all_sparse_ops():
+    if _name == "sparse_coo_tensor":
+        continue   # constructor keeps its richer signature above
+    globals()[_name] = _make_public(_name)
 
 
-def matmul(x, y: Tensor) -> Tensor:
-    """sparse [M, K] @ dense [K, N] -> dense [M, N] via gather +
-    segment-sum (static-shape TPU path)."""
-    if isinstance(x, SparseCsrTensor):
-        x = x.to_sparse_coo()
-    if not isinstance(x, SparseCooTensor):
-        raise TypeError("sparse.matmul expects a sparse lhs")
-    rows = x.indices._value[0]
-    cols = x.indices._value[1]
-    dense = y._value if isinstance(y, Tensor) else jnp.asarray(y)
-    contrib = x.values._value[:, None] * dense[cols]      # [nnz, N]
-    out = jax.ops.segment_sum(contrib, rows,
-                              num_segments=x.shape[0])
-    return Tensor(out)
+# masked_matmul / mask_as / fused_attention take DENSE leading operands:
+# dispatch on the sparse mask instead (overrides the generated wrappers)
+def mask_as(x, mask, name=None):
+    """Dense x's entries at mask's sparsity -> sparse."""
+    op = _registry.get_sparse_op("mask_as")
+    layout = "coo" if isinstance(mask, SparseCooTensor) else "csr"
+    return op.kernels[layout](x, mask)
 
 
-def masked_matmul(x: Tensor, y: Tensor, mask):
-    """dense @ dense evaluated only at mask's sparsity (csr/coo)."""
-    coo = mask.to_sparse_coo() if isinstance(mask, SparseCsrTensor) \
-        else mask
-    rows = coo.indices._value[0]
-    cols = coo.indices._value[1]
-    xv = x._value
-    yv = y._value
-    vals = jnp.einsum("nk,nk->n", xv[rows], yv[:, cols].T)
-    return SparseCooTensor(coo.indices, Tensor(vals), coo.shape)
+def masked_matmul(x, y, mask, name=None):
+    """(x @ y) evaluated only at mask's stored positions -> sparse."""
+    op = _registry.get_sparse_op("masked_matmul")
+    layout = "coo" if isinstance(mask, SparseCooTensor) else "csr"
+    return op.kernels[layout](x, y, mask)
 
 
-class _SparseNNFunctional:
+def fused_attention(query, key, value, sparse_mask, key_padding_mask=None,
+                    attn_mask=None, name=None):
+    """Sparse-masked attention (reference sparse fused_attention)."""
+    op = _registry.get_sparse_op("fused_attention")
+    return op.kernels["csr"](query, key, value, sparse_mask,
+                             key_padding_mask, attn_mask)
+
+
+# --------------------------------------------------------------- sparse.nn
+class _SparseNN:
+    """paddle.sparse.nn: layers over the sparse functional surface."""
+
+    class ReLU:
+        def __call__(self, x):
+            return dispatch("relu", x)
+
+    class LeakyReLU:
+        def __init__(self, negative_slope=0.01):
+            self.negative_slope = negative_slope
+
+        def __call__(self, x):
+            return dispatch("leaky_relu", x,
+                            negative_slope=self.negative_slope)
+
+    class Softmax:
+        def __init__(self, axis=-1):
+            self.axis = axis
+
+        def __call__(self, x):
+            return dispatch("softmax", x, axis=self.axis)
+
+    class BatchNorm:
+        """Per-channel BN over the values [nnz, C] (reference sparse
+        batch_norm: statistics over stored entries)."""
+
+        def __init__(self, num_features, momentum=0.9, epsilon=1e-5):
+            from .. import nn as dense_nn
+            self._bn = dense_nn.BatchNorm1D(num_features,
+                                            momentum=momentum,
+                                            epsilon=epsilon)
+            self.training = True
+
+        def parameters(self):
+            return self._bn.parameters()
+
+        def train(self):
+            self.training = True
+            self._bn.train()
+
+        def eval(self):
+            self.training = False
+            self._bn.eval()
+
+        def __call__(self, x):
+            out_vals = self._bn(x.values)
+            if isinstance(x, SparseCooTensor):
+                return SparseCooTensor(x.indices, out_vals, x.shape)
+            return SparseCsrTensor(x.crows, x.cols, out_vals, x.shape)
+
+    # functional aliases (kept from the round-1 surface)
     @staticmethod
     def relu(x):
-        if isinstance(x, (SparseCooTensor,)):
-            return SparseCooTensor(x.indices,
-                                   Tensor(jnp.maximum(
-                                       x.values._value, 0)), x.shape)
-        return Tensor(jnp.maximum(x._value, 0))
+        if isinstance(x, (SparseCooTensor, SparseCsrTensor)):
+            return dispatch("relu", x)
+        from ..nn import functional as F
+        return F.relu(x)
 
     @staticmethod
     def softmax(x, axis=-1):
-        if isinstance(x, SparseCsrTensor):
-            coo = x.to_sparse_coo()
-            rows = coo.indices._value[0]
-            vals = coo.values._value
-            mx = jax.ops.segment_max(vals, rows,
-                                     num_segments=coo.shape[0])
-            e = jnp.exp(vals - mx[rows])
-            s = jax.ops.segment_sum(e, rows, num_segments=coo.shape[0])
-            return SparseCsrTensor(x.crows, x.cols,
-                                   Tensor(e / s[rows]), x.shape)
-        raise TypeError("sparse softmax expects csr")
+        return dispatch("softmax", x, axis=axis)
 
 
-nn = _SparseNNFunctional()
+nn = _SparseNN()
+nn.functional = nn

@@ -1,0 +1,584 @@
+"""Sparse kernel bodies: compositions over the dense op registry.
+
+The reference implements ~40 sparse ops as hand-written COO/CSR CUDA/CPU
+kernels (paddle/phi/kernels/sparse/). The TPU-native stance: the VALUES
+path is a composition of registered dense ops (gather / segment-sum /
+elementwise), so XLA lowers it to one-hot matmuls and scatters on the
+MXU and the eager autograd engine differentiates it for free; the INDEX
+structure (which entries exist) is computed host-side with numpy —
+structure is data-dependent and XLA requires static shapes, so eager
+structure resolution is the honest split (the same reason the
+reference's coalesce runs a thrust sort outside the graph).
+
+Every function here takes/returns the storage classes from
+`paddle_tpu.sparse` and is registered per layout via registry.py against
+sparse_ops.yaml.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from .._core.executor import apply
+from .._core.tensor import Tensor
+
+
+def _sp():
+    from . import SparseCooTensor, SparseCsrTensor
+    return SparseCooTensor, SparseCsrTensor
+
+
+def _np_idx(t: Tensor) -> np.ndarray:
+    return np.asarray(t._value)
+
+
+def _linear(idx: np.ndarray, shape) -> np.ndarray:
+    """Row-major linear index over the sparse dims."""
+    strides = np.ones(idx.shape[0], np.int64)
+    for d in range(idx.shape[0] - 2, -1, -1):
+        strides[d] = strides[d + 1] * shape[d + 1]
+    return (idx.astype(np.int64) * strides[:, None]).sum(0)
+
+
+def _csr_rows(crows: np.ndarray) -> np.ndarray:
+    return np.repeat(np.arange(len(crows) - 1), np.diff(crows))
+
+
+# ------------------------------------------------------------ unary family
+
+def make_unary(op_name: str, defaults: Optional[dict] = None):
+    """Values-wise op with unchanged structure, per layout. `defaults`
+    fills the dense kernel's required attrs (dense kernels carry no
+    python defaults — those live in the generated wrappers)."""
+    defaults = defaults or {}
+
+    def _vals(values, attrs):
+        if op_name == "pow":
+            # dense pow is a BINARY op (x, y); the sparse surface's
+            # `factor` attr becomes the second operand
+            factor = attrs.get("factor", defaults.get("factor", 1.0))
+            return apply("pow", values, Tensor(jnp.asarray(factor)))
+        full = dict(defaults)
+        full.update(attrs)
+        return apply(op_name, values, **full)
+
+    def coo(x, **attrs):
+        C, _ = _sp()
+        return C(x.indices, _vals(x.values, attrs), x.shape)
+
+    def csr(x, **attrs):
+        _, S = _sp()
+        return S(x.crows, x.cols, _vals(x.values, attrs), x.shape)
+
+    return coo, csr
+
+
+def cast_coo(x, index_dtype="", value_dtype=""):
+    C, _ = _sp()
+    idx = x.indices if not index_dtype else Tensor(
+        x.indices._value.astype(index_dtype))
+    vals = x.values if not value_dtype else apply("cast", x.values,
+                                                  dtype=value_dtype)
+    return C(idx, vals, x.shape)
+
+
+def cast_csr(x, index_dtype="", value_dtype=""):
+    _, S = _sp()
+    crows = x.crows if not index_dtype else Tensor(
+        x.crows._value.astype(index_dtype))
+    cols = x.cols if not index_dtype else Tensor(
+        x.cols._value.astype(index_dtype))
+    vals = x.values if not value_dtype else apply("cast", x.values,
+                                                  dtype=value_dtype)
+    return S(crows, cols, vals, x.shape)
+
+
+# ----------------------------------------------------------- structure ops
+
+def coalesce_coo(x):
+    """Sort indices row-major, merge duplicates (values segment-summed,
+    differentiable); structure on host, values on device."""
+    C, _ = _sp()
+    idx = _np_idx(x.indices)
+    if idx.shape[1] == 0:
+        return C(x.indices, x.values, x.shape)
+    lin = _linear(idx, x.shape)
+    order = np.argsort(lin, kind="stable")
+    sorted_lin = lin[order]
+    is_new = np.concatenate([[True], sorted_lin[1:] != sorted_lin[:-1]])
+    seg = np.cumsum(is_new) - 1
+    nseg = int(seg[-1]) + 1
+    new_idx = idx[:, order][:, is_new]
+    vals = apply("index_select_", x.values,
+                 Tensor(jnp.asarray(order)), axis=0)
+    merged = apply("segment_sum", vals, Tensor(jnp.asarray(seg)),
+                   num_segments=nseg)
+    return C(Tensor(jnp.asarray(new_idx)), merged, x.shape)
+
+
+def sparse_coo_tensor_kernel(indices, values, shape):
+    from . import sparse_coo_tensor
+    return sparse_coo_tensor(indices, values, shape)
+
+
+def to_dense_coo(x) -> Tensor:
+    sparse_nd = x.indices.shape[0]
+    sparse_shape = x.shape[:sparse_nd]
+    dense_shape = x.shape[sparse_nd:]
+    lin = _linear(_np_idx(x.indices), sparse_shape)
+    n = int(np.prod(sparse_shape))
+    flat = apply("segment_sum", x.values, Tensor(jnp.asarray(lin)),
+                 num_segments=n)
+    return apply("reshape", flat, shape=list(sparse_shape)
+                 + list(dense_shape))
+
+
+def to_dense_csr(x) -> Tensor:
+    return to_dense_coo(csr_to_coo(x))
+
+
+def csr_to_coo(x, sparse_dim=2):
+    C, _ = _sp()
+    crows = _np_idx(x.crows)
+    if len(x.shape) == 3:   # batched CSR [B, M, N]
+        b, m = x.shape[0], x.shape[1]
+        crows2 = crows.reshape(b, m + 1)
+        rows, batches = [], []
+        for bi in range(b):
+            r = _csr_rows(crows2[bi])
+            rows.append(r)
+            batches.append(np.full(len(r), bi))
+        rows = np.concatenate(rows) if rows else np.zeros(0, np.int64)
+        batches = np.concatenate(batches) if batches else \
+            np.zeros(0, np.int64)
+        idx = np.stack([batches, rows, _np_idx(x.cols)])
+    else:
+        rows = _csr_rows(crows)
+        idx = np.stack([rows, _np_idx(x.cols)])
+    return C(Tensor(jnp.asarray(idx.astype(np.int64))), x.values,
+             x.shape)
+
+
+def coo_to_csr(x):
+    _, S = _sp()
+    if len(x.shape) != 2:
+        raise ValueError("to_sparse_csr requires a 2-D sparse tensor")
+    x = coalesce_coo(x)
+    idx = _np_idx(x.indices)
+    crows = np.zeros(x.shape[0] + 1, np.int64)
+    np.add.at(crows, idx[0] + 1, 1)
+    crows = np.cumsum(crows)
+    return S(Tensor(jnp.asarray(crows)),
+             Tensor(jnp.asarray(idx[1].astype(np.int64))),
+             x.values, x.shape)
+
+
+def values_coo(x) -> Tensor:
+    return x.values
+
+
+def values_csr(x) -> Tensor:
+    return x.values
+
+
+def indices_coo(x) -> Tensor:
+    return x.indices
+
+
+def transpose_coo(x, perm):
+    C, _ = _sp()
+    idx = _np_idx(x.indices)
+    if len(perm) != idx.shape[0]:
+        raise ValueError("transpose perm must cover the sparse dims")
+    new_idx = idx[list(perm)]
+    new_shape = [x.shape[p] for p in perm]
+    return coalesce_coo(C(Tensor(jnp.asarray(new_idx)), x.values,
+                          new_shape))
+
+
+def transpose_csr(x, perm):
+    return coo_to_csr(transpose_coo(csr_to_coo(x), perm))
+
+
+def reshape_coo(x, shape):
+    C, _ = _sp()
+    sparse_nd = x.indices.shape[0]
+    if sparse_nd != len(x.shape):
+        raise ValueError("reshape supports fully-sparse COO only")
+    total = int(np.prod(x.shape))
+    shape = list(shape)
+    if -1 in shape:
+        known = int(np.prod([s for s in shape if s != -1]))
+        shape[shape.index(-1)] = total // known
+    lin = _linear(_np_idx(x.indices), x.shape)
+    new_idx = np.stack(np.unravel_index(lin, shape)).astype(np.int64)
+    return C(Tensor(jnp.asarray(new_idx)), x.values, shape)
+
+
+def mask_as_coo(x: Tensor, mask):
+    """Take dense x's entries at mask's sparsity (sparse output)."""
+    C, _ = _sp()
+    sparse_nd = mask.indices.shape[0]
+    lin = _linear(_np_idx(mask.indices), x.shape[:sparse_nd])
+    n_dense = x.shape[sparse_nd:]
+    flat = apply("reshape", x, shape=[int(np.prod(x.shape[:sparse_nd]))]
+                 + list(n_dense))
+    vals = apply("index_select_", flat, Tensor(jnp.asarray(lin)), axis=0)
+    return C(mask.indices, vals, mask.shape)
+
+
+def mask_as_csr(x: Tensor, mask):
+    return coo_to_csr(mask_as_coo(x, csr_to_coo(mask)))
+
+
+def full_like_coo(x, fill_value):
+    C, _ = _sp()
+    vals = apply("full_like_k", x.values, value=float(fill_value))
+    return C(x.indices, vals, x.shape)
+
+
+def full_like_csr(x, fill_value):
+    _, S = _sp()
+    vals = apply("full_like_k", x.values, value=float(fill_value))
+    return S(x.crows, x.cols, vals, x.shape)
+
+
+def slice_coo(x, axes, starts, ends):
+    C, _ = _sp()
+    idx = _np_idx(x.indices)
+    keep = np.ones(idx.shape[1], bool)
+    new_shape = list(x.shape)
+    offsets = np.zeros(idx.shape[0], np.int64)
+    for ax, st, en in zip(axes, starts, ends):
+        dim = x.shape[ax]
+        st = st if st >= 0 else st + dim
+        en = en if en >= 0 else en + dim
+        st = min(max(st, 0), dim)      # clamp (paddle slice semantics)
+        en = min(max(en, st), dim)
+        keep &= (idx[ax] >= st) & (idx[ax] < en)
+        offsets[ax] = st
+        new_shape[ax] = en - st
+    pos = np.nonzero(keep)[0]
+    new_idx = idx[:, pos] - offsets[:, None]
+    vals = apply("index_select_", x.values,
+                 Tensor(jnp.asarray(pos.astype(np.int64))), axis=0)
+    return C(Tensor(jnp.asarray(new_idx)), vals, new_shape)
+
+
+# -------------------------------------------------------------- binary ops
+
+def _binary_coo(x, y, combine: str):
+    """Union-merge elementwise op on COO operands (add/subtract)."""
+    C, _ = _sp()
+    if list(x.shape) != list(y.shape):
+        raise ValueError("sparse binary op: shape mismatch")
+    yv = y.values if combine == "add" else apply("scale", y.values, scale=-1.0, bias=0.0,
+                                                 bias_after_scale=True)
+    idx = np.concatenate([_np_idx(x.indices), _np_idx(y.indices)], 1)
+    vals = apply("concat_", x.values, yv, axis=0)
+    return coalesce_coo(C(Tensor(jnp.asarray(idx)), vals, x.shape))
+
+
+def add_coo(x, y):
+    return _binary_coo(x, y, "add")
+
+
+def subtract_coo(x, y):
+    return _binary_coo(x, y, "subtract")
+
+
+def add_csr(x, y):
+    return coo_to_csr(add_coo(csr_to_coo(x), csr_to_coo(y)))
+
+
+def subtract_csr(x, y):
+    return coo_to_csr(subtract_coo(csr_to_coo(x), csr_to_coo(y)))
+
+
+def _intersect_coo(x, y):
+    """Positions of the common sparsity pattern after coalescing."""
+    x = coalesce_coo(x)
+    y = coalesce_coo(y)
+    lx = _linear(_np_idx(x.indices), x.shape)
+    ly = _linear(_np_idx(y.indices), y.shape)
+    common, ix, iy = np.intersect1d(lx, ly, return_indices=True)
+    return x, y, ix.astype(np.int64), iy.astype(np.int64)
+
+
+def multiply_coo(x, y):
+    C, _ = _sp()
+    if list(x.shape) != list(y.shape):
+        raise ValueError("sparse multiply: shape mismatch")
+    x, y, ix, iy = _intersect_coo(x, y)
+    xv = apply("index_select_", x.values, Tensor(jnp.asarray(ix)), axis=0)
+    yv = apply("index_select_", y.values, Tensor(jnp.asarray(iy)), axis=0)
+    new_idx = _np_idx(x.indices)[:, ix]
+    return C(Tensor(jnp.asarray(new_idx)),
+             apply("multiply", xv, yv), x.shape)
+
+
+def divide_coo(x, y):
+    C, _ = _sp()
+    x, y, ix, iy = _intersect_coo(x, y)
+    if len(ix) != x.values.shape[0] or len(iy) != y.values.shape[0]:
+        raise ValueError(
+            "sparse divide requires identical sparsity patterns")
+    xv = apply("index_select_", x.values, Tensor(jnp.asarray(ix)), axis=0)
+    yv = apply("index_select_", y.values, Tensor(jnp.asarray(iy)), axis=0)
+    return C(Tensor(jnp.asarray(_np_idx(x.indices)[:, ix])),
+             apply("divide", xv, yv), x.shape)
+
+
+def multiply_csr(x, y):
+    return coo_to_csr(multiply_coo(csr_to_coo(x), csr_to_coo(y)))
+
+
+def divide_csr(x, y):
+    return coo_to_csr(divide_coo(csr_to_coo(x), csr_to_coo(y)))
+
+
+def divide_scalar_coo(x, scalar):
+    C, _ = _sp()
+    return C(x.indices, apply("scale", x.values, scale=1.0 / scalar, bias=0.0,
+                   bias_after_scale=True), x.shape)
+
+
+def divide_scalar_csr(x, scalar):
+    _, S = _sp()
+    return S(x.crows, x.cols, apply("scale", x.values, scale=1.0 / scalar,
+                                    bias=0.0, bias_after_scale=True),
+             x.shape)
+
+
+# ------------------------------------------------------------ matmul family
+
+def matmul_coo(x, y: Tensor) -> Tensor:
+    """sparse [M, K] @ dense [K, N] -> dense [M, N]: gather rows of y at
+    the stored columns, scale by values, segment-sum into output rows —
+    the one-hot-matmul form XLA maps to the MXU."""
+    rows = Tensor(jnp.asarray(_np_idx(x.indices)[0]))
+    cols = Tensor(jnp.asarray(_np_idx(x.indices)[1]))
+    gathered = apply("index_select_", y, cols, axis=0)     # [nnz, N]
+    vals = x.values
+    if len(y.shape) > 1:
+        vals = apply("reshape", vals, shape=[vals.shape[0], 1])
+    contrib = apply("multiply", vals, gathered)
+    return apply("segment_sum", contrib, rows, num_segments=x.shape[0])
+
+
+def matmul_csr(x, y: Tensor) -> Tensor:
+    return matmul_coo(csr_to_coo(x), y)
+
+
+def mv_coo(x, vec: Tensor) -> Tensor:
+    return matmul_coo(x, vec)
+
+
+def mv_csr(x, vec: Tensor) -> Tensor:
+    return matmul_coo(csr_to_coo(x), vec)
+
+
+def addmm_coo(input, x: Tensor, y: Tensor, beta=1.0, alpha=1.0) -> Tensor:
+    """beta * input + alpha * (x @ y); sparse input, dense x/y -> dense."""
+    prod = apply("matmul", x, y, transpose_x=False,
+                 transpose_y=False)
+    return apply("add",
+                 apply("scale", to_dense_coo(input), scale=beta,
+                       bias=0.0, bias_after_scale=True),
+                 apply("scale", prod, scale=alpha, bias=0.0,
+                       bias_after_scale=True))
+
+
+def addmm_csr(input, x: Tensor, y: Tensor, beta=1.0, alpha=1.0) -> Tensor:
+    return addmm_coo(csr_to_coo(input), x, y, beta, alpha)
+
+
+def masked_matmul_coo(x: Tensor, y: Tensor, mask):
+    """(x @ y) evaluated ONLY at mask's sparsity -> sparse out. Never
+    materializes the dense product."""
+    C, _ = _sp()
+    rows = Tensor(jnp.asarray(_np_idx(mask.indices)[0]))
+    cols = Tensor(jnp.asarray(_np_idx(mask.indices)[1]))
+    xg = apply("index_select_", x, rows, axis=0)           # [nnz, K]
+    yt = apply("transpose", y, perm=[1, 0])
+    yg = apply("index_select_", yt, cols, axis=0)          # [nnz, K]
+    vals = apply("sum_", apply("multiply", xg, yg), axis=[-1],
+                 keepdim=False)
+    return C(mask.indices, vals, mask.shape)
+
+
+def masked_matmul_csr(x: Tensor, y: Tensor, mask):
+    return coo_to_csr(masked_matmul_coo(x, y, csr_to_coo(mask)))
+
+
+# --------------------------------------------------------- reductions / nn
+
+def sum_coo(x, axis=None, keepdim=False):
+    C, _ = _sp()
+    if axis is None:
+        return apply("sum_", x.values, axis=None, keepdim=bool(keepdim))
+    ax = axis if axis >= 0 else axis + len(x.shape)
+    sparse_nd = x.indices.shape[0]
+    if ax >= sparse_nd:
+        # dense-dim reduction: values-wise
+        vals = apply("sum_", x.values, axis=[ax - sparse_nd + 1],
+                     keepdim=bool(keepdim))
+        shape = [s for d, s in enumerate(x.shape)
+                 if d != ax or keepdim]
+        if keepdim:
+            shape = list(x.shape)
+            shape[ax] = 1
+        return C(x.indices, vals, shape)
+    idx = np.delete(_np_idx(x.indices), ax, axis=0)
+    if keepdim:
+        idx = np.insert(idx, ax, 0, axis=0)
+        shape = list(x.shape)
+        shape[ax] = 1
+    else:
+        shape = [s for d, s in enumerate(x.shape) if d != ax]
+    return coalesce_coo(C(Tensor(jnp.asarray(idx)), x.values, shape))
+
+
+def sum_csr(x, axis=None, keepdim=False):
+    out = sum_coo(csr_to_coo(x), axis, keepdim)
+    if isinstance(out, Tensor):
+        return out
+    return coo_to_csr(out) if len(out.shape) == 2 else out
+
+
+def softmax_csr(x, axis=-1):
+    """Row-wise softmax over the STORED entries (absent entries are
+    -inf, the reference's sparse softmax semantics)."""
+    _, S = _sp()
+    if axis not in (-1, len(x.shape) - 1):
+        raise ValueError("sparse softmax supports the last axis only")
+    crows = _np_idx(x.crows)
+    if len(x.shape) == 3:
+        b, m = x.shape[0], x.shape[1]
+        rows = []
+        for bi in range(b):
+            rows.append(_csr_rows(crows.reshape(b, m + 1)[bi]) + bi * m)
+        rows = np.concatenate(rows)
+        nrows = b * m
+    else:
+        rows = _csr_rows(crows)
+        nrows = x.shape[0]
+    seg = Tensor(jnp.asarray(rows))
+    vals = x.values
+    mx = apply("segment_max", vals, seg, num_segments=nrows)
+    mx = Tensor(jnp.where(jnp.isfinite(mx._value), mx._value, 0.0))
+    shifted = apply("subtract", vals,
+                    apply("index_select_", mx.detach(), seg, axis=0))
+    e = apply("exp", shifted)
+    den = apply("segment_sum", e, seg, num_segments=nrows)
+    out = apply("divide", e, apply("index_select_", den, seg, axis=0))
+    return S(x.crows, x.cols, out, x.shape)
+
+
+def softmax_coo(x, axis=-1):
+    return csr_to_coo(softmax_csr(coo_to_csr(x), axis))
+
+
+def fused_attention_csr(query: Tensor, key: Tensor, value: Tensor,
+                        sparse_mask, key_padding_mask=None,
+                        attn_mask=None) -> Tensor:
+    """Attention evaluated only at sparse_mask's stored positions
+    (reference sparse fused_attention: q/k/v [B*H, S, D], csr mask,
+    2-D shared or 3-D per-batch). Scores, softmax, and the weighted sum
+    all run at nnz cost."""
+    if len(query.shape) != 3:
+        raise ValueError("fused_attention expects q/k/v [batch*heads, "
+                         "seq, head_dim]")
+    bh, s_len, d = query.shape
+    scale = 1.0 / float(np.sqrt(d))
+    coo = csr_to_coo(sparse_mask)
+    idx = _np_idx(coo.indices)
+    rows_np, cols_np = idx[-2], idx[-1]
+
+    if len(sparse_mask.shape) == 3:
+        # per-batch sparsity: gather (b, pos) pairs and segment by the
+        # GLOBAL row b*S + r — within-batch rows must never mix
+        if sparse_mask.shape[0] != bh:
+            raise ValueError("batched sparse_mask batch dim must equal "
+                             "q/k/v leading dim")
+        b_np = idx[0]
+        qg = apply("gather_nd_", query, Tensor(jnp.asarray(
+            np.stack([b_np, rows_np], 1))))            # [nnz, D]
+        kg = apply("gather_nd_", key, Tensor(jnp.asarray(
+            np.stack([b_np, cols_np], 1))))
+        vg = apply("gather_nd_", value, Tensor(jnp.asarray(
+            np.stack([b_np, cols_np], 1))))
+        scores = apply("sum_", apply("multiply", qg, kg), axis=[-1],
+                       keepdim=False)                  # [nnz]
+        scores = apply("scale", scores, scale=scale, bias=0.0,
+                       bias_after_scale=True)
+        if attn_mask is not None:
+            am = np.asarray(attn_mask._value)[rows_np, cols_np]
+            scores = apply("add", scores, Tensor(jnp.asarray(am)))
+        if key_padding_mask is not None:
+            kp = np.asarray(key_padding_mask._value)
+            if kp.ndim == 2:
+                scores = apply("add", scores,
+                               Tensor(jnp.asarray(kp[b_np, cols_np])))
+        seg_np = b_np * s_len + rows_np
+        seg = Tensor(jnp.asarray(seg_np))
+        nseg = bh * s_len
+        mx = apply("segment_max", scores, seg, num_segments=nseg)
+        mx = Tensor(jnp.where(jnp.isfinite(mx._value), mx._value, 0.0))
+        shifted = apply("subtract", scores,
+                        apply("index_select_", mx.detach(), seg, axis=0))
+        e = apply("exp", shifted)
+        den = apply("segment_sum", e, seg, num_segments=nseg)
+        p = apply("divide", e,
+                  apply("index_select_", den, seg, axis=0))   # [nnz]
+        pe = apply("reshape", p, shape=[p.shape[0], 1])
+        contrib = apply("multiply", pe, vg)            # [nnz, D]
+        out = apply("segment_sum", contrib, seg, num_segments=nseg)
+        return apply("reshape", out, shape=[bh, s_len, d])
+
+    rows = Tensor(jnp.asarray(rows_np))
+    cols = Tensor(jnp.asarray(cols_np))
+    qg = apply("index_select_", query, rows, axis=1)   # [BH, nnz, D]
+    kg = apply("index_select_", key, cols, axis=1)
+    scores = apply("sum_", apply("multiply", qg, kg), axis=[-1],
+                   keepdim=False)                      # [BH, nnz]
+    scores = apply("scale", scores, scale=scale, bias=0.0,
+                   bias_after_scale=True)
+    if attn_mask is not None:
+        am = np.asarray(attn_mask._value)[rows_np, cols_np]
+        scores = apply("add", scores, Tensor(jnp.asarray(am)))
+    if key_padding_mask is not None:
+        kp = np.asarray(key_padding_mask._value)
+        if kp.ndim == 2:   # [BH, S] additive mask at key positions
+            scores = apply("add", scores,
+                           Tensor(jnp.asarray(kp[:, cols_np])))
+
+    # per-(bh, row) softmax: segment ops run on the leading axis
+    scores_t = apply("transpose", scores, perm=[1, 0])  # [nnz, BH]
+    seg = Tensor(jnp.asarray(rows_np))
+    mx = apply("segment_max", scores_t, seg, num_segments=s_len)
+    mx = Tensor(jnp.where(jnp.isfinite(mx._value), mx._value, 0.0))
+    shifted = apply("subtract", scores_t,
+                    apply("index_select_", mx.detach(), seg, axis=0))
+    e = apply("exp", shifted)
+    den = apply("segment_sum", e, seg, num_segments=s_len)
+    p = apply("divide", e, apply("index_select_", den, seg, axis=0))
+
+    vg = apply("index_select_", value, cols, axis=1)   # [BH, nnz, D]
+    vg_t = apply("transpose", vg, perm=[1, 0, 2])      # [nnz, BH, D]
+    pe = apply("reshape", p, shape=[p.shape[0], p.shape[1], 1])
+    contrib = apply("multiply", pe, vg_t)
+    out = apply("segment_sum", contrib, seg, num_segments=s_len)
+    return apply("transpose", out, perm=[1, 0, 2])     # [BH, S, D]
+
+
+def isnan_coo(x):
+    C, _ = _sp()
+    return C(x.indices, apply("isnan", x.values), x.shape)
+
+
+def isnan_csr(x):
+    _, S = _sp()
+    return S(x.crows, x.cols, apply("isnan", x.values), x.shape)

@@ -1,0 +1,139 @@
+"""Sparse op registry with layout-keyed dispatch.
+
+The reference registers sparse kernels into its KernelFactory with the
+LAYOUT component of the KernelKey selecting `abs_coo` vs `abs_csr`
+(paddle/phi/kernels/sparse/, kernel_factory.h:58). The TPU-native form:
+sparse kernels are COMPOSITIONS over the dense op registry applied to
+the storage components (values carry autograd through the ordinary
+eager engine; index structure is computed host-side because XLA needs
+static shapes), registered here per layout, and
+`paddle_tpu/ops/yaml/sparse_ops.yaml` is the system of record — an op
+registered without a schema entry raises, and the import-time
+completeness check fails on either direction of drift (the same
+contract ops.yaml has for the dense registry).
+"""
+from __future__ import annotations
+
+import inspect
+import os
+from typing import Callable, Dict, List, Optional
+
+from ..ops.yaml.gen import OpEntry, load_schema
+
+_SPARSE_YAML = os.path.join(os.path.dirname(__file__), "..", "ops",
+                            "yaml", "sparse_ops.yaml")
+
+_SCHEMA: Optional[Dict[str, OpEntry]] = None
+_SPARSE_OPS: Dict[str, "SparseOpDef"] = {}
+
+
+def schema() -> Dict[str, OpEntry]:
+    global _SCHEMA
+    if _SCHEMA is None:
+        _SCHEMA = load_schema(_SPARSE_YAML)
+    return _SCHEMA
+
+
+class SparseOpDef:
+    __slots__ = ("name", "kernels", "entry")
+
+    def __init__(self, name: str, kernels: Dict[str, Callable],
+                 entry: OpEntry):
+        self.name = name
+        self.kernels = kernels       # layout -> callable
+        self.entry = entry
+
+
+def register_sparse_op(name: str, coo: Callable = None,
+                       csr: Callable = None) -> SparseOpDef:
+    """Register per-layout kernel bodies. The name MUST be declared in
+    sparse_ops.yaml with matching layouts."""
+    ent = schema().get(name)
+    if ent is None:
+        raise ValueError(
+            f"sparse op '{name}' is not declared in sparse_ops.yaml — "
+            f"the schema is the system of record; add an entry first")
+    kernels = {}
+    if coo is not None:
+        kernels["coo"] = coo
+    if csr is not None:
+        kernels["csr"] = csr
+    declared = set(ent.layouts or [])
+    if set(kernels) != declared:
+        raise ValueError(
+            f"sparse op '{name}': registered layouts {sorted(kernels)} "
+            f"!= declared layouts {sorted(declared)}")
+    d = SparseOpDef(name, kernels, ent)
+    _SPARSE_OPS[name] = d
+    return d
+
+
+def get_sparse_op(name: str) -> SparseOpDef:
+    return _SPARSE_OPS[name]
+
+
+def all_sparse_ops() -> List[str]:
+    return sorted(_SPARSE_OPS)
+
+
+def dispatch(name: str, x, *args, **kwargs):
+    """Select the kernel by the first operand's storage layout."""
+    from . import SparseCooTensor, SparseCsrTensor
+    op = _SPARSE_OPS.get(name)
+    if op is None:
+        raise KeyError(f"unknown sparse op '{name}'")
+    if isinstance(x, SparseCooTensor):
+        layout = "coo"
+    elif isinstance(x, SparseCsrTensor):
+        layout = "csr"
+    else:
+        raise TypeError(
+            f"sparse.{name} expects a sparse tensor first operand, got "
+            f"{type(x).__name__}")
+    fn = op.kernels.get(layout)
+    if fn is None:
+        raise TypeError(
+            f"sparse.{name} has no {layout} kernel (declared layouts: "
+            f"{sorted(op.kernels)})")
+    return fn(x, *args, **kwargs)
+
+
+def validate() -> List[str]:
+    """Schema/registry consistency (the gen.validate analog)."""
+    problems = []
+    for name, ent in schema().items():
+        op = _SPARSE_OPS.get(name)
+        if op is None:
+            problems.append(f"{name}: declared but not registered")
+            continue
+        for layout, fn in op.kernels.items():
+            try:
+                sig = inspect.signature(fn)
+                params = list(sig.parameters)
+            except (TypeError, ValueError):
+                continue
+            has_kw = any(p.kind == inspect.Parameter.VAR_KEYWORD
+                         for p in sig.parameters.values())
+            for a, _, _ in ent.attrs:
+                if a not in params and not has_kw:
+                    problems.append(
+                        f"{name}[{layout}]: attr '{a}' not a kernel "
+                        f"parameter ({params})")
+            n_tensor = len(ent.tensor_args)
+            if len(params) < n_tensor:
+                problems.append(
+                    f"{name}[{layout}]: {n_tensor} tensor args but "
+                    f"kernel takes {len(params)}")
+    return problems
+
+
+def check_complete() -> None:
+    """Import-time two-way drift check (ops.yaml contract)."""
+    declared = set(schema())
+    registered = set(_SPARSE_OPS)
+    missing = sorted(declared - registered)
+    undeclared = sorted(registered - declared)
+    if missing or undeclared:
+        raise RuntimeError(
+            "sparse_ops.yaml disagrees with the sparse registry — "
+            f"unregistered: {missing[:8]}; undeclared: {undeclared[:8]}")

@@ -375,3 +375,57 @@ def test_lazy_guard_error_path_materializes():
     except ValueError:
         pass
     np.testing.assert_allclose(y.numpy(), [2.0, 2.0])  # not poisoned
+
+
+def test_grad_mode_not_baked_into_fast_path():
+    """An entry captured under no_grad must not serve a training call."""
+    net = nn.Linear(6, 3)
+
+    def fwd(m, inp):
+        return (m(inp) ** 2).mean()
+
+    sfn = symbolic_translate(fwd)
+    x = _x(30, (2, 6))
+    with paddle.no_grad():
+        _ = sfn(net, x)          # warmup captured WITHOUT grads
+    loss = sfn(net, x)           # training call
+    loss.backward()
+    assert net.weight.grad is not None
+    net.weight.clear_grad()
+    # and eval again: served by the no-grad entry, no graph built
+    with paddle.no_grad():
+        out = sfn(net, x)
+    assert out.stop_gradient
+
+
+def test_is_comparison_on_tracked_object_guarded():
+    class Cfg:
+        mode = "a"
+
+    cfg = Cfg()
+
+    def fn(c, x):
+        if c.mode is _MODE_A:
+            return x * 2.0
+        return x * 100.0
+
+    cfg.mode = _MODE_A
+    sfn = symbolic_translate(fn)
+    x = _x(31)
+    np.testing.assert_allclose(sfn(cfg, x).numpy(), (x * 2.0).numpy())
+    cfg.mode = _MODE_B
+    np.testing.assert_allclose(sfn(cfg, x).numpy(), (x * 100.0).numpy())
+
+
+_MODE_A = object()
+_MODE_B = object()
+
+
+def test_detached_alias_stays_detached_under_lazy():
+    from paddle_tpu._core import lazy as _lz
+    x = paddle.to_tensor(np.ones((2,), "float32"))
+    x.stop_gradient = False
+    with _lz.lazy_guard():
+        y = (x * 2.0).detach()   # the undetached temp dies immediately
+    assert y.stop_gradient
+    assert y._autograd_meta.grad_node is None

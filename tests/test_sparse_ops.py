@@ -1,0 +1,394 @@
+"""Sparse op family: forward vs dense oracle + grad checks.
+
+Mirrors the reference's sparse OpTests (test/legacy_test/
+test_sparse_*_op.py): every op runs the same computation densely, and
+the VALUES gradient of the sparse path must match the dense gradient
+projected onto the sparsity pattern.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.sparse as sparse
+from paddle_tpu.sparse import SparseCooTensor, SparseCsrTensor
+
+
+def _coo(seed=0, shape=(4, 5), nnz=6, positive=False):
+    r = np.random.RandomState(seed)
+    # unique positions
+    lin = r.choice(shape[0] * shape[1], size=nnz, replace=False)
+    idx = np.stack(np.unravel_index(lin, shape)).astype(np.int64)
+    vals = r.randn(nnz).astype("float32")
+    if positive:
+        vals = np.abs(vals) + 0.5
+    return sparse.sparse_coo_tensor(idx, vals, shape=list(shape))
+
+
+def _dense_of(sp):
+    return np.asarray(sp.to_dense().numpy())
+
+
+# ------------------------------------------------------------ registry
+
+def test_registry_is_system_of_record():
+    from paddle_tpu.sparse.registry import (all_sparse_ops,
+                                            register_sparse_op, validate)
+    assert len(all_sparse_ops()) >= 40
+    assert validate() == []
+    with pytest.raises(ValueError):
+        register_sparse_op("not_a_declared_sparse_op", coo=lambda x: x)
+
+
+def test_layout_dispatch_errors():
+    s = _coo()
+    with pytest.raises(TypeError):
+        sparse.reshape(s.to_sparse_csr(), shape=[20])  # coo-only op
+    with pytest.raises(TypeError):
+        sparse.abs(paddle.to_tensor([1.0]))            # dense operand
+
+
+# ------------------------------------------------------------ unary ops
+
+@pytest.mark.parametrize("name", ["abs", "sin", "sinh", "tan", "tanh",
+                                  "asin", "asinh", "atan", "square",
+                                  "sqrt", "log1p", "expm1", "relu",
+                                  "relu6", "leaky_relu"])
+def test_unary_matches_dense_and_grads(name):
+    positive = name in ("sqrt", "log1p")
+    s = _coo(seed=hash(name) % 1000, positive=positive)
+    if positive:
+        # keep |values| < 1 domains valid for asin/atanh-style ops
+        pass
+    s.values.stop_gradient = False
+    out = getattr(sparse, name)(s)
+    assert isinstance(out, SparseCooTensor)
+
+    vals = paddle.to_tensor(s.values.numpy())
+    vals.stop_gradient = False
+    import paddle_tpu.ops.generated as G
+    dense_fn = getattr(G, name)
+    ref = dense_fn(vals, negative_slope=0.01) if name == "leaky_relu" \
+        else dense_fn(vals)
+    np.testing.assert_allclose(np.asarray(out.values.numpy()),
+                               np.asarray(ref.numpy()), rtol=1e-5,
+                               atol=1e-6)
+    # grad parity through the values component
+    out.values.sum().backward()
+    ref.sum().backward()
+    np.testing.assert_allclose(np.asarray(s.values.grad.numpy()),
+                               np.asarray(vals.grad.numpy()), rtol=1e-5,
+                               atol=1e-6)
+    # csr path agrees
+    c = _coo(seed=hash(name) % 1000, positive=positive).to_sparse_csr()
+    outc = getattr(sparse, name)(c)
+    assert isinstance(outc, SparseCsrTensor)
+
+
+def test_asin_atanh_domain():
+    idx = [[0, 1], [1, 0]]
+    s = sparse.sparse_coo_tensor(idx, [0.3, -0.5], shape=[2, 2])
+    np.testing.assert_allclose(
+        np.asarray(sparse.asin(s).values.numpy()),
+        np.arcsin([0.3, -0.5]), rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(sparse.atanh(s).values.numpy()),
+        np.arctanh([0.3, -0.5]), rtol=1e-6)
+
+
+def test_pow_scale_cast_isnan():
+    s = _coo(seed=3, positive=True)
+    np.testing.assert_allclose(
+        np.asarray(sparse.pow(s, factor=2.0).values.numpy()),
+        np.asarray(s.values.numpy()) ** 2, rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(sparse.scale(s, scale=3.0, bias=1.0).values.numpy()),
+        np.asarray(s.values.numpy()) * 3 + 1, rtol=1e-5)
+    c = sparse.cast(s, value_dtype="float64")
+    assert str(c.values.dtype) in ("paddle_tpu.float64", "float64") or \
+        "64" in str(c.values.dtype)
+    n = sparse.isnan(s)
+    assert not np.asarray(n.values.numpy()).any()
+
+
+# ------------------------------------------------------------ binary ops
+
+def test_add_subtract_union_and_grads():
+    a = _coo(seed=1, nnz=5)
+    b = _coo(seed=2, nnz=5)
+    a.values.stop_gradient = False
+    b.values.stop_gradient = False
+    out = sparse.add(a, b)
+    np.testing.assert_allclose(_dense_of(out),
+                               _dense_of(a) + _dense_of(b), rtol=1e-5)
+    out.values.sum().backward()
+    # every stored value contributes exactly once to the union sum
+    np.testing.assert_allclose(np.asarray(a.values.grad.numpy()),
+                               np.ones(a.nnz()), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(b.values.grad.numpy()),
+                               np.ones(b.nnz()), rtol=1e-6)
+
+    sub = sparse.subtract(_coo(seed=1, nnz=5), _coo(seed=2, nnz=5))
+    np.testing.assert_allclose(_dense_of(sub),
+                               _dense_of(a) - _dense_of(b), rtol=1e-5)
+
+
+def test_multiply_intersection_and_grads():
+    a = _coo(seed=4, nnz=8)
+    b = _coo(seed=5, nnz=8)
+    a.values.stop_gradient = False
+    out = sparse.multiply(a, b)
+    np.testing.assert_allclose(_dense_of(out),
+                               _dense_of(a) * _dense_of(b), rtol=1e-5)
+    if out.nnz():
+        out.values.sum().backward()
+        assert a.values.grad is not None
+
+
+def test_divide_same_pattern_and_scalar():
+    idx = [[0, 1, 2], [1, 2, 0]]
+    a = sparse.sparse_coo_tensor(idx, [2.0, 6.0, 9.0], shape=[3, 3])
+    b = sparse.sparse_coo_tensor(idx, [2.0, 3.0, 3.0], shape=[3, 3])
+    out = sparse.divide(a, b)
+    np.testing.assert_allclose(np.sort(np.asarray(out.values.numpy())),
+                               [1.0, 2.0, 3.0])
+    with pytest.raises(ValueError):
+        sparse.divide(a, _coo(seed=9, shape=(3, 3), nnz=2))
+    half = sparse.divide_scalar(a, 2.0)
+    np.testing.assert_allclose(np.sort(np.asarray(half.values.numpy())),
+                               [1.0, 3.0, 4.5])
+
+
+# ------------------------------------------------------------ matmul
+
+def test_matmul_coo_csr_grads():
+    s = _coo(seed=6, shape=(4, 5), nnz=7)
+    s.values.stop_gradient = False
+    y = paddle.to_tensor(np.random.RandomState(7).randn(5, 3)
+                         .astype("float32"))
+    y.stop_gradient = False
+    out = sparse.matmul(s, y)
+    ref = _dense_of(s) @ y.numpy()
+    np.testing.assert_allclose(np.asarray(out.numpy()), ref, rtol=1e-5)
+
+    out.sum().backward()
+    # d out.sum / dy == column-sums of dense(s)
+    np.testing.assert_allclose(np.asarray(y.grad.numpy()),
+                               np.broadcast_to(
+                                   _dense_of(s).sum(0)[:, None],
+                                   (5, 3)), rtol=1e-5)
+    assert s.values.grad is not None
+
+    csr = _coo(seed=6, shape=(4, 5), nnz=7).to_sparse_csr()
+    out2 = sparse.matmul(csr, paddle.to_tensor(y.numpy()))
+    np.testing.assert_allclose(np.asarray(out2.numpy()), ref, rtol=1e-5)
+
+
+def test_mv_addmm_masked_matmul():
+    s = _coo(seed=8, shape=(4, 5), nnz=6)
+    v = paddle.to_tensor(np.random.RandomState(9).randn(5)
+                         .astype("float32"))
+    np.testing.assert_allclose(np.asarray(sparse.mv(s, v).numpy()),
+                               _dense_of(s) @ v.numpy(), rtol=1e-5)
+
+    r = np.random.RandomState(10)
+    x = paddle.to_tensor(r.randn(4, 3).astype("float32"))
+    y = paddle.to_tensor(r.randn(3, 5).astype("float32"))
+    out = sparse.addmm(s, x, y, beta=0.5, alpha=2.0)
+    np.testing.assert_allclose(
+        np.asarray(out.numpy()),
+        0.5 * _dense_of(s) + 2.0 * (x.numpy() @ y.numpy()), rtol=1e-4)
+
+    xm = paddle.to_tensor(r.randn(4, 6).astype("float32"))
+    ym = paddle.to_tensor(r.randn(6, 5).astype("float32"))
+    xm.stop_gradient = False
+    mm = sparse.masked_matmul(xm, ym, s)
+    assert isinstance(mm, SparseCooTensor)
+    full = xm.numpy() @ ym.numpy()
+    mask = (_dense_of(s) != 0)
+    np.testing.assert_allclose(_dense_of(mm), full * mask, rtol=1e-4)
+    mm.values.sum().backward()
+    assert xm.grad is not None
+
+
+# ------------------------------------------------------- reductions / nn
+
+def test_sum_axes():
+    s = _coo(seed=11, shape=(4, 5), nnz=6)
+    d = _dense_of(s)
+    np.testing.assert_allclose(
+        float(sparse.sum(s).numpy()), d.sum(), rtol=1e-5)
+    out0 = sparse.sum(s, axis=0)
+    np.testing.assert_allclose(_dense_of(out0), d.sum(0), rtol=1e-5)
+    out1 = sparse.sum(s, axis=1)
+    np.testing.assert_allclose(_dense_of(out1), d.sum(1), rtol=1e-5)
+
+
+def test_softmax_csr_matches_dense_and_grads():
+    s = _coo(seed=12, shape=(4, 6), nnz=10)
+    csr = s.to_sparse_csr()
+    csr.values.stop_gradient = False
+    out = sparse.softmax(csr)
+    d = _dense_of(s)
+    mask = d != 0
+    dd = np.where(mask, d, -np.inf)
+    e = np.exp(dd - np.nanmax(np.where(mask, dd, np.nan), axis=1,
+                              keepdims=True, initial=None)
+               if False else dd - dd.max(1, keepdims=True))
+    e = np.where(mask, e, 0)
+    rows_with = mask.any(1)
+    ref = np.zeros_like(d)
+    ref[rows_with] = e[rows_with] / e[rows_with].sum(1, keepdims=True)
+    np.testing.assert_allclose(_dense_of(out), ref, rtol=1e-4,
+                               atol=1e-6)
+    out.values.sum().backward()
+    assert csr.values.grad is not None
+
+
+def test_fused_attention_matches_dense():
+    r = np.random.RandomState(13)
+    bh, s_len, d = 2, 6, 4
+    q = paddle.to_tensor(r.randn(bh, s_len, d).astype("float32"))
+    k = paddle.to_tensor(r.randn(bh, s_len, d).astype("float32"))
+    v = paddle.to_tensor(r.randn(bh, s_len, d).astype("float32"))
+    q.stop_gradient = False
+    # causal sparsity pattern as the mask
+    rows, cols = np.tril_indices(s_len)
+    mask_coo = sparse.sparse_coo_tensor(
+        np.stack([rows, cols]), np.ones(len(rows), "float32"),
+        shape=[s_len, s_len])
+    mask = mask_coo.to_sparse_csr()
+
+    out = sparse.fused_attention(q, k, v, mask)
+    # dense oracle
+    qn, kn, vn = q.numpy(), k.numpy(), v.numpy()
+    scores = np.einsum("bsd,btd->bst", qn, kn) / np.sqrt(d)
+    dense_mask = np.tril(np.ones((s_len, s_len))) > 0
+    scores = np.where(dense_mask, scores, -np.inf)
+    p = np.exp(scores - scores.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    ref = np.einsum("bst,btd->bsd", p, vn)
+    np.testing.assert_allclose(np.asarray(out.numpy()), ref, rtol=1e-4,
+                               atol=1e-5)
+    out.sum().backward()
+    assert q.grad is not None
+
+
+# ----------------------------------------------------------- structure
+
+def test_coalesce_merges_duplicates_with_grads():
+    idx = [[0, 0, 1], [1, 1, 2]]
+    vals = paddle.to_tensor(np.array([1.0, 2.0, 5.0], "float32"))
+    vals.stop_gradient = False
+    s = sparse.sparse_coo_tensor(idx, vals, shape=[2, 3])
+    c = sparse.coalesce(s)
+    assert c.nnz() == 2
+    np.testing.assert_allclose(np.sort(np.asarray(c.values.numpy())),
+                               [3.0, 5.0])
+    c.values.sum().backward()
+    np.testing.assert_allclose(np.asarray(vals.grad.numpy()),
+                               [1.0, 1.0, 1.0])
+
+
+def test_transpose_reshape_slice_mask_as_full_like():
+    s = _coo(seed=14, shape=(4, 5), nnz=6)
+    d = _dense_of(s)
+    t = sparse.transpose(s, perm=[1, 0])
+    np.testing.assert_allclose(_dense_of(t), d.T, rtol=1e-6)
+    rs = sparse.reshape(s, shape=[20])
+    np.testing.assert_allclose(_dense_of(rs), d.reshape(20), rtol=1e-6)
+    sl = sparse.slice(s, axes=[0, 1], starts=[1, 0], ends=[3, 4])
+    np.testing.assert_allclose(_dense_of(sl), d[1:3, 0:4], rtol=1e-6)
+
+    dense = paddle.to_tensor(np.arange(20, dtype="float32")
+                             .reshape(4, 5))
+    m = sparse.mask_as(dense, s)
+    np.testing.assert_allclose(
+        _dense_of(m), np.where(d != 0, dense.numpy(), 0), rtol=1e-6)
+
+    fl = sparse.full_like(s, 7.0)
+    np.testing.assert_allclose(np.asarray(fl.values.numpy()),
+                               np.full(s.nnz(), 7.0))
+
+
+def test_roundtrips_and_component_ops():
+    s = _coo(seed=15, shape=(4, 5), nnz=6)
+    csr = s.to_sparse_csr()
+    back = csr.to_sparse_coo()
+    np.testing.assert_allclose(_dense_of(back), _dense_of(s), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(sparse.values(s).numpy()),
+                               np.asarray(s.values.numpy()))
+    assert sparse.indices(s).shape == [2, s.nnz()]
+
+
+def test_sparse_nn_layers():
+    s = _coo(seed=16, shape=(3, 3), nnz=4)
+    out = sparse.nn.ReLU()(s)
+    np.testing.assert_allclose(
+        np.asarray(out.values.numpy()),
+        np.maximum(np.asarray(s.values.numpy()), 0), rtol=1e-6)
+    bn = sparse.nn.BatchNorm(num_features=2)
+    vals = np.random.RandomState(17).randn(5, 2).astype("float32")
+    idx = np.stack([np.arange(5), np.arange(5)])
+    sp = sparse.sparse_coo_tensor(idx, vals, shape=[5, 5, 2])
+    normed = bn(sp)
+    got = np.asarray(normed.values.numpy())
+    np.testing.assert_allclose(got.mean(0), [0, 0], atol=1e-4)
+
+
+# ---------------------------------------------------- r5 review findings
+
+def test_fused_attention_batched_mask():
+    """A 3-D per-batch mask must not mix batches in the softmax."""
+    r = np.random.RandomState(21)
+    bh, s_len, d = 2, 3, 4
+    q = paddle.to_tensor(r.randn(bh, s_len, d).astype("float32"))
+    k = paddle.to_tensor(r.randn(bh, s_len, d).astype("float32"))
+    v = paddle.to_tensor(r.randn(bh, s_len, d).astype("float32"))
+    # different sparsity per batch
+    patterns = [np.array([[0, 0], [1, 0], [1, 1], [2, 2]]),
+                np.array([[0, 0], [0, 1], [2, 0], [2, 1], [2, 2]])]
+    idx = np.concatenate(
+        [np.concatenate([np.full((len(p), 1), b), p], 1)
+         for b, p in enumerate(patterns)]).T
+    coo = sparse.sparse_coo_tensor(
+        idx, np.ones(idx.shape[1], "float32"),
+        shape=[bh, s_len, s_len])
+    # batched CSR: concatenated per-batch crows
+    crows, cols = [], []
+    for b, p in enumerate(patterns):
+        c = np.zeros(s_len + 1, np.int64)
+        np.add.at(c, p[:, 0] + 1, 1)
+        crows.append(np.cumsum(c))
+        cols.append(p[:, 1])
+    mask = sparse.sparse_csr_tensor(
+        np.concatenate(crows), np.concatenate(cols),
+        np.ones(sum(len(p) for p in patterns), "float32"),
+        shape=[bh, s_len, s_len])
+
+    out = sparse.fused_attention(q, k, v, mask)
+    qn, kn, vn = q.numpy(), k.numpy(), v.numpy()
+    scores = np.einsum("bsd,btd->bst", qn, kn) / np.sqrt(d)
+    dm = np.zeros((bh, s_len, s_len), bool)
+    for b, p in enumerate(patterns):
+        dm[b, p[:, 0], p[:, 1]] = True
+    scores = np.where(dm, scores, -np.inf)
+    with np.errstate(invalid="ignore"):
+        p_ = np.exp(scores - scores.max(-1, keepdims=True))
+        p_ = np.nan_to_num(p_ / p_.sum(-1, keepdims=True))
+    ref = np.einsum("bst,btd->bsd", p_, vn)
+    np.testing.assert_allclose(np.asarray(out.numpy()), ref, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_slice_negative_out_of_range_clamps():
+    s = _coo(seed=30, shape=(4, 5), nnz=6)
+    d = _dense_of(s)
+    out = sparse.slice(s, axes=[0], starts=[-10], ends=[3])
+    assert out.shape == [3, 5]
+    np.testing.assert_allclose(_dense_of(out), d[0:3], rtol=1e-6)
+
+
+def test_csr_constructor_dtype():
+    t = sparse.sparse_csr_tensor([0, 1, 2], [0, 1], [1.0, 2.0],
+                                 shape=[2, 2], dtype="float64")
+    assert "float64" in str(t.values._value.dtype)
