@@ -46,6 +46,9 @@ def fwd_callable(op: OpDef, attrs: Dict[str, Any]):
     key = (op.name, backend, attrs_key(attrs))
     fn = _FWD_CACHE.get(key)
     if fn is None:
+        cap = flags.flag_value("FLAGS_eager_compile_cache_size")
+        while cap and len(_FWD_CACHE) >= cap:   # 0 = unlimited
+            _FWD_CACHE.pop(next(iter(_FWD_CACHE)))
         fn = jax.jit(functools.partial(op.kernel_for(backend), **attrs))
         _FWD_CACHE[key] = fn
     return fn
@@ -104,8 +107,12 @@ def _check_nan_inf(name: str, outs):
         if hasattr(o, "dtype") and jnp.issubdtype(o.dtype, jnp.inexact):
             bad = bool(jnp.any(~jnp.isfinite(o)))
             if bad:
-                raise FloatingPointError(
-                    f"NaN/Inf detected in output {i} of op '{name}'")
+                msg = f"NaN/Inf detected in output {i} of op '{name}'"
+                if flags.flag_value("FLAGS_check_nan_inf_level") >= 1:
+                    import warnings
+                    warnings.warn(msg)
+                else:
+                    raise FloatingPointError(msg)
 
 
 def clear_compile_cache():

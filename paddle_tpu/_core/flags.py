@@ -90,7 +90,8 @@ define_flag("FLAGS_check_nan_inf", False,
 define_flag("FLAGS_call_stack_level", 1,
             "Error message verbosity: 0 brief, 1 python stack, 2 full.")
 define_flag("FLAGS_eager_compile_cache_size", 4096,
-            "Max cached compiled executables for eager op dispatch.")
+            "Max cached compiled executables for eager op dispatch "
+            "(0 = unlimited).")
 define_flag("FLAGS_log_compiles", False, "Log XLA compilations of eager ops.")
 define_flag("FLAGS_seed", 0, "Default global random seed.")
 define_flag("FLAGS_tpu_matmul_precision", "default",
@@ -136,6 +137,127 @@ define_flag("FLAGS_dataloader_num_workers", 0,
             "Default DataLoader worker count when not passed.")
 define_flag("FLAGS_profiler_dir", "",
             "Directory for chrome-trace exports ('' = cwd).")
+define_flag("FLAGS_dataloader_prefetch_factor", 2,
+            "Default DataLoader prefetch batches per worker.")
+
+# ---- SOT / lazy capture knobs (jit/sot, _core/lazy)
+define_flag("FLAGS_sot_cache_entries", 8,
+            "Max guarded fast-path entries kept per SotFunction.")
+define_flag("FLAGS_sot_inline_depth", 8,
+            "Max recursive bytecode-inline depth in the SOT executor.")
+define_flag("FLAGS_sot_step_budget", 2_000_000,
+            "Max interpreted bytecode steps per SOT frame before the "
+            "frame falls back to native execution.")
+define_flag("FLAGS_sot_guard_size_cap", 64,
+            "Largest container/array value-guarded by SOT; larger "
+            "inputs refuse the fast path instead.")
+define_flag("FLAGS_lazy_enable", True,
+            "Kill-switch for the lazy fusion window: when false, "
+            "lazy_guard() becomes a no-op and ops dispatch eagerly.")
+
+# ---- AMP / GradScaler defaults (amp/grad_scaler.py)
+define_flag("FLAGS_amp_init_loss_scaling", 65536.0,
+            "GradScaler default init_loss_scaling.")
+define_flag("FLAGS_amp_incr_every_n_steps", 2000,
+            "GradScaler default good-step interval before scale growth.")
+define_flag("FLAGS_amp_decr_every_n_nan_or_inf", 1,
+            "GradScaler default bad-step count before scale shrink.")
+
+# ---- debug nets
+define_flag("FLAGS_check_nan_inf_level", 0,
+            "NaN/Inf scan action: 0 raise, 1 warn and continue.")
+
+# ---- kernels / pallas
+define_flag("FLAGS_flash_interpret", False,
+            "Force Pallas flash kernels into interpret mode (CPU mesh "
+            "tests; PT_FLASH_INTERPRET env is the legacy spelling).")
+define_flag("FLAGS_moe_capacity_factor", 1.25,
+            "Default MoE gating capacity factor.")
+
+# ---- distributed transport / pipeline
+define_flag("FLAGS_pg_native_transport", True,
+            "Allow the native socket collective engine; false forces "
+            "the pure-python store-relay fallback on every rank.")
+define_flag("FLAGS_pipeline_stash_warn_mb", 0,
+            "Warn when a pipeline runtime's activation stash exceeds "
+            "this many MB (0 = off).")
+define_flag("FLAGS_pipeline_max_inflight", 0,
+            "Hard cap on stashed in-flight micro-batches per pipeline "
+            "rank (0 = unlimited; exceeding raises).")
+define_flag("FLAGS_dp_broadcast_params", True,
+            "DataParallel broadcasts parameters from rank 0 at wrap "
+            "time so replicas start identical.")
+define_flag("FLAGS_elastic_heartbeat_interval_s", 0.5,
+            "ElasticManager heartbeat/watch interval in seconds.")
+define_flag("FLAGS_watchdog_check_interval_s", 1.0,
+            "CommTaskManager watchdog poll interval in seconds.")
+define_flag("FLAGS_auto_tuner_max_trials", 0,
+            "Auto-tuner default measured-trial count (0 = cost-model "
+            "ranking only).")
+
+# ---- compile caches
+define_flag("FLAGS_dy2static_cache_limit", 64,
+            "Max cached (signature -> executable) entries per "
+            "to_static function before oldest eviction.")
+
+# ---- inference defaults (inference/Config)
+define_flag("FLAGS_inference_opt_level", 2,
+            "Default inference Config optimization level.")
+define_flag("FLAGS_inference_donate_inputs", False,
+            "Default inference Config input-donation setting.")
+
+# ---- profiler
+define_flag("FLAGS_host_tracer_level", 1,
+            "Host tracer detail: 0 off, 1 ops, 2 ops+python ranges.")
+define_flag("FLAGS_profiler_max_events", 1_000_000,
+            "Host tracer event-buffer cap (oldest dropped beyond it).")
+
+# ---- model-surface defaults
+define_flag("FLAGS_onnx_opset", 13,
+            "Minimum default-domain opset version for ONNX export "
+            "(raised per-op when an emitted op needs newer).")
+define_flag("FLAGS_hapi_log_freq", 1,
+            "hapi ProgBarLogger default step logging frequency.")
+define_flag("FLAGS_asp_mask_algo", "mask_1d",
+            "Default ASP 2:4 pruning mask algorithm.")
+define_flag("FLAGS_quant_bits", 8,
+            "Default quantization bit width for observers/QAT.")
+
+# ---- sparse
+define_flag("FLAGS_sparse_validate_indices", False,
+            "Bounds-check sparse indices at construction (debug).")
+
+# ---- IR
+define_flag("FLAGS_ir_pass_disable", "",
+            "Comma-separated IR pass names to skip in the pipeline.")
+
+# ---- remaining runtime knobs
+define_flag("FLAGS_rpc_timeout_s", 180.0,
+            "Default rpc_sync/rpc_async call timeout in seconds.")
+define_flag("FLAGS_conv_data_format", "NCHW",
+            "Default conv/pool data layout when data_format is not "
+            "passed (the DataLayout default of the reference).")
+define_flag("FLAGS_launch_log_dir", "log",
+            "Default --log_dir for paddle.distributed.launch.")
+define_flag("FLAGS_host_alloc_chunk_kb", 256,
+            "Native host allocator pool chunk size in KB "
+            "(csrc/allocator.cc pt_alloc_create).")
+define_flag("FLAGS_zb_w_extra_delay", 0,
+            "Extra micro-batches of weight-grad (W) deferral in the "
+            "ZeroBubble schedule beyond the warmup depth.")
+define_flag("FLAGS_amp_level", "O1",
+            "Default auto_cast level when not passed.")
+define_flag("FLAGS_allow_pickle_load", False,
+            "Permit loading legacy pickle parameter files (pickle can "
+            "execute code; PT_ALLOW_PICKLE_LOAD=1 is the env spelling).")
+define_flag("FLAGS_jit_save_meta", True,
+            "jit.save writes the .pdmeta named-IO sidecar used by the "
+            "inference AnalysisPredictor.")
+define_flag("FLAGS_ckpt_strict_load", True,
+            "Distributed checkpoint load fails on missing/unexpected "
+            "keys instead of loading the intersection.")
+define_flag("FLAGS_guard_log", False,
+            "Log SOT guard-set contents and fast-path misses (debug).")
 
 
 

@@ -494,11 +494,18 @@ class lazy_guard:
         self.ctx: Optional[CaptureContext] = None
 
     def __enter__(self) -> CaptureContext:
+        from . import flags
         self.ctx = CaptureContext(self._max)
-        _ACTIVE.append(self.ctx)
+        if flags.flag_value("FLAGS_lazy_enable"):
+            _ACTIVE.append(self.ctx)
+            self._active = True
+        else:
+            self._active = False   # kill-switch: pure eager
         return self.ctx
 
     def __exit__(self, et, ev, tb):
+        if not getattr(self, "_active", True):
+            return False
         _ACTIVE.pop()
         if et is None:
             self.ctx.flush("guard_exit")

@@ -201,3 +201,19 @@ def bind_jit(lib):
     lib.pt_jit_close.argtypes = [c.c_void_p]
     lib._jit_bound = True
     return lib
+
+
+_HOST_POOL = None
+
+
+def host_pool():
+    """Process-wide native host memory pool (csrc/allocator.cc), sized
+    by FLAGS_host_alloc_chunk_kb at first use — the python face of the
+    reference's host AllocatorFacade."""
+    global _HOST_POOL
+    if _HOST_POOL is None:
+        from . import flags
+        lib = get_lib(required=True)
+        _HOST_POOL = lib.pt_alloc_create(
+            int(flags.flag_value("FLAGS_host_alloc_chunk_kb")) * 1024)
+    return _HOST_POOL

@@ -80,8 +80,11 @@ class auto_cast:
     """Context manager: `with paddle.amp.auto_cast(level='O1'):`"""
 
     def __init__(self, enable=True, custom_white_list=None,
-                 custom_black_list=None, level="O1", dtype=None,
+                 custom_black_list=None, level=None, dtype=None,
                  use_promote=True):
+        if level is None:
+            from .._core.flags import flag_value
+            level = flag_value("FLAGS_amp_level")
         if dtype is None:
             from .._core.flags import flag_value
             dtype = flag_value("FLAGS_amp_dtype")

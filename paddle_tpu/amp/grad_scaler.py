@@ -14,9 +14,19 @@ from .._core.tensor import Tensor
 
 
 class GradScaler:
-    def __init__(self, enable=True, init_loss_scaling=2.**16,
-                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=2000,
-                 decr_every_n_nan_or_inf=1, use_dynamic_loss_scaling=True):
+    def __init__(self, enable=True, init_loss_scaling=None,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=None,
+                 decr_every_n_nan_or_inf=None,
+                 use_dynamic_loss_scaling=True):
+        from .._core.flags import flag_value
+        if init_loss_scaling is None:
+            init_loss_scaling = flag_value("FLAGS_amp_init_loss_scaling")
+        if incr_every_n_steps is None:
+            incr_every_n_steps = flag_value(
+                "FLAGS_amp_incr_every_n_steps")
+        if decr_every_n_nan_or_inf is None:
+            decr_every_n_nan_or_inf = flag_value(
+                "FLAGS_amp_decr_every_n_nan_or_inf")
         self._enable = enable
         self._scale = float(init_loss_scaling) if enable else 1.0
         self._incr_ratio = incr_ratio

@@ -13,7 +13,7 @@ class AutoTuner:
     def __init__(self, model_config: Dict, world_size: int,
                  tune_space: Optional[Dict] = None,
                  trial_fn: Optional[Callable[[Dict], float]] = None,
-                 max_trials: int = 0):
+                 max_trials: int = None):
         """trial_fn(config) -> measured seconds/step; when given, the top
         `max_trials` cost-model candidates are measured and re-ranked."""
         base = dict(model_config)
@@ -25,6 +25,9 @@ class AutoTuner:
                            "pp_degree": degrees},
             base=base)
         self.trial_fn = trial_fn
+        if max_trials is None:
+            from ..._core.flags import flag_value
+            max_trials = flag_value("FLAGS_auto_tuner_max_trials")
         self.max_trials = max_trials
         self.history: List[Dict] = []
 

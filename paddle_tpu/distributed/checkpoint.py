@@ -77,7 +77,17 @@ def load_state_dict(state_dict: Dict[str, Tensor], path: str,
         data = pickle.load(f)
     import jax
     import jax.numpy as jnp
+
+    from .._core.flags import flag_value
     from .api import placements_to_spec
+    if flag_value("FLAGS_ckpt_strict_load"):
+        missing = sorted(set(state_dict) - set(data))
+        unexpected = sorted(set(data) - set(state_dict))
+        if missing or unexpected:
+            raise KeyError(
+                f"checkpoint at {path} mismatch: missing "
+                f"{missing[:5]}, unexpected {unexpected[:5]} — set "
+                "FLAGS_ckpt_strict_load=0 to load the intersection")
     for name, t in state_dict.items():
         if name not in data:
             continue

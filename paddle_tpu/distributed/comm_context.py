@@ -78,14 +78,16 @@ class CommContext:
         anyone blocks in connect/accept. Native is used only when ALL
         ranks can — a per-rank silent fallback would leave peers hanging
         in accept and mismatch collective protocols."""
-        ok = True
+        from .._core.flags import flag_value
+        ok = bool(flag_value("FLAGS_pg_native_transport"))
         try:
-            lib = native.get_lib(required=True)
-            probe = lib.ptcc_create(rank, world)
-            if not probe:
-                ok = False
-            else:
-                lib.ptcc_destroy(probe)
+            if ok:
+                lib = native.get_lib(required=True)
+                probe = lib.ptcc_create(rank, world)
+                if not probe:
+                    ok = False
+                else:
+                    lib.ptcc_destroy(probe)
         except Exception:
             ok = False
         store.set(f"{key}/cap/{rank}", b"1" if ok else b"0")

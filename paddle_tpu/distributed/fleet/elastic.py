@@ -28,13 +28,17 @@ class ElasticStatus:
 class ElasticManager:
     def __init__(self, node_id: str, store: TCPStore,
                  min_np: int = 1, max_np: int = -1,
-                 heartbeat_interval: float = 0.5,
+                 heartbeat_interval: float = None,
                  node_timeout: float = 2.0,
                  on_membership_change: Optional[Callable] = None):
         self.node_id = node_id
         self.store = store
         self.min_np = min_np
         self.max_np = max_np if max_np > 0 else 10 ** 9
+        if heartbeat_interval is None:
+            from ..._core.flags import flag_value
+            heartbeat_interval = flag_value(
+                "FLAGS_elastic_heartbeat_interval_s")
         self.interval = heartbeat_interval
         self.node_timeout = node_timeout
         self.on_membership_change = on_membership_change

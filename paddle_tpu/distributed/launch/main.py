@@ -40,7 +40,9 @@ def _parse_args(argv=None):
     p.add_argument("--devices", type=str, default="",
                    help="accepted for reference-CLI compat; the TPU "
                         "runtime drives all local chips from one process")
-    p.add_argument("--log_dir", type=str, default="log")
+    from ..._core.flags import flag_value
+    p.add_argument("--log_dir", type=str,
+                   default=flag_value("FLAGS_launch_log_dir"))
     p.add_argument("--job_id", type=str, default="default")
     from ..._core.flags import flag_value
     p.add_argument("--max_restarts", type=int, default=int(

@@ -194,19 +194,37 @@ class _HostPipeBase:
         self.max_stash_bytes = 0
 
     def _track(self, extra=()):
+        from .._core.flags import flag_value
         n = len(self._stash) + sum(len(d) for d in extra)
         self.max_inflight = max(self.max_inflight, n)
+        cap = flag_value("FLAGS_pipeline_max_inflight")
+        if cap and n > cap:
+            raise RuntimeError(
+                f"pipeline rank {self.rank}: {n} in-flight micro-batch "
+                f"stashes exceed FLAGS_pipeline_max_inflight={cap}")
+        def _bytes_of(t):
+            if t is None:
+                return 0
+            if isinstance(t, (list, tuple)):   # ZB residual lists
+                return sum(_bytes_of(x) for x in t)
+            if hasattr(t, "_value"):
+                return t.size * t._value.dtype.itemsize
+            if hasattr(t, "nbytes"):
+                return t.nbytes
+            return 0
+
         live = 0
         for d in (self._stash,) + tuple(extra):
             for vals in d.values():
-                for t in vals:
-                    if t is None:
-                        continue
-                    if hasattr(t, "_value"):
-                        live += t.size * t._value.dtype.itemsize
-                    elif hasattr(t, "nbytes"):
-                        live += t.nbytes
+                live += _bytes_of(vals)
         self.max_stash_bytes = max(self.max_stash_bytes, live)
+        warn_mb = flag_value("FLAGS_pipeline_stash_warn_mb")
+        if warn_mb and live > warn_mb * (1 << 20):
+            import warnings
+            warnings.warn(
+                f"pipeline rank {self.rank}: activation stash "
+                f"{live / (1 << 20):.1f} MB exceeds "
+                f"FLAGS_pipeline_stash_warn_mb={warn_mb}")
 
     def _grad_payload(self, x_in):
         """Input grad to send upstream; zeros keep the P2P protocol
@@ -477,9 +495,10 @@ def _zero_bubble_schedule(rank: int, pp_size: int, num_micro: int):
     are deferred by the rank's warmup depth so they fill the cooldown
     bubble that 1F1B leaves idle. Returns [("F"|"B"|"W", micro), ...].
     """
+    from .._core.flags import flag_value
     P, m = pp_size, num_micro
     wf = min(P - rank - 1, m)
-    delay = P - rank - 1
+    delay = P - rank - 1 + flag_value("FLAGS_zb_w_extra_delay")
     acts = [("F", i) for i in range(wf)]
     w_done = 0
     for j in range(m - wf):
@@ -505,50 +524,82 @@ class DistPipelineRuntimeZB(_HostPipeBase):
     The reference implements ZeroBubble as a pipeline-scheduler pass
     splitting matmul_grad into its activation-grad and weight-grad
     matmuls (passes/pipeline_scheduler_pass/pipeline_zero_bubble.py:62).
-    The TPU-native split is at the stage level via two jitted VJPs over
-    the stage's pure function f(params, x):
+    The TPU-native split, WITHOUT recomputing the stage forward:
 
-      B(i): dx   = vjp(f wrt x    only)(dout)   — sent upstream at once
-      W(i): dpar = vjp(f wrt params only)(dout) — deferred into bubbles
+      F(i): out, residuals = vjp(f)(pv, x) — ONE forward; the pullback's
+            closure is converted to explicit arrays (jax.closure_convert)
+            so the residuals cross the jit boundary and are stashed.
+      B(i): dx   = pullback(residuals, dout)[x-half]     — XLA dead-code
+      W(i): dpar = pullback(residuals, dout)[param-half] — eliminates
+            the other half, so each call compiles only its matmuls.
 
-    Requesting a cotangent subset makes XLA compile only that half of
-    the transpose; each call re-runs the stage forward for residuals
-    (rematerialisation — the standard TPU trade of FLOPs for schedule
-    freedom). Gradients accumulate into param.grad at W time, so the
-    optimizer step must follow the full schedule, exactly as in the
-    reference where W ops are reordered before opt.
+    Per micro-batch: exactly 1 forward + 1 activation-grad transpose +
+    1 weight-grad transpose, reusing saved residuals — the reference's
+    split-matmul-grad semantics generalized to arbitrary stage bodies
+    (call counts asserted by tests via the probe counters; the DCE split
+    is asserted via compiled FLOPs). Gradients accumulate into
+    param.grad at W time, so the optimizer step must follow the full
+    schedule, exactly as in the reference where W ops are reordered
+    before opt.
     """
 
     def __init__(self, stage_layer: Layer, group, loss_fn,
                  num_microbatches: int):
-        import jax
-        import jax.numpy as jnp
-
         super().__init__(group, loss_fn, num_microbatches)
         self.stage = stage_layer
         self.is_first = self.rank == 0
         self.is_last = self.rank == self.P - 1
         self._params = list(stage_layer.parameters())
-        # _stash: i -> (x_val, None) until B; _w_stash: i -> (x_val,
-        # dout_or_label) until W
+        # _stash: i -> residuals until B; _w_stash: i -> (residuals, g)
+        # until W
         self._w_stash = {}
         self.executed: List[tuple] = []  # action trace for tests
+        self.counts = {"F": 0, "B": 0, "W": 0}  # probe for tests
+        self._built = False
+
+    def _build(self, xv, yv=None):
+        """Trace the stage once (abstractly) to learn the pullback's
+        pytree structure; build the three jitted entry points.
+
+        jax.vjp's pullback is a jax.tree_util.Partial PYTREE: its leaves
+        are exactly the saved residuals (including non-float ones like
+        relu masks — which closure_convert would have baked as
+        constants), and its treedef is the static transpose program.
+        Flattening it lets the residuals cross the jit boundary as
+        arrays and the treedef be reused for every micro-batch."""
+        import jax
+
+        pv = [p._value for p in self._params]
+        holder = {}
 
         if self.is_last:
-            self._fwd = jax.jit(
-                lambda pv, xv, yv: self._run_pure(pv, xv, yv))
-            self._bx = jax.jit(lambda pv, xv, yv: jax.vjp(
-                lambda x_: self._run_pure(pv, x_, yv),
-                xv)[1](jnp.float32(1.0))[0])
-            self._bw = jax.jit(lambda pv, xv, yv: jax.vjp(
-                lambda p_: self._run_pure(p_, xv, yv),
-                pv)[1](jnp.float32(1.0))[0])
+            def fwd_res(pv_, xv_, yv_):
+                out, pull = jax.vjp(
+                    lambda p_, x_: self._run_pure(p_, x_, yv_), pv_, xv_)
+                leaves, treedef = jax.tree_util.tree_flatten(pull)
+                holder["td"] = treedef
+                return out, leaves
+            jax.eval_shape(fwd_res, pv, xv, yv)
         else:
-            self._fwd = jax.jit(lambda pv, xv: self._run_pure(pv, xv))
-            self._bx = jax.jit(lambda pv, xv, g: jax.vjp(
-                lambda x_: self._run_pure(pv, x_), xv)[1](g)[0])
-            self._bw = jax.jit(lambda pv, xv, g: jax.vjp(
-                lambda p_: self._run_pure(p_, xv), pv)[1](g)[0])
+            def fwd_res(pv_, xv_):
+                out, pull = jax.vjp(self._run_pure, pv_, xv_)
+                leaves, treedef = jax.tree_util.tree_flatten(pull)
+                holder["td"] = treedef
+                return out, leaves
+            jax.eval_shape(fwd_res, pv, xv)
+
+        td = holder["td"]
+        unflatten = jax.tree_util.tree_unflatten
+        self._pull = lambda g, *leaves: unflatten(td, list(leaves))(g)
+        self._fwd_res = jax.jit(fwd_res)
+        # the pullback returns (dparams, dx); requesting one half lets
+        # XLA dead-code-eliminate the other (asserted via FLOPs in
+        # tests) — no forward recompute in either
+        self._bx = jax.jit(
+            lambda leaves, g: unflatten(td, list(leaves))(g)[1])
+        self._bw = jax.jit(
+            lambda leaves, g: unflatten(td, list(leaves))(g)[0])
+        self._built = True
 
     def _run_pure(self, pvals, xv, yv=None):
         """Stage forward as a pure function of (param values, input):
@@ -572,42 +623,51 @@ class DistPipelineRuntimeZB(_HostPipeBase):
     def train_batch(self, micro_inputs=None, micro_labels=None):
         import numpy as np
 
+        import jax.numpy as jnp
+
         self._check_micros(micro_inputs, micro_labels,
                            self.is_first, self.is_last)
         pv = [p._value for p in self._params]
         labels = micro_labels
         losses: List[float] = []
+        one = jnp.ones((), jnp.float32)
         for kind, i in _zero_bubble_schedule(self.rank, self.P, self.m):
             self.executed.append((kind, i))
             if kind == "F":
+                self.counts["F"] += 1
                 if self.is_first:
                     xv = micro_inputs[i]._value
                 else:
                     xv = np.ascontiguousarray(
                         self.pg.recv(self.rank - 1))
+                if not self._built:
+                    self._build(xv, labels[i]._value
+                                if self.is_last else None)
                 if self.is_last:
-                    out = self._fwd(pv, xv, labels[i]._value)
+                    out, res = self._fwd_res(pv, xv, labels[i]._value)
                     losses.append(float(out))
                 else:
-                    out = self._fwd(pv, xv)
+                    out, res = self._fwd_res(pv, xv)
                     self.pg.send(np.asarray(out), self.rank + 1)
-                self._stash[i] = (xv, None)
+                self._stash[i] = res
                 self._track((self._w_stash,))
             elif kind == "B":
-                xv, _ = self._stash.pop(i)
+                self.counts["B"] += 1
+                res = self._stash.pop(i)
                 if self.is_last:
-                    g = labels[i]._value  # the loss closure's label
-                    dx = self._bx(pv, xv, g)
+                    g = one          # d loss / d loss
                 else:
-                    g = np.ascontiguousarray(self.pg.recv(self.rank + 1))
-                    dx = self._bx(pv, xv, g)
+                    g = jnp.asarray(np.ascontiguousarray(
+                        self.pg.recv(self.rank + 1)))
+                dx = self._bx(res, g)
                 if not self.is_first:
                     self.pg.send(np.asarray(dx), self.rank - 1)
-                self._w_stash[i] = (xv, g)
+                self._w_stash[i] = (res, g)
                 self._track((self._w_stash,))
             else:  # W
-                xv, g = self._w_stash.pop(i)
-                dparams = self._bw(pv, xv, g)
+                self.counts["W"] += 1
+                res, g = self._w_stash.pop(i)
+                dparams = self._bw(res, g)
                 for p, dp in zip(self._params, dparams):
                     if p.grad is None:
                         p.grad = Tensor(dp)

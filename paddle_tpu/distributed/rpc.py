@@ -154,8 +154,11 @@ def _call(to: str, fn, args, kwargs, timeout):
     return payload
 
 
-def rpc_sync(to: str, fn, args=None, kwargs=None, timeout=180.0):
+def rpc_sync(to: str, fn, args=None, kwargs=None, timeout=None):
     """Blocking remote call (rpc/api.py rpc_sync)."""
+    if timeout is None:
+        from .._core.flags import flag_value
+        timeout = flag_value("FLAGS_rpc_timeout_s")
     return _call(to, fn, args, kwargs, timeout)
 
 

@@ -34,8 +34,12 @@ def _dump_stacks() -> str:
 
 
 class CommTaskManager:
-    def __init__(self, check_interval: float = 1.0,
+    def __init__(self, check_interval: float = None,
                  on_timeout: Optional[Callable] = None):
+        if check_interval is None:
+            from .._core.flags import flag_value
+            check_interval = flag_value(
+                "FLAGS_watchdog_check_interval_s")
         self._tasks: Dict[str, CommTask] = {}
         self._lock = threading.Lock()
         self._interval = check_interval

@@ -62,7 +62,10 @@ class CallbackList:
 class ProgBarLogger(Callback):
     """Per-epoch progress logging (hapi/callbacks.py ProgBarLogger)."""
 
-    def __init__(self, log_freq: int = 1, verbose: int = 2):
+    def __init__(self, log_freq: int = None, verbose: int = 2):
+        if log_freq is None:
+            from .._core.flags import flag_value
+            log_freq = flag_value("FLAGS_hapi_log_freq")
         super().__init__()
         self.log_freq = log_freq
         self.verbose = verbose

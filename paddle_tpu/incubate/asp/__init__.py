@@ -64,7 +64,10 @@ def check_mask_2_4(mat: np.ndarray) -> bool:
     return bool(np.all((np.abs(g) > 0).sum(-1) <= 2))
 
 
-def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+def prune_model(model, n=2, m=4, mask_algo=None, with_mask=True):
+    if mask_algo is None:
+        from ..._core.flags import flag_value
+        mask_algo = flag_value("FLAGS_asp_mask_algo")
     """Compute and apply 2:4 masks to all supported layers' weights."""
     pruned = {}
     for name, sub in model.named_sublayers():

@@ -31,12 +31,16 @@ class Config:
     def __init__(self, prog_file: Optional[str] = None,
                  params_file: Optional[str] = None):
         # jit.save writes one artifact; prog_file is the path prefix
+        from .._core.flags import flag_value
         self.model_path = prog_file
         self._use_device = True       # accelerator (TPU) vs host CPU
         self._memory_pool_mb = 0
         self._enable_profile = False
-        self._ir_optim = True
-        self._memory_optim = False
+        # defaults come from the runtime flag surface so deployments can
+        # flip them fleet-wide without code changes
+        self._ir_optim = flag_value("FLAGS_inference_opt_level") > 0
+        self._memory_optim = bool(
+            flag_value("FLAGS_inference_donate_inputs"))
 
     def set_model(self, prog_file, params_file=None):
         self.model_path = prog_file

@@ -274,14 +274,18 @@ class DataLoader:
     def __init__(self, dataset, feed_list=None, places=None,
                  return_list=True, batch_sampler=None, batch_size=1,
                  shuffle=False, drop_last=False, collate_fn=None,
-                 num_workers=None, use_buffer_reader=True, prefetch_factor=2,
+                 num_workers=None, use_buffer_reader=True,
+                 prefetch_factor=None,
                  use_shared_memory=True, timeout=0, worker_init_fn=None,
                  persistent_workers=False):
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
+        from .._core.flags import flag_value
         if num_workers is None:
-            from .._core.flags import flag_value
             num_workers = flag_value("FLAGS_dataloader_num_workers")
+        if prefetch_factor is None:
+            prefetch_factor = flag_value(
+                "FLAGS_dataloader_prefetch_factor")
         self.num_workers = num_workers
         self.timeout = timeout or 0
         self.prefetch = max(prefetch_factor, 1) if use_buffer_reader else 0

@@ -88,11 +88,17 @@ class PassManager:
 
     def run(self, ws: Workspace,
             protected: Sequence = ()) -> bool:
+        from .._core.flags import flag_value
+        disabled = {n.strip()
+                    for n in flag_value("FLAGS_ir_pass_disable").split(",")
+                    if n.strip()}
         prot = frozenset(id(v) for v in protected)
         changed_any = False
         for _ in range(self.max_iters if self.iterate_to_fixpoint else 1):
             round_changed = False
             for p in self.passes:
+                if p.name in disabled:
+                    continue
                 t0 = time.perf_counter()
                 changed = bool(p.run(ws, prot))
                 self.stats.append({

@@ -164,6 +164,12 @@ class StaticFunction:
                self._layer.training if self._layer else None,
                a_def, k_def, a_static, k_static)
         if key not in self._fwd_cache:
+            from .._core.flags import flag_value
+            cap = flag_value("FLAGS_dy2static_cache_limit")
+            while cap and len(self._fwd_cache) >= cap:  # 0 = unlimited
+                old_key = next(iter(self._fwd_cache))
+                self._fwd_cache.pop(old_key)
+                self._bwd_cache.pop(old_key, None)
             pure = self._make_pure(names)
 
             def pure_dyn(s, ad, kd, _a=(a_def, a_static),
@@ -367,7 +373,9 @@ def _load_param_file(path):
     except Exception:
         # legacy pickle container (pre-r3): refuse unless opted in —
         # unpickling executes arbitrary code
-        if os.environ.get("PT_ALLOW_PICKLE_LOAD") == "1":
+        from .._core.flags import flag_value
+        if os.environ.get("PT_ALLOW_PICKLE_LOAD") == "1" \
+                or flag_value("FLAGS_allow_pickle_load"):
             return pickle.loads(data)
         raise RuntimeError(
             f"{path} is a legacy pickle parameter file; loading pickle "
@@ -463,10 +471,12 @@ def save(layer, path, input_spec=None, **configs):
                for d in getattr(spec, "shape", examples[i].shape)]
         dt = str(jnp.dtype(getattr(spec, "dtype", examples[i].dtype)))
         in_meta.append({"name": nm, "shape": shp, "dtype": dt})
-    n_out = len(jax.tree_util.tree_leaves(exported.out_avals))
-    with open(path + ".pdmeta", "w") as f:
-        _json.dump({"inputs": in_meta,
-                    "outputs": [f"out{i}" for i in range(n_out)]}, f)
+    from .._core.flags import flag_value
+    if flag_value("FLAGS_jit_save_meta"):
+        n_out = len(jax.tree_util.tree_leaves(exported.out_avals))
+        with open(path + ".pdmeta", "w") as f:
+            _json.dump({"inputs": in_meta,
+                        "outputs": [f"out{i}" for i in range(n_out)]}, f)
 
 
 def load(path, **configs):

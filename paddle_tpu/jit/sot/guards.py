@@ -138,9 +138,11 @@ class GuardSet:
 
 GUARDABLE_VALUE_TYPES = (bool, int, float, str, bytes, type(None))
 
-# containers/arrays are value-guarded only up to this size; beyond it
-# the per-call compare cost outweighs the fast path
-_GUARD_SIZE_CAP = 64
+def _size_cap() -> int:
+    # containers/arrays are value-guarded only up to this size; beyond
+    # it the per-call compare cost outweighs the fast path
+    from ..._core.flags import flag_value
+    return flag_value("FLAGS_sot_guard_size_cap")
 
 
 def is_guardable_value(v, _depth=0) -> bool:
@@ -149,14 +151,14 @@ def is_guardable_value(v, _depth=0) -> bool:
     if _depth > 4:
         return False
     if isinstance(v, (tuple, list)):
-        return len(v) <= _GUARD_SIZE_CAP and all(
+        return len(v) <= _size_cap() and all(
             is_guardable_value(x, _depth + 1) for x in v)
     if isinstance(v, dict):
-        return len(v) <= _GUARD_SIZE_CAP and all(
+        return len(v) <= _size_cap() and all(
             isinstance(k, GUARDABLE_VALUE_TYPES)
             and is_guardable_value(x, _depth + 1) for k, x in v.items())
     if _np is not None and isinstance(v, _np.ndarray):
-        return v.size <= 4 * _GUARD_SIZE_CAP
+        return v.size <= 4 * _size_cap()
     return False
 
 

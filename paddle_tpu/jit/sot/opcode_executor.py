@@ -312,8 +312,9 @@ class _Frame:
         self.kw_names: Tuple[str, ...] = ()
 
 
-_MAX_INLINE_DEPTH = 8
-_MAX_STEPS = 2_000_000
+def _flag(name):
+    from ..._core.flags import flag_value
+    return flag_value(name)
 
 
 class OpcodeExecutor:
@@ -373,10 +374,11 @@ class OpcodeExecutor:
         steps = 0
         push = f.stack.append
         pop = f.stack.pop
+        step_budget = _flag("FLAGS_sot_step_budget")
 
         while True:
             steps += 1
-            if steps > _MAX_STEPS:
+            if steps > step_budget:
                 raise SotFallback("step budget exceeded")
             ins = f.instructions[idx]
             op = ins.opname
@@ -834,7 +836,7 @@ class OpcodeExecutor:
             self_arg = real.__self__
 
         if isinstance(target, types.FunctionType) \
-                and self.depth < _MAX_INLINE_DEPTH \
+                and self.depth < _flag("FLAGS_sot_inline_depth") \
                 and not str(getattr(target, "__module__", "") or "") \
                 .startswith(_NEVER_INLINE_PREFIXES) \
                 and prescan_cached(target.__code__) is None:
@@ -922,8 +924,6 @@ class _CacheEntry:
 class SotFunction:
     """symbolic_translate(fn): guarded capture-and-replay wrapper."""
 
-    _MAX_ENTRIES = 8
-
     def __init__(self, fn):
         self._callable = fn
         self._entries: List[_CacheEntry] = []
@@ -938,7 +938,14 @@ class SotFunction:
             else args
         from ..._core.autograd import is_grad_enabled
         grad_now = is_grad_enabled()
+        log = _flag("FLAGS_guard_log")
         for entry in self._entries:
+            if log:
+                failed = [g for g in entry.guards
+                          if not g.check(fn, eval_args, kwargs)]
+                if failed:
+                    print(f"[sot] {getattr(fn, '__name__', fn)}: "
+                          f"guard miss {failed[:3]}")
             if entry.grad_mode == grad_now \
                     and entry.guards.check_all(fn, eval_args, kwargs):
                 try:
@@ -996,7 +1003,8 @@ class SotFunction:
 
         entry = self._build_entry(session, out, args, kwargs)
         if entry is not None:
-            if len(self._entries) >= self._MAX_ENTRIES:
+            cap = _flag("FLAGS_sot_cache_entries")
+            while cap and len(self._entries) >= cap:  # 0 = unlimited
                 self._entries.pop(0)
             self._entries.append(entry)
         return out

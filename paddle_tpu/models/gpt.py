@@ -257,7 +257,10 @@ def _use_flash_kernel(config: GPTConfig, seq: int, mesh_axes) -> bool:
         return False
     if jax.default_backend() == "tpu":
         return seq >= 256
-    return os.environ.get("PT_FLASH_INTERPRET") == "1"
+    if os.environ.get("PT_FLASH_INTERPRET") == "1":
+        return True
+    from .._core.flags import flag_value
+    return bool(flag_value("FLAGS_flash_interpret"))
 
 
 def _ln(x, g, b, eps):

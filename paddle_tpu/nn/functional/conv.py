@@ -60,7 +60,10 @@ register_op("conv2d", _conv_kernel)
 
 
 def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
-           data_format="NCHW", name=None):
+           data_format=None, name=None):
+    if data_format is None:
+        from ..._core.flags import flag_value
+        data_format = flag_value("FLAGS_conv_data_format")
     return apply("conv2d", x, weight, bias, stride=_pair(stride),
                  padding=_norm_padding(padding), dilation=_pair(dilation),
                  groups=int(groups), dims=2, fmt=data_format)

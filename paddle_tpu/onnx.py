@@ -290,7 +290,7 @@ def _lower_node(node, rank_of, shape_of, idx):
 
 # ----------------------------------------------------------------- export
 
-def export(layer, path: str, input_spec=None, opset_version: int = 13,
+def export(layer, path: str, input_spec=None, opset_version: int = None,
            **configs) -> str:
     """Trace `layer` with input_spec (list of paddle.static.InputSpec or
     example Tensors), map the recorded graph to ONNX, write
@@ -361,6 +361,9 @@ def export(layer, path: str, input_spec=None, opset_version: int = 13,
         return list(np.asarray(t._value).shape)
 
     nodes: List[bytes] = []
+    if opset_version is None:
+        from ._core.flags import flag_value
+        opset_version = flag_value("FLAGS_onnx_opset")
     needed_opset = opset_version
     for i, node in enumerate(prog.ops):
         specs = _lower_node(node, rank_of, shape_of, i)

@@ -151,3 +151,13 @@ def select_scatter(x, values, axis, index, name=None):
 @tensor_method("unfold")
 def unfold(x, axis, size, step, name=None):
     return apply("unfold_op", x, axis=axis, size=size, step=step)
+
+
+def _accuracy_check_kernel(x, y, fn_name, rtol, atol, equal_nan):
+    return jnp.all(jnp.isclose(x, y, rtol=rtol, atol=atol,
+                               equal_nan=equal_nan))
+
+
+# Per-tensor numeric compare op (reference ops.yaml:31 accuracy_check):
+# the primitive under the acc-align parity harnesses.
+register_op("accuracy_check", _accuracy_check_kernel)

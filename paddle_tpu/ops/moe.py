@@ -31,13 +31,16 @@ def _capacity(s: int, e: int, k: int, capacity_factor: float,
     return max(int(s * k * capacity_factor / e + 0.999999), 1)
 
 
-def top2_gating(logits, capacity_factor: float = 1.25,
+def top2_gating(logits, capacity_factor: float = None,
                 capacity: Optional[int] = None):
     """GShard top-2 gating (moe/gate/gshard_gate.py analog).
 
     logits [S, E] -> (combine [S, E, C], dispatch bool [S, E, C], aux_loss).
     aux_loss is the GShard load-balance loss: E * mean(me * ce).
     """
+    if capacity_factor is None:
+        from .._core.flags import flag_value
+        capacity_factor = flag_value("FLAGS_moe_capacity_factor")
     s, e = logits.shape
     c = _capacity(s, e, 2, capacity_factor, capacity)
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)  # [S,E]

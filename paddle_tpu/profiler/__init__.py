@@ -58,6 +58,13 @@ class RecordEvent:
             return
         t1 = time.perf_counter_ns()
         with _events_lock:
+            from .._core.flags import flag_value
+            if flag_value("FLAGS_host_tracer_level") < 1:
+                return
+            cap = flag_value("FLAGS_profiler_max_events")
+            if len(_events) >= cap:
+                # amortized O(1)/event: drop the oldest 1/64th at once
+                del _events[:max(cap // 64, 1)]
             _events.append({
                 "name": self.name,
                 "tid": threading.get_ident() & 0xFFFF,

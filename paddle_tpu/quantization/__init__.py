@@ -18,7 +18,10 @@ from .. import nn
 # ------------------------------------------------------------- observers
 
 class BaseObserver:
-    def __init__(self, quant_bits: int = 8):
+    def __init__(self, quant_bits: int = None):
+        if quant_bits is None:
+            from .._core.flags import flag_value
+            quant_bits = flag_value("FLAGS_quant_bits")
         self.quant_bits = quant_bits
         self._scale: Optional[float] = None
 

@@ -162,6 +162,15 @@ def sparse_coo_tensor(indices, values, shape=None, dtype=None,
         shape = [int(d) + 1 for d in np.asarray(
             jnp.max(indices._value, axis=1))]
         shape += list(values.shape[1:])
+    from .._core.flags import flag_value
+    if flag_value("FLAGS_sparse_validate_indices") and \
+            indices.shape[1] > 0:
+        iv = np.asarray(indices._value)
+        hi = np.asarray(shape[:iv.shape[0]])[:, None]
+        if (iv < 0).any() or (iv >= hi).any():
+            raise ValueError(
+                "sparse_coo_tensor: index out of bounds for shape "
+                f"{shape} (FLAGS_sparse_validate_indices=1)")
     return SparseCooTensor(indices, values, shape)
 
 

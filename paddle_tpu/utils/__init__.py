@@ -11,3 +11,42 @@ from .install_check import run_check  # noqa: F401
 
 __all__ = ["run_check", "cpp_extension", "load_custom_device_lib",
            "get_all_custom_device_type", "load_op_library", "CustomDevice"]
+
+
+def accuracy_check(x, y, fn_name="", rtol=1e-5, atol=1e-8,
+                   equal_nan=False, raise_on_fail=True):
+    """Per-tensor numeric parity check (reference accuracy_check op,
+    ops.yaml:31 — the primitive of the acc-align harnesses in
+    test/auto_parallel/hybrid_strategy/semi_auto_llama_acc_align.py).
+
+    Runs the registered `accuracy_check` op; on mismatch raises (or
+    returns False) with max-abs/rel-diff detail.
+    """
+    import numpy as np
+
+    from .._core.executor import apply
+    from .._core.tensor import Tensor
+
+    if not isinstance(x, Tensor):
+        x = Tensor(x)
+    if not isinstance(y, Tensor):
+        y = Tensor(y)
+    # fn_name stays OUT of the op attrs: it would join the jit
+    # compile-cache key and force one compilation per checked tensor
+    ok = bool(apply("accuracy_check", x, y, fn_name="",
+                    rtol=float(rtol), atol=float(atol),
+                    equal_nan=bool(equal_nan)).numpy())
+    if ok:
+        return True
+    xv = np.asarray(x.numpy(), np.float64)
+    yv = np.asarray(y.numpy(), np.float64)
+    ad = np.abs(xv - yv)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        rd = np.where(yv != 0, ad / np.abs(yv), np.inf)
+    msg = (f"accuracy_check failed for '{fn_name or 'tensor'}': "
+           f"max_abs_diff={ad.max():.3e} max_rel_diff={rd.max():.3e} "
+           f"(rtol={rtol}, atol={atol}, {int((ad > atol).sum())}/"
+           f"{ad.size} elements over atol)")
+    if raise_on_fail:
+        raise AssertionError(msg)
+    return False

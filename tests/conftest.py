@@ -36,6 +36,7 @@ _DIST_MODULES = {
     "test_engine_tuner_elastic",
     "test_auto_tuner_trials",
     "test_mp_multiproc",
+    "test_acc_align",
 }
 
 # Compile-heavy single-process suites (>= ~10 s each on one core):

@@ -1,0 +1,79 @@
+"""Acc-align parity harness (reference:
+test/auto_parallel/hybrid_strategy/semi_auto_llama_acc_align.py).
+
+Trains the SAME tiny GPT for N steps on a 1-device mesh and on an
+8-device dp2 x pp2 x mp2 hybrid mesh (virtual CPU devices), and checks
+the loss curves step-for-step with the accuracy_check op. Runs in a
+subprocess because the 8-device CPU mesh must be forced before JAX
+backend init.
+
+Tolerance: rtol=2e-3 — sharded reductions reassociate float adds (psum
+trees vs sequential sums); bit-exactness across layouts is not a
+property even the reference asserts (their harness uses allclose with
+loose tolerances too).
+"""
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_SCRIPT = r"""
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+import paddle_tpu as paddle
+from paddle_tpu.models.gpt import GPTConfig, build_train_step
+
+STEPS = 5
+config = GPTConfig(vocab_size=128, hidden_size=64, num_layers=2,
+                   num_heads=4, max_position_embeddings=64,
+                   dtype="float32")
+r = np.random.RandomState(0)
+toks = r.randint(0, 128, size=(STEPS, 4, 64)).astype(np.int32)
+lbls = r.randint(0, 128, size=(STEPS, 4, 64)).astype(np.int32)
+
+
+def run(mesh_axes):
+    devs = np.asarray(jax.devices()[:int(np.prod(
+        [n for _, n in mesh_axes]))])
+    mesh = Mesh(devs.reshape([n for _, n in mesh_axes]),
+                tuple(a for a, _ in mesh_axes))
+    pp = dict(mesh_axes).get("pp", 1)
+    init_fn, step = build_train_step(
+        config, mesh, lr=1e-2, seq_shard=dict(mesh_axes).get("mp", 1) > 1,
+        remat=False, pp_microbatches=2 if pp > 1 else None)
+    state = init_fn(0)
+    losses = []
+    for i in range(STEPS):
+        state, loss = step(state, jnp.asarray(toks[i]),
+                           jnp.asarray(lbls[i]))
+        losses.append(float(loss))
+    return losses
+
+
+single = run([("dp", 1), ("pp", 1), ("mp", 1)])
+hybrid = run([("dp", 2), ("pp", 2), ("mp", 2)])
+print("single:", single)
+print("hybrid:", hybrid)
+for i, (a, b) in enumerate(zip(single, hybrid)):
+    paddle.utils.accuracy_check(
+        paddle.to_tensor(a), paddle.to_tensor(b),
+        fn_name=f"loss_step_{i}", rtol=2e-3, atol=1e-5)
+print("ACC-ALIGN-OK")
+"""
+
+
+def test_gpt_single_vs_hybrid_mesh_loss_curve():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run([sys.executable, "-c", _SCRIPT], env=env,
+                         capture_output=True, text=True, timeout=540)
+    assert "ACC-ALIGN-OK" in out.stdout, \
+        f"stdout:\n{out.stdout}\nstderr:\n{out.stderr[-3000:]}"

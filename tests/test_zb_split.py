@@ -1,0 +1,102 @@
+"""ZeroBubble B/W split without forward recompute (VERDICT r4 item 5).
+
+The ZB runtime must run EXACTLY one forward per micro-batch and reuse
+saved residuals in both backward halves; the halves must each compile to
+strictly less work than the full pullback (XLA DCE did the split).
+Single-rank runtime with a stub process group — the multi-process
+schedule/parity tests live in test_pipeline_hostdriven.py.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.distributed.pipeline import DistPipelineRuntimeZB
+
+
+class _StubPG:
+    rank = 0
+    size = 1
+
+    def barrier(self):
+        pass
+
+
+class _StubGroup:
+    pg = _StubPG()
+
+
+M = 3
+
+
+def _runtime_and_data():
+    paddle.seed(11)
+    stage = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 8))
+    rt = DistPipelineRuntimeZB(stage, _StubGroup(), F.mse_loss,
+                               num_microbatches=M)
+    r = np.random.RandomState(3)
+    xs = [paddle.to_tensor(r.randn(4, 8).astype("float32"))
+          for _ in range(M)]
+    ys = [paddle.to_tensor(r.randn(4, 8).astype("float32"))
+          for _ in range(M)]
+    return rt, stage, xs, ys
+
+
+def test_one_forward_one_split_backward_per_micro():
+    rt, stage, xs, ys = _runtime_and_data()
+    loss = rt.train_batch(micro_inputs=xs, micro_labels=ys)
+    assert rt.counts == {"F": M, "B": M, "W": M}, rt.counts
+
+    # parity with plain eager autograd (same seed -> same init)
+    paddle.seed(11)
+    ref = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 8))
+    total = None
+    for x, y in zip(xs, ys):
+        l = F.mse_loss(ref(x), y) / M
+        l.backward()
+        total = l if total is None else total + l
+    np.testing.assert_allclose(loss, float(total.numpy()), rtol=1e-5)
+    for p, q in zip(stage.parameters(), ref.parameters()):
+        np.testing.assert_allclose(p.grad.numpy(), q.grad.numpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_bw_halves_are_dce_split_and_reuse_residuals():
+    import jax
+
+    rt, stage, xs, ys = _runtime_and_data()
+    rt.train_batch(micro_inputs=xs, micro_labels=ys)
+
+    pv = [p._value for p in rt._params]
+    xv = xs[0]._value
+    yv = ys[0]._value
+    out, res = rt._fwd_res(pv, xv, yv)
+    g = np.float32(1.0)
+
+    def flops(jitted, *args):
+        c = jitted.lower(*args).compile().cost_analysis()
+        return float(c["flops"])
+
+    fl_bx = flops(rt._bx, res, g)
+    fl_bw = flops(rt._bw, res, g)
+
+    # the full pullback (both halves) as one executable
+    full = jax.jit(lambda consts, g_: rt._pull(g_, *consts))
+    fl_full = flops(full, res, g)
+
+    # each half compiles to strictly less work than the full transpose
+    assert fl_bx < fl_full, (fl_bx, fl_full)
+    assert fl_bw < fl_full, (fl_bw, fl_full)
+
+    # the old (recompute) formulation re-runs the forward inside B:
+    # the residual-reusing half must cost less
+    def old_bx(pv_, xv_, yv_, g_):
+        return jax.vjp(lambda x_: _stage_loss(rt, pv_, x_, yv_),
+                       xv_)[1](g_)[0]
+    fl_old = flops(jax.jit(old_bx), pv, xv, yv, g)
+    assert fl_bx < fl_old, (fl_bx, fl_old)
+
+
+def _stage_loss(rt, pv, xv, yv):
+    return rt._run_pure(pv, xv, yv)
