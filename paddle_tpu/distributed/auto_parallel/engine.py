@@ -264,7 +264,15 @@ def _engine_build_rank_programs(self, program, fetch_var,
     for p in build_strategy_passes(self._strategy):
         p.run(ws, protected)
     ShardingCompletionPass(ctx).run(ws, protected)
-    parts = Partitioner(ctx, mesh).partition_all(ws)
+    stage_map = None
+    if "pp" in mesh.dim_names:
+        # cost-based stage cuts (planner_v2 role) instead of uniform
+        # op-count splitting
+        from .planner import plan_stage_map
+        n_stages = mesh.shape[mesh.dim_names.index("pp")]
+        stage_map = plan_stage_map(ws, n_stages)
+    parts = Partitioner(ctx, mesh,
+                        stage_map=stage_map).partition_all(ws)
     return parts, ws, ctx
 
 

@@ -44,7 +44,6 @@ def _parse_args(argv=None):
     p.add_argument("--log_dir", type=str,
                    default=flag_value("FLAGS_launch_log_dir"))
     p.add_argument("--job_id", type=str, default="default")
-    from ..._core.flags import flag_value
     p.add_argument("--max_restarts", type=int, default=int(
         os.environ.get("PADDLE_ELASTIC_FAULT_TOLERANC_LEVEL",
                        flag_value("FLAGS_launch_max_restarts"))) or 0,

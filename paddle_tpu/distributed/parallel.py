@@ -64,10 +64,10 @@ class DataParallel(Layer):
         """Replicas must start identical (parallel.py
         sync_params_buffers analog)."""
         import jax.numpy as jnp
+        from .._core.flags import flag_value
+        if not flag_value("FLAGS_dp_broadcast_params"):
+            return
         for p in self._layers.parameters():
-            from .._core.flags import flag_value
-            if not flag_value("FLAGS_dp_broadcast_params"):
-                break
             synced = self._pg.broadcast(p.numpy(), src=0)
             if self._pg.rank != 0:
                 p._replace_value_inplace(

@@ -526,9 +526,9 @@ class DistPipelineRuntimeZB(_HostPipeBase):
     matmuls (passes/pipeline_scheduler_pass/pipeline_zero_bubble.py:62).
     The TPU-native split, WITHOUT recomputing the stage forward:
 
-      F(i): out, residuals = vjp(f)(pv, x) — ONE forward; the pullback's
-            closure is converted to explicit arrays (jax.closure_convert)
-            so the residuals cross the jit boundary and are stashed.
+      F(i): out, residuals = vjp(f)(pv, x) — ONE forward; the pullback
+            (a jax.tree_util.Partial pytree) is FLATTENED so its
+            residual leaves cross the jit boundary and are stashed.
       B(i): dx   = pullback(residuals, dout)[x-half]     — XLA dead-code
       W(i): dpar = pullback(residuals, dout)[param-half] — eliminates
             the other half, so each call compiles only its matmuls.

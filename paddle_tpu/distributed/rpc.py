@@ -163,9 +163,12 @@ def rpc_sync(to: str, fn, args=None, kwargs=None, timeout=None):
 
 
 def rpc_async(to: str, fn, args=None, kwargs=None,
-              timeout=180.0) -> Future:
+              timeout=None) -> Future:
     """Future-returning remote call (rpc/api.py rpc_async; .wait() /
     .result() both work, Future API)."""
+    if timeout is None:
+        from .._core.flags import flag_value
+        timeout = flag_value("FLAGS_rpc_timeout_s")
     fut = _state["futures_pool"].submit(_call, to, fn, args, kwargs,
                                         timeout)
     fut.wait = fut.result  # paddle's FutureWrapper exposes wait()

@@ -65,10 +65,10 @@ def check_mask_2_4(mat: np.ndarray) -> bool:
 
 
 def prune_model(model, n=2, m=4, mask_algo=None, with_mask=True):
+    """Compute and apply 2:4 masks to all supported layers' weights."""
     if mask_algo is None:
         from ..._core.flags import flag_value
         mask_algo = flag_value("FLAGS_asp_mask_algo")
-    """Compute and apply 2:4 masks to all supported layers' weights."""
     pruned = {}
     for name, sub in model.named_sublayers():
         if not any(isinstance(sub, t) for t in _supported_layers):

@@ -57,11 +57,11 @@ class RecordEvent:
         if self._t0 is None or not _recording:
             return
         t1 = time.perf_counter_ns()
+        from .._core.flags import flag_value
+        if flag_value("FLAGS_host_tracer_level") < 1:
+            return
+        cap = flag_value("FLAGS_profiler_max_events")
         with _events_lock:
-            from .._core.flags import flag_value
-            if flag_value("FLAGS_host_tracer_level") < 1:
-                return
-            cap = flag_value("FLAGS_profiler_max_events")
             if len(_events) >= cap:
                 # amortized O(1)/event: drop the oldest 1/64th at once
                 del _events[:max(cap // 64, 1)]

@@ -168,3 +168,21 @@ def test_ckpt_strict_load_flag(tmp_path):
     with _with_flag("FLAGS_ckpt_strict_load", False):
         load_state_dict(sd, str(d))
         np.testing.assert_allclose(sd["a"].numpy(), np.ones(2))
+
+
+def test_host_alloc_chunk_flag_consumer():
+    """host_pool() builds the native host pool with the flagged chunk
+    size (csrc/allocator.cc)."""
+    from paddle_tpu._core import native
+    try:
+        lib = native.get_lib(required=True)
+    except Exception:
+        pytest.skip("native lib unavailable")
+    native._HOST_POOL = None
+    with _with_flag("FLAGS_host_alloc_chunk_kb", 64):
+        h = native.host_pool()
+        assert h
+        p = lib.pt_alloc_malloc(h, 1024)
+        assert p
+        assert lib.pt_alloc_free(h, p) == 0
+    native._HOST_POOL = None
