@@ -86,10 +86,10 @@ def plan_stage_map(ws, n_stages: int,
                    cost_model: Optional[CostModel] = None) -> List[int]:
     """Balanced contiguous stage cuts via DP (planner_v2 role).
 
-    Returns op_index -> stage. Minimizes the BOTTLENECK stage time
-    (compute + the comm time of values crossing into the stage) — the
-    pipeline's steady-state throughput is set by its slowest stage.
-    O(n^2 * stages).
+    Returns op_index -> stage. Minimizes the BOTTLENECK stage COMPUTE
+    time (steady-state pipeline throughput is set by the slowest stage,
+    with P2P overlapping compute), tie-broken by total bytes crossing
+    the chosen cuts. O(n^2 * stages).
     """
     cm = cost_model or CostModel()
     ops = list(ws.ops)
@@ -112,9 +112,10 @@ def plan_stage_map(ws, n_stages: int,
             if p is None or p >= i:
                 continue
             b = cm.var_bytes(v)
-            # v crosses every cut between producer and consumer
+            # v crosses every cut between producer and consumer; a cut's
+            # comm load is the SUM of all vars crossing it
             for j in range(p + 1, i + 1):
-                cross[j] = max(cross[j], b)   # one send per cut point
+                cross[j] += b
 
     # Objective (lexicographic): minimize the BOTTLENECK stage compute —
     # steady-state pipeline throughput is set by the slowest stage, with

@@ -18,35 +18,82 @@ import numpy as np
 
 
 class Accessor:
-    """Server-side optimizer (ps/table accessor analog)."""
+    """Server-side optimizer (ps/table accessor analog): the optimizer
+    runs IN the server on push, the reference's
+    ps/table/sparse_sgd_rule.cc SGD/adagrad/adam family. Adam keeps
+    (m, v, t) in the per-entry state dict."""
 
     def __init__(self, kind: str = "sgd", lr: float = 0.01,
-                 init_std: float = 0.01):
+                 init_std: float = 0.01, beta1: float = 0.9,
+                 beta2: float = 0.999, epsilon: float = 1e-8):
         self.kind = kind
         self.lr = lr
         self.init_std = init_std
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
 
     def init_rows(self, n_rows: int, dim: int, rng: np.random.RandomState):
         return (rng.randn(n_rows, dim) * self.init_std).astype(np.float32)
 
-    def apply(self, value: np.ndarray, grad: np.ndarray,
-              state: Optional[np.ndarray]):
+    def apply(self, value: np.ndarray, grad: np.ndarray, state):
         if self.kind == "sgd":
             value -= self.lr * grad
             return state
         if self.kind == "adagrad":
-            if state is None:
+            if state is None or isinstance(state, dict):
+                # fresh, or left over from a different accessor kind
+                # (e.g. a table re-registered adam -> adagrad): restart
                 state = np.zeros_like(value)
             state += grad * grad
             value -= self.lr * grad / (np.sqrt(state) + 1e-10)
             return state
+        if self.kind == "adam":
+            if not isinstance(state, dict):
+                state = {"m": np.zeros_like(value),
+                         "v": np.zeros_like(value), "t": 0}
+            state["t"] += 1
+            t = state["t"]
+            state["m"] = self.beta1 * state["m"] + (1 - self.beta1) * grad
+            state["v"] = self.beta2 * state["v"] \
+                + (1 - self.beta2) * grad * grad
+            mhat = state["m"] / (1 - self.beta1 ** t)
+            vhat = state["v"] / (1 - self.beta2 ** t)
+            value -= self.lr * mhat / (np.sqrt(vhat) + self.epsilon)
+            return state
         raise ValueError(f"unknown accessor {self.kind}")
+
+
+class CtrAccessor(Accessor):
+    """CTR sparse accessor (ps/table/ctr_accessor.cc analog): every
+    entry carries (show, click) counters; rows are scored
+    nonclk_coeff*(show-click) + click_coeff*click, counters decay each
+    shrink pass, and entries whose score falls under delete_threshold
+    are evicted — the frequency-adaptive lifecycle the reference runs
+    for billion-row CTR embeddings. Embedding updates are adagrad."""
+
+    def __init__(self, lr: float = 0.05, init_std: float = 0.01,
+                 nonclk_coeff: float = 0.1, click_coeff: float = 1.0,
+                 show_decay_rate: float = 0.98,
+                 delete_threshold: float = 0.8):
+        super().__init__(kind="adagrad", lr=lr, init_std=init_std)
+        self.nonclk_coeff = nonclk_coeff
+        self.click_coeff = click_coeff
+        self.show_decay_rate = show_decay_rate
+        self.delete_threshold = delete_threshold
+
+    def score(self, show: float, click: float) -> float:
+        return self.nonclk_coeff * max(show - click, 0.0) \
+            + self.click_coeff * click
 
 
 class DenseTable:
     def __init__(self, name: str, shape, accessor: Accessor):
         self.name = name
-        rng = np.random.RandomState(hash(name) % (2 ** 31))
+        # crc32, not hash(): builtin hash is seed-randomized per
+        # interpreter, and table init must agree across processes
+        import zlib
+        rng = np.random.RandomState(zlib.crc32(name.encode()) % (2 ** 31))
         self.value = (rng.randn(*shape) * accessor.init_std).astype(
             np.float32)
         self.accessor = accessor
@@ -73,9 +120,18 @@ class SparseTable:
         self.dim = dim
         self.accessor = accessor
         self._rows: Dict[int, np.ndarray] = {}
-        self._states: Dict[int, np.ndarray] = {}
-        self._rng = np.random.RandomState(hash(name) % (2 ** 31))
+        self._states: Dict[int, object] = {}
+        self._show_click: Dict[int, tuple] = {}
         self._lock = threading.Lock()
+
+    def _init_row(self, key: int) -> np.ndarray:
+        # deterministic per (table, id): a row's initial value must not
+        # depend on creation ORDER or which server shard owns it, or a
+        # sharded run can never match a single-process one
+        import zlib
+        seed = zlib.crc32(f"{self.name}:{key}".encode()) % (2 ** 31)
+        rng = np.random.RandomState(seed)
+        return self.accessor.init_rows(1, self.dim, rng)[0]
 
     def pull(self, ids: np.ndarray) -> np.ndarray:
         ids = np.asarray(ids).reshape(-1)
@@ -84,8 +140,7 @@ class SparseTable:
             for i, ident in enumerate(ids):
                 key = int(ident)
                 if key not in self._rows:
-                    self._rows[key] = self.accessor.init_rows(
-                        1, self.dim, self._rng)[0]
+                    self._rows[key] = self._init_row(key)
                 out[i] = self._rows[key]
         return out
 
@@ -101,8 +156,7 @@ class SparseTable:
                 acc[key] = acc.get(key, 0.0) + g
             for key, g in acc.items():
                 if key not in self._rows:
-                    self._rows[key] = self.accessor.init_rows(
-                        1, self.dim, self._rng)[0]
+                    self._rows[key] = self._init_row(key)
                 row = self._rows[key][None]
                 st = self._states.get(key)
                 st_new = self.accessor.apply(row, g[None], st)
@@ -113,6 +167,43 @@ class SparseTable:
     def size(self) -> int:
         with self._lock:
             return len(self._rows)
+
+    # ------------------------------------------- CTR lifecycle (ctr_accessor)
+    def push_show_click(self, ids, shows, clicks):
+        """Accumulate impression/click counters (CtrAccessor entries)."""
+        ids = np.asarray(ids).reshape(-1)
+        shows = np.asarray(shows).reshape(-1)
+        clicks = np.asarray(clicks).reshape(-1)
+        with self._lock:
+            for ident, s, c in zip(ids, shows, clicks):
+                key = int(ident)
+                sh, cl = self._show_click.get(key, (0.0, 0.0))
+                self._show_click[key] = (sh + float(s), cl + float(c))
+
+    def get_show_click(self, ident):
+        with self._lock:
+            return self._show_click.get(int(ident), (0.0, 0.0))
+
+    def shrink(self, threshold: Optional[float] = None) -> int:
+        """Decay counters, evict entries scoring under the threshold
+        (reference MemorySparseTable::Shrink). Returns evicted count."""
+        acc = self.accessor
+        if not isinstance(acc, CtrAccessor):
+            return 0
+        thr = acc.delete_threshold if threshold is None else threshold
+        evicted = 0
+        with self._lock:
+            for key in list(self._rows):
+                sh, cl = self._show_click.get(key, (0.0, 0.0))
+                sh *= acc.show_decay_rate
+                cl *= acc.show_decay_rate
+                self._show_click[key] = (sh, cl)
+                if acc.score(sh, cl) < thr:
+                    self._rows.pop(key, None)
+                    self._states.pop(key, None)
+                    self._show_click.pop(key, None)
+                    evicted += 1
+        return evicted
 
 
 class ParameterServer:

@@ -1,0 +1,256 @@
+"""PS data pipeline: slot datasets + prefetching feed.
+
+Analog of the reference's C++ Dataset/DataFeed stack
+(fluid/framework/data_set.h InMemoryDataset/QueueDataset,
+data_feed.h MultiSlotDataFeed): slot-record text files are parsed into
+memory, shuffled (locally or globally with a seed every worker shares),
+sharded per worker, and served as padded batches through a background
+prefetch thread — the data_feed role of keeping trainer threads fed
+without blocking on IO.
+
+Slot-record line format (the reference's MultiSlot text convention,
+simplified): whitespace-separated tokens, first the integer label,
+then `slot:feasign` pairs:
+
+    1 emb:1001 emb:53 ctx:7
+    0 emb:42 ctx:7 ctx:9
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class SlotRecord:
+    __slots__ = ("label", "slots")
+
+    def __init__(self, label: int, slots: Dict[str, List[int]]):
+        self.label = label
+        self.slots = slots
+
+
+def parse_slot_line(line: str) -> Optional[SlotRecord]:
+    toks = line.split()
+    if not toks:
+        return None
+    label = int(toks[0])
+    slots: Dict[str, List[int]] = {}
+    for t in toks[1:]:
+        slot, _, feasign = t.partition(":")
+        if not feasign:
+            raise ValueError(f"bad slot token '{t}' (want slot:feasign)")
+        slots.setdefault(slot, []).append(int(feasign))
+    return SlotRecord(label, slots)
+
+
+class InMemoryDataset:
+    """paddle.distributed.InMemoryDataset analog."""
+
+    def __init__(self):
+        self._files: List[str] = []
+        self._records: List[SlotRecord] = []
+        self.batch_size = 1
+        self.slots: Optional[List[str]] = None
+        self._prefetch = 2
+
+    def init(self, batch_size: int = 1, thread_num: int = 1,
+             use_var: Optional[Sequence[str]] = None,
+             prefetch: int = 2, **kwargs):
+        self.batch_size = int(batch_size)
+        self.slots = list(use_var) if use_var else None
+        self._prefetch = max(int(prefetch), 1)
+
+    def set_filelist(self, files: Sequence[str]):
+        self._files = list(files)
+
+    def load_into_memory(self):
+        self._records = []
+        for path in self._files:
+            with open(path) as f:
+                for line in f:
+                    rec = parse_slot_line(line)
+                    if rec is not None:
+                        self._records.append(rec)
+        if self.slots is None:
+            names = set()
+            for r in self._records:
+                names.update(r.slots)
+            self.slots = sorted(names)
+
+    # ---------------------------------------------------------- shuffles
+    def local_shuffle(self, seed: Optional[int] = None):
+        np.random.RandomState(seed).shuffle(self._records)
+
+    def global_shuffle(self, fleet=None, seed: int = 0):
+        """Every worker shuffles the FULL record list with the shared
+        seed, then reads its own interleaved shard — the same record
+        placement the reference's global shuffle produces without
+        needing the records to leave the workers."""
+        np.random.RandomState(seed).shuffle(self._records)
+
+    def get_memory_data_size(self) -> int:
+        return len(self._records)
+
+    # ----------------------------------------------------------- batches
+    def _shard(self, worker_id: int, n_workers: int) -> List[SlotRecord]:
+        return self._records[worker_id::n_workers]
+
+    def batches(self, worker_id: int = 0, n_workers: int = 1,
+                drop_last: bool = False):
+        """Yield (labels [B], {slot: (ids [B, L] int64, mask [B, L])})
+        with per-slot right-padding (id 0 + mask 0)."""
+        recs = self._shard(worker_id, n_workers)
+        bs = self.batch_size
+        for lo in range(0, len(recs), bs):
+            chunk = recs[lo:lo + bs]
+            if drop_last and len(chunk) < bs:
+                break
+            yield self._collate(chunk)
+
+    def _collate(self, chunk: List[SlotRecord]):
+        labels = np.asarray([r.label for r in chunk], np.float32)
+        out = {}
+        for slot in self.slots or ():
+            maxlen = max((len(r.slots.get(slot, ())) for r in chunk),
+                         default=1) or 1
+            ids = np.zeros((len(chunk), maxlen), np.int64)
+            mask = np.zeros((len(chunk), maxlen), np.float32)
+            for i, r in enumerate(chunk):
+                vals = r.slots.get(slot, [])
+                ids[i, :len(vals)] = vals
+                mask[i, :len(vals)] = 1.0
+            out[slot] = (ids, mask)
+        return labels, out
+
+    def prefetch_batches(self, worker_id: int = 0, n_workers: int = 1,
+                         drop_last: bool = False):
+        """Background-thread feed (data_feed.h role): batches are
+        collated ahead of consumption in a bounded queue."""
+        q: "queue.Queue" = queue.Queue(maxsize=self._prefetch)
+        DONE = object()
+
+        def feeder():
+            try:
+                for b in self.batches(worker_id, n_workers, drop_last):
+                    q.put(b)
+            finally:
+                q.put(DONE)
+
+        t = threading.Thread(target=feeder, daemon=True)
+        t.start()
+        while True:
+            b = q.get()
+            if b is DONE:
+                break
+            yield b
+        t.join()
+
+
+class QueueDataset(InMemoryDataset):
+    """Streaming variant (reference QueueDataset): batches parse lazily
+    from files, no shuffle (single pass)."""
+
+    def load_into_memory(self):   # streaming: nothing to preload
+        pass
+
+    def batches(self, worker_id: int = 0, n_workers: int = 1,
+                drop_last: bool = False):
+        if self.slots is None:
+            raise ValueError("QueueDataset needs init(use_var=[...]) — "
+                             "slots cannot be inferred while streaming")
+        chunk: List[SlotRecord] = []
+        idx = 0
+        for path in self._files:
+            with open(path) as f:
+                for line in f:
+                    rec = parse_slot_line(line)
+                    if rec is None:
+                        continue
+                    if idx % n_workers == worker_id:
+                        chunk.append(rec)
+                        if len(chunk) == self.batch_size:
+                            yield self._collate(chunk)
+                            chunk = []
+                    idx += 1
+        if chunk and not drop_last:
+            yield self._collate(chunk)
+
+
+# --------------------------------------------------------- worker loop
+
+class CtrWorker:
+    """Hogwild-style CTR trainer over the PS (device_worker.h
+    HogwildWorker role): sum-pooled sparse embeddings per slot -> dense
+    logistic head; embedding grads push to the sparse tables, head
+    grads to a dense table — optimizer-on-server for both."""
+
+    def __init__(self, client, slots: Sequence[str], dim: int,
+                 table_prefix: str = "ctr", lr: float = 0.1,
+                 kind: str = "sgd"):
+        self.client = client
+        self.slots = list(slots)
+        self.dim = dim
+        self.prefix = table_prefix
+        for slot in self.slots:
+            client.register_sparse_table(f"{table_prefix}.{slot}", dim,
+                                         kind=kind, lr=lr)
+        # the dense head is a plain parameter — the CTR entry lifecycle
+        # only applies to sparse tables
+        client.register_dense_table(f"{table_prefix}.head",
+                                    [len(self.slots) * dim + 1],
+                                    kind="sgd" if kind == "ctr" else kind,
+                                    lr=lr)
+
+    def train_batch(self, labels, slot_batches) -> float:
+        """One pull-compute-push round; returns the batch logloss."""
+        c = self.client
+        feats = []
+        pulled = {}
+        for slot in self.slots:
+            ids, mask = slot_batches[slot]
+            # padded positions (mask 0) must NOT touch the tables: they
+            # would materialize a phantom id-0 row and inflate rpcs
+            flat_ids = ids.reshape(-1)
+            sel = mask.reshape(-1) > 0
+            rows_flat = np.zeros((len(flat_ids), self.dim), np.float32)
+            if sel.any():
+                rows_flat[sel] = c.pull_sparse(
+                    f"{self.prefix}.{slot}", flat_ids[sel])
+            rows = rows_flat.reshape(*ids.shape, self.dim)
+            pulled[slot] = (ids, mask, rows)
+            feats.append((rows * mask[..., None]).sum(1))   # [B, D]
+        x = np.concatenate(feats, 1)                        # [B, S*D]
+        head = c.pull_dense(f"{self.prefix}.head")
+        w, b = head[:-1], head[-1]
+        logits = x @ w + b
+        p = 1.0 / (1.0 + np.exp(-logits))
+        y = np.asarray(labels, np.float32)
+        eps = 1e-7
+        loss = float(-np.mean(y * np.log(p + eps)
+                              + (1 - y) * np.log(1 - p + eps)))
+
+        dlogits = (p - y) / len(y)                          # [B]
+        dw = x.T @ dlogits
+        db = dlogits.sum()
+        c.push_dense(f"{self.prefix}.head",
+                     np.concatenate([dw, [db]]).astype(np.float32))
+        dx = np.outer(dlogits, w)                           # [B, S*D]
+        for si, slot in enumerate(self.slots):
+            ids, mask, rows = pulled[slot]
+            dslot = dx[:, si * self.dim:(si + 1) * self.dim]
+            drows = dslot[:, None, :] * mask[..., None]     # [B, L, D]
+            flat_ids = ids.reshape(-1)
+            sel = mask.reshape(-1) > 0
+            if not sel.any():
+                continue
+            c.push_sparse(f"{self.prefix}.{slot}", flat_ids[sel],
+                          drows.reshape(-1, self.dim)[sel])
+            if hasattr(c, "push_show_click"):
+                shows = mask.reshape(-1)[sel]
+                clicks = (mask * y[:, None]).reshape(-1)[sel]
+                c.push_show_click(f"{self.prefix}.{slot}",
+                                  flat_ids[sel], shows, clicks)
+        return loss

@@ -18,7 +18,8 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
-from . import Accessor, ParameterServer, get_parameter_server
+from . import (Accessor, CtrAccessor, ParameterServer,
+               get_parameter_server)
 from .. import rpc
 
 # ------------------------------------------------------------- handlers
@@ -30,16 +31,22 @@ import threading as _threading
 _register_lock = _threading.Lock()  # rpc handlers run in a thread pool
 
 
+def _make_accessor(kind, lr):
+    if kind == "ctr":
+        return CtrAccessor(lr=lr)
+    return Accessor(kind=kind, lr=lr)
+
+
 def _srv_register_dense(name, shape, kind, lr):
     ps = get_parameter_server()
     with _register_lock:  # check+register must be atomic (TOCTOU)
         if name not in ps._dense:
             ps.register_dense_table(name, shape,
-                                    Accessor(kind=kind, lr=lr))
+                                    _make_accessor(kind, lr))
         else:
             # re-register (second trainer, or a checkpoint-preloaded
             # table): keep the VALUES but honor the requested optimizer
-            ps._dense[name].accessor = Accessor(kind=kind, lr=lr)
+            ps._dense[name].accessor = _make_accessor(kind, lr)
     return True
 
 
@@ -47,10 +54,9 @@ def _srv_register_sparse(name, dim, kind, lr):
     ps = get_parameter_server()
     with _register_lock:
         if name not in ps._sparse:
-            ps.register_sparse_table(name, dim,
-                                     Accessor(kind=kind, lr=lr))
+            ps.register_sparse_table(name, dim, _make_accessor(kind, lr))
         else:
-            ps._sparse[name].accessor = Accessor(kind=kind, lr=lr)
+            ps._sparse[name].accessor = _make_accessor(kind, lr)
     return True
 
 
@@ -84,6 +90,41 @@ def _srv_load(path):
 
 def _srv_ping():
     return "pong"
+
+
+def _srv_push_show_click(name, ids, shows, clicks):
+    get_parameter_server()._sparse[name].push_show_click(ids, shows,
+                                                         clicks)
+    return True
+
+
+def _srv_shrink(name, threshold):
+    return get_parameter_server()._sparse[name].shrink(threshold)
+
+
+_barrier_lock = _threading.Lock()
+_barrier_state: Dict[str, list] = {}   # tag -> [arrived, generation]
+
+
+def _srv_barrier_arrive(tag: str, n: int) -> int:
+    """Generation barrier, arrive half: returns the generation the
+    caller joined; the n-th arrival bumps the generation and resets the
+    count, so tags are REUSABLE round after round. Handlers never
+    block — clients poll _srv_barrier_gen — so the rpc thread pool
+    cannot be starved by waiting participants."""
+    with _barrier_lock:
+        st = _barrier_state.setdefault(tag, [0, 0])
+        gen = st[1]
+        st[0] += 1
+        if st[0] >= n:
+            st[0] = 0
+            st[1] += 1
+        return gen
+
+
+def _srv_barrier_gen(tag: str) -> int:
+    with _barrier_lock:
+        return _barrier_state.get(tag, [0, 0])[1]
 
 
 # --------------------------------------------------------------- server
@@ -192,6 +233,39 @@ class PsClient:
     def ping(self) -> bool:
         return all(rpc.rpc_sync(s, _srv_ping) == "pong"
                    for s in self.servers)
+
+    def push_show_click(self, name, ids, shows, clicks):
+        """CTR counters ride the same id sharding as grads."""
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        shows = np.asarray(shows, np.float32).reshape(-1)
+        clicks = np.asarray(clicks, np.float32).reshape(-1)
+        shard = ids % self.n
+        futs = []
+        for s in range(self.n):
+            sel = shard == s
+            futs.append(rpc.rpc_async(
+                self.servers[s], _srv_push_show_click,
+                args=(name, ids[sel], shows[sel], clicks[sel])))
+        for f in futs:
+            f.wait()
+
+    def shrink(self, name, threshold=None) -> int:
+        """Run the CTR eviction pass on every shard; total evicted."""
+        return sum(rpc.rpc_sync(s, _srv_shrink, args=(name, threshold))
+                   for s in self.servers)
+
+    def barrier(self, tag: str, n: int, timeout: float = 300.0):
+        """All n participants must call with the same tag; tags are
+        reusable across rounds (generation-counted server side)."""
+        import time
+        g = rpc.rpc_sync(self.servers[0], _srv_barrier_arrive,
+                         args=(tag, n))
+        deadline = time.time() + timeout
+        while rpc.rpc_sync(self.servers[0], _srv_barrier_gen,
+                           args=(tag,)) <= g:
+            if time.time() > deadline:
+                raise TimeoutError(f"ps barrier '{tag}' timed out")
+            time.sleep(0.005)
 
 
 # ------------------------------------------------------------ fleet glue

@@ -37,6 +37,7 @@ _DIST_MODULES = {
     "test_auto_tuner_trials",
     "test_mp_multiproc",
     "test_acc_align",
+    "test_ps_runtime",
 }
 
 # Compile-heavy single-process suites (>= ~10 s each on one core):
