@@ -1,0 +1,205 @@
+// Eager hot-path primitives as a CPython extension.
+//
+// The reference keeps eager dispatch and the autograd walk in C++
+// (phi/core/kernel_factory.h:316 SelectKernelOrThrowError,
+// fluid/eager/backward.cc:106 RunBackward); this module is the
+// TPU-native equivalent of the pieces that still cost python time per
+// op after XLA owns the math:
+//
+//   attrs_key(name, backend, attrs) — the canonical executable-cache
+//       key (KernelKey construction): sorted (k, v) attr tuple built in
+//       one C pass. Returns None for attr values outside the primitive
+//       set so the caller can fall back to the python path.
+//   discover(roots)               — the backward engine's in-degree BFS
+//       (RunBackward's node_in_degree_map): one C loop over .edges.
+//
+// Plain CPython C API (no pybind per the build rules); compiled into
+// its own extension .so by _core/native.py next to libpaddle_tpu_rt.
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <algorithm>
+#include <vector>
+
+namespace {
+
+// value is cache-key-safe if hashable AND compares by value:
+// primitives and tuples thereof. (Lists/dicts/arrays -> python path.)
+bool key_safe(PyObject* v) {
+  if (v == Py_None || PyBool_Check(v) || PyLong_Check(v) ||
+      PyFloat_Check(v) || PyUnicode_Check(v) || PyBytes_Check(v)) {
+    return true;
+  }
+  if (PyTuple_Check(v)) {
+    Py_ssize_t n = PyTuple_GET_SIZE(v);
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      if (!key_safe(PyTuple_GET_ITEM(v, i))) return false;
+    }
+    return true;
+  }
+  return false;
+}
+
+PyObject* attrs_key(PyObject*, PyObject* args) {
+  PyObject* name;
+  PyObject* backend;
+  PyObject* attrs;
+  if (!PyArg_ParseTuple(args, "OOO!", &name, &backend, &PyDict_Type,
+                        &attrs)) {
+    return nullptr;
+  }
+
+  Py_ssize_t n = PyDict_Size(attrs);
+  std::vector<std::pair<PyObject*, PyObject*>> items;
+  items.reserve(n);
+  PyObject *k, *v;
+  Py_ssize_t pos = 0;
+  while (PyDict_Next(attrs, &pos, &k, &v)) {
+    if (!PyUnicode_Check(k) || !key_safe(v)) {
+      Py_RETURN_NONE;  // exotic attr: python fallback builds the key
+    }
+    items.emplace_back(k, v);
+  }
+  std::sort(items.begin(), items.end(),
+            [](const std::pair<PyObject*, PyObject*>& a,
+               const std::pair<PyObject*, PyObject*>& b) {
+              return PyUnicode_Compare(a.first, b.first) < 0;
+            });
+
+  PyObject* inner = PyTuple_New(n);
+  if (!inner) return nullptr;
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* pair = PyTuple_New(2);
+    if (!pair) {
+      Py_DECREF(inner);
+      return nullptr;
+    }
+    Py_INCREF(items[i].first);
+    Py_INCREF(items[i].second);
+    PyTuple_SET_ITEM(pair, 0, items[i].first);
+    PyTuple_SET_ITEM(pair, 1, items[i].second);
+    PyTuple_SET_ITEM(inner, i, pair);
+  }
+
+  PyObject* key = PyTuple_New(3);
+  if (!key) {
+    Py_DECREF(inner);
+    return nullptr;
+  }
+  Py_INCREF(name);
+  Py_INCREF(backend);
+  PyTuple_SET_ITEM(key, 0, name);
+  PyTuple_SET_ITEM(key, 1, backend);
+  PyTuple_SET_ITEM(key, 2, inner);
+  return key;
+}
+
+// discover(roots: list[GradNode]) -> dict {node: in_degree}
+// Mirrors autograd._discover: BFS over node.edges; an edge object with
+// .kind == "node" contributes one in-degree to .node.
+PyObject* discover(PyObject*, PyObject* args) {
+  PyObject* roots;
+  if (!PyArg_ParseTuple(args, "O", &roots)) return nullptr;
+  PyObject* seq = PySequence_Fast(roots, "discover expects a sequence");
+  if (!seq) return nullptr;
+
+  PyObject* deps = PyDict_New();
+  if (!deps) {
+    Py_DECREF(seq);
+    return nullptr;
+  }
+  PyObject* zero = PyLong_FromLong(0);
+  PyObject* kind_node = PyUnicode_InternFromString("node");
+  PyObject* s_edges = PyUnicode_InternFromString("edges");
+  PyObject* s_kind = PyUnicode_InternFromString("kind");
+  PyObject* s_node = PyUnicode_InternFromString("node");
+
+  std::vector<PyObject*> queue;  // borrowed refs kept alive by deps
+  Py_ssize_t nroots = PySequence_Fast_GET_SIZE(seq);
+  for (Py_ssize_t i = 0; i < nroots; ++i) {
+    PyObject* r = PySequence_Fast_GET_ITEM(seq, i);
+    if (!PyDict_Contains(deps, r)) {
+      if (PyDict_SetItem(deps, r, zero) < 0) goto fail;
+      queue.push_back(r);
+    }
+  }
+
+  for (size_t qi = 0; qi < queue.size(); ++qi) {
+    PyObject* node = queue[qi];
+    PyObject* edges = PyObject_GetAttr(node, s_edges);
+    if (!edges) goto fail;
+    PyObject* eseq = PySequence_Fast(edges, "edges must be a sequence");
+    Py_DECREF(edges);
+    if (!eseq) goto fail;
+    Py_ssize_t ne = PySequence_Fast_GET_SIZE(eseq);
+    for (Py_ssize_t i = 0; i < ne; ++i) {
+      PyObject* e = PySequence_Fast_GET_ITEM(eseq, i);
+      PyObject* kind = PyObject_GetAttr(e, s_kind);
+      if (!kind) {
+        Py_DECREF(eseq);
+        goto fail;
+      }
+      int is_node = PyObject_RichCompareBool(kind, kind_node, Py_EQ);
+      Py_DECREF(kind);
+      if (is_node < 0) {
+        Py_DECREF(eseq);
+        goto fail;
+      }
+      if (!is_node) continue;
+      PyObject* child = PyObject_GetAttr(e, s_node);
+      if (!child) {
+        Py_DECREF(eseq);
+        goto fail;
+      }
+      PyObject* cur = PyDict_GetItem(deps, child);  // borrowed
+      long count = cur ? PyLong_AsLong(cur) : 0;
+      PyObject* nv = PyLong_FromLong(count + 1);
+      int rc = nv ? PyDict_SetItem(deps, child, nv) : -1;
+      Py_XDECREF(nv);
+      if (rc < 0) {
+        Py_DECREF(child);
+        Py_DECREF(eseq);
+        goto fail;
+      }
+      if (!cur) queue.push_back(child);
+      Py_DECREF(child);
+    }
+    Py_DECREF(eseq);
+  }
+
+  Py_DECREF(zero);
+  Py_DECREF(kind_node);
+  Py_DECREF(s_edges);
+  Py_DECREF(s_kind);
+  Py_DECREF(s_node);
+  Py_DECREF(seq);
+  return deps;
+
+fail:
+  Py_XDECREF(zero);
+  Py_XDECREF(kind_node);
+  Py_XDECREF(s_edges);
+  Py_XDECREF(s_kind);
+  Py_XDECREF(s_node);
+  Py_DECREF(deps);
+  Py_DECREF(seq);
+  return nullptr;
+}
+
+PyMethodDef methods[] = {
+    {"attrs_key", attrs_key, METH_VARARGS,
+     "Canonical (name, backend, sorted attrs) executable-cache key; "
+     "None if any attr value needs the python fallback."},
+    {"discover", discover, METH_VARARGS,
+     "Backward-engine in-degree BFS over GradNode.edges."},
+    {nullptr, nullptr, 0, nullptr}};
+
+PyModuleDef module = {PyModuleDef_HEAD_INIT, "pt_eager_core",
+                      "Eager hot-path primitives (csrc/eager_core.cc).",
+                      -1, methods};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit_pt_eager_core(void) {
+  return PyModule_Create(&module);
+}

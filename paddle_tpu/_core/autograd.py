@@ -209,7 +209,13 @@ def record(op: OpDef, attrs, in_tensors, out_tensors, saved_vals=None):
 # ---------------------------------------------------------------- the engine
 
 def _discover(roots: List[GradNode]):
-    """BFS the grad graph; return per-node in-degree (edge reference counts)."""
+    """BFS the grad graph; return per-node in-degree (edge reference
+    counts). The C extension (csrc/eager_core.cc discover) runs the
+    same walk in one C loop; this python body is the fallback."""
+    from .dispatch import _eager_core
+    ec = _eager_core()
+    if ec is not None:
+        return ec.discover(roots)
     deps: Dict[GradNode, int] = defaultdict(int)
     visited = set()
     q = deque(roots)

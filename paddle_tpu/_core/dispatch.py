@@ -41,9 +41,32 @@ def attrs_key(attrs: Dict[str, Any]):
     return tuple(sorted((k, _hashable(v)) for k, v in attrs.items()))
 
 
+def _full_key(name: str, backend: str, attrs: Dict[str, Any]):
+    """(name, backend, canonical attrs): the KernelKey of the executable
+    cache. The C extension builds it in one pass for primitive attrs
+    (kernel_factory.h:58 role); exotic values take the python path."""
+    ec = _eager_core()
+    if ec is not None:
+        key = ec.attrs_key(name, backend, attrs)
+        if key is not None:
+            return key
+    return (name, backend, attrs_key(attrs))
+
+
+_EAGER_CORE = False   # tri-state: False = not looked up yet
+
+
+def _eager_core():
+    global _EAGER_CORE
+    if _EAGER_CORE is False:
+        from . import native
+        _EAGER_CORE = native.get_eager_core()
+    return _EAGER_CORE
+
+
 def fwd_callable(op: OpDef, attrs: Dict[str, Any]):
     backend = jax.default_backend()  # kernel-key Backend component
-    key = (op.name, backend, attrs_key(attrs))
+    key = _full_key(op.name, backend, attrs)
     fn = _FWD_CACHE.get(key)
     if fn is None:
         cap = flags.flag_value("FLAGS_eager_compile_cache_size")
@@ -67,7 +90,7 @@ def eager_forward(op: OpDef, vals: Tuple, attrs: Dict[str, Any]) -> Tuple:
 
 def bwd_callable(op: OpDef, attrs: Dict[str, Any]):
     backend = jax.default_backend()
-    key = (op.name, backend, attrs_key(attrs))
+    key = _full_key(op.name, backend, attrs)
     fn = _BWD_CACHE.get(key)
     if fn is not None:
         return fn

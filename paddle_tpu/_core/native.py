@@ -38,8 +38,11 @@ def _needs_build() -> bool:
 
 
 def _build():
+    import sys
+    env = dict(os.environ)
+    env["PT_PYTHON"] = sys.executable   # ABI-match the extension build
     subprocess.run(["sh", os.path.join(_CSRC, "build.sh")], check=True,
-                   capture_output=True)
+                   capture_output=True, env=env)
 
 
 def _bind(lib):
@@ -217,3 +220,31 @@ def host_pool():
         _HOST_POOL = lib.pt_alloc_create(
             int(flags.flag_value("FLAGS_host_alloc_chunk_kb")) * 1024)
     return _HOST_POOL
+
+
+_EAGER_CORE = None
+_EAGER_CORE_TRIED = False
+
+
+def get_eager_core():
+    """The eager hot-path CPython extension (csrc/eager_core.cc):
+    dispatch-key construction + backward in-degree BFS in C. Returns
+    None when unavailable (python fallbacks stay correct); set
+    PT_DISABLE_NATIVE_EAGER=1 to force the python path."""
+    global _EAGER_CORE, _EAGER_CORE_TRIED
+    if _EAGER_CORE_TRIED:
+        return _EAGER_CORE
+    _EAGER_CORE_TRIED = True
+    if os.environ.get("PT_DISABLE_NATIVE_EAGER") == "1":
+        return None
+    try:
+        get_lib(required=True)   # builds csrc (including the extension)
+        import importlib.util
+        so = os.path.join(_CSRC, "build", "pt_eager_core.so")
+        spec = importlib.util.spec_from_file_location("pt_eager_core", so)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _EAGER_CORE = mod
+    except Exception:
+        _EAGER_CORE = None
+    return _EAGER_CORE

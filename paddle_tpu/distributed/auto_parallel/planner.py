@@ -105,17 +105,23 @@ def plan_stage_map(ws, n_stages: int,
     for i, op in enumerate(ops):
         for v in getattr(op, "outputs", []):
             produced_at[id(v)] = i
-    cross = [0.0] * (n + 1)
+    # a var crossing a cut is ONE send regardless of how many later
+    # ops consume it: accumulate per var over [producer+1, last_consumer]
+    last_use: Dict[int, int] = {}
+    var_of: Dict[int, object] = {}
     for i, op in enumerate(ops):
         for v in getattr(op, "inputs", []):
             p = produced_at.get(id(v))
             if p is None or p >= i:
                 continue
-            b = cm.var_bytes(v)
-            # v crosses every cut between producer and consumer; a cut's
-            # comm load is the SUM of all vars crossing it
-            for j in range(p + 1, i + 1):
-                cross[j] += b
+            last_use[id(v)] = max(last_use.get(id(v), 0), i)
+            var_of[id(v)] = v
+    cross = [0.0] * (n + 1)
+    for vid, i in last_use.items():
+        p = produced_at[vid]
+        b = cm.var_bytes(var_of[vid])
+        for j in range(p + 1, i + 1):
+            cross[j] += b
 
     # Objective (lexicographic): minimize the BOTTLENECK stage compute —
     # steady-state pipeline throughput is set by the slowest stage, with

@@ -120,3 +120,100 @@ def test_gpt_train_step_dp_pp_mp_3d():
     state = init_fn(0)
     state, loss = step_fn(state, tokens, labels)
     assert np.isfinite(float(loss))
+
+
+def test_activation_memory_scales_with_stages_not_microbatches():
+    """Memory-true pipeline (VERDICT r4 item 4), both halves:
+
+    (a) instrumentation: jax.grad THROUGH the streamed scan has GPipe
+        residency — saved boundary activations grow with the number of
+        micro-batches even at a fixed global batch;
+    (b) the hand-scheduled pipeline_1f1b_train_step keeps a rotating
+        residual stash of depth 2*stages, so its compiled temp memory
+        stays nearly flat in M — the 1F1B activation bound.
+    """
+    from paddle_tpu.distributed.pipeline_compiled import (
+        pipeline_1f1b_train_step)
+
+    rng = np.random.RandomState(2)
+    L, h = 4, 256
+    B = 32                      # fixed global batch for both runs
+    w = jnp.asarray(rng.randn(L, h, h) * 0.1, jnp.float32)
+    b = jnp.asarray(rng.randn(L, h) * 0.1, jnp.float32)
+    x = jnp.asarray(rng.randn(B, h), jnp.float32)
+    y = jnp.asarray(rng.randn(B, h), jnp.float32)
+    mesh = _mesh((4,), ("pp",))
+
+    def block(a, blk):
+        wi, bi = blk
+        hmid = jnp.tanh(a @ wi + bi)
+        return jnp.tanh(hmid @ wi.T + a)
+
+    def stage(p, a):
+        return block(a, p)
+
+    def loss_fn(out, lbl):
+        return jnp.mean((out - lbl) ** 2)
+
+    def scan_temp(m):
+        trunk = pipelined_trunk(block, mesh, num_microbatches=m,
+                                axis_name="pp", remat=True)
+
+        def loss(params, xv):
+            return (trunk(params, xv) ** 2).mean()
+
+        mem = jax.jit(jax.grad(loss)).lower(
+            (w, b), x).compile().memory_analysis()
+        return float(mem.temp_size_in_bytes)
+
+    def f1b_temp(m):
+        tr = pipeline_1f1b_train_step(stage, loss_fn, mesh, m)
+        mem = jax.jit(tr).lower((w, b), x, y).compile().memory_analysis()
+        return float(mem.temp_size_in_bytes)
+
+    scan_ratio = scan_temp(8) / scan_temp(2)
+    f1b_ratio = f1b_temp(8) / f1b_temp(2)
+    # the scan grows with M (GPipe residency); 1F1B must not
+    assert f1b_ratio <= 1.3, (f1b_ratio,)
+    assert f1b_ratio < scan_ratio, (f1b_ratio, scan_ratio)
+
+
+def test_1f1b_compiled_matches_sequential_grads():
+    rng = np.random.RandomState(5)
+    from paddle_tpu.distributed.pipeline_compiled import (
+        pipeline_1f1b_train_step)
+    n, M, mb, h = 4, 8, 2, 16
+    w = jnp.asarray(rng.randn(n, h, h) * 0.3, jnp.float32)
+    b = jnp.asarray(rng.randn(n, h) * 0.1, jnp.float32)
+    x = jnp.asarray(rng.randn(M * mb, h), jnp.float32)
+    y = jnp.asarray(rng.randn(M * mb, h), jnp.float32)
+
+    def stage(p, a):
+        wi, bi = p
+        return jnp.tanh(a @ wi + bi)
+
+    def loss_fn(out, lbl):
+        return jnp.mean((out - lbl) ** 2)
+
+    mesh = _mesh((4,), ("pp",))
+    train = pipeline_1f1b_train_step(stage, loss_fn, mesh, M)
+    loss, grads = jax.jit(train)((w, b), x, y)
+
+    def seq_loss(params, xv, yv):
+        wf, bf = params
+        a = xv
+        for i in range(n):
+            a = jnp.tanh(a @ wf[i] + bf[i])
+        am = a.reshape(M, mb, h)
+        ym = yv.reshape(M, mb, h)
+        return jnp.mean(jnp.stack(
+            [jnp.mean((am[i] - ym[i]) ** 2) for i in range(M)]))
+
+    ref_loss, ref_grads = jax.value_and_grad(seq_loss)((w, b), x, y)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(grads[0]),
+                               np.asarray(ref_grads[0]), rtol=2e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(grads[1]),
+                               np.asarray(ref_grads[1]), rtol=2e-4,
+                               atol=1e-5)
