@@ -53,6 +53,106 @@ def _mask_2_4(w: np.ndarray) -> np.ndarray:
     return mask.reshape(orig)
 
 
+def _mask_2d_patterns():
+    """All 4x4 binary matrices with exactly two ones per row AND per
+    column (the reference's valid 2D 2:4 patterns, 90 of them)."""
+    global _PATTERNS_2D
+    if _PATTERNS_2D is not None:
+        return _PATTERNS_2D
+    import itertools
+    rows = [r for r in itertools.product([0, 1], repeat=4)
+            if sum(r) == 2]
+    pats = []
+    for combo in itertools.product(rows, repeat=4):
+        m = np.asarray(combo, np.float64)
+        if (m.sum(0) == 2).all():
+            pats.append(m)
+    _PATTERNS_2D = np.stack(pats)        # [90, 4, 4]
+    return _PATTERNS_2D
+
+
+_PATTERNS_2D = None
+
+
+def _blocks_4x4(w: np.ndarray):
+    """(blocks [nb, 4, 4], meta) for the padded 2-D view of w."""
+    orig = w.shape
+    flat = w.reshape(-1, orig[-1])
+    r_pad = (-flat.shape[0]) % 4
+    c_pad = (-flat.shape[1]) % 4
+    padded = np.pad(flat, ((0, r_pad), (0, c_pad)))
+    R, C = padded.shape
+    blocks = padded.reshape(R // 4, 4, C // 4, 4).transpose(0, 2, 1, 3)
+    return blocks.reshape(-1, 4, 4), (orig, flat.shape, R, C)
+
+
+def _unblocks(mask_blocks: np.ndarray, meta) -> np.ndarray:
+    orig, fshape, R, C = meta
+    m = mask_blocks.reshape(R // 4, C // 4, 4, 4).transpose(0, 2, 1, 3)
+    m = m.reshape(R, C)[:fshape[0], :fshape[1]]
+    return m.reshape(orig)
+
+
+def _mask_2d_best(w: np.ndarray) -> np.ndarray:
+    """Exhaustive best 2D 2:4 mask per 4x4 block (asp/utils.py
+    get_mask_2d_best): among the 90 valid patterns pick the one
+    retaining the most magnitude — 2:4 along rows AND columns, the
+    layout that stays sparse under transpose."""
+    blocks, meta = _blocks_4x4(np.abs(w))
+    pats = _mask_2d_patterns()                       # [90, 4, 4]
+    scores = np.einsum("bij,pij->bp", blocks, pats)  # [nb, 90]
+    best = pats[np.argmax(scores, axis=1)]           # [nb, 4, 4]
+    return _unblocks(best, meta)
+
+
+def _mask_2d_greedy(w: np.ndarray) -> np.ndarray:
+    """Greedy 2D 2:4 (get_mask_2d_greedy): take entries by magnitude
+    while row/col budgets (2 each) allow. Greedy can dead-end below 8
+    kept entries (budgets exhausted with one admissible cell left);
+    stuck blocks fall back to the exhaustive pattern search so density
+    is always exactly 0.5."""
+    blocks, meta = _blocks_4x4(np.abs(w))
+    pats = _mask_2d_patterns()
+    out = np.zeros_like(blocks)
+    for b in range(blocks.shape[0]):
+        order = np.argsort(-blocks[b].reshape(-1))
+        rows = np.zeros(4, int)
+        cols = np.zeros(4, int)
+        taken = 0
+        for idx in order:
+            i, j = divmod(int(idx), 4)
+            if rows[i] < 2 and cols[j] < 2:
+                out[b, i, j] = 1.0
+                rows[i] += 1
+                cols[j] += 1
+                taken += 1
+                if taken == 8:
+                    break
+        if taken < 8:
+            scores = np.einsum("ij,pij->p", blocks[b], pats)
+            out[b] = pats[np.argmax(scores)]
+    return _unblocks(out, meta)
+
+
+_MASK_ALGOS = {
+    "mask_1d": _mask_2_4,
+    "mask_2d_greedy": _mask_2d_greedy,
+    "mask_2d_best": _mask_2d_best,
+}
+
+
+def check_mask_2d(mat: np.ndarray) -> bool:
+    """Every 4x4 block has <= 2 nonzeros per row AND per column."""
+    blocks, _ = _blocks_4x4(mat)
+    nz = np.abs(blocks) > 0
+    return bool(np.all(nz.sum(1) <= 2) and np.all(nz.sum(2) <= 2))
+
+
+def calculate_density(mat: np.ndarray) -> float:
+    mat = np.asarray(mat)
+    return float((np.abs(mat) > 0).mean())
+
+
 def check_mask_2_4(mat: np.ndarray) -> bool:
     """Every aligned group of 4 (last dim) has <= 2 nonzeros."""
     n = mat.shape[-1]
@@ -69,6 +169,10 @@ def prune_model(model, n=2, m=4, mask_algo=None, with_mask=True):
     if mask_algo is None:
         from ..._core.flags import flag_value
         mask_algo = flag_value("FLAGS_asp_mask_algo")
+    if mask_algo not in _MASK_ALGOS:
+        raise ValueError(f"unknown mask_algo '{mask_algo}' "
+                         f"(have {sorted(_MASK_ALGOS)})")
+    make_mask = _MASK_ALGOS[mask_algo]
     pruned = {}
     for name, sub in model.named_sublayers():
         if not any(isinstance(sub, t) for t in _supported_layers):
@@ -77,7 +181,7 @@ def prune_model(model, n=2, m=4, mask_algo=None, with_mask=True):
                 _excluded:
             continue
         w = np.asarray(sub.weight.numpy())
-        mask = _mask_2_4(w)
+        mask = make_mask(w)
         sub.weight.set_value(Tensor(jnp.asarray(w * mask)))
         _masks[id(sub.weight)] = jnp.asarray(mask)
         pruned[name] = mask

@@ -161,3 +161,20 @@ def _accuracy_check_kernel(x, y, fn_name, rtol, atol, equal_nan):
 # Per-tensor numeric compare op (reference ops.yaml:31 accuracy_check):
 # the primitive under the acc-align parity harnesses.
 register_op("accuracy_check", _accuracy_check_kernel)
+
+
+def _quant_linear_i8(x, wq, w_scale, act_scale, qmax):
+    """Dynamic-activation int8 linear: quantize x, int8 x int8 matmul
+    with an int32 accumulator (the MXU's native int8 path), dequantize
+    by act_scale * per-channel w_scale. w_scale is a tensor INPUT, not
+    an attr — every layer shares one compiled executable."""
+    from jax import lax
+    xq = jnp.clip(jnp.round(x / act_scale), -qmax - 1, qmax).astype(
+        jnp.int8)
+    acc = lax.dot_general(
+        xq, wq, (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    return acc.astype(jnp.float32) * (act_scale * w_scale)
+
+
+register_op("quant_linear_i8", _quant_linear_i8)

@@ -56,11 +56,129 @@ class MovingAverageObserver(BaseObserver):
             self.momentum * self._scale + (1 - self.momentum) * cur
 
 
+class HistObserver(BaseObserver):
+    """Histogram-percentile observer (imperative/ptq_quantizer.py
+    HistQuantizer analog): accumulates a |x| histogram over calibration
+    batches and clips at the given percentile — robust to outliers that
+    blow up plain absmax."""
+
+    def __init__(self, quant_bits: int = None, bins: int = 2048,
+                 percentile: float = 0.9999):
+        super().__init__(quant_bits)
+        self.bins = bins
+        self.percentile = percentile
+        self._hist: Optional[np.ndarray] = None
+        self._hist_max = 0.0
+
+    def observe(self, x: Tensor):
+        a = np.abs(np.asarray(x.numpy(), np.float64)).reshape(-1)
+        amax = float(a.max()) if a.size else 0.0
+        if amax == 0.0:
+            return
+        if self._hist is None:
+            self._hist_max = amax
+            self._hist, _ = np.histogram(a, self.bins,
+                                         range=(0, self._hist_max))
+            self._hist = self._hist.astype(np.float64)
+        else:
+            if amax > self._hist_max:
+                # rescale the existing histogram into the wider range
+                ratio = self._hist_max / amax
+                old = self._hist
+                idx = (np.arange(self.bins) * ratio).astype(int)
+                nh = np.zeros(self.bins)
+                np.add.at(nh, idx, old)
+                self._hist = nh
+                self._hist_max = amax
+            h, _ = np.histogram(a, self.bins, range=(0, self._hist_max))
+            self._hist += h
+        cdf = np.cumsum(self._hist)
+        cdf = cdf / cdf[-1]
+        cut = int(np.searchsorted(cdf, self.percentile)) + 1
+        self._scale = (cut / self.bins) * self._hist_max / self.qmax
+
+
+class KLObserver(HistObserver):
+    """KL-divergence threshold search (ptq_quantizer.py KLQuantizer /
+    the TensorRT calibration recipe): pick the clip threshold whose
+    quantized distribution minimizes KL(P||Q) against the clipped
+    reference distribution."""
+
+    def __init__(self, quant_bits: int = None, bins: int = 2048):
+        super().__init__(quant_bits, bins=bins)
+
+    def _finalize_scale(self):
+        if self._hist is None:
+            return
+        nlevels = int(2 ** (self.quant_bits - 1))   # 128 for int8
+        hist = self._hist
+        best_kl, best_i = None, self.bins
+        for i in range(nlevels, self.bins + 1, max(self.bins // 128, 1)):
+            p = hist[:i].copy()
+            p[i - 1] += hist[i:].sum()          # clip mass into the edge
+            if p.sum() == 0:
+                continue
+            # quantize the first i bins down to nlevels buckets
+            chunk = i / nlevels
+            edges = (np.arange(i) / chunk).astype(int)
+            q = np.zeros(i)
+            sums = np.zeros(nlevels)
+            counts = np.zeros(nlevels)
+            np.add.at(sums, edges, p)
+            np.add.at(counts, edges, (hist[:i] > 0).astype(float))
+            counts[counts == 0] = 1
+            q = (sums / counts)[edges] * (hist[:i] > 0)
+            ps = p / p.sum()
+            qs = q / q.sum() if q.sum() else q
+            mask = ps > 0
+            kl = float(np.sum(ps[mask] * np.log(
+                ps[mask] / np.maximum(qs[mask], 1e-12))))
+            if best_kl is None or kl < best_kl:
+                best_kl, best_i = kl, i
+        self._scale = (best_i / self.bins) * self._hist_max / self.qmax
+
+    def observe(self, x: Tensor):
+        super().observe(x)
+        self._finalize_scale()
+
+
+class PerChannelAbsmaxObserver(BaseObserver):
+    """Channel-wise absmax for WEIGHTS (observers/groupwise.py role):
+    one scale per output channel; `axis` is the channel dim (0 for
+    conv OIHW, 1 for linear [in, out])."""
+
+    def __init__(self, quant_bits: int = None, axis: int = -1):
+        super().__init__(quant_bits)
+        self.axis = axis
+        self._scales: Optional[np.ndarray] = None
+
+    def observe(self, x: Tensor):
+        a = np.abs(np.asarray(x.numpy(), np.float64))
+        ax = self.axis % a.ndim
+        red = tuple(d for d in range(a.ndim) if d != ax)
+        amax = a.max(axis=red)
+        amax[amax == 0] = 1e-8
+        cur = amax / self.qmax
+        self._scales = cur if self._scales is None else \
+            np.maximum(self._scales, cur)
+        self._scale = float(cur.max())
+
+    def scale(self):
+        return self._scales if self._scales is not None else 1.0
+
+
 # ------------------------------------------------------------ fake quant
 
-def fake_quant(x: Tensor, scale: float, qmax: float) -> Tensor:
-    """Simulated symmetric int quantization with STE."""
+def fake_quant(x: Tensor, scale, qmax: float,
+               channel_axis: Optional[int] = None) -> Tensor:
+    """Simulated symmetric int quantization with STE; `scale` may be a
+    per-channel array (broadcast along `channel_axis`)."""
     import paddle_tpu as paddle
+    if isinstance(scale, np.ndarray):
+        shape = [1] * x.ndim
+        ax = (channel_axis if channel_axis is not None else -1) % x.ndim
+        shape[ax] = scale.shape[0]
+        scale = paddle.to_tensor(scale.reshape(shape).astype("float32"))
     q = paddle.clip(paddle.round(x / scale), -qmax - 1, qmax) * scale
     return x + (q - x).detach()
 
@@ -87,7 +205,9 @@ class QuantedLayer(nn.Layer):
         self.weight_observer.observe(self.inner.weight)
         wq = fake_quant(self.inner.weight,
                         self.weight_observer.scale(),
-                        self.weight_observer.qmax)
+                        self.weight_observer.qmax,
+                        channel_axis=getattr(self.weight_observer,
+                                             "axis", None))
         inner = self.inner
         if isinstance(inner, nn.Linear):
             return F.linear(xq, wq, inner.bias)
@@ -143,6 +263,107 @@ def _swap_layers(model: nn.Layer, config: QuantConfig, qat: bool):
     return model
 
 
+def _broadcast_scale(w_scale, ndim: int, axis: int):
+    """Per-channel scales reshaped to broadcast against the weight
+    along the OBSERVER'S channel axis (not a hardcoded one)."""
+    if not isinstance(w_scale, np.ndarray):
+        return float(w_scale)
+    shape = [1] * ndim
+    shape[axis % ndim] = w_scale.shape[0]
+    return w_scale.reshape(shape)
+
+
+class QuantizedLinear(nn.Layer):
+    """CONVERTED linear: int8 weights + frozen scales, executing the
+    matmul on the MXU in int8 with an int32 accumulator (the TPU form
+    of the reference's quantized inference kernels): x is dynamically
+    quantized per call, y = (x_q @ w_q) * (s_x * s_w)."""
+
+    def __init__(self, inner: nn.Linear, w_scale, act_scale: float,
+                 qmax: float, channel_axis: int = -1):
+        super().__init__()
+        import paddle_tpu as paddle
+        w = np.asarray(inner.weight.numpy(), np.float64)
+        ws = _broadcast_scale(w_scale, w.ndim, channel_axis)
+        wq = np.clip(np.round(w / ws), -qmax - 1, qmax).astype(np.int8)
+        self.register_buffer("weight_q", paddle.to_tensor(wq))
+        # a [out] row vector the op broadcasts over the output dim
+        out_scale = np.broadcast_to(
+            np.asarray(ws, np.float32), w.shape).max(
+            axis=tuple(range(w.ndim - 1)))
+        self.register_buffer("w_scale", paddle.to_tensor(
+            out_scale.astype(np.float32)))
+        self.act_scale = float(act_scale)
+        self.qmax = float(qmax)
+        self.bias = inner.bias
+
+    def forward(self, x):
+        from .._core.executor import apply
+        out = apply("quant_linear_i8", x, self.weight_q, self.w_scale,
+                    act_scale=self.act_scale, qmax=self.qmax)
+        return out + self.bias if self.bias is not None else out
+
+
+class QuantizedConv2D(nn.Layer):
+    """CONVERTED conv: weight-only int8 storage (4x smaller params),
+    dequantized ON DEVICE at call time (cast + multiply through the op
+    registry, so the path traces/compiles) — the deployment sweet spot
+    when activations stay bf16 on the MXU. The fp32 weight is NOT
+    retained; only int8 + scales + conv attrs survive conversion."""
+
+    def __init__(self, inner: nn.Conv2D, w_scale, qmax: float,
+                 channel_axis: int = 0):
+        super().__init__()
+        import paddle_tpu as paddle
+        w = np.asarray(inner.weight.numpy(), np.float64)
+        ws = _broadcast_scale(w_scale, w.ndim, channel_axis)
+        wq = np.clip(np.round(w / ws), -qmax - 1, qmax).astype(np.int8)
+        self.register_buffer("weight_q", paddle.to_tensor(wq))
+        self.register_buffer("w_scale", paddle.to_tensor(
+            np.broadcast_to(np.asarray(ws, np.float32),
+                            w.shape).astype(np.float32)))
+        self.bias = inner.bias
+        self._stride = inner._stride
+        self._padding = inner._padding
+        self._dilation = inner._dilation
+        self._groups = inner._groups
+
+    def forward(self, x):
+        from .._core.executor import apply
+        from ..nn import functional as F
+        w = apply("cast", self.weight_q, dtype="float32") * self.w_scale
+        return F.conv2d(x, w, self.bias, stride=self._stride,
+                        padding=self._padding, dilation=self._dilation,
+                        groups=self._groups)
+
+
+def _convert_layers(model: nn.Layer):
+    for name, child in list(model._sub_layers.items()):
+        if isinstance(child, QuantedLayer):
+            w_scale = child.weight_observer.scale()
+            act_scale = child.act_observer.scale()
+            qmax = child.weight_observer.qmax
+            axis = getattr(child.weight_observer, "axis", None)
+            if isinstance(child.inner, nn.Linear):
+                model._sub_layers[name] = QuantizedLinear(
+                    child.inner, w_scale, act_scale, qmax,
+                    channel_axis=axis if axis is not None else -1)
+            elif isinstance(child.inner, nn.Conv2D):
+                model._sub_layers[name] = QuantizedConv2D(
+                    child.inner, w_scale, qmax,
+                    channel_axis=axis if axis is not None else 0)
+        else:
+            _convert_layers(child)
+    return model
+
+
+def _maybe_copy(model: nn.Layer, inplace: bool) -> nn.Layer:
+    if inplace:
+        return model
+    import copy
+    return copy.deepcopy(model)
+
+
 class QAT:
     """Quantization-aware training (quantization/qat.py analog)."""
 
@@ -150,10 +371,13 @@ class QAT:
         self.config = config
 
     def quantize(self, model: nn.Layer, inplace: bool = False):
-        return _swap_layers(model, self.config, qat=True)
+        return _swap_layers(_maybe_copy(model, inplace), self.config,
+                            qat=True)
 
     def convert(self, model: nn.Layer, inplace: bool = False):
-        return model
+        """Freeze scales, store int8 weights, swap in the int8 compute
+        layers (the reference's convert/save-quantized step)."""
+        return _convert_layers(_maybe_copy(model, inplace))
 
 
 class PTQ:
@@ -164,10 +388,11 @@ class PTQ:
         self.config = config
 
     def quantize(self, model: nn.Layer, inplace: bool = False):
-        return _swap_layers(model, self.config, qat=False)
+        return _swap_layers(_maybe_copy(model, inplace), self.config,
+                            qat=False)
 
     def convert(self, model: nn.Layer, inplace: bool = False):
-        return model
+        return _convert_layers(_maybe_copy(model, inplace))
 
 
 def quanted_scales(model: nn.Layer) -> Dict[str, float]:

@@ -140,3 +140,36 @@ def test_distributed_embedding_lookup_update():
     out2 = emb.forward(np.array([1]))
     # id 1 appeared twice -> grad 2 applied with lr 1
     np.testing.assert_allclose(out2[0], out[0, 0] - 2.0, rtol=1e-5)
+
+
+def test_asp_2d_mask_algorithms():
+    import numpy as np
+    from paddle_tpu.incubate.asp import (_mask_2d_best, _mask_2d_greedy,
+                                         calculate_density,
+                                         check_mask_2d, check_mask_2_4)
+    r = np.random.RandomState(0)
+    w = r.randn(8, 12).astype("float32")
+    for fn in (_mask_2d_best, _mask_2d_greedy):
+        m = fn(w)
+        assert m.shape == w.shape
+        assert check_mask_2d(m * w)
+        assert check_mask_2_4(m * w)          # 2D implies 1D rows
+        assert abs(calculate_density(m) - 0.5) < 1e-6
+    # best >= greedy in retained magnitude
+    best = (np.abs(w) * _mask_2d_best(w)).sum()
+    greedy = (np.abs(w) * _mask_2d_greedy(w)).sum()
+    assert best >= greedy - 1e-6
+
+
+def test_asp_prune_model_honors_mask_algo():
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    import paddle_tpu.incubate.asp as asp
+    import pytest
+    paddle.seed(9)
+    net = nn.Sequential(nn.Linear(8, 8))
+    asp.prune_model(net, mask_algo="mask_2d_best")
+    assert asp.check_mask_2d(np.asarray(net[0].weight.numpy()))
+    with pytest.raises(ValueError):
+        asp.prune_model(net, mask_algo="nope")

@@ -59,7 +59,7 @@ def test_ptq_calibration_collects_scales():
             qnet(paddle.to_tensor(rng.randn(4, 8).astype(np.float32)))
     scales = quanted_scales(qnet)
     assert all(v["activation"] > 0 for v in scales.values())
-    out = ptq.convert(qnet)
+    out = ptq.convert(qnet, inplace=True)
     assert out is qnet
 
 
@@ -75,3 +75,152 @@ def test_quantized_output_close_to_fp():
     out = qnet(x).numpy()
     # int8 simulation stays within ~2% relative of fp32
     assert np.max(np.abs(out - ref)) < 0.05 * np.max(np.abs(ref)) + 0.02
+
+
+# --------------------------------------------------- r5: observers + int8
+
+def test_hist_observer_robust_to_outliers():
+    from paddle_tpu.quantization import AbsmaxObserver, HistObserver
+    r = np.random.RandomState(0)
+    data = paddle.to_tensor(np.concatenate(
+        [r.randn(10000), [100.0]]).astype("float32"))
+    h = HistObserver(bins=256, percentile=0.999)
+    h.observe(data)
+    a = AbsmaxObserver()
+    a.observe(data)
+    # absmax is destroyed by the single outlier; the histogram clips it
+    assert h.scale() < a.scale() * 0.2
+
+
+def test_kl_observer_reasonable_threshold():
+    from paddle_tpu.quantization import KLObserver
+    r = np.random.RandomState(1)
+    k = KLObserver(bins=256)
+    k.observe(paddle.to_tensor(r.randn(5000).astype("float32")))
+    # gaussian: the KL threshold lands well inside the tail
+    assert 0.005 < k.scale() < 0.05
+
+
+def test_per_channel_weight_observer():
+    from paddle_tpu.quantization import PerChannelAbsmaxObserver
+    w = np.zeros((4, 3), "float32")
+    w[:, 0] = 1.0
+    w[:, 1] = 10.0
+    w[:, 2] = 0.1
+    ob = PerChannelAbsmaxObserver(axis=1)
+    ob.observe(paddle.to_tensor(w))
+    s = ob.scale()
+    assert s.shape == (3,)
+    assert s[1] > s[0] > s[2]
+
+
+def test_qat_train_then_int8_convert_close_to_float():
+    from paddle_tpu.quantization import (MovingAverageObserver,
+                                         PerChannelAbsmaxObserver, QAT,
+                                         QuantConfig, QuantizedLinear)
+    r = np.random.RandomState(2)
+    paddle.seed(4)
+    net = paddle.nn.Sequential(paddle.nn.Linear(16, 32),
+                               paddle.nn.ReLU(),
+                               paddle.nn.Linear(32, 4))
+    x = paddle.to_tensor(r.randn(16, 16).astype("float32"))
+    y = paddle.to_tensor(r.randn(16, 4).astype("float32"))
+
+    cfg = QuantConfig(activation=MovingAverageObserver,
+                      weight=lambda: PerChannelAbsmaxObserver(axis=1))
+    qat = QAT(cfg)
+    qm = qat.quantize(net)
+    opt = paddle.optimizer.Adam(1e-2, parameters=qm.parameters())
+    first = None
+    for i in range(25):
+        loss = ((qm(x) - y) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        first = first if first is not None else float(loss.numpy())
+    last = float(loss.numpy())
+    assert last < first * 0.5            # trains THROUGH the fake quant
+
+    float_out = qm(x).numpy()
+    conv = qat.convert(qm)
+    assert any(isinstance(l, QuantizedLinear) for l in conv.sublayers())
+    int8_out = conv(x).numpy()
+    # converted int8 execution tracks the simulated-quant model closely
+    denom = np.abs(float_out).max()
+    assert np.abs(int8_out - float_out).max() < 0.1 * denom
+    # and the stored weights really are int8
+    ql = [l for l in conv.sublayers()
+          if isinstance(l, QuantizedLinear)][0]
+    assert str(ql.weight_q._value.dtype) == "int8"
+
+
+def test_int8_linear_op_matches_manual():
+    from paddle_tpu._core.executor import apply
+    r = np.random.RandomState(3)
+    x = r.randn(4, 8).astype("float32")
+    w = (r.randn(8, 5) * 0.2).astype("float32")
+    w_scale = np.abs(w).max(0) / 127.0
+    wq = np.clip(np.round(w / w_scale), -128, 127).astype(np.int8)
+    act_scale = float(np.abs(x).max() / 127.0)
+    out = apply("quant_linear_i8", paddle.to_tensor(x),
+                paddle.to_tensor(wq),
+                paddle.to_tensor(w_scale.astype("float32")),
+                act_scale=act_scale, qmax=127.0)
+    xq = np.clip(np.round(x / act_scale), -128, 127)
+    ref = (xq @ wq.astype(np.int32)) * (act_scale * w_scale)
+    np.testing.assert_allclose(np.asarray(out.numpy()), ref, rtol=1e-5)
+
+
+def test_quantized_conv_weight_only_int8():
+    from paddle_tpu.quantization import (AbsmaxObserver,
+                                         PerChannelAbsmaxObserver, PTQ,
+                                         QuantConfig, QuantizedConv2D)
+    r = np.random.RandomState(4)
+    net = paddle.nn.Sequential(paddle.nn.Conv2D(3, 8, 3, padding=1),
+                               paddle.nn.ReLU())
+    x = paddle.to_tensor(r.randn(2, 3, 8, 8).astype("float32"))
+    ref = net(x).numpy()
+    cfg = QuantConfig(activation=AbsmaxObserver,
+                      weight=lambda: PerChannelAbsmaxObserver(axis=0))
+    ptq = PTQ(cfg)
+    qm = ptq.quantize(net)
+    qm(x)
+    conv = ptq.convert(qm)
+    assert any(isinstance(l, QuantizedConv2D) for l in conv.sublayers())
+    out = conv(x).numpy()
+    assert np.abs(out - ref).max() < 0.1 * np.abs(ref).max()
+
+
+def test_convert_not_inplace_by_default():
+    from paddle_tpu.quantization import (AbsmaxObserver, PTQ,
+                                         QuantConfig, QuantedLayer,
+                                         QuantizedLinear)
+    r = np.random.RandomState(7)
+    net = paddle.nn.Sequential(paddle.nn.Linear(8, 8))
+    x = paddle.to_tensor(r.randn(4, 8).astype("float32"))
+    cfg = QuantConfig(activation=AbsmaxObserver, weight=AbsmaxObserver)
+    ptq = PTQ(cfg)
+    qm = ptq.quantize(net)
+    qm(x)
+    conv = ptq.convert(qm)                  # default inplace=False
+    assert conv is not qm
+    # the calibrated fake-quant model is untouched and still usable
+    assert any(isinstance(l, QuantedLayer) for l in qm.sublayers())
+    assert any(isinstance(l, QuantizedLinear) for l in conv.sublayers())
+    np.testing.assert_allclose(conv(x).numpy(), qm(x).numpy(),
+                               rtol=1e-2, atol=1e-3)
+
+
+def test_asp_greedy_dead_end_block_completes():
+    import numpy as np
+    from paddle_tpu.incubate.asp import (_mask_2d_greedy,
+                                         calculate_density,
+                                         check_mask_2d)
+    # magnitudes engineered so plain greedy dead-ends at 7 entries
+    w = np.ones((4, 4)) * 0.01
+    big = [(0, 0), (0, 1), (1, 1), (1, 3), (3, 0), (3, 3)]
+    for k, (i, j) in enumerate(big):
+        w[i, j] = 10.0 - k * 0.1
+    m = _mask_2d_greedy(w)
+    assert calculate_density(m) == 0.5      # exactly 8 of 16
+    assert check_mask_2d(m)
