@@ -230,6 +230,9 @@ define_flag("FLAGS_sparse_validate_indices", False,
 # ---- IR
 define_flag("FLAGS_ir_pass_disable", "",
             "Comma-separated IR pass names to skip in the pipeline.")
+define_flag("FLAGS_enable_auto_layout", False,
+            "Run the NHWC auto-layout pass in the static pipeline "
+            "(transpose-sunk NHWC convs, auto_layout_pass.cc role).")
 
 # ---- remaining runtime knobs
 define_flag("FLAGS_rpc_timeout_s", 180.0,

@@ -323,6 +323,7 @@ class FuseScaleScale(RewritePattern):
 def default_pass_manager(amp: bool = False):
     """The standard static-compile pipeline (the role of
     executor.py _add_feed_fetch_ops + pir pass registry defaults)."""
+    from .._core.flags import flag_value
     from .pass_base import PassManager
     passes = [
         ConstantFoldingPass(),
@@ -331,6 +332,143 @@ def default_pass_manager(amp: bool = False):
         CommonSubexpressionEliminationPass(),
         DeadCodeEliminationPass(),
     ]
+    if flag_value("FLAGS_enable_auto_layout"):
+        passes.insert(0, AutoLayoutPass())
     if amp:
         passes.insert(0, AutoMixedPrecisionPass())
     return PassManager(passes, iterate_to_fixpoint=True, max_iters=4)
+
+
+# ---------------------------------------------------------- auto layout
+
+_LAYOUT_AGNOSTIC_UNARY = frozenset({
+    "relu", "relu6", "gelu", "tanh", "sigmoid", "silu", "leaky_relu",
+    "exp", "abs", "sqrt", "square", "hardswish", "elu", "softplus",
+    "cast",   # AMP inserts these between convs; attrs carry no layout
+})
+
+_NCHW_TO_NHWC = [0, 2, 3, 1]
+_NHWC_TO_NCHW = [0, 3, 1, 2]
+
+
+def _permuted(shape, perm):
+    return [shape[p] for p in perm] if shape and len(shape) == 4 else \
+        list(shape)
+
+
+class AutoLayoutPass(Pass):
+    """NHWC auto-layout for conv stacks (the reference's
+    auto_layout_pass.cc + auto_layout_insert_pass): every NCHW conv2d is
+    rewritten to transpose -> conv(NHWC) -> transpose-back, then the
+    restoring transposes are SUNK through layout-agnostic elementwise
+    ops and cancelled against the next conv's pre-transpose — so a
+    conv/act chain carries its activations in NHWC end to end with one
+    transpose at each boundary. On TPU the MXU consumes NHWC convs
+    without the relayout copies XLA inserts for NCHW."""
+
+    name = "auto_layout"
+
+    def run(self, ws: Workspace, protected: frozenset) -> bool:
+        from ..static import Variable
+        changed = False
+        for node in list(ws.ops):
+            if node.op_name != "conv2d":
+                continue
+            if node.attrs.get("fmt") != "NCHW" \
+                    or node.attrs.get("dims") != 2:
+                continue
+            x = node.inputs[0]
+            xs = getattr(x, "var_shape", getattr(x, "shape", None))
+            prog = getattr(x, "program", None)
+            xdt = getattr(x, "var_dtype", None) or "float32"
+            xin = Variable(f"{getattr(x, 'name', 'x')}.nhwc",
+                           _permuted(xs, _NCHW_TO_NHWC), xdt, prog)
+            pre = _mk_op("transpose", {"perm": list(_NCHW_TO_NHWC)},
+                         [x], [xin])
+            ws.ops.insert(ws.ops.index(node), pre)
+            node.inputs[0] = xin
+
+            out = node.outputs[0]
+            os_ = getattr(out, "var_shape", getattr(out, "shape", None))
+            odt = getattr(out, "var_dtype", None) or "float32"
+            out_nhwc = Variable(f"{getattr(out, 'name', 'y')}.nhwc",
+                                _permuted(os_, _NCHW_TO_NHWC), odt,
+                                prog)
+            post = _mk_op("transpose", {"perm": list(_NHWC_TO_NCHW)},
+                          [out_nhwc], [out])
+            ws.ops.insert(ws.ops.index(node) + 1, post)
+            node.outputs = [out_nhwc]
+            node.attrs["fmt"] = "NHWC"
+            changed = True
+
+        if changed:
+            PatternRewriter([_SinkTransposePattern(),
+                             _CancelTransposePattern()]).run(ws,
+                                                             protected)
+            # sinking re-homes consumers, orphaning the original
+            # restoring transposes — sweep them out
+            DeadCodeEliminationPass().run(ws, protected)
+        return changed
+
+
+def _mk_op(name, attrs, inputs, outputs):
+    from ..static import OpNode
+    return OpNode(name, attrs, list(inputs), list(outputs))
+
+
+class _SinkTransposePattern(RewritePattern):
+    """unary(transpose_back(x)) -> transpose_back(unary(x)): pushes the
+    NCHW-restoring transpose past layout-agnostic ops so it can cancel
+    against the next conv's pre-transpose."""
+
+    root_ops = tuple(_LAYOUT_AGNOSTIC_UNARY)
+
+    def match_and_rewrite(self, node, rewriter):
+        from ..static import Variable
+        if len(node.inputs) != 1:
+            return False
+        src = node.inputs[0]
+        prod = rewriter.producer_of(src)
+        if prod is None or prod.op_name != "transpose":
+            return False
+        if list(prod.attrs.get("perm", ())) != _NHWC_TO_NCHW:
+            return False
+        x_nhwc = prod.inputs[0]
+        out = node.outputs[0]
+        prog = getattr(out, "program", None)
+        mid = Variable(f"{getattr(out, 'name', 'u')}.nhwc",
+                       _permuted(getattr(out, "var_shape", None)
+                                 or [0, 0, 0, 0], _NCHW_TO_NHWC),
+                       getattr(out, "var_dtype", None) or "float32",
+                       prog)
+        new_unary = _mk_op(node.op_name, dict(node.attrs), [x_nhwc],
+                           [mid])
+        new_tr = _mk_op("transpose", {"perm": list(_NHWC_TO_NCHW)},
+                        [mid], [out])
+        rewriter.insert_before(node, new_unary)
+        rewriter.insert_before(node, new_tr)
+        # new_tr reuses `out` as its output: drop it from the old node
+        # BEFORE erasing, or erase_op pops the producer entry new_tr
+        # just registered and sinking stalls after one op per sweep
+        node.outputs = []
+        rewriter.erase_op(node)
+        return True
+
+
+class _CancelTransposePattern(RewritePattern):
+    """transpose(transpose(x, p1), p2) with p2∘p1 == identity -> x."""
+
+    root_ops = ("transpose",)
+
+    def match_and_rewrite(self, node, rewriter):
+        prod = rewriter.producer_of(node.inputs[0])
+        if prod is None or prod.op_name != "transpose":
+            return False
+        p1 = list(prod.attrs.get("perm", ()))
+        p2 = list(node.attrs.get("perm", ()))
+        if len(p1) != len(p2):
+            return False
+        if [p1[p] for p in p2] != list(range(len(p1))):
+            return False
+        rewriter.replace_op(node, [prod.inputs[0]])
+        return True

@@ -219,9 +219,12 @@ class Executor:
             return []   # startup program: parameters already initialized
 
         from .._core.flags import get_flags
-        passes_on = get_flags(
-            "FLAGS_apply_ir_passes")["FLAGS_apply_ir_passes"]
-        key = (program.id, program._version, passes_on,
+        flags_now = get_flags(["FLAGS_apply_ir_passes",
+                               "FLAGS_enable_auto_layout",
+                               "FLAGS_ir_pass_disable"])
+        passes_on = flags_now["FLAGS_apply_ir_passes"]
+        key = (program.id, program._version,
+               tuple(sorted(flags_now.items())),
                tuple(sorted(feed.keys())),
                tuple(id(v) for v in fetch_list),
                tuple(id(p) for p in (extra_passes or ())))

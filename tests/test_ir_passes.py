@@ -240,3 +240,146 @@ class TestEndToEnd:
         assert pm.stats
         names = {s["pass"] for s in pm.stats}
         assert "dead_code_elimination" in names
+
+
+# ---------------------------------------------------------- auto layout
+
+def test_auto_layout_pass_nhwc_chain():
+    """conv -> relu -> conv in NCHW: the pass converts both convs to
+    NHWC, sinks the restoring transpose through relu, cancels it with
+    the second conv's pre-transpose (2 boundary transposes survive),
+    and numerics are unchanged (reference auto_layout_pass.cc role)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.static as static
+    from paddle_tpu.ir import Workspace
+    from paddle_tpu.ir.passes import AutoLayoutPass
+
+    rng = np.random.RandomState(0)
+    w1 = paddle.to_tensor(rng.randn(4, 3, 3, 3).astype("float32") * 0.2)
+    w2 = paddle.to_tensor(rng.randn(2, 4, 3, 3).astype("float32") * 0.2)
+    paddle.enable_static()
+    try:
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [2, 3, 8, 8], "float32")
+            h = paddle.nn.functional.conv2d(x, w1, padding=1)
+            h = paddle.nn.functional.relu(h)
+            out = paddle.nn.functional.conv2d(h, w2, padding=1)
+        exe = static.Executor()
+        feed = {"x": rng.randn(2, 3, 8, 8).astype("float32")}
+        ref = exe.run(prog, feed=feed, fetch_list=[out])[0]
+
+        ws = Workspace(prog)
+        changed = AutoLayoutPass().run(ws, frozenset([id(out)]))
+        assert changed
+        fmts = [n.attrs.get("fmt") for n in ws.ops
+                if n.op_name == "conv2d"]
+        assert fmts == ["NHWC", "NHWC"], fmts
+        n_tr = sum(1 for n in ws.ops if n.op_name == "transpose")
+        # one in-transpose at the head, one out-transpose at the tail;
+        # the interior pair cancelled through relu
+        assert n_tr == 2, [n.op_name for n in ws.ops]
+
+        got = _run_ws(ws, prog, feed, out)
+        np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-5)
+    finally:
+        paddle.disable_static()
+
+
+def _run_ws(ws, prog, feed, fetch):
+    """Replay a transformed Workspace like the Executor does."""
+    import jax.numpy as jnp
+    from paddle_tpu._core.op_registry import get_op
+    from paddle_tpu.static import Variable
+    env = {}
+    for v in ws.feed_vars:
+        env[id(v)] = jnp.asarray(feed[v.name])
+
+    def val(t):
+        t = ws.resolve(t)
+        if isinstance(t, Variable):
+            if id(t) in env:
+                return env[id(t)]
+            if id(t) in ws.const_env:
+                return ws.const_env[id(t)]
+            raise KeyError(t.name)
+        if t is None:
+            return None
+        return t._value if hasattr(t, "_value") else t
+
+    import jax
+    for node in ws.ops:
+        op = get_op(node.op_name)
+        out = op.kernel_for(jax.default_backend())(
+            *[val(t) for t in node.inputs], **node.attrs)
+        outs = out if op.multi_output else (out,)
+        for var, o in zip(node.outputs, jax.tree_util.tree_leaves(outs)):
+            env[id(var)] = o
+    import numpy as np
+    f = ws.resolve(fetch)
+    return np.asarray(env[id(f)])
+
+
+def test_auto_layout_flag_runs_in_executor():
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.static as static
+    from paddle_tpu._core.flags import set_flags, flag_value
+
+    rng = np.random.RandomState(1)
+    w = paddle.to_tensor(rng.randn(4, 3, 3, 3).astype("float32") * 0.2)
+    paddle.enable_static()
+    old = flag_value("FLAGS_enable_auto_layout")
+    try:
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [2, 3, 8, 8], "float32")
+            out = paddle.nn.functional.relu(
+                paddle.nn.functional.conv2d(x, w, padding=1))
+        exe = static.Executor()
+        feed = {"x": rng.randn(2, 3, 8, 8).astype("float32")}
+        ref = exe.run(prog, feed=feed, fetch_list=[out])[0]
+        set_flags({"FLAGS_enable_auto_layout": True})
+        # the flag joins the executor cache key: no cache-busting needed
+        got = exe.run(prog, feed=feed, fetch_list=[out])[0]
+        np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-5)
+    finally:
+        set_flags({"FLAGS_enable_auto_layout": old})
+        paddle.disable_static()
+
+
+def test_auto_layout_sinks_deep_chains_and_amp_casts():
+    """Regression (r5 review): cast sinks like other elementwise ops,
+    and chains longer than one op sink fully in one pass run."""
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.static as static
+    from paddle_tpu.ir import Workspace
+    from paddle_tpu.ir.passes import AutoLayoutPass
+
+    rng = np.random.RandomState(2)
+    w1 = paddle.to_tensor(rng.randn(4, 3, 3, 3).astype("float32") * 0.2)
+    w2 = paddle.to_tensor(rng.randn(2, 4, 3, 3).astype("float32") * 0.2)
+    paddle.enable_static()
+    try:
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [2, 3, 8, 8], "float32")
+            h = paddle.nn.functional.conv2d(x, w1, padding=1)
+            # three layout-agnostic ops incl. a cast between the convs
+            h = paddle.nn.functional.relu(h)
+            h = paddle.cast(h, "float32")
+            h = paddle.tanh(h)
+            out = paddle.nn.functional.conv2d(h, w2, padding=1)
+        ws = Workspace(prog)
+        assert AutoLayoutPass().run(ws, frozenset([id(out)]))
+        n_tr = sum(1 for n in ws.ops if n.op_name == "transpose")
+        assert n_tr == 2, [n.op_name for n in ws.ops]
+        # intermediate vars carry the propagated dtype, not blanket f32
+        ref = _run_ws(ws, prog,
+                      {"x": rng.randn(2, 3, 8, 8).astype("float32")},
+                      out)
+        assert ref.shape == (2, 2, 8, 8)
+    finally:
+        paddle.disable_static()
