@@ -164,7 +164,12 @@ class GradNode:
         self.freed = True
 
 
-_accum = jax.jit(jnp.add)
+_accum_jit = jax.jit(jnp.add)
+
+
+def _accum(a, b):
+    dispatch.bump_exec()
+    return _accum_jit(a, b)
 
 
 def record(op: OpDef, attrs, in_tensors, out_tensors, saved_vals=None):
@@ -296,12 +301,26 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
 def _engine_run(tensors, grad_tensors, targets, retain_graph=False):
     from .tensor import Tensor  # local import to avoid cycle
 
-    # a pending lazy capture must land before the walk: the fused
-    # segment GradNodes are only wired in at flush
     from . import lazy
-    lazy.flush_active("backward")
-
     tensors = tensors if isinstance(tensors, (list, tuple)) else [tensors]
+
+    # whole-step fusion fast path: when the root is still pending in the
+    # lazy window, forward + vjp compile and run as ONE XLA program and
+    # grads land directly on the leaves — no flush, no graph walk
+    if targets is None and not retain_graph \
+            and lazy.try_fused_backward(tensors, grad_tensors):
+        return {}
+
+    # otherwise a pending lazy capture must land before the walk: the
+    # fused segment GradNodes are only wired in at flush. paddle.grad
+    # with explicit targets needs gradients AT interior values, which a
+    # fused segment node cannot address — land those per-op instead.
+    if targets is not None:
+        ctx = lazy.current_context()
+        if ctx is not None:
+            ctx.flush_per_op("grad_targets")
+    else:
+        lazy.flush_active("backward")
     if grad_tensors is None:
         grad_tensors = [None] * len(tensors)
     grad_tensors = [g._value if isinstance(g, Tensor) else g

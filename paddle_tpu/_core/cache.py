@@ -1,0 +1,56 @@
+"""Bounded LRU for compiled-executable caches.
+
+Every cache of jitted runners (eager per-op `_FWD_CACHE`/`_BWD_CACHE`,
+lazy `_SEG_CACHE`/`_SEG_BWD_CACHE`/`_FUSED_CACHE`) used to be an
+unbounded dict — a leak under shape-polymorphic workloads where every
+new shape mints a new signature. `ExecCache` is a drop-in dict
+replacement with LRU eviction; the capacity is read live from a flag at
+insertion time so `set_flags` takes effect mid-session (the analog of
+the reference's FLAGS_* cache-size knobs, kernel_factory.h cache role).
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+
+
+class ExecCache(OrderedDict):
+    """dict-compatible LRU. Capacity comes from ``flag`` (0 = unlimited);
+    an optional second flag acts as an additional bound (the legacy
+    FLAGS_eager_compile_cache_size spelling for the eager caches)."""
+
+    def __init__(self, flag: str = "FLAGS_executable_cache_capacity",
+                 extra_flag: str = None):
+        super().__init__()
+        self._flag = flag
+        self._extra_flag = extra_flag
+
+    def _capacity(self) -> int:
+        from . import flags
+        cap = flags.flag_value(self._flag)
+        if self._extra_flag is not None:
+            extra = flags.flag_value(self._extra_flag)
+            if extra and (not cap or extra < cap):
+                cap = extra
+        return cap
+
+    def get(self, key, default=None):
+        try:
+            val = OrderedDict.__getitem__(self, key)
+        except KeyError:
+            return default
+        self.move_to_end(key)
+        return val
+
+    def __getitem__(self, key):
+        val = OrderedDict.__getitem__(self, key)
+        self.move_to_end(key)
+        return val
+
+    def __setitem__(self, key, val):
+        OrderedDict.__setitem__(self, key, val)
+        self.move_to_end(key)
+        cap = self._capacity()
+        while cap and len(self) > cap:
+            # NOT popitem(): OrderedDict.popitem re-enters the overridden
+            # __getitem__ after unlinking the entry -> KeyError
+            OrderedDict.__delitem__(self, next(iter(self)))

@@ -15,16 +15,65 @@ paddle/fluid/eager/tensor_wrapper.h) unless the op registered a custom bwd.
 from __future__ import annotations
 
 import functools
+import hashlib
+import weakref
 from typing import Any, Dict, Tuple
 
 import jax
 import numpy as np
 
 from . import flags
+from .cache import ExecCache
 from .op_registry import OpDef
 
-_FWD_CACHE: Dict[Tuple, Any] = {}
-_BWD_CACHE: Dict[Tuple, Any] = {}
+_FWD_CACHE: Dict[Tuple, Any] = ExecCache(
+    extra_flag="FLAGS_eager_compile_cache_size")
+_BWD_CACHE: Dict[Tuple, Any] = ExecCache(
+    extra_flag="FLAGS_eager_compile_cache_size")
+
+# ndarray attrs (e.g. index tables, window vectors) are hashed by content;
+# digesting v.tobytes() on EVERY dispatch is O(size) per op. Arrays used
+# as attrs are config-like and treated as immutable between calls, so
+# large-array digests are memoized per array identity — validated by
+# weakref (a recycled id can't alias a dead array's digest) plus an O(1)
+# sampled fingerprint, so a realloc, shape/dtype change, or in-place
+# mutation touching a sampled position recomputes instead of reusing a
+# stale cached executable. Small arrays are digested in full every call
+# (it's ~free), so they can never go stale at all; mutations of a LARGE
+# attr array at only-unsampled positions are outside the contract.
+_ARR_DIGEST: Dict[int, Tuple] = {}
+_ARR_MEMO_MIN_BYTES = 2048
+
+
+def _full_digest(v: np.ndarray):
+    return (v.shape, str(v.dtype), hashlib.sha1(v.tobytes()).hexdigest())
+
+
+def _fingerprint(v: np.ndarray):
+    idx = np.linspace(0, v.size - 1, num=min(v.size, 16)).astype(np.int64)
+    return (v.shape, str(v.dtype), v.flat[idx].tobytes())
+
+
+def _digest_array(v: np.ndarray):
+    if v.nbytes <= _ARR_MEMO_MIN_BYTES:
+        return _full_digest(v)
+    ent = _ARR_DIGEST.get(id(v))
+    if ent is not None and ent[0]() is v and ent[1] == _fingerprint(v):
+        return ent[2]
+    key = _full_digest(v)
+    try:
+        wr = weakref.ref(v)
+    except TypeError:  # un-weakref-able subclass: skip memoization
+        return key
+    if len(_ARR_DIGEST) >= 4096:
+        for k in [k for k, e in _ARR_DIGEST.items() if e[0]() is None]:
+            del _ARR_DIGEST[k]
+        # still over cap (all entries live): evict oldest down to half so
+        # the purge scan amortizes instead of running on every insert
+        while len(_ARR_DIGEST) >= 2048:
+            del _ARR_DIGEST[next(iter(_ARR_DIGEST))]
+    _ARR_DIGEST[id(v)] = (wr, _fingerprint(v), key)
+    return key
 
 
 def _hashable(v):
@@ -33,12 +82,40 @@ def _hashable(v):
     if isinstance(v, dict):
         return tuple(sorted((k, _hashable(x)) for k, x in v.items()))
     if isinstance(v, np.ndarray):
-        return (v.shape, str(v.dtype), v.tobytes())
+        return _digest_array(v)
     return v
 
 
+# Interning pool: steady-state dispatch sees the same few hundred attr
+# signatures over and over; returning the SAME tuple object makes the
+# downstream cache keys and segment signatures compare by identity
+# fast-path and hash once (the KernelKey-interning role of
+# kernel_factory.h:58).
+_KEY_INTERN: Dict[Tuple, Tuple] = {}
+
+
 def attrs_key(attrs: Dict[str, Any]):
-    return tuple(sorted((k, _hashable(v)) for k, v in attrs.items()))
+    key = tuple(sorted((k, _hashable(v)) for k, v in attrs.items()))
+    if len(_KEY_INTERN) > 8192:
+        _KEY_INTERN.clear()
+    return _KEY_INTERN.setdefault(key, key)
+
+
+# Framework-issued XLA executable launches (segment runners, fused
+# fwd+bwd steps, eager per-op calls, grad accumulations, fused optimizer
+# updates). The eager hot-path contract — one fused fwd+bwd program plus
+# one donated optimizer program per steady-state train step — is
+# asserted against this counter by tests/test_eager_hotpath.py.
+_EXEC_COUNT = 0
+
+
+def bump_exec(n: int = 1):
+    global _EXEC_COUNT
+    _EXEC_COUNT += n
+
+
+def exec_count() -> int:
+    return _EXEC_COUNT
 
 
 def _full_key(name: str, backend: str, attrs: Dict[str, Any]):
@@ -69,16 +146,14 @@ def fwd_callable(op: OpDef, attrs: Dict[str, Any]):
     key = _full_key(op.name, backend, attrs)
     fn = _FWD_CACHE.get(key)
     if fn is None:
-        cap = flags.flag_value("FLAGS_eager_compile_cache_size")
-        while cap and len(_FWD_CACHE) >= cap:   # 0 = unlimited
-            _FWD_CACHE.pop(next(iter(_FWD_CACHE)))
         fn = jax.jit(functools.partial(op.kernel_for(backend), **attrs))
-        _FWD_CACHE[key] = fn
+        _FWD_CACHE[key] = fn   # ExecCache evicts LRU past either cap flag
     return fn
 
 
 def eager_forward(op: OpDef, vals: Tuple, attrs: Dict[str, Any]) -> Tuple:
     """Run the op's forward. Returns a tuple of raw outputs."""
+    bump_exec()
     out = fwd_callable(op, attrs)(*vals)
     if flags.flag_value("FLAGS_benchmark"):
         jax.block_until_ready(out)
@@ -113,6 +188,7 @@ def bwd_callable(op: OpDef, attrs: Dict[str, Any]):
 def eager_backward(op: OpDef, saved: Tuple, attrs: Dict[str, Any],
                    gouts: Tuple) -> Tuple:
     """Compute input gradients. float0 / integer cotangents become None."""
+    bump_exec()
     grads = bwd_callable(op, attrs)(tuple(saved), tuple(gouts))
     out = []
     for g in grads:

@@ -8,13 +8,23 @@ GradNode, capture inputs, and wire slot edges. AMP auto-cast interception
 """
 from __future__ import annotations
 
+import math
+
+import jax
 import jax.numpy as jnp
 
-from . import lazy
+from . import flags, lazy
 from .autograd import is_grad_enabled, record
 from .dispatch import eager_forward
 from .op_registry import get_op
 from .tensor import Tensor
+
+
+# python-scalar coercion cache: op attrs like `y * 1e-4 + eps` pay a
+# full jnp.asarray device-put per dispatch otherwise (~45% of chain
+# dispatch time). jax arrays are immutable, so sharing one per distinct
+# (type, value) is safe; keyed by type so True does not alias 1.
+_SCALAR_CACHE: dict = {}
 
 
 def _coerce(x):
@@ -22,6 +32,23 @@ def _coerce(x):
         return x
     # jnp.asarray keeps python scalars weakly typed so dtype promotion
     # matches jax semantics (x_bf16 + 1.0 stays bf16).
+    if isinstance(x, (bool, int, float)):
+        # floats key on the sign bit too: hash(-0.0) == hash(0.0), and
+        # substituting a cached +0.0 for -0.0 flips e.g. 1/x to +inf
+        key = (type(x), x, math.copysign(1.0, x)) \
+            if isinstance(x, float) else (type(x), x)
+        v = _SCALAR_CACHE.get(key)
+        if v is None:
+            v = jnp.asarray(x)
+            if isinstance(v, jax.core.Tracer):
+                # inside a jax trace (to_static/vmap) array creation is
+                # staged: caching the tracer would leak it into every
+                # dispatch after the trace exits
+                return Tensor(v, stop_gradient=True)
+            if len(_SCALAR_CACHE) > 4096:
+                _SCALAR_CACHE.clear()
+            _SCALAR_CACHE[key] = v
+        return Tensor(v, stop_gradient=True)
     return Tensor(jnp.asarray(x), stop_gradient=True)
 
 
@@ -35,6 +62,22 @@ def apply(op_name: str, *inputs, **attrs):
         return _static_recorder(op_name, ts, attrs)
     ts = _maybe_amp_cast(op_name, ts)
     ctx = lazy.current_context()
+    if ctx is not None and any(
+            t is not None and isinstance(t._payload, jax.core.Tracer)
+            for t in ts):
+        # op runs under an enclosing jax trace (to_static/sot jit body):
+        # tracers must never be recorded into the fusion window — a
+        # flush after that trace exits would replay dead tracers.
+        # Dispatch inline; the nested jit call inlines into the trace.
+        ctx = None
+    if ctx is not None and (_profile_cb is not None
+                            or flags.flag_value("FLAGS_check_nan_inf")
+                            or flags.flag_value("FLAGS_benchmark")):
+        # per-op host tracing / NaN scans / per-op timing need per-op
+        # dispatch: bypass the fusion window (pending work lands first so
+        # event order matches execution order)
+        ctx.flush("per_op_mode")
+        ctx = None
     if ctx is not None:
         try:
             outs = ctx.record(op, ts, attrs)

@@ -154,6 +154,20 @@ define_flag("FLAGS_sot_guard_size_cap", 64,
 define_flag("FLAGS_lazy_enable", True,
             "Kill-switch for the lazy fusion window: when false, "
             "lazy_guard() becomes a no-op and ops dispatch eagerly.")
+define_flag("FLAGS_eager_fusion", True,
+            "Ambient fusion window: plain dygraph code (no lazy_guard) "
+            "records into a segment that runs as one cached XLA program "
+            "at the next sync point. The eager hot-path default; false "
+            "restores strict per-op dispatch.")
+define_flag("FLAGS_executable_cache_capacity", 1024,
+            "LRU capacity for each compiled-executable cache (lazy "
+            "segment/bwd/fused-step + eager fwd/bwd); 0 = unbounded.")
+define_flag("FLAGS_lazy_donate_inputs", True,
+            "Donate lazy-segment input buffers whose backing tensor is "
+            "dead or overwritten at flush (XLA reuses them in place).")
+define_flag("FLAGS_optimizer_donate_params", True,
+            "Donate old parameter/state buffers into the fused optimizer "
+            "update so XLA updates them in place (no per-step copy).")
 
 # ---- AMP / GradScaler defaults (amp/grad_scaler.py)
 define_flag("FLAGS_amp_init_loss_scaling", 65536.0,

@@ -27,18 +27,48 @@ one XLA execution per segment.
 """
 from __future__ import annotations
 
+import contextlib
 import functools
+import warnings
 import weakref
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from . import dispatch
+from .cache import ExecCache
 from .op_registry import OpDef
 
-_SEG_CACHE: Dict[Tuple, Any] = {}
+# Compiled-runner caches (LRU-bounded by FLAGS_executable_cache_capacity):
+#   _SEG_CACHE   (signature, donate_mask) -> jitted segment runner
+#   _FUSED_CACHE (signature, grad_in, root) -> jitted fwd+vjp step runner
+_SEG_CACHE: Dict[Tuple, Any] = ExecCache()
+_FUSED_CACHE: Dict[Tuple, Any] = ExecCache()
 _AVAL_CACHE: Dict[Tuple, Tuple] = {}
+
+
+@contextlib.contextmanager
+def _quiet_donation_compile():
+    """Backends without buffer donation (CPU) warn at compile time and
+    silently copy instead; donation is a best-effort optimization here,
+    not a contract. Scoped around OUR compile-triggering first calls so
+    the suppression never leaks into user code, where the same warning
+    may be the only signal that their own donate_argnums degraded."""
+    with warnings.catch_warnings():
+        warnings.filterwarnings(
+            "ignore", message="Some donated buffers were not usable")
+        yield
+
+
+def _live_aliases(ref):
+    """Tensors still ALIASING this pending output. Payload identity is
+    the correctness-bearing invariant: a tensor overwritten in place
+    mid-segment no longer keeps its old pending output alive — and must
+    not be clobbered when the segment's results are bound."""
+    return [t for t in (r() for r in ref.trefs)
+            if t is not None and t._payload is ref]
 
 
 class LazyRef:
@@ -74,6 +104,18 @@ class _PendingOp:
         self.n_outs = len(out_refs)
 
 
+# str(np.dtype) costs ~10us a call and the dispatch hot path needs it
+# for every input of every signature — memoized per dtype object
+_DTYPE_STR: Dict[Any, str] = {}
+
+
+def _dstr(dt) -> str:
+    s = _DTYPE_STR.get(dt)
+    if s is None:
+        s = _DTYPE_STR[dt] = str(dt)
+    return s
+
+
 def _aval_of(x):
     # weak_type MUST survive: python scalars are weak (x64 mode makes
     # them f64-weak) and weak+f32 promotes to f32, not f64
@@ -81,11 +123,12 @@ def _aval_of(x):
                                 weak_type=getattr(x, "weak_type", False))
 
 
-def _out_avals(op: OpDef, attrs, in_avals):
-    from .dispatch import attrs_key
+def _out_avals(op: OpDef, attrs, in_avals, akey=None):
+    if akey is None:
+        akey = dispatch.attrs_key(attrs)
     backend = jax.default_backend()
-    key = (op.name, backend, attrs_key(attrs),
-           tuple((tuple(a.shape), str(a.dtype), a.weak_type)
+    key = (op.name, backend, akey,
+           tuple((tuple(a.shape), _dstr(a.dtype), a.weak_type)
                  if a is not None else None for a in in_avals))
     hit = _AVAL_CACHE.get(key)
     if hit is None:
@@ -105,28 +148,66 @@ class CaptureContext:
     segment; flush() compiles + runs it as one XLA executable."""
 
     def __init__(self, max_segment_ops: Optional[int] = None):
-        from . import flags
         self.pending: List[_PendingOp] = []
         # graph inputs of the CURRENT segment: id(tensor) -> index
         self._in_ids: Dict[int, int] = {}
-        self._in_tensors: List = []   # strong refs (cleared per segment)
+        # WEAK refs to the input tensors: a tensor dying mid-segment must
+        # not be pinned by the trace (only its payload snapshot in
+        # _in_vals is needed to execute, and a dead input is a donation
+        # candidate). _in_pins strong-pins them only under an on_flush
+        # observer (SOT capture rebinds inputs at entry-build time).
+        self._in_tensors: List = []
+        self._in_pins: List = []
         self._in_vals: List = []
-        self.max_ops = max_segment_ops if max_segment_ops is not None \
-            else flags.flag_value("FLAGS_lazy_max_segment_ops")
+        # record-time autograd snapshot per input: (requires_grad,
+        # AutogradMeta, inplace_version). The meta OBJECT is strongly
+        # held: an intermediate that dies before the flush (a local of a
+        # returned-from function) must still chain gradients through its
+        # grad_node — only the tensor wrapper is gone, not the graph.
+        self._in_meta: List = []
+        # incremental structural signature: one entry appended per
+        # recorded op, so flush never re-walks the whole pending list
+        self._sig_ops: List[Tuple] = []
+        self._max_override = max_segment_ops
         # stats for tests / profiling
         self.segments_run = 0
         self.ops_recorded = 0
         self.breaks: List[str] = []
 
+    @property
+    def max_ops(self) -> int:
+        """Segment cap, read live so set_flags mid-session takes effect
+        on already-open (incl. ambient) contexts."""
+        if self._max_override is not None:
+            return self._max_override
+        from . import flags
+        return flags.flag_value("FLAGS_lazy_max_segment_ops")
+
     # ---------------------------------------------------------- recording
     def _input_index(self, tensor) -> int:
         idx = self._in_ids.get(id(tensor))
-        if idx is None:
-            idx = len(self._in_vals)
-            self._in_ids[id(tensor)] = idx
-            self._in_tensors.append(tensor)
-            self._in_vals.append(tensor._payload)
+        # validate against id() reuse: the map entry is only good if the
+        # weakref at that slot still points at THIS tensor
+        if idx is not None and self._in_tensors[idx]() is tensor:
+            return idx
+        idx = len(self._in_vals)
+        self._in_ids[id(tensor)] = idx
+        self._in_tensors.append(weakref.ref(tensor))
+        if self.on_flush is not None:
+            self._in_pins.append(tensor)
+        self._in_vals.append(tensor._payload)
+        self._in_meta.append((not tensor.stop_gradient,
+                              tensor._autograd_meta,
+                              tensor._inplace_version))
         return idx
+
+    def note_inplace(self, tensor):
+        """`tensor`'s payload is being overwritten in place. Ops already
+        recorded keep the registered snapshot (eager ordering); future
+        records must re-register the fresh payload, so the id mapping is
+        evicted. The orphaned snapshot becomes a donation candidate at
+        flush (its backing tensor no longer aliases it)."""
+        self._in_ids.pop(id(tensor), None)
 
     def record(self, op: OpDef, ts, attrs):
         """Record one op application; returns out Tensors (lazy)."""
@@ -158,7 +239,8 @@ class CaptureContext:
             in_avals.append(_aval_of(p))
             req = req or (not t.stop_gradient)
 
-        out_avals = _out_avals(op, attrs, in_avals)
+        akey = dispatch.attrs_key(attrs)
+        out_avals = _out_avals(op, attrs, in_avals, akey)
 
         # pass 2 (cannot fail): register external inputs + build wiring
         wiring = []
@@ -169,6 +251,7 @@ class CaptureContext:
                 wiring.append(("in", self._input_index(r[1])))
             else:
                 wiring.append(r)
+        wiring = tuple(wiring)
         req = req and is_grad_enabled()
         op_idx = len(self.pending)
         out_refs = []
@@ -179,8 +262,8 @@ class CaptureContext:
             t = _lazy_tensor(ref, stop_gradient=not (req and inexact))
             out_refs.append(ref)
             outs.append(t)
-        self.pending.append(_PendingOp(op, dict(attrs), tuple(wiring),
-                                       out_refs))
+        self.pending.append(_PendingOp(op, dict(attrs), wiring, out_refs))
+        self._sig_ops.append((op.name, akey, wiring, len(out_refs)))
         self.ops_recorded += 1
         return tuple(outs)
 
@@ -191,50 +274,86 @@ class CaptureContext:
         if len(self.pending) >= self.max_ops:
             self.flush("segment_cap")
 
+    def _reset_segment(self):
+        self.pending = []
+        self._in_ids = {}
+        self._in_tensors = []
+        self._in_pins = []
+        self._in_vals = []
+        self._in_meta = []
+        self._sig_ops = []
+
+    def _live_outputs(self, pending):
+        """Lazy refs some Tensor still aliases (see _live_aliases)."""
+        live: List[Tuple[int, int]] = []
+        live_refs: List[LazyRef] = []
+        for j, pop in enumerate(pending):
+            for ref in pop.out_refs:
+                if _live_aliases(ref):
+                    live.append((j, ref.slot))
+                    live_refs.append(ref)
+        return live, live_refs
+
+    def _signature(self, in_vals, live) -> Tuple:
+        return (jax.default_backend(), tuple(self._sig_ops),
+                _in_signature(in_vals), tuple(live))
+
     # ------------------------------------------------------------- flush
     def flush(self, reason: str = "materialize"):
         if not self.pending:
             # nothing recorded, but clear any input registrations a
             # partially-failed record may have left behind
-            self._in_ids = {}
-            self._in_tensors = []
-            self._in_vals = []
+            self._reset_segment()
             return
         pending = self.pending
-        in_tensors = self._in_tensors
         in_vals = self._in_vals
+        in_meta = self._in_meta
+        in_tensors = [r() for r in self._in_tensors]  # None = died
 
-        # live outputs: lazy refs some Tensor still aliases
-        live: List[Tuple[int, int]] = []
-        live_refs: List[LazyRef] = []
-        for j, pop in enumerate(pending):
-            for ref in pop.out_refs:
-                if any(r() is not None for r in ref.trefs):
-                    live.append((j, ref.slot))
-                    live_refs.append(ref)
+        live, live_refs = self._live_outputs(pending)
+        sig = self._signature(in_vals, live)
 
-        sig = _segment_signature(pending, in_vals, live)
-        runner = _SEG_CACHE.get(sig)
-        if runner is None:
-            runner = jax.jit(_build_segment_fn(pending, live))
-            _SEG_CACHE[sig] = runner
-        # run BEFORE clearing state: a compile/run failure must leave the
-        # trace intact (and surface the real error), not lose it
-        out_vals = runner(list(in_vals))
-        self.pending = []
-        self._in_ids = {}
-        self._in_tensors = []
-        self._in_vals = []
+        # donation: an input whose backing tensor died or was overwritten
+        # is dead the moment this program runs — let XLA reuse its buffer
+        # for an output (the in-place param.copy_ pattern) instead of
+        # copying. Never donate when the segment registers a grad node:
+        # saved inputs are the backward residuals.
+        donate: Tuple[int, ...] = ()
+        from . import flags
+        if flags.flag_value("FLAGS_lazy_donate_inputs") and not \
+                _segment_needs_grad(in_tensors, in_vals, live_refs, in_meta):
+            donate = _donatable_inputs(in_tensors, in_vals, live_refs)
+
+        dispatch.bump_exec()
+        try:
+            runner = _SEG_CACHE.get((sig, donate))
+            # async dispatch: out_vals are in-flight futures — the host
+            # returns to tracing the next ops while the device executes;
+            # sync happens only at explicit .numpy()/float() reads
+            if runner is None:
+                runner = jax.jit(_build_segment_fn(pending, live),
+                                 donate_argnums=donate)
+                _SEG_CACHE[(sig, donate)] = runner
+                with _quiet_donation_compile():   # first call compiles
+                    out_vals = runner(*in_vals)
+            else:
+                out_vals = runner(*in_vals)
+        except Exception:
+            # a failed compile/run must not pin input tensors or poison
+            # later records: drop the trace and surface the error (the
+            # un-materialized outputs re-raise on read)
+            self._reset_segment()
+            raise
+        self._reset_segment()
         self.breaks.append(reason)
         self.segments_run += 1
 
-        # bind concrete values into every alive aliasing Tensor; the
-        # grad node attaches to a grad-REQUIRING alias — a detach()ed
-        # alias must never have its stop_gradient flipped back
+        # bind concrete values into every aliasing Tensor; the grad node
+        # attaches to a grad-REQUIRING alias — a detach()ed alias must
+        # never have its stop_gradient flipped back
         out_tensors = []
         for ref, val in zip(live_refs, out_vals):
-            ts = [r() for r in ref.trefs]
-            ts = [t for t in ts if t is not None]
+            ts = _live_aliases(ref)
             for t in ts:
                 t._payload = val
             grad_ts = [t for t in ts if not t.stop_gradient]
@@ -242,7 +361,7 @@ class CaptureContext:
                                else (ts[0] if ts else None))
 
         self._register_grad(pending, live, live_refs, out_tensors,
-                            in_tensors, in_vals, sig)
+                            in_tensors, in_vals, sig, in_meta)
 
         if self.on_flush is not None:
             self.on_flush(self, reason, pending, live, live_refs,
@@ -250,69 +369,296 @@ class CaptureContext:
 
     on_flush = None  # observer hook (jit/sot records segment structure)
 
+    def flush_per_op(self, reason: str = "grad_targets"):
+        """Land the pending trace as per-op eager dispatches — one
+        GradNode per op instead of one fused segment node.
+
+        paddle.grad(outputs, inputs) needs gradients AT interior values;
+        a fused segment node only maps output cotangents to segment
+        inputs, so a target produced inside the segment would be
+        unreachable. Replaying the recorded wiring through the per-op
+        path restores that granularity (cost: per-op dispatch, but only
+        on the explicit-targets path)."""
+        if not self.pending:
+            self._reset_segment()
+            return
+        from .autograd import record
+        from .tensor import Tensor
+        pending = self.pending
+        in_vals = self._in_vals
+        in_meta = self._in_meta
+        in_tensors = [r() for r in self._in_tensors]
+        # reset FIRST: the per-op dispatches below must not re-record
+        # into this context
+        self._reset_segment()
+        self.breaks.append(reason)
+        self.segments_run += 1
+
+        out_tensors: List[List] = []
+        for pop in pending:
+            ins = []
+            vals = []
+            for w in pop.wiring:
+                if w is None:
+                    ins.append(None)
+                    vals.append(None)
+                elif w[0] == "in":
+                    t = in_tensors[w[1]]
+                    v = in_vals[w[1]]
+                    if t is None or t._payload is not v:
+                        # input died or was overwritten in place after
+                        # registration: eager ordering saw the snapshot.
+                        # The stand-in adopts the record-time autograd
+                        # snapshot so grads still chain through a dead
+                        # intermediate's grad_node.
+                        req, meta, _ = in_meta[w[1]]
+                        t = Tensor(v, stop_gradient=not req)
+                        if meta is not None:
+                            t._autograd_meta = meta
+                    ins.append(t)
+                    vals.append(v)
+                else:
+                    t = out_tensors[w[1]][w[2]]
+                    ins.append(t)
+                    vals.append(t._payload)
+            outs = dispatch.eager_forward(pop.op, tuple(vals), pop.attrs)
+            wrapped = []
+            for ref, val in zip(pop.out_refs, outs):
+                ts = _live_aliases(ref)
+                for t in ts:
+                    t._payload = val
+                tt = next((t for t in ts if not t.stop_gradient), None)
+                if tt is None:
+                    # no live grad-requiring alias (value interior to the
+                    # trace, or only detached aliases survive): wire the
+                    # graph through a fresh stand-in
+                    tt = Tensor(val, stop_gradient=not ref.requires_grad)
+                wrapped.append(tt)
+            if any(ref.requires_grad for ref in pop.out_refs):
+                record(pop.op, pop.attrs, ins, wrapped, saved_vals=vals)
+            out_tensors.append(wrapped)
+
     # ----------------------------------------------------------- autograd
     def _register_grad(self, pending, live, live_refs, out_tensors,
-                       in_tensors, in_vals, sig):
+                       in_tensors, in_vals, sig, in_meta=None):
         register_segment_grad(pending, live, live_refs, out_tensors,
-                              in_tensors, in_vals, sig)
+                              in_tensors, in_vals, sig, in_meta)
+
+
+def _in_grad_records(in_tensors, in_meta):
+    """(requires_grad, meta, version) per input. requires_grad is the
+    RECORD-time intent when a snapshot exists (eager semantics: flipping
+    stop_gradient after the op must not change its grad); meta is read
+    live so a grad_node attached between record and flush is seen."""
+    if in_meta is not None:
+        return in_meta
+    return [(False, None, 0) if t is None else
+            (not t.stop_gradient, t._autograd_meta, t._inplace_version)
+            for t in in_tensors]
+
+
+def _input_grad_eligible(t, rec, val) -> bool:
+    """Can gradients flow INTO this segment input? Dead leaves (no
+    grad_node, tensor gone) are excluded: their grads are unobservable."""
+    req, meta, _ = rec
+    if not req or not jnp.issubdtype(val.dtype, jnp.inexact):
+        return False
+    return t is not None or (meta is not None
+                             and meta.grad_node is not None)
+
+
+def _segment_needs_grad(in_tensors, in_vals, live_refs, in_meta=None) -> bool:
+    """Will register_segment_grad wire a GradNode for this segment? If so
+    the inputs are saved as backward residuals and must NOT be donated."""
+    recs = _in_grad_records(in_tensors, in_meta)
+    grad_in = any(_input_grad_eligible(t, recs[i], in_vals[i])
+                  for i, t in enumerate(in_tensors))
+    return grad_in and any(ref.requires_grad for ref in live_refs)
+
+
+def _donatable_inputs(in_tensors, in_vals, live_refs) -> Tuple[int, ...]:
+    """Inputs safe to donate: concrete jax arrays registered exactly once
+    whose backing tensor is dead or no longer aliases the snapshot, whose
+    shape/dtype matches some output (so XLA can actually reuse the
+    buffer — avoids 'donated buffer not usable' churn), and which nothing
+    else in the process still references."""
+    import sys
+    out_shapes = {(tuple(r.aval.shape), _dstr(np.dtype(r.aval.dtype)))
+                  for r in live_refs}
+    counts: Dict[int, int] = {}
+    for v in in_vals:
+        counts[id(v)] = counts.get(id(v), 0) + 1
+    donate = []
+    for i, t in enumerate(in_tensors):
+        v = in_vals[i]
+        if not isinstance(v, jax.Array) or isinstance(v, jax.core.Tracer):
+            continue
+        if getattr(v, "weak_type", False):
+            # weak-typed arrays are the shared python-scalar coercion
+            # cache (executor._SCALAR_CACHE): donating a shared buffer
+            # would invalidate every later use
+            continue
+        if counts[id(v)] != 1:
+            continue
+        if (tuple(v.shape), _dstr(np.dtype(v.dtype))) not in out_shapes:
+            continue
+        if t is not None and t._payload is v:
+            continue
+        # sole-ownership proof: the registered tensor died or moved on,
+        # but OTHER Tensors may alias the same payload (detach()/
+        # Tensor(t) share it) and GradNodes may have saved it as a
+        # residual — donating then deletes a buffer something live still
+        # reads. Expected refs here: in_vals entry + local v +
+        # getrefcount arg = 3; anything above means an outside alias.
+        if sys.getrefcount(v) > 3:
+            continue
+        donate.append(i)
+    return tuple(donate)
 
 
 def register_segment_grad(pending, live, live_refs, out_tensors,
-                          in_tensors, in_vals, sig):
-    """Wire ONE fused GradNode for an executed segment. live_refs only
-    needs .aval / .requires_grad (LazyRef or a replay meta)."""
-    from .autograd import GradNode, _Edge
-    # NOTE deliberately no is_grad_enabled() check here: grad intent was
-    # decided at RECORD time (ref.requires_grad), matching eager
-    # semantics — a flush that happens to run inside no_grad (e.g. a
-    # logging read) must not drop gradients for ops recorded outside it
-    grad_in = [i for i, t in enumerate(in_tensors)
-               if not t.stop_gradient
-               and jnp.issubdtype(in_vals[i].dtype, jnp.inexact)]
-    grad_out = [k for k, ref in enumerate(live_refs)
-                if ref.requires_grad]
-    if not grad_in or not grad_out:
+                          in_tensors, in_vals, sig, in_meta=None):
+    """Wire fused GradNodes for an executed segment — one per weakly-
+    connected component of the recorded dataflow. Two user-level graphs
+    captured in the same window (the ambient mode makes this common)
+    must stay INDEPENDENT: backward through one must not consume or
+    free the other's residuals. live_refs only needs .aval /
+    .requires_grad (LazyRef or a replay meta). in_tensors may contain
+    None for inputs whose tensor died mid-segment (they can no longer
+    receive a gradient).
+
+    NOTE deliberately no is_grad_enabled() check here: grad intent was
+    decided at RECORD time (ref.requires_grad), matching eager
+    semantics — a flush that happens to run inside no_grad (e.g. a
+    logging read) must not drop gradients for ops recorded outside it."""
+    recs = _in_grad_records(in_tensors, in_meta)
+    grad_in_all = [i for i, t in enumerate(in_tensors)
+                   if _input_grad_eligible(t, recs[i], in_vals[i])]
+    grad_out_all = [k for k, ref in enumerate(live_refs)
+                    if ref.requires_grad]
+    if not grad_in_all or not grad_out_all:
         return
 
-    gi = set(grad_in)
+    # union-find over op indices [0, n_ops) and inputs [n_ops, ...)
+    n_ops = len(pending)
+    parent = list(range(n_ops + len(in_vals)))
+
+    def find(a):
+        while parent[a] != a:
+            parent[a] = parent[parent[a]]
+            a = parent[a]
+        return a
+
+    for j, p in enumerate(pending):
+        for w in p.wiring:
+            if w is None:
+                continue
+            a = find(j)
+            b = find(n_ops + w[1] if w[0] == "in" else w[1])
+            if a != b:
+                parent[b] = a
+
+    comps: Dict[int, Tuple[List[int], List[int]]] = {}
+    for i in grad_in_all:
+        comps.setdefault(find(n_ops + i), ([], []))[0].append(i)
+    for k in grad_out_all:
+        comps.setdefault(find(live[k][0]), ([], []))[1].append(k)
+    comps = {r: c for r, c in comps.items() if c[0] and c[1]}
+    if not comps:
+        return
+
+    # each GradNode saves and differentiates only ITS slice of the
+    # segment: a disjoint graph captured in the same ambient window must
+    # not have its input buffers pinned as this component's residuals,
+    # nor its backward FLOPs paid under a zero cotangent
+    ops_by_root: Dict[int, List[int]] = {}
+    for j in range(n_ops):
+        ops_by_root.setdefault(find(j), []).append(j)
+    ins_by_root: Dict[int, List[int]] = {}
+    for i in range(len(in_vals)):
+        ins_by_root.setdefault(find(n_ops + i), []).append(i)
+
+    for r, (gi_c, go_c) in comps.items():
+        comp_ops = ops_by_root[r]
+        comp_ins = ins_by_root.get(r, [])
+        if len(comp_ops) == n_ops and len(comp_ins) == len(in_vals):
+            # sole component spans the whole segment (the steady-state
+            # train-step case): no remap, and the cache key stays `sig`
+            _register_component_grad(gi_c, go_c, pending, live, live_refs,
+                                     out_tensors, in_tensors, in_vals, sig,
+                                     recs)
+            continue
+        op_l = {j: lj for lj, j in enumerate(comp_ops)}
+        in_l = {i: li for li, i in enumerate(comp_ins)}
+        local_pending = []
+        for j in comp_ops:
+            p = pending[j]
+            wir = tuple(None if w is None else
+                        ("in", in_l[w[1]]) if w[0] == "in" else
+                        ("op", op_l[w[1]], w[2]) for w in p.wiring)
+            local_pending.append(_PendingOp(p.op, p.attrs, wir, p.out_refs))
+        comp_ks = [k for k, (j, _) in enumerate(live) if find(j) == r]
+        k_l = {k: lk for lk, k in enumerate(comp_ks)}
+        local_live = [(op_l[live[k][0]], live[k][1]) for k in comp_ks]
+        # global op/input index lists in the key make two segments that
+        # slice to the same local structure share a compile only when
+        # the remapping is identical
+        comp_sig = (sig[0], tuple(sig[1][j] for j in comp_ops),
+                    tuple(sig[2][i] for i in comp_ins), tuple(local_live),
+                    tuple(comp_ops), tuple(comp_ins))
+        _register_component_grad(
+            [in_l[i] for i in gi_c], [k_l[k] for k in go_c],
+            local_pending, local_live, [live_refs[k] for k in comp_ks],
+            [out_tensors[k] for k in comp_ks],
+            [in_tensors[i] for i in comp_ins],
+            [in_vals[i] for i in comp_ins], comp_sig,
+            [recs[i] for i in comp_ins])
+
+
+def _register_component_grad(grad_in, grad_out, pending, live, live_refs,
+                             out_tensors, in_tensors, in_vals, sig, recs):
+    """One GradNode for one dataflow component: edges per grad-requiring
+    input, output slots per grad-requiring live output (LOCAL indices)."""
+    from .autograd import GradNode, _Edge
     edges = []
     versions = []
     refs = []
-    for i, t in enumerate(in_tensors):
-        if i not in gi:
-            edges.append(_Edge(None))
-            versions.append(t._inplace_version)
-            refs.append(None)
-            continue
-        meta = t._autograd_meta
+    for i in grad_in:
+        t = in_tensors[i]
+        meta = recs[i][1] if t is None else t._autograd_meta
         if meta.grad_node is not None:
             edges.append(_Edge("node", node=meta.grad_node,
                                slot=meta.out_slot))
-        else:
+        elif t is not None:
             edges.append(_Edge("leaf", leaf=t))
-        versions.append(t._inplace_version)
-        refs.append(weakref.ref(t))
+        else:       # dead leaf: grads unobservable (filtered above, but
+            edges.append(_Edge(None))   # keep alignment defensively)
+        versions.append(recs[i][2] if t is None else t._inplace_version)
+        refs.append(None if t is None else weakref.ref(t))
 
     node = GradNode(
         None, {}, tuple(in_vals), edges,
-        out_shapes=tuple(tuple(r.aval.shape) for r in live_refs),
-        out_dtypes=tuple(r.aval.dtype for r in live_refs))
+        out_shapes=tuple(tuple(live_refs[k].aval.shape) for k in grad_out),
+        out_dtypes=tuple(live_refs[k].aval.dtype for k in grad_out))
     node.name = "lazy_segment"
     node.saved_versions = tuple(versions)
     node.in_refs = tuple(refs)
 
     bwd = _segment_bwd(sig, pending, live, tuple(grad_in))
 
-    def py_bwd(gouts, _saved=tuple(in_vals), _bwd=bwd,
-               _refs=live_refs, _n=len(grad_in)):
-        cts = []
-        for g, ref in zip(gouts, _refs):
+    def py_bwd(gouts, _saved=tuple(in_vals), _bwd=bwd, _refs=live_refs,
+               _go=tuple(grad_out)):
+        dispatch.bump_exec()
+        # the cached vjp covers the WHOLE segment: seed this component's
+        # slots, zeros elsewhere (disjoint components contribute nothing)
+        cts = [jnp.zeros(r.aval.shape, r.aval.dtype) for r in _refs]
+        for g, k in zip(gouts, _go):
             if g is None:
-                cts.append(jnp.zeros(ref.aval.shape, ref.aval.dtype))
-            elif hasattr(g, "astype") and g.dtype != ref.aval.dtype:
-                cts.append(g.astype(ref.aval.dtype))
-            else:
-                cts.append(g)
+                continue
+            ref = _refs[k]
+            if hasattr(g, "astype") and g.dtype != ref.aval.dtype:
+                g = g.astype(ref.aval.dtype)
+            cts[k] = g
         grads = _bwd(list(_saved), tuple(cts))
         out = []
         for g in grads:
@@ -323,38 +669,26 @@ def register_segment_grad(pending, live, live_refs, out_tensors,
                 out.append(g)
         return tuple(out)
 
-    # edges cover every segment input; py_bwd returns grads aligned
-    # with them (None for stop-gradient slots)
-    def py_bwd_full(gouts, _inner=py_bwd, _n_in=len(in_tensors),
-                    _grad_in=tuple(grad_in)):
-        grads = _inner(gouts)
-        out = [None] * _n_in
-        for g, i in zip(grads, _grad_in):
-            out[i] = g
-        return tuple(out)
+    node.py_bwd = py_bwd
 
-    node.py_bwd = py_bwd_full
-
-    for k, t in enumerate(out_tensors):
-        if k in grad_out and t is not None and not t.stop_gradient:
+    for local_k, k in enumerate(grad_out):
+        t = out_tensors[k]
+        if t is not None and not t.stop_gradient:
             m = t._autograd_meta
             if m.grad_node is None:
                 m.grad_node = node
-                m.out_slot = k
+                m.out_slot = local_k
 
 
-def _segment_signature(pending, in_vals, live):
-    from .dispatch import attrs_key
-    ops_sig = tuple(
-        (p.op.name, attrs_key(p.attrs), p.wiring, p.n_outs)
-        for p in pending)
-    in_sig = tuple((tuple(v.shape), str(v.dtype),
-                    bool(getattr(v, "weak_type", False)))
-                   for v in in_vals)
-    return (jax.default_backend(), ops_sig, in_sig, tuple(live))
+def _in_signature(in_vals):
+    return tuple((tuple(v.shape), _dstr(v.dtype),
+                  bool(getattr(v, "weak_type", False)))
+                 for v in in_vals)
 
 
 def _build_segment_fn(pending, live):
+    """Compile body of one segment. Variadic over inputs so jax.jit's
+    donate_argnums can address individual input buffers."""
     backend = jax.default_backend()
     steps = []
     for p in pending:
@@ -362,7 +696,7 @@ def _build_segment_fn(pending, live):
                                         **p.attrs),
                       p.wiring, p.op.multi_output))
 
-    def seg_fn(inputs):
+    def seg_fn(*inputs):
         vals: List[Tuple] = []
         for fn, wiring, multi in steps:
             ins = []
@@ -380,7 +714,29 @@ def _build_segment_fn(pending, live):
     return seg_fn
 
 
-_SEG_BWD_CACHE: Dict[Tuple, Any] = {}
+def _build_fused_fn(pending, live, grad_in: Tuple[int, ...], root_k: int):
+    """Whole-step fusion: forward segment + vjp seeded at live output
+    `root_k` as ONE program — the eager analog of the donated jitted
+    train step in models/trainer.py. Returns (live_out_vals, grads)."""
+    seg = _build_segment_fn(pending, live)
+
+    def fused(*inputs):
+        def f(*gvals):
+            full = list(inputs)
+            for v, i in zip(gvals, grad_in):
+                full[i] = v
+            outs = seg(*full)
+            return outs[root_k], outs
+
+        root_val, pull, outs = jax.vjp(
+            f, *[inputs[i] for i in grad_in], has_aux=True)
+        grads = pull(jnp.ones(root_val.shape, root_val.dtype))
+        return outs, grads
+
+    return fused
+
+
+_SEG_BWD_CACHE: Dict[Tuple, Any] = ExecCache()
 
 
 def _segment_bwd(sig, pending, live, grad_in: Tuple[int, ...]):
@@ -394,7 +750,7 @@ def _segment_bwd(sig, pending, live, grad_in: Tuple[int, ...]):
                 full = list(inputs)
                 for v, i in zip(gvals, _gi):
                     full[i] = v
-                return _seg(full)
+                return _seg(*full)
             _, pull = jax.vjp(f, *[inputs[i] for i in _gi])
             return pull(list(cts))
 
@@ -439,7 +795,7 @@ class ReplayableSegment:
         self.live = live
         self.metas = [_RefMeta(r.aval, r.requires_grad) for r in live_refs]
         self.sig = sig
-        self.in_avals = tuple((tuple(v.shape), str(v.dtype))
+        self.in_avals = tuple((tuple(v.shape), _dstr(v.dtype))
                               for v in in_vals)
         # which inputs fed grad-requiring chains at capture (replay must
         # see the same stop_gradient mask to reuse the vjp wiring)
@@ -448,14 +804,15 @@ class ReplayableSegment:
     def run(self, in_tensors):
         from .tensor import Tensor
         in_vals = [t._value for t in in_tensors]
-        got = tuple((tuple(v.shape), str(v.dtype)) for v in in_vals)
+        got = tuple((tuple(v.shape), _dstr(v.dtype)) for v in in_vals)
         if got != self.in_avals:
             raise _ReplayMismatch("input avals changed")
-        runner = _SEG_CACHE.get(self.sig)
+        runner = _SEG_CACHE.get((self.sig, ()))
         if runner is None:
             runner = jax.jit(_build_segment_fn(self.pending, self.live))
-            _SEG_CACHE[self.sig] = runner
-        out_vals = runner(list(in_vals))
+            _SEG_CACHE[(self.sig, ())] = runner
+        dispatch.bump_exec()
+        out_vals = runner(*in_vals)
         outs = []
         for meta, val in zip(self.metas, out_vals):
             outs.append(Tensor(val, stop_gradient=not meta.requires_grad))
@@ -471,14 +828,175 @@ class _ReplayMismatch(Exception):
 # --------------------------------------------------------------- the guard
 _ACTIVE: List[CaptureContext] = []
 
+# Ambient context: the fusion window as the DEFAULT eager mode — no
+# guard needed. Installed by enable_eager_fusion(); explicit lazy_guard
+# contexts stack above it and take precedence.
+_AMBIENT: Optional[CaptureContext] = None
+
 
 def current_context() -> Optional[CaptureContext]:
-    return _ACTIVE[-1] if _ACTIVE else None
+    # FLAGS_lazy_enable / FLAGS_eager_fusion are re-read on every
+    # dispatch, so toggling them mid-session (even inside an open guard)
+    # takes effect immediately — no stale ambient state survives a flip
+    global _AMBIENT
+    from . import flags
+    if not flags.flag_value("FLAGS_lazy_enable"):
+        return None
+    if _ACTIVE:
+        return _ACTIVE[-1]
+    if flags.flag_value("FLAGS_eager_fusion"):
+        if _AMBIENT is None:
+            _AMBIENT = CaptureContext()
+        return _AMBIENT
+    if _AMBIENT is not None:
+        # flag flipped off with ops pending: land them, then retire the
+        # ambient context so dispatch is strictly per-op again
+        ctx, _AMBIENT = _AMBIENT, None
+        ctx.flush("ambient_disable")
+    return None
 
 
 def flush_active(reason: str = "materialize"):
-    if _ACTIVE:
-        _ACTIVE[-1].flush(reason)
+    ctx = current_context()
+    if ctx is not None:
+        ctx.flush(reason)
+
+
+def enable_eager_fusion(enable: bool = True) -> Optional[CaptureContext]:
+    """Toggle the ambient fusion window (FLAGS_eager_fusion).
+
+    With fusion on (the default), plain dygraph code (no lazy_guard)
+    records ops into an ambient segment that runs as one cached XLA
+    program at the next sync point (.numpy()/float()/backward()/segment
+    cap) — the TPU-native analog of the reference's CUDA-stream
+    run-ahead. Turning it off flushes anything pending and restores
+    strict per-op dispatch. Returns the ambient context when enabling."""
+    from . import flags
+    flags.set_flags({"FLAGS_eager_fusion": enable})
+    return current_context() if not _ACTIVE else _AMBIENT
+
+
+def eager_fusion_enabled() -> bool:
+    from . import flags
+    return bool(flags.flag_value("FLAGS_eager_fusion"))
+
+
+def note_inplace(tensor):
+    """Called by Tensor._replace_value_inplace: evict the tensor's input
+    registration from every open capture context (see
+    CaptureContext.note_inplace)."""
+    for ctx in _ACTIVE:
+        ctx.note_inplace(tensor)
+    if _AMBIENT is not None:
+        _AMBIENT.note_inplace(tensor)
+
+
+def try_fused_backward(tensors, grad_tensors) -> bool:
+    """Whole-step fusion entry: backward() on a root still pending in the
+    active window compiles forward+vjp as ONE cached XLA program (the
+    "step cache", keyed on the segment signature + grad wiring) instead
+    of flushing forward and walking the generic engine. Grads land
+    directly on the leaves as in-flight futures; the graph is consumed
+    (retain_graph=False semantics). Returns True when handled; any
+    fallback condition returns False and the generic path runs."""
+    ctx = current_context()
+    if ctx is None or not ctx.pending or ctx.on_flush is not None:
+        return False
+    if len(tensors) != 1:
+        return False
+    if grad_tensors and any(g is not None for g in grad_tensors):
+        return False
+    root = tensors[0]
+    p = root._payload
+    if not getattr(p, "_is_lazy_ref", False) or p.ctx is not ctx \
+            or p.op_idx is None or not p.requires_grad:
+        return False
+    if int(np.prod(p.aval.shape)) != 1:   # implicit seed needs a scalar
+        return False
+    if root._autograd_meta.hooks:
+        return False
+
+    pending = ctx.pending
+    in_vals = ctx._in_vals
+    in_meta = ctx._in_meta
+    in_tensors = [r() for r in ctx._in_tensors]
+    live, live_refs = ctx._live_outputs(pending)
+
+    root_k = None
+    for k, ref in enumerate(live_refs):
+        if ref is p:
+            root_k = k
+        elif ref.requires_grad:
+            # another grad-requiring output survives: the generic engine
+            # must own the graph (user may backward through it later)
+            return False
+    if root_k is None:
+        return False
+
+    grad_in: List[int] = []
+    for i, t in enumerate(in_tensors):
+        req, meta, _ = in_meta[i]
+        if not req or not jnp.issubdtype(in_vals[i].dtype, jnp.inexact):
+            continue
+        if meta.grad_node is not None or meta.hooks:
+            # grads flow beyond this segment (even if the intermediate
+            # tensor itself died), or a hook must fire: only the generic
+            # engine handles that correctly
+            return False
+        if t is None:
+            continue   # dead leaf: its grad is unobservable
+        grad_in.append(i)
+    if not grad_in:
+        return False
+    grad_in = tuple(grad_in)
+
+    sig = ctx._signature(in_vals, live)
+    key = (sig, grad_in, root_k)
+    runner = _FUSED_CACHE.get(key)
+    if runner is None:
+        runner = jax.jit(_build_fused_fn(pending, live, grad_in, root_k))
+        _FUSED_CACHE[key] = runner
+    dispatch.bump_exec()
+    try:
+        out_vals, grads = runner(*in_vals)
+    except Exception:
+        ctx._reset_segment()
+        raise
+    ctx._reset_segment()
+    ctx.breaks.append("backward_fused")
+    ctx.segments_run += 1
+
+    # bind live outputs (they stay in-flight futures — tracing of the
+    # next step overlaps this one's device execution)
+    for ref, val in zip(live_refs, out_vals):
+        for t in _live_aliases(ref):
+            t._payload = val
+
+    from .autograd import GradNode, _accum
+    from .tensor import Tensor
+    for i, g in zip(grad_in, grads):
+        t = in_tensors[i]
+        meta = t._autograd_meta
+        if meta.grad is None:
+            meta.grad = Tensor(g, stop_gradient=True)
+        else:
+            meta.grad = Tensor(_accum(meta.grad._value, g),
+                               stop_gradient=True)
+
+    # the graph was consumed (retain_graph=False semantics): leave a
+    # FREED GradNode on the root so a second backward() raises the same
+    # "second time" error as the generic engine, instead of the root
+    # looking like a leaf and the call silently no-opping
+    meta = root._autograd_meta
+    if meta.grad_node is None:
+        tomb = GradNode(None, {}, None, [],
+                        out_shapes=(tuple(p.aval.shape),),
+                        out_dtypes=(p.aval.dtype,))
+        tomb.name = "lazy_segment_fused"
+        tomb.freed = True
+        meta.grad_node = tomb
+        meta.out_slot = 0
+    return True
 
 
 class lazy_guard:
@@ -517,10 +1035,7 @@ class lazy_guard:
             try:
                 self.ctx.flush("guard_error")
             except Exception:
-                self.ctx.pending = []
-                self.ctx._in_ids = {}
-                self.ctx._in_tensors = []
-                self.ctx._in_vals = []
+                self.ctx._reset_segment()
         return False
 
 
@@ -531,4 +1046,5 @@ def segment_cache_size() -> int:
 def clear_segment_cache():
     _SEG_CACHE.clear()
     _SEG_BWD_CACHE.clear()
+    _FUSED_CACHE.clear()
     _AVAL_CACHE.clear()

@@ -171,19 +171,51 @@ class Tensor:
         return self._value
 
     def _replace_value_inplace(self, new_value):
-        """In-place mutation: bump version (tensor_wrapper.h safety model)."""
+        """In-place mutation: bump version (tensor_wrapper.h safety model).
+        Open capture contexts are notified so ops recorded AFTER the swap
+        see the fresh payload (and the orphaned snapshot can be donated)."""
+        from . import lazy
+        lazy.note_inplace(self)
         self._value = new_value
         self._inplace_version += 1
         return self
 
     def set_value(self, value):
+        from . import lazy
+        aval = self._meta_aval()
         if isinstance(value, Tensor):
-            value = value._value
-        value = jnp.asarray(value, dtype=self._value.dtype)
-        if tuple(value.shape) != tuple(self._value.shape):
+            vp = value._payload
+            if getattr(vp, "_is_lazy_ref", False) and \
+                    lazy.current_context() is not None:
+                # stay in the fusion window: alias the pending value
+                # (casting through the op layer if dtypes differ)
+                # instead of materializing both sides — the in-place
+                # `param.copy_(new)` train-step pattern stays one fused,
+                # donation-eligible segment
+                if tuple(value._meta_aval().shape) != tuple(aval.shape):
+                    raise ValueError(
+                        f"set_value shape mismatch: "
+                        f"{tuple(value._meta_aval().shape)} vs "
+                        f"{tuple(aval.shape)}")
+                src = value
+                if np.dtype(value._meta_aval().dtype) != np.dtype(aval.dtype):
+                    from ..ops import cast
+                    src = cast(value, dtypes_mod.from_np(np.dtype(aval.dtype)))
+                newp = src._payload
+                if getattr(newp, "_is_lazy_ref", False):
+                    lazy.note_inplace(self)
+                    self._payload = newp
+                    newp.add_tref(self)
+                    self._inplace_version += 1
+                    return self
+                value = newp   # cast materialized: fall through
+            else:
+                value = value._value
+        value = jnp.asarray(value, dtype=np.dtype(aval.dtype))
+        if tuple(value.shape) != tuple(aval.shape):
             raise ValueError(
                 f"set_value shape mismatch: {value.shape} vs "
-                f"{self._value.shape}")
+                f"{tuple(aval.shape)}")
         return self._replace_value_inplace(value)
 
     def copy_(self, other):

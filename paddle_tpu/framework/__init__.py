@@ -61,3 +61,9 @@ from .tensor_types import (  # noqa: E402,F401
 
 __all__ += ["SelectedRows", "TensorArray", "StringTensor", "create_array",
             "array_write", "array_read", "array_length"]
+
+from .._core.lazy import (  # noqa: E402,F401
+    eager_fusion_enabled, enable_eager_fusion, lazy_guard,
+)
+
+__all__ += ["lazy_guard", "enable_eager_fusion", "eager_fusion_enabled"]

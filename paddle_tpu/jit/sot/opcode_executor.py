@@ -1019,6 +1019,10 @@ class SotFunction:
          out_tensors) = session.flushes[0]
         if reason != "guard_exit" or not pending:
             return None
+        if any(t is None for t in in_tensors):
+            # an input tensor died during capture (lazy trace holds only
+            # weakrefs) — there is nothing to rebind on replay
+            return None
 
         # map materialized arrays back to segment slots / inputs
         out_ids = {}

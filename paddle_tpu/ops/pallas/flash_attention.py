@@ -438,8 +438,13 @@ def _make_partitioned(fn, n_arrays, n_outs, rule):
             rule, need_replication_factors=("i", "j", "k", "l"))
     except Exception:  # pragma: no cover - jax-version dependent
         sdy_rule = None
-    p.def_partition(infer_sharding_from_operands=infer, partition=part,
-                    sharding_rule=sdy_rule)
+    try:
+        p.def_partition(infer_sharding_from_operands=infer, partition=part,
+                        sharding_rule=sdy_rule)
+    except TypeError:  # pragma: no cover - jax-version dependent
+        # older jax: def_partition has no sharding_rule kwarg (GSPMD-only
+        # propagation); the Shardy rule is an optimization, not required
+        p.def_partition(infer_sharding_from_operands=infer, partition=part)
     return p
 
 

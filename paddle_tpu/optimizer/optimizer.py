@@ -17,6 +17,8 @@ from typing import Dict, List, Optional
 import jax
 import jax.numpy as jnp
 
+from .._core import dispatch as _dispatch
+from .._core import flags as _flags
 from .._core.autograd import no_grad
 from .._core.tensor import Tensor
 from .lr import LRScheduler
@@ -61,7 +63,14 @@ class Optimizer:
             self._param_groups.append({"params": params,
                                        "learning_rate": 1.0,
                                        "weight_decay": self._default_wd})
+        # donating pvals/states lets XLA update parameters and optimizer
+        # state IN PLACE (no per-step param copy) — old buffers are dead
+        # the moment step() swaps the payloads. Grads are NOT donated
+        # (user code commonly inspects p.grad after step()).
         self._jit_update = jax.jit(
+            self._fused_update, static_argnames=("wds", "lr_mults"),
+            donate_argnums=(0, 2))
+        self._jit_update_nodonate = jax.jit(
             self._fused_update, static_argnames=("wds", "lr_mults"))
 
     # ------------------------------------------------------------- lr
@@ -118,8 +127,12 @@ class Optimizer:
 
         wds = tuple(m["weight_decay"] for m in metas)
         lr_mults = tuple(m["learning_rate"] for m in metas)
-        new_p, new_s = self._jit_update(pvals, gvals, states, lr, t,
-                                        wds=wds, lr_mults=lr_mults)
+        fn = self._pick_update(pvals, gvals, states)
+        _dispatch.bump_exec()
+        from .._core.lazy import _quiet_donation_compile
+        with _quiet_donation_compile():   # no-donation backends (CPU)
+            new_p, new_s = fn(pvals, gvals, states, lr, t,
+                              wds=wds, lr_mults=lr_mults)
         for (p, _), meta, np_, ns in zip(pairs, metas, new_p, new_s):
             pid = id(p)
             self._states[pid] = ns
@@ -128,6 +141,33 @@ class Optimizer:
                 p._replace_value_inplace(np_.astype(p._value.dtype))
             else:
                 p._replace_value_inplace(np_)
+
+    def _pick_update(self, pvals, gvals, states):
+        """Donating runner unless disabled, a buffer appears twice in
+        the call (tied params / shared state would trip XLA's
+        use-after-donate check), or a donated buffer is aliased outside
+        this optimizer (an EMA/checkpoint `p.detach()` snapshot, a saved
+        backward residual): donation deletes the buffer, so anything
+        else still referencing it must force the copying runner."""
+        import sys
+        if not _flags.flag_value("FLAGS_optimizer_donate_params"):
+            return self._jit_update_nodonate
+        seen = set()
+        for v in pvals + gvals + jax.tree_util.tree_leaves(states):
+            if id(v) in seen:
+                return self._jit_update_nodonate
+            seen.add(id(v))
+        # expected refs for a solely-owned param value: Tensor._payload
+        # (or self._master entry) + pvals list + loop var + getrefcount
+        # arg = 4. A state leaf: self._states dict + leaves list + loop
+        # var + arg = 4 (the `states` list holds the dicts, not leaves).
+        for v in pvals:
+            if sys.getrefcount(v) > 4:
+                return self._jit_update_nodonate
+        for v in jax.tree_util.tree_leaves(states):
+            if sys.getrefcount(v) > 4:
+                return self._jit_update_nodonate
+        return self._jit_update
 
     def _fused_update(self, pvals, gvals, states, lr, t, wds, lr_mults):
         new_p, new_s = [], []

@@ -67,6 +67,91 @@ def test_lazy_enable_kill_switch():
     np.testing.assert_allclose(y.numpy(), [2.0, 2.0])
 
 
+def test_lazy_enable_toggle_mid_guard_takes_effect():
+    """Flipping FLAGS_lazy_enable with a guard already open must take
+    effect on the NEXT dispatch (no stale context, no stale cache hit):
+    ops before the flip stay lazy, ops after run eagerly, and both
+    produce correct values."""
+    from paddle_tpu._core import lazy
+    x = paddle.to_tensor(np.ones((2,), "float32"))
+    with lazy.lazy_guard() as ctx:
+        y = x + 1.0
+        assert getattr(y._payload, "_is_lazy_ref", False)
+        set_flags({"FLAGS_lazy_enable": False})
+        try:
+            z = x * 3.0
+            assert not getattr(z._payload, "_is_lazy_ref", False), \
+                "kill-switch must take effect mid-guard"
+        finally:
+            set_flags({"FLAGS_lazy_enable": True})
+        w = x * 5.0
+        assert getattr(w._payload, "_is_lazy_ref", False)
+    np.testing.assert_allclose(y.numpy(), [2.0, 2.0])
+    np.testing.assert_allclose(z.numpy(), [3.0, 3.0])
+    np.testing.assert_allclose(w.numpy(), [5.0, 5.0])
+    assert ctx.ops_recorded >= 2
+
+
+def test_lazy_max_segment_ops_live_on_open_context():
+    """FLAGS_lazy_max_segment_ops is read live: lowering it mid-session
+    caps the ALREADY-OPEN context's next record."""
+    from paddle_tpu._core import lazy
+    x = paddle.to_tensor(np.ones((2,), "float32"))
+    old = flag_value("FLAGS_lazy_max_segment_ops")
+    with lazy.lazy_guard() as ctx:
+        y = x + 1.0
+        assert ctx.segments_run == 0
+        set_flags({"FLAGS_lazy_max_segment_ops": 2})
+        try:
+            y = y + 1.0   # hits the lowered cap -> forced flush
+            assert ctx.segments_run == 1
+            assert "segment_cap" in ctx.breaks
+        finally:
+            set_flags({"FLAGS_lazy_max_segment_ops": old})
+    np.testing.assert_allclose(y.numpy(), [3.0, 3.0])
+
+
+def test_eager_fusion_flag_toggle_flushes_ambient():
+    """Turning FLAGS_eager_fusion off lands pending ambient work and
+    restores strict per-op dispatch; turning it back on resumes fusion."""
+    from paddle_tpu._core import lazy
+    assert lazy.eager_fusion_enabled()
+    x = paddle.to_tensor(np.ones((2,), "float32"))
+    y = x + 1.0                            # ambient: lazy
+    assert getattr(y._payload, "_is_lazy_ref", False)
+    lazy.enable_eager_fusion(False)
+    try:
+        assert not getattr(y._payload, "_is_lazy_ref", False), \
+            "disable must flush pending ambient ops"
+        z = x * 2.0                        # strict per-op dispatch
+        assert not getattr(z._payload, "_is_lazy_ref", False)
+    finally:
+        lazy.enable_eager_fusion(True)
+    w = x * 4.0
+    assert getattr(w._payload, "_is_lazy_ref", False)
+    np.testing.assert_allclose(y.numpy(), [2.0, 2.0])
+    np.testing.assert_allclose(w.numpy(), [4.0, 4.0])
+
+
+def test_executable_cache_capacity_flag_lru():
+    """FLAGS_executable_cache_capacity bounds every compiled-runner
+    cache with LRU eviction, read live at insertion time."""
+    from paddle_tpu._core import lazy
+    lazy.clear_segment_cache()
+    with _with_flag("FLAGS_executable_cache_capacity", 2):
+        for k in range(1, 5):   # 4 distinct signatures
+            x = paddle.to_tensor(np.ones((k, 2), "float32"))
+            with lazy.lazy_guard():
+                y = x + 1.0
+            np.testing.assert_allclose(y.numpy(), np.full((k, 2), 2.0))
+        assert len(lazy._SEG_CACHE) <= 2, "LRU cap not enforced"
+    # re-running an evicted signature recompiles and still works
+    x = paddle.to_tensor(np.ones((1, 2), "float32"))
+    with lazy.lazy_guard():
+        y = x + 1.0
+    np.testing.assert_allclose(y.numpy(), np.full((1, 2), 2.0))
+
+
 def test_pipeline_max_inflight_cap():
     from paddle_tpu.distributed.pipeline import _HostPipeBase
 

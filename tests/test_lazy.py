@@ -139,6 +139,60 @@ def test_segment_cap_flag():
         set_flags({"FLAGS_lazy_max_segment_ops": old})
 
 
+def test_trace_does_not_pin_dead_inputs():
+    """The capture holds only WEAK refs to input tensors: a tensor dying
+    mid-segment must not be kept alive by the trace (its payload
+    snapshot in _in_vals is all the flush needs — and the orphaned
+    buffer becomes a donation candidate)."""
+    import gc
+    import weakref
+    with lazy.lazy_guard() as ctx:
+        x = paddle.to_tensor(np.full((3, 3), 2.0, "float32"))
+        y = x * 3.0
+        wr = weakref.ref(x)
+        del x
+        gc.collect()
+        assert wr() is None, "lazy trace pinned a dead input tensor"
+        assert len(ctx.pending) == 1, "trace must survive the input's death"
+    np.testing.assert_allclose(y.numpy(), np.full((3, 3), 6.0))
+
+
+def test_failed_flush_drops_trace_state():
+    """A segment that fails to compile/run must surface the error AND
+    drop the trace (input registrations included) — not pin tensors or
+    poison later records."""
+    x = paddle.to_tensor(np.ones((2, 2), "float32"))
+    with lazy.lazy_guard() as ctx:
+        y = x + 1.0
+        orig = lazy._build_segment_fn
+        lazy._build_segment_fn = lambda *a, **k: (_ for _ in ()).throw(
+            RuntimeError("boom"))
+        lazy.clear_segment_cache()
+        try:
+            with pytest.raises(RuntimeError, match="boom"):
+                ctx.flush("forced")
+        finally:
+            lazy._build_segment_fn = orig
+        assert ctx.pending == [] and ctx._in_tensors == [] \
+            and ctx._in_vals == [] and ctx._in_ids == {}
+        # the context keeps working after the failure
+        z = x * 2.0
+        np.testing.assert_allclose(z.numpy(), np.full((2, 2), 2.0))
+
+
+def test_inplace_swap_mid_segment_uses_fresh_payload():
+    """set_value/copy_ mid-segment: ops recorded BEFORE the swap keep the
+    registered snapshot (eager ordering); ops recorded AFTER see the new
+    payload."""
+    x = paddle.to_tensor(np.ones((2,), "float32"))
+    with lazy.lazy_guard():
+        before = x + 1.0                   # sees 1.0
+        x.set_value(np.full((2,), 5.0, "float32"))
+        after = x + 1.0                    # sees 5.0
+    np.testing.assert_allclose(before.numpy(), [2.0, 2.0])
+    np.testing.assert_allclose(after.numpy(), [6.0, 6.0])
+
+
 def test_uncapturable_op_falls_back():
     """An op whose shape inference needs concrete data (eval_shape fails)
     breaks the graph and runs eagerly instead of raising."""
@@ -147,3 +201,41 @@ def test_uncapturable_op_falls_back():
     with lazy.lazy_guard():
         out = paddle.nonzero(F.relu(x))
     np.testing.assert_allclose(out.numpy(), ref)
+
+
+def test_disjoint_components_slice_saved_residuals():
+    """Two independent graphs captured in one window get INDEPENDENT
+    GradNodes, each saving only its own component's inputs — backward
+    through one must not pin (or differentiate) the other's buffers."""
+    a = paddle.to_tensor(np.full((4,), 3.0, "float32"))
+    a.stop_gradient = False
+    b = paddle.to_tensor(np.full((4,), 5.0, "float32"))
+    b.stop_gradient = False
+    with lazy.lazy_guard():
+        ya = (a * a).sum()
+        yb = (b + b).sum()
+    na = ya._autograd_meta.grad_node
+    nb = yb._autograd_meta.grad_node
+    assert na is not None and nb is not None and na is not nb
+    assert len(na.saved) == 1, "component A pinned foreign inputs"
+    assert len(nb.saved) == 1, "component B pinned foreign inputs"
+    ya.backward()
+    yb.backward()
+    np.testing.assert_allclose(a.grad.numpy(), np.full((4,), 6.0))
+    np.testing.assert_allclose(b.grad.numpy(), np.full((4,), 2.0))
+
+
+def test_ndarray_attr_digest_invalidates_on_mutation():
+    """The memoized ndarray-attr digest must not go stale when the array
+    is mutated in place (small arrays are digested in full; large ones
+    are guarded by a sampled fingerprint)."""
+    from paddle_tpu._core.dispatch import _digest_array
+    big = np.arange(1024, dtype="float32")          # above memo threshold
+    k1 = _digest_array(big)
+    assert _digest_array(big) == k1                 # memo hit
+    big[0] = 999.0
+    assert _digest_array(big) != k1
+    small = np.arange(4, dtype="float32")
+    s1 = _digest_array(small)
+    small[1] = 7.0
+    assert _digest_array(small) != s1
