@@ -7,8 +7,11 @@ row. Select with BENCH_ROWS=1,2,3 (default all).
 Row 1  LeNet/MNIST eager dynamic-graph   steps/sec
 Row 2  ResNet-50 @to_static AMP(bf16)    images/sec/chip
 Row 3  BERT-base pretrain-style step     tokens/sec/chip
-(Rows 4-5 — multi-chip GPT/ERNIE hybrids — need a pod; their single-chip
-proxies are bench.py's headline + the dryrun_multichip compile check.)
+Row 4  eager dispatch-overhead microbench  ops/sec through the lazy window
+Row 5  static-check overhead sanity      asserts 0 sanitizer sweeps when
+                                         off; reports warn-mode overhead %
+(Multi-chip GPT/ERNIE hybrids need a pod; their single-chip proxies are
+bench.py's headline + the dryrun_multichip compile check.)
 """
 from __future__ import annotations
 
@@ -142,10 +145,57 @@ def bench_dispatch():
             "value": round(chain * 2 / sec, 1), "unit": "ops/s"}
 
 
+def bench_static_checks():
+    """Row 5: program-sanitizer overhead sanity. With
+    FLAGS_static_checks=off the checkers must contribute ZERO work —
+    asserted by counting sanitizer sweeps (hooks.SEGMENT_SWEEPS frozen
+    across the whole off-mode timing; exact, immune to machine noise,
+    unlike a wall-clock delta between two identical code paths). The
+    reported value is warn-mode overhead on the same 32-op lazy chain,
+    min-of-interleaved-rounds."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.analysis import hooks
+
+    x = paddle.to_tensor(np.ones((16, 16), "float32"))
+    chain = 16
+
+    def run():
+        y = x
+        for _ in range(chain):
+            y = y * 1.0001 + 0.0001
+        return y._value
+
+    def timed(mode):
+        paddle.set_flags({"FLAGS_static_checks": mode})
+        try:
+            return _timeit(run, steps=100, warmup=10)
+        finally:
+            paddle.set_flags({"FLAGS_static_checks": "off"})
+
+    timed("off")               # prime: compile + cache warmup off-clock
+    start = hooks.SEGMENT_SWEEPS
+    # interleave off/warn rounds so machine drift hits both equally
+    rounds = []
+    for _ in range(5):
+        before = hooks.SEGMENT_SWEEPS
+        off_t = timed("off")
+        assert hooks.SEGMENT_SWEEPS == before, \
+            "FLAGS_static_checks=off ran sanitizer sweeps (must be 0)"
+        rounds.append((off_t, timed("warn")))
+    assert hooks.SEGMENT_SWEEPS > start, "warn mode never swept"
+    off = min(r[0] for r in rounds)
+    warn = min(r[1] for r in rounds)
+    warn_pct = (warn - off) / off * 100.0
+    return {"metric": f"static-check overhead ({chain * 2}-op lazy "
+                      f"chain; off = 0 sweeps asserted)",
+            "value": round(warn_pct, 1), "unit": "% warn-mode overhead"}
+
+
 def main():
-    rows = os.environ.get("BENCH_ROWS", "1,2,3,4").split(",")
+    rows = os.environ.get("BENCH_ROWS", "1,2,3,4,5").split(",")
     table = {"1": bench_lenet, "2": bench_resnet50, "3": bench_bert,
-             "4": bench_dispatch}
+             "4": bench_dispatch, "5": bench_static_checks}
     for r in rows:
         r = r.strip()
         out = table[r]()

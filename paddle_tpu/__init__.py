@@ -68,6 +68,18 @@ from . import audio  # noqa: E402
 from . import static  # noqa: E402
 from . import text  # noqa: E402
 from . import utils  # noqa: E402
+# paddle.analysis (the program sanitizer) loads lazily: the checkers
+# must cost nothing — not even import work — when FLAGS_static_checks
+# is off, and the runtime hooks (lazy.py, pass_base.py) already import
+# it on demand
+
+
+def __getattr__(name):
+    if name == "analysis":
+        import importlib
+        return importlib.import_module(".analysis", __name__)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")
 
 from .framework import save, load  # noqa: E402
 

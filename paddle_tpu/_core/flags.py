@@ -180,6 +180,24 @@ define_flag("FLAGS_amp_decr_every_n_nan_or_inf", 1,
 # ---- debug nets
 define_flag("FLAGS_check_nan_inf_level", 0,
             "NaN/Inf scan action: 0 raise, 1 warn and continue.")
+define_flag("FLAGS_static_checks", "off",
+            "Program sanitizer level: 'off' (no cost), 'warn' (run the "
+            "paddle_tpu.analysis checkers over every flushed lazy "
+            "segment and IR pass and emit StaticCheckWarning), 'error' "
+            "(raise StaticCheckError on any violation).")
+# off-synonym values the hot-path gates (lazy record/flush, PassManager)
+# test membership against — keeps '0'/'false' spellings from paying the
+# analysis import or even a str() call per recorded op. The lowercase
+# frozenset is the single source of truth (check_mode() normalizes
+# against it); STATIC_CHECKS_OFF adds the common case/type variants so
+# the raw-value gate needs no normalization, as a frozenset because the
+# membership test runs once per recorded op.
+STATIC_CHECKS_OFF_WORDS = frozenset(
+    ("off", "0", "false", "none", "disable", "disabled", ""))
+STATIC_CHECKS_OFF = frozenset(
+    w for word in STATIC_CHECKS_OFF_WORDS
+    for w in (word, word.capitalize(), word.upper())
+) | {0, False, None}
 
 # ---- kernels / pallas
 define_flag("FLAGS_flash_interpret", False,

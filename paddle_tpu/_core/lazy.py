@@ -38,6 +38,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import dispatch
+from .flags import STATIC_CHECKS_OFF as _CHECKS_OFF
 from .cache import ExecCache
 from .op_registry import OpDef
 
@@ -94,14 +95,18 @@ class LazyRef:
 
 
 class _PendingOp:
-    __slots__ = ("op", "attrs", "wiring", "out_refs", "n_outs")
+    __slots__ = ("op", "attrs", "wiring", "out_refs", "n_outs", "src")
 
-    def __init__(self, op, attrs, wiring, out_refs):
+    def __init__(self, op, attrs, wiring, out_refs, src=None):
         self.op = op
         self.attrs = attrs
         self.wiring = wiring          # per input: ("in", i) | ("op", j, s) | None
         self.out_refs = out_refs      # list[LazyRef]
         self.n_outs = len(out_refs)
+        # "file:line" of the recording user frame — captured only under
+        # FLAGS_static_checks so diagnostics can point at Python source;
+        # deliberately NOT part of the segment signature
+        self.src = src
 
 
 # str(np.dtype) costs ~10us a call and the dispatch hot path needs it
@@ -262,7 +267,13 @@ class CaptureContext:
             t = _lazy_tensor(ref, stop_gradient=not (req and inexact))
             out_refs.append(ref)
             outs.append(t)
-        self.pending.append(_PendingOp(op, dict(attrs), wiring, out_refs))
+        src = None
+        from . import flags
+        if flags.flag_value("FLAGS_static_checks") not in _CHECKS_OFF:
+            from ..analysis.hooks import call_site
+            src = call_site()
+        self.pending.append(_PendingOp(op, dict(attrs), wiring, out_refs,
+                                       src))
         self._sig_ops.append((op.name, akey, wiring, len(out_refs)))
         self.ops_recorded += 1
         return tuple(outs)
@@ -324,6 +335,23 @@ class CaptureContext:
                 _segment_needs_grad(in_tensors, in_vals, live_refs, in_meta):
             donate = _donatable_inputs(in_tensors, in_vals, live_refs)
 
+        # program sanitizer (paddle_tpu.analysis): one flag read when
+        # off; in warn/error mode the segment checkers run over the
+        # program about to execute (donation safety, in-place races,
+        # tracer leaks, shape/dtype drift). 'error' stops a corrupting
+        # launch — drop the trace like a failed compile would.
+        if flags.flag_value("FLAGS_static_checks") not in _CHECKS_OFF:
+            from ..analysis import hooks as _sanitizer
+            try:
+                _mode = _sanitizer.check_mode()   # full normalization
+                if _mode != "off":
+                    _sanitizer.on_segment_flush(
+                        self, pending, in_vals, in_meta, in_tensors,
+                        live, live_refs, donate, _mode)
+            except Exception:
+                self._reset_segment()
+                raise
+
         dispatch.bump_exec()
         try:
             runner = _SEG_CACHE.get((sig, donate))
@@ -359,6 +387,15 @@ class CaptureContext:
             grad_ts = [t for t in ts if not t.stop_gradient]
             out_tensors.append(grad_ts[0] if grad_ts
                                else (ts[0] if ts else None))
+
+        # FLAGS_check_nan_inf covers fused-segment outputs too (the
+        # per-op eager scan in dispatch.py never sees ops that were
+        # recorded before the flag flipped on, nor replayed segments):
+        # scan every live output, blaming its producing op
+        if flags.flag_value("FLAGS_check_nan_inf"):
+            for (j, _s), val in zip(live, out_vals):
+                dispatch._check_nan_inf(
+                    f"{pending[j].op.name} (lazy segment output)", (val,))
 
         self._register_grad(pending, live, live_refs, out_tensors,
                             in_tensors, in_vals, sig, in_meta)
@@ -596,7 +633,8 @@ def register_segment_grad(pending, live, live_refs, out_tensors,
             wir = tuple(None if w is None else
                         ("in", in_l[w[1]]) if w[0] == "in" else
                         ("op", op_l[w[1]], w[2]) for w in p.wiring)
-            local_pending.append(_PendingOp(p.op, p.attrs, wir, p.out_refs))
+            local_pending.append(_PendingOp(p.op, p.attrs, wir, p.out_refs,
+                                            getattr(p, "src", None)))
         comp_ks = [k for k, (j, _) in enumerate(live) if find(j) == r]
         k_l = {k: lk for lk, k in enumerate(comp_ks)}
         local_live = [(op_l[live[k][0]], live[k][1]) for k in comp_ks]
@@ -813,6 +851,12 @@ class ReplayableSegment:
             _SEG_CACHE[(self.sig, ())] = runner
         dispatch.bump_exec()
         out_vals = runner(*in_vals)
+        from . import flags
+        if flags.flag_value("FLAGS_check_nan_inf"):
+            for (j, _s), val in zip(self.live, out_vals):
+                dispatch._check_nan_inf(
+                    f"{self.pending[j].op.name} (replayed segment output)",
+                    (val,))
         outs = []
         for meta, val in zip(self.metas, out_vals):
             outs.append(Tensor(val, stop_gradient=not meta.requires_grad))
@@ -950,6 +994,23 @@ def try_fused_backward(tensors, grad_tensors) -> bool:
         return False
     grad_in = tuple(grad_in)
 
+    # the sanitizer covers the fused fwd+vjp path exactly like a plain
+    # flush — this IS the default steady-state train step, so 'error'
+    # mode must stop a corrupted program here too (no donation mask:
+    # fused-step inputs are the backward residuals)
+    from . import flags
+    if flags.flag_value("FLAGS_static_checks") not in _CHECKS_OFF:
+        from ..analysis import hooks as _sanitizer
+        try:
+            _mode = _sanitizer.check_mode()
+            if _mode != "off":
+                _sanitizer.on_segment_flush(
+                    ctx, pending, in_vals, in_meta, in_tensors,
+                    live, live_refs, (), _mode)
+        except Exception:
+            ctx._reset_segment()
+            raise
+
     sig = ctx._signature(in_vals, live)
     key = (sig, grad_in, root_k)
     runner = _FUSED_CACHE.get(key)
@@ -962,6 +1023,12 @@ def try_fused_backward(tensors, grad_tensors) -> bool:
     except Exception:
         ctx._reset_segment()
         raise
+
+    if flags.flag_value("FLAGS_check_nan_inf"):
+        for (j, _s), val in zip(live, out_vals):
+            dispatch._check_nan_inf(
+                f"{pending[j].op.name} (fused-step output)", (val,))
+        dispatch._check_nan_inf("fused-step gradients", tuple(grads))
     ctx._reset_segment()
     ctx.breaks.append("backward_fused")
     ctx.segments_run += 1

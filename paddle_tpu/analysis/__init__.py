@@ -1,0 +1,94 @@
+"""paddle_tpu.analysis — the program sanitizer.
+
+A static-analysis framework over the two program representations the
+framework produces:
+
+- lazy `CaptureContext` segments (`_PendingOp` dataflow, _core/lazy.py)
+- IR `Workspace` programs (ir/pass_base.py)
+
+Five checkers ship by default: donation safety, in-place race
+detection, tracer-leak detection, shape/dtype consistency, and
+effect/purity verification for IR passes. Three surfaces:
+
+- `FLAGS_static_checks` = off | warn | error, wired into
+  `CaptureContext.flush` and `PassManager.run`;
+- this module's `check_segment(ctx)` / `check_program(program)` API;
+- `python -m paddle_tpu.analysis` — traces the bench_suite models and
+  reports.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+from .diagnostics import (CheckReport, Diagnostic, StaticCheckError,
+                          StaticCheckWarning, SEVERITY_ERROR,
+                          SEVERITY_WARNING)
+from .segment_checks import (SegmentView, check_donation_safety,
+                             check_inplace_races,
+                             check_process_tracer_leaks,
+                             check_shape_dtype, check_tracer_leaks)
+from .program_checks import (check_pass_effects, check_program_shapes,
+                             impure_fingerprint)
+from . import hooks
+
+__all__ = [
+    "CheckReport", "Diagnostic", "StaticCheckError",
+    "StaticCheckWarning", "SegmentView", "check_segment",
+    "check_program", "check_process_tracer_leaks",
+]
+
+
+def check_segment(ctx_or_view, donate: Optional[Tuple[int, ...]] = None,
+                  process: bool = False) -> CheckReport:
+    """Run every segment checker over an open CaptureContext (or a
+    prebuilt SegmentView). Non-destructive: nothing is flushed or
+    mutated; the donation mask defaults to what flush() would compute.
+
+        with lazy_guard() as ctx:
+            ... record ops ...
+            report = paddle_tpu.analysis.check_segment(ctx)
+        assert report.ok, report.render()
+    """
+    if isinstance(ctx_or_view, SegmentView):
+        view = ctx_or_view
+    else:
+        view = SegmentView.from_context(ctx_or_view, donate=donate)
+    report = CheckReport(f"lazy segment ({len(view.pending)} ops)")
+    check_donation_safety(view, report)
+    check_inplace_races(view, report, strict=True)
+    check_tracer_leaks(view, report)
+    check_shape_dtype(view, report)
+    if process:
+        check_process_tracer_leaks(report)
+    return report
+
+
+def check_program(program_or_ws, protected: Sequence = ()) -> CheckReport:
+    """Run the program-level checkers over a static Program (a fresh
+    Workspace is derived) or an already-rewritten Workspace."""
+    from ..ir.pass_base import Workspace
+    ws = program_or_ws if isinstance(program_or_ws, Workspace) \
+        else Workspace(program_or_ws)
+    report = CheckReport(f"program ({len(ws.ops)} ops)")
+    check_program_shapes(ws, report)
+    # a standalone program has no before/after pass delta to verify,
+    # but a fingerprint asymmetry against its source Program means some
+    # caller-side rewrite already dropped effects
+    src = getattr(ws, "program", None)
+    if src is not None and src.ops is not ws.ops:
+        names_src = [n.op_name for n in src.ops
+                     if _is_impure(n.op_name)]
+        names_ws = [n.op_name for n in ws.ops
+                    if _is_impure(n.op_name)]
+        if names_src != names_ws:
+            report.add(
+                "pass_effects",
+                f"workspace impure ops {names_ws} diverged from the "
+                f"recorded program's {names_src}",
+                severity=SEVERITY_ERROR)
+    return report
+
+
+def _is_impure(name: str) -> bool:
+    from ..ir.pass_base import is_impure
+    return is_impure(name)

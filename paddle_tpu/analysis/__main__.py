@@ -1,0 +1,173 @@
+"""CLI: trace the bench_suite models and run the program sanitizer.
+
+    python -m paddle_tpu.analysis [--models lenet,resnet50,bert]
+                                  [--execute] [--verbose]
+
+Default is record-only: each model's forward(+loss) is RECORDED into a
+lazy capture window (aval inference, no XLA compile/run), the segment
+checkers sweep the pending program, and for the eager models a static
+Program is also recorded and swept through the default IR pass pipeline
+with the post-pass verify hook armed. `--execute` additionally flushes
+each segment end to end. Exit code 0 = no findings.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def _trace_eager(build_fn, name: str, execute: bool, verbose: bool):
+    """Record one train-shaped forward into a capture window and sweep
+    it. Returns the CheckReport."""
+    import paddle_tpu as paddle
+    from paddle_tpu import analysis
+    from paddle_tpu._core import lazy
+
+    with lazy.lazy_guard(max_segment_ops=1 << 30) as ctx:
+        build_fn()
+        report = analysis.check_segment(ctx, process=True)
+        n_ops = len(ctx.pending)
+        if execute:
+            ctx.flush("cli")
+        else:
+            ctx._reset_segment()
+    print(f"[{name}] eager segment: {n_ops} ops recorded, "
+          f"{len(report.diagnostics)} finding(s)"
+          + (" (executed)" if execute else ""))
+    if verbose or not report.ok:
+        for d in report.diagnostics:
+            print("   ", d.render())
+    return report
+
+
+def _trace_static(build_fn, feeds, name: str, verbose: bool):
+    """Record a static Program, run the default pass pipeline with the
+    verify hook armed, and sweep the result."""
+    from paddle_tpu import analysis, static
+    from paddle_tpu.ir import Workspace, default_pass_manager
+
+    prog = static.Program()
+    static.enable_static()
+    try:
+        with static.program_guard(prog):
+            vars_ = {n: static.data(n, shape, dtype)
+                     for n, (shape, dtype) in feeds.items()}
+            outs = build_fn(vars_)
+    finally:
+        static.disable_static()
+    ws = Workspace(prog)
+    outs = outs if isinstance(outs, (list, tuple)) else [outs]
+    default_pass_manager().run(ws, protected=list(outs))
+    report = analysis.check_program(ws)
+    print(f"[{name}] static program: {len(prog.ops)} ops recorded, "
+          f"{len(ws.ops)} after passes, "
+          f"{len(report.diagnostics)} finding(s)")
+    if verbose or not report.ok:
+        for d in report.diagnostics:
+            print("   ", d.render())
+    return report
+
+
+def run_lenet(execute: bool, verbose: bool):
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.vision.models import LeNet
+
+    paddle.seed(0)
+    model = LeNet()
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(8, 1, 28, 28).astype("float32"))
+    y = paddle.to_tensor(
+        np.random.RandomState(0).randint(0, 10, (8,)).astype("int64"))
+
+    reports = [_trace_eager(
+        lambda: F.cross_entropy(model(x), y),
+        "lenet", execute, verbose)]
+
+    def build(v):
+        h = v["x"] * 2.0 + 1.0
+        return F.relu(h).sum()
+
+    reports.append(_trace_static(
+        build, {"x": ([8, 16], "float32")}, "lenet-static", verbose))
+    return reports
+
+
+def run_resnet50(execute: bool, verbose: bool):
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.vision.models import resnet50
+
+    paddle.seed(0)
+    model = resnet50()
+    model.eval()      # frozen running stats: a pure recordable forward
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(2, 3, 64, 64).astype("float32"))
+    return [_trace_eager(lambda: model(x).mean(), "resnet50", execute,
+                         verbose)]
+
+
+def run_bert(execute: bool, verbose: bool):
+    """bench_suite row 3 builds a pure-jax compiled trainer
+    (models/bert.py) — there is no framework-level program to lint, so
+    the sweep covers the process-wide tracer caches after building the
+    step, plus an eager proxy of the attention arithmetic."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import analysis
+    from paddle_tpu.models.bert import BERT_CONFIGS, build_train_step
+
+    cfg = BERT_CONFIGS["bert-base"]
+    build_train_step(cfg, mesh=None, lr=1e-4)   # compile-time tracing
+    report = analysis.CheckReport("bert trainer (process caches)")
+    analysis.check_process_tracer_leaks(report)
+    print(f"[bert] jax-level trainer: no framework segments; process "
+          f"tracer sweep: {len(report.diagnostics)} finding(s)")
+    for d in report.diagnostics:
+        print("   ", d.render())
+
+    def attn_proxy():
+        q = paddle.to_tensor(
+            np.random.RandomState(0).randn(2, 4, 16).astype("float32"))
+        s = paddle.matmul(q, q.transpose([0, 2, 1])) * (1.0 / 4.0)
+        return paddle.nn.functional.softmax(s, axis=-1).sum()
+
+    return [report,
+            _trace_eager(attn_proxy, "bert-attn-proxy", execute, verbose)]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m paddle_tpu.analysis")
+    ap.add_argument("--models", default="lenet,resnet50,bert",
+                    help="comma list: lenet,resnet50,bert")
+    ap.add_argument("--execute", action="store_true",
+                    help="also flush/execute each recorded segment")
+    ap.add_argument("--verbose", action="store_true",
+                    help="print every diagnostic, not just findings")
+    args = ap.parse_args(argv)
+
+    import paddle_tpu as paddle
+    # provenance is captured at record time only when checks are on
+    paddle.set_flags({"FLAGS_static_checks": "warn"})
+
+    table = {"lenet": run_lenet, "resnet50": run_resnet50,
+             "bert": run_bert}
+    reports = []
+    for m in args.models.split(","):
+        m = m.strip()
+        if not m:
+            continue
+        if m not in table:
+            print(f"unknown model '{m}' (have: {sorted(table)})")
+            return 2
+        reports.extend(table[m](args.execute, args.verbose))
+
+    findings = sum(len(r.diagnostics) for r in reports)
+    print(f"== static analysis: {findings} finding(s) across "
+          f"{len(reports)} program(s)")
+    return 0 if findings == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,112 @@
+"""Structured diagnostics for the program sanitizer.
+
+Every checker reports through a `CheckReport` of `Diagnostic`s carrying
+(checker, severity, op index, op name, Python source provenance captured
+at record time, message, fix hint) — the static-analysis analog of the
+reference's enforce-style error payloads (paddle/common/enforce.h), but
+machine-readable so `error` mode can raise with the full finding set and
+tests can assert exact diagnostics.
+"""
+from __future__ import annotations
+
+import warnings
+from typing import List, Optional
+
+SEVERITY_ERROR = "error"
+SEVERITY_WARNING = "warning"
+
+
+class StaticCheckWarning(UserWarning):
+    """Emitted in FLAGS_static_checks=warn mode; one per CheckReport."""
+
+
+class StaticCheckError(RuntimeError):
+    """Raised in FLAGS_static_checks=error mode. `.report` holds the
+    structured findings."""
+
+    def __init__(self, report: "CheckReport"):
+        self.report = report
+        super().__init__(report.render())
+
+
+class Diagnostic:
+    __slots__ = ("checker", "severity", "message", "op_index", "op_name",
+                 "provenance", "hint")
+
+    def __init__(self, checker: str, message: str,
+                 severity: str = SEVERITY_ERROR,
+                 op_index: Optional[int] = None,
+                 op_name: Optional[str] = None,
+                 provenance: Optional[str] = None,
+                 hint: Optional[str] = None):
+        self.checker = checker
+        self.severity = severity
+        self.message = message
+        self.op_index = op_index
+        self.op_name = op_name
+        self.provenance = provenance
+        self.hint = hint
+
+    def render(self) -> str:
+        where = ""
+        if self.op_index is not None or self.op_name is not None:
+            idx = "?" if self.op_index is None else str(self.op_index)
+            where = f" [op #{idx}" + (
+                f" {self.op_name}]" if self.op_name else "]")
+        src = f" (recorded at {self.provenance})" if self.provenance else ""
+        hint = f"\n      hint: {self.hint}" if self.hint else ""
+        return (f"{self.severity}: {self.checker}:{where} "
+                f"{self.message}{src}{hint}")
+
+    def __repr__(self):
+        return f"Diagnostic<{self.render()}>"
+
+
+class CheckReport:
+    """Findings of one sanitizer run over one program/segment."""
+
+    def __init__(self, subject: str = ""):
+        self.subject = subject
+        self.diagnostics: List[Diagnostic] = []
+
+    def add(self, checker: str, message: str, **kw) -> Diagnostic:
+        d = Diagnostic(checker, message, **kw)
+        self.diagnostics.append(d)
+        return d
+
+    def extend(self, other: "CheckReport"):
+        self.diagnostics.extend(other.diagnostics)
+        return self
+
+    def by_checker(self, checker: str) -> List[Diagnostic]:
+        return [d for d in self.diagnostics if d.checker == checker]
+
+    @property
+    def ok(self) -> bool:
+        return not self.diagnostics
+
+    @property
+    def errors(self) -> List[Diagnostic]:
+        return [d for d in self.diagnostics
+                if d.severity == SEVERITY_ERROR]
+
+    def render(self) -> str:
+        head = (f"static checks: {len(self.diagnostics)} finding(s)"
+                + (f" in {self.subject}" if self.subject else ""))
+        return "\n".join([head] + ["  " + d.render()
+                                   for d in self.diagnostics])
+
+    def emit(self, mode: str, stacklevel: int = 3):
+        """Surface the findings per FLAGS_static_checks semantics:
+        'error' raises when any error-severity finding exists (warnings
+        still warn); 'warn' warns; 'off' is a no-op."""
+        if not self.diagnostics or mode == "off":
+            return
+        if mode == "error" and self.errors:
+            raise StaticCheckError(self)
+        warnings.warn(self.render(), StaticCheckWarning,
+                      stacklevel=stacklevel)
+
+    def __repr__(self):
+        return (f"CheckReport({self.subject!r}, "
+                f"{len(self.diagnostics)} diagnostics)")

@@ -1,0 +1,212 @@
+"""Static checkers over IR `Workspace` programs (ir/pass_base.py).
+
+Two checker families:
+
+- shape/dtype consistency: re-derive output avals op by op along the
+  (possibly rewritten) dataflow and flag drift the rewrite patterns
+  (AMP, layout, fused-scale) introduced. Dtype changes that merely
+  PROPAGATE from upstream rewrites (an AMP cast flowing through a
+  matmul) are consistent and not flagged; an op whose inputs are
+  untouched but whose declared outputs disagree with what it derives is
+  a broken rewrite.
+- effect/purity verification: DCE/CSE/const-fold must never drop or
+  reorder impure ops. PassManager snapshots the impure-op fingerprint
+  before each pass and verifies it after (the post-pass verify hook).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import jax
+import numpy as np
+
+from .diagnostics import SEVERITY_ERROR, CheckReport
+
+CHECKER_SHAPE = "shape_dtype"
+CHECKER_EFFECTS = "pass_effects"
+
+
+# --------------------------------------------------- shape/dtype checks
+
+def _declared_aval(var):
+    shape = tuple(1 if d in (None, -1) else d for d in var.var_shape)
+    return jax.ShapeDtypeStruct(shape, var.var_dtype)
+
+
+def _shapes_compatible(declared, got) -> bool:
+    """Declared dims of None/-1 are dynamic wildcards (the static.data
+    substitution maps them to 1 for eval_shape)."""
+    if len(declared) != len(got):
+        return False
+    return all(d in (None, -1) or d == g for d, g in zip(declared, got))
+
+
+def check_program_shapes(ws, report: CheckReport):
+    from ..static import Variable
+
+    derived: Dict[int, Any] = {}
+
+    def input_aval(t):
+        # concrete constants pass through AS VALUES (not
+        # ShapeDtypeStructs): weak_type must survive or python-scalar
+        # promotion derives the wrong dtype (the _record_op contract)
+        if t is None:
+            return None
+        if isinstance(t, Variable):
+            t = ws.resolve(t)
+        if isinstance(t, Variable):
+            const = ws.const_env.get(id(t))
+            if const is not None:
+                return const
+            return derived.get(id(t), _declared_aval(t))
+        return t._value if hasattr(t, "_value") else t
+
+    # record-time input lists, keyed by output-variable identity (the
+    # Workspace shallow-copy shares Variable objects with the source
+    # Program): a node whose CURRENT inputs still match its recorded
+    # ones was never rewritten, so any dtype drift it derives is its
+    # own corruption — while a node whose inputs a pass replaced (AMP
+    # casts, const injection) legitimately shifts dtype downstream
+    src_inputs: Dict[int, Any] = {}
+    prog = getattr(ws, "program", None)
+    if prog is not None:
+        for n in getattr(prog, "ops", ()):
+            for o in n.outputs:
+                src_inputs[id(o)] = n.inputs
+
+    def inputs_unchanged(node) -> bool:
+        orig = None
+        for o in node.outputs:
+            orig = src_inputs.get(id(o))
+            if orig is not None:
+                break
+        if orig is None:
+            # pass-created node (layout transposes): its declarations
+            # were authored by the rewrite itself
+            return False
+        return len(orig) == len(node.inputs) and \
+            all(a is b for a, b in zip(orig, node.inputs))
+
+    def any_input_drifted(node) -> bool:
+        # an input Variable whose DERIVED dtype disagrees with its
+        # declaration carries upstream drift (an AMP cast several ops
+        # back) — dtype drift here is propagation, not this node's own
+        # corruption
+        for t in node.inputs:
+            if isinstance(t, Variable):
+                rt = ws.resolve(t)
+                if isinstance(rt, Variable):
+                    got = derived.get(id(rt))
+                    if got is not None and \
+                            np.dtype(got.dtype) != np.dtype(rt.var_dtype):
+                        return True
+        return False
+
+    from .._core.op_registry import get_op
+    backend = jax.default_backend()
+    for idx, node in enumerate(ws.ops):
+        try:
+            op = get_op(node.op_name)
+        except Exception:
+            continue   # synthetic test node: nothing to derive
+        in_avals = [input_aval(t) for t in node.inputs]
+        fields = {"op_index": idx, "op_name": node.op_name,
+                  "provenance": getattr(node, "src", None)}
+        try:
+            fn = op.kernel_for(backend)
+            out = jax.eval_shape(lambda *xs: fn(*xs, **node.attrs),
+                                 *in_avals)
+        except Exception as e:
+            report.add(
+                CHECKER_SHAPE,
+                f"not executable with the rewritten input avals: "
+                f"{type(e).__name__}: {e}",
+                severity=SEVERITY_ERROR,
+                hint="a pass produced inputs this kernel cannot take",
+                **fields)
+            continue
+        leaves = jax.tree_util.tree_leaves(
+            out if op.multi_output else (out,))
+        if len(leaves) != len(node.outputs):
+            report.add(
+                CHECKER_SHAPE,
+                f"derives {len(leaves)} outputs but the node declares "
+                f"{len(node.outputs)}",
+                severity=SEVERITY_ERROR, **fields)
+            continue
+        node_untouched = inputs_unchanged(node)
+        for s, (var, got) in enumerate(zip(node.outputs, leaves)):
+            if not isinstance(var, Variable):
+                continue
+            if not _shapes_compatible(tuple(var.var_shape),
+                                      tuple(got.shape)):
+                report.add(
+                    CHECKER_SHAPE,
+                    f"output {s} ('{var.name}') shape drifted: "
+                    f"declared {tuple(var.var_shape)}, derives "
+                    f"{tuple(got.shape)}",
+                    severity=SEVERITY_ERROR,
+                    hint="rewrites must preserve declared shapes "
+                         "(fetch metadata and downstream InferMeta "
+                         "both read them)",
+                    **fields)
+            elif np.dtype(got.dtype) != np.dtype(var.var_dtype) \
+                    and node_untouched and not any_input_drifted(node):
+                # the op ITSELF changed dtype semantics (corrupted
+                # attrs), not a propagated AMP/layout cast
+                report.add(
+                    CHECKER_SHAPE,
+                    f"output {s} ('{var.name}') dtype drifted with "
+                    f"unrewritten inputs: declared "
+                    f"{np.dtype(var.var_dtype)}, derives "
+                    f"{np.dtype(got.dtype)}",
+                    severity=SEVERITY_ERROR,
+                    hint="only an input rewrite (AMP cast) may shift "
+                         "an op's output dtype",
+                    **fields)
+            derived[id(var)] = got
+
+
+# ----------------------------------------------------- effect / purity
+
+def impure_fingerprint(ws) -> List[Tuple[Any, str]]:
+    """Node+name sequence of the impure ops — the part of the program
+    passes must preserve verbatim (no drops, no reorders). Holds the
+    node OBJECTS (not bare ids): the fingerprint keeps a dropped node
+    alive, so a pass allocating fresh nodes can never reuse its id and
+    mask the drop."""
+    from ..ir.pass_base import is_impure
+    return [(n, n.op_name) for n in ws.ops if is_impure(n.op_name)]
+
+
+def check_pass_effects(ws, pass_name: str,
+                       before: List[Tuple[Any, str]],
+                       report: CheckReport):
+    after = impure_fingerprint(ws)
+    after_ids = {id(n) for n, _ in after}
+    dropped = [(n, name) for n, name in before
+               if id(n) not in after_ids]
+    for _, name in dropped:
+        report.add(
+            CHECKER_EFFECTS,
+            f"pass '{pass_name}' dropped impure op '{name}': results "
+            f"of non-pure ops (rng, dropout, print, assign_out) are "
+            f"not functions of their inputs and must survive every "
+            f"rewrite",
+            severity=SEVERITY_ERROR, op_name=name,
+            hint="passes must skip _is_impure ops (DCE keeps them "
+                 "live, CSE/const-fold must not touch them)")
+    if not dropped:
+        before_ids = {id(n) for n, _ in before}
+        kept_before = [e for e in before if id(e[0]) in after_ids]
+        surviving = [e for e in after if id(e[0]) in before_ids]
+        if [id(n) for n, _ in kept_before] != \
+                [id(n) for n, _ in surviving]:
+            report.add(
+                CHECKER_EFFECTS,
+                f"pass '{pass_name}' reordered impure ops: "
+                f"{[n for _, n in kept_before]} -> "
+                f"{[n for _, n in surviving]}",
+                severity=SEVERITY_ERROR,
+                hint="side-effect order is program semantics; rewrites "
+                     "may move pure ops only")

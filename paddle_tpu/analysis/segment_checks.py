@@ -1,0 +1,333 @@
+"""Static checkers over lazy `CaptureContext` segments (_PendingOp
+dataflow, _core/lazy.py).
+
+Each checker re-derives an invariant the runtime relies on and reports
+violations as structured diagnostics. They run at flush time under
+FLAGS_static_checks (hooks.py) and programmatically via
+`paddle_tpu.analysis.check_segment`.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from .diagnostics import SEVERITY_ERROR, SEVERITY_WARNING, CheckReport
+
+CHECKER_DONATION = "donation_safety"
+CHECKER_INPLACE = "inplace_race"
+CHECKER_TRACER = "tracer_leak"
+CHECKER_SHAPE = "shape_dtype"
+
+
+class SegmentView:
+    """Immutable snapshot of one pending/flushing segment — everything
+    the checkers need, decoupled from CaptureContext internals so seeded
+    violations can be constructed directly in tests."""
+
+    __slots__ = ("pending", "in_vals", "in_tensors", "in_meta", "in_ids",
+                 "live", "live_refs", "donate", "needs_grad")
+
+    def __init__(self, pending, in_vals, in_tensors, in_meta, in_ids,
+                 live, live_refs, donate=(), needs_grad=False):
+        self.pending = pending
+        self.in_vals = in_vals
+        self.in_tensors = in_tensors      # resolved; None = died
+        self.in_meta = in_meta            # (req, meta, version) per input
+        self.in_ids = in_ids              # id(tensor) -> input index
+        self.live = live                  # [(op_idx, slot)]
+        self.live_refs = live_refs
+        self.donate = tuple(donate)
+        self.needs_grad = needs_grad
+
+    @classmethod
+    def from_context(cls, ctx, donate: Optional[Tuple[int, ...]] = None):
+        """Snapshot an open CaptureContext exactly the way flush() sees
+        it (including the donation mask it would compute)."""
+        from .._core import lazy
+        pending = list(ctx.pending)
+        in_vals = list(ctx._in_vals)
+        in_meta = list(ctx._in_meta)
+        in_tensors = [r() for r in ctx._in_tensors]
+        live, live_refs = ctx._live_outputs(pending)
+        needs_grad = lazy._segment_needs_grad(in_tensors, in_vals,
+                                              live_refs, in_meta)
+        if donate is None:
+            donate = ()
+            from .._core import flags
+            if flags.flag_value("FLAGS_lazy_donate_inputs") \
+                    and not needs_grad:
+                donate = lazy._donatable_inputs(in_tensors, in_vals,
+                                                live_refs)
+        return cls(pending, in_vals, in_tensors, in_meta,
+                   dict(ctx._in_ids), live, live_refs, donate, needs_grad)
+
+    # ------------------------------------------------------------ helpers
+    def op_diag_fields(self, j: int) -> Dict:
+        p = self.pending[j]
+        return {"op_index": j, "op_name": p.op.name,
+                "provenance": getattr(p, "src", None)}
+
+    def readers_of_input(self, i: int) -> List[int]:
+        return [j for j, p in enumerate(self.pending)
+                if any(w is not None and w[0] == "in" and w[1] == i
+                       for w in p.wiring)]
+
+
+# ------------------------------------------------------- donation safety
+
+def check_donation_safety(view: SegmentView, report: CheckReport):
+    """No donated input may be (a) still aliased by a live tensor while
+    an op in the segment reads it — the buffer would be clobbered under
+    a later host-side read, (b) registered more than once — a second
+    input slot reads the freed buffer, (c) donated twice (two donated
+    slots sharing one payload), or (d) donated while the segment
+    registers a GradNode — the inputs are the backward residuals."""
+    counts: Dict[int, int] = {}
+    for v in view.in_vals:
+        counts[id(v)] = counts.get(id(v), 0) + 1
+
+    if view.donate and view.needs_grad:
+        report.add(
+            CHECKER_DONATION,
+            f"inputs {sorted(view.donate)} donated while the segment "
+            f"registers a GradNode: the input buffers are saved as "
+            f"backward residuals and must outlive the flush",
+            severity=SEVERITY_ERROR,
+            hint="suppress donation when _segment_needs_grad() holds "
+                 "(the flush path's own guard)")
+
+    donated_payloads: Dict[int, int] = {}
+    for i in view.donate:
+        if i >= len(view.in_vals):
+            report.add(CHECKER_DONATION,
+                       f"donation index {i} out of range "
+                       f"({len(view.in_vals)} inputs)",
+                       severity=SEVERITY_ERROR)
+            continue
+        v = view.in_vals[i]
+        t = view.in_tensors[i]
+
+        prev = donated_payloads.get(id(v))
+        if prev is not None:
+            report.add(
+                CHECKER_DONATION,
+                f"inputs {prev} and {i} donate the same buffer twice "
+                f"(one payload registered under two donated slots)",
+                severity=SEVERITY_ERROR,
+                hint="donate a buffer at most once per executable "
+                     "(jax donate_argnums frees it after the first use)")
+        donated_payloads[id(v)] = i
+
+        if t is not None and t._payload is v:
+            readers = view.readers_of_input(i)
+            j = readers[-1] if readers else None
+            fields = view.op_diag_fields(j) if j is not None else {}
+            report.add(
+                CHECKER_DONATION,
+                f"input {i} donated but still aliased by a live tensor"
+                + (f" and read by op #{j}" if j is not None else "")
+                + ": the alias reads a freed buffer after the flush",
+                severity=SEVERITY_ERROR,
+                hint="only donate inputs whose backing tensor died or "
+                     "was overwritten (t._payload is not the snapshot)",
+                **fields)
+
+        if counts.get(id(v), 0) > 1:
+            report.add(
+                CHECKER_DONATION,
+                f"input {i} donated but its payload is registered "
+                f"{counts[id(v)]} times in this segment: the other "
+                f"slots read a freed buffer",
+                severity=SEVERITY_ERROR,
+                hint="skip donation for multiply-registered values")
+
+        if getattr(v, "weak_type", False):
+            report.add(
+                CHECKER_DONATION,
+                f"input {i} donated but weak-typed: weak arrays are the "
+                f"shared python-scalar coercion cache and must never be "
+                f"donated",
+                severity=SEVERITY_ERROR,
+                hint="executor._SCALAR_CACHE entries are shared across "
+                     "all later dispatches")
+
+
+# ------------------------------------------------------- in-place races
+
+def check_inplace_races(view: SegmentView, report: CheckReport,
+                        strict: bool = True):
+    """A tensor registered as a segment input whose `_inplace_version`
+    was bumped between record and flush MUST have notified the capture
+    window (note_inplace evicts its id mapping). A still-intact mapping
+    with a changed version means future records would silently read the
+    stale snapshot — the bug class `_replace_value_inplace` exists to
+    prevent.
+
+    `strict` additionally flags payload swaps without a version bump
+    (direct `t._value = x` writes mid-window). The flush hook runs
+    non-strict: a version-less swap on a tensor no future op touches is
+    harmless, and several cold paths (state loading) do it on purpose.
+    """
+    for i, t in enumerate(view.in_tensors):
+        if t is None:
+            continue
+        idx = view.in_ids.get(id(t))
+        if idx != i:
+            # mapping evicted (note_inplace ran) or re-registered at a
+            # fresh slot: the context saw the mutation
+            continue
+        _, _, rec_version = view.in_meta[i]
+        if t._inplace_version != rec_version:
+            readers = view.readers_of_input(i)
+            fields = (view.op_diag_fields(readers[-1])
+                      if readers else {})
+            report.add(
+                CHECKER_INPLACE,
+                f"input {i} mutated in place (version "
+                f"{rec_version} -> {t._inplace_version}) inside the "
+                f"capture window without note_inplace: records after "
+                f"the mutation would reuse the stale snapshot",
+                severity=SEVERITY_ERROR,
+                hint="route the mutation through Tensor.set_value/"
+                     "copy_/_replace_value_inplace so every open "
+                     "capture context is notified",
+                **fields)
+        elif strict and t._payload is not view.in_vals[i]:
+            report.add(
+                CHECKER_INPLACE,
+                f"input {i} payload swapped mid-window without a "
+                f"version bump or note_inplace (direct _value write)",
+                severity=SEVERITY_WARNING,
+                hint="use _replace_value_inplace for in-place payload "
+                     "swaps")
+
+
+# --------------------------------------------------------- tracer leaks
+
+def _is_tracer(x) -> bool:
+    return isinstance(x, jax.core.Tracer)
+
+
+def check_tracer_leaks(view: SegmentView, report: CheckReport):
+    """No jax tracer may be captured by a segment: a tracer input or a
+    tracer buried in an op's attrs outlives its trace and poisons every
+    replay of the cached executable (the PR-1 UnexpectedTracerError
+    class, generalized)."""
+    for i, v in enumerate(view.in_vals):
+        if _is_tracer(v):
+            readers = view.readers_of_input(i)
+            fields = (view.op_diag_fields(readers[0]) if readers else {})
+            report.add(
+                CHECKER_TRACER,
+                f"input {i} is a jax tracer ({type(v).__name__}): "
+                f"flushing after its trace exits replays a dead tracer",
+                severity=SEVERITY_ERROR,
+                hint="ops under an enclosing jax trace must bypass the "
+                     "fusion window (executor.apply tracer check)",
+                **fields)
+    for j, p in enumerate(view.pending):
+        leaked = [k for k, leaf in _attr_leaves(p.attrs) if
+                  _is_tracer(leaf)]
+        if leaked:
+            report.add(
+                CHECKER_TRACER,
+                f"attrs {sorted(set(leaked))} hold jax tracers: the "
+                f"cached executable would close over a dead trace",
+                severity=SEVERITY_ERROR,
+                hint="materialize attr values before record, or bypass "
+                     "the window under an active trace",
+                **view.op_diag_fields(j))
+
+
+def _attr_leaves(attrs):
+    out = []
+    for k, v in attrs.items():
+        for leaf in jax.tree_util.tree_leaves(v):
+            out.append((k, leaf))
+    return out
+
+
+def check_process_tracer_leaks(report: CheckReport):
+    """Process-wide sweep of the caches a tracer could hide in between
+    flushes: the python-scalar coercion cache and the aval cache keys.
+    Not run per-flush (O(cache size)); the CLI and check_segment(...,
+    process=True) use it."""
+    from .._core import executor
+    for key, v in list(executor._SCALAR_CACHE.items()):
+        if _is_tracer(v):
+            report.add(
+                CHECKER_TRACER,
+                f"python-scalar coercion cache holds a tracer for key "
+                f"{key!r}: every later dispatch of this scalar replays "
+                f"a dead trace",
+                severity=SEVERITY_ERROR,
+                hint="_coerce must never memoize tracers (it checks "
+                     "isinstance(v, jax.core.Tracer))")
+
+
+# --------------------------------------------------- shape/dtype checks
+
+def check_shape_dtype(view: SegmentView, report: CheckReport):
+    """Re-derive every op's output avals along the recorded dataflow and
+    compare with the avals the segment promised its aliasing tensors.
+    A mismatch means a post-record rewrite (or a buggy kernel variant)
+    changed the program behind the metadata's back — the executable
+    would produce values whose shape/dtype no longer match what
+    shape/dtype reads answered from."""
+    from .._core import lazy
+
+    def in_aval(w):
+        if w is None:
+            return None
+        if w[0] == "in":
+            v = view.in_vals[w[1]]
+            return lazy._aval_of(v)
+        return view.pending[w[1]].out_refs[w[2]].aval
+
+    for j, p in enumerate(view.pending):
+        in_avals = [in_aval(w) for w in p.wiring]
+        try:
+            derived = lazy._out_avals(p.op, p.attrs, in_avals)
+        except Exception as e:
+            report.add(
+                CHECKER_SHAPE,
+                f"output avals no longer derivable from the recorded "
+                f"inputs/attrs: {type(e).__name__}: {e}",
+                severity=SEVERITY_ERROR,
+                hint="a rewrite changed attrs/wiring into something "
+                     "the kernel cannot infer shapes for",
+                **view.op_diag_fields(j))
+            continue
+        if len(derived) != len(p.out_refs):
+            report.add(
+                CHECKER_SHAPE,
+                f"op derives {len(derived)} outputs but the segment "
+                f"recorded {len(p.out_refs)}",
+                severity=SEVERITY_ERROR,
+                **view.op_diag_fields(j))
+            continue
+        for s, (got, ref) in enumerate(zip(derived, p.out_refs)):
+            want = ref.aval
+            if tuple(got.shape) != tuple(want.shape):
+                report.add(
+                    CHECKER_SHAPE,
+                    f"output {s} shape drifted: recorded "
+                    f"{tuple(want.shape)}, derives {tuple(got.shape)}",
+                    severity=SEVERITY_ERROR,
+                    hint="metadata reads (Tensor.shape) answered from "
+                         "the recorded aval; the executable disagrees",
+                    **view.op_diag_fields(j))
+            elif np.dtype(got.dtype) != np.dtype(want.dtype):
+                report.add(
+                    CHECKER_SHAPE,
+                    f"output {s} dtype drifted: recorded "
+                    f"{np.dtype(want.dtype)}, derives "
+                    f"{np.dtype(got.dtype)}",
+                    severity=SEVERITY_ERROR,
+                    **view.op_diag_fields(j))
+
+
+SEGMENT_CHECKERS = (check_donation_safety, check_inplace_races,
+                    check_tracer_leaks, check_shape_dtype)

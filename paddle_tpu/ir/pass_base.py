@@ -4,6 +4,18 @@ from __future__ import annotations
 import time
 from typing import Any, Dict, List, Optional, Sequence
 
+# ops whose results are not pure functions of their inputs — never fold,
+# dedupe, reorder, or drop across these (pir marks these via op traits).
+# Lives here (not passes.py) so the analysis-layer purity verifier and
+# the stock passes share one definition.
+IMPURE_MARKERS = ("rand", "dropout", "uniform", "normal", "bernoulli",
+                  "poisson", "multinomial", "exponential", "seed",
+                  "print", "assign_out", "share_data")
+
+
+def is_impure(op_name: str) -> bool:
+    return any(m in op_name for m in IMPURE_MARKERS)
+
 
 class Workspace:
     """A transformed compilation view of a recorded Program.
@@ -88,24 +100,42 @@ class PassManager:
 
     def run(self, ws: Workspace,
             protected: Sequence = ()) -> bool:
-        from .._core.flags import flag_value
+        from .._core.flags import STATIC_CHECKS_OFF, flag_value
         disabled = {n.strip()
                     for n in flag_value("FLAGS_ir_pass_disable").split(",")
                     if n.strip()}
         prot = frozenset(id(v) for v in protected)
+        # program sanitizer post-pass verify hook (paddle_tpu.analysis):
+        # with FLAGS_static_checks on, every pass is checked for dropped
+        # or reordered impure ops right after it runs, and the rewritten
+        # workspace gets a shape/dtype consistency sweep at the end
+        sanitizer = None
+        mode = "off"
+        if flag_value("FLAGS_static_checks") not in STATIC_CHECKS_OFF \
+                and ws is not None:
+            from ..analysis import hooks as sanitizer
+            mode = sanitizer.check_mode()
+            if mode == "off":
+                sanitizer = None
         changed_any = False
         for _ in range(self.max_iters if self.iterate_to_fixpoint else 1):
             round_changed = False
             for p in self.passes:
                 if p.name in disabled:
                     continue
+                before = sanitizer.pre_pass_fingerprint(ws) \
+                    if sanitizer else None
                 t0 = time.perf_counter()
                 changed = bool(p.run(ws, prot))
                 self.stats.append({
                     "pass": p.name, "changed": changed,
                     "ms": (time.perf_counter() - t0) * 1e3})
+                if sanitizer is not None:
+                    sanitizer.verify_pass(ws, p.name, before, mode)
                 round_changed |= changed
             changed_any |= round_changed
             if not round_changed:
                 break
+        if sanitizer is not None and changed_any:
+            sanitizer.verify_pipeline(ws, mode)
         return changed_any

@@ -7,21 +7,15 @@ import jax
 import jax.numpy as jnp
 
 from .._core.op_registry import get_op
-from .pass_base import Pass, Workspace
+from .pass_base import Pass, Workspace, is_impure
 from .pattern_rewrite import PatternRewriter, RewritePattern
 
 # FLAGS_apply_ir_passes is defined with the core flags
 # (_core/flags.py) so static mode works without importing this module.
 
-# ops whose results are not pure functions of their inputs — never fold,
-# dedupe, or reorder across these (pir marks these via op traits)
-_IMPURE_MARKERS = ("rand", "dropout", "uniform", "normal", "bernoulli",
-                   "poisson", "multinomial", "exponential", "seed",
-                   "print", "assign_out", "share_data")
-
-
-def _is_impure(op_name: str) -> bool:
-    return any(m in op_name for m in _IMPURE_MARKERS)
+# impure-op predicate shared with the analysis-layer purity verifier
+# (definition lives in pass_base.IMPURE_MARKERS)
+_is_impure = is_impure
 
 
 def _value_of_const(ws: Workspace, t) -> Any:

@@ -29,10 +29,19 @@ import dis
 import functools
 import inspect
 import operator
+import sys
 import types
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
+
+# The interpreter speaks two bytecode dialects: CPython 3.12 (the
+# primary target: CALL/KW_NAMES/BINARY_OP/LOAD_ATTR-with-bit) and
+# CPython 3.10 (CALL_FUNCTION*/LOAD_METHOD/per-op BINARY_*/ROT_*).
+# Version gates below pick per-opcode semantics; unknown dialects fall
+# back via prescan's unsupported-opcode rejection.
+_PY311 = sys.version_info >= (3, 11)
+_PY312 = sys.version_info >= (3, 12)
 
 from ..._core import lazy
 from ..._core.tensor import Tensor
@@ -112,9 +121,37 @@ _SUPPORTED = {
     "CALL", "KW_NAMES", "CALL_FUNCTION_EX", "MAKE_FUNCTION",
     "IMPORT_NAME", "IMPORT_FROM", "RAISE_VARARGS",
     "LOAD_ASSERTION_ERROR",
+    # --- CPython 3.10 dialect (absent from 3.12 code objects)
+    "DUP_TOP", "DUP_TOP_TWO", "ROT_TWO", "ROT_THREE", "ROT_FOUR",
+    "ROT_N", "UNARY_POSITIVE", "JUMP_ABSOLUTE",
+    "JUMP_IF_TRUE_OR_POP", "JUMP_IF_FALSE_OR_POP",
+    "CALL_FUNCTION", "CALL_FUNCTION_KW", "CALL_METHOD", "LOAD_METHOD",
+    "LIST_TO_TUPLE", "LOAD_CLOSURE",
     # NOT supported (prescan must reject BEFORE any side effect runs):
-    # LOAD_SUPER_ATTR, LOAD_BUILD_CLASS, exception handling, generators
+    # LOAD_SUPER_ATTR, LOAD_BUILD_CLASS, exception handling
+    # (SETUP_FINALLY on 3.10), generators
 }
+
+# py3.10 spells each binary operator as its own opcode (3.11 collapsed
+# them into BINARY_OP + an _nb_ops index)
+_BIN_OPS: Dict[str, Any] = {}
+for _n, _f, _inf in [
+        ("ADD", operator.add, operator.iadd),
+        ("SUBTRACT", operator.sub, operator.isub),
+        ("MULTIPLY", operator.mul, operator.imul),
+        ("TRUE_DIVIDE", operator.truediv, operator.itruediv),
+        ("FLOOR_DIVIDE", operator.floordiv, operator.ifloordiv),
+        ("MODULO", operator.mod, operator.imod),
+        ("POWER", operator.pow, operator.ipow),
+        ("MATRIX_MULTIPLY", operator.matmul, operator.imatmul),
+        ("LSHIFT", operator.lshift, operator.ilshift),
+        ("RSHIFT", operator.rshift, operator.irshift),
+        ("AND", operator.and_, operator.iand),
+        ("OR", operator.or_, operator.ior),
+        ("XOR", operator.xor, operator.ixor)]:
+    _BIN_OPS["BINARY_" + _n] = _f
+    _BIN_OPS["INPLACE_" + _n] = _inf
+_SUPPORTED.update(_BIN_OPS)
 
 # CALL_INTRINSIC_1 operands we can emulate
 _INTRINSIC_1 = {}
@@ -155,10 +192,11 @@ for _name, _sym in getattr(dis, "_nb_ops", []):
 
 _NO_FALLTHROUGH = {"RETURN_VALUE", "RETURN_CONST", "RAISE_VARARGS",
                    "RERAISE", "JUMP_FORWARD", "JUMP_BACKWARD",
-                   "JUMP_BACKWARD_NO_INTERRUPT"}
+                   "JUMP_BACKWARD_NO_INTERRUPT", "JUMP_ABSOLUTE"}
 _JUMPS = {"JUMP_FORWARD", "JUMP_BACKWARD", "JUMP_BACKWARD_NO_INTERRUPT",
           "POP_JUMP_IF_FALSE", "POP_JUMP_IF_TRUE", "POP_JUMP_IF_NONE",
-          "POP_JUMP_IF_NOT_NONE", "FOR_ITER"}
+          "POP_JUMP_IF_NOT_NONE", "FOR_ITER", "JUMP_ABSOLUTE",
+          "JUMP_IF_TRUE_OR_POP", "JUMP_IF_FALSE_OR_POP"}
 
 
 def _reachable(instructions, off2idx):
@@ -180,31 +218,72 @@ def _reachable(instructions, off2idx):
     return seen
 
 
+def _nested_writes_cellvar(code, names: frozenset) -> bool:
+    """True if any code object nested (at any depth) under `code`
+    STORE_DEREFs / DELETE_DEREFs one of `names` — a nonlocal writer to
+    a cell the symbolic frame only models read-only (py3.10)."""
+    for const in code.co_consts:
+        if isinstance(const, types.CodeType):
+            for ins in dis.get_instructions(const):
+                if ins.opname in ("STORE_DEREF", "DELETE_DEREF") \
+                        and ins.argval in names:
+                    return True
+            if _nested_writes_cellvar(const, names):
+                return True
+    return False
+
+
 def prescan(code) -> Optional[str]:
     """Return a fallback reason, or None if the code is interpretable."""
     if code.co_flags & (inspect.CO_GENERATOR | inspect.CO_COROUTINE |
                         inspect.CO_ASYNC_GENERATOR):
         return "generator/coroutine"
+    if "__class__" in code.co_freevars:
+        # zero-arg super() needs the real frame's __class__ cell, which
+        # a symbolic frame cannot provide (3.12 also rejects this via
+        # the LOAD_SUPER_ATTR opcode)
+        return "zero-arg super"
     if code.co_cellvars:
-        return "creates closure cells"
+        if _PY311:
+            # 3.11+ cell machinery (MAKE_CELL/COPY_FREE_VARS rebinding)
+            # is not emulated. On 3.10 cells are implicit: captured
+            # PARAMETERS land in f.locals via getcallargs and
+            # LOAD_CLOSURE rebuilds read-only cells; captured mutable
+            # locals use STORE_DEREF, which stays unsupported and
+            # rejects the frame below.
+            return "creates closure cells"
+        # 3.10: the outer frame has no STORE_DEREF when only a NESTED
+        # function mutates the captured name (nonlocal) — but that
+        # nested mutation would land in the fresh read-only cell built
+        # at LOAD_CLOSURE, not f.locals, so a later LOAD_DEREF here
+        # would read a silently stale value. Reject writers anywhere in
+        # the nested code tree.
+        if _nested_writes_cellvar(code, frozenset(code.co_cellvars)):
+            return "nested nonlocal store to captured local"
     instructions = list(dis.get_instructions(code))
     off2idx = {ins.offset: i for i, ins in enumerate(instructions)}
     # a handler that CATCHES (PUSH_EXC_INFO) needs exception dispatch we
     # don't do; cleanup-only handlers (PEP 709 comprehensions) just
-    # re-raise, and propagating past them is equivalent
-    try:
-        for entry in dis._parse_exception_table(code):
-            tgt = instructions[off2idx[entry.target]]
-            if tgt.opname == "PUSH_EXC_INFO":
-                return "try/except handler"
-    except Exception:
-        return "unparseable exception table"
+    # re-raise, and propagating past them is equivalent. Exception
+    # TABLES exist only on py3.11+ — 3.10 compiles try/except to
+    # SETUP_FINALLY block opcodes, which the unsupported-opcode scan
+    # below rejects, so skipping the table walk there loses nothing.
+    _parse_table = getattr(dis, "_parse_exception_table", None)
+    if _parse_table is not None:
+        try:
+            for entry in _parse_table(code):
+                tgt = instructions[off2idx[entry.target]]
+                if tgt.opname == "PUSH_EXC_INFO":
+                    return "try/except handler"
+        except Exception:
+            return "unparseable exception table"
     live = _reachable(instructions, off2idx)
     for i in sorted(live):
         ins = instructions[i]
         if ins.opname not in _SUPPORTED:
             return f"unsupported opcode {ins.opname}"
-        if ins.opname == "MAKE_FUNCTION" and ins.arg and (ins.arg & 0x08):
+        if _PY311 and ins.opname == "MAKE_FUNCTION" and ins.arg and \
+                (ins.arg & 0x08):
             return "MAKE_FUNCTION with closure"
         if ins.opname == "CALL_INTRINSIC_1" and \
                 ins.arg not in _INTRINSIC_1:
@@ -302,6 +381,14 @@ class _Frame:
 
     def __init__(self, code, local_vals, fn_for_globals, fn_source):
         self.code = code
+        # getcallargs spells dot-prefixed params ('.0', a 3.10
+        # comprehension's iterator arg) as 'implicitN' — rebind them to
+        # the names LOAD_FAST actually uses
+        for name in code.co_varnames[:code.co_argcount]:
+            if name.startswith(".") and name not in local_vals:
+                alt = "implicit" + name[1:]
+                if alt in local_vals:
+                    local_vals[name] = local_vals.pop(alt)
         self.instructions = list(dis.get_instructions(code))
         self.off2idx = {ins.offset: i
                         for i, ins in enumerate(self.instructions)}
@@ -412,7 +499,9 @@ class OpcodeExecutor:
                 f.locals.pop(ins.argval, None)
 
             elif op == "LOAD_GLOBAL":
-                if ins.arg & 1:
+                # the arg's low bit means "push NULL first" only on
+                # 3.11+; on 3.10 the arg is a bare name index
+                if _PY311 and ins.arg & 1:
                     push(_NULL)
                 push(self._load_global(ins.argval))
             elif op == "STORE_GLOBAL":
@@ -420,12 +509,30 @@ class OpcodeExecutor:
                 s.mutated = True
             elif op == "LOAD_DEREF":
                 name = ins.argval
-                if name in f.locals:     # cellvar-free frames only
+                if name in f.locals:     # cellvar param (3.10) / local
                     push(f.locals[name])
                 else:
                     i = f.code.co_freevars.index(name)
                     val = self.fn.__closure__[i].cell_contents
-                    push(s.wrap(val, self._deref_source(name)))
+                    if id(self.fn) in s.created_ids:
+                        # session-made function (3.10 comprehension):
+                        # the cell value was unwrapped AND guarded at
+                        # LOAD_CLOSURE; its source lives in the MAKING
+                        # frame, not here — re-wrapping would mint an
+                        # un-evaluable closure source on the root fn
+                        push(val)
+                    else:
+                        push(s.wrap(val, self._deref_source(name)))
+            elif op == "LOAD_CLOSURE":
+                # 3.10: push a fresh read-only cell for a captured
+                # parameter. The value is unwrapped AND guarded here —
+                # the made function may be called natively, so a
+                # Tracked wrapper must not hide in its closure, and the
+                # specialization it bakes in needs a guard.
+                name = ins.argval
+                if name not in f.locals:
+                    raise SotFallback(f"closure over non-local {name}")
+                push(types.CellType(s.deep_unwrap(f.locals[name])))
 
             elif op == "PUSH_NULL":
                 push(_NULL)
@@ -437,7 +544,7 @@ class OpcodeExecutor:
                 f.stack[-1], f.stack[-ins.arg] = \
                     f.stack[-ins.arg], f.stack[-1]
 
-            elif op == "LOAD_ATTR":
+            elif op == "LOAD_ATTR" or op == "LOAD_METHOD":
                 self._load_attr(ins)
             elif op == "STORE_ATTR":
                 obj = pop()
@@ -629,7 +736,10 @@ class OpcodeExecutor:
                 try:
                     push(next(it))
                 except StopIteration:
-                    push(_NULL)
+                    if _PY312:
+                        push(_NULL)   # 3.12: END_FOR pops the pair
+                    else:
+                        pop()         # 3.10: pop the spent iterator
                     idx = f.off2idx[ins.argval]
             elif op == "END_FOR":
                 pop()
@@ -660,21 +770,33 @@ class OpcodeExecutor:
                 kw = uv(pop()) if ins.arg & 1 else {}
                 posargs = uv(pop())
                 callee = pop()
-                if callee is _NULL:
-                    callee = pop()
-                else:
-                    null = pop()
-                    if null is not _NULL:
-                        posargs = [null] + list(posargs)
+                if _PY311:
+                    # 3.11+ keeps a NULL (or bound self) under the
+                    # callable; 3.10 has nothing beneath it
+                    if callee is _NULL:
+                        callee = pop()
+                    else:
+                        null = pop()
+                        if null is not _NULL:
+                            posargs = [null] + list(posargs)
                 push(self._dispatch_call(callee, list(posargs), dict(kw)))
             elif op == "MAKE_FUNCTION":
+                if not _PY311:
+                    pop()                        # qualname (<=3.10)
                 code = pop()
+                closure = None
+                if ins.arg & 0x08:
+                    # py3.10 read-only closure: LOAD_CLOSURE built the
+                    # cells below from unwrapped (and guarded) locals
+                    closure = tuple(uv(pop()))
+                if ins.arg & 0x04:
+                    pop()                        # annotations
                 kwdefaults = uv(pop()) if ins.arg & 0x02 else None
                 defaults = uv(pop()) if ins.arg & 0x01 else None
                 fnobj = types.FunctionType(
                     code, self.fn.__globals__, code.co_name,
                     tuple(self.session.deep_unwrap(defaults))
-                    if defaults else None)
+                    if defaults else None, closure)
                 if kwdefaults:
                     fnobj.__kwdefaults__ = dict(kwdefaults)
                 s.created_ids.add(id(fnobj))
@@ -687,6 +809,45 @@ class OpcodeExecutor:
                                fromlist, level))
             elif op == "IMPORT_FROM":
                 push(getattr(uv(f.stack[-1]), ins.argval))
+
+            # ------------------------------- CPython 3.10 dialect
+            elif op in _BIN_OPS:
+                b = pop()
+                a = pop()
+                push(self._rewrap(_BIN_OPS[op](uv(a), uv(b)), a, b))
+            elif op == "UNARY_POSITIVE":
+                a = pop()
+                push(self._rewrap(operator.pos(uv(a)), a))
+            elif op == "DUP_TOP":
+                push(f.stack[-1])
+            elif op == "DUP_TOP_TWO":
+                f.stack.extend(f.stack[-2:])
+            elif op in ("ROT_TWO", "ROT_THREE", "ROT_FOUR", "ROT_N"):
+                n = {"ROT_TWO": 2, "ROT_THREE": 3,
+                     "ROT_FOUR": 4}.get(op, ins.arg)
+                f.stack[-n:] = [f.stack[-1]] + f.stack[-n:-1]
+            elif op == "JUMP_ABSOLUTE":
+                idx = f.off2idx[ins.argval]
+            elif op in ("JUMP_IF_TRUE_OR_POP", "JUMP_IF_FALSE_OR_POP"):
+                cond = self._branch_bool(f.stack[-1])
+                if cond == (op == "JUMP_IF_TRUE_OR_POP"):
+                    idx = f.off2idx[ins.argval]
+                else:
+                    pop()
+            elif op == "CALL_FUNCTION":
+                args = self._popn(ins.arg)
+                push(self._dispatch_call(pop(), args, {}))
+            elif op == "CALL_FUNCTION_KW":
+                names = uv(pop())
+                vals = self._popn(ins.arg)
+                nkw = len(names)
+                kwargs = dict(zip(names, vals[ins.arg - nkw:]))
+                args = vals[:ins.arg - nkw]
+                push(self._dispatch_call(pop(), args, kwargs))
+            elif op == "CALL_METHOD":
+                self._call(ins.arg)   # same pair layout as 3.12 CALL
+            elif op == "LIST_TO_TUPLE":
+                push(tuple(uv(pop())))
 
             elif op == "LOAD_ASSERTION_ERROR":
                 push(AssertionError)
@@ -747,7 +908,9 @@ class OpcodeExecutor:
         obj = f.stack.pop()
         name = ins.argval
         real = uv(obj)
-        if ins.arg & 1:
+        # 3.10 spells the method-call form as its own LOAD_METHOD
+        # opcode; 3.12 folds it into LOAD_ATTR's low arg bit
+        if ins.opname == "LOAD_METHOD" or (_PY312 and ins.arg & 1):
             # method-call form: push (callable, self) or (NULL, attr)
             attr = getattr(real, name)
             if inspect.ismethod(attr) and attr.__self__ is real:

@@ -55,7 +55,14 @@ def _adopt(self, out):
     tensor_wrapper.h inplace check in autograd), but the node that
     produced ``out`` itself recorded the pre-mutation value — sync its
     recorded version so the op's own backward stays valid."""
-    self._value = out._value
+    val = out._value      # materializes first (may flush a window)
+    # notify every still-open capture context BEFORE the swap: a lower
+    # context on the guard stack may still map this tensor to its old
+    # snapshot, and a record after the swap would silently read stale
+    # data (the inplace_race checker's bug class)
+    from .._core import lazy as _lazy
+    _lazy.note_inplace(self)
+    self._value = val
     self._autograd_meta = out._autograd_meta
     self._stop_gradient = out._stop_gradient
     self._inplace_version += 1
@@ -134,9 +141,10 @@ for _name, _fn in [("add_", add), ("subtract_", subtract),
 
 def _fill_(self, value):
     import jax.numpy as jnp
-    self._value = jnp.full_like(self._value, value)
-    self._inplace_version += 1
-    return self
+    # _replace_value_inplace (not a bare _value write): open capture
+    # windows must be notified or later records reuse the stale snapshot
+    return self._replace_value_inplace(
+        jnp.full_like(self._value, value))
 
 
 def _zero_(self):

@@ -4,6 +4,14 @@ fake-device testing approach (phi/backends/custom/fake_cpu_device.h,
 SURVEY.md §4)."""
 import os
 
+# The suite self-lints: every flushed lazy segment and IR pass pipeline
+# runs the paddle_tpu.analysis checkers (donation safety, in-place
+# races, tracer leaks, shape/dtype drift, pass purity) in warn mode —
+# a checker false positive shows up as a StaticCheckWarning in test
+# output, a real violation in framework code fails the seeded tests.
+# Env (not set_flags) so the flag is live from the first import.
+os.environ.setdefault("FLAGS_static_checks", "warn")
+
 os.environ["JAX_PLATFORMS"] = "cpu"  # override the axon TPU tunnel
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
@@ -110,3 +118,19 @@ def _seed():
     import paddle_tpu
     paddle_tpu.seed(2024)
     yield
+
+
+def with_flag(name, value):
+    """Context manager: set a runtime flag, restore the old value on
+    exit. Shared by the flag-surface and analysis suites (import as
+    `from conftest import with_flag`)."""
+    from paddle_tpu._core.flags import flag_value, set_flags
+
+    class _Ctx:
+        def __enter__(self):
+            self.old = flag_value(name)
+            set_flags({name: value})
+
+        def __exit__(self, *a):
+            set_flags({name: self.old})
+    return _Ctx()

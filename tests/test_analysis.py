@@ -1,0 +1,436 @@
+"""Program sanitizer (paddle_tpu.analysis): seeded-violation suite.
+
+Each of the five checkers must catch a deliberately constructed
+violation with op/provenance fields in the diagnostic, `error` mode
+must raise StaticCheckError, and the clean paths must stay silent
+(no false positives — the whole tier-1 suite runs under
+FLAGS_static_checks=warn via conftest).
+"""
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu import analysis, static
+from paddle_tpu._core import lazy
+from paddle_tpu._core.flags import flag_value, set_flags
+from paddle_tpu.analysis import (StaticCheckError, StaticCheckWarning,
+                                 check_program, check_segment)
+from paddle_tpu.analysis.segment_checks import SegmentView
+from paddle_tpu.ir import PassManager, Workspace, default_pass_manager
+from paddle_tpu.ir.pass_base import Pass
+
+
+from conftest import with_flag as _with_flag  # noqa: E402
+
+
+def _x(shape=(4, 4), seed=0):
+    return paddle.to_tensor(
+        np.random.RandomState(seed).randn(*shape).astype("float32"))
+
+
+# ------------------------------------------------------ donation safety
+
+def test_donation_after_read_reported():
+    x = _x()
+    with lazy.lazy_guard() as ctx:
+        y = x * 5.0
+        # seed the violation: claim input 0 is donatable while the live
+        # tensor x still aliases its registered payload
+        view = SegmentView.from_context(ctx, donate=(0,))
+        report = check_segment(view)
+    diags = report.by_checker("donation_safety")
+    assert diags, report.render()
+    d = diags[0]
+    assert "still aliased" in d.message and "read by op #0" in d.message
+    assert d.op_index == 0 and d.op_name == "multiply"
+    assert d.provenance and "test_analysis.py" in d.provenance
+    assert float(y.numpy()[0, 0]) == pytest.approx(
+        float(x.numpy()[0, 0]) * 5.0)
+
+
+def test_donation_of_grad_residuals_reported():
+    x = _x()
+    x.stop_gradient = False
+    with lazy.lazy_guard() as ctx:
+        y = (x * 3.0).sum()
+        # flush would NEVER donate here (the segment registers a
+        # GradNode); forcing a mask must trip the residual check
+        view = SegmentView.from_context(ctx, donate=(0,))
+        report = check_segment(view)
+        assert any("GradNode" in d.message
+                   for d in report.by_checker("donation_safety")), \
+            report.render()
+        # and the mask flush actually computes is clean
+        assert check_segment(ctx).ok
+    y.backward()
+    assert x.grad is not None
+
+
+def test_donation_double_registration_reported():
+    x = _x()
+    with lazy.lazy_guard() as ctx:
+        y = x + x        # same payload registered once (deduped by id)
+        z = y * 2.0
+        view = SegmentView.from_context(ctx)
+        # seed: duplicate the registration by hand, then donate one copy
+        view.in_vals.append(view.in_vals[0])
+        view.in_tensors.append(None)
+        view.in_meta.append((False, None, 0))
+        view = SegmentView(view.pending, view.in_vals, view.in_tensors,
+                           view.in_meta, view.in_ids, view.live,
+                           view.live_refs, donate=(0,))
+        report = analysis.CheckReport()
+        from paddle_tpu.analysis.segment_checks import \
+            check_donation_safety
+        check_donation_safety(view, report)
+        assert any("registered 2 times" in d.message
+                   for d in report.diagnostics), report.render()
+        ctx._reset_segment()
+
+
+# ------------------------------------------------------- in-place races
+
+def test_unnotified_inplace_mutation_reported_and_error_raises():
+    x = _x(seed=1)
+    with lazy.lazy_guard() as ctx:
+        y = x + 3.0
+        # seed the violation: bump the version WITHOUT note_inplace
+        # (the bug class _replace_value_inplace exists to prevent)
+        x._inplace_version += 1
+        report = check_segment(ctx)
+        diags = report.by_checker("inplace_race")
+        assert diags, report.render()
+        assert "without note_inplace" in diags[0].message
+        assert "version 0 -> 1" in diags[0].message
+        assert diags[0].provenance and \
+            "test_analysis.py" in diags[0].provenance
+
+        # flush under warn: StaticCheckWarning, values still computed
+        with _with_flag("FLAGS_static_checks", "warn"):
+            with warnings.catch_warnings(record=True) as w:
+                warnings.simplefilter("always")
+                ctx.flush()
+        assert any(isinstance(wi.message, StaticCheckWarning)
+                   for wi in w)
+    np.testing.assert_allclose(y.numpy(), x.numpy() + 3.0, rtol=1e-6)
+
+    # error mode: the flush refuses to launch the corrupted segment
+    with lazy.lazy_guard() as ctx:
+        z = x + 4.0
+        x._inplace_version += 1
+        with _with_flag("FLAGS_static_checks", "error"):
+            with pytest.raises(StaticCheckError) as ei:
+                ctx.flush()
+        assert ei.value.report.by_checker("inplace_race")
+        assert not ctx.pending    # trace dropped like a failed compile
+
+
+def test_fused_backward_path_runs_sanitizer():
+    """backward() on a pending scalar root takes the fused fwd+vjp
+    path (PR 1's step cache) — the default steady-state train step —
+    and error mode must stop a corrupted program there too, not only
+    on explicit flushes."""
+    x = _x(seed=11)
+    x.stop_gradient = False
+    with lazy.lazy_guard() as ctx:
+        loss = (x * 3.0).sum()
+        x._inplace_version += 1            # unnotified mutation
+        with _with_flag("FLAGS_static_checks", "error"):
+            with pytest.raises(StaticCheckError) as ei:
+                loss.backward()
+        assert ei.value.report.by_checker("inplace_race")
+        assert not ctx.pending             # trace dropped
+    x._inplace_version = 0
+
+
+def test_check_nan_inf_covers_fused_backward():
+    """The flush-time NaN/Inf scan covers the fused fwd+vjp path."""
+    x = paddle.to_tensor(np.array([1.0, np.inf], "float32"))
+    x.stop_gradient = False
+    with lazy.lazy_guard():
+        loss = (x * 2.0).sum()
+        with _with_flag("FLAGS_check_nan_inf", True):
+            with pytest.raises(FloatingPointError):
+                loss.backward()
+
+
+def test_unknown_static_checks_value_raises():
+    """A typo ('eror') must not silently downgrade error mode to warn."""
+    from paddle_tpu.analysis.hooks import check_mode
+    with _with_flag("FLAGS_static_checks", "eror"):
+        with pytest.raises(ValueError, match="eror"):
+            check_mode()
+
+
+def test_notified_inplace_mutation_is_clean():
+    x = _x(seed=2)
+    with lazy.lazy_guard() as ctx:
+        y = x + 1.0
+        x.set_value(x * 0.5)     # notified route: evicts the mapping
+        assert check_segment(ctx).by_checker("inplace_race") == []
+    np.testing.assert_allclose(y.numpy(), x.numpy() * 2.0 + 1.0,
+                               rtol=1e-6)
+
+
+def test_inplace_ops_notify_open_windows():
+    """add_/fill_ route through note_inplace (the checker's bug class,
+    fixed in ops/__init__): records after the mutation must see the
+    fresh payload."""
+    x = _x(seed=3)
+    with lazy.lazy_guard() as ctx:
+        y = x + 1.0              # registers x's original payload
+        x.fill_(7.0)             # must evict the registration
+        z = x + 1.0              # must read the FILLED value
+        assert check_segment(ctx).by_checker("inplace_race") == []
+    np.testing.assert_allclose(z.numpy(), np.full((4, 4), 8.0))
+
+
+# -------------------------------------------------------- tracer leaks
+
+def _make_dead_tracer():
+    import jax
+    import jax.numpy as jnp
+    box = {}
+
+    def f(t):
+        box["tr"] = t
+        return t * 2.0
+
+    jax.make_jaxpr(f)(jnp.ones((2,), jnp.float32))
+    return box["tr"]
+
+
+def test_tracer_leak_in_segment_inputs_reported():
+    tr = _make_dead_tracer()
+    x = _x(seed=4)
+    with lazy.lazy_guard() as ctx:
+        y = x * 2.0
+        view = SegmentView.from_context(ctx)
+        view.in_vals[0] = tr          # seed: a dead tracer as input
+        report = check_segment(view)
+        diags = report.by_checker("tracer_leak")
+        assert diags, report.render()
+        assert "jax tracer" in diags[0].message
+        assert diags[0].op_name == "multiply"
+        ctx._reset_segment()
+
+
+def test_tracer_leak_in_attrs_and_scalar_cache_reported():
+    tr = _make_dead_tracer()
+    x = _x(seed=5)
+    with lazy.lazy_guard() as ctx:
+        y = x.reshape([16])
+        ctx.pending[0].attrs["_seeded"] = tr    # attrs leak
+        report = check_segment(ctx)
+        assert any("attrs" in d.message
+                   for d in report.by_checker("tracer_leak")), \
+            report.render()
+        ctx._reset_segment()
+
+    from paddle_tpu._core import executor
+    key = (float, 123456.75, 1.0)
+    executor._SCALAR_CACHE[key] = tr            # cache leak
+    try:
+        report = analysis.CheckReport()
+        analysis.check_process_tracer_leaks(report)
+        assert any("coercion cache" in d.message
+                   for d in report.diagnostics)
+    finally:
+        executor._SCALAR_CACHE.pop(key, None)
+
+
+# ------------------------------------------------- shape/dtype (lazy)
+
+def test_segment_shape_drift_reported():
+    x = _x(seed=6)
+    with lazy.lazy_guard() as ctx:
+        y = x.reshape([16])
+        # seed: a rogue rewrite mutates attrs behind the metadata
+        ctx.pending[-1].attrs["shape"] = [2, 8]
+        report = check_segment(ctx)
+        diags = report.by_checker("shape_dtype")
+        assert diags, report.render()
+        assert "recorded (16,), derives (2, 8)" in diags[0].message
+        assert diags[0].op_name == "reshape"
+        assert diags[0].provenance and \
+            "test_analysis.py" in diags[0].provenance
+        with _with_flag("FLAGS_static_checks", "error"):
+            with pytest.raises(StaticCheckError):
+                ctx.flush()
+
+
+# --------------------------------------------- shape/dtype (Workspace)
+
+def _record_static(build, feeds):
+    prog = static.Program()
+    static.enable_static()
+    try:
+        with static.program_guard(prog):
+            vars_ = {n: static.data(n, shape, dtype)
+                     for n, (shape, dtype) in feeds.items()}
+            outs = build(vars_)
+    finally:
+        static.disable_static()
+    return prog, outs
+
+
+def test_program_dtype_drift_reported():
+    prog, out = _record_static(
+        lambda v: paddle.cast(v["x"], "float16") * 1.0,
+        {"x": ([4, 4], "float32")})
+    ws = Workspace(prog)
+    # seed: corrupt the cast's dtype attr after recording
+    cast_node = next(n for n in ws.ops if n.op_name == "cast")
+    cast_node.attrs["dtype"] = "float32"
+    report = check_program(ws)
+    diags = report.by_checker("shape_dtype")
+    assert diags, report.render()
+    assert "dtype drifted" in diags[0].message
+    assert diags[0].op_name == "cast"
+
+
+def test_program_amp_dtype_propagation_not_flagged():
+    """AMP's bf16 rewrite changes dtypes ON PURPOSE; drift that merely
+    propagates from rewritten inputs must not be reported."""
+    from paddle_tpu.ir import AutoMixedPrecisionPass
+    prog, out = _record_static(
+        lambda v: paddle.matmul(v["x"], v["x"]).sum(),
+        {"x": ([4, 4], "float32")})
+    ws = Workspace(prog)
+    with _with_flag("FLAGS_static_checks", "error"):
+        PassManager([AutoMixedPrecisionPass()]).run(ws, protected=[out])
+    assert check_program(ws).by_checker("shape_dtype") == [], \
+        check_program(ws).render()
+
+
+# ------------------------------------------------- pass effect/purity
+
+class _RogueDropPass(Pass):
+    name = "rogue_drop"
+
+    def run(self, ws, protected):
+        ws.ops[:] = [n for n in ws.ops if "dropout" not in n.op_name]
+        return True
+
+
+class _RogueReorderPass(Pass):
+    name = "rogue_reorder"
+
+    def run(self, ws, protected):
+        imp = [n for n in ws.ops
+               if "dropout" in n.op_name or "uniform" in n.op_name]
+        if len(imp) >= 2:
+            a, b = ws.ops.index(imp[0]), ws.ops.index(imp[1])
+            ws.ops[a], ws.ops[b] = ws.ops[b], ws.ops[a]
+        return True
+
+
+def _dropout_prog():
+    def build(v):
+        h = F.dropout(v["x"], p=0.5, training=True)
+        return (h * 2.0).sum()
+    return _record_static(build, {"x": ([4, 4], "float32")})
+
+
+def test_rogue_pass_dropping_impure_op_raises():
+    prog, out = _dropout_prog()
+    ws = Workspace(prog)
+    with _with_flag("FLAGS_static_checks", "error"):
+        with pytest.raises(StaticCheckError) as ei:
+            PassManager([_RogueDropPass()]).run(ws, protected=[out])
+    diags = ei.value.report.by_checker("pass_effects")
+    assert diags and "rogue_drop" in diags[0].message
+    assert "dropped impure op" in diags[0].message
+    assert diags[0].op_name and "dropout" in diags[0].op_name
+
+
+def test_rogue_pass_reordering_impure_ops_reported():
+    def build(v):
+        a = F.dropout(v["x"], p=0.5, training=True)
+        b = paddle.uniform([4, 4], min=0.0, max=1.0)
+        return (a + b).sum()
+
+    prog, out = _record_static(build, {"x": ([4, 4], "float32")})
+    ws = Workspace(prog)
+    with _with_flag("FLAGS_static_checks", "warn"):
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            PassManager([_RogueReorderPass()]).run(ws, protected=[out])
+    msgs = [str(wi.message) for wi in w
+            if isinstance(wi.message, StaticCheckWarning)]
+    assert any("reordered impure ops" in m for m in msgs), msgs
+
+
+def test_default_pipeline_clean_under_error_mode():
+    """The stock pass pipeline must survive the verifier: impure ops
+    preserved, shapes/dtypes consistent (no false positives)."""
+    prog, out = _dropout_prog()
+    ws = Workspace(prog)
+    with _with_flag("FLAGS_static_checks", "error"):
+        default_pass_manager().run(ws, protected=[out])
+    assert any("dropout" in n.op_name for n in ws.ops)
+
+
+# ---------------------------------------------- NaN/Inf flush coverage
+
+def test_check_nan_inf_covers_lazy_segment_outputs():
+    """Satellite: ops recorded while the flag was off must still be
+    scanned when their segment flushes after the flag turns on (the
+    per-op eager scan never sees them)."""
+    x = paddle.to_tensor(np.array([1.0, np.inf], "float32"))
+    with lazy.lazy_guard() as ctx:
+        y = x * 2.0                        # recorded, flag off
+        with _with_flag("FLAGS_check_nan_inf", True):
+            with pytest.raises(FloatingPointError) as ei:
+                ctx.flush()
+    assert "multiply" in str(ei.value)
+
+    # warn level: values still come back
+    x2 = paddle.to_tensor(np.array([1.0, np.nan], "float32"))
+    with lazy.lazy_guard() as ctx:
+        z = x2 + 1.0
+        with _with_flag("FLAGS_check_nan_inf", True):
+            with _with_flag("FLAGS_check_nan_inf_level", 1):
+                with warnings.catch_warnings(record=True) as w:
+                    warnings.simplefilter("always")
+                    ctx.flush()
+    assert any("NaN/Inf" in str(wi.message) for wi in w)
+    assert np.isnan(z.numpy()).any()
+
+
+# ------------------------------------------------------------ surfaces
+
+def test_check_segment_clean_on_real_model_step():
+    import paddle_tpu.nn as nn
+    net = nn.Linear(8, 4)
+    x = _x((2, 8), seed=7)
+    with lazy.lazy_guard() as ctx:
+        y = net(x).sum()
+        report = check_segment(ctx, process=True)
+    assert report.ok, report.render()
+    y.backward()
+    assert net.weight.grad is not None
+
+
+def test_cli_exits_zero_on_lenet():
+    from paddle_tpu.analysis.__main__ import main
+    old = flag_value("FLAGS_static_checks")
+    try:
+        assert main(["--models", "lenet"]) == 0
+    finally:
+        set_flags({"FLAGS_static_checks": old})
+
+
+def test_error_mode_raise_keeps_later_eager_ops_working():
+    x = _x(seed=8)
+    with lazy.lazy_guard() as ctx:
+        y = x * 2.0
+        x._inplace_version += 1
+        with _with_flag("FLAGS_static_checks", "error"):
+            with pytest.raises(StaticCheckError):
+                ctx.flush()
+    z = x + 1.0          # fresh work after the dropped trace
+    np.testing.assert_allclose(z.numpy(), x.numpy() + 1.0, rtol=1e-6)

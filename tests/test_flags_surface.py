@@ -11,15 +11,7 @@ import paddle_tpu as paddle
 from paddle_tpu._core.flags import _REGISTRY, flag_value, set_flags
 
 
-def _with_flag(name, value):
-    class _Ctx:
-        def __enter__(self):
-            self.old = flag_value(name)
-            set_flags({name: value})
-
-        def __exit__(self, *a):
-            set_flags({name: self.old})
-    return _Ctx()
+from conftest import with_flag as _with_flag  # noqa: E402
 
 
 def test_flag_surface_size_and_help():
@@ -189,6 +181,31 @@ def test_sparse_validate_indices_flag():
                                      shape=[2, 2])
     # off: constructs without bounds check (legacy behavior)
     sparse.sparse_coo_tensor([[0, 1], [0, 1]], [1.0, 2.0], shape=[2, 2])
+
+
+def test_static_checks_flag_live():
+    """FLAGS_static_checks is read live at flush: 'error' refuses to
+    launch a seeded-violation segment, 'off' skips the checkers (and
+    captures no provenance on the recorded ops)."""
+    from paddle_tpu._core import lazy
+    from paddle_tpu.analysis import StaticCheckError
+
+    x = paddle.to_tensor(np.ones((2,), "float32"))
+    with _with_flag("FLAGS_static_checks", "error"):
+        with lazy.lazy_guard() as ctx:
+            y = x + 1.0
+            x._inplace_version += 1      # seeded unnotified mutation
+            with pytest.raises(StaticCheckError):
+                ctx.flush()
+    x._inplace_version = 0
+    with _with_flag("FLAGS_static_checks", "off"):
+        with lazy.lazy_guard() as ctx:
+            y = x + 1.0
+            assert ctx.pending[-1].src is None, \
+                "off mode must not pay for provenance capture"
+            x._inplace_version += 1
+        np.testing.assert_allclose(y.numpy(), [2.0, 2.0])
+    x._inplace_version = 0
 
 
 def test_ir_pass_disable_flag():
