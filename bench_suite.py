@@ -10,6 +10,10 @@ Row 3  BERT-base pretrain-style step     tokens/sec/chip
 Row 4  eager dispatch-overhead microbench  ops/sec through the lazy window
 Row 5  static-check overhead sanity      asserts 0 sanitizer sweeps when
                                          off; reports warn-mode overhead %
+Row 6  observability overhead sanity     asserts 0 registry mutations when
+                                         off; reports enabled overhead % and
+                                         a counter snapshot (cache_hit_rate,
+                                         compiles) in the row json
 (Multi-chip GPT/ERNIE hybrids need a pod; their single-chip proxies are
 bench.py's headline + the dryrun_multichip compile check.)
 """
@@ -148,10 +152,11 @@ def bench_dispatch():
 def bench_static_checks():
     """Row 5: program-sanitizer overhead sanity. With
     FLAGS_static_checks=off the checkers must contribute ZERO work —
-    asserted by counting sanitizer sweeps (hooks.SEGMENT_SWEEPS frozen
-    across the whole off-mode timing; exact, immune to machine noise,
-    unlike a wall-clock delta between two identical code paths). The
-    reported value is warn-mode overhead on the same 32-op lazy chain,
+    asserted by counting sanitizer sweeps (hooks.segment_sweeps(), the
+    sanitizer.segment_sweeps registry counter, frozen across the whole
+    off-mode timing; exact, immune to machine noise, unlike a
+    wall-clock delta between two identical code paths). The reported
+    value is warn-mode overhead on the same 32-op lazy chain,
     min-of-interleaved-rounds."""
     import numpy as np
     import paddle_tpu as paddle
@@ -174,16 +179,16 @@ def bench_static_checks():
             paddle.set_flags({"FLAGS_static_checks": "off"})
 
     timed("off")               # prime: compile + cache warmup off-clock
-    start = hooks.SEGMENT_SWEEPS
+    start = hooks.segment_sweeps()
     # interleave off/warn rounds so machine drift hits both equally
     rounds = []
     for _ in range(5):
-        before = hooks.SEGMENT_SWEEPS
+        before = hooks.segment_sweeps()
         off_t = timed("off")
-        assert hooks.SEGMENT_SWEEPS == before, \
+        assert hooks.segment_sweeps() == before, \
             "FLAGS_static_checks=off ran sanitizer sweeps (must be 0)"
         rounds.append((off_t, timed("warn")))
-    assert hooks.SEGMENT_SWEEPS > start, "warn mode never swept"
+    assert hooks.segment_sweeps() > start, "warn mode never swept"
     off = min(r[0] for r in rounds)
     warn = min(r[1] for r in rounds)
     warn_pct = (warn - off) / off * 100.0
@@ -192,10 +197,78 @@ def bench_static_checks():
             "value": round(warn_pct, 1), "unit": "% warn-mode overhead"}
 
 
+def bench_observability():
+    """Row 6: observability overhead sanity. With FLAGS_observability
+    off the instrumentation must contribute ZERO registry work —
+    asserted by the registry's MUTATIONS counter staying frozen across
+    the whole off-mode timing (exact, immune to machine noise; the
+    sanitizer-row technique). The reported value is enabled-mode
+    overhead on the same 32-op lazy chain, min-of-interleaved-rounds,
+    and the row json carries the counter snapshot the driver folds into
+    BENCH (cache_hit_rate, compiles, flushes)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import observability as obs
+    from paddle_tpu.observability import metrics
+
+    x = paddle.to_tensor(np.ones((16, 16), "float32"))
+    chain = 16
+
+    def run():
+        y = x
+        for _ in range(chain):
+            y = y * 1.0001 + 0.0001
+        return y._value
+
+    def timed(on):
+        paddle.set_flags({"FLAGS_observability": on,
+                          "FLAGS_static_checks": "off"})
+        try:
+            return _timeit(run, steps=100, warmup=10)
+        finally:
+            paddle.set_flags({"FLAGS_observability": False})
+
+    timed(False)               # prime: compile + cache warmup off-clock
+    rounds = []
+    for _ in range(5):
+        before = metrics.MUTATIONS
+        off_t = timed(False)
+        assert metrics.MUTATIONS == before, \
+            "FLAGS_observability=off did registry work (must be 0)"
+        rounds.append((off_t, timed(True)))
+    off = min(r[0] for r in rounds)
+    on = min(r[1] for r in rounds)
+    on_pct = (on - off) / off * 100.0
+
+    # counter snapshot for the BENCH json: re-run the chain enabled
+    # from a clean registry so the derived rates describe steady state
+    obs.reset()
+    paddle.set_flags({"FLAGS_observability": True})
+    try:
+        for _ in range(20):
+            run()
+    finally:
+        paddle.set_flags({"FLAGS_observability": False})
+    snap = obs.stats()
+    return {"metric": f"observability overhead ({chain * 2}-op lazy "
+                      f"chain; off = 0 registry mutations asserted)",
+            "value": round(on_pct, 1), "unit": "% enabled overhead",
+            "counters": {
+                "cache_hit_rate": round(snap["cache_hit_rate"], 4)
+                if snap["cache_hit_rate"] is not None else None,
+                "step_cache_hit_rate": snap["step_cache_hit_rate"],
+                "compiles": snap["compiles"],
+                "segment_flushes":
+                    snap["counters"].get("segment.flushes", 0),
+                "segment_ops": snap["counters"].get("segment.ops", 0),
+            }}
+
+
 def main():
-    rows = os.environ.get("BENCH_ROWS", "1,2,3,4,5").split(",")
+    rows = os.environ.get("BENCH_ROWS", "1,2,3,4,5,6").split(",")
     table = {"1": bench_lenet, "2": bench_resnet50, "3": bench_bert,
-             "4": bench_dispatch, "5": bench_static_checks}
+             "4": bench_dispatch, "5": bench_static_checks,
+             "6": bench_observability}
     for r in rows:
         r = r.strip()
         out = table[r]()

@@ -61,6 +61,7 @@ from . import signal  # noqa: E402
 from . import distribution  # noqa: E402
 from . import sparse  # noqa: E402
 from . import profiler  # noqa: E402
+from . import observability  # noqa: E402
 from . import quantization  # noqa: E402
 from . import inference  # noqa: E402
 from . import onnx  # noqa: E402

@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 
 from . import dispatch
+from ..observability import _state as _OBS
 from .op_registry import OpDef
 
 # ---------------------------------------------------------------- grad mode
@@ -309,7 +310,16 @@ def _engine_run(tensors, grad_tensors, targets, retain_graph=False):
     # grads land directly on the leaves — no flush, no graph walk
     if targets is None and not retain_graph \
             and lazy.try_fused_backward(tensors, grad_tensors):
+        if _OBS.METRICS:
+            from ..observability import metrics
+            metrics.inc("autograd.fused_steps")
         return {}
+    # generic engine walk (fallbacks from whole-step fusion land here —
+    # a rising engine_runs/fused_steps ratio is the signal a training
+    # loop fell off the fused hot path)
+    if _OBS.METRICS:
+        from ..observability import metrics
+        metrics.inc("autograd.engine_runs")
 
     # otherwise a pending lazy capture must land before the walk: the
     # fused segment GradNodes are only wired in at flush. paddle.grad

@@ -7,10 +7,17 @@ new shape mints a new signature. `ExecCache` is a drop-in dict
 replacement with LRU eviction; the capacity is read live from a flag at
 insertion time so `set_flags` takes effect mid-session (the analog of
 the reference's FLAGS_* cache-size knobs, kernel_factory.h cache role).
+
+A cache constructed with `stat="segment"` additionally reports hit/miss
+counts into the observability registry (`cache.segment.{hit,miss}`)
+when metrics collection is on — one module-level check per lookup when
+it is off.
 """
 from __future__ import annotations
 
 from collections import OrderedDict
+
+from ..observability import _state as _obs
 
 
 class ExecCache(OrderedDict):
@@ -19,10 +26,18 @@ class ExecCache(OrderedDict):
     FLAGS_eager_compile_cache_size spelling for the eager caches)."""
 
     def __init__(self, flag: str = "FLAGS_executable_cache_capacity",
-                 extra_flag: str = None):
+                 extra_flag: str = None, stat: str = None):
         super().__init__()
         self._flag = flag
         self._extra_flag = extra_flag
+        # direct Counter handles: metrics.reset() zeroes them in place,
+        # so holding the objects (no per-lookup name resolution) is safe
+        if stat is not None:
+            from ..observability import metrics
+            self._hit = metrics.counter(f"cache.{stat}.hit")
+            self._miss = metrics.counter(f"cache.{stat}.miss")
+        else:
+            self._hit = self._miss = None
 
     def _capacity(self) -> int:
         from . import flags
@@ -37,8 +52,12 @@ class ExecCache(OrderedDict):
         try:
             val = OrderedDict.__getitem__(self, key)
         except KeyError:
+            if _obs.METRICS and self._miss is not None:
+                self._miss.inc()
             return default
         self.move_to_end(key)
+        if _obs.METRICS and self._hit is not None:
+            self._hit.inc()
         return val
 
     def __getitem__(self, key):

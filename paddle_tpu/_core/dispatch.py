@@ -23,13 +23,14 @@ import jax
 import numpy as np
 
 from . import flags
+from ..observability import _state as _obs
 from .cache import ExecCache
 from .op_registry import OpDef
 
 _FWD_CACHE: Dict[Tuple, Any] = ExecCache(
-    extra_flag="FLAGS_eager_compile_cache_size")
+    extra_flag="FLAGS_eager_compile_cache_size", stat="eager_fwd")
 _BWD_CACHE: Dict[Tuple, Any] = ExecCache(
-    extra_flag="FLAGS_eager_compile_cache_size")
+    extra_flag="FLAGS_eager_compile_cache_size", stat="eager_bwd")
 
 # ndarray attrs (e.g. index tables, window vectors) are hashed by content;
 # digesting v.tobytes() on EVERY dispatch is O(size) per op. Arrays used
@@ -148,6 +149,9 @@ def fwd_callable(op: OpDef, attrs: Dict[str, Any]):
     if fn is None:
         fn = jax.jit(functools.partial(op.kernel_for(backend), **attrs))
         _FWD_CACHE[key] = fn   # ExecCache evicts LRU past either cap flag
+        if _obs.METRICS:
+            from ..observability import metrics
+            metrics.inc("compiles.eager_fwd")
     return fn
 
 
@@ -182,6 +186,9 @@ def bwd_callable(op: OpDef, attrs: Dict[str, Any]):
 
         fn = jax.jit(_vjp)
     _BWD_CACHE[key] = fn
+    if _obs.METRICS:
+        from ..observability import metrics
+        metrics.inc("compiles.eager_bwd")
     return fn
 
 

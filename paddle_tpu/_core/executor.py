@@ -14,6 +14,7 @@ import jax
 import jax.numpy as jnp
 
 from . import flags, lazy
+from ..observability import _state as _obs
 from .autograd import is_grad_enabled, record
 from .dispatch import eager_forward
 from .op_registry import get_op
@@ -91,6 +92,12 @@ def apply(op_name: str, *inputs, **attrs):
             ctx.maybe_cap_flush()
             return outs if op.multi_output else outs[0]
     vals = tuple(t._value if t is not None else None for t in ts)
+    if _obs.METRICS:
+        # per-op dispatches bypassing the fusion window (window off,
+        # tracer inputs, per-op profiling modes, record fallbacks) —
+        # the counterpart of segment.ops for hot-path health checks
+        from ..observability import metrics
+        metrics.inc("eager.ops")
     if _profile_cb is not None:
         with _profile_cb(op_name):
             out_vals = eager_forward(op, vals, attrs)

@@ -57,12 +57,47 @@ def get_flags(flags: Union[str, Iterable[str]]) -> Dict[str, Any]:
 
 def set_flags(flags: Dict[str, Any]) -> None:
     with _LOCK:
+        # resolve + parse EVERYTHING before mutating anything: a typo'd
+        # name or unparseable value mid-dict must not leave earlier
+        # flags written to the registry with their watcher-cached gates
+        # (STATIC_CHECKS_ACTIVE, observability _state) never updated
+        updates = []
         for name, value in flags.items():
             key = _resolve(name)
             if key not in _REGISTRY:
                 raise ValueError(f"unknown flag: {name}")
             flag = _REGISTRY[key]
-            flag.value = _parse(value, flag.type) if isinstance(value, str) and flag.type is not str else flag.type(value)
+            parsed = _parse(value, flag.type) \
+                if isinstance(value, str) and flag.type is not str \
+                else flag.type(value)
+            updates.append((key, flag, parsed))
+        fire = []
+        for key, flag, parsed in updates:
+            flag.value = parsed
+            for cb in _WATCHERS.get(key, ()):
+                fire.append((cb, parsed))
+    # callbacks run outside the registry lock (they may read other flags)
+    for cb, value in fire:
+        cb(value)
+
+
+# flag-change watchers: subsystems that cache a flag into a module-level
+# fast gate (observability ACTIVE, profiler host-tracer level) register
+# here so set_flags keeps the cached copy coherent without the hot path
+# paying a registry lookup per event.
+_WATCHERS: Dict[str, list] = {}
+
+
+def watch_flag(name: str, callback) -> None:
+    """Invoke `callback(value)` now and after every set_flags update of
+    `name` (alias-resolved)."""
+    with _LOCK:
+        key = _resolve(name)
+        if key not in _REGISTRY:
+            raise ValueError(f"unknown flag: {name}")
+        _WATCHERS.setdefault(key, []).append(callback)
+        value = _REGISTRY[key].value
+    callback(value)
 
 
 # reference-name aliases: the subset of the reference's ~187 PHI flags
@@ -199,6 +234,21 @@ STATIC_CHECKS_OFF = frozenset(
     for w in (word, word.capitalize(), word.upper())
 ) | {0, False, None}
 
+# Cached module-level gate for the record/flush hot paths: True iff
+# FLAGS_static_checks is not an off-spelling. A watch_flag callback
+# keeps it coherent (env init and every set_flags land here), so the
+# per-recorded-op gate is one attribute read instead of a registry
+# resolve + frozenset test per op.
+STATIC_CHECKS_ACTIVE = False
+
+
+def _sync_static_checks_gate(value):
+    global STATIC_CHECKS_ACTIVE
+    STATIC_CHECKS_ACTIVE = value not in STATIC_CHECKS_OFF
+
+
+watch_flag("FLAGS_static_checks", _sync_static_checks_gate)
+
 # ---- kernels / pallas
 define_flag("FLAGS_flash_interpret", False,
             "Force Pallas flash kernels into interpret mode (CPU mesh "
@@ -243,6 +293,27 @@ define_flag("FLAGS_host_tracer_level", 1,
             "Host tracer detail: 0 off, 1 ops, 2 ops+python ranges.")
 define_flag("FLAGS_profiler_max_events", 1_000_000,
             "Host tracer event-buffer cap (oldest dropped beyond it).")
+define_flag("FLAGS_profiler_fused_runtime", False,
+            "Profiler keeps the fusion window ON while recording: no "
+            "per-op host events (op::*), the trace instead carries the "
+            "fused-runtime spans (segment flush/compile/execute, fused "
+            "step, optimizer) the steady-state hot path actually runs.")
+
+# ---- observability (paddle_tpu.observability)
+define_flag("FLAGS_observability", False,
+            "Collect runtime metrics (counters/gauges/histograms) at "
+            "the fused-runtime instrumentation points; off = the hot "
+            "paths pay one module-level check and zero registry work.")
+define_flag("FLAGS_flight_recorder", False,
+            "Keep a bounded ring buffer of recent runtime events "
+            "(spans, flushes, cache decisions) and dump a readable "
+            "report on enforce errors, failed flushes, and sanitizer "
+            "error-mode trips.")
+define_flag("FLAGS_flight_recorder_capacity", 512,
+            "Flight-recorder ring size (events kept).")
+define_flag("FLAGS_flight_recorder_dir", "",
+            "Directory for flight-record dumps ('' = FLAGS_profiler_dir "
+            "or cwd).")
 
 # ---- model-surface defaults
 define_flag("FLAGS_onnx_opset", 13,

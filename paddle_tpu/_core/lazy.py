@@ -38,16 +38,55 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import dispatch
-from .flags import STATIC_CHECKS_OFF as _CHECKS_OFF
+from . import flags as _flags
+from ..observability import _state as _OBS
 from .cache import ExecCache
 from .op_registry import OpDef
 
 # Compiled-runner caches (LRU-bounded by FLAGS_executable_cache_capacity):
 #   _SEG_CACHE   (signature, donate_mask) -> jitted segment runner
 #   _FUSED_CACHE (signature, grad_in, root) -> jitted fwd+vjp step runner
-_SEG_CACHE: Dict[Tuple, Any] = ExecCache()
-_FUSED_CACHE: Dict[Tuple, Any] = ExecCache()
+# The stat names feed cache.<name>.{hit,miss} observability counters;
+# cache.fused_step is THE steady-state step-cache hit-rate signal.
+_SEG_CACHE: Dict[Tuple, Any] = ExecCache(stat="segment")
+_FUSED_CACHE: Dict[Tuple, Any] = ExecCache(stat="fused_step")
 _AVAL_CACHE: Dict[Tuple, Tuple] = {}
+
+
+def _obs_flush_span(reason: str, n_ops: int, n_inputs: int, n_live: int,
+                    n_donate: int):
+    """Counters + the begun flush span. Callers gate on _OBS.ACTIVE —
+    this never runs when observability, tracing, and the flight
+    recorder are all off."""
+    if _OBS.METRICS:
+        from ..observability import metrics
+        metrics.inc("segment.flushes")
+        # record_fallback:<op> collapses to one reason bucket
+        metrics.inc("segment.flush_reason." + reason.split(":", 1)[0])
+        metrics.inc("segment.ops", n_ops)
+        if n_donate:
+            metrics.inc("segment.donated_inputs", n_donate)
+    from ..observability.spans import span
+    return span(f"segment::flush[{reason}]", hist="segment.flush_us",
+                reason=reason, ops=n_ops, inputs=n_inputs,
+                live=n_live, donated=n_donate).begin()
+
+
+def _obs_exec_span(compiled: bool, n_ops: int):
+    """The compile-vs-cached-execute split under a flush span (compile
+    counters are bumped at the call sites, which know WHICH cache
+    missed: compiles.segment vs compiles.fused_step)."""
+    from ..observability.spans import span
+    return span("segment::compile" if compiled else "segment::execute",
+                hist=("segment.compile_us" if compiled
+                      else "segment.execute_us"), ops=n_ops).begin()
+
+
+def _obs_flush_failed(reason: str, err: BaseException):
+    """Failed flush: the flight recorder's post-mortem trigger."""
+    if _OBS.FLIGHT:
+        from ..observability import flight
+        flight.on_error("flush_failed", f"reason={reason}: {err!r}")
 
 
 @contextlib.contextmanager
@@ -268,8 +307,7 @@ class CaptureContext:
             out_refs.append(ref)
             outs.append(t)
         src = None
-        from . import flags
-        if flags.flag_value("FLAGS_static_checks") not in _CHECKS_OFF:
+        if _flags.STATIC_CHECKS_ACTIVE:
             from ..analysis.hooks import call_site
             src = call_site()
         self.pending.append(_PendingOp(op, dict(attrs), wiring, out_refs,
@@ -335,12 +373,12 @@ class CaptureContext:
                 _segment_needs_grad(in_tensors, in_vals, live_refs, in_meta):
             donate = _donatable_inputs(in_tensors, in_vals, live_refs)
 
-        # program sanitizer (paddle_tpu.analysis): one flag read when
-        # off; in warn/error mode the segment checkers run over the
+        # program sanitizer (paddle_tpu.analysis): one cached-gate read
+        # when off; in warn/error mode the segment checkers run over the
         # program about to execute (donation safety, in-place races,
         # tracer leaks, shape/dtype drift). 'error' stops a corrupting
         # launch — drop the trace like a failed compile would.
-        if flags.flag_value("FLAGS_static_checks") not in _CHECKS_OFF:
+        if _flags.STATIC_CHECKS_ACTIVE:
             from ..analysis import hooks as _sanitizer
             try:
                 _mode = _sanitizer.check_mode()   # full normalization
@@ -352,57 +390,89 @@ class CaptureContext:
                 self._reset_segment()
                 raise
 
+        fspan = _obs_flush_span(reason, len(pending), len(in_vals),
+                                len(live), len(donate)) \
+            if _OBS.ACTIVE else None
         dispatch.bump_exec()
+        xspan = None
         try:
             runner = _SEG_CACHE.get((sig, donate))
             # async dispatch: out_vals are in-flight futures — the host
             # returns to tracing the next ops while the device executes;
             # sync happens only at explicit .numpy()/float() reads
             if runner is None:
+                if fspan is not None:
+                    xspan = _obs_exec_span(True, len(pending))
+                if _OBS.METRICS:
+                    from ..observability import metrics
+                    metrics.inc("compiles.segment")
                 runner = jax.jit(_build_segment_fn(pending, live),
                                  donate_argnums=donate)
                 _SEG_CACHE[(sig, donate)] = runner
                 with _quiet_donation_compile():   # first call compiles
                     out_vals = runner(*in_vals)
             else:
+                if fspan is not None:
+                    xspan = _obs_exec_span(False, len(pending))
                 out_vals = runner(*in_vals)
-        except Exception:
+            if xspan is not None:
+                xspan.end()
+        except Exception as e:
             # a failed compile/run must not pin input tensors or poison
             # later records: drop the trace and surface the error (the
-            # un-materialized outputs re-raise on read)
+            # un-materialized outputs re-raise on read). Spans end
+            # BEFORE the flight dump so the report contains the failing
+            # flush/compile entry, not just the error note.
             self._reset_segment()
+            if xspan is not None:
+                xspan.end(error=e)
+            if fspan is not None:
+                fspan.end(error=e)
+            _obs_flush_failed(reason, e)
             raise
         self._reset_segment()
         self.breaks.append(reason)
         self.segments_run += 1
 
-        # bind concrete values into every aliasing Tensor; the grad node
-        # attaches to a grad-REQUIRING alias — a detach()ed alias must
-        # never have its stop_gradient flipped back
-        out_tensors = []
-        for ref, val in zip(live_refs, out_vals):
-            ts = _live_aliases(ref)
-            for t in ts:
-                t._payload = val
-            grad_ts = [t for t in ts if not t.stop_gradient]
-            out_tensors.append(grad_ts[0] if grad_ts
-                               else (ts[0] if ts else None))
+        try:
+            # bind concrete values into every aliasing Tensor; the grad
+            # node attaches to a grad-REQUIRING alias — a detach()ed
+            # alias must never have its stop_gradient flipped back
+            out_tensors = []
+            for ref, val in zip(live_refs, out_vals):
+                ts = _live_aliases(ref)
+                for t in ts:
+                    t._payload = val
+                grad_ts = [t for t in ts if not t.stop_gradient]
+                out_tensors.append(grad_ts[0] if grad_ts
+                                   else (ts[0] if ts else None))
 
-        # FLAGS_check_nan_inf covers fused-segment outputs too (the
-        # per-op eager scan in dispatch.py never sees ops that were
-        # recorded before the flag flipped on, nor replayed segments):
-        # scan every live output, blaming its producing op
-        if flags.flag_value("FLAGS_check_nan_inf"):
-            for (j, _s), val in zip(live, out_vals):
-                dispatch._check_nan_inf(
-                    f"{pending[j].op.name} (lazy segment output)", (val,))
+            # FLAGS_check_nan_inf covers fused-segment outputs too (the
+            # per-op eager scan in dispatch.py never sees ops that were
+            # recorded before the flag flipped on, nor replayed
+            # segments): scan every live output, blaming its producer
+            if flags.flag_value("FLAGS_check_nan_inf"):
+                for (j, _s), val in zip(live, out_vals):
+                    dispatch._check_nan_inf(
+                        f"{pending[j].op.name} (lazy segment output)",
+                        (val,))
 
-        self._register_grad(pending, live, live_refs, out_tensors,
-                            in_tensors, in_vals, sig, in_meta)
+            self._register_grad(pending, live, live_refs, out_tensors,
+                                in_tensors, in_vals, sig, in_meta)
 
-        if self.on_flush is not None:
-            self.on_flush(self, reason, pending, live, live_refs,
-                          in_tensors, in_vals, sig, out_tensors)
+            if self.on_flush is not None:
+                self.on_flush(self, reason, pending, live, live_refs,
+                              in_tensors, in_vals, sig, out_tensors)
+        except Exception as e:
+            # a post-execute failure (NaN trip, grad wiring, observer)
+            # still closes the flush span and triggers the flight
+            # post-mortem — this is exactly the event it exists for
+            if fspan is not None:
+                fspan.end(error=e)
+            _obs_flush_failed(reason, e)
+            raise
+        if fspan is not None:
+            fspan.end()
 
     on_flush = None  # observer hook (jit/sot records segment structure)
 
@@ -419,8 +489,6 @@ class CaptureContext:
         if not self.pending:
             self._reset_segment()
             return
-        from .autograd import record
-        from .tensor import Tensor
         pending = self.pending
         in_vals = self._in_vals
         in_meta = self._in_meta
@@ -431,6 +499,30 @@ class CaptureContext:
         self.breaks.append(reason)
         self.segments_run += 1
 
+        rspan = None
+        if _OBS.ACTIVE:
+            if _OBS.METRICS:
+                from ..observability import metrics
+                metrics.inc("segment.replays_per_op")
+                metrics.inc("segment.flush_reason."
+                            + reason.split(":", 1)[0])
+            from ..observability.spans import span
+            rspan = span(f"segment::replay_per_op[{reason}]",
+                         hist="segment.replay_per_op_us", reason=reason,
+                         ops=len(pending)).begin()
+
+        try:
+            self._replay_per_op(pending, in_vals, in_meta, in_tensors)
+        except Exception as e:
+            if rspan is not None:
+                rspan.end(error=e)
+            raise
+        if rspan is not None:
+            rspan.end()
+
+    def _replay_per_op(self, pending, in_vals, in_meta, in_tensors):
+        from .autograd import record
+        from .tensor import Tensor
         out_tensors: List[List] = []
         for pop in pending:
             ins = []
@@ -774,13 +866,16 @@ def _build_fused_fn(pending, live, grad_in: Tuple[int, ...], root_k: int):
     return fused
 
 
-_SEG_BWD_CACHE: Dict[Tuple, Any] = ExecCache()
+_SEG_BWD_CACHE: Dict[Tuple, Any] = ExecCache(stat="segment_bwd")
 
 
 def _segment_bwd(sig, pending, live, grad_in: Tuple[int, ...]):
     key = (sig, grad_in)
     fn = _SEG_BWD_CACHE.get(key)
     if fn is None:
+        if _OBS.METRICS:
+            from ..observability import metrics
+            metrics.inc("compiles.segment_bwd")
         seg = _build_segment_fn(pending, live)
 
         def bwd(inputs, cts, _seg=seg, _gi=grad_in):
@@ -846,11 +941,24 @@ class ReplayableSegment:
         if got != self.in_avals:
             raise _ReplayMismatch("input avals changed")
         runner = _SEG_CACHE.get((self.sig, ()))
-        if runner is None:
+        compiled = runner is None
+        if compiled:
             runner = jax.jit(_build_segment_fn(self.pending, self.live))
             _SEG_CACHE[(self.sig, ())] = runner
+            if _OBS.METRICS:
+                from ..observability import metrics
+                metrics.inc("compiles.segment")
         dispatch.bump_exec()
-        out_vals = runner(*in_vals)
+        xspan = _obs_exec_span(compiled, len(self.pending)) \
+            if _OBS.ACTIVE else None
+        try:
+            out_vals = runner(*in_vals)
+        except Exception as e:
+            if xspan is not None:
+                xspan.end(error=e)
+            raise
+        if xspan is not None:
+            xspan.end()
         from . import flags
         if flags.flag_value("FLAGS_check_nan_inf"):
             for (j, _s), val in zip(self.live, out_vals):
@@ -999,7 +1107,7 @@ def try_fused_backward(tensors, grad_tensors) -> bool:
     # mode must stop a corrupted program here too (no donation mask:
     # fused-step inputs are the backward residuals)
     from . import flags
-    if flags.flag_value("FLAGS_static_checks") not in _CHECKS_OFF:
+    if _flags.STATIC_CHECKS_ACTIVE:
         from ..analysis import hooks as _sanitizer
         try:
             _mode = _sanitizer.check_mode()
@@ -1011,24 +1119,51 @@ def try_fused_backward(tensors, grad_tensors) -> bool:
             ctx._reset_segment()
             raise
 
+    fspan = _obs_flush_span("backward_fused", len(pending), len(in_vals),
+                            len(live), 0) if _OBS.ACTIVE else None
     sig = ctx._signature(in_vals, live)
     key = (sig, grad_in, root_k)
     runner = _FUSED_CACHE.get(key)
-    if runner is None:
+    compiled = runner is None
+    if compiled:
         runner = jax.jit(_build_fused_fn(pending, live, grad_in, root_k))
         _FUSED_CACHE[key] = runner
+        if _OBS.METRICS:
+            from ..observability import metrics
+            metrics.inc("compiles.fused_step")
     dispatch.bump_exec()
+    xspan = _obs_exec_span(compiled, len(pending)) \
+        if fspan is not None else None
     try:
         out_vals, grads = runner(*in_vals)
-    except Exception:
+    except Exception as e:
         ctx._reset_segment()
+        # spans end BEFORE the flight dump (report must carry them)
+        if xspan is not None:
+            xspan.end(error=e)
+        if fspan is not None:
+            fspan.end(error=e)
+        _obs_flush_failed("backward_fused", e)
         raise
+    if xspan is not None:
+        xspan.end()
 
     if flags.flag_value("FLAGS_check_nan_inf"):
-        for (j, _s), val in zip(live, out_vals):
-            dispatch._check_nan_inf(
-                f"{pending[j].op.name} (fused-step output)", (val,))
-        dispatch._check_nan_inf("fused-step gradients", tuple(grads))
+        try:
+            for (j, _s), val in zip(live, out_vals):
+                dispatch._check_nan_inf(
+                    f"{pending[j].op.name} (fused-step output)", (val,))
+            dispatch._check_nan_inf("fused-step gradients", tuple(grads))
+        except Exception as e:
+            # a NaN trip drops the consumed trace like a failed compile
+            # (leaving it armed would re-execute the whole forward as a
+            # plain segment on the next read), closes the step span,
+            # and triggers the flight post-mortem
+            ctx._reset_segment()
+            if fspan is not None:
+                fspan.end(error=e)
+            _obs_flush_failed("backward_fused", e)
+            raise
     ctx._reset_segment()
     ctx.breaks.append("backward_fused")
     ctx.segments_run += 1
@@ -1063,6 +1198,8 @@ def try_fused_backward(tensors, grad_tensors) -> bool:
         tomb.freed = True
         meta.grad_node = tomb
         meta.out_slot = 0
+    if fspan is not None:
+        fspan.end()
     return True
 
 

@@ -27,6 +27,12 @@ class StaticCheckError(RuntimeError):
     def __init__(self, report: "CheckReport"):
         self.report = report
         super().__init__(report.render())
+        from ..observability import _state as _obs
+        if _obs.FLIGHT:
+            # sanitizer error-mode trip: dump the flight record so the
+            # runtime events leading up to the bad program survive
+            from ..observability import flight
+            flight.on_error("static_check", report.render())
 
 
 class Diagnostic:

@@ -31,10 +31,15 @@ def check_mode() -> str:
 
 # ------------------------------------------------------------- segments
 
-# flush-time sweeps since process start — bench_suite row 5 asserts this
-# stays frozen with FLAGS_static_checks=off (checker work is exactly 0,
-# not merely "too small to measure")
-SEGMENT_SWEEPS = 0
+def segment_sweeps() -> int:
+    """Flush-time sweeps since process start — lives in the
+    observability metrics registry (`sanitizer.segment_sweeps`; counted
+    unconditionally because this path only runs in warn/error mode).
+    bench_suite row 5 asserts it stays frozen with
+    FLAGS_static_checks=off (checker work is exactly 0, not merely
+    'too small to measure')."""
+    from ..observability import metrics
+    return metrics.counter("sanitizer.segment_sweeps").value
 
 
 def on_segment_flush(ctx, pending, in_vals, in_meta, in_tensors,
@@ -43,8 +48,8 @@ def on_segment_flush(ctx, pending, in_vals, in_meta, in_tensors,
     Called by CaptureContext.flush AFTER the donation mask is computed
     and BEFORE the executable runs, so 'error' mode stops a corrupting
     program from launching."""
-    global SEGMENT_SWEEPS
-    SEGMENT_SWEEPS += 1
+    from ..observability import metrics
+    metrics.counter("sanitizer.segment_sweeps").inc()
     from .diagnostics import CheckReport
     from .segment_checks import (SegmentView, check_donation_safety,
                                  check_inplace_races, check_shape_dtype,

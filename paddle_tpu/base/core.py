@@ -27,6 +27,12 @@ class EnforceNotMet(RuntimeError):
         super().__init__("\n".join(parts))
         self.message = message
         self.context = context
+        from ..observability import _state as _obs
+        if _obs.FLIGHT:
+            # framework error with the flight recorder armed: dump the
+            # recent runtime events alongside the enforce message
+            from ..observability import flight
+            flight.on_error("enforce", message)
 
 
 class InvalidArgumentError(EnforceNotMet, ValueError):

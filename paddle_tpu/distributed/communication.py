@@ -25,6 +25,20 @@ import jax
 import numpy as np
 
 from .._core.tensor import Tensor
+from ..observability import _state as _OBS
+from ..observability.spans import NULL_SPAN
+
+
+def _obs_comm(name: str):
+    """Span + call counter for one host-driven collective. One
+    module-level check when observability is off."""
+    if not _OBS.ACTIVE:
+        return NULL_SPAN
+    if _OBS.METRICS:
+        from ..observability import metrics
+        metrics.inc("comm.calls." + name)
+    from ..observability.spans import span
+    return span("comm::" + name, hist=f"comm.{name}_us")
 
 
 class ReduceOp:
@@ -170,7 +184,8 @@ def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     eager multi-process path rides the store-backed ProcessGroup."""
     if _single(group):
         return tensor
-    out = _pg(group).all_reduce(_np(tensor), op)
+    with _obs_comm("all_reduce"):
+        out = _pg(group).all_reduce(_np(tensor), op)
     tensor._adopt(_wrap_like(out, tensor))
     return tensor
 
@@ -180,7 +195,8 @@ def all_gather(tensor_list: List, tensor: Tensor, group=None, sync_op=True):
         tensor_list.append(tensor.clone() if isinstance(tensor, Tensor)
                            else tensor)
         return tensor_list
-    parts = _pg(group).all_gather(_np(tensor))
+    with _obs_comm("all_gather"):
+        parts = _pg(group).all_gather(_np(tensor))
     tensor_list.extend(_wrap_like(p, tensor) for p in parts)
     return tensor_list
 
@@ -196,7 +212,9 @@ def all_gather_object(object_list, obj, group=None):
 def broadcast(tensor: Tensor, src=0, group=None, sync_op=True):
     if _single(group):
         return tensor
-    out = _pg(group).broadcast(_np(tensor), _grank(group, src, 'src'))
+    with _obs_comm("broadcast"):
+        out = _pg(group).broadcast(_np(tensor),
+                                   _grank(group, src, 'src'))
     tensor._adopt(_wrap_like(out, tensor))
     return tensor
 
@@ -214,7 +232,9 @@ def reduce(tensor: Tensor, dst=0, op=ReduceOp.SUM, group=None,
            sync_op=True):
     if _single(group):
         return tensor
-    out = _pg(group).reduce(_np(tensor), _grank(group, dst, 'dst'), op)
+    with _obs_comm("reduce"):
+        out = _pg(group).reduce(_np(tensor), _grank(group, dst, 'dst'),
+                                op)
     tensor._adopt(_wrap_like(out, tensor))
     return tensor
 
@@ -225,7 +245,9 @@ def reduce_scatter(tensor: Tensor, tensor_list, op=ReduceOp.SUM, group=None,
         t = tensor_list[0]
         tensor._adopt(t.clone())
         return tensor
-    out = _pg(group).reduce_scatter([_np(t) for t in tensor_list], op)
+    with _obs_comm("reduce_scatter"):
+        out = _pg(group).reduce_scatter([_np(t) for t in tensor_list],
+                                        op)
     tensor._adopt(_wrap_like(out, tensor))
     return tensor
 
@@ -237,7 +259,8 @@ def scatter(tensor: Tensor, tensor_list=None, src=0, group=None,
             tensor._adopt(tensor_list[0].clone())
         return tensor
     parts = [_np(t) for t in tensor_list] if tensor_list else None
-    out = _pg(group).scatter(parts, _grank(group, src, 'src'))
+    with _obs_comm("scatter"):
+        out = _pg(group).scatter(parts, _grank(group, src, 'src'))
     tensor._adopt(_wrap_like(out, tensor))
     return tensor
 
@@ -248,7 +271,9 @@ def gather(tensor: Tensor, gather_list=None, dst=0, group=None,
         if gather_list is not None:
             gather_list.append(tensor.clone())
         return gather_list
-    parts = _pg(group).gather(_np(tensor), _grank(group, dst, 'dst'))
+    with _obs_comm("gather"):
+        parts = _pg(group).gather(_np(tensor),
+                                  _grank(group, dst, 'dst'))
     if parts is not None and gather_list is not None:
         gather_list.extend(_wrap_like(p, tensor) for p in parts)
     return gather_list
@@ -258,7 +283,8 @@ def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
     if _single(group):
         out_tensor_list.extend(t.clone() for t in in_tensor_list)
         return out_tensor_list
-    parts = _pg(group).all_to_all([_np(t) for t in in_tensor_list])
+    with _obs_comm("alltoall"):
+        parts = _pg(group).all_to_all([_np(t) for t in in_tensor_list])
     out_tensor_list.extend(_wrap_like(p, in_tensor_list[0]) for p in parts)
     return out_tensor_list
 
@@ -270,14 +296,16 @@ def send(tensor: Tensor, dst=0, group=None, sync_op=True):
     g = group or _get_default_group()
     if g.nranks <= 1:
         raise RuntimeError("send needs a multi-process group")
-    _pg(group).send(_np(tensor), _grank(group, dst, 'dst'))
+    with _obs_comm("send"):
+        _pg(group).send(_np(tensor), _grank(group, dst, 'dst'))
 
 
 def recv(tensor: Tensor, src=0, group=None, sync_op=True):
     g = group or _get_default_group()
     if g.nranks <= 1:
         raise RuntimeError("recv needs a multi-process group")
-    out = _pg(group).recv(_grank(group, src, 'src'))
+    with _obs_comm("recv"):
+        out = _pg(group).recv(_grank(group, src, 'src'))
     tensor._adopt(_wrap_like(out, tensor))
     return tensor
 
@@ -293,7 +321,8 @@ def irecv(tensor, src=0, group=None):
 def barrier(group=None):
     if _single(group):
         return
-    _pg(group).barrier()
+    with _obs_comm("barrier"):
+        _pg(group).barrier()
 
 
 def wait(tensor, group=None, use_calc_stream=True):

@@ -45,6 +45,7 @@ _PY312 = sys.version_info >= (3, 12)
 
 from ..._core import lazy
 from ..._core.tensor import Tensor
+from ...observability import _state as _OBS
 from .guards import Guard, GuardSet, Source, is_guardable_value
 
 
@@ -1102,6 +1103,13 @@ class SotFunction:
         from ..._core.autograd import is_grad_enabled
         grad_now = is_grad_enabled()
         log = _flag("FLAGS_guard_log")
+        gspan = None
+        if _OBS.ACTIVE:
+            from ...observability.spans import span
+            gspan = span("sot::guard_eval", hist="sot.guard_eval_us",
+                         fn=getattr(fn, "__name__", "?"),
+                         entries=len(self._entries)).begin()
+        guards_matched = False
         for entry in self._entries:
             if log:
                 failed = [g for g in entry.guards
@@ -1111,12 +1119,30 @@ class SotFunction:
                           f"guard miss {failed[:3]}")
             if entry.grad_mode == grad_now \
                     and entry.guards.check_all(fn, eval_args, kwargs):
+                guards_matched = True
+                if gspan is not None:
+                    gspan.end()
                 try:
                     out = entry.run(fn, eval_args, kwargs)
                     self.stats["fast_hits"] += 1
+                    if _OBS.METRICS:
+                        from ...observability import metrics
+                        metrics.inc("sot.fast_hits")
                     return out
                 except (lazy._ReplayMismatch, _ReplayMismatch):
+                    if _OBS.METRICS:
+                        from ...observability import metrics
+                        metrics.inc("sot.replay_mismatches")
                     continue
+        if gspan is not None:
+            gspan.end()
+            if _OBS.METRICS:
+                from ...observability import metrics
+                # a replay mismatch after a guard PASS is not a guard
+                # miss — it is already counted above
+                if self._entries and not guards_matched:
+                    metrics.inc("sot.guard_misses")
+                metrics.inc("sot.captures")
         return self._capture(args, kwargs)
 
     # ------------------------------------------------------------ capture

@@ -1,0 +1,107 @@
+"""paddle_tpu.observability — fused-runtime telemetry.
+
+Three consumers over one set of instrumentation points (segment
+record→flush, compile vs. cached execute, executable-cache hit/miss,
+donation decisions, fused-backward step cache, per-op replay, SOT guard
+evaluation, distributed collectives, optimizer updates):
+
+- **metrics registry** (`FLAGS_observability` / `enable()`): process-
+  wide counters/gauges/histograms, snapshot via `stats()`;
+- **structured spans**: while a `paddle_tpu.profiler.Profiler` is
+  recording, the same points emit timed span events into the chrome
+  trace (`segment::flush[reason]` with compile/execute children);
+- **flight recorder** (`FLAGS_flight_recorder`): bounded ring of recent
+  events, auto-dumped to a report on enforce errors, failed flushes,
+  and sanitizer error-mode trips.
+
+Cost when everything is off: one module-level boolean check per
+instrumentation point (`observability._state.ACTIVE`), zero registry
+work — asserted by bench_suite row 6.
+
+    python -m paddle_tpu.observability        # demo workload + stats
+"""
+from __future__ import annotations
+
+from .._core import flags as _flags
+from . import _state, flight, metrics, spans
+from .metrics import counter, gauge, histogram
+from .spans import span
+
+__all__ = ["stats", "reset", "enable", "disable", "enabled",
+           "counter", "gauge", "histogram", "span",
+           "flight_record", "dump_flight_record"]
+
+# keep the module-level fast gates coherent with the flags (env spelling
+# FLAGS_observability=1 works from first import; set_flags mid-session
+# flips the gate immediately)
+_flags.watch_flag("FLAGS_observability", _state.set_metrics)
+_flags.watch_flag("FLAGS_flight_recorder", _state.set_flight)
+
+
+def enable(flight_recorder: bool = None):
+    """Turn on metrics collection (and optionally the flight recorder)."""
+    f = {"FLAGS_observability": True}
+    if flight_recorder is not None:
+        f["FLAGS_flight_recorder"] = bool(flight_recorder)
+    _flags.set_flags(f)
+
+
+def disable():
+    _flags.set_flags({"FLAGS_observability": False})
+
+
+def enabled() -> bool:
+    return _state.METRICS
+
+
+def reset():
+    """Zero every metric and drop the flight ring (counter snapshots
+    restart from a clean baseline)."""
+    metrics.reset()
+    flight.reset()
+
+
+def _derived(counters: dict) -> dict:
+    hits = misses = 0
+    for k, v in counters.items():
+        if k.startswith("cache."):
+            if k.endswith(".hit"):
+                hits += v
+            elif k.endswith(".miss"):
+                misses += v
+    step_hit = counters.get("cache.fused_step.hit", 0)
+    step_miss = counters.get("cache.fused_step.miss", 0)
+    return {
+        "compiles": sum(v for k, v in counters.items()
+                        if k.startswith("compiles.")),
+        "cache_hit_rate": (hits / (hits + misses)
+                           if hits + misses else None),
+        "step_cache_hit_rate": (step_hit / (step_hit + step_miss)
+                                if step_hit + step_miss else None),
+    }
+
+
+def stats(reset_after: bool = False) -> dict:
+    """Snapshot of the registry plus derived headline numbers:
+
+    - ``compiles``: framework-issued XLA compilations (sum of the
+      ``compiles.*`` counters) — steady state adds zero;
+    - ``cache_hit_rate``: hit fraction across every executable cache;
+    - ``step_cache_hit_rate``: the fused fwd+vjp "step cache" alone —
+      THE steady-state train-step health signal.
+    """
+    snap = metrics.snapshot()
+    snap.update(_derived(snap["counters"]))
+    if reset_after:
+        reset()
+    return snap
+
+
+def flight_record() -> str:
+    """The flight-recorder ring formatted as a report."""
+    return flight.record()
+
+
+def dump_flight_record(path: str = None) -> str:
+    """Write the flight record to a file; returns the path."""
+    return flight.dump(reason="manual dump", path=path)

@@ -1,0 +1,112 @@
+"""CLI: run a small workload with telemetry on and print the stats.
+
+    python -m paddle_tpu.observability [--model chain|lenet] [--steps N]
+                                       [--json] [--trace PATH] [--flight]
+
+`chain` (default) is the dispatch microbench's elementwise chain —
+fast, exercises segment record/flush/cache. `lenet` runs real train
+steps through the whole-step fusion path (step cache, fused optimizer).
+`--trace PATH` additionally records the run under a fused-runtime
+profiler session and exports the chrome trace there. Exit code 0.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _run_chain(steps: int):
+    import numpy as np
+    import paddle_tpu as paddle
+
+    x = paddle.to_tensor(np.ones((16, 16), "float32"))
+    for _ in range(steps):
+        y = x
+        for _ in range(16):
+            y = y * 1.0001 + 0.0001
+        np.asarray(y._value)
+
+
+def _run_lenet(steps: int):
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.vision.models import LeNet
+
+    paddle.seed(0)
+    model = LeNet()
+    opt = paddle.optimizer.Adam(1e-3, parameters=model.parameters())
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(32, 1, 28, 28).astype(np.float32))
+    y = paddle.to_tensor(rng.randint(0, 10, (32,)).astype(np.int64))
+    for _ in range(steps):
+        loss = F.cross_entropy(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        np.asarray(loss._value)
+
+
+def _render(snap: dict) -> str:
+    lines = ["== paddle_tpu.observability stats =="]
+    lines.append(f"  compiles:            {snap['compiles']}")
+    for k in ("cache_hit_rate", "step_cache_hit_rate"):
+        v = snap[k]
+        lines.append(f"  {k + ':':<21}"
+                     + ("n/a" if v is None else f"{v:.3f}"))
+    lines.append("  counters:")
+    for k in sorted(snap["counters"]):
+        lines.append(f"    {k:<40} {snap['counters'][k]}")
+    if snap["histograms"]:
+        lines.append("  histograms (us):")
+        for k in sorted(snap["histograms"]):
+            h = snap["histograms"][k]
+            if not h["count"]:
+                continue
+            lines.append(
+                f"    {k:<40} n={h['count']} avg={h['avg']:.1f} "
+                f"min={h['min']:.1f} max={h['max']:.1f}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.observability")
+    ap.add_argument("--model", default="chain",
+                    choices=("chain", "lenet"))
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--json", action="store_true",
+                    help="print the stats snapshot as JSON")
+    ap.add_argument("--trace", default=None, metavar="PATH",
+                    help="also export a fused-runtime chrome trace")
+    ap.add_argument("--flight", action="store_true",
+                    help="enable the flight recorder and print the ring")
+    args = ap.parse_args(argv)
+
+    import paddle_tpu as paddle
+    from paddle_tpu import observability as obs
+
+    obs.enable(flight_recorder=args.flight or None)
+    obs.reset()
+    run = _run_lenet if args.model == "lenet" else _run_chain
+
+    if args.trace:
+        from paddle_tpu.profiler import Profiler, ProfilerTarget
+        with Profiler(targets=[ProfilerTarget.CPU],
+                      fused_runtime=True) as p:
+            run(args.steps)
+        path = p.export(args.trace)
+        print(f"chrome trace written to {path}", file=sys.stderr)
+    else:
+        run(args.steps)
+
+    snap = obs.stats()
+    print(json.dumps(snap) if args.json else _render(snap))
+    if args.flight:
+        print(obs.flight_record())
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

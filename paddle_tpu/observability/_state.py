@@ -1,0 +1,43 @@
+"""Module-level fast gates for the observability layer.
+
+Every instrumented hot path (segment flush, cache lookup, collective,
+SOT guard eval) pays exactly ONE module-attribute read when everything
+here is off — the same discipline as FLAGS_static_checks. The gates are
+kept coherent with their flags via flags.watch_flag (registered in
+observability/__init__) and with the profiler's recording state via
+profiler start/stop/step.
+
+This module must stay import-light (stdlib only): _core.cache and
+_core.lazy import it at module load.
+"""
+from __future__ import annotations
+
+METRICS = False   # FLAGS_observability: registry collection at hot sites
+TRACE = False     # profiler is recording: spans land in the host trace
+FLIGHT = False    # FLAGS_flight_recorder: ring-buffer event capture
+
+# The single gate hot paths read: any consumer on.
+ACTIVE = False
+
+
+def recompute():
+    global ACTIVE
+    ACTIVE = METRICS or TRACE or FLIGHT
+
+
+def set_metrics(on: bool):
+    global METRICS
+    METRICS = bool(on)
+    recompute()
+
+
+def set_trace(on: bool):
+    global TRACE
+    TRACE = bool(on)
+    recompute()
+
+
+def set_flight(on: bool):
+    global FLIGHT
+    FLIGHT = bool(on)
+    recompute()

@@ -1,0 +1,122 @@
+"""Flight recorder: a bounded ring of recent runtime events, dumped to
+a human-readable report when something goes wrong.
+
+While `FLAGS_flight_recorder` is on, the instrumented runtime pushes
+one entry per span/flush/cache decision into a deque (capacity =
+FLAGS_flight_recorder_capacity). Three triggers auto-dump the ring:
+
+- an `EnforceNotMet` (framework error) being constructed,
+- a failed segment flush (compile/run error in the fusion window),
+- a sanitizer error-mode trip (`StaticCheckError`).
+
+so post-mortem debugging gets the last N runtime events — flush
+reasons, cache hits, donation decisions — without re-running the
+workload under a profiler session.
+"""
+from __future__ import annotations
+
+import collections
+import os
+import threading
+import time
+from typing import Optional
+
+from . import _state
+
+_LOCK = threading.Lock()
+_RING: Optional[collections.deque] = None
+_DUMP_SEQ = 0
+
+
+def _ring() -> collections.deque:
+    global _RING
+    if _RING is None:
+        from .._core import flags
+        cap = max(int(flags.flag_value("FLAGS_flight_recorder_capacity")),
+                  1)
+        _RING = collections.deque(maxlen=cap)
+    return _RING
+
+
+def _on_capacity_change(v):
+    """Resize a live ring in place (keeping the newest entries) so a
+    set_flags capacity change takes effect immediately, not at the
+    next reset()."""
+    global _RING
+    with _LOCK:
+        if _RING is not None:
+            _RING = collections.deque(_RING, maxlen=max(int(v), 1))
+
+
+from .._core import flags as _flags  # noqa: E402
+
+_flags.watch_flag("FLAGS_flight_recorder_capacity", _on_capacity_change)
+
+
+def note(kind: str, name: str, **detail):
+    """Append one event. Callers gate on `_state.FLIGHT`; calling when
+    off is a cheap no-op (so non-hot paths may call unconditionally)."""
+    if not _state.FLIGHT:
+        return
+    with _LOCK:
+        _ring().append((time.perf_counter_ns(), kind, name, detail))
+
+
+def reset():
+    global _RING
+    with _LOCK:
+        _RING = None     # re-read capacity flag on next use
+
+
+def record() -> str:
+    """The current ring formatted as a report (oldest first)."""
+    with _LOCK:
+        entries = list(_RING) if _RING is not None else []
+    now = time.perf_counter_ns()
+    lines = [f"== paddle_tpu flight record: {len(entries)} event(s), "
+             f"pid {os.getpid()} =="]
+    for t, kind, name, detail in entries:
+        rel = (t - now) / 1e9
+        extra = " ".join(f"{k}={v}" for k, v in detail.items())
+        lines.append(f"  {rel:+10.6f}s  {kind:<6} {name}"
+                     + (f"  {extra}" if extra else ""))
+    if not entries:
+        lines.append("  (empty — was FLAGS_flight_recorder on while the "
+                     "workload ran?)")
+    return "\n".join(lines)
+
+
+def dump(reason: str = "", path: str = None) -> str:
+    """Write the report to a file and return its path."""
+    global _DUMP_SEQ
+    if path is None:
+        from .._core import flags
+        d = (flags.flag_value("FLAGS_flight_recorder_dir")
+             or flags.flag_value("FLAGS_profiler_dir") or ".")
+        os.makedirs(d, exist_ok=True)
+        with _LOCK:
+            _DUMP_SEQ += 1
+            seq = _DUMP_SEQ
+        path = os.path.join(d, f"flight_{os.getpid()}_{seq}.txt")
+    body = record()
+    if reason:
+        body = f"trigger: {reason}\n{body}"
+    with open(path, "w") as f:
+        f.write(body + "\n")
+    from . import metrics
+    metrics.inc("flight.dumps")
+    return path
+
+
+def on_error(kind: str, message: str):
+    """Auto-dump trigger (enforce error / sanitizer trip / failed
+    flush). Gated by the caller on `_state.FLIGHT`; never raises — a
+    dump failure must not mask the original error."""
+    note("error", kind, message=message[:200])
+    try:
+        path = dump(reason=f"{kind}: {message[:200]}")
+        import logging
+        logging.getLogger("paddle_tpu.observability").error(
+            "flight record dumped to %s (%s)", path, kind)
+    except Exception:
+        pass

@@ -1,0 +1,84 @@
+"""Structured runtime spans.
+
+A span is one timed region of the fused runtime (a segment flush, an
+XLA compile, a collective) that fans out to every enabled consumer:
+
+- metrics:  duration observed into a histogram (`hist` name, so e.g.
+  every `segment::flush[<reason>]` variant feeds ONE `segment.flush_us`
+  histogram instead of fragmenting per reason);
+- trace:    an event appended to the profiler's host-event buffer, so
+  the chrome-trace export shows the span on the recording thread's
+  lane, nested under/over other host events by time;
+- flight:   a ring-buffer entry for post-mortem dumps.
+
+Callers pre-gate on `_state.ACTIVE` — constructing a span when
+everything is off never happens on a hot path.
+"""
+from __future__ import annotations
+
+import time
+
+from . import _state, metrics
+
+
+class Span:
+    __slots__ = ("name", "hist", "args", "_t0")
+
+    def __init__(self, name: str, hist=None, args=None):
+        self.name = name
+        self.hist = hist
+        self.args = args
+        self._t0 = None
+
+    def begin(self):
+        self._t0 = time.perf_counter_ns()
+        return self
+
+    def end(self, error=None):
+        if self._t0 is None:
+            return
+        t0, self._t0 = self._t0, None
+        dur_us = (time.perf_counter_ns() - t0) / 1000.0
+        if _state.METRICS and self.hist is not None:
+            metrics.observe(self.hist, dur_us)
+        if _state.TRACE:
+            from ..profiler import _add_span_event
+            _add_span_event(self.name, t0 / 1000.0, dur_us, self.args)
+        if _state.FLIGHT:
+            from . import flight
+            detail = dict(self.args) if self.args else {}
+            detail["dur_us"] = round(dur_us, 1)
+            if error is not None:
+                detail["error"] = repr(error)
+            flight.note("span", self.name, **detail)
+
+    def __enter__(self):
+        return self.begin()
+
+    def __exit__(self, et, ev, tb):
+        self.end(error=ev)
+        return False
+
+
+def span(name: str, hist: str = None, **args) -> Span:
+    return Span(name, hist, args or None)
+
+
+class _NullSpan:
+    """Shared no-op stand-in (stateless, safe to reuse) so call sites
+    can write `with maybe_span(...)` without a branch."""
+
+    def begin(self):
+        return self
+
+    def end(self, error=None):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+NULL_SPAN = _NullSpan()

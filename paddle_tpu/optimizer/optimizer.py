@@ -19,6 +19,7 @@ import jax.numpy as jnp
 
 from .._core import dispatch as _dispatch
 from .._core import flags as _flags
+from ..observability import _state as _OBS
 from .._core.autograd import no_grad
 from .._core.tensor import Tensor
 from .lr import LRScheduler
@@ -128,11 +129,32 @@ class Optimizer:
         wds = tuple(m["weight_decay"] for m in metas)
         lr_mults = tuple(m["learning_rate"] for m in metas)
         fn = self._pick_update(pvals, gvals, states)
+        ospan = None
+        if _OBS.ACTIVE:
+            donated = fn is self._jit_update
+            if _OBS.METRICS:
+                from ..observability import metrics
+                metrics.inc("optimizer.steps")
+                metrics.inc("optimizer.donated_steps" if donated
+                            else "optimizer.copied_steps")
+            from ..observability.spans import span
+            ospan = span("optimizer::fused_step",
+                         hist="optimizer.step_us", params=len(pvals),
+                         donated=donated).begin()
         _dispatch.bump_exec()
         from .._core.lazy import _quiet_donation_compile
-        with _quiet_donation_compile():   # no-donation backends (CPU)
-            new_p, new_s = fn(pvals, gvals, states, lr, t,
-                              wds=wds, lr_mults=lr_mults)
+        try:
+            with _quiet_donation_compile():   # no-donation backends (CPU)
+                new_p, new_s = fn(pvals, gvals, states, lr, t,
+                                  wds=wds, lr_mults=lr_mults)
+        except Exception as e:
+            # a failed update must still close the span so the flight
+            # record shows the step that died
+            if ospan is not None:
+                ospan.end(error=e)
+            raise
+        if ospan is not None:
+            ospan.end()
         for (p, _), meta, np_, ns in zip(pairs, metas, new_p, new_s):
             pid = id(p)
             self._states[pid] = ns

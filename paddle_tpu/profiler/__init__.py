@@ -4,23 +4,39 @@ Host tracer: RecordEvent instrumentation collecting (name, tid, t0, t1)
 host events — the analog of the reference's HostTracer
 (paddle/fluid/platform/profiler/event_tracing.h). Device tracer: on TPU,
 the CUPTI role (cuda_tracer.cc) is played by jax.profiler (XLA/xplane
-traces for TensorBoard). Scheduler states and chrome-trace export mirror
-profiler.py:89 (make_scheduler) and chrometracing_logger.cc.
+traces, ingested by profiler/xplane.py). Scheduler states and
+chrome-trace export mirror profiler.py:89 (make_scheduler) and
+chrometracing_logger.cc.
+
+Two recording modes:
+
+- default: per-op host events (`op::<name>`) — the fusion window is
+  bypassed while recording so each op dispatches (and times) alone;
+- `fused_runtime=True` (or FLAGS_profiler_fused_runtime): the fusion
+  window stays ON and the trace instead carries the runtime spans the
+  steady-state hot path actually executes — `segment::flush[reason]`
+  with `segment::compile` / `segment::execute` children, fused
+  optimizer updates, collectives (see paddle_tpu.observability).
 """
 from __future__ import annotations
 
 import json
+import logging
 import os
 import threading
 import time
 from enum import Enum
 from typing import Callable, List, Optional
 
+from .._core import flags as _flags
+from ..observability import _state as _obs_state
 from .statistic import SortedKeys, StatisticData, summary as _summary
 
 __all__ = ["Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
            "make_scheduler", "export_chrome_tracing", "SortedKeys",
            "load_profiler_result"]
+
+log = logging.getLogger("paddle_tpu.profiler")
 
 
 class ProfilerState(Enum):
@@ -42,35 +58,109 @@ _events_lock = threading.Lock()
 _events: List[dict] = []
 _recording = False
 
+# Disabled-path fast gates: a RecordEvent in user code must be
+# near-free when no profiler is recording, so begin()/end() test ONE
+# module-level bool — no clock stamp, no flag-registry lookup. The
+# flag values are cached here and kept coherent via flags.watch_flag.
+_TRACER_ON = False      # _recording and host_tracer_level >= 1
+_TRACER_LEVEL = 1
+_MAX_EVENTS = 1_000_000
+_CUR_PROFILER = None    # the profiler currently recording, if any
+
+
+def _refresh_gates():
+    global _TRACER_ON
+    _TRACER_ON = _recording and _TRACER_LEVEL >= 1
+
+
+def _on_level_change(v):
+    global _TRACER_LEVEL
+    _TRACER_LEVEL = v
+    _refresh_gates()
+    # flipping the level mid-recording must (un)install the per-op
+    # dispatch hook immediately, not at the next step boundary
+    p = _CUR_PROFILER
+    if p is not None:
+        p._sync_recording()
+
+
+def _on_cap_change(v):
+    global _MAX_EVENTS
+    _MAX_EVENTS = v
+
+
+_flags.watch_flag("FLAGS_host_tracer_level", _on_level_change)
+_flags.watch_flag("FLAGS_profiler_max_events", _on_cap_change)
+
+
+# Interned per-thread ids: threading.get_ident() & 0xFFFF could merge
+# two threads' trace lanes on a collision, and even a full get_ident()
+# key is recycled by the OS after a thread exits (a later thread would
+# inherit a dead thread's lane and name). Thread-local storage dies
+# with its thread, so every thread — including one on a recycled
+# ident — gets a fresh small id; _TID_NAMES carries the names into
+# the export's metadata events.
+_TID_LOCK = threading.Lock()
+_TID_TLS = threading.local()
+_TID_NAMES: dict = {}        # small id -> thread name at first event
+
+
+def _tid() -> int:
+    t = getattr(_TID_TLS, "tid", None)
+    if t is None:
+        with _TID_LOCK:
+            t = len(_TID_NAMES) + 1
+            _TID_NAMES[t] = threading.current_thread().name
+        _TID_TLS.tid = t
+    return t
+
+
+def _append_event(ev: dict):
+    with _events_lock:
+        if len(_events) >= _MAX_EVENTS:
+            # amortized O(1)/event: drop the oldest 1/64th at once
+            del _events[:max(_MAX_EVENTS // 64, 1)]
+        _events.append(ev)
+
+
+def _add_span_event(name: str, ts_us: float, dur_us: float, args=None):
+    """Observability spans land in the host-event buffer under
+    cat='runtime' (called by paddle_tpu.observability.spans while
+    `_recording`; spans bypass the host-tracer level — they are the
+    fused-runtime trace, not python-range detail)."""
+    if not _recording:
+        return
+    ev = {"name": name, "tid": _tid(), "ts": ts_us, "dur": dur_us,
+          "cat": "runtime"}
+    if args:
+        ev["args"] = args
+    _append_event(ev)
+
 
 class RecordEvent:
-    """User-scope host event (profiler/utils.py RecordEvent analog)."""
+    """User-scope host event (profiler/utils.py RecordEvent analog).
+    Disabled cost: one module-level bool per begin/end."""
 
     def __init__(self, name: str, event_type=None):
         self.name = name
         self._t0 = None
 
     def begin(self):
+        if not _TRACER_ON:
+            self._t0 = None
+            return
         self._t0 = time.perf_counter_ns()
 
     def end(self):
-        if self._t0 is None or not _recording:
+        if self._t0 is None or not _TRACER_ON:
             return
         t1 = time.perf_counter_ns()
-        from .._core.flags import flag_value
-        if flag_value("FLAGS_host_tracer_level") < 1:
-            return
-        cap = flag_value("FLAGS_profiler_max_events")
-        with _events_lock:
-            if len(_events) >= cap:
-                # amortized O(1)/event: drop the oldest 1/64th at once
-                del _events[:max(cap // 64, 1)]
-            _events.append({
-                "name": self.name,
-                "tid": threading.get_ident() & 0xFFFF,
-                "ts": self._t0 / 1000.0,       # us, chrome convention
-                "dur": (t1 - self._t0) / 1000.0,
-            })
+        _append_event({
+            "name": self.name,
+            "tid": _tid(),
+            "ts": self._t0 / 1000.0,       # us, chrome convention
+            "dur": (t1 - self._t0) / 1000.0,
+        })
 
     def __enter__(self):
         self.begin()
@@ -113,8 +203,7 @@ def export_chrome_tracing(dir_name: str = None, worker_name: str = None):
     """on_trace_ready factory writing chrome trace json (reference
     chrometracing_logger.cc output shape)."""
     if dir_name is None:
-        from .._core.flags import flag_value
-        dir_name = flag_value("FLAGS_profiler_dir") or "."
+        dir_name = _flags.flag_value("FLAGS_profiler_dir") or "."
     os.makedirs(dir_name, exist_ok=True)
 
     def handler(prof: "Profiler"):
@@ -130,7 +219,8 @@ class Profiler:
     def __init__(self, *, targets=None, scheduler=None,
                  on_trace_ready=None, timer_only: bool = False,
                  record_shapes: bool = False, profile_memory: bool = False,
-                 with_flops: bool = False, emit_nvtx: bool = False):
+                 with_flops: bool = False, emit_nvtx: bool = False,
+                 fused_runtime: Optional[bool] = None):
         self.targets = targets or [ProfilerTarget.CPU]
         if scheduler is None:
             self.scheduler = _default_scheduler
@@ -142,6 +232,11 @@ class Profiler:
             self.scheduler = scheduler
         self.on_trace_ready = on_trace_ready
         self.timer_only = timer_only
+        # fused-runtime recording: keep the fusion window on (no per-op
+        # events; the trace carries segment/comm/optimizer spans)
+        self.fused_runtime = (
+            _flags.flag_value("FLAGS_profiler_fused_runtime")
+            if fused_runtime is None else bool(fused_runtime))
         self.step_num = 0
         self.current_state = ProfilerState.CLOSED
         self._device_tracing = False
@@ -149,25 +244,37 @@ class Profiler:
         self._device_events: List[dict] = []
 
     # ---------------------------------------------------------- lifecycle
+    def _sync_recording(self):
+        """Recompute every consumer of the recording state: the fast
+        RecordEvent gate, the per-op dispatch hook (installed only
+        while actually recording in per-op mode — ops during CLOSED
+        cycles were always dropped, now they skip the detour entirely),
+        and the observability TRACE gate feeding spans into _events."""
+        global _recording, _CUR_PROFILER
+        _recording = self.current_state in (
+            ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN)
+        _CUR_PROFILER = self if _recording else None
+        _refresh_gates()
+        _obs_state.set_trace(_recording)
+        from .._core import executor
+        if _recording and not self.fused_runtime and _TRACER_LEVEL >= 1:
+            executor.set_profile_cb(lambda name: RecordEvent(f"op::{name}"))
+        else:
+            executor.set_profile_cb(None)
+
     def start(self):
-        global _recording
         with _events_lock:
             _events.clear()
         self._device_events = []  # never mix cycles if a capture fails
         self.current_state = self.scheduler(self.step_num)
-        _recording = self.current_state in (
-            ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN)
-        from .._core import executor
-        executor.set_profile_cb(lambda name: RecordEvent(f"op::{name}"))
+        self._sync_recording()
         if _recording:
             self._maybe_device_trace()
         return self
 
     def stop(self):
-        global _recording
-        _recording = False
-        from .._core import executor
-        executor.set_profile_cb(None)
+        self.current_state = ProfilerState.CLOSED
+        self._sync_recording()
         self._stop_device_trace()
         if self.on_trace_ready is not None:
             self.on_trace_ready(self)
@@ -176,7 +283,6 @@ class Profiler:
         prev = self.current_state
         self.step_num += 1
         self.current_state = self.scheduler(self.step_num)
-        global _recording
         if prev == ProfilerState.RECORD_AND_RETURN:
             # cycle boundary: pull the device trace in NOW so the per-cycle
             # export carries this cycle's device events, not none
@@ -184,8 +290,7 @@ class Profiler:
             if self.on_trace_ready is not None:
                 self.on_trace_ready(self)
         was_recording = _recording
-        _recording = self.current_state in (
-            ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN)
+        self._sync_recording()
         if _recording and (not was_recording
                            or prev == ProfilerState.RECORD_AND_RETURN):
             # new record cycle: drop the previous cycle's events so each
@@ -210,52 +315,64 @@ class Profiler:
             import jax
             self._tb_dir = os.environ.get("PADDLE_PROFILER_TB_DIR",
                                           "/tmp/paddle_tpu_profile")
-            # xplane stamps wall-clock ns; host events use perf_counter ns.
-            # Sample both clocks at trace start so device events can be
-            # rebased onto the host timeline at ingest.
+            # xplane may stamp wall-clock ns while host events use
+            # perf_counter ns; sample both clocks (plus the session
+            # start for trace-relative dumps) so device events can be
+            # rebased onto the host timeline at ingest
             self._clock_offset_us = (time.time_ns()
                                      - time.perf_counter_ns()) / 1000.0
+            self._trace_start_perf_us = time.perf_counter_ns() / 1000.0
             jax.profiler.start_trace(self._tb_dir)
             self._device_tracing = True
-        except Exception:
+        except Exception as e:
+            log.warning("device trace: start_trace failed: %r", e)
             self._device_tracing = False
 
     def _stop_device_trace(self):
-        if self._device_tracing:
-            try:
-                import jax
-                jax.profiler.stop_trace()
-                self._ingest_device_trace()
-            except Exception:
-                pass
-            self._device_tracing = False
+        if not self._device_tracing:
+            return
+        self._device_tracing = False
+        try:
+            import jax
+            jax.profiler.stop_trace()
+        except Exception as e:
+            log.warning("device trace: stop_trace failed: %r", e)
+            return
+        try:
+            self._ingest_device_trace()
+        except Exception as e:
+            log.warning("device trace: xplane ingestion failed: %r", e)
 
     def _ingest_device_trace(self):
-        """Parse the captured XLA xplane into per-kernel device events
+        """Parse the captured XLA dump into per-kernel device events
         (the role of the reference's cuda_tracer.cc ingesting CUPTI
-        activity records): planes/lines/events via
-        jax.profiler.ProfileData, merged into the chrome trace under
-        cat='device'."""
-        import glob
-        import jax
-        files = sorted(glob.glob(self._tb_dir + "/**/*.xplane.pb",
-                                 recursive=True), key=os.path.getmtime)
-        if not files:
+        activity records) via profiler/xplane.py, rebasing timestamps
+        onto the host perf_counter timeline. Zero-event ingests log the
+        specific fallback reason instead of passing silently."""
+        from . import xplane
+        events, why = xplane.ingest(self._tb_dir)
+        if why:
+            log.warning("device trace: %s", why)
+        if not events:
             return
-        pd = jax.profiler.ProfileData.from_file(files[-1])
-        out = []
-        for plane in pd.planes:
-            for line in plane.lines:
-                if line.name == "python":
-                    continue  # the host tracer already covers Python
-                tid = f"{plane.name}/{line.name}"
-                offset = getattr(self, "_clock_offset_us", 0.0)
-                for e in line.events:
-                    out.append({"name": e.name, "tid": tid,
-                                "ts": e.start_ns / 1000.0 - offset,
-                                "dur": e.duration_ns / 1000.0,
-                                "cat": "device"})
-        self._device_events = out
+        # per-event clock resolution: one dump can mix wall-clock
+        # device lines with trace-relative derived lines
+        offset = getattr(self, "_clock_offset_us", 0.0)
+        base = getattr(self, "_trace_start_perf_us", 0.0)
+
+        def rebase(ns):
+            if ns > xplane._WALL_CLOCK_MIN_NS:
+                return ns / 1000.0 - offset
+            return base + ns / 1000.0
+
+        self._device_events = [
+            {"name": e["name"], "tid": e["tid"],
+             "ts": rebase(e["start_ns"]),
+             "dur": e["dur_ns"] / 1000.0, "cat": "device"}
+            for e in events]
+        if _obs_state.METRICS:
+            from ..observability import metrics
+            metrics.inc("profiler.device_events", len(self._device_events))
 
     # ------------------------------------------------------------ exports
     def events(self) -> List[dict]:
@@ -277,20 +394,33 @@ class Profiler:
                            key=lambda kv: -kv[1]["total_us"]))
 
     def export(self, path: str, format: str = "json"):
-        trace = {
-            "traceEvents": [
-                {"name": e["name"], "ph": "X", "pid": os.getpid(),
-                 "tid": e["tid"], "ts": e["ts"], "dur": e["dur"],
-                 "cat": "host"}
-                for e in self.events()
-            ] + [
-                {"name": e["name"], "ph": "X", "pid": os.getpid(),
-                 "tid": e["tid"], "ts": e["ts"], "dur": e["dur"],
-                 "cat": "device"}
-                for e in self.device_events()
-            ],
-            "displayTimeUnit": "ms",
-        }
+        pid = os.getpid()
+        trace_events = [
+            {"name": e["name"], "ph": "X", "pid": pid,
+             "tid": e["tid"], "ts": e["ts"], "dur": e["dur"],
+             "cat": e.get("cat", "host"),
+             **({"args": e["args"]} if "args" in e else {})}
+            for e in self.events()
+        ] + [
+            {"name": e["name"], "ph": "X", "pid": pid,
+             "tid": e["tid"], "ts": e["ts"], "dur": e["dur"],
+             "cat": "device"}
+            for e in self.device_events()
+        ]
+        # name the interned host-thread lanes so two python threads are
+        # never confused in the viewer — only lanes with events in THIS
+        # export (under thread churn the intern map remembers every
+        # thread ever seen; re-emitting dead empty lanes would bloat
+        # each cycle's trace)
+        used = {e["tid"] for e in trace_events}
+        with _TID_LOCK:
+            tids = [(i, n) for i, n in _TID_NAMES.items() if i in used]
+        for small_id, tname in tids:
+            trace_events.append({"name": "thread_name", "ph": "M",
+                                 "pid": pid, "tid": small_id,
+                                 "cat": "__metadata",
+                                 "args": {"name": tname}})
+        trace = {"traceEvents": trace_events, "displayTimeUnit": "ms"}
         d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)
