@@ -155,9 +155,13 @@ def bench_static_checks():
     asserted by counting sanitizer sweeps (hooks.segment_sweeps(), the
     sanitizer.segment_sweeps registry counter, frozen across the whole
     off-mode timing; exact, immune to machine noise, unlike a
-    wall-clock delta between two identical code paths). The reported
-    value is warn-mode overhead on the same 32-op lazy chain,
-    min-of-interleaved-rounds."""
+    wall-clock delta between two identical code paths). Fix mode on
+    the same (clean) program must perform ZERO rewrites — the
+    sanitizer.fixes_applied counter stays frozen while the fix-mode
+    sweeps run (the sanitizer must never rewrite correct code). The
+    reported value is warn-mode overhead on the same 32-op lazy chain,
+    min-of-interleaved-rounds; the row json carries the fix-mode
+    overhead alongside."""
     import numpy as np
     import paddle_tpu as paddle
     from paddle_tpu.analysis import hooks
@@ -189,12 +193,24 @@ def bench_static_checks():
             "FLAGS_static_checks=off ran sanitizer sweeps (must be 0)"
         rounds.append((off_t, timed("warn")))
     assert hooks.segment_sweeps() > start, "warn mode never swept"
+
+    # fix mode over a clean program: sweeps run, rewrites do not
+    sweeps_before = hooks.segment_sweeps()
+    fixes_before = hooks.fixes_applied()
+    fix_t = timed("fix")
+    assert hooks.segment_sweeps() > sweeps_before, "fix mode never swept"
+    assert hooks.fixes_applied() == fixes_before, \
+        "FLAGS_static_checks=fix rewrote a clean program (must be 0)"
+
     off = min(r[0] for r in rounds)
     warn = min(r[1] for r in rounds)
     warn_pct = (warn - off) / off * 100.0
     return {"metric": f"static-check overhead ({chain * 2}-op lazy "
-                      f"chain; off = 0 sweeps asserted)",
-            "value": round(warn_pct, 1), "unit": "% warn-mode overhead"}
+                      f"chain; off = 0 sweeps, clean-program fix = 0 "
+                      f"rewrites asserted)",
+            "value": round(warn_pct, 1), "unit": "% warn-mode overhead",
+            "fix_mode_overhead_pct": round((fix_t - off) / off * 100.0,
+                                           1)}
 
 
 def bench_observability():

@@ -218,8 +218,12 @@ define_flag("FLAGS_check_nan_inf_level", 0,
 define_flag("FLAGS_static_checks", "off",
             "Program sanitizer level: 'off' (no cost), 'warn' (run the "
             "paddle_tpu.analysis checkers over every flushed lazy "
-            "segment and IR pass and emit StaticCheckWarning), 'error' "
-            "(raise StaticCheckError on any violation).")
+            "segment, IR pass, reshard lowering, pipeline build and "
+            "SOT capture, emitting StaticCheckWarning), 'error' (raise "
+            "StaticCheckError on any violation), 'fix' (repair the "
+            "mechanical classes — missing note_inplace, unsafe "
+            "donation, dead captures — in place, re-check, and warn "
+            "for whatever could not be repaired).")
 # off-synonym values the hot-path gates (lazy record/flush, PassManager)
 # test membership against — keeps '0'/'false' spellings from paying the
 # analysis import or even a str() call per recorded op. The lowercase

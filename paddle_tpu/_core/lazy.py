@@ -148,6 +148,15 @@ class _PendingOp:
         self.src = src
 
 
+# the view-op family the sanitizer's alias graph tracks (reference
+# semantics alias storage). THE authoritative set — it lives here so
+# the record hot path gates on it without importing analysis;
+# analysis.alias_graph re-exports it as VIEW_OP_NAMES
+_VIEW_OP_NAMES = frozenset((
+    "reshape", "squeeze", "unsqueeze", "flatten_", "transpose",
+    "view_slice", "view_dtype", "strided_slice_", "diagonal_", "split_",
+))
+
 # str(np.dtype) costs ~10us a call and the dispatch hot path needs it
 # for every input of every signature — memoized per dtype object
 _DTYPE_STR: Dict[Any, str] = {}
@@ -310,6 +319,18 @@ class CaptureContext:
         if _flags.STATIC_CHECKS_ACTIVE:
             from ..analysis.hooks import call_site
             src = call_site()
+            if op.name in _VIEW_OP_NAMES:
+                # cross-segment alias graph: reference view semantics
+                # share storage with the base, so the sanitizer tracks
+                # view->base edges process-wide (paddle_tpu.analysis.
+                # alias_graph) to catch a later donation/in-place
+                # mutation of the base while the view lives on. EVERY
+                # output aliases the base (split_ returns N views)
+                base = next((t for t in ts if t is not None), None)
+                if base is not None:
+                    from ..analysis import alias_graph as _ag
+                    for _out in outs:
+                        _ag.note_view(_out, base, op.name, src)
         self.pending.append(_PendingOp(op, dict(attrs), wiring, out_refs,
                                        src))
         self._sig_ops.append((op.name, akey, wiring, len(out_refs)))
@@ -376,16 +397,29 @@ class CaptureContext:
         # program sanitizer (paddle_tpu.analysis): one cached-gate read
         # when off; in warn/error mode the segment checkers run over the
         # program about to execute (donation safety, in-place races,
-        # tracer leaks, shape/dtype drift). 'error' stops a corrupting
-        # launch — drop the trace like a failed compile would.
+        # tracer leaks, shape/dtype drift, cross-segment donation, view
+        # aliases). 'error' stops a corrupting launch — drop the trace
+        # like a failed compile would. 'fix' repairs the mechanical
+        # classes in place and hands back the rewritten (pending,
+        # donate) pair; a pruned op list invalidates the incremental
+        # live/signature state, so both are recomputed before dispatch.
+        _checks_on = False
         if _flags.STATIC_CHECKS_ACTIVE:
             from ..analysis import hooks as _sanitizer
             try:
                 _mode = _sanitizer.check_mode()   # full normalization
                 if _mode != "off":
-                    _sanitizer.on_segment_flush(
+                    _checks_on = True
+                    _fixed = _sanitizer.on_segment_flush(
                         self, pending, in_vals, in_meta, in_tensors,
-                        live, live_refs, donate, _mode)
+                        live, live_refs, donate, _mode, fixable=True,
+                        reason=reason)
+                    if _fixed is not None:
+                        new_pending, donate = _fixed
+                        if new_pending is not pending:
+                            pending = new_pending
+                            live, live_refs = self._live_outputs(pending)
+                            sig = self._signature(in_vals, live)
             except Exception:
                 self._reset_segment()
                 raise
@@ -430,6 +464,13 @@ class CaptureContext:
                 fspan.end(error=e)
             _obs_flush_failed(reason, e)
             raise
+        if _checks_on and donate:
+            # cross-segment ledger (sanitizer dataflow): recorded only
+            # AFTER the executable ran — a failed compile/run donated
+            # nothing, and a phantom entry would turn a valid later
+            # program into a false cross_segment_donation error
+            from ..analysis.dataflow import note_segment_donation
+            note_segment_donation(in_vals, donate, reason, pending)
         self._reset_segment()
         self.breaks.append(reason)
         self.segments_run += 1
@@ -1105,7 +1146,9 @@ def try_fused_backward(tensors, grad_tensors) -> bool:
     # the sanitizer covers the fused fwd+vjp path exactly like a plain
     # flush — this IS the default steady-state train step, so 'error'
     # mode must stop a corrupted program here too (no donation mask:
-    # fused-step inputs are the backward residuals)
+    # fused-step inputs are the backward residuals). fixable=False:
+    # the root/live layout is baked into the step-cache key, so fix
+    # mode reports here instead of rewriting.
     from . import flags
     if _flags.STATIC_CHECKS_ACTIVE:
         from ..analysis import hooks as _sanitizer
@@ -1114,7 +1157,8 @@ def try_fused_backward(tensors, grad_tensors) -> bool:
             if _mode != "off":
                 _sanitizer.on_segment_flush(
                     ctx, pending, in_vals, in_meta, in_tensors,
-                    live, live_refs, (), _mode)
+                    live, live_refs, (), _mode, fixable=False,
+                    reason="backward_fused")
         except Exception:
             ctx._reset_segment()
             raise

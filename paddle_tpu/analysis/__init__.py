@@ -1,20 +1,32 @@
-"""paddle_tpu.analysis — the program sanitizer.
+"""paddle_tpu.analysis — the whole-program sanitizer.
 
-A static-analysis framework over the two program representations the
+A static-analysis framework over every program representation the
 framework produces:
 
 - lazy `CaptureContext` segments (`_PendingOp` dataflow, _core/lazy.py)
 - IR `Workspace` programs (ir/pass_base.py)
+- the SOT guarded fast-path cache (jit/sot)
+- distributed lowerings (reshard transitions, pipeline schedules)
 
-Five checkers ship by default: donation safety, in-place race
-detection, tracer-leak detection, shape/dtype consistency, and
-effect/purity verification for IR passes. Three surfaces:
+Eleven checkers ship: the per-program five (donation safety, in-place
+races, tracer leaks, shape/dtype drift, IR pass effect/purity) plus the
+cross-program wave — cross-segment donation (buffer identity threaded
+across the fused fwd+vjp+optimizer step-cache boundary), view alias
+graphs (a view of a donated/mutated base, even segments later), dead
+captures (recorded ops nobody can observe, with the wasted FLOPs/bytes),
+SOT guard soundness (never-firing and shadowed cache entries), reshard
+placement validation, and pipeline-schedule deadlock/ordering
+simulation. Surfaces:
 
-- `FLAGS_static_checks` = off | warn | error, wired into
-  `CaptureContext.flush` and `PassManager.run`;
-- this module's `check_segment(ctx)` / `check_program(program)` API;
-- `python -m paddle_tpu.analysis` — traces the bench_suite models and
-  reports.
+- `FLAGS_static_checks` = off | warn | error | fix, wired into
+  `CaptureContext.flush`, `try_fused_backward`, `PassManager.run`,
+  reshard lowering, pipeline-runtime construction, and SOT capture;
+  `fix` repairs the mechanical classes (missing note_inplace, unsafe
+  donation, dead captures) in place and re-checks;
+- this module's `check_segment` / `check_program` / `check_guards` /
+  `check_reshard` / `check_pipeline_schedule` API;
+- `python -m paddle_tpu.analysis` — traces the bench_suite models plus
+  the distributed configs and reports (`--json`, `--fix`).
 """
 from __future__ import annotations
 
@@ -23,26 +35,39 @@ from typing import Optional, Sequence, Tuple
 from .diagnostics import (CheckReport, Diagnostic, StaticCheckError,
                           StaticCheckWarning, SEVERITY_ERROR,
                           SEVERITY_WARNING)
-from .segment_checks import (SegmentView, check_donation_safety,
+from .segment_checks import (SegmentView, check_dead_captures,
+                             check_donation_safety,
                              check_inplace_races,
                              check_process_tracer_leaks,
                              check_shape_dtype, check_tracer_leaks)
 from .program_checks import (check_pass_effects, check_program_shapes,
                              impure_fingerprint)
-from . import hooks
+from .dataflow import check_cross_segment_donation
+from .alias_graph import check_view_aliases
+from .sot_checks import check_guards
+from .distributed_checks import (check_pipeline_schedule, check_reshard,
+                                 simulate_pipeline)
+from . import alias_graph, dataflow, distributed_checks, fixes, hooks, \
+    sot_checks
 
 __all__ = [
     "CheckReport", "Diagnostic", "StaticCheckError",
     "StaticCheckWarning", "SegmentView", "check_segment",
-    "check_program", "check_process_tracer_leaks",
+    "check_program", "check_process_tracer_leaks", "check_guards",
+    "check_reshard", "check_pipeline_schedule", "simulate_pipeline",
+    "check_cross_segment_donation", "check_view_aliases",
+    "check_dead_captures", "fix_segment",
 ]
 
 
 def check_segment(ctx_or_view, donate: Optional[Tuple[int, ...]] = None,
-                  process: bool = False) -> CheckReport:
+                  process: bool = False, lints: bool = True) -> CheckReport:
     """Run every segment checker over an open CaptureContext (or a
     prebuilt SegmentView). Non-destructive: nothing is flushed or
     mutated; the donation mask defaults to what flush() would compute.
+    `lints=False` drops the optimization lints (dead captures, strict
+    view/in-place divergence), leaving only the correctness checkers
+    the flush hook runs.
 
         with lazy_guard() as ctx:
             ... record ops ...
@@ -53,14 +78,40 @@ def check_segment(ctx_or_view, donate: Optional[Tuple[int, ...]] = None,
         view = ctx_or_view
     else:
         view = SegmentView.from_context(ctx_or_view, donate=donate)
-    report = CheckReport(f"lazy segment ({len(view.pending)} ops)")
-    check_donation_safety(view, report)
-    check_inplace_races(view, report, strict=True)
-    check_tracer_leaks(view, report)
-    check_shape_dtype(view, report)
+    # the one shared battery (hooks.run_segment_checkers) — the flush
+    # hook runs the same list non-strict/lint-free
+    report = hooks.run_segment_checkers(
+        view, f"lazy segment ({len(view.pending)} ops)", lints=lints,
+        strict_inplace=True, strict_views=lints)
     if process:
         check_process_tracer_leaks(report)
     return report
+
+
+def fix_segment(ctx_or_view, report: Optional[CheckReport] = None,
+                dry_run: bool = False):
+    """Repair the mechanical finding classes of `report` (computed via
+    check_segment when not given) against the context/view, and return
+    (FixResult, post_fix_report). With `dry_run` nothing is mutated —
+    the CLI's diff-printout path."""
+    if isinstance(ctx_or_view, SegmentView):
+        view, ctx = ctx_or_view, None
+    else:
+        view = SegmentView.from_context(ctx_or_view)
+        ctx = ctx_or_view
+    if report is None:
+        report = check_segment(view)
+    result = fixes.plan_and_apply(view, report, ctx=ctx,
+                                  dry_run=dry_run)
+    if dry_run:
+        # residual = the findings no planned repair addresses
+        addressed = {id(d) for d in result.consumed}
+        post = CheckReport(report.subject + " (fix dry-run residual)")
+        post.diagnostics = [d for d in report.diagnostics
+                            if id(d) not in addressed]
+    else:
+        post = check_segment(view)
+    return result, post
 
 
 def check_program(program_or_ws, protected: Sequence = ()) -> CheckReport:

@@ -1,32 +1,53 @@
-"""CLI: trace the bench_suite models and run the program sanitizer.
+"""CLI: trace the bench_suite + distributed configs, run the sanitizer.
 
-    python -m paddle_tpu.analysis [--models lenet,resnet50,bert]
-                                  [--execute] [--verbose]
+    python -m paddle_tpu.analysis
+        [--models lenet,resnet50,bert,reshard,pipeline]
+        [--execute] [--verbose] [--json] [--fix]
 
 Default is record-only: each model's forward(+loss) is RECORDED into a
 lazy capture window (aval inference, no XLA compile/run), the segment
 checkers sweep the pending program, and for the eager models a static
 Program is also recorded and swept through the default IR pass pipeline
-with the post-pass verify hook armed. `--execute` additionally flushes
-each segment end to end. Exit code 0 = no findings.
+with the post-pass verify hook armed. The distributed models sweep the
+reshard placement-transition matrix and the four pipeline schedules.
+`--execute` additionally flushes each segment end to end. `--json`
+emits the machine-readable report (the observability CLI's snapshot
+shape: headline numbers + a `counters` block). `--fix` plans the
+mechanical repairs for every finding and prints the dry-run diff (the
+runtime equivalent is `FLAGS_static_checks=fix`). Exit code 0 = no
+findings (post-fix findings when --fix).
 """
 from __future__ import annotations
 
 import argparse
+import json
 import sys
+
+_JSON = {"models": {}}
+_FIX = False        # set by --fix: plan repairs + print dry-run diffs
+
+
+def _note(name: str, report):
+    _JSON["models"].setdefault(name, []).append(report.to_dict())
 
 
 def _trace_eager(build_fn, name: str, execute: bool, verbose: bool):
     """Record one train-shaped forward into a capture window and sweep
-    it. Returns the CheckReport."""
+    it. Returns the CheckReport (the dry-run residual under --fix)."""
     import paddle_tpu as paddle
     from paddle_tpu import analysis
     from paddle_tpu._core import lazy
 
     with lazy.lazy_guard(max_segment_ops=1 << 30) as ctx:
-        build_fn()
+        # hold the root alive through the sweep: a dropped loss tensor
+        # would (correctly) flag the whole trace as dead captures
+        out = build_fn()
         report = analysis.check_segment(ctx, process=True)
         n_ops = len(ctx.pending)
+        if _FIX and not report.ok:
+            result, report = analysis.fix_segment(ctx, report,
+                                                  dry_run=True)
+            print(result.diff())
         if execute:
             ctx.flush("cli")
         else:
@@ -37,6 +58,7 @@ def _trace_eager(build_fn, name: str, execute: bool, verbose: bool):
     if verbose or not report.ok:
         for d in report.diagnostics:
             print("   ", d.render())
+    _note(name, report)
     return report
 
 
@@ -65,6 +87,7 @@ def _trace_static(build_fn, feeds, name: str, verbose: bool):
     if verbose or not report.ok:
         for d in report.diagnostics:
             print("   ", d.render())
+    _note(name, report)
     return report
 
 
@@ -126,6 +149,7 @@ def run_bert(execute: bool, verbose: bool):
           f"tracer sweep: {len(report.diagnostics)} finding(s)")
     for d in report.diagnostics:
         print("   ", d.render())
+    _note("bert", report)
 
     def attn_proxy():
         q = paddle.to_tensor(
@@ -137,22 +161,120 @@ def run_bert(execute: bool, verbose: bool):
             _trace_eager(attn_proxy, "bert-attn-proxy", execute, verbose)]
 
 
+def run_reshard(execute: bool, verbose: bool):
+    """Distributed sweep 1: the reshard placement-transition matrix on
+    a mesh built from the visible devices — every pairwise {r,s,p}
+    move plus an nd-mesh multi-axis change, each validated against the
+    SPMD rules AND executed (reshard_value runs under the sanitizer
+    hook, so this sweeps the live lowering path)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from paddle_tpu import analysis
+    from paddle_tpu.distributed import ProcessMesh
+    from paddle_tpu.distributed.auto_parallel.reshard_functions import (
+        DistAttrLite, reshard_value)
+    from paddle_tpu.distributed.placements import (Partial, Replicate,
+                                                   Shard)
+
+    n = jax.device_count()
+    mesh = ProcessMesh(list(range(n)), dim_names=["x"])
+    # both dims multiples of every mesh-axis size in play, whatever
+    # the visible device count, so the clean sweep stays clean
+    val = jnp.asarray(np.random.RandomState(0)
+                      .randn(2 * n, 4 * n).astype("float32"))
+    report = analysis.CheckReport("reshard transition matrix")
+    transitions = [
+        (mesh, [Replicate()], [Shard(0)]),
+        (mesh, [Shard(0)], [Replicate()]),
+        (mesh, [Shard(0)], [Shard(1)]),
+        (mesh, [Replicate()], [Partial()]),
+        (mesh, [Partial()], [Replicate()]),    # stacked-Partial source
+    ]
+    if n >= 4 and n % 2 == 0:
+        mesh2 = ProcessMesh(
+            np.arange(n).reshape(2, n // 2), dim_names=["a", "b"])
+        transitions.append((mesh2, [Shard(0), Replicate()],
+                            [Replicate(), Shard(1)]))
+    import warnings as _warnings
+    from paddle_tpu.analysis import StaticCheckWarning
+    ran = 0
+    for m, src_p, dst_p in transitions:
+        v = val
+        if any(p.is_partial() for p in src_p):
+            v = jnp.stack([val] * n)
+        # checker findings collected directly (the CLI sweeps in warn
+        # mode, where the hook warns instead of raising), THEN the
+        # live lowering path runs under the same hook — its duplicate
+        # warning for findings already in the report is silenced
+        analysis.check_reshard(
+            v.ndim, DistAttrLite(m, src_p), DistAttrLite(m, dst_p),
+            report, global_shape=tuple(val.shape))
+        with _warnings.catch_warnings():
+            _warnings.simplefilter("ignore", StaticCheckWarning)
+            reshard_value(v, m, src_p, m, dst_p)
+        ran += 1
+    print(f"[reshard] {ran} transitions lowered under the sanitizer, "
+          f"{len(report.diagnostics)} finding(s)")
+    if verbose or not report.ok:
+        for d in report.diagnostics:
+            print("   ", d.render())
+    _note("reshard", report)
+    return [report]
+
+
+def run_pipeline(execute: bool, verbose: bool):
+    """Distributed sweep 2: lower and simulate every host-driven
+    pipeline schedule for a pod-shaped config (deadlock / P2P-ordering
+    verification over the exact generators the runtimes execute)."""
+    from paddle_tpu import analysis
+
+    reports = []
+    configs = [("FThenB", 4, 8, 1), ("1F1B", 4, 8, 1),
+               ("VPP", 4, 8, 2), ("ZeroBubble", 4, 8, 1)]
+    for sched, P, m, C in configs:
+        r = analysis.check_pipeline_schedule(sched, P, m, num_chunks=C)
+        print(f"[pipeline] {sched} (P={P}, m={m}"
+              + (f", C={C}" if C != 1 else "")
+              + f"): {len(r.diagnostics)} finding(s)")
+        if verbose or not r.ok:
+            for d in r.diagnostics:
+                print("   ", d.render())
+        _note("pipeline", r)
+        reports.append(r)
+    return reports
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="python -m paddle_tpu.analysis")
-    ap.add_argument("--models", default="lenet,resnet50,bert",
-                    help="comma list: lenet,resnet50,bert")
+    ap.add_argument("--models",
+                    default="lenet,resnet50,bert,reshard,pipeline",
+                    help="comma list: lenet,resnet50,bert,reshard,"
+                         "pipeline")
     ap.add_argument("--execute", action="store_true",
                     help="also flush/execute each recorded segment")
     ap.add_argument("--verbose", action="store_true",
                     help="print every diagnostic, not just findings")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the machine-readable report (the "
+                         "observability CLI's snapshot shape)")
+    ap.add_argument("--fix", action="store_true",
+                    help="plan the mechanical repairs and print the "
+                         "dry-run diff; exit code reflects the "
+                         "post-fix residual")
     args = ap.parse_args(argv)
+
+    global _FIX
+    _FIX = bool(args.fix)
+    _JSON["models"] = {}     # fresh accumulator per invocation
 
     import paddle_tpu as paddle
     # provenance is captured at record time only when checks are on
     paddle.set_flags({"FLAGS_static_checks": "warn"})
 
     table = {"lenet": run_lenet, "resnet50": run_resnet50,
-             "bert": run_bert}
+             "bert": run_bert, "reshard": run_reshard,
+             "pipeline": run_pipeline}
     reports = []
     for m in args.models.split(","):
         m = m.strip()
@@ -166,6 +288,20 @@ def main(argv=None) -> int:
     findings = sum(len(r.diagnostics) for r in reports)
     print(f"== static analysis: {findings} finding(s) across "
           f"{len(reports)} program(s)")
+    if args.json:
+        from .hooks import fixes_applied, segment_sweeps
+        from ..observability import metrics
+        snap = metrics.snapshot()
+        payload = {
+            "findings": findings,
+            "programs": sum(len(v) for v in _JSON["models"].values()),
+            "segment_sweeps": segment_sweeps(),
+            "fixes_applied": fixes_applied(),
+            "models": _JSON["models"],
+            "counters": {k: v for k, v in snap["counters"].items()
+                         if k.startswith("sanitizer.")},
+        }
+        print(json.dumps(payload))
     return 0 if findings == 0 else 1
 
 

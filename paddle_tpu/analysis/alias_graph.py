@@ -1,0 +1,228 @@
+"""View alias graph: strided/reshaped views tracked across segments.
+
+The reference's dygraph view ops (reshape / squeeze / slice / ...)
+return tensors that SHARE STORAGE with their base. This build's
+XLA-functional runtime materializes views as fresh arrays, but the
+semantic contract users program against is the reference's — and two
+runtime mechanisms re-introduce real storage sharing: XLA may alias a
+view-shaped output onto its input buffer inside a compiled segment,
+and buffer donation frees the base's storage outright. A view whose
+base is donated (or mutated in place) is therefore a bug even when the
+view op was recorded SEGMENTS ago — which is exactly why the per-flush
+checkers never saw this class.
+
+`note_view` is called from `CaptureContext.record` (only under
+FLAGS_static_checks — the edge capture shares the provenance gate) for
+every view-class op, building a process-wide graph of
+view-tensor -> base-tensor edges keyed by both base-tensor identity
+and base-payload identity (so a base whose wrapper died is still
+matched at donation time via the payload the segment registered).
+
+`check_view_aliases` runs in the flush sweep: donating an input whose
+live views exist is an error; `strict` (the check_segment API)
+additionally warns when a base was mutated in place while views
+recorded before the mutation are still live — the silent
+view-semantics divergence class.
+"""
+from __future__ import annotations
+
+import weakref
+from typing import Dict, List, Optional
+
+from .diagnostics import SEVERITY_ERROR, SEVERITY_WARNING, CheckReport
+
+CHECKER_VIEW = "view_alias"
+
+# ops whose REFERENCE semantics alias their input's storage (the
+# dygraph view family; python/paddle/tensor/manipulation.py view ops).
+# The authoritative set lives in _core.lazy (the record hot path gates
+# on it without importing this module); re-exported here for checkers
+# and tests.
+from .._core.lazy import _VIEW_OP_NAMES as VIEW_OP_NAMES  # noqa: E402
+
+_MAX_EDGES = 4096
+
+
+class _ViewEdge:
+    __slots__ = ("view_ref", "base_ref", "op_name", "src",
+                 "base_version", "base_payload_ref", "seq")
+
+    def __init__(self, view_t, base_t, op_name, src, seq):
+        self.view_ref = weakref.ref(view_t)
+        self.base_ref = weakref.ref(base_t)
+        self.op_name = op_name
+        self.src = src                      # record-site provenance
+        self.base_version = base_t._inplace_version
+        # payload EPOCH at record time as a WEAKREF (None while the
+        # base was lazy or unweakreffable): a view created after a
+        # note_inplace payload swap aliases the NEW storage, so
+        # donating the old snapshot must not flag it — and identity is
+        # validated through the ref, never a raw id, so CPython id
+        # reuse can't alias a dead epoch onto a fresh payload
+        payload = base_t._payload
+        self.base_payload_ref = None
+        if not getattr(payload, "_is_lazy_ref", False):
+            try:
+                self.base_payload_ref = weakref.ref(payload)
+            except TypeError:
+                pass
+        self.seq = seq
+
+    def same_payload(self, payload) -> bool:
+        return self.base_payload_ref is not None \
+            and self.base_payload_ref() is payload
+
+
+class AliasGraph:
+    """view -> base edges, queryable by base tensor or base payload."""
+
+    def __init__(self):
+        # id(base tensor) -> edges; payload ids resolved through
+        # _by_payload because the donated snapshot outlives the wrapper
+        self._by_base: Dict[int, List[_ViewEdge]] = {}
+        self._by_payload: Dict[int, List[_ViewEdge]] = {}
+        self._payload_refs: Dict[int, object] = {}
+        self._seq = 0
+        self._edges = 0
+
+    def note_view(self, view_t, base_t, op_name: str,
+                  src: Optional[str] = None):
+        self._seq += 1
+        edge = _ViewEdge(view_t, base_t, op_name, src, self._seq)
+        self._by_base.setdefault(id(base_t), []).append(edge)
+        payload = base_t._payload
+        if not getattr(payload, "_is_lazy_ref", False):
+            try:
+                pref = weakref.ref(payload)
+            except TypeError:
+                pref = None
+            if pref is not None:
+                self._by_payload.setdefault(id(payload), []).append(edge)
+                self._payload_refs[id(payload)] = pref
+        self._edges += 1
+        if self._edges > _MAX_EDGES:
+            self._sweep()
+
+    def live_views(self, base_t=None, payload=None) -> List[_ViewEdge]:
+        """Edges whose view tensor is still alive, matched by base
+        tensor identity and/or by the payload the base registered."""
+        found: List[_ViewEdge] = []
+        seen = set()
+        buckets = []
+        if base_t is not None:
+            for e in self._by_base.get(id(base_t), ()):
+                if e.base_ref() is base_t:
+                    buckets.append(e)
+        if payload is not None:
+            pref = self._payload_refs.get(id(payload))
+            if pref is not None and pref() is payload:
+                # per-edge validation too: an id-reused bucket may mix
+                # a dead payload's stale edges with the fresh one's
+                buckets.extend(
+                    e for e in self._by_payload.get(id(payload), ())
+                    if e.same_payload(payload))
+        for e in buckets:
+            if id(e) in seen:
+                continue
+            seen.add(id(e))
+            if e.view_ref() is not None:
+                found.append(e)
+        return found
+
+    def _sweep(self):
+        # _by_base edges need both endpoints alive; _by_payload edges
+        # need the VIEW and the PAYLOAD alive — a dead base WRAPPER is
+        # exactly the case payload-identity matching exists for (the
+        # donated snapshot outlives the wrapper), so base_ref death
+        # must not evict them
+        for k in list(self._by_base):
+            kept = [e for e in self._by_base[k]
+                    if e.view_ref() is not None
+                    and e.base_ref() is not None]
+            if kept:
+                self._by_base[k] = kept
+            else:
+                del self._by_base[k]
+        for k in list(self._by_payload):
+            pref = self._payload_refs.get(k)
+            if pref is None or pref() is None:
+                del self._by_payload[k]
+                self._payload_refs.pop(k, None)
+                continue
+            kept = [e for e in self._by_payload[k]
+                    if e.view_ref() is not None]
+            if kept:
+                self._by_payload[k] = kept
+            else:
+                del self._by_payload[k]
+                self._payload_refs.pop(k, None)
+        self._edges = sum(len(v) for v in self._by_base.values()) \
+            + sum(len(v) for v in self._by_payload.values())
+
+    def clear(self):
+        self._by_base.clear()
+        self._by_payload.clear()
+        self._payload_refs.clear()
+        self._edges = 0
+
+
+GRAPH = AliasGraph()
+
+
+def note_view(view_t, base_t, op_name: str, src: Optional[str] = None):
+    GRAPH.note_view(view_t, base_t, op_name, src)
+
+
+def check_view_aliases(view, report: CheckReport, strict: bool = False):
+    """(a) a donated input must have no live view tensors — on an
+    aliasing/donating backend the view's storage is the base's, and
+    donation frees it; (b) strict mode: a base mutated in place while
+    views recorded before the mutation are still live silently diverges
+    from the reference's shared-storage view semantics."""
+    for i in view.donate:
+        if i >= len(view.in_vals):
+            continue            # donation_safety already reports range
+        t = view.in_tensors[i]
+        edges = GRAPH.live_views(base_t=t, payload=view.in_vals[i])
+        # payload-EPOCH filter: a view recorded after a note_inplace
+        # payload swap aliases the NEW storage — donating the old
+        # snapshot cannot touch it. Lazy-epoch edges (base pending at
+        # record) materialized their own buffer at flush and are
+        # equally safe against donation of the registered snapshot.
+        # Identity goes through the edge's weakref (same_payload), so
+        # a reused id can never resurrect a dead epoch.
+        edges = [e for e in edges if e.same_payload(view.in_vals[i])]
+        for e in edges:
+            where = f" (view recorded at {e.src})" if e.src else ""
+            report.add(
+                CHECKER_VIEW,
+                f"input {i} donated but a live tensor still views its "
+                f"storage through '{e.op_name}'{where}: donation frees "
+                f"the base buffer the view aliases",
+                severity=SEVERITY_ERROR,
+                hint="drop the donation while views of the base are "
+                     "alive, or materialize the view first",
+                data={"input": i, "donate_index": i})
+    if not strict:
+        return
+    for i, t in enumerate(view.in_tensors):
+        if t is None:
+            continue
+        for e in GRAPH.live_views(base_t=t):
+            if t._inplace_version > e.base_version:
+                where = f" (view recorded at {e.src})" if e.src else ""
+                report.add(
+                    CHECKER_VIEW,
+                    f"input {i} mutated in place (version "
+                    f"{e.base_version} -> {t._inplace_version}) while a "
+                    f"'{e.op_name}' view created before the mutation is "
+                    f"still live{where}: reference view semantics would "
+                    f"propagate the write into the view; this runtime's "
+                    f"snapshot will not",
+                    severity=SEVERITY_WARNING,
+                    hint="re-derive the view after mutating the base, "
+                         "or mutate through the view")
+
+
+def reset():
+    GRAPH.clear()

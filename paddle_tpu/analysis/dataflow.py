@@ -1,0 +1,165 @@
+"""Cross-segment dataflow: buffer identity threaded across flushes.
+
+PR 2's checkers see one program at a time, but the steady-state train
+step spans THREE executables — the fused fwd+vjp step, the donated
+optimizer update, and the next step's forward — and the donation bugs
+that matter live exactly on those boundaries: a buffer donated by one
+program (its device storage freed / reused in place) must never be
+registered as an input of a later one.
+
+`BufferLedger` is the process-wide identity tracker. Every donating
+site notes the buffers it hands to XLA (lazy-segment flush via
+`hooks.on_segment_flush`, the fused optimizer step via
+`Optimizer.step`), keyed by `id(value)` and validated by weakref so
+CPython id reuse can never alias a dead record onto a fresh live
+array. `check_cross_segment_donation` then runs inside the ordinary
+per-flush sweep: any input of the NEXT program whose payload identity
+matches a previously-donated buffer is a read-after-free the per-flush
+checkers were structurally blind to.
+
+Gating: every entry point is reached only under FLAGS_static_checks
+(warn/error/fix) — off mode records nothing and pays nothing.
+"""
+from __future__ import annotations
+
+import weakref
+from typing import Dict, Optional
+
+from .diagnostics import SEVERITY_ERROR, CheckReport
+
+CHECKER_XSEG = "cross_segment_donation"
+
+# ledger size bound: donation records whose buffer is already collected
+# are swept on insert; this cap only matters if thousands of donated
+# buffers stay alive simultaneously (CPU backends ignore donation)
+_MAX_RECORDS = 4096
+
+
+class _DonationRecord:
+    __slots__ = ("ref", "origin", "provenance", "seq")
+
+    def __init__(self, ref, origin: str, provenance: Optional[str],
+                 seq: int):
+        self.ref = ref              # weakref to the donated value
+        self.origin = origin        # which program donated it
+        self.provenance = provenance
+        self.seq = seq
+
+
+class BufferLedger:
+    """id(value) -> donation record, weakref-validated."""
+
+    def __init__(self):
+        self._records: Dict[int, _DonationRecord] = {}
+        self._seq = 0
+
+    def note_donation(self, vals, indices, origin: str,
+                      provenance: Optional[str] = None) -> int:
+        """Record that `vals[i] for i in indices` were donated by
+        `origin`. Returns how many buffers were newly tracked."""
+        from ..observability import metrics
+        self._seq += 1
+        tracked = 0
+        for i in indices:
+            v = vals[i]
+            try:
+                ref = weakref.ref(v)
+            except TypeError:
+                # unweakreffable value: identity can't be validated
+                # against id reuse, so tracking it risks false
+                # positives — skip
+                continue
+            self._records[id(v)] = _DonationRecord(
+                ref, origin, provenance, self._seq)
+            tracked += 1
+        if tracked:
+            metrics.inc("sanitizer.tracked_donations", tracked)
+        if len(self._records) > _MAX_RECORDS:
+            self._sweep()
+        return tracked
+
+    def lookup(self, v) -> Optional[_DonationRecord]:
+        """The donation record for this exact value object, if any."""
+        rec = self._records.get(id(v))
+        if rec is None:
+            return None
+        if rec.ref() is not v:
+            # the donated buffer died and CPython reused its id for a
+            # fresh (live, never-donated) object: stale entry
+            del self._records[id(v)]
+            return None
+        return rec
+
+    def _sweep(self):
+        dead = [k for k, rec in self._records.items() if rec.ref() is None]
+        for k in dead:
+            del self._records[k]
+        while len(self._records) > _MAX_RECORDS:
+            # oldest-first eviction keeps the ledger bounded even if
+            # every tracked buffer is somehow still alive
+            k = min(self._records, key=lambda k: self._records[k].seq)
+            del self._records[k]
+
+    def __len__(self):
+        return len(self._records)
+
+    def clear(self):
+        self._records.clear()
+
+
+LEDGER = BufferLedger()
+
+
+def note_segment_donation(in_vals, donate, reason: str,
+                          pending=None) -> int:
+    """Flush-site hook: the donation mask a lazy-segment flush is about
+    to hand to jax.jit's donate_argnums."""
+    if not donate:
+        return 0
+    origin = f"lazy segment flush[{reason}]"
+    prov = None
+    if pending:
+        prov = next((getattr(p, "src", None) for p in pending
+                     if getattr(p, "src", None)), None)
+    return LEDGER.note_donation(in_vals, donate, origin, prov)
+
+
+def note_optimizer_donation(pvals, state_leaves, optimizer_name: str) -> int:
+    """Optimizer-site hook: the fused update donates the OLD param and
+    state buffers (donate_argnums=(0, 2)); after step() swaps the
+    payloads those buffers are freed on donating backends."""
+    vals = list(pvals) + list(state_leaves)
+    return LEDGER.note_donation(
+        vals, range(len(vals)),
+        f"fused optimizer update ({optimizer_name})")
+
+
+def check_cross_segment_donation(view, report: CheckReport):
+    """No input of this segment may be a buffer some EARLIER program
+    donated: the device storage was freed (or reused for that
+    program's outputs), so executing this segment reads garbage. The
+    per-flush donation checker cannot see this class — by the time the
+    reading segment flushes, the donating one is long gone."""
+    for i, v in enumerate(view.in_vals):
+        rec = LEDGER.lookup(v)
+        if rec is None:
+            continue
+        readers = view.readers_of_input(i)
+        fields = (view.op_diag_fields(readers[0]) if readers else {})
+        where = f" (donated at {rec.provenance})" if rec.provenance else ""
+        report.add(
+            CHECKER_XSEG,
+            f"input {i} was donated by an earlier program "
+            f"[{rec.origin}]{where}: its buffer is freed on donating "
+            f"backends, so this segment reads garbage",
+            severity=SEVERITY_ERROR,
+            hint="the donated tensor's payload must be replaced before "
+                 "it is read again (note_inplace/_replace_value_inplace"
+                 "), or the donation suppressed while aliases live",
+            data={"input": i},
+            **fields)
+
+
+def reset():
+    """Test hook: drop all tracked donations."""
+    LEDGER.clear()

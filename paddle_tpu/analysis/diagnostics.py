@@ -37,14 +37,15 @@ class StaticCheckError(RuntimeError):
 
 class Diagnostic:
     __slots__ = ("checker", "severity", "message", "op_index", "op_name",
-                 "provenance", "hint")
+                 "provenance", "hint", "data")
 
     def __init__(self, checker: str, message: str,
                  severity: str = SEVERITY_ERROR,
                  op_index: Optional[int] = None,
                  op_name: Optional[str] = None,
                  provenance: Optional[str] = None,
-                 hint: Optional[str] = None):
+                 hint: Optional[str] = None,
+                 data: Optional[dict] = None):
         self.checker = checker
         self.severity = severity
         self.message = message
@@ -52,6 +53,10 @@ class Diagnostic:
         self.op_name = op_name
         self.provenance = provenance
         self.hint = hint
+        # machine-readable finding payload (input index, donate slot,
+        # dead op list, ...) — what fixes.py plans repairs from, so the
+        # autofixer never has to re-parse rendered messages
+        self.data = data
 
     def render(self) -> str:
         where = ""
@@ -102,12 +107,46 @@ class CheckReport:
         return "\n".join([head] + ["  " + d.render()
                                    for d in self.diagnostics])
 
+    def to_dict(self) -> dict:
+        """JSON-shaped report (the analysis CLI's --json payload)."""
+        return {
+            "subject": self.subject,
+            "findings": len(self.diagnostics),
+            "diagnostics": [
+                {"checker": d.checker, "severity": d.severity,
+                 "message": d.message, "op_index": d.op_index,
+                 "op_name": d.op_name, "provenance": d.provenance,
+                 "hint": d.hint, "data": d.data}
+                for d in self.diagnostics],
+        }
+
+    def account(self):
+        """Fold the findings into the observability registry: one
+        `sanitizer.diagnostics.<checker>` counter bump per diagnostic
+        (unconditional — this path only runs in warn/error/fix mode,
+        the sanitizer's own row-5 contract) plus a flight-recorder
+        event per error-severity finding so flight dumps show what the
+        sanitizer saw before the runtime died."""
+        if not self.diagnostics:
+            return
+        from ..observability import _state as _obs
+        from ..observability import metrics
+        for d in self.diagnostics:
+            metrics.inc("sanitizer.diagnostics." + d.checker)
+            if d.severity == SEVERITY_ERROR and _obs.FLIGHT:
+                from ..observability import flight
+                flight.note("sanitz", d.checker,
+                            op=d.op_name, message=d.message[:160])
+
     def emit(self, mode: str, stacklevel: int = 3):
         """Surface the findings per FLAGS_static_checks semantics:
         'error' raises when any error-severity finding exists (warnings
-        still warn); 'warn' warns; 'off' is a no-op."""
+        still warn); 'warn' warns; 'fix' warns for whatever the
+        autofixer could not repair (callers emit the residual report);
+        'off' is a no-op."""
         if not self.diagnostics or mode == "off":
             return
+        self.account()
         if mode == "error" and self.errors:
             raise StaticCheckError(self)
         warnings.warn(self.render(), StaticCheckWarning,

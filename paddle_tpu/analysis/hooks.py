@@ -12,9 +12,10 @@ from typing import Optional
 
 
 def check_mode() -> str:
-    """Normalized FLAGS_static_checks value: 'off' | 'warn' | 'error'.
-    Unrecognized spellings raise — a typo ('eror') must not silently
-    downgrade the requested mode or enable warn-mode overhead."""
+    """Normalized FLAGS_static_checks value: 'off' | 'warn' | 'error'
+    | 'fix'. Unrecognized spellings raise — a typo ('eror') must not
+    silently downgrade the requested mode or enable warn-mode
+    overhead."""
     from .._core import flags
     raw = flags.flag_value("FLAGS_static_checks")
     v = str(raw).lower()
@@ -24,9 +25,11 @@ def check_mode() -> str:
         return "error"
     if v in ("warn", "warning", "on", "true", "1"):
         return "warn"
+    if v in ("fix", "autofix", "repair"):
+        return "fix"
     raise ValueError(
-        f"FLAGS_static_checks={raw!r}: expected 'off', 'warn', or "
-        f"'error'")
+        f"FLAGS_static_checks={raw!r}: expected 'off', 'warn', "
+        f"'error', or 'fix'")
 
 
 # ------------------------------------------------------------- segments
@@ -42,32 +45,148 @@ def segment_sweeps() -> int:
     return metrics.counter("sanitizer.segment_sweeps").value
 
 
+def fixes_applied() -> int:
+    """Autofix rewrites since process start (`sanitizer.fixes_applied`
+    registry counter). bench_suite row 5 asserts it stays frozen when
+    fix mode sweeps a CLEAN program — the sanitizer must never rewrite
+    correct code."""
+    from ..observability import metrics
+    return metrics.counter("sanitizer.fixes_applied").value
+
+
+def run_segment_checkers(view, subject: str, lints: bool = False,
+                         strict_inplace: bool = False,
+                         strict_views: bool = False):
+    """THE segment checker battery — the single list both surfaces
+    share (the flush hook below and `analysis.check_segment`), so a new
+    checker added here reaches both. `lints` additionally runs the
+    optimization lints (dead captures) — on only for fix mode (which
+    repairs them silently) and the explicit check_segment API, so
+    warn-mode self-linting stays free of benign-but-true waste
+    reports. The flush hook runs non-strict: version-less payload
+    swaps on inputs no future op reads are deliberate in cold paths
+    (state loading), and the view/in-place divergence lint is
+    API-only."""
+    from .diagnostics import CheckReport
+    from .segment_checks import (check_dead_captures,
+                                 check_donation_safety,
+                                 check_inplace_races, check_shape_dtype,
+                                 check_tracer_leaks)
+    from .alias_graph import check_view_aliases
+    from .dataflow import check_cross_segment_donation
+    report = CheckReport(subject)
+    check_donation_safety(view, report)
+    check_inplace_races(view, report, strict=strict_inplace)
+    check_tracer_leaks(view, report)
+    check_shape_dtype(view, report)
+    check_cross_segment_donation(view, report)
+    check_view_aliases(view, report, strict=strict_views)
+    if lints:
+        check_dead_captures(view, report)
+    return report
+
+
 def on_segment_flush(ctx, pending, in_vals, in_meta, in_tensors,
-                     live, live_refs, donate, mode: str):
+                     live, live_refs, donate, mode: str,
+                     fixable: bool = True, reason: str = "materialize"):
     """Flush-time sanitizer pass over the segment about to execute.
     Called by CaptureContext.flush AFTER the donation mask is computed
     and BEFORE the executable runs, so 'error' mode stops a corrupting
-    program from launching."""
+    program from launching.
+
+    In 'fix' mode (and `fixable`, i.e. a plain flush — the fused
+    fwd+vjp path reports but never rewrites, its root/live layout is
+    baked into the step-cache key) the mechanical finding classes are
+    repaired in place, the checkers re-run to prove the diagnostics
+    clear, and the REPAIRED (pending, donate) pair is returned for the
+    flush to execute; any other mode returns None."""
     from ..observability import metrics
     metrics.counter("sanitizer.segment_sweeps").inc()
-    from .diagnostics import CheckReport
-    from .segment_checks import (SegmentView, check_donation_safety,
-                                 check_inplace_races, check_shape_dtype,
-                                 check_tracer_leaks)
+    from .segment_checks import SegmentView
     from .._core import lazy
     view = SegmentView(
         pending, in_vals, in_tensors, in_meta, dict(ctx._in_ids),
         live, live_refs, donate,
         lazy._segment_needs_grad(in_tensors, in_vals, live_refs,
-                                 in_meta))
-    report = CheckReport(f"lazy segment ({len(pending)} ops)")
-    check_donation_safety(view, report)
-    # non-strict at flush: version-less payload swaps on inputs no
-    # future op reads are deliberate in cold paths (state loading)
-    check_inplace_races(view, report, strict=False)
-    check_tracer_leaks(view, report)
-    check_shape_dtype(view, report)
-    report.emit(mode, stacklevel=5)
+                                 in_meta), ctx=ctx)
+    subject = f"lazy segment ({len(pending)} ops)"
+    do_fix = mode == "fix" and fixable
+    report = run_segment_checkers(view, subject, lints=do_fix)
+
+    out = None
+    if do_fix and not report.ok:
+        from . import fixes
+        result = fixes.plan_and_apply(view, report, ctx=ctx)
+        if result.n_applied:
+            # repaired findings still count: the per-checker
+            # sanitizer.diagnostics.* contract is unconditional, and
+            # dashboards must not undercount exactly when autofix is
+            # masking bugs (the residual report accounts via emit)
+            from .diagnostics import CheckReport
+            repaired = CheckReport(subject + " (repaired)")
+            repaired.diagnostics = result.consumed
+            repaired.account()
+            # prove the repair: the mechanical findings must clear
+            report = run_segment_checkers(view, subject + " (post-fix)",
+                                          lints=True)
+            out = (result.pending, result.donate)
+    report.emit("warn" if mode == "fix" else mode, stacklevel=5)
+    # NOTE: the donation is threaded into the cross-segment ledger by
+    # the FLUSH ITSELF after the executable ran (lazy.flush calls
+    # dataflow.note_segment_donation post-execute) — recording here
+    # would leave a phantom entry behind a failed compile/run and turn
+    # a valid later program into a false cross_segment_donation error.
+    return out
+
+
+# ------------------------------------------------- distributed surfaces
+
+def on_reshard(val_ndim: int, src, dst, global_shape, mode: str):
+    """Reshard-lowering hook (distributed reshard_value): validate the
+    placement transition against the SPMD rules before any collective
+    is planned. 'error' stops the bad transfer; fix mode has nothing
+    mechanical to rewrite here, so it reports like warn."""
+    from ..observability import metrics
+    metrics.counter("sanitizer.reshard_sweeps").inc()
+    from .diagnostics import CheckReport
+    from .distributed_checks import check_reshard
+    report = CheckReport("reshard transition")
+    check_reshard(val_ndim, src, dst, report, global_shape=global_shape)
+    report.emit("warn" if mode == "fix" else mode, stacklevel=5)
+    return report
+
+
+def on_pipeline_build(schedule: str, pp_size: int, num_micro: int,
+                      num_chunks: int, mode: str):
+    """Pipeline-runtime construction hook: lower the schedule to
+    per-rank P2P programs and simulate for deadlock/ordering before the
+    first batch blocks a real process group."""
+    from ..observability import metrics
+    metrics.counter("sanitizer.pipeline_sweeps").inc()
+    from .distributed_checks import check_pipeline_schedule
+    report = check_pipeline_schedule(schedule, pp_size, num_micro,
+                                     num_chunks)
+    report.emit("warn" if mode == "fix" else mode, stacklevel=5)
+    return report
+
+
+# ----------------------------------------------------------- SOT guards
+
+def on_sot_entry_installed(sot_fn, mode: str):
+    """Post-capture hook (SotFunction._capture): incremental sweep of
+    the JUST-INSTALLED cache entry (unsatisfiable guard set, shadowed
+    by a prior entry) — the moment the bug is introduced. Only the new
+    entry is checked so a k-entry cache pays O(k), not O(k^2), per
+    capture and earlier findings are not re-warned; the full-cache
+    sweep stays available as `analysis.check_guards`."""
+    from ..observability import metrics
+    metrics.counter("sanitizer.guard_sweeps").inc()
+    from .diagnostics import CheckReport
+    from .sot_checks import check_new_entry
+    name = getattr(sot_fn, "__name__", "?")
+    report = CheckReport(f"sot capture ({name})")
+    check_new_entry(name, sot_fn._entries, report)
+    report.emit("warn" if mode == "fix" else mode, stacklevel=5)
     return report
 
 

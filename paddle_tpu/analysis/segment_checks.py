@@ -19,6 +19,7 @@ CHECKER_DONATION = "donation_safety"
 CHECKER_INPLACE = "inplace_race"
 CHECKER_TRACER = "tracer_leak"
 CHECKER_SHAPE = "shape_dtype"
+CHECKER_DEAD = "dead_capture"
 
 
 class SegmentView:
@@ -27,10 +28,15 @@ class SegmentView:
     violations can be constructed directly in tests."""
 
     __slots__ = ("pending", "in_vals", "in_tensors", "in_meta", "in_ids",
-                 "live", "live_refs", "donate", "needs_grad")
+                 "live", "live_refs", "donate", "needs_grad", "ctx")
 
     def __init__(self, pending, in_vals, in_tensors, in_meta, in_ids,
-                 live, live_refs, donate=(), needs_grad=False):
+                 live, live_refs, donate=(), needs_grad=False, ctx=None):
+        # the CaptureContext this view snapshot came from (None for
+        # hand-built seeded views): the autofixer applies repairs to
+        # the REAL context through it, so a fix proven on the view is
+        # also a fix of the program that will flush
+        self.ctx = ctx
         self.pending = pending
         self.in_vals = in_vals
         self.in_tensors = in_tensors      # resolved; None = died
@@ -61,7 +67,8 @@ class SegmentView:
                 donate = lazy._donatable_inputs(in_tensors, in_vals,
                                                 live_refs)
         return cls(pending, in_vals, in_tensors, in_meta,
-                   dict(ctx._in_ids), live, live_refs, donate, needs_grad)
+                   dict(ctx._in_ids), live, live_refs, donate, needs_grad,
+                   ctx=ctx)
 
     # ------------------------------------------------------------ helpers
     def op_diag_fields(self, j: int) -> Dict:
@@ -96,7 +103,8 @@ def check_donation_safety(view: SegmentView, report: CheckReport):
             f"backward residuals and must outlive the flush",
             severity=SEVERITY_ERROR,
             hint="suppress donation when _segment_needs_grad() holds "
-                 "(the flush path's own guard)")
+                 "(the flush path's own guard)",
+            data={"donate_index": list(view.donate)})
 
     donated_payloads: Dict[int, int] = {}
     for i in view.donate:
@@ -104,7 +112,8 @@ def check_donation_safety(view: SegmentView, report: CheckReport):
             report.add(CHECKER_DONATION,
                        f"donation index {i} out of range "
                        f"({len(view.in_vals)} inputs)",
-                       severity=SEVERITY_ERROR)
+                       severity=SEVERITY_ERROR,
+                       data={"donate_index": i})
             continue
         v = view.in_vals[i]
         t = view.in_tensors[i]
@@ -117,7 +126,8 @@ def check_donation_safety(view: SegmentView, report: CheckReport):
                 f"(one payload registered under two donated slots)",
                 severity=SEVERITY_ERROR,
                 hint="donate a buffer at most once per executable "
-                     "(jax donate_argnums frees it after the first use)")
+                     "(jax donate_argnums frees it after the first use)",
+                data={"donate_index": i})
         donated_payloads[id(v)] = i
 
         if t is not None and t._payload is v:
@@ -132,6 +142,7 @@ def check_donation_safety(view: SegmentView, report: CheckReport):
                 severity=SEVERITY_ERROR,
                 hint="only donate inputs whose backing tensor died or "
                      "was overwritten (t._payload is not the snapshot)",
+                data={"donate_index": i},
                 **fields)
 
         if counts.get(id(v), 0) > 1:
@@ -141,7 +152,8 @@ def check_donation_safety(view: SegmentView, report: CheckReport):
                 f"{counts[id(v)]} times in this segment: the other "
                 f"slots read a freed buffer",
                 severity=SEVERITY_ERROR,
-                hint="skip donation for multiply-registered values")
+                hint="skip donation for multiply-registered values",
+                data={"donate_index": i})
 
         if getattr(v, "weak_type", False):
             report.add(
@@ -151,7 +163,8 @@ def check_donation_safety(view: SegmentView, report: CheckReport):
                 f"donated",
                 severity=SEVERITY_ERROR,
                 hint="executor._SCALAR_CACHE entries are shared across "
-                     "all later dispatches")
+                     "all later dispatches",
+                data={"donate_index": i})
 
 
 # ------------------------------------------------------- in-place races
@@ -193,6 +206,7 @@ def check_inplace_races(view: SegmentView, report: CheckReport,
                 hint="route the mutation through Tensor.set_value/"
                      "copy_/_replace_value_inplace so every open "
                      "capture context is notified",
+                data={"input": i},
                 **fields)
         elif strict and t._payload is not view.in_vals[i]:
             report.add(
@@ -329,5 +343,94 @@ def check_shape_dtype(view: SegmentView, report: CheckReport):
                     **view.op_diag_fields(j))
 
 
+# --------------------------------------------------------- dead captures
+
+def _op_flops(op_name: str, in_avals, out_avals) -> int:
+    """Rough FLOP count for the waste report: matmul-family ops pay
+    2*M*N*K, everything else one FLOP per output element. Order of
+    magnitude is all the diagnostic needs."""
+    if "matmul" in op_name and in_avals and in_avals[0] is not None:
+        a = in_avals[0]
+        k = int(a.shape[-1]) if len(a.shape) else 1
+        n_out = sum(int(np.prod(o.shape)) for o in out_avals)
+        return 2 * k * n_out
+    return sum(int(np.prod(o.shape)) for o in out_avals)
+
+
+def contributing_ops(view: SegmentView) -> set:
+    """Op indices reachable backwards from every KEEP root — live
+    outputs, impure ops (their side effects are observable), and ops
+    with any surviving tensor wrapper (even detached/overwritten:
+    someone may still observe them). Closure over producers matters:
+    a kept op's inputs must be kept too, or pruning the 'dead'
+    producer of a kept consumer would corrupt the wiring."""
+    from ..ir.pass_base import is_impure
+    alive = set()
+    stack = [j for j, _s in view.live]
+    for j, p in enumerate(view.pending):
+        if is_impure(p.op.name) or any(_live_meta(ref)
+                                       for ref in p.out_refs):
+            stack.append(j)
+    while stack:
+        j = stack.pop()
+        if j in alive:
+            continue
+        alive.add(j)
+        for w in view.pending[j].wiring:
+            if w is not None and w[0] == "op" and w[1] not in alive:
+                stack.append(w[1])
+    return alive
+
+
+def check_dead_captures(view: SegmentView, report: CheckReport):
+    """A recorded op none of whose outputs are live-aliased, read by a
+    live-contributing op, or grad-connected is DEAD: no one can ever
+    observe its result. XLA's DCE drops it from the compiled program,
+    but the host already paid record + signature + a bigger compile for
+    it — and under the reference's eager semantics it would have paid
+    the full FLOPs. Impure ops (rng, print, assign_out) are never dead:
+    their side effects are their observable result."""
+    alive = contributing_ops(view)
+    dead = [j for j in range(len(view.pending)) if j not in alive]
+    if not dead:
+        return
+    flops = 0
+    nbytes = 0
+    for j in dead:
+        p = view.pending[j]
+        out_avals = [r.aval for r in p.out_refs]
+        in_avals = []
+        for w in p.wiring:
+            if w is None:
+                in_avals.append(None)
+            elif w[0] == "in":
+                v = view.in_vals[w[1]]
+                in_avals.append(v if hasattr(v, "shape") else None)
+            else:
+                in_avals.append(view.pending[w[1]].out_refs[w[2]].aval)
+        flops += _op_flops(p.op.name, in_avals, out_avals)
+        nbytes += sum(int(np.prod(a.shape)) * np.dtype(a.dtype).itemsize
+                      for a in out_avals)
+    names = [view.pending[j].op.name for j in dead[:4]]
+    fields = view.op_diag_fields(dead[0])
+    report.add(
+        CHECKER_DEAD,
+        f"{len(dead)} recorded op(s) {names}{'...' if len(dead) > 4 else ''} "
+        f"produce outputs never materialized, grad-connected, or "
+        f"aliased: ~{flops} FLOPs / {nbytes} output bytes of wasted "
+        f"eager work (XLA DCEs them, but record+compile were paid)",
+        severity=SEVERITY_WARNING,
+        hint="drop the dead computation at the call site, or run "
+             "FLAGS_static_checks=fix to prune it from the segment",
+        data={"dead_ops": dead, "flops": flops, "bytes": nbytes},
+        **fields)
+
+
+def _live_meta(ref) -> bool:
+    """Does any still-alive tensor alias this pending output?"""
+    return any(r() is not None for r in getattr(ref, "trefs", ()))
+
+
 SEGMENT_CHECKERS = (check_donation_safety, check_inplace_races,
-                    check_tracer_leaks, check_shape_dtype)
+                    check_tracer_leaks, check_shape_dtype,
+                    check_dead_captures)

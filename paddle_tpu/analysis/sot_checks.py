@@ -1,0 +1,143 @@
+"""SOT guard-soundness checks over a SotFunction's cache entries.
+
+Two bug classes in the guarded fast-path cache
+(jit/sot/opcode_executor.py `SotFunction._entries`, first match wins):
+
+- a guard set that can NEVER fire: two guards constrain the same
+  observation (same source + kind) to different expected values, or a
+  `none: True` guard coexists with a value/len/tensor_meta guard on the
+  same source. The entry is dead weight — every call pays its guard
+  evaluation and none will ever hit.
+- a SHADOWED entry: an earlier entry's guard set is subsumed by a later
+  one's (every constraint of the earlier appears verbatim in the
+  later), on the same grad mode / grad mask / input avals. First match
+  wins, so the later entry is unreachable: its capture and compile were
+  wasted and the cache slot is dead.
+
+Entries that differ in grad_mode, grad_mask or input avals are NOT
+shadows — a guard-identical entry is still reachable through the
+replay-mismatch fallthrough (`entry.run` raising _ReplayMismatch moves
+the scan to the next entry).
+
+Run automatically after each capture installs a cache entry (warn /
+error / fix mode), and on demand via `check_guards(fn)`.
+"""
+from __future__ import annotations
+
+from .diagnostics import SEVERITY_ERROR, SEVERITY_WARNING, CheckReport
+
+CHECKER_GUARD = "sot_guard"
+
+# guard kinds that, on one source, imply the value is NOT None
+_NONNULL_KINDS = ("value", "len", "tensor_meta", "id")
+
+
+def check_guard_set(guards, report: CheckReport, entry_idx=None,
+                    fn_name: str = "?"):
+    """Unsatisfiability within ONE guard set."""
+    where = f"entry #{entry_idx}" if entry_idx is not None else "guards"
+    for key, gs in guards.by_key().items():
+        if len(gs) < 2:
+            continue
+        exp = gs[0].expected
+        for g in gs[1:]:
+            if not gs[0].same_constraint(g):
+                report.add(
+                    CHECKER_GUARD,
+                    f"{fn_name}: {where} can never fire: source "
+                    f"{key[0]} is {key[1]}-guarded to both "
+                    f"{exp!r} and {g.expected!r}",
+                    severity=SEVERITY_ERROR,
+                    hint="a capture specialized one value two "
+                         "incompatible ways; the entry is dead weight "
+                         "every call still pays to evaluate",
+                    data={"entry": entry_idx, "source": key[0]})
+                break
+    by_src: dict = {}
+    for g in guards:
+        by_src.setdefault(repr(g.source), []).append(g)
+    for src, gs in by_src.items():
+        none_true = any(g.kind == "none" and g.expected is True
+                        for g in gs)
+        nonnull = [g for g in gs if g.kind in _NONNULL_KINDS]
+        if none_true and nonnull:
+            report.add(
+                CHECKER_GUARD,
+                f"{fn_name}: {where} can never fire: source {src} is "
+                f"guarded None and simultaneously "
+                f"{nonnull[0].kind}-guarded (a None value satisfies "
+                f"neither)",
+                severity=SEVERITY_ERROR,
+                data={"entry": entry_idx, "source": src})
+
+
+def _shadows(early, late) -> bool:
+    """Does `early` make a later `late` unreachable? Same grad
+    mode/mask/input avals (otherwise the replay-mismatch fallthrough
+    keeps `late` reachable) and every early guard appears in late's."""
+    return early.grad_mode == late.grad_mode \
+        and early.grad_mask == late.grad_mask \
+        and early.segment.in_avals == late.segment.in_avals \
+        and early.guards.subsumes(late.guards)
+
+
+def _report_shadow(report: CheckReport, fn_name: str, i, early, j, late):
+    report.add(
+        CHECKER_GUARD,
+        f"{fn_name}: cache entry #{j} is unreachable: "
+        f"entry #{i}'s guards ({len(early.guards)}) are a "
+        f"subset of #{j}'s ({len(late.guards)}) with "
+        f"identical grad mode/mask and input avals, and "
+        f"the scan stops at the first match",
+        severity=SEVERITY_WARNING,
+        hint="the later capture duplicated an existing "
+             "specialization — usually a guard that should "
+             "have been added at the first capture",
+        data={"shadowed": j, "by": i})
+
+
+def check_entry_shadowing(entries, report: CheckReport,
+                          fn_name: str = "?"):
+    """First-match-wins reachability across the entry list."""
+    for i, early in enumerate(entries):
+        for j in range(i + 1, len(entries)):
+            if _shadows(early, entries[j]):
+                _report_shadow(report, fn_name, i, early, j, entries[j])
+
+
+def check_new_entry(fn_name: str, entries, report: CheckReport):
+    """Incremental sweep for the post-capture hook: the just-installed
+    LAST entry's satisfiability, plus whether a prior entry shadows it.
+    Appending an entry can only make the NEW one unreachable (priors
+    are checked first), so this is the full marginal coverage at O(k)
+    pair checks — and findings already reported for earlier installs
+    are not re-warned on every capture."""
+    if not entries:
+        return report
+    j = len(entries) - 1
+    late = entries[j]
+    check_guard_set(late.guards, report, entry_idx=j, fn_name=fn_name)
+    for i, early in enumerate(entries[:-1]):
+        if _shadows(early, late):
+            _report_shadow(report, fn_name, i, early, j, late)
+            break
+    return report
+
+
+def check_guards(fn, report: CheckReport = None) -> CheckReport:
+    """Sweep a SotFunction's guarded cache: per-entry satisfiability +
+    cross-entry shadowing. Accepts the SotFunction or a raw callable
+    previously wrapped by symbolic_translate."""
+    from ..jit.sot.opcode_executor import SotFunction
+    if not isinstance(fn, SotFunction):
+        raise TypeError("check_guards needs a SotFunction "
+                        "(symbolic_translate(fn))")
+    name = getattr(fn, "__name__", "?")
+    if report is None:
+        report = CheckReport(f"sot guards ({name}, "
+                             f"{len(fn._entries)} entries)")
+    for idx, entry in enumerate(fn._entries):
+        check_guard_set(entry.guards, report, entry_idx=idx,
+                        fn_name=name)
+    check_entry_shadowing(fn._entries, report, fn_name=name)
+    return report

@@ -313,5 +313,20 @@ def reshard_value(val, src_mesh, src_placements, dst_mesh,
     """Registry-dispatched reshard over raw values."""
     src = DistAttrLite(src_mesh, src_placements)
     dst = DistAttrLite(dst_mesh, dst_placements)
+    from ..._core import flags as _flags
+    if _flags.STATIC_CHECKS_ACTIVE:
+        # program sanitizer (paddle_tpu.analysis.distributed_checks):
+        # validate the placement transition against the SPMD rules
+        # before any collective is planned — 'error' refuses to plan a
+        # transfer that would shard out of range / unevenly / through
+        # the accidental cross-mesh path
+        from ...analysis import hooks as _sanitizer
+        _mode = _sanitizer.check_mode()
+        if _mode != "off":
+            n_partial = len(src.partial_dims())
+            gshape = tuple(val.shape)[n_partial:] \
+                if hasattr(val, "shape") else None
+            _sanitizer.on_reshard(getattr(val, "ndim", 0), src, dst,
+                                  gshape, _mode)
     fn = choose_reshard_function(src, dst)
     return fn.eval(val, src, dst), fn

@@ -226,6 +226,21 @@ class _HostPipeBase:
                 f"{live / (1 << 20):.1f} MB exceeds "
                 f"FLAGS_pipeline_stash_warn_mb={warn_mb}")
 
+    def _static_check_schedule(self, schedule: str, num_chunks: int = 1):
+        """Program-sanitizer hook: lower this runtime's schedule to
+        per-rank P2P programs and simulate for deadlock/ordering BEFORE
+        the first batch can block a live process group
+        (paddle_tpu.analysis.distributed_checks). One cached-gate read
+        when checks are off."""
+        from .._core import flags as _flags
+        if not _flags.STATIC_CHECKS_ACTIVE:
+            return
+        from ..analysis import hooks as _sanitizer
+        mode = _sanitizer.check_mode()
+        if mode != "off":
+            _sanitizer.on_pipeline_build(schedule, self.P, self.m,
+                                         num_chunks, mode)
+
     def _grad_payload(self, x_in):
         """Input grad to send upstream; zeros keep the P2P protocol
         symmetric when the input turned out disconnected."""
@@ -253,6 +268,28 @@ class _HostPipeBase:
                 f"{None if micro_labels is None else len(micro_labels)}")
 
 
+def _fb_schedule(rank: int, pp_size: int, num_micro: int,
+                 schedule: str = "1F1B"):
+    """Per-rank action list for the flat F/B schedules. THE definition
+    DistPipelineRuntime.train_batch executes AND the sanitizer's
+    pipeline checker (analysis/distributed_checks.py) simulates — one
+    source so the checker can never certify a schedule the runtime no
+    longer runs. Returns [("F"|"B", micro), ...]."""
+    P, m = pp_size, num_micro
+    if schedule == "FThenB":
+        return [("F", i) for i in range(m)] + \
+               [("B", i) for i in range(m)]
+    # 1F1B (pipeline_parallel.py:684)
+    warmup = min(P - rank - 1, m)
+    acts = [("F", i) for i in range(warmup)]
+    for j in range(m - warmup):
+        acts.append(("F", warmup + j))
+        acts.append(("B", j))
+    for j in range(m - warmup, m):
+        acts.append(("B", j))
+    return acts
+
+
 class DistPipelineRuntime(_HostPipeBase):
     """Host-driven multi-process pipeline schedules over the store-backed
     ProcessGroup transport — the reference's PipelineParallel runtime
@@ -275,6 +312,7 @@ class DistPipelineRuntime(_HostPipeBase):
         self.schedule = schedule
         self.is_first = self.rank == 0
         self.is_last = self.rank == self.num_stages - 1
+        self._static_check_schedule(schedule)
 
     def _forward_micro(self, i, micro_in, label):
         import numpy as np
@@ -310,32 +348,19 @@ class DistPipelineRuntime(_HostPipeBase):
         """Run one batch. Rank 0 supplies micro_inputs (list of M input
         Tensors); the last rank supplies micro_labels. Returns the batch
         loss on the last rank (None elsewhere)."""
-        m = self.m
         self._check_micros(micro_inputs, micro_labels,
                            self.is_first, self.is_last)
         losses = []
-
-        def fwd(i):
-            x = micro_inputs[i] if self.is_first else None
-            y = micro_labels[i] if self.is_last else None
-            loss = self._forward_micro(i, x, y)
-            if loss is not None:
-                losses.append(float(loss.numpy()))
-
-        if self.schedule == "FThenB":
-            for i in range(m):
-                fwd(i)
-            for i in range(m):
+        for kind, i in _fb_schedule(self.rank, self.num_stages, self.m,
+                                    self.schedule):
+            if kind == "F":
+                x = micro_inputs[i] if self.is_first else None
+                y = micro_labels[i] if self.is_last else None
+                loss = self._forward_micro(i, x, y)
+                if loss is not None:
+                    losses.append(float(loss.numpy()))
+            else:
                 self._backward_micro(i)
-        else:  # 1F1B (pipeline_parallel.py:684)
-            warmup = min(self.num_stages - self.rank - 1, m)
-            for i in range(warmup):
-                fwd(i)
-            for j in range(m - warmup):
-                fwd(warmup + j)
-                self._backward_micro(j)
-            for j in range(m - warmup, m):
-                self._backward_micro(j)
 
         self.pg.barrier()
         return sum(losses) if self.is_last else None
@@ -433,6 +458,7 @@ class DistPipelineRuntimeVPP(_HostPipeBase):
         self.chunks = list(chunk_layers)
         self.C = len(self.chunks)
         self.V = self.P * self.C
+        self._static_check_schedule("VPP", num_chunks=self.C)
 
     def _vstage(self, chunk):
         return chunk * self.P + self.rank
@@ -556,6 +582,7 @@ class DistPipelineRuntimeZB(_HostPipeBase):
         self.executed: List[tuple] = []  # action trace for tests
         self.counts = {"F": 0, "B": 0, "W": 0}  # probe for tests
         self._built = False
+        self._static_check_schedule("ZeroBubble")
 
     def _build(self, xv, yv=None):
         """Trace the stage once (abstractly) to learn the pullback's

@@ -68,6 +68,20 @@ class Guard:
         self.kind = kind        # value|id|tensor_meta|none
         self.expected = expected
 
+    def key(self) -> Tuple[str, str]:
+        """(source, kind) identity — two guards with the same key
+        constrain the same observation, so differing `expected` values
+        are mutually exclusive. The introspection handle the guard
+        soundness checker (paddle_tpu.analysis.sot_checks) walks."""
+        return (repr(self.source), self.kind)
+
+    def same_constraint(self, other: "Guard") -> bool:
+        """Byte-identical constraint (key + expected)."""
+        if self.key() != other.key():
+            return False
+        return values_equal(self.expected, other.expected) \
+            if type(self.expected) is type(other.expected) else False
+
     def check(self, fn, args, kwargs) -> bool:
         if self.kind == "sig":
             # call-binding shape: positional count + kwarg names. Params
@@ -128,6 +142,26 @@ class GuardSet:
 
     def check_all(self, fn, args, kwargs) -> bool:
         return all(g.check(fn, args, kwargs) for g in self._guards)
+
+    # ------------------------------------------------------ introspection
+    def by_key(self) -> dict:
+        """{(source_repr, kind): [Guard, ...]} — more than one guard
+        under a key means the set over-constrains one observation;
+        differing expectations make the whole set unsatisfiable."""
+        out: dict = {}
+        for g in self._guards:
+            out.setdefault(g.key(), []).append(g)
+        return out
+
+    def subsumes(self, other: "GuardSet") -> bool:
+        """True when every guard in `self` also appears (same source,
+        kind, AND expected) in `other`: any call that satisfies `other`
+        satisfies `self`, so in a first-match-wins cache an earlier
+        `self` makes a later `other` unreachable."""
+        for g in self._guards:
+            if not any(g.same_constraint(o) for o in other._guards):
+                return False
+        return True
 
     def __len__(self):
         return len(self._guards)

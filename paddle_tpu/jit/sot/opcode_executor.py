@@ -1196,6 +1196,16 @@ class SotFunction:
             while cap and len(self._entries) >= cap:  # 0 = unlimited
                 self._entries.pop(0)
             self._entries.append(entry)
+            from ..._core import flags as _cflags
+            if _cflags.STATIC_CHECKS_ACTIVE:
+                # program sanitizer: sweep the guarded cache the moment
+                # a new entry lands — an unsatisfiable guard set or a
+                # shadowed (unreachable) entry is introduced exactly
+                # here (paddle_tpu.analysis.sot_checks)
+                from ...analysis import hooks as _sanitizer
+                _mode = _sanitizer.check_mode()
+                if _mode != "off":
+                    _sanitizer.on_sot_entry_installed(self, _mode)
         return out
 
     def _build_entry(self, session, out, args, kwargs):

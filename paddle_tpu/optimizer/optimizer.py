@@ -141,6 +141,14 @@ class Optimizer:
             ospan = span("optimizer::fused_step",
                          hist="optimizer.step_us", params=len(pvals),
                          donated=donated).begin()
+        # sanitizer gate resolved BEFORE the donating update executes:
+        # check_mode() raises on unrecognized spellings, and a raise
+        # after fn() consumed the old buffers but before the write-back
+        # would leave params pointing at deleted arrays
+        _track_donation = False
+        if _flags.STATIC_CHECKS_ACTIVE and fn is self._jit_update:
+            from ..analysis import hooks as _sanitizer
+            _track_donation = _sanitizer.check_mode() != "off"
         _dispatch.bump_exec()
         from .._core.lazy import _quiet_donation_compile
         try:
@@ -155,6 +163,17 @@ class Optimizer:
             raise
         if ospan is not None:
             ospan.end()
+        if _track_donation:
+            # sanitizer cross-segment dataflow: the fused update donated
+            # the old param/state buffers — thread their identity into
+            # the ledger so a later segment registering one of them is
+            # caught as a read-after-donate (dataflow.py). Recorded
+            # only AFTER the update ran: a failed step donated nothing,
+            # and a phantom entry would flag live params as freed.
+            from ..analysis.dataflow import note_optimizer_donation
+            note_optimizer_donation(
+                pvals, jax.tree_util.tree_leaves(states),
+                type(self).__name__)
         for (p, _), meta, np_, ns in zip(pairs, metas, new_p, new_s):
             pid = id(p)
             self._states[pid] = ns

@@ -1,10 +1,13 @@
 """Program sanitizer (paddle_tpu.analysis): seeded-violation suite.
 
-Each of the five checkers must catch a deliberately constructed
-violation with op/provenance fields in the diagnostic, `error` mode
-must raise StaticCheckError, and the clean paths must stay silent
-(no false positives — the whole tier-1 suite runs under
-FLAGS_static_checks=warn via conftest).
+Each checker — the per-program five plus the cross-program wave
+(cross-segment donation, view alias graph, dead captures, SOT guard
+soundness, reshard placement, pipeline schedules) — must catch a
+deliberately constructed violation with op/provenance fields in the
+diagnostic, `error` mode must raise StaticCheckError, `fix` mode must
+repair the mechanical classes with a clean re-check, and the clean
+paths must stay silent (no false positives — the whole tier-1 suite
+runs under FLAGS_static_checks=warn via conftest).
 """
 import warnings
 
@@ -162,6 +165,16 @@ def test_unknown_static_checks_value_raises():
     from paddle_tpu.analysis.hooks import check_mode
     with _with_flag("FLAGS_static_checks", "eror"):
         with pytest.raises(ValueError, match="eror"):
+            check_mode()
+
+
+def test_fix_mode_spellings_recognized():
+    from paddle_tpu.analysis.hooks import check_mode
+    for spelling in ("fix", "autofix", "repair", "FIX"):
+        with _with_flag("FLAGS_static_checks", spelling):
+            assert check_mode() == "fix"
+    with _with_flag("FLAGS_static_checks", "fixx"):
+        with pytest.raises(ValueError):
             check_mode()
 
 
@@ -401,6 +414,503 @@ def test_check_nan_inf_covers_lazy_segment_outputs():
     assert np.isnan(z.numpy()).any()
 
 
+# ---------------------------------------------- cross-segment donation
+
+def test_cross_segment_donation_reported_and_error_raises():
+    """A buffer donated by an EARLIER program registered as an input of
+    a later segment is a read-after-free the per-flush checkers cannot
+    see; the dataflow ledger threads the identity across the boundary."""
+    from paddle_tpu.analysis import dataflow
+    x = _x(seed=20)
+    dataflow.LEDGER.note_donation(
+        [x._value], (0,), "lazy segment flush[step]",
+        provenance="train.py:42")
+    try:
+        with lazy.lazy_guard() as ctx:
+            y = x * 2.0
+            report = check_segment(ctx, lints=False)
+            diags = report.by_checker("cross_segment_donation")
+            assert diags, report.render()
+            d = diags[0]
+            assert "donated by an earlier program" in d.message
+            assert "lazy segment flush[step]" in d.message
+            assert "train.py:42" in d.message
+            assert d.op_name == "multiply"
+
+            # error mode: the flush refuses to launch the read-after-free
+            with _with_flag("FLAGS_static_checks", "error"):
+                with pytest.raises(StaticCheckError) as ei:
+                    ctx.flush()
+            assert ei.value.report.by_checker("cross_segment_donation")
+            assert not ctx.pending
+    finally:
+        dataflow.reset()
+
+
+def test_real_flush_donation_lands_in_ledger():
+    """The flush hook threads its actual donation mask into the ledger
+    (counted by sanitizer.tracked_donations)."""
+    from paddle_tpu.analysis import dataflow
+    from paddle_tpu.observability import metrics
+    before = metrics.counter("sanitizer.tracked_donations").value
+    x = _x(seed=21)
+    with lazy.lazy_guard() as ctx:
+        y = x * 2.0
+        x.set_value(x * 0.0 + 1.0)   # overwrite: orphaned payload donates
+        ctx.flush()
+    assert metrics.counter("sanitizer.tracked_donations").value > before
+    np.testing.assert_allclose(x.numpy(), np.ones((4, 4)), rtol=1e-6)
+    dataflow.reset()
+
+
+def test_optimizer_donation_lands_in_ledger():
+    """The fused optimizer update's donated param/state buffers enter
+    the same ledger — the step-cache boundary the tentpole threads."""
+    import paddle_tpu.nn as nn
+    from paddle_tpu.analysis import dataflow
+    from paddle_tpu.observability import metrics
+    net = nn.Linear(4, 4)
+    opt = paddle.optimizer.Adam(1e-3, parameters=net.parameters())
+    x = _x((2, 4), seed=22)
+    loss = net(x).sum()
+    loss.backward()
+    before = metrics.counter("sanitizer.tracked_donations").value
+    opt.step()
+    if opt._pick_update([], [], []) is opt._jit_update:
+        assert metrics.counter(
+            "sanitizer.tracked_donations").value > before
+    dataflow.reset()
+
+
+def test_failed_flush_leaves_no_phantom_donation():
+    """A flush that dies at compile/run donated nothing: the ledger
+    must not hold a phantom record that would turn a valid later
+    program into a false cross_segment_donation error."""
+    from paddle_tpu.analysis import dataflow
+    dataflow.reset()
+    x = _x(seed=34)
+    with lazy.lazy_guard() as ctx:
+        y = x * 2.0
+        x.set_value(x * 0.0 + 5.0)   # orphaned payload: donation candidate
+        ctx.pending[0].attrs["_boom"] = object()   # sabotage the compile
+        # the sabotage lives in attrs, which the (record-time) cache
+        # signature does not see: drop cached runners so the flush
+        # cannot sidestep the corrupted build via a structural hit
+        lazy.clear_segment_cache()
+        with pytest.raises(Exception):
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                ctx.flush()
+    assert len(dataflow.LEDGER) == 0
+    dataflow.reset()
+
+
+def test_dead_capture_closure_keeps_producers_of_kept_ops():
+    """An op kept only through a surviving (overwritten) wrapper keeps
+    its producers too — pruning must never sever a kept consumer's
+    inputs (regression: KeyError during fix-mode wiring remap)."""
+    x = _x(seed=35)
+    with _with_flag("FLAGS_static_checks", "fix"):
+        with lazy.lazy_guard() as ctx:
+            y = x * 2.0
+            z = x + 1.0
+            w = z * 3.0
+            w.set_value(x * 0.0)    # wrapper alive, payload overwritten
+            del z                   # producer of a kept-but-dead-payload op
+            ctx.flush()             # must not crash
+    np.testing.assert_allclose(y.numpy(), x.numpy() * 2.0, rtol=1e-6)
+    np.testing.assert_allclose(w.numpy(), np.zeros((4, 4)), atol=0)
+
+
+# ----------------------------------------------------- view alias graph
+
+def test_aliased_view_donation_reported_and_fixed():
+    """Donating a base whose reshape-view is still live is flagged even
+    though the view op ran in a PREVIOUS segment; the fix drops the
+    donation."""
+    from paddle_tpu.analysis import fix_segment
+    x = _x(seed=23)
+    with lazy.lazy_guard() as ctx:
+        v = x.reshape([16])          # records the view edge
+    assert v.shape == [16]
+    with lazy.lazy_guard() as ctx:
+        y = x * 2.0
+        view = SegmentView.from_context(ctx, donate=(0,))
+        report = analysis.CheckReport()
+        analysis.check_view_aliases(view, report)
+        diags = report.by_checker("view_alias")
+        assert diags, report.render()
+        assert "'reshape'" in diags[0].message
+        assert "test_analysis.py" in diags[0].message   # view provenance
+
+        # fix: drop the donation, re-check comes back clean
+        result, post = fix_segment(view, report)
+        assert result.donate == ()
+        assert any("drop donation" in a for a in result.actions)
+        assert post.by_checker("view_alias") == [], post.render()
+        ctx._reset_segment()
+
+
+def test_view_of_fresh_payload_not_flagged_on_old_snapshot_donation():
+    """A view recorded AFTER a note_inplace payload swap aliases the
+    NEW storage: donating the old snapshot must not flag it (payload
+    epochs, not just base-tensor identity)."""
+    x = _x(seed=36)
+    with lazy.lazy_guard() as ctx:
+        y = x + 1.0                  # registers the OLD payload
+        x.set_value(x * 2.0)         # note_inplace: payload swapped
+        ctx.flush()
+    v2 = None
+    with lazy.lazy_guard() as ctx:
+        v2 = x.reshape([16])         # view of the NEW payload
+        ctx.flush()
+    with lazy.lazy_guard() as ctx:
+        z = x * 3.0
+        view = SegmentView.from_context(ctx, donate=(0,))
+        # seed: pretend input 0's registered snapshot is an OLD epoch
+        # by pointing in_vals at a fresh array object
+        import jax.numpy as jnp
+        view.in_vals[0] = jnp.zeros((4, 4), jnp.float32)
+        report = analysis.CheckReport()
+        analysis.check_view_aliases(view, report)
+        assert report.by_checker("view_alias") == [], report.render()
+        ctx._reset_segment()
+    assert v2 is not None
+
+
+def test_view_of_mutated_base_warns_in_strict_mode():
+    x = _x(seed=24)
+    with lazy.lazy_guard() as ctx:
+        v = x.transpose([1, 0])
+    with lazy.lazy_guard() as ctx:
+        y = x + 1.0
+        x._inplace_version += 3      # mutation after the view
+        view = SegmentView.from_context(ctx)
+        report = analysis.CheckReport()
+        analysis.check_view_aliases(view, report, strict=True)
+        assert any("view semantics" in d.message
+                   for d in report.by_checker("view_alias")), \
+            report.render()
+        ctx._reset_segment()
+    x._inplace_version = 0
+
+
+# --------------------------------------------------------- dead captures
+
+def test_dead_capture_reported_with_waste_estimate():
+    x = _x(seed=25)
+    with lazy.lazy_guard() as ctx:
+        y = x * 2.0
+        z = paddle.matmul(x, x)      # dead: dropped before any read
+        del z
+        report = check_segment(ctx)
+        diags = report.by_checker("dead_capture")
+        assert diags, report.render()
+        d = diags[0]
+        assert "never materialized" in d.message
+        assert d.op_name == "matmul"
+        assert d.data["flops"] == 2 * 4 * 4 * 4   # 2*M*N*K
+        assert d.data["bytes"] == 4 * 4 * 4
+        assert d.provenance and "test_analysis.py" in d.provenance
+        ctx._reset_segment()
+
+
+def test_dead_capture_fix_prunes_and_recheck_clean():
+    from paddle_tpu.analysis import fix_segment
+    x = _x(seed=26)
+    with lazy.lazy_guard() as ctx:
+        y = x * 2.0
+        z = x + 5.0
+        del z
+        report = check_segment(ctx)
+        assert report.by_checker("dead_capture")
+        result, post = fix_segment(ctx, report)
+        assert any("prune" in a for a in result.actions)
+        assert post.ok, post.render()
+        assert len(ctx.pending) == 1      # only the multiply survives
+    np.testing.assert_allclose(y.numpy(), x.numpy() * 2.0, rtol=1e-6)
+
+
+def test_fix_mode_flush_prunes_dead_captures():
+    from paddle_tpu.analysis.hooks import fixes_applied
+    x = _x(seed=27)
+    before = fixes_applied()
+    with _with_flag("FLAGS_static_checks", "fix"):
+        with lazy.lazy_guard() as ctx:
+            y = x * 3.0
+            z = x + 7.0
+            del z
+            ctx.flush()
+    assert fixes_applied() > before
+    np.testing.assert_allclose(y.numpy(), x.numpy() * 3.0, rtol=1e-6)
+
+
+def test_fix_mode_clean_program_zero_rewrites():
+    """The row-5 contract: fix mode must never rewrite correct code."""
+    from paddle_tpu.analysis.hooks import fixes_applied
+    x = _x(seed=28)
+    before = fixes_applied()
+    with _with_flag("FLAGS_static_checks", "fix"):
+        with lazy.lazy_guard() as ctx:
+            y = x * 4.0
+            ctx.flush()
+    assert fixes_applied() == before
+    np.testing.assert_allclose(y.numpy(), x.numpy() * 4.0, rtol=1e-6)
+
+
+def test_fix_mode_inplace_roundtrip():
+    """The missing-note_inplace repair: fix evicts the registration (the
+    notification the mutation site skipped), the re-check is clean, and
+    a later record re-registers the fresh payload."""
+    from paddle_tpu.analysis.hooks import fixes_applied
+    x = _x(seed=29)
+    before = fixes_applied()
+    with _with_flag("FLAGS_static_checks", "fix"):
+        with lazy.lazy_guard() as ctx:
+            y = x + 3.0
+            x._inplace_version += 1          # unnotified mutation
+            import warnings as _w
+            with _w.catch_warnings(record=True) as w:
+                _w.simplefilter("always")
+                ctx.flush()
+            # the mechanical class was repaired, not warned about
+            assert not any(isinstance(wi.message, StaticCheckWarning)
+                           for wi in w), [str(wi.message) for wi in w]
+    assert fixes_applied() > before
+    np.testing.assert_allclose(y.numpy(), x.numpy() + 3.0, rtol=1e-6)
+    x._inplace_version = 0
+
+
+# ------------------------------------------------- SOT guard soundness
+
+def test_never_firing_guard_set_reported():
+    from paddle_tpu.analysis.sot_checks import check_guard_set
+    from paddle_tpu.jit.sot.guards import GuardSet, Source
+    gs = GuardSet()
+    s = Source("arg", None, 1)
+    gs.add(s, "value", (int, 3))
+    gs.add(s, "value", (int, 4))     # same source, different expected
+    report = analysis.CheckReport()
+    check_guard_set(gs, report, entry_idx=0, fn_name="step")
+    diags = report.by_checker("sot_guard")
+    assert diags, report.render()
+    assert "can never fire" in diags[0].message
+    assert "arg[1]" in diags[0].message
+
+    gs2 = GuardSet()
+    gs2.add(s, "none", True)
+    gs2.add(s, "len", 3)             # None has no len
+    report2 = analysis.CheckReport()
+    check_guard_set(gs2, report2)
+    assert any("satisfies neither" in d.message
+               for d in report2.by_checker("sot_guard"))
+
+
+def test_shadowed_cache_entry_reported():
+    """An earlier entry whose guards are a subset of a later one's (same
+    grad mode/mask/avals) makes the later entry unreachable."""
+    from paddle_tpu.jit.sot import symbolic_translate
+
+    def f(a, flag):
+        return a * 2.0 if flag else a * 3.0
+
+    sf = symbolic_translate(f)
+    x = _x((2, 2), seed=30)
+    sf(x, True)
+    assert len(sf._entries) == 1
+    report = analysis.check_guards(sf)
+    assert report.ok, report.render()    # one healthy entry: clean
+
+    # seed the shadow: duplicate the entry (identical guards/mask/avals)
+    sf._entries.append(sf._entries[0])
+    report = analysis.check_guards(sf)
+    diags = report.by_checker("sot_guard")
+    assert diags, report.render()
+    assert "unreachable" in diags[0].message
+    assert diags[0].data == {"shadowed": 1, "by": 0}
+
+
+def test_healthy_multi_entry_sot_cache_is_clean():
+    """Two real specializations (different guard VALUES) are reachable:
+    the sweep that runs automatically after each capture under warn
+    mode must stay silent."""
+    from paddle_tpu.jit.sot import symbolic_translate
+
+    def f(a, flag):
+        return a * 2.0 if flag else a * 3.0
+
+    sf = symbolic_translate(f)
+    x = _x((2, 2), seed=31)
+    import warnings as _w
+    with _w.catch_warnings(record=True) as w:
+        _w.simplefilter("always")
+        sf(x, True)
+        sf(x, False)
+    assert not any(isinstance(wi.message, StaticCheckWarning)
+                   for wi in w)
+    assert analysis.check_guards(sf).ok
+
+
+# ------------------------------------------------- reshard placement
+
+def _mesh2x2():
+    from paddle_tpu.distributed import ProcessMesh
+    return ProcessMesh([[0, 1], [2, 3]], dim_names=["dp", "mp"])
+
+
+def test_reshard_placement_mismatch_reported():
+    from paddle_tpu.distributed.auto_parallel.reshard_functions import \
+        DistAttrLite
+    from paddle_tpu.distributed.placements import Replicate, Shard
+    mesh = _mesh2x2()
+    report = analysis.CheckReport()
+    analysis.check_reshard(
+        2, DistAttrLite(mesh, [Replicate(), Replicate()]),
+        DistAttrLite(mesh, [Shard(5), Replicate()]),
+        report, global_shape=(4, 8))
+    diags = report.by_checker("reshard_placement")
+    assert diags, report.render()
+    assert "Shard(dim=5)" in diags[0].message
+    assert "out of range" in diags[0].message
+
+    # placements rank != mesh rank
+    report = analysis.CheckReport()
+    analysis.check_reshard(
+        2, DistAttrLite(mesh, [Replicate()]),
+        DistAttrLite(mesh, [Replicate(), Replicate()]),
+        report, global_shape=(4, 8))
+    assert any("does not match its mesh rank" in d.message
+               for d in report.by_checker("reshard_placement"))
+
+    # uneven shard: dim 7 over a mesh axis of 2
+    report = analysis.CheckReport()
+    analysis.check_reshard(
+        2, DistAttrLite(mesh, [Replicate(), Replicate()]),
+        DistAttrLite(mesh, [Shard(0), Replicate()]),
+        report, global_shape=(7, 8))
+    assert any("not evenly divisible" in d.message
+               for d in report.by_checker("reshard_placement"))
+
+
+def test_reshard_error_mode_stops_bad_transition():
+    from paddle_tpu.distributed.auto_parallel.reshard_functions import \
+        reshard_value
+    from paddle_tpu.distributed.placements import Replicate, Shard
+    import jax.numpy as jnp
+    mesh = _mesh2x2()
+    val = jnp.ones((4, 8), jnp.float32)
+    with _with_flag("FLAGS_static_checks", "error"):
+        with pytest.raises(StaticCheckError) as ei:
+            reshard_value(val, mesh, [Replicate(), Replicate()],
+                          mesh, [Shard(5), Replicate()])
+    assert ei.value.report.by_checker("reshard_placement")
+
+
+def test_reshard_equal_but_distinct_meshes_warned():
+    from paddle_tpu.distributed.auto_parallel.reshard_functions import \
+        DistAttrLite
+    from paddle_tpu.distributed.placements import Replicate
+    m1, m2 = _mesh2x2(), _mesh2x2()
+    assert m1 == m2 and m1 is not m2
+    report = analysis.CheckReport()
+    analysis.check_reshard(
+        2, DistAttrLite(m1, [Replicate(), Replicate()]),
+        DistAttrLite(m2, [Replicate(), Replicate()]),
+        report, global_shape=(4, 8))
+    assert any("DISTINCT objects" in d.message
+               for d in report.by_checker("reshard_placement"))
+
+
+# ------------------------------------------------- pipeline schedules
+
+def test_pipeline_schedules_clean():
+    for sched, C in (("FThenB", 1), ("1F1B", 1), ("VPP", 2),
+                     ("ZeroBubble", 1)):
+        r = analysis.check_pipeline_schedule(sched, 4, 8, num_chunks=C)
+        assert r.ok, (sched, r.render())
+
+
+def test_pipeline_deadlock_reported():
+    """Mismatched micro counts across ranks: one rank blocks on recvs
+    no peer will ever send — the exact class _check_micros catches one
+    rank at a time, here caught globally before launch."""
+    from paddle_tpu.analysis.distributed_checks import schedule_programs
+    p3 = schedule_programs("1F1B", 2, 3)
+    p2 = schedule_programs("1F1B", 2, 2)
+    report = analysis.CheckReport()
+    analysis.simulate_pipeline([p3[0], p2[1]], report, schedule="1F1B")
+    diags = report.by_checker("pipeline_schedule")
+    assert diags, report.render()
+    assert "DEADLOCK" in diags[0].message
+    assert diags[0].data["blocked"] == [0]
+
+
+def test_pipeline_ordering_violation_reported():
+    """A rank running its backwards in the wrong order pops FIFO
+    messages under the wrong tags — silent corruption at runtime,
+    an exact diagnostic here."""
+    from paddle_tpu.analysis.distributed_checks import schedule_programs
+    progs = schedule_programs("FThenB", 2, 2)
+    ops = progs[0]
+    # swap rank 0's two backward recvs: expects grad 0 then grad 1
+    ri = [k for k, op in enumerate(ops) if op[0] == "recv"]
+    ops[ri[0]], ops[ri[1]] = ops[ri[1]], ops[ri[0]]
+    report = analysis.CheckReport()
+    analysis.simulate_pipeline(progs, report, schedule="FThenB")
+    diags = report.by_checker("pipeline_schedule")
+    assert diags, report.render()
+    assert "FIFO order diverged" in diags[0].message
+    assert "SILENT data corruption" in diags[0].message
+
+
+def test_pipeline_runtime_build_checks_schedule():
+    """The runtime constructors sweep their schedule when checks are
+    on (clean config: no warnings, sweeps counted)."""
+    from paddle_tpu.observability import metrics
+
+    class _FakePg:
+        rank, size = 0, 2
+
+        def barrier(self):
+            pass
+
+    class _FakeGroup:
+        pg = _FakePg()
+
+    from paddle_tpu.distributed.pipeline import DistPipelineRuntime
+    import paddle_tpu.nn as nn
+    before = metrics.counter("sanitizer.pipeline_sweeps").value
+    DistPipelineRuntime(nn.Linear(2, 2), _FakeGroup(), None, 4)
+    assert metrics.counter("sanitizer.pipeline_sweeps").value > before
+
+
+# --------------------------------------------- observability integration
+
+def test_diagnostics_counted_and_flight_recorded():
+    """Every emitted diagnostic bumps its per-checker counter
+    (sanitizer.diagnostics.<checker>, visible in observability.stats())
+    and error-severity findings land in the flight ring."""
+    from paddle_tpu import observability as obs
+    from paddle_tpu.observability import metrics
+    x = _x(seed=33)
+    before = metrics.counter("sanitizer.diagnostics.inplace_race").value
+    with _with_flag("FLAGS_flight_recorder", True):
+        with lazy.lazy_guard() as ctx:
+            y = x + 2.0
+            x._inplace_version += 1        # seeded unnotified mutation
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                ctx.flush()                 # warn mode (conftest)
+        assert metrics.counter(
+            "sanitizer.diagnostics.inplace_race").value > before
+        assert "sanitz" in obs.flight_record()
+        assert "inplace_race" in obs.flight_record()
+    x._inplace_version = 0
+    snap = obs.stats()
+    assert any(k.startswith("sanitizer.diagnostics.")
+               for k in snap["counters"])
+
+
 # ------------------------------------------------------------ surfaces
 
 def test_check_segment_clean_on_real_model_step():
@@ -422,6 +932,52 @@ def test_cli_exits_zero_on_lenet():
         assert main(["--models", "lenet"]) == 0
     finally:
         set_flags({"FLAGS_static_checks": old})
+
+
+def test_cli_distributed_sweep_and_json(capsys):
+    """The distributed bench models (reshard matrix + the four pipeline
+    schedules) sweep clean; --json emits the observability-CLI-shaped
+    payload (headline numbers + a counters block)."""
+    import json as _json
+    from paddle_tpu.analysis.__main__ import main
+    old = flag_value("FLAGS_static_checks")
+    try:
+        assert main(["--models", "reshard,pipeline", "--json"]) == 0
+    finally:
+        set_flags({"FLAGS_static_checks": old})
+    out = capsys.readouterr().out
+    payload = _json.loads(out.strip().rsplit("\n", 1)[-1])
+    assert payload["findings"] == 0
+    assert payload["programs"] >= 5
+    assert "fixes_applied" in payload and "segment_sweeps" in payload
+    assert any(k.startswith("sanitizer.") for k in payload["counters"])
+    assert "pipeline" in payload["models"]
+
+
+def test_cli_fix_dry_run_prints_diff(capsys):
+    """--fix plans the mechanical repairs and prints the dry-run diff;
+    the exit code reflects the post-fix residual."""
+    from paddle_tpu.analysis import __main__ as cli
+    old = flag_value("FLAGS_static_checks")
+    try:
+        cli._FIX = True
+        set_flags({"FLAGS_static_checks": "warn"})
+        rep = cli._trace_eager(_dead_capture_build, "seeded", False,
+                               False)
+    finally:
+        cli._FIX = False
+        set_flags({"FLAGS_static_checks": old})
+    out = capsys.readouterr().out
+    assert "fix plan:" in out and "prune" in out
+    assert rep.by_checker("dead_capture") == []   # residual is clean
+
+
+def _dead_capture_build():
+    x = _x(seed=32)
+    y = x * 2.0
+    z = x + 9.0        # dead: dropped before any read
+    del z
+    return y
 
 
 def test_error_mode_raise_keeps_later_eager_ops_working():

@@ -208,6 +208,35 @@ def test_static_checks_flag_live():
     x._inplace_version = 0
 
 
+def test_static_checks_fix_spelling_live():
+    """'fix' (and its synonyms) is a first-class FLAGS_static_checks
+    level: the flush repairs the mechanical classes in place instead of
+    warning, and clean programs are never rewritten."""
+    from paddle_tpu._core import lazy
+    from paddle_tpu.analysis.hooks import check_mode, fixes_applied
+
+    for spelling in ("fix", "autofix", "repair"):
+        with _with_flag("FLAGS_static_checks", spelling):
+            assert check_mode() == "fix"
+
+    x = paddle.to_tensor(np.ones((2,), "float32"))
+    with _with_flag("FLAGS_static_checks", "fix"):
+        before = fixes_applied()
+        with lazy.lazy_guard() as ctx:
+            y = x + 1.0
+            x._inplace_version += 1      # seeded unnotified mutation
+            ctx.flush()                   # repaired, not raised
+        assert fixes_applied() == before + 1
+        np.testing.assert_allclose(y.numpy(), [2.0, 2.0])
+        # clean program: the rewrite counter must stay frozen
+        before = fixes_applied()
+        with lazy.lazy_guard() as ctx:
+            z = x * 2.0
+            ctx.flush()
+        assert fixes_applied() == before
+    x._inplace_version = 0
+
+
 def test_ir_pass_disable_flag():
     from paddle_tpu.ir.pass_base import Pass, PassManager
 
