@@ -14,6 +14,11 @@ Row 6  observability overhead sanity     asserts 0 registry mutations when
                                          off; reports enabled overhead % and
                                          a counter snapshot (cache_hit_rate,
                                          compiles) in the row json
+Row 7  resilience recovery latency       asserts the faults-off path freezes
+                                         every resilience.* counter (zero
+                                         runtime work); reports the
+                                         detect->restore->re-run latency for
+                                         one injected elastic-step failure
 (Multi-chip GPT/ERNIE hybrids need a pod; their single-chip proxies are
 bench.py's headline + the dryrun_multichip compile check.)
 """
@@ -280,11 +285,88 @@ def bench_observability():
             }}
 
 
+def bench_resilience():
+    """Row 7: fault-tolerance overhead + recovery latency. With
+    FLAGS_fault_inject off the resilience runtime must contribute ZERO
+    registry work — asserted by every `resilience.*` counter staying
+    FROZEN across the 32-op dispatch chain AND an ElasticStep-wrapped
+    LeNet loop (the exact-counter technique of rows 5/6; wall-clock
+    deltas between identical paths are machine noise, frozen counters
+    are not). The reported value is the recovery latency — detect ->
+    restore snapshot -> re-run to success — for ONE injected step
+    failure; the row json carries the elastic vs plain per-step time
+    so the snapshot cost (the price of rollback insurance, paid only
+    when the wrapper is used) stays visible."""
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.distributed.resilience import ElasticStep
+    from paddle_tpu.observability import metrics
+    from paddle_tpu.vision.models import LeNet
+
+    x = paddle.to_tensor(np.ones((16, 16), "float32"))
+
+    def chain():
+        y = x
+        for _ in range(16):
+            y = y * 1.0001 + 0.0001
+        return y._value
+
+    paddle.seed(0)
+    model = LeNet()
+    opt = paddle.optimizer.Adam(1e-3, parameters=model.parameters())
+    rng = np.random.RandomState(0)
+    bx = paddle.to_tensor(rng.randn(32, 1, 28, 28).astype(np.float32))
+    by = paddle.to_tensor(rng.randint(0, 10, (32,)).astype(np.int64))
+    elastic = ElasticStep(optimizer=opt)
+
+    def step():
+        loss = F.cross_entropy(model(bx), by)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss._value
+
+    def res_counters():
+        return {k: v for k, v in metrics.snapshot()["counters"].items()
+                if k.startswith("resilience.")}
+
+    # warm both paths off-clock (the snapshot's per-shape copy ops
+    # compile on the first elastic step), then freeze-assert the
+    # faults-off run
+    _timeit(chain, steps=20, warmup=5)
+    plain_t = _timeit(step, steps=5, warmup=2)
+    _timeit(lambda: elastic.run(step), steps=1, warmup=2)
+    before = res_counters()
+    _timeit(chain, steps=100, warmup=0)
+    elastic_t = _timeit(lambda: elastic.run(step), steps=5, warmup=0)
+    assert res_counters() == before, \
+        "FLAGS_fault_inject off did resilience work (must be 0)"
+
+    # one injected transient step failure: measure the recovery
+    fail_at = elastic.step_index + 2
+    paddle.set_flags(
+        {"FLAGS_fault_inject": f"step::{fail_at}=fail"})
+    try:
+        for _ in range(3):
+            np.asarray(elastic.run(step))
+    finally:
+        paddle.set_flags({"FLAGS_fault_inject": ""})
+    assert elastic.last_recovery_s is not None, "no recovery measured"
+    return {"metric": "resilience recovery latency (LeNet elastic "
+                      "step, detect -> restore -> re-run; faults-off "
+                      "= frozen resilience.* counters asserted)",
+            "value": round(elastic.last_recovery_s * 1000.0, 2),
+            "unit": "ms",
+            "plain_step_ms": round(plain_t * 1000.0, 2),
+            "elastic_step_ms": round(elastic_t * 1000.0, 2)}
+
+
 def main():
-    rows = os.environ.get("BENCH_ROWS", "1,2,3,4,5,6").split(",")
+    rows = os.environ.get("BENCH_ROWS", "1,2,3,4,5,6,7").split(",")
     table = {"1": bench_lenet, "2": bench_resnet50, "3": bench_bert,
              "4": bench_dispatch, "5": bench_static_checks,
-             "6": bench_observability}
+             "6": bench_observability, "7": bench_resilience}
     for r in rows:
         r = r.strip()
         out = table[r]()

@@ -253,6 +253,40 @@ def _sync_static_checks_gate(value):
 
 watch_flag("FLAGS_static_checks", _sync_static_checks_gate)
 
+# ---- fault tolerance / resilience (distributed/resilience)
+define_flag("FLAGS_fault_inject", "",
+            "Deterministic fault-injection plan ('' = off, zero cost): "
+            "'seed=N;site[@occ]=kind[(arg)][:prob];...' where site is a "
+            "named injection point (store::get, pg::init, "
+            "comm::all_reduce, segment::compile, step::N, ckpt::save; "
+            "trailing * wildcards match) and kind is fail | die | "
+            "delay(s) | stuck(s). See distributed/resilience/faults.py.")
+define_flag("FLAGS_retry_max_attempts", 3,
+            "RetryPolicy default attempt budget for transient failures "
+            "(TCPStore ops, process-group bring-up, host collectives, "
+            "checkpoint I/O).")
+define_flag("FLAGS_retry_backoff_s", 0.05,
+            "RetryPolicy base backoff delay in seconds (exponential "
+            "with deterministic jitter).")
+define_flag("FLAGS_elastic_max_retries", 2,
+            "ElasticStep: rollback-and-rerun attempts per training step "
+            "before the failure propagates.")
+
+# Cached module-level gate for the fault-injection hot-path hooks
+# (store ops, collectives, segment compile, elastic steps): True iff
+# FLAGS_fault_inject names a plan. Same watcher-kept-coherent pattern
+# as STATIC_CHECKS_ACTIVE — the off path pays one attribute read and
+# never imports the resilience package.
+FAULT_INJECT_ACTIVE = False
+
+
+def _sync_fault_inject_gate(value):
+    global FAULT_INJECT_ACTIVE
+    FAULT_INJECT_ACTIVE = bool(str(value).strip())
+
+
+watch_flag("FLAGS_fault_inject", _sync_fault_inject_gate)
+
 # ---- kernels / pallas
 define_flag("FLAGS_flash_interpret", False,
             "Force Pallas flash kernels into interpret mode (CPU mesh "

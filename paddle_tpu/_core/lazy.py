@@ -435,6 +435,13 @@ class CaptureContext:
             # returns to tracing the next ops while the device executes;
             # sync happens only at explicit .numpy()/float() reads
             if runner is None:
+                if _flags.FAULT_INJECT_ACTIVE:
+                    # segment::compile fault site (transient compile
+                    # failure): raises inside this try so cleanup is
+                    # exactly a real failed compile — trace dropped,
+                    # spans closed, flight post-mortem
+                    from ..distributed.resilience import faults as _faults
+                    _faults.inject("segment::compile")
                 if fspan is not None:
                     xspan = _obs_exec_span(True, len(pending))
                 if _OBS.METRICS:
@@ -1169,6 +1176,18 @@ def try_fused_backward(tensors, grad_tensors) -> bool:
     key = (sig, grad_in, root_k)
     runner = _FUSED_CACHE.get(key)
     compiled = runner is None
+    if compiled and _flags.FAULT_INJECT_ACTIVE:
+        # segment::compile fault site on the fused fwd+vjp path too:
+        # clean up exactly like a real failed compile
+        from ..distributed.resilience import faults as _faults
+        try:
+            _faults.inject("segment::compile")
+        except Exception as e:
+            ctx._reset_segment()
+            if fspan is not None:
+                fspan.end(error=e)
+            _obs_flush_failed("backward_fused", e)
+            raise
     if compiled:
         runner = jax.jit(_build_fused_fn(pending, live, grad_in, root_k))
         _FUSED_CACHE[key] = runner

@@ -170,6 +170,33 @@ def on_pipeline_build(schedule: str, pp_size: int, num_micro: int,
     return report
 
 
+def on_world_shrink(transitions, pipeline=None):
+    """Post-recovery validation (resilience.shrink_world): every
+    planned reshard transition — and the shrunk pipeline schedule,
+    when one is in play — is checked BEFORE the first post-recovery
+    step. Always runs in 'error' semantics: recovering onto a broken
+    layout (out-of-range shard, uneven split, deadlocking schedule
+    over the shrunk world) is strictly worse than failing loudly, so
+    this sweep does not honor FLAGS_static_checks=off.
+
+    `transitions` is a list of (val_ndim, src_attr, dst_attr,
+    global_shape); `pipeline` is (schedule, pp_size, num_micro,
+    num_chunks) or None."""
+    from ..observability import metrics
+    metrics.counter("sanitizer.shrink_sweeps").inc()
+    from .diagnostics import CheckReport
+    from .distributed_checks import check_pipeline_schedule, check_reshard
+    report = CheckReport("world-shrink recovery plan")
+    for val_ndim, src, dst, gshape in transitions:
+        check_reshard(val_ndim, src, dst, report, global_shape=gshape)
+    if pipeline is not None:
+        schedule, pp_size, num_micro, num_chunks = pipeline
+        check_pipeline_schedule(schedule, pp_size, num_micro,
+                                num_chunks, report=report)
+    report.emit("error", stacklevel=4)
+    return report
+
+
 # ----------------------------------------------------------- SOT guards
 
 def on_sot_entry_installed(sot_fn, mode: str):

@@ -37,6 +37,9 @@ from . import context_parallel  # noqa: F401
 from . import utils  # noqa: F401
 from .store import TCPStore, create_or_get_global_tcp_store  # noqa: F401
 from .watchdog import CommTaskManager, get_comm_task_manager  # noqa: F401
+from . import resilience  # noqa: F401
+from .resilience import (ElasticStep, FaultPlan, RetryPolicy,  # noqa: F401
+                         shrink_world)
 from . import auto_parallel  # noqa: F401
 from .auto_parallel import Engine, Strategy, to_static  # noqa: F401
 from . import auto_tuner  # noqa: F401

@@ -11,8 +11,10 @@ global). Orbax-backed incremental shard files are the follow-up.
 """
 from __future__ import annotations
 
+import hashlib
 import os
 import pickle
+import tempfile
 from typing import Dict
 
 import numpy as np
@@ -21,6 +23,33 @@ from .._core.tensor import Tensor
 from .api import DistAttr, shard_tensor
 from .mesh import ProcessMesh
 from .placements import Partial, Replicate, Shard
+from .resilience import faults as _faults
+from .resilience import retry as _retry
+
+
+def _checksum(blob: bytes) -> str:
+    return "sha256:" + hashlib.sha256(blob).hexdigest()
+
+
+def _atomic_write(path: str, blob: bytes) -> None:
+    """Write-to-temp + fsync + os.replace: a crash mid-save leaves the
+    previous checkpoint intact instead of a torn pickle that loads
+    garbage or half a state dict."""
+    d = os.path.dirname(path) or "."
+    fd, tmp = tempfile.mkstemp(dir=d,
+                               prefix=".tmp_" + os.path.basename(path))
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(blob)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
 
 
 def _placement_to_tuple(p):
@@ -41,6 +70,8 @@ def _placement_from_tuple(t):
 
 def save_state_dict(state_dict: Dict[str, Tensor], path: str,
                     process_group=None, coordinator_rank=0):
+    if _faults.ACTIVE:
+        _faults.inject("ckpt::save")
     os.makedirs(path, exist_ok=True)
     meta = {}
     data = {}
@@ -62,10 +93,21 @@ def save_state_dict(state_dict: Dict[str, Tensor], path: str,
         else:
             meta[name] = {"py": True}
             data[name] = t
-    with open(os.path.join(path, "metadata.pkl"), "wb") as f:
-        pickle.dump(meta, f)
-    with open(os.path.join(path, "data_rank0.pkl"), "wb") as f:
-        pickle.dump(data, f)
+    # atomic + verified layout: the data file is pickled to bytes first
+    # so its checksum can ride the metadata; both files land via
+    # temp-write + os.replace (data first — a crash in between leaves
+    # the OLD metadata whose checksum then refuses the new data with a
+    # clear error instead of loading a mixed checkpoint)
+    data_blob = pickle.dumps(data)
+    meta["__checkpoint_format__"] = {
+        "version": 2,
+        "checksums": {"data_rank0.pkl": _checksum(data_blob)},
+    }
+    ckpt = _retry.ckpt_policy()
+    ckpt.run(_atomic_write, os.path.join(path, "data_rank0.pkl"),
+             data_blob, what="ckpt::write(data)")
+    ckpt.run(_atomic_write, os.path.join(path, "metadata.pkl"),
+             pickle.dumps(meta), what="ckpt::write(meta)")
 
 
 def load_state_dict(state_dict: Dict[str, Tensor], path: str,
@@ -73,8 +115,36 @@ def load_state_dict(state_dict: Dict[str, Tensor], path: str,
     """Fill `state_dict`'s tensors in place; each target keeps its OWN
     current dist_attr (that's the reshard-on-load: stored global values
     are re-laid-out to whatever mesh the target uses now)."""
-    with open(os.path.join(path, "data_rank0.pkl"), "rb") as f:
-        data = pickle.load(f)
+    if _faults.ACTIVE:
+        _faults.inject("ckpt::load")
+
+    def _read(p):
+        with open(p, "rb") as f:
+            return f.read()
+
+    ckpt = _retry.ckpt_policy()
+    data_blob = ckpt.run(_read, os.path.join(path, "data_rank0.pkl"),
+                         what="ckpt::read(data)")
+    # verify the per-file checksum BEFORE unpickling: a torn or
+    # bit-rotted data file fails with a clear framework error instead
+    # of loading garbage (or executing a corrupt pickle stream).
+    # Checkpoints from the pre-checksum format load unverified.
+    meta_path = os.path.join(path, "metadata.pkl")
+    if os.path.exists(meta_path):
+        meta = pickle.loads(ckpt.run(_read, meta_path,
+                                     what="ckpt::read(meta)"))
+        fmt = meta.get("__checkpoint_format__")
+        expected = (fmt or {}).get("checksums", {}).get("data_rank0.pkl")
+        if expected is not None and _checksum(data_blob) != expected:
+            from ..base.core import EnforceNotMet
+            raise EnforceNotMet(
+                f"checkpoint at {path} is corrupted: data_rank0.pkl "
+                f"checksum {_checksum(data_blob)} does not match the "
+                f"recorded {expected}",
+                context="the file was torn by a crash mid-save or "
+                        "modified after save_state_dict; re-save or "
+                        "restore from a replica")
+    data = pickle.loads(data_blob)
     import jax
     import jax.numpy as jnp
 

@@ -27,6 +27,43 @@ import numpy as np
 from .._core.tensor import Tensor
 from ..observability import _state as _OBS
 from ..observability.spans import NULL_SPAN
+from .resilience import faults as _faults
+from .resilience import retry as _retry
+
+
+def _resilient(name: str, fn, *args, **kw):
+    """`comm::<name>` fault site + the comm retry policy around one
+    host-driven collective. The injection runs INSIDE the retried
+    closure, so a transient fault on attempt 1 is retried past (an
+    occurrence-scoped plan entry fires once); faults off = one
+    module-attribute read + one try/except.
+
+    Retries must replay the SAME wire round: the store-fallback
+    transport keys every collective by per-group sequence counters, so
+    a failed attempt restores them before re-running — otherwise the
+    retrying rank moves to seq N+1 while its peers sit at N and every
+    later collective deadlocks off-by-one. Publishes are store.set
+    (overwrite-idempotent) and the round's retire counter only ticks
+    after success, so a pre-completion replay is clean. Failures of
+    the native ring transport mid-exchange are NOT in the retryable
+    set (raw socket errors surface as StoreOpError-free RuntimeError)
+    — a half-exchanged ring needs the step-level rollback, not an op
+    retry."""
+    pg = getattr(fn, "__self__", None)
+
+    def attempt():
+        if _faults.ACTIVE:
+            _faults.inject("comm::" + name)
+        if pg is not None:
+            snap = (pg._seq, dict(pg._p2p_seq), pg._barrier_round)
+        try:
+            return fn(*args, **kw)
+        except BaseException:
+            if pg is not None:
+                pg._seq, pg._barrier_round = snap[0], snap[2]
+                pg._p2p_seq = snap[1]
+            raise
+    return _retry.comm_policy().run(attempt, what="comm::" + name)
 
 
 def _obs_comm(name: str):
@@ -185,7 +222,8 @@ def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     if _single(group):
         return tensor
     with _obs_comm("all_reduce"):
-        out = _pg(group).all_reduce(_np(tensor), op)
+        out = _resilient("all_reduce", _pg(group).all_reduce,
+                         _np(tensor), op)
     tensor._adopt(_wrap_like(out, tensor))
     return tensor
 
@@ -196,7 +234,8 @@ def all_gather(tensor_list: List, tensor: Tensor, group=None, sync_op=True):
                            else tensor)
         return tensor_list
     with _obs_comm("all_gather"):
-        parts = _pg(group).all_gather(_np(tensor))
+        parts = _resilient("all_gather", _pg(group).all_gather,
+                           _np(tensor))
     tensor_list.extend(_wrap_like(p, tensor) for p in parts)
     return tensor_list
 
@@ -213,8 +252,8 @@ def broadcast(tensor: Tensor, src=0, group=None, sync_op=True):
     if _single(group):
         return tensor
     with _obs_comm("broadcast"):
-        out = _pg(group).broadcast(_np(tensor),
-                                   _grank(group, src, 'src'))
+        out = _resilient("broadcast", _pg(group).broadcast,
+                         _np(tensor), _grank(group, src, 'src'))
     tensor._adopt(_wrap_like(out, tensor))
     return tensor
 
@@ -233,8 +272,8 @@ def reduce(tensor: Tensor, dst=0, op=ReduceOp.SUM, group=None,
     if _single(group):
         return tensor
     with _obs_comm("reduce"):
-        out = _pg(group).reduce(_np(tensor), _grank(group, dst, 'dst'),
-                                op)
+        out = _resilient("reduce", _pg(group).reduce, _np(tensor),
+                         _grank(group, dst, 'dst'), op)
     tensor._adopt(_wrap_like(out, tensor))
     return tensor
 
@@ -246,8 +285,8 @@ def reduce_scatter(tensor: Tensor, tensor_list, op=ReduceOp.SUM, group=None,
         tensor._adopt(t.clone())
         return tensor
     with _obs_comm("reduce_scatter"):
-        out = _pg(group).reduce_scatter([_np(t) for t in tensor_list],
-                                        op)
+        out = _resilient("reduce_scatter", _pg(group).reduce_scatter,
+                         [_np(t) for t in tensor_list], op)
     tensor._adopt(_wrap_like(out, tensor))
     return tensor
 
@@ -260,7 +299,8 @@ def scatter(tensor: Tensor, tensor_list=None, src=0, group=None,
         return tensor
     parts = [_np(t) for t in tensor_list] if tensor_list else None
     with _obs_comm("scatter"):
-        out = _pg(group).scatter(parts, _grank(group, src, 'src'))
+        out = _resilient("scatter", _pg(group).scatter, parts,
+                         _grank(group, src, 'src'))
     tensor._adopt(_wrap_like(out, tensor))
     return tensor
 
@@ -272,8 +312,8 @@ def gather(tensor: Tensor, gather_list=None, dst=0, group=None,
             gather_list.append(tensor.clone())
         return gather_list
     with _obs_comm("gather"):
-        parts = _pg(group).gather(_np(tensor),
-                                  _grank(group, dst, 'dst'))
+        parts = _resilient("gather", _pg(group).gather, _np(tensor),
+                           _grank(group, dst, 'dst'))
     if parts is not None and gather_list is not None:
         gather_list.extend(_wrap_like(p, tensor) for p in parts)
     return gather_list
@@ -284,7 +324,8 @@ def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
         out_tensor_list.extend(t.clone() for t in in_tensor_list)
         return out_tensor_list
     with _obs_comm("alltoall"):
-        parts = _pg(group).all_to_all([_np(t) for t in in_tensor_list])
+        parts = _resilient("all_to_all", _pg(group).all_to_all,
+                           [_np(t) for t in in_tensor_list])
     out_tensor_list.extend(_wrap_like(p, in_tensor_list[0]) for p in parts)
     return out_tensor_list
 
@@ -297,7 +338,8 @@ def send(tensor: Tensor, dst=0, group=None, sync_op=True):
     if g.nranks <= 1:
         raise RuntimeError("send needs a multi-process group")
     with _obs_comm("send"):
-        _pg(group).send(_np(tensor), _grank(group, dst, 'dst'))
+        _resilient("send", _pg(group).send, _np(tensor),
+                   _grank(group, dst, 'dst'))
 
 
 def recv(tensor: Tensor, src=0, group=None, sync_op=True):
@@ -305,7 +347,8 @@ def recv(tensor: Tensor, src=0, group=None, sync_op=True):
     if g.nranks <= 1:
         raise RuntimeError("recv needs a multi-process group")
     with _obs_comm("recv"):
-        out = _pg(group).recv(_grank(group, src, 'src'))
+        out = _resilient("recv", _pg(group).recv,
+                         _grank(group, src, 'src'))
     tensor._adopt(_wrap_like(out, tensor))
     return tensor
 
@@ -322,7 +365,7 @@ def barrier(group=None):
     if _single(group):
         return
     with _obs_comm("barrier"):
-        _pg(group).barrier()
+        _resilient("barrier", _pg(group).barrier)
 
 
 def wait(tensor, group=None, use_calc_stream=True):

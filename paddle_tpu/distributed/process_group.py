@@ -23,6 +23,9 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
+from .resilience import faults as _faults
+from .resilience import retry as _retry
+
 _REDUCE_FNS = {
     "sum": lambda acc, x: acc + x,
     "max": np.maximum,
@@ -90,15 +93,29 @@ class ProcessGroup:
         import os
         if (self.rank >= 0 and self.size > 1
                 and os.environ.get("PADDLE_NATIVE_COMM", "1") != "0"):
+            # the instance counter bumps ONCE per construction (outside
+            # the retried closure — a retried bring-up must rendezvous
+            # under the SAME key on every attempt or the ranks desync)
             inst = ProcessGroup._cc_instances.get(gid, 0)
             ProcessGroup._cc_instances[gid] = inst + 1
-            from .comm_context import CommContext
-            self._cc = CommContext.create_negotiated(
-                store, self.rank, self.size, key=f"__cc/{gid}/{inst}")
-            if self._cc is not None:
-                self._ccp = CommContext(
-                    store, self.rank, self.size,
-                    key=f"__cc/{gid}/{inst}/p2p")
+
+            def _bring_up():
+                # pg::init fault site + bring-up retry policy: multi-host
+                # rendezvous flakiness (MLPerf-on-pods' dominant failure
+                # mode, arxiv 1909.09756) gets backoff-and-reconnect
+                # instead of a dead job
+                if _faults.ACTIVE:
+                    _faults.inject("pg::init")
+                from .comm_context import CommContext
+                self._cc = CommContext.create_negotiated(
+                    store, self.rank, self.size, key=f"__cc/{gid}/{inst}")
+                if self._cc is not None:
+                    self._ccp = CommContext(
+                        store, self.rank, self.size,
+                        key=f"__cc/{gid}/{inst}/p2p")
+
+            _retry.bringup_policy().run(_bring_up,
+                                        what=f"pg::init(gid={gid})")
 
     # ------------------------------------------------------------ plumbing
     def _next(self) -> str:

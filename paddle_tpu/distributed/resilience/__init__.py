@@ -1,0 +1,20 @@
+"""paddle_tpu.distributed.resilience — elastic fault-tolerance runtime.
+
+Four pieces wired end-to-end (the reactions to the distributed layer's
+existing sensors — watchdog, TCPStore rendezvous, checkpoint):
+
+- `faults`   deterministic fault injection (`FLAGS_fault_inject`)
+- `retry`    retry/timeout/backoff policies for the transient class
+- `ElasticStep`  step snapshot + rollback + watchdog coverage
+- `shrink_world` mesh/process-group rebuild over surviving ranks,
+  sanitizer-validated before the first post-recovery step
+"""
+from __future__ import annotations
+
+from . import faults  # noqa: F401
+from . import retry  # noqa: F401
+from .faults import (CollectiveTimeout, FaultError, FaultPlan,  # noqa: F401
+                     RankDeath, TransientFault)
+from .retry import RetryPolicy  # noqa: F401
+from .elastic import (ElasticStep, plan_shrink,  # noqa: F401
+                      shrink_world)

@@ -1,0 +1,361 @@
+"""Step rollback + graceful world-shrink.
+
+`ElasticStep` is the reaction half of the watchdog: it wraps one
+training step with an in-memory snapshot of everything the step
+mutates (parameter payloads, optimizer state, master weights, the
+global RNG key), registers the step with the comm watchdog, and on a
+transient failure — an injected fault, a stuck collective the
+watchdog timed out, a failed segment compile — restores the snapshot
+and re-runs, proving bit-exact resume (tests/test_resilience.py).
+
+Snapshots are **donation-aware**: the fused optimizer update donates
+the old param/state buffers (`donate_argnums=(0, 2)`,
+`FLAGS_optimizer_donate_params`), and its `_pick_update` refcount
+probe falls back to the copying runner if anything else still holds a
+reference to a param buffer. Snapshots therefore take *fresh copies*
+(`jnp.array(v, copy=True)`) BEFORE the step runs — they neither die
+with the donated originals nor inflate the originals' refcounts, so
+the donating fast path stays on.
+
+`shrink_world` is the reaction to confirmed rank loss (`RankDeath`):
+rebuild the ProcessMesh over the survivors, re-lay-out every sharded
+tensor via the existing reshard path, and have the PR-4 sanitizer
+checkers (`reshard_placement`, `pipeline_schedule`) validate the
+recovery plan BEFORE the first post-recovery step (2112.02752's
+elastic resize, single-controller edition).
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, Optional, Sequence
+
+from ..._core import flags as _flags
+from ..watchdog import get_comm_task_manager
+from .faults import RankDeath, TransientFault
+
+# step failures the rollback path absorbs (RankDeath is handled
+# separately — it needs a world-shrink, not a re-run)
+_RETRYABLE_STEP = (TransientFault, TimeoutError, ConnectionError,
+                   OSError)
+
+
+def _copy_buf(v):
+    import jax.numpy as jnp
+    return jnp.array(v, copy=True)
+
+
+class ElasticStep:
+    """Wrap a train step with snapshot/rollback + watchdog coverage.
+
+    Usage::
+
+        elastic = ElasticStep(optimizer=opt, timeout=30.0)
+        for batch in loader:
+            loss = elastic.run(train_one_step, batch)
+
+    `run` fires the ``step::<N>`` fault site (N = 1-based step index),
+    so `FLAGS_fault_inject="step::3=fail"` exercises the rollback path
+    deterministically.
+    """
+
+    def __init__(self, optimizer=None, parameters: Sequence = None, *,
+                 max_retries: Optional[int] = None,
+                 timeout: Optional[float] = None,
+                 watchdog=None, name: str = "train_step",
+                 on_rank_death: Optional[Callable] = None):
+        if optimizer is None and parameters is None:
+            raise ValueError(
+                "ElasticStep needs an optimizer and/or parameters to "
+                "snapshot")
+        self._opt = optimizer
+        self._params = list(parameters) if parameters is not None else \
+            [p for p, _ in optimizer._all_params()]
+        self._max_retries = max_retries
+        self._timeout = timeout
+        self._watchdog = watchdog
+        self._task_name = f"elastic::{name}"
+        self._registered = False
+        self._on_rank_death = on_rank_death
+        self.step_index = 0
+        self.last_recovery_s: Optional[float] = None
+
+    # -------------------------------------------------------- snapshot
+    def _snapshot(self) -> Dict:
+        snap = {"params": [(p, _copy_buf(p._value)) for p in self._params]}
+        opt = self._opt
+        if opt is not None:
+            snap["opt_states"] = {
+                pid: {k: _copy_buf(v) for k, v in st.items()}
+                for pid, st in opt._states.items()}
+            snap["opt_master"] = {pid: _copy_buf(v)
+                                  for pid, v in opt._master.items()}
+            snap["opt_step"] = opt._step_count
+            lr = opt._lr
+            if hasattr(lr, "state_dict"):
+                snap["lr_state"] = dict(lr.state_dict())
+        from ..._core import random as _rng
+        snap["rng"] = dict(_rng._state)
+        return snap
+
+    def _restore(self, snap: Dict):
+        """Put the snapshot back — via copies, so the snapshot itself
+        stays pristine for a second retry — and clear grads (a failed
+        step may have half-accumulated them; the re-run's backward
+        must start clean)."""
+        for p, buf in snap["params"]:
+            p._replace_value_inplace(_copy_buf(buf))
+            p.clear_grad()
+        opt = self._opt
+        if opt is not None:
+            opt._states = {
+                pid: {k: _copy_buf(v) for k, v in st.items()}
+                for pid, st in snap["opt_states"].items()}
+            opt._master = {pid: _copy_buf(v)
+                           for pid, v in snap["opt_master"].items()}
+            opt._step_count = snap["opt_step"]
+            if "lr_state" in snap:
+                opt._lr.set_state_dict(dict(snap["lr_state"]))
+        from ..._core import random as _rng
+        _rng._state.update(snap["rng"])
+
+    # -------------------------------------------------------- watchdog
+    def _heartbeat(self):
+        if self._timeout is None:
+            return
+        if self._watchdog is None:
+            self._watchdog = get_comm_task_manager()
+        if not self._registered:
+            self._watchdog.register(self._task_name, timeout=self._timeout)
+            self._registered = True
+        else:
+            self._watchdog.heartbeat(self._task_name)
+
+    def _check_watchdog(self):
+        """Raise in THIS (waiting) thread if the watchdog declared the
+        step stuck while it ran — the 'raise on next check' contract."""
+        if self._registered:
+            self._watchdog.check(self._task_name)
+
+    def shutdown(self):
+        if self._registered:
+            self._watchdog.deregister(self._task_name)
+            self._registered = False
+
+    # ------------------------------------------------------------- run
+    def run(self, step_fn: Callable, *args, **kw):
+        self.step_index += 1
+        site = f"step::{self.step_index}"
+        budget = self._max_retries if self._max_retries is not None \
+            else int(_flags.flag_value("FLAGS_elastic_max_retries"))
+        snap = self._snapshot()
+        self._heartbeat()
+        attempt = 0
+        deaths = 0
+        detect_t: Optional[float] = None
+        while True:
+            try:
+                if _flags.FAULT_INJECT_ACTIVE:
+                    from . import faults
+                    faults.inject(site)
+                out = step_fn(*args, **kw)
+                self._check_watchdog()
+                if detect_t is not None:
+                    self.last_recovery_s = time.perf_counter() - detect_t
+                    from ...observability import metrics
+                    metrics.observe("resilience.recovery_us",
+                                    self.last_recovery_s * 1e6)
+                return out
+            except RankDeath as e:
+                detect_t = time.perf_counter()
+                deaths += 1
+                self._note_failure(site, e, kind="rank_death")
+                # bounded like the transient path: a death that
+                # recurs on every post-shrink re-run (or a handler
+                # that fails to evict the dead rank) must not spin
+                # restore->shrink->re-run forever
+                if self._on_rank_death is None or deaths > budget:
+                    if self._on_rank_death is not None:
+                        from ...observability import metrics
+                        metrics.inc("resilience.gave_up")
+                    raise
+                # confirmed rank loss: restore the pre-step state, let
+                # the handler rebuild the world (shrink_world), then
+                # re-run the step on the survivors
+                self._restore(snap)
+                self._on_rank_death(e)
+                self._count_rollback(site, e)
+            except _RETRYABLE_STEP as e:
+                detect_t = time.perf_counter()
+                self._heartbeat()   # the stall is over; stop the clock
+                attempt += 1
+                self._note_failure(site, e, kind="step_failure")
+                if attempt > budget:
+                    from ...observability import metrics
+                    metrics.inc("resilience.gave_up")
+                    raise
+                self._restore(snap)
+                self._count_rollback(site, e)
+
+    # ------------------------------------------------------ accounting
+    @staticmethod
+    def _note_failure(site: str, e: BaseException, kind: str):
+        from ...observability import metrics
+        metrics.inc("resilience.step_failures")
+        from ...observability import _state as _OBS
+        if _OBS.FLIGHT:
+            from ...observability import flight
+            flight.note("elastic", site, event=kind,
+                        error=repr(e)[:160])
+
+    @staticmethod
+    def _count_rollback(site: str, e: BaseException):
+        from ...observability import metrics
+        metrics.inc("resilience.rollbacks")
+        from ...observability import _state as _OBS
+        if _OBS.FLIGHT:
+            from ...observability import flight
+            flight.note("elastic", site, event="rollback")
+
+
+# ------------------------------------------------------- world shrink
+
+def plan_shrink(mesh, lost_process_ids: Sequence[int]):
+    """The survivors' ProcessMesh. Shrinks along the FIRST mesh axis
+    when the survivor count still factors over the trailing axes
+    (dp-style node loss keeps the mesh rank and dim names); otherwise
+    flattens to a 1-D mesh over the survivors."""
+    import numpy as np
+    from ..mesh import ProcessMesh
+    lost = set(int(r) for r in lost_process_ids)
+    survivors = [pid for pid in mesh.process_ids if pid not in lost]
+    if not survivors:
+        from ...base.core import EnforceNotMet
+        raise EnforceNotMet(
+            f"world shrink leaves no survivors (mesh {mesh!r}, "
+            f"lost {sorted(lost)})")
+    shape = mesh.shape
+    trailing = 1
+    for s in shape[1:]:
+        trailing *= s
+    n = len(survivors)
+    if len(shape) > 1 and trailing and n % trailing == 0 \
+            and n // trailing >= 1:
+        new_shape = [n // trailing] + shape[1:]
+        names = mesh.dim_names
+    else:
+        new_shape = [n]
+        names = [mesh.dim_names[0]]
+    return ProcessMesh(np.asarray(survivors).reshape(new_shape), names)
+
+
+def _shrunk_placements(old_placements, old_mesh, new_mesh, global_shape):
+    """Placements on the shrunk mesh: kept when the mesh rank survived
+    AND the shard still divides evenly over the (smaller) axis;
+    replicated otherwise (a flattened mesh invalidates per-axis shard
+    assignments, and an uneven split would fail the sanitizer's
+    reshard_placement check — replicate first, re-shard later)."""
+    from ..placements import Replicate
+    if new_mesh.ndim != old_mesh.ndim:
+        return [Replicate()] * new_mesh.ndim
+    out = []
+    for mesh_dim, p in enumerate(old_placements):
+        if p.is_shard():
+            d = p.get_dim()
+            axis = new_mesh.shape[mesh_dim]
+            size = global_shape[d] if d < len(global_shape) else None
+            if size is None or (axis and size % axis != 0):
+                out.append(Replicate())
+                continue
+        out.append(p)
+    return out
+
+
+def _reshard_opt_state(optimizer, param, dst):
+    """Re-lay-out one param's optimizer state leaves (and master
+    weight) onto the param's post-shrink sharding."""
+    import jax
+    from ..api import placements_to_spec
+    pid = id(param)
+
+    def put(v):
+        spec = placements_to_spec(dst.placements, dst.process_mesh,
+                                  getattr(v, "ndim", 0))
+        return jax.device_put(v, dst.process_mesh.named_sharding(spec))
+
+    st = optimizer._states.get(pid)
+    if st:
+        optimizer._states[pid] = {k: put(v) for k, v in st.items()}
+    if pid in optimizer._master:
+        optimizer._master[pid] = put(optimizer._master[pid])
+
+
+def shrink_world(mesh, lost_process_ids: Sequence[int],
+                 state: Optional[Dict] = None, *,
+                 optimizer=None,
+                 pipeline: Optional[tuple] = None,
+                 set_global: bool = True):
+    """Rebuild the world over the surviving ranks after confirmed rank
+    loss: plan the shrunk mesh, have the sanitizer's distributed
+    checkers validate every reshard transition (and the shrunk
+    pipeline schedule, when `pipeline=(schedule, num_micro)` or
+    `(schedule, num_micro, num_chunks)` is given) BEFORE any transfer
+    runs, then re-lay-out each sharded tensor in `state` in place via
+    the reshard registry. When `optimizer` is given, its per-param
+    state leaves and master weights follow their param's new layout
+    (they share the param's shape, and a state buffer left on the old
+    mesh would fail the next fused update's device check). Returns
+    the new ProcessMesh.
+
+    Validation is unconditional (mode 'error'): recovery onto a broken
+    layout is strictly worse than failing loudly — this is the one
+    sanitizer sweep that does not honor FLAGS_static_checks=off.
+    """
+    t0 = time.perf_counter()
+    new_mesh = plan_shrink(mesh, lost_process_ids)
+    tensors = []
+    transitions = []
+    if state:
+        from ..api import DistAttr
+        for name, t in state.items():
+            attr = getattr(t, "_dist_attr", None)
+            if attr is None or attr.process_mesh is not mesh:
+                continue
+            new_pl = _shrunk_placements(attr.placements, mesh, new_mesh,
+                                        tuple(t._value.shape))
+            dst = DistAttr(new_mesh, new_pl)
+            tensors.append((t, dst))
+            transitions.append((t._value.ndim, attr, dst,
+                                tuple(t._value.shape)))
+    pipe_cfg = None
+    if pipeline is not None:
+        schedule, num_micro = pipeline[0], pipeline[1]
+        num_chunks = pipeline[2] if len(pipeline) > 2 else 1
+        pipe_cfg = (schedule, new_mesh.size, num_micro, num_chunks)
+    from ...analysis import hooks as _sanitizer
+    _sanitizer.on_world_shrink(transitions, pipe_cfg)
+
+    # plan validated: move the data through the reshard registry
+    from ..auto_parallel.reshard_functions import reshard_value
+    for t, dst in tensors:
+        new_val, _fn = reshard_value(
+            t._value, t._dist_attr.process_mesh,
+            t._dist_attr.placements, dst.process_mesh, dst.placements)
+        t._replace_value_inplace(new_val)
+        t._dist_attr = dst
+        if optimizer is not None:
+            _reshard_opt_state(optimizer, t, dst)
+    if set_global:
+        from ..mesh import get_mesh, set_mesh
+        if get_mesh() is mesh:
+            set_mesh(new_mesh)
+    from ...observability import metrics
+    metrics.inc("resilience.world_shrinks")
+    metrics.observe("resilience.shrink_us",
+                    (time.perf_counter() - t0) * 1e6)
+    from ...observability import _state as _OBS
+    if _OBS.FLIGHT:
+        from ...observability import flight
+        flight.note("shrink", "world",
+                    old=mesh.size, new=new_mesh.size,
+                    lost=list(lost_process_ids), resharded=len(tensors))
+    return new_mesh

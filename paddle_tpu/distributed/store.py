@@ -8,13 +8,31 @@ multi-host bring-up: exchanging coordinator addresses before
 jax.distributed.initialize, barrier-by-key, elastic membership."""
 from __future__ import annotations
 
+import logging
 import os
 from typing import Optional
 
 from .._core import native
+from .resilience import faults as _faults
+from .resilience import retry as _retry
+
+_log = logging.getLogger("paddle_tpu.distributed")
+
+# typed transient failure for set/get/wait (lives in resilience.retry —
+# this module imports retry, not the reverse — and is re-exported here
+# because it is the store's error)
+StoreOpError = _retry.StoreOpError
 
 
 class TCPStore:
+
+    # barrier round numbers wrap here: a round's keys are deleted when
+    # the last rank leaves, so reuse after 2^16 rounds is safe — and
+    # the counter no longer grows without bound across a long job's
+    # repeated barriers on the same key (all ranks wrap identically,
+    # so the key namespaces still agree)
+    _BARRIER_ROUND_WRAP = 1 << 16
+
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  is_master: bool = False, world_size: int = 1,
                  timeout: float = None):
@@ -42,29 +60,51 @@ class TCPStore:
                 f"TCPStore connect failed: {native.last_error()}")
 
     # ------------------------------------------------------------- KV API
+    # Each op is one retryable attempt wrapped by the store RetryPolicy
+    # (resilience/retry.py): transient failures — injected via the
+    # store::* fault sites or OS-level — back off and re-attempt; a
+    # first-attempt success pays one try/except and zero registry work.
     def set(self, key: str, value) -> None:
         data = value.encode() if isinstance(value, str) else bytes(value)
+        _retry.store_policy().run(self._set_once, key, data,
+                                  what=f"store::set({key})")
+
+    def _set_once(self, key: str, data: bytes) -> None:
+        if _faults.ACTIVE:
+            _faults.inject("store::set")
         if self._lib.pt_store_set(self._client, key.encode(), data,
                                   len(data)) != 0:
-            raise RuntimeError(f"TCPStore.set failed: "
+            raise StoreOpError(f"TCPStore.set failed: "
                                f"{native.last_error()}")
 
     def get(self, key: str) -> bytes:
+        return _retry.store_policy().run(self._get_once, key,
+                                         what=f"store::get({key})")
+
+    def _get_once(self, key: str) -> bytes:
         import ctypes
+        if _faults.ACTIVE:
+            _faults.inject("store::get")
         n = self._lib.pt_store_get(self._client, key.encode(), None, 0,
                                    self._timeout_ms)
         if n < 0:
-            raise RuntimeError(f"TCPStore.get('{key}') failed: "
+            raise StoreOpError(f"TCPStore.get('{key}') failed: "
                                f"{native.last_error()}")
         buf = ctypes.create_string_buffer(int(n))
         n2 = self._lib.pt_store_get(self._client, key.encode(), buf, n,
                                     self._timeout_ms)
         if n2 < 0:
-            raise RuntimeError(f"TCPStore.get('{key}') failed: "
+            raise StoreOpError(f"TCPStore.get('{key}') failed: "
                                f"{native.last_error()}")
         return buf.raw[:n2]
 
     def add(self, key: str, amount: int = 1) -> int:
+        # NOT retried: add is not idempotent — a retry after an applied-
+        # but-unacked increment would double-count, and rendezvous
+        # counters are exactly where that corrupts the job. The fault
+        # site still fires so tests can target it.
+        if _faults.ACTIVE:
+            _faults.inject("store::add")
         r = self._lib.pt_store_add(self._client, key.encode(), amount)
         if r < 0 and native.last_error():
             raise RuntimeError(f"TCPStore.add failed: "
@@ -72,9 +112,15 @@ class TCPStore:
         return int(r)
 
     def wait(self, key: str, timeout: Optional[float] = None) -> None:
+        _retry.store_policy().run(self._wait_once, key, timeout,
+                                  what=f"store::wait({key})")
+
+    def _wait_once(self, key: str, timeout: Optional[float]) -> None:
+        if _faults.ACTIVE:
+            _faults.inject("store::wait")
         ms = int((timeout or self._timeout_ms / 1000) * 1000)
         if self._lib.pt_store_wait(self._client, key.encode(), ms) != 0:
-            raise RuntimeError(f"TCPStore.wait('{key}') timed out")
+            raise StoreOpError(f"TCPStore.wait('{key}') timed out")
 
     def delete(self, key: str) -> None:
         if self._lib.pt_store_del(self._client, key.encode()) != 0:
@@ -89,7 +135,7 @@ class TCPStore:
         call barrier the same number of times, so local counters agree),
         and the last rank out deletes the round's keys."""
         rnd = self._barrier_rounds.get(key, 0)
-        self._barrier_rounds[key] = rnd + 1
+        self._barrier_rounds[key] = (rnd + 1) % self._BARRIER_ROUND_WRAP
         base = f"__bar/{key}/{rnd}"
         arrived = self.add(f"{base}/count", 1)
         if arrived >= self.world_size:
@@ -113,10 +159,18 @@ class TCPStore:
         self._close_server()
 
     def __del__(self):
+        # narrow handling with a logged reason (the xplane-fallback
+        # convention): interpreter teardown can null out the ctypes lib
+        # or module globals (AttributeError/TypeError), and a peer gone
+        # first surfaces as OSError/RuntimeError from the native close —
+        # anything else is a real bug and should not be swallowed
         try:
             self.close()
-        except Exception:
-            pass
+        except (OSError, RuntimeError, AttributeError, TypeError) as e:
+            try:
+                _log.debug("TCPStore close during __del__ skipped: %r", e)
+            except Exception:
+                pass   # logging itself can be torn down at exit
 
 
 def create_or_get_global_tcp_store() -> TCPStore:

@@ -23,6 +23,7 @@ class CommTask:
         self.timeout = timeout
         self.last_beat = time.monotonic()
         self.timed_out = False
+        self.stacks = ""   # host stacks captured when the timeout fired
 
 
 def _dump_stacks() -> str:
@@ -50,7 +51,7 @@ class CommTaskManager:
     def _default_handler(self, task: CommTask):
         sys.stderr.write(
             f"[watchdog] task '{task.name}' exceeded {task.timeout}s "
-            f"without a heartbeat; host stacks:\n{_dump_stacks()}\n")
+            f"without a heartbeat; host stacks:\n{task.stacks}\n")
 
     # ------------------------------------------------------------- tasks
     def register(self, name: str, timeout: float = None) -> CommTask:
@@ -81,6 +82,25 @@ class CommTaskManager:
             t = self._tasks.get(name)
             return bool(t and t.timed_out)
 
+    def check(self, name: str) -> None:
+        """Raise in the CALLER — the waiting thread — if `name` has
+        timed out: the 'handler fires in the watchdog thread, the
+        waiting thread raises on its next check' contract from the
+        module docstring. The captured host stacks ride the error (and
+        the flight dump, via EnforceNotMet's armed-recorder trigger);
+        `heartbeat()` still recovers a task instead of raising."""
+        with self._lock:
+            t = self._tasks.get(name)
+            fired = bool(t and t.timed_out)
+            stacks = t.stacks if fired else ""
+        if fired:
+            from ..base.core import EnforceNotMet
+            raise EnforceNotMet(
+                f"watchdog: task '{name}' exceeded {t.timeout}s without "
+                f"a heartbeat",
+                context=f"host stacks at timeout:\n{stacks}"
+                if stacks else "")
+
     # ----------------------------------------------------------- thread
     def _ensure_thread(self):
         if self._thread is None or not self._thread.is_alive():
@@ -96,13 +116,37 @@ class CommTaskManager:
                 for t in self._tasks.values():
                     if not t.timed_out and \
                             now - t.last_beat > t.timeout:
+                        # stacks BEFORE timed_out becomes visible: a
+                        # waiting thread polling check() between the
+                        # flag and the capture would otherwise raise
+                        # with empty stacks — the exact post-mortem
+                        # signal the capture exists to preserve
+                        t.stacks = _dump_stacks()
                         t.timed_out = True
                         fired.append(t)
             for t in fired:
+                # counter + flight BEFORE the handler: a raising
+                # handler must not lose the post-mortem signal
+                self._account_fired(t)
                 try:
                     self._on_timeout(t)
                 except Exception:
+                    # a raising handler cannot kill the watchdog loop;
+                    # the waiting thread raises on its next check()
                     pass
+
+    @staticmethod
+    def _account_fired(t: CommTask):
+        from ..observability import metrics
+        metrics.inc("resilience.watchdog_fired")
+        from ..observability import _state as _OBS
+        if _OBS.FLIGHT:
+            from ..observability import flight
+            flight.note("watchdg", t.name, timeout_s=t.timeout)
+            # the stack dump lands in the flight record file itself —
+            # post-mortems should not depend on stderr capture
+            flight.dump(reason=f"watchdog: task '{t.name}' exceeded "
+                               f"{t.timeout}s; host stacks:\n{t.stacks}")
 
     def shutdown(self):
         self._stop.set()
