@@ -19,6 +19,14 @@ Row 7  resilience recovery latency       asserts the faults-off path freezes
                                          runtime work); reports the
                                          detect->restore->re-run latency for
                                          one injected elastic-step failure
+Row 8  adaptive re-plan latency          asserts the faults-off path freezes
+                                         every resilience.* counter (incl.
+                                         the adaptive replans/member_epochs/
+                                         ckpt_* set) across an
+                                         AdaptiveTrainer loop; reports the
+                                         membership-change -> first
+                                         post-replan-step latency for one
+                                         injected member::leave
 (Multi-chip GPT/ERNIE hybrids need a pod; their single-chip proxies are
 bench.py's headline + the dryrun_multichip compile check.)
 """
@@ -362,11 +370,81 @@ def bench_resilience():
             "elastic_step_ms": round(elastic_t * 1000.0, 2)}
 
 
+def bench_replan():
+    """Row 8: adaptive re-plan latency. The faults-off freeze-assert of
+    row 7, extended over an AdaptiveTrainer-wrapped loop so the NEW
+    resilience counters (replans, member_epochs, ckpt_fallbacks,
+    ckpt_restores, replan_fallback_plans) are proven frozen too — the
+    membership poll must cost one module-level bool when injection is
+    off. The reported value is the full adaptive-recovery latency for
+    one injected member::leave: membership change -> quiesce -> tuner
+    re-plan -> sanitizer validation -> mesh swap -> step-cache re-key
+    -> first successful post-replan step (which recompiles the fused
+    step against the new mesh epoch, so the compile is priced in).
+    The mesh is logical (8 processes losing 2) so the row runs on any
+    visible device count; row 7 already prices the data movement."""
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.distributed.mesh import ProcessMesh
+    from paddle_tpu.distributed.resilience import AdaptiveTrainer
+    from paddle_tpu.observability import metrics
+    from paddle_tpu.vision.models import LeNet
+
+    paddle.seed(0)
+    model = LeNet()
+    opt = paddle.optimizer.Adam(1e-3, parameters=model.parameters())
+    rng = np.random.RandomState(0)
+    bx = paddle.to_tensor(rng.randn(32, 1, 28, 28).astype(np.float32))
+    by = paddle.to_tensor(rng.randint(0, 10, (32,)).astype(np.int64))
+    mesh = ProcessMesh(list(range(8)), dim_names=["dp"])
+    trainer = AdaptiveTrainer(optimizer=opt, mesh=mesh,
+                              lost_ranks=[6, 7])
+
+    def step():
+        loss = F.cross_entropy(model(bx), by)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss._value
+
+    def res_counters():
+        return {k: v for k, v in metrics.snapshot()["counters"].items()
+                if k.startswith("resilience.")}
+
+    _timeit(lambda: trainer.run(step), steps=1, warmup=2)
+    before = res_counters()
+    adaptive_t = _timeit(lambda: trainer.run(step), steps=5, warmup=0)
+    assert res_counters() == before, \
+        "faults-off adaptive loop did resilience work (must be 0)"
+
+    # occurrence counting starts when the plan is armed: the leave
+    # fires on the SECOND post-arm membership poll
+    paddle.set_flags({"FLAGS_fault_inject": "member::leave@2=die"})
+    try:
+        for _ in range(3):
+            np.asarray(trainer.run(step))
+    finally:
+        paddle.set_flags({"FLAGS_fault_inject": ""})
+    assert trainer.replans == 1 and \
+        trainer.last_replan_latency_s is not None, "no replan measured"
+    return {"metric": "adaptive re-plan latency (8->6 member::leave, "
+                      "membership change -> first post-replan step; "
+                      "faults-off = frozen resilience.* counters "
+                      "asserted)",
+            "value": round(trainer.last_replan_latency_s * 1000.0, 2),
+            "unit": "ms",
+            "adaptive_step_ms": round(adaptive_t * 1000.0, 2),
+            "plan": {k: trainer.last_plan.get(k) for k in
+                     ("dp_degree", "mp_degree", "pp_degree")}}
+
+
 def main():
-    rows = os.environ.get("BENCH_ROWS", "1,2,3,4,5,6,7").split(",")
+    rows = os.environ.get("BENCH_ROWS", "1,2,3,4,5,6,7,8").split(",")
     table = {"1": bench_lenet, "2": bench_resnet50, "3": bench_bert,
              "4": bench_dispatch, "5": bench_static_checks,
-             "6": bench_observability, "7": bench_resilience}
+             "6": bench_observability, "7": bench_resilience,
+             "8": bench_replan}
     for r in rows:
         r = r.strip()
         out = table[r]()

@@ -271,6 +271,11 @@ define_flag("FLAGS_retry_backoff_s", 0.05,
 define_flag("FLAGS_elastic_max_retries", 2,
             "ElasticStep: rollback-and-rerun attempts per training step "
             "before the failure propagates.")
+define_flag("FLAGS_checkpoint_keep", 3,
+            "CheckpointManager: verified checkpoint generations kept on "
+            "disk (older generations pruned after each save; load "
+            "auto-falls-back to the newest verified older generation "
+            "when the latest fails its checksum).")
 
 # Cached module-level gate for the fault-injection hot-path hooks
 # (store ops, collectives, segment compile, elastic steps): True iff

@@ -52,6 +52,25 @@ _SEG_CACHE: Dict[Tuple, Any] = ExecCache(stat="segment")
 _FUSED_CACHE: Dict[Tuple, Any] = ExecCache(stat="fused_step")
 _AVAL_CACHE: Dict[Tuple, Tuple] = {}
 
+# Mesh epoch: a salt baked into every segment/step-cache signature.
+# Elastic re-planning (resilience/adaptive.py) bumps it after moving
+# live state onto a new device mesh, so the first post-replan step
+# compiles exactly ONE fresh executable against the new layout instead
+# of silently hitting a runner whose donation bookkeeping and sharding
+# assumptions were fixed on the old mesh; every later step hits the
+# re-keyed entry (recompile-exactly-once, asserted in
+# tests/test_resilience.py via the compiles.fused_step counter).
+MESH_EPOCH = 0
+
+
+def bump_mesh_epoch() -> int:
+    """Invalidate the compiled-segment and fused-step cache keys (the
+    old entries age out of the LRU; nothing is recompiled until the
+    next flush)."""
+    global MESH_EPOCH
+    MESH_EPOCH += 1
+    return MESH_EPOCH
+
 
 def _obs_flush_span(reason: str, n_ops: int, n_inputs: int, n_live: int,
                     n_donate: int):
@@ -365,8 +384,10 @@ class CaptureContext:
         return live, live_refs
 
     def _signature(self, in_vals, live) -> Tuple:
+        # MESH_EPOCH rides at the END: register_segment_grad slices the
+        # ops/inputs halves positionally (sig[1]/sig[2])
         return (jax.default_backend(), tuple(self._sig_ops),
-                _in_signature(in_vals), tuple(live))
+                _in_signature(in_vals), tuple(live), MESH_EPOCH)
 
     # ------------------------------------------------------------- flush
     def flush(self, reason: str = "materialize"):
@@ -783,7 +804,8 @@ def register_segment_grad(pending, live, live_refs, out_tensors,
         # the remapping is identical
         comp_sig = (sig[0], tuple(sig[1][j] for j in comp_ops),
                     tuple(sig[2][i] for i in comp_ins), tuple(local_live),
-                    tuple(comp_ops), tuple(comp_ins))
+                    tuple(comp_ops), tuple(comp_ins),
+                    sig[4])   # MESH_EPOCH rides every derived key too
         _register_component_grad(
             [in_l[i] for i in gi_c], [k_l[k] for k in go_c],
             local_pending, local_live, [live_refs[k] for k in comp_ks],

@@ -1,7 +1,7 @@
 """CLI: trace the bench_suite + distributed configs, run the sanitizer.
 
     python -m paddle_tpu.analysis
-        [--models lenet,resnet50,bert,reshard,pipeline]
+        [--models lenet,resnet50,bert,reshard,replan,pipeline]
         [--execute] [--verbose] [--json] [--fix]
 
 Default is record-only: each model's forward(+loss) is RECORDED into a
@@ -223,6 +223,72 @@ def run_reshard(execute: bool, verbose: bool):
     return [report]
 
 
+def run_replan(execute: bool, verbose: bool):
+    """Distributed sweep 3: shrunk + re-planned mesh configs. For an
+    8-way world losing ranks, the adaptive re-planner picks a
+    survivor-feasible dp/mp plan (divisor degree space) and every
+    planned placement transition — kept-rank, flattened-1D-reshard,
+    and forced-replicate cases — is validated against the SPMD rules,
+    exactly the sweep `shrink_world`/`AdaptiveTrainer` run before any
+    recovery data moves."""
+    from paddle_tpu import analysis
+    from paddle_tpu.distributed.auto_parallel.reshard_functions import \
+        DistAttrLite
+    from paddle_tpu.distributed.mesh import ProcessMesh
+    from paddle_tpu.distributed.placements import Replicate, Shard
+    from paddle_tpu.distributed.resilience.adaptive import (Replanner,
+                                                            mesh_for_plan)
+    from paddle_tpu.distributed.resilience.elastic import \
+        _shrunk_placements
+
+    import numpy as np
+    old_mesh = ProcessMesh(np.arange(8).reshape(4, 2),
+                           dim_names=["dp", "mp"])
+    # tensors the old mesh laid out: (ndim, placements, global_shape)
+    tensors = [
+        (2, [Shard(0), Replicate()], (48, 16)),
+        (2, [Replicate(), Shard(1)], (16, 48)),
+        (2, [Replicate(), Replicate()], (8, 8)),
+        (1, [Shard(0), Replicate()], (40,)),
+    ]
+    llm = {"hidden_size": 1024, "num_layers": 8}
+    cases = [
+        # 6 survivors: the tuner re-plans (4,2) -> (3,2); same mesh
+        # rank, so per-axis shards survive where the dim divides and
+        # the 40-dim falls back to replicate (40 % 3 != 0)
+        ([6, 7], llm),
+        # 7 survivors (prime): 1-D dp=7, undivisible dims replicate
+        ([7], llm),
+        # 4 survivors with a dp-bounding batch: a flattened 1-D plan
+        # where divisible dims re-shard for real (48 % 4 == 0)
+        ([4, 5, 6, 7], dict(llm, global_batch_size=2)),
+    ]
+    reports = []
+    for lost, config in cases:
+        survivors = [p for p in range(8) if p not in lost]
+        plan = Replanner(config).replan(len(survivors))
+        new_mesh = mesh_for_plan(survivors, plan)
+        report = analysis.CheckReport(
+            f"replanned shrink 8->{len(survivors)} "
+            f"(dp={plan.get('dp_degree', 1)}, "
+            f"mp={plan.get('mp_degree', 1)}, mesh {new_mesh.shape})")
+        for ndim, placements, gshape in tensors:
+            dst_p = _shrunk_placements(placements, old_mesh, new_mesh,
+                                       gshape)
+            analysis.check_reshard(
+                ndim, DistAttrLite(old_mesh, placements),
+                DistAttrLite(new_mesh, dst_p), report,
+                global_shape=gshape)
+        print(f"[replan] {report.subject}: "
+              f"{len(report.diagnostics)} finding(s)")
+        if verbose or not report.ok:
+            for d in report.diagnostics:
+                print("   ", d.render())
+        _note("replan", report)
+        reports.append(report)
+    return reports
+
+
 def run_pipeline(execute: bool, verbose: bool):
     """Distributed sweep 2: lower and simulate every host-driven
     pipeline schedule for a pod-shaped config (deadlock / P2P-ordering
@@ -248,9 +314,10 @@ def run_pipeline(execute: bool, verbose: bool):
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="python -m paddle_tpu.analysis")
     ap.add_argument("--models",
-                    default="lenet,resnet50,bert,reshard,pipeline",
+                    default="lenet,resnet50,bert,reshard,replan,"
+                            "pipeline",
                     help="comma list: lenet,resnet50,bert,reshard,"
-                         "pipeline")
+                         "replan,pipeline")
     ap.add_argument("--execute", action="store_true",
                     help="also flush/execute each recorded segment")
     ap.add_argument("--verbose", action="store_true",
@@ -274,7 +341,7 @@ def main(argv=None) -> int:
 
     table = {"lenet": run_lenet, "resnet50": run_resnet50,
              "bert": run_bert, "reshard": run_reshard,
-             "pipeline": run_pipeline}
+             "replan": run_replan, "pipeline": run_pipeline}
     reports = []
     for m in args.models.split(","):
         m = m.strip()

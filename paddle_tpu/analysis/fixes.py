@@ -26,11 +26,20 @@ about to flush:
   the pending list, remapping downstream wiring / LazyRef indices /
   the incremental signature, so the compiled program never contains
   them.
+- **leaked tracers** (`tracer_leak`): a tracer that outlived its trace
+  is unexecutable by definition — every flush of the poisoned program
+  dies with UnexpectedTracerError. The mechanical eviction: pop
+  tracer entries from the process scalar-coercion cache
+  (`executor._SCALAR_CACHE`), and for a tracer segment input (or an
+  op whose attrs closed over one) prune the poisoned forward closure
+  and swap the input slot to a concrete placeholder — but ONLY when
+  no live tensor aliases a poisoned output (then the user would
+  observe the substitution, so the finding stays reported like warn).
 
-Non-mechanical classes (tracer leaks, shape drift, cross-segment
-donation, guard contradictions, distributed findings) are NOT touched:
-their repair needs intent the checker cannot infer, so fix mode
-reports them exactly like warn mode.
+Non-mechanical classes (shape drift, cross-segment donation, guard
+contradictions, distributed findings) are NOT touched: their repair
+needs intent the checker cannot infer, so fix mode reports them
+exactly like warn mode.
 
 Every applied fix bumps `sanitizer.fixes_applied` (bench_suite row 5
 asserts the counter stays FROZEN over a clean program — fix mode must
@@ -47,7 +56,25 @@ from .diagnostics import CheckReport
 
 # checkers fixes.py knows how to repair
 FIXABLE = ("donation_safety", "view_alias", "inplace_race",
-           "dead_capture")
+           "dead_capture", "tracer_leak")
+
+
+def _poison_closure(view, roots):
+    """Every op reachable forward from `roots` through the segment
+    dataflow — the set a leaked tracer poisons."""
+    closure = set(roots)
+    changed = True
+    while changed:
+        changed = False
+        for j, p in enumerate(view.pending):
+            if j in closure:
+                continue
+            for w in p.wiring:
+                if w is not None and w[0] != "in" and w[1] in closure:
+                    closure.add(j)
+                    changed = True
+                    break
+    return closure
 
 
 class FixResult:
@@ -99,6 +126,8 @@ def plan_and_apply(view, report: CheckReport, ctx=None,
     drop: set = set()
     evict_inputs: set = set()
     dead_ops: List[int] = []
+    scalar_keys: List = []
+    tracer_inputs: set = set()
 
     for d in report.diagnostics:
         if d.checker not in FIXABLE:
@@ -135,6 +164,40 @@ def plan_and_apply(view, report: CheckReport, ctx=None,
                 actions.append(
                     f"prune {len(data['dead_ops'])} dead op(s) "
                     f"{names} (~{data.get('flops', 0)} FLOPs)")
+        elif d.checker == "tracer_leak":
+            if "scalar_key" in data:
+                consumed.append(d)
+                scalar_keys.append(data["scalar_key"])
+                actions.append(
+                    f"evict leaked tracer from the scalar-coercion "
+                    f"cache (key {data['scalar_key']!r})")
+            elif "tracer_input" in data or "tracer_op" in data:
+                if "tracer_input" in data:
+                    i = data["tracer_input"]
+                    closure = _poison_closure(
+                        view, view.readers_of_input(i))
+                else:
+                    i = None
+                    closure = _poison_closure(view, [data["tracer_op"]])
+                if any(j in closure for j, _s in view.live):
+                    # a live tensor aliases a poisoned output: the
+                    # substitution would be observable — not mechanical
+                    continue
+                consumed.append(d)
+                for j in sorted(closure):
+                    if j not in dead_ops:
+                        dead_ops.append(j)
+                if i is not None:
+                    tracer_inputs.add(i)
+                    if i not in drop:
+                        drop.add(i)   # never donate a placeholder slot
+                actions.append(
+                    "evict leaked tracer "
+                    + (f"input {i}" if i is not None
+                       else f"attrs of op #{data['tracer_op']}")
+                    + f": prune its {len(closure)} poisoned op(s)"
+                    + (" and swap the slot to a concrete placeholder"
+                       if i is not None else ""))
 
     before_donate = tuple(donate)
     before_ops = [(p.op.name, True) for p in view.pending]
@@ -162,6 +225,31 @@ def plan_and_apply(view, report: CheckReport, ctx=None,
         new_pending = _prune_dead(view, ctx, sorted(dead_ops))
         for j in sorted(dead_ops):
             before_ops[j] = (before_ops[j][0], False)
+
+    # ---- apply: leaked-tracer evictions
+    if scalar_keys:
+        from .._core import executor
+        for key in scalar_keys:
+            executor._SCALAR_CACHE.pop(key, None)
+    if tracer_inputs:
+        # after the poisoned closure is pruned nothing reads these
+        # slots; a concrete placeholder of the same aval keeps the
+        # input indexing intact without closing over the dead trace
+        import jax.numpy as jnp
+        for i in sorted(tracer_inputs):
+            v = view.in_vals[i]
+            aval = getattr(v, "aval", None)
+            ph = jnp.zeros(aval.shape, aval.dtype) \
+                if aval is not None else jnp.zeros(())
+            view.in_vals[i] = ph
+            if ctx is not None and i < len(ctx._in_vals) \
+                    and ctx._in_vals is not view.in_vals:
+                ctx._in_vals[i] = ph
+            t = view.in_tensors[i] if i < len(view.in_tensors) else None
+            if t is not None:
+                view.in_ids.pop(id(t), None)
+                if ctx is not None:
+                    ctx.note_inplace(t)
 
     # ---- apply: donation drops (already computed)
     view.donate = new_donate

@@ -240,6 +240,7 @@ def check_tracer_leaks(view: SegmentView, report: CheckReport):
                 severity=SEVERITY_ERROR,
                 hint="ops under an enclosing jax trace must bypass the "
                      "fusion window (executor.apply tracer check)",
+                data={"tracer_input": i},
                 **fields)
     for j, p in enumerate(view.pending):
         leaked = [k for k, leaf in _attr_leaves(p.attrs) if
@@ -252,6 +253,7 @@ def check_tracer_leaks(view: SegmentView, report: CheckReport):
                 severity=SEVERITY_ERROR,
                 hint="materialize attr values before record, or bypass "
                      "the window under an active trace",
+                data={"tracer_op": j},
                 **view.op_diag_fields(j))
 
 
@@ -278,7 +280,8 @@ def check_process_tracer_leaks(report: CheckReport):
                 f"a dead trace",
                 severity=SEVERITY_ERROR,
                 hint="_coerce must never memoize tracers (it checks "
-                     "isinstance(v, jax.core.Tracer))")
+                     "isinstance(v, jax.core.Tracer))",
+                data={"scalar_key": key})
 
 
 # --------------------------------------------------- shape/dtype checks

@@ -7,6 +7,15 @@ from typing import Dict, List
 from .prune import prune_candidates
 
 
+def degree_space(world_size: int) -> List[int]:
+    """Every parallel degree that tiles `world_size` exactly — the
+    candidate axis for a survivor-count re-plan (the default
+    powers-of-two ladder misses worlds like 6 or 12, exactly the sizes
+    rank loss produces)."""
+    n = max(int(world_size), 1)
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
 class GridSearch:
     """Cartesian product of the tunable axes, pruned by feasibility."""
 

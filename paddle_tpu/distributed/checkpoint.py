@@ -1,4 +1,5 @@
-"""Distributed checkpoint: save_state_dict / load_state_dict.
+"""Distributed checkpoint: save_state_dict / load_state_dict +
+generation retention (CheckpointManager).
 
 Analog of python/paddle/distributed/checkpoint (save_state_dict.py:135,
 load_state_dict.py): sharded per-rank files + global metadata, resharding
@@ -8,16 +9,30 @@ Round-1 format: one file per host (single-controller = one file) holding
 each tensor's GLOBAL value + its dist_attr; load re-applies the current
 mesh/placements (load-time reshard comes free because values are stored
 global). Orbax-backed incremental shard files are the follow-up.
+
+`CheckpointManager` layers retention on top: N verified generations
+(`FLAGS_checkpoint_keep`, default 3) under one root with a JSON
+manifest; a load that trips the checksum verifier auto-falls-back to
+the newest verified OLDER generation (logged reason +
+`resilience.ckpt_fallbacks`) instead of raising immediately — the
+adaptive trainer's last line of recovery when in-memory rollback is
+exhausted.
 """
 from __future__ import annotations
 
 import hashlib
+import json
+import logging
 import os
 import pickle
+import shutil
 import tempfile
-from typing import Dict
+import time
+from typing import Dict, List, Optional
 
 import numpy as np
+
+_LOG = logging.getLogger(__name__)
 
 from .._core.tensor import Tensor
 from .api import DistAttr, shard_tensor
@@ -175,3 +190,142 @@ def load_state_dict(state_dict: Dict[str, Tensor], path: str,
                 arr, attr.process_mesh.named_sharding(spec))
         t._replace_value_inplace(arr)
     return state_dict
+
+
+# ------------------------------------------------- generation retention
+
+class CheckpointManager:
+    """N verified checkpoint generations under one root.
+
+    Layout::
+
+        <root>/MANIFEST.json          # [{gen, path, step, saved_at}]
+        <root>/gen_00000001/          # save_state_dict output
+        <root>/gen_00000002/
+        ...
+
+    `save` writes a fresh generation (atomic + checksummed via
+    save_state_dict), appends it to the manifest (itself written
+    atomically, AFTER the data — a crash in between leaves an orphan
+    directory the next save harmlessly overwrites), and prunes beyond
+    `keep` (`FLAGS_checkpoint_keep` when not pinned). `load` walks
+    generations newest-first: a checksum failure (torn save, bit rot)
+    falls back to the next older VERIFIED generation with a logged
+    reason and a `resilience.ckpt_fallbacks` count, raising only when
+    no generation survives verification.
+    """
+
+    MANIFEST = "MANIFEST.json"
+
+    def __init__(self, root: str, keep: Optional[int] = None):
+        self.root = root
+        self._keep = keep
+
+    @property
+    def keep(self) -> int:
+        if self._keep is not None:
+            return max(int(self._keep), 1)
+        from .._core.flags import flag_value
+        return max(int(flag_value("FLAGS_checkpoint_keep")), 1)
+
+    # -------------------------------------------------------- manifest
+    def _manifest(self) -> List[Dict]:
+        path = os.path.join(self.root, self.MANIFEST)
+        try:
+            with open(path) as f:
+                return list(json.load(f)["generations"])
+        except (OSError, ValueError, KeyError):
+            return []
+
+    def _write_manifest(self, entries: List[Dict]) -> None:
+        _atomic_write(
+            os.path.join(self.root, self.MANIFEST),
+            json.dumps({"generations": entries}, indent=1).encode())
+
+    def generations(self) -> List[int]:
+        return sorted(int(e["gen"]) for e in self._manifest())
+
+    def latest(self) -> Optional[int]:
+        gens = self.generations()
+        return gens[-1] if gens else None
+
+    def _gen_path(self, gen: int) -> str:
+        return os.path.join(self.root, f"gen_{gen:08d}")
+
+    # ------------------------------------------------------------- save
+    def save(self, state_dict: Dict, step: Optional[int] = None) -> int:
+        os.makedirs(self.root, exist_ok=True)
+        entries = self._manifest()
+        gen = (int(entries[-1]["gen"]) + 1) if entries else 1
+        save_state_dict(state_dict, self._gen_path(gen))
+        entries.append({"gen": gen, "path": f"gen_{gen:08d}",
+                        "step": step, "saved_at": time.time()})
+        while len(entries) > self.keep:
+            old = entries.pop(0)
+            shutil.rmtree(os.path.join(self.root, old["path"]),
+                          ignore_errors=True)
+        self._write_manifest(entries)
+        return gen
+
+    # ------------------------------------------------------------- load
+    def _peek_keys(self, gen: int) -> List[str]:
+        """State keys a generation recorded (its metadata, no data
+        read) — lets a caller whose live state is SMALLER than the
+        checkpoint (fresh optimizer, no moments yet) extend the load
+        target instead of silently dropping the extra entries."""
+        with open(os.path.join(self._gen_path(gen),
+                               "metadata.pkl"), "rb") as f:
+            meta = pickle.load(f)
+        return [k for k in meta if k != "__checkpoint_format__"]
+
+    def load(self, state_dict: Dict,
+             generation: Optional[int] = None,
+             augment_missing: bool = False) -> int:
+        """Fill `state_dict` from `generation` (default: newest),
+        falling back past corrupted generations. Returns the
+        generation actually loaded. `augment_missing` adds keys the
+        generation recorded but the target lacks (placeholder None,
+        replaced by the stored value) so a smaller live state — a
+        fresh optimizer with no moments yet — still receives the full
+        checkpoint instead of its intersection."""
+        from ..base.core import EnforceNotMet
+        gens = self.generations()
+        if generation is not None:
+            gens = [g for g in gens if g <= int(generation)]
+        if not gens:
+            raise EnforceNotMet(
+                f"no checkpoint generation under {self.root!r}"
+                + (f" at or below {generation}" if generation is not None
+                   else ""))
+        last_err: Optional[BaseException] = None
+        for gen in reversed(gens):
+            added: List[str] = []
+            try:
+                if augment_missing:
+                    for k in self._peek_keys(gen):
+                        if k not in state_dict:
+                            state_dict[k] = None
+                            added.append(k)
+                load_state_dict(state_dict, self._gen_path(gen))
+                if last_err is not None:
+                    from ..observability import metrics
+                    metrics.inc("resilience.ckpt_fallbacks")
+                    _LOG.warning(
+                        "checkpoint generation fallback: loaded gen %d "
+                        "after newer generation(s) failed verification "
+                        "(%s)", gen, last_err)
+                    from ..observability import _state as _OBS
+                    if _OBS.FLIGHT:
+                        from ..observability import flight
+                        flight.note("ckpt", "fallback", loaded=gen,
+                                    error=repr(last_err)[:160])
+                return gen
+            except (EnforceNotMet, OSError, pickle.UnpicklingError) as e:
+                # a failed generation's placeholder keys must not leak
+                # into the next (older) attempt's strict-load key set
+                for k in added:
+                    state_dict.pop(k, None)
+                last_err = e
+        raise EnforceNotMet(
+            f"every checkpoint generation under {self.root!r} failed "
+            f"verification; newest error: {last_err}")

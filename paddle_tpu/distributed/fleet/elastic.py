@@ -73,11 +73,25 @@ class ElasticManager:
                 if failures > 20:
                     return
 
-    def current_membership(self) -> Dict:
+    def _probe(self, key: str):
+        """Short, un-retried key probe (None = missing/slow). A plain
+        `get` waits the store's FULL timeout for a missing key — one
+        unregistered node would freeze the whole heartbeat scan."""
+        f = getattr(self.store, "try_get", None)
+        if f is not None:
+            return f(key, timeout=max(self.interval, 0.25))
         try:
-            raw = self.store.get("__elastic/membership")
-            return json.loads(raw.decode())
+            return self.store.get(key)
         except Exception:
+            return None
+
+    def current_membership(self) -> Dict:
+        raw = self._probe("__elastic/membership")
+        if raw is None:
+            return {"epoch": 0, "members": []}
+        try:
+            return json.loads(raw.decode())
+        except ValueError:
             return {"epoch": 0, "members": []}
 
     def my_rank(self) -> int:
@@ -86,6 +100,21 @@ class ElasticManager:
             return m["members"].index(self.node_id)
         except ValueError:
             return -1
+
+    def wait_for_members(self, predicate: Callable[[Dict], bool],
+                         timeout: float = 30.0) -> Dict:
+        """Block until `predicate(membership)` holds — initial
+        rendezvous (`len(m["members"]) == world`), or waiting for a
+        death to be noticed (`"3" not in m["members"]`). Returns the
+        latest membership either way; the caller re-checks the
+        predicate to distinguish success from timeout."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            m = self.current_membership()
+            if predicate(m):
+                return m
+            time.sleep(min(0.05, self.interval))
+        return self.current_membership()
 
     # --------------------------------------------------------- master side
     def watch(self, known_nodes: List[str]):
@@ -103,11 +132,13 @@ class ElasticManager:
         self.store.set(f"__elastic/announce/{seq}", self.node_id)
 
     def _alive(self, node: str) -> bool:
+        raw = self._probe(f"__elastic/node/{node}")
+        if raw is None:
+            return False
         try:
-            raw = self.store.get(f"__elastic/node/{node}")
             return time.time() - json.loads(raw.decode())["t"] \
                 < self.node_timeout
-        except Exception:
+        except (ValueError, KeyError):
             return False
 
     def _watch_loop(self):
@@ -118,10 +149,12 @@ class ElasticManager:
             try:
                 cnt = self.store.add("__elastic/announce_count", 0)
                 while announced < cnt:  # adopt announced node ids
+                    raw = self._probe(
+                        f"__elastic/announce/{announced + 1}")
+                    if raw is None:
+                        break   # counter visible before key: next scan
                     announced += 1
-                    nid = self.store.get(
-                        f"__elastic/announce/{announced}").decode()
-                    self._known.add(nid)
+                    self._known.add(raw.decode())
                 alive = sorted(n for n in self._known if self._alive(n))
                 if alive != last and len(alive) >= self.min_np:
                     self.epoch += 1

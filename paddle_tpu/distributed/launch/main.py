@@ -49,6 +49,19 @@ def _parse_args(argv=None):
                        flag_value("FLAGS_launch_max_restarts"))) or 0,
         help="relaunch the pod up to N times on worker failure "
              "(elastic manager restart behavior)")
+    p.add_argument("--elastic_mode", choices=("collapse", "shrink"),
+                   default="collapse",
+                   help="worker-failure policy: 'collapse' (default) "
+                        "tears the pod down and restarts/propagates; "
+                        "'shrink' tolerates dead workers while at "
+                        "least --min_np survive — the survivors keep "
+                        "running (and re-plan via their own "
+                        "ElasticManager/AdaptiveTrainer membership "
+                        "epochs) instead of being restarted")
+    p.add_argument("--min_np", type=int, default=0,
+                   help="shrink mode: minimum live workers per node; "
+                        "0 = all must survive (shrink tolerates "
+                        "nothing)")
     p.add_argument("script", type=str)
     p.add_argument("script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
@@ -83,42 +96,64 @@ def _spawn_pod(args, node_rank: int, world: int, endpoints, epoch: int):
         log = open(os.path.join(
             args.log_dir,
             f"workerlog.{node_rank}.{lr}.e{epoch}"), "w")
-        procs.append((subprocess.Popen(
+        procs.append((lr, subprocess.Popen(
             [sys.executable, args.script] + args.script_args, env=env,
             stdout=log, stderr=subprocess.STDOUT), log))
     return procs
 
 
 def _kill_pod(procs):
-    for proc, _ in procs:
+    for _, proc, _ in procs:
         if proc.poll() is None:
             try:
                 proc.send_signal(signal.SIGTERM)
             except OSError:
                 pass
     deadline = time.time() + 10
-    for proc, _ in procs:
+    for _, proc, _ in procs:
         try:
             proc.wait(timeout=max(0.1, deadline - time.time()))
         except subprocess.TimeoutExpired:
             proc.kill()
-    for _, log in procs:
+    for _, _, log in procs:
         log.close()
 
 
-def _watch_pod(procs, master=None, epoch: int = 0):
+def _watch_pod(procs, master=None, epoch: int = 0, args=None):
     """Poll until the pod finishes. Returns (rc, failed): first non-zero
     exit fails the pod; with a master, a REMOTE node's failure signal
     also tears this pod down (controllers/controller.py:87 watch +
-    elastic fault broadcast)."""
+    elastic fault broadcast).
+
+    Shrink mode (`--elastic_mode shrink`): a dead worker does NOT tear
+    the pod down while at least --min_np workers stay live — the
+    launcher records the loss and keeps watching, and the surviving
+    trainers (who see the death through their own ElasticManager
+    heartbeats) re-plan and keep training. Only dropping below min_np
+    fails the pod."""
+    shrink = args is not None and args.elastic_mode == "shrink"
+    nproc = len(procs)
+    min_np = (args.min_np or nproc) if shrink else 0
+    lost = []
     last_remote_check = 0.0
     while procs:
         alive = []
-        for proc, log in procs:
+        for rank, proc, log in procs:
             r = proc.poll()
             if r is None:
-                alive.append((proc, log))
+                alive.append((rank, proc, log))
             elif r != 0:
+                if shrink:
+                    lost.append(rank)
+                    log.close()
+                    survivors = nproc - len(lost)
+                    print(f"[launch] worker {rank} died (rc={r}); "
+                          f"shrink mode keeps the pod with "
+                          f"{survivors} survivor(s)", file=sys.stderr)
+                    if survivors >= min_np:
+                        continue
+                    print(f"[launch] survivors {survivors} < min_np "
+                          f"{min_np}: pod fails", file=sys.stderr)
                 return r, True
             else:
                 log.close()  # finished worker: release the handle now
@@ -129,6 +164,9 @@ def _watch_pod(procs, master=None, epoch: int = 0):
             if master.poll_failure(epoch):
                 return 1, True
         time.sleep(0.3)
+    if lost:
+        print(f"[launch] pod finished after shrinking past dead "
+              f"worker(s) {lost}", file=sys.stderr)
     return 0, False
 
 
@@ -208,7 +246,7 @@ def main(argv=None):
 
         procs = _spawn_pod(args, node_rank, world, endpoints, epoch)
         try:
-            rc, failed = _watch_pod(procs, master, epoch)
+            rc, failed = _watch_pod(procs, master, epoch, args=args)
         except KeyboardInterrupt:
             _kill_pod(procs)  # Ctrl-C must not orphan the workers
             if master is not None:

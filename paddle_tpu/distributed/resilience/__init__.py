@@ -8,6 +8,10 @@ existing sensors — watchdog, TCPStore rendezvous, checkpoint):
 - `ElasticStep`  step snapshot + rollback + watchdog coverage
 - `shrink_world` mesh/process-group rebuild over surviving ranks,
   sanitizer-validated before the first post-recovery step
+- `AdaptiveTrainer` (adaptive.py)  membership-change re-PLANNING: on
+  rank loss the auto-tuner picks a survivor-feasible dp/mp/pp
+  strategy, the sanitizer validates it, state reshards (or reloads a
+  verified checkpoint generation) and the step cache re-keys
 """
 from __future__ import annotations
 
@@ -18,3 +22,5 @@ from .faults import (CollectiveTimeout, FaultError, FaultPlan,  # noqa: F401
 from .retry import RetryPolicy  # noqa: F401
 from .elastic import (ElasticStep, plan_shrink,  # noqa: F401
                       shrink_world)
+from .adaptive import (AdaptiveTrainer, MembershipEvent,  # noqa: F401
+                       Replanner, mesh_for_plan)

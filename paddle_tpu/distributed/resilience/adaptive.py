@@ -1,0 +1,541 @@
+"""Adaptive elastic training: re-PLAN the parallel strategy on
+membership change, don't just re-shard it.
+
+PR 5 gave the runtime *reactions* — retry, rollback, validated
+world-shrink — but every recovery kept the OLD dp/mp/pp strategy.
+This module is the 2112.02752 step ("End-to-end Adaptive Distributed
+Training on PaddlePaddle"): when the world changes, the surviving
+ranks re-*plan*.
+
+`AdaptiveTrainer` connects pieces that already exist but don't talk:
+
+- **event sources** — ElasticManager membership epochs
+  (fleet/elastic.py: the master publishes ``{epoch, members}`` from
+  heartbeat scans; the trainer polls between steps), `RankDeath`
+  surfaced by the step/watchdog path (ElasticStep's ``on_rank_death``),
+  and the injectable ``member::leave`` / ``member::join`` fault sites
+  fired at every step boundary (`FLAGS_fault_inject=
+  "member::leave@2=die"` drills a deterministic leave);
+- **the re-planner** — the auto-tuner's analytic cost/memory model
+  (auto_tuner/cost_model.py) searched over *survivor-feasible* degree
+  spaces (divisors of the survivor count, not powers of two — rank
+  loss routinely produces worlds like 6 or 12), with a guaranteed
+  data-parallel fallback plan when the model/world admits nothing
+  better;
+- **validation** — the sanitizer's reshard/pipeline sweep
+  (`analysis.hooks.on_world_shrink`, ALWAYS error mode) approves every
+  planned placement transition BEFORE any data moves;
+- **application** — `shrink_world(..., target_mesh=planned_mesh)`
+  re-shards params + optimizer state in place through the validated
+  reshard registry; the LR scheduler and global RNG ride the
+  in-memory snapshot. When in-memory state is unusable (reshard
+  failure, or the rollback budget exhausted), the trainer reloads the
+  newest *verified* generation from its `CheckpointManager`;
+- **resume** — `lazy.bump_mesh_epoch()` re-keys the segment/step
+  caches so the fused train step recompiles exactly ONCE against the
+  new mesh, then hits the fresh entry every later step.
+
+Observability: `resilience.replans` / `resilience.member_epochs`
+counters, the `resilience.replan_us` histogram (membership change →
+first successful post-replan step), `resilience::replan` spans, and
+flight-recorder notes along the whole pipeline.
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from ..._core import flags as _flags
+from .elastic import (ElasticStep, _RETRYABLE_STEP, _shrunk_placements,
+                      shrink_world)
+from .faults import FaultError, RankDeath
+
+
+class MembershipEvent:
+    """One observed change of the training world."""
+
+    __slots__ = ("epoch", "members", "lost", "joined", "source")
+
+    def __init__(self, epoch: int, members: Sequence,
+                 lost: Sequence = (), joined: Sequence = (),
+                 source: str = "manager"):
+        self.epoch = epoch
+        self.members = list(members)
+        self.lost = list(lost)
+        self.joined = list(joined)
+        self.source = source
+
+    def __repr__(self):
+        return (f"MembershipEvent(epoch={self.epoch}, "
+                f"members={self.members}, lost={self.lost}, "
+                f"joined={self.joined}, source={self.source!r})")
+
+
+class Replanner:
+    """Survivor-feasible parallel-strategy search over the auto-tuner's
+    cost model.
+
+    The degree space is the divisors of the survivor count (pruned by
+    the tuner's own feasibility rules: product tiling, head/hidden
+    divisibility, memory fit), so the chosen dp/mp/pp always tiles a
+    realizable survivor mesh — including the flattened case where the
+    survivor count no longer factors the old mesh rank. When nothing
+    in the space survives pruning (e.g. a batch size the survivor
+    count cannot divide), the guaranteed fallback is plain data
+    parallelism over all survivors, counted under
+    `resilience.replan_fallback_plans` with a logged reason."""
+
+    def __init__(self, model_config: Optional[Dict] = None,
+                 n_params: Optional[int] = None):
+        self.model_config = dict(model_config or {})
+        if n_params and "n_params" not in self.model_config:
+            self.model_config["n_params"] = int(n_params)
+
+    def replan(self, survivor_count: int) -> Dict:
+        from ..auto_tuner.search import degree_space
+        from ..auto_tuner.tuner import AutoTuner
+        degrees = degree_space(survivor_count)
+        space = {"dp_degree": degrees, "mp_degree": degrees,
+                 "pp_degree": degrees}
+        try:
+            return AutoTuner(self.model_config, survivor_count,
+                             tune_space=space, max_trials=0).tune()
+        except RuntimeError as e:
+            # a survivor count the model constraints cannot tile any
+            # better way always admits pure data parallelism
+            from ...observability import metrics
+            metrics.inc("resilience.replan_fallback_plans")
+            import warnings
+            warnings.warn(
+                f"adaptive re-plan: no tuner-feasible config for "
+                f"{survivor_count} survivors ({e}); falling back to "
+                f"dp={survivor_count}", RuntimeWarning, stacklevel=2)
+            plan = dict(self.model_config)
+            plan.update(world_size=survivor_count,
+                        dp_degree=survivor_count, mp_degree=1,
+                        pp_degree=1)
+            return plan
+
+
+def mesh_for_plan(process_ids: Sequence[int], plan: Dict):
+    """The survivor ProcessMesh realizing a tuner plan: one mesh axis
+    per parallel degree > 1, in dp/mp/pp order (degenerate plans get a
+    1-D ``dp`` mesh so downstream placement logic always has an
+    axis)."""
+    from ..mesh import ProcessMesh
+    dims: List[int] = []
+    names: List[str] = []
+    for name in ("dp", "mp", "pp"):
+        deg = int(plan.get(f"{name}_degree", 1) or 1)
+        if deg > 1:
+            dims.append(deg)
+            names.append(name)
+    if not dims:
+        dims, names = [len(process_ids)], ["dp"]
+    if int(np.prod(dims)) != len(process_ids):
+        from ...base.core import EnforceNotMet
+        raise EnforceNotMet(
+            f"plan degrees {dims} ({names}) do not tile the "
+            f"{len(process_ids)} survivors {sorted(process_ids)}")
+    return ProcessMesh(
+        np.asarray(sorted(int(p) for p in process_ids)).reshape(dims),
+        names)
+
+
+class AdaptiveTrainer:
+    """ElasticStep + membership watching + tuner re-planning +
+    checkpoint retention, in one loop::
+
+        trainer = AdaptiveTrainer(optimizer=opt, mesh=mesh,
+                                  manager=elastic_manager,
+                                  checkpoint_dir="ckpt",
+                                  checkpoint_every=1)
+        for batch in loader:
+            loss = trainer.run(step_fn, batch)
+
+    On a membership-change event (manager epoch, `RankDeath`, or an
+    injected ``member::leave`` fault) the trainer quiesces, re-plans
+    dp/mp/pp for the survivors, validates the plan through the
+    sanitizer sweep, re-shards (or reloads a verified checkpoint
+    generation), re-keys the step cache, and resumes bit-exact.
+
+    `lost_ranks` resolves WHICH process ids died when the event itself
+    does not say (fault sites, watchdog `RankDeath`): a static list,
+    or a callable ``(exception) -> list``. With a `manager`, epoch
+    diffs resolve the lost set from node ids (which must be the
+    trainer-rank strings for mesh-backed training).
+    """
+
+    def __init__(self, optimizer=None, parameters: Sequence = None, *,
+                 mesh=None, model_config: Optional[Dict] = None,
+                 manager=None,
+                 lost_ranks: Union[Sequence[int], Callable, None] = None,
+                 pipeline: Optional[tuple] = None,
+                 checkpoint_dir: Optional[str] = None,
+                 checkpoint_every: int = 0,
+                 max_retries: Optional[int] = None,
+                 timeout: Optional[float] = None,
+                 name: str = "adaptive"):
+        self._opt = optimizer
+        self._elastic = ElasticStep(
+            optimizer=optimizer, parameters=parameters,
+            max_retries=max_retries, timeout=timeout, name=name,
+            on_rank_death=self._on_rank_death)
+        self._params = self._elastic._params
+        if mesh is None:
+            from ..mesh import get_mesh
+            mesh = get_mesh()
+        self.mesh = mesh
+        self._replanner = Replanner(
+            model_config, n_params=self._count_params())
+        self._manager = manager
+        self._members: List = []
+        self._last_epoch = 0
+        if manager is not None:
+            m = manager.current_membership()
+            self._last_epoch = int(m.get("epoch", 0))
+            self._members = list(m.get("members", []))
+        self._lost_ranks = lost_ranks
+        self._pipeline = pipeline
+        self.ckpt = None
+        if checkpoint_dir:
+            from ..checkpoint import CheckpointManager
+            self.ckpt = CheckpointManager(checkpoint_dir)
+        self._ckpt_every = int(checkpoint_every)
+        self.replans = 0
+        self.last_plan: Optional[Dict] = None
+        self.last_event: Optional[MembershipEvent] = None
+        self.last_replan_latency_s: Optional[float] = None
+        self._replan_t0: Optional[float] = None
+
+    # ------------------------------------------------------------- misc
+    def _count_params(self) -> int:
+        n = 0
+        for p in self._params:
+            n += int(np.prod(p._value.shape)) if p._value.ndim else 1
+        return n
+
+    @property
+    def step_index(self) -> int:
+        return self._elastic.step_index
+
+    def shutdown(self):
+        self._elastic.shutdown()
+
+    def _quiesce(self, drop: bool):
+        """No in-flight lazy work may straddle a re-plan: a healthy
+        boundary flushes the ambient window (pending user ops
+        materialize on the OLD layout), a failed step drops its
+        aborted trace the way a failed compile would."""
+        from ..._core import lazy
+        ctx = lazy.current_context()
+        if ctx is not None and ctx.pending:
+            if drop:
+                ctx._reset_segment()
+            else:
+                ctx.flush("replan_quiesce")
+
+    # ----------------------------------------------------- event intake
+    def _poll_events(self):
+        """Step-boundary membership poll: injected member:: sites
+        first (deterministic drills), then the manager's published
+        epoch."""
+        if _flags.FAULT_INJECT_ACTIVE:
+            from . import faults
+            try:
+                faults.inject("member::leave")
+            except FaultError as e:
+                self._membership_event(MembershipEvent(
+                    self._last_epoch + 1, self._members,
+                    lost=self._resolve_lost(e), source="fault"))
+            try:
+                faults.inject("member::join")
+            except FaultError:
+                self._membership_event(MembershipEvent(
+                    self._last_epoch + 1, self._members,
+                    joined=["<injected>"], source="fault"))
+        if self._manager is not None:
+            m = self._manager.current_membership()
+            epoch = int(m.get("epoch", 0))
+            if epoch > self._last_epoch:
+                old = list(self._members)
+                new = list(m.get("members", []))
+                self._membership_event(MembershipEvent(
+                    epoch, new,
+                    lost=self._node_ids_to_ranks(
+                        [n for n in old if n not in new], old),
+                    joined=[n for n in new if n not in old],
+                    source="manager"))
+
+    @staticmethod
+    def _node_ids_to_ranks(node_ids: List, members: List) -> List[int]:
+        out = []
+        for n in node_ids:
+            try:
+                out.append(int(n))
+            except (TypeError, ValueError):
+                out.append(members.index(n))
+        return out
+
+    def _resolve_lost(self, e: BaseException) -> List[int]:
+        if callable(self._lost_ranks):
+            return list(self._lost_ranks(e))
+        if self._lost_ranks is not None:
+            return list(self._lost_ranks)
+        raise e   # cannot tell who died: propagate the death
+
+    def _on_rank_death(self, e: RankDeath):
+        """ElasticStep's rank-death hook: state was already restored to
+        the pre-step snapshot; drop the aborted trace and re-plan for
+        the survivors. ElasticStep then re-runs the step."""
+        self._membership_event(MembershipEvent(
+            self._last_epoch + 1, self._members,
+            lost=self._resolve_lost(e), source="rank_death"),
+            drop_inflight=True)
+
+    # -------------------------------------------------------- the replan
+    def _membership_event(self, ev: MembershipEvent,
+                          drop_inflight: bool = False):
+        from ...observability import metrics
+        metrics.inc("resilience.member_epochs")
+        self._replan_t0 = time.perf_counter()
+        prev_epoch, prev_members = self._last_epoch, self._members
+        self._last_epoch = ev.epoch
+        self._members = list(ev.members)
+        self.last_event = ev
+        from ...observability import _state as _OBS
+        if _OBS.FLIGHT:
+            from ...observability import flight
+            flight.note("adaptive", "membership", epoch=ev.epoch,
+                        lost=list(ev.lost), joined=list(ev.joined),
+                        source=ev.source)
+        if ev.joined and not ev.lost:
+            # growth needs fresh processes to host state — that is a
+            # relaunch-from-checkpoint decision above this loop; the
+            # event is recorded (epoch adopted, counter, flight) and
+            # training continues on the current plan.
+            self._replan_t0 = None
+            return
+        lost = [r for r in ev.lost
+                if self.mesh is None
+                or r in set(self.mesh.process_ids)]
+        if not lost or self.mesh is None:
+            self._replan_t0 = None
+            return
+        try:
+            self._replan_and_apply(lost, ev, drop_inflight)
+        except BaseException:
+            # the event must not be consumed by a FAILED re-plan: put
+            # the epoch back so the next poll re-observes it instead
+            # of silently training on against the dead ranks
+            self._last_epoch, self._members = prev_epoch, prev_members
+            self._replan_t0 = None
+            raise
+
+    def _replan_and_apply(self, lost: List[int], ev: MembershipEvent,
+                          drop_inflight: bool = False):
+        from ...observability import _state as _OBS
+        from ...observability import metrics
+        sp = None
+        if _OBS.ACTIVE:
+            from ...observability.spans import span
+            sp = span("resilience::replan",
+                      hist="resilience.replan_apply_us",
+                      lost=list(lost), source=ev.source).begin()
+        try:
+            self._quiesce(drop=drop_inflight)
+            survivors = [pid for pid in self.mesh.process_ids
+                         if pid not in set(lost)]
+            if not survivors:
+                from ...base.core import EnforceNotMet
+                raise EnforceNotMet(
+                    f"membership change loses every rank of "
+                    f"{self.mesh!r} ({sorted(lost)}): nothing to "
+                    f"re-plan onto")
+            plan = self._replanner.replan(len(survivors))
+            new_mesh = mesh_for_plan(survivors, plan)
+            state = {(p.name or f"p{i}"): p
+                     for i, p in enumerate(self._params)}
+            from ...analysis.diagnostics import StaticCheckError
+            try:
+                # validates every transition (sanitizer, error mode)
+                # BEFORE moving data, then reshards params + optimizer
+                # state through the reshard registry
+                shrink_world(self.mesh, lost, state,
+                             optimizer=self._opt,
+                             pipeline=self._pipeline,
+                             target_mesh=new_mesh)
+            except StaticCheckError:
+                # the sanitizer REFUSED the plan itself — reloading a
+                # checkpoint onto the refused layout would bypass the
+                # validate-before-move gate, so this must fail loudly
+                raise
+            except Exception:
+                if self.ckpt is None or self.ckpt.latest() is None:
+                    raise
+                # the validated plan failed during EXECUTION (a reshard
+                # died half way through the tensor list, leaving mixed
+                # layouts): adopt the planned layout wholesale, then
+                # fill it from the newest VERIFIED generation
+                self._adopt_layout(new_mesh)
+                self.restore_from_checkpoint()
+            self.mesh = new_mesh
+            self.last_plan = plan
+            self.replans += 1
+            metrics.inc("resilience.replans")
+            from ..._core import lazy
+            lazy.bump_mesh_epoch()
+            if _OBS.FLIGHT:
+                from ...observability import flight
+                flight.note("adaptive", "replan",
+                            survivors=len(survivors),
+                            dp=plan.get("dp_degree", 1),
+                            mp=plan.get("mp_degree", 1),
+                            pp=plan.get("pp_degree", 1))
+        except BaseException as e:
+            if sp is not None:
+                sp.end(error=e)
+            raise
+        if sp is not None:
+            sp.end()
+
+    def _adopt_layout(self, new_mesh):
+        """Point every mesh-resident param at its planned placement on
+        `new_mesh` WITHOUT moving data — the follow-up checkpoint load
+        lays the stored global values out against these attrs."""
+        from ..api import DistAttr
+        old_mesh = self.mesh
+        for p in self._params:
+            attr = getattr(p, "_dist_attr", None)
+            if attr is None or attr.process_mesh is not old_mesh:
+                continue
+            p._dist_attr = DistAttr(
+                new_mesh,
+                _shrunk_placements(attr.placements, old_mesh, new_mesh,
+                                   tuple(p._value.shape)))
+        from ..mesh import get_mesh, set_mesh
+        if get_mesh() is old_mesh:
+            set_mesh(new_mesh)
+
+    # -------------------------------------------------------- checkpoint
+    def _full_state(self) -> Dict:
+        """Everything a resume needs, keyed stably by param INDEX —
+        auto-generated param names ride a process-global counter, so
+        a fresh trainer (or another process) would never match them:
+        params (as Tensors — reshard-on-load re-lays them out),
+        optimizer state/master/step count, LR-scheduler state and the
+        global RNG key."""
+        st: Dict = {}
+        for i, p in enumerate(self._params):
+            st[f"param::{i}"] = p
+        opt = self._opt
+        if opt is not None:
+            for i, p in enumerate(self._params):
+                pid = id(p)
+                for k, v in (opt._states.get(pid) or {}).items():
+                    st[f"opt::state:{i}:{k}"] = np.asarray(v)
+                if pid in opt._master:
+                    st[f"opt::master:{i}"] = np.asarray(opt._master[pid])
+            st["opt::step_count"] = opt._step_count
+            lr = opt._lr
+            if hasattr(lr, "state_dict"):
+                st["opt::lr"] = dict(lr.state_dict())
+        from ..._core import random as _rng
+        st["rng::seed"] = _rng._state.get("seed")
+        key = _rng._state.get("key")
+        st["rng::key"] = np.asarray(key) if key is not None else None
+        st["meta::step_index"] = self._elastic.step_index
+        return st
+
+    def save_checkpoint(self) -> int:
+        if self.ckpt is None:
+            raise ValueError("AdaptiveTrainer has no checkpoint_dir")
+        return self.ckpt.save(self._full_state(),
+                              step=self._elastic.step_index)
+
+    def restore_from_checkpoint(self, generation: Optional[int] = None):
+        """Reload the newest verified generation (or `generation`) into
+        the live model/optimizer/RNG. The CheckpointManager handles
+        corrupted-generation fallback; this applies the loaded leaves
+        back to the optimizer dictionaries keyed by the LIVE param
+        ids."""
+        import jax.numpy as jnp
+        if self.ckpt is None:
+            raise ValueError("AdaptiveTrainer has no checkpoint_dir")
+        # augment_missing: a fresh optimizer has no moment entries yet,
+        # and a target built only from the LIVE state would silently
+        # drop the checkpoint's — the generation's own key set extends
+        # the target so the full state loads
+        st = self._full_state()
+        gen = self.ckpt.load(st, generation=generation,
+                             augment_missing=True)
+        opt = self._opt
+        if opt is not None:
+            states: Dict = {}
+            master: Dict = {}
+            for key, v in st.items():
+                if v is None:
+                    continue   # key absent from the loaded generation
+                if key.startswith("opt::state:"):
+                    _, _, i_k = key.partition("opt::state:")
+                    i, _, k = i_k.partition(":")
+                    pid = id(self._params[int(i)])
+                    states.setdefault(pid, {})[k] = jnp.asarray(v)
+                elif key.startswith("opt::master:"):
+                    pid = id(self._params[int(key.rsplit(":", 1)[1])])
+                    master[pid] = jnp.asarray(v)
+            # unconditional: the loaded generation's moments/master ARE
+            # the optimizer state now (empty means the checkpoint
+            # predates the first step — live leftovers would be stale)
+            opt._states = states
+            opt._master = master
+            opt._step_count = int(st.get("opt::step_count") or 0)
+            if st.get("opt::lr") is not None \
+                    and hasattr(opt._lr, "set_state_dict"):
+                opt._lr.set_state_dict(dict(st["opt::lr"]))
+        for p in self._params:
+            p.clear_grad()
+        from ..._core import random as _rng
+        if st.get("rng::key") is not None:
+            _rng._state["key"] = jnp.asarray(st["rng::key"])
+            _rng._state["seed"] = st.get("rng::seed")
+        # the step counter rewinds with the state: replayed steps keep
+        # their original step:: site numbering and save() step metadata
+        if st.get("meta::step_index") is not None:
+            self._elastic.step_index = int(st["meta::step_index"])
+        from ...observability import metrics
+        metrics.inc("resilience.ckpt_restores")
+        from ...observability import _state as _OBS
+        if _OBS.FLIGHT:
+            from ...observability import flight
+            flight.note("adaptive", "ckpt_restore", generation=gen)
+        return gen
+
+    # --------------------------------------------------------------- run
+    def run(self, step_fn: Callable, *args, **kw):
+        """One adaptive train step: poll membership, run under the
+        elastic snapshot/rollback wrapper, and when even the in-memory
+        rollback budget is exhausted, fall back to the newest verified
+        checkpoint generation and try once more."""
+        self._poll_events()
+        try:
+            out = self._elastic.run(step_fn, *args, **kw)
+        except _RETRYABLE_STEP:
+            if self.ckpt is None or self.ckpt.latest() is None:
+                raise
+            self._quiesce(drop=True)
+            self.restore_from_checkpoint()
+            out = self._elastic.run(step_fn, *args, **kw)
+        if self._replan_t0 is not None:
+            self.last_replan_latency_s = \
+                time.perf_counter() - self._replan_t0
+            self._replan_t0 = None
+            from ...observability import metrics
+            metrics.observe("resilience.replan_us",
+                            self.last_replan_latency_s * 1e6)
+        if self.ckpt is not None and self._ckpt_every > 0 \
+                and self._elastic.step_index % self._ckpt_every == 0:
+            self.save_checkpoint()
+        return out

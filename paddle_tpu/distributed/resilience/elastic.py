@@ -251,11 +251,26 @@ def plan_shrink(mesh, lost_process_ids: Sequence[int]):
 def _shrunk_placements(old_placements, old_mesh, new_mesh, global_shape):
     """Placements on the shrunk mesh: kept when the mesh rank survived
     AND the shard still divides evenly over the (smaller) axis;
-    replicated otherwise (a flattened mesh invalidates per-axis shard
-    assignments, and an uneven split would fail the sanitizer's
-    reshard_placement check — replicate first, re-shard later)."""
-    from ..placements import Replicate
+    replicated otherwise (an uneven split would fail the sanitizer's
+    reshard_placement check — replicate first, re-shard later).
+
+    Flattened-mesh case (the survivor count no longer factors the old
+    mesh rank, so plan_shrink collapsed to 1-D): per-axis shard
+    assignments are invalid, but a tensor the old mesh sharded can
+    still plan a REAL 1-D split along its first still-divisible shard
+    dim instead of blanket replication — replicating every formerly
+    sharded tensor after a shrink is exactly when per-chip memory is
+    tightest."""
+    from ..placements import Replicate, Shard
     if new_mesh.ndim != old_mesh.ndim:
+        if new_mesh.ndim == 1:
+            axis = new_mesh.shape[0]
+            for p in old_placements:
+                if p.is_shard():
+                    d = p.get_dim()
+                    if d < len(global_shape) and axis \
+                            and global_shape[d] % axis == 0:
+                        return [Shard(d)]
         return [Replicate()] * new_mesh.ndim
     out = []
     for mesh_dim, p in enumerate(old_placements):
@@ -293,7 +308,8 @@ def shrink_world(mesh, lost_process_ids: Sequence[int],
                  state: Optional[Dict] = None, *,
                  optimizer=None,
                  pipeline: Optional[tuple] = None,
-                 set_global: bool = True):
+                 set_global: bool = True,
+                 target_mesh=None):
     """Rebuild the world over the surviving ranks after confirmed rank
     loss: plan the shrunk mesh, have the sanitizer's distributed
     checkers validate every reshard transition (and the shrunk
@@ -309,9 +325,28 @@ def shrink_world(mesh, lost_process_ids: Sequence[int],
     Validation is unconditional (mode 'error'): recovery onto a broken
     layout is strictly worse than failing loudly — this is the one
     sanitizer sweep that does not honor FLAGS_static_checks=off.
+
+    `target_mesh` overrides the default plan_shrink topology: the
+    adaptive re-planner (resilience/adaptive.py) passes the mesh the
+    auto-tuner chose for the survivors, and the data moves through
+    this same validate-then-reshard path. It must cover exactly the
+    survivor set.
     """
     t0 = time.perf_counter()
-    new_mesh = plan_shrink(mesh, lost_process_ids)
+    if target_mesh is not None:
+        lost = set(int(r) for r in lost_process_ids)
+        survivors = set(pid for pid in mesh.process_ids
+                        if pid not in lost)
+        if set(target_mesh.process_ids) != survivors:
+            from ...base.core import EnforceNotMet
+            raise EnforceNotMet(
+                f"target_mesh {target_mesh!r} covers processes "
+                f"{sorted(target_mesh.process_ids)} but the survivors "
+                f"of {mesh!r} minus {sorted(lost)} are "
+                f"{sorted(survivors)}")
+        new_mesh = target_mesh
+    else:
+        new_mesh = plan_shrink(mesh, lost_process_ids)
     tensors = []
     transitions = []
     if state:

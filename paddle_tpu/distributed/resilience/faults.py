@@ -16,8 +16,12 @@ Plan grammar (semicolon- or comma-separated entries)::
 - ``site`` names an injection point: ``store::get``, ``store::set``,
   ``store::add``, ``store::wait``, ``pg::init``, ``comm::all_reduce``
   (and every other ``comm::<op>``), ``segment::compile``, ``step::N``
-  (ElasticStep's N-th step), ``ckpt::save``, ``ckpt::load``. A
-  trailing ``*`` wildcards (``comm::*``).
+  (ElasticStep's N-th step), ``ckpt::save``, ``ckpt::load``, and the
+  membership events ``member::leave`` / ``member::join`` polled by
+  AdaptiveTrainer at every step boundary (any kind raised there is
+  consumed as the event — ``member::leave@2=die`` drills a
+  deterministic rank leave that triggers a re-plan). A trailing ``*``
+  wildcards (``comm::*``).
 - ``@occ`` fires on the occ-th *matching occurrence* (1-based);
   omitted = the first occurrence only (so a retry of the same site
   succeeds). ``@*`` fires on every occurrence.

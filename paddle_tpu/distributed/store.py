@@ -81,6 +81,31 @@ class TCPStore:
         return _retry.store_policy().run(self._get_once, key,
                                          what=f"store::get({key})")
 
+    def try_get(self, key: str, timeout: float = 0.25):
+        """Liveness-probe get: ONE attempt with its own short deadline,
+        None when the key is missing or slow — never retried and never
+        the store-wide timeout. `get` waits for a key that SHOULD
+        appear (rendezvous); this asks whether a key is there NOW
+        (heartbeat scans, membership polls) — using `get` for that
+        blocks the watcher for the full store timeout per missing
+        node. Deliberately NOT a `store::get` fault site: probe
+        callers treat this as never-raising, and a probe consuming
+        the site's occurrence counts would desync @occ drills aimed
+        at real rendezvous gets (membership drills have their own
+        member:: sites)."""
+        import ctypes
+        ms = max(int(timeout * 1000), 1)
+        n = self._lib.pt_store_get(self._client, key.encode(), None, 0,
+                                   ms)
+        if n < 0:
+            return None
+        buf = ctypes.create_string_buffer(int(n))
+        n2 = self._lib.pt_store_get(self._client, key.encode(), buf, n,
+                                    ms)
+        if n2 < 0:
+            return None
+        return buf.raw[:n2]
+
     def _get_once(self, key: str) -> bytes:
         import ctypes
         if _faults.ACTIVE:

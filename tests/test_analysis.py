@@ -255,6 +255,80 @@ def test_tracer_leak_in_attrs_and_scalar_cache_reported():
         executor._SCALAR_CACHE.pop(key, None)
 
 
+def _make_dead_tracer_shaped(shape):
+    import jax
+    import jax.numpy as jnp
+    box = {}
+
+    def f(t):
+        box["tr"] = t
+        return t * 2.0
+
+    jax.make_jaxpr(f)(jnp.ones(shape, jnp.float32))
+    return box["tr"]
+
+
+def test_tracer_leak_autofix_roundtrip():
+    """The tracer-eviction repair: a dead tracer seeded as a segment
+    input (whose poisoned closure has no live outputs) AND a tracer in
+    the scalar-coercion cache are both evicted by fix mode — poisoned
+    ops pruned, the slot swapped to a concrete placeholder, the cache
+    entry popped — and the re-check proves both diagnostics clear."""
+    from paddle_tpu._core import executor
+
+    tr = _make_dead_tracer_shaped((4, 4))
+    x = _x(seed=40)
+    w = _x(seed=41)
+    with lazy.lazy_guard() as ctx:
+        dead = w * 2.0
+        del dead                 # the poisoned closure dies
+        z = x + 1.0              # clean op stays observable
+        view = SegmentView.from_context(ctx)
+        view.in_vals[view.in_ids[id(w)]] = tr
+        key = (float, 424242.5, 1.0)
+        executor._SCALAR_CACHE[key] = tr
+        try:
+            report = check_segment(view)
+            analysis.check_process_tracer_leaks(report)
+            assert len(report.by_checker("tracer_leak")) == 2, \
+                report.render()
+            result, post = analysis.fix_segment(view, report)
+            assert any("evict leaked tracer input" in a
+                       for a in result.actions), result.actions
+            assert any("scalar-coercion cache" in a
+                       for a in result.actions), result.actions
+            assert key not in executor._SCALAR_CACHE
+            assert not post.by_checker("tracer_leak"), post.render()
+            process = analysis.CheckReport()
+            analysis.check_process_tracer_leaks(process)
+            assert not process.diagnostics
+            # the clean remainder still executes correctly
+            assert len(ctx.pending) == 1
+        finally:
+            executor._SCALAR_CACHE.pop(key, None)
+    np.testing.assert_allclose(z.numpy(), x.numpy() + 1.0, rtol=1e-6)
+
+
+def test_tracer_leak_autofix_skips_live_alias():
+    """A live tensor aliasing a poisoned output makes the substitution
+    observable — NOT mechanical, so the finding must survive fix mode
+    unconsumed."""
+    tr = _make_dead_tracer_shaped((4, 4))
+    w = _x(seed=42)
+    with lazy.lazy_guard() as ctx:
+        y = w * 2.0              # ALIVE poisoned output
+        view = SegmentView.from_context(ctx)
+        view.in_vals[view.in_ids[id(w)]] = tr
+        report = check_segment(view)
+        assert report.by_checker("tracer_leak")
+        result, post = analysis.fix_segment(view, report)
+        assert not any("tracer" in a for a in result.actions)
+        assert post.by_checker("tracer_leak"), \
+            "live-aliased tracer poison must stay reported"
+        ctx._reset_segment()
+    del y
+
+
 # ------------------------------------------------- shape/dtype (lazy)
 
 def test_segment_shape_drift_reported():

@@ -1,8 +1,14 @@
 """Elastic fault-tolerance runtime (distributed/resilience/): fault
 injection determinism, retry/backoff policies, step rollback
-bit-exactness, world-shrink recovery, watchdog reactions, atomic
-checkpoints, and the zero-overhead faults-off gate."""
+bit-exactness, world-shrink recovery, adaptive re-planning on
+membership change, checkpoint retention/fallback, watchdog reactions,
+atomic checkpoints, and the zero-overhead faults-off gate."""
+import json
 import os
+import signal
+import socket
+import subprocess
+import sys
 import time
 
 import numpy as np
@@ -13,15 +19,20 @@ import paddle_tpu.distributed as dist
 import paddle_tpu.nn.functional as F
 from paddle_tpu._core import flags as core_flags
 from paddle_tpu.base.core import EnforceNotMet
-from paddle_tpu.distributed.resilience import (CollectiveTimeout,
+from paddle_tpu.distributed.resilience import (AdaptiveTrainer,
+                                               CollectiveTimeout,
                                                ElasticStep, FaultPlan,
-                                               RankDeath, RetryPolicy,
+                                               RankDeath, Replanner,
+                                               RetryPolicy,
                                                TransientFault, faults,
-                                               retry, shrink_world)
+                                               mesh_for_plan, retry,
+                                               shrink_world)
 from paddle_tpu.observability import metrics
 from paddle_tpu.vision.models import LeNet
 
 from conftest import with_flag
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def _counter(name):
@@ -518,3 +529,596 @@ def test_faults_off_zero_overhead_gate():
              if k.startswith("resilience.")}
     assert after == snap, \
         f"faults-off path mutated resilience counters: {snap} -> {after}"
+
+
+# ------------------------------------------------- adaptive re-planning
+
+def _plain_lenet(n_steps):
+    """Fault-free reference run (single-process, no wrappers)."""
+    paddle.seed(0)
+    model = LeNet()
+    opt = paddle.optimizer.Adam(1e-3, parameters=model.parameters())
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(8, 1, 28, 28).astype(np.float32))
+    y = paddle.to_tensor(rng.randint(0, 10, (8,)).astype(np.int64))
+
+    def step():
+        loss = F.cross_entropy(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return float(loss)
+
+    return [step() for _ in range(n_steps)]
+
+
+def _adaptive_lenet(mesh=None, **trainer_kw):
+    paddle.seed(0)
+    model = LeNet()
+    if mesh is not None:
+        dist.shard_layer(model, mesh)
+    opt = paddle.optimizer.Adam(1e-3, parameters=model.parameters())
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(8, 1, 28, 28).astype(np.float32))
+    y = paddle.to_tensor(rng.randint(0, 10, (8,)).astype(np.int64))
+    trainer = AdaptiveTrainer(optimizer=opt, mesh=mesh, **trainer_kw)
+
+    def step():
+        loss = F.cross_entropy(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return float(loss)
+
+    return trainer, step, model
+
+
+def test_replanner_survivor_feasible_degrees():
+    """The degree space is the divisors of the survivor count, so the
+    chosen plan always tiles the survivor mesh — including worlds the
+    powers-of-two ladder cannot express (6), primes (7), and the
+    tuner-infeasible case that falls back to pure dp."""
+    r = Replanner({"hidden_size": 1024, "num_layers": 8})
+    for n in (6, 7, 5, 4, 3, 1):
+        plan = r.replan(n)
+        assert plan["dp_degree"] * plan["mp_degree"] \
+            * plan["pp_degree"] == n
+        mesh = mesh_for_plan(list(range(n)), plan)
+        assert mesh.size == n
+    # a batch the survivor count cannot tile: guaranteed dp fallback
+    before = _counter("resilience.replan_fallback_plans")
+    with pytest.warns(RuntimeWarning, match="falling back to dp=7"):
+        plan = Replanner({"hidden_size": 1024,
+                          "global_batch_size": 5}).replan(7)
+    assert plan["dp_degree"] == 7 and plan["mp_degree"] == 1
+    assert _counter("resilience.replan_fallback_plans") == before + 1
+
+
+def test_member_leave_replans_and_recompiles_once():
+    """The tentpole acceptance drill, single-process: an injected
+    member::leave on an 8-mesh LeNet run triggers an automatic
+    re-plan — the tuner picks a survivor-feasible plan, the sanitizer
+    shrink sweep validates it before data moves, params land on the
+    new mesh, the step cache re-keys so the fused step recompiles
+    exactly ONCE, and the losses match the fault-free reference."""
+    ref = _plain_lenet(5)
+    mesh = dist.auto_mesh(8, dim_names=["dp"])
+    dist.set_mesh(mesh)
+    try:
+        trainer, step, model = _adaptive_lenet(mesh=mesh,
+                                               lost_ranks=[6, 7])
+        sweeps = _counter("sanitizer.shrink_sweeps")
+        epochs = _counter("resilience.member_epochs")
+        replans = _counter("resilience.replans")
+        with with_flag("FLAGS_observability", True):
+            losses = [trainer.run(step)]      # warm the step cache
+            compiles = _counter("compiles.fused_step")
+            with with_flag("FLAGS_fault_inject", "member::leave@1=die"):
+                losses += [trainer.run(step) for _ in range(4)]
+            # exactly ONE recompile across the replan + the 3 steps
+            # after it: the mesh-epoch re-key forces a fresh entry at
+            # the first post-replan step, which every later step hits
+            assert _counter("compiles.fused_step") == compiles + 1
+        np.testing.assert_allclose(losses, ref, rtol=1e-5)
+        assert trainer.replans == 1
+        assert trainer.mesh.size == 6 and trainer.mesh is not mesh
+        assert dist.get_mesh() is trainer.mesh
+        plan = trainer.last_plan
+        assert plan["dp_degree"] * plan["mp_degree"] \
+            * plan["pp_degree"] == 6
+        for p in model.parameters():
+            assert p._dist_attr.process_mesh is trainer.mesh
+        assert _counter("sanitizer.shrink_sweeps") == sweeps + 1
+        assert _counter("resilience.member_epochs") == epochs + 1
+        assert _counter("resilience.replans") == replans + 1
+        assert trainer.last_replan_latency_s is not None \
+            and trainer.last_replan_latency_s > 0
+        trainer.shutdown()
+    finally:
+        dist.set_mesh(None)
+
+
+def test_rank_death_routes_through_replan():
+    """`step::N=die` (the watchdog/step path, not the membership poll)
+    reaches the same re-plan pipeline via ElasticStep's on_rank_death:
+    state restores to the pre-step snapshot, the survivors re-plan,
+    and the step re-runs bit-exact."""
+    ref = _plain_lenet(4)
+    mesh = dist.auto_mesh(8, dim_names=["dp"])
+    dist.set_mesh(mesh)
+    try:
+        trainer, step, _ = _adaptive_lenet(mesh=mesh, lost_ranks=[7])
+        with with_flag("FLAGS_fault_inject", "step::2=die"):
+            losses = [trainer.run(step) for _ in range(4)]
+        np.testing.assert_allclose(losses, ref, rtol=1e-5)
+        assert trainer.replans == 1 and trainer.mesh.size == 7
+        trainer.shutdown()
+    finally:
+        dist.set_mesh(None)
+
+
+def test_member_join_event_counted_but_no_replan():
+    """A join event is adopted (epoch, counter, flight) but does not
+    re-plan: growth needs fresh processes to host state — a relaunch
+    decision above the loop."""
+    trainer, step, _ = _adaptive_lenet()
+    epochs = _counter("resilience.member_epochs")
+    replans = _counter("resilience.replans")
+    with with_flag("FLAGS_fault_inject", "member::join@2=fail"):
+        losses = [trainer.run(step) for _ in range(3)]
+    assert len(losses) == 3
+    assert _counter("resilience.member_epochs") == epochs + 1
+    assert _counter("resilience.replans") == replans
+    assert trainer.replans == 0
+    trainer.shutdown()
+
+
+def test_rank_death_without_lost_resolution_propagates():
+    """No manager, no lost_ranks: the trainer cannot tell who died, so
+    the death propagates instead of guessing a shrink."""
+    trainer, step, _ = _adaptive_lenet()
+    with with_flag("FLAGS_fault_inject", "member::leave@1=die"):
+        with pytest.raises(RankDeath):
+            trainer.run(step)
+    trainer.shutdown()
+
+
+def test_flattened_mesh_reshard_after_shrink():
+    """The re-shard-after-shrink satellite: when the survivor count no
+    longer factors the old mesh rank, `_shrunk_placements` plans a
+    REAL 1-D split along a still-divisible tensor dim (memory stays
+    bounded), and only replicates when nothing divides."""
+    from paddle_tpu.distributed.resilience.elastic import \
+        _shrunk_placements
+
+    old = dist.ProcessMesh(np.arange(8).reshape(4, 2),
+                           dim_names=["dp", "mp"])
+    flat = dist.ProcessMesh(list(range(5)), dim_names=["dp"])
+    pl = _shrunk_placements([dist.Shard(0), dist.Replicate()], old,
+                            flat, (20, 8))
+    assert len(pl) == 1 and pl[0].is_shard() and pl[0].get_dim() == 0
+    # the second mesh axis' shard survives the flatten too
+    pl = _shrunk_placements([dist.Replicate(), dist.Shard(1)], old,
+                            flat, (8, 20))
+    assert pl[0].is_shard() and pl[0].get_dim() == 1
+    # nothing divides: replicate (the pre-PR behavior, now the last
+    # resort instead of the only answer)
+    pl = _shrunk_placements([dist.Shard(0), dist.Replicate()], old,
+                            flat, (21, 8))
+    assert pl == [dist.Replicate()]
+
+    # end to end through the validated shrink path: the flattened
+    # world keeps a real shard and the data survives bit-exact
+    t = dist.shard_tensor(
+        paddle.to_tensor(np.arange(160, dtype=np.float32)
+                         .reshape(20, 8)),
+        old, [dist.Shard(0), dist.Replicate()])
+    new_mesh = shrink_world(old, [5, 6, 7], {"t": t}, set_global=False)
+    assert new_mesh.ndim == 1 and new_mesh.size == 5
+    assert t._dist_attr.placements[0].is_shard()
+    np.testing.assert_array_equal(
+        np.asarray(t._value),
+        np.arange(160, dtype=np.float32).reshape(20, 8))
+
+
+def test_shrink_world_target_mesh_must_cover_survivors():
+    mesh = dist.auto_mesh(8, dim_names=["dp"])
+    wrong = dist.ProcessMesh(list(range(5)), dim_names=["dp"])
+    with pytest.raises(EnforceNotMet, match="survivors"):
+        shrink_world(mesh, [6, 7], {}, set_global=False,
+                     target_mesh=wrong)
+
+
+def test_manager_epoch_drives_replan():
+    """A REAL ElasticManager membership epoch (store heartbeats, not a
+    fault site) drives the re-plan: node '7' stops heartbeating, the
+    master publishes a survivor epoch, and the trainer's step-boundary
+    poll picks it up."""
+    store = _local_store()
+    try:
+        from paddle_tpu.distributed.fleet.elastic import ElasticManager
+        dead = ElasticManager("7", store, heartbeat_interval=0.05,
+                              node_timeout=0.6)
+        dead.register()
+        mgr = ElasticManager("0", store, heartbeat_interval=0.05,
+                             node_timeout=0.6)
+        mgr.register()
+        mgr.watch(["0", "7"])
+        m = mgr.wait_for_members(
+            lambda m: set(m["members"]) == {"0", "7"}, timeout=10)
+        assert set(m["members"]) == {"0", "7"}
+
+        mesh = dist.auto_mesh(8, dim_names=["dp"])
+        trainer, step, _ = _adaptive_lenet(mesh=mesh, manager=mgr)
+        trainer.run(step)
+        assert trainer.replans == 0
+        dead.shutdown()              # heartbeats stop: node 7 is gone
+        m = mgr.wait_for_members(lambda m: "7" not in m["members"],
+                                 timeout=10)
+        assert "7" not in m["members"]
+        trainer.run(step)            # boundary poll sees the epoch
+        assert trainer.replans == 1
+        assert trainer.mesh.size == 7
+        assert trainer.last_event.source == "manager"
+        assert trainer.last_event.lost == [7]
+        trainer.shutdown()
+        mgr.shutdown()
+    finally:
+        store.close()
+
+
+def test_failed_replan_does_not_consume_epoch():
+    """A membership event whose re-plan FAILS must not be swallowed:
+    the epoch rolls back so the next poll (or a direct retry)
+    re-observes it instead of silently training on against the dead
+    ranks."""
+    from paddle_tpu.distributed.resilience import MembershipEvent
+
+    mesh = dist.auto_mesh(8, dim_names=["dp"])
+    trainer, step, _ = _adaptive_lenet(mesh=mesh)
+    members = [str(r) for r in range(8)]
+    with pytest.raises(EnforceNotMet, match="nothing to\\s+re-plan"):
+        trainer._membership_event(MembershipEvent(
+            5, [], lost=list(range(8)), source="manager"))
+    assert trainer._last_epoch == 0 and trainer.replans == 0
+    # the same epoch still processes once the event is survivable
+    trainer._membership_event(MembershipEvent(
+        5, members[:6], lost=[6, 7], source="manager"))
+    assert trainer._last_epoch == 5 and trainer.replans == 1
+    assert trainer.mesh.size == 6
+    trainer.shutdown()
+
+
+def test_restore_into_fresh_trainer_recovers_optimizer_state(tmp_path):
+    """A BRAND-NEW trainer (fresh optimizer, no Adam moments yet)
+    restoring from a generation must receive the checkpoint's full
+    optimizer state — the load target is augmented from the
+    generation's own key set — and replay the next steps bit-exact
+    (dropped moments would diverge immediately)."""
+    ref = _plain_lenet(5)
+    root = str(tmp_path / "ck")
+    trainer, step, _ = _adaptive_lenet(checkpoint_dir=root,
+                                       checkpoint_every=1)
+    for _ in range(3):
+        trainer.run(step)
+    trainer.shutdown()
+
+    fresh, fresh_step, _ = _adaptive_lenet(checkpoint_dir=root)
+    assert fresh.restore_from_checkpoint() == 3
+    # the step counter rewound with the state
+    assert fresh.step_index == 3
+    losses = [fresh.run(fresh_step) for _ in range(2)]
+    np.testing.assert_allclose(losses, ref[3:5], rtol=1e-5)
+    fresh.shutdown()
+
+
+# ------------------------------------- checkpoint retention & fallback
+
+def test_checkpoint_manager_retention_and_manifest(tmp_path):
+    from paddle_tpu.distributed.checkpoint import CheckpointManager
+
+    root = str(tmp_path / "gens")
+    mgr = CheckpointManager(root, keep=3)
+    for i in range(5):
+        state = {"w": paddle.to_tensor(
+            np.full((2, 2), float(i), np.float32)), "step": i}
+        gen = mgr.save(state, step=i)
+        assert gen == i + 1
+    # keep=3: generations 1 and 2 pruned from disk AND manifest
+    assert mgr.generations() == [3, 4, 5]
+    assert sorted(d for d in os.listdir(root)
+                  if d.startswith("gen_")) == \
+        ["gen_00000003", "gen_00000004", "gen_00000005"]
+    manifest = json.load(open(os.path.join(root, "MANIFEST.json")))
+    assert [e["gen"] for e in manifest["generations"]] == [3, 4, 5]
+    assert all(e["step"] is not None for e in manifest["generations"])
+    # load newest; explicit older generation loads too
+    target = {"w": paddle.to_tensor(np.zeros((2, 2), np.float32)),
+              "step": -1}
+    assert mgr.load(target) == 5
+    assert target["step"] == 4
+    assert mgr.load(target, generation=3) == 3
+    assert target["step"] == 2
+
+
+def test_checkpoint_manager_fallback_on_corruption(tmp_path):
+    """The retention satellite's acceptance: a corrupted latest
+    generation falls back to the newest verified OLDER generation with
+    a counted, logged reason — and only raises when every generation
+    is bad."""
+    from paddle_tpu.distributed.checkpoint import CheckpointManager
+
+    root = str(tmp_path / "gens")
+    mgr = CheckpointManager(root, keep=3)
+    for i in range(3):
+        mgr.save({"w": paddle.to_tensor(
+            np.full((2, 2), float(i), np.float32))}, step=i)
+
+    def corrupt(gen):
+        p = os.path.join(root, f"gen_{gen:08d}", "data_rank0.pkl")
+        blob = bytearray(open(p, "rb").read())
+        blob[len(blob) // 2] ^= 0xFF
+        open(p, "wb").write(bytes(blob))
+
+    corrupt(3)
+    before = _counter("resilience.ckpt_fallbacks")
+    target = {"w": paddle.to_tensor(np.zeros((2, 2), np.float32))}
+    assert mgr.load(target) == 2
+    np.testing.assert_array_equal(target["w"].numpy(),
+                                  np.full((2, 2), 1.0))
+    assert _counter("resilience.ckpt_fallbacks") == before + 1
+    corrupt(2)
+    corrupt(1)
+    with pytest.raises(EnforceNotMet, match="failed verification"):
+        mgr.load(target)
+
+
+def test_adaptive_falls_back_to_checkpoint_when_rollback_exhausted(
+        tmp_path):
+    """The acceptance criterion's last clause: recovery that exhausts
+    the in-memory rollback budget reloads the newest VERIFIED
+    checkpoint generation (here: the latest is corrupted, so the
+    manager falls back a generation) and training resumes bit-exact
+    from that state — replaying the steps since."""
+    ref = _plain_lenet(5)
+    trainer, step, _ = _adaptive_lenet(
+        checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=1,
+        max_retries=1)
+    losses = [trainer.run(step) for _ in range(3)]
+    assert trainer.ckpt.generations() == [1, 2, 3]
+    # corrupt the LATEST generation: the fallback must skip it
+    p = os.path.join(str(tmp_path / "ck"), "gen_00000003",
+                     "data_rank0.pkl")
+    blob = bytearray(open(p, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF
+    open(p, "wb").write(bytes(blob))
+    fallbacks = _counter("resilience.ckpt_fallbacks")
+    restores = _counter("resilience.ckpt_restores")
+    gave_up = _counter("resilience.gave_up")
+    # two injected failures vs a budget of 1: in-memory rollback
+    # exhausts, the checkpoint path takes over
+    with with_flag("FLAGS_fault_inject",
+                   "step::4@1=fail;step::4@2=fail"):
+        losses.append(trainer.run(step))
+    losses.append(trainer.run(step))
+    assert _counter("resilience.gave_up") == gave_up + 1
+    assert _counter("resilience.ckpt_restores") == restores + 1
+    assert _counter("resilience.ckpt_fallbacks") == fallbacks + 1
+    # gen 3 was corrupt -> resumed from gen 2 (post-step-2 state):
+    # steps 3 and 4 replay exactly
+    np.testing.assert_allclose(losses[:3], ref[:3], rtol=1e-5)
+    np.testing.assert_allclose(losses[3:], ref[2:4], rtol=1e-5)
+    trainer.shutdown()
+
+
+# ------------------------------------------- multi-process death drill
+
+_DRILL_SCRIPT = """
+import json, os, signal, sys, time
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import numpy as np
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+import paddle_tpu.nn.functional as F
+from paddle_tpu.distributed.fleet.elastic import ElasticManager
+from paddle_tpu.distributed.resilience import AdaptiveTrainer
+from paddle_tpu.distributed.store import TCPStore
+from paddle_tpu.observability import metrics
+from paddle_tpu.vision.models import LeNet
+
+RANK = int(os.environ["PADDLE_TRAINER_ID"])
+WORLD = int(os.environ["PADDLE_TRAINERS_NUM"])
+KILL_RANK, KILL_STEP, STEPS = 1, 2, 5
+
+
+def build():
+    paddle.seed(0)
+    model = LeNet()
+    opt = paddle.optimizer.Adam(1e-3, parameters=model.parameters())
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(8, 1, 28, 28).astype(np.float32))
+    y = paddle.to_tensor(rng.randint(0, 10, (8,)).astype(np.int64))
+
+    def step():
+        loss = F.cross_entropy(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return float(loss)
+
+    return step, opt
+
+
+# Warm the XLA caches BEFORE joining the heartbeat group: WORLD
+# concurrent cold compiles saturate the box for long enough to stale
+# every peer's heartbeat and flap the membership — the real steps
+# must be cache hits so the only epoch change is the drilled death.
+warm_step, _ = build()
+warm_step()
+
+store = TCPStore(os.environ["MASTER_ADDR"],
+                 int(os.environ["MASTER_PORT"]),
+                 is_master=(RANK == 0), world_size=WORLD, timeout=120)
+# generous node_timeout: on a small box the 7-way post-replan
+# recompile can starve heartbeat threads for seconds; a flapped-out
+# survivor is handled correctly (leave -> replan, rejoin -> recorded)
+# but the drill aims at ONE deterministic death
+mgr = ElasticManager(str(RANK), store, min_np=1,
+                     heartbeat_interval=0.2, node_timeout=10.0)
+mgr.register()
+if RANK == 0:
+    mgr.watch([str(r) for r in range(WORLD)])
+
+# initial rendezvous: wait until the master has seen every trainer
+m = mgr.wait_for_members(lambda m: len(m["members"]) == WORLD,
+                         timeout=90)
+assert len(m["members"]) == WORLD, f"rendezvous failed: {m}"
+
+mesh = dist.ProcessMesh(list(range(WORLD)), dim_names=["dp"])
+step, opt = build()
+trainer = AdaptiveTrainer(optimizer=opt, mesh=mesh, manager=mgr)
+
+events = []
+_orig_event = trainer._membership_event
+def _traced_event(ev, **kw):
+    events.append({"epoch": ev.epoch, "lost": list(ev.lost),
+                   "joined": list(ev.joined), "source": ev.source})
+    return _orig_event(ev, **kw)
+trainer._membership_event = _traced_event
+
+sweeps0 = metrics.counter("sanitizer.shrink_sweeps").value
+losses = []
+for s in range(1, STEPS + 1):
+    if RANK == KILL_RANK and s == KILL_STEP:
+        losses.append(trainer.run(step))   # completes step 2...
+        os.kill(os.getpid(), signal.SIGKILL)   # ...then dies mid-run
+    if RANK != KILL_RANK and s == KILL_STEP + 1:
+        # survivors hold at the step-3 boundary until the master
+        # noticed the death (drill determinism: the re-plan must
+        # happen MID-RUN, not after the loop raced to the end)
+        mgr.wait_for_members(
+            lambda m: str(KILL_RANK) not in m["members"],
+            timeout=120)
+    losses.append(trainer.run(step))
+
+out = {"rank": RANK, "losses": losses, "replans": trainer.replans,
+       "events": events,
+       "mesh": trainer.mesh.shape,
+       "plan": {k: trainer.last_plan.get(k) for k in
+                ("dp_degree", "mp_degree", "pp_degree")}
+               if trainer.last_plan else None,
+       "shrink_sweeps":
+           metrics.counter("sanitizer.shrink_sweeps").value - sweeps0}
+with open(f"result_{RANK}.json", "w") as f:
+    json.dump(out, f)
+trainer.shutdown()
+mgr.shutdown()
+store.close()
+"""
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(420)
+def test_multiprocess_rank_death_drill(tmp_path):
+    """THE deferred multi-PROCESS drill: 8 real spawned trainers
+    rendezvous through a TCPStore-backed ElasticManager; rank 1 is
+    SIGKILLed after step 2 of 5. The launcher (--elastic_mode shrink)
+    keeps the pod alive, the master publishes a survivor epoch, and
+    every survivor re-plans (tuner picks a 7-feasible plan, sanitizer
+    sweep validates it) and finishes all 5 steps with losses matching
+    the fault-free shrunk run to rtol 1e-5."""
+    from paddle_tpu._core import native
+    if not native.get_lib():
+        pytest.skip("native lib unavailable")
+    world = 8
+    script = tmp_path / "drill.py"
+    script.write_text(_DRILL_SCRIPT)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("MASTER_ADDR", None)
+    env.pop("MASTER_PORT", None)
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", str(world),
+         "--elastic_mode", "shrink", "--min_np", str(world - 1),
+         "--master", f"127.0.0.1:{_free_port()}", str(script)],
+        env=env, cwd=str(tmp_path), capture_output=True, text=True,
+        timeout=390)
+    logs = ""
+    logdir = tmp_path / "log"
+    if logdir.exists():
+        for f in sorted(os.listdir(logdir)):
+            logs += f"\n--- {f}\n" + (logdir / f).read_text()[-2000:]
+    assert proc.returncode == 0, \
+        f"launcher rc={proc.returncode}\n{proc.stderr}\n{logs}"
+    assert "shrink mode keeps the pod" in proc.stderr
+
+    ref = _plain_lenet(5)
+    survivors = [r for r in range(world) if r != 1]
+    assert not (tmp_path / "result_1.json").exists(), \
+        "the killed rank must not have finished"
+    for r in survivors:
+        path = tmp_path / f"result_{r}.json"
+        assert path.exists(), f"rank {r} wrote no result\n{logs}"
+        out = json.loads(path.read_text())
+        # the death was observed as a membership epoch and re-planned
+        # (on a starved box the recompile storm can additionally flap
+        # a survivor out and back in — each flap is handled the same
+        # validated way, so assert the drilled death, not flap-free)
+        assert out["replans"] >= 1, (r, out)
+        assert any(1 in e["lost"] for e in out["events"]), (r, out)
+        assert out["shrink_sweeps"] == out["replans"], (r, out)
+        mesh_size = int(np.prod(out["mesh"]))
+        assert mesh_size < world, (r, out)
+        p = out["plan"]
+        assert p["dp_degree"] * p["mp_degree"] * p["pp_degree"] \
+            == mesh_size, (r, out)
+        assert len(out["losses"]) == 5, (r, out)
+        np.testing.assert_allclose(out["losses"], ref, rtol=1e-5,
+                                   err_msg=f"rank {r}")
+
+
+def test_launch_shrink_mode_tolerates_worker_death(tmp_path):
+    """Launcher shrink-mode unit: one worker of four exits non-zero;
+    with --min_np 3 the pod keeps running, the survivors finish, and
+    the launcher exits 0 (collapse mode would have failed the pod)."""
+    body = """
+import os, sys
+rank = os.environ["PADDLE_TRAINER_ID"]
+if rank == "2":
+    sys.exit(9)
+open(f"done_{rank}", "w").write("ok")
+"""
+    script = tmp_path / "worker.py"
+    script.write_text(body)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "4", "--elastic_mode", "shrink",
+         "--min_np", "3",
+         "--master", f"127.0.0.1:{_free_port()}", str(script)],
+        env=env, cwd=str(tmp_path), capture_output=True, text=True,
+        timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    assert "shrink mode keeps the pod" in proc.stderr
+    for r in (0, 1, 3):
+        assert (tmp_path / f"done_{r}").exists()
+    assert not (tmp_path / "done_2").exists()
+    # below min_np the pod fails as before
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "4", "--elastic_mode", "shrink",
+         "--min_np", "4",
+         "--master", f"127.0.0.1:{_free_port()}", str(script)],
+        env=env, cwd=str(tmp_path), capture_output=True, text=True,
+        timeout=120)
+    assert proc.returncode != 0
