@@ -27,6 +27,15 @@ Row 8  adaptive re-plan latency          asserts the faults-off path freezes
                                          membership-change -> first
                                          post-replan-step latency for one
                                          injected member::leave
+Row 9  async dispatch pipeline         capped-chain speedup with
+                                       FLAGS_async_flush on vs off;
+                                       asserts the checks-off/faults-off
+                                       counter freezes (rows 5/7) still
+                                       hold with async on, and that the
+                                       flush executor drains with no
+                                       leaked worker thread; row json
+                                       carries the per-step budget
+                                       snapshot (observability budget)
 (Multi-chip GPT/ERNIE hybrids need a pod; their single-chip proxies are
 bench.py's headline + the dryrun_multichip compile check.)
 """
@@ -439,12 +448,119 @@ def bench_replan():
                      ("dp_degree", "mp_degree", "pp_degree")}}
 
 
+def bench_async_flush():
+    """Row 9: async dispatch pipeline. A 64-op chain over a 16-op
+    segment cap seals 4 segments per step mid-record — exactly the
+    run-ahead case the pipeline targets — timed with FLAGS_async_flush
+    off vs on (min of interleaved rounds). Correctness riders, all
+    exact-counter asserts in the row-5/6/7 style:
+
+    - checks-off sweep freeze and faults-off resilience freeze both
+      hold WITH async on (the pipeline must not smuggle sanitizer or
+      resilience work onto the worker);
+    - the executor drains clean and shutdown leaves no worker thread;
+    - the row json carries the per-step budget snapshot (the
+      observability `budget` mode over the LeNet fused step) so every
+      bench round records where the step's host time went.
+    """
+    import threading
+
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu._core import async_flush
+    from paddle_tpu.analysis import hooks
+    from paddle_tpu.observability import budget as budget_mod
+    from paddle_tpu.observability import metrics
+
+    x = paddle.to_tensor(np.ones((16, 16), "float32"))
+    chain = 64
+
+    def run_phases():
+        """One step, phase-split: the RECORD phase is everything the
+        recording thread does until the last op is recorded (with sync
+        flush this carries the 4 cap-sealed segments' cache lookup +
+        dispatch inline; with async it is seal+submit only) — the
+        dispatch-side time the pipeline removes from the critical
+        path. The SYNC phase is the final fetch, where deferred work
+        lands. On a CPU box both phases compete for the same cores, so
+        total wall barely moves — on a real accelerator the sync phase
+        is device time the host no longer serializes in front of."""
+        t0 = time.perf_counter()
+        y = x
+        for _ in range(chain):
+            y = y * 1.0001 + 0.0001
+        t1 = time.perf_counter()
+        import numpy as _np
+        _np.asarray(y._value)
+        return t1 - t0, time.perf_counter() - t1
+
+    def timed(async_on, steps=100):
+        paddle.set_flags({"FLAGS_async_flush": async_on,
+                          "FLAGS_lazy_max_segment_ops": 16})
+        try:
+            for _ in range(10):
+                run_phases()
+            rec = tot = 0.0
+            for _ in range(steps):
+                r, s = run_phases()
+                rec += r
+                tot += r + s
+            return rec / steps, tot / steps
+        finally:
+            async_flush.drain(raise_latched=False)
+            paddle.set_flags({"FLAGS_async_flush": False,
+                              "FLAGS_lazy_max_segment_ops": 256})
+
+    def frozen_counters():
+        snap = metrics.snapshot()["counters"]
+        return {k: v for k, v in snap.items()
+                if k.startswith("resilience.")}, hooks.segment_sweeps()
+
+    timed(False, steps=20)     # prime: compile + cache warmup off-clock
+    timed(True, steps=20)
+    res_before, sweeps_before = frozen_counters()
+    rounds = [(timed(False), timed(True)) for _ in range(5)]
+    res_after, sweeps_after = frozen_counters()
+    assert res_after == res_before, \
+        "async pipeline did resilience work with faults off (must be 0)"
+    assert sweeps_after == sweeps_before, \
+        "async pipeline ran sanitizer sweeps with checks off (must be 0)"
+
+    # drain/shutdown hygiene: no leaked flush worker
+    async_flush.drain()
+    async_flush.shutdown()
+    assert not any(t.name == async_flush._WORKER_NAME
+                   for t in threading.enumerate()), \
+        "flush executor leaked its worker thread past shutdown"
+
+    # per-step budget snapshot: the LeNet fused train step (the same
+    # builder the observability CLI's budget mode uses)
+    from paddle_tpu.observability.__main__ import _lenet_step
+    snapshot = budget_mod.collect(_lenet_step(), steps=10, warmup=3)
+
+    rec_off = min(r[0][0] for r in rounds)
+    rec_on = min(r[1][0] for r in rounds)
+    tot_off = min(r[0][1] for r in rounds)
+    tot_on = min(r[1][1] for r in rounds)
+    return {"metric": f"async dispatch pipeline ({chain}-op chain, "
+                      f"16-op cap; recording-thread dispatch time off "
+                      f"vs on; checks-off/faults-off freezes + clean "
+                      f"drain asserted)",
+            "value": round(rec_off / rec_on, 2) if rec_on else None,
+            "unit": "x dispatch-side cut",
+            "record_ms_sync": round(rec_off * 1000.0, 3),
+            "record_ms_async": round(rec_on * 1000.0, 3),
+            "total_ms_sync": round(tot_off * 1000.0, 3),
+            "total_ms_async": round(tot_on * 1000.0, 3),
+            "budget": snapshot}
+
+
 def main():
-    rows = os.environ.get("BENCH_ROWS", "1,2,3,4,5,6,7,8").split(",")
+    rows = os.environ.get("BENCH_ROWS", "1,2,3,4,5,6,7,8,9").split(",")
     table = {"1": bench_lenet, "2": bench_resnet50, "3": bench_bert,
              "4": bench_dispatch, "5": bench_static_checks,
              "6": bench_observability, "7": bench_resilience,
-             "8": bench_replan}
+             "8": bench_replan, "9": bench_async_flush}
     for r in rows:
         r = r.strip()
         out = table[r]()

@@ -55,14 +55,23 @@ class ExecCache(OrderedDict):
             if _obs.METRICS and self._miss is not None:
                 self._miss.inc()
             return default
-        self.move_to_end(key)
+        try:
+            # the other thread's eviction loop may delete this key
+            # between the successful read above and the LRU touch —
+            # the value is already in hand, so a lost touch is benign
+            self.move_to_end(key)
+        except KeyError:
+            pass
         if _obs.METRICS and self._hit is not None:
             self._hit.inc()
         return val
 
     def __getitem__(self, key):
         val = OrderedDict.__getitem__(self, key)
-        self.move_to_end(key)
+        try:
+            self.move_to_end(key)
+        except KeyError:
+            pass
         return val
 
     def __setitem__(self, key, val):
@@ -71,5 +80,12 @@ class ExecCache(OrderedDict):
         cap = self._capacity()
         while cap and len(self) > cap:
             # NOT popitem(): OrderedDict.popitem re-enters the overridden
-            # __getitem__ after unlinking the entry -> KeyError
-            OrderedDict.__delitem__(self, next(iter(self)))
+            # __getitem__ after unlinking the entry -> KeyError.
+            # The async flush worker and the recording thread can both
+            # insert: each C-level dict op is GIL-atomic, but the oldest
+            # key read here may be evicted by the other thread between
+            # the two calls — losing that race is benign, so tolerate it
+            try:
+                OrderedDict.__delitem__(self, next(iter(self)))
+            except (KeyError, StopIteration, RuntimeError):
+                break

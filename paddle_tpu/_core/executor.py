@@ -71,9 +71,7 @@ def apply(op_name: str, *inputs, **attrs):
         # flush after that trace exits would replay dead tracers.
         # Dispatch inline; the nested jit call inlines into the trace.
         ctx = None
-    if ctx is not None and (_profile_cb is not None
-                            or flags.flag_value("FLAGS_check_nan_inf")
-                            or flags.flag_value("FLAGS_benchmark")):
+    if ctx is not None and (_profile_cb is not None or _PER_OP_MODE):
         # per-op host tracing / NaN scans / per-op timing need per-op
         # dispatch: bypass the fusion window (pending work lands first so
         # event order matches execution order)
@@ -108,6 +106,24 @@ def apply(op_name: str, *inputs, **attrs):
             t is not None and not t.stop_gradient for t in ts):
         record(op, attrs, ts, outs)
     return outs if op.multi_output else outs[0]
+
+
+# Watcher-kept gate for the two per-op-mode flags: the record hot path
+# used to pay two registry lookups per DISPATCHED OP re-reading flags
+# that flip a handful of times per process. set_flags keeps it coherent
+# (the STATIC_CHECKS_ACTIVE pattern), so mid-session flips still bypass
+# the fusion window on the very next op.
+_PER_OP_MODE = False
+
+
+def _sync_per_op_mode(_value=None):
+    global _PER_OP_MODE
+    _PER_OP_MODE = bool(flags.flag_value("FLAGS_check_nan_inf")
+                        or flags.flag_value("FLAGS_benchmark"))
+
+
+flags.watch_flag("FLAGS_check_nan_inf", _sync_per_op_mode)
+flags.watch_flag("FLAGS_benchmark", _sync_per_op_mode)
 
 
 # Static-graph recorder (installed by paddle_tpu.static.enable_static):

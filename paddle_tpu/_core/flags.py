@@ -200,6 +200,18 @@ define_flag("FLAGS_executable_cache_capacity", 1024,
 define_flag("FLAGS_lazy_donate_inputs", True,
             "Donate lazy-segment input buffers whose backing tensor is "
             "dead or overwritten at flush (XLA reuses them in place).")
+define_flag("FLAGS_async_flush", False,
+            "Hand sealed lazy segments to a single-worker flush "
+            "executor: compile+execute launch off the Python thread "
+            "while eager recording continues; results materialize "
+            "through pending-value placeholders and worker errors "
+            "re-raise at the next sync point (_value read, backward, "
+            "drain). Off = the exact pre-existing synchronous path.")
+define_flag("FLAGS_prefetch_depth", 2,
+            "Device-feed double-buffer depth: DevicePrefetcher (and the "
+            "bench input path) keeps this many upcoming batches' "
+            "host->device transfers in flight so step N+1's inputs "
+            "land while step N executes (0/1 = no overlap).")
 define_flag("FLAGS_optimizer_donate_params", True,
             "Donate old parameter/state buffers into the fused optimizer "
             "update so XLA updates them in place (no per-step copy).")
@@ -224,6 +236,16 @@ define_flag("FLAGS_static_checks", "off",
             "mechanical classes — missing note_inplace, unsafe "
             "donation, dead captures — in place, re-check, and warn "
             "for whatever could not be repaired).")
+define_flag("FLAGS_dead_capture_min_flops", 1024,
+            "Dead-capture lint floor: segments whose dead ops waste "
+            "fewer estimated FLOPs than this AND fewer output bytes "
+            "than FLAGS_dead_capture_min_bytes are not reported "
+            "(scalar bookkeeping the user cannot act on; 0 reports "
+            "everything). Fix-mode pruning honors the same floor.")
+define_flag("FLAGS_dead_capture_min_bytes", 4096,
+            "Dead-capture lint floor companion: minimum wasted output "
+            "bytes before a dead capture below the FLOPs floor is "
+            "still reported.")
 # off-synonym values the hot-path gates (lazy record/flush, PassManager)
 # test membership against — keeps '0'/'false' spellings from paying the
 # analysis import or even a str() call per recorded op. The lowercase
@@ -292,6 +314,20 @@ def _sync_fault_inject_gate(value):
 
 watch_flag("FLAGS_fault_inject", _sync_fault_inject_gate)
 
+# Cached module-level gate for the async flush pipeline (the
+# STATIC_CHECKS_ACTIVE pattern): True iff FLAGS_async_flush is on. The
+# per-flush gate is one attribute read; the executor module is never
+# imported while this is False.
+ASYNC_FLUSH_ACTIVE = False
+
+
+def _sync_async_flush_gate(value):
+    global ASYNC_FLUSH_ACTIVE
+    ASYNC_FLUSH_ACTIVE = bool(value)
+
+
+watch_flag("FLAGS_async_flush", _sync_async_flush_gate)
+
 # ---- kernels / pallas
 define_flag("FLAGS_flash_interpret", False,
             "Force Pallas flash kernels into interpret mode (CPU mesh "
@@ -314,6 +350,12 @@ define_flag("FLAGS_dp_broadcast_params", True,
             "time so replicas start identical.")
 define_flag("FLAGS_elastic_heartbeat_interval_s", 0.5,
             "ElasticManager heartbeat/watch interval in seconds.")
+define_flag("FLAGS_elastic_eviction_debounce", 3,
+            "ElasticManager: consecutive missed/stale heartbeat probes "
+            "before a node is evicted from membership (the PR-6 drill "
+            "showed 8 cold XLA compiles starve every peer's heartbeat "
+            "thread — one slow scan must not publish a member::leave "
+            "epoch; 1 restores the old evict-on-first-miss behavior).")
 define_flag("FLAGS_watchdog_check_interval_s", 1.0,
             "CommTaskManager watchdog poll interval in seconds.")
 define_flag("FLAGS_auto_tuner_max_trials", 0,

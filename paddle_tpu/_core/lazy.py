@@ -40,6 +40,7 @@ import numpy as np
 from . import dispatch
 from . import flags as _flags
 from ..observability import _state as _OBS
+from .async_flush import resolve_pending
 from .cache import ExecCache
 from .op_registry import OpDef
 
@@ -70,6 +71,99 @@ def bump_mesh_epoch() -> int:
     global MESH_EPOCH
     MESH_EPOCH += 1
     return MESH_EPOCH
+
+
+# ---- hot-path flag gates. current_context()/max_ops used to pay ~4
+# registry lookups per RECORDED OP; the watcher pattern
+# (STATIC_CHECKS_ACTIVE) caches each flag into a module attribute that
+# set_flags keeps coherent, so mid-session flips still take effect
+# immediately (test_flags_surface contract) at one attribute read.
+_LAZY_ENABLE = True
+_EAGER_FUSION = True
+_MAX_SEG_OPS = 256
+_DONATE_INPUTS = True
+
+
+def _mk_gate(name):
+    def _set(v, _n=name):
+        globals()[_n] = v
+    return _set
+
+
+_flags.watch_flag("FLAGS_lazy_enable", _mk_gate("_LAZY_ENABLE"))
+_flags.watch_flag("FLAGS_eager_fusion", _mk_gate("_EAGER_FUSION"))
+_flags.watch_flag("FLAGS_lazy_max_segment_ops", _mk_gate("_MAX_SEG_OPS"))
+_flags.watch_flag("FLAGS_lazy_donate_inputs", _mk_gate("_DONATE_INPUTS"))
+
+# flush reasons eligible for the async pipeline: only seals where the
+# recording thread genuinely runs ahead (a cap mid-record). Reads
+# (materialize/guard exit) block on the result anyway — going async
+# there only adds a thread hop to the critical path.
+_ASYNC_REASONS = frozenset(("segment_cap",))
+
+# set the first time a segment is flushed asynchronously; gates the
+# resolve-scan at consumption points so the sync-only path never pays
+# even the per-value getattr walk
+_ASYNC_SEEN = False
+
+
+class _CachedKey:
+    """Executable-cache key wrapper with a precomputed hash.
+
+    The steady-state signature memo returns the SAME _CachedKey object
+    every step, so the per-step cache lookup costs one cached-int hash
+    and one identity compare instead of re-hashing a structure that
+    grows with the op count. Subscripting delegates to the wrapped
+    tuple (register_segment_grad slices sig[1]/sig[2]/sig[4]
+    positionally)."""
+
+    __slots__ = ("sig", "_h")
+
+    def __init__(self, sig):
+        self.sig = sig
+        self._h = hash(sig)
+
+    def __hash__(self):
+        return self._h
+
+    def __eq__(self, other):
+        if self is other:
+            return True
+        if isinstance(other, _CachedKey):
+            return self._h == other._h and self.sig == other.sig
+        return NotImplemented
+
+    def __getitem__(self, i):
+        return self.sig[i]
+
+    def __repr__(self):
+        return f"_CachedKey({self.sig!r})"
+
+
+# per-op signature entries interned by content: steady-state memo
+# validation compares tuples of IDENTICAL entry objects, so the
+# per-step check is n pointer compares (exact, not sampled)
+_SIG_ENTRY_INTERN: Dict[Tuple, Tuple] = {}
+
+# Hot-import bindings: record()/_lazy_tensor() run per recorded op, and
+# a function-local `from .tensor import Tensor` costs an importlib
+# round-trip per call (~190 of them per 32-op chain step in the
+# profile).
+# Bound once on first use — module top-level import would be cyclic
+# during package init (tensor -> autograd -> dispatch while lazy loads).
+_TENSOR_CLS = None
+_AUTOGRAD_META = None
+_IS_GRAD_ENABLED = None
+
+
+def _bind_hot_imports():
+    global _TENSOR_CLS, _AUTOGRAD_META, _IS_GRAD_ENABLED
+    from .autograd import AutogradMeta, is_grad_enabled
+    from .tensor import Tensor
+    _TENSOR_CLS = Tensor
+    _AUTOGRAD_META = AutogradMeta
+    _IS_GRAD_ENABLED = is_grad_enabled
+    return Tensor
 
 
 def _obs_flush_span(reason: str, n_ops: int, n_inputs: int, n_live: int,
@@ -241,6 +335,11 @@ class CaptureContext:
         # recorded op, so flush never re-walks the whole pending list
         self._sig_ops: List[Tuple] = []
         self._max_override = max_segment_ops
+        # steady-state signature memo: (ops_key, in_sig, live, epoch,
+        # backend) -> the _CachedKey handed out last flush. Validated
+        # by EXACT comparison over interned entries (identity-fast) +
+        # the mesh epoch, so a replan or any structural drift rebuilds.
+        self._sig_memo: Optional[Tuple] = None
         # stats for tests / profiling
         self.segments_run = 0
         self.ops_recorded = 0
@@ -248,12 +347,12 @@ class CaptureContext:
 
     @property
     def max_ops(self) -> int:
-        """Segment cap, read live so set_flags mid-session takes effect
-        on already-open (incl. ambient) contexts."""
+        """Segment cap, read live (via the watcher-kept gate) so
+        set_flags mid-session takes effect on already-open (incl.
+        ambient) contexts."""
         if self._max_override is not None:
             return self._max_override
-        from . import flags
-        return flags.flag_value("FLAGS_lazy_max_segment_ops")
+        return _MAX_SEG_OPS
 
     # ---------------------------------------------------------- recording
     def _input_index(self, tensor) -> int:
@@ -283,8 +382,10 @@ class CaptureContext:
 
     def record(self, op: OpDef, ts, attrs):
         """Record one op application; returns out Tensors (lazy)."""
-        from .autograd import is_grad_enabled
-        from .tensor import Tensor
+        is_grad_enabled = _IS_GRAD_ENABLED
+        if is_grad_enabled is None:
+            _bind_hot_imports()
+            is_grad_enabled = _IS_GRAD_ENABLED
 
         # pass 1: resolve avals WITHOUT mutating the input record, so a
         # failing aval inference (un-capturable op) leaves no ghost
@@ -352,7 +453,11 @@ class CaptureContext:
                         _ag.note_view(_out, base, op.name, src)
         self.pending.append(_PendingOp(op, dict(attrs), wiring, out_refs,
                                        src))
-        self._sig_ops.append((op.name, akey, wiring, len(out_refs)))
+        entry = (op.name, akey, wiring, len(out_refs))
+        entry = _SIG_ENTRY_INTERN.setdefault(entry, entry)
+        if len(_SIG_ENTRY_INTERN) > 65536:
+            _SIG_ENTRY_INTERN.clear()
+        self._sig_ops.append(entry)
         self.ops_recorded += 1
         return tuple(outs)
 
@@ -383,11 +488,26 @@ class CaptureContext:
                     live_refs.append(ref)
         return live, live_refs
 
-    def _signature(self, in_vals, live) -> Tuple:
+    def _signature(self, in_vals, live) -> "_CachedKey":
         # MESH_EPOCH rides at the END: register_segment_grad slices the
-        # ops/inputs halves positionally (sig[1]/sig[2])
-        return (jax.default_backend(), tuple(self._sig_ops),
-                _in_signature(in_vals), tuple(live), MESH_EPOCH)
+        # ops/inputs halves positionally (sig[1]/sig[2]). The memo
+        # hands back last step's _CachedKey when nothing structural
+        # changed — entries are interned, so the comparison is n
+        # identity checks, and downstream cache lookups hash a cached
+        # int instead of re-walking the whole structure every step.
+        ops_key = tuple(self._sig_ops)
+        in_sig = _in_signature(in_vals)
+        live_t = tuple(live)
+        backend = jax.default_backend()
+        memo = self._sig_memo
+        if memo is not None and memo[3] == MESH_EPOCH \
+                and memo[4] == backend and memo[0] == ops_key \
+                and memo[1] == in_sig and memo[2] == live_t:
+            return memo[5]
+        key = _CachedKey((backend, ops_key, in_sig, live_t, MESH_EPOCH))
+        self._sig_memo = (ops_key, in_sig, live_t, MESH_EPOCH, backend,
+                          key)
+        return key
 
     # ------------------------------------------------------------- flush
     def flush(self, reason: str = "materialize"):
@@ -408,12 +528,28 @@ class CaptureContext:
         # is dead the moment this program runs — let XLA reuse its buffer
         # for an output (the in-place param.copy_ pattern) instead of
         # copying. Never donate when the segment registers a grad node:
-        # saved inputs are the backward residuals.
+        # saved inputs are the backward residuals. The all-inputs-alive
+        # step (the common case) pays ONE identity scan here instead of
+        # the set/dict builds + per-buffer refcount probes of the full
+        # candidate search.
         donate: Tuple[int, ...] = ()
         from . import flags
-        if flags.flag_value("FLAGS_lazy_donate_inputs") and not \
+        if _DONATE_INPUTS and any(
+                t is None or t._payload is not in_vals[i]
+                for i, t in enumerate(in_tensors)) and not \
                 _segment_needs_grad(in_tensors, in_vals, live_refs, in_meta):
             donate = _donatable_inputs(in_tensors, in_vals, live_refs)
+
+        # async dispatch pipeline: a cap-sealed segment hands off to
+        # the single-worker flush executor so compile+execute leave the
+        # recording thread; live outputs become PendingValues that
+        # materialize at the next sync point. SOT capture (on_flush
+        # observer) needs concrete out tensors, so it stays synchronous.
+        if _flags.ASYNC_FLUSH_ACTIVE and reason in _ASYNC_REASONS \
+                and self.on_flush is None:
+            self._flush_async(reason, pending, in_vals, in_meta,
+                              in_tensors, live, live_refs, sig, donate)
+            return
 
         # program sanitizer (paddle_tpu.analysis): one cached-gate read
         # when off; in warn/error mode the segment checkers run over the
@@ -451,6 +587,9 @@ class CaptureContext:
         dispatch.bump_exec()
         xspan = None
         try:
+            # inputs produced by a still-in-flight async flush resolve
+            # here (the pipeline's data-dependency sync)
+            run_vals = resolve_pending(in_vals) if _ASYNC_SEEN else in_vals
             runner = _SEG_CACHE.get((sig, donate))
             # async dispatch: out_vals are in-flight futures — the host
             # returns to tracing the next ops while the device executes;
@@ -472,11 +611,11 @@ class CaptureContext:
                                  donate_argnums=donate)
                 _SEG_CACHE[(sig, donate)] = runner
                 with _quiet_donation_compile():   # first call compiles
-                    out_vals = runner(*in_vals)
+                    out_vals = runner(*run_vals)
             else:
                 if fspan is not None:
                     xspan = _obs_exec_span(False, len(pending))
-                out_vals = runner(*in_vals)
+                out_vals = runner(*run_vals)
             if xspan is not None:
                 xspan.end()
         except Exception as e:
@@ -543,6 +682,133 @@ class CaptureContext:
         if fspan is not None:
             fspan.end()
 
+    def _flush_async(self, reason, pending, in_vals, in_meta, in_tensors,
+                     live, live_refs, sig, donate):
+        """Seal the segment and hand it to the flush executor.
+
+        Caller-thread work is exactly what MUST happen at eager order:
+        donation decision (already made — refcount semantics are
+        caller-relative), output binding (every live alias gets a
+        PendingValue payload), and grad wiring (the graph exists the
+        moment eager code moves on). The sanitizer sweep, cache lookup,
+        compile, execute, ledger note, and NaN scan all run on the
+        worker; failures fail every PendingValue and latch on the
+        executor, re-raising at the next sync point (the flight
+        post-mortem fires on the worker, so the report carries the
+        failing flush)."""
+        global _ASYNC_SEEN
+        from .async_flush import PendingValue, get_executor
+
+        # mode resolved NOW (a typo'd FLAGS_static_checks raises at the
+        # flush site, not from a worker); the sweep itself runs off-thread
+        mode = None
+        if _flags.STATIC_CHECKS_ACTIVE:
+            from ..analysis import hooks as _sanitizer
+            mode = _sanitizer.check_mode()
+            if mode == "off":
+                mode = None
+        in_ids = dict(self._in_ids)
+        fault_active = _flags.FAULT_INJECT_ACTIVE
+        from . import flags
+        nan_check = flags.flag_value("FLAGS_check_nan_inf")
+
+        pvs = [PendingValue(ref.aval) for ref in live_refs]
+        out_tensors = []
+        for ref, pv in zip(live_refs, pvs):
+            ts = _live_aliases(ref)
+            for t in ts:
+                t._payload = pv
+            grad_ts = [t for t in ts if not t.stop_gradient]
+            out_tensors.append(grad_ts[0] if grad_ts
+                               else (ts[0] if ts else None))
+        if _OBS.METRICS:
+            from ..observability import metrics
+            metrics.inc("segment.async_flushes")
+
+        def job(pending=pending, live=live, live_refs=live_refs,
+                sig=sig, donate=donate):
+            pvmap = {id(r): pv for r, pv in zip(live_refs, pvs)}
+            fspan = xspan = None
+            try:
+                if mode is not None:
+                    from ..analysis import hooks as _sanitizer
+                    # fixable=False: fix-mode REPAIRS stay on the
+                    # synchronous path — the fixer rewrites context
+                    # state that now belongs to the NEXT recording
+                    # segment, and the sealed outputs are already bound
+                    # to PendingValues. Warn/error semantics (incl. the
+                    # deferred StaticCheckError) are identical; ctx is
+                    # withheld so nothing can touch live state.
+                    _sanitizer.on_segment_flush(
+                        None, pending, in_vals, in_meta, in_tensors,
+                        live, live_refs, donate, mode, fixable=False,
+                        reason=reason, in_ids=in_ids)
+                fspan = _obs_flush_span(reason, len(pending),
+                                        len(in_vals), len(live),
+                                        len(donate)) \
+                    if _OBS.ACTIVE else None
+                run_vals = resolve_pending(in_vals)
+                dispatch.bump_exec()
+                runner = _SEG_CACHE.get((sig, donate))
+                if runner is None:
+                    if fault_active:
+                        from ..distributed.resilience import faults \
+                            as _faults
+                        _faults.inject("segment::compile")
+                    if fspan is not None:
+                        xspan = _obs_exec_span(True, len(pending))
+                    if _OBS.METRICS:
+                        from ..observability import metrics
+                        metrics.inc("compiles.segment")
+                    runner = jax.jit(_build_segment_fn(pending, live),
+                                     donate_argnums=donate)
+                    _SEG_CACHE[(sig, donate)] = runner
+                    with _quiet_donation_compile():
+                        out_vals = runner(*run_vals)
+                else:
+                    if fspan is not None:
+                        xspan = _obs_exec_span(False, len(pending))
+                    out_vals = runner(*run_vals)
+                if xspan is not None:
+                    xspan.end()
+                    xspan = None
+                if mode is not None and donate:
+                    from ..analysis.dataflow import note_segment_donation
+                    note_segment_donation(in_vals, donate, reason,
+                                          pending)
+                if nan_check:
+                    for (j, _s), val in zip(live, out_vals):
+                        dispatch._check_nan_inf(
+                            f"{pending[j].op.name} (lazy segment "
+                            f"output)", (val,))
+                for ref, val in zip(live_refs, out_vals):
+                    pv = pvmap.pop(id(ref), None)
+                    if pv is not None:
+                        pv._fill(val)
+                for pv in pvmap.values():   # fixer dropped a live slot
+                    pv._fail(RuntimeError(
+                        "async flush lost a live output"))
+                if fspan is not None:
+                    fspan.end()
+            except BaseException as e:
+                for pv in pvs:
+                    if not pv.done():
+                        pv._fail(e)
+                if xspan is not None:
+                    xspan.end(error=e)
+                if fspan is not None:
+                    fspan.end(error=e)
+                _obs_flush_failed(reason, e)
+                raise
+
+        get_executor().submit(job)
+        _ASYNC_SEEN = True
+        self._reset_segment()
+        self.breaks.append(reason)
+        self.segments_run += 1
+        self._register_grad(pending, live, live_refs, out_tensors,
+                            in_tensors, in_vals, sig, in_meta)
+
     on_flush = None  # observer hook (jit/sot records segment structure)
 
     def flush_per_op(self, reason: str = "grad_targets"):
@@ -592,6 +858,17 @@ class CaptureContext:
     def _replay_per_op(self, pending, in_vals, in_meta, in_tensors):
         from .autograd import record
         from .tensor import Tensor
+        if _ASYNC_SEEN:
+            # per-op replay hands raw payloads to eager dispatch:
+            # in-flight async results resolve first, and tensors whose
+            # payload IS the pending snapshot adopt the concrete value
+            # so the overwritten-in-place identity check below stays
+            # exact
+            resolved = resolve_pending(in_vals)
+            for t, v, rv in zip(in_tensors, in_vals, resolved):
+                if t is not None and t._payload is v:
+                    t._payload = rv
+            in_vals = resolved
         out_tensors: List[List] = []
         for pop in pending:
             ins = []
@@ -848,6 +1125,9 @@ def _register_component_grad(grad_in, grad_out, pending, live, live_refs,
 
     def py_bwd(gouts, _saved=tuple(in_vals), _bwd=bwd, _refs=live_refs,
                _go=tuple(grad_out)):
+        if _ASYNC_SEEN:
+            # residuals saved from an async step may still be in flight
+            _saved = resolve_pending(_saved)
         dispatch.bump_exec()
         # the cached vjp covers the WHOLE segment: seed this component's
         # slots, zeros elsewhere (disjoint components contribute nothing)
@@ -963,12 +1243,13 @@ def _segment_bwd(sig, pending, live, grad_in: Tuple[int, ...]):
 
 
 def _lazy_tensor(ref: LazyRef, stop_gradient=True):
-    from .tensor import Tensor
+    Tensor = _TENSOR_CLS
+    if Tensor is None:
+        Tensor = _bind_hot_imports()
     t = object.__new__(Tensor)
     t._payload = ref
     t._stop_gradient = stop_gradient
-    from .autograd import AutogradMeta
-    t._autograd_meta = AutogradMeta()
+    t._autograd_meta = _AUTOGRAD_META()
     t._inplace_version = 0
     t.name = None
     t.persistable = False
@@ -1048,32 +1329,59 @@ class _ReplayMismatch(Exception):
 
 
 # --------------------------------------------------------------- the guard
-_ACTIVE: List[CaptureContext] = []
+# Capture state is PER-THREAD. The window used to be process-global,
+# which silently interleaved two threads' records into one segment —
+# a DataLoader prefetch thread slicing Tensor batches while the main
+# thread records the model corrupts the wiring (op indices race with
+# concurrent resets). Per-thread windows give each thread its own
+# eager order, exactly like per-thread CUDA streams in the reference;
+# cross-thread tensor handoff materializes at the boundary (DataLoader
+# does this for every queued batch).
+import threading as _threading
 
-# Ambient context: the fusion window as the DEFAULT eager mode — no
-# guard needed. Installed by enable_eager_fusion(); explicit lazy_guard
-# contexts stack above it and take precedence.
-_AMBIENT: Optional[CaptureContext] = None
+
+class _ThreadState(_threading.local):
+    def __init__(self):
+        self.active: List[CaptureContext] = []   # explicit lazy_guards
+        self.ambient: Optional[CaptureContext] = None
+
+
+_TLS = _ThreadState()
+
+# every open context, across threads — note_inplace must evict a
+# mutated tensor's registration from ALL of them (an optimizer on the
+# main thread swapping a payload another thread registered). Guarded:
+# WeakSet iteration while another thread registers a context would
+# RuntimeError.
+_ALL_CTXS = weakref.WeakSet()
+_ALL_CTXS_LOCK = _threading.Lock()
+
+
+def _track_ctx(ctx: CaptureContext):
+    with _ALL_CTXS_LOCK:
+        _ALL_CTXS.add(ctx)
 
 
 def current_context() -> Optional[CaptureContext]:
-    # FLAGS_lazy_enable / FLAGS_eager_fusion are re-read on every
-    # dispatch, so toggling them mid-session (even inside an open guard)
-    # takes effect immediately — no stale ambient state survives a flip
-    global _AMBIENT
-    from . import flags
-    if not flags.flag_value("FLAGS_lazy_enable"):
+    # FLAGS_lazy_enable / FLAGS_eager_fusion are read through the
+    # watcher-kept module gates, so toggling them mid-session (even
+    # inside an open guard) still takes effect immediately — no stale
+    # ambient state survives a flip, and the per-dispatch cost drops
+    # from two registry lookups to two attribute reads
+    tls = _TLS
+    if not _LAZY_ENABLE:
         return None
-    if _ACTIVE:
-        return _ACTIVE[-1]
-    if flags.flag_value("FLAGS_eager_fusion"):
-        if _AMBIENT is None:
-            _AMBIENT = CaptureContext()
-        return _AMBIENT
-    if _AMBIENT is not None:
+    if tls.active:
+        return tls.active[-1]
+    if _EAGER_FUSION:
+        if tls.ambient is None:
+            tls.ambient = CaptureContext()
+            _track_ctx(tls.ambient)
+        return tls.ambient
+    if tls.ambient is not None:
         # flag flipped off with ops pending: land them, then retire the
         # ambient context so dispatch is strictly per-op again
-        ctx, _AMBIENT = _AMBIENT, None
+        ctx, tls.ambient = tls.ambient, None
         ctx.flush("ambient_disable")
     return None
 
@@ -1092,10 +1400,11 @@ def enable_eager_fusion(enable: bool = True) -> Optional[CaptureContext]:
     program at the next sync point (.numpy()/float()/backward()/segment
     cap) — the TPU-native analog of the reference's CUDA-stream
     run-ahead. Turning it off flushes anything pending and restores
-    strict per-op dispatch. Returns the ambient context when enabling."""
+    strict per-op dispatch. Returns the (calling thread's) ambient
+    context when enabling."""
     from . import flags
     flags.set_flags({"FLAGS_eager_fusion": enable})
-    return current_context() if not _ACTIVE else _AMBIENT
+    return current_context() if not _TLS.active else _TLS.ambient
 
 
 def eager_fusion_enabled() -> bool:
@@ -1105,12 +1414,13 @@ def eager_fusion_enabled() -> bool:
 
 def note_inplace(tensor):
     """Called by Tensor._replace_value_inplace: evict the tensor's input
-    registration from every open capture context (see
-    CaptureContext.note_inplace)."""
-    for ctx in _ACTIVE:
+    registration from EVERY open capture context, any thread (see
+    CaptureContext.note_inplace; eviction itself is a GIL-atomic
+    dict.pop)."""
+    with _ALL_CTXS_LOCK:
+        ctxs = list(_ALL_CTXS)
+    for ctx in ctxs:
         ctx.note_inplace(tensor)
-    if _AMBIENT is not None:
-        _AMBIENT.note_inplace(tensor)
 
 
 def try_fused_backward(tensors, grad_tensors) -> bool:
@@ -1220,7 +1530,8 @@ def try_fused_backward(tensors, grad_tensors) -> bool:
     xspan = _obs_exec_span(compiled, len(pending)) \
         if fspan is not None else None
     try:
-        out_vals, grads = runner(*in_vals)
+        run_vals = resolve_pending(in_vals) if _ASYNC_SEEN else in_vals
+        out_vals, grads = runner(*run_vals)
     except Exception as e:
         ctx._reset_segment()
         # spans end BEFORE the flight dump (report must carry them)
@@ -1304,7 +1615,8 @@ class lazy_guard:
         from . import flags
         self.ctx = CaptureContext(self._max)
         if flags.flag_value("FLAGS_lazy_enable"):
-            _ACTIVE.append(self.ctx)
+            _TLS.active.append(self.ctx)
+            _track_ctx(self.ctx)
             self._active = True
         else:
             self._active = False   # kill-switch: pure eager
@@ -1313,7 +1625,7 @@ class lazy_guard:
     def __exit__(self, et, ev, tb):
         if not getattr(self, "_active", True):
             return False
-        _ACTIVE.pop()
+        _TLS.active.pop()
         if et is None:
             self.ctx.flush("guard_exit")
         else:

@@ -34,6 +34,10 @@ class Tensor:
             # alias a pending lazy value (keeps the fusion window open:
             # wrapping/detaching a lazy tensor must not force a flush)
             value.add_tref(self)
+        elif getattr(value, "_is_pending_value", False):
+            # alias an in-flight async-flush output: resolution happens
+            # lazily at the first _value read, like any other alias
+            pass
         elif not isinstance(value, (jax.Array, jax.core.Tracer)):
             value = jnp.asarray(value)
         self._payload = value
@@ -56,6 +60,12 @@ class Tensor:
             v = self._payload
             if getattr(v, "_is_lazy_ref", False):
                 raise RuntimeError("lazy value failed to materialize")
+        if getattr(v, "_is_pending_value", False):
+            # in-flight async-flush output: THE sync point — block on
+            # the worker, re-raise its (typed) failure, cache the
+            # concrete array so later reads are free
+            v = v.resolve()
+            self._payload = v
         return v
 
     @_value.setter

@@ -88,7 +88,8 @@ def run_segment_checkers(view, subject: str, lints: bool = False,
 
 def on_segment_flush(ctx, pending, in_vals, in_meta, in_tensors,
                      live, live_refs, donate, mode: str,
-                     fixable: bool = True, reason: str = "materialize"):
+                     fixable: bool = True, reason: str = "materialize",
+                     in_ids: Optional[dict] = None):
     """Flush-time sanitizer pass over the segment about to execute.
     Called by CaptureContext.flush AFTER the donation mask is computed
     and BEFORE the executable runs, so 'error' mode stops a corrupting
@@ -105,7 +106,11 @@ def on_segment_flush(ctx, pending, in_vals, in_meta, in_tensors,
     from .segment_checks import SegmentView
     from .._core import lazy
     view = SegmentView(
-        pending, in_vals, in_tensors, in_meta, dict(ctx._in_ids),
+        pending, in_vals, in_tensors, in_meta,
+        # async flushes pass the SEAL-time registration snapshot (the
+        # context has already been reset for the next segment by the
+        # time the worker sweeps)
+        dict(ctx._in_ids) if in_ids is None else in_ids,
         live, live_refs, donate,
         lazy._segment_needs_grad(in_tensors, in_vals, live_refs,
                                  in_meta), ctx=ctx)

@@ -414,6 +414,16 @@ def check_dead_captures(view: SegmentView, report: CheckReport):
         flops += _op_flops(p.op.name, in_avals, out_avals)
         nbytes += sum(int(np.prod(a.shape)) * np.dtype(a.dtype).itemsize
                       for a in out_avals)
+    # cost-aware floor: a couple of dead scalar bookkeeping ops are
+    # real but unactionable — reporting them would re-noise the
+    # warn-mode self-lint the lint-severity split just cleaned up.
+    # Report (and fix-mode prune) only waste someone would chase:
+    # above the estimated-FLOPs floor OR the output-bytes floor.
+    from .._core import flags as _flags
+    min_flops = _flags.flag_value("FLAGS_dead_capture_min_flops")
+    min_bytes = _flags.flag_value("FLAGS_dead_capture_min_bytes")
+    if flops < min_flops and nbytes < min_bytes:
+        return
     names = [view.pending[j].op.name for j in dead[:4]]
     fields = view.op_diag_fields(dead[0])
     report.add(

@@ -320,9 +320,15 @@ class CheckpointManager:
                         flight.note("ckpt", "fallback", loaded=gen,
                                     error=repr(last_err)[:160])
                 return gen
-            except (EnforceNotMet, OSError, pickle.UnpicklingError) as e:
+            except (EnforceNotMet, OSError, pickle.UnpicklingError,
+                    KeyError) as e:
                 # a failed generation's placeholder keys must not leak
-                # into the next (older) attempt's strict-load key set
+                # into the next (older) attempt's strict-load key set.
+                # KeyError is load_state_dict's strict-load mismatch
+                # (e.g. the generation predates the optimizer's first
+                # step and lacks its moment keys): an older generation
+                # may still satisfy the key set, and the documented
+                # contract is to raise only when NO generation loads.
                 for k in added:
                     state_dict.pop(k, None)
                 last_err = e

@@ -30,17 +30,29 @@ class ElasticManager:
                  min_np: int = 1, max_np: int = -1,
                  heartbeat_interval: float = None,
                  node_timeout: float = 2.0,
+                 eviction_debounce: int = None,
                  on_membership_change: Optional[Callable] = None):
         self.node_id = node_id
         self.store = store
         self.min_np = min_np
         self.max_np = max_np if max_np > 0 else 10 ** 9
+        from ..._core.flags import flag_value
         if heartbeat_interval is None:
-            from ..._core.flags import flag_value
             heartbeat_interval = flag_value(
                 "FLAGS_elastic_heartbeat_interval_s")
         self.interval = heartbeat_interval
         self.node_timeout = node_timeout
+        # eviction debounce (the PR-6 drill learning folded back): a
+        # member leaves only after this many CONSECUTIVE stale/missed
+        # probes. Under CPU starvation (8 concurrent cold XLA compiles)
+        # a single scan routinely sees every peer stale — publishing a
+        # member::leave epoch off one bad scan triggers a replan storm
+        # the adaptive trainer then has to flap through. 1 = legacy
+        # evict-on-first-miss.
+        self.eviction_debounce = max(
+            int(eviction_debounce if eviction_debounce is not None
+                else flag_value("FLAGS_elastic_eviction_debounce")), 1)
+        self._miss_counts: Dict[str, int] = {}
         self.on_membership_change = on_membership_change
         self.epoch = 0
         self.members: List[str] = []
@@ -141,6 +153,23 @@ class ElasticManager:
         except (ValueError, KeyError):
             return False
 
+    def _scan_alive(self, last: List[str]) -> List[str]:
+        """One heartbeat scan with eviction debounce: a node already in
+        the membership survives up to eviction_debounce-1 consecutive
+        stale/missed probes (one starved scan must not evict the
+        world); a node never seen alive gets no such grace."""
+        alive = []
+        for n in sorted(self._known):
+            if self._alive(n):
+                self._miss_counts.pop(n, None)
+                alive.append(n)
+            else:
+                c = self._miss_counts.get(n, 0) + 1
+                self._miss_counts[n] = c
+                if n in last and c < self.eviction_debounce:
+                    alive.append(n)   # debounced, not yet evicted
+        return alive
+
     def _watch_loop(self):
         last: List[str] = []
         announced = 0
@@ -155,7 +184,7 @@ class ElasticManager:
                         break   # counter visible before key: next scan
                     announced += 1
                     self._known.add(raw.decode())
-                alive = sorted(n for n in self._known if self._alive(n))
+                alive = self._scan_alive(last)
                 if alive != last and len(alive) >= self.min_np:
                     self.epoch += 1
                     self.members = alive[:self.max_np]

@@ -228,14 +228,22 @@ class AdaptiveTrainer:
         """No in-flight lazy work may straddle a re-plan: a healthy
         boundary flushes the ambient window (pending user ops
         materialize on the OLD layout), a failed step drops its
-        aborted trace the way a failed compile would."""
-        from ..._core import lazy
+        aborted trace the way a failed compile would. The async flush
+        pipeline drains either way — a worker job landing MID-reshard
+        would race the data movement. On the drop path its latched
+        errors ARE the failure being handled and are discarded; on the
+        healthy path an unread worker failure must surface BEFORE the
+        re-plan trusts the state (a raise here fails the re-plan,
+        which rolls the adopted epoch back and re-observes the event
+        — the same path any re-plan failure takes)."""
+        from ..._core import async_flush, lazy
         ctx = lazy.current_context()
         if ctx is not None and ctx.pending:
             if drop:
                 ctx._reset_segment()
             else:
                 ctx.flush("replan_quiesce")
+        async_flush.drain(raise_latched=not drop)
 
     # ----------------------------------------------------- event intake
     def _poll_events(self):

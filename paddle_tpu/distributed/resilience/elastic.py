@@ -81,6 +81,13 @@ class ElasticStep:
 
     # -------------------------------------------------------- snapshot
     def _snapshot(self) -> Dict:
+        # the async flush pipeline must be EMPTY before state is copied:
+        # an in-flight segment could still be writing (donating into)
+        # the very buffers being snapshotted, and a latched off-thread
+        # failure belongs to the PREVIOUS step — surface it here, before
+        # this step's snapshot pretends the world is healthy
+        from ..._core import async_flush
+        async_flush.drain()
         snap = {"params": [(p, _copy_buf(p._value)) for p in self._params]}
         opt = self._opt
         if opt is not None:
@@ -102,6 +109,17 @@ class ElasticStep:
         stays pristine for a second retry — and clear grads (a failed
         step may have half-accumulated them; the re-run's backward
         must start clean)."""
+        # drain the failed step's in-flight flushes FIRST: a worker job
+        # finishing after the restore would overwrite rolled-back
+        # payloads with aborted-step results. Its errors are the
+        # failure being handled — discard, don't re-raise.
+        from ..._core import async_flush
+        async_flush.drain(raise_latched=False)
+        from ..._core import lazy
+        ctx = lazy.current_context()
+        if ctx is not None and ctx.pending:
+            # the aborted step's half-recorded trace dies with it
+            ctx._reset_segment()
         for p, buf in snap["params"]:
             p._replace_value_inplace(_copy_buf(buf))
             p.clear_grad()

@@ -93,35 +93,43 @@ class TCPStore:
         the site's occurrence counts would desync @occ drills aimed
         at real rendezvous gets (membership drills have their own
         member:: sites)."""
+        out = self._sized_read(key, max(int(timeout * 1000), 1))
+        return None if isinstance(out, int) else out
+
+    def _sized_read(self, key: str, ms: int):
+        """The native get's size-then-read, raced against concurrent
+        rewrites: if the value grows between the two calls, the native
+        side skips the copy (buf too small) but still returns the NEW
+        length — returning the zero-filled buffer would hand the
+        caller garbage (a heartbeat scan would adopt a '\\x00...' node
+        id; a rendezvous consumer would json-parse NULs). Re-size and
+        retry; returns the bytes, or the last failing native rc (int
+        < 0) / -1 for a key that would not hold still."""
         import ctypes
-        ms = max(int(timeout * 1000), 1)
         n = self._lib.pt_store_get(self._client, key.encode(), None, 0,
                                    ms)
         if n < 0:
-            return None
-        buf = ctypes.create_string_buffer(int(n))
-        n2 = self._lib.pt_store_get(self._client, key.encode(), buf, n,
-                                    ms)
-        if n2 < 0:
-            return None
-        return buf.raw[:n2]
+            return int(n)
+        for _ in range(3):
+            buf = ctypes.create_string_buffer(int(n))
+            n2 = self._lib.pt_store_get(self._client, key.encode(), buf,
+                                        n, ms)
+            if n2 < 0:
+                return int(n2)
+            if n2 <= n:
+                return buf.raw[:n2]
+            n = n2
+        return -1
 
     def _get_once(self, key: str) -> bytes:
-        import ctypes
         if _faults.ACTIVE:
             _faults.inject("store::get")
-        n = self._lib.pt_store_get(self._client, key.encode(), None, 0,
-                                   self._timeout_ms)
-        if n < 0:
-            raise StoreOpError(f"TCPStore.get('{key}') failed: "
-                               f"{native.last_error()}")
-        buf = ctypes.create_string_buffer(int(n))
-        n2 = self._lib.pt_store_get(self._client, key.encode(), buf, n,
-                                    self._timeout_ms)
-        if n2 < 0:
-            raise StoreOpError(f"TCPStore.get('{key}') failed: "
-                               f"{native.last_error()}")
-        return buf.raw[:n2]
+        out = self._sized_read(key, self._timeout_ms)
+        if isinstance(out, int):
+            reason = native.last_error() \
+                or "value kept changing size under the read"
+            raise StoreOpError(f"TCPStore.get('{key}') failed: {reason}")
+        return out
 
     def add(self, key: str, amount: int = 1) -> int:
         # NOT retried: add is not idempotent — a retry after an applied-

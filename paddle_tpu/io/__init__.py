@@ -20,7 +20,8 @@ from .._core.tensor import Tensor
 __all__ = ["Dataset", "IterableDataset", "TensorDataset", "Subset",
            "ConcatDataset", "random_split", "DataLoader", "BatchSampler",
            "Sampler", "SequenceSampler", "RandomSampler",
-           "DistributedBatchSampler", "default_collate_fn"]
+           "DistributedBatchSampler", "default_collate_fn",
+           "DevicePrefetcher"]
 
 
 class Dataset:
@@ -255,6 +256,26 @@ def _tree_to_tensor(obj):
     return obj
 
 
+def _materialize_tree(obj):
+    """Land any lazy/pending payloads in a batch ON the thread that
+    built it. The fusion window is per-thread: a Tensor whose value is
+    still pending in the prefetch thread's window must not cross the
+    queue, or the consumer would flush (and race) another thread's
+    capture context mid-record."""
+    if isinstance(obj, Tensor):
+        obj._value       # property read = the window's sync point
+        return obj
+    if isinstance(obj, (tuple, list)):
+        for o in obj:
+            _materialize_tree(o)
+        return obj
+    if isinstance(obj, dict):
+        for v in obj.values():
+            _materialize_tree(v)
+        return obj
+    return obj
+
+
 def _mp_worker_loop(dataset, collate, index_q, data_q):
     """Worker process body (dataloader_iter.py:368 analog): pull batch
     index lists, build + collate the batch host-side, push numpy."""
@@ -337,7 +358,7 @@ class DataLoader:
         def worker():
             try:
                 for item in self._produce():
-                    q.put(item)
+                    q.put(_materialize_tree(item))
             except Exception as e:  # pragma: no cover
                 err.append(e)
             finally:
@@ -417,6 +438,70 @@ class DataLoader:
                     p.terminate()
             for p in procs:
                 p.join(timeout=5)
+
+
+class DevicePrefetcher:
+    """Double-buffered host→device input feed.
+
+    Wraps any iterator of batches (numpy arrays, Tensors, or nested
+    tuples/lists/dicts of them — a DataLoader, a NativeTokenLoader, a
+    generator) and keeps the next `depth` batches' host→device
+    transfers IN FLIGHT while the current step executes: `jax.device_put`
+    is async under PJRT, so issuing it a batch early overlaps the PCIe/
+    ICI copy with step N's compute instead of serializing it into step
+    N+1's dispatch (`FLAGS_prefetch_depth`, default 2, is the classic
+    double buffer; 0/1 degrades to synchronous placement).
+
+    The span budget shows this as host-gap time: with per-step input
+    feed the gap carries the transfer, with the prefetcher it rides
+    under `segment::execute`/device time. Used by the bench input path
+    and available for any training loop::
+
+        for tokens, labels in DevicePrefetcher(loader):
+            loss = train_step(tokens, labels)
+    """
+
+    def __init__(self, source, depth: int = None):
+        from .._core.flags import flag_value
+        self._source = iter(source)
+        self._depth = flag_value("FLAGS_prefetch_depth") \
+            if depth is None else int(depth)
+
+    @staticmethod
+    def _to_device(obj):
+        import jax
+        if isinstance(obj, Tensor):
+            # a Tensor batch already landed (or is lazily pending);
+            # touch nothing — placement was the loader's job
+            return obj
+        if isinstance(obj, np.ndarray):
+            return Tensor(jax.device_put(obj))
+        if isinstance(obj, (tuple, list)):
+            return type(obj)(DevicePrefetcher._to_device(o) for o in obj)
+        if isinstance(obj, dict):
+            return {k: DevicePrefetcher._to_device(v)
+                    for k, v in obj.items()}
+        return obj
+
+    def __iter__(self):
+        depth = max(self._depth, 1)
+        import collections
+        ring = collections.deque()
+        it = self._source
+        try:
+            while True:
+                while len(ring) < depth:
+                    try:
+                        # device_put returns immediately; the transfer
+                        # proceeds while earlier batches compute
+                        ring.append(self._to_device(next(it)))
+                    except StopIteration:
+                        break
+                if not ring:
+                    return
+                yield ring.popleft()
+        finally:
+            ring.clear()
 
 
 from .token_feed import NativeTokenLoader  # noqa: E402,F401

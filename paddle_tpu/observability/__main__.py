@@ -1,18 +1,33 @@
 """CLI: run a small workload with telemetry on and print the stats.
 
-    python -m paddle_tpu.observability [--model chain|lenet] [--steps N]
-                                       [--json] [--trace PATH] [--flight]
+    python -m paddle_tpu.observability [stats|budget]
+        [--model chain|lenet|resnet50|gpt2] [--steps N]
+        [--json] [--trace PATH] [--flight] [--async-flush]
 
-`chain` (default) is the dispatch microbench's elementwise chain —
-fast, exercises segment record/flush/cache. `lenet` runs real train
-steps through the whole-step fusion path (step cache, fused optimizer).
-`--trace PATH` additionally records the run under a fused-runtime
-profiler session and exports the chrome trace there. Exit code 0.
+Modes:
+
+- ``stats`` (default): run the workload with metrics on, print the
+  registry snapshot (counters / derived rates / histograms).
+- ``budget``: the per-step time-budget profile — spans aggregated into
+  a ranked table (segment flush/compile/execute, sot::, optimizer::,
+  comm::, plus the unspanned **host gap**), the measurement that
+  decides which hot-path item to burn next (observability/budget.py).
+
+`chain` is the dispatch microbench's elementwise chain — fast,
+exercises segment record/flush/cache. `lenet` runs real train steps
+through the whole-step fusion path (step cache, fused optimizer).
+`resnet50` / `gpt2` run the eager dygraph train loops of the bench
+models (batch via BUDGET_BATCH, default small — sized for a quick
+profile, not a benchmark). `--trace PATH` additionally records the run
+under a fused-runtime profiler session and exports the chrome trace.
+`--async-flush` turns the async dispatch pipeline on for the run so
+before/after budgets come from one command. Exit code 0.
 """
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
 
@@ -28,24 +43,118 @@ def _run_chain(steps: int):
         np.asarray(y._value)
 
 
-def _run_lenet(steps: int):
+def _train_loop(model, opt, x, y, loss_fn):
+    import numpy as np
+
+    def one():
+        loss = loss_fn(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        np.asarray(loss._value)
+    return one
+
+
+def _lenet_step():
+    """LeNet train step fed through the REAL input path — a DataLoader
+    wrapped in DevicePrefetcher (FLAGS_prefetch_depth double buffer) —
+    so the budget's host gap includes input feed the way a training
+    loop pays it. Also the workload bench row 9 snapshots."""
     import numpy as np
     import paddle_tpu as paddle
     import paddle_tpu.nn.functional as F
+    from paddle_tpu.io import DataLoader, Dataset, DevicePrefetcher
     from paddle_tpu.vision.models import LeNet
 
     paddle.seed(0)
     model = LeNet()
     opt = paddle.optimizer.Adam(1e-3, parameters=model.parameters())
     rng = np.random.RandomState(0)
-    x = paddle.to_tensor(rng.randn(32, 1, 28, 28).astype(np.float32))
-    y = paddle.to_tensor(rng.randint(0, 10, (32,)).astype(np.int64))
-    for _ in range(steps):
+    b = int(os.environ.get("BUDGET_BATCH", "32"))
+    xs = rng.randn(4 * b, 1, 28, 28).astype(np.float32)
+    ys = rng.randint(0, 10, (4 * b,)).astype(np.int64)
+
+    class _Synth(Dataset):
+        def __len__(self):
+            return len(xs)
+
+        def __getitem__(self, i):
+            return xs[i], ys[i]
+
+    def batches():
+        while True:
+            for xb, yb in DevicePrefetcher(
+                    DataLoader(_Synth(), batch_size=b, drop_last=True)):
+                yield xb, yb
+
+    feed = batches()
+
+    def one():
+        x, y = next(feed)
         loss = F.cross_entropy(model(x), y)
         loss.backward()
         opt.step()
         opt.clear_grad()
         np.asarray(loss._value)
+    return one
+
+
+def _resnet50_step():
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.vision.models import resnet50
+
+    paddle.seed(0)
+    model = resnet50()
+    opt = paddle.optimizer.Momentum(0.1, parameters=model.parameters())
+    rng = np.random.RandomState(0)
+    b = int(os.environ.get("BUDGET_BATCH", "4"))
+    x = paddle.to_tensor(rng.randn(b, 3, 224, 224).astype(np.float32))
+    y = paddle.to_tensor(rng.randint(0, 1000, (b,)).astype(np.int64))
+    return _train_loop(model, opt, x, y, F.cross_entropy)
+
+
+def _gpt2_step():
+    """Eager dygraph GPT train step (the fusion-window path — the
+    compiled functional trainer bench.py measures has no per-op host
+    work to budget). Layer count/width via BUDGET_GPT_LAYERS/HIDDEN."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import (GPTConfig, GPTForPretraining,
+                                       GPTPretrainingCriterion)
+
+    paddle.seed(0)
+    cfg = GPTConfig(
+        vocab_size=1024,
+        hidden_size=int(os.environ.get("BUDGET_GPT_HIDDEN", "128")),
+        num_layers=int(os.environ.get("BUDGET_GPT_LAYERS", "4")),
+        num_heads=4, dtype="float32", use_flash_attention=False,
+        max_position_embeddings=int(os.environ.get("BUDGET_GPT_SEQ",
+                                                   "128")))
+    model = GPTForPretraining(cfg)
+    crit = GPTPretrainingCriterion()
+    opt = paddle.optimizer.AdamW(1e-4, parameters=model.parameters())
+    rng = np.random.RandomState(0)
+    b = int(os.environ.get("BUDGET_BATCH", "2"))
+    seq = cfg.max_position_embeddings
+    x = paddle.to_tensor(rng.randint(0, cfg.vocab_size,
+                                     (b, seq)).astype(np.int64))
+    y = paddle.to_tensor(rng.randint(0, cfg.vocab_size,
+                                     (b, seq)).astype(np.int64))
+
+    def one():
+        logits = model(x)
+        loss = crit(logits, y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        np.asarray(loss._value)
+    return one
+
+
+_MODELS = {"chain": None, "lenet": _lenet_step,
+           "resnet50": _resnet50_step, "gpt2": _gpt2_step}
 
 
 def _render(snap: dict) -> str:
@@ -73,23 +182,53 @@ def _render(snap: dict) -> str:
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m paddle_tpu.observability")
+    ap.add_argument("mode", nargs="?", default="stats",
+                    choices=("stats", "budget"),
+                    help="stats = registry snapshot; budget = ranked "
+                         "per-step time-budget table")
     ap.add_argument("--model", default="chain",
-                    choices=("chain", "lenet"))
+                    choices=tuple(_MODELS))
     ap.add_argument("--steps", type=int, default=10)
     ap.add_argument("--json", action="store_true",
-                    help="print the stats snapshot as JSON")
+                    help="print the result as JSON")
     ap.add_argument("--trace", default=None, metavar="PATH",
                     help="also export a fused-runtime chrome trace")
     ap.add_argument("--flight", action="store_true",
                     help="enable the flight recorder and print the ring")
+    ap.add_argument("--async-flush", action="store_true",
+                    help="run with FLAGS_async_flush on (before/after "
+                         "budget comparisons from one command)")
     args = ap.parse_args(argv)
 
     import paddle_tpu as paddle
     from paddle_tpu import observability as obs
 
+    if args.async_flush:
+        paddle.set_flags({"FLAGS_async_flush": True})
+
+    if args.mode == "budget":
+        from paddle_tpu.observability import budget as _budget
+        make = _MODELS[args.model]
+        step = (lambda: _run_chain(1)) if make is None else make()
+        out = _budget.collect(step, steps=args.steps)
+        out["model"] = args.model
+        out["async_flush"] = bool(args.async_flush)
+        print(json.dumps(out) if args.json
+              else _budget.render(out, f"per-step budget [{args.model}]"))
+        from paddle_tpu._core import async_flush
+        async_flush.drain()
+        return 0
+
     obs.enable(flight_recorder=args.flight or None)
     obs.reset()
-    run = _run_lenet if args.model == "lenet" else _run_chain
+    if args.model == "chain":
+        run = _run_chain
+    else:
+        step = _MODELS[args.model]()
+
+        def run(steps):
+            for _ in range(steps):
+                step()
 
     if args.trace:
         from paddle_tpu.profiler import Profiler, ProfilerTarget
@@ -101,6 +240,11 @@ def main(argv=None) -> int:
     else:
         run(args.steps)
 
+    # land any in-flight async flushes BEFORE snapshotting: counters
+    # mid-flight would under-report, and an unread worker failure must
+    # fail the command, not vanish into the atexit shutdown
+    from paddle_tpu._core import async_flush
+    async_flush.drain()
     snap = obs.stats()
     print(json.dumps(snap) if args.json else _render(snap))
     if args.flight:

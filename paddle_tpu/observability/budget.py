@@ -1,0 +1,153 @@
+"""Per-step time-budget profile: where a train step's host time goes.
+
+Spends the PR-3 telemetry the way the flat-bench rounds demanded: run a
+workload with metrics on, take the span-histogram delta, and rank every
+instrumented component (segment flush / compile / execute, per-op
+replay, SOT guard evaluation, optimizer fused step, collectives,
+resilience) against the measured wall time per step. Whatever the spans
+do NOT account for is the **host gap** — Python dispatch, input feed,
+cache-key hashing, autograd glue, and device wait — i.e. exactly the
+overhead class "Exploring the limits of Concurrency in ML Training on
+Google TPUs" (2011.03641) fingers once the accelerator is saturated.
+
+`segment::flush` brackets its compile/execute children, so the table
+reports the flush ENTRY as exclusive scheduling overhead
+(flush − compile − execute − replay) to keep the ranking additive.
+
+    python -m paddle_tpu.observability budget --model lenet --steps 20
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional
+
+# known histogram -> (display name, parent whose span brackets this
+# one). Children subtract out of their parent so the ranked entries sum
+# to the accounted total without double counting; any other *_us
+# histogram (comm.<op>_us, resilience.*) gets its own top-level row.
+_KNOWN = {
+    "segment.flush_us": ("segment::flush (scheduling)", None),
+    "segment.compile_us": ("segment::compile", "segment.flush_us"),
+    "segment.execute_us": ("segment::execute", "segment.flush_us"),
+    "segment.replay_per_op_us": ("segment::replay_per_op", None),
+    "optimizer.step_us": ("optimizer::fused_step", None),
+    "sot.guard_eval_us": ("sot::guard_eval", None),
+}
+
+
+def collect(run_fn: Callable[[], None], steps: int,
+            warmup: int = 3) -> Dict:
+    """Run `run_fn` (ONE step per call) `steps` times with metrics on
+    and return the ranked per-step budget dict. Compile warms up
+    off-clock so the budget describes the steady state; the compile
+    rows of the ranked table then show residual (cache-miss) compiles
+    only."""
+    from . import enable, disable, stats
+    from .._core.flags import flag_value
+
+    for _ in range(warmup):
+        run_fn()
+    was_on = flag_value("FLAGS_observability")
+    enable()
+    # delta against a pre-run snapshot, NOT reset(): a session that
+    # already has observability on (bench rows freeze-asserting
+    # counters around this call) must not have its registry wiped
+    before = stats()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        run_fn()
+    wall_us = (time.perf_counter() - t0) * 1e6
+    snap = _delta(before, stats())
+    if not was_on:
+        disable()
+    return _rank(snap, wall_us, steps)
+
+
+def _delta(before: Dict, after: Dict) -> Dict:
+    b_hists = before.get("histograms", {})
+    hists = {}
+    for k, h in after.get("histograms", {}).items():
+        bh = b_hists.get(k, {})
+        hists[k] = {"total": (h.get("total") or 0.0)
+                    - (bh.get("total") or 0.0),
+                    "count": (h.get("count") or 0)
+                    - (bh.get("count") or 0)}
+    b_ctrs = before.get("counters", {})
+    counters = {k: v - b_ctrs.get(k, 0)
+                for k, v in after.get("counters", {}).items()}
+    return {"histograms": hists, "counters": counters,
+            "step_cache_hit_rate": after.get("step_cache_hit_rate")}
+
+
+def _rank(snap: Dict, wall_us: float, steps: int) -> Dict:
+    hists = snap.get("histograms", {})
+    entries: List[Dict] = []
+    accounted = 0.0
+    for hist, h in hists.items():
+        if not hist.endswith("_us"):
+            continue
+        total, count = (h.get("total") or 0.0), (h.get("count") or 0)
+        if not count and not total:
+            continue
+        name, parent = _KNOWN.get(hist, (hist[:-3].replace(".", "::"),
+                                         None))
+        entries.append({"name": name, "hist": hist,
+                        "us_per_step": total / steps,
+                        "calls_per_step": count / steps,
+                        "_parent": parent})
+    # make parents exclusive
+    for e in entries:
+        child_sum = sum(c["us_per_step"] for c in entries
+                        if c["_parent"] == e["hist"])
+        if child_sum:
+            e["us_per_step"] = max(e["us_per_step"] - child_sum, 0.0)
+    for e in entries:
+        e.pop("_parent", None)
+        accounted += e["us_per_step"]
+    wall_per_step = wall_us / steps
+    host_gap = max(wall_per_step - accounted, 0.0)
+    entries.append({"name": "host gap (dispatch / input feed / "
+                            "device wait — unspanned)",
+                    "hist": None, "us_per_step": host_gap,
+                    "calls_per_step": None})
+    entries.sort(key=lambda e: -e["us_per_step"])
+    for e in entries:
+        e["pct_of_step"] = round(100.0 * e["us_per_step"] / wall_per_step,
+                                 2) if wall_per_step else None
+        e["us_per_step"] = round(e["us_per_step"], 2)
+        if e["calls_per_step"] is not None:
+            e["calls_per_step"] = round(e["calls_per_step"], 3)
+    counters = snap.get("counters", {})
+    return {
+        "steps": steps,
+        "wall_us_per_step": round(wall_per_step, 2),
+        "accounted_us_per_step": round(accounted, 2),
+        "host_gap_us_per_step": round(host_gap, 2),
+        # span time in excess of wall time = work that ran CONCURRENTLY
+        # with the step loop (the async flush worker's lane) — the
+        # direct evidence the pipeline took dispatch off the critical
+        # path rather than merely relabeling it
+        "overlap_us_per_step": round(max(accounted - wall_per_step, 0.0),
+                                     2),
+        "entries": entries,
+        "counters": {k: counters[k] for k in sorted(counters)
+                     if k.startswith(("segment.", "cache.", "compiles.",
+                                      "optimizer.", "sot.", "eager."))},
+        "step_cache_hit_rate": snap.get("step_cache_hit_rate"),
+    }
+
+
+def render(budget: Dict, title: str = "per-step budget") -> str:
+    lines = [f"== {title} ==",
+             f"  wall/step:      {budget['wall_us_per_step']:>12.1f} us",
+             f"  accounted:      {budget['accounted_us_per_step']:>12.1f}"
+             f" us",
+             f"  host gap:       {budget['host_gap_us_per_step']:>12.1f}"
+             f" us",
+             "  ranked components:"]
+    for e in budget["entries"]:
+        calls = ("" if e["calls_per_step"] is None
+                 else f"  x{e['calls_per_step']:g}/step")
+        lines.append(f"    {e['us_per_step']:>10.1f} us "
+                     f"{e['pct_of_step']:>6.2f}%  {e['name']}{calls}")
+    return "\n".join(lines)

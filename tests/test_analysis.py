@@ -673,35 +673,63 @@ def test_view_of_mutated_base_warns_in_strict_mode():
 
 def test_dead_capture_reported_with_waste_estimate():
     x = _x(seed=25)
+    with _with_flag("FLAGS_dead_capture_min_flops", 0), \
+            _with_flag("FLAGS_dead_capture_min_bytes", 0):
+        with lazy.lazy_guard() as ctx:
+            y = x * 2.0
+            z = paddle.matmul(x, x)  # dead: dropped before any read
+            del z
+            report = check_segment(ctx)
+            diags = report.by_checker("dead_capture")
+            assert diags, report.render()
+            d = diags[0]
+            assert "never materialized" in d.message
+            assert d.op_name == "matmul"
+            assert d.data["flops"] == 2 * 4 * 4 * 4   # 2*M*N*K
+            assert d.data["bytes"] == 4 * 4 * 4
+            assert d.provenance and "test_analysis.py" in d.provenance
+            ctx._reset_segment()
+
+
+def test_dead_capture_cost_floor():
+    """Cost-aware threshold: dead scalar bookkeeping below BOTH floors
+    is not reported (the user cannot act on it), while waste above the
+    FLOPs floor still is — with the default floors live."""
+    x = _x(seed=42)
     with lazy.lazy_guard() as ctx:
         y = x * 2.0
-        z = paddle.matmul(x, x)      # dead: dropped before any read
+        z = x + 5.0                  # dead: 16 FLOPs / 64 bytes
+        del z
+        report = check_segment(ctx)
+        assert report.by_checker("dead_capture") == [], report.render()
+        ctx._reset_segment()
+    big = paddle.to_tensor(np.ones((64, 64), "float32"))
+    with lazy.lazy_guard() as ctx:
+        y = big * 2.0
+        z = paddle.matmul(big, big)  # dead: 2*64^3 FLOPs >> floor
         del z
         report = check_segment(ctx)
         diags = report.by_checker("dead_capture")
         assert diags, report.render()
-        d = diags[0]
-        assert "never materialized" in d.message
-        assert d.op_name == "matmul"
-        assert d.data["flops"] == 2 * 4 * 4 * 4   # 2*M*N*K
-        assert d.data["bytes"] == 4 * 4 * 4
-        assert d.provenance and "test_analysis.py" in d.provenance
+        assert diags[0].data["flops"] >= 2 * 64 * 64 * 64
         ctx._reset_segment()
 
 
 def test_dead_capture_fix_prunes_and_recheck_clean():
     from paddle_tpu.analysis import fix_segment
     x = _x(seed=26)
-    with lazy.lazy_guard() as ctx:
-        y = x * 2.0
-        z = x + 5.0
-        del z
-        report = check_segment(ctx)
-        assert report.by_checker("dead_capture")
-        result, post = fix_segment(ctx, report)
-        assert any("prune" in a for a in result.actions)
-        assert post.ok, post.render()
-        assert len(ctx.pending) == 1      # only the multiply survives
+    with _with_flag("FLAGS_dead_capture_min_flops", 0), \
+            _with_flag("FLAGS_dead_capture_min_bytes", 0):
+        with lazy.lazy_guard() as ctx:
+            y = x * 2.0
+            z = x + 5.0
+            del z
+            report = check_segment(ctx)
+            assert report.by_checker("dead_capture")
+            result, post = fix_segment(ctx, report)
+            assert any("prune" in a for a in result.actions)
+            assert post.ok, post.render()
+            assert len(ctx.pending) == 1   # only the multiply survives
     np.testing.assert_allclose(y.numpy(), x.numpy() * 2.0, rtol=1e-6)
 
 
@@ -709,7 +737,9 @@ def test_fix_mode_flush_prunes_dead_captures():
     from paddle_tpu.analysis.hooks import fixes_applied
     x = _x(seed=27)
     before = fixes_applied()
-    with _with_flag("FLAGS_static_checks", "fix"):
+    with _with_flag("FLAGS_static_checks", "fix"), \
+            _with_flag("FLAGS_dead_capture_min_flops", 0), \
+            _with_flag("FLAGS_dead_capture_min_bytes", 0):
         with lazy.lazy_guard() as ctx:
             y = x * 3.0
             z = x + 7.0
@@ -1047,9 +1077,11 @@ def test_cli_fix_dry_run_prints_diff(capsys):
 
 
 def _dead_capture_build():
-    x = _x(seed=32)
+    # sized above the cost-aware floor (2*64^3 FLOPs) so the lint still
+    # fires with the default FLAGS_dead_capture_min_flops/bytes live
+    x = paddle.to_tensor(np.full((64, 64), 1.5, "float32"))
     y = x * 2.0
-    z = x + 9.0        # dead: dropped before any read
+    z = paddle.matmul(x, x)      # dead: dropped before any read
     del z
     return y
 

@@ -1,0 +1,292 @@
+"""Async dispatch pipeline (FLAGS_async_flush) — determinism, error
+deferral, rollback draining, sanitizer coverage, and shutdown hygiene.
+
+The acceptance contract of the async flush executor (_core/async_flush
++ the CaptureContext._flush_async path):
+
+- bit-exact parity: the SAME losses and parameters as the synchronous
+  path on a real train loop (the pipeline may only move work in time,
+  never change it);
+- off-thread failures re-raise at the next sync point — injected
+  segment::compile faults keep their type (rollback retry-ability),
+  sanitizer error-mode trips keep StaticCheckError, anything else
+  surfaces as EnforceNotMet;
+- ElasticStep drains in-flight flushes before snapshot/restore so a
+  worker job can never land into rolled-back state;
+- the executor drains at shutdown without leaking its worker thread.
+"""
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from conftest import with_flag
+from paddle_tpu._core import async_flush, lazy
+from paddle_tpu._core.flags import set_flags
+
+
+@pytest.fixture
+def async_mode():
+    """Async flush on, with a small segment cap so real workloads seal
+    multiple in-flight segments mid-record; everything restored (and
+    the pipeline drained) on exit."""
+    set_flags({"FLAGS_async_flush": True,
+               "FLAGS_lazy_max_segment_ops": 16})
+    try:
+        yield
+    finally:
+        async_flush.drain(raise_latched=False)
+        set_flags({"FLAGS_async_flush": False,
+                   "FLAGS_lazy_max_segment_ops": 256})
+
+
+def _lenet_losses_params(steps=4):
+    paddle.seed(0)
+    from paddle_tpu.vision.models import LeNet
+    model = LeNet()
+    opt = paddle.optimizer.Adam(1e-3, parameters=model.parameters())
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(8, 1, 28, 28).astype(np.float32))
+    y = paddle.to_tensor(rng.randint(0, 10, (8,)).astype(np.int64))
+    losses = []
+    for _ in range(steps):
+        loss = F.cross_entropy(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(np.asarray(loss._value).copy())
+    return losses, [np.asarray(p._value).copy()
+                    for p in model.parameters()]
+
+
+def test_async_on_off_bit_exact_lenet():
+    """The satellite determinism contract: async on vs off is BIT-exact
+    on the LeNet train loop — same segment programs, same order, same
+    numerics; the pipeline only overlaps them with recording."""
+    with with_flag("FLAGS_lazy_max_segment_ops", 24):
+        l_sync, p_sync = _lenet_losses_params()
+        with with_flag("FLAGS_async_flush", True):
+            l_async, p_async = _lenet_losses_params()
+        async_flush.drain()
+    assert all((a == b).all() for a, b in zip(l_sync, l_async))
+    assert all((a == b).all() for a, b in zip(p_sync, p_async))
+
+
+def test_async_chain_matches_sync_and_overlaps(async_mode):
+    x = paddle.to_tensor(np.full((8, 8), 1.25, "float32"))
+    y = x
+    for _ in range(40):                 # 40 ops: seals 2+ async segments
+        y = y * 1.01 + 0.001
+    # metadata reads answer from the pending aval without blocking
+    assert y.shape == [8, 8]
+    got = np.asarray(y._value)
+    set_flags({"FLAGS_async_flush": False})
+    z = x
+    for _ in range(40):
+        z = z * 1.01 + 0.001
+    np.testing.assert_array_equal(got, np.asarray(z._value))
+
+
+def test_backward_through_async_segments(async_mode):
+    """Grad registration happens at seal time; backward resolves the
+    saved pending residuals — grads match the synchronous path."""
+    def run():
+        w = paddle.to_tensor(np.full((4, 4), 0.5, "float32"),
+                             stop_gradient=False)
+        z = w
+        for _ in range(24):
+            z = z * 1.1 + 0.1
+        z.sum().backward()
+        return np.asarray(w.grad._value).copy()
+    g_async = run()
+    set_flags({"FLAGS_async_flush": False})
+    g_sync = run()
+    np.testing.assert_array_equal(g_async, g_sync)
+
+
+def test_injected_compile_fault_defers_with_type(async_mode):
+    """An injected segment::compile fault on the worker re-raises AS
+    TransientFault at the sync point — the retryable class rollback
+    depends on."""
+    from paddle_tpu.distributed.resilience.faults import TransientFault
+    lazy.clear_segment_cache()
+    with with_flag("FLAGS_fault_inject", "segment::compile=fail"):
+        x = paddle.to_tensor(np.ones((3, 3), "float32"))
+        z = x
+        for _ in range(20):
+            z = z * 1.125 + 0.25
+        with pytest.raises(TransientFault):
+            float(z.sum())
+    async_flush.drain(raise_latched=False)
+
+
+def test_generic_worker_failure_surfaces_as_enforce(async_mode,
+                                                   monkeypatch):
+    """A non-framework failure off-thread (a real compile blowup)
+    surfaces as EnforceNotMet at the sync point, original chained."""
+    from paddle_tpu.base.core import EnforceNotMet
+
+    def boom(pending, live):
+        raise ValueError("synthetic compile failure")
+
+    lazy.clear_segment_cache()
+    monkeypatch.setattr(lazy, "_build_segment_fn", boom)
+    x = paddle.to_tensor(np.ones((3, 3), "float32"))
+    z = x
+    for _ in range(20):
+        z = z * 2.0 + 1.0
+    with pytest.raises(EnforceNotMet) as ei:
+        float(z.sum())
+    assert isinstance(ei.value.__cause__, ValueError)
+    async_flush.drain(raise_latched=False)
+
+
+def test_sanitizer_error_mode_defers_static_check_error(async_mode):
+    """The flush sweep runs ON the worker; an error-mode violation in a
+    cap-sealed segment re-raises as StaticCheckError at the sync
+    point."""
+    from paddle_tpu.analysis import StaticCheckError
+    x = paddle.to_tensor(np.ones((4, 4), "float32"))
+    with with_flag("FLAGS_static_checks", "error"):
+        with lazy.lazy_guard(max_segment_ops=8) as ctx:
+            y = x * 2.0
+            x._inplace_version += 1   # seed: in-place race, no note
+            try:
+                z = y
+                for _ in range(10):   # cross the cap: async seal+sweep
+                    z = z * 1.5
+                with pytest.raises(StaticCheckError):
+                    np.asarray(z._value)
+            finally:
+                x._inplace_version = 0
+                ctx._reset_segment()
+    async_flush.drain(raise_latched=False)
+
+
+def test_sanitizer_warn_sweep_covers_async_flushes(async_mode):
+    """Warn mode sweeps async-sealed segments too (off the recording
+    thread): the sweep counter advances by the async flush."""
+    from paddle_tpu.analysis import hooks
+    with with_flag("FLAGS_static_checks", "warn"):
+        before = hooks.segment_sweeps()
+        x = paddle.to_tensor(np.ones((4, 4), "float32"))
+        z = x
+        for _ in range(40):
+            z = z * 1.01
+        np.asarray(z._value)
+        async_flush.drain()
+        assert hooks.segment_sweeps() > before
+
+
+def test_elastic_rollback_drains_inflight_flushes(async_mode):
+    """ElasticStep under async: an injected step failure rolls back,
+    the pipeline is drained before snapshot AND restore, and the
+    retried run finishes bit-exact vs the fault-free loop."""
+    from paddle_tpu.distributed.resilience import ElasticStep
+
+    def train(fault: bool):
+        paddle.seed(7)
+        from paddle_tpu.vision.models import LeNet
+        model = LeNet()
+        opt = paddle.optimizer.Adam(1e-3,
+                                    parameters=model.parameters())
+        rng = np.random.RandomState(7)
+        x = paddle.to_tensor(rng.randn(8, 1, 28, 28).astype(np.float32))
+        y = paddle.to_tensor(rng.randint(0, 10, (8,)).astype(np.int64))
+        elastic = ElasticStep(optimizer=opt)
+
+        def step():
+            loss = F.cross_entropy(model(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss._value
+
+        if fault:
+            set_flags({"FLAGS_fault_inject": "step::2=fail"})
+        try:
+            losses = [np.asarray(elastic.run(step)).copy()
+                      for _ in range(3)]
+        finally:
+            set_flags({"FLAGS_fault_inject": ""})
+        return losses
+
+    faulty = train(fault=True)
+    clean = train(fault=False)
+    assert all((a == b).all() for a, b in zip(faulty, clean))
+
+
+def test_executor_drains_and_shuts_down_clean(async_mode):
+    x = paddle.to_tensor(np.ones((4, 4), "float32"))
+    z = x
+    for _ in range(40):
+        z = z * 1.001
+    np.asarray(z._value)
+    async_flush.drain()
+    ex = async_flush.get_executor()
+    assert ex.inflight() == 0
+    async_flush.shutdown()
+    assert not any(t.name == async_flush._WORKER_NAME
+                   for t in threading.enumerate()), \
+        "flush worker thread leaked past shutdown"
+    # the pipeline restarts cleanly after a shutdown
+    z = x
+    for _ in range(20):
+        z = z * 1.002
+    np.asarray(z._value)
+    async_flush.drain()
+
+
+def test_device_prefetcher_order_and_depth():
+    """DevicePrefetcher yields every batch in order, converts numpy
+    leaves to Tensors, and honors depth=1 (degraded synchronous)."""
+    from paddle_tpu.io import DevicePrefetcher
+    batches = [(np.full((2, 2), i, "float32"),
+                np.array([i], "int64")) for i in range(6)]
+    for depth in (1, 2, 4):
+        out = list(DevicePrefetcher(iter(batches), depth=depth))
+        assert len(out) == 6
+        for i, (a, b) in enumerate(out):
+            assert float(a._value[0, 0]) == float(i)
+            assert int(b._value[0]) == i
+
+
+def test_async_off_leaves_sync_path_untouched():
+    """With the flag off (the default), no executor is ever created by
+    a plain workload — the off path pays nothing."""
+    async_flush.shutdown()
+    x = paddle.to_tensor(np.ones((4, 4), "float32"))
+    z = x
+    for _ in range(20):
+        z = z * 1.003
+    np.asarray(z._value)
+    assert async_flush._EXECUTOR is None
+
+
+def test_executor_backpressure_bounds_inflight():
+    """submit() blocks once _MAX_INFLIGHT jobs are queued/running (the
+    run-ahead memory bound) and wakes as the worker drains; shutdown
+    wakes blocked submitters too."""
+    import time
+
+    ex = async_flush.FlushExecutor(max_inflight=2)
+    gate = threading.Event()
+    for _ in range(2):
+        ex.submit(lambda: gate.wait(10))
+    unblocked = []
+
+    def third():
+        ex.submit(lambda: None)
+        unblocked.append(True)
+
+    th = threading.Thread(target=third, daemon=True)
+    th.start()
+    time.sleep(0.2)
+    assert not unblocked, "3rd submit should block on backpressure"
+    gate.set()
+    th.join(10)
+    assert unblocked, "submit never released after the worker drained"
+    ex.drain()
+    ex.shutdown()

@@ -141,3 +141,73 @@ def test_engine_steps_per_epoch_and_validation():
                       epochs=3, steps_per_epoch=2, valid_freq=1)
     assert len(hist["loss"]) == 6        # 2 steps x 3 epochs
     assert len(hist["eval_loss"]) == 3   # validated each epoch
+
+
+def test_elastic_eviction_debounce():
+    """PR-6 drill learning folded back: a membership eviction needs N
+    CONSECUTIVE stale/missed heartbeat probes (FLAGS_elastic_
+    eviction_debounce) — one starved scan must not publish a
+    member::leave epoch. A node never seen alive gets no grace."""
+    import json as _json
+
+    from paddle_tpu.distributed.fleet.elastic import ElasticManager
+
+    class FakeStore:
+        def __init__(self):
+            self.data = {}
+
+        def try_get(self, key, timeout=None):
+            return self.data.get(key)
+
+        def set(self, key, val):
+            self.data[key] = val.encode() if isinstance(val, str) else val
+
+    store = FakeStore()
+    mgr = ElasticManager("master", store, heartbeat_interval=0.01,
+                         node_timeout=10.0, eviction_debounce=3)
+    mgr._known = {"a", "b"}
+
+    def beat(node):
+        store.set(f"__elastic/node/{node}",
+                  _json.dumps({"t": time.time()}))
+
+    beat("a")
+    beat("b")
+    last = mgr._scan_alive([])
+    assert last == ["a", "b"]
+
+    # b's heartbeat goes stale: two scans of grace, evicted on the 3rd
+    del store.data["__elastic/node/b"]
+    beat("a")
+    assert mgr._scan_alive(last) == ["a", "b"]     # miss 1: debounced
+    assert mgr._scan_alive(last) == ["a", "b"]     # miss 2: debounced
+    assert mgr._scan_alive(last) == ["a"]          # miss 3: evicted
+
+    # one good beat resets the miss counter entirely
+    beat("b")
+    last = mgr._scan_alive(last)
+    assert last == ["a", "b"]
+    del store.data["__elastic/node/b"]
+    beat("a")
+    assert mgr._scan_alive(last) == ["a", "b"]     # fresh grace again
+
+    # a node that was never in the membership gets no debounce grace
+    mgr2 = ElasticManager("m2", store, heartbeat_interval=0.01,
+                          node_timeout=10.0, eviction_debounce=3)
+    mgr2._known = {"a", "ghost"}
+    beat("a")
+    assert mgr2._scan_alive([]) == ["a"]
+
+    # default comes from the flag (legacy evict-on-first-miss at 1)
+    from conftest import with_flag
+    with with_flag("FLAGS_elastic_eviction_debounce", 1):
+        mgr3 = ElasticManager("m3", store, heartbeat_interval=0.01,
+                              node_timeout=10.0)
+        assert mgr3.eviction_debounce == 1
+        mgr3._known = {"a", "b"}
+        beat("a")
+        beat("b")
+        last3 = mgr3._scan_alive([])
+        del store.data["__elastic/node/b"]
+        beat("a")
+        assert mgr3._scan_alive(last3) == ["a"]    # first miss evicts

@@ -239,3 +239,62 @@ def test_ndarray_attr_digest_invalidates_on_mutation():
     s1 = _digest_array(small)
     small[1] = 7.0
     assert _digest_array(small) != s1
+
+
+def test_fusion_window_is_per_thread():
+    """Capture state is thread-local: two threads recording
+    concurrently must never interleave one segment's wiring (the
+    DataLoader-prefetch-thread corruption class). Each thread fuses
+    and materializes its own chain correctly."""
+    import threading
+
+    results = {}
+    errors = []
+
+    def worker(tag, base):
+        try:
+            t = paddle.to_tensor(np.full((8, 8), base, "float32"))
+            y = t
+            for _ in range(64):       # crosses the default segment cap
+                y = y + 1.0
+            results[tag] = np.asarray(y._value)[0, 0]
+        except Exception as e:        # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i, float(i * 100)))
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    for i in range(4):
+        assert results[i] == i * 100 + 64.0
+
+
+def test_threaded_dataloader_with_tensor_dataset_trains():
+    """Regression: a TensorDataset of live Tensors makes the loader's
+    prefetch THREAD record slice ops; with a process-global window this
+    interleaved two threads' records into one segment and corrupted
+    the wiring mid-train. Batches now materialize on the loader thread
+    and windows are per-thread."""
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.io import DataLoader, TensorDataset
+    from paddle_tpu.vision.models import LeNet
+
+    paddle.seed(0)
+    rng = np.random.RandomState(0)
+    ds = TensorDataset(
+        [paddle.to_tensor(rng.randn(64, 1, 28, 28).astype(np.float32)),
+         paddle.to_tensor(rng.randint(0, 10, (64,)).astype(np.int64))])
+    model = LeNet()
+    opt = paddle.optimizer.Adam(1e-3, parameters=model.parameters())
+    losses = []
+    for _ in range(2):
+        for x, y in DataLoader(ds, batch_size=32, drop_last=True):
+            loss = F.cross_entropy(model(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(np.asarray(loss._value)))
+    assert len(losses) == 4 and np.isfinite(losses).all()

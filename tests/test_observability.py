@@ -346,3 +346,44 @@ def test_stats_without_enable_is_well_formed():
     snap = obs.stats()
     assert set(snap) >= {"counters", "gauges", "histograms", "compiles",
                          "cache_hit_rate", "step_cache_hit_rate"}
+
+
+# -------------------------------------------------------------- budget
+
+def test_budget_mode_ranks_components(capsys):
+    """`python -m paddle_tpu.observability budget` aggregates the span
+    histograms into a ranked per-step table whose entries (incl. the
+    unspanned host gap) sum to the wall time."""
+    from paddle_tpu.observability.__main__ import main
+
+    assert main(["budget", "--model", "chain", "--steps", "3",
+                 "--json"]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["model"] == "chain" and out["steps"] == 3
+    assert out["wall_us_per_step"] > 0
+    names = [e["name"] for e in out["entries"]]
+    assert any("host gap" in n for n in names)
+    assert any(n.startswith("segment::") for n in names)
+    total = sum(e["us_per_step"] for e in out["entries"])
+    want = out["accounted_us_per_step"] + out["host_gap_us_per_step"]
+    assert abs(total - want) < max(1.0, 0.01 * want)
+    # ranked: descending per-step cost
+    costs = [e["us_per_step"] for e in out["entries"]]
+    assert costs == sorted(costs, reverse=True)
+    obs.reset()
+
+
+def test_budget_collect_restores_metrics_flag():
+    from paddle_tpu.observability import budget as budget_mod
+
+    x = paddle.to_tensor(np.ones((4, 4), "float32"))
+
+    def step():
+        np.asarray((x * 1.5)._value)
+
+    with with_flag("FLAGS_observability", False):
+        out = budget_mod.collect(step, steps=2, warmup=1)
+        assert not obs.enabled()       # collect turned it back off
+    assert out["wall_us_per_step"] > 0
+    assert out["host_gap_us_per_step"] <= out["wall_us_per_step"]
+    obs.reset()
