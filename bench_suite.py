@@ -36,8 +36,19 @@ Row 9  async dispatch pipeline         capped-chain speedup with
                                        leaked worker thread; row json
                                        carries the per-step budget
                                        snapshot (observability budget)
+Row 10 distributed telemetry plane   asserts the telemetry-off path
+                                     (WITH async flush on) writes zero
+                                     __telem/ store keys and freezes
+                                     every registry counter; reports
+                                     the per-step publication overhead
+                                     with telemetry on
 (Multi-chip GPT/ERNIE hybrids need a pod; their single-chip proxies are
 bench.py's headline + the dryrun_multichip compile check.)
+
+`--diff` mode: compare the newest two BENCH_*.json in the cwd and fail
+loudly (exit 1) on a >10% regression in any row present in both — so a
+drift like ResNet r05's 790->752 is caught mechanically, not by a
+reviewer squinting at tables.
 """
 from __future__ import annotations
 
@@ -555,12 +566,185 @@ def bench_async_flush():
             "budget": snapshot}
 
 
+def bench_telemetry():
+    """Row 10: distributed telemetry plane. Telemetry-off contract,
+    asserted EXACTLY (the rows-5..9 counter technique) with the async
+    flush pipeline ON — the plane must not smuggle work into either
+    path: (a) the registry's MUTATIONS counter stays frozen across a
+    dispatch chain + an ElasticStep-wrapped loop with a publisher
+    INITIALIZED but the flag off, and (b) the store holds zero
+    __telem/ keys afterwards (seq-key probe per rank). The reported
+    value is the publication overhead per step with telemetry on —
+    frame build cost on the training thread; the store set is
+    off-thread by construction."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu._core import async_flush
+    from paddle_tpu.distributed.resilience import ElasticStep
+    from paddle_tpu.distributed.store import TCPStore
+    from paddle_tpu.observability import distributed as dtel
+    from paddle_tpu.observability import metrics
+
+    x = paddle.to_tensor(np.ones((16, 16), "float32"))
+
+    def chain():
+        y = x
+        for _ in range(16):
+            y = y * 1.0001 + 0.0001
+        return y._value
+
+    w = paddle.to_tensor(np.zeros((8, 8), "float32"))
+    opt = paddle.optimizer.SGD(0.0, parameters=[w])
+    elastic = ElasticStep(optimizer=opt)
+
+    store = TCPStore("127.0.0.1", 0, is_master=True, world_size=1,
+                     timeout=10)
+    try:
+        pub = dtel.init(store, rank=0, world_size=1)
+        paddle.set_flags({"FLAGS_async_flush": True})
+        try:
+            _timeit(chain, steps=20, warmup=5)
+            _timeit(lambda: elastic.run(chain), steps=2, warmup=2)
+            async_flush.drain()
+            # -------- telemetry OFF: frozen counters, zero store keys
+            before = metrics.MUTATIONS
+            off_t = _timeit(lambda: elastic.run(chain), steps=50,
+                            warmup=0)
+            async_flush.drain()
+            assert metrics.MUTATIONS == before, \
+                "telemetry-off loop did registry work (must be 0)"
+            assert store.try_get("__telem/seq/0", timeout=0.05) \
+                is None, "telemetry-off loop wrote __telem/ store keys"
+            assert pub._seq == 0, \
+                "telemetry-off loop built frames (must be 0)"
+            # -------- telemetry ON: publication overhead per step
+            paddle.set_flags({"FLAGS_distributed_telemetry": True})
+            try:
+                on_t = _timeit(lambda: elastic.run(chain), steps=50,
+                               warmup=5)
+                pub.flush()
+            finally:
+                paddle.set_flags(
+                    {"FLAGS_distributed_telemetry": False})
+            assert pub._seq > 0 and \
+                store.try_get("__telem/seq/0") is not None, \
+                "telemetry-on loop never published a frame"
+        finally:
+            paddle.set_flags({"FLAGS_async_flush": False})
+            async_flush.drain(raise_latched=False)
+        snap = metrics.snapshot()["histograms"].get(
+            "telemetry.publish_us", {})
+        return {"metric": "distributed telemetry publication (chain "
+                          "elastic step; off = frozen counters + zero "
+                          "__telem/ store keys asserted, async flush "
+                          "on)",
+                "value": round((on_t - off_t) * 1e6, 2),
+                "unit": "us/step publication overhead",
+                "frames": pub._seq,
+                "publish_us_avg": (round(snap["total"] / snap["count"],
+                                         2) if snap.get("count")
+                                   else None)}
+    finally:
+        dtel.shutdown()
+        store.close()
+
+
+# ------------------------------------------------------------- diff mode
+
+def _rows_of(path: str) -> dict:
+    """metric -> (value, unit) extracted from one driver BENCH_*.json
+    (json lines live in its 'tail' string; the headline row carries
+    nested 'rows')."""
+    with open(path) as f:
+        doc = json.load(f)
+    out = {}
+
+    def adopt(obj):
+        if isinstance(obj, dict) and "metric" in obj \
+                and isinstance(obj.get("value"), (int, float)):
+            out[obj["metric"]] = (float(obj["value"]),
+                                  str(obj.get("unit", "")))
+        if isinstance(obj, dict):
+            for r in obj.get("rows", ()):
+                adopt(r)
+
+    for line in str(doc.get("tail", "")).splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            adopt(json.loads(line))
+        except ValueError:
+            continue
+    return out
+
+
+def _lower_is_better(metric: str, unit: str) -> bool:
+    """Direction from the UNIT first: a rate (tokens/s, images/s,
+    ops/s, 'x' speedup) is higher-is-better even when the metric NAME
+    says 'overhead' (row 4 reports dispatch overhead AS a rate). Only
+    unit-less cost words fall back to the name."""
+    u = unit.lower()
+    # a RATE unit ends its first token with '/s' (tokens/s, ops/s);
+    # 'us/step publication overhead' must not match
+    first = u.split()[0] if u.split() else ""
+    if first.endswith("/s") or u.startswith("x "):
+        return False
+    text = f"{metric} {u}".lower()
+    return any(w in text for w in ("overhead", "latency", "ms", "% "))
+
+
+def diff_mode(threshold: float = 0.10) -> int:
+    """Compare the newest two BENCH_*.json in the cwd; exit non-zero on
+    a >threshold regression in any metric present in both."""
+    import glob
+    # name order, not mtime: the driver writes BENCH_r<NN>.json with
+    # zero-padded round numbers; checkouts scramble mtimes
+    files = sorted(glob.glob("BENCH_*.json"))
+    if len(files) < 2:
+        print(f"bench --diff: need two BENCH_*.json, found {files}")
+        return 2
+    old_path, new_path = files[-2], files[-1]
+    old, new = _rows_of(old_path), _rows_of(new_path)
+    shared = [m for m in new if m in old and old[m][0]]
+    regressions = []
+    for m in shared:
+        ov, unit = old[m]
+        nv = new[m][0]
+        change = (nv - ov) / abs(ov)
+        worse = change > threshold if _lower_is_better(m, unit) \
+            else change < -threshold
+        mark = "REGRESSION" if worse else "ok"
+        print(f"  [{mark:>10}] {change * 100:+7.1f}%  {m}  "
+              f"({ov:g} -> {nv:g} {unit})")
+        if worse:
+            regressions.append(m)
+    print(f"bench --diff: {old_path} -> {new_path}, "
+          f"{len(shared)} shared row(s), "
+          f"{len(regressions)} regression(s)")
+    if not shared:
+        # a gate that compared nothing must not pass: zero shared rows
+        # means the BENCH format drifted (renamed 'tail', truncated
+        # file, re-worded metrics) — exactly when silent drift hides
+        print("FAILED: no shared rows — BENCH format drift?")
+        return 2
+    if regressions:
+        print("FAILED rows:\n  " + "\n  ".join(regressions))
+        return 1
+    return 0
+
+
 def main():
-    rows = os.environ.get("BENCH_ROWS", "1,2,3,4,5,6,7,8,9").split(",")
+    import sys
+    if "--diff" in sys.argv[1:]:
+        raise SystemExit(diff_mode())
+    rows = os.environ.get("BENCH_ROWS",
+                          "1,2,3,4,5,6,7,8,9,10").split(",")
     table = {"1": bench_lenet, "2": bench_resnet50, "3": bench_bert,
              "4": bench_dispatch, "5": bench_static_checks,
              "6": bench_observability, "7": bench_resilience,
-             "8": bench_replan, "9": bench_async_flush}
+             "8": bench_replan, "9": bench_async_flush,
+             "10": bench_telemetry}
     for r in rows:
         r = r.strip()
         out = table[r]()

@@ -178,6 +178,16 @@ class FlushExecutor:
             except BaseException as e:   # latched, surfaced at sync
                 with self._lock:
                     self._latched.append(e)
+                from ..observability import _state as _OBS
+                if _OBS.DIST:
+                    # a latched worker error is a postmortem trigger on
+                    # the distributed plane too: publish this rank's
+                    # ring now — by the time the error re-raises at the
+                    # sync point the ring may have wrapped past the
+                    # failing flush. Never raises.
+                    from ..observability import distributed as _dtel
+                    _dtel.trigger_postmortem(
+                        f"async_flush worker error: {e!r}")
                 if on_error is not None:
                     try:
                         on_error(e)

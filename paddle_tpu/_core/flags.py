@@ -385,6 +385,33 @@ define_flag("FLAGS_profiler_fused_runtime", False,
             "step, optimizer) the steady-state hot path actually runs.")
 
 # ---- observability (paddle_tpu.observability)
+define_flag("FLAGS_distributed_telemetry", False,
+            "Cross-rank telemetry plane: each rank periodically "
+            "publishes a compact frame (metrics/span-histogram deltas, "
+            "step index, mesh epoch, recent span events) through the "
+            "TCPStore under __telem/ keys, and rank 0 merges them into "
+            "a cluster step table (per-rank skew, straggler flags), a "
+            "comm-overlap report, and a merged per-rank chrome trace. "
+            "Off = one module-level check per step, zero registry and "
+            "zero store work (bench row 10).")
+define_flag("FLAGS_distributed_telemetry_interval", 1,
+            "Telemetry plane: steps between frame publications (1 = "
+            "every step boundary).")
+define_flag("FLAGS_distributed_telemetry_events", 4096,
+            "Telemetry plane: span events buffered per rank between "
+            "frame publications (oldest dropped beyond it).")
+define_flag("FLAGS_telemetry_straggler_factor", 1.25,
+            "Step-table straggler flag: a rank is flagged when its "
+            "per-step time exceeds the step's cross-rank median by "
+            "this factor (and by FLAGS_telemetry_straggler_min_us).")
+define_flag("FLAGS_telemetry_straggler_min_us", 1000.0,
+            "Step-table straggler flag: minimum absolute skew "
+            "(slowest minus median, us) before a rank is flagged — "
+            "filters factor-trips on micro-second steps.")
+define_flag("FLAGS_telemetry_postmortem_grace_s", 3.0,
+            "Distributed flight postmortem: how long rank 0 polls the "
+            "store for survivor rings before writing the aggregated "
+            "report with whatever arrived.")
 define_flag("FLAGS_observability", False,
             "Collect runtime metrics (counters/gauges/histograms) at "
             "the fused-runtime instrumentation points; off = the hot "

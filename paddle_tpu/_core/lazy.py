@@ -166,6 +166,15 @@ def _bind_hot_imports():
     return Tensor
 
 
+# flush reasons that BREAK the fusion window mid-step (vs. the natural
+# whole-step seals: materialize, backward_fused, grad_targets, guard
+# exits). Each break forfeits the step cache and the optimizer's
+# donation fast path for that window — the BUDGET_r06 eager-GPT finding
+# (4 record_fallback breaks/step) promoted to a first-class counter.
+_WINDOW_BREAK_REASONS = frozenset(
+    ("record_fallback", "segment_cap", "ambient_disable", "guard_error"))
+
+
 def _obs_flush_span(reason: str, n_ops: int, n_inputs: int, n_live: int,
                     n_donate: int):
     """Counters + the begun flush span. Callers gate on _OBS.ACTIVE —
@@ -175,7 +184,11 @@ def _obs_flush_span(reason: str, n_ops: int, n_inputs: int, n_live: int,
         from ..observability import metrics
         metrics.inc("segment.flushes")
         # record_fallback:<op> collapses to one reason bucket
-        metrics.inc("segment.flush_reason." + reason.split(":", 1)[0])
+        head = reason.split(":", 1)[0]
+        metrics.inc("segment.flush_reason." + head)
+        if head in _WINDOW_BREAK_REASONS:
+            metrics.inc("fusion.window_breaks")
+            metrics.inc("fusion.window_breaks." + head)
         metrics.inc("segment.ops", n_ops)
         if n_donate:
             metrics.inc("segment.donated_inputs", n_donate)

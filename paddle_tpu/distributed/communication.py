@@ -66,16 +66,24 @@ def _resilient(name: str, fn, *args, **kw):
     return _retry.comm_policy().run(attempt, what="comm::" + name)
 
 
-def _obs_comm(name: str):
-    """Span + call counter for one host-driven collective. One
-    module-level check when observability is off."""
+def _obs_comm(name: str, nbytes: int = 0):
+    """Span + call/byte counters for one host-driven collective. One
+    module-level check when observability is off.
+
+    `nbytes` is the payload size, computed ONCE at the call site —
+    outside the `_resilient` retry closure — so a retried collective
+    prices its bandwidth once, not per attempt; the span carries it so
+    the cross-rank overlap report can turn comm time into achieved
+    GB/s."""
     if not _OBS.ACTIVE:
         return NULL_SPAN
     if _OBS.METRICS:
         from ..observability import metrics
         metrics.inc("comm.calls." + name)
+        if nbytes:
+            metrics.inc("comm.bytes." + name, nbytes)
     from ..observability.spans import span
-    return span("comm::" + name, hist=f"comm.{name}_us")
+    return span("comm::" + name, hist=f"comm.{name}_us", bytes=nbytes)
 
 
 class ReduceOp:
@@ -208,6 +216,18 @@ def _np(t):
     return t.numpy() if isinstance(t, Tensor) else np.asarray(t)
 
 
+def _meta_nbytes(t) -> int:
+    """Expected payload bytes from shape/dtype metadata only (recv's
+    placeholder must not be materialized just to price its size)."""
+    if isinstance(t, Tensor):
+        a = t._meta_aval()
+        n = 1
+        for s in a.shape:
+            n *= int(s)
+        return n * np.dtype(a.dtype).itemsize
+    return np.asarray(t).nbytes
+
+
 def _wrap_like(arr: np.ndarray, like) -> Tensor:
     t = Tensor(np.ascontiguousarray(arr))
     if isinstance(like, Tensor):
@@ -221,9 +241,9 @@ def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     eager multi-process path rides the store-backed ProcessGroup."""
     if _single(group):
         return tensor
-    with _obs_comm("all_reduce"):
-        out = _resilient("all_reduce", _pg(group).all_reduce,
-                         _np(tensor), op)
+    arr = _np(tensor)
+    with _obs_comm("all_reduce", arr.nbytes):
+        out = _resilient("all_reduce", _pg(group).all_reduce, arr, op)
     tensor._adopt(_wrap_like(out, tensor))
     return tensor
 
@@ -233,9 +253,9 @@ def all_gather(tensor_list: List, tensor: Tensor, group=None, sync_op=True):
         tensor_list.append(tensor.clone() if isinstance(tensor, Tensor)
                            else tensor)
         return tensor_list
-    with _obs_comm("all_gather"):
-        parts = _resilient("all_gather", _pg(group).all_gather,
-                           _np(tensor))
+    arr = _np(tensor)
+    with _obs_comm("all_gather", arr.nbytes):
+        parts = _resilient("all_gather", _pg(group).all_gather, arr)
     tensor_list.extend(_wrap_like(p, tensor) for p in parts)
     return tensor_list
 
@@ -251,9 +271,10 @@ def all_gather_object(object_list, obj, group=None):
 def broadcast(tensor: Tensor, src=0, group=None, sync_op=True):
     if _single(group):
         return tensor
-    with _obs_comm("broadcast"):
+    arr = _np(tensor)
+    with _obs_comm("broadcast", arr.nbytes):
         out = _resilient("broadcast", _pg(group).broadcast,
-                         _np(tensor), _grank(group, src, 'src'))
+                         arr, _grank(group, src, 'src'))
     tensor._adopt(_wrap_like(out, tensor))
     return tensor
 
@@ -271,8 +292,9 @@ def reduce(tensor: Tensor, dst=0, op=ReduceOp.SUM, group=None,
            sync_op=True):
     if _single(group):
         return tensor
-    with _obs_comm("reduce"):
-        out = _resilient("reduce", _pg(group).reduce, _np(tensor),
+    arr = _np(tensor)
+    with _obs_comm("reduce", arr.nbytes):
+        out = _resilient("reduce", _pg(group).reduce, arr,
                          _grank(group, dst, 'dst'), op)
     tensor._adopt(_wrap_like(out, tensor))
     return tensor
@@ -284,9 +306,10 @@ def reduce_scatter(tensor: Tensor, tensor_list, op=ReduceOp.SUM, group=None,
         t = tensor_list[0]
         tensor._adopt(t.clone())
         return tensor
-    with _obs_comm("reduce_scatter"):
+    parts = [_np(t) for t in tensor_list]
+    with _obs_comm("reduce_scatter", sum(p.nbytes for p in parts)):
         out = _resilient("reduce_scatter", _pg(group).reduce_scatter,
-                         [_np(t) for t in tensor_list], op)
+                         parts, op)
     tensor._adopt(_wrap_like(out, tensor))
     return tensor
 
@@ -298,7 +321,8 @@ def scatter(tensor: Tensor, tensor_list=None, src=0, group=None,
             tensor._adopt(tensor_list[0].clone())
         return tensor
     parts = [_np(t) for t in tensor_list] if tensor_list else None
-    with _obs_comm("scatter"):
+    with _obs_comm("scatter",
+                   sum(p.nbytes for p in parts) if parts else 0):
         out = _resilient("scatter", _pg(group).scatter, parts,
                          _grank(group, src, 'src'))
     tensor._adopt(_wrap_like(out, tensor))
@@ -311,8 +335,9 @@ def gather(tensor: Tensor, gather_list=None, dst=0, group=None,
         if gather_list is not None:
             gather_list.append(tensor.clone())
         return gather_list
-    with _obs_comm("gather"):
-        parts = _resilient("gather", _pg(group).gather, _np(tensor),
+    arr = _np(tensor)
+    with _obs_comm("gather", arr.nbytes):
+        parts = _resilient("gather", _pg(group).gather, arr,
                            _grank(group, dst, 'dst'))
     if parts is not None and gather_list is not None:
         gather_list.extend(_wrap_like(p, tensor) for p in parts)
@@ -323,9 +348,9 @@ def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
     if _single(group):
         out_tensor_list.extend(t.clone() for t in in_tensor_list)
         return out_tensor_list
-    with _obs_comm("alltoall"):
-        parts = _resilient("all_to_all", _pg(group).all_to_all,
-                           [_np(t) for t in in_tensor_list])
+    ins = [_np(t) for t in in_tensor_list]
+    with _obs_comm("alltoall", sum(p.nbytes for p in ins)):
+        parts = _resilient("all_to_all", _pg(group).all_to_all, ins)
     out_tensor_list.extend(_wrap_like(p, in_tensor_list[0]) for p in parts)
     return out_tensor_list
 
@@ -337,8 +362,9 @@ def send(tensor: Tensor, dst=0, group=None, sync_op=True):
     g = group or _get_default_group()
     if g.nranks <= 1:
         raise RuntimeError("send needs a multi-process group")
-    with _obs_comm("send"):
-        _resilient("send", _pg(group).send, _np(tensor),
+    arr = _np(tensor)
+    with _obs_comm("send", arr.nbytes):
+        _resilient("send", _pg(group).send, arr,
                    _grank(group, dst, 'dst'))
 
 
@@ -346,7 +372,7 @@ def recv(tensor: Tensor, src=0, group=None, sync_op=True):
     g = group or _get_default_group()
     if g.nranks <= 1:
         raise RuntimeError("recv needs a multi-process group")
-    with _obs_comm("recv"):
+    with _obs_comm("recv", _meta_nbytes(tensor)):
         out = _resilient("recv", _pg(group).recv,
                          _grank(group, src, 'src'))
     tensor._adopt(_wrap_like(out, tensor))

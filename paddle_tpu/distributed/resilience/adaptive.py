@@ -329,6 +329,15 @@ class AdaptiveTrainer:
         lost = [r for r in ev.lost
                 if self.mesh is None
                 or r in set(self.mesh.process_ids)]
+        if ev.lost and _OBS.DIST:
+            # distributed postmortem BEFORE the re-plan mutates state:
+            # survivors publish their flight rings, rank 0 writes the
+            # interleaved report next to the dead rank's last dump.
+            # Never raises — a telemetry failure must not fail recovery.
+            from ...observability import distributed as _dtel
+            _dtel.trigger_postmortem(
+                f"{ev.source}: lost ranks {sorted(ev.lost)} "
+                f"(epoch {ev.epoch})")
         if not lost or self.mesh is None:
             self._replan_t0 = None
             return

@@ -30,6 +30,7 @@ import time
 from typing import Callable, Dict, Optional, Sequence
 
 from ..._core import flags as _flags
+from ...observability import _state as _OBS
 from ..watchdog import get_comm_task_manager
 from .faults import RankDeath, TransientFault
 
@@ -177,6 +178,12 @@ class ElasticStep:
                     faults.inject(site)
                 out = step_fn(*args, **kw)
                 self._check_watchdog()
+                if _OBS.DIST:
+                    # cross-rank telemetry: stamp the step boundary and
+                    # (per the interval flag) publish this rank's frame.
+                    # Off = this one module-attribute read.
+                    from ...observability import distributed as _dtel
+                    _dtel.on_step(self.step_index)
                 if detect_t is not None:
                     self.last_recovery_s = time.perf_counter() - detect_t
                     from ...observability import metrics

@@ -180,7 +180,9 @@ class FaultPlan:
         from ...observability import _state as _OBS
         if _OBS.FLIGHT:
             from ...observability import flight
-            flight.note("fault", site, kind=act.kind,
+            # detail key must not be 'kind' — that is note()'s first
+            # positional (the event kind, "fault")
+            flight.note("fault", site, fault=act.kind,
                         occurrence=occurrence, arg=act.arg)
         if act.kind in _DELAY_KINDS and act.arg:
             self._sleep(act.arg)

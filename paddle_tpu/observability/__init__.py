@@ -36,6 +36,7 @@ __all__ = ["stats", "reset", "enable", "disable", "enabled",
 # flips the gate immediately)
 _flags.watch_flag("FLAGS_observability", _state.set_metrics)
 _flags.watch_flag("FLAGS_flight_recorder", _state.set_flight)
+_flags.watch_flag("FLAGS_distributed_telemetry", _state.set_dist)
 
 
 def enable(flight_recorder: bool = None):
@@ -78,6 +79,10 @@ def _derived(counters: dict) -> dict:
                            if hits + misses else None),
         "step_cache_hit_rate": (step_hit / (step_hit + step_miss)
                                 if step_hit + step_miss else None),
+        # every fusion-window break costs the step cache + optimizer
+        # donation — the BUDGET_r06 eager-GPT finding, now a headline
+        # number instead of raw span archaeology
+        "fusion_window_breaks": counters.get("fusion.window_breaks", 0),
     }
 
 

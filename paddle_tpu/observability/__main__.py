@@ -1,8 +1,10 @@
 """CLI: run a small workload with telemetry on and print the stats.
 
-    python -m paddle_tpu.observability [stats|budget]
+    python -m paddle_tpu.observability [stats|budget|merge]
         [--model chain|lenet|resnet50|gpt2] [--steps N]
         [--json] [--trace PATH] [--flight] [--async-flush]
+        [--distributed] [--nranks N]
+        merge <dir>
 
 Modes:
 
@@ -12,6 +14,18 @@ Modes:
   a ranked table (segment flush/compile/execute, sot::, optimizer::,
   comm::, plus the unspanned **host gap**), the measurement that
   decides which hot-path item to burn next (observability/budget.py).
+- ``budget --distributed``: the cross-rank edition — spawns
+  ``--nranks`` local trainer ranks over the distributed launcher, each
+  publishing telemetry frames through a shared TCPStore while running
+  compute + a host-driven gradient all-reduce per step; rank 0 merges
+  them and the command prints the cluster step table (per-rank skew,
+  straggler flags) and the comm-overlap report (the baseline the
+  overlapped-collectives work must beat — ~0 today), and leaves the
+  per-rank dumps + merged chrome trace in a scratch dir.
+- ``merge <dir>``: offline aggregation — merge ``telem_rank*.json``
+  dumps (written by TelemetryPublisher.dump) found in <dir> into the
+  same step table + overlap report, and write ``merged_trace.json``
+  (one chrome-trace lane per rank, clock-rebased) next to them.
 
 `chain` is the dispatch microbench's elementwise chain — fast,
 exercises segment record/flush/cache. `lenet` runs real train steps
@@ -157,6 +171,205 @@ _MODELS = {"chain": None, "lenet": _lenet_step,
            "resnet50": _resnet50_step, "gpt2": _gpt2_step}
 
 
+# ------------------------------------------------- distributed budget
+# One trainer rank of the local drill: compute chain + host-driven
+# gradient all-reduce per step under ElasticStep (so the step:: fault
+# sites and the telemetry on_step hook both fire), frames published
+# through the shared TCPStore. Env knobs (set by the CLI/test parent):
+#   TELEM_OUT        output dir (dumps, merged artifacts)
+#   TELEM_STEPS      steps per rank
+#   TELEM_SLOW_RANK  optional straggler: that rank runs with an
+#                    injected step::*=delay fault (TELEM_SLOW_DELAY s)
+#   TELEM_KILL_RANK/TELEM_KILL_STEP  optional death drill: SIGKILL
+#                    self after completing that step; the kill rank is
+#                    excluded from the comm group up front so survivor
+#                    collectives never hang on a dead peer (collective
+#                    death handling is the resilience layer's job, not
+#                    this measurement's)
+_DISTRIBUTED_DRILL = """
+import json, os, signal, sys, time
+import numpy as np
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed.communication import Group, all_reduce
+from paddle_tpu.distributed.process_group import ProcessGroup
+from paddle_tpu.distributed.resilience import ElasticStep
+from paddle_tpu.distributed.store import TCPStore
+from paddle_tpu.observability import distributed as dtel
+
+RANK = int(os.environ["PADDLE_TRAINER_ID"])
+WORLD = int(os.environ["PADDLE_TRAINERS_NUM"])
+OUT = os.environ["TELEM_OUT"]
+STEPS = int(os.environ.get("TELEM_STEPS", "10"))
+SLOW = int(os.environ.get("TELEM_SLOW_RANK", "-1"))
+KILL = int(os.environ.get("TELEM_KILL_RANK", "-1"))
+KILL_STEP = int(os.environ.get("TELEM_KILL_STEP", "2"))
+
+paddle.set_flags({"FLAGS_observability": True,
+                  "FLAGS_flight_recorder": True,
+                  "FLAGS_distributed_telemetry": True})
+if RANK == SLOW:
+    delay = os.environ.get("TELEM_SLOW_DELAY", "0.05")
+    paddle.set_flags({"FLAGS_fault_inject":          # @* = every step
+                      "step::*@*=delay(%s)" % delay})
+
+store = TCPStore(os.environ["MASTER_ADDR"],
+                 int(os.environ["MASTER_PORT"]),
+                 is_master=(RANK == 0), world_size=WORLD, timeout=120)
+pub = dtel.init(store, rank=RANK, world_size=WORLD)
+
+comm_ranks = [r for r in range(WORLD) if r != KILL]
+group = None
+if RANK in comm_ranks and len(comm_ranks) > 1:
+    group = Group(comm_ranks,
+                  pg=ProcessGroup(store, RANK, comm_ranks, gid=1))
+
+x = paddle.to_tensor(np.ones((64, 64), "float32"))
+grad = paddle.to_tensor(
+    np.ones((256, 256), "float32"))        # 256 KB payload
+w = paddle.to_tensor(np.zeros((64, 64), "float32"))
+opt = paddle.optimizer.SGD(0.0, parameters=[w])
+elastic = ElasticStep(optimizer=opt)
+
+
+def step():
+    y = x
+    for _ in range(16):
+        y = y * 1.0001 + 0.0001
+    np.asarray(y._value)                   # compute lands
+    if group is not None:
+        all_reduce(grad, group=group)      # host-driven gradient sync
+    return y
+
+
+for s in range(1, STEPS + 1):
+    elastic.run(step)
+    if RANK == KILL and s == KILL_STEP:
+        pub.flush()
+        os.kill(os.getpid(), signal.SIGKILL)
+
+pub.flush()
+pub.dump(OUT)
+if group is not None:
+    group.pg.barrier()                     # every dump + frame landed
+if KILL >= 0:
+    # death drill: survivors publish their flight rings; rank 0 also
+    # aggregates the interleaved report (grace-bounded store polls)
+    post = dtel.trigger_postmortem(
+        "drill: rank %d killed at step %d" % (KILL, KILL_STEP))
+else:
+    post = None
+
+if RANK == 0:
+    agg = dtel.TelemetryAggregator()
+    agg.poll_store(store, list(range(WORLD)))
+    for r in range(WORLD):   # prefer full offline dumps when present
+        p = os.path.join(OUT, "telem_rank%d.json" % r)
+        if os.path.exists(p):
+            agg.add_dump(p)
+    out = {"nranks": WORLD, "steps": STEPS,
+           "step_table": agg.step_table(),
+           "overlap": agg.overlap_report(),
+           "postmortem": post}
+    agg.merged_trace(os.path.join(OUT, "merged_trace.json"))
+    with open(os.path.join(OUT, "distributed_budget.json"), "w") as f:
+        json.dump(out, f)
+if group is not None:
+    group.pg.barrier()                     # hold the store master open
+pub.shutdown()
+store.close()
+"""
+
+
+def _free_port() -> int:
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _budget_distributed(args) -> int:
+    """Spawn `--nranks` local ranks over the distributed launcher, let
+    rank 0 aggregate, print the merged step table + overlap report."""
+    import subprocess
+    import tempfile
+
+    out_dir = args.out or tempfile.mkdtemp(prefix="pt_telem_")
+    os.makedirs(out_dir, exist_ok=True)
+    script = os.path.join(out_dir, "_telem_drill.py")
+    with open(script, "w") as f:
+        f.write(_DISTRIBUTED_DRILL)
+    env = dict(os.environ)
+    env["TELEM_OUT"] = out_dir
+    env["TELEM_STEPS"] = str(args.steps)
+    env.pop("MASTER_ADDR", None)
+    env.pop("MASTER_PORT", None)
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", str(args.nranks),
+         "--elastic_mode", "shrink", "--min_np", "1",
+         "--log_dir", os.path.join(out_dir, "log"),
+         "--master", f"127.0.0.1:{_free_port()}", script],
+        env=env, cwd=out_dir, capture_output=True, text=True,
+        timeout=600)
+    result_path = os.path.join(out_dir, "distributed_budget.json")
+    if proc.returncode != 0 or not os.path.exists(result_path):
+        sys.stderr.write(proc.stderr)
+        logdir = os.path.join(out_dir, "log")
+        if os.path.isdir(logdir):
+            for name in sorted(os.listdir(logdir)):
+                with open(os.path.join(logdir, name)) as f:
+                    tail = f.read()[-1500:]
+                sys.stderr.write(f"\n--- {name}\n{tail}\n")
+        print(f"distributed budget failed (rc={proc.returncode})",
+              file=sys.stderr)
+        return proc.returncode or 1
+    with open(result_path) as f:
+        out = json.load(f)
+    out["out_dir"] = out_dir
+    if args.json:
+        print(json.dumps(out))
+    else:
+        from paddle_tpu.observability import distributed as dtel
+        print(dtel.render_step_table(out["step_table"]))
+        print(dtel.render_overlap(out["overlap"]))
+        if out.get("postmortem"):
+            print(f"distributed postmortem: {out['postmortem']}")
+        print(f"artifacts (dumps, merged_trace.json) in {out_dir}")
+    return 0
+
+
+def _merge(args) -> int:
+    """Offline aggregation over telem_rank*.json dumps in a dir."""
+    import glob
+
+    from paddle_tpu.observability import distributed as dtel
+
+    d = args.path
+    if not d or not os.path.isdir(d):
+        print(f"merge: {d!r} is not a directory", file=sys.stderr)
+        return 2
+    dumps = sorted(glob.glob(os.path.join(d, "telem_rank*.json")))
+    if not dumps:
+        print(f"merge: no telem_rank*.json dumps in {d}",
+              file=sys.stderr)
+        return 2
+    agg = dtel.TelemetryAggregator()
+    for p in dumps:
+        agg.add_dump(p)
+    trace_path = os.path.join(d, "merged_trace.json")
+    agg.merged_trace(trace_path)
+    out = {"ranks": agg.ranks, "step_table": agg.step_table(),
+           "overlap": agg.overlap_report(), "trace": trace_path}
+    if args.json:
+        print(json.dumps(out))
+    else:
+        print(dtel.render_step_table(out["step_table"]))
+        print(dtel.render_overlap(out["overlap"]))
+        print(f"merged chrome trace written to {trace_path}")
+    return 0
+
+
 def _render(snap: dict) -> str:
     lines = ["== paddle_tpu.observability stats =="]
     lines.append(f"  compiles:            {snap['compiles']}")
@@ -183,12 +396,25 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m paddle_tpu.observability")
     ap.add_argument("mode", nargs="?", default="stats",
-                    choices=("stats", "budget"),
+                    choices=("stats", "budget", "merge"),
                     help="stats = registry snapshot; budget = ranked "
-                         "per-step time-budget table")
+                         "per-step time-budget table; merge = offline "
+                         "aggregation of per-rank telemetry dumps")
+    ap.add_argument("path", nargs="?", default=None,
+                    help="merge mode: directory holding "
+                         "telem_rank*.json dumps")
     ap.add_argument("--model", default="chain",
                     choices=tuple(_MODELS))
     ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--distributed", action="store_true",
+                    help="budget mode: spawn --nranks local trainer "
+                         "ranks over the launcher and print the merged "
+                         "cross-rank step table + comm-overlap report")
+    ap.add_argument("--nranks", type=int, default=4,
+                    help="rank count for budget --distributed")
+    ap.add_argument("--out", default=None, metavar="DIR",
+                    help="budget --distributed: artifact directory "
+                         "(default: a fresh temp dir)")
     ap.add_argument("--json", action="store_true",
                     help="print the result as JSON")
     ap.add_argument("--trace", default=None, metavar="PATH",
@@ -199,6 +425,11 @@ def main(argv=None) -> int:
                     help="run with FLAGS_async_flush on (before/after "
                          "budget comparisons from one command)")
     args = ap.parse_args(argv)
+
+    if args.mode == "merge":
+        return _merge(args)
+    if args.mode == "budget" and args.distributed:
+        return _budget_distributed(args)
 
     import paddle_tpu as paddle
     from paddle_tpu import observability as obs

@@ -15,6 +15,7 @@ from __future__ import annotations
 METRICS = False   # FLAGS_observability: registry collection at hot sites
 TRACE = False     # profiler is recording: spans land in the host trace
 FLIGHT = False    # FLAGS_flight_recorder: ring-buffer event capture
+DIST = False      # FLAGS_distributed_telemetry: cross-rank frame plane
 
 # The single gate hot paths read: any consumer on.
 ACTIVE = False
@@ -22,7 +23,7 @@ ACTIVE = False
 
 def recompute():
     global ACTIVE
-    ACTIVE = METRICS or TRACE or FLIGHT
+    ACTIVE = METRICS or TRACE or FLIGHT or DIST
 
 
 def set_metrics(on: bool):
@@ -40,4 +41,10 @@ def set_trace(on: bool):
 def set_flight(on: bool):
     global FLIGHT
     FLIGHT = bool(on)
+    recompute()
+
+
+def set_dist(on: bool):
+    global DIST
+    DIST = bool(on)
     recompute()

@@ -132,7 +132,8 @@ def _rank(snap: Dict, wall_us: float, steps: int) -> Dict:
         "entries": entries,
         "counters": {k: counters[k] for k in sorted(counters)
                      if k.startswith(("segment.", "cache.", "compiles.",
-                                      "optimizer.", "sot.", "eager."))},
+                                      "optimizer.", "sot.", "eager.",
+                                      "fusion.", "comm."))},
         "step_cache_hit_rate": snap.get("step_cache_hit_rate"),
     }
 

@@ -68,13 +68,31 @@ def reset():
         _RING = None     # re-read capacity flag on next use
 
 
+def entries() -> list:
+    """Copy of the raw ring: [(perf_ns, kind, name, detail), ...] —
+    the distributed postmortem publishes this, rebased, to rank 0."""
+    with _LOCK:
+        return list(_RING) if _RING is not None else []
+
+
+def _rank():
+    """Trainer rank for dump tagging (None outside a launched job).
+    Read per dump, not at import: the launcher sets the env after the
+    worker process starts importing."""
+    r = os.environ.get("PADDLE_TRAINER_ID")
+    return int(r) if r and r.isdigit() else None
+
+
 def record() -> str:
     """The current ring formatted as a report (oldest first)."""
     with _LOCK:
         entries = list(_RING) if _RING is not None else []
     now = time.perf_counter_ns()
+    rank = _rank()
+    who = (f"rank {rank} pid {os.getpid()}" if rank is not None
+           else f"pid {os.getpid()}")
     lines = [f"== paddle_tpu flight record: {len(entries)} event(s), "
-             f"pid {os.getpid()} =="]
+             f"{who} =="]
     for t, kind, name, detail in entries:
         rel = (t - now) / 1e9
         extra = " ".join(f"{k}={v}" for k, v in detail.items())
@@ -86,18 +104,27 @@ def record() -> str:
     return "\n".join(lines)
 
 
+def _dump_dir() -> str:
+    from .._core import flags
+    return (flags.flag_value("FLAGS_flight_recorder_dir")
+            or flags.flag_value("FLAGS_profiler_dir") or ".")
+
+
 def dump(reason: str = "", path: str = None) -> str:
-    """Write the report to a file and return its path."""
+    """Write the report to a file and return its path. The default
+    filename is rank-tagged (`flight_r<rank>_<pid>_<seq>.txt` inside a
+    launched job) so concurrent multi-process dumps into one shared
+    FLAGS_flight_recorder_dir can never clobber each other."""
     global _DUMP_SEQ
     if path is None:
-        from .._core import flags
-        d = (flags.flag_value("FLAGS_flight_recorder_dir")
-             or flags.flag_value("FLAGS_profiler_dir") or ".")
+        d = _dump_dir()
         os.makedirs(d, exist_ok=True)
         with _LOCK:
             _DUMP_SEQ += 1
             seq = _DUMP_SEQ
-        path = os.path.join(d, f"flight_{os.getpid()}_{seq}.txt")
+        rank = _rank()
+        tag = f"r{rank}_" if rank is not None else ""
+        path = os.path.join(d, f"flight_{tag}{os.getpid()}_{seq}.txt")
     body = record()
     if reason:
         body = f"trigger: {reason}\n{body}"

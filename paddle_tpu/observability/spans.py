@@ -51,6 +51,11 @@ class Span:
             if error is not None:
                 detail["error"] = repr(error)
             flight.note("span", self.name, **detail)
+        if _state.DIST:
+            from . import distributed
+            distributed.note_span(
+                self.name, t0, dur_us,
+                (self.args or {}).get("bytes", 0))
 
     def __enter__(self):
         return self.begin()
