@@ -42,6 +42,16 @@ Row 10 distributed telemetry plane   asserts the telemetry-off path
                                      every registry counter; reports
                                      the per-step publication overhead
                                      with telemetry on
+Row 11 memory telemetry plane     asserts the memory-telemetry-off path
+                                  (WITH async flush on) keeps the
+                                  live-buffer census empty, freezes
+                                  every registry counter and makes zero
+                                  memory_analysis calls; reports the
+                                  enabled overhead us/step on the 32-op
+                                  chain and embeds the LeNet
+                                  steady-state peak/donated-bytes
+                                  snapshot (peak participates in --diff
+                                  as a bytes row, down-good)
 (Multi-chip GPT/ERNIE hybrids need a pod; their single-chip proxies are
 bench.py's headline + the dryrun_multichip compile check.)
 
@@ -649,6 +659,118 @@ def bench_telemetry():
         store.close()
 
 
+def bench_memory():
+    """Row 11: memory telemetry plane. Off contract asserted EXACTLY
+    (the rows-5..10 counter technique) with the async flush pipeline
+    ON: across a capped 32-op dispatch chain the census stays empty,
+    the registry's MUTATIONS counter stays frozen, and zero
+    ``memory_analysis()`` calls happen. The reported value is the
+    enabled-mode overhead per step on the same chain (census
+    registration + watermark upkeep on the record path). The row json
+    embeds the LeNet steady-state byte snapshot — census peak
+    watermark, donated bytes per step (lazy-flush mask + fused
+    optimizer donate_argnums), and the compiled executables' temp
+    footprint from the cached memory analysis; peak rides as a nested
+    diff row with a bytes unit (down-good) so bench_suite --diff
+    catches footprint regressions mechanically."""
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu._core import async_flush
+    from paddle_tpu.observability import memory as memtel
+    from paddle_tpu.observability import metrics
+
+    x = paddle.to_tensor(np.ones((16, 16), "float32"))
+
+    def chain():
+        y = x
+        for _ in range(32):
+            y = y * 1.0001 + 0.0001
+        return y._value
+
+    from paddle_tpu._core.flags import flag_value
+    checks_was = flag_value("FLAGS_static_checks")
+    # checks off for the freeze window: the warn-mode sanitizer sweep
+    # counts registry work by design (the row-10 precedent)
+    paddle.set_flags({"FLAGS_async_flush": True,
+                      "FLAGS_lazy_max_segment_ops": 16,
+                      "FLAGS_static_checks": "off"})
+    try:
+        _timeit(chain, steps=20, warmup=5)
+        async_flush.drain()
+        # ---------------- memory telemetry OFF: the freeze contract
+        before = metrics.MUTATIONS
+        calls0 = memtel.ANALYSIS_CALLS
+        census0 = memtel.census_size()
+        off_t = _timeit(chain, steps=100, warmup=0)
+        async_flush.drain()
+        assert metrics.MUTATIONS == before, \
+            "memory-telemetry-off loop did registry work (must be 0)"
+        assert memtel.census_size() == census0 == 0, \
+            "memory-telemetry-off loop registered census entries"
+        assert memtel.ANALYSIS_CALLS == calls0, \
+            "memory-telemetry-off loop called memory_analysis"
+        # ---------------- ON: enabled overhead per step
+        paddle.set_flags({"FLAGS_memory_telemetry": True})
+        try:
+            on_t = _timeit(chain, steps=100, warmup=5)
+            async_flush.drain()
+            assert memtel.census_size() > 0, \
+                "memory-telemetry-on loop registered nothing"
+        finally:
+            paddle.set_flags({"FLAGS_memory_telemetry": False})
+    finally:
+        paddle.set_flags({"FLAGS_async_flush": False,
+                          "FLAGS_lazy_max_segment_ops": 256,
+                          "FLAGS_static_checks": checks_was})
+        async_flush.drain(raise_latched=False)
+
+    # ---------------- LeNet steady-state byte snapshot
+    paddle.set_flags({"FLAGS_memory_telemetry": True})
+    try:
+        seq0 = memtel.exec_seq()    # scope the analysis log to LeNet
+        from paddle_tpu.vision.models import LeNet
+        paddle.seed(0)
+        model = LeNet()
+        opt = paddle.optimizer.Adam(1e-3, parameters=model.parameters())
+        rng = np.random.RandomState(0)
+        xb = paddle.to_tensor(rng.randn(32, 1, 28, 28).astype(np.float32))
+        yb = paddle.to_tensor(rng.randint(0, 10, (32,)).astype(np.int64))
+
+        def step():
+            loss = F.cross_entropy(model(xb), yb)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss._value
+
+        _timeit(step, steps=2, warmup=3)       # warm the step cache
+        memtel.reset_peak()
+        d0 = memtel.donated_bytes()
+        steps = 4
+        _timeit(step, steps=steps, warmup=0)
+        peak = memtel.peak_bytes()
+        donated = (memtel.donated_bytes() - d0) / steps
+        temps = [e.get("temp_bytes") or 0
+                 for e in memtel.executable_stats()
+                 if e.get("seq", 0) > seq0]
+    finally:
+        paddle.set_flags({"FLAGS_memory_telemetry": False})
+
+    return {"metric": "memory telemetry overhead (32-op capped chain; "
+                      "off = empty census + frozen counters + zero "
+                      "memory_analysis calls, async flush on)",
+            "value": round((on_t - off_t) * 1e6, 2),
+            "unit": "us/step overhead",
+            "lenet_peak_bytes": int(peak),
+            "lenet_donated_bytes_per_step": round(donated, 1),
+            "lenet_temp_bytes_max": int(max(temps)) if temps else 0,
+            "census_entries_on": memtel.census_size(),
+            "rows": [{"metric": "LeNet steady-state peak HBM "
+                                "(b32 census watermark)",
+                      "value": int(peak), "unit": "bytes peak"}]}
+
+
 # ------------------------------------------------------------- diff mode
 
 def _rows_of(path: str) -> dict:
@@ -682,7 +804,8 @@ def _rows_of(path: str) -> dict:
 def _lower_is_better(metric: str, unit: str) -> bool:
     """Direction from the UNIT first: a rate (tokens/s, images/s,
     ops/s, 'x' speedup) is higher-is-better even when the metric NAME
-    says 'overhead' (row 4 reports dispatch overhead AS a rate). Only
+    says 'overhead' (row 4 reports dispatch overhead AS a rate). Byte
+    units (row 11's peak-HBM snapshot) are cost: down-good. Only
     unit-less cost words fall back to the name."""
     u = unit.lower()
     # a RATE unit ends its first token with '/s' (tokens/s, ops/s);
@@ -691,7 +814,8 @@ def _lower_is_better(metric: str, unit: str) -> bool:
     if first.endswith("/s") or u.startswith("x "):
         return False
     text = f"{metric} {u}".lower()
-    return any(w in text for w in ("overhead", "latency", "ms", "% "))
+    return any(w in text for w in ("overhead", "latency", "ms", "% ",
+                                   "bytes"))
 
 
 def diff_mode(threshold: float = 0.10) -> int:
@@ -739,12 +863,12 @@ def main():
     if "--diff" in sys.argv[1:]:
         raise SystemExit(diff_mode())
     rows = os.environ.get("BENCH_ROWS",
-                          "1,2,3,4,5,6,7,8,9,10").split(",")
+                          "1,2,3,4,5,6,7,8,9,10,11").split(",")
     table = {"1": bench_lenet, "2": bench_resnet50, "3": bench_bert,
              "4": bench_dispatch, "5": bench_static_checks,
              "6": bench_observability, "7": bench_resilience,
              "8": bench_replan, "9": bench_async_flush,
-             "10": bench_telemetry}
+             "10": bench_telemetry, "11": bench_memory}
     for r in rows:
         r = r.strip()
         out = table[r]()

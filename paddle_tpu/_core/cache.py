@@ -30,6 +30,11 @@ class ExecCache(OrderedDict):
         super().__init__()
         self._flag = flag
         self._extra_flag = extra_flag
+        # per-entry XLA memory analysis (observability/memory.py fills
+        # this at compile time while FLAGS_memory_telemetry is on), so
+        # a step-cache hit can report its compiled footprint without
+        # re-lowering anything; pruned with the entry it describes
+        self._mem: dict = {}
         # direct Counter handles: metrics.reset() zeroes them in place,
         # so holding the objects (no per-lookup name resolution) is safe
         if stat is not None:
@@ -86,6 +91,20 @@ class ExecCache(OrderedDict):
             # key read here may be evicted by the other thread between
             # the two calls — losing that race is benign, so tolerate it
             try:
-                OrderedDict.__delitem__(self, next(iter(self)))
+                oldest = next(iter(self))
+                OrderedDict.__delitem__(self, oldest)
+                self._mem.pop(oldest, None)
             except (KeyError, StopIteration, RuntimeError):
                 break
+
+    def note_memory(self, key, info: dict):
+        """Attach a compiled executable's memory analysis to its cache
+        entry (observability/memory.py, FLAGS_memory_telemetry)."""
+        self._mem[key] = info
+
+    def memory_info(self, key, default=None):
+        return self._mem.get(key, default)
+
+    def clear(self):
+        OrderedDict.clear(self)
+        self._mem.clear()

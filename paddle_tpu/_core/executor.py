@@ -101,7 +101,17 @@ def apply(op_name: str, *inputs, **attrs):
             out_vals = eager_forward(op, vals, attrs)
     else:
         out_vals = eager_forward(op, vals, attrs)
-    outs = tuple(Tensor(v) for v in out_vals)
+    if _obs.MEM:
+        # census birth site for per-op eager outputs: the op name
+        # (Tensor.__init__ reads the thread-local hint)
+        from ..observability import memory as _memtel
+        _memtel.set_site("eager:" + op_name)
+        try:
+            outs = tuple(Tensor(v) for v in out_vals)
+        finally:
+            _memtel.clear_site()
+    else:
+        outs = tuple(Tensor(v) for v in out_vals)
     if is_grad_enabled() and any(
             t is not None and not t.stop_gradient for t in ts):
         record(op, attrs, ts, outs)

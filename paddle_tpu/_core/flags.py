@@ -280,9 +280,11 @@ define_flag("FLAGS_fault_inject", "",
             "Deterministic fault-injection plan ('' = off, zero cost): "
             "'seed=N;site[@occ]=kind[(arg)][:prob];...' where site is a "
             "named injection point (store::get, pg::init, "
-            "comm::all_reduce, segment::compile, step::N, ckpt::save; "
-            "trailing * wildcards match) and kind is fail | die | "
-            "delay(s) | stuck(s). See distributed/resilience/faults.py.")
+            "comm::all_reduce, segment::compile, exec::oom, step::N, "
+            "ckpt::save; trailing * wildcards match) and kind is fail "
+            "| die | delay(s) | stuck(s) | oom (synthetic XLA "
+            "RESOURCE_EXHAUSTED at the execute sites). See "
+            "distributed/resilience/faults.py.")
 define_flag("FLAGS_retry_max_attempts", 3,
             "RetryPolicy default attempt budget for transient failures "
             "(TCPStore ops, process-group bring-up, host collectives, "
@@ -416,6 +418,25 @@ define_flag("FLAGS_observability", False,
             "Collect runtime metrics (counters/gauges/histograms) at "
             "the fused-runtime instrumentation points; off = the hot "
             "paths pay one module-level check and zero registry work.")
+define_flag("FLAGS_memory_telemetry", False,
+            "Byte-domain telemetry plane (observability/memory.py): "
+            "live-buffer census with birth-site provenance at the "
+            "Tensor-creation and lazy bind choke points, per-compile "
+            "XLA memory_analysis cached on the executable-cache entry, "
+            "donation savings accounting, and OOM postmortems at the "
+            "execute sites. Off = one module-level check per choke "
+            "point, zero census and zero registry work (bench row 11).")
+define_flag("FLAGS_memory_budget_bytes", 0,
+            "Per-device HBM budget in bytes for the cross-rank memory "
+            "column: budget --distributed flags the rank whose peak is "
+            "nearest this budget (0 = unknown; the highest absolute "
+            "peak is flagged instead).")
+define_flag("FLAGS_flight_max_dumps", 32,
+            "Flight-recorder dump retention: per-rank cap on "
+            "flight_*.txt files kept in FLAGS_flight_recorder_dir "
+            "(oldest pruned first after each dump; rank-aware so one "
+            "rank's churn cannot evict another rank's postmortem; "
+            "0 = unlimited).")
 define_flag("FLAGS_flight_recorder", False,
             "Keep a bounded ring buffer of recent runtime events "
             "(spans, flushes, cache decisions) and dump a readable "

@@ -215,6 +215,72 @@ def _obs_flush_failed(reason: str, err: BaseException):
         flight.on_error("flush_failed", f"reason={reason}: {err!r}")
 
 
+def _oom_convert(e: BaseException, where: str, mem_info=None):
+    """RESOURCE_EXHAUSTED at an execute site becomes the typed
+    ``base.core.ResourceExhaustedError`` carrying the memory
+    postmortem (top live buffers with provenance, failing executable's
+    memory analysis, watermark). Anything else passes through at the
+    cost of one substring check — this only runs on the error path."""
+    if "RESOURCE_EXHAUSTED" not in str(e):
+        return e
+    from ..observability import memory as _memtel
+    return _memtel.on_oom(e, where, mem_info)
+
+
+def _inject_exec_oom():
+    """``exec::oom`` drill site: a synthetic RESOURCE_EXHAUSTED at the
+    execute boundary (resilience/faults.py kind ``oom``), fired at all
+    three execute sites so the OOM postmortem path — including the
+    async worker's typed re-raise at the sync point — is testable
+    without exhausting real device memory. Callers pre-gate on
+    ``_flags.FAULT_INJECT_ACTIVE``."""
+    from ..distributed.resilience import faults as _faults
+    _faults.inject("exec::oom")
+
+
+def _compile_segment_runner(pending, live, donate, run_vals, sig):
+    """Build one segment's cached runner. With the memory telemetry
+    plane on (and concrete inputs), compile through the jax AOT path so
+    the executable's ``memory_analysis()`` lands on the ExecCache entry
+    exactly once per compile; otherwise the plain jit wrapper. Both are
+    interchangeable callables — the cache key already pins the input
+    signature, so an AOT-compiled entry only ever sees matching
+    arguments."""
+    jitted = jax.jit(_build_segment_fn(pending, live),
+                     donate_argnums=donate)
+    if _OBS.MEM and not any(isinstance(v, jax.core.Tracer)
+                            for v in run_vals):
+        from ..observability import memory as _memtel
+        with _quiet_donation_compile():
+            return _memtel.aot_compile(jitted, run_vals, stat="segment",
+                                       cache=_SEG_CACHE,
+                                       key=(sig, donate))
+    return jitted
+
+
+def _compile_fused_runner(pending, live, grad_in, root_k, run_vals, key):
+    """Fused fwd+vjp step runner, AOT-compiled for its memory analysis
+    when the telemetry plane is on (the steady-state step cache can
+    then report its compiled footprint on every later hit)."""
+    jitted = jax.jit(_build_fused_fn(pending, live, grad_in, root_k))
+    if _OBS.MEM and not any(isinstance(v, jax.core.Tracer)
+                            for v in run_vals):
+        from ..observability import memory as _memtel
+        with _quiet_donation_compile():
+            return _memtel.aot_compile(jitted, run_vals,
+                                       stat="fused_step",
+                                       cache=_FUSED_CACHE, key=key)
+    return jitted
+
+
+def _note_donated_inputs(in_vals, donate):
+    """Donation savings accounting: bytes of the input buffers this
+    executed program consumed in place (gated on _OBS.MEM by callers)."""
+    from ..observability import memory as _memtel
+    _memtel.note_donated(sum(getattr(in_vals[i], "nbytes", 0)
+                             for i in donate))
+
+
 @contextlib.contextmanager
 def _quiet_donation_compile():
     """Backends without buffer donation (CPU) warn at compile time and
@@ -603,6 +669,8 @@ class CaptureContext:
             # inputs produced by a still-in-flight async flush resolve
             # here (the pipeline's data-dependency sync)
             run_vals = resolve_pending(in_vals) if _ASYNC_SEEN else in_vals
+            if _flags.FAULT_INJECT_ACTIVE:
+                _inject_exec_oom()
             runner = _SEG_CACHE.get((sig, donate))
             # async dispatch: out_vals are in-flight futures — the host
             # returns to tracing the next ops while the device executes;
@@ -620,8 +688,8 @@ class CaptureContext:
                 if _OBS.METRICS:
                     from ..observability import metrics
                     metrics.inc("compiles.segment")
-                runner = jax.jit(_build_segment_fn(pending, live),
-                                 donate_argnums=donate)
+                runner = _compile_segment_runner(pending, live, donate,
+                                                 run_vals, sig)
                 _SEG_CACHE[(sig, donate)] = runner
                 with _quiet_donation_compile():   # first call compiles
                     out_vals = runner(*run_vals)
@@ -643,6 +711,10 @@ class CaptureContext:
             if fspan is not None:
                 fspan.end(error=e)
             _obs_flush_failed(reason, e)
+            oe = _oom_convert(e, f"segment::flush[{reason}]",
+                              _SEG_CACHE.memory_info((sig, donate)))
+            if oe is not e:
+                raise oe from e
             raise
         if _checks_on and donate:
             # cross-segment ledger (sanitizer dataflow): recorded only
@@ -651,6 +723,8 @@ class CaptureContext:
             # program into a false cross_segment_donation error
             from ..analysis.dataflow import note_segment_donation
             note_segment_donation(in_vals, donate, reason, pending)
+        if _OBS.MEM and donate:
+            _note_donated_inputs(in_vals, donate)
         self._reset_segment()
         self.breaks.append(reason)
         self.segments_run += 1
@@ -667,6 +741,13 @@ class CaptureContext:
                 grad_ts = [t for t in ts if not t.stop_gradient]
                 out_tensors.append(grad_ts[0] if grad_ts
                                    else (ts[0] if ts else None))
+
+            if _OBS.MEM:
+                # live-buffer census: segment outputs are born here,
+                # provenance = segment signature + producing op
+                from ..observability import memory as _memtel
+                _memtel.note_segment_outputs(pending, live, out_vals,
+                                             sig)
 
             # FLAGS_check_nan_inf covers fused-segment outputs too (the
             # per-op eager scan in dispatch.py never sees ops that were
@@ -762,6 +843,8 @@ class CaptureContext:
                     if _OBS.ACTIVE else None
                 run_vals = resolve_pending(in_vals)
                 dispatch.bump_exec()
+                if fault_active:
+                    _inject_exec_oom()
                 runner = _SEG_CACHE.get((sig, donate))
                 if runner is None:
                     if fault_active:
@@ -773,8 +856,9 @@ class CaptureContext:
                     if _OBS.METRICS:
                         from ..observability import metrics
                         metrics.inc("compiles.segment")
-                    runner = jax.jit(_build_segment_fn(pending, live),
-                                     donate_argnums=donate)
+                    runner = _compile_segment_runner(pending, live,
+                                                     donate, run_vals,
+                                                     sig)
                     _SEG_CACHE[(sig, donate)] = runner
                     with _quiet_donation_compile():
                         out_vals = runner(*run_vals)
@@ -789,6 +873,12 @@ class CaptureContext:
                     from ..analysis.dataflow import note_segment_donation
                     note_segment_donation(in_vals, donate, reason,
                                           pending)
+                if _OBS.MEM:
+                    if donate:
+                        _note_donated_inputs(in_vals, donate)
+                    from ..observability import memory as _memtel
+                    _memtel.note_segment_outputs(pending, live, out_vals,
+                                                 sig)
                 if nan_check:
                     for (j, _s), val in zip(live, out_vals):
                         dispatch._check_nan_inf(
@@ -804,14 +894,22 @@ class CaptureContext:
                 if fspan is not None:
                     fspan.end()
             except BaseException as e:
+                # RESOURCE_EXHAUSTED converts to the typed postmortem
+                # HERE, on the worker: the PendingValues and the
+                # executor latch carry the typed error, so the sync
+                # point re-raises exactly what the sync path would
+                oe = _oom_convert(e, "segment::flush[async]",
+                                  _SEG_CACHE.memory_info((sig, donate)))
                 for pv in pvs:
                     if not pv.done():
-                        pv._fail(e)
+                        pv._fail(oe)
                 if xspan is not None:
-                    xspan.end(error=e)
+                    xspan.end(error=oe)
                 if fspan is not None:
-                    fspan.end(error=e)
-                _obs_flush_failed(reason, e)
+                    fspan.end(error=oe)
+                _obs_flush_failed(reason, oe)
+                if oe is not e:
+                    raise oe from e
                 raise
 
         get_executor().submit(job)
@@ -1329,6 +1427,10 @@ class ReplayableSegment:
                 dispatch._check_nan_inf(
                     f"{self.pending[j].op.name} (replayed segment output)",
                     (val,))
+        if _OBS.MEM:
+            from ..observability import memory as _memtel
+            _memtel.note_segment_outputs(self.pending, self.live,
+                                         out_vals, self.sig)
         outs = []
         for meta, val in zip(self.metas, out_vals):
             outs.append(Tensor(val, stop_gradient=not meta.requires_grad))
@@ -1533,8 +1635,24 @@ def try_fused_backward(tensors, grad_tensors) -> bool:
                 fspan.end(error=e)
             _obs_flush_failed("backward_fused", e)
             raise
+    run_vals = None
     if compiled:
-        runner = jax.jit(_build_fused_fn(pending, live, grad_in, root_k))
+        try:
+            run_vals = resolve_pending(in_vals) if _ASYNC_SEEN \
+                else in_vals
+            runner = _compile_fused_runner(pending, live, grad_in,
+                                           root_k, run_vals, key)
+        except Exception as e:
+            # AOT compile (memory telemetry on) or pending-input
+            # resolution failed: clean up exactly like a failed compile
+            ctx._reset_segment()
+            if fspan is not None:
+                fspan.end(error=e)
+            _obs_flush_failed("backward_fused", e)
+            oe = _oom_convert(e, "backward_fused")
+            if oe is not e:
+                raise oe from e
+            raise
         _FUSED_CACHE[key] = runner
         if _OBS.METRICS:
             from ..observability import metrics
@@ -1543,7 +1661,11 @@ def try_fused_backward(tensors, grad_tensors) -> bool:
     xspan = _obs_exec_span(compiled, len(pending)) \
         if fspan is not None else None
     try:
-        run_vals = resolve_pending(in_vals) if _ASYNC_SEEN else in_vals
+        if run_vals is None:     # cache hit: not resolved above
+            run_vals = resolve_pending(in_vals) if _ASYNC_SEEN \
+                else in_vals
+        if _flags.FAULT_INJECT_ACTIVE:
+            _inject_exec_oom()
         out_vals, grads = runner(*run_vals)
     except Exception as e:
         ctx._reset_segment()
@@ -1553,6 +1675,10 @@ def try_fused_backward(tensors, grad_tensors) -> bool:
         if fspan is not None:
             fspan.end(error=e)
         _obs_flush_failed("backward_fused", e)
+        oe = _oom_convert(e, "backward_fused",
+                          _FUSED_CACHE.memory_info(key))
+        if oe is not e:
+            raise oe from e
         raise
     if xspan is not None:
         xspan.end()
@@ -1582,6 +1708,12 @@ def try_fused_backward(tensors, grad_tensors) -> bool:
     for ref, val in zip(live_refs, out_vals):
         for t in _live_aliases(ref):
             t._payload = val
+
+    if _OBS.MEM:
+        from ..observability import memory as _memtel
+        _memtel.note_segment_outputs(pending, live, out_vals, sig)
+        for g in grads:
+            _memtel.note_buffer(g, "fused_step.grad")
 
     from .autograd import GradNode, _accum
     from .tensor import Tensor

@@ -19,6 +19,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import dtype as dtypes_mod
+from ..observability import _state as _OBS
 from .autograd import AutogradMeta, is_grad_enabled, no_grad, run_backward
 
 
@@ -47,6 +48,14 @@ class Tensor:
         self.name = name
         self.persistable = False
         self._dist_attr = None  # set by paddle_tpu.distributed for DistTensor
+        if _OBS.MEM:
+            # live-buffer census (FLAGS_memory_telemetry): Tensor
+            # creation is THE eager choke point for concrete payloads;
+            # the birth site comes from the dispatcher's thread-local
+            # hint (eager:<op>) or defaults to tensor.create. Weakref
+            # only — the census never extends a buffer's lifetime.
+            from ..observability import memory as _memtel
+            _memtel.note_buffer(self._payload)
 
     # ----------------------------------------------------------- raw value
     @property
@@ -188,6 +197,12 @@ class Tensor:
         lazy.note_inplace(self)
         self._value = new_value
         self._inplace_version += 1
+        if _OBS.MEM:
+            # the swapped-in payload is a fresh buffer born HERE (the
+            # optimizer write-back path) — without this the census
+            # would lose every parameter after its first update
+            from ..observability import memory as _memtel
+            _memtel.note_buffer(self._payload)
         return self
 
     def set_value(self, value):

@@ -15,7 +15,9 @@ Plan grammar (semicolon- or comma-separated entries)::
 
 - ``site`` names an injection point: ``store::get``, ``store::set``,
   ``store::add``, ``store::wait``, ``pg::init``, ``comm::all_reduce``
-  (and every other ``comm::<op>``), ``segment::compile``, ``step::N``
+  (and every other ``comm::<op>``), ``segment::compile``,
+  ``exec::oom`` (the three segment execute sites — sync flush, async
+  worker, fused backward — pair it with kind ``oom``), ``step::N``
   (ElasticStep's N-th step), ``ckpt::save``, ``ckpt::load``, and the
   membership events ``member::leave`` / ``member::join`` polled by
   AdaptiveTrainer at every step boundary (any kind raised there is
@@ -30,7 +32,9 @@ Plan grammar (semicolon- or comma-separated entries)::
   non-retryable class that triggers world-shrink), ``delay(s)``
   (sleep s seconds, then proceed — a slow collective), ``stuck(s)``
   (sleep s seconds — long enough for the watchdog to fire — then
-  raise `CollectiveTimeout`).
+  raise `CollectiveTimeout`), ``oom`` (raise `ResourceExhausted` — a
+  synthetic RESOURCE_EXHAUSTED the execute sites convert into the
+  typed OOM postmortem).
 - ``:prob`` makes the entry probabilistic; draws come from a
   per-entry `random.Random` seeded by (seed, entry index), so the
   same seed and the same call sequence produce the SAME injection
@@ -78,9 +82,24 @@ class RankDeath(FaultError):
     world-shrink over the survivors, not a retry of the same op."""
 
 
+class ResourceExhausted(FaultError):
+    """Synthetic XLA RESOURCE_EXHAUSTED (kind ``oom``), fired at the
+    ``exec::oom`` execute sites so the OOM-postmortem path is drillable
+    without exhausting real device memory. NOT retryable — the message
+    carries the status name the execute sites' converter matches on,
+    so the drill takes exactly the real-OOM path (postmortem + typed
+    re-raise, including through the async flush worker)."""
+
+    def __init__(self, site: str, kind: str, occurrence: int):
+        FaultError.__init__(self, site, kind, occurrence)
+        self.args = (self.args[0]
+                     + " [synthetic RESOURCE_EXHAUSTED: out of memory]",)
+
+
 _DELAY_KINDS = ("delay", "stuck")
 _RAISE = {"fail": TransientFault, "drop": TransientFault,
-          "die": RankDeath, "stuck": CollectiveTimeout}
+          "die": RankDeath, "stuck": CollectiveTimeout,
+          "oom": ResourceExhausted}
 
 _ENTRY_RE = re.compile(
     r"^(?P<site>[^@=]+?)(?:@(?P<occ>\*|\d+))?="
@@ -139,7 +158,7 @@ class FaultPlan:
             if kind not in _RAISE and kind not in _DELAY_KINDS:
                 raise ValueError(
                     f"FLAGS_fault_inject: unknown kind {kind!r} in "
-                    f"{e!r} (fail | die | delay(s) | stuck(s))")
+                    f"{e!r} (fail | die | delay(s) | stuck(s) | oom)")
             occ = m.group("occ")
             occ = None if occ == "*" else (1 if occ is None else int(occ))
             arg = float(m.group("arg")) if m.group("arg") else 0.0

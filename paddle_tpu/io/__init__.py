@@ -475,6 +475,25 @@ class DevicePrefetcher:
             # touch nothing — placement was the loader's job
             return obj
         if isinstance(obj, np.ndarray):
+            from ..observability import _state as _obs
+            if _obs.ACTIVE:
+                # io::h2d carries the payload bytes, so the budget's
+                # host gap and the comm-overlap report price the input
+                # feed like any other transfer (device_put is async —
+                # the span times the dispatch, the bytes price the
+                # copy). Census birth site rides the same gate.
+                from ..observability.spans import span
+                _memtel = None
+                if _obs.MEM:
+                    from ..observability import memory as _memtel
+                    _memtel.set_site("io:h2d")
+                try:
+                    with span("io::h2d", hist="io.h2d_us",
+                              bytes=int(obj.nbytes)):
+                        return Tensor(jax.device_put(obj))
+                finally:
+                    if _memtel is not None:
+                        _memtel.clear_site()
             return Tensor(jax.device_put(obj))
         if isinstance(obj, (tuple, list)):
             return type(obj)(DevicePrefetcher._to_device(o) for o in obj)

@@ -12,7 +12,14 @@ evaluation, distributed collectives, optimizer updates):
   trace (`segment::flush[reason]` with compile/execute children);
 - **flight recorder** (`FLAGS_flight_recorder`): bounded ring of recent
   events, auto-dumped to a report on enforce errors, failed flushes,
-  and sanitizer error-mode trips.
+  and sanitizer error-mode trips (rank-aware retention via
+  FLAGS_flight_max_dumps).
+
+Plus the byte-domain plane (`FLAGS_memory_telemetry`, memory.py):
+live-buffer census with birth-site provenance, per-executable XLA
+memory analysis cached at compile time, donation savings accounting,
+and OOM postmortems with a typed re-raise — `stats()` gains a
+``memory`` section while it is on.
 
 Cost when everything is off: one module-level boolean check per
 instrumentation point (`observability._state.ACTIVE`), zero registry
@@ -37,6 +44,7 @@ __all__ = ["stats", "reset", "enable", "disable", "enabled",
 _flags.watch_flag("FLAGS_observability", _state.set_metrics)
 _flags.watch_flag("FLAGS_flight_recorder", _state.set_flight)
 _flags.watch_flag("FLAGS_distributed_telemetry", _state.set_dist)
+_flags.watch_flag("FLAGS_memory_telemetry", _state.set_mem)
 
 
 def enable(flight_recorder: bool = None):
@@ -97,6 +105,12 @@ def stats(reset_after: bool = False) -> dict:
     """
     snap = metrics.snapshot()
     snap.update(_derived(snap["counters"]))
+    if _state.MEM:
+        # byte-domain headline (census watermark + cached per-
+        # executable memory analysis) rides along whenever the memory
+        # telemetry plane is on
+        from . import memory as _memory
+        snap["memory"] = _memory.summary()
     if reset_after:
         reset()
     return snap

@@ -12,8 +12,11 @@ Modes:
   registry snapshot (counters / derived rates / histograms).
 - ``budget``: the per-step time-budget profile — spans aggregated into
   a ranked table (segment flush/compile/execute, sot::, optimizer::,
-  comm::, plus the unspanned **host gap**), the measurement that
+  comm::, io::, plus the unspanned **host gap**), the measurement that
   decides which hot-path item to burn next (observability/budget.py).
+  The memory telemetry plane rides along: the header carries per-step
+  byte columns (census peak watermark, compiled temp footprint from
+  cached memory_analysis, donated bytes per step).
 - ``budget --distributed``: the cross-rank edition — spawns
   ``--nranks`` local trainer ranks over the distributed launcher, each
   publishing telemetry frames through a shared TCPStore while running
@@ -207,7 +210,8 @@ KILL_STEP = int(os.environ.get("TELEM_KILL_STEP", "2"))
 
 paddle.set_flags({"FLAGS_observability": True,
                   "FLAGS_flight_recorder": True,
-                  "FLAGS_distributed_telemetry": True})
+                  "FLAGS_distributed_telemetry": True,
+                  "FLAGS_memory_telemetry": True})
 if RANK == SLOW:
     delay = os.environ.get("TELEM_SLOW_DELAY", "0.05")
     paddle.set_flags({"FLAGS_fault_inject":          # @* = every step
@@ -377,6 +381,12 @@ def _render(snap: dict) -> str:
         v = snap[k]
         lines.append(f"  {k + ':':<21}"
                      + ("n/a" if v is None else f"{v:.3f}"))
+    mem = snap.get("memory")
+    if mem:
+        lines.append(f"  memory:              live {mem['live_bytes']} B"
+                     f", peak {mem['peak_bytes']} B, donated "
+                     f"{mem['donated_bytes']} B, census {mem['census']} "
+                     f"buffer(s)")
     lines.append("  counters:")
     for k in sorted(snap["counters"]):
         lines.append(f"    {k:<40} {snap['counters'][k]}")

@@ -16,6 +16,7 @@ METRICS = False   # FLAGS_observability: registry collection at hot sites
 TRACE = False     # profiler is recording: spans land in the host trace
 FLIGHT = False    # FLAGS_flight_recorder: ring-buffer event capture
 DIST = False      # FLAGS_distributed_telemetry: cross-rank frame plane
+MEM = False       # FLAGS_memory_telemetry: live-buffer census + bytes
 
 # The single gate hot paths read: any consumer on.
 ACTIVE = False
@@ -23,7 +24,7 @@ ACTIVE = False
 
 def recompute():
     global ACTIVE
-    ACTIVE = METRICS or TRACE or FLIGHT or DIST
+    ACTIVE = METRICS or TRACE or FLIGHT or DIST or MEM
 
 
 def set_metrics(on: bool):
@@ -47,4 +48,10 @@ def set_flight(on: bool):
 def set_dist(on: bool):
     global DIST
     DIST = bool(on)
+    recompute()
+
+
+def set_mem(on: bool):
+    global MEM
+    MEM = bool(on)
     recompute()

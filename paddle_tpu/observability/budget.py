@@ -41,26 +41,66 @@ def collect(run_fn: Callable[[], None], steps: int,
     and return the ranked per-step budget dict. Compile warms up
     off-clock so the budget describes the steady state; the compile
     rows of the ranked table then show residual (cache-miss) compiles
-    only."""
-    from . import enable, disable, stats
-    from .._core.flags import flag_value
+    only.
 
-    for _ in range(warmup):
-        run_fn()
-    was_on = flag_value("FLAGS_observability")
-    enable()
-    # delta against a pre-run snapshot, NOT reset(): a session that
-    # already has observability on (bench rows freeze-asserting
-    # counters around this call) must not have its registry wiped
-    before = stats()
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        run_fn()
-    wall_us = (time.perf_counter() - t0) * 1e6
-    snap = _delta(before, stats())
-    if not was_on:
-        disable()
-    return _rank(snap, wall_us, steps)
+    The memory telemetry plane is switched on for the whole run —
+    including the warmup, so the warmup compiles capture their
+    ``memory_analysis()`` — and the result gains a ``memory`` section:
+    peak bytes (census watermark over the measured window), the
+    steady-state compiled temp footprint (cached per-executable
+    analysis, no re-lowering), and donated bytes per step."""
+    from . import enable, disable, stats
+    from . import memory as _memtel
+    from .._core.flags import flag_value, set_flags
+
+    mem_was = flag_value("FLAGS_memory_telemetry")
+    if not mem_was:
+        set_flags({"FLAGS_memory_telemetry": True})
+    try:
+        seq0 = _memtel.exec_seq()
+        for _ in range(warmup):
+            run_fn()
+        was_on = flag_value("FLAGS_observability")
+        enable()
+        # delta against a pre-run snapshot, NOT reset(): a session that
+        # already has observability on (bench rows freeze-asserting
+        # counters around this call) must not have its registry wiped
+        before = stats()
+        _memtel.reset_peak()
+        donated0 = _memtel.donated_bytes()
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            run_fn()
+        wall_us = (time.perf_counter() - t0) * 1e6
+        snap = _delta(before, stats())
+        peak = _memtel.peak_bytes()
+        live = _memtel.live_bytes()
+        donated = _memtel.donated_bytes() - donated0
+        execs = _memtel.executable_stats()
+        if not was_on:
+            disable()
+    finally:
+        if not mem_was:
+            set_flags({"FLAGS_memory_telemetry": False})
+    out = _rank(snap, wall_us, steps)
+    # prefer executables compiled DURING this collect (warmup included)
+    # so another workload's entries in the process-global log can't
+    # pollute the column; a fully-warm process (no new compiles — the
+    # caches already hold this workload, analyzed earlier) falls back
+    # to the whole log
+    fresh = [e for e in execs if e.get("seq", 0) > seq0]
+    execs = fresh or execs
+    temps = [e.get("temp_bytes") or 0 for e in execs]
+    out["memory"] = {
+        "peak_bytes": int(peak),
+        "live_bytes": int(live),
+        "donated_bytes_per_step": round(donated / steps, 1),
+        # largest temp allocation among the compiled executables this
+        # workload runs — its steady-state compiled footprint
+        "temp_bytes": int(max(temps)) if temps else 0,
+        "executables": execs[-6:],
+    }
+    return out
 
 
 def _delta(before: Dict, after: Dict) -> Dict:
@@ -133,9 +173,19 @@ def _rank(snap: Dict, wall_us: float, steps: int) -> Dict:
         "counters": {k: counters[k] for k in sorted(counters)
                      if k.startswith(("segment.", "cache.", "compiles.",
                                       "optimizer.", "sot.", "eager.",
-                                      "fusion.", "comm."))},
+                                      "fusion.", "comm.", "memory.",
+                                      "io."))},
         "step_cache_hit_rate": snap.get("step_cache_hit_rate"),
     }
+
+
+def _fmt_bytes(n) -> str:
+    n = float(n)
+    for unit in ("B", "KB", "MB"):
+        if abs(n) < 1024.0:
+            return f"{n:.1f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024.0
+    return f"{n:.2f} GB"
 
 
 def render(budget: Dict, title: str = "per-step budget") -> str:
@@ -144,8 +194,15 @@ def render(budget: Dict, title: str = "per-step budget") -> str:
              f"  accounted:      {budget['accounted_us_per_step']:>12.1f}"
              f" us",
              f"  host gap:       {budget['host_gap_us_per_step']:>12.1f}"
-             f" us",
-             "  ranked components:"]
+             f" us"]
+    mem = budget.get("memory")
+    if mem:
+        lines.append(
+            f"  memory:         peak {_fmt_bytes(mem['peak_bytes'])} | "
+            f"temp {_fmt_bytes(mem['temp_bytes'])} | "
+            f"donated/step {_fmt_bytes(mem['donated_bytes_per_step'])} |"
+            f" live(end) {_fmt_bytes(mem['live_bytes'])}")
+    lines.append("  ranked components:")
     for e in budget["entries"]:
         calls = ("" if e["calls_per_step"] is None
                  else f"  x{e['calls_per_step']:g}/step")

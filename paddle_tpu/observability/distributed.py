@@ -210,6 +210,15 @@ class TelemetryPublisher:
             "marks": [[s, round(t, 3), round(d, 3)]
                       for s, t, d in self._marks],
         }
+        if _state.MEM:
+            # byte-domain deltas ride the frame: rank 0's step table
+            # grows a per-rank memory column from these (watermark +
+            # census size + donation total, all O(1) reads)
+            from . import memory as _memtel
+            frame["mem"] = {"live": _memtel.live_bytes(),
+                            "peak": _memtel.peak_bytes(),
+                            "donated": _memtel.donated_bytes(),
+                            "census": _memtel.census_size()}
         self._marks = []
         self.frames.append(frame)
         self._q.append(frame)        # drop-oldest: never blocks
@@ -455,7 +464,10 @@ class TelemetryAggregator:
         """rank -> step -> {"comm": [intervals], "other": [intervals],
         "bytes": payload} — every span event bucketed into its rank's
         step window by midpoint (rank-local timeline; no cross-rank
-        clock involved)."""
+        clock involved). Transfers are the ``comm::*`` collectives AND
+        the ``io::*`` device-feed spans (io::h2d carries payload bytes
+        the same way), so the input feed is priced like any other
+        transfer."""
         import bisect
         out: Dict[int, Dict[int, Dict]] = {}
         for r in self.ranks:
@@ -483,7 +495,7 @@ class TelemetryAggregator:
                     b = buckets.setdefault(
                         s, {"comm": [], "other": [], "bytes": 0})
                     iv = (t0_us, t0_us + dur_us)
-                    if span_family(name) == "comm":
+                    if span_family(name) in ("comm", "io"):
                         b["comm"].append(iv)
                         b["bytes"] += int(nbytes)
                     else:
@@ -598,8 +610,34 @@ class TelemetryAggregator:
                 "slowest": slowest}
         return {"ranks": self.ranks, "steps": rows,
                 "families": families,
+                "memory": self._memory_column(),
                 "straggler_counts": {str(r): n for r, n in
                                      strag_counts.items()}}
+
+    def _memory_column(self) -> Optional[Dict]:
+        """Per-rank byte watermark from the newest frame that carried a
+        ``mem`` section (FLAGS_memory_telemetry on that rank), plus the
+        rank nearest its HBM budget: peak/FLAGS_memory_budget_bytes
+        when the budget is known, highest absolute peak otherwise —
+        THE number that picks the mesh degree before scaling a model
+        up (memory, not FLOPs, binds first on TPUs)."""
+        col: Dict[str, Dict] = {}
+        for r in self.ranks:
+            for frame in reversed(self.frames(r)):
+                m = frame.get("mem")
+                if m:
+                    col[str(r)] = m
+                    break
+        if not col:
+            return None
+        from .._core.flags import flag_value
+        budget_b = int(flag_value("FLAGS_memory_budget_bytes"))
+        nearest = max(col, key=lambda rs: col[rs].get("peak", 0))
+        frac = (round(col[nearest].get("peak", 0) / budget_b, 4)
+                if budget_b > 0 else None)
+        return {"ranks": col, "budget_bytes": budget_b,
+                "nearest_budget": int(nearest),
+                "nearest_budget_frac": frac}
 
     # ----------------------------------------------------- comm overlap
     def overlap_report(self) -> Dict:
@@ -854,6 +892,20 @@ def render_step_table(table: Dict) -> str:
             lines.append(f"    {fam:<12} skew={info['skew_us']:>10.1f} "
                          f"slowest=r{info['slowest']} "
                          f"median={info['median_us']:.1f}")
+    if table.get("memory"):
+        mem = table["memory"]
+        cells = "  ".join(
+            f"r{r}={mem['ranks'][str(r)].get('peak', 0) / 1048576.0:.1f}"
+            f"MB" for r in ranks if str(r) in mem["ranks"])
+        near = mem["nearest_budget"]
+        if mem.get("budget_bytes"):
+            frac = mem.get("nearest_budget_frac")
+            tail = (f"nearest budget: r{near} at "
+                    f"{frac * 100.0:.0f}% of "
+                    f"{mem['budget_bytes'] / 1048576.0:.0f}MB")
+        else:
+            tail = f"highest peak: r{near} (no FLAGS_memory_budget_bytes)"
+        lines.append(f"  per-rank peak memory: {cells}  [{tail}]")
     if table["straggler_counts"]:
         lines.append(f"  straggler flags: "
                      + ", ".join(f"r{r}x{n}" for r, n in

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import collections
 import os
+import re
 import threading
 import time
 from typing import Optional
@@ -110,12 +111,60 @@ def _dump_dir() -> str:
             or flags.flag_value("FLAGS_profiler_dir") or ".")
 
 
+# auto-named dump files eligible for retention pruning: plain dumps
+# and OOM postmortems, tagged (group 1 = rank) or untagged. Distributed
+# postmortem reports (flight_distributed_*) and any explicit-path dump
+# never match, so retention can never eat them.
+_PRUNABLE_RE = re.compile(
+    r"^flight_(?:oom_)?(?:r(\d+)_)?\d+_\d+\.txt$")
+
+
+def _prune_dumps(d: str, rank: Optional[int]):
+    """Retention: keep the newest FLAGS_flight_max_dumps auto-named
+    dumps in `d` BELONGING TO THIS RANK (rank-aware — a churning rank
+    pruning only its own files can never evict another rank's
+    postmortem from a shared dump dir). 0 disables pruning."""
+    from .._core import flags
+    keep = int(flags.flag_value("FLAGS_flight_max_dumps"))
+    if keep <= 0:
+        return
+    mine = []
+    try:
+        names = os.listdir(d)
+    except OSError:
+        return
+    for name in names:
+        m = _PRUNABLE_RE.match(name)
+        if m is None:
+            continue
+        r = int(m.group(1)) if m.group(1) is not None else None
+        if r != rank:
+            continue
+        p = os.path.join(d, name)
+        try:
+            mine.append((os.path.getmtime(p), p))
+        except OSError:
+            continue
+    if len(mine) <= keep:
+        return
+    mine.sort()            # oldest first
+    for _, p in mine[:len(mine) - keep]:
+        try:
+            os.remove(p)
+        except OSError:
+            pass
+
+
 def dump(reason: str = "", path: str = None) -> str:
     """Write the report to a file and return its path. The default
     filename is rank-tagged (`flight_r<rank>_<pid>_<seq>.txt` inside a
     launched job) so concurrent multi-process dumps into one shared
-    FLAGS_flight_recorder_dir can never clobber each other."""
+    FLAGS_flight_recorder_dir can never clobber each other; after each
+    auto-named dump the oldest files beyond FLAGS_flight_max_dumps are
+    pruned (this rank's only)."""
     global _DUMP_SEQ
+    prune_dir = None
+    rank = None
     if path is None:
         d = _dump_dir()
         os.makedirs(d, exist_ok=True)
@@ -125,11 +174,14 @@ def dump(reason: str = "", path: str = None) -> str:
         rank = _rank()
         tag = f"r{rank}_" if rank is not None else ""
         path = os.path.join(d, f"flight_{tag}{os.getpid()}_{seq}.txt")
+        prune_dir = d
     body = record()
     if reason:
         body = f"trigger: {reason}\n{body}"
     with open(path, "w") as f:
         f.write(body + "\n")
+    if prune_dir is not None:
+        _prune_dumps(prune_dir, rank)
     from . import metrics
     metrics.inc("flight.dumps")
     return path

@@ -153,8 +153,12 @@ class Optimizer:
         from .._core.lazy import _quiet_donation_compile
         try:
             with _quiet_donation_compile():   # no-donation backends (CPU)
-                new_p, new_s = fn(pvals, gvals, states, lr, t,
-                                  wds=wds, lr_mults=lr_mults)
+                if _OBS.MEM:
+                    new_p, new_s = self._run_analyzed(
+                        fn, pvals, gvals, states, lr, t, wds, lr_mults)
+                else:
+                    new_p, new_s = fn(pvals, gvals, states, lr, t,
+                                      wds=wds, lr_mults=lr_mults)
         except Exception as e:
             # a failed update must still close the span so the flight
             # record shows the step that died
@@ -163,6 +167,15 @@ class Optimizer:
             raise
         if ospan is not None:
             ospan.end()
+        if _OBS.MEM and fn is self._jit_update:
+            # donation savings: the donated runner consumed every old
+            # param/state buffer in place — the bytes the fused
+            # optimizer's donate_argnums machinery saved this step
+            from ..observability import memory as _memtel
+            _memtel.note_donated(
+                sum(int(v.nbytes) for v in pvals)
+                + sum(int(v.nbytes)
+                      for v in jax.tree_util.tree_leaves(states)))
         if _track_donation:
             # sanitizer cross-segment dataflow: the fused update donated
             # the old param/state buffers — thread their identity into
@@ -174,14 +187,49 @@ class Optimizer:
             note_optimizer_donation(
                 pvals, jax.tree_util.tree_leaves(states),
                 type(self).__name__)
-        for (p, _), meta, np_, ns in zip(pairs, metas, new_p, new_s):
-            pid = id(p)
-            self._states[pid] = ns
-            if pid in self._master:
-                self._master[pid] = np_
-                p._replace_value_inplace(np_.astype(p._value.dtype))
-            else:
-                p._replace_value_inplace(np_)
+        _memtel = None
+        if _OBS.MEM:
+            # census birth site for the write-back below: updated
+            # parameter payloads are born at the fused optimizer step
+            from ..observability import memory as _memtel
+            _memtel.set_site("optimizer.param_update")
+        try:
+            for (p, _), meta, np_, ns in zip(pairs, metas, new_p, new_s):
+                pid = id(p)
+                self._states[pid] = ns
+                if pid in self._master:
+                    self._master[pid] = np_
+                    p._replace_value_inplace(np_.astype(p._value.dtype))
+                else:
+                    p._replace_value_inplace(np_)
+        finally:
+            if _memtel is not None:
+                _memtel.clear_site()
+
+    def _run_analyzed(self, fn, pvals, gvals, states, lr, t, wds,
+                      lr_mults):
+        """Memory-telemetry path (FLAGS_memory_telemetry): run the
+        fused update through an AOT-compiled executable so its
+        ``memory_analysis()`` is captured exactly once per (donation,
+        signature) — the fused optimizer is the third compile site the
+        byte plane covers. Behavior is identical to calling the jitted
+        `fn`; the compiled object is cached per signature."""
+        from ..observability import memory as _memtel
+        leaves, treedef = jax.tree_util.tree_flatten(
+            (pvals, gvals, states, lr, t))
+        sig = (fn is self._jit_update, wds, lr_mults, str(treedef),
+               tuple((tuple(v.shape), str(v.dtype)) for v in leaves))
+        cache = self.__dict__.setdefault("_aot_updates", {})
+        compiled = cache.get(sig)
+        if compiled is None:
+            compiled = _memtel.aot_compile(
+                fn, (pvals, gvals, states, lr, t),
+                kwargs={"wds": wds, "lr_mults": lr_mults},
+                stat="optimizer", key=sig)
+            if len(cache) > 8:     # param-group churn guard
+                cache.clear()
+            cache[sig] = compiled
+        return compiled(pvals, gvals, states, lr, t)
 
     def _pick_update(self, pvals, gvals, states):
         """Donating runner unless disabled, a buffer appears twice in

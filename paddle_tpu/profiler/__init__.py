@@ -137,6 +137,18 @@ def _add_span_event(name: str, ts_us: float, dur_us: float, args=None):
     _append_event(ev)
 
 
+def _add_counter_event(name: str, value):
+    """Chrome counter-track sample (ph='C') — the memory telemetry
+    plane feeds memory.live_bytes here on census changes while a
+    profiler records, so the trace shows the byte watermark as a
+    counter lane alongside the runtime spans."""
+    if not _recording:
+        return
+    _append_event({"name": name, "tid": _tid(), "ph": "C",
+                   "ts": time.perf_counter_ns() / 1000.0,
+                   "cat": "runtime", "args": {"bytes": int(value)}})
+
+
 class RecordEvent:
     """User-scope host event (profiler/utils.py RecordEvent analog).
     Disabled cost: one module-level bool per begin/end."""
@@ -396,8 +408,10 @@ class Profiler:
     def export(self, path: str, format: str = "json"):
         pid = os.getpid()
         trace_events = [
-            {"name": e["name"], "ph": "X", "pid": pid,
-             "tid": e["tid"], "ts": e["ts"], "dur": e["dur"],
+            # counter samples (ph='C': the memory track) carry no dur
+            {"name": e["name"], "ph": e.get("ph", "X"), "pid": pid,
+             "tid": e["tid"], "ts": e["ts"],
+             **({"dur": e["dur"]} if "dur" in e else {}),
              "cat": e.get("cat", "host"),
              **({"args": e["args"]} if "args" in e else {})}
             for e in self.events()

@@ -25,6 +25,8 @@ def summary(events: List[dict], sorted_by: Optional[SortedKeys] = None,
             time_unit: str = "ms") -> str:
     agg = {}
     for e in events:
+        if "dur" not in e:
+            continue     # counter samples (memory track) have no span
         a = agg.setdefault(e["name"],
                            {"calls": 0, "total": 0.0, "max": 0.0,
                             "min": float("inf")})

@@ -1,0 +1,375 @@
+"""Memory telemetry plane (FLAGS_memory_telemetry) — the byte-domain
+acceptance contract:
+
+- **off is free**: with the flag off, a LeNet train loop (async flush
+  on) does zero registry work, registers zero census entries, and makes
+  zero ``memory_analysis()`` calls;
+- **census hygiene**: the live-buffer census holds weakrefs only —
+  freed and donated buffers leave it, and no Tensor is kept alive by
+  its own telemetry;
+- **analysis cached per executable**: one ``memory_analysis()`` call
+  per compile, landing on the ExecCache entry; a step-cache hit makes
+  zero calls;
+- **donation accounting**: the lazy-flush mask and the fused
+  optimizer's donate_argnums count ``memory.donated_bytes`` per step;
+- **OOM postmortem**: the seeded ``exec::oom`` drill produces a typed
+  ``ResourceExhaustedError`` whose postmortem names the planted large
+  live buffer with provenance — including through the async-flush
+  worker, which re-raises typed at the sync point;
+- **surfaces**: budget gains peak/temp/donated byte columns, telemetry
+  frames carry the watermark, and the distributed step table grows a
+  per-rank memory column flagging the rank nearest its budget.
+"""
+import gc
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from conftest import with_flag
+from paddle_tpu._core import async_flush, lazy
+from paddle_tpu.base.core import ResourceExhaustedError
+from paddle_tpu.observability import memory as memtel
+from paddle_tpu.observability import metrics
+
+
+@pytest.fixture
+def mem_on():
+    paddle.set_flags({"FLAGS_memory_telemetry": True})
+    try:
+        yield
+    finally:
+        paddle.set_flags({"FLAGS_memory_telemetry": False})
+        memtel.reset()
+
+
+def _lenet_step_fn(batch=8):
+    from paddle_tpu.vision.models import LeNet
+    paddle.seed(0)
+    model = LeNet()
+    opt = paddle.optimizer.Adam(1e-3, parameters=model.parameters())
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(batch, 1, 28, 28).astype(np.float32))
+    y = paddle.to_tensor(rng.randint(0, 10, (batch,)).astype(np.int64))
+
+    def step():
+        loss = F.cross_entropy(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return float(np.asarray(loss._value))
+
+    return step, model
+
+
+# ------------------------------------------------------------ off contract
+
+def test_memory_telemetry_off_is_free():
+    """LeNet loop with async flush on, telemetry off: zero registry
+    mutations, zero census entries, zero analysis calls (checks off for
+    the freeze window — the warn-mode sanitizer counts by design)."""
+    step, _ = _lenet_step_fn()
+    step()     # warm every compile off-window
+    memtel.reset()
+    with with_flag("FLAGS_static_checks", "off"), \
+            with_flag("FLAGS_async_flush", True):
+        before = metrics.MUTATIONS
+        calls0 = memtel.ANALYSIS_CALLS
+        for _ in range(3):
+            step()
+        async_flush.drain()
+        assert metrics.MUTATIONS == before, \
+            "memory-telemetry-off loop did registry work"
+        assert memtel.census_size() == 0, \
+            "memory-telemetry-off loop registered census entries"
+        assert memtel.ANALYSIS_CALLS == calls0
+    async_flush.drain(raise_latched=False)
+
+
+# ----------------------------------------------------------------- census
+
+def test_census_tracks_births_with_provenance(mem_on):
+    x = paddle.to_tensor(np.ones((32, 32), "float32"))
+    y = x
+    for _ in range(4):
+        y = y * 1.0001 + 0.0001
+    np.asarray(y._value)
+    rows = memtel.census()
+    sites = [r["site"] for r in rows]
+    assert any(s == "tensor.create" for s in sites)          # x itself
+    assert any(s.startswith("seg@") and "#" in s for s in sites), sites
+    assert memtel.live_bytes() == sum(r["nbytes"] for r in rows)
+    assert memtel.peak_bytes() >= memtel.live_bytes()
+
+
+def test_census_weakref_hygiene(mem_on):
+    x = paddle.to_tensor(np.ones((64, 64), "float32"))
+    y = (x * 2.0)
+    np.asarray(y._value)
+    live0 = memtel.live_bytes()
+    n0 = memtel.census_size()
+    del y
+    gc.collect()
+    # the freed segment output left the census; nothing telemetry-side
+    # kept it alive
+    assert memtel.census_size() == n0 - 1
+    assert memtel.live_bytes() == live0 - 64 * 64 * 4
+
+
+def test_no_tensor_kept_alive_by_telemetry(mem_on):
+    import weakref
+    t = paddle.to_tensor(np.ones((16, 16), "float32"))
+    wt = weakref.ref(t)
+    wp = weakref.ref(t._payload)
+    del t
+    gc.collect()
+    assert wt() is None and wp() is None
+
+
+def test_donation_accounting_and_census_stability(mem_on):
+    step, model = _lenet_step_fn()
+    step()                      # states initialized, caches warm
+    d0 = memtel.donated_bytes()
+    n0 = memtel.census_size()
+    for _ in range(3):
+        step()
+        gc.collect()
+    # the fused optimizer donates every param+state buffer per step
+    param_bytes = sum(int(np.prod(p.shape)) * 4
+                      for p in model.parameters())
+    assert memtel.donated_bytes() - d0 >= 3 * param_bytes
+    # donated (old) buffers leave the census: steady state can't grow
+    assert memtel.census_size() <= n0 + 2
+
+
+# ------------------------------------------- per-executable memory analysis
+
+def test_memory_analysis_cached_per_executable(mem_on):
+    x = paddle.to_tensor(np.ones((17, 23), "float32"))  # fresh signature
+
+    def run():
+        y = x
+        for _ in range(6):
+            y = y * 1.0001 + 0.0001
+        np.asarray(y._value)
+
+    calls0 = memtel.ANALYSIS_CALLS
+    run()                                   # compiles -> one analysis
+    after_compile = memtel.ANALYSIS_CALLS
+    assert after_compile == calls0 + 1
+    for _ in range(3):                      # steady state: cache hits
+        run()
+    assert memtel.ANALYSIS_CALLS == after_compile, \
+        "a cache hit re-ran memory_analysis"
+    infos = [lazy._SEG_CACHE.memory_info(k)
+             for k in list(lazy._SEG_CACHE)]
+    infos = [i for i in infos if i is not None]
+    assert infos and all("argument_bytes" in i for i in infos)
+
+
+def test_fused_step_and_optimizer_analyzed(mem_on):
+    step, _ = _lenet_step_fn(batch=9)       # fresh step-cache signature
+    calls0 = memtel.ANALYSIS_CALLS
+    step()
+    caches = {e["cache"] for e in memtel.executable_stats()}
+    assert "fused_step" in caches and "optimizer" in caches
+    after = memtel.ANALYSIS_CALLS
+    assert after > calls0
+    step()
+    step()
+    assert memtel.ANALYSIS_CALLS == after, \
+        "steady-state steps re-analyzed a cached executable"
+
+
+# ----------------------------------------------------------- OOM postmortem
+
+def test_oom_drill_sync_postmortem(mem_on, tmp_path):
+    planted = paddle.to_tensor(np.zeros((512, 512), "float32"))  # 1 MiB
+    assert planted is not None
+    x = paddle.to_tensor(np.ones((8, 8), "float32"))
+    with with_flag("FLAGS_flight_recorder", True), \
+            with_flag("FLAGS_flight_recorder_dir", str(tmp_path)), \
+            with_flag("FLAGS_fault_inject", "exec::oom=oom"):
+        with pytest.raises(ResourceExhaustedError) as ei:
+            np.asarray((x * 2.0)._value)
+    path = ei.value.postmortem_path
+    assert path and os.path.exists(path)
+    body = open(path).read()
+    assert "RESOURCE_EXHAUSTED" in body
+    assert "1048576" in body, "postmortem must name the planted buffer"
+    assert "tensor.create" in body          # its birth-site provenance
+    assert "watermark" in body
+    # postmortem counted; typed error is a MemoryError subclass too
+    assert isinstance(ei.value, MemoryError)
+
+
+def test_oom_drill_async_typed_at_sync_point(mem_on, tmp_path):
+    planted = paddle.to_tensor(np.zeros((256, 256), "float32"))
+    assert planted is not None
+    with with_flag("FLAGS_flight_recorder_dir", str(tmp_path)), \
+            with_flag("FLAGS_async_flush", True), \
+            with_flag("FLAGS_lazy_max_segment_ops", 8), \
+            with_flag("FLAGS_fault_inject", "exec::oom=oom"):
+        x = paddle.to_tensor(np.ones((8, 8), "float32"))
+        y = x
+        for _ in range(12):     # cap-seal -> the worker fires the fault
+            y = y + 1.0
+        with pytest.raises(ResourceExhaustedError) as ei:
+            np.asarray(y._value)
+        assert ei.value.postmortem_path
+        assert "262144" in open(ei.value.postmortem_path).read()
+    async_flush.drain(raise_latched=False)
+
+
+# ----------------------------------------------------------------- surfaces
+
+def test_budget_gains_byte_columns():
+    from paddle_tpu.observability import budget
+    x = paddle.to_tensor(np.ones((16, 16), "float32"))
+
+    def step():
+        y = x
+        for _ in range(4):
+            y = y * 1.0001
+        np.asarray(y._value)
+
+    out = budget.collect(step, steps=3, warmup=1)
+    mem = out["memory"]
+    for key in ("peak_bytes", "temp_bytes", "donated_bytes_per_step",
+                "live_bytes"):
+        assert key in mem
+    assert mem["peak_bytes"] > 0
+    text = budget.render(out)
+    assert "memory:" in text and "peak" in text
+    memtel.reset()
+    paddle.set_flags({"FLAGS_memory_telemetry": False})
+
+
+def test_frame_carries_watermark(mem_on):
+    from paddle_tpu.observability import distributed as dtel
+
+    class _Store:
+        def __init__(self):
+            self.d = {}
+
+        def set(self, k, v):
+            self.d[k] = v
+
+    t = paddle.to_tensor(np.ones((32, 32), "float32"))
+    assert t is not None
+    pub = dtel.TelemetryPublisher(_Store(), rank=0, world_size=1)
+    try:
+        pub.on_step(1)
+        frame = pub.frames[-1]
+        assert frame["mem"]["live"] > 0
+        assert frame["mem"]["peak"] >= frame["mem"]["live"]
+        assert frame["mem"]["census"] >= 1
+    finally:
+        pub.shutdown()
+
+
+def _frame(rank, peak, step=1):
+    return {"v": 1, "rank": rank, "seq": 1, "step": step,
+            "t_wall": 0.0, "t_perf_us": 0.0, "counters": {},
+            "hists": {}, "spans": [],
+            "marks": [[step, 1000.0 * (rank + 1), 900.0]],
+            "mem": {"live": peak // 2, "peak": peak, "donated": 0,
+                    "census": 3}}
+
+
+def test_step_table_memory_column():
+    from paddle_tpu.observability import distributed as dtel
+    agg = dtel.TelemetryAggregator()
+    agg.add_frame(_frame(0, 1000))
+    agg.add_frame(_frame(1, 4000))
+    agg.add_frame(_frame(2, 2000))
+    table = agg.step_table()
+    mem = table["memory"]
+    assert set(mem["ranks"]) == {"0", "1", "2"}
+    assert mem["nearest_budget"] == 1       # highest peak, no budget
+    assert mem["nearest_budget_frac"] is None
+    with with_flag("FLAGS_memory_budget_bytes", 8000):
+        mem2 = agg.step_table()["memory"]
+        assert mem2["nearest_budget"] == 1
+        assert mem2["nearest_budget_frac"] == 0.5
+        text = dtel.render_step_table(agg.step_table())
+    assert "per-rank peak memory" in text and "r1" in text
+
+
+def test_step_table_without_mem_frames_has_no_column():
+    from paddle_tpu.observability import distributed as dtel
+    agg = dtel.TelemetryAggregator()
+    f = _frame(0, 100)
+    del f["mem"]
+    agg.add_frame(f)
+    table = agg.step_table()
+    assert table["memory"] is None
+    assert "per-rank peak memory" not in dtel.render_step_table(table)
+
+
+def test_h2d_span_prices_input_feed():
+    from paddle_tpu.io import DevicePrefetcher
+    with with_flag("FLAGS_observability", True):
+        before = metrics.snapshot()["histograms"].get(
+            "io.h2d_us", {}).get("count") or 0
+        batches = [np.ones((4, 8), "float32") for _ in range(3)]
+        out = list(DevicePrefetcher(iter(batches), depth=2))
+        assert len(out) == 3
+        snap = metrics.snapshot()["histograms"]["io.h2d_us"]
+        assert (snap["count"] or 0) >= before + 3
+
+
+# --------------------------------------------------- flight dump retention
+
+def test_flight_dump_retention_rank_aware(tmp_path):
+    from paddle_tpu.observability import flight
+    # a foreign rank's postmortem and a distributed report must SURVIVE
+    # this process's churn
+    foreign = tmp_path / "flight_r7_123_1.txt"
+    foreign.write_text("foreign rank postmortem")
+    distd = tmp_path / "flight_distributed_r0_99.txt"
+    distd.write_text("distributed report")
+    with with_flag("FLAGS_flight_recorder", True), \
+            with_flag("FLAGS_flight_recorder_dir", str(tmp_path)), \
+            with_flag("FLAGS_flight_max_dumps", 3):
+        flight.note("test", "retention")
+        paths = [flight.dump(reason="retention test")
+                 for _ in range(6)]
+    names = sorted(os.listdir(tmp_path))
+    own = [n for n in names if flight._PRUNABLE_RE.match(n)
+           and not n.startswith("flight_r7_")]
+    assert len(own) == 3, names
+    # the newest three survived, oldest pruned
+    assert os.path.basename(paths[-1]) in names
+    assert os.path.basename(paths[0]) not in names
+    assert foreign.name in names and distd.name in names
+    flight.reset()
+
+
+def test_flight_max_dumps_zero_disables_pruning(tmp_path):
+    from paddle_tpu.observability import flight
+    with with_flag("FLAGS_flight_recorder", True), \
+            with_flag("FLAGS_flight_recorder_dir", str(tmp_path)), \
+            with_flag("FLAGS_flight_max_dumps", 0):
+        flight.note("test", "retention")
+        for _ in range(5):
+            flight.dump(reason="no pruning")
+    own = [n for n in os.listdir(tmp_path)
+           if flight._PRUNABLE_RE.match(n)]
+    assert len(own) == 5
+    flight.reset()
+
+
+# ------------------------------------------------------------ fault plumbing
+
+def test_exec_oom_fault_kind_parses_and_is_not_retryable():
+    from paddle_tpu.distributed.resilience.faults import (
+        FaultPlan, ResourceExhausted)
+    plan = FaultPlan("exec::oom=oom")
+    with pytest.raises(ResourceExhausted) as ei:
+        plan.fire("exec::oom")
+    assert "RESOURCE_EXHAUSTED" in str(ei.value)
+    from paddle_tpu.distributed.resilience.faults import TransientFault
+    assert not isinstance(ei.value, TransientFault)
