@@ -52,6 +52,21 @@ Row 11 memory telemetry plane     asserts the memory-telemetry-off path
                                   steady-state peak/donated-bytes
                                   snapshot (peak participates in --diff
                                   as a bytes row, down-good)
+Row 12 SPMD fused-step multichip dryrun   spawns subprocesses with
+                                  XLA_FLAGS=--xla_force_host_platform_
+                                  device_count=8 and measures the
+                                  AMBIENT-MESH fused train step
+                                  (distributed.spmd: dp-sharded batch,
+                                  compiled gradient all-reduce, sharded
+                                  donating optimizer) at mesh sizes
+                                  1/2/4/8 — weak scaling, fixed
+                                  per-device batch, tokens/s up-good —
+                                  with the per-device peak/temp byte
+                                  columns from the memory plane; also
+                                  asserts a NO-mesh run never touches
+                                  the sharding key path
+                                  (lazy.SHARD_SIG_BUILDS frozen)
+
 (Multi-chip GPT/ERNIE hybrids need a pod; their single-chip proxies are
 bench.py's headline + the dryrun_multichip compile check.)
 
@@ -771,6 +786,151 @@ def bench_memory():
                       "value": int(peak), "unit": "bytes peak"}]}
 
 
+def _spmd_dryrun_worker(n: int):
+    """Row-12 subprocess body (`bench_suite.py --spmd-dryrun N`): one
+    fused-step workload under an n-device ambient dp mesh, weak scaling
+    (fixed per-device batch). Prints ONE json line. Runs in a fresh
+    process so the forced 8-device CPU backend and the mesh size are
+    set before any jax init."""
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.observability import memory as memtel
+    from paddle_tpu.observability import metrics
+
+    # few params (each replicated grad = one compiled all-reduce), a
+    # short program (per-op execute cost multiplies with the virtual
+    # device count on a shared host), small per-device compute: the
+    # shape that exposes scaling on small hosts while staying a real
+    # fwd+vjp+optimizer step
+    B0 = int(os.environ.get("SPMD_DRYRUN_B0", 8))
+    S = int(os.environ.get("SPMD_DRYRUN_S", 32))
+    H = int(os.environ.get("SPMD_DRYRUN_H", 64))
+    paddle.set_flags({"FLAGS_static_checks": "off",
+                      "FLAGS_memory_telemetry": True,
+                      "FLAGS_observability": True})
+    paddle.seed(0)
+    r = np.random.RandomState(0)
+    B = B0 * n
+    x_np = r.randn(B, S, H).astype("float32")
+    y_np = r.randint(0, H, (B * S,)).astype("int64")
+
+    with dist.auto_mesh(n, dim_names=["dp"]):
+        net = nn.Sequential(nn.Linear(H, H, bias_attr=False),
+                            nn.Linear(H, H, bias_attr=False))
+        opt = paddle.optimizer.Adam(1e-3, parameters=net.parameters())
+        dp = dist.DataParallel(net)
+        x = paddle.to_tensor(x_np)
+        y = paddle.to_tensor(y_np)
+
+        def step():
+            # one expression: a surviving grad-requiring intermediate
+            # would route backward() to the generic engine instead of
+            # the fused fwd+vjp step
+            loss = F.cross_entropy(dp(x).reshape([B * S, H]), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        _timeit(lambda: step()._value, steps=2, warmup=3)
+        memtel.reset_peak()
+        # min-of-rounds (the row 5/6 technique): this row runs on
+        # whatever shares the host, and the scale column divides two
+        # of these numbers
+        dt = min(_timeit(lambda: step()._value, steps=8, warmup=0)
+                 for _ in range(3))
+        snap = metrics.snapshot()["counters"]
+    temps = [int(e.get("temp_bytes") or 0)
+             for e in memtel.executable_stats()]
+    print(json.dumps({
+        "n": n, "step_ms": round(dt * 1e3, 3),
+        "tokens_s": round(B * S / dt, 1),
+        "peak_pd_bytes": memtel.peak_per_device_bytes(),
+        "peak_bytes": memtel.peak_bytes(),
+        "temp_bytes_max": max(temps) if temps else 0,
+        "compiled_comm_bytes": int(sum(
+            v for k, v in snap.items()
+            if k.startswith("comm.bytes.compiled."))),
+        "host_comm_calls": int(sum(
+            v for k, v in snap.items() if k.startswith("comm.calls."))),
+    }), flush=True)
+
+
+def bench_spmd_multichip():
+    """Row 12: SPMD fused-step multichip dryrun. Weak scaling (fixed
+    per-device batch) of the ambient-mesh fused step at mesh sizes
+    1/2/4/8 over the forced 8-device CPU backend, with per-device
+    peak/temp byte columns; plus the no-mesh off-freeze: a meshless
+    run must never build a sharding key component."""
+    import subprocess
+    import sys
+
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu._core import lazy
+
+    # ---------------- no-mesh off-freeze (in-process)
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(16, 16), nn.ReLU(), nn.Linear(16, 4))
+    opt = paddle.optimizer.Adam(1e-3, parameters=net.parameters())
+    r = np.random.RandomState(0)
+    x = paddle.to_tensor(r.randn(8, 16).astype("float32"))
+    y = paddle.to_tensor(r.randint(0, 4, (8,)).astype("int64"))
+    builds0 = lazy.SHARD_SIG_BUILDS
+    for _ in range(5):
+        loss = F.cross_entropy(net(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert lazy.SHARD_SIG_BUILDS == builds0, \
+        "no-mesh run touched the sharding key path"
+
+    # ---------------- subprocess sweep over mesh sizes
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    here = os.path.abspath(__file__)
+    results = {}
+    for n in (1, 2, 4, 8):
+        out = subprocess.run(
+            [sys.executable, here, "--spmd-dryrun", str(n)],
+            capture_output=True, text=True, env=env, timeout=600)
+        line = [ln for ln in out.stdout.splitlines()
+                if ln.startswith("{")]
+        if out.returncode != 0 or not line:
+            raise RuntimeError(
+                f"spmd dryrun n={n} failed rc={out.returncode}: "
+                f"{out.stderr[-2000:]}")
+        results[n] = json.loads(line[-1])
+    base = results[1]["tokens_s"]
+    scale8 = round(results[8]["tokens_s"] / base, 2) if base else 0.0
+    rows = [{"metric": f"spmd dryrun fused-step tokens/s (mesh=dp{n}, "
+                       "weak scaling)",
+             "value": results[n]["tokens_s"], "unit": "tokens/s",
+             "step_ms": results[n]["step_ms"],
+             "peak_pd_bytes": results[n]["peak_pd_bytes"],
+             "temp_bytes_max": results[n]["temp_bytes_max"],
+             "compiled_comm_bytes": results[n]["compiled_comm_bytes"],
+             "host_comm_calls": results[n]["host_comm_calls"]}
+            for n in (1, 2, 4, 8)]
+    return {"metric": "spmd multichip dryrun fused-step tokens/s "
+                      "(mesh=dp8, weak scaling, 8 virtual CPU devices)",
+            "value": results[8]["tokens_s"], "unit": "tokens/s",
+            "scale_8x_vs_1x": scale8,
+            # 8 virtual devices share the host's real cores: the
+            # achievable dryrun scale is bounded by them, so the scale
+            # column reads against this, not against 8
+            "host_cores": os.cpu_count(),
+            "host_comm_calls_total": sum(results[n]["host_comm_calls"]
+                                         for n in (1, 2, 4, 8)),
+            "rows": rows}
+
+
 # ------------------------------------------------------------- diff mode
 
 def _rows_of(path: str) -> dict:
@@ -862,13 +1022,18 @@ def main():
     import sys
     if "--diff" in sys.argv[1:]:
         raise SystemExit(diff_mode())
+    if "--spmd-dryrun" in sys.argv[1:]:
+        i = sys.argv.index("--spmd-dryrun")
+        _spmd_dryrun_worker(int(sys.argv[i + 1]))
+        return
     rows = os.environ.get("BENCH_ROWS",
-                          "1,2,3,4,5,6,7,8,9,10,11").split(",")
+                          "1,2,3,4,5,6,7,8,9,10,11,12").split(",")
     table = {"1": bench_lenet, "2": bench_resnet50, "3": bench_bert,
              "4": bench_dispatch, "5": bench_static_checks,
              "6": bench_observability, "7": bench_resilience,
              "8": bench_replan, "9": bench_async_flush,
-             "10": bench_telemetry, "11": bench_memory}
+             "10": bench_telemetry, "11": bench_memory,
+             "12": bench_spmd_multichip}
     for r in rows:
         r = r.strip()
         out = table[r]()

@@ -35,6 +35,10 @@ class ExecCache(OrderedDict):
         # a step-cache hit can report its compiled footprint without
         # re-lowering anything; pruned with the entry it describes
         self._mem: dict = {}
+        # per-entry compiled-collective byte estimate (lazy.py fills
+        # this once per sharded compile from the in/out specs); a
+        # steady-state hit re-counts the cached number per execution
+        self._comm: dict = {}
         # direct Counter handles: metrics.reset() zeroes them in place,
         # so holding the objects (no per-lookup name resolution) is safe
         if stat is not None:
@@ -94,6 +98,7 @@ class ExecCache(OrderedDict):
                 oldest = next(iter(self))
                 OrderedDict.__delitem__(self, oldest)
                 self._mem.pop(oldest, None)
+                self._comm.pop(oldest, None)
             except (KeyError, StopIteration, RuntimeError):
                 break
 
@@ -105,6 +110,15 @@ class ExecCache(OrderedDict):
     def memory_info(self, key, default=None):
         return self._mem.get(key, default)
 
+    def note_comm(self, key, nbytes: int):
+        """Attach the compiled-collective byte estimate to its cache
+        entry (lazy._note_compiled_comm, ambient SPMD mesh)."""
+        self._comm[key] = int(nbytes)
+
+    def comm_info(self, key, default=None):
+        return self._comm.get(key, default)
+
     def clear(self):
         OrderedDict.clear(self)
         self._mem.clear()
+        self._comm.clear()

@@ -63,6 +63,18 @@ _AVAL_CACHE: Dict[Tuple, Tuple] = {}
 # tests/test_resilience.py via the compiles.fused_step counter).
 MESH_EPOCH = 0
 
+# Ambient SPMD mesh (distributed/spmd.py activates/clears this; lazy
+# NEVER imports distributed). While set, cache signatures gain a
+# sharding component — (mesh shape+axes, per-input PartitionSpec) —
+# and the compile sites lower with GSPMD in_shardings so collectives
+# live inside the executable. None = the zero-cost single-device path:
+# one module-attr read per flush, zero extra key bytes.
+SPMD = None
+
+# sharding-component builds (diagnostics + the bench row-12 off-freeze
+# assert: a no-mesh run must never touch the sharding key path)
+SHARD_SIG_BUILDS = 0
+
 
 def bump_mesh_epoch() -> int:
     """Invalidate the compiled-segment and fused-step cache keys (the
@@ -238,16 +250,56 @@ def _inject_exec_oom():
     _faults.inject("exec::oom")
 
 
-def _compile_segment_runner(pending, live, donate, run_vals, sig):
+def _spmd_jit(fn, donate, run_vals, spmd):
+    """jit with explicit GSPMD input layouts when an ambient mesh is
+    active: every input's committed on-mesh sharding (replicated for
+    the rest) becomes an ``in_shardings`` entry, so the ONE compiled
+    program is partitioned over the dp×mp mesh and its collectives
+    (gradient all-reduce, TP exchanges) are emitted by the compiler
+    instead of driven from the host. Tracer inputs fall back to plain
+    jit (spmd.in_shardings returns None)."""
+    if spmd is not None:
+        shardings = spmd.in_shardings(run_vals)
+        if shardings is not None:
+            if _OBS.METRICS:
+                from ..observability import metrics
+                metrics.inc("compiles.spmd")
+            return jax.jit(fn, donate_argnums=donate,
+                           in_shardings=shardings)
+    return jax.jit(fn, donate_argnums=donate)
+
+
+def _note_compiled_comm(cache, key, spmd, in_vals, out_vals, site,
+                        gather_only=False):
+    """Observability parity for collectives compiled INTO a program:
+    estimate their payload from the in/out sharding specs (computed
+    once per compile, cached on the ExecCache entry like the memory
+    analysis) and count them per execution as
+    ``comm.bytes.compiled.<site>`` — so moving collectives off the
+    host does not blind the PR-8 comm-overlap report. Callers gate on
+    ``_OBS.METRICS and SPMD``."""
+    est = cache.comm_info(key)
+    if est is None:
+        est = spmd.estimate_bytes(in_vals, out_vals,
+                                  gather_only=gather_only)
+        cache.note_comm(key, est)
+    if est:
+        from ..observability import metrics
+        metrics.inc("comm.bytes.compiled." + site, est)
+
+
+def _compile_segment_runner(pending, live, donate, run_vals, sig,
+                            spmd=None):
     """Build one segment's cached runner. With the memory telemetry
     plane on (and concrete inputs), compile through the jax AOT path so
     the executable's ``memory_analysis()`` lands on the ExecCache entry
     exactly once per compile; otherwise the plain jit wrapper. Both are
     interchangeable callables — the cache key already pins the input
     signature, so an AOT-compiled entry only ever sees matching
-    arguments."""
-    jitted = jax.jit(_build_segment_fn(pending, live),
-                     donate_argnums=donate)
+    arguments. `spmd` is the ambient mesh the caller keyed the segment
+    against (the async worker passes its seal-time capture)."""
+    jitted = _spmd_jit(_build_segment_fn(pending, live), donate,
+                       run_vals, spmd)
     if _OBS.MEM and not any(isinstance(v, jax.core.Tracer)
                             for v in run_vals):
         from ..observability import memory as _memtel
@@ -258,11 +310,29 @@ def _compile_segment_runner(pending, live, donate, run_vals, sig):
     return jitted
 
 
-def _compile_fused_runner(pending, live, grad_in, root_k, run_vals, key):
+def _spmd_for_compile(in_vals):
+    """The ambient mesh a program should be PINNED against, or None.
+    A segment whose key-time inputs include unresolved PendingValues
+    compiles without in_shardings: their layout is unknowable at seal
+    time, the key carries the "?" sentinel for them, and an unpinned
+    jit re-specializes per input layout internally — so one cache
+    entry stays correct for every layout the producer hands it."""
+    spmd = SPMD
+    if spmd is None:
+        return None
+    if _ASYNC_SEEN and any(getattr(v, "_is_pending_value", False)
+                           for v in in_vals):
+        return None
+    return spmd
+
+
+def _compile_fused_runner(pending, live, grad_in, root_k, run_vals, key,
+                          spmd=None):
     """Fused fwd+vjp step runner, AOT-compiled for its memory analysis
     when the telemetry plane is on (the steady-state step cache can
     then report its compiled footprint on every later hit)."""
-    jitted = jax.jit(_build_fused_fn(pending, live, grad_in, root_k))
+    jitted = _spmd_jit(_build_fused_fn(pending, live, grad_in, root_k),
+                       (), run_vals, spmd)
     if _OBS.MEM and not any(isinstance(v, jax.core.Tracer)
                             for v in run_vals):
         from ..observability import memory as _memtel
@@ -415,9 +485,11 @@ class CaptureContext:
         self._sig_ops: List[Tuple] = []
         self._max_override = max_segment_ops
         # steady-state signature memo: (ops_key, in_sig, live, epoch,
-        # backend) -> the _CachedKey handed out last flush. Validated
-        # by EXACT comparison over interned entries (identity-fast) +
-        # the mesh epoch, so a replan or any structural drift rebuilds.
+        # backend, shard_sig) -> the _CachedKey handed out last flush.
+        # Validated by EXACT comparison over interned entries
+        # (identity-fast) + the mesh epoch + the ambient-mesh sharding
+        # component (None without a mesh), so a replan, a mesh switch
+        # or any structural drift rebuilds.
         self._sig_memo: Optional[Tuple] = None
         # stats for tests / profiling
         self.segments_run = 0
@@ -568,24 +640,40 @@ class CaptureContext:
         return live, live_refs
 
     def _signature(self, in_vals, live) -> "_CachedKey":
-        # MESH_EPOCH rides at the END: register_segment_grad slices the
-        # ops/inputs halves positionally (sig[1]/sig[2]). The memo
-        # hands back last step's _CachedKey when nothing structural
-        # changed — entries are interned, so the comparison is n
-        # identity checks, and downstream cache lookups hash a cached
-        # int instead of re-walking the whole structure every step.
+        # MESH_EPOCH rides after the structural halves:
+        # register_segment_grad slices the ops/inputs halves
+        # positionally (sig[1]/sig[2]), so the SPMD sharding component
+        # — (mesh shape+axes, per-input PartitionSpec) — is appended at
+        # the very END and ONLY when a mesh is ambient: a no-mesh
+        # session's key stays the 5-tuple it always was (zero extra key
+        # bytes) while the same dygraph code under two meshes (or two
+        # input layouts) keys two distinct executables. The memo hands
+        # back last step's _CachedKey when nothing structural changed —
+        # entries are interned, so the comparison is n identity checks,
+        # and downstream cache lookups hash a cached int instead of
+        # re-walking the whole structure every step.
         ops_key = tuple(self._sig_ops)
         in_sig = _in_signature(in_vals)
         live_t = tuple(live)
         backend = jax.default_backend()
+        spmd = SPMD
+        shard_sig = None
+        if spmd is not None:
+            global SHARD_SIG_BUILDS
+            SHARD_SIG_BUILDS += 1
+            shard_sig = (spmd.key,
+                         tuple(spmd.spec_of(v) for v in in_vals))
         memo = self._sig_memo
         if memo is not None and memo[3] == MESH_EPOCH \
-                and memo[4] == backend and memo[0] == ops_key \
+                and memo[4] == backend and memo[5] == shard_sig \
+                and memo[0] == ops_key \
                 and memo[1] == in_sig and memo[2] == live_t:
-            return memo[5]
-        key = _CachedKey((backend, ops_key, in_sig, live_t, MESH_EPOCH))
+            return memo[6]
+        base = (backend, ops_key, in_sig, live_t, MESH_EPOCH)
+        key = _CachedKey(base if shard_sig is None
+                         else base + (shard_sig,))
         self._sig_memo = (ops_key, in_sig, live_t, MESH_EPOCH, backend,
-                          key)
+                          shard_sig, key)
         return key
 
     # ------------------------------------------------------------- flush
@@ -688,8 +776,9 @@ class CaptureContext:
                 if _OBS.METRICS:
                     from ..observability import metrics
                     metrics.inc("compiles.segment")
-                runner = _compile_segment_runner(pending, live, donate,
-                                                 run_vals, sig)
+                runner = _compile_segment_runner(
+                    pending, live, donate, run_vals, sig,
+                    _spmd_for_compile(in_vals))
                 _SEG_CACHE[(sig, donate)] = runner
                 with _quiet_donation_compile():   # first call compiles
                     out_vals = runner(*run_vals)
@@ -723,6 +812,9 @@ class CaptureContext:
             # program into a false cross_segment_donation error
             from ..analysis.dataflow import note_segment_donation
             note_segment_donation(in_vals, donate, reason, pending)
+        if SPMD is not None and _OBS.METRICS:
+            _note_compiled_comm(_SEG_CACHE, (sig, donate), SPMD,
+                                run_vals, out_vals, "segment")
         if _OBS.MEM and donate:
             _note_donated_inputs(in_vals, donate)
         self._reset_segment()
@@ -744,10 +836,13 @@ class CaptureContext:
 
             if _OBS.MEM:
                 # live-buffer census: segment outputs are born here,
-                # provenance = segment signature + producing op
+                # provenance = segment signature + producing op (+ the
+                # mesh descriptor when the step ran sharded, so an OOM
+                # postmortem names which mesh config filled the device)
                 from ..observability import memory as _memtel
-                _memtel.note_segment_outputs(pending, live, out_vals,
-                                             sig)
+                _memtel.note_segment_outputs(
+                    pending, live, out_vals, sig,
+                    mesh=SPMD.desc if SPMD is not None else None)
 
             # FLAGS_check_nan_inf covers fused-segment outputs too (the
             # per-op eager scan in dispatch.py never sees ops that were
@@ -803,6 +898,13 @@ class CaptureContext:
                 mode = None
         in_ids = dict(self._in_ids)
         fault_active = _flags.FAULT_INJECT_ACTIVE
+        # ambient mesh captured at SEAL time: the signature above was
+        # built against it, and the worker must compile/account against
+        # the same state even if the recording thread exits the mesh.
+        # spmd_pin is None when any sealed input is still pending —
+        # such programs compile unpinned (see _spmd_for_compile)
+        spmd = SPMD
+        spmd_pin = _spmd_for_compile(in_vals)
         from . import flags
         nan_check = flags.flag_value("FLAGS_check_nan_inf")
 
@@ -858,7 +960,7 @@ class CaptureContext:
                         metrics.inc("compiles.segment")
                     runner = _compile_segment_runner(pending, live,
                                                      donate, run_vals,
-                                                     sig)
+                                                     sig, spmd_pin)
                     _SEG_CACHE[(sig, donate)] = runner
                     with _quiet_donation_compile():
                         out_vals = runner(*run_vals)
@@ -873,12 +975,16 @@ class CaptureContext:
                     from ..analysis.dataflow import note_segment_donation
                     note_segment_donation(in_vals, donate, reason,
                                           pending)
+                if spmd is not None and _OBS.METRICS:
+                    _note_compiled_comm(_SEG_CACHE, (sig, donate), spmd,
+                                        run_vals, out_vals, "segment")
                 if _OBS.MEM:
                     if donate:
                         _note_donated_inputs(in_vals, donate)
                     from ..observability import memory as _memtel
-                    _memtel.note_segment_outputs(pending, live, out_vals,
-                                                 sig)
+                    _memtel.note_segment_outputs(
+                        pending, live, out_vals, sig,
+                        mesh=spmd.desc if spmd is not None else None)
                 if nan_check:
                     for (j, _s), val in zip(live, out_vals):
                         dispatch._check_nan_inf(
@@ -1194,6 +1300,13 @@ def register_segment_grad(pending, live, live_refs, out_tensors,
                     tuple(sig[2][i] for i in comp_ins), tuple(local_live),
                     tuple(comp_ops), tuple(comp_ins),
                     sig[4])   # MESH_EPOCH rides every derived key too
+        raw = sig.sig if isinstance(sig, _CachedKey) else tuple(sig)
+        if len(raw) > 5:
+            # SPMD sharding component: slice the per-input specs to this
+            # component's inputs so the derived backward key re-keys on
+            # a re-plan / re-layout exactly like the whole-segment key
+            comp_sig += ((raw[5][0],
+                          tuple(raw[5][1][i] for i in comp_ins)),)
         _register_component_grad(
             [in_l[i] for i in gi_c], [k_l[k] for k in go_c],
             local_pending, local_live, [live_refs[k] for k in comp_ks],
@@ -1390,6 +1503,11 @@ class ReplayableSegment:
         self.live = live
         self.metas = [_RefMeta(r.aval, r.requires_grad) for r in live_refs]
         self.sig = sig
+        # RECORD-time ambient mesh: `sig` was keyed against it, so a
+        # replay must compile against the same state — not whatever
+        # mesh happens to be ambient at replay time (the key and the
+        # runner's sharding regime must never contradict)
+        self.spmd = SPMD
         self.in_avals = tuple((tuple(v.shape), _dstr(v.dtype))
                               for v in in_vals)
         # which inputs fed grad-requiring chains at capture (replay must
@@ -1405,7 +1523,8 @@ class ReplayableSegment:
         runner = _SEG_CACHE.get((self.sig, ()))
         compiled = runner is None
         if compiled:
-            runner = jax.jit(_build_segment_fn(self.pending, self.live))
+            runner = _spmd_jit(_build_segment_fn(self.pending, self.live),
+                               (), in_vals, self.spmd)
             _SEG_CACHE[(self.sig, ())] = runner
             if _OBS.METRICS:
                 from ..observability import metrics
@@ -1429,8 +1548,9 @@ class ReplayableSegment:
                     (val,))
         if _OBS.MEM:
             from ..observability import memory as _memtel
-            _memtel.note_segment_outputs(self.pending, self.live,
-                                         out_vals, self.sig)
+            _memtel.note_segment_outputs(
+                self.pending, self.live, out_vals, self.sig,
+                mesh=self.spmd.desc if self.spmd is not None else None)
         outs = []
         for meta, val in zip(self.metas, out_vals):
             outs.append(Tensor(val, stop_gradient=not meta.requires_grad))
@@ -1638,10 +1758,12 @@ def try_fused_backward(tensors, grad_tensors) -> bool:
     run_vals = None
     if compiled:
         try:
+            spmd_pin = _spmd_for_compile(in_vals)
             run_vals = resolve_pending(in_vals) if _ASYNC_SEEN \
                 else in_vals
             runner = _compile_fused_runner(pending, live, grad_in,
-                                           root_k, run_vals, key)
+                                           root_k, run_vals, key,
+                                           spmd_pin)
         except Exception as e:
             # AOT compile (memory telemetry on) or pending-input
             # resolution failed: clean up exactly like a failed compile
@@ -1709,9 +1831,17 @@ def try_fused_backward(tensors, grad_tensors) -> bool:
         for t in _live_aliases(ref):
             t._payload = val
 
+    if SPMD is not None and _OBS.METRICS:
+        # the dp gradient all-reduce (and any TP exchange) of this step
+        # ran INSIDE the executable: account its estimated payload so
+        # the comm-overlap report is not blind to compiled collectives
+        _note_compiled_comm(_FUSED_CACHE, key, SPMD, run_vals,
+                            list(out_vals) + list(grads), "fused_step")
     if _OBS.MEM:
         from ..observability import memory as _memtel
-        _memtel.note_segment_outputs(pending, live, out_vals, sig)
+        _memtel.note_segment_outputs(
+            pending, live, out_vals, sig,
+            mesh=SPMD.desc if SPMD is not None else None)
         for g in grads:
             _memtel.note_buffer(g, "fused_step.grad")
 

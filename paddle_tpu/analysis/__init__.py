@@ -45,7 +45,9 @@ from .program_checks import (check_pass_effects, check_program_shapes,
 from .dataflow import check_cross_segment_donation
 from .alias_graph import check_view_aliases
 from .sot_checks import check_guards
-from .distributed_checks import (check_pipeline_schedule, check_reshard,
+from .distributed_checks import (check_compiled_pipeline,
+                                 check_pipeline_schedule, check_reshard,
+                                 compiled_pipeline_programs,
                                  simulate_pipeline)
 from . import alias_graph, dataflow, distributed_checks, fixes, hooks, \
     sot_checks
@@ -55,6 +57,7 @@ __all__ = [
     "StaticCheckWarning", "SegmentView", "check_segment",
     "check_program", "check_process_tracer_leaks", "check_guards",
     "check_reshard", "check_pipeline_schedule", "simulate_pipeline",
+    "check_compiled_pipeline", "compiled_pipeline_programs",
     "check_cross_segment_donation", "check_view_aliases",
     "check_dead_captures", "fix_segment",
 ]

@@ -308,6 +308,17 @@ def run_pipeline(execute: bool, verbose: bool):
                 print("   ", d.render())
         _note("pipeline", r)
         reports.append(r)
+    # the COMPILED pipeline's ppermute order (validated from the real
+    # lowering's exported permutation lists, pipeline_compiled.py)
+    for kind, P, m in (("stream", 4, 8), ("1f1b", 4, 8)):
+        r = analysis.check_compiled_pipeline(kind, P, m)
+        print(f"[pipeline] compiled-{kind} (P={P}, m={m}): "
+              f"{len(r.diagnostics)} finding(s)")
+        if verbose or not r.ok:
+            for d in r.diagnostics:
+                print("   ", d.render())
+        _note("pipeline", r)
+        reports.append(r)
     return reports
 
 

@@ -258,6 +258,74 @@ def simulate_pipeline(programs: Sequence[Sequence[tuple]],
             data={"undelivered": undelivered})
 
 
+def compiled_pipeline_programs(kind: str, pp_size: int,
+                               num_micro: int) -> List[List[tuple]]:
+    """Lower the COMPILED pipeline's collective-permute order to
+    per-rank P2P programs — built from the permutation lists and tick
+    counts the shipping lowerings themselves use
+    (distributed/pipeline_compiled.py exports them), so the simulator
+    validates the real lowering, not a hand-modeled one.
+
+    A ``ppermute`` is a full collective: every rank sends along its
+    edge and receives along the inverse edge every tick (bubble ticks
+    carry zeros, exactly like the lowering). Tags are (stream, tick),
+    so a FIFO divergence or an asymmetric edge set surfaces as the
+    usual ordering / deadlock diagnostics."""
+    from ..distributed import pipeline_compiled as pc
+    P, m = pp_size, num_micro
+
+    def _edges(perm, what):
+        srcs = {s for s, _ in perm}
+        dsts = {d for _, d in perm}
+        if len(perm) != P or srcs != set(range(P)) \
+                or dsts != set(range(P)):
+            raise ValueError(
+                f"{what} permutation is not a bijection over {P} "
+                f"ranks: {perm}")
+        return ({s: d for s, d in perm}, {d: s for s, d in perm})
+
+    if kind in ("stream", "spmd_pipeline"):
+        phases = [("act", _edges(pc.stream_permutation(P), "stream"))]
+        T = pc.stream_tick_count(m, P)
+    elif kind in ("1f1b", "pipeline_1f1b_train_step"):
+        down, up = pc.fb_permutations(P)
+        phases = [("act", _edges(down, "down")),
+                  ("grad", _edges(up, "up"))]
+        T = pc.fb_tick_count(m, P)
+    else:
+        raise ValueError(f"unknown compiled pipeline kind '{kind}'")
+
+    progs: List[List[tuple]] = []
+    for r in range(P):
+        ops: List[tuple] = []
+        for t in range(T):
+            ops.append(("local", f"tick{t}"))
+            for name, (dst_of, src_of) in phases:
+                ops.append(("send", dst_of[r], (name, t)))
+                ops.append(("recv", src_of[r], (name, t)))
+        progs.append(ops)
+    return progs
+
+
+def check_compiled_pipeline(kind: str, pp_size: int, num_micro: int,
+                            report: Optional[CheckReport] = None
+                            ) -> CheckReport:
+    """Lower + simulate the compiled pipeline's ppermute schedule."""
+    if report is None:
+        report = CheckReport(
+            f"compiled pipeline {kind} (P={pp_size}, m={num_micro})")
+    try:
+        progs = compiled_pipeline_programs(kind, pp_size, num_micro)
+    except ValueError as e:
+        report.add(CHECKER_PIPELINE,
+                   f"compiled pipeline '{kind}' rejected for "
+                   f"P={pp_size}, m={num_micro}: {e}",
+                   severity=SEVERITY_ERROR)
+        return report
+    simulate_pipeline(progs, report, schedule=f"compiled-{kind}")
+    return report
+
+
 def check_pipeline_schedule(schedule: str, pp_size: int, num_micro: int,
                             num_chunks: int = 1,
                             report: Optional[CheckReport] = None

@@ -17,6 +17,8 @@ from .communication import (ReduceOp, Group, new_group, get_group,  # noqa: F401
                             reduce_scatter, scatter, gather, alltoall,
                             all_to_all, send, recv, isend, irecv, barrier,
                             wait, get_backend, stream)
+from . import spmd  # noqa: F401
+from .spmd import shard_batch, suggest_mesh_degree  # noqa: F401
 from .parallel import DataParallel  # noqa: F401
 from . import fleet  # noqa: F401
 from . import checkpoint  # noqa: F401

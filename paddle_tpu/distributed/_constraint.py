@@ -5,11 +5,27 @@ used by TP (feature dim on 'mp') and SP (sequence dim on 'mp') layers —
 the GSPMD analog of the reference's hand-issued _c_identity/_c_concat/
 _c_split collectives (fleet/layers/mpu/mp_ops.py). Dims other than the
 constrained one are left UNCONSTRAINED so XLA keeps whatever sharding the
-surrounding program gives them (e.g. batch over 'dp')."""
+surrounding program gives them (e.g. batch over 'dp').
+
+The constraint is a registered op whose kernel CAPTURES the mesh at
+constrain_dim call time (the op name is salted by the mesh's device
+identity, so one closure per mesh) and emits
+``with_sharding_constraint`` with a NamedSharding built from it
+(UNCONSTRAINED entries allowed — no legacy ``with mesh:`` resource env
+needed). Call-time capture is load-bearing: the async flush worker may
+TRACE the recorded segment after the mesh block exited, and a replayed
+SOT segment may trace under a different live mesh — a kernel that
+re-resolved the global mesh at trace time would silently lower the
+constraint as identity (or against the wrong mesh) and cache that
+program under the right key. That lets the SAME dygraph TP layer
+record into the ambient fusion window (paddle_tpu.distributed.spmd):
+the constraint rides the lazy segment and lowers inside the one GSPMD
+step program.
+"""
 from __future__ import annotations
 
 import jax
-from jax.sharding import PartitionSpec
+from jax.sharding import NamedSharding, PartitionSpec
 
 from .._core.tensor import Tensor
 from .mesh import get_mesh
@@ -17,28 +33,53 @@ from .mesh import get_mesh
 _U = PartitionSpec.UNCONSTRAINED
 
 
+def _apply_constraint(x, jm, dim: int, axis: str, shard: bool):
+    """Kernel body: the jax Mesh was captured when the op was
+    registered, so tracing works identically on the recording thread,
+    the async flush worker, and a replay under any ambient state."""
+    entries = [_U] * x.ndim
+    entries[dim % x.ndim] = axis if shard else None
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(jm, PartitionSpec(*entries)))
+
+
 def constrain_dim(t: Tensor, dim: int, axis: str = "mp",
                   shard: bool = True) -> Tensor:
-    """Under trace with a global mesh carrying ``axis``: constrain ``dim``
-    of ``t`` to Shard(axis) (shard=True) or replicated (shard=False),
-    leaving other dims unconstrained. Identity otherwise (eager / no mesh /
-    axis absent — the reference's degenerate degree-1 case)."""
+    """Under any trace (lazy fusion window, ambient SPMD mesh, or an
+    enclosing jax trace) with a global mesh carrying ``axis``:
+    constrain ``dim`` of ``t`` to Shard(axis) (shard=True) or
+    replicated (shard=False), leaving other dims unconstrained.
+    Identity otherwise (eager / no mesh / axis absent — the reference's
+    degenerate degree-1 case)."""
     mesh = get_mesh()
     if mesh is None or axis not in mesh.dim_names:
         return t
-    if not isinstance(t._value, jax.core.Tracer):
-        return t
-    entries = [_U] * t.ndim
-    entries[dim % t.ndim] = axis if shard else None
-    spec = PartitionSpec(*entries)
+    p = t._payload
+    if not isinstance(p, jax.core.Tracer):
+        # fusion-window / eager values join the trace only under an
+        # AMBIENT mesh (whose cache keys carry the sharding component);
+        # a plain global mesh keeps the old identity behavior outside
+        # jax traces — and the lazy value is never materialized just to
+        # decide
+        from . import spmd
+        if not spmd.active():
+            return t
     from .._core.executor import apply
     from .._core.op_registry import _OPS, register_op
+    # the op NAME is salted with the mesh's device identity: the eager
+    # per-op executable caches (and jax's own trace cache) key on the
+    # op, so a lowering that baked mesh A's device assignment can never
+    # be replayed after an elastic replan swapped in a same-shaped
+    # mesh B — it gets a fresh op, hence a fresh lowering
+    jm = mesh.jax_mesh()
+    mesh_tag = hash((tuple(d.id for d in jm.devices.flatten()),
+                     tuple(jm.axis_names))) & 0xFFFFFFFF
     key = (f"shard_constraint_{axis}_{dim % t.ndim}_"
-           f"{'s' if shard else 'r'}_{t.ndim}")
+           f"{'s' if shard else 'r'}_{t.ndim}_m{mesh_tag:08x}")
     if key not in _OPS:
-        # synthetic per-(axis,dim,mode,rank) op family — generated names
-        # can't be enumerated in ops.yaml, so registered as custom
-        register_op(key, lambda x, _s=spec:
-                    jax.lax.with_sharding_constraint(x, _s),
+        # synthetic per-(axis,dim,mode,rank,mesh) op family — generated
+        # names can't be enumerated in ops.yaml, so registered as custom
+        register_op(key, lambda x, _jm=jm, _d=dim, _a=axis, _sh=shard:
+                    _apply_constraint(x, _jm, _d, _a, _sh),
                     custom=True)
     return apply(key, t)

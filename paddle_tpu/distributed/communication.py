@@ -16,6 +16,14 @@ ProcessGroup stack (process_group.h:130-246). TPU-native split
 
 Cross-host in-graph collectives ride jax.distributed (PJRT DCN) once
 init_parallel_env has connected hosts (PADDLE_USE_JAX_DIST=1).
+
+Routing under an AMBIENT SPMD mesh (distributed/spmd.py): a
+single-controller mesh session holds globally-consistent values, so
+these host-driven entry points degenerate to identity (world_size==1)
+while the REAL collectives — gradient all-reduce, ZeRO all-gather, TP
+exchanges — are compiled INTO the fused step/optimizer executables by
+GSPMD. The host path below only runs across real OS processes, where
+no ambient mesh can span the ranks.
 """
 from __future__ import annotations
 

@@ -112,6 +112,25 @@ class ProcessMesh:
         from .communication import _group_for_mesh_dim
         return _group_for_mesh_dim(self, dim_name)
 
+    # -------------------------------------------------- ambient context
+    def __enter__(self) -> "ProcessMesh":
+        """``with mesh:`` activates this mesh as the AMBIENT SPMD mesh
+        (distributed/spmd.py): inside the block the same dygraph code
+        compiles to ONE GSPMD program partitioned over it — the step
+        cache keys gain a sharding component and the fused-step /
+        optimizer compile sites lower with in_shardings/donation so
+        dp/TP/ZeRO collectives live inside the executable. Also sets
+        the global mesh (restored on exit) so mesh-keyed construction
+        paths pick their compiled regime."""
+        from . import spmd
+        spmd.activate(self)
+        return self
+
+    def __exit__(self, et, ev, tb):
+        from . import spmd
+        spmd.deactivate(had_error=et is not None)
+        return False
+
 
 def auto_mesh(*dim_sizes, dim_names=None) -> ProcessMesh:
     """Build a mesh over the first prod(dim_sizes) devices in enumeration

@@ -44,6 +44,17 @@ class DataParallel(Layer):
         self._bucket_bytes = int(comm_buffer_size) * 1024 * 1024
         self._unregister = None
         self._synced_grad_ids = {}
+        # Compiled regime: under an ambient SPMD mesh (single
+        # controller) the wrapper shards each batch over the data axis
+        # and gradient averaging is GSPMD's psum inside the fused step
+        # — NO host reducer registered, zero comm::* spans per step.
+        # Falls back to the host-driven reducer across real processes.
+        self._spmd = None
+        if self._pg is None or self._nranks <= 1:
+            from . import spmd
+            if spmd.active():
+                self._spmd = spmd.state()
+                self._shard_params_on_mesh()
         if self._pg is not None and self._nranks > 1:
             self._sync_params_from_rank0()
             # weakref: a discarded wrapper must not be pinned forever by
@@ -58,6 +69,19 @@ class DataParallel(Layer):
                 dp._reduce_gradients()
 
             self._unregister = unreg = register_post_backward_callback(_cb)
+
+    # ------------------------------------------------------------ spmd
+    def _shard_params_on_mesh(self):
+        """Commit every parameter onto the ambient mesh (replicated
+        unless a TP layer already annotated it): the first fused step
+        then compiles against deterministic layouts instead of
+        re-laying uncommitted arrays out at dispatch time."""
+        from .api import shard_tensor
+        from .placements import Replicate
+        mesh = self._spmd.pmesh
+        for p in self._layers.parameters():
+            if p._dist_attr is None:
+                shard_tensor(p, mesh, [Replicate()] * mesh.ndim)
 
     # ------------------------------------------------------------ reducer
     def _sync_params_from_rank0(self):
@@ -114,6 +138,8 @@ class DataParallel(Layer):
         without a fresh grad contributes its existing grad or zeros
         (find_unused_parameters semantics, reducer.cc
         MarkVarReadyInCallback for unused vars)."""
+        if self._spmd is not None:
+            return   # gradient sync compiled into the fused step
         if not self._grad_sync_enabled or self._pg is None \
                 or self._nranks <= 1:
             return
@@ -144,6 +170,14 @@ class DataParallel(Layer):
 
     # -------------------------------------------------------------- API
     def forward(self, *inputs, **kwargs):
+        if self._spmd is not None:
+            # dp-shard each batch tensor's leading dim onto the mesh's
+            # data axis (identity for non-divisible batches / scalars):
+            # the recorded segment then sees dp-sharded inputs and the
+            # fused fwd+vjp compiles the gradient all-reduce in
+            from . import spmd
+            inputs = tuple(spmd.shard_batch(x) for x in inputs)
+            kwargs = {k: spmd.shard_batch(v) for k, v in kwargs.items()}
         return self._layers(*inputs, **kwargs)
 
     def state_dict(self, *args, **kwargs):

@@ -25,6 +25,35 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 
+# ------------------------------------------------- the collective order
+# THE permutation lists and tick counts the compiled lowerings below
+# are built from. Exported so the sanitizer's pipeline_schedule checker
+# (analysis/distributed_checks.check_compiled_pipeline) validates the
+# REAL collective-permute order of the shipping lowering, not a
+# hand-modeled copy of it.
+
+def stream_permutation(n: int):
+    """Activation ring of the streamed-scan pipeline: stage i hands its
+    output to stage i+1 every tick (one ``ppermute`` per tick)."""
+    return [(i, (i + 1) % n) for i in range(n)]
+
+
+def stream_tick_count(num_micro: int, n: int) -> int:
+    return num_micro + n - 1
+
+
+def fb_permutations(n: int):
+    """The 1F1B train step's per-tick pair: activations flow down the
+    ring, cotangents flow up it."""
+    down = [(i, (i + 1) % n) for i in range(n)]
+    up = [((i + 1) % n, i) for i in range(n)]
+    return down, up
+
+
+def fb_tick_count(num_micro: int, n: int) -> int:
+    return num_micro + 2 * (n - 1)
+
+
 def spmd_pipeline(stage_fn: Callable, x_mb, axis_name: str = "pp"):
     """Stream micro-batches through pipeline stages. Call inside a manual
     shard_map context over ``axis_name``.
@@ -37,8 +66,8 @@ def spmd_pipeline(stage_fn: Callable, x_mb, axis_name: str = "pp"):
     n = jax.lax.psum(1, axis_name)
     rank = jax.lax.axis_index(axis_name)
     m = x_mb.shape[0]
-    t_total = m + n - 1
-    perm = [(i, (i + 1) % n) for i in range(n)]
+    t_total = stream_tick_count(m, n)
+    perm = stream_permutation(n)
 
     state0 = jnp.zeros_like(x_mb[0])
     outputs0 = jnp.zeros_like(x_mb)
@@ -235,9 +264,8 @@ def pipeline_1f1b_train_step(stage_fn: Callable, loss_fn: Callable,
         recv_bwd = jnp.zeros(mb_shape, x_mb.dtype)
         loss_acc = jnp.zeros((), jnp.float32)
 
-        down = [(i, (i + 1) % n) for i in range(n)]
-        up = [((i + 1) % n, i) for i in range(n)]
-        T = M + 2 * (n - 1)
+        down, up = fb_permutations(n)
+        T = fb_tick_count(M, n)
         p_leaves_live = jax.tree_util.tree_leaves(params)
 
         def tick(t, carry):

@@ -29,6 +29,37 @@ class ShardingOptimizerStage:
     P_G_OS = 3      # + parameter sharding
 
 
+def _install_state_sharding(optim, axis):
+    """Wrap the optimizer's state factory so moment/master arrays are
+    physically laid out Shard(0) over the sharding axis — each rank
+    holds 1/N of optimizer state (stage-1 semantics). Shared by the
+    group-sharded stage wrappers and the ambient-mesh (compiled) route
+    of DygraphShardingOptimizer."""
+    import jax
+    from .api import placements_to_spec
+    mesh = get_mesh()
+    if mesh is None or axis not in mesh.dim_names or \
+            mesh.get_dim_size(axis) <= 1:
+        return
+    size = mesh.get_dim_size(axis)
+    orig = optim._init_state
+
+    def sharded_init(p, _orig=orig):
+        st = _orig(p)
+        out = {}
+        for k, v in st.items():
+            if v.ndim >= 1 and v.shape[0] % size == 0 and \
+                    v.shape[0] >= size:
+                placements = [Shard(0) if n == axis else Replicate()
+                              for n in mesh.dim_names]
+                spec = placements_to_spec(placements, mesh, v.ndim)
+                v = jax.device_put(v, mesh.named_sharding(spec))
+            out[k] = v
+        return out
+
+    optim._init_state = sharded_init
+
+
 class GroupShardedOptimizerStage2:
     """Stage 1/2 wrapper (group_sharded_optimizer_stage2.py analog):
     optimizer states annotated Shard(0) on the sharding axis so the
@@ -42,33 +73,7 @@ class GroupShardedOptimizerStage2:
         self._install_state_sharding(optim)
 
     def _install_state_sharding(self, optim):
-        """Wrap the optimizer's state factory so moment/master arrays are
-        physically laid out Shard(0) over the sharding axis — each rank
-        holds 1/N of optimizer state (stage-1 semantics)."""
-        import jax
-        from .api import placements_to_spec
-        mesh = get_mesh()
-        axis = self._shard_axis
-        if mesh is None or axis not in mesh.dim_names or \
-                mesh.get_dim_size(axis) <= 1:
-            return
-        size = mesh.get_dim_size(axis)
-        orig = optim._init_state
-
-        def sharded_init(p, _orig=orig):
-            st = _orig(p)
-            out = {}
-            for k, v in st.items():
-                if v.ndim >= 1 and v.shape[0] % size == 0 and \
-                        v.shape[0] >= size:
-                    placements = [Shard(0) if n == axis else Replicate()
-                                  for n in mesh.dim_names]
-                    spec = placements_to_spec(placements, mesh, v.ndim)
-                    v = jax.device_put(v, mesh.named_sharding(spec))
-                out[k] = v
-            return out
-
-        optim._init_state = sharded_init
+        _install_state_sharding(optim, self._shard_axis)
 
     @staticmethod
     def _axis():
@@ -238,8 +243,32 @@ class DygraphShardingOptimizer:
     def __init__(self, optimizer, group=None, offload=False):
         self._inner = optimizer
         self._group = group
-        self._pg = _require_pg(group)
+        # Compiled regime: under an ambient SPMD mesh with a data axis
+        # (single controller) the whole stage-1/2 host choreography —
+        # reduce-to-owner, owner-only update, param broadcast — is
+        # subsumed by ONE sharded update program: states are laid out
+        # Shard(0) over the data axis (each device holds 1/N of m/v)
+        # and the optimizer's spmd path compiles the gradient reduce
+        # and the param re-replication INSIDE the executable. step()
+        # then just delegates. Host path untouched across processes.
+        from . import spmd as _spmd
+        self._spmd = None
+        st = _spmd.state()
+        if st is not None and _spmd._data_axis(st) is not None:
+            from .parallel_env import get_world_size, is_initialized
+            if not (is_initialized() and get_world_size() > 1):
+                self._spmd = st
+                _install_state_sharding(optimizer, _spmd._data_axis(st))
+        if self._spmd is not None:
+            self._pg = None
+        else:
+            self._pg = _require_pg(group)
         self._offload = bool(offload)
+        if self._spmd is not None:
+            self._params = [p for p, _ in optimizer._all_params()
+                            if not p.stop_gradient]
+            self._owners = {}
+            return
         # participation is decided by stop_gradient ONLY (static and
         # identical across ranks) so the collective sequence can never
         # diverge between ranks
@@ -259,11 +288,19 @@ class DygraphShardingOptimizer:
         return self._inner
 
     def owned(self, p) -> bool:
+        if self._spmd is not None:
+            return True   # single controller owns the whole logical model
         return self._owners[id(p)] == self._pg.rank
 
     def step(self):
         import jax.numpy as jnp
         import numpy as np
+        if self._spmd is not None:
+            # compiled regime: the sharded update program owns the
+            # reduce/update/re-replicate choreography (zero host
+            # collectives); states were laid out Shard(0) at init
+            self._inner.step()
+            return
         pg = self._pg
         # 1) reduce grads into owners; free the rest (ZeRO-2). Ranks with
         # a missing grad (data-dependent paths) contribute zeros plus a

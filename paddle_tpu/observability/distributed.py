@@ -689,7 +689,35 @@ class TelemetryAggregator:
             "gbps": (round(tot_bytes / (tot_comm / 1e6) / 1e9, 4)
                      if tot_comm else None),
         }
-        return {"steps": rows, "total": total}
+        return {"steps": rows, "total": total,
+                "compiled": self._compiled_comm(per_rank)}
+
+    def _compiled_comm(self, per_rank) -> Optional[Dict]:
+        """Collectives the SPMD step compiled INTO its executables are
+        invisible to the comm::* span layer — their estimated payload
+        rides the frames as ``comm.bytes.compiled.<site>`` counter
+        deltas (lazy._note_compiled_comm). Summed here so moving the
+        collectives off the host keeps them priced: a run whose host
+        comm_us dropped to ~0 while compiled bytes are nonzero MOVED
+        its traffic into the program instead of losing it."""
+        prefix = "comm.bytes.compiled."
+        sites: Dict[str, int] = {}
+        per_step = 0.0
+        for r in self.ranks:
+            rank_total = 0
+            for frame in self.frames(r):
+                for k, v in frame.get("counters", {}).items():
+                    if k.startswith(prefix):
+                        sites[k[len(prefix):]] = \
+                            sites.get(k[len(prefix):], 0) + int(v)
+                        rank_total += int(v)
+            steps = len(per_rank.get(r, ()))
+            if rank_total and steps:
+                per_step += rank_total / steps
+        if not sites:
+            return None
+        return {"sites": sites, "bytes": sum(sites.values()),
+                "bytes_per_step": round(per_step, 1)}
 
     # ----------------------------------------------------- merged trace
     def merged_trace(self, path: Optional[str] = None) -> Dict:
@@ -924,6 +952,13 @@ def render_overlap(report: Dict) -> str:
                  f"overlapped: {t['overlap_us'] / 1000.0:.2f} ms, "
                  f"fraction: {frac}, payload: {t['bytes']} B, "
                  f"achieved: {bw}")
+    comp = report.get("compiled")
+    if comp:
+        sites = ", ".join(f"{k}={v}" for k, v in
+                          sorted(comp["sites"].items()))
+        lines.append(f"  compiled-in-program collectives (est): "
+                     f"{comp['bytes']} B total, "
+                     f"{comp['bytes_per_step']} B/step ({sites})")
     for row in report["steps"]:
         frac = ("n/a" if row["overlap_frac"] is None
                 else f"{row['overlap_frac']:.3f}")

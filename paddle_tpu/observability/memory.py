@@ -57,7 +57,8 @@ _LOCK = threading.RLock()
 
 
 class _Entry:
-    __slots__ = ("ref", "nbytes", "shape", "dtype", "site", "t_birth")
+    __slots__ = ("ref", "nbytes", "pd_nbytes", "shape", "dtype", "site",
+                 "t_birth")
 
 
 _CENSUS: Dict[int, _Entry] = {}
@@ -66,6 +67,12 @@ _CENSUS: Dict[int, _Entry] = {}
 # metrics registry is off; gauges mirror these only under _state.METRICS
 LIVE_BYTES = 0
 PEAK_BYTES = 0
+# PER-DEVICE watermark: a buffer sharded over an N-device mesh costs
+# each device only its shard — THE number that sizes dp×mp against the
+# HBM budget (spmd.suggest_mesh_degree). Equals the global totals for
+# unsharded runs.
+LIVE_PD_BYTES = 0
+PEAK_PD_BYTES = 0
 DONATED_BYTES = 0
 ANALYSIS_CALLS = 0
 OOM_POSTMORTEMS = 0
@@ -117,9 +124,27 @@ def note_buffer(val, site: Optional[str] = None):
         nb = int(val.nbytes)
     except Exception:
         return
+    # per-device cost: a NamedSharding-committed buffer occupies only
+    # its shard on each device (one isinstance check on the unsharded
+    # path; shard_shape is metadata-only)
+    pd = nb
+    try:
+        sh = val.sharding
+        from jax.sharding import NamedSharding as _NS
+        if isinstance(sh, _NS) and nb:
+            shard = sh.shard_shape(tuple(val.shape))
+            n = 1
+            for s in shard:
+                n *= int(s)
+            tot = 1
+            for s in val.shape:
+                tot *= int(s)
+            pd = int(nb * n / tot) if tot else nb
+    except Exception:
+        pd = nb
     if site is None:
         site = _SITE.site or "tensor.create"
-    global LIVE_BYTES, PEAK_BYTES
+    global LIVE_BYTES, PEAK_BYTES, LIVE_PD_BYTES, PEAK_PD_BYTES
     with _LOCK:
         ex = _CENSUS.get(k)
         if ex is not None:
@@ -127,18 +152,23 @@ def note_buffer(val, site: Optional[str] = None):
                 return
             # id reuse beat the dead entry's callback: replace it
             LIVE_BYTES -= ex.nbytes
+            LIVE_PD_BYTES -= ex.pd_nbytes
             del _CENSUS[k]
         e = _Entry()
         e.ref = weakref.ref(val, lambda _r, _k=k: _drop(_k))
         e.nbytes = nb
+        e.pd_nbytes = pd
         e.shape = tuple(val.shape)
         e.dtype = str(val.dtype)
         e.site = site
         e.t_birth = time.perf_counter()
         _CENSUS[k] = e
         LIVE_BYTES += nb
+        LIVE_PD_BYTES += pd
         if LIVE_BYTES > PEAK_BYTES:
             PEAK_BYTES = LIVE_BYTES
+        if LIVE_PD_BYTES > PEAK_PD_BYTES:
+            PEAK_PD_BYTES = LIVE_PD_BYTES
         live, peak = LIVE_BYTES, PEAK_BYTES
     _publish(live, peak)
 
@@ -146,13 +176,14 @@ def note_buffer(val, site: Optional[str] = None):
 def _drop(k: int):
     """Weakref callback: the payload died (freed, or deleted by
     donation and then released) — remove it from the census."""
-    global LIVE_BYTES
+    global LIVE_BYTES, LIVE_PD_BYTES
     with _LOCK:
         e = _CENSUS.get(k)
         if e is None or e.ref() is not None:
             return              # already replaced by an id-reuse insert
         del _CENSUS[k]
         LIVE_BYTES -= e.nbytes
+        LIVE_PD_BYTES -= e.pd_nbytes
         live, peak = LIVE_BYTES, PEAK_BYTES
     _publish(live, peak)
 
@@ -169,15 +200,19 @@ def _publish(live: int, peak: int):
         _add_counter_event("memory.live_bytes", live)
 
 
-def note_segment_outputs(pending, live, out_vals, sig=None):
+def note_segment_outputs(pending, live, out_vals, sig=None, mesh=None):
     """Census registration for a flushed/replayed segment's live
-    outputs: birth site = segment signature tag + producing op."""
+    outputs: birth site = segment signature tag + producing op, plus
+    the ambient mesh descriptor when the step ran sharded
+    (``seg@<sig>:<op>#i@dp2xmp4``) — an OOM postmortem on a sharded
+    run then names which mesh configuration filled the device."""
     try:
         tag = (hash(sig) & 0xFFFF) if sig is not None else 0
     except TypeError:
         tag = 0
+    suffix = f"@{mesh}" if mesh else ""
     for (j, _s), val in zip(live, out_vals):
-        note_buffer(val, f"seg@{tag:04x}:{pending[j].op.name}#{j}")
+        note_buffer(val, f"seg@{tag:04x}:{pending[j].op.name}#{j}{suffix}")
 
 
 def note_donated(nbytes: int):
@@ -200,6 +235,14 @@ def peak_bytes() -> int:
     return PEAK_BYTES
 
 
+def per_device_bytes() -> int:
+    return LIVE_PD_BYTES
+
+
+def peak_per_device_bytes() -> int:
+    return PEAK_PD_BYTES
+
+
 def donated_bytes() -> int:
     return DONATED_BYTES
 
@@ -209,11 +252,12 @@ def census_size() -> int:
 
 
 def reset_peak():
-    """Re-anchor the watermark at the current live total (budget /
+    """Re-anchor the watermarks at the current live totals (budget /
     bench measurement windows)."""
-    global PEAK_BYTES
+    global PEAK_BYTES, PEAK_PD_BYTES
     with _LOCK:
         PEAK_BYTES = LIVE_BYTES
+        PEAK_PD_BYTES = LIVE_PD_BYTES
 
 
 def census(top: Optional[int] = None) -> List[Dict]:
@@ -233,11 +277,12 @@ def reset():
     """Drop the census and zero every total (tests / fresh measurement
     baselines). Dead entries' pending callbacks tolerate the clear."""
     global LIVE_BYTES, PEAK_BYTES, DONATED_BYTES, ANALYSIS_CALLS
-    global OOM_POSTMORTEMS
+    global OOM_POSTMORTEMS, LIVE_PD_BYTES, PEAK_PD_BYTES
     with _LOCK:
         _CENSUS.clear()
         _EXECS.clear()
         LIVE_BYTES = PEAK_BYTES = DONATED_BYTES = 0
+        LIVE_PD_BYTES = PEAK_PD_BYTES = 0
         ANALYSIS_CALLS = OOM_POSTMORTEMS = 0
 
 
@@ -343,6 +388,8 @@ def summary() -> Dict:
     return {
         "live_bytes": LIVE_BYTES,
         "peak_bytes": PEAK_BYTES,
+        "live_per_device_bytes": LIVE_PD_BYTES,
+        "peak_per_device_bytes": PEAK_PD_BYTES,
         "donated_bytes": DONATED_BYTES,
         "census": census_size(),
         "analysis_calls": ANALYSIS_CALLS,

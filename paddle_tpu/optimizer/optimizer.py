@@ -19,6 +19,7 @@ import jax.numpy as jnp
 
 from .._core import dispatch as _dispatch
 from .._core import flags as _flags
+from .._core import lazy as _lazy
 from ..observability import _state as _OBS
 from .._core.autograd import no_grad
 from .._core.tensor import Tensor
@@ -153,7 +154,11 @@ class Optimizer:
         from .._core.lazy import _quiet_donation_compile
         try:
             with _quiet_donation_compile():   # no-donation backends (CPU)
-                if _OBS.MEM:
+                if _lazy.SPMD is not None:
+                    new_p, new_s = self._run_spmd(
+                        _lazy.SPMD, fn is self._jit_update, pvals,
+                        gvals, states, lr, t, wds, lr_mults)
+                elif _OBS.MEM:
                     new_p, new_s = self._run_analyzed(
                         fn, pvals, gvals, states, lr, t, wds, lr_mults)
                 else:
@@ -205,6 +210,64 @@ class Optimizer:
         finally:
             if _memtel is not None:
                 _memtel.clear_site()
+
+    def _run_spmd(self, spmd, donate, pvals, gvals, states, lr, t, wds,
+                  lr_mults):
+        """Ambient-mesh update path (distributed/spmd.py): the fused
+        update lowers as ONE GSPMD program with explicit
+        ``in_shardings``/``out_shardings`` + donation. Outputs mirror
+        the (params, states) input layouts, so a ZeRO run (states
+        device_put Shard(0) by the sharding optimizer stages) keeps 1/N
+        of m/v per device while the compiler inserts the all-gather
+        that re-replicates the updated params INSIDE the executable —
+        no host-driven broadcast. Cached per (donation, signature,
+        layout, mesh epoch); tracer inputs fall back to the plain
+        jitted update."""
+        import jax
+        args = (pvals, gvals, states, lr, t)
+        leaves, treedef = jax.tree_util.tree_flatten(args)
+        if any(isinstance(v, jax.core.Tracer) for v in leaves):
+            fn = self._jit_update if donate else self._jit_update_nodonate
+            return fn(pvals, gvals, states, lr, t, wds=wds,
+                      lr_mults=lr_mults)
+        specs = tuple(spmd.spec_of(v) for v in leaves)
+        sig = (donate, wds, lr_mults, str(treedef),
+               tuple((tuple(v.shape), str(getattr(v, "dtype", None)))
+                     for v in leaves),
+               specs, spmd.key, _lazy.MESH_EPOCH)
+        cache = self.__dict__.setdefault("_spmd_updates", {})
+        entry = cache.get(sig)
+        if entry is None:
+            in_sh = jax.tree_util.tree_unflatten(
+                treedef, [spmd.sharding_for(c) for c in specs])
+            out_sh = (in_sh[0], in_sh[2])
+            # pjit rejects kwargs alongside in_shardings, and wds /
+            # lr_mults are part of `sig` anyway: close over them
+            body = functools.partial(self._fused_update, wds=wds,
+                                     lr_mults=lr_mults)
+            runner = jax.jit(body, in_shardings=in_sh,
+                             out_shardings=out_sh,
+                             donate_argnums=(0, 2) if donate else ())
+            if _OBS.METRICS:
+                from ..observability import metrics
+                metrics.inc("compiles.spmd")
+            if _OBS.MEM:
+                from ..observability import memory as _memtel
+                runner = _memtel.aot_compile(runner, args,
+                                             stat="optimizer", key=sig)
+            # compiled-comm estimate: an output replicated over an axis
+            # that shards a state input is the ZeRO all-gather
+            est = spmd.estimate_bytes(
+                leaves, list(pvals) + jax.tree_util.tree_leaves(states),
+                gather_only=True)
+            if len(cache) > 8:     # param-group churn guard
+                cache.clear()
+            entry = cache[sig] = (runner, est)
+        runner, est = entry
+        if est and _OBS.METRICS:
+            from ..observability import metrics
+            metrics.inc("comm.bytes.compiled.optimizer", est)
+        return runner(pvals, gvals, states, lr, t)
 
     def _run_analyzed(self, fn, pvals, gvals, states, lr, t, wds,
                       lr_mults):

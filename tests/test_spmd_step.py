@@ -1,0 +1,453 @@
+"""SPMD-compiled fused train step (ISSUE 10 acceptance).
+
+The ambient mesh (distributed/spmd.py, `with ProcessMesh: ...`) makes
+the SAME dygraph code compile to ONE GSPMD program over a dp×mp mesh:
+sharding-salted step-cache keys, compiled (in-program) collectives for
+the eager dp/ZeRO/TP paths with zero host-driven comm::* work, and the
+no-mesh session paying zero extra key bytes. Runs on the suite's forced
+8-virtual-device CPU backend (conftest)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from conftest import with_flag
+from paddle_tpu._core import dispatch, lazy
+from paddle_tpu.distributed import spmd
+
+
+def _build(seed=0):
+    paddle.seed(seed)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    opt = paddle.optimizer.Adam(1e-3, parameters=net.parameters())
+    return net, opt
+
+
+def _data(seed=0, batch=16):
+    r = np.random.RandomState(seed)
+    return (r.randn(batch, 8).astype("float32"),
+            r.randint(0, 4, (batch,)).astype("int64"))
+
+
+def _train(net, opt, x, y, steps, wrap_dp=False):
+    model = dist.DataParallel(net) if wrap_dp else net
+    xt, yt = paddle.to_tensor(x), paddle.to_tensor(y)
+    losses = []
+    for _ in range(steps):
+        loss = F.cross_entropy(model(xt), yt)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    return losses
+
+
+def _counters():
+    from paddle_tpu.observability import metrics
+    return dict(metrics.snapshot()["counters"])
+
+
+# ------------------------------------------------------------ cache keys
+
+def test_no_mesh_pays_zero_sharding_key_work():
+    """A meshless session never touches the sharding key path: no
+    component builds, 5-tuple signatures, and the signature memo still
+    hands back the same _CachedKey object every steady step."""
+    net, opt = _build()
+    x, y = _data()
+    builds0 = lazy.SHARD_SIG_BUILDS
+    _train(net, opt, x, y, 3)
+    ctx = lazy.current_context()
+    memo_key = ctx._sig_memo[6]
+    assert len(memo_key.sig) == 5, "no-mesh key grew a component"
+    _train(net, opt, x, y, 2)
+    assert ctx._sig_memo[6] is memo_key, "sig memo fast path broke"
+    assert lazy.SHARD_SIG_BUILDS == builds0, \
+        "no-mesh run built a sharding key component"
+
+
+def test_replicated_mesh_losses_and_params_bit_exact():
+    """A 1-device replicated mesh changes the key, not the numbers:
+    losses AND final params byte-equal the no-mesh fused step."""
+    x, y = _data()
+    net_a, opt_a = _build()
+    ref = _train(net_a, opt_a, x, y, 4)
+    net_b, opt_b = _build()
+    with dist.auto_mesh(1, dim_names=["dp"]):
+        got = _train(net_b, opt_b, x, y, 4)
+    assert ref == got, f"replicated-mesh losses drifted: {ref} vs {got}"
+    for pa, pb in zip(net_a.parameters(), net_b.parameters()):
+        assert np.array_equal(pa.numpy(), pb.numpy())
+
+
+def test_sharding_salted_keys_two_meshes_zero_cross_hits():
+    """Same dygraph code under two meshes keys two distinct step-cache
+    entry sets; re-running under the first mesh recompiles nothing
+    (its entries were neither evicted nor aliased by the second)."""
+    # unique layer widths: this test counts compiles, so its cache
+    # keys must be untouched by every other test in the module
+    r = np.random.RandomState(7)
+    x = r.randn(12, 8).astype("float32")
+    y = r.randint(0, 3, (12,)).astype("int64")
+    with with_flag("FLAGS_observability", True):
+        def compiles():
+            return _counters().get("compiles.fused_step", 0)
+
+        def run_under(mesh_dims, names):
+            paddle.seed(7)
+            net = nn.Sequential(nn.Linear(8, 24), nn.ReLU(),
+                                nn.Linear(24, 3))
+            opt = paddle.optimizer.Adam(
+                1e-3, parameters=net.parameters())
+            with dist.auto_mesh(*mesh_dims, dim_names=names):
+                _train(net, opt, x, y, 3)
+
+        c0 = compiles()
+        run_under((1,), ["dp"])
+        c_a = compiles() - c0
+        assert c_a > 0
+        run_under((1, 1), ["dp", "mp"])
+        c_b = compiles() - c0 - c_a
+        assert c_b == c_a, \
+            "second mesh cross-hit the first mesh's step cache"
+        # the exact same key progression as phase 1: every step hits
+        run_under((1,), ["dp"])
+        assert compiles() - c0 - c_a - c_b == 0, \
+            "re-entering the first mesh recompiled"
+
+
+def test_bump_mesh_epoch_recompiles_exactly_once():
+    x, y = _data()
+    with with_flag("FLAGS_observability", True):
+        net, opt = _build()
+        with dist.auto_mesh(1, dim_names=["dp"]):
+            _train(net, opt, x, y, 3)          # warm
+            c0 = _counters().get("compiles.fused_step", 0)
+            lazy.bump_mesh_epoch()
+            _train(net, opt, x, y, 3)
+            delta = _counters().get("compiles.fused_step", 0) - c0
+    assert delta == 1, f"expected exactly one recompile, got {delta}"
+
+
+# ----------------------------------------------------- dp gradient sync
+
+def test_dp_mesh_compiled_grad_sync_zero_host_comm():
+    """The acceptance drill: eager dp under an ambient dp4 mesh — the
+    batch shards over the mesh, gradient averaging is a compiled psum
+    inside the ≤2 XLA executions, and the host comm::* layer runs
+    ZERO collectives; losses match the single-device run."""
+    x, y = _data(batch=16)
+    ref_net, ref_opt = _build()
+    ref = _train(ref_net, ref_opt, x, y, 5)
+
+    with with_flag("FLAGS_observability", True):
+        net, opt = _build()
+        with dist.auto_mesh(4, dim_names=["dp"]):
+            c0 = _counters()
+            losses = _train(net, opt, x, y, 3, wrap_dp=True)
+            n0 = dispatch.exec_count()
+            losses += _train(net, opt, x, y, 2, wrap_dp=True)
+            per_step = (dispatch.exec_count() - n0) / 2
+            c1 = _counters()
+    host_calls = sum(v - c0.get(k, 0) for k, v in c1.items()
+                     if k.startswith("comm.calls."))
+    assert host_calls == 0, \
+        f"host-driven collectives ran under the mesh: {host_calls}"
+    assert per_step <= 2, f"{per_step} XLA executions per steady step"
+    assert c1.get("comm.bytes.compiled.fused_step", 0) > \
+        c0.get("comm.bytes.compiled.fused_step", 0), \
+        "compiled gradient all-reduce was not priced"
+    np.testing.assert_allclose(ref, losses, rtol=1e-5)
+    # the batch really ran dp-sharded
+    p = next(iter(net.parameters()))
+    assert "dp" in str(p._value.sharding.mesh.axis_names)
+
+
+# ----------------------------------------------------------------- ZeRO
+
+def test_zero_sharding_optimizer_compiled_state_sharding():
+    """DygraphShardingOptimizer under an ambient mesh routes through
+    the compiled path: moments are physically Shard(0) over dp (1/N
+    per device), the updated params re-replicate inside the program
+    (priced as comm.bytes.compiled.optimizer), and the numbers match
+    the plain optimizer."""
+    from jax.sharding import NamedSharding
+    x, y = _data()
+    ref_net, ref_opt = _build()
+    ref = _train(ref_net, ref_opt, x, y, 4)
+
+    with with_flag("FLAGS_observability", True):
+        net, opt = _build()
+        with dist.auto_mesh(4, dim_names=["dp"]):
+            c0 = _counters()
+            zopt = dist.DygraphShardingOptimizer(opt)
+            losses = _train(net, zopt, x, y, 4, wrap_dp=True)
+            c1 = _counters()
+            st = next(iter(opt._states.values()))
+            sh = st["m"].sharding
+            assert isinstance(sh, NamedSharding) and "dp" in str(sh.spec), \
+                f"optimizer state not dp-sharded: {sh}"
+    host_calls = sum(v - c0.get(k, 0) for k, v in c1.items()
+                     if k.startswith("comm.calls."))
+    assert host_calls == 0
+    assert c1.get("comm.bytes.compiled.optimizer", 0) > \
+        c0.get("comm.bytes.compiled.optimizer", 0), \
+        "ZeRO re-replication was not priced"
+    np.testing.assert_allclose(ref, losses, rtol=1e-5)
+
+
+# ------------------------------------------------------------------- TP
+
+def test_tp_layers_compile_under_ambient_mesh():
+    """Column/Row-parallel layers under an ambient dp×mp mesh carry
+    mp-sharded weights and match the dense computation — the TP
+    exchange lives inside the compiled program."""
+    r = np.random.RandomState(3)
+    with dist.auto_mesh(1, 2, dim_names=["dp", "mp"]):
+        paddle.seed(3)
+        col = dist.fleet.mp_layers.ColumnParallelLinear(
+            8, 16, gather_output=False, has_bias=False)
+        row = dist.fleet.mp_layers.RowParallelLinear(
+            16, 8, has_bias=False, input_is_parallel=True)
+        assert "mp" in str(col.weight._value.sharding.spec)
+        assert "mp" in str(row.weight._value.sharding.spec)
+        x = paddle.to_tensor(r.randn(4, 8).astype("float32"))
+        out = row(col(x))
+        loss = out.sum()
+        loss.backward()
+        got = out.numpy()
+        w1, w2 = col.weight.numpy(), row.weight.numpy()
+    ref = (x.numpy() @ w1) @ w2
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+    assert col.weight.grad is not None and row.weight.grad is not None
+
+
+# ------------------------------------------------------- fallback rules
+
+def test_shard_batch_fallback_rules():
+    x = paddle.to_tensor(np.ones((6, 4), "float32"))
+    assert dist.shard_batch(x) is x, "no mesh must be identity"
+    with dist.auto_mesh(4, dim_names=["dp"]):
+        assert dist.shard_batch(x) is x, \
+            "non-divisible batch must stay replicated"
+        ok = dist.shard_batch(paddle.to_tensor(np.ones((8, 4),
+                                                       "float32")))
+        assert "dp" in str(ok._value.sharding.spec)
+    with dist.auto_mesh(1, 1, dim_names=["pp", "mp"]):
+        assert dist.shard_batch(x) is x, "no data axis must be identity"
+
+
+def test_pending_inputs_key_distinctly_and_mesh_key_carries_devices():
+    """Review regressions: (a) an unresolved async PendingValue keys
+    as the "?" sentinel — never colliding with replicated (None) or
+    sharded concrete inputs, and such programs compile UNPINNED; (b)
+    the mesh half of the sharding component carries device ids, so two
+    same-shaped meshes over different device assignments never alias
+    a runner."""
+    import jax
+    from paddle_tpu._core.async_flush import PendingValue
+    with dist.auto_mesh(2, dim_names=["dp"]):
+        st = spmd.state()
+        pv = PendingValue(jax.ShapeDtypeStruct((4, 4), "float32"))
+        assert st.spec_of(pv) == "?"
+        assert st.spec_of(np.ones((4, 4), "float32")) is None
+        prev = lazy._ASYNC_SEEN
+        lazy._ASYNC_SEEN = True
+        try:
+            assert lazy._spmd_for_compile([pv]) is None, \
+                "pending-input program must compile unpinned"
+            assert lazy._spmd_for_compile(
+                [np.ones((2,), "float32")]) is st
+        finally:
+            lazy._ASYNC_SEEN = prev
+        key_a = st.key
+    mesh_b = dist.ProcessMesh(np.asarray([2, 3]), ["dp"])
+    with mesh_b:
+        key_b = spmd.state().key
+    assert key_a != key_b, "device assignment absent from the mesh key"
+    assert key_a[:2] == key_b[:2]      # same shape+axes, devices differ
+
+
+def test_replay_segment_pins_record_time_mesh():
+    """A captured segment compiled for replay uses the RECORD-time
+    ambient state, not whatever mesh is live at replay time."""
+    with dist.auto_mesh(2, dim_names=["dp"]):
+        seg_sp = lazy.ReplayableSegment([], [], [], [], ("sig",)).spmd
+        assert seg_sp is spmd.state()
+    seg_none = lazy.ReplayableSegment([], [], [], [], ("sig",)).spmd
+    assert seg_none is None
+
+
+def test_async_flush_parity_under_mesh():
+    """Cap-sealed async segments under an ambient mesh compile against
+    the seal-time mesh capture and stay bit-exact with sync."""
+    from paddle_tpu._core import async_flush
+
+    def chain():
+        x = paddle.to_tensor(np.ones((8, 8), "float32"))
+        with dist.auto_mesh(2, dim_names=["dp"]):
+            y = dist.shard_batch(x)
+            for i in range(12):
+                y = y * 1.01 + 0.1
+        return y.numpy()
+
+    with with_flag("FLAGS_lazy_max_segment_ops", 4):
+        ref = chain()
+        with with_flag("FLAGS_async_flush", True):
+            try:
+                got = chain()
+            finally:
+                async_flush.drain()
+    assert np.array_equal(ref, got)
+
+
+def test_async_traced_tp_constraint_keeps_captured_mesh():
+    """Review regression: the constraint op captures its mesh at call
+    time, so a cap-sealed segment traced by the flush WORKER after the
+    mesh block exited still lowers the mp sharding — not identity."""
+    from paddle_tpu._core import async_flush
+    with with_flag("FLAGS_async_flush", True), \
+            with_flag("FLAGS_lazy_max_segment_ops", 3):
+        try:
+            with dist.auto_mesh(1, 8, dim_names=["dp", "mp"]):
+                paddle.seed(0)
+                col = dist.fleet.mp_layers.ColumnParallelLinear(
+                    8, 16, gather_output=False, has_bias=False)
+                out = col(paddle.to_tensor(np.ones((4, 8), "float32")))
+                for _ in range(4):
+                    out = out * 1.0
+            val = out._value          # materialize OUTSIDE the mesh
+            async_flush.drain()
+        finally:
+            async_flush.drain(raise_latched=False)
+    assert "mp" in str(getattr(val.sharding, "spec", "")), \
+        f"async-traced constraint lost its mesh: {val.sharding}"
+
+
+def test_shard_batch_never_materializes_lazy_values():
+    """Review regression: shard_batch must not force a flush just to
+    re-lay out a recorded value — the ≤2-executions contract holds
+    when the batch itself is produced by recorded ops."""
+    ctx = lazy.current_context()
+    with dist.auto_mesh(4, dim_names=["dp"]):
+        raw = paddle.to_tensor(np.ones((8, 4), "float32"))
+        x = raw / 255.0               # recorded: payload is a LazyRef
+        seg0 = ctx.segments_run
+        out = dist.shard_batch(x)
+        assert out is x, "lazy batch must pass through unsharded"
+        assert ctx.segments_run == seg0, "shard_batch forced a flush"
+        # re-feeding an already-sharded batch pays nothing
+        s1 = dist.shard_batch(paddle.to_tensor(
+            np.ones((8, 4), "float32")))
+        assert dist.shard_batch(s1) is s1
+
+
+# ----------------------------------------------------- byte-plane hooks
+
+def test_census_provenance_carries_mesh_axis():
+    from paddle_tpu.observability import memory as memtel
+    with with_flag("FLAGS_memory_telemetry", True):
+        net, opt = _build()
+        x, y = _data(batch=8)
+        with dist.auto_mesh(2, dim_names=["dp"]):
+            model = dist.DataParallel(net)
+            xt, yt = paddle.to_tensor(x), paddle.to_tensor(y)
+            loss = F.cross_entropy(model(xt), yt)
+            loss.backward()           # fused step binds live outputs
+            # `loss` stays alive: its census entry (weakref) survives
+            # to be read
+            sites = {row["site"] for row in memtel.census()}
+            opt.clear_grad()
+    assert any(s.startswith("seg@") and s.endswith("@dp2")
+               for s in sites), f"no mesh-tagged birth sites in {sites}"
+
+
+def test_per_device_watermark_tracks_shards():
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+    from paddle_tpu.observability import memory as memtel
+    mesh = dist.auto_mesh(4, dim_names=["dp"]).jax_mesh()
+    with with_flag("FLAGS_memory_telemetry", True):
+        live0, pd0 = memtel.live_bytes(), memtel.per_device_bytes()
+        val = jax.device_put(
+            np.ones((8, 16), "float32"),
+            NamedSharding(mesh, PartitionSpec("dp")))
+        t = paddle.to_tensor(val)
+        assert memtel.live_bytes() - live0 >= 8 * 16 * 4
+        assert memtel.per_device_bytes() - pd0 <= 2 * 16 * 4 + 64, \
+            "sharded buffer not priced per-device"
+        del t, val
+
+
+def test_suggest_mesh_degree_from_bytes():
+    assert dist.suggest_mesh_degree(100, peak_bytes=60,
+                                    temp_bytes=20) == 1
+    assert dist.suggest_mesh_degree(100, peak_bytes=350,
+                                    temp_bytes=50) == 4
+    assert dist.suggest_mesh_degree(0, peak_bytes=350,
+                                    temp_bytes=50) == 1
+
+
+# --------------------------------------- compiled-pipeline checker wire
+
+def test_compiled_pipeline_checker_validates_real_lowering():
+    from paddle_tpu import analysis
+    from paddle_tpu.distributed import pipeline_compiled as pc
+    # the checker consumes the SAME permutation lists the lowerings use
+    assert pc.stream_permutation(4) == [(0, 1), (1, 2), (2, 3), (3, 0)]
+    down, up = pc.fb_permutations(4)
+    assert up == [(1, 0), (2, 1), (3, 2), (0, 3)]
+    for kind in ("stream", "1f1b"):
+        rep = analysis.check_compiled_pipeline(kind, 4, 8)
+        assert rep.ok, [d.render() for d in rep.diagnostics]
+
+
+def test_compiled_pipeline_checker_seeded_violations():
+    from paddle_tpu import analysis
+    # a non-bijective permutation is rejected before simulation
+    rep = analysis.check_compiled_pipeline("bogus-kind", 4, 8)
+    assert not rep.ok
+    # seeded deadlock: drop one rank's send — its peer's recv starves
+    progs = analysis.compiled_pipeline_programs("stream", 4, 4)
+    progs[2] = [op for op in progs[2] if op[0] != "send"]
+    from paddle_tpu.analysis.diagnostics import CheckReport
+    rep = CheckReport("seeded")
+    analysis.simulate_pipeline(progs, rep, schedule="seeded")
+    assert not rep.ok
+    assert any("DEADLOCK" in d.message for d in rep.diagnostics)
+
+
+# ------------------------------------------- overlap report parity
+
+def test_overlap_report_prices_compiled_collectives():
+    from paddle_tpu.observability import distributed as dtel
+    agg = dtel.TelemetryAggregator()
+    frame = {"v": dtel.FRAME_VERSION, "rank": 0, "pid": 1, "seq": 1,
+             "step": 1, "mesh_epoch": 0, "t_wall": 1000.0,
+             "t_perf_us": 0.0,
+             "counters": {"comm.bytes.compiled.fused_step": 4096,
+                          "comm.bytes.compiled.optimizer": 1024,
+                          "cache.fused_step.hit": 2},
+             "hists": {},
+             "spans": [],
+             "marks": [[1, 1000.0, 500.0], [2, 2000.0, 500.0]]}
+    agg.add_frame(frame)
+    rep = agg.overlap_report()
+    comp = rep["compiled"]
+    assert comp["bytes"] == 5120
+    assert comp["sites"] == {"fused_step": 4096, "optimizer": 1024}
+    assert comp["bytes_per_step"] == 2560.0
+    assert "compiled-in-program" in dtel.render_overlap(rep)
+
+
+def test_overlap_report_compiled_absent_without_counters():
+    from paddle_tpu.observability import distributed as dtel
+    agg = dtel.TelemetryAggregator()
+    agg.add_frame({"v": dtel.FRAME_VERSION, "rank": 0, "pid": 1,
+                   "seq": 1, "step": 1, "mesh_epoch": 0,
+                   "t_wall": 1000.0, "t_perf_us": 0.0, "counters": {},
+                   "hists": {}, "spans": [],
+                   "marks": [[1, 1000.0, 500.0]]})
+    assert agg.overlap_report()["compiled"] is None
