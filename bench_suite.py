@@ -67,6 +67,21 @@ Row 12 SPMD fused-step multichip dryrun   spawns subprocesses with
                                   the sharding key path
                                   (lazy.SHARD_SIG_BUILDS frozen)
 
+Row 13 perf static analyzer gate    runs `python -m paddle_tpu.analysis
+                                  --perf --json` (fusion-break / host-
+                                  sync / implicit-reshard counts over
+                                  the bench models on the dryrun dp×mp
+                                  mesh; subprocess rc gates the row)
+                                  and asserts `budget.static_diff` on
+                                  the LeNet budget model reconciles
+                                  static predictions with the measured
+                                  seal-reason counters; the per-class
+                                  counts land as 'findings' rows that
+                                  --diff compares with ZERO tolerance —
+                                  a PR that introduces a new fusion
+                                  break or implicit reshard on the
+                                  bench models fails the gate
+
 (Multi-chip GPT/ERNIE hybrids need a pod; their single-chip proxies are
 bench.py's headline + the dryrun_multichip compile check.)
 
@@ -931,6 +946,66 @@ def bench_spmd_multichip():
             "rows": rows}
 
 
+def bench_perf_lint():
+    """Row 13: the perf static analyzer as a mechanical regression
+    gate. The --perf CLI sweeps the bench models (eager-GPT fusion
+    breaks, eager-ResNet BN-sync class, sharded models' implicit
+    reshards on the dryrun dp×mp mesh) in a subprocess — its exit code
+    gates the row — and budget.static_diff proves the analyzer's
+    predictions match the measured seal-reason counters in-process.
+    Per-class counts become 'findings' rows: --diff treats any
+    INCREASE as a regression (zero tolerance)."""
+    import subprocess
+    import sys
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8",
+               PT_PERF_NO_REEXEC="1")
+    out = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.analysis", "--perf",
+         "--json"],
+        capture_output=True, text=True, env=env, timeout=1800)
+    lines = [ln for ln in out.stdout.splitlines() if ln.startswith("{")]
+    if out.returncode != 0 or not lines:
+        raise RuntimeError(
+            f"analysis --perf failed rc={out.returncode}: "
+            f"{out.stderr[-2000:]}")
+    payload = json.loads(lines[-1])
+
+    def count(model, key):
+        return sum(d.get(key, 0) for d in payload["models"].get(model,
+                                                                ()))
+
+    # static-vs-measured reconciliation on the LeNet budget model (the
+    # deterministic fused-path workload): the analyzer is held to the
+    # meters, in-process
+    from paddle_tpu.observability import budget
+    from paddle_tpu.observability.__main__ import _lenet_step
+    sd = budget.static_diff(_lenet_step(), steps=3)
+    assert sd["ok"], \
+        f"static seal predictions diverge from measured counters: {sd}"
+
+    rows = [
+        {"metric": "perf lint fusion breaks (eager-GPT bench model)",
+         "value": count("gpt2-eager", "breaks"), "unit": "findings"},
+        {"metric": "perf lint host syncs (eager-ResNet BN-stat class)",
+         "value": count("resnet50-eager", "syncs"), "unit": "findings"},
+        {"metric": "perf lint implicit reshards (sharded dryrun "
+                   "models)",
+         "value": (count("lenet-sharded", "reshards")
+                   + count("tp-sharded", "reshards")),
+         "unit": "findings"},
+    ]
+    return {"metric": "perf static analyzer gate (fusion breaks + "
+                      "host syncs + implicit reshards on the bench "
+                      "models; static-diff reconciled)",
+            "value": payload["breaks"] + payload["syncs"]
+            + payload["reshards"],
+            "unit": "findings",
+            "static_diff_ok": bool(sd["ok"]),
+            "rows": rows}
+
+
 # ------------------------------------------------------------- diff mode
 
 def _rows_of(path: str) -> dict:
@@ -990,14 +1065,25 @@ def diff_mode(threshold: float = 0.10) -> int:
         return 2
     old_path, new_path = files[-2], files[-1]
     old, new = _rows_of(old_path), _rows_of(new_path)
-    shared = [m for m in new if m in old and old[m][0]]
+    # a zero old value is only comparable for count rows ('findings'):
+    # 0 -> 1 findings is exactly the regression the perf-lint gate
+    # exists to catch, while a 0 rate/latency row is a broken sample
+    shared = [m for m in new
+              if m in old and (old[m][0] or old[m][1] == "findings")]
     regressions = []
     for m in shared:
         ov, unit = old[m]
         nv = new[m][0]
-        change = (nv - ov) / abs(ov)
-        worse = change > threshold if _lower_is_better(m, unit) \
-            else change < -threshold
+        if unit == "findings":
+            # perf-lint counts gate with ZERO tolerance: any new
+            # fusion break / host sync / implicit reshard on the bench
+            # models is a regression, however small the percentage
+            change = (nv - ov) / abs(ov) if ov else (1.0 if nv else 0.0)
+            worse = nv > ov
+        else:
+            change = (nv - ov) / abs(ov)
+            worse = change > threshold if _lower_is_better(m, unit) \
+                else change < -threshold
         mark = "REGRESSION" if worse else "ok"
         print(f"  [{mark:>10}] {change * 100:+7.1f}%  {m}  "
               f"({ov:g} -> {nv:g} {unit})")
@@ -1027,13 +1113,13 @@ def main():
         _spmd_dryrun_worker(int(sys.argv[i + 1]))
         return
     rows = os.environ.get("BENCH_ROWS",
-                          "1,2,3,4,5,6,7,8,9,10,11,12").split(",")
+                          "1,2,3,4,5,6,7,8,9,10,11,12,13").split(",")
     table = {"1": bench_lenet, "2": bench_resnet50, "3": bench_bert,
              "4": bench_dispatch, "5": bench_static_checks,
              "6": bench_observability, "7": bench_resilience,
              "8": bench_replan, "9": bench_async_flush,
              "10": bench_telemetry, "11": bench_memory,
-             "12": bench_spmd_multichip}
+             "12": bench_spmd_multichip, "13": bench_perf_lint}
     for r in rows:
         r = r.strip()
         out = table[r]()

@@ -80,9 +80,12 @@ def apply(op_name: str, *inputs, **attrs):
     if ctx is not None:
         try:
             outs = ctx.record(op, ts, attrs)
-        except Exception:
+        except Exception as e:
             # un-capturable op (data-dependent shapes, host-side body):
-            # graph break — run what's pending, then this op eagerly
+            # graph break — run what's pending, then this op eagerly.
+            # The failure is stashed (as a string, no traceback pin) so
+            # the perf analyzer can name WHY the window broke.
+            ctx._last_record_error = (op_name, f"{type(e).__name__}: {e}")
             ctx.flush("record_fallback:" + op_name)
         else:
             # cap-flush OUTSIDE the handler: a segment that fails to

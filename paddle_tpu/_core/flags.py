@@ -246,6 +246,17 @@ define_flag("FLAGS_dead_capture_min_bytes", 4096,
             "Dead-capture lint floor companion: minimum wasted output "
             "bytes before a dead capture below the FLOPs floor is "
             "still reported.")
+define_flag("FLAGS_sharding_replicated_min_bytes", 1 << 20,
+            "Sharding perf lint (analysis/sharding_prop.py): minimum "
+            "redundant bytes (tensor size x (mesh size - 1)) before a "
+            "fully-replicated input to an otherwise-sharded program is "
+            "flagged (small scalars/stats are legitimately replicated; "
+            "0 flags everything).")
+define_flag("FLAGS_sharding_comm_min_bytes", 1024,
+            "Sharding perf lint: minimum total priced compiled-"
+            "collective traffic per execution before the ranked "
+            "comm-hotspot summary diagnostic is attached to the "
+            "report (0 reports any non-zero traffic).")
 # off-synonym values the hot-path gates (lazy record/flush, PassManager)
 # test membership against — keeps '0'/'false' spellings from paying the
 # analysis import or even a str() call per recorded op. The lowercase

@@ -75,6 +75,20 @@ SPMD = None
 # assert: a no-mesh run must never touch the sharding key path)
 SHARD_SIG_BUILDS = 0
 
+# Perf-lint flush observer (analysis/perf_checks.py installs
+# hooks.on_perf_flush here while a PerfRecorder is active): every seal
+# of the fusion window — flush, per-op replay, fused backward — reports
+# (ctx, reason, pending) so the static perf analyzer can attribute
+# fusion-window breaks and host syncs to the recorded ops' source
+# lines. None = one module-attr read per flush, zero work.
+PERF_OBSERVER = None
+
+# Forced src capture for perf traces (nesting counter): _PendingOp.src
+# is normally captured only under FLAGS_static_checks, but perf
+# diagnostics must point at Python source even with the sanitizer off —
+# the analysis CLI and check_perf bump this around their own traces.
+PERF_SRC = 0
+
 
 def bump_mesh_epoch() -> int:
     """Invalidate the compiled-segment and fused-step cache keys (the
@@ -491,6 +505,10 @@ class CaptureContext:
         # component (None without a mesh), so a replan, a mesh switch
         # or any structural drift rebuilds.
         self._sig_memo: Optional[Tuple] = None
+        # (op_name, repr(error)) of the last record() failure — the
+        # executor stashes it on the record_fallback path so the perf
+        # analyzer can say WHY an op broke the window
+        self._last_record_error = None
         # stats for tests / profiling
         self.segments_run = 0
         self.ops_recorded = 0
@@ -602,6 +620,12 @@ class CaptureContext:
                     from ..analysis import alias_graph as _ag
                     for _out in outs:
                         _ag.note_view(_out, base, op.name, src)
+        elif PERF_SRC:
+            # perf tracing forces provenance capture even with the
+            # sanitizer off (no alias-graph work — that is the
+            # correctness sanitizer's job, not the perf lint's)
+            from ..analysis.hooks import call_site
+            src = call_site()
         self.pending.append(_PendingOp(op, dict(attrs), wiring, out_refs,
                                        src))
         entry = (op.name, akey, wiring, len(out_refs))
@@ -683,6 +707,8 @@ class CaptureContext:
             # partially-failed record may have left behind
             self._reset_segment()
             return
+        if PERF_OBSERVER is not None:
+            PERF_OBSERVER(self, reason, self.pending)
         pending = self.pending
         in_vals = self._in_vals
         in_meta = self._in_meta
@@ -1041,6 +1067,8 @@ class CaptureContext:
         if not self.pending:
             self._reset_segment()
             return
+        if PERF_OBSERVER is not None:
+            PERF_OBSERVER(self, reason, self.pending)
         pending = self.pending
         in_vals = self._in_vals
         in_meta = self._in_meta
@@ -1716,6 +1744,12 @@ def try_fused_backward(tensors, grad_tensors) -> bool:
     if not grad_in:
         return False
     grad_in = tuple(grad_in)
+
+    if PERF_OBSERVER is not None:
+        # the fused fwd+vjp path seals the window without calling
+        # flush(): report it so a perf trace's seal accounting matches
+        # the segment.flush_reason.* counters exactly
+        PERF_OBSERVER(ctx, "backward_fused", pending)
 
     # the sanitizer covers the fused fwd+vjp path exactly like a plain
     # flush — this IS the default steady-state train step, so 'error'

@@ -34,7 +34,7 @@ from typing import Optional, Sequence, Tuple
 
 from .diagnostics import (CheckReport, Diagnostic, StaticCheckError,
                           StaticCheckWarning, SEVERITY_ERROR,
-                          SEVERITY_WARNING)
+                          SEVERITY_PERF, SEVERITY_WARNING)
 from .segment_checks import (SegmentView, check_dead_captures,
                              check_donation_safety,
                              check_inplace_races,
@@ -49,8 +49,12 @@ from .distributed_checks import (check_compiled_pipeline,
                                  check_pipeline_schedule, check_reshard,
                                  compiled_pipeline_programs,
                                  simulate_pipeline)
+from .perf_checks import PerfRecorder, trace_step
+from .perf_checks import check_perf as _check_perf_impl
+from .sharding_prop import propagate as propagate_specs
+from .sharding_prop import check_sharding as _check_sharding_impl
 from . import alias_graph, dataflow, distributed_checks, fixes, hooks, \
-    sot_checks
+    perf_checks, sharding_prop, sot_checks
 
 __all__ = [
     "CheckReport", "Diagnostic", "StaticCheckError",
@@ -59,8 +63,28 @@ __all__ = [
     "check_reshard", "check_pipeline_schedule", "simulate_pipeline",
     "check_compiled_pipeline", "compiled_pipeline_programs",
     "check_cross_segment_donation", "check_view_aliases",
-    "check_dead_captures", "fix_segment",
+    "check_dead_captures", "fix_segment", "check_perf",
+    "check_sharding", "propagate_specs", "PerfRecorder", "trace_step",
 ]
+
+
+def check_perf(ctx_or_step) -> CheckReport:
+    """Perf lint: fusion-window breaks + host syncs. Pass a step
+    callable to trace one step (src capture forced — diagnostics carry
+    file:line even with FLAGS_static_checks off), or an open
+    CaptureContext for the purely-static sweep of its pending program
+    (segment-cap prediction)."""
+    return _check_perf_impl(ctx_or_step)
+
+
+def check_sharding(ctx_or_view, mesh=None,
+                   report: Optional[CheckReport] = None) -> CheckReport:
+    """Sharding perf lint: propagate PartitionSpecs through the pending
+    op graph under `mesh` (default: the active ambient mesh) and flag
+    implicit reshards, mp-boundary spec mismatches and accidentally-
+    replicated large tensors; the report's `sharding_comm` summary
+    ranks per-op compiled-collective hotspots."""
+    return _check_sharding_impl(ctx_or_view, mesh=mesh, report=report)
 
 
 def check_segment(ctx_or_view, donate: Optional[Tuple[int, ...]] = None,

@@ -3,6 +3,9 @@
     python -m paddle_tpu.analysis
         [--models lenet,resnet50,bert,reshard,replan,pipeline]
         [--execute] [--verbose] [--json] [--fix]
+    python -m paddle_tpu.analysis --perf
+        [--models gpt2-eager,resnet50-eager,lenet-sharded,tp-sharded]
+        [--json]
 
 Default is record-only: each model's forward(+loss) is RECORDED into a
 lazy capture window (aval inference, no XLA compile/run), the segment
@@ -16,11 +19,25 @@ shape: headline numbers + a `counters` block). `--fix` plans the
 mechanical repairs for every finding and prints the dry-run diff (the
 runtime equivalent is `FLAGS_static_checks=fix`). Exit code 0 = no
 findings (post-fix findings when --fix).
+
+``--perf`` switches to the PERFORMANCE lint (analysis/perf_checks.py +
+sharding_prop.py): the eager bench models are traced for one step and
+every fusion-window break (eager-GPT's per-layer `record_fallback`)
+and host sync (eager-ResNet's batch-norm running-stat class) is
+reported with source attribution and the predicted seal-reason
+histogram (`budget --static-diff` reconciles these against measured
+counters); the sharded models record under a dryrun dp×mp mesh and
+run the PartitionSpec propagation sweep (implicit reshards, mp-layer
+round trips, comm-hotspot ranking). Needs ≥4 devices for the dryrun
+mesh — on a single-device host the CLI re-execs itself with 8 forced
+CPU devices. Perf findings are expected (exit 0 reports them; the
+bench_suite --diff gate compares their COUNTS across rounds).
 """
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
 _JSON = {"models": {}}
@@ -322,13 +339,245 @@ def run_pipeline(execute: bool, verbose: bool):
     return reports
 
 
+# ------------------------------------------------------------ perf lint
+
+def _perf_note(name: str, report, seal_counts=None, extra=None):
+    d = report.to_dict()
+    breaks = sum((x["data"] or {}).get("count", 1)
+                 for x in d["diagnostics"]
+                 if x["checker"] == "fusion_break")
+    syncs = sum((x["data"] or {}).get("count", 1)
+                for x in d["diagnostics"]
+                if x["checker"] == "host_sync")
+    reshards = sum(1 for x in d["diagnostics"]
+                   if x["checker"] == "implicit_reshard")
+    d.update({"breaks": breaks, "syncs": syncs, "reshards": reshards,
+              "seal_counts": seal_counts or {}})
+    if extra:
+        d.update(extra)
+    _JSON["models"].setdefault(name, []).append(d)
+    return d
+
+
+def _perf_print(name: str, d, report, verbose: bool):
+    print(f"[{name}] perf lint: {d['breaks']} fusion break(s), "
+          f"{d['syncs']} host sync(s), {d['reshards']} implicit "
+          f"reshard(s) per step"
+          + (f"; seals {d['seal_counts']}" if d["seal_counts"] else ""))
+    if verbose or report.diagnostics:
+        for diag in report.diagnostics:
+            print("   ", diag.render())
+
+
+def perf_gpt2_eager(verbose: bool):
+    """Eager-GPT, the BUDGET_r06 configuration (hidden 128, 4 layers,
+    seq 128): one traced train step. Expected steady-state shape on
+    this toolchain: 4 `record_fallback` breaks/step (the Pallas
+    flash-attention dispatch cannot record) — the finding the 'kill
+    the host dispatch tax' ROADMAP item consumes."""
+    from paddle_tpu.observability.__main__ import _gpt2_step
+    from paddle_tpu import analysis
+    report, counts, _ = analysis.trace_step(_gpt2_step())
+    d = _perf_note("gpt2-eager", report, counts)
+    _perf_print("gpt2-eager", d, report, verbose)
+    return report
+
+
+def perf_resnet50_eager(verbose: bool):
+    """Eager ResNet-50 in TRAIN mode (running stats live), small input
+    so the CLI stays quick: one traced step. Expected: the batch-norm
+    running-stat class — one deduped host_sync finding counting 53
+    materialize seals/step at nn/functional/norm.py."""
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu import analysis
+    from paddle_tpu.vision.models import resnet50
+
+    paddle.seed(0)
+    model = resnet50()
+    model.train()
+    opt = paddle.optimizer.Momentum(0.1, parameters=model.parameters())
+    r = np.random.RandomState(0)
+    x = paddle.to_tensor(r.randn(2, 3, 64, 64).astype("float32"))
+    y = paddle.to_tensor(r.randint(0, 1000, (2,)).astype("int64"))
+
+    def step():
+        loss = F.cross_entropy(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        np.asarray(loss._value)
+
+    report, counts, _ = analysis.trace_step(step)
+    d = _perf_note("resnet50-eager", report, counts)
+    _perf_print("resnet50-eager", d, report, verbose)
+    return report
+
+
+def _dryrun_mesh():
+    import jax
+    import paddle_tpu.distributed as dist
+    n = jax.device_count()
+    if n >= 4:
+        return dist.auto_mesh(2, 2, dim_names=["dp", "mp"])
+    # degraded single-device fallback (the CLI normally re-execs with
+    # 8 forced CPU devices before getting here)
+    return dist.auto_mesh(1, 1, dim_names=["dp", "mp"])
+
+
+def perf_lenet_sharded(verbose: bool):
+    """LeNet forward recorded under the dryrun dp×mp mesh with a
+    dp-sharded batch: the PartitionSpec propagation sweep. A correctly
+    laid-out model: zero reshard findings, batch sharding propagates
+    end to end, the loss reduction is the only priced collective."""
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu import analysis
+    from paddle_tpu._core import lazy
+    from paddle_tpu.vision.models import LeNet
+
+    paddle.seed(0)
+    r = np.random.RandomState(0)
+    with _dryrun_mesh():
+        model = LeNet()
+        x = dist.shard_batch(paddle.to_tensor(
+            r.randn(8, 1, 28, 28).astype("float32")))
+        y = paddle.to_tensor(r.randint(0, 10, (8,)).astype("int64"))
+        lazy.PERF_SRC += 1
+        try:
+            with lazy.lazy_guard(max_segment_ops=1 << 30) as ctx:
+                out = F.cross_entropy(model(x), y)
+                res, report = analysis.propagate_specs(ctx)
+                analysis.sharding_prop.summarize_comm(res, report)
+                ctx._reset_segment()
+        finally:
+            lazy.PERF_SRC -= 1
+    d = _perf_note("lenet-sharded", report,
+                   extra={"comm_bytes": res.comm_total(),
+                          "comm": res.comm})
+    _perf_print("lenet-sharded", d, report, verbose)
+    return report
+
+
+def perf_tp_sharded(verbose: bool):
+    """Column→Row parallel mp-layers under the dryrun mesh: the TP
+    boundary contract — specs must round-trip the sharding-constraint
+    ops (zero implicit_reshard findings) and the row exchange prices
+    as the one intended all-reduce."""
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    from paddle_tpu import analysis
+    from paddle_tpu._core import lazy
+
+    paddle.seed(3)
+    r = np.random.RandomState(3)
+    with _dryrun_mesh():
+        col = dist.fleet.mp_layers.ColumnParallelLinear(
+            8, 16, gather_output=False, has_bias=False)
+        row = dist.fleet.mp_layers.RowParallelLinear(
+            16, 8, has_bias=False, input_is_parallel=True)
+        x = paddle.to_tensor(r.randn(4, 8).astype("float32"))
+        lazy.PERF_SRC += 1
+        try:
+            with lazy.lazy_guard(max_segment_ops=1 << 30) as ctx:
+                out = row(col(x))
+                res, report = analysis.propagate_specs(ctx)
+                analysis.sharding_prop.summarize_comm(res, report)
+                ctx._reset_segment()
+        finally:
+            lazy.PERF_SRC -= 1
+    d = _perf_note("tp-sharded", report,
+                   extra={"comm_bytes": res.comm_total(),
+                          "comm": res.comm})
+    _perf_print("tp-sharded", d, report, verbose)
+    return report
+
+
+_PERF_TABLE = {
+    "gpt2-eager": perf_gpt2_eager,
+    "resnet50-eager": perf_resnet50_eager,
+    "lenet-sharded": perf_lenet_sharded,
+    "tp-sharded": perf_tp_sharded,
+}
+_PERF_DEFAULT_MODELS = "gpt2-eager,resnet50-eager,lenet-sharded," \
+                       "tp-sharded"
+
+
+def _maybe_reexec_for_devices(argv) -> int:
+    """--perf wants the dryrun dp×mp mesh (≥4 devices). On a
+    single-device host, re-exec with 8 forced CPU devices BEFORE jax
+    initializes in this process. Returns the child's exit code, or -1
+    to continue in-process."""
+    if os.environ.get("PT_PERF_NO_REEXEC") == "1":
+        return -1
+    if "xla_force_host_platform_device_count" in \
+            os.environ.get("XLA_FLAGS", ""):
+        return -1
+    import jax
+    if jax.device_count() >= 4:
+        return -1
+    import subprocess
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8"
+                        ).strip()
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["PT_PERF_NO_REEXEC"] = "1"
+    return subprocess.call(
+        [sys.executable, "-m", "paddle_tpu.analysis"] + list(argv),
+        env=env)
+
+
+def _perf_main(args, argv) -> int:
+    rc = _maybe_reexec_for_devices(argv)
+    if rc >= 0:
+        return rc
+    import paddle_tpu as paddle  # noqa: F401 (jax/backend init)
+    _JSON["models"] = {}
+    models = args.models if args.models is not None \
+        else _PERF_DEFAULT_MODELS
+    reports = []
+    for m in models.split(","):
+        m = m.strip()
+        if not m:
+            continue
+        if m not in _PERF_TABLE:
+            print(f"unknown perf model '{m}' "
+                  f"(have: {sorted(_PERF_TABLE)})")
+            return 2
+        reports.append(_PERF_TABLE[m](args.verbose))
+    totals = {
+        "breaks": sum(d["breaks"] for v in _JSON["models"].values()
+                      for d in v),
+        "syncs": sum(d["syncs"] for v in _JSON["models"].values()
+                     for d in v),
+        "reshards": sum(d["reshards"] for v in _JSON["models"].values()
+                        for d in v),
+    }
+    print(f"== perf lint: {totals['breaks']} fusion break(s), "
+          f"{totals['syncs']} host sync(s), {totals['reshards']} "
+          f"implicit reshard(s) across {len(reports)} model(s)")
+    if args.json:
+        print(json.dumps(dict(totals, models=_JSON["models"])))
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="python -m paddle_tpu.analysis")
-    ap.add_argument("--models",
-                    default="lenet,resnet50,bert,reshard,replan,"
-                            "pipeline",
+    ap.add_argument("--models", default=None,
                     help="comma list: lenet,resnet50,bert,reshard,"
-                         "replan,pipeline")
+                         "replan,pipeline (sanitizer mode) or "
+                         "gpt2-eager,resnet50-eager,lenet-sharded,"
+                         "tp-sharded (--perf mode)")
+    ap.add_argument("--perf", action="store_true",
+                    help="performance lint: trace the eager bench "
+                         "models for fusion-window breaks / host syncs "
+                         "and sweep the sharded models' PartitionSpec "
+                         "propagation on a dryrun dp×mp mesh")
     ap.add_argument("--execute", action="store_true",
                     help="also flush/execute each recorded segment")
     ap.add_argument("--verbose", action="store_true",
@@ -340,11 +589,17 @@ def main(argv=None) -> int:
                     help="plan the mechanical repairs and print the "
                          "dry-run diff; exit code reflects the "
                          "post-fix residual")
+    raw_argv = list(sys.argv[1:] if argv is None else argv)
     args = ap.parse_args(argv)
+
+    if args.perf:
+        return _perf_main(args, raw_argv)
 
     global _FIX
     _FIX = bool(args.fix)
     _JSON["models"] = {}     # fresh accumulator per invocation
+    if args.models is None:
+        args.models = "lenet,resnet50,bert,reshard,replan,pipeline"
 
     import paddle_tpu as paddle
     # provenance is captured at record time only when checks are on

@@ -14,6 +14,13 @@ from typing import List, Optional
 
 SEVERITY_ERROR = "error"
 SEVERITY_WARNING = "warning"
+# Performance lint severity (perf_checks / sharding_prop findings):
+# the program is CORRECT but pays for it — a fusion-window break, a
+# host sync, an implicit reshard. Never raises in 'error' mode (a slow
+# program must not be stopped like a corrupting one) and never emitted
+# by the flush-hook correctness sweep, only by the perf surfaces
+# (check_perf / check_sharding / the analysis --perf CLI).
+SEVERITY_PERF = "perf"
 
 
 class StaticCheckWarning(UserWarning):

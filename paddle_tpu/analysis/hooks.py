@@ -8,7 +8,7 @@ from __future__ import annotations
 
 import os
 import sys
-from typing import Optional
+from typing import Optional, Tuple
 
 
 def check_mode() -> str:
@@ -144,6 +144,22 @@ def on_segment_flush(ctx, pending, in_vals, in_meta, in_tensors,
     return out
 
 
+# ------------------------------------------------------------ perf lint
+
+def on_perf_flush(ctx, reason: str, pending):
+    """Fusion-window seal observer (`lazy.PERF_OBSERVER` points here
+    while a perf trace is active): every flush / per-op replay / fused
+    backward reports its seal reason and the pending program so the
+    perf analyzer (analysis/perf_checks.py) can attribute window
+    breaks and host syncs to source lines. Installed only for the
+    duration of a PerfRecorder trace — the steady state pays one
+    module-attr read per flush."""
+    from .perf_checks import _active_recorder
+    rec = _active_recorder()
+    if rec is not None:
+        rec._on_seal(ctx, reason, pending)
+
+
 # ------------------------------------------------- distributed surfaces
 
 def on_reshard(val_ndim: int, src, dst, global_shape, mode: str):
@@ -276,3 +292,46 @@ def call_site() -> Optional[str]:
             return f"{fname}:{f.f_lineno}"
         f = f.f_back
     return None
+
+
+# runtime-infrastructure layers a perf diagnostic should see THROUGH:
+# the sync/break trigger inside nn/models/vision code (a batch_norm
+# running-stat read, a flash_attention dispatch) is the informative
+# frame, while _core/analysis/observability frames are plumbing
+_INFRA_DIRS = tuple(os.path.join(_PKG_DIR, d) + os.sep
+                    for d in ("_core", "analysis", "observability",
+                              "jit", "autograd"))
+# stdlib frames (runpy bootstrapping a -m CLI, threading glue) are
+# plumbing, never the "user source" of a perf event
+_STDLIB_DIR = os.path.dirname(os.__file__) + os.sep
+_FRAME_KIND: dict = {}   # co_filename -> 'user' | 'infra' | 'framework'
+
+
+def perf_site() -> Tuple[Optional[str], Optional[str]]:
+    """(user_site, framework_site) of the current call stack: the first
+    frame OUTSIDE the package (what call_site returns — where user code
+    triggered the event) and the first package frame outside the
+    runtime-infrastructure layers (where in nn/models/io code the sync
+    or break actually lives, e.g. nn/functional/norm.py's running-stat
+    update). Either may be None."""
+    user = framework = None
+    f = sys._getframe(1)
+    while f is not None and user is None:
+        fname = f.f_code.co_filename
+        kind = _FRAME_KIND.get(fname)
+        if kind is None:
+            ap = os.path.abspath(fname)
+            if ap.startswith(_PKG_DIR):
+                kind = "infra" if ap.startswith(_INFRA_DIRS) \
+                    else "framework"
+            elif ap.startswith(_STDLIB_DIR) or fname.startswith("<"):
+                kind = "infra"
+            else:
+                kind = "user"
+            _FRAME_KIND[fname] = kind
+        if kind == "user":
+            user = f"{fname}:{f.f_lineno}"
+        elif kind == "framework" and framework is None:
+            framework = f"{fname}:{f.f_lineno}"
+        f = f.f_back
+    return user, framework

@@ -1,0 +1,302 @@
+"""Static *performance* lint over the fusion window: fusion-window
+breaks and host syncs.
+
+BUDGET_r06 diagnosed the single-chip plateau dynamically — eager-GPT
+breaks the fusion window 4×/step (`record_fallback` on the Pallas
+flash-attention dispatch forfeits the step cache and optimizer
+donation), eager-ResNet syncs 54×/step materializing batch-norm
+running stats. This module turns those one-off measurements into a
+repeatable static analyzer: a :class:`PerfRecorder` observes every
+seal of the fusion window (``lazy.PERF_OBSERVER`` → `hooks.on_perf_
+flush`) during ONE traced step and classifies each seal structurally —
+no timing involved, so the findings are deterministic and diffable:
+
+- **fusion breaks** (`lazy._WINDOW_BREAK_REASONS`): `record_fallback`
+  (an op that cannot record — the stashed record error names why),
+  `segment_cap` (the window outgrew FLAGS_lazy_max_segment_ops),
+  `ambient_disable` / `guard_error`. Each break forfeits the step
+  cache and the optimizer's donation fast path for that window.
+- **host syncs**: a mid-step ``materialize`` (in-window state math that
+  escapes to a `._value` read — the batch-norm running-stat class) and
+  `grad_targets` per-op replays.
+
+Diagnostics carry the user src ``file:line`` threaded through
+`_PendingOp.src` (capture is FORCED for perf traces via
+``lazy.PERF_SRC`` even when FLAGS_static_checks is off) plus the
+framework frame that issued the read (`hooks.perf_site`), and repeated
+findings from the same source line dedupe into one diagnostic with a
+count. `seal_counts()` is the full predicted per-step seal-reason
+histogram — what ``budget --static-diff`` reconciles against the
+measured ``segment.flush_reason.*`` counters.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .diagnostics import (CheckReport, SEVERITY_PERF)
+
+CHECKER_BREAK = "fusion_break"
+CHECKER_SYNC = "host_sync"
+
+# seal-reason classification (heads; record_fallback:<op> collapses).
+# Breaks are THE set the measured fusion.window_breaks counter uses
+# (imported, not copied — a new break reason classifies on both sides
+# at once); syncs are the reads that stall the host mid-step;
+# everything else (backward, backward_fused, guard_exit, mesh
+# transitions, cli/test seals) is a natural whole-step boundary and
+# never a finding.
+from .._core.lazy import _WINDOW_BREAK_REASONS as BREAK_REASONS
+
+SYNC_REASONS = frozenset(("materialize", "grad_targets"))
+
+_HINTS = {
+    "record_fallback": (
+        "this op cannot record into the fusion window: it dispatches "
+        "per-op, seals the pending segment, and the step loses the "
+        "fused fwd+vjp cache + optimizer donation — move it out of the "
+        "step or make its aval inference succeed"),
+    "segment_cap": (
+        "the window hit FLAGS_lazy_max_segment_ops mid-step; raise the "
+        "cap (or split the step) so the whole step seals at backward"),
+    "ambient_disable": (
+        "FLAGS_eager_fusion flipped off mid-step with ops pending"),
+    "guard_error": (
+        "an exception unwound through a lazy_guard with ops pending"),
+    "materialize": (
+        "in-window value read back on the host mid-step (the batch-norm "
+        "running-stat class): pure elementwise state math can stay "
+        "recorded in the window — keep the update on Tensors, or move "
+        "the host read outside the step"),
+    "grad_targets": (
+        "paddle.grad(targets) replays the trace per-op: interior-value "
+        "grads forfeit whole-step fusion for this window"),
+}
+
+
+class PerfEvent:
+    """One observed seal of the fusion window."""
+
+    __slots__ = ("reason", "head", "op_name", "n_ops", "user_src",
+                 "framework_src", "detail", "src")
+
+    def __init__(self, reason, head, op_name, n_ops, user_src,
+                 framework_src, detail=None, src=None):
+        self.reason = reason          # full reason string
+        self.head = head              # reason bucket (pre-':')
+        self.op_name = op_name        # breaking/last op, if known
+        self.n_ops = n_ops            # pending ops lost to this seal
+        self.user_src = user_src      # first frame outside the package
+        self.framework_src = framework_src  # first nn/models/... frame
+        self.detail = detail          # e.g. the stashed record error
+        self.src = src                # recorded _PendingOp.src, if any
+
+
+# ------------------------------------------------------------ recorder
+
+# active recorder stack (process-global: perf traces are an explicit,
+# single-threaded analysis activity, not a runtime mode)
+_RECORDERS: List["PerfRecorder"] = []
+
+
+def _active_recorder() -> Optional["PerfRecorder"]:
+    return _RECORDERS[-1] if _RECORDERS else None
+
+
+class PerfRecorder:
+    """Observes every fusion-window seal while active.
+
+        with PerfRecorder() as rec:
+            step_fn()                      # one training step
+        report = rec.report()              # deduped perf diagnostics
+        counts = rec.seal_counts()         # predicted flush_reason hist
+
+    Installation forces `_PendingOp.src` capture (``lazy.PERF_SRC``) so
+    diagnostics carry source lines even with FLAGS_static_checks off,
+    and points ``lazy.PERF_OBSERVER`` at the hooks trampoline."""
+
+    def __init__(self):
+        self.events: List[PerfEvent] = []
+        # static compiled-comm estimate: when a seal happens under an
+        # ambient SPMD mesh, the sharding propagation pass prices the
+        # segment's collectives (sharding_prop) — summed here so
+        # `budget --static-diff` can cross-check the measured
+        # comm.bytes.compiled.* counters ("no false clean")
+        self.comm_bytes = 0
+        self.sharding_report = CheckReport("perf trace sharding")
+
+    # -------------------------------------------------------- lifecycle
+    def __enter__(self) -> "PerfRecorder":
+        from .._core import lazy
+        from . import hooks
+        _RECORDERS.append(self)
+        lazy.PERF_SRC += 1
+        lazy.PERF_OBSERVER = hooks.on_perf_flush
+        return self
+
+    def __exit__(self, et, ev, tb):
+        from .._core import lazy
+        _RECORDERS.remove(self)
+        lazy.PERF_SRC -= 1
+        if not _RECORDERS:
+            lazy.PERF_OBSERVER = None
+        return False
+
+    # -------------------------------------------------------- observing
+    def _on_seal(self, ctx, reason: str, pending):
+        from . import hooks
+        from .._core import lazy
+        if lazy.SPMD is not None and ctx is not None:
+            # sealed under an ambient mesh: price the segment's
+            # compiled collectives statically (the sharding sweep also
+            # collects implicit-reshard findings across the real step)
+            from .sharding_prop import propagate
+            res, _ = propagate(ctx, lazy.SPMD,
+                               report=self.sharding_report)
+            self.comm_bytes += res.comm_total()
+        head = reason.split(":", 1)[0]
+        op_name = None
+        detail = None
+        src = None
+        if head == "record_fallback":
+            # the BREAKING op never reached the pending list — its name
+            # rides the reason, its failure the executor's stash
+            op_name = reason.split(":", 1)[1] if ":" in reason else None
+            err = getattr(ctx, "_last_record_error", None)
+            if err is not None and (op_name is None or err[0] == op_name):
+                detail = err[1]
+            if ctx is not None:
+                ctx._last_record_error = None
+        elif head == "segment_cap" and pending:
+            # the op that tripped the cap is the last recorded one
+            op_name = pending[-1].op.name
+            src = getattr(pending[-1], "src", None)
+        user_src, framework_src = hooks.perf_site()
+        self.events.append(PerfEvent(reason, head, op_name, len(pending),
+                                     user_src, framework_src, detail,
+                                     src))
+
+    # -------------------------------------------------------- reporting
+    def seal_counts(self) -> Dict[str, int]:
+        """Predicted seal-reason histogram of the traced step — the
+        exact shape of the measured ``segment.flush_reason.*`` counters
+        (record_fallback:<op> collapsed to its head bucket)."""
+        out: Dict[str, int] = {}
+        for e in self.events:
+            out[e.head] = out.get(e.head, 0) + 1
+        return out
+
+    def break_count(self) -> int:
+        return sum(1 for e in self.events if e.head in BREAK_REASONS)
+
+    def sync_count(self) -> int:
+        return sum(1 for e in self.events if e.head in SYNC_REASONS)
+
+    def report(self, subject: str = "perf trace",
+               report: Optional[CheckReport] = None) -> CheckReport:
+        """Deduped perf diagnostics: events sharing (class, head, op,
+        source line) collapse into ONE diagnostic carrying the count —
+        53 batch-norm syncs from the same running-stat update are one
+        finding, not 53 lines. Sharding findings collected per seal
+        (implicit reshards / replicated tensors, traced under an
+        ambient mesh) ride along at the end."""
+        if report is None:
+            report = CheckReport(subject)
+        groups: Dict[Tuple, List[PerfEvent]] = {}
+        order: List[Tuple] = []
+        for e in self.events:
+            if e.head in BREAK_REASONS:
+                checker = CHECKER_BREAK
+            elif e.head in SYNC_REASONS:
+                checker = CHECKER_SYNC
+            else:
+                continue        # natural whole-step seal
+            key = (checker, e.head, e.op_name, e.user_src,
+                   e.framework_src)
+            if key not in groups:
+                groups[key] = []
+                order.append(key)
+            groups[key].append(e)
+        for key in order:
+            checker, head, op_name, user_src, framework_src = key
+            evs = groups[key]
+            n = len(evs)
+            ops_lost = sum(e.n_ops for e in evs)
+            kind = ("breaks the fusion window"
+                    if checker == CHECKER_BREAK else "syncs the host")
+            msg = f"'{head}' {kind} {n}x per traced step"
+            if op_name:
+                msg += f" at op '{op_name}'"
+            if framework_src:
+                msg += f" (issued from {framework_src})"
+            msg += f", sealing {ops_lost} recorded op(s) early"
+            detail = next((e.detail for e in evs if e.detail), None)
+            if detail:
+                msg += f" — record failed: {detail}"
+            report.add(
+                checker, msg, severity=SEVERITY_PERF, op_name=op_name,
+                # user frame first; framework model/layer code (a CLI
+                # trace has no frame outside the package) and the
+                # recorded op src are the fallbacks
+                provenance=user_src or framework_src or next(
+                    (e.src for e in evs if e.src), None),
+                hint=_HINTS.get(head),
+                data={"kind": head, "count": n, "ops_lost": ops_lost,
+                      "op": op_name, "framework_src": framework_src,
+                      "detail": detail})
+        report.extend(self.sharding_report)
+        return report
+
+
+# ------------------------------------------------------------- tracing
+
+def trace_step(step_fn: Callable[[], None], warmup: int = 1
+               ) -> Tuple[CheckReport, Dict[str, int], PerfRecorder]:
+    """Trace ONE step of `step_fn` under a PerfRecorder (after `warmup`
+    untraced calls so one-time setup — param/optimizer-state creation,
+    first-call caches — does not pollute the steady-state structure).
+    Returns (report, predicted seal counts, recorder)."""
+    from .._core import lazy
+    for _ in range(warmup):
+        step_fn()
+    # the traced step must start from a sealed window
+    lazy.flush_active("perf_trace")
+    with PerfRecorder() as rec:
+        step_fn()
+        lazy.flush_active("perf_trace")
+    return rec.report(), rec.seal_counts(), rec
+
+
+def check_perf(ctx_or_step) -> CheckReport:
+    """Perf lint entry point.
+
+    - Called with a STEP CALLABLE: trace one step (src capture forced)
+      and report its fusion breaks / host syncs — the analysis CLI's
+      ``--perf`` path.
+    - Called with an open CaptureContext: purely static sweep of the
+      pending program — today that is the segment-cap prediction (how
+      many cap seals this window will take before its natural seal);
+      breaks and syncs are attributes of the step's *dynamics* and
+      need the traced form.
+    """
+    if callable(ctx_or_step) and not hasattr(ctx_or_step, "pending"):
+        report, _, _ = trace_step(ctx_or_step)
+        return report
+    ctx = ctx_or_step
+    report = CheckReport(f"perf sweep ({len(ctx.pending)} pending ops)")
+    cap = ctx.max_ops
+    n = len(ctx.pending)
+    if cap and n >= cap:
+        breaks = n // cap
+        first = ctx.pending[min(cap - 1, n - 1)]
+        report.add(
+            CHECKER_BREAK,
+            f"{n} pending ops exceed the {cap}-op segment cap: "
+            f"{breaks} 'segment_cap' window break(s) per step — the "
+            f"step cache and optimizer donation are forfeited",
+            severity=SEVERITY_PERF, op_index=min(cap - 1, n - 1),
+            op_name=first.op.name,
+            provenance=getattr(first, "src", None),
+            hint=_HINTS["segment_cap"],
+            data={"kind": "segment_cap", "count": breaks,
+                  "cap": cap, "pending": n})
+    return report

@@ -1,0 +1,692 @@
+"""Static PartitionSpec propagation through `_PendingOp` dataflow.
+
+Abstract interpretation of the pending op graph under an ambient SPMD
+mesh, PRE-GSPMD: every recorded value gets an inferred PartitionSpec
+(or UNKNOWN where no rule applies — never a guess) starting from the
+segment inputs' committed on-mesh layouts, so layout pathologies are
+visible before the compiler silently "fixes" them with implicit
+resharding:
+
+- **implicit_reshard** — two operands meet with conflicting specs
+  (an elementwise op joining tensors sharded on different axes, a
+  matmul whose contraction dims disagree, a value entering an mp-layer
+  sharding constraint with the wrong layout): GSPMD inserts a
+  reshard/all-gather every step. Priced from the operand bytes.
+- **replicated_tensor** — a large tensor entering a sharded program
+  fully replicated: bytes × (mesh size − 1) of HBM and broadcast
+  traffic that sharding would reclaim (flag floor:
+  FLAGS_sharding_replicated_min_bytes; a fully-replicated program is
+  single-device semantics and never flagged).
+- **sharding_comm** — the per-op compiled-collective ranking: every
+  contraction/reduction over a sharded axis (and every partial value a
+  later op forces GSPMD to resolve) is priced with the same ring
+  all-reduce model as ``_Ambient.estimate_bytes``
+  (2(k−1)/k · nbytes), ranked, and the top hotspots attached as one
+  summary diagnostic when they clear FLAGS_sharding_comm_min_bytes.
+
+The mp-layer sharding-constraint ops (`shard_constraint_<axis>_<dim>_
+<s|r>_...`, distributed/_constraint.py) are first-class: an s-mode
+constraint checks the propagated spec round-trips (the TP boundary
+contract), an r-mode constraint is the intended all-reduce point that
+clears a partial value. Findings carry the recording user src
+(`_PendingOp.src`) and perf severity — correctness is the sanitizer's
+job; this pass prices correct-but-slow programs.
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..observability.budget import _fmt_bytes
+from .diagnostics import CheckReport, SEVERITY_PERF
+
+CHECKER_RESHARD = "implicit_reshard"
+CHECKER_REPLICATED = "replicated_tensor"
+CHECKER_COMM = "sharding_comm"
+
+# sentinel: no propagation rule applied — downstream consumers of an
+# UNKNOWN value produce no findings (conservative, never a false claim)
+UNKNOWN = None
+
+_CONSTRAINT_RE = re.compile(
+    r"^shard_constraint_(?P<axis>.+)_(?P<dim>\d+)_(?P<mode>[sr])_"
+    r"(?P<ndim>\d+)_m[0-9a-f]+$")
+
+# single-input-led ops whose output dims align 1:1 with input-0 dims:
+# an entry rides through where the dim size is unchanged
+_DIMWISE_OPS = frozenset((
+    "max_pool_nd", "avg_pool_nd", "max_pool_nd_index", "bn_apply",
+    "dropout", "softmax", "pad", "layer_norm", "rms_norm",
+    "group_norm"))
+
+
+class ValState:
+    """Inferred layout of one recorded value: full-rank per-dim spec
+    entries (None | axis | tuple-of-axes) or UNKNOWN, plus the mesh
+    axes the value is still PARTIAL over (a contraction ran over a
+    sharded axis and the all-reduce is deferred)."""
+
+    __slots__ = ("entries", "partial")
+
+    def __init__(self, entries, partial=frozenset()):
+        self.entries = entries            # tuple | UNKNOWN
+        self.partial = frozenset(partial)
+
+    @property
+    def known(self):
+        return self.entries is not UNKNOWN
+
+    def replicated(self):
+        return self.known and all(e is None for e in self.entries) \
+            and not self.partial
+
+    def sharded_axes(self) -> frozenset:
+        if not self.known:
+            return frozenset()
+        out = set()
+        for e in self.entries:
+            if e is None:
+                continue
+            out.update(e if isinstance(e, tuple) else (e,))
+        return frozenset(out)
+
+    def spec(self) -> Optional[Tuple]:
+        """Normalized-spec view (trailing Nones stripped) — the shape
+        `_Ambient.spec_of` returns, for cross-validation against
+        GSPMD's actual output shardings."""
+        if not self.known:
+            return None
+        out = list(self.entries)
+        while out and out[-1] is None:
+            out.pop()
+        return tuple(out)
+
+    def __repr__(self):
+        return f"ValState({self.entries!r}, partial={set(self.partial)})"
+
+
+def _full_rank(spec, ndim: int) -> Tuple:
+    """Pad a normalized spec to `ndim` entries."""
+    spec = tuple(spec or ())
+    return spec + (None,) * (ndim - len(spec))
+
+
+def _nbytes(aval) -> int:
+    try:
+        return int(np.prod(aval.shape)) * np.dtype(aval.dtype).itemsize
+    except Exception:
+        return 0
+
+
+class PropResult:
+    """Propagation output: specs per value + the comm-event ranking."""
+
+    def __init__(self):
+        self.in_states: List[ValState] = []
+        self.out_states: Dict[Tuple[int, int], ValState] = {}
+        self.comm: List[Dict] = []     # {op_index, op, kind, axes,
+        #                                 bytes, src, intended}
+        self.mesh_size = 1
+
+    def spec_at(self, op_idx: int, slot: int = 0) -> Optional[Tuple]:
+        st = self.out_states.get((op_idx, slot))
+        return st.spec() if st is not None and st.known else None
+
+    def live_specs(self, live) -> List[Optional[Tuple]]:
+        return [self.spec_at(j, s) for (j, s) in live]
+
+    def comm_total(self) -> int:
+        return sum(e["bytes"] for e in self.comm)
+
+
+class _Prop:
+    def __init__(self, view, mesh, report: CheckReport):
+        self.view = view
+        self.mesh = mesh
+        self.report = report
+        self.res = PropResult()
+        self.res.mesh_size = int(np.prod(mesh.shape))
+        self._axis_size = dict(zip(mesh.axes, mesh.shape))
+
+    # ------------------------------------------------------------ utils
+    def _axes_factor(self, axes) -> int:
+        k = 1
+        for a in axes:
+            k *= self._axis_size.get(a, 1)
+        return k
+
+    def _note_comm(self, op_idx, kind, axes, nbytes, src,
+                   intended=False, gather_only=False):
+        k = self._axes_factor(axes)
+        if k <= 1 or nbytes <= 0:
+            return
+        factor = (k - 1) / k if gather_only else 2 * (k - 1) / k
+        self.res.comm.append({
+            "op_index": op_idx,
+            "op": self.view.pending[op_idx].op.name
+            if 0 <= op_idx < len(self.view.pending) else None,
+            "kind": kind, "axes": sorted(axes),
+            "bytes": int(factor * nbytes), "src": src,
+            "intended": bool(intended)})
+
+    def _resolve_partial(self, op_idx, st: ValState, nbytes, src):
+        """A partial value consumed by an op that cannot keep it
+        partial: GSPMD materializes the deferred all-reduce here."""
+        if st.partial:
+            self._note_comm(op_idx, "all_reduce", st.partial, nbytes,
+                            src)
+        return ValState(st.entries)
+
+    # ------------------------------------------------------------- run
+    def run(self) -> PropResult:
+        view = self.view
+        mesh = self.mesh
+        for i, v in enumerate(view.in_vals):
+            spec = mesh.spec_of(v)
+            if spec == "?":
+                self.res.in_states.append(ValState(UNKNOWN))
+            else:
+                nd = getattr(v, "ndim", len(getattr(v, "shape", ())))
+                self.res.in_states.append(
+                    ValState(_full_rank(spec, int(nd))))
+        for j, pop in enumerate(view.pending):
+            in_states, in_avals = [], []
+            for w in pop.wiring:
+                if w is None:
+                    in_states.append(None)
+                    in_avals.append(None)
+                elif w[0] == "in":
+                    in_states.append(self.res.in_states[w[1]])
+                    in_avals.append(view.in_vals[w[1]])
+                else:
+                    in_states.append(
+                        self.res.out_states.get((w[1], w[2]),
+                                                ValState(UNKNOWN)))
+                    in_avals.append(
+                        view.pending[w[1]].out_refs[w[2]].aval)
+            outs = self._apply(j, pop, in_states, in_avals)
+            for s, st in enumerate(outs[:pop.n_outs]):
+                self.res.out_states[(j, s)] = st
+            for s in range(len(outs), pop.n_outs):
+                self.res.out_states[(j, s)] = ValState(UNKNOWN)
+        self._flag_live_partials()
+        self._check_replicated()
+        return self.res
+
+    # ------------------------------------------------------ op dispatch
+    def _apply(self, j, pop, in_states, in_avals) -> List[ValState]:
+        name = pop.op.name
+        src = getattr(pop, "src", None)
+        m = _CONSTRAINT_RE.match(name)
+        if m is not None:
+            return self._constraint(j, pop, in_states, in_avals, m, src)
+
+        # any non-constraint op consuming a partial value forces GSPMD
+        # to materialize the deferred all-reduce first. The resolved
+        # state is written BACK to the producing slot: GSPMD inserts
+        # ONE reduce per value, so a second consumer (or the live-
+        # output pass) must see the value already resolved, not price
+        # the same collective again.
+        states = []
+        for w, st, av in zip(pop.wiring, in_states, in_avals):
+            if st is not None and st.known and st.partial:
+                if w is not None and w[0] != "in":
+                    cur = self.res.out_states.get((w[1], w[2]))
+                    if cur is not None and not cur.partial:
+                        # already resolved by an earlier consumer
+                        st = cur
+                if st.partial:
+                    st = self._resolve_partial(j, st, _nbytes(av), src)
+                    if w is not None:
+                        if w[0] == "in":
+                            self.res.in_states[w[1]] = st
+                        else:
+                            self.res.out_states[(w[1], w[2])] = st
+            states.append(st)
+        known = [(st, av) for st, av in zip(states, in_avals)
+                 if st is not None]
+        if any(not st.known for st, _ in known):
+            return [ValState(UNKNOWN)] * pop.n_outs
+
+        if name in ("linear", "matmul"):
+            return self._matmul(j, pop, states, in_avals, src)
+        if name == "conv2d":
+            return self._conv(j, pop, states, in_avals, src)
+        if name == "embedding":
+            return self._embedding(j, pop, states, in_avals, src)
+        if name == "transpose":
+            perm = tuple(pop.attrs.get("perm", ()))
+            st = states[0]
+            if perm and st.known:
+                return [ValState(tuple(st.entries[p] for p in perm))]
+            return [ValState(UNKNOWN)]
+        if name in ("reshape", "flatten_"):
+            return self._reshape(j, pop, states, in_avals)
+        if name in _DIMWISE_OPS:
+            # output dims align 1:1 with input-0 dims (pooling, norm
+            # application, padding): an entry survives where the dim
+            # is untouched (size unchanged), windowed/resized dims
+            # drop to None — batch/channel sharding rides through
+            st = states[0]
+            av = in_avals[0]
+            out_ref = pop.out_refs[0]
+            in_shape = tuple(getattr(av, "shape", ()))
+            out_shape = tuple(out_ref.aval.shape)
+            if st.known and len(in_shape) == len(out_shape):
+                entries = tuple(
+                    e if in_shape[d] == out_shape[d] else None
+                    for d, e in enumerate(
+                        _full_rank(st.entries, len(in_shape))))
+                outs = [ValState(entries)]
+                # multi-output variants (max_pool_nd_index) mirror
+                return outs * pop.n_outs
+            return [ValState(UNKNOWN)] * pop.n_outs
+        if name == "bn_stats":
+            return self._bn_stats(j, pop, states, in_avals)
+
+        out_avals = [r.aval for r in pop.out_refs]
+        # reduce-to-scalar (softmax_ce, mean/sum to a loss): the result
+        # combines over every sharded input axis
+        if pop.n_outs == 1 and len(out_avals[0].shape) == 0:
+            axes = set()
+            for st, _ in known:
+                axes |= st.sharded_axes()
+            if axes:
+                self._note_comm(j, "all_reduce", axes,
+                                _nbytes(out_avals[0]), src)
+            return [ValState((), frozenset())]
+        # structural elementwise: one output whose shape is the
+        # broadcast of the input shapes -> dimension-aligned join
+        if pop.n_outs == 1 and self._is_broadcast_ew(known, out_avals[0]):
+            return [self._ew_join(j, known, out_avals[0], src)]
+        # default: propagate replication, never guess sharding
+        if all(st.replicated() for st, _ in known):
+            return [ValState(_full_rank((), len(r.aval.shape)))
+                    for r in pop.out_refs]
+        return [ValState(UNKNOWN)] * pop.n_outs
+
+    # -------------------------------------------------------- rules
+    @staticmethod
+    def _is_broadcast_ew(known, out_aval) -> bool:
+        out_shape = tuple(out_aval.shape)
+        try:
+            shapes = [tuple(getattr(av, "shape", ())) for _, av in known]
+            return tuple(np.broadcast_shapes(*shapes)) == out_shape \
+                if shapes else False
+        except ValueError:
+            return False
+
+    def _ew_join(self, j, known, out_aval, src) -> ValState:
+        out_shape = tuple(out_aval.shape)
+        nd = len(out_shape)
+        entries = []
+        for d in range(nd):
+            cands = []
+            for st, av in known:
+                shape = tuple(getattr(av, "shape", ()))
+                dd = d - (nd - len(shape))   # right-aligned
+                if dd < 0 or shape[dd] == 1:
+                    continue                 # broadcast dim: unsharded
+                e = st.entries[dd]
+                if e is not None:
+                    cands.append((e, _nbytes(av)))
+            uniq = {c[0] for c in cands}
+            if len(uniq) > 1:
+                # conflicting shardings meet: GSPMD reshards one
+                # operand here EVERY step
+                nb = min(b for _, b in cands)
+                axes = set()
+                for e in uniq:
+                    axes.update(e if isinstance(e, tuple) else (e,))
+                self.report.add(
+                    CHECKER_RESHARD,
+                    f"operands meet with conflicting shardings on dim "
+                    f"{d} ({sorted(map(str, uniq))}): GSPMD inserts an "
+                    f"implicit reshard (~{_fmt_bytes(nb)}) every step",
+                    severity=SEVERITY_PERF, op_index=j,
+                    op_name=self.view.pending[j].op.name,
+                    provenance=src,
+                    hint="commit both operands to one layout (shard_"
+                         "tensor / the mp-layer constraint) before "
+                         "they meet",
+                    data={"dim": d, "specs": sorted(map(str, uniq)),
+                          "bytes": nb})
+                self._note_comm(j, "reshard", axes, nb, src,
+                                gather_only=True)
+                entries.append(cands[0][0])
+            elif uniq:
+                entries.append(next(iter(uniq)))
+            else:
+                entries.append(None)
+        return ValState(tuple(entries))
+
+    def _matmul(self, j, pop, states, in_avals, src) -> List[ValState]:
+        name = pop.op.name
+        x, y = states[0], states[1]
+        xa, ya = in_avals[0], in_avals[1]
+        xe, ye = list(x.entries), list(y.entries)
+        xs = list(getattr(xa, "shape", ()))
+        ys = list(getattr(ya, "shape", ()))
+        if name == "matmul":
+            if pop.attrs.get("transpose_x") and len(xe) > 1:
+                xe[-1], xe[-2] = xe[-2], xe[-1]
+                xs[-1], xs[-2] = xs[-2], xs[-1]
+            if pop.attrs.get("transpose_y") and len(ye) > 1:
+                ye[-1], ye[-2] = ye[-2], ye[-1]
+                ys[-1], ys[-2] = ys[-2], ys[-1]
+        if len(xe) < 1 or len(ye) < 1:
+            return [ValState(UNKNOWN)] * pop.n_outs
+        # 1-D operands contract away; the common model case is 2-D+
+        kx = xe[-1]
+        ky = ye[0] if len(ye) == 1 else ye[-2]
+        partial: set = set()
+        if kx is not None and ky is not None and kx != ky:
+            nb = min(_nbytes(xa), _nbytes(ya))
+            self.report.add(
+                CHECKER_RESHARD,
+                f"contraction dims sharded differently ({kx!r} vs "
+                f"{ky!r}): GSPMD re-lays one operand out "
+                f"(~{_fmt_bytes(nb)}) every step",
+                severity=SEVERITY_PERF, op_index=j, op_name=name,
+                provenance=src,
+                hint="shard both matmul operands' contraction dim on "
+                     "the same mesh axis (the TP pattern)",
+                data={"specs": [str(kx), str(ky)], "bytes": nb})
+            self._note_comm(j, "reshard", _axes_of(ky), nb, src,
+                            gather_only=True)
+            ky = kx
+        contracted = kx if kx is not None else ky
+        if contracted is not None:
+            partial |= set(_axes_of(contracted))
+        out_ref = pop.out_refs[0]
+        nd_out = len(out_ref.aval.shape)
+        entries = [None] * nd_out
+        if nd_out >= 1:
+            # N from y's last dim
+            e = ye[-1] if len(ye) >= 2 else None
+            entries[-1] = e
+        if nd_out >= 2:
+            e = xe[-2] if len(xe) >= 2 else None
+            entries[-2] = e
+        # batch dims from x (right-aligned above the matrix dims)
+        for d in range(nd_out - 2):
+            dd = d - (nd_out - len(xe))
+            if 0 <= dd < len(xe) - 2:
+                entries[d] = xe[dd]
+        out = [ValState(tuple(entries), frozenset(partial))]
+        # bias add of linear: already folded into the kernel; out state
+        # covers the single output
+        return out + [ValState(UNKNOWN)] * (pop.n_outs - 1)
+
+    def _conv(self, j, pop, states, in_avals, src) -> List[ValState]:
+        x, w = states[0], states[1]
+        fmt = str(pop.attrs.get("fmt", pop.attrs.get("data_format",
+                                                     "NCHW")))
+        out_ref = pop.out_refs[0]
+        nd = len(out_ref.aval.shape)
+        entries = [None] * nd
+        c_axis = 1 if fmt.startswith("NC") else nd - 1
+        xc_axis = 1 if fmt.startswith("NC") else len(x.entries) - 1
+        if x.entries:
+            entries[0] = x.entries[0]          # batch rides through
+        partial: set = set()
+        if len(w.entries) >= 2:
+            entries[c_axis] = w.entries[0]     # out-channels from w[O,...]
+            kx = x.entries[xc_axis] if len(x.entries) > xc_axis else None
+            kw = w.entries[1]
+            contracted = kx if kx is not None else kw
+            if contracted is not None:
+                partial |= set(_axes_of(contracted))
+        return [ValState(tuple(entries), frozenset(partial))]
+
+    def _bn_stats(self, j, pop, states, in_avals) -> List[ValState]:
+        """bn_stats(x) -> (mean, var), both (C,): the channel entry
+        survives; the batch/spatial reduction over any sharded axis
+        leaves the stats PARTIAL (under a dp mesh the running-stat
+        update implies a per-step all-reduce)."""
+        st = states[0]
+        av = in_avals[0]
+        fmt = str(pop.attrs.get("fmt", "NCHW"))
+        nd = len(getattr(av, "shape", ()))
+        c_dim = 1 if fmt.startswith("NC") and nd > 1 else nd - 1
+        entries = _full_rank(st.entries, nd)
+        partial = set(st.partial)
+        for d, e in enumerate(entries):
+            if d != c_dim:
+                partial.update(_axes_of(e))
+        out = ValState((entries[c_dim],), frozenset(partial))
+        return [out] * pop.n_outs
+
+    def _embedding(self, j, pop, states, in_avals, src) -> List[ValState]:
+        w, ids = states[0], states[1]
+        out_ref = pop.out_refs[0]
+        nd = len(out_ref.aval.shape)
+        entries = [None] * nd
+        for d, e in enumerate(ids.entries[:nd - 1]):
+            entries[d] = e
+        if len(w.entries) >= 2:
+            entries[-1] = w.entries[1]
+        partial: set = set()
+        if w.entries and w.entries[0] is not None:
+            # vocab-sharded table: the gather becomes masked-take +
+            # psum over the vocab axis
+            partial |= set(_axes_of(w.entries[0]))
+        return [ValState(tuple(entries), frozenset(partial))]
+
+    def _reshape(self, j, pop, states, in_avals) -> List[ValState]:
+        st = states[0]
+        av = in_avals[0]
+        out_ref = pop.out_refs[0]
+        in_shape = tuple(getattr(av, "shape", ()))
+        out_shape = tuple(out_ref.aval.shape)
+        if not st.known:
+            return [ValState(UNKNOWN)]
+        # leading-dim sharding survives a reshape that keeps dim0; any
+        # sharded dim being merged/split goes UNKNOWN (GSPMD's call)
+        lead_keeps = (in_shape and out_shape
+                      and in_shape[0] == out_shape[0])
+        others_sharded = any(e is not None for e in st.entries[1:])
+        if lead_keeps and not others_sharded:
+            return [ValState((st.entries[0],)
+                             + (None,) * (len(out_shape) - 1))]
+        if st.replicated():
+            return [ValState(_full_rank((), len(out_shape)))]
+        return [ValState(UNKNOWN)]
+
+    def _constraint(self, j, pop, in_states, in_avals, m,
+                    src) -> List[ValState]:
+        axis = m.group("axis")
+        dim = int(m.group("dim"))
+        mode = m.group("mode")
+        st = in_states[0]
+        av = in_avals[0]
+        out_ref = pop.out_refs[0]
+        nd = len(out_ref.aval.shape)
+        k = self._axis_size.get(axis, 1)
+        if not st.known:
+            entries = [None] * nd
+            entries[dim % nd] = axis if mode == "s" else None
+            return [ValState(tuple(entries))]
+        entries = list(_full_rank(st.entries, nd))
+        cur = entries[dim % nd]
+        if mode == "s":
+            if st.partial and axis in st.partial:
+                # partial -> Shard(axis): reduce-scatter
+                self._note_comm(j, "reduce_scatter", {axis},
+                                _nbytes(av), src, intended=True,
+                                gather_only=True)
+            elif cur is None and k > 1:
+                self.report.add(
+                    CHECKER_RESHARD,
+                    f"value enters the '{axis}'-shard constraint on "
+                    f"dim {dim} REPLICATED: the upstream compute ran "
+                    f"un-sharded and GSPMD slices it here every step "
+                    f"(specs did not round-trip the mp-layer boundary)",
+                    severity=SEVERITY_PERF, op_index=j,
+                    op_name=pop.op.name, provenance=src,
+                    hint="shard the producing weight/input on "
+                         f"'{axis}' so the constraint is a no-op",
+                    data={"axis": axis, "dim": dim,
+                          "got": str(st.spec()), "bytes": _nbytes(av)})
+            elif cur is not None and cur != axis \
+                    and axis not in _axes_of(cur):
+                nb = _nbytes(av)
+                self.report.add(
+                    CHECKER_RESHARD,
+                    f"value enters the '{axis}'-shard constraint on "
+                    f"dim {dim} sharded on {cur!r}: an all-to-all "
+                    f"reshard (~{_fmt_bytes(nb)}) every step",
+                    severity=SEVERITY_PERF, op_index=j,
+                    op_name=pop.op.name, provenance=src,
+                    data={"axis": axis, "dim": dim, "got": str(cur),
+                          "bytes": nb})
+                self._note_comm(j, "reshard", set(_axes_of(cur)), nb,
+                                src, gather_only=True)
+            entries[dim % nd] = axis
+            partial = st.partial - {axis}
+        else:
+            # r-mode: the intended resolution point. A partial value
+            # all-reduces here (the TP row-parallel exchange); a
+            # dim-sharded value all-gathers (gather_output=True).
+            partial = st.partial
+            if axis in partial:
+                self._note_comm(j, "all_reduce", {axis}, _nbytes(av),
+                                src, intended=True)
+                partial = partial - {axis}
+            elif cur is not None and axis in _axes_of(cur):
+                self._note_comm(j, "all_gather", {axis}, _nbytes(av),
+                                src, intended=True, gather_only=True)
+            entries[dim % nd] = None
+        return [ValState(tuple(entries), frozenset(partial))]
+
+    # --------------------------------------------------- post passes
+    def _flag_live_partials(self):
+        """A live output still partial at the segment boundary: GSPMD
+        resolves it against the output sharding — price the deferred
+        all-reduce (this is exactly the case `estimate_bytes` counts:
+        output replicated over an axis that shards an input)."""
+        for (j, s), st in self.res.out_states.items():
+            if not st.known or not st.partial:
+                continue
+            if any((j, s) == ls for ls in self.view.live):
+                ref = self.view.pending[j].out_refs[s]
+                self._note_comm(j, "all_reduce", st.partial,
+                                _nbytes(ref.aval),
+                                getattr(self.view.pending[j], "src",
+                                        None))
+
+    def _check_replicated(self):
+        """Large fully-replicated tensors entering an otherwise-sharded
+        program: every device holds (and any broadcast moves) the full
+        payload."""
+        from .._core import flags
+        floor = int(flags.flag_value(
+            "FLAGS_sharding_replicated_min_bytes"))
+        if self.res.mesh_size <= 1:
+            return
+        any_sharded = any(st.known and st.sharded_axes()
+                          for st in self.res.in_states) \
+            or any(st.known and st.sharded_axes()
+                   for st in self.res.out_states.values())
+        if not any_sharded:
+            return
+        for i, st in enumerate(self.res.in_states):
+            if not st.known or not st.replicated():
+                continue
+            v = self.view.in_vals[i]
+            nb = int(getattr(v, "nbytes", 0) or _nbytes(v))
+            waste = nb * (self.res.mesh_size - 1)
+            if nb <= 0 or waste < floor:
+                continue
+            readers = self.view.readers_of_input(i)
+            fields = (self.view.op_diag_fields(readers[0])
+                      if readers else {})
+            self.report.add(
+                CHECKER_REPLICATED,
+                f"input {i} ({_fmt_bytes(nb)}) is fully replicated "
+                f"over the {self.res.mesh_size}-device mesh: "
+                f"{_fmt_bytes(waste)} of redundant HBM/broadcast a "
+                f"sharding would reclaim",
+                severity=SEVERITY_PERF,
+                hint="shard it (shard_tensor / ZeRO state sharding / "
+                     "the mp-layer constraint) or shrink it",
+                data={"input_index": i, "bytes": nb,
+                      "wasted_bytes": waste}, **fields)
+
+
+def _axes_of(entry) -> Tuple:
+    if entry is None:
+        return ()
+    return tuple(entry) if isinstance(entry, tuple) else (entry,)
+
+
+def _as_ambient(mesh):
+    """Accept an _Ambient, a ProcessMesh, or None (= the active ambient
+    state)."""
+    from .._core import lazy
+    if mesh is None:
+        mesh = lazy.SPMD
+        if mesh is None:
+            raise ValueError(
+                "check_sharding needs a mesh: pass one or run under "
+                "`with dist.auto_mesh(...)`")
+    if hasattr(mesh, "spec_of"):
+        return mesh
+    from ..distributed.spmd import _Ambient
+    return _Ambient(mesh)
+
+
+def propagate(ctx_or_view, mesh=None,
+              report: Optional[CheckReport] = None
+              ) -> Tuple[PropResult, CheckReport]:
+    """Propagate PartitionSpecs through a pending segment. Returns
+    (PropResult, CheckReport) — the result carries per-value specs for
+    cross-validation against GSPMD, the report the perf findings."""
+    from .segment_checks import SegmentView
+    view = ctx_or_view if isinstance(ctx_or_view, SegmentView) \
+        else SegmentView.from_context(ctx_or_view, donate=())
+    mesh = _as_ambient(mesh)
+    if report is None:
+        report = CheckReport(
+            f"sharding propagation ({len(view.pending)} ops)")
+    res = _Prop(view, mesh, report).run()
+    return res, report
+
+
+def check_sharding(ctx_or_view, mesh=None,
+                   report: Optional[CheckReport] = None) -> CheckReport:
+    """Sharding perf lint over a pending segment: implicit reshards,
+    mp-boundary spec mismatches, accidentally-replicated large
+    tensors, plus the ranked comm-hotspot summary (when total priced
+    traffic clears FLAGS_sharding_comm_min_bytes)."""
+    res, report = propagate(ctx_or_view, mesh, report)
+    summarize_comm(res, report)
+    return report
+
+
+def summarize_comm(res: PropResult,
+                   report: CheckReport) -> CheckReport:
+    """Attach the ranked per-op comm-hotspot summary of a propagation
+    result to `report` (one perf diagnostic, only when total priced
+    traffic clears FLAGS_sharding_comm_min_bytes)."""
+    from .._core import flags
+    floor = int(flags.flag_value("FLAGS_sharding_comm_min_bytes"))
+    total = res.comm_total()
+    if res.comm and total >= floor:
+        top = sorted(res.comm, key=lambda e: -e["bytes"])[:8]
+        lines = "; ".join(
+            f"#{e['op_index']} {e['op']} {e['kind']}"
+            f"[{','.join(e['axes'])}] {_fmt_bytes(e['bytes'])}"
+            + (" (intended)" if e["intended"] else "")
+            for e in top)
+        report.add(
+            CHECKER_COMM,
+            f"compiled-collective traffic ~{_fmt_bytes(total)} per "
+            f"execution; top per-op hotspots: {lines}",
+            severity=SEVERITY_PERF,
+            hint="rank candidates for quantized/overlapped "
+                 "collectives (EQuARX): the biggest rows pay first",
+            data={"total_bytes": total, "hotspots": top})
+    return report

@@ -398,10 +398,26 @@ class AdaptiveTrainer:
                 # fill it from the newest VERIFIED generation
                 self._adopt_layout(new_mesh)
                 self.restore_from_checkpoint()
+            old_mesh = self.mesh
             self.mesh = new_mesh
             self.last_plan = plan
             self.replans += 1
             metrics.inc("resilience.replans")
+            from .. import spmd as _spmd
+            st = _spmd.state()
+            if st is not None and (
+                    st.pmesh is old_mesh
+                    or set(lost) & set(st.pmesh.process_ids)):
+                # survivors inside a `with auto_mesh(...)` block: the
+                # ambient state still wraps the OLD mesh — its jax
+                # mesh, device set and cache-key component would pin
+                # every post-replan compile to dead ranks. Gated on
+                # lost-rank COVERAGE, not object identity: an ambient
+                # mesh equal to (but distinct from) the trainer's mesh
+                # is just as stale. Rebuild against the planned
+                # survivor mesh (the window was quiesced above; the
+                # epoch bump below re-keys).
+                _spmd.rebuild_ambient(new_mesh)
             from ..._core import lazy
             lazy.bump_mesh_epoch()
             if _OBS.FLIGHT:

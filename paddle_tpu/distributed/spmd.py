@@ -53,7 +53,7 @@ from .._core import lazy as _lazy
 from . import mesh as _mesh_mod
 
 __all__ = ["activate", "deactivate", "active", "state", "shard_batch",
-           "suggest_mesh_degree"]
+           "rebuild_ambient", "suggest_mesh_degree"]
 
 
 def _norm_spec(spec) -> Tuple:
@@ -213,6 +213,26 @@ def deactivate(had_error: bool = False):
         prev_spmd, prev_mesh = _STACK.pop()
         _lazy.SPMD = prev_spmd
         _mesh_mod.set_mesh(prev_mesh)
+
+
+def rebuild_ambient(pmesh) -> Optional[_Ambient]:
+    """Swap the ACTIVE ambient mesh for a fresh state built from
+    `pmesh` — the elastic re-plan hook (ROADMAP item (d)): a replan
+    re-keys the step caches via MESH_EPOCH, but survivors inside a
+    ``with auto_mesh(...)`` block would otherwise keep compiling
+    against the STALE `_Ambient` object (old jax mesh, old device set,
+    old cache-key component). Called by AdaptiveTrainer after the
+    survivor mesh is planned and state moved; the caller has already
+    quiesced the window, so no segment straddles the swap. The
+    activation stack's saved outer entries are untouched — exiting the
+    mesh block still restores whatever was ambient before it. No-op
+    (returns None) when no mesh is ambient."""
+    if _lazy.SPMD is None:
+        return None
+    st = _Ambient(pmesh)
+    _lazy.SPMD = st
+    _mesh_mod.set_mesh(pmesh)
+    return st
 
 
 def active() -> bool:

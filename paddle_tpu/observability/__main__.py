@@ -434,6 +434,13 @@ def main(argv=None) -> int:
     ap.add_argument("--async-flush", action="store_true",
                     help="run with FLAGS_async_flush on (before/after "
                          "budget comparisons from one command)")
+    ap.add_argument("--static-diff", action="store_true",
+                    help="budget mode: reconcile the static perf "
+                         "analyzer's predictions (one traced step, "
+                         "analysis/perf_checks) against the measured "
+                         "seal-reason / window-break / compiled-comm "
+                         "counters over --steps steps; exit 1 on a "
+                         "mismatch")
     args = ap.parse_args(argv)
 
     if args.mode == "merge":
@@ -451,6 +458,15 @@ def main(argv=None) -> int:
         from paddle_tpu.observability import budget as _budget
         make = _MODELS[args.model]
         step = (lambda: _run_chain(1)) if make is None else make()
+        if args.static_diff:
+            out = _budget.static_diff(step, steps=args.steps)
+            out["model"] = args.model
+            print(json.dumps(out) if args.json
+                  else _budget.render_static_diff(
+                      out, f"static vs measured [{args.model}]"))
+            from paddle_tpu._core import async_flush
+            async_flush.drain()
+            return 0 if out["ok"] else 1
         out = _budget.collect(step, steps=args.steps)
         out["model"] = args.model
         out["async_flush"] = bool(args.async_flush)

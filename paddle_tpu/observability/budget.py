@@ -179,6 +179,95 @@ def _rank(snap: Dict, wall_us: float, steps: int) -> Dict:
     }
 
 
+# ------------------------------------------------------- static diff
+
+def static_diff(step_fn: Callable[[], None], steps: int = 5) -> Dict:
+    """Reconcile the STATIC perf analyzer's predictions against the
+    measured meters (the analyzer held to the counters PRs 7–10
+    built): trace one step under a PerfRecorder (analysis/perf_checks)
+    for the predicted seal-reason histogram and static comm estimate,
+    then measure `steps` steps through `collect` and compare against
+    the ``segment.flush_reason.*`` / ``fusion.window_breaks`` /
+    ``comm.bytes.compiled.*`` counters per step.
+
+    Exact-match gate on the seal rows (a steady-state step's seal
+    structure is deterministic); the comm row is an estimator
+    cross-check — two different models price the same collectives, so
+    the gate is "static must not claim CLEAN when the meters show
+    traffic" (and vice versa), not byte equality."""
+    from ..analysis import perf_checks
+
+    report, predicted, rec = perf_checks.trace_step(step_fn)
+    measured = collect(step_fn, steps=steps)
+    counters = measured["counters"]
+
+    heads = set(predicted)
+    for k in counters:
+        if k.startswith("segment.flush_reason."):
+            heads.add(k[len("segment.flush_reason."):])
+    heads.discard("perf_trace")   # the recorder's own boundary seal
+    rows: List[Dict] = []
+    ok = True
+    for h in sorted(heads):
+        stat = predicted.get(h, 0)
+        meas = counters.get("segment.flush_reason." + h, 0) / steps
+        match = abs(stat - meas) < 1e-9
+        ok = ok and match
+        rows.append({"class": "seal:" + h, "static": stat,
+                     "measured_per_step": round(meas, 3),
+                     "match": match})
+
+    stat_breaks = sum(predicted.get(h, 0)
+                      for h in perf_checks.BREAK_REASONS)
+    meas_breaks = counters.get("fusion.window_breaks", 0) / steps
+    breaks_match = abs(stat_breaks - meas_breaks) < 1e-9
+    ok = ok and breaks_match
+    rows.append({"class": "fusion.window_breaks", "static": stat_breaks,
+                 "measured_per_step": round(meas_breaks, 3),
+                 "match": breaks_match})
+
+    stat_syncs = sum(predicted.get(h, 0)
+                     for h in perf_checks.SYNC_REASONS)
+    rows.append({"class": "host_syncs", "static": stat_syncs,
+                 "measured_per_step": round(
+                     sum(counters.get("segment.flush_reason." + h, 0)
+                         for h in perf_checks.SYNC_REASONS) / steps, 3),
+                 "match": True})   # folded into the per-head rows
+
+    meas_comm = sum(v for k, v in counters.items()
+                    if k.startswith("comm.bytes.compiled.")) / steps
+    comm_match = (rec.comm_bytes > 0) == (meas_comm > 0)
+    ok = ok and comm_match
+    rows.append({"class": "comm.bytes.compiled", "static": rec.comm_bytes,
+                 "measured_per_step": round(meas_comm, 1),
+                 "match": comm_match})
+
+    return {
+        "ok": bool(ok),
+        "steps_measured": steps,
+        "rows": rows,
+        "static_findings": [d.render() for d in report.diagnostics],
+        "measured_wall_us_per_step": measured["wall_us_per_step"],
+    }
+
+
+def render_static_diff(diff: Dict, title: str = "static vs measured"
+                       ) -> str:
+    lines = [f"== {title} ==",
+             f"  {'class':<28} {'static':>10} {'measured':>10}  verdict"]
+    for r in diff["rows"]:
+        mark = "MATCH" if r["match"] else "MISMATCH"
+        lines.append(f"  {r['class']:<28} {r['static']:>10g} "
+                     f"{r['measured_per_step']:>10g}  {mark}")
+    verdict = ("OK: static predictions match the meters" if diff["ok"]
+               else "FAILED: static analysis diverges from the "
+                    "measured counters")
+    lines.append(f"  => {verdict}")
+    for f in diff["static_findings"]:
+        lines.append("  " + f)
+    return "\n".join(lines)
+
+
 def _fmt_bytes(n) -> str:
     n = float(n)
     for unit in ("B", "KB", "MB"):

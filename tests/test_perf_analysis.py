@@ -1,0 +1,485 @@
+"""Perf static analyzer (ISSUE 11): sharding propagation +
+fusion-break / host-sync lint over recorded segments.
+
+- analysis/perf_checks.py: a PerfRecorder observes every fusion-window
+  seal during one traced step and classifies breaks (record_fallback /
+  segment_cap / ...) and host syncs (the batch-norm running-stat
+  materialize class) with source attribution, deduped per source line.
+- analysis/sharding_prop.py: PartitionSpec abstract interpretation
+  through _PendingOp dataflow under the ambient mesh, cross-validated
+  against GSPMD's actual output shardings; implicit reshards,
+  mp-boundary round trips, replicated-tensor lint, comm ranking.
+- observability/budget.py static_diff: the analyzer held to the
+  measured seal-reason counters.
+
+Runs on the suite's forced 8-virtual-device CPU backend (conftest).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from conftest import with_flag
+from paddle_tpu import analysis
+from paddle_tpu._core import lazy
+from paddle_tpu._core.executor import apply
+from paddle_tpu._core.op_registry import _OPS, register_op
+
+
+# ------------------------------------------------------------ perf lint
+
+def _bn_model():
+    paddle.seed(0)
+    model = nn.Sequential(nn.Conv2D(1, 4, 3), nn.BatchNorm2D(4),
+                          nn.ReLU(), nn.Conv2D(4, 4, 3),
+                          nn.BatchNorm2D(4))
+    model.train()
+    opt = paddle.optimizer.SGD(0.1, parameters=model.parameters())
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(2, 1, 8, 8).astype("float32"))
+
+    def step():
+        loss = model(x).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        np.asarray(loss._value)
+    return step
+
+
+def test_bn_running_stat_sync_class_deduped():
+    """The eager-ResNet finding, miniature: each train-mode BatchNorm
+    materializes the window for its running-stat update. The two BN
+    layers' syncs share one source line, so they dedupe into ONE
+    host_sync diagnostic with count=2, the framework site naming
+    nn/functional/norm.py and the user site naming THIS file."""
+    report, counts, rec = analysis.trace_step(_bn_model())
+    assert counts.get("materialize") == 2, counts
+    syncs = report.by_checker("host_sync")
+    assert len(syncs) == 1, report.render()
+    d = syncs[0]
+    assert d.severity == "perf"
+    assert d.data["count"] == 2
+    assert "norm.py" in d.data["framework_src"]
+    assert d.provenance and "test_perf_analysis.py" in d.provenance
+    assert rec.sync_count() == 2 and rec.break_count() == 0
+
+
+def test_record_fallback_break_attributed():
+    """An op whose aval inference fails takes the record_fallback
+    path: the perf trace names the op, the stashed record error, and
+    the window break it caused."""
+    if "perf_nested_break_t" not in _OPS:
+        # nested outputs defeat record-time aval inference but run
+        # eagerly (the leaves stack into one array) — the seeded
+        # stand-in for ops like the Pallas flash-attention dispatch
+        register_op("perf_nested_break_t",
+                    lambda x: [[x * 2.0, x + 1.0]],
+                    multi_output=True, custom=True)
+    x = paddle.to_tensor(np.ones((4, 4), "float32"))
+
+    def step():
+        y = x * 1.5 + 0.5
+        z = apply("perf_nested_break_t", y)[0]
+        np.asarray(z.sum()._value)
+
+    report, counts, rec = analysis.trace_step(step)
+    assert counts.get("record_fallback") == 1, counts
+    breaks = report.by_checker("fusion_break")
+    assert len(breaks) == 1, report.render()
+    d = breaks[0]
+    assert d.op_name == "perf_nested_break_t"
+    assert "nested outputs" in d.data["detail"]
+    assert d.data["kind"] == "record_fallback"
+    assert rec.break_count() == 1
+
+
+def test_segment_cap_break_traced_and_static():
+    """A step that outgrows FLAGS_lazy_max_segment_ops: the traced
+    form counts the cap seals; the static check_perf(ctx) form
+    predicts them from the pending program alone."""
+    x = paddle.to_tensor(np.ones((4, 4), "float32"))
+
+    def step():
+        y = x
+        for _ in range(10):
+            y = y * 1.01
+        np.asarray(y._value)
+
+    with with_flag("FLAGS_lazy_max_segment_ops", 4):
+        report, counts, _ = analysis.trace_step(step)
+    assert counts.get("segment_cap") == 2, counts
+    caps = [d for d in report.by_checker("fusion_break")
+            if d.data["kind"] == "segment_cap"]
+    assert len(caps) == 1 and caps[0].data["count"] == 2
+
+    # static form: an open context whose pending exceeds the cap
+    with lazy.lazy_guard(max_segment_ops=1 << 30) as ctx:
+        y = x
+        for _ in range(10):
+            y = y * 1.01
+        ctx._max_override = 4
+        static = analysis.check_perf(ctx)
+        ctx._max_override = 1 << 30
+        ctx._reset_segment()
+    caps = [d for d in static.by_checker("fusion_break")
+            if d.data["kind"] == "segment_cap"]
+    assert len(caps) == 1 and caps[0].data["count"] == 2
+
+
+def test_perf_src_forced_without_static_checks():
+    """Satellite: perf traces force _PendingOp.src capture so
+    diagnostics carry file:line even when FLAGS_static_checks=off."""
+    x = paddle.to_tensor(np.ones((2, 2), "float32"))
+    with with_flag("FLAGS_static_checks", "off"):
+        def step():
+            y = x * 2.0
+            np.asarray(y._value)   # mid-trace host sync
+
+        report, counts, _ = analysis.trace_step(step)
+    syncs = report.by_checker("host_sync")
+    assert len(syncs) == 1
+    assert syncs[0].provenance \
+        and "test_perf_analysis.py" in syncs[0].provenance
+    # and the observer is fully uninstalled afterwards
+    assert lazy.PERF_OBSERVER is None and lazy.PERF_SRC == 0
+
+
+def test_natural_seals_are_not_findings():
+    """A clean fused train step (LeNet-shaped): one backward_fused
+    seal, zero perf findings."""
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    opt = paddle.optimizer.Adam(1e-3, parameters=net.parameters())
+    r = np.random.RandomState(0)
+    x = paddle.to_tensor(r.randn(8, 8).astype("float32"))
+    y = paddle.to_tensor(r.randint(0, 4, (8,)).astype("int64"))
+
+    def step():
+        loss = F.cross_entropy(net(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        np.asarray(loss._value)
+
+    report, counts, _ = analysis.trace_step(step)
+    assert report.ok, report.render()
+    assert counts.get("backward_fused") == 1, counts
+
+
+# ------------------------------------------------------ sharding prop
+
+def _mesh22():
+    return dist.auto_mesh(2, 2, dim_names=["dp", "mp"])
+
+
+def test_sharding_prop_dp_batch_end_to_end():
+    """A dp-sharded LeNet batch: the batch entry propagates through
+    conv/pool/flatten/linear to the loss, whose reduction over the
+    sharded batch is the one priced collective; zero findings."""
+    from paddle_tpu.vision.models import LeNet
+    paddle.seed(0)
+    r = np.random.RandomState(0)
+    with _mesh22():
+        model = LeNet()
+        x = dist.shard_batch(paddle.to_tensor(
+            r.randn(8, 1, 28, 28).astype("float32")))
+        y = paddle.to_tensor(r.randint(0, 10, (8,)).astype("int64"))
+        with lazy.lazy_guard(max_segment_ops=1 << 30) as ctx:
+            loss = F.cross_entropy(model(x), y)
+            res, report = analysis.propagate_specs(ctx)
+            n_ops = len(ctx.pending)
+            ctx._reset_segment()
+    assert report.ok, report.render()
+    # batch sharding rides every feature-map op; loss is replicated
+    for j in range(n_ops - 1):
+        assert res.spec_at(j) == ("dp",), (j, res.spec_at(j))
+    assert res.spec_at(n_ops - 1) == ()
+    assert len(res.comm) == 1 and res.comm[0]["axes"] == ["dp"] \
+        and res.comm[0]["kind"] == "all_reduce"
+
+
+def test_sharding_prop_replicated_mesh_zero_findings():
+    """Nothing committed to the mesh: everything propagates
+    replicated, no comm, no findings (the required no-false-positive
+    baseline)."""
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(8, 8).astype("float32"))
+    with _mesh22():
+        with lazy.lazy_guard(max_segment_ops=1 << 30) as ctx:
+            out = net(x).sum()
+            res, _ = analysis.propagate_specs(ctx)
+            report = analysis.check_sharding(ctx)
+            ctx._reset_segment()
+    assert report.ok, report.render()
+    assert res.comm == []
+    assert all(st.replicated() for st in res.in_states)
+
+
+def test_sharding_prop_tp_round_trip_cross_validated():
+    """The mp-layer contract: Column→Row parallel specs round-trip
+    their sharding constraints (zero findings), the static specs of
+    BOTH live outputs equal GSPMD's actual output shardings, and the
+    row exchange prices as the one intended mp all-reduce."""
+    import jax
+    from paddle_tpu.distributed import spmd as spmd_mod
+    paddle.seed(3)
+    r = np.random.RandomState(3)
+    with _mesh22():
+        col = dist.fleet.mp_layers.ColumnParallelLinear(
+            8, 16, gather_output=False, has_bias=False)
+        row = dist.fleet.mp_layers.RowParallelLinear(
+            16, 8, has_bias=False, input_is_parallel=True)
+        x = paddle.to_tensor(r.randn(4, 8).astype("float32"))
+        with lazy.lazy_guard(max_segment_ops=1 << 30) as ctx:
+            h = col(x)       # held live: constrained (None, 'mp')
+            out = row(h)     # constrained back to replicated
+            res, report = analysis.propagate_specs(ctx)
+            live, _refs = ctx._live_outputs(ctx.pending)
+            st = lazy.SPMD
+            fn = lazy._build_segment_fn(ctx.pending, live)
+            compiled = jax.jit(
+                fn, in_shardings=st.in_shardings(ctx._in_vals)
+            ).lower(*ctx._in_vals).compile()
+            gspmd = [spmd_mod._norm_spec(s.spec)
+                     for s in compiled.output_shardings]
+            static = res.live_specs(live)
+            ctx._reset_segment()
+    assert report.ok, report.render()
+    assert static == gspmd, f"static {static} vs GSPMD {gspmd}"
+    assert (None, "mp") in static       # the constrained TP activation
+    intended = [e for e in res.comm if e["intended"]]
+    assert len(intended) == 1 and intended[0]["axes"] == ["mp"] \
+        and intended[0]["kind"] == "all_reduce"
+
+
+def test_sharding_prop_implicit_reshard_conflict():
+    """Two operands sharded on DIFFERENT axes meet in an elementwise
+    op: flagged as an implicit reshard with the op's provenance."""
+    from paddle_tpu.distributed import shard_tensor
+    from paddle_tpu.distributed.placements import Replicate, Shard
+    r = np.random.RandomState(0)
+    with _mesh22() as mesh:
+        a = shard_tensor(paddle.to_tensor(
+            r.randn(8, 8).astype("float32")), mesh,
+            [Shard(0), Replicate()])
+        b = shard_tensor(paddle.to_tensor(
+            r.randn(8, 8).astype("float32")), mesh,
+            [Replicate(), Shard(0)])
+        with lazy.lazy_guard(max_segment_ops=1 << 30) as ctx:
+            c = a + b
+            report = analysis.check_sharding(ctx)
+            ctx._reset_segment()
+    findings = report.by_checker("implicit_reshard")
+    assert len(findings) == 1, report.render()
+    assert findings[0].severity == "perf"
+    assert findings[0].data["dim"] == 0
+
+
+def test_sharding_prop_constraint_entered_replicated():
+    """A value entering an s-mode mp constraint REPLICATED (the
+    upstream compute ran un-sharded): the round-trip violation is
+    flagged at the constraint op."""
+    from paddle_tpu.distributed._constraint import constrain_dim
+    x = paddle.to_tensor(np.ones((4, 8), "float32"))
+    with _mesh22():
+        with lazy.lazy_guard(max_segment_ops=1 << 30) as ctx:
+            y = x * 2.0
+            z = constrain_dim(y, 1, "mp", shard=True)
+            report = analysis.check_sharding(ctx)
+            ctx._reset_segment()
+    findings = report.by_checker("implicit_reshard")
+    assert len(findings) == 1, report.render()
+    assert findings[0].data["axis"] == "mp"
+    assert "round-trip" in findings[0].message
+
+
+def test_sharding_prop_replicated_large_input_lint():
+    """A large fully-replicated tensor entering an otherwise-sharded
+    program is flagged with the wasted bytes (mesh-size scaled); the
+    floor flag suppresses small stats."""
+    r = np.random.RandomState(0)
+    with _mesh22():
+        big = paddle.to_tensor(r.randn(64, 64).astype("float32"))
+        x = dist.shard_batch(paddle.to_tensor(
+            r.randn(8, 64).astype("float32")))
+        with lazy.lazy_guard(max_segment_ops=1 << 30) as ctx:
+            out = paddle.matmul(x, big)
+            with with_flag("FLAGS_sharding_replicated_min_bytes", 1):
+                report = analysis.check_sharding(ctx)
+            clean = analysis.check_sharding(ctx)   # default 1MB floor
+            ctx._reset_segment()
+    findings = report.by_checker("replicated_tensor")
+    assert len(findings) == 1, report.render()
+    assert findings[0].data["wasted_bytes"] == 64 * 64 * 4 * 3
+    assert not clean.by_checker("replicated_tensor")
+
+
+def test_sharding_comm_summary_ranked():
+    """The comm-hotspot ranking: with the floor lowered, the summary
+    diagnostic ranks per-op collectives largest-first."""
+    from paddle_tpu.distributed._constraint import constrain_dim
+    r = np.random.RandomState(0)
+    with _mesh22():
+        w = dist.shard_tensor(
+            paddle.to_tensor(r.randn(16, 32).astype("float32")),
+            dist.get_mesh(), [dist.Shard(0), dist.Replicate()])
+        x = constrain_dim(paddle.to_tensor(
+            r.randn(8, 16).astype("float32")), 1, "mp")
+        with lazy.lazy_guard(max_segment_ops=1 << 30) as ctx:
+            # mp-sharded contraction -> partial -> resolved at the sum
+            out = paddle.matmul(x, w).sum()
+            with with_flag("FLAGS_sharding_comm_min_bytes", 1):
+                report = analysis.check_sharding(ctx)
+            ctx._reset_segment()
+    summary = report.by_checker("sharding_comm")
+    assert len(summary) == 1, report.render()
+    hs = summary[0].data["hotspots"]
+    assert hs == sorted(hs, key=lambda e: -e["bytes"])
+    assert summary[0].data["total_bytes"] > 0
+
+
+def test_partial_value_priced_once_across_consumers():
+    """Review regression: GSPMD inserts ONE all-reduce per partial
+    value — a partial matmul output feeding two consumers (and staying
+    live) must be priced once, not per consumer."""
+    from paddle_tpu.distributed import shard_tensor
+    from paddle_tpu.distributed._constraint import constrain_dim
+    from paddle_tpu.distributed.placements import Replicate, Shard
+    r = np.random.RandomState(0)
+    with _mesh22() as mesh:
+        w = shard_tensor(paddle.to_tensor(
+            r.randn(16, 8).astype("float32")), mesh,
+            [Replicate(), Shard(0)])        # dim0 sharded over 'mp'
+        x = constrain_dim(paddle.to_tensor(
+            r.randn(8, 16).astype("float32")), 1, "mp")
+        with lazy.lazy_guard(max_segment_ops=1 << 30) as ctx:
+            out = paddle.matmul(x, w)       # partial over 'mp'
+            a = out + 1.0                   # first consumer resolves
+            b = out * 2.0                   # second sees it resolved
+            res, _ = analysis.propagate_specs(ctx)
+            ctx._reset_segment()
+    reduces = [e for e in res.comm if e["kind"] == "all_reduce"]
+    assert len(reduces) == 1, res.comm
+    assert reduces[0]["axes"] == ["mp"]
+
+
+def test_check_perf_traced_surfaces_sharding_findings():
+    """Review regression: implicit-reshard findings collected while a
+    traced step seals under an ambient mesh must surface in the
+    recorder's report, not vanish."""
+    from paddle_tpu.distributed import shard_tensor
+    from paddle_tpu.distributed.placements import Replicate, Shard
+    r = np.random.RandomState(0)
+    with _mesh22() as mesh:
+        a = shard_tensor(paddle.to_tensor(
+            r.randn(8, 8).astype("float32")), mesh,
+            [Shard(0), Replicate()])
+        b = shard_tensor(paddle.to_tensor(
+            r.randn(8, 8).astype("float32")), mesh,
+            [Replicate(), Shard(0)])
+
+        def step():
+            c = a + b
+            np.asarray(c._value)
+
+        report = analysis.check_perf(step)
+    assert report.by_checker("implicit_reshard"), report.render()
+
+
+# ------------------------------------------------------- static diff
+
+def test_static_diff_clean_fused_step():
+    """budget.static_diff on a clean fused step: every seal row
+    matches the measured counters and the verdict is OK."""
+    from paddle_tpu.observability import budget
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    opt = paddle.optimizer.Adam(1e-3, parameters=net.parameters())
+    r = np.random.RandomState(0)
+    x = paddle.to_tensor(r.randn(8, 8).astype("float32"))
+    y = paddle.to_tensor(r.randint(0, 4, (8,)).astype("int64"))
+
+    def step():
+        loss = F.cross_entropy(net(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        np.asarray(loss._value)
+
+    diff = budget.static_diff(step, steps=3)
+    assert diff["ok"], budget.render_static_diff(diff)
+    rows = {r_["class"]: r_ for r_ in diff["rows"]}
+    assert rows["seal:backward_fused"]["static"] == 1
+    assert rows["fusion.window_breaks"]["static"] == 0
+
+
+def test_static_diff_no_false_clean_on_known_break():
+    """The acceptance gate: a model with a known fusion break must
+    show it statically AND match the measured counter — never a false
+    'clean'."""
+    from paddle_tpu.observability import budget
+    if "perf_nested_break_t" not in _OPS:
+        register_op("perf_nested_break_t",
+                    lambda x: [[x * 2.0, x + 1.0]],
+                    multi_output=True, custom=True)
+    x = paddle.to_tensor(np.ones((4, 4), "float32"))
+
+    def step():
+        y = x * 1.5
+        z = apply("perf_nested_break_t", y)[0]
+        np.asarray(z.sum()._value)
+
+    diff = budget.static_diff(step, steps=3)
+    assert diff["ok"], budget.render_static_diff(diff)
+    rows = {r_["class"]: r_ for r_ in diff["rows"]}
+    assert rows["seal:record_fallback"]["static"] == 1
+    assert rows["fusion.window_breaks"]["static"] == 1
+    assert rows["fusion.window_breaks"]["measured_per_step"] == 1
+
+
+def test_static_diff_prices_compiled_comm_under_mesh():
+    """Under an ambient dp mesh the traced step's sharding sweep must
+    predict non-zero compiled-collective traffic exactly when the
+    comm.bytes.compiled.* meters count some (no false clean)."""
+    from paddle_tpu.observability import budget
+    paddle.seed(0)
+    r = np.random.RandomState(0)
+    with dist.auto_mesh(4, dim_names=["dp"]):
+        net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(),
+                            nn.Linear(16, 4))
+        opt = paddle.optimizer.Adam(1e-3, parameters=net.parameters())
+        dp = dist.DataParallel(net)
+        x = paddle.to_tensor(r.randn(8, 8).astype("float32"))
+        y = paddle.to_tensor(r.randint(0, 4, (8,)).astype("int64"))
+
+        def step():
+            loss = F.cross_entropy(dp(x).reshape([8, 4]), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            np.asarray(loss._value)
+
+        diff = budget.static_diff(step, steps=3)
+    assert diff["ok"], budget.render_static_diff(diff)
+    rows = {r_["class"]: r_ for r_ in diff["rows"]}
+    assert rows["comm.bytes.compiled"]["static"] > 0
+    assert rows["comm.bytes.compiled"]["measured_per_step"] > 0
+
+
+# --------------------------------------------------------------- CLI
+
+def test_perf_cli_sharded_models_in_process():
+    """The --perf CLI's sharded sweeps run in-process on the suite's
+    8-device backend (no re-exec) and exit 0."""
+    from paddle_tpu.analysis.__main__ import _JSON, main
+    rc = main(["--perf", "--models", "lenet-sharded,tp-sharded",
+               "--json"])
+    assert rc == 0
+    assert set(_JSON["models"]) == {"lenet-sharded", "tp-sharded"}
+    tp = _JSON["models"]["tp-sharded"][0]
+    assert tp["reshards"] == 0 and tp["comm_bytes"] > 0

@@ -638,6 +638,35 @@ def test_member_leave_replans_and_recompiles_once():
         dist.set_mesh(None)
 
 
+def test_replan_rebuilds_ambient_mesh():
+    """ROADMAP item (d): survivors running INSIDE a `with auto_mesh`
+    block must not keep the stale ambient `_Ambient` object across a
+    re-plan — the rebuilt state wraps the planned survivor mesh (new
+    descriptor, new device set, new cache-key component) and training
+    continues bit-consistent with the fault-free reference."""
+    from paddle_tpu.distributed import spmd
+    ref = _plain_lenet(5)
+    mesh = dist.auto_mesh(8, dim_names=["dp"])
+    with mesh:
+        old_state = spmd.state()
+        assert old_state is not None and old_state.desc == "dp8"
+        trainer, step, _ = _adaptive_lenet(mesh=mesh, lost_ranks=[6, 7])
+        losses = [trainer.run(step)]
+        with with_flag("FLAGS_fault_inject", "member::leave@1=die"):
+            losses += [trainer.run(step) for _ in range(4)]
+        st = spmd.state()
+        assert trainer.replans == 1 and trainer.mesh.size == 6
+        assert st is not None and st is not old_state, \
+            "replan left the stale ambient mesh object active"
+        assert st.pmesh is trainer.mesh
+        assert st.desc == "dp6", st.desc
+        assert st.key != old_state.key, \
+            "rebuilt ambient state kept the old cache-key component"
+        trainer.shutdown()
+    assert spmd.state() is None, "mesh exit did not pop the ambient"
+    np.testing.assert_allclose(losses, ref, rtol=1e-5)
+
+
 def test_rank_death_routes_through_replan():
     """`step::N=die` (the watchdog/step path, not the membership poll)
     reaches the same re-plan pipeline via ElasticStep's on_rank_death:
