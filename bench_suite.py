@@ -82,6 +82,17 @@ Row 13 perf static analyzer gate    runs `python -m paddle_tpu.analysis
                                   break or implicit reshard on the
                                   bench models fails the gate
 
+Row 14 compute telemetry plane  asserts the compute-telemetry-off path
+                                (WITH async flush on) makes zero
+                                cost_analysis calls, counts zero FLOPs
+                                and freezes every registry counter;
+                                reports the enabled overhead us/step on
+                                the capped chain and embeds the LeNet
+                                steady-state MFU / GFLOP/s snapshot
+                                (both ride as nested diff rows with
+                                up-good units so efficiency regressions
+                                gate mechanically)
+
 (Multi-chip GPT/ERNIE hybrids need a pod; their single-chip proxies are
 bench.py's headline + the dryrun_multichip compile check.)
 
@@ -135,8 +146,34 @@ def bench_lenet():
         return loss._value
 
     sec = _timeit(step, steps=30, warmup=5)
+    mfu, gflops = _measure_mfu(step, sec)
     return {"metric": "LeNet MNIST dygraph (b128 eager fwd+bwd+adam)",
-            "value": round(1.0 / sec, 1), "unit": "steps/s"}
+            "value": round(1.0 / sec, 1), "unit": "steps/s",
+            "mfu": mfu, "gflops": gflops}
+
+
+def _measure_mfu(step, sec_per_step, steps=3):
+    """Headline MFU / GFLOP/s columns: flip the compute telemetry
+    plane on AFTER the timed rounds (entering the plane re-keys the
+    executable caches, so the instrumented pass compiles fresh,
+    cost-analyzed runners), count the per-step FLOPs over a few
+    steps, and price them against the ALREADY-measured steady-state
+    step time — the timed number is never perturbed."""
+    import paddle_tpu as paddle
+    from paddle_tpu.observability import compute as comptel
+
+    paddle.set_flags({"FLAGS_compute_telemetry": True})
+    try:
+        step()                      # recompile under the plane
+        f0 = comptel.executed_flops()
+        for _ in range(steps):
+            step()
+        flops_per_step = (comptel.executed_flops() - f0) / steps
+    finally:
+        paddle.set_flags({"FLAGS_compute_telemetry": False})
+    achieved = flops_per_step / sec_per_step if sec_per_step else 0.0
+    return (round(comptel.mfu(achieved), 6),
+            round(achieved / 1e9, 3))
 
 
 def bench_resnet50():
@@ -825,6 +862,7 @@ def _spmd_dryrun_worker(n: int):
     H = int(os.environ.get("SPMD_DRYRUN_H", 64))
     paddle.set_flags({"FLAGS_static_checks": "off",
                       "FLAGS_memory_telemetry": True,
+                      "FLAGS_compute_telemetry": True,
                       "FLAGS_observability": True})
     paddle.seed(0)
     r = np.random.RandomState(0)
@@ -850,19 +888,30 @@ def _spmd_dryrun_worker(n: int):
             opt.clear_grad()
             return loss
 
+        from paddle_tpu.observability import compute as comptel
         _timeit(lambda: step()._value, steps=2, warmup=3)
         memtel.reset_peak()
+        f0 = comptel.executed_flops()
+        t_f = time.perf_counter()
         # min-of-rounds (the row 5/6 technique): this row runs on
         # whatever shares the host, and the scale column divides two
         # of these numbers
         dt = min(_timeit(lambda: step()._value, steps=8, warmup=0)
                  for _ in range(3))
+        # per-CHIP achieved FLOP/s over the whole 3x8-step window
+        # (cost analysis prices the partitioned module, so the counted
+        # FLOPs are already per-device)
+        d_flops = comptel.executed_flops() - f0
+        d_t = time.perf_counter() - t_f
+        achieved = d_flops / d_t if d_t > 0 else 0.0
         snap = metrics.snapshot()["counters"]
     temps = [int(e.get("temp_bytes") or 0)
              for e in memtel.executable_stats()]
     print(json.dumps({
         "n": n, "step_ms": round(dt * 1e3, 3),
         "tokens_s": round(B * S / dt, 1),
+        "mfu": round(comptel.mfu(achieved), 6),
+        "gflops": round(achieved / 1e9, 3),
         "peak_pd_bytes": memtel.peak_per_device_bytes(),
         "peak_bytes": memtel.peak_bytes(),
         "temp_bytes_max": max(temps) if temps else 0,
@@ -928,6 +977,8 @@ def bench_spmd_multichip():
                        "weak scaling)",
              "value": results[n]["tokens_s"], "unit": "tokens/s",
              "step_ms": results[n]["step_ms"],
+             "mfu": results[n].get("mfu"),
+             "gflops": results[n].get("gflops"),
              "peak_pd_bytes": results[n]["peak_pd_bytes"],
              "temp_bytes_max": results[n]["temp_bytes_max"],
              "compiled_comm_bytes": results[n]["compiled_comm_bytes"],
@@ -937,6 +988,8 @@ def bench_spmd_multichip():
                       "(mesh=dp8, weak scaling, 8 virtual CPU devices)",
             "value": results[8]["tokens_s"], "unit": "tokens/s",
             "scale_8x_vs_1x": scale8,
+            "mfu": results[8].get("mfu"),
+            "gflops": results[8].get("gflops"),
             # 8 virtual devices share the host's real cores: the
             # achievable dryrun scale is bounded by them, so the scale
             # column reads against this, not against 8
@@ -1006,6 +1059,98 @@ def bench_perf_lint():
             "rows": rows}
 
 
+def bench_compute():
+    """Row 14: compute telemetry plane. Off contract asserted EXACTLY
+    (the rows-5..11 counter technique) with the async flush pipeline
+    ON: across a capped 32-op dispatch chain zero ``cost_analysis()``
+    calls happen, zero FLOPs are counted, and the registry's MUTATIONS
+    counter stays frozen. The reported value is the enabled-mode
+    overhead per step on the same chain (per-op src capture + the
+    per-execution FLOP count). The row json embeds the LeNet
+    steady-state compute snapshot — MFU, achieved GFLOP/s, arithmetic
+    intensity — via budget.collect; MFU and GFLOP/s ride as nested
+    diff rows with up-good units so an efficiency regression gates
+    mechanically."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu._core import async_flush
+    from paddle_tpu.observability import budget as budget_mod
+    from paddle_tpu.observability import compute as comptel
+    from paddle_tpu.observability import metrics
+
+    x = paddle.to_tensor(np.ones((16, 16), "float32"))
+
+    def chain():
+        y = x
+        for _ in range(32):
+            y = y * 1.0001 + 0.0001
+        return y._value
+
+    from paddle_tpu._core.flags import flag_value
+    checks_was = flag_value("FLAGS_static_checks")
+    # checks off for the freeze window: the warn-mode sanitizer sweep
+    # counts registry work by design (the row-10/11 precedent)
+    paddle.set_flags({"FLAGS_async_flush": True,
+                      "FLAGS_lazy_max_segment_ops": 16,
+                      "FLAGS_static_checks": "off"})
+    try:
+        _timeit(chain, steps=20, warmup=5)
+        async_flush.drain()
+        # ---------------- compute telemetry OFF: the freeze contract
+        before = metrics.MUTATIONS
+        calls0 = comptel.COST_CALLS
+        flops0 = comptel.executed_flops()
+        off_t = _timeit(chain, steps=100, warmup=0)
+        async_flush.drain()
+        assert metrics.MUTATIONS == before, \
+            "compute-telemetry-off loop did registry work (must be 0)"
+        assert comptel.COST_CALLS == calls0, \
+            "compute-telemetry-off loop called cost_analysis"
+        assert comptel.executed_flops() == flops0, \
+            "compute-telemetry-off loop counted FLOPs (must be 0)"
+        # ---------------- ON: enabled overhead per step
+        paddle.set_flags({"FLAGS_compute_telemetry": True})
+        try:
+            on_t = _timeit(chain, steps=100, warmup=5)
+            async_flush.drain()
+            assert comptel.COST_CALLS > calls0, \
+                "compute-telemetry-on loop captured no cost analysis"
+            assert comptel.executed_flops() > flops0, \
+                "compute-telemetry-on loop counted no FLOPs"
+        finally:
+            paddle.set_flags({"FLAGS_compute_telemetry": False})
+    finally:
+        paddle.set_flags({"FLAGS_async_flush": False,
+                          "FLAGS_lazy_max_segment_ops": 256,
+                          "FLAGS_static_checks": checks_was})
+        async_flush.drain(raise_latched=False)
+
+    # ---------------- LeNet steady-state compute snapshot
+    from paddle_tpu.observability.__main__ import _lenet_step
+    snap = budget_mod.collect(_lenet_step(), steps=8, warmup=3)
+    comp = snap["compute"]
+    assert comp["cost_analysis_calls_measured"] == 0, \
+        "steady-state LeNet window re-ran cost_analysis (must be " \
+        "captured once per compile)"
+    return {"metric": "compute telemetry overhead (32-op capped chain; "
+                      "off = zero cost_analysis calls + zero FLOPs "
+                      "counted + frozen counters, async flush on)",
+            "value": round((on_t - off_t) * 1e6, 2),
+            "unit": "us/step overhead",
+            "lenet_mfu": comp["mfu"],
+            "lenet_gflops": comp["gflops_per_s"],
+            "lenet_flops_per_step": comp["flops_per_step"],
+            "lenet_arith_intensity": comp["arith_intensity"],
+            "lenet_bound": comp["bound"],
+            "rows": [{"metric": "LeNet steady-state MFU (b32 budget "
+                                "window, per-chip peak)",
+                      "value": comp["mfu"], "unit": "mfu"},
+                     {"metric": "LeNet steady-state achieved GFLOP/s "
+                                "(b32 budget window)",
+                      "value": comp["gflops_per_s"],
+                      "unit": "gflops"}]}
+
+
 # ------------------------------------------------------------- diff mode
 
 def _rows_of(path: str) -> dict:
@@ -1044,9 +1189,12 @@ def _lower_is_better(metric: str, unit: str) -> bool:
     unit-less cost words fall back to the name."""
     u = unit.lower()
     # a RATE unit ends its first token with '/s' (tokens/s, ops/s);
-    # 'us/step publication overhead' must not match
+    # 'us/step publication overhead' must not match. Efficiency units
+    # (mfu, gflops — bench row 14's LeNet snapshot rows) are up-good:
+    # an MFU drop is exactly the regression the compute plane gates.
     first = u.split()[0] if u.split() else ""
-    if first.endswith("/s") or u.startswith("x "):
+    if first.endswith("/s") or u.startswith("x ") \
+            or first in ("mfu", "gflops"):
         return False
     text = f"{metric} {u}".lower()
     return any(w in text for w in ("overhead", "latency", "ms", "% ",
@@ -1113,13 +1261,14 @@ def main():
         _spmd_dryrun_worker(int(sys.argv[i + 1]))
         return
     rows = os.environ.get("BENCH_ROWS",
-                          "1,2,3,4,5,6,7,8,9,10,11,12,13").split(",")
+                          "1,2,3,4,5,6,7,8,9,10,11,12,13,14").split(",")
     table = {"1": bench_lenet, "2": bench_resnet50, "3": bench_bert,
              "4": bench_dispatch, "5": bench_static_checks,
              "6": bench_observability, "7": bench_resilience,
              "8": bench_replan, "9": bench_async_flush,
              "10": bench_telemetry, "11": bench_memory,
-             "12": bench_spmd_multichip, "13": bench_perf_lint}
+             "12": bench_spmd_multichip, "13": bench_perf_lint,
+             "14": bench_compute}
     for r in rows:
         r = r.strip()
         out = table[r]()

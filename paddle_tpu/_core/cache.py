@@ -39,6 +39,10 @@ class ExecCache(OrderedDict):
         # this once per sharded compile from the in/out specs); a
         # steady-state hit re-counts the cached number per execution
         self._comm: dict = {}
+        # per-entry XLA cost analysis (observability/compute.py fills
+        # this at compile time while FLAGS_compute_telemetry is on);
+        # every execution of the entry prices its cached FLOPs
+        self._cost: dict = {}
         # direct Counter handles: metrics.reset() zeroes them in place,
         # so holding the objects (no per-lookup name resolution) is safe
         if stat is not None:
@@ -99,6 +103,7 @@ class ExecCache(OrderedDict):
                 OrderedDict.__delitem__(self, oldest)
                 self._mem.pop(oldest, None)
                 self._comm.pop(oldest, None)
+                self._cost.pop(oldest, None)
             except (KeyError, StopIteration, RuntimeError):
                 break
 
@@ -118,7 +123,16 @@ class ExecCache(OrderedDict):
     def comm_info(self, key, default=None):
         return self._comm.get(key, default)
 
+    def note_cost(self, key, info: dict):
+        """Attach a compiled executable's cost analysis to its cache
+        entry (observability/compute.py, FLAGS_compute_telemetry)."""
+        self._cost[key] = info
+
+    def cost_info(self, key, default=None):
+        return self._cost.get(key, default)
+
     def clear(self):
         OrderedDict.clear(self)
         self._mem.clear()
         self._comm.clear()
+        self._cost.clear()

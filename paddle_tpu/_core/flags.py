@@ -437,6 +437,31 @@ define_flag("FLAGS_memory_telemetry", False,
             "donation savings accounting, and OOM postmortems at the "
             "execute sites. Off = one module-level check per choke "
             "point, zero census and zero registry work (bench row 11).")
+define_flag("FLAGS_compute_telemetry", False,
+            "Compute-efficiency telemetry plane (observability/"
+            "compute.py): per-executable XLA cost_analysis (FLOPs, "
+            "bytes accessed, transcendentals) captured once per compile "
+            "at the three fused-runtime compile sites and cached on the "
+            "executable-cache entry, per-execution FLOP counters "
+            "(compute.flops.{segment,fused_step,optimizer}), MFU/"
+            "roofline columns in the budget tool, and source-attributed "
+            "device profiles (each recorded op's lowering wrapped in a "
+            "jax.named_scope carrying its paddle file:line). Off = one "
+            "module-level check per site, zero registry and zero "
+            "analysis work (bench row 14).")
+define_flag("FLAGS_device_peak_flops", 0.0,
+            "Per-chip peak FLOP/s the MFU column divides by. 0 = "
+            "autodetect per backend: TPU from the device_kind table "
+            "(v2 45T .. v6e 918T bf16), CPU falls back to a nominal "
+            "cores x 2.5 GHz x 16 fp32-FLOPs/cycle AVX2-FMA envelope "
+            "(documented in README — CPU MFU is a relative meter, not "
+            "an absolute one).")
+define_flag("FLAGS_device_peak_membw", 0.0,
+            "Per-chip peak memory bandwidth in bytes/s for the "
+            "roofline ridge point (peak_flops / peak_membw). 0 = "
+            "autodetect: TPU from the device_kind table (v4 1.2TB/s, "
+            "v5p 2.8TB/s, ...), CPU falls back to a nominal 25.6 GB/s "
+            "two-channel DDR4 envelope.")
 define_flag("FLAGS_memory_budget_bytes", 0,
             "Per-device HBM budget in bytes for the cross-rank memory "
             "column: budget --distributed flags the rank whose peak is "

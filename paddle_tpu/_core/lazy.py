@@ -89,6 +89,20 @@ PERF_OBSERVER = None
 # the analysis CLI and check_perf bump this around their own traces.
 PERF_SRC = 0
 
+# True when some executable was cached WITHOUT cost_analysis capture
+# (compiled while FLAGS_compute_telemetry was off). Entering the
+# compute plane bumps MESH_EPOCH only while this is set — so a
+# monitoring loop that flips the plane on/off around each budget
+# sample (budget.collect) does not invalidate every compiled-program
+# cache in the process on every sample once the warm entries already
+# carry their analyses.
+COST_STALE = False
+
+
+def mark_cost_stale():
+    global COST_STALE
+    COST_STALE = True
+
 
 def bump_mesh_epoch() -> int:
     """Invalidate the compiled-segment and fused-step cache keys (the
@@ -302,25 +316,40 @@ def _note_compiled_comm(cache, key, spmd, in_vals, out_vals, site,
         metrics.inc("comm.bytes.compiled." + site, est)
 
 
+def _mesh_devices(spmd) -> int:
+    """Pricing basis for the compute plane's per-chip cost analysis:
+    the ambient mesh's device count (1 without a mesh)."""
+    if spmd is None:
+        return 1
+    n = 1
+    for s in spmd.shape:
+        n *= int(s)
+    return n
+
+
 def _compile_segment_runner(pending, live, donate, run_vals, sig,
                             spmd=None):
-    """Build one segment's cached runner. With the memory telemetry
-    plane on (and concrete inputs), compile through the jax AOT path so
-    the executable's ``memory_analysis()`` lands on the ExecCache entry
-    exactly once per compile; otherwise the plain jit wrapper. Both are
-    interchangeable callables — the cache key already pins the input
-    signature, so an AOT-compiled entry only ever sees matching
-    arguments. `spmd` is the ambient mesh the caller keyed the segment
-    against (the async worker passes its seal-time capture)."""
+    """Build one segment's cached runner. With the memory or compute
+    telemetry plane on (and concrete inputs), compile through the jax
+    AOT path so the executable's ``memory_analysis()`` /
+    ``cost_analysis()`` land on the ExecCache entry exactly once per
+    compile; otherwise the plain jit wrapper. Both are interchangeable
+    callables — the cache key already pins the input signature, so an
+    AOT-compiled entry only ever sees matching arguments. `spmd` is
+    the ambient mesh the caller keyed the segment against (the async
+    worker passes its seal-time capture)."""
     jitted = _spmd_jit(_build_segment_fn(pending, live), donate,
                        run_vals, spmd)
-    if _OBS.MEM and not any(isinstance(v, jax.core.Tracer)
-                            for v in run_vals):
+    if not _OBS.COMPUTE:
+        mark_cost_stale()
+    if (_OBS.MEM or _OBS.COMPUTE) and not any(
+            isinstance(v, jax.core.Tracer) for v in run_vals):
         from ..observability import memory as _memtel
         with _quiet_donation_compile():
             return _memtel.aot_compile(jitted, run_vals, stat="segment",
                                        cache=_SEG_CACHE,
-                                       key=(sig, donate))
+                                       key=(sig, donate),
+                                       n_devices=_mesh_devices(spmd))
     return jitted
 
 
@@ -342,18 +371,22 @@ def _spmd_for_compile(in_vals):
 
 def _compile_fused_runner(pending, live, grad_in, root_k, run_vals, key,
                           spmd=None):
-    """Fused fwd+vjp step runner, AOT-compiled for its memory analysis
-    when the telemetry plane is on (the steady-state step cache can
-    then report its compiled footprint on every later hit)."""
+    """Fused fwd+vjp step runner, AOT-compiled for its memory / cost
+    analysis when a telemetry plane is on (the steady-state step cache
+    can then report its compiled footprint and price its FLOPs on
+    every later hit)."""
     jitted = _spmd_jit(_build_fused_fn(pending, live, grad_in, root_k),
                        (), run_vals, spmd)
-    if _OBS.MEM and not any(isinstance(v, jax.core.Tracer)
-                            for v in run_vals):
+    if not _OBS.COMPUTE:
+        mark_cost_stale()
+    if (_OBS.MEM or _OBS.COMPUTE) and not any(
+            isinstance(v, jax.core.Tracer) for v in run_vals):
         from ..observability import memory as _memtel
         with _quiet_donation_compile():
             return _memtel.aot_compile(jitted, run_vals,
                                        stat="fused_step",
-                                       cache=_FUSED_CACHE, key=key)
+                                       cache=_FUSED_CACHE, key=key,
+                                       n_devices=_mesh_devices(spmd))
     return jitted
 
 
@@ -620,10 +653,13 @@ class CaptureContext:
                     from ..analysis import alias_graph as _ag
                     for _out in outs:
                         _ag.note_view(_out, base, op.name, src)
-        elif PERF_SRC:
-            # perf tracing forces provenance capture even with the
-            # sanitizer off (no alias-graph work — that is the
-            # correctness sanitizer's job, not the perf lint's)
+        elif PERF_SRC or _OBS.COMPUTE:
+            # perf tracing AND the compute telemetry plane force
+            # provenance capture even with the sanitizer off (no
+            # alias-graph work — that is the correctness sanitizer's
+            # job): perf diagnostics need the line, and the compute
+            # plane bakes it into each op's named_scope so device
+            # profiles group by paddle source
             from ..analysis.hooks import call_site
             src = call_site()
         self.pending.append(_PendingOp(op, dict(attrs), wiring, out_refs,
@@ -841,6 +877,12 @@ class CaptureContext:
         if SPMD is not None and _OBS.METRICS:
             _note_compiled_comm(_SEG_CACHE, (sig, donate), SPMD,
                                 run_vals, out_vals, "segment")
+        if _OBS.COMPUTE:
+            # FLOP accounting: price this execution from the cost
+            # analysis the compile cached on the entry (zero work when
+            # the entry predates the plane)
+            from ..observability import compute as _comptel
+            _comptel.count_cached(_SEG_CACHE, (sig, donate), "segment")
         if _OBS.MEM and donate:
             _note_donated_inputs(in_vals, donate)
         self._reset_segment()
@@ -1004,6 +1046,10 @@ class CaptureContext:
                 if spmd is not None and _OBS.METRICS:
                     _note_compiled_comm(_SEG_CACHE, (sig, donate), spmd,
                                         run_vals, out_vals, "segment")
+                if _OBS.COMPUTE:
+                    from ..observability import compute as _comptel
+                    _comptel.count_cached(_SEG_CACHE, (sig, donate),
+                                          "segment")
                 if _OBS.MEM:
                     if donate:
                         _note_donated_inputs(in_vals, donate)
@@ -1420,17 +1466,32 @@ def _in_signature(in_vals):
 
 def _build_segment_fn(pending, live):
     """Compile body of one segment. Variadic over inputs so jax.jit's
-    donate_argnums can address individual input buffers."""
+    donate_argnums can address individual input buffers.
+
+    With the compute telemetry plane on, each op's lowering is wrapped
+    in ``jax.named_scope("<op>[<file>:<line>]")`` from its recorded
+    ``_PendingOp.src`` — the HLO op_name metadata then carries paddle
+    source provenance, so xplane device traces and the profiler
+    statistic table can group device time by the line that recorded
+    the op (observability/compute.py note_provenance/source_of).
+    Decided at build (= compile) time: the off path pays nothing, and
+    scope strings are metadata only — they never change what the
+    program computes."""
     backend = jax.default_backend()
+    scoped = _OBS.COMPUTE
     steps = []
     for p in pending:
+        scope = None
+        if scoped and p.src is not None:
+            from ..observability.compute import scope_name
+            scope = scope_name(p.op.name, p.src)
         steps.append((functools.partial(p.op.kernel_for(backend),
                                         **p.attrs),
-                      p.wiring, p.op.multi_output))
+                      p.wiring, p.op.multi_output, scope))
 
     def seg_fn(*inputs):
         vals: List[Tuple] = []
-        for fn, wiring, multi in steps:
+        for fn, wiring, multi, scope in steps:
             ins = []
             for w in wiring:
                 if w is None:
@@ -1439,7 +1500,11 @@ def _build_segment_fn(pending, live):
                     ins.append(inputs[w[1]])
                 else:
                     ins.append(vals[w[1]][w[2]])
-            out = fn(*ins)
+            if scope is not None:
+                with jax.named_scope(scope):
+                    out = fn(*ins)
+            else:
+                out = fn(*ins)
             vals.append(tuple(out) if multi else (out,))
         return [vals[j][s] for (j, s) in live]
 
@@ -1551,8 +1616,9 @@ class ReplayableSegment:
         runner = _SEG_CACHE.get((self.sig, ()))
         compiled = runner is None
         if compiled:
-            runner = _spmd_jit(_build_segment_fn(self.pending, self.live),
-                               (), in_vals, self.spmd)
+            runner = _compile_segment_runner(self.pending, self.live, (),
+                                             in_vals, self.sig,
+                                             spmd=self.spmd)
             _SEG_CACHE[(self.sig, ())] = runner
             if _OBS.METRICS:
                 from ..observability import metrics
@@ -1574,6 +1640,9 @@ class ReplayableSegment:
                 dispatch._check_nan_inf(
                     f"{self.pending[j].op.name} (replayed segment output)",
                     (val,))
+        if _OBS.COMPUTE:
+            from ..observability import compute as _comptel
+            _comptel.count_cached(_SEG_CACHE, (self.sig, ()), "segment")
         if _OBS.MEM:
             from ..observability import memory as _memtel
             _memtel.note_segment_outputs(
@@ -1871,6 +1940,9 @@ def try_fused_backward(tensors, grad_tensors) -> bool:
         # the comm-overlap report is not blind to compiled collectives
         _note_compiled_comm(_FUSED_CACHE, key, SPMD, run_vals,
                             list(out_vals) + list(grads), "fused_step")
+    if _OBS.COMPUTE:
+        from ..observability import compute as _comptel
+        _comptel.count_cached(_FUSED_CACHE, key, "fused_step")
     if _OBS.MEM:
         from ..observability import memory as _memtel
         _memtel.note_segment_outputs(

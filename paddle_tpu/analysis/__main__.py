@@ -371,10 +371,13 @@ def _perf_print(name: str, d, report, verbose: bool):
 
 def perf_gpt2_eager(verbose: bool):
     """Eager-GPT, the BUDGET_r06 configuration (hidden 128, 4 layers,
-    seq 128): one traced train step. Expected steady-state shape on
-    this toolchain: 4 `record_fallback` breaks/step (the Pallas
-    flash-attention dispatch cannot record) — the finding the 'kill
-    the host dispatch tax' ROADMAP item consumes."""
+    seq 128): one traced train step. Expected steady-state shape:
+    ZERO breaks — the flash-attention record-time aval inference now
+    succeeds on toolchains without ``jax.enable_x64`` (the x64 toggle
+    degrades to a no-op there), so the step stays in one fusion window
+    and reaches the fused fwd+vjp steady state. This row was the
+    4-`record_fallback`-breaks/step finding of BUDGET_r06; the gate
+    now exists to catch the class COMING BACK."""
     from paddle_tpu.observability.__main__ import _gpt2_step
     from paddle_tpu import analysis
     report, counts, _ = analysis.trace_step(_gpt2_step())
@@ -385,9 +388,12 @@ def perf_gpt2_eager(verbose: bool):
 
 def perf_resnet50_eager(verbose: bool):
     """Eager ResNet-50 in TRAIN mode (running stats live), small input
-    so the CLI stays quick: one traced step. Expected: the batch-norm
-    running-stat class — one deduped host_sync finding counting 53
-    materialize seals/step at nn/functional/norm.py."""
+    so the CLI stays quick: one traced step. Expected: ZERO host
+    syncs — the batch-norm running-stat update is pure in-window
+    elementwise state math now (nn/functional/norm.py set_value
+    aliases the pending result instead of reading ``mean._value``
+    back). This row was the 53-materialize-seals/step finding of
+    BUDGET_r06; the gate now exists to catch the class COMING BACK."""
     import numpy as np
     import paddle_tpu as paddle
     import paddle_tpu.nn.functional as F

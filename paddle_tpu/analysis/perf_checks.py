@@ -122,6 +122,11 @@ class PerfRecorder:
         # `budget --static-diff` can cross-check the measured
         # comm.bytes.compiled.* counters ("no false clean")
         self.comm_bytes = 0
+        # static FLOP estimate of every sealed segment's forward math
+        # (sharding_prop.segment_flops — the rule-table FLOP model):
+        # the cost axis `budget --static-diff` holds the measured
+        # compute.flops.* counters against, same no-false-clean gate
+        self.static_flops = 0
         self.sharding_report = CheckReport("perf trace sharding")
 
     # -------------------------------------------------------- lifecycle
@@ -145,6 +150,11 @@ class PerfRecorder:
     def _on_seal(self, ctx, reason: str, pending):
         from . import hooks
         from .._core import lazy
+        if ctx is not None and pending:
+            # static FLOP model over the sealed program (pure shape
+            # math — no mesh needed)
+            from .sharding_prop import segment_flops
+            self.static_flops += segment_flops(pending, ctx._in_vals)
         if lazy.SPMD is not None and ctx is not None:
             # sealed under an ambient mesh: price the segment's
             # compiled collectives statically (the sharding sweep also

@@ -163,13 +163,25 @@ class _Prop:
         if k <= 1 or nbytes <= 0:
             return
         factor = (k - 1) / k if gather_only else 2 * (k - 1) / k
+        # cost axis for the hotspot ranking: the producing op's static
+        # FLOPs ride each comm event, so a ranking consumer can weigh
+        # "big collective on a cheap op" against "small collective on
+        # the op that burns the step's FLOPs"
+        flops = 0
+        if 0 <= op_idx < len(self.view.pending):
+            pop = self.view.pending[op_idx]
+            flops = op_flops(
+                pop.op.name, pop.attrs,
+                _op_in_avals(self.view.pending, self.view.in_vals,
+                             op_idx),
+                [r.aval for r in pop.out_refs])
         self.res.comm.append({
             "op_index": op_idx,
             "op": self.view.pending[op_idx].op.name
             if 0 <= op_idx < len(self.view.pending) else None,
             "kind": kind, "axes": sorted(axes),
             "bytes": int(factor * nbytes), "src": src,
-            "intended": bool(intended)})
+            "intended": bool(intended), "flops": flops})
 
     def _resolve_partial(self, op_idx, st: ValState, nbytes, src):
         """A partial value consumed by an op that cannot keep it
@@ -620,6 +632,75 @@ def _axes_of(entry) -> Tuple:
     if entry is None:
         return ()
     return tuple(entry) if isinstance(entry, tuple) else (entry,)
+
+
+# ----------------------------------------------------- static FLOP model
+
+def _numel(aval) -> int:
+    try:
+        return int(np.prod(tuple(getattr(aval, "shape", ())) or (1,)))
+    except Exception:
+        return 0
+
+
+def op_flops(name: str, attrs: Dict, in_avals, out_avals) -> int:
+    """Per-op static FLOP estimate of the FORWARD math — the compute
+    plane's rule-table companion to the comm pricing above: matmul /
+    linear cost 2·M·N·K, conv2d costs 2·|out|·C·R·S MAC-pairs,
+    reductions cost one op per input element, everything else one op
+    per output element (the XLA cost-analysis convention for
+    elementwise HLO). Cross-validated against ``cost_analysis()`` on
+    the bench models in tests — an estimator for ranking and the
+    no-false-clean static-diff gate, not an exact meter."""
+    outs = [a for a in out_avals if a is not None]
+    ins = [a for a in in_avals if a is not None]
+    out_n = sum(_numel(a) for a in outs)
+    if name in ("matmul", "linear") and len(ins) >= 2:
+        x = ins[0]
+        xs = tuple(getattr(x, "shape", ()))
+        if name == "matmul" and attrs.get("transpose_x") and len(xs) >= 2:
+            k = xs[-2]
+        else:
+            k = xs[-1] if xs else 1
+        return 2 * _numel(outs[0]) * int(k) if outs else 0
+    if name == "conv2d" and len(ins) >= 2:
+        w = tuple(getattr(ins[1], "shape", ()))
+        if len(w) >= 2 and outs:
+            recv = int(np.prod(w[1:]))      # C·R·S per output element
+            return 2 * _numel(outs[0]) * recv
+    if name == "bn_stats" and ins:
+        return 2 * _numel(ins[0])           # mean + var passes
+    # reduction shape (one output strictly smaller than its input):
+    # one combine op per input element
+    if len(outs) == 1 and ins and _numel(outs[0]) < _numel(ins[0]):
+        return _numel(ins[0])
+    return out_n
+
+
+def _op_in_avals(pending, in_avals, j):
+    """Resolve op j's input avals through the recorded wiring."""
+    out = []
+    for w in pending[j].wiring:
+        if w is None:
+            out.append(None)
+        elif w[0] == "in":
+            out.append(in_avals[w[1]])
+        else:
+            out.append(pending[w[1]].out_refs[w[2]].aval)
+    return out
+
+
+def segment_flops(pending, in_avals) -> int:
+    """Total static FLOPs of one recorded segment's forward math
+    (`in_avals` may be the concrete input payloads — only .shape is
+    read). The perf lint's cost axis: what ``budget --static-diff``
+    holds the measured ``compute.flops.*`` counters against."""
+    total = 0
+    for j, pop in enumerate(pending):
+        total += op_flops(pop.op.name, pop.attrs,
+                          _op_in_avals(pending, in_avals, j),
+                          [r.aval for r in pop.out_refs])
+    return total
 
 
 def _as_ambient(mesh):

@@ -51,16 +51,19 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
     use_batch = training and not use_global_stats
     if use_batch:
         mean, var = apply("bn_stats", x, fmt=data_format)
-        # update running stats out-of-graph
+        # update running stats IN-WINDOW: the update is pure
+        # elementwise state math, so it records into the ambient fusion
+        # window like any other op and set_value aliases the pending
+        # result onto the running-stat tensor (note_inplace semantics).
+        # The old form read `mean._value` here, which materialized the
+        # window EVERY BatchNorm layer — the eager-ResNet
+        # 53-syncs/step class BUDGET_r06 / the perf lint attributed to
+        # this line; the stats now land with the step's natural seal.
         from ..._core.autograd import no_grad
         with no_grad():
             m = momentum
-            running_mean._replace_value_inplace(
-                (m * running_mean._value +
-                 (1 - m) * mean._value.astype(running_mean._value.dtype)))
-            running_var._replace_value_inplace(
-                (m * running_var._value +
-                 (1 - m) * var._value.astype(running_var._value.dtype)))
+            running_mean.set_value(m * running_mean + (1.0 - m) * mean)
+            running_var.set_value(m * running_var + (1.0 - m) * var)
     else:
         mean, var = running_mean, running_var
     return apply("bn_apply", x, mean, var, weight, bias, eps=float(epsilon),

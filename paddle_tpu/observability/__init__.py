@@ -47,6 +47,29 @@ _flags.watch_flag("FLAGS_distributed_telemetry", _state.set_dist)
 _flags.watch_flag("FLAGS_memory_telemetry", _state.set_mem)
 
 
+def _on_compute_flag(on):
+    was = _state.COMPUTE
+    _state.set_compute(on)
+    if on and not was:
+        # ENTERING the compute plane re-keys the compiled-program
+        # caches (mesh-epoch salt): the next execution of each
+        # workload compiles exactly ONE fresh executable whose
+        # cost_analysis() and named-scope provenance are captured —
+        # a warm pre-plane cache would otherwise report zero FLOPs
+        # forever (analyses are captured at compile time only). Only
+        # when some runner was actually cached without cost capture
+        # (COST_STALE): a monitoring loop flipping the plane around
+        # each budget sample must not recompile the world per sample
+        # once the warm entries already carry their analyses.
+        from .._core import lazy
+        if lazy.COST_STALE:
+            lazy.bump_mesh_epoch()
+            lazy.COST_STALE = False
+
+
+_flags.watch_flag("FLAGS_compute_telemetry", _on_compute_flag)
+
+
 def enable(flight_recorder: bool = None):
     """Turn on metrics collection (and optionally the flight recorder)."""
     f = {"FLAGS_observability": True}
@@ -111,6 +134,11 @@ def stats(reset_after: bool = False) -> dict:
         # telemetry plane is on
         from . import memory as _memory
         snap["memory"] = _memory.summary()
+    if _state.COMPUTE:
+        # FLOP-domain headline (cost-analysis log + executed-FLOPs
+        # totals + the per-chip peak the MFU column divides by)
+        from . import compute as _compute
+        snap["compute"] = _compute.summary()
     if reset_after:
         reset()
     return snap

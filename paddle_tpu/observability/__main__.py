@@ -14,9 +14,14 @@ Modes:
   a ranked table (segment flush/compile/execute, sot::, optimizer::,
   comm::, io::, plus the unspanned **host gap**), the measurement that
   decides which hot-path item to burn next (observability/budget.py).
-  The memory telemetry plane rides along: the header carries per-step
-  byte columns (census peak watermark, compiled temp footprint from
-  cached memory_analysis, donated bytes per step).
+  The memory AND compute telemetry planes ride along: the header
+  carries per-step byte columns (census peak watermark, compiled temp
+  footprint, donated bytes per step) and the compute-efficiency
+  columns — achieved GFLOP/s, MFU against the per-chip peak
+  (FLAGS_device_peak_flops), and the roofline verdict (arithmetic
+  intensity vs the ridge point: compute-bound vs memory-bound); the
+  --json payload carries them as ``compute.mfu`` /
+  ``compute.flops_per_step`` / ``compute.arith_intensity``.
 - ``budget --distributed``: the cross-rank edition — spawns
   ``--nranks`` local trainer ranks over the distributed launcher, each
   publishing telemetry frames through a shared TCPStore while running
@@ -161,8 +166,11 @@ def _gpt2_step():
                                      (b, seq)).astype(np.int64))
 
     def one():
-        logits = model(x)
-        loss = crit(logits, y)
+        # one expression: a surviving grad-requiring `logits` local
+        # would route backward() to the generic engine instead of the
+        # fused fwd+vjp step — with the flash-attention record fix the
+        # GPT step now reaches its fused steady state
+        loss = crit(model(x), y)
         loss.backward()
         opt.step()
         opt.clear_grad()
@@ -211,7 +219,8 @@ KILL_STEP = int(os.environ.get("TELEM_KILL_STEP", "2"))
 paddle.set_flags({"FLAGS_observability": True,
                   "FLAGS_flight_recorder": True,
                   "FLAGS_distributed_telemetry": True,
-                  "FLAGS_memory_telemetry": True})
+                  "FLAGS_memory_telemetry": True,
+                  "FLAGS_compute_telemetry": True})
 if RANK == SLOW:
     delay = os.environ.get("TELEM_SLOW_DELAY", "0.05")
     paddle.set_flags({"FLAGS_fault_inject":          # @* = every step

@@ -17,6 +17,7 @@ TRACE = False     # profiler is recording: spans land in the host trace
 FLIGHT = False    # FLAGS_flight_recorder: ring-buffer event capture
 DIST = False      # FLAGS_distributed_telemetry: cross-rank frame plane
 MEM = False       # FLAGS_memory_telemetry: live-buffer census + bytes
+COMPUTE = False   # FLAGS_compute_telemetry: FLOPs accounting + MFU
 
 # The single gate hot paths read: any consumer on.
 ACTIVE = False
@@ -24,7 +25,7 @@ ACTIVE = False
 
 def recompute():
     global ACTIVE
-    ACTIVE = METRICS or TRACE or FLIGHT or DIST or MEM
+    ACTIVE = METRICS or TRACE or FLIGHT or DIST or MEM or COMPUTE
 
 
 def set_metrics(on: bool):
@@ -54,4 +55,10 @@ def set_dist(on: bool):
 def set_mem(on: bool):
     global MEM
     MEM = bool(on)
+    recompute()
+
+
+def set_compute(on: bool):
+    global COMPUTE
+    COMPUTE = bool(on)
     recompute()

@@ -43,21 +43,33 @@ def collect(run_fn: Callable[[], None], steps: int,
     rows of the ranked table then show residual (cache-miss) compiles
     only.
 
-    The memory telemetry plane is switched on for the whole run —
-    including the warmup, so the warmup compiles capture their
-    ``memory_analysis()`` — and the result gains a ``memory`` section:
-    peak bytes (census watermark over the measured window), the
-    steady-state compiled temp footprint (cached per-executable
-    analysis, no re-lowering), and donated bytes per step."""
+    The memory AND compute telemetry planes are switched on for the
+    whole run — including the warmup, so the warmup compiles capture
+    their ``memory_analysis()`` / ``cost_analysis()`` — and the result
+    gains a ``memory`` section (peak bytes, compiled temp footprint,
+    donated bytes per step) plus a ``compute`` section: per-step FLOPs
+    from the executed-runner counters, achieved GFLOP/s over the
+    measured wall window, MFU against the per-chip peak
+    (FLAGS_device_peak_flops, autodetected when 0), and the roofline
+    verdict (arithmetic intensity = flops / bytes-accessed vs the
+    ridge point) saying compute-bound vs memory-bound."""
     from . import enable, disable, stats
+    from . import compute as _comptel
     from . import memory as _memtel
     from .._core.flags import flag_value, set_flags
 
     mem_was = flag_value("FLAGS_memory_telemetry")
+    comp_was = flag_value("FLAGS_compute_telemetry")
+    planes = {}
     if not mem_was:
-        set_flags({"FLAGS_memory_telemetry": True})
+        planes["FLAGS_memory_telemetry"] = True
+    if not comp_was:
+        planes["FLAGS_compute_telemetry"] = True
+    if planes:
+        set_flags(planes)
     try:
         seq0 = _memtel.exec_seq()
+        cseq0 = _comptel.exec_seq()
         for _ in range(warmup):
             run_fn()
         was_on = flag_value("FLAGS_observability")
@@ -68,6 +80,9 @@ def collect(run_fn: Callable[[], None], steps: int,
         before = stats()
         _memtel.reset_peak()
         donated0 = _memtel.donated_bytes()
+        flops0 = _comptel.executed_flops()
+        cbytes0 = _comptel.executed_bytes()
+        calls0 = _comptel.COST_CALLS
         t0 = time.perf_counter()
         for _ in range(steps):
             run_fn()
@@ -77,12 +92,36 @@ def collect(run_fn: Callable[[], None], steps: int,
         live = _memtel.live_bytes()
         donated = _memtel.donated_bytes() - donated0
         execs = _memtel.executable_stats()
+        flops = _comptel.executed_flops() - flops0
+        cbytes = _comptel.executed_bytes() - cbytes0
+        cost_calls = _comptel.COST_CALLS - calls0
+        cexecs = [e for e in _comptel.executable_stats()
+                  if e.get("seq", 0) > cseq0]
+        peak_fl = _comptel.peak_flops()
         if not was_on:
             disable()
     finally:
+        restore = {}
         if not mem_was:
-            set_flags({"FLAGS_memory_telemetry": False})
+            restore["FLAGS_memory_telemetry"] = False
+        if not comp_was:
+            restore["FLAGS_compute_telemetry"] = False
+        if restore:
+            set_flags(restore)
     out = _rank(snap, wall_us, steps)
+    achieved = flops / (wall_us * 1e-6) if wall_us else 0.0
+    out["compute"] = {
+        "flops_per_step": round(flops / steps, 1),
+        "gflops_per_s": round(achieved / 1e9, 3),
+        "mfu": round(_comptel.mfu(achieved, peak_fl), 6),
+        "peak_flops": peak_fl,
+        # cost_analysis() calls DURING the measured window: a warm
+        # steady state makes ZERO (captured-once-per-compile contract,
+        # counter-asserted in tests and the bench row)
+        "cost_analysis_calls_measured": int(cost_calls),
+        **_comptel.roofline(flops, cbytes, peak=peak_fl),
+        "executables": cexecs[-6:],
+    }
     # prefer executables compiled DURING this collect (warmup included)
     # so another workload's entries in the process-global log can't
     # pollute the column; a fully-warm process (no new compiles — the
@@ -174,7 +213,7 @@ def _rank(snap: Dict, wall_us: float, steps: int) -> Dict:
                      if k.startswith(("segment.", "cache.", "compiles.",
                                       "optimizer.", "sot.", "eager.",
                                       "fusion.", "comm.", "memory.",
-                                      "io."))},
+                                      "compute.", "io."))},
         "step_cache_hit_rate": snap.get("step_cache_hit_rate"),
     }
 
@@ -242,6 +281,20 @@ def static_diff(step_fn: Callable[[], None], steps: int = 5) -> Dict:
                  "measured_per_step": round(meas_comm, 1),
                  "match": comm_match})
 
+    # static FLOP model vs the measured compute.flops.* counters: two
+    # different estimators price the same step (the static model counts
+    # forward op math, cost_analysis counts the fused fwd+vjp module),
+    # so the gate is the PR-11 no-false-clean form — static must not
+    # claim zero compute when the meters count some, and vice versa —
+    # not numeric equality
+    meas_flops = sum(v for k, v in counters.items()
+                     if k.startswith("compute.flops.")) / steps
+    flops_match = (rec.static_flops > 0) == (meas_flops > 0)
+    ok = ok and flops_match
+    rows.append({"class": "compute.flops", "static": rec.static_flops,
+                 "measured_per_step": round(meas_flops, 1),
+                 "match": flops_match})
+
     return {
         "ok": bool(ok),
         "steps_measured": steps,
@@ -291,6 +344,15 @@ def render(budget: Dict, title: str = "per-step budget") -> str:
             f"temp {_fmt_bytes(mem['temp_bytes'])} | "
             f"donated/step {_fmt_bytes(mem['donated_bytes_per_step'])} |"
             f" live(end) {_fmt_bytes(mem['live_bytes'])}")
+    comp = budget.get("compute")
+    if comp and comp.get("flops_per_step"):
+        bound = comp.get("bound") or "n/a"
+        lines.append(
+            f"  compute:        {comp['gflops_per_s']:.2f} GFLOP/s | "
+            f"MFU {comp['mfu'] * 100.0:.3f}% of "
+            f"{comp['peak_flops'] / 1e9:.0f} GFLOP/s peak | "
+            f"AI {comp['arith_intensity']:.2f} FLOP/B vs ridge "
+            f"{comp['ridge_intensity']:.2f} ({bound})")
     lines.append("  ranked components:")
     for e in budget["entries"]:
         calls = ("" if e["calls_per_step"] is None

@@ -145,6 +145,10 @@ class TelemetryPublisher:
         self._steps_since = 0
         self._last_counters: Dict[str, int] = {}
         self._last_hists: Dict[str, tuple] = {}
+        # (executed-FLOPs total, t_perf_us) at the last publication:
+        # the compute section ships per-frame deltas so rank 0 can put
+        # an MFU column next to the straggler flags
+        self._last_compute = None
         self._last_step_t: Optional[float] = None
         self._marks: List = []   # [step_index, end_us, dur_us]
         # retained for the offline dump; bounded so a long training
@@ -219,6 +223,30 @@ class TelemetryPublisher:
                             "peak": _memtel.peak_bytes(),
                             "donated": _memtel.donated_bytes(),
                             "census": _memtel.census_size()}
+        if _state.COMPUTE:
+            # FLOP-domain deltas: executed FLOPs since the last frame
+            # over the elapsed window -> this rank's achieved GFLOP/s
+            # and MFU against its OWN backend peak (each rank prices
+            # itself, so a heterogeneous pod stays honest). The step
+            # table's straggler column reads this to say "slow AND
+            # idle" vs "slow but saturated".
+            from . import compute as _comptel
+            flops = _comptel.executed_flops()
+            now_us = frame["t_perf_us"]
+            peak = _comptel.peak_flops()
+            comp = {"peak": peak}
+            if self._last_compute is not None:
+                d_flops = flops - self._last_compute[0]
+                dt_us = now_us - self._last_compute[1]
+                comp["flops"] = int(d_flops)
+                if dt_us > 0:
+                    ach = d_flops / (dt_us * 1e-6)
+                    comp["gflops"] = round(ach / 1e9, 3)
+                    comp["mfu"] = round(_comptel.mfu(ach, peak), 6)
+            else:
+                comp["flops"] = int(flops)
+            frame["compute"] = comp
+            self._last_compute = (flops, now_us)
         self._marks = []
         self.frames.append(frame)
         self._q.append(frame)        # drop-oldest: never blocks
@@ -535,6 +563,22 @@ class TelemetryAggregator:
                             for s in steps})
         rows = []
         strag_counts: Dict[int, int] = {}
+        # per-rank windowed MFU by frame step (the compute plane's
+        # frame section): lets a straggler flag say "slow AND idle"
+        # (its device is starving — chase input feed / host dispatch)
+        # vs "slow but saturated" (its device is busy — chase the work
+        # imbalance). Each frame's MFU covers the steps since the
+        # previous frame, so a step row reads the first frame at or
+        # after it — not the newest frame, which would stamp the
+        # end-of-run verdict onto every historical row.
+        mfu_frames: Dict[int, list] = {}
+        for r in self.ranks:
+            pts = sorted(
+                (int(f["step"]), f["compute"]["mfu"])
+                for f in self.frames(r)
+                if f.get("compute", {}).get("mfu") is not None)
+            if pts:
+                mfu_frames[int(r)] = pts
         for s in all_steps:
             durs = {r: steps[s]["dur_us"]
                     for r, steps in per_rank.items() if s in steps}
@@ -569,6 +613,17 @@ class TelemetryAggregator:
             if straggler is not None:
                 strag_counts[straggler] = \
                     strag_counts.get(straggler, 0) + 1
+            compute_verdict = None
+            if straggler is not None:
+                mfus = {r: next((m for st, m in mfu_frames[r]
+                                 if st >= s), mfu_frames[r][-1][1])
+                        for r in durs if r in mfu_frames}
+                if straggler in mfus and len(mfus) > 1:
+                    cvals2 = sorted(mfus.values())
+                    cmed = cvals2[(len(cvals2) - 1) // 2]
+                    compute_verdict = ("idle" if mfus[straggler]
+                                       < 0.6 * max(cmed, 1e-12)
+                                       else "saturated")
             # per-rank maps are string-keyed so the table survives a
             # json round trip (the CLI ships it between processes)
             rows.append({"step": s,
@@ -578,7 +633,8 @@ class TelemetryAggregator:
                          "max_us": round(mx, 1),
                          "skew_us": round(skew, 1),
                          "straggler": straggler,
-                         "straggler_via": via})
+                         "straggler_via": via,
+                         "straggler_compute": compute_verdict})
         # span-family skew: per rank us/step for each family, then
         # slowest-minus-median across ranks
         fam_rank: Dict[str, Dict[int, float]] = {}
@@ -611,8 +667,23 @@ class TelemetryAggregator:
         return {"ranks": self.ranks, "steps": rows,
                 "families": families,
                 "memory": self._memory_column(),
+                "compute": self._compute_column(),
                 "straggler_counts": {str(r): n for r, n in
                                      strag_counts.items()}}
+
+    def _compute_column(self) -> Optional[Dict]:
+        """Per-rank achieved GFLOP/s + MFU from the newest frame that
+        carried a ``compute`` section (FLAGS_compute_telemetry on that
+        rank) — the per-chip-MFU acceptance column the pod-scale
+        ROADMAP item grades against."""
+        col: Dict[str, Dict] = {}
+        for r in self.ranks:
+            for frame in reversed(self.frames(r)):
+                c = frame.get("compute")
+                if c:
+                    col[str(r)] = c
+                    break
+        return {"ranks": col} if col else None
 
     def _memory_column(self) -> Optional[Dict]:
         """Per-rank byte watermark from the newest frame that carried a
@@ -909,7 +980,10 @@ def render_step_table(table: Dict) -> str:
         flag = "-"
         if row["straggler"] is not None:
             via = row.get("straggler_via")
-            flag = f"r{row['straggler']}" + (f" ({via})" if via else "")
+            verdict = row.get("straggler_compute")
+            detail = ", ".join(x for x in (via, verdict) if x)
+            flag = f"r{row['straggler']}" \
+                + (f" ({detail})" if detail else "")
         lines.append(f"  {row['step']:>4} | {cells} | "
                      f"{row['median_us'] / 1000.0:6.2f} | "
                      f"{row['skew_us'] / 1000.0:5.2f} | {flag}")
@@ -934,6 +1008,13 @@ def render_step_table(table: Dict) -> str:
         else:
             tail = f"highest peak: r{near} (no FLAGS_memory_budget_bytes)"
         lines.append(f"  per-rank peak memory: {cells}  [{tail}]")
+    if table.get("compute"):
+        comp = table["compute"]
+        cells = "  ".join(
+            f"r{r}={comp['ranks'][str(r)].get('mfu', 0) * 100.0:.3f}%"
+            f"/{comp['ranks'][str(r)].get('gflops', 0):.1f}GF"
+            for r in ranks if str(r) in comp["ranks"])
+        lines.append(f"  per-rank MFU / achieved GFLOP/s: {cells}")
     if table["straggler_counts"]:
         lines.append(f"  straggler flags: "
                      + ", ".join(f"r{r}x{n}" for r, n in

@@ -345,23 +345,39 @@ def note_executable(stat: str, key, info: Dict):
 
 
 def aot_compile(jitted, args, kwargs: Optional[Dict] = None,
-                stat: str = "segment", cache=None, key=None):
+                stat: str = "segment", cache=None, key=None,
+                n_devices: int = 1):
     """Compile a jitted callable through the AOT path so the Compiled
     executable (donation baked in) doubles as the cached runner AND its
-    memory analysis is captured exactly once — a later cache hit runs
-    the same executable with zero analysis work. Returns a runner
-    callable with the same concrete-array arguments (the executable
-    cache key already pins the input signature); tracer arguments on a
-    later call fall back to the jit wrapper, because a Compiled object
-    cannot inline into an enclosing jax trace — and the cached runner
-    outlives the telemetry session."""
+    analyses are captured exactly once per compile — a later cache hit
+    runs the same executable with zero analysis work. One
+    ``lower().compile()`` serves BOTH telemetry planes: the memory
+    plane's ``memory_analysis()`` (``_state.MEM``) and the compute
+    plane's ``cost_analysis()`` + HLO source provenance
+    (``_state.COMPUTE``) — callers gate on either being on. Returns a
+    runner callable with the same concrete-array arguments (the
+    executable cache key already pins the input signature); tracer
+    arguments on a later call fall back to the jit wrapper, because a
+    Compiled object cannot inline into an enclosing jax trace — and
+    the cached runner outlives the telemetry session."""
     import jax
     compiled = jitted.lower(*args, **(kwargs or {})).compile()
-    info = analyze(compiled)
-    note_executable(stat, key, info)
-    if cache is not None and key is not None \
-            and hasattr(cache, "note_memory"):
-        cache.note_memory(key, info)
+    info = None
+    if _state.MEM:
+        info = analyze(compiled)
+        note_executable(stat, key, info)
+        if cache is not None and key is not None \
+                and hasattr(cache, "note_memory"):
+            cache.note_memory(key, info)
+    cinfo = None
+    if _state.COMPUTE:
+        from . import compute as _comptel
+        cinfo = _comptel.analyze(compiled, n_devices)
+        _comptel.note_executable(stat, key, cinfo)
+        if cache is not None and key is not None \
+                and hasattr(cache, "note_cost"):
+            cache.note_cost(key, cinfo)
+        _comptel.note_provenance(compiled)
 
     def runner(*vals, _compiled=compiled, _jitted=jitted,
                _kw=dict(kwargs or {}), _tracer=jax.core.Tracer):
@@ -373,6 +389,7 @@ def aot_compile(jitted, args, kwargs: Optional[Dict] = None,
         return _compiled(*vals)
 
     runner.memory_analysis_info = info
+    runner.cost_analysis_info = cinfo
     return runner
 
 

@@ -32,8 +32,22 @@ def _no_x64():
     """Trace pallas kernels with x64 OFF: the framework enables
     jax_enable_x64 globally (paddle int64 parity), but int64 scalars in
     Mosaic kernels hit an infinite convert_element_type recursion in the
-    TPU lowering. Kernel math is int32/fp32/bf16 regardless."""
-    return jax.enable_x64(False)
+    TPU lowering. Kernel math is int32/fp32/bf16 regardless.
+
+    Toolchains without the scoped ``jax.enable_x64`` override (it
+    landed in newer jax) run WITHOUT the toggle: the old
+    ``jax.experimental`` context manager only scopes trace-time dtype
+    decisions while interpret-mode lowering happens later outside it
+    (mixed i64/i32 loop carries -> verifier errors), and the kernels
+    pin every dtype explicitly anyway, so x64 mode changes nothing
+    they compute. This is also what lets ``flash_attention`` RECORD
+    into the fusion window on such toolchains — the old AttributeError
+    at record-time aval inference was the eager-GPT 4-breaks/step
+    ``record_fallback`` class the perf lint attributed here."""
+    if hasattr(jax, "enable_x64"):
+        return jax.enable_x64(False)
+    import contextlib
+    return contextlib.nullcontext()
 
 
 def _block_sizes(sq: int, sk: int, d: int):

@@ -158,7 +158,7 @@ class Optimizer:
                     new_p, new_s = self._run_spmd(
                         _lazy.SPMD, fn is self._jit_update, pvals,
                         gvals, states, lr, t, wds, lr_mults)
-                elif _OBS.MEM:
+                elif _OBS.MEM or _OBS.COMPUTE:
                     new_p, new_s = self._run_analyzed(
                         fn, pvals, gvals, states, lr, t, wds, lr_mults)
                 else:
@@ -251,10 +251,13 @@ class Optimizer:
             if _OBS.METRICS:
                 from ..observability import metrics
                 metrics.inc("compiles.spmd")
-            if _OBS.MEM:
+            if not _OBS.COMPUTE:
+                _lazy.mark_cost_stale()
+            if _OBS.MEM or _OBS.COMPUTE:
                 from ..observability import memory as _memtel
-                runner = _memtel.aot_compile(runner, args,
-                                             stat="optimizer", key=sig)
+                runner = _memtel.aot_compile(
+                    runner, args, stat="optimizer", key=sig,
+                    n_devices=_lazy._mesh_devices(spmd))
             # compiled-comm estimate: an output replicated over an axis
             # that shards a state input is the ZeRO all-gather
             est = spmd.estimate_bytes(
@@ -267,24 +270,36 @@ class Optimizer:
         if est and _OBS.METRICS:
             from ..observability import metrics
             metrics.inc("comm.bytes.compiled.optimizer", est)
+        if _OBS.COMPUTE:
+            from ..observability import compute as _comptel
+            _comptel.note_execution(
+                getattr(runner, "cost_analysis_info", None), "optimizer")
         return runner(pvals, gvals, states, lr, t)
 
     def _run_analyzed(self, fn, pvals, gvals, states, lr, t, wds,
                       lr_mults):
-        """Memory-telemetry path (FLAGS_memory_telemetry): run the
-        fused update through an AOT-compiled executable so its
-        ``memory_analysis()`` is captured exactly once per (donation,
-        signature) — the fused optimizer is the third compile site the
-        byte plane covers. Behavior is identical to calling the jitted
-        `fn`; the compiled object is cached per signature."""
+        """Telemetry path (FLAGS_memory_telemetry and/or
+        FLAGS_compute_telemetry): run the fused update through an
+        AOT-compiled executable so its ``memory_analysis()`` /
+        ``cost_analysis()`` are captured exactly once per (donation,
+        signature) — the fused optimizer is the third compile site
+        both planes cover. Behavior is identical to calling the jitted
+        `fn`; the compiled object is cached per signature and every
+        execution prices its cached FLOPs."""
         from ..observability import memory as _memtel
         leaves, treedef = jax.tree_util.tree_flatten(
             (pvals, gvals, states, lr, t))
+        # MESH_EPOCH salt: entering the compute plane bumps the epoch
+        # so a warm pre-plane entry (no captured analyses) re-keys and
+        # the next step compiles one fresh, analyzed executable
         sig = (fn is self._jit_update, wds, lr_mults, str(treedef),
-               tuple((tuple(v.shape), str(v.dtype)) for v in leaves))
+               tuple((tuple(v.shape), str(v.dtype)) for v in leaves),
+               _lazy.MESH_EPOCH)
         cache = self.__dict__.setdefault("_aot_updates", {})
         compiled = cache.get(sig)
         if compiled is None:
+            if not _OBS.COMPUTE:
+                _lazy.mark_cost_stale()
             compiled = _memtel.aot_compile(
                 fn, (pvals, gvals, states, lr, t),
                 kwargs={"wds": wds, "lr_mults": lr_mults},
@@ -292,6 +307,11 @@ class Optimizer:
             if len(cache) > 8:     # param-group churn guard
                 cache.clear()
             cache[sig] = compiled
+        if _OBS.COMPUTE:
+            from ..observability import compute as _comptel
+            _comptel.note_execution(
+                getattr(compiled, "cost_analysis_info", None),
+                "optimizer")
         return compiled(pvals, gvals, states, lr, t)
 
     def _pick_update(self, pvals, gvals, states):

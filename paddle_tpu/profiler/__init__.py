@@ -137,16 +137,23 @@ def _add_span_event(name: str, ts_us: float, dur_us: float, args=None):
     _append_event(ev)
 
 
-def _add_counter_event(name: str, value):
+def _add_counter_event(name: str, value, key: str = "bytes"):
     """Chrome counter-track sample (ph='C') — the memory telemetry
-    plane feeds memory.live_bytes here on census changes while a
-    profiler records, so the trace shows the byte watermark as a
-    counter lane alongside the runtime spans."""
+    plane feeds memory.live_bytes here on census changes, and the
+    compute plane feeds achieved GFLOP/s per execution, while a
+    profiler records: the trace shows the byte watermark and the
+    FLOP-rate as counter lanes alongside the runtime spans."""
     if not _recording:
         return
     _append_event({"name": name, "tid": _tid(), "ph": "C",
                    "ts": time.perf_counter_ns() / 1000.0,
-                   "cat": "runtime", "args": {"bytes": int(value)}})
+                   "cat": "runtime",
+                   # byte counters stay integral; rate counters (the
+                   # GFLOP/s lane) keep their fraction — int() would
+                   # flatline any rate under 1 GFLOP/s (every CPU-box
+                   # bench model) to a constant 0
+                   "args": {key: int(value) if key == "bytes"
+                            else round(float(value), 4)}})
 
 
 class RecordEvent:
@@ -405,6 +412,26 @@ class Profiler:
         return dict(sorted(agg.items(),
                            key=lambda kv: -kv[1]["total_us"]))
 
+    def _source_of(self, name: str):
+        """paddle ``op@file:line`` provenance for one device event, or
+        None — resolved through the compute plane's HLO-instruction map
+        (populated at segment compile while FLAGS_compute_telemetry is
+        on: each recorded op's lowering is wrapped in a named_scope
+        carrying its recording source line)."""
+        from ..observability import compute as _comptel
+        return _comptel.source_of(name)
+
+    def source_summary(self, sorted_by=None, time_unit="ms"):
+        """The statistic table over DEVICE events grouped by paddle
+        source provenance: device time attributed to the
+        ``op@file:line`` that recorded the op (unattributed kernels
+        keep their raw HLO name). Closes the loop from the perf lint's
+        "this line breaks the window" to "this line spends the device
+        time"."""
+        evs = [dict(e, name=self._source_of(e["name"]) or e["name"])
+               for e in self.device_events()]
+        return _summary(evs, sorted_by=sorted_by, time_unit=time_unit)
+
     def export(self, path: str, format: str = "json"):
         pid = os.getpid()
         trace_events = [
@@ -418,7 +445,12 @@ class Profiler:
         ] + [
             {"name": e["name"], "ph": "X", "pid": pid,
              "tid": e["tid"], "ts": e["ts"], "dur": e["dur"],
-             "cat": "device"}
+             "cat": "device",
+             # paddle source provenance (op@file:line from the compute
+             # plane's named-scope HLO map) rides the exported event so
+             # the chrome trace groups device time by recording line
+             **({"args": {"src": src}} if (src := self._source_of(
+                 e["name"])) else {})}
             for e in self.device_events()
         ]
         # name the interned host-thread lanes so two python threads are

@@ -49,22 +49,46 @@ def _bn_model():
     return step
 
 
-def test_bn_running_stat_sync_class_deduped():
-    """The eager-ResNet finding, miniature: each train-mode BatchNorm
-    materializes the window for its running-stat update. The two BN
-    layers' syncs share one source line, so they dedupe into ONE
-    host_sync diagnostic with count=2, the framework site naming
-    nn/functional/norm.py and the user site naming THIS file."""
+def test_bn_running_stat_update_stays_in_window():
+    """The eager-ResNet 53-syncs/step class is GONE: train-mode
+    BatchNorm running stats update as in-window elementwise state
+    math (nn/functional/norm.py set_value aliases the pending
+    result), so the miniature BN train step seals once at backward
+    with zero host syncs."""
     report, counts, rec = analysis.trace_step(_bn_model())
+    assert counts.get("materialize") is None, counts
+    assert counts.get("backward_fused") == 1, counts
+    assert not report.by_checker("host_sync"), report.render()
+    assert rec.sync_count() == 0 and rec.break_count() == 0
+
+
+def test_host_sync_class_deduped():
+    """Host syncs issued from ONE source line dedupe into a single
+    host_sync diagnostic carrying the count — the shape the BN class
+    had before it moved in-window, seeded here with an explicit
+    mid-step read so the dedup machinery stays covered."""
+    x = paddle.to_tensor(np.ones((4, 4), "float32"))
+
+    def peek(t):
+        np.asarray(t._value)       # the shared sync source line
+
+    def step():
+        # both mid-step reads issue from peek's ONE source line; the
+        # trace harness seals the step boundary itself
+        y = x * 1.1
+        peek(y)
+        z = y + 1.0
+        peek(z)
+
+    report, counts, rec = analysis.trace_step(step)
     assert counts.get("materialize") == 2, counts
     syncs = report.by_checker("host_sync")
     assert len(syncs) == 1, report.render()
     d = syncs[0]
     assert d.severity == "perf"
     assert d.data["count"] == 2
-    assert "norm.py" in d.data["framework_src"]
     assert d.provenance and "test_perf_analysis.py" in d.provenance
-    assert rec.sync_count() == 2 and rec.break_count() == 0
+    assert rec.sync_count() >= 2 and rec.break_count() == 0
 
 
 def test_record_fallback_break_attributed():
