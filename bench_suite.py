@@ -93,6 +93,22 @@ Row 14 compute telemetry plane  asserts the compute-telemetry-off path
                                 up-good units so efficiency regressions
                                 gate mechanically)
 
+Row 15 mem static analyzer gate  runs `python -m paddle_tpu.analysis
+                                --mem --json` (per-device train-step
+                                peak priced at pod shapes {1x1, 4x2,
+                                2x2x2} via static liveness — no
+                                compile; subprocess rc gates the row)
+                                under a 2MB/device planning budget so
+                                the oom_risk verdicts stay live, and
+                                asserts budget.static_diff's
+                                memory.peak row reconciles the
+                                liveness prediction with the measured
+                                census watermark; the oom_risk count
+                                is a 'findings' row (--diff zero
+                                tolerance, matching row 13) and the
+                                per-shape static totals ride as byte
+                                rows (down-good)
+
 (Multi-chip GPT/ERNIE hybrids need a pod; their single-chip proxies are
 bench.py's headline + the dryrun_multichip compile check.)
 
@@ -1151,6 +1167,80 @@ def bench_compute():
                       "unit": "gflops"}]}
 
 
+def bench_mem_lint():
+    """Row 15: the mem static analyzer as a mechanical regression gate,
+    the row-13 pattern in the BYTE domain. The --mem CLI records the
+    bench models and prices the per-device train-step peak at the
+    candidate pod shapes ({1x1, 4x2, 2x2x2}) in a subprocess — its
+    exit code gates the row, and a planning budget is set so the
+    oom_risk machinery is LIVE (a model that stops fitting its
+    historical shape produces a new finding). The oom_risk count rides
+    as a 'findings' row: --diff treats ANY increase as a regression
+    (zero tolerance, matching row 13); the static per-device totals
+    ride as byte rows (down-good) so footprint growth gates too.
+    In-process, budget.static_diff proves the liveness prediction
+    reconciles with the measured census watermark (the memory.peak
+    no-false-clean row)."""
+    import subprocess
+    import sys
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    # a 2 MB/device planning budget: lenet fits every shape (0
+    # findings), gpt2-mini's activation-heavy step fits none (3) —
+    # both verdict classes stay exercised, so the gate can neither rot
+    # into always-clean nor mask a model growing past its shape
+    env["FLAGS_memory_budget_bytes"] = str(2 << 20)
+    out = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.analysis", "--mem",
+         "--json"],
+        capture_output=True, text=True, env=env, timeout=1800)
+    lines = [ln for ln in out.stdout.splitlines() if ln.startswith("{")]
+    if out.returncode != 0 or not lines:
+        raise RuntimeError(
+            f"analysis --mem failed rc={out.returncode}: "
+            f"{out.stderr[-2000:]}")
+    payload = json.loads(lines[-1])
+
+    def shape_total(model, shape):
+        for d in payload["models"].get(model, ()):
+            for r in d["rows"]:
+                if r["shape"] == shape:
+                    return r["total_pd_bytes"]
+        # a missing model/shape must FAIL the row, not feed a 0-byte
+        # "improvement" into the down-good --diff gate
+        raise RuntimeError(
+            f"--mem payload missing {model} @ {shape}: "
+            f"{sorted(payload['models'])}")
+
+    # static-vs-measured reconciliation (memory.peak row) in-process
+    from paddle_tpu.observability import budget
+    from paddle_tpu.observability.__main__ import _lenet_step
+    sd = budget.static_diff(_lenet_step(), steps=3)
+    peak_rows = [r for r in sd["rows"] if r["class"] == "memory.peak"]
+    assert peak_rows and peak_rows[0]["match"], \
+        f"static liveness peak diverges from the byte plane: {sd}"
+    assert sd["ok"], f"static-diff failed: {sd}"
+
+    rows = [
+        {"metric": "mem lint per-device step total "
+                   "(lenet @ dp4xmp2 static plan)",
+         "value": shape_total("lenet", [4, 2]), "unit": "bytes"},
+        {"metric": "mem lint per-device step total "
+                   "(gpt2-mini @ dp2xmp2xpp2 static plan)",
+         "value": shape_total("gpt2-mini", [2, 2, 2]),
+         "unit": "bytes"},
+    ]
+    return {"metric": "mem static analyzer gate (oom_risk findings on "
+                      "the bench models' pod-shape sweep, 2MB/device "
+                      "planning budget; memory.peak static-diff "
+                      "reconciled)",
+            "value": payload["oom_risk"],
+            "unit": "findings",
+            "budget_bytes": payload["budget_bytes"],
+            "static_diff_ok": bool(sd["ok"]),
+            "rows": rows}
+
+
 # ------------------------------------------------------------- diff mode
 
 def _rows_of(path: str) -> dict:
@@ -1260,15 +1350,16 @@ def main():
         i = sys.argv.index("--spmd-dryrun")
         _spmd_dryrun_worker(int(sys.argv[i + 1]))
         return
-    rows = os.environ.get("BENCH_ROWS",
-                          "1,2,3,4,5,6,7,8,9,10,11,12,13,14").split(",")
+    rows = os.environ.get(
+        "BENCH_ROWS",
+        "1,2,3,4,5,6,7,8,9,10,11,12,13,14,15").split(",")
     table = {"1": bench_lenet, "2": bench_resnet50, "3": bench_bert,
              "4": bench_dispatch, "5": bench_static_checks,
              "6": bench_observability, "7": bench_resilience,
              "8": bench_replan, "9": bench_async_flush,
              "10": bench_telemetry, "11": bench_memory,
              "12": bench_spmd_multichip, "13": bench_perf_lint,
-             "14": bench_compute}
+             "14": bench_compute, "15": bench_mem_lint}
     for r in rows:
         r = r.strip()
         out = table[r]()

@@ -53,8 +53,11 @@ from .perf_checks import PerfRecorder, trace_step
 from .perf_checks import check_perf as _check_perf_impl
 from .sharding_prop import propagate as propagate_specs
 from .sharding_prop import check_sharding as _check_sharding_impl
+from .mem_liveness import (CandidateMesh, analyze_liveness,
+                           check_memory, plan_pod_shape,
+                           step_footprint, sweep_pod_shapes)
 from . import alias_graph, dataflow, distributed_checks, fixes, hooks, \
-    perf_checks, sharding_prop, sot_checks
+    mem_liveness, perf_checks, sharding_prop, sot_checks
 
 __all__ = [
     "CheckReport", "Diagnostic", "StaticCheckError",
@@ -65,6 +68,8 @@ __all__ = [
     "check_cross_segment_donation", "check_view_aliases",
     "check_dead_captures", "fix_segment", "check_perf",
     "check_sharding", "propagate_specs", "PerfRecorder", "trace_step",
+    "analyze_liveness", "check_memory", "step_footprint",
+    "sweep_pod_shapes", "plan_pod_shape", "CandidateMesh",
 ]
 
 

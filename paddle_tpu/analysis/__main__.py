@@ -6,6 +6,8 @@
     python -m paddle_tpu.analysis --perf
         [--models gpt2-eager,resnet50-eager,lenet-sharded,tp-sharded]
         [--json]
+    python -m paddle_tpu.analysis --mem
+        [--mesh dp,mp[,pp]] [--models lenet,gpt2-mini] [--json]
 
 Default is record-only: each model's forward(+loss) is RECORDED into a
 lazy capture window (aval inference, no XLA compile/run), the segment
@@ -32,6 +34,16 @@ round trips, comm-hotspot ranking). Needs ≥4 devices for the dryrun
 mesh — on a single-device host the CLI re-execs itself with 8 forced
 CPU devices. Perf findings are expected (exit 0 reports them; the
 bench_suite --diff gate compares their COUNTS across rounds).
+
+``--mem`` switches to the MEM lint (analysis/mem_liveness.py): each
+bench model's forward+loss is recorded (aval inference only) and the
+full train-step per-device footprint — liveness peak + optimizer
+state + compiled-temp estimate — is priced at candidate pod shapes
+(default dp×mp ∈ {1×1, 4×2, 2×2×2}; ``--mesh 4,2`` picks one) via
+`CandidateMesh`, i.e. WITHOUT compiling and on a host that cannot
+build the mesh. With FLAGS_memory_budget_bytes set, shapes that do
+not fit carry ``oom_risk`` findings (bench row 15 gates their count
+with zero tolerance). Exit 0 reports findings, like --perf.
 """
 from __future__ import annotations
 
@@ -513,6 +525,136 @@ _PERF_DEFAULT_MODELS = "gpt2-eager,resnet50-eager,lenet-sharded," \
                        "tp-sharded"
 
 
+# ------------------------------------------------------------- mem lint
+
+# the acceptance sweep: pure data-parallel, the dp×mp pod slice, and a
+# 3D dp×mp×pp shape — all priced WITHOUT compiling, on any host
+_MEM_DEFAULT_SHAPES = ((1, 1), (4, 2), (2, 2, 2))
+
+
+def _mem_record_and_sweep(build_fn, name: str, shapes, optimizer: str,
+                          verbose: bool):
+    """Record one model's forward+loss into a capture window (aval
+    inference only — no compile, no devices) and price the full
+    train-step footprint at every candidate pod shape."""
+    from paddle_tpu import analysis
+    from paddle_tpu._core import lazy
+    from paddle_tpu.analysis.mem_liveness import render_sweep
+
+    lazy.PERF_SRC += 1      # top-buffer rows carry file:line provenance
+    try:
+        with lazy.lazy_guard(max_segment_ops=1 << 30) as ctx:
+            out = build_fn()    # root held alive through the sweep
+            n_ops = len(ctx.pending)
+            rows = analysis.sweep_pod_shapes(ctx, shapes=shapes,
+                                             optimizer=optimizer)
+            ctx._reset_segment()
+    finally:
+        lazy.PERF_SRC -= 1
+    oom = sum(r["oom_risk"] for r in rows)
+    print(f"[{name}] mem lint: {n_ops} ops recorded, "
+          f"{len(rows)} pod shape(s) priced, {oom} oom_risk finding(s)")
+    print(render_sweep(rows, title=f"{name}: per-device peak by pod "
+                                   f"shape ({optimizer} step)"))
+    if verbose:
+        for r in rows:
+            for t in r["top"]:
+                print(f"    {r['mesh']}: {t['pd_bytes']} B/dev "
+                      f"{t['kind']} {t['dtype']}{t['shape']}"
+                      + (f" @ {t['src']}" if t.get("src") else ""))
+    d = {"n_ops": n_ops, "rows": rows, "oom_risk": oom}
+    _JSON["models"].setdefault(name, []).append(d)
+    return d
+
+
+def mem_lenet(shapes, verbose: bool):
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.vision.models import LeNet
+
+    paddle.seed(0)
+    model = LeNet()
+    r = np.random.RandomState(0)
+    x = paddle.to_tensor(r.randn(8, 1, 28, 28).astype("float32"))
+    y = paddle.to_tensor(r.randint(0, 10, (8,)).astype("int64"))
+    return _mem_record_and_sweep(
+        lambda: F.cross_entropy(model(x), y), "lenet", shapes, "adam",
+        verbose)
+
+
+def mem_gpt2(shapes, verbose: bool):
+    """Miniature eager GPT (the pod-planning shape class that actually
+    needs mp: embedding + attention + mlp weights shard on the model
+    axis under the TP assumption)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import (GPTConfig, GPTForPretraining,
+                                       GPTPretrainingCriterion)
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=512, hidden_size=64, num_layers=2,
+                    num_heads=4, dtype="float32",
+                    use_flash_attention=False,
+                    max_position_embeddings=32)
+    model = GPTForPretraining(cfg)
+    crit = GPTPretrainingCriterion()
+    r = np.random.RandomState(0)
+    x = paddle.to_tensor(r.randint(0, 512, (8, 32)).astype("int64"))
+    y = paddle.to_tensor(r.randint(0, 512, (8, 32)).astype("int64"))
+    return _mem_record_and_sweep(
+        lambda: crit(model(x), y), "gpt2-mini", shapes, "adamw",
+        verbose)
+
+
+_MEM_TABLE = {"lenet": mem_lenet, "gpt2-mini": mem_gpt2}
+
+
+def _parse_mesh(spec: str):
+    try:
+        shape = tuple(int(s) for s in spec.replace("x", ",").split(",")
+                      if s.strip())
+    except ValueError:
+        shape = ()
+    if not shape or len(shape) > 3 or any(s < 1 for s in shape):
+        raise SystemExit(
+            f"--mesh {spec!r}: expected dp,mp[,pp] positive degrees "
+            f"(e.g. --mesh 4,2)")
+    return shape
+
+
+def _mem_main(args) -> int:
+    import paddle_tpu as paddle  # noqa: F401 (backend init)
+    _JSON["models"] = {}
+    shapes = [_parse_mesh(args.mesh)] if args.mesh \
+        else list(_MEM_DEFAULT_SHAPES)
+    models = args.models if args.models is not None \
+        else ",".join(_MEM_TABLE)
+    results = []
+    for m in models.split(","):
+        m = m.strip()
+        if not m:
+            continue
+        if m not in _MEM_TABLE:
+            print(f"unknown mem model '{m}' (have: {sorted(_MEM_TABLE)})")
+            return 2
+        results.append(_MEM_TABLE[m](shapes, args.verbose))
+    from paddle_tpu._core.flags import flag_value
+    total_oom = sum(d["oom_risk"] for d in results)
+    budget = int(flag_value("FLAGS_memory_budget_bytes"))
+    print(f"== mem lint: {len(shapes)} pod shape(s) x "
+          f"{len(results)} model(s), {total_oom} oom_risk finding(s)"
+          + (f" against a {budget} B/device budget" if budget
+             else " (no FLAGS_memory_budget_bytes set — sweep is "
+                  "informational)"))
+    if args.json:
+        print(json.dumps({"oom_risk": total_oom,
+                          "budget_bytes": budget,
+                          "shapes": [list(s) for s in shapes],
+                          "models": _JSON["models"]}))
+    return 0
+
+
 def _maybe_reexec_for_devices(argv) -> int:
     """--perf wants the dryrun dp×mp mesh (≥4 devices). On a
     single-device host, re-exec with 8 forced CPU devices BEFORE jax
@@ -584,6 +726,16 @@ def main(argv=None) -> int:
                          "models for fusion-window breaks / host syncs "
                          "and sweep the sharded models' PartitionSpec "
                          "propagation on a dryrun dp×mp mesh")
+    ap.add_argument("--mem", action="store_true",
+                    help="mem lint: record the bench models and price "
+                         "the per-device train-step peak at candidate "
+                         "pod shapes (static liveness — no compile, no "
+                         "devices); oom_risk findings gate against "
+                         "FLAGS_memory_budget_bytes")
+    ap.add_argument("--mesh", default=None, metavar="DP,MP[,PP]",
+                    help="restrict the --mem sweep to one candidate "
+                         "shape (e.g. --mesh 4,2); default sweeps "
+                         "1x1, 4x2 and 2x2x2")
     ap.add_argument("--execute", action="store_true",
                     help="also flush/execute each recorded segment")
     ap.add_argument("--verbose", action="store_true",
@@ -600,6 +752,8 @@ def main(argv=None) -> int:
 
     if args.perf:
         return _perf_main(args, raw_argv)
+    if args.mem:
+        return _mem_main(args)
 
     global _FIX
     _FIX = bool(args.fix)

@@ -280,13 +280,22 @@ _IS_FRAMEWORK_FILE: dict = {}   # co_filename -> bool (abspath memo)
 def call_site() -> Optional[str]:
     """'file:line' of the first user frame below the framework — the
     Python source provenance a record-time diagnostic points at.
-    Runs per recorded op in warn/error mode, hence the filename memo."""
+    Stdlib frames (runpy bootstrapping a -m CLI, threading glue) are
+    plumbing, never the user source: a CLI-driven trace gets None
+    rather than a misleading 'runpy.py:86'. Runs per recorded op in
+    warn/error mode, hence the filename memo."""
     f = sys._getframe(1)
     while f is not None:
         fname = f.f_code.co_filename
         fw = _IS_FRAMEWORK_FILE.get(fname)
         if fw is None:
-            fw = os.path.abspath(fname).startswith(_PKG_DIR)
+            ap = os.path.abspath(fname)
+            # "<frozen runpy>"-style bootstrap frames are plumbing;
+            # "<stdin>"/"<string>" stay USER frames — an interactive
+            # session's diagnostics keep their source pointer
+            fw = ap.startswith(_PKG_DIR) \
+                or ap.startswith(_STDLIB_DIR) \
+                or fname.startswith("<frozen")
             _IS_FRAMEWORK_FILE[fname] = fw
         if not fw:
             return f"{fname}:{f.f_lineno}"

@@ -1,0 +1,730 @@
+"""Static per-device peak-HBM liveness analyzer and pod-shape planner.
+
+The byte-domain twin of the perf lint (perf_checks/sharding_prop): the
+ROADMAP's pod-scale items (3D parallelism, serving admission,
+distributed linalg) all start from one question — *does this shape fit
+in HBM?* — and until now the only answers came from running (the PR-9
+census watermark) or compiling (per-executable ``memory_analysis``),
+neither of which works for a pod shape this box cannot execute. The
+TPU-pod scaling recipes (1909.09756, 2011.03641) pick the parallelism
+plan from per-chip MEMORY, not FLOPs; this module answers statically,
+from the recorded program alone:
+
+- **liveness pass** (:func:`analyze_liveness`): abstract interpretation
+  over `_PendingOp` dataflow assigns every buffer a birth/death
+  interval — inputs live from t=0 to their last read when DONATED
+  (the flush donation mask frees them), to the program boundary
+  otherwise; intermediates live from their producing op to their last
+  consumer (live outputs to the boundary); outputs of the view-op
+  family (`alias_graph.VIEW_OP_NAMES` — XLA aliases them onto their
+  base inside a compiled program) cost zero bytes and extend the
+  base's lifetime instead; duplicate registrations of one payload
+  (the `note_inplace` re-registration pattern) are counted once.
+  Under a train-shaped program (`needs_grad`) the fused fwd+vjp
+  structure is modeled on a mirrored 2n-step timeline: op j's vjp runs
+  at ``2n-1-j``, so residuals saved by op j (its inputs and outputs)
+  stay live through it — the classic all-residuals-live peak at the
+  fwd/bwd boundary — cotangents live from their producing backward
+  step to their consuming one, and parameter gradients are born at
+  their first backward contribution and live out.
+- **per-device pricing**: every interval is priced at its SHARD size
+  on an arbitrary candidate mesh by running the `sharding_prop`
+  PartitionSpec propagation and dividing each buffer by the product of
+  its sharded axes' degrees. :class:`CandidateMesh` stands in for
+  meshes this host cannot build (a dp4×mp2 pod on a laptop): it
+  carries only (axes, shape, assumed input specs) — no jax devices,
+  no compile. A ``pp`` axis is a STAGE split, not a tensor sharding:
+  the op list is partitioned into contiguous stages and the per-device
+  peak is the worst stage's local peak.
+- **full train-step footprint** (:func:`step_footprint`): liveness
+  peak (params + activations + cotangents + grads) + optimizer
+  moments/master (sized from the grad-requiring inputs at their param
+  layout) + a compiled-temp estimate (the largest single-op working
+  set — the scratch XLA needs beyond the named buffers).
+- **pod-shape planner** (:func:`sweep_pod_shapes` /
+  :func:`plan_pod_shape`): sweep candidate dp×mp(×pp) shapes WITHOUT
+  compiling, assuming the batch shards on dp and (optionally) params
+  on mp, and report per-shape per-device totals against
+  ``FLAGS_memory_budget_bytes`` — `spmd.suggest_mesh_shape` sizes a
+  mesh from this BEFORE the first run.
+- **oom_risk** (:func:`check_memory`): a perf-severity finding when
+  the predicted per-device peak exceeds the HBM budget, with
+  top-buffer source attribution from the recorded `_PendingOp.src`.
+
+Cross-validated in tests: the static per-device peak lands within 2×
+of ``memory_analysis()`` + the census per-device watermark on LeNet
+and a TP-sharded layer pair, and `budget --static-diff` holds the
+prediction to the measured byte meters (no-false-clean).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..observability.budget import _fmt_bytes
+from .diagnostics import CheckReport, SEVERITY_PERF
+# ONE byte-sizing rule for both passes: a pricing fix in the
+# propagation pass must never diverge the liveness pass
+from .sharding_prop import _nbytes
+
+CHECKER_OOM = "oom_risk"
+
+# optimizer state priced per parameter byte: moments kept at the param
+# layout (the fused update's out_shardings mirror its inputs)
+_OPT_FACTORS = {
+    "sgd": 0, "momentum": 1, "adagrad": 1, "rmsprop": 1,
+    "adam": 2, "adamw": 2, "lamb": 2, "lbfgs": 2,
+}
+
+# the default pod-shape sweep (dp, mp[, pp]) — the acceptance set plus
+# the single-axis dp ladder the no-TP models actually use
+DEFAULT_SHAPES: Tuple[Tuple[int, ...], ...] = (
+    (1, 1), (2, 1), (4, 1), (4, 2), (2, 2, 2), (8, 2), (4, 4, 2))
+
+
+class CandidateMesh:
+    """A mesh SHAPE to plan against, not a mesh to run on: carries the
+    axis names/sizes and the ASSUMED input PartitionSpecs, quacking
+    like `spmd._Ambient` for the propagation pass (`spec_of`) without
+    ever touching jax devices — so a laptop can price a dp4×mp2×pp2
+    pod. Register assumptions with :meth:`assume`; unassumed inputs
+    propagate replicated (the `_Ambient` fallback rule)."""
+
+    __slots__ = ("shape", "axes", "desc", "_axis_size", "_specs")
+
+    _DEFAULT_AXES = ("dp", "mp", "pp")
+
+    def __init__(self, shape: Sequence[int],
+                 axes: Optional[Sequence[str]] = None):
+        self.shape = tuple(int(s) for s in shape)
+        self.axes = tuple(axes) if axes is not None \
+            else self._DEFAULT_AXES[:len(self.shape)]
+        if len(self.axes) != len(self.shape):
+            raise ValueError(f"{len(self.shape)} mesh dims need "
+                             f"{len(self.shape)} axis names, got "
+                             f"{self.axes}")
+        self.desc = "x".join(f"{n}{s}"
+                             for n, s in zip(self.axes, self.shape))
+        self._axis_size = dict(zip(self.axes, self.shape))
+        self._specs: Dict[int, Tuple] = {}
+
+    @property
+    def size(self) -> int:
+        return int(np.prod(self.shape))
+
+    def assume(self, val, spec) -> "CandidateMesh":
+        """Assume `val` (a payload or Tensor) is laid out as `spec`
+        (PartitionSpec-shaped tuple) on this candidate mesh."""
+        payload = getattr(val, "_payload", val)
+        self._specs[id(payload)] = tuple(spec)
+        return self
+
+    def spec_of(self, val) -> Optional[Tuple]:
+        if getattr(val, "_is_pending_value", False):
+            return "?"
+        return self._specs.get(id(val))
+
+
+def _unit_mesh() -> CandidateMesh:
+    return CandidateMesh((1,), ("dp",))
+
+
+def _as_mesh(mesh):
+    """None -> the active ambient mesh, else a single-device candidate
+    (unsharded pricing); ProcessMesh -> its _Ambient; CandidateMesh /
+    _Ambient pass through."""
+    if mesh is None:
+        from .._core import lazy
+        return lazy.SPMD if lazy.SPMD is not None else _unit_mesh()
+    if hasattr(mesh, "spec_of"):
+        return mesh
+    from ..distributed.spmd import _Ambient
+    return _Ambient(mesh)
+
+
+def _shard_factor(state, axis_size: Dict[str, int]) -> int:
+    """How many ways the propagated spec divides the buffer: the
+    product of its sharded axes' degrees (each axis shards a distinct
+    dim). UNKNOWN prices replicated — conservative, never under."""
+    if state is None or not getattr(state, "known", False):
+        return 1
+    k = 1
+    for a in state.sharded_axes():
+        k *= int(axis_size.get(a, 1))
+    return max(k, 1)
+
+
+class Interval:
+    """One buffer's life [birth, death) on the liveness timeline, priced
+    per device."""
+
+    __slots__ = ("key", "kind", "birth", "death", "nbytes", "pd_bytes",
+                 "shape", "dtype", "src", "spec", "donated", "alias_of",
+                 "stages")
+
+    def __init__(self, key, kind, birth, death, nbytes, pd_bytes,
+                 shape=(), dtype="", src=None, spec=None, donated=False,
+                 alias_of=None):
+        self.key = key            # "in:3" | "op:5:0" | "grad:in:2" | ...
+        self.kind = kind          # input|param|activation|output|
+        #                           cotangent|grad
+        self.birth = birth
+        self.death = death
+        self.nbytes = nbytes
+        self.pd_bytes = pd_bytes
+        self.shape = tuple(shape)
+        self.dtype = str(dtype)
+        self.src = src
+        self.spec = spec
+        self.donated = donated
+        self.alias_of = alias_of  # key of the base buffer (view family)
+        self.stages: set = set()  # pp stages this buffer occupies
+
+    def row(self) -> Dict:
+        return {"key": self.key, "kind": self.kind, "birth": self.birth,
+                "death": self.death, "nbytes": self.nbytes,
+                "pd_bytes": self.pd_bytes, "shape": list(self.shape),
+                "dtype": self.dtype, "src": self.src,
+                "spec": None if self.spec is None
+                else list(map(str, self.spec)),
+                "donated": self.donated, "alias_of": self.alias_of}
+
+
+class LivenessResult:
+    """Intervals + the peak-bytes timeline of one analyzed program."""
+
+    def __init__(self, mesh, n_ops: int, train: bool, pp: int = 1):
+        self.mesh_desc = getattr(mesh, "desc", "dp1")
+        self.mesh_size = int(np.prod(getattr(mesh, "shape", (1,))))
+        self.n_ops = n_ops
+        self.train = train
+        self.pp = pp
+        self.intervals: List[Interval] = []
+        self.peak_pd_bytes = 0
+        self.peak_t = 0
+        self.peak_stage = 0
+        # [(t, pd_bytes)] at every event point of the peak stage
+        self.timeline: List[Tuple[int, int]] = []
+        # largest single-op working set (inputs+outputs, per device):
+        # the compiled-temp stand-in the step footprint adds when no
+        # memory_analysis() exists yet
+        self.temp_pd_bytes = 0
+
+    def top(self, n: int = 8) -> List[Dict]:
+        """The buffers alive at the peak, largest first, with source
+        attribution."""
+        live = [iv for iv in self.intervals
+                if iv.birth <= self.peak_t < iv.death
+                and self.peak_stage in iv.stages and iv.pd_bytes > 0]
+        live.sort(key=lambda iv: -iv.pd_bytes)
+        return [iv.row() for iv in live[:n]]
+
+    def bytes_of(self, kind: str) -> int:
+        """Total per-device bytes of one interval kind (deduped —
+        aliases cost zero by construction)."""
+        return sum(iv.pd_bytes for iv in self.intervals
+                   if iv.kind == kind)
+
+    def worst_stage_bytes_of(self, kind: str) -> int:
+        """Per-device bytes of one kind on the HEAVIEST pp stage — a
+        device only holds its own stage's params/grads, so optimizer
+        state must be sized from the worst stage, not the full model
+        (a buffer read by several stages counts in each). Equals
+        bytes_of() when pp == 1."""
+        if self.pp <= 1:
+            return self.bytes_of(kind)
+        totals = [0] * self.pp
+        for iv in self.intervals:
+            if iv.kind != kind:
+                continue
+            for s in iv.stages:
+                if 0 <= s < self.pp:
+                    totals[s] += iv.pd_bytes
+        return max(totals, default=0)
+
+    def to_dict(self) -> Dict:
+        return {"mesh": self.mesh_desc, "n_ops": self.n_ops,
+                "train": self.train, "pp": self.pp,
+                "peak_pd_bytes": self.peak_pd_bytes,
+                "peak_t": self.peak_t, "peak_stage": self.peak_stage,
+                "temp_pd_bytes": self.temp_pd_bytes,
+                "timeline": [list(p) for p in self.timeline],
+                "top": self.top(8)}
+
+
+def _view_of(pop) -> bool:
+    from .alias_graph import VIEW_OP_NAMES
+    return pop.op.name in VIEW_OP_NAMES
+
+
+def analyze_liveness(ctx_or_view, mesh=None, train: Optional[bool] = None,
+                     note: bool = True, prop=None) -> LivenessResult:
+    """Compute the per-device peak-HBM timeline of one pending program.
+
+    `mesh` may be an `_Ambient`, a ProcessMesh, a :class:`CandidateMesh`
+    (pod shapes this host cannot build) or None (the ambient mesh, or
+    unsharded). `train` overrides the fused fwd+vjp modeling (default:
+    the view's own `needs_grad`). With `note`, the prediction is
+    recorded with the byte plane so a later OOM postmortem can say
+    whether the failure was statically foreseeable. A caller that
+    already ran the propagation pass over this exact (view, mesh) can
+    hand its `PropResult` in as `prop` instead of paying a second
+    abstract-interpretation sweep (the PerfRecorder does)."""
+    from .segment_checks import SegmentView
+    view = ctx_or_view if isinstance(ctx_or_view, SegmentView) \
+        else SegmentView.from_context(ctx_or_view)
+    mesh = _as_mesh(mesh)
+    axis_size = dict(getattr(mesh, "_axis_size", {}) or {})
+    pp = int(axis_size.pop("pp", 1) or 1)
+
+    # per-value specs from the propagation pass (findings discarded —
+    # the perf lint owns them; this pass only needs the layouts)
+    if prop is not None:
+        res = prop
+    else:
+        from .sharding_prop import propagate
+        res, _rep = propagate(view, mesh, report=CheckReport("liveness"))
+
+    pending = view.pending
+    n = len(pending)
+    if train is None:
+        train = bool(view.needs_grad)
+    T = 2 * n if train else n
+    out = LivenessResult(mesh, n, train, pp=pp)
+    if n == 0:
+        return out
+
+    def t_bwd(j: int) -> int:
+        return 2 * n - 1 - j
+
+    def stage_of(j: int) -> int:
+        return min(j * pp // n, pp - 1) if pp > 1 else 0
+
+    live_set = set(view.live)
+    donated = set(view.donate)
+
+    # readers per input / per op output
+    in_readers: Dict[int, List[int]] = {}
+    out_readers: Dict[Tuple[int, int], List[int]] = {}
+    for j, pop in enumerate(pending):
+        for w in pop.wiring:
+            if w is None:
+                continue
+            if w[0] == "in":
+                in_readers.setdefault(w[1], []).append(j)
+            else:
+                out_readers.setdefault((w[1], w[2]), []).append(j)
+
+    ivals: Dict[str, Interval] = {}
+
+    # ---------------------------------------------------------- inputs
+    seen_payload: Dict[int, str] = {}
+    for i, v in enumerate(view.in_vals):
+        key = f"in:{i}"
+        nb = _nbytes(v)
+        readers = in_readers.get(i, [])
+        last = max(readers) if readers else -1
+        if i in donated:
+            # the donation mask frees the buffer for output reuse the
+            # moment its last read is done
+            death = last + 1 if last >= 0 else 1
+        else:
+            death = T
+        if train and readers and any(
+                r.requires_grad for jj in readers
+                for r in pending[jj].out_refs):
+            # a residual of some grad-registering op: stays live
+            # through that op's vjp (the fused fwd+vjp contract —
+            # donation is already suppressed when the segment needs
+            # grad, so this only ever EXTENDS)
+            death = max(death, max(t_bwd(jj) + 1 for jj in readers))
+        st = res.in_states[i] if i < len(res.in_states) else None
+        factor = _shard_factor(st, axis_size)
+        requires_grad = bool(view.in_meta[i][0]) \
+            if i < len(view.in_meta) else False
+        alias = seen_payload.get(id(v))
+        pd = 0 if alias else nb // factor
+        if alias is None:
+            seen_payload[id(v)] = key
+        iv = Interval(
+            key, "param" if requires_grad else "input", 0, death, nb,
+            pd, getattr(v, "shape", ()), getattr(v, "dtype", ""),
+            src=None,
+            spec=st.spec() if st is not None and st.known else None,
+            donated=i in donated, alias_of=alias)
+        iv.stages = {stage_of(jj) for jj in readers} or {0}
+        ivals[key] = iv
+
+    # --------------------------------------------------- intermediates
+    base_of: Dict[str, str] = {}     # view chains resolve to their root
+    for j, pop in enumerate(pending):
+        is_view = _view_of(pop)
+        base_key = None
+        if is_view:
+            for w in pop.wiring:
+                if w is None:
+                    continue
+                base_key = f"in:{w[1]}" if w[0] == "in" \
+                    else f"op:{w[1]}:{w[2]}"
+                break
+            if base_key is not None:
+                base_key = base_of.get(base_key, base_key)
+        for s, ref in enumerate(pop.out_refs):
+            key = f"op:{j}:{s}"
+            nb = _nbytes(ref.aval)
+            readers = out_readers.get((j, s), [])
+            last = max(readers) if readers else j
+            death = T if (j, s) in live_set else last + 1
+            if train and (readers or (j, s) in live_set) and (
+                    ref.requires_grad or any(
+                        r.requires_grad for jj in readers
+                        for r in pending[jj].out_refs)):
+                # saved as its own op's residual and/or a consumer's
+                bwd_times = [t_bwd(j) + 1] + [t_bwd(jj) + 1
+                                              for jj in readers]
+                death = max(death, max(bwd_times))
+            st = res.out_states.get((j, s))
+            factor = _shard_factor(st, axis_size)
+            stages = {stage_of(j)} | {stage_of(jj) for jj in readers}
+            if is_view and base_key is not None and base_key in ivals:
+                # XLA aliases a view-shaped output onto its base inside
+                # the compiled program: zero new bytes, base life
+                # extended to cover the view's — and the base's BYTES
+                # charged to every stage the view is consumed in (a
+                # stage reading the view holds the base's storage)
+                base_of[key] = base_key
+                base = ivals[base_key]
+                base.death = max(base.death, death)
+                base.stages |= stages
+                pd = 0
+            else:
+                pd = nb // factor
+            iv = Interval(
+                key, "output" if (j, s) in live_set else "activation",
+                j, death, nb, pd, ref.aval.shape, ref.aval.dtype,
+                src=getattr(pop, "src", None),
+                spec=st.spec() if st is not None and st.known else None,
+                alias_of=base_key if is_view else None)
+            iv.stages = stages
+            ivals[key] = iv
+
+    # ------------------------------------------- backward-only buffers
+    if train:
+        for j, pop in enumerate(pending):
+            if not any(r.requires_grad for r in pop.out_refs):
+                continue
+            for s, ref in enumerate(pop.out_refs):
+                if not ref.requires_grad:
+                    continue
+                # cotangent of (j, s): produced by its consumers' vjps
+                # (which run EARLIER on the backward timeline),
+                # consumed by op j's own vjp
+                readers = [jj for jj in out_readers.get((j, s), ())
+                           if any(r.requires_grad
+                                  for r in pending[jj].out_refs)]
+                birth = min((t_bwd(jj) for jj in readers),
+                            default=t_bwd(j))
+                st = res.out_states.get((j, s))
+                nb = _nbytes(ref.aval)
+                iv = Interval(
+                    f"ct:{j}:{s}", "cotangent", birth, t_bwd(j) + 1,
+                    nb, nb // _shard_factor(st, axis_size),
+                    ref.aval.shape, ref.aval.dtype,
+                    src=getattr(pop, "src", None),
+                    spec=st.spec() if st is not None and st.known
+                    else None)
+                iv.stages = {stage_of(j)}
+                ivals[iv.key] = iv
+        for i, v in enumerate(view.in_vals):
+            if i >= len(view.in_meta) or not view.in_meta[i][0]:
+                continue
+            readers = in_readers.get(i, [])
+            if not readers:
+                continue
+            # parameter gradient: born at the first backward
+            # contribution (the LAST forward reader's vjp), lives out
+            birth = t_bwd(max(readers))
+            st = res.in_states[i] if i < len(res.in_states) else None
+            nb = _nbytes(v)
+            iv = Interval(
+                f"grad:in:{i}", "grad", birth, T, nb,
+                nb // _shard_factor(st, axis_size),
+                getattr(v, "shape", ()), getattr(v, "dtype", ""),
+                spec=st.spec() if st is not None and st.known else None)
+            iv.stages = {stage_of(max(readers))}
+            ivals[iv.key] = iv
+
+    out.intervals = list(ivals.values())
+
+    # ------------------------------------------------- peak per stage
+    best = (0, 0, 0)      # (peak, t, stage)
+    best_timeline: List[Tuple[int, int]] = []
+    for stage in range(pp):
+        events: Dict[int, int] = {}
+        for iv in out.intervals:
+            if stage not in iv.stages or iv.pd_bytes <= 0:
+                continue
+            events[iv.birth] = events.get(iv.birth, 0) + iv.pd_bytes
+            events[iv.death] = events.get(iv.death, 0) - iv.pd_bytes
+        cur = 0
+        timeline = []
+        for t in sorted(events):
+            cur += events[t]
+            timeline.append((t, cur))
+            if cur > best[0]:
+                best = (cur, t, stage)
+        if stage == best[2]:
+            best_timeline = timeline
+    out.peak_pd_bytes, out.peak_t, out.peak_stage = best
+    out.timeline = best_timeline
+
+    # largest single-op per-device working set — the compiled-temp
+    # estimate for programs that never compiled
+    for j, pop in enumerate(pending):
+        ws = 0
+        for w in pop.wiring:
+            if w is None:
+                continue
+            key = f"in:{w[1]}" if w[0] == "in" else f"op:{w[1]}:{w[2]}"
+            key = base_of.get(key, key)
+            iv = ivals.get(key)
+            if iv is not None:
+                ws += iv.pd_bytes or iv.nbytes
+        for s in range(pop.n_outs):
+            iv = ivals.get(f"op:{j}:{s}")
+            if iv is not None:
+                ws += iv.pd_bytes
+        out.temp_pd_bytes = max(out.temp_pd_bytes, ws)
+
+    if note:
+        from ..observability import memory as _memtel
+        _memtel.note_static_prediction(
+            out.peak_pd_bytes, f"{n}-op segment"
+            + (" (train)" if train else ""), out.mesh_desc)
+    return out
+
+
+# -------------------------------------------------- train-step footprint
+
+def step_footprint(ctx_or_view, mesh=None, optimizer: str = "adam",
+                   master_weights: bool = False,
+                   train: bool = True, note: bool = True) -> Dict:
+    """Full train-step per-device footprint of a recorded forward(+loss)
+    program: the liveness peak (params + activations + cotangents +
+    grads on the mirrored fwd+vjp timeline) plus the optimizer
+    moments/master (sized from the grad-requiring inputs at the param
+    layout) plus the compiled-temp estimate. All numbers are PER
+    DEVICE under `mesh`."""
+    res = analyze_liveness(ctx_or_view, mesh=mesh, train=train,
+                           note=False)
+    # under a pp stage split a device holds only its stage's params,
+    # so the per-device param/grad/optimizer bytes come from the
+    # heaviest stage, not the whole model
+    params = res.worst_stage_bytes_of("param")
+    grads = res.worst_stage_bytes_of("grad")
+    factor = _OPT_FACTORS.get(str(optimizer).lower(), 2)
+    opt_state = params * factor + (params if master_weights else 0)
+    total = res.peak_pd_bytes + opt_state + res.temp_pd_bytes
+    fp = {
+        "mesh": res.mesh_desc,
+        "devices": res.mesh_size,
+        "train": res.train,
+        "params_pd_bytes": params,
+        "grads_pd_bytes": grads,
+        "opt_state_pd_bytes": opt_state,
+        "activations_pd_bytes": res.bytes_of("activation")
+        + res.bytes_of("cotangent"),
+        "liveness_peak_pd_bytes": res.peak_pd_bytes,
+        "temp_pd_bytes": res.temp_pd_bytes,
+        "total_pd_bytes": total,
+        "top": res.top(8),
+    }
+    if note:
+        from ..observability import memory as _memtel
+        _memtel.note_static_prediction(
+            total, f"{res.n_ops}-op train step ({optimizer})",
+            res.mesh_desc)
+    return fp
+
+
+# ------------------------------------------------------ oom_risk finding
+
+def check_memory(ctx_or_view, mesh=None,
+                 budget: Optional[int] = None,
+                 report: Optional[CheckReport] = None,
+                 train: Optional[bool] = None,
+                 optimizer: str = "adam",
+                 footprint: Optional[Dict] = None,
+                 note: bool = True) -> CheckReport:
+    """Mem lint over a pending program: predict the per-device peak of
+    the full step under `mesh` and flag ``oom_risk`` (perf severity —
+    a program that will not fit is a capacity problem, not a
+    correctness one) when it exceeds the HBM budget
+    (`FLAGS_memory_budget_bytes` unless overridden; a budget of 0
+    disables the gate). Pass a precomputed `footprint` (and
+    `note=False`) when sweeping CANDIDATE shapes — the gate then
+    reuses it instead of re-running the liveness pass, and a
+    hypothetical mesh's prediction never overwrites the one the OOM
+    postmortem reads."""
+    from .._core import flags
+    from .segment_checks import SegmentView
+    view = ctx_or_view if isinstance(ctx_or_view, SegmentView) \
+        else SegmentView.from_context(ctx_or_view)
+    if budget is None:
+        budget = int(flags.flag_value("FLAGS_memory_budget_bytes"))
+    if report is None:
+        report = CheckReport(
+            f"mem lint ({len(view.pending)} ops)")
+    fp = footprint if footprint is not None else step_footprint(
+        view, mesh=mesh, optimizer=optimizer,
+        train=bool(view.needs_grad) if train is None else train,
+        note=note)
+    if budget and fp["total_pd_bytes"] > budget:
+        top = fp["top"][:4]
+        named = "; ".join(
+            f"{_fmt_bytes(r['pd_bytes'])} {r['kind']} "
+            f"{r['dtype']}{r['shape']}"
+            + (f" (recorded at {r['src']})" if r.get("src") else "")
+            for r in top)
+        report.add(
+            CHECKER_OOM,
+            f"predicted per-device step peak "
+            f"{_fmt_bytes(fp['total_pd_bytes'])} exceeds the "
+            f"{_fmt_bytes(budget)} HBM budget on mesh {fp['mesh']} "
+            f"(liveness {_fmt_bytes(fp['liveness_peak_pd_bytes'])} + "
+            f"optimizer {_fmt_bytes(fp['opt_state_pd_bytes'])} + temp "
+            f"{_fmt_bytes(fp['temp_pd_bytes'])}); top buffers: {named}",
+            severity=SEVERITY_PERF,
+            provenance=next((r.get("src") for r in top if r.get("src")),
+                            None),
+            hint="grow the mesh (dp shards batch/activations, mp the "
+                 "flagged params), enable donation, or shrink the "
+                 "batch — sweep shapes with `python -m "
+                 "paddle_tpu.analysis --mem`",
+            data={"predicted_pd_bytes": fp["total_pd_bytes"],
+                  "budget_bytes": int(budget), "mesh": fp["mesh"],
+                  "footprint": {k: v for k, v in fp.items()
+                                if k != "top"},
+                  "top": top})
+    return report
+
+
+# ------------------------------------------------------ pod-shape sweep
+
+def _assumed_mesh(view, shape: Sequence[int],
+                  axes: Optional[Sequence[str]] = None,
+                  shard_params: bool = True) -> CandidateMesh:
+    """Candidate mesh with the standard planning assumptions: batch
+    inputs (no grad, leading dim divisible) shard on dp; with
+    `shard_params` and mp>1, each grad-requiring input shards its
+    largest mp-divisible dim on mp (the TP/ZeRO upper bound — what a
+    correctly-sharded model would reclaim)."""
+    mesh = CandidateMesh(shape, axes)
+    dp = mesh._axis_size.get("dp", 1)
+    mp = mesh._axis_size.get("mp", 1)
+    for i, v in enumerate(view.in_vals):
+        shp = tuple(getattr(v, "shape", ()))
+        if not shp:
+            continue
+        requires_grad = bool(view.in_meta[i][0]) \
+            if i < len(view.in_meta) else False
+        if not requires_grad:
+            if dp > 1 and shp[0] % dp == 0:
+                mesh.assume(v, ("dp",))
+        elif shard_params and mp > 1:
+            dims = [d for d in range(len(shp) - 1, -1, -1)
+                    if shp[d] % mp == 0]
+            if dims:
+                d = max(dims, key=lambda dd: shp[dd])
+                spec = [None] * len(shp)
+                spec[d] = "mp"
+                mesh.assume(v, tuple(spec))
+    return mesh
+
+
+def sweep_pod_shapes(ctx_or_view, shapes=None,
+                     optimizer: str = "adam",
+                     train: Optional[bool] = None,
+                     budget: Optional[int] = None,
+                     shard_params: bool = True) -> List[Dict]:
+    """Price one recorded program at every candidate pod shape WITHOUT
+    compiling: one row per shape with the per-device footprint, the
+    budget verdict, and any ``oom_risk`` finding count. Shapes are
+    (dp,), (dp, mp) or (dp, mp, pp) tuples."""
+    from .._core import flags
+    from .segment_checks import SegmentView
+    view = ctx_or_view if isinstance(ctx_or_view, SegmentView) \
+        else SegmentView.from_context(ctx_or_view)
+    if budget is None:
+        budget = int(flags.flag_value("FLAGS_memory_budget_bytes"))
+    if train is None:
+        train = bool(view.needs_grad) or any(
+            m[0] for m in view.in_meta)
+    rows: List[Dict] = []
+    for shape in (shapes or DEFAULT_SHAPES):
+        mesh = _assumed_mesh(view, shape, shard_params=shard_params)
+        fp = step_footprint(view, mesh=mesh, optimizer=optimizer,
+                            train=train, note=False)
+        # the candidate footprint is handed in: one liveness pass per
+        # shape, and the hypothetical mesh never touches the
+        # postmortem's STATIC_PREDICTION slot
+        report = check_memory(view, mesh=mesh, budget=budget,
+                              train=train, optimizer=optimizer,
+                              footprint=fp, note=False)
+        rows.append({
+            "shape": list(mesh.shape), "mesh": mesh.desc,
+            "devices": mesh.size,
+            **{k: v for k, v in fp.items() if k != "top"},
+            "budget_bytes": int(budget),
+            "fits": (not budget)
+            or fp["total_pd_bytes"] <= budget,
+            "oom_risk": len(report.by_checker(CHECKER_OOM)),
+            "top": fp["top"][:4],
+        })
+    return rows
+
+
+def plan_pod_shape(ctx_or_view, hbm_bytes_per_device: Optional[int] = None,
+                   shapes=None, **kw) -> Optional[Tuple[int, ...]]:
+    """The smallest candidate shape (fewest devices) whose predicted
+    per-device step footprint fits the HBM budget — mesh sizing BEFORE
+    the first run. None when nothing in the sweep fits; planning with
+    NO budget at all (no argument, FLAGS_memory_budget_bytes unset)
+    raises — every shape would vacuously 'fit' and a confident (1, 1)
+    answer with zero capacity checking is exactly the OOM this pass
+    exists to prevent."""
+    rows = sweep_pod_shapes(ctx_or_view, shapes=shapes,
+                            budget=hbm_bytes_per_device, **kw)
+    budget = hbm_bytes_per_device or (rows[0]["budget_bytes"]
+                                      if rows else 0)
+    if not budget:
+        raise ValueError(
+            "plan_pod_shape needs an HBM budget: pass "
+            "hbm_bytes_per_device or set FLAGS_memory_budget_bytes")
+    fitting = [r for r in rows if r["total_pd_bytes"] <= budget]
+    if not fitting:
+        return None
+    best = min(fitting, key=lambda r: (r["devices"],
+                                       r["total_pd_bytes"]))
+    return tuple(best["shape"])
+
+
+def render_sweep(rows: List[Dict], title: str = "pod-shape plan") -> str:
+    """The per-shape peak table the --mem CLI prints."""
+    lines = [f"== {title} ==",
+             f"  {'mesh':<14} {'devs':>4} {'params':>10} {'opt':>10} "
+             f"{'act':>10} {'temp':>10} {'peak/dev':>10}  verdict"]
+    for r in rows:
+        if r.get("budget_bytes"):
+            verdict = "fits" if r["fits"] else "OOM-RISK"
+        else:
+            verdict = "-"
+        lines.append(
+            f"  {r['mesh']:<14} {r['devices']:>4} "
+            f"{_fmt_bytes(r['params_pd_bytes']):>10} "
+            f"{_fmt_bytes(r['opt_state_pd_bytes']):>10} "
+            f"{_fmt_bytes(r['activations_pd_bytes']):>10} "
+            f"{_fmt_bytes(r['temp_pd_bytes']):>10} "
+            f"{_fmt_bytes(r['total_pd_bytes']):>10}  {verdict}")
+    return "\n".join(lines)

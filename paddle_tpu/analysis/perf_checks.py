@@ -77,10 +77,10 @@ class PerfEvent:
     """One observed seal of the fusion window."""
 
     __slots__ = ("reason", "head", "op_name", "n_ops", "user_src",
-                 "framework_src", "detail", "src")
+                 "framework_src", "detail", "src", "cap")
 
     def __init__(self, reason, head, op_name, n_ops, user_src,
-                 framework_src, detail=None, src=None):
+                 framework_src, detail=None, src=None, cap=None):
         self.reason = reason          # full reason string
         self.head = head              # reason bucket (pre-':')
         self.op_name = op_name        # breaking/last op, if known
@@ -89,6 +89,7 @@ class PerfEvent:
         self.framework_src = framework_src  # first nn/models/... frame
         self.detail = detail          # e.g. the stashed record error
         self.src = src                # recorded _PendingOp.src, if any
+        self.cap = cap                # ctx.max_ops at a segment_cap seal
 
 
 # ------------------------------------------------------------ recorder
@@ -127,6 +128,14 @@ class PerfRecorder:
         # the cost axis `budget --static-diff` holds the measured
         # compute.flops.* counters against, same no-false-clean gate
         self.static_flops = 0
+        # static per-device peak-HBM prediction (mem_liveness) over the
+        # traced step's sealed programs — the BYTE axis of
+        # `budget --static-diff` (`memory.peak` row, no-false-clean
+        # against the measured census watermark)
+        self.static_peak_bytes = 0
+        # total ops recorded across every seal of the traced step: the
+        # whole-step window size a segment_cap fix hint must name
+        self.total_ops = 0
         self.sharding_report = CheckReport("perf trace sharding")
 
     # -------------------------------------------------------- lifecycle
@@ -155,18 +164,34 @@ class PerfRecorder:
             # math — no mesh needed)
             from .sharding_prop import segment_flops
             self.static_flops += segment_flops(pending, ctx._in_vals)
-        if lazy.SPMD is not None and ctx is not None:
-            # sealed under an ambient mesh: price the segment's
-            # compiled collectives statically (the sharding sweep also
-            # collects implicit-reshard findings across the real step)
-            from .sharding_prop import propagate
-            res, _ = propagate(ctx, lazy.SPMD,
-                               report=self.sharding_report)
-            self.comm_bytes += res.comm_total()
+            self.total_ops += len(pending)
+            prop = None
+            if lazy.SPMD is not None:
+                # sealed under an ambient mesh: price the segment's
+                # compiled collectives statically (the sharding sweep
+                # also collects implicit-reshard findings across the
+                # real step); the SAME PropResult feeds the liveness
+                # pass below — one abstract interpretation per seal
+                from .sharding_prop import propagate
+                prop, _ = propagate(ctx, lazy.SPMD,
+                                    report=self.sharding_report)
+                self.comm_bytes += prop.comm_total()
+            try:
+                # static per-device peak of this sealed program
+                # (mem_liveness — priced on the ambient mesh when one
+                # is active, unsharded otherwise); best-effort: a
+                # liveness failure must never break the traced run
+                from .mem_liveness import analyze_liveness
+                lres = analyze_liveness(ctx, mesh=lazy.SPMD, prop=prop)
+                self.static_peak_bytes = max(self.static_peak_bytes,
+                                             lres.peak_pd_bytes)
+            except Exception:       # pragma: no cover - defensive
+                pass
         head = reason.split(":", 1)[0]
         op_name = None
         detail = None
         src = None
+        cap = None
         if head == "record_fallback":
             # the BREAKING op never reached the pending list — its name
             # rides the reason, its failure the executor's stash
@@ -180,10 +205,12 @@ class PerfRecorder:
             # the op that tripped the cap is the last recorded one
             op_name = pending[-1].op.name
             src = getattr(pending[-1], "src", None)
+            cap = getattr(ctx, "max_ops", None) if ctx is not None \
+                else None
         user_src, framework_src = hooks.perf_site()
         self.events.append(PerfEvent(reason, head, op_name, len(pending),
                                      user_src, framework_src, detail,
-                                     src))
+                                     src, cap))
 
     # -------------------------------------------------------- reporting
     def seal_counts(self) -> Dict[str, int]:
@@ -242,6 +269,26 @@ class PerfRecorder:
             detail = next((e.detail for e in evs if e.detail), None)
             if detail:
                 msg += f" — record failed: {detail}"
+            data = {"kind": head, "count": n, "ops_lost": ops_lost,
+                    "op": op_name, "framework_src": framework_src,
+                    "detail": detail}
+            hint = _HINTS.get(head)
+            if head == "segment_cap":
+                # concrete remedy: the whole-step window size is the
+                # total ops the traced step recorded across every seal
+                # — the cap value that lets the step seal ONCE at its
+                # natural boundary (the eager-ResNet 2×/step cap trip
+                # was reported without this number)
+                cap = next((e.cap for e in evs if e.cap is not None),
+                           None)
+                need = self.total_ops
+                data.update({"window_ops": need, "cap": cap})
+                hint = (f"set FLAGS_lazy_max_segment_ops >= {need} "
+                        f"(the traced step records {need} ops; the "
+                        + (f"current cap is {cap}" if cap
+                           else "cap is lower")
+                        + ") so the whole step seals once at backward "
+                          "and keeps the step cache + donation")
             report.add(
                 checker, msg, severity=SEVERITY_PERF, op_name=op_name,
                 # user frame first; framework model/layer code (a CLI
@@ -249,10 +296,7 @@ class PerfRecorder:
                 # recorded op src are the fallbacks
                 provenance=user_src or framework_src or next(
                     (e.src for e in evs if e.src), None),
-                hint=_HINTS.get(head),
-                data={"kind": head, "count": n, "ops_lost": ops_lost,
-                      "op": op_name, "framework_src": framework_src,
-                      "detail": detail})
+                hint=hint, data=data)
         report.extend(self.sharding_report)
         return report
 
@@ -306,7 +350,9 @@ def check_perf(ctx_or_step) -> CheckReport:
             severity=SEVERITY_PERF, op_index=min(cap - 1, n - 1),
             op_name=first.op.name,
             provenance=getattr(first, "src", None),
-            hint=_HINTS["segment_cap"],
+            hint=f"set FLAGS_lazy_max_segment_ops >= {n} (the pending "
+                 f"window is {n} ops; the current cap is {cap}) so "
+                 f"the step seals once at its natural boundary",
             data={"kind": "segment_cap", "count": breaks,
-                  "cap": cap, "pending": n})
+                  "cap": cap, "pending": n, "window_ops": n})
     return report

@@ -276,6 +276,10 @@ class _Prop:
             return [ValState(UNKNOWN)]
         if name in ("reshape", "flatten_"):
             return self._reshape(j, pop, states, in_avals)
+        if name in ("concat_", "stack_"):
+            return self._cat_stack(j, pop, states, in_avals, src)
+        if name == "split_":
+            return self._split(j, pop, states, in_avals, src)
         if name in _DIMWISE_OPS:
             # output dims align 1:1 with input-0 dims (pooling, norm
             # application, padding): an entry survives where the dim
@@ -486,6 +490,90 @@ class _Prop:
             # psum over the vocab axis
             partial |= set(_axes_of(w.entries[0]))
         return [ValState(tuple(entries), frozenset(partial))]
+
+    def _cat_stack(self, j, pop, states, in_avals, src) -> List[ValState]:
+        """concat_ / stack_ (variadic, all inputs same rank): every dim
+        other than the concat/stack axis joins like an elementwise op —
+        conflicting entries are an implicit reshard, agreeing entries
+        ride through. The CONCAT axis itself goes unsharded (pieces
+        sharded along it force GSPMD to re-lay the boundary out —
+        priced as a gather); a STACK op's new axis is born unsharded
+        and the input dims shift around it."""
+        name = pop.op.name
+        out_ref = pop.out_refs[0]
+        nd = len(out_ref.aval.shape)
+        axis = int(pop.attrs.get("axis", 0)) % max(nd, 1)
+        known = [(st, av) for st, av in zip(states, in_avals)
+                 if st is not None]
+        entries: List = [None] * nd
+        for d in range(nd):
+            if d == axis:
+                if name == "concat_":
+                    # inputs sharded ALONG the concat dim: the pieces'
+                    # shard boundaries disagree with the output's, so
+                    # GSPMD gathers along those axes every step
+                    gather_axes = set()
+                    nb = 0
+                    for st, av in known:
+                        e = st.entries[d] if len(st.entries) > d else None
+                        if e is not None:
+                            gather_axes.update(_axes_of(e))
+                            nb = max(nb, _nbytes(av))
+                    if gather_axes:
+                        self._note_comm(j, "all_gather", gather_axes,
+                                        nb, src, gather_only=True)
+                continue
+            # input dim for output dim d: identical for concat_, shifted
+            # past the new axis for stack_
+            dd = d if name == "concat_" else (d if d < axis else d - 1)
+            cands = []
+            for st, av in known:
+                if dd >= len(st.entries):
+                    continue
+                e = st.entries[dd]
+                if e is not None:
+                    cands.append((e, _nbytes(av)))
+            uniq = {c[0] for c in cands}
+            if len(uniq) > 1:
+                nb = min(b for _, b in cands)
+                axes = set()
+                for e in uniq:
+                    axes.update(_axes_of(e))
+                self.report.add(
+                    CHECKER_RESHARD,
+                    f"{name} operands meet with conflicting shardings "
+                    f"on dim {dd} ({sorted(map(str, uniq))}): GSPMD "
+                    f"inserts an implicit reshard (~{_fmt_bytes(nb)}) "
+                    f"every step",
+                    severity=SEVERITY_PERF, op_index=j, op_name=name,
+                    provenance=src,
+                    hint="commit every concatenated/stacked operand "
+                         "to one layout before they meet",
+                    data={"dim": dd, "specs": sorted(map(str, uniq)),
+                          "bytes": nb})
+                self._note_comm(j, "reshard", axes, nb, src,
+                                gather_only=True)
+                entries[d] = cands[0][0]
+            elif uniq:
+                entries[d] = next(iter(uniq))
+        return [ValState(tuple(entries))] * pop.n_outs
+
+    def _split(self, j, pop, states, in_avals, src) -> List[ValState]:
+        """split_(x): every output keeps x's layout on the untouched
+        dims; the SPLIT axis goes unsharded (the piece boundaries cut
+        across the shard boundaries — a sharded split dim prices as a
+        gather, mirroring the concat rule)."""
+        st = states[0]
+        av = in_avals[0]
+        out_ref = pop.out_refs[0]
+        nd = len(out_ref.aval.shape)
+        axis = int(pop.attrs.get("axis", 0)) % max(nd, 1)
+        entries = list(_full_rank(st.entries, nd))
+        if entries[axis] is not None:
+            self._note_comm(j, "all_gather", set(_axes_of(entries[axis])),
+                            _nbytes(av), src, gather_only=True)
+            entries[axis] = None
+        return [ValState(tuple(entries))] * pop.n_outs
 
     def _reshape(self, j, pop, states, in_avals) -> List[ValState]:
         st = states[0]

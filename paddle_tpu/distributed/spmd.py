@@ -53,7 +53,8 @@ from .._core import lazy as _lazy
 from . import mesh as _mesh_mod
 
 __all__ = ["activate", "deactivate", "active", "state", "shard_batch",
-           "rebuild_ambient", "suggest_mesh_degree"]
+           "rebuild_ambient", "suggest_mesh_degree",
+           "suggest_mesh_shape"]
 
 
 def _norm_spec(spec) -> Tuple:
@@ -305,16 +306,28 @@ def shard_batch(x, axis: Optional[str] = None):
 
 def suggest_mesh_degree(hbm_bytes_per_device: Optional[int] = None,
                         peak_bytes: Optional[int] = None,
-                        temp_bytes: Optional[int] = None) -> int:
+                        temp_bytes: Optional[int] = None,
+                        view=None, optimizer: str = "adam") -> int:
     """Minimal power-of-two device count whose per-device footprint
-    fits the HBM budget — sized against the BYTE plane (PR 9), not
-    FLOPs: the live-buffer census peak watermark (per-device when the
-    run was sharded) plus the compiled executables' temp bytes from
-    the cached ``memory_analysis()``. Pass overrides to size from a
-    recorded snapshot instead of the live registries."""
+    fits the HBM budget — sized against the BYTE plane, not FLOPs.
+
+    Two sources, static first: pass `view` (an open CaptureContext or
+    SegmentView holding the recorded forward+loss) and the need is the
+    STATIC mem-liveness train-step footprint (analysis/mem_liveness) —
+    a mesh sized BEFORE the first run, on a host that cannot execute
+    the shape. Otherwise the measured registries answer: the census
+    peak watermark (per-device when the run was sharded) plus the
+    compiled executables' temp bytes from the cached
+    ``memory_analysis()``. Explicit ``peak_bytes``/``temp_bytes``
+    override both."""
     from .._core.flags import flag_value
     if hbm_bytes_per_device is None:
         hbm_bytes_per_device = int(flag_value("FLAGS_memory_budget_bytes"))
+    if view is not None and peak_bytes is None:
+        from ..analysis import mem_liveness as _ml
+        fp = _ml.step_footprint(view, mesh=None, optimizer=optimizer)
+        # the static total already models the compiled temp
+        peak_bytes, temp_bytes = fp["total_pd_bytes"], 0
     if peak_bytes is None or temp_bytes is None:
         from ..observability import memory as _memtel
         if peak_bytes is None:
@@ -329,3 +342,23 @@ def suggest_mesh_degree(hbm_bytes_per_device: Optional[int] = None,
     if need <= hbm_bytes_per_device:
         return 1
     return 2 ** math.ceil(math.log2(need / hbm_bytes_per_device))
+
+
+def suggest_mesh_shape(view, hbm_bytes_per_device: Optional[int] = None,
+                       shapes=None, optimizer: str = "adam",
+                       shard_params: bool = True
+                       ) -> Optional[Tuple[int, ...]]:
+    """Plan a dp×mp(×pp) POD SHAPE from the static mem-liveness pass —
+    the smallest candidate shape whose predicted per-device train-step
+    footprint fits the HBM budget, computed without compiling or
+    touching devices (`analysis.plan_pod_shape` with the standard
+    batch-on-dp / params-on-mp assumptions). None when nothing in the
+    candidate sweep fits; `view` is the recorded forward+loss
+    program."""
+    from .._core.flags import flag_value
+    from ..analysis import mem_liveness as _ml
+    if hbm_bytes_per_device is None:
+        hbm_bytes_per_device = int(flag_value("FLAGS_memory_budget_bytes"))
+    return _ml.plan_pod_shape(view, hbm_bytes_per_device, shapes=shapes,
+                              optimizer=optimizer,
+                              shard_params=shard_params)

@@ -89,6 +89,7 @@ def collect(run_fn: Callable[[], None], steps: int,
         wall_us = (time.perf_counter() - t0) * 1e6
         snap = _delta(before, stats())
         peak = _memtel.peak_bytes()
+        peak_pd = _memtel.peak_per_device_bytes()
         live = _memtel.live_bytes()
         donated = _memtel.donated_bytes() - donated0
         execs = _memtel.executable_stats()
@@ -132,6 +133,9 @@ def collect(run_fn: Callable[[], None], steps: int,
     temps = [e.get("temp_bytes") or 0 for e in execs]
     out["memory"] = {
         "peak_bytes": int(peak),
+        # shard-priced watermark: what the static mem-liveness pass
+        # predicts, and what sizes a mesh against the HBM budget
+        "peak_per_device_bytes": int(peak_pd),
         "live_bytes": int(live),
         "donated_bytes_per_step": round(donated / steps, 1),
         # largest temp allocation among the compiled executables this
@@ -294,6 +298,23 @@ def static_diff(step_fn: Callable[[], None], steps: int = 5) -> Dict:
     rows.append({"class": "compute.flops", "static": rec.static_flops,
                  "measured_per_step": round(meas_flops, 1),
                  "match": flops_match})
+
+    # static per-device peak-HBM prediction (mem_liveness over the
+    # traced step's sealed programs) vs the measured census per-device
+    # watermark: two estimators of the BYTE peak (the static pass
+    # counts the recorded program's buffers, the census counts what
+    # the runtime actually bound), so the gate is the no-false-clean
+    # form — the mem lint must not claim an empty footprint while the
+    # byte plane measured one, and vice versa
+    meas_peak = measured.get("memory", {}).get(
+        "peak_per_device_bytes",
+        measured.get("memory", {}).get("peak_bytes", 0))
+    stat_peak = getattr(rec, "static_peak_bytes", 0)
+    peak_match = (stat_peak > 0) == (meas_peak > 0)
+    ok = ok and peak_match
+    rows.append({"class": "memory.peak", "static": stat_peak,
+                 "measured_per_step": int(meas_peak),
+                 "match": peak_match})
 
     return {
         "ok": bool(ok),

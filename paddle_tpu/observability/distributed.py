@@ -222,7 +222,11 @@ class TelemetryPublisher:
             frame["mem"] = {"live": _memtel.live_bytes(),
                             "peak": _memtel.peak_bytes(),
                             "donated": _memtel.donated_bytes(),
-                            "census": _memtel.census_size()}
+                            "census": _memtel.census_size(),
+                            # STRING-keyed per-device map: survives the
+                            # json round trip through the store (the
+                            # PR-8 step-table key-type bug class)
+                            "per_device": _memtel.device_bytes()}
         if _state.COMPUTE:
             # FLOP-domain deltas: executed FLOPs since the last frame
             # over the elapsed window -> this rank's achieved GFLOP/s

@@ -77,6 +77,12 @@ DONATED_BYTES = 0
 ANALYSIS_CALLS = 0
 OOM_POSTMORTEMS = 0
 
+# newest static per-device peak prediction (analysis/mem_liveness):
+# {pd_bytes, desc, mesh} — the OOM postmortem prints it next to the
+# measured watermark so the report says whether the OOM was statically
+# foreseeable. Best-effort provenance: the last program analyzed.
+STATIC_PREDICTION: Optional[Dict] = None
+
 # per-executable memory analysis log: (cache stat, cache key) -> info.
 # Bounded like the executable caches it shadows.
 _EXECS: "OrderedDict[Tuple, Dict]" = OrderedDict()
@@ -227,6 +233,41 @@ def note_donated(nbytes: int):
         metrics.inc("memory.donated_bytes", n)
 
 
+def note_static_prediction(pd_bytes: int, desc: str,
+                           mesh: Optional[str] = None):
+    """Record the newest static per-device peak prediction (the
+    mem-liveness pass calls this whenever it analyzes a program as it
+    will actually run — not for candidate-shape sweeps). Read back by
+    the OOM postmortem."""
+    global STATIC_PREDICTION
+    STATIC_PREDICTION = {"pd_bytes": int(pd_bytes), "desc": str(desc),
+                         "mesh": mesh}
+
+
+def device_bytes() -> Dict[str, int]:
+    """Live census bytes per device id — STRING-keyed (device ids are
+    ints; an int-keyed map silently becomes string-keyed after one
+    json round trip, the PR-8 step-table bug class, so the map is born
+    string-keyed). Sharded buffers charge each device its own shard;
+    resolution failures fall back to device '0'."""
+    out: Dict[str, int] = {}
+    with _LOCK:
+        vals = [e.ref() for e in _CENSUS.values()]
+    for val in vals:
+        if val is None:
+            continue
+        try:
+            for sh in val.addressable_shards:
+                k = str(sh.device.id)
+                out[k] = out.get(k, 0) + int(sh.data.nbytes)
+        except Exception:
+            try:
+                out["0"] = out.get("0", 0) + int(val.nbytes)
+            except Exception:
+                pass
+    return out
+
+
 def live_bytes() -> int:
     return LIVE_BYTES
 
@@ -278,12 +319,14 @@ def reset():
     baselines). Dead entries' pending callbacks tolerate the clear."""
     global LIVE_BYTES, PEAK_BYTES, DONATED_BYTES, ANALYSIS_CALLS
     global OOM_POSTMORTEMS, LIVE_PD_BYTES, PEAK_PD_BYTES
+    global STATIC_PREDICTION
     with _LOCK:
         _CENSUS.clear()
         _EXECS.clear()
         LIVE_BYTES = PEAK_BYTES = DONATED_BYTES = 0
         LIVE_PD_BYTES = PEAK_PD_BYTES = 0
         ANALYSIS_CALLS = OOM_POSTMORTEMS = 0
+        STATIC_PREDICTION = None
 
 
 # -------------------------------------------- per-executable memory analysis
@@ -411,6 +454,11 @@ def summary() -> Dict:
         "census": census_size(),
         "analysis_calls": ANALYSIS_CALLS,
         "oom_postmortems": OOM_POSTMORTEMS,
+        # STRING-keyed per-device byte map (json-round-trip safe — the
+        # PR-8 step-table key-type bug class)
+        "per_device": device_bytes(),
+        "static_prediction": dict(STATIC_PREDICTION)
+        if STATIC_PREDICTION else None,
         "top": census(8),
         "executables": execs[-8:],
     }
@@ -480,6 +528,28 @@ def _write_postmortem(where: str, err: BaseException, top_rows: List[Dict],
              f"watermark: live={LIVE_BYTES} B  peak={PEAK_BYTES} B  "
              f"donated_total={DONATED_BYTES} B  "
              f"census={census_size()} buffer(s)"]
+    sp = STATIC_PREDICTION
+    if sp:
+        # was this OOM statically foreseeable? Compare the mem-lint
+        # prediction for the program against the measured per-device
+        # PEAK watermark — the high-water mark the device actually
+        # reached, not whatever happens to be live at failure time
+        verdict = ("FORESEEABLE — the static plan predicted at least "
+                   "the measured watermark; `python -m "
+                   "paddle_tpu.analysis --mem` would have flagged "
+                   "oom_risk before the first run"
+                   if sp["pd_bytes"] >= PEAK_PD_BYTES else
+                   "under-predicted — the measured watermark exceeds "
+                   "the static plan (untracked allocations or a "
+                   "workload the recorded program does not cover)")
+        lines.append(
+            f"static predicted peak: {sp['pd_bytes']} B/device "
+            f"({sp['desc']}, mesh {sp['mesh'] or 'dp1'}) vs measured "
+            f"peak {PEAK_PD_BYTES} B/device: {verdict}")
+    else:
+        lines.append("static predicted peak: none recorded (run the "
+                     "mem lint — analysis.check_memory / `--mem` — "
+                     "over the step to know OOM risk before running)")
     if mem_info:
         pretty = " ".join(f"{k}={v}" for k, v in mem_info.items())
         lines.append(f"failing executable memory analysis: {pretty}")

@@ -138,6 +138,12 @@ def test_segment_cap_break_traced_and_static():
     caps = [d for d in report.by_checker("fusion_break")
             if d.data["kind"] == "segment_cap"]
     assert len(caps) == 1 and caps[0].data["count"] == 2
+    # satellite: the diagnostic carries the predicted whole-step
+    # window size and a CONCRETE cap-raise remedy (the eager-ResNet
+    # 2x/step cap trip used to be reported without one)
+    assert caps[0].data["window_ops"] == 10
+    assert caps[0].data["cap"] == 4
+    assert "FLAGS_lazy_max_segment_ops >= 10" in caps[0].hint
 
     # static form: an open context whose pending exceeds the cap
     with lazy.lazy_guard(max_segment_ops=1 << 30) as ctx:
@@ -151,6 +157,8 @@ def test_segment_cap_break_traced_and_static():
     caps = [d for d in static.by_checker("fusion_break")
             if d.data["kind"] == "segment_cap"]
     assert len(caps) == 1 and caps[0].data["count"] == 2
+    assert caps[0].data["window_ops"] == 10
+    assert "FLAGS_lazy_max_segment_ops >= 10" in caps[0].hint
 
 
 def test_perf_src_forced_without_static_checks():
