@@ -109,6 +109,21 @@ Row 15 mem static analyzer gate  runs `python -m paddle_tpu.analysis
                                 per-shape static totals ride as byte
                                 rows (down-good)
 
+Row 16 goodput plane  asserts the goodput-off path (WITH async flush
+                                on and every new probe exercised:
+                                ElasticStep marks, DevicePrefetcher
+                                input-wait pull, CheckpointManager
+                                save) freezes the registry AND the
+                                goodput step ring; reports the LeNet
+                                job goodput fraction over a budget
+                                window ('goodput %', up-good in
+                                --diff) with per-bucket us/step
+                                badput rows (down-good; a 0 -> N
+                                badput bucket gates like a findings
+                                row) and the bucket-additivity
+                                identity asserted from the same
+                                ledger the budget spans feed
+
 (Multi-chip GPT/ERNIE hybrids need a pod; their single-chip proxies are
 bench.py's headline + the dryrun_multichip compile check.)
 
@@ -1241,6 +1256,112 @@ def bench_mem_lint():
             "rows": rows}
 
 
+def bench_goodput():
+    """Row 16: goodput plane. Off contract asserted EXACTLY (the
+    rows-5..15 counter technique) with the async flush pipeline ON and
+    every new probe exercised on the off path: an ElasticStep-wrapped
+    capped chain (step marks + recovery probes), a DevicePrefetcher
+    pull from an exhausted-then-refilled source (the io::input_wait
+    stall probe) and a CheckpointManager save (the ckpt::save span
+    site) — across all of it the registry's MUTATIONS counter AND the
+    goodput step ring stay frozen, and the ledger never starts. The
+    reported value is the LeNet job goodput fraction over a budget
+    window (unit 'goodput %', up-good in --diff); the structural
+    badput buckets ride as us/step rows (down-good, 0 -> N gates like
+    a findings row) and the bucket-additivity identity is asserted
+    from the SAME ledger the budget's spans feed."""
+    import tempfile
+
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu._core import async_flush
+    from paddle_tpu.distributed.checkpoint import CheckpointManager
+    from paddle_tpu.distributed.resilience import ElasticStep
+    from paddle_tpu.io import DevicePrefetcher
+    from paddle_tpu.observability import budget as budget_mod
+    from paddle_tpu.observability import goodput as goodtel
+    from paddle_tpu.observability import metrics
+
+    x = paddle.to_tensor(np.ones((16, 16), "float32"))
+
+    def chain():
+        y = x
+        for _ in range(16):
+            y = y * 1.0001 + 0.0001
+        return np.asarray(y._value)
+
+    w = paddle.to_tensor(np.zeros((8, 8), "float32"))
+    opt = paddle.optimizer.SGD(0.0, parameters=[w])
+    elastic = ElasticStep(optimizer=opt)
+    ckpt_dir = tempfile.mkdtemp(prefix="pt_goodput_ckpt_")
+
+    from paddle_tpu._core.flags import flag_value
+    checks_was = flag_value("FLAGS_static_checks")
+    # checks off for the freeze window: the warn-mode sanitizer sweep
+    # counts registry work by design (the rows-10..14 precedent)
+    paddle.set_flags({"FLAGS_async_flush": True,
+                      "FLAGS_lazy_max_segment_ops": 16,
+                      "FLAGS_static_checks": "off"})
+    try:
+        _timeit(chain, steps=10, warmup=5)
+        elastic.run(chain)           # warm the elastic path
+        async_flush.drain()
+        # ---------------- goodput OFF: the freeze contract
+        before = metrics.MUTATIONS
+        ring0 = goodtel.RING_MUTATIONS
+        for _ in range(30):
+            elastic.run(chain)
+        for _ in DevicePrefetcher(iter([np.ones((4, 4), "float32")])):
+            pass
+        CheckpointManager(ckpt_dir, keep=1).save(
+            {"w": np.zeros((8, 8), "float32")}, step=0)
+        async_flush.drain()
+        assert metrics.MUTATIONS == before, \
+            "goodput-off loop did registry work (must be 0)"
+        assert goodtel.RING_MUTATIONS == ring0, \
+            "goodput-off loop mutated the step ring (must be 0)"
+        assert not goodtel.LEDGER._started, \
+            "goodput-off loop started the ledger"
+    finally:
+        paddle.set_flags({"FLAGS_async_flush": False,
+                          "FLAGS_lazy_max_segment_ops": 256,
+                          "FLAGS_static_checks": checks_was})
+        async_flush.drain(raise_latched=False)
+        elastic.shutdown()
+
+    # ---------------- LeNet job goodput over a budget window (the
+    # collect call turns the plane on, wraps each step with ledger
+    # marks, and budget_section asserts the additivity identity)
+    from paddle_tpu.observability.__main__ import _lenet_step
+    snap = budget_mod.collect(_lenet_step(), steps=8, warmup=3)
+    g = snap["goodput"]
+    assert g["additivity_ok"], g
+    per = g["buckets_us_per_step"]
+    # the structural stall classes gate in --diff; host/idle are box
+    # noise and ride the row json as plain fields instead
+    rows = [{"metric": f"LeNet goodput badput: {b} "
+                       "(b32 budget window)",
+             "value": per.get(b, 0.0), "unit": "us/step badput"}
+            for b in ("compile", "input_wait", "comm_wait", "ckpt_io",
+                      "recovery")]
+    rows.insert(0, {"metric": "LeNet job goodput fraction "
+                              "(b32 budget window)",
+                    "value": round((g["goodput_frac"] or 0.0) * 100.0,
+                                   2),
+                    "unit": "goodput %"})
+    return {"metric": "goodput plane (off = frozen counters + frozen "
+                      "step ring across elastic/prefetch/ckpt probes, "
+                      "async flush on; LeNet bucket additivity "
+                      "asserted)",
+            "value": round((g["goodput_frac"] or 0.0) * 100.0, 2),
+            "unit": "goodput %",
+            "lenet_wall_us_per_step": g["wall_us_per_step"],
+            "lenet_host_us_per_step": per.get("host", 0.0),
+            "lenet_idle_us_per_step": per.get("idle", 0.0),
+            "buckets_us_per_step": per,
+            "rows": rows}
+
+
 # ------------------------------------------------------------- diff mode
 
 def _rows_of(path: str) -> dict:
@@ -1280,15 +1401,16 @@ def _lower_is_better(metric: str, unit: str) -> bool:
     u = unit.lower()
     # a RATE unit ends its first token with '/s' (tokens/s, ops/s);
     # 'us/step publication overhead' must not match. Efficiency units
-    # (mfu, gflops — bench row 14's LeNet snapshot rows) are up-good:
-    # an MFU drop is exactly the regression the compute plane gates.
+    # (mfu, gflops — bench row 14's LeNet snapshot rows — and row 16's
+    # 'goodput %') are up-good: an efficiency drop is exactly the
+    # regression those planes gate.
     first = u.split()[0] if u.split() else ""
     if first.endswith("/s") or u.startswith("x ") \
-            or first in ("mfu", "gflops"):
+            or first in ("mfu", "gflops", "goodput"):
         return False
     text = f"{metric} {u}".lower()
     return any(w in text for w in ("overhead", "latency", "ms", "% ",
-                                   "bytes"))
+                                   "bytes", "badput"))
 
 
 def diff_mode(threshold: float = 0.10) -> int:
@@ -1303,11 +1425,13 @@ def diff_mode(threshold: float = 0.10) -> int:
         return 2
     old_path, new_path = files[-2], files[-1]
     old, new = _rows_of(old_path), _rows_of(new_path)
-    # a zero old value is only comparable for count rows ('findings'):
-    # 0 -> 1 findings is exactly the regression the perf-lint gate
-    # exists to catch, while a 0 rate/latency row is a broken sample
+    # a zero old value is only comparable for count rows ('findings')
+    # and row 16's badput buckets: 0 -> 1 findings (or 0 -> a new
+    # stall class) is exactly the regression those gates exist to
+    # catch, while a 0 rate/latency row is a broken sample
     shared = [m for m in new
-              if m in old and (old[m][0] or old[m][1] == "findings")]
+              if m in old and (old[m][0] or old[m][1] == "findings"
+                               or "badput" in old[m][1])]
     regressions = []
     for m in shared:
         ov, unit = old[m]
@@ -1318,6 +1442,12 @@ def diff_mode(threshold: float = 0.10) -> int:
             # models is a regression, however small the percentage
             change = (nv - ov) / abs(ov) if ov else (1.0 if nv else 0.0)
             worse = nv > ov
+        elif "badput" in unit and not ov:
+            # a badput bucket appearing from zero is a NEW stall class
+            # (injected feed stall, recovery in a clean run) — gate it
+            # above a 50us/step floor so rounding noise cannot trip it
+            change = 1.0 if nv else 0.0
+            worse = nv > 50.0
         else:
             change = (nv - ov) / abs(ov)
             worse = change > threshold if _lower_is_better(m, unit) \
@@ -1352,14 +1482,15 @@ def main():
         return
     rows = os.environ.get(
         "BENCH_ROWS",
-        "1,2,3,4,5,6,7,8,9,10,11,12,13,14,15").split(",")
+        "1,2,3,4,5,6,7,8,9,10,11,12,13,14,15,16").split(",")
     table = {"1": bench_lenet, "2": bench_resnet50, "3": bench_bert,
              "4": bench_dispatch, "5": bench_static_checks,
              "6": bench_observability, "7": bench_resilience,
              "8": bench_replan, "9": bench_async_flush,
              "10": bench_telemetry, "11": bench_memory,
              "12": bench_spmd_multichip, "13": bench_perf_lint,
-             "14": bench_compute, "15": bench_mem_lint}
+             "14": bench_compute, "15": bench_mem_lint,
+             "16": bench_goodput}
     for r in rows:
         r = r.strip()
         out = table[r]()

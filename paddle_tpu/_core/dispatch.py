@@ -214,6 +214,12 @@ def _check_nan_inf(name: str, outs):
             bad = bool(jnp.any(~jnp.isfinite(o)))
             if bad:
                 msg = f"NaN/Inf detected in output {i} of op '{name}'"
+                if _obs.GOODPUT:
+                    # job-health anomaly regardless of the scan's
+                    # raise/warn level: the goodput plane's NaN watch
+                    # rides the existing scan instead of re-scanning
+                    from ..observability import goodput
+                    goodput.note_nan(name)
                 if flags.flag_value("FLAGS_check_nan_inf_level") >= 1:
                     import warnings
                     warnings.warn(msg)

@@ -467,6 +467,38 @@ define_flag("FLAGS_memory_budget_bytes", 0,
             "column: budget --distributed flags the rank whose peak is "
             "nearest this budget (0 = unknown; the highest absolute "
             "peak is flagged instead).")
+define_flag("FLAGS_goodput", False,
+            "Goodput plane (observability/goodput.py): per-process "
+            "wall-clock attribution ledger partitioning the job "
+            "timeline into exclusive states (productive execute, "
+            "compile, input wait, comm wait, host gap, checkpoint "
+            "I/O, recovery, idle) with bucket additivity asserted, a "
+            "bounded step-time ring feeding anomaly detection, and a "
+            "hang watchdog that captures stacks + dumps the flight "
+            "ring when no step progress happens within "
+            "FLAGS_goodput_hang_factor x the median step time. Off = "
+            "one module-level check per probe, zero ring mutations "
+            "(bench row 16).")
+define_flag("FLAGS_goodput_hang_factor", 8.0,
+            "Goodput hang watchdog: the job is declared hung when no "
+            "probe-visible progress happens within this factor x the "
+            "rolling median step time (floored by "
+            "FLAGS_goodput_hang_min_s).")
+define_flag("FLAGS_goodput_hang_min_s", 1.0,
+            "Goodput hang watchdog: floor on the dynamic timeout so "
+            "micro-second steps cannot arm a hair-trigger deadline "
+            "over a legitimate recompile.")
+define_flag("FLAGS_goodput_hang_poll_s", 0.25,
+            "Goodput hang watchdog: watchdog-thread poll interval in "
+            "seconds (bounds detection latency beyond the timeout).")
+define_flag("FLAGS_goodput_spike_factor", 3.0,
+            "Goodput anomaly detection: a step slower than this "
+            "factor x the rolling median counts "
+            "goodput.anomalies.step_spike (same factor watches loss "
+            "divergence via note_loss).")
+define_flag("FLAGS_goodput_ring", 128,
+            "Goodput step-time ring capacity (rolling median window "
+            "for the spike and hang thresholds).")
 define_flag("FLAGS_flight_max_dumps", 32,
             "Flight-recorder dump retention: per-rank cap on "
             "flight_*.txt files kept in FLAGS_flight_recorder_dir "

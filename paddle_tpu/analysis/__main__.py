@@ -404,12 +404,17 @@ def perf_resnet50_eager(verbose: bool):
     syncs — the batch-norm running-stat update is pure in-window
     elementwise state math now (nn/functional/norm.py set_value
     aliases the pending result instead of reading ``mean._value``
-    back). This row was the 53-materialize-seals/step finding of
-    BUDGET_r06; the gate now exists to catch the class COMING BACK."""
+    back) — and ZERO breaks: the step records 547 ops, so the config
+    applies the lint's own segment_cap remedy
+    (``set FLAGS_lazy_max_segment_ops >= 547``) and the whole step
+    seals once at backward instead of paying 2 cap breaks/step. This
+    row was the 53-materialize-seals/step finding of BUDGET_r06; the
+    gate now exists to catch either class COMING BACK."""
     import numpy as np
     import paddle_tpu as paddle
     import paddle_tpu.nn.functional as F
     from paddle_tpu import analysis
+    from paddle_tpu._core.flags import flag_value
     from paddle_tpu.vision.models import resnet50
 
     paddle.seed(0)
@@ -427,7 +432,12 @@ def perf_resnet50_eager(verbose: bool):
         opt.clear_grad()
         np.asarray(loss._value)
 
-    report, counts, _ = analysis.trace_step(step)
+    cap_was = flag_value("FLAGS_lazy_max_segment_ops")
+    paddle.set_flags({"FLAGS_lazy_max_segment_ops": 1024})
+    try:
+        report, counts, _ = analysis.trace_step(step)
+    finally:
+        paddle.set_flags({"FLAGS_lazy_max_segment_ops": cap_was})
     d = _perf_note("resnet50-eager", report, counts)
     _perf_print("resnet50-eager", d, report, verbose)
     return report

@@ -35,6 +35,7 @@ import numpy as np
 _LOG = logging.getLogger(__name__)
 
 from .._core.tensor import Tensor
+from ..observability import _state as _OBS
 from .api import DistAttr, shard_tensor
 from .mesh import ProcessMesh
 from .placements import Partial, Replicate, Shard
@@ -112,17 +113,34 @@ def save_state_dict(state_dict: Dict[str, Tensor], path: str,
     # so its checksum can ride the metadata; both files land via
     # temp-write + os.replace (data first — a crash in between leaves
     # the OLD metadata whose checksum then refuses the new data with a
-    # clear error instead of loading a mixed checkpoint)
-    data_blob = pickle.dumps(data)
-    meta["__checkpoint_format__"] = {
-        "version": 2,
-        "checksums": {"data_rank0.pkl": _checksum(data_blob)},
-    }
-    ckpt = _retry.ckpt_policy()
-    ckpt.run(_atomic_write, os.path.join(path, "data_rank0.pkl"),
-             data_blob, what="ckpt::write(data)")
-    ckpt.run(_atomic_write, os.path.join(path, "metadata.pkl"),
-             pickle.dumps(meta), what="ckpt::write(meta)")
+    # clear error instead of loading a mixed checkpoint).
+    # The ckpt::save span covers serialization + both writes with the
+    # payload bytes as its arg: checkpoint I/O was an unmetered fault
+    # site since PR 5 — the time feeds the goodput ckpt bucket, the
+    # bytes price the retention policy.
+    sp = None
+    if _OBS.ACTIVE:
+        from ..observability.spans import span as _span
+        sp = _span("ckpt::save", hist="ckpt.save_us", bytes=0).begin()
+    try:
+        data_blob = pickle.dumps(data)
+        meta["__checkpoint_format__"] = {
+            "version": 2,
+            "checksums": {"data_rank0.pkl": _checksum(data_blob)},
+        }
+        if sp is not None:
+            sp.args["bytes"] = len(data_blob)
+        ckpt = _retry.ckpt_policy()
+        ckpt.run(_atomic_write, os.path.join(path, "data_rank0.pkl"),
+                 data_blob, what="ckpt::write(data)")
+        ckpt.run(_atomic_write, os.path.join(path, "metadata.pkl"),
+                 pickle.dumps(meta), what="ckpt::write(meta)")
+    except BaseException as e:
+        if sp is not None:
+            sp.end(error=e)
+        raise
+    if sp is not None:
+        sp.end()
 
 
 def load_state_dict(state_dict: Dict[str, Tensor], path: str,
@@ -132,7 +150,24 @@ def load_state_dict(state_dict: Dict[str, Tensor], path: str,
     are re-laid-out to whatever mesh the target uses now)."""
     if _faults.ACTIVE:
         _faults.inject("ckpt::load")
+    # ckpt::load span over read + verify + unpickle + device placement
+    # (payload bytes filled in once the data file is read)
+    sp = None
+    if _OBS.ACTIVE:
+        from ..observability.spans import span as _span
+        sp = _span("ckpt::load", hist="ckpt.load_us", bytes=0).begin()
+    try:
+        out = _load_state_dict_impl(state_dict, path, sp)
+    except BaseException as e:
+        if sp is not None:
+            sp.end(error=e)
+        raise
+    if sp is not None:
+        sp.end()
+    return out
 
+
+def _load_state_dict_impl(state_dict, path, sp):
     def _read(p):
         with open(p, "rb") as f:
             return f.read()
@@ -140,6 +175,8 @@ def load_state_dict(state_dict: Dict[str, Tensor], path: str,
     ckpt = _retry.ckpt_policy()
     data_blob = ckpt.run(_read, os.path.join(path, "data_rank0.pkl"),
                          what="ckpt::read(data)")
+    if sp is not None:
+        sp.args["bytes"] = len(data_blob)
     # verify the per-file checksum BEFORE unpickling: a torn or
     # bit-rotted data file fails with a clear framework error instead
     # of loading garbage (or executing a corrupt pickle stream).

@@ -558,9 +558,22 @@ class AdaptiveTrainer:
         except _RETRYABLE_STEP:
             if self.ckpt is None or self.ckpt.latest() is None:
                 raise
-            self._quiesce(drop=True)
-            self.restore_from_checkpoint()
-            out = self._elastic.run(step_fn, *args, **kw)
+            # last-line recovery (rollback budget exhausted): the whole
+            # quiesce -> verified-generation reload -> re-run window is
+            # badput; the goodput ledger prices it under its recovery
+            # bucket (off = one module-attribute read)
+            from ...observability import _state as _OBS
+            _goodput = None
+            if _OBS.GOODPUT:
+                from ...observability import goodput as _goodput
+                _goodput.recovery_begin()
+            try:
+                self._quiesce(drop=True)
+                self.restore_from_checkpoint()
+                out = self._elastic.run(step_fn, *args, **kw)
+            finally:
+                if _goodput is not None:
+                    _goodput.recovery_end()
         if self._replan_t0 is not None:
             self.last_replan_latency_s = \
                 time.perf_counter() - self._replan_t0

@@ -171,55 +171,86 @@ class ElasticStep:
         attempt = 0
         deaths = 0
         detect_t: Optional[float] = None
-        while True:
-            try:
-                if _flags.FAULT_INJECT_ACTIVE:
-                    from . import faults
-                    faults.inject(site)
-                out = step_fn(*args, **kw)
-                self._check_watchdog()
-                if _OBS.DIST:
-                    # cross-rank telemetry: stamp the step boundary and
-                    # (per the interval flag) publish this rank's frame.
-                    # Off = this one module-attribute read.
-                    from ...observability import distributed as _dtel
-                    _dtel.on_step(self.step_index)
-                if detect_t is not None:
-                    self.last_recovery_s = time.perf_counter() - detect_t
-                    from ...observability import metrics
-                    metrics.observe("resilience.recovery_us",
-                                    self.last_recovery_s * 1e6)
-                return out
-            except RankDeath as e:
-                detect_t = time.perf_counter()
-                deaths += 1
-                self._note_failure(site, e, kind="rank_death")
-                # bounded like the transient path: a death that
-                # recurs on every post-shrink re-run (or a handler
-                # that fails to evict the dead rank) must not spin
-                # restore->shrink->re-run forever
-                if self._on_rank_death is None or deaths > budget:
-                    if self._on_rank_death is not None:
+        # goodput ledger step boundary + recovery window: off = this
+        # one module-attribute read (the DIST-hook discipline; the
+        # precise GOODPUT gate so other planes being on neither
+        # imports the goodput module nor pays its no-op calls). The
+        # recovery window opens at the FIRST failure of this step and
+        # closes with the recovery_us observation, so the ledger's
+        # recovery bucket and the histogram measure the same wall.
+        _goodput = None
+        if _OBS.GOODPUT:
+            from ...observability import goodput as _goodput
+            _goodput.step_begin(self.step_index)
+        recovering = False
+        try:
+            while True:
+                try:
+                    if _flags.FAULT_INJECT_ACTIVE:
+                        from . import faults
+                        faults.inject(site)
+                    out = step_fn(*args, **kw)
+                    self._check_watchdog()
+                    if _OBS.DIST:
+                        # cross-rank telemetry: stamp the step boundary
+                        # and (per the interval flag) publish this
+                        # rank's frame. Off = one module-attr read.
+                        from ...observability import distributed as _dtel
+                        _dtel.on_step(self.step_index)
+                    if detect_t is not None:
+                        self.last_recovery_s = \
+                            time.perf_counter() - detect_t
+                        from ...observability import metrics
+                        metrics.observe("resilience.recovery_us",
+                                        self.last_recovery_s * 1e6)
+                        if _goodput is not None and recovering:
+                            _goodput.recovery_end()
+                            recovering = False
+                    if _goodput is not None:
+                        _goodput.step_end(self.step_index)
+                    return out
+                except RankDeath as e:
+                    detect_t = time.perf_counter()
+                    if _goodput is not None and not recovering:
+                        _goodput.recovery_begin()
+                        recovering = True
+                    deaths += 1
+                    self._note_failure(site, e, kind="rank_death")
+                    # bounded like the transient path: a death that
+                    # recurs on every post-shrink re-run (or a handler
+                    # that fails to evict the dead rank) must not spin
+                    # restore->shrink->re-run forever
+                    if self._on_rank_death is None or deaths > budget:
+                        if self._on_rank_death is not None:
+                            from ...observability import metrics
+                            metrics.inc("resilience.gave_up")
+                        raise
+                    # confirmed rank loss: restore the pre-step state,
+                    # let the handler rebuild the world (shrink_world),
+                    # then re-run the step on the survivors
+                    self._restore(snap)
+                    self._on_rank_death(e)
+                    self._count_rollback(site, e)
+                except _RETRYABLE_STEP as e:
+                    detect_t = time.perf_counter()
+                    if _goodput is not None and not recovering:
+                        _goodput.recovery_begin()
+                        recovering = True
+                    self._heartbeat()  # the stall is over; stop the clock
+                    attempt += 1
+                    self._note_failure(site, e, kind="step_failure")
+                    if attempt > budget:
                         from ...observability import metrics
                         metrics.inc("resilience.gave_up")
-                    raise
-                # confirmed rank loss: restore the pre-step state, let
-                # the handler rebuild the world (shrink_world), then
-                # re-run the step on the survivors
-                self._restore(snap)
-                self._on_rank_death(e)
-                self._count_rollback(site, e)
-            except _RETRYABLE_STEP as e:
-                detect_t = time.perf_counter()
-                self._heartbeat()   # the stall is over; stop the clock
-                attempt += 1
-                self._note_failure(site, e, kind="step_failure")
-                if attempt > budget:
-                    from ...observability import metrics
-                    metrics.inc("resilience.gave_up")
-                    raise
-                self._restore(snap)
-                self._count_rollback(site, e)
+                        raise
+                    self._restore(snap)
+                    self._count_rollback(site, e)
+        except BaseException:
+            # a step that gives up must not leak its in-step/recovery
+            # ledger state into the caller's timeline
+            if _goodput is not None:
+                _goodput.step_abort()
+            raise
 
     # ------------------------------------------------------ accounting
     @staticmethod

@@ -77,6 +77,15 @@ class CommTaskManager:
         with self._lock:
             self._tasks.pop(name, None)
 
+    def set_timeout(self, name: str, timeout: float):
+        """Retune a live task's deadline (the goodput hang watchdog
+        derives its timeout from the rolling median step time, so it
+        tightens as the job settles)."""
+        with self._lock:
+            t = self._tasks.get(name)
+            if t is not None:
+                t.timeout = float(timeout)
+
     def timed_out(self, name: str) -> bool:
         with self._lock:
             t = self._tasks.get(name)

@@ -507,13 +507,30 @@ class DevicePrefetcher:
         import collections
         ring = collections.deque()
         it = self._source
+        from ..observability import _state as _obs
         try:
             while True:
                 while len(ring) < depth:
+                    # an empty ring means the NEXT pull blocks the
+                    # training thread on the source (the feed stall
+                    # that used to hide inside the host gap): the
+                    # io::input_wait span + io.input_wait_us histogram
+                    # make it visible and feed the goodput plane's
+                    # input-wait bucket. Top-up pulls with a batch
+                    # already buffered are prefetch work, not a stall.
+                    starved = not ring and _obs.ACTIVE
                     try:
-                        # device_put returns immediately; the transfer
-                        # proceeds while earlier batches compute
-                        ring.append(self._to_device(next(it)))
+                        if starved:
+                            from ..observability.spans import span
+                            with span("io::input_wait",
+                                      hist="io.input_wait_us"):
+                                nxt = next(it)
+                        else:
+                            # device_put returns immediately; the
+                            # transfer proceeds while earlier batches
+                            # compute
+                            nxt = next(it)
+                        ring.append(self._to_device(nxt))
                     except StopIteration:
                         break
                 if not ring:

@@ -70,6 +70,22 @@ def _on_compute_flag(on):
 _flags.watch_flag("FLAGS_compute_telemetry", _on_compute_flag)
 
 
+def _on_goodput_flag(on):
+    import sys as _sys
+    _state.set_goodput(bool(on))
+    # the goodput module is only imported once the plane is first
+    # turned ON (the resilience-package laziness discipline); after
+    # that, flips keep its ledger/watchdog coherent
+    mod = _sys.modules.get(__name__ + ".goodput")
+    if on:
+        from . import goodput as mod
+    if mod is not None:
+        mod._sync(bool(on))
+
+
+_flags.watch_flag("FLAGS_goodput", _on_goodput_flag)
+
+
 def enable(flight_recorder: bool = None):
     """Turn on metrics collection (and optionally the flight recorder)."""
     f = {"FLAGS_observability": True}
@@ -139,6 +155,11 @@ def stats(reset_after: bool = False) -> dict:
         # totals + the per-chip peak the MFU column divides by)
         from . import compute as _compute
         snap["compute"] = _compute.summary()
+    if _state.GOODPUT:
+        # job-level wall attribution: the exclusive bucket partition,
+        # goodput fraction and top badput source from the ledger
+        from . import goodput as _goodtel
+        snap["goodput"] = _goodtel.summary()
     if reset_after:
         reset()
     return snap

@@ -18,6 +18,7 @@ FLIGHT = False    # FLAGS_flight_recorder: ring-buffer event capture
 DIST = False      # FLAGS_distributed_telemetry: cross-rank frame plane
 MEM = False       # FLAGS_memory_telemetry: live-buffer census + bytes
 COMPUTE = False   # FLAGS_compute_telemetry: FLOPs accounting + MFU
+GOODPUT = False   # FLAGS_goodput: wall-clock attribution ledger
 
 # The single gate hot paths read: any consumer on.
 ACTIVE = False
@@ -25,7 +26,8 @@ ACTIVE = False
 
 def recompute():
     global ACTIVE
-    ACTIVE = METRICS or TRACE or FLIGHT or DIST or MEM or COMPUTE
+    ACTIVE = METRICS or TRACE or FLIGHT or DIST or MEM or COMPUTE \
+        or GOODPUT
 
 
 def set_metrics(on: bool):
@@ -61,4 +63,10 @@ def set_mem(on: bool):
 def set_compute(on: bool):
     global COMPUTE
     COMPUTE = bool(on)
+    recompute()
+
+
+def set_goodput(on: bool):
+    global GOODPUT
+    GOODPUT = bool(on)
     recompute()

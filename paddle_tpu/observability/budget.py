@@ -55,23 +55,41 @@ def collect(run_fn: Callable[[], None], steps: int,
     ridge point) saying compute-bound vs memory-bound."""
     from . import enable, disable, stats
     from . import compute as _comptel
+    from . import goodput as _goodtel
     from . import memory as _memtel
     from .._core.flags import flag_value, set_flags
 
     mem_was = flag_value("FLAGS_memory_telemetry")
     comp_was = flag_value("FLAGS_compute_telemetry")
+    good_was = flag_value("FLAGS_goodput")
     planes = {}
     if not mem_was:
         planes["FLAGS_memory_telemetry"] = True
     if not comp_was:
         planes["FLAGS_compute_telemetry"] = True
+    if not good_was:
+        planes["FLAGS_goodput"] = True
     if planes:
         set_flags(planes)
+
+    def stepped():
+        # the goodput ledger's step boundary: outermost marks only, so
+        # a workload that already runs under ElasticStep (whose run()
+        # marks its own steps) nests instead of double counting. A
+        # step that raises ABORTS (no ring entry, recovery state
+        # unwound) instead of being recorded as completed.
+        _goodtel.step_begin()
+        try:
+            run_fn()
+        except BaseException:
+            _goodtel.step_abort()
+            raise
+        _goodtel.step_end()
     try:
         seq0 = _memtel.exec_seq()
         cseq0 = _comptel.exec_seq()
         for _ in range(warmup):
-            run_fn()
+            stepped()
         was_on = flag_value("FLAGS_observability")
         enable()
         # delta against a pre-run snapshot, NOT reset(): a session that
@@ -83,10 +101,12 @@ def collect(run_fn: Callable[[], None], steps: int,
         flops0 = _comptel.executed_flops()
         cbytes0 = _comptel.executed_bytes()
         calls0 = _comptel.COST_CALLS
+        good0 = _goodtel.snapshot()
         t0 = time.perf_counter()
         for _ in range(steps):
-            run_fn()
+            stepped()
         wall_us = (time.perf_counter() - t0) * 1e6
+        good1 = _goodtel.snapshot()
         snap = _delta(before, stats())
         peak = _memtel.peak_bytes()
         peak_pd = _memtel.peak_per_device_bytes()
@@ -107,9 +127,15 @@ def collect(run_fn: Callable[[], None], steps: int,
             restore["FLAGS_memory_telemetry"] = False
         if not comp_was:
             restore["FLAGS_compute_telemetry"] = False
+        if not good_was:
+            restore["FLAGS_goodput"] = False
         if restore:
             set_flags(restore)
     out = _rank(snap, wall_us, steps)
+    # job-level wall attribution over the measured window, from the
+    # SAME ledger the spans feed (no second timing source); the bucket
+    # additivity identity is asserted inside budget_section
+    out["goodput"] = _goodtel.budget_section(good0, good1, steps)
     achieved = flops / (wall_us * 1e-6) if wall_us else 0.0
     out["compute"] = {
         "flops_per_step": round(flops / steps, 1),
@@ -374,6 +400,10 @@ def render(budget: Dict, title: str = "per-step budget") -> str:
             f"{comp['peak_flops'] / 1e9:.0f} GFLOP/s peak | "
             f"AI {comp['arith_intensity']:.2f} FLOP/B vs ridge "
             f"{comp['ridge_intensity']:.2f} ({bound})")
+    good = budget.get("goodput")
+    if good:
+        from . import goodput as _goodtel
+        lines.append("  " + _goodtel.render_line(good))
     lines.append("  ranked components:")
     for e in budget["entries"]:
         calls = ("" if e["calls_per_step"] is None

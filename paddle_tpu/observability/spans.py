@@ -32,13 +32,23 @@ class Span:
 
     def begin(self):
         self._t0 = time.perf_counter_ns()
+        if _state.GOODPUT:
+            # the attribution ledger's state transition: entering a
+            # mapped span (execute/compile/comm/io/ckpt/...) switches
+            # the wall-clock bucket the goodput plane accrues into
+            from . import goodput
+            goodput.on_span_begin(self.name, self._t0)
         return self
 
     def end(self, error=None):
         if self._t0 is None:
             return
         t0, self._t0 = self._t0, None
-        dur_us = (time.perf_counter_ns() - t0) / 1000.0
+        now_ns = time.perf_counter_ns()
+        dur_us = (now_ns - t0) / 1000.0
+        if _state.GOODPUT:
+            from . import goodput
+            goodput.on_span_end(self.name, now_ns, dur_us)
         if _state.METRICS and self.hist is not None:
             metrics.observe(self.hist, dur_us)
         if _state.TRACE:

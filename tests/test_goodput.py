@@ -1,0 +1,419 @@
+"""Goodput plane (observability/goodput.py): wall-clock attribution
+ledger (bucket additivity, recovery accounting), the off-freeze
+contract, the io::input_wait / ckpt::save/load probes, step-time +
+NaN/loss anomaly detection, the hang watchdog drill, and the
+cross-rank goodput report (frames, cluster report, input-bound
+straggler verdict). ISSUE 14 tentpole."""
+import glob
+import os
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import observability as obs
+from paddle_tpu.observability import _state, goodput, metrics
+from paddle_tpu.observability import distributed as dtel
+
+from conftest import with_flag
+
+
+@pytest.fixture
+def goodput_on():
+    with with_flag("FLAGS_goodput", True):
+        yield
+    # the ledger stops with the flag; drop any anomaly counters the
+    # test seeded
+    obs.reset()
+
+
+class _FakePG:
+    """ProcessGroup stand-in: quacks enough for _resilient's
+    sequence-counter snapshot (the test_distributed_telemetry
+    pattern)."""
+
+    def __init__(self):
+        self.rank, self.size, self.global_rank = 0, 2, 0
+        self._seq, self._p2p_seq, self._barrier_round = 0, {}, 0
+
+    def all_reduce(self, arr, op):
+        return arr
+
+
+def _chain_step(x, n=8):
+    y = x
+    for _ in range(n):
+        y = y * 1.0001 + 0.0001
+    return np.asarray(y._value)
+
+
+# --------------------------------------------------------- off contract
+
+def test_goodput_off_is_zero_work(tmp_path):
+    """Plane off (async flush ON): frozen registry, frozen step ring,
+    ledger never starts — across every new probe: ElasticStep step
+    marks, the DevicePrefetcher input-wait pull, a checkpoint save."""
+    from paddle_tpu._core import async_flush
+    from paddle_tpu.distributed.checkpoint import CheckpointManager
+    from paddle_tpu.distributed.resilience import ElasticStep
+    from paddle_tpu.io import DevicePrefetcher
+
+    assert _state.GOODPUT is False
+    w = paddle.to_tensor(np.zeros((4, 4), "float32"))
+    opt = paddle.optimizer.SGD(0.0, parameters=[w])
+    elastic = ElasticStep(optimizer=opt)
+    x = paddle.to_tensor(np.ones((8, 8), "float32"))
+    with with_flag("FLAGS_async_flush", True), \
+            with_flag("FLAGS_static_checks", "off"):
+        elastic.run(lambda: _chain_step(x))      # warm
+        async_flush.drain()
+        before = metrics.MUTATIONS
+        ring0 = goodput.RING_MUTATIONS
+        for _ in range(5):
+            elastic.run(lambda: _chain_step(x))
+        for _ in DevicePrefetcher(iter([np.ones((4, 4), "float32")])):
+            pass
+        CheckpointManager(str(tmp_path), keep=1).save(
+            {"w": np.zeros((4, 4), "float32")}, step=0)
+        async_flush.drain()
+        assert metrics.MUTATIONS == before
+        assert goodput.RING_MUTATIONS == ring0
+        assert not goodput.LEDGER._started
+    async_flush.drain(raise_latched=False)
+    elastic.shutdown()
+
+
+# ----------------------------------------------------------- additivity
+
+def test_bucket_additivity_lenet_budget(monkeypatch):
+    """The acceptance identity: over a LeNet budget run the exclusive
+    buckets sum to the measured wall within 5%, and the budget tool
+    renders its goodput line from the SAME ledger (no second timing
+    source)."""
+    from paddle_tpu.observability import budget
+    from paddle_tpu.observability.__main__ import _lenet_step
+
+    monkeypatch.setenv("BUDGET_BATCH", "8")
+    out = budget.collect(_lenet_step(), steps=4, warmup=2)
+    g = out["goodput"]
+    assert g["additivity_ok"]
+    total = sum(g["buckets_us_per_step"].values())
+    # ledger wall == bucket sum (construction) == measured wall (5%)
+    assert total == pytest.approx(g["wall_us_per_step"], rel=0.01)
+    assert total == pytest.approx(out["wall_us_per_step"], rel=0.05)
+    assert g["buckets_us_per_step"]["execute"] > 0
+    assert "goodput:" in budget.render(out)
+    assert not _state.GOODPUT   # collect restored the plane
+
+
+def test_snapshot_additivity_and_stats_section(goodput_on):
+    x = paddle.to_tensor(np.ones((8, 8), "float32"))
+    for _ in range(3):
+        goodput.step_begin()
+        _chain_step(x)
+        goodput.step_end(loss=1.0)
+    snap = goodput.snapshot()
+    assert goodput.check_additivity(snap)
+    assert snap["steps"] == 3 and snap["median_step_us"] > 0
+    with with_flag("FLAGS_observability", True):
+        sec = obs.stats()["goodput"]
+    assert sec["goodput_frac"] is not None
+    assert sec["additivity_ok"]
+
+
+# --------------------------------------------------------------- probes
+
+def test_input_wait_probe_feeds_histogram_and_bucket(goodput_on):
+    """A training thread blocked on an empty DevicePrefetcher source is
+    no longer invisible host gap: io::input_wait meters the stall and
+    the ledger's input-wait bucket carries it."""
+    from paddle_tpu.io import DevicePrefetcher
+
+    def slow_src():
+        for _ in range(3):
+            time.sleep(0.02)
+            yield np.ones((4, 4), "float32")
+
+    with with_flag("FLAGS_observability", True):
+        h0 = metrics.snapshot()["histograms"].get(
+            "io.input_wait_us", {"count": 0})["count"]
+        b0 = goodput.snapshot()["buckets"]["input_wait"]
+        for _ in DevicePrefetcher(slow_src()):
+            pass
+        h = metrics.snapshot()["histograms"]["io.input_wait_us"]
+        assert h["count"] > h0
+        assert h["max"] >= 15000.0   # the 20ms sleep was metered
+        assert goodput.snapshot()["buckets"]["input_wait"] \
+            - b0 >= 15000.0
+
+
+def test_ckpt_spans_time_and_bytes(goodput_on, tmp_path):
+    """ckpt::save / ckpt::load meter the checkpoint I/O the fault
+    sites have had since PR 5, payload bytes included; the ledger's
+    ckpt bucket carries the time."""
+    from paddle_tpu.distributed.checkpoint import (load_state_dict,
+                                                   save_state_dict)
+
+    state = {"w": paddle.to_tensor(
+        np.ones((64, 64), "float32"))}      # 16 KB payload
+    with with_flag("FLAGS_observability", True), \
+            with_flag("FLAGS_distributed_telemetry", True):
+        dtel.shutdown()          # clean event ring
+        save_state_dict(state, str(tmp_path / "ckpt"))
+        load_state_dict(state, str(tmp_path / "ckpt"))
+        hists = metrics.snapshot()["histograms"]
+        assert hists["ckpt.save_us"]["count"] == 1
+        assert hists["ckpt.load_us"]["count"] == 1
+        events = dtel._drain_events()
+    saves = [e for e in events if e[0] == "ckpt::save"]
+    loads = [e for e in events if e[0] == "ckpt::load"]
+    assert saves and saves[0][3] >= 64 * 64 * 4   # bytes arg rides
+    assert loads and loads[0][3] >= 64 * 64 * 4
+    assert goodput.snapshot()["buckets"]["ckpt_io"] > 0
+    dtel.shutdown()
+
+
+def test_recovery_bucket_matches_recovery_us(goodput_on):
+    """The ledger's recovery window opens at fault detection and
+    closes with the resilience.recovery_us observation — one wall,
+    two meters, matching within epsilon. Recovery is STICKY: the
+    re-run's execute time is badput (redone work), not goodput."""
+    from paddle_tpu.distributed.resilience import ElasticStep
+
+    w = paddle.to_tensor(np.zeros((8, 8), "float32"))
+    opt = paddle.optimizer.SGD(0.0, parameters=[w])
+    elastic = ElasticStep(optimizer=opt)
+    x = paddle.to_tensor(np.ones((8, 8), "float32"))
+    with with_flag("FLAGS_fault_inject", "step::2=fail"):
+        for _ in range(4):
+            elastic.run(lambda: _chain_step(x))
+    rec = metrics.snapshot()["histograms"]["resilience.recovery_us"]
+    assert rec["count"] == 1
+    bucket = goodput.snapshot()["buckets"]["recovery"]
+    assert bucket == pytest.approx(rec["total"], rel=0.15, abs=500.0)
+    assert metrics.snapshot()["counters"]["resilience.rollbacks"] == 1
+    elastic.shutdown()
+
+
+def test_step_abort_unwinds_ledger_state(goodput_on):
+    """A step that gives up (budget exhausted) must not leak its
+    in-step/recovery ledger state into the caller's timeline."""
+    from paddle_tpu.distributed.resilience import ElasticStep
+    from paddle_tpu.distributed.resilience.faults import TransientFault
+
+    w = paddle.to_tensor(np.zeros((4, 4), "float32"))
+    opt = paddle.optimizer.SGD(0.0, parameters=[w])
+    elastic = ElasticStep(optimizer=opt, max_retries=0)
+    with with_flag("FLAGS_fault_inject", "step::1@*=fail"):
+        with pytest.raises(TransientFault):
+            elastic.run(lambda: 0)
+    assert goodput.LEDGER._step_depth == 0
+    assert goodput.LEDGER._recover_depth == 0
+    elastic.shutdown()
+
+
+# ------------------------------------------------------------ anomalies
+
+def test_step_spike_anomaly(goodput_on):
+    x = paddle.to_tensor(np.ones((4, 4), "float32"))
+    with with_flag("FLAGS_goodput_spike_factor", 3.0):
+        for i in range(8):
+            goodput.step_begin()
+            _chain_step(x, n=2)
+            if i == 7:
+                time.sleep(0.05)    # >> 3x the ~ms median
+            goodput.step_end()
+    assert metrics.snapshot()["counters"][
+        "goodput.anomalies.step_spike"] >= 1
+
+
+def test_nan_watch_rides_the_nan_scan(goodput_on):
+    """A NaN tripping the existing FLAGS_check_nan_inf scan counts a
+    goodput anomaly whatever the scan's warn/raise level does."""
+    with with_flag("FLAGS_check_nan_inf", True), \
+            with_flag("FLAGS_check_nan_inf_level", 1):
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            t = paddle.to_tensor(np.zeros((4,), "float32"))
+            np.asarray((t / 0.0)._value)    # inf/nan output
+    assert metrics.snapshot()["counters"]["goodput.anomalies.nan"] >= 1
+
+
+def test_loss_divergence_watch(goodput_on):
+    for _ in range(6):
+        goodput.note_loss(1.0)
+    goodput.note_loss(100.0)
+    assert metrics.snapshot()["counters"][
+        "goodput.anomalies.loss_divergence"] == 1
+    goodput.note_loss(float("nan"))
+    assert metrics.snapshot()["counters"]["goodput.anomalies.nan"] == 1
+
+
+# --------------------------------------------------------- hang watchdog
+
+def test_hang_drill_stuck_collective(goodput_on, tmp_path):
+    """The acceptance drill: an injected stuck collective is detected
+    within FLAGS_goodput_hang_factor x the median step time (plus the
+    watchdog poll), produces a stack-carrying flight dump, and the job
+    survives — the watchdog names the hang while the rank is still
+    alive, not in its obituary."""
+    from paddle_tpu.distributed.communication import Group, all_reduce
+
+    g = Group([0, 1], pg=_FakePG())
+    x = paddle.to_tensor(np.ones((8, 8), "float32"))
+    t = paddle.to_tensor(np.ones((64, 64), "float32"))
+    stuck_s = 1.0
+    factor = 5.0
+    with with_flag("FLAGS_flight_recorder", True), \
+            with_flag("FLAGS_flight_recorder_dir", str(tmp_path)), \
+            with_flag("FLAGS_goodput_hang_factor", factor), \
+            with_flag("FLAGS_goodput_hang_min_s", 0.01), \
+            with_flag("FLAGS_goodput_hang_poll_s", 0.02), \
+            with_flag("FLAGS_retry_backoff_s", 0.001), \
+            with_flag("FLAGS_fault_inject",
+                      f"comm::all_reduce@4=stuck({stuck_s})"):
+        for _ in range(6):
+            goodput.step_begin()
+            _chain_step(x)
+            time.sleep(0.015)        # a real median for the timeout
+            all_reduce(t, group=g)   # occurrence 4 sleeps then raises
+            goodput.step_end()
+    # the job completed all 6 steps — detection happened in flight
+    assert goodput.LEDGER.steps == 6
+    assert metrics.snapshot()["counters"]["goodput.hangs"] >= 1
+    hang = goodput.LEDGER.last_hang
+    assert hang is not None
+    assert hang["bucket"] == "comm_wait"      # hung INSIDE the comm span
+    assert "--- thread" in hang["stacks"]     # stacks captured
+    # the acceptance bound: the timeout was derived from
+    # factor x median (the floor did not dominate), and detection
+    # landed within it plus the watchdog's poll slack — well before
+    # the stuck window ended
+    median_s = goodput.LEDGER.median_us() / 1e6
+    assert hang["timeout_s"] <= factor * median_s * 1.5 + 1e-6
+    assert hang["latency_s"] <= hang["timeout_s"] + 3 * 0.02 + 0.25
+    assert hang["latency_s"] < stuck_s
+    dumps = glob.glob(os.path.join(str(tmp_path), "flight_*.txt"))
+    assert any("--- thread" in open(p).read() for p in dumps), \
+        "no stack-carrying flight dump was written"
+
+
+# ------------------------------------------------------------ cross-rank
+
+def _native_store():
+    from paddle_tpu._core import native
+    if not native.get_lib():
+        pytest.skip("native lib unavailable")
+    from paddle_tpu.distributed.store import TCPStore
+    return TCPStore("127.0.0.1", 0, is_master=True, world_size=1,
+                    timeout=10)
+
+
+def test_frames_carry_goodput_and_cluster_report(goodput_on):
+    """Each rank's bucket deltas ride the telemetry frames; rank 0
+    sums them into the per-rank goodput column and the job-end cluster
+    goodput report (productive chip-seconds / total chip-seconds, top
+    badput source per rank)."""
+    from paddle_tpu.distributed.resilience import ElasticStep
+
+    store = _native_store()
+    try:
+        with with_flag("FLAGS_distributed_telemetry", True):
+            pub = dtel.init(store, rank=0, world_size=1)
+            w = paddle.to_tensor(np.zeros((4, 4), "float32"))
+            opt = paddle.optimizer.SGD(0.0, parameters=[w])
+            elastic = ElasticStep(optimizer=opt)
+            x = paddle.to_tensor(np.ones((8, 8), "float32"))
+            for _ in range(5):
+                elastic.run(lambda: _chain_step(x))
+            pub.flush()
+            agg = dtel.TelemetryAggregator()
+            agg.poll_store(store, [0])
+        assert any(f.get("goodput") for f in agg.frames(0))
+        table = agg.step_table()
+        col = table["goodput"]["ranks"]["0"]
+        assert col["goodput_frac"] is not None
+        report = agg.goodput_report()
+        c = report["cluster"]
+        assert c["total_chip_s"] > 0
+        assert 0.0 <= c["goodput_frac"] <= 1.0
+        r0 = report["ranks"]["0"]
+        assert r0["top_badput"] is not None
+        # chip-seconds identity: per-rank buckets sum to the total
+        assert sum(r0["buckets_us"].values()) == pytest.approx(
+            r0["total_us"], rel=0.01)
+        assert "cluster goodput report" in dtel.render_goodput(report)
+        elastic.shutdown()
+    finally:
+        dtel.shutdown()
+        store.close()
+
+
+def _frame(rank, seq, **kw):
+    base = {"v": dtel.FRAME_VERSION, "rank": rank, "pid": 1000 + rank,
+            "seq": seq, "step": seq, "mesh_epoch": 0, "t_wall": 1000.0,
+            "t_perf_us": 0.0, "counters": {}, "hists": {}, "spans": [],
+            "marks": []}
+    base.update(kw)
+    return base
+
+
+def test_straggler_verdict_gains_input_bound_case():
+    """A wall-flagged straggler whose covering goodput window is
+    dominated by the input-wait bucket is verdicted 'input_bound' —
+    slow because starved, not because its work is bigger."""
+    agg = dtel.TelemetryAggregator()
+    for s in (1, 2, 3):
+        # r0 steps 10ms; r1 steps 100ms, 80% of it waiting on the feed
+        agg.add_frame(_frame(0, s, marks=[[s, s * 10_000.0, 10_000.0]],
+                             goodput={"buckets": {"execute": 8000.0,
+                                                  "host": 2000.0},
+                                      "steps": 1}))
+        agg.add_frame(_frame(1, s, marks=[[s, s * 100_000.0,
+                                           100_000.0]],
+                             goodput={"buckets": {"execute": 10000.0,
+                                                  "input_wait": 80000.0,
+                                                  "host": 10000.0},
+                                      "steps": 1}))
+    # a replayed step (checkpoint restore rewinds the index) publishes
+    # a second goodput-carrying frame with the SAME step value; the
+    # aggregation sort must key on the step, not fall through to
+    # comparing the goodput dicts (TypeError)
+    agg.add_frame(_frame(1, 4, step=2,
+                         goodput={"buckets": {"execute": 1.0},
+                                  "steps": 1}))
+    table = agg.step_table()
+    flagged = [r for r in table["steps"] if r["straggler"] is not None]
+    assert flagged, table["steps"]
+    row = flagged[0]
+    assert row["straggler"] == 1 and row["straggler_via"] == "wall"
+    assert row["straggler_badput"] == "input_wait"
+    assert row["straggler_compute"] == "input_bound"
+    report = agg.goodput_report()
+    assert report["ranks"]["1"]["input_bound"] is True
+    assert report["ranks"]["0"]["input_bound"] is False
+    rendered = dtel.render_step_table(table)
+    assert "input_bound" in rendered
+
+
+def test_offthread_spans_do_not_enter_the_partition(goodput_on):
+    """A span finishing on another thread (the async flush worker's
+    compile/execute) is overlapped work: priced in the offthread map,
+    never in the exclusive wall partition."""
+    import threading
+
+    from paddle_tpu.observability.spans import span
+
+    def worker():
+        with span("segment::execute", hist="segment.execute_us"):
+            time.sleep(0.02)
+
+    snap0 = goodput.snapshot()
+    th = threading.Thread(target=worker)
+    th.start()
+    th.join()
+    snap = goodput.snapshot()
+    assert snap["buckets"]["execute"] == snap0["buckets"]["execute"]
+    assert snap["offthread_us"].get("execute", 0.0) >= 15000.0
