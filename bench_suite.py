@@ -124,6 +124,21 @@ Row 16 goodput plane  asserts the goodput-off path (WITH async flush
                                 identity asserted from the same
                                 ledger the budget spans feed
 
+Row 17 record fast path   record-phase us/op on the 64-op dispatch
+                                microbench for {fast path off,
+                                pure-python fast path, native record
+                                core} — min of interleaved rounds, the
+                                us/op legs ride --diff as down-good
+                                rows; asserts the off path does ZERO
+                                fast-path work (lazy.FAST_OPS frozen),
+                                the pure-python prong alone wins
+                                measurably, and (with the native
+                                library built) fast-path-on cuts
+                                record-phase us/op >= 3x; embeds a
+                                gpt2-eager budget snapshot so the
+                                host-gap row prices the win on a real
+                                model
+
 (Multi-chip GPT/ERNIE hybrids need a pod; their single-chip proxies are
 bench.py's headline + the dryrun_multichip compile check.)
 
@@ -1362,6 +1377,147 @@ def bench_goodput():
             "rows": rows}
 
 
+def bench_record_fastpath():
+    """Row 17: the trace-stable record fast path + native record core.
+    A 64-op elementwise chain under the default segment cap seals once
+    per step, so the RECORD phase (time until the last op is recorded,
+    the row-9 phase split) is pure per-op record work — the exact
+    ~us/op tax BUDGET_r06 attributed the single-chip plateau to. Three
+    legs, min of interleaved rounds:
+
+      off     FLAGS_record_fast_path=false — the frozen pre-existing
+              path (lazy.FAST_OPS asserted frozen across it);
+      python  fast path on, native core forced out (lazy._NC /
+              dispatch._EAGER_CORE = None) — the pure-python skeleton
+              replay, which must stand alone and win measurably;
+      native  fast path on with csrc/eager_core.cc's skel_record —
+              match + commit in one C call per op.
+
+    Gate: with the native library built, fast-path-on record-phase
+    us/op must be >= 3x below the off leg (the pure-python leg gates
+    at a measurable >= 1.2x). The row json embeds a small gpt2-eager
+    budget snapshot (host gap + record counters) so the win is priced
+    on a real model's step, not just the microbench."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu._core import async_flush, dispatch, lazy
+    from paddle_tpu.observability import budget as budget_mod
+
+    x = paddle.to_tensor(np.ones((16, 16), "float32"))
+    chain = 32          # 64 recorded ops, one materialize seal per step
+    n_ops = chain * 2
+
+    def run_phases():
+        t0 = time.perf_counter()
+        y = x
+        for _ in range(chain):
+            y = y * 1.0001 + 0.0001
+        t1 = time.perf_counter()
+        np.asarray(y._value)
+        return t1 - t0
+
+    native_mod = dispatch._eager_core()
+    have_native = native_mod is not None \
+        and hasattr(native_mod, "skel_record")
+
+    def force_native(on):
+        # the two prongs resolve/cached independently; the bench legs
+        # force them in-process (the documented test/bench hook). The
+        # on path RE-RESOLVES through lazy._native_core so bind_types
+        # runs — handing lazy._NC a module whose types were never
+        # bound would make every skel_record punt to python.
+        if on and have_native:
+            lazy._NC = None
+            lazy._NC_TRIED = False
+            dispatch._EAGER_CORE = native_mod
+            lazy._native_core()
+        else:
+            lazy._NC = None
+            lazy._NC_TRIED = True
+            dispatch._EAGER_CORE = None if not on else native_mod
+
+    def leg(fast_on, native_on, steps=60):
+        paddle.set_flags({"FLAGS_record_fast_path": fast_on})
+        force_native(native_on)
+        try:
+            for _ in range(8):
+                run_phases()
+            return min(run_phases() for _ in range(steps))
+        finally:
+            paddle.set_flags({"FLAGS_record_fast_path": True})
+            force_native(True)
+
+    leg(False, True, steps=10)       # prime compiles off-clock
+    leg(True, False, steps=10)
+    fast0 = lazy.FAST_OPS
+    off_probe = leg(False, True, steps=10)
+    assert lazy.FAST_OPS == fast0, \
+        "FLAGS_record_fast_path=false did fast-path work (must be 0)"
+    del off_probe
+
+    rounds = []
+    for _ in range(5):
+        rounds.append((leg(False, True), leg(True, False),
+                       leg(True, True) if have_native else None))
+    off = min(r[0] for r in rounds)
+    py = min(r[1] for r in rounds)
+    nat = min(r[2] for r in rounds) if have_native else None
+    off_us = off * 1e6 / n_ops
+    py_us = py * 1e6 / n_ops
+    nat_us = nat * 1e6 / n_ops if nat else None
+    best_us = nat_us if nat_us else py_us
+
+    assert off_us / py_us >= 1.2, \
+        f"pure-python fast path shows no measurable win " \
+        f"({off_us:.2f} -> {py_us:.2f} us/op)"
+    if have_native:
+        assert off_us / nat_us >= 3.0, \
+            f"record fast path below the 3x gate " \
+            f"({off_us:.2f} -> {nat_us:.2f} us/op)"
+
+    # gpt2-eager budget snapshot: the host-gap row prices the win on a
+    # real model (small config so the row stays affordable)
+    genv = {"BUDGET_GPT_LAYERS": "2", "BUDGET_GPT_HIDDEN": "64",
+            "BUDGET_GPT_SEQ": "64", "BUDGET_BATCH": "2"}
+    saved_env = {k: os.environ.get(k) for k in genv}
+    os.environ.update(genv)
+    try:
+        from paddle_tpu.observability.__main__ import _gpt2_step
+        fast0 = lazy.FAST_OPS
+        snap = budget_mod.collect(_gpt2_step(), steps=4, warmup=2)
+        gpt2 = {"wall_us_per_step": snap["wall_us_per_step"],
+                "host_gap_us_per_step": snap["host_gap_us_per_step"],
+                "record_fast_ops": lazy.FAST_OPS - fast0,
+                "counters": {k: v for k, v in snap["counters"].items()
+                             if k.startswith(("record.", "segment.ops",
+                                              "fusion."))}}
+    finally:
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        async_flush.drain(raise_latched=False)
+
+    rows = [{"metric": "record-phase overhead (fast path on, best "
+                       "available core)",
+             "value": round(best_us, 3), "unit": "us/op"},
+            {"metric": "record-phase overhead (pure-python fast path)",
+             "value": round(py_us, 3), "unit": "us/op"}]
+    return {"metric": f"record fast path ({n_ops}-op microbench; "
+                      f"off-freeze + pure-python win asserted"
+                      f"{' + native 3x gate' if have_native else ''})",
+            "value": round(off_us / best_us, 2),
+            "unit": "x record-phase cut",
+            "record_us_per_op_off": round(off_us, 3),
+            "record_us_per_op_python": round(py_us, 3),
+            "record_us_per_op_native": (round(nat_us, 3)
+                                        if nat_us else None),
+            "native_core_available": bool(have_native),
+            "gpt2_budget": gpt2,
+            "rows": rows}
+
+
 # ------------------------------------------------------------- diff mode
 
 def _rows_of(path: str) -> dict:
@@ -1405,6 +1561,9 @@ def _lower_is_better(metric: str, unit: str) -> bool:
     # 'goodput %') are up-good: an efficiency drop is exactly the
     # regression those planes gate.
     first = u.split()[0] if u.split() else ""
+    if first.endswith("/op"):
+        # per-op cost (row 17's record-phase us/op legs): down-good
+        return True
     if first.endswith("/s") or u.startswith("x ") \
             or first in ("mfu", "gflops", "goodput"):
         return False
@@ -1482,7 +1641,7 @@ def main():
         return
     rows = os.environ.get(
         "BENCH_ROWS",
-        "1,2,3,4,5,6,7,8,9,10,11,12,13,14,15,16").split(",")
+        "1,2,3,4,5,6,7,8,9,10,11,12,13,14,15,16,17").split(",")
     table = {"1": bench_lenet, "2": bench_resnet50, "3": bench_bert,
              "4": bench_dispatch, "5": bench_static_checks,
              "6": bench_observability, "7": bench_resilience,
@@ -1490,7 +1649,7 @@ def main():
              "10": bench_telemetry, "11": bench_memory,
              "12": bench_spmd_multichip, "13": bench_perf_lint,
              "14": bench_compute, "15": bench_mem_lint,
-             "16": bench_goodput}
+             "16": bench_goodput, "17": bench_record_fastpath}
     for r in rows:
         r = r.strip()
         out = table[r]()

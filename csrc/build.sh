@@ -12,15 +12,18 @@ g++ -std=c++17 -O2 -fPIC -shared -pthread \
 # fake custom-device plugin (contract-test backend, fake_cpu_device.h analog)
 g++ -std=c++17 -O2 -fPIC -shared \
     fake_device.cc -o build/libpt_fake_device.so
-# eager hot-path CPython extension (dispatch key + backward BFS).
+# eager hot-path CPython extension (dispatch key + backward BFS + the
+# native record core: skeleton matcher, aval cache, interns).
 # BEST-EFFORT: it needs Python dev headers and must be built against
 # the interpreter that will import it (PT_PYTHON, set by
 # _core/native.py to sys.executable) — a failure here must never take
-# down the core runtime library built above.
+# down the core runtime library built above; the pure-python record
+# fast path stands alone. -fvisibility=hidden keeps the record-core
+# helpers internal (PyInit_* carries its own default visibility).
 PY="${PT_PYTHON:-python3}"
 PYINC="$("$PY" -c 'import sysconfig; print(sysconfig.get_paths()["include"])' 2>/dev/null || true)"
 if [ -n "$PYINC" ] && [ -f "$PYINC/Python.h" ]; then
-    g++ -std=c++17 -O2 -fPIC -shared \
+    g++ -std=c++17 -O2 -fPIC -shared -fvisibility=hidden \
         -I"$PYINC" eager_core.cc -o build/pt_eager_core.so \
         || echo "WARN: pt_eager_core build failed (python fallback stays)"
 else

@@ -13,6 +13,35 @@
 //   discover(roots)               — the backward engine's in-degree BFS
 //       (RunBackward's node_in_degree_map): one C loop over .edges.
 //
+// THE NATIVE RECORD CORE (_core/lazy.py's record hot path in C —
+// every entry point stands alone in pure python when this library is
+// unavailable, and the two prongs are benched separately in
+// bench_suite row 17):
+//
+//   sorted_attrs(attrs)      — attrs-only canonical key: one-pass
+//       sorted (k, v) tuple interned in a C-side pool (None for exotic
+//       values -> python fallback), the per-record half of attrs_key.
+//   sig_entry(entry)         — content-intern of one per-op segment
+//       signature entry; pool CLEARED past 65536 entries (the python
+//       pool's overflow rule — identity compares degrade to equality,
+//       never correctness; pinned in tests/test_record_fastpath.py).
+//   aval_cache_get/put/clear — the record-time out-aval cache keyed by
+//       (op, backend, attrs-key, per-aval atoms): the key is built in
+//       one C pass over INTERNED (shape, dtype-str, weak_type) atoms
+//       and probed with zero python-level tuple construction.
+//   bind_types(...)          — one-time registration of the LazyRef /
+//       Tensor / AutogradMeta / _PendingOp classes skel_record mints.
+//   skel_record(ctx, ctups, in_sig, op, ts, attrs, ige) — trace-stable
+//       skeleton replay of ONE record: validates (op, attrs, input
+//       wiring, grad intent) against the retained skeleton op,
+//       registers fresh external inputs, and constructs the LazyRef /
+//       Tensor outputs + _PendingOp from the skeleton's cached avals —
+//       no jax, no aval inference. Returns the out-tensor tuple, None
+//       on a mismatch (the caller falls back to the full record path),
+//       or NotImplemented to punt to the python fast path (exotic
+//       attrs / unexpected object shapes). NOTHING is mutated unless
+//       the whole op validated.
+//
 // Plain CPython C API (no pybind per the build rules); compiled into
 // its own extension .so by _core/native.py next to libpaddle_tpu_rt.
 #define PY_SSIZE_T_CLEAN
@@ -22,6 +51,35 @@
 #include <vector>
 
 namespace {
+
+// ---- interned pools + bound types (module-lifetime globals)
+PyObject* g_dtype_str = nullptr;     // dtype obj -> str(dtype)
+PyObject* g_atom_intern = nullptr;   // (shape, dstr, weak) -> itself
+PyObject* g_aval_cache = nullptr;    // aval key -> out-aval tuple
+PyObject* g_entry_intern = nullptr;  // sig entry -> itself
+PyObject* g_attrs_intern = nullptr;  // sorted attrs tuple -> itself
+PyObject* g_lazyref_t = nullptr;     // lazy.LazyRef
+PyObject* g_tensor_t = nullptr;      // tensor.Tensor
+PyObject* g_agmeta_t = nullptr;      // autograd.AutogradMeta
+PyObject* g_pending_t = nullptr;     // lazy._PendingOp
+PyObject* g_tracer_t = nullptr;      // jax.core.Tracer (optional)
+
+constexpr Py_ssize_t kAvalCacheCap = 65536;
+constexpr Py_ssize_t kEntryCap = 65536;
+constexpr Py_ssize_t kAttrsCap = 8192;
+
+PyObject* intern_str(const char* s) { return PyUnicode_InternFromString(s); }
+
+// interned attribute-name strings (filled at module init)
+PyObject* g_one = nullptr;  // cached small-int 1
+PyObject *s_skel_pos, *s_fast_ops, *s_ops_recorded;
+PyObject *s_payload, *s_shape, *s_dtype, *s_weak_type, *s_stop_gradient,
+    *s_autograd_meta, *s_inplace_version, *s_ctx, *s_op_idx, *s_slot,
+    *s_aval, *s_requires_grad, *s_trefs, *s_in_ids, *s_in_tensors,
+    *s_in_pins, *s_in_vals, *s_in_meta, *s_pending_attr, *s_sig_ops,
+    *s_on_flush, *s_grad, *s_grad_node, *s_out_slot, *s_hooks,
+    *s_retain_grads, *s_name_attr, *s_persistable, *s_dist_attr, *s_op,
+    *s_attrs, *s_wiring, *s_out_refs, *s_n_outs, *s_src, *s_is_lazy_ref;
 
 // value is cache-key-safe if hashable AND compares by value:
 // primitives and tuples thereof. (Lists/dicts/arrays -> python path.)
@@ -186,12 +244,725 @@ fail:
   return nullptr;
 }
 
+// ------------------------------------------------- native record core
+
+// intern `obj` in `pool` (cap -> clear, the python overflow rule).
+// Returns a NEW reference to the canonical object, or null on error.
+PyObject* pool_intern(PyObject* pool, PyObject* obj, Py_ssize_t cap) {
+  PyObject* found = PyDict_GetItem(pool, obj);  // borrowed, no errors
+  if (found) {
+    Py_INCREF(found);
+    return found;
+  }
+  if (PyDict_Size(pool) > cap) PyDict_Clear(pool);
+  if (PyDict_SetItem(pool, obj, obj) < 0) return nullptr;
+  Py_INCREF(obj);
+  return obj;
+}
+
+// sorted_attrs(attrs: dict) -> interned ((k, v), ...) | None (exotic)
+PyObject* sorted_attrs(PyObject*, PyObject* args) {
+  PyObject* attrs;
+  if (!PyArg_ParseTuple(args, "O!", &PyDict_Type, &attrs)) return nullptr;
+  Py_ssize_t n = PyDict_Size(attrs);
+  std::vector<std::pair<PyObject*, PyObject*>> items;
+  items.reserve(n);
+  PyObject *k, *v;
+  Py_ssize_t pos = 0;
+  while (PyDict_Next(attrs, &pos, &k, &v)) {
+    if (!PyUnicode_Check(k) || !key_safe(v)) Py_RETURN_NONE;
+    items.emplace_back(k, v);
+  }
+  std::sort(items.begin(), items.end(),
+            [](const std::pair<PyObject*, PyObject*>& a,
+               const std::pair<PyObject*, PyObject*>& b) {
+              return PyUnicode_Compare(a.first, b.first) < 0;
+            });
+  PyObject* key = PyTuple_New(n);
+  if (!key) return nullptr;
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* pair = PyTuple_New(2);
+    if (!pair) {
+      Py_DECREF(key);
+      return nullptr;
+    }
+    Py_INCREF(items[i].first);
+    Py_INCREF(items[i].second);
+    PyTuple_SET_ITEM(pair, 0, items[i].first);
+    PyTuple_SET_ITEM(pair, 1, items[i].second);
+    PyTuple_SET_ITEM(key, i, pair);
+  }
+  PyObject* interned = pool_intern(g_attrs_intern, key, kAttrsCap);
+  Py_DECREF(key);
+  return interned;
+}
+
+// sig_entry(entry: tuple) -> the interned canonical entry
+PyObject* sig_entry(PyObject*, PyObject* args) {
+  PyObject* entry;
+  if (!PyArg_ParseTuple(args, "O", &entry)) return nullptr;
+  return pool_intern(g_entry_intern, entry, kEntryCap);
+}
+
+// str(dtype) memoized per dtype object. NEW reference.
+PyObject* dtype_str(PyObject* dt) {
+  PyObject* s = PyDict_GetItem(g_dtype_str, dt);  // borrowed
+  if (s) {
+    Py_INCREF(s);
+    return s;
+  }
+  s = PyObject_Str(dt);
+  if (!s) return nullptr;
+  if (PyDict_SetItem(g_dtype_str, dt, s) < 0) {
+    Py_DECREF(s);
+    return nullptr;
+  }
+  return s;
+}
+
+// (tuple(shape), str(dtype), weak_type) atom for one aval, interned.
+// NEW reference; null on error (caller clears + falls back).
+PyObject* aval_atom(PyObject* a) {
+  PyObject* shape = PyObject_GetAttr(a, s_shape);
+  if (!shape) return nullptr;
+  if (!PyTuple_Check(shape)) {
+    PyObject* t = PySequence_Tuple(shape);
+    Py_DECREF(shape);
+    if (!t) return nullptr;
+    shape = t;
+  }
+  PyObject* dt = PyObject_GetAttr(a, s_dtype);
+  if (!dt) {
+    Py_DECREF(shape);
+    return nullptr;
+  }
+  PyObject* ds = dtype_str(dt);
+  Py_DECREF(dt);
+  if (!ds) {
+    Py_DECREF(shape);
+    return nullptr;
+  }
+  PyObject* weak = PyObject_GetAttr(a, s_weak_type);
+  if (!weak) {
+    PyErr_Clear();
+    weak = Py_False;
+    Py_INCREF(weak);
+  }
+  PyObject* atom = PyTuple_New(3);
+  if (!atom) {
+    Py_DECREF(shape);
+    Py_DECREF(ds);
+    Py_DECREF(weak);
+    return nullptr;
+  }
+  PyTuple_SET_ITEM(atom, 0, shape);
+  PyTuple_SET_ITEM(atom, 1, ds);
+  PyTuple_SET_ITEM(atom, 2, weak);
+  PyObject* interned = pool_intern(g_atom_intern, atom, kAvalCacheCap);
+  Py_DECREF(atom);
+  return interned;
+}
+
+// (name, backend, akey, (atom|None, ...)) — NEW reference.
+PyObject* build_aval_key(PyObject* name, PyObject* backend, PyObject* akey,
+                         PyObject* avals) {
+  PyObject* seq = PySequence_Fast(avals, "avals must be a sequence");
+  if (!seq) return nullptr;
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+  PyObject* atoms = PyTuple_New(n);
+  if (!atoms) {
+    Py_DECREF(seq);
+    return nullptr;
+  }
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* a = PySequence_Fast_GET_ITEM(seq, i);
+    if (a == Py_None) {
+      Py_INCREF(Py_None);
+      PyTuple_SET_ITEM(atoms, i, Py_None);
+      continue;
+    }
+    PyObject* atom = aval_atom(a);
+    if (!atom) {
+      Py_DECREF(atoms);
+      Py_DECREF(seq);
+      return nullptr;
+    }
+    PyTuple_SET_ITEM(atoms, i, atom);
+  }
+  Py_DECREF(seq);
+  PyObject* key = PyTuple_New(4);
+  if (!key) {
+    Py_DECREF(atoms);
+    return nullptr;
+  }
+  Py_INCREF(name);
+  Py_INCREF(backend);
+  Py_INCREF(akey);
+  PyTuple_SET_ITEM(key, 0, name);
+  PyTuple_SET_ITEM(key, 1, backend);
+  PyTuple_SET_ITEM(key, 2, akey);
+  PyTuple_SET_ITEM(key, 3, atoms);
+  return key;
+}
+
+// aval_cache_get(name, backend, akey, avals) -> outs tuple | None
+PyObject* aval_cache_get(PyObject*, PyObject* args) {
+  PyObject *name, *backend, *akey, *avals;
+  if (!PyArg_ParseTuple(args, "OOOO", &name, &backend, &akey, &avals)) {
+    return nullptr;
+  }
+  PyObject* key = build_aval_key(name, backend, akey, avals);
+  if (!key) return nullptr;
+  PyObject* v = PyDict_GetItem(g_aval_cache, key);  // borrowed
+  Py_DECREF(key);
+  if (v) {
+    Py_INCREF(v);
+    return v;
+  }
+  Py_RETURN_NONE;
+}
+
+// aval_cache_put(name, backend, akey, avals, outs[, cap]) — `cap`
+// (FLAGS_executable_cache_capacity, read by the cold-path caller)
+// bounds the pool: past it the cache clears in full (simple-clear
+// rather than LRU — inserts are compile-path cold). 0/absent = the
+// built-in 65536 ceiling.
+PyObject* aval_cache_put(PyObject*, PyObject* args) {
+  PyObject *name, *backend, *akey, *avals, *outs;
+  Py_ssize_t cap = 0;
+  if (!PyArg_ParseTuple(args, "OOOOO|n", &name, &backend, &akey, &avals,
+                        &outs, &cap)) {
+    return nullptr;
+  }
+  if (cap <= 0 || cap > kAvalCacheCap) cap = kAvalCacheCap;
+  PyObject* key = build_aval_key(name, backend, akey, avals);
+  if (!key) return nullptr;
+  if (PyDict_Size(g_aval_cache) > cap) PyDict_Clear(g_aval_cache);
+  int rc = PyDict_SetItem(g_aval_cache, key, outs);
+  Py_DECREF(key);
+  if (rc < 0) return nullptr;
+  Py_RETURN_NONE;
+}
+
+PyObject* aval_cache_clear(PyObject*, PyObject*) {
+  PyDict_Clear(g_aval_cache);
+  Py_RETURN_NONE;
+}
+
+PyObject* intern_sizes(PyObject*, PyObject*) {
+  return Py_BuildValue(
+      "{s:n,s:n,s:n,s:n,s:n}", "aval_cache", PyDict_Size(g_aval_cache),
+      "aval_atoms", PyDict_Size(g_atom_intern), "sig_entry",
+      PyDict_Size(g_entry_intern), "attrs", PyDict_Size(g_attrs_intern),
+      "dtype_str", PyDict_Size(g_dtype_str));
+}
+
+// bind_types(LazyRef, Tensor, AutogradMeta, _PendingOp, Tracer)
+PyObject* bind_types(PyObject*, PyObject* args) {
+  PyObject *lr, *tt, *ag, *po, *tr;
+  if (!PyArg_ParseTuple(args, "OOOOO", &lr, &tt, &ag, &po, &tr)) {
+    return nullptr;
+  }
+  Py_XDECREF(g_lazyref_t);
+  Py_XDECREF(g_tensor_t);
+  Py_XDECREF(g_agmeta_t);
+  Py_XDECREF(g_pending_t);
+  Py_XDECREF(g_tracer_t);
+  Py_INCREF(lr);
+  Py_INCREF(tt);
+  Py_INCREF(ag);
+  Py_INCREF(po);
+  Py_INCREF(tr);
+  g_lazyref_t = lr;
+  g_tensor_t = tt;
+  g_agmeta_t = ag;
+  g_pending_t = po;
+  g_tracer_t = tr;
+  Py_RETURN_NONE;
+}
+
+// allocate an instance of a bound slots class WITHOUT running __init__
+// (the C analog of object.__new__(cls)); slots are filled by SetAttr.
+PyObject* alloc_instance(PyObject* type) {
+  PyTypeObject* tp = (PyTypeObject*)type;
+  return tp->tp_alloc(tp, 0);
+}
+
+// set one slot, return false on error
+bool set_slot(PyObject* obj, PyObject* name, PyObject* v) {
+  return PyObject_SetAttr(obj, name, v) == 0;
+}
+
+// the result protocol of skel_record: nullptr = python error raised;
+// MISS  -> Py_None (skeleton mismatch, caller takes the full path);
+// PUNT  -> Py_NotImplemented (C cannot judge; python fast path decides)
+PyObject* miss() { Py_RETURN_NONE; }
+PyObject* punt() {
+  PyErr_Clear();
+  Py_RETURN_NOTIMPLEMENTED;
+}
+
+// skel_record(ctx, ctups, in_sig, op, ts, attrs, ige) — see file
+// header. Reads and advances ctx._skel_pos itself (and bumps
+// ctx._fast_ops / ctx.ops_recorded on success) so the python wrapper
+// is one call + one result check per replayed op.
+// ctups[pos] = (op, akey, attrs, fast_attrs, wiring, out_avals,
+//               out_req, req, has_inexact, entry, n_outs).
+PyObject* skel_record(PyObject*, PyObject* const* fargs,
+                      Py_ssize_t nargs) {
+  if (nargs != 7) {
+    PyErr_SetString(PyExc_TypeError, "skel_record expects 7 arguments");
+    return nullptr;
+  }
+  PyObject* ctx = fargs[0];
+  PyObject* ctups = fargs[1];
+  PyObject* in_sig = fargs[2];
+  PyObject* op = fargs[3];
+  PyObject* ts = fargs[4];
+  PyObject* attrs = fargs[5];
+  PyObject* ige = fargs[6];
+  if (!PyList_Check(ctups) || !g_lazyref_t) return punt();
+  PyObject* pos_o = PyObject_GetAttr(ctx, s_skel_pos);
+  if (!pos_o) return punt();
+  Py_ssize_t pos = PyLong_AsSsize_t(pos_o);
+  Py_DECREF(pos_o);
+  if (pos < 0 && PyErr_Occurred()) return punt();
+  if (pos >= PyList_GET_SIZE(ctups)) return miss();
+  PyObject* ctup = PyList_GET_ITEM(ctups, pos);  // borrowed
+  if (!PyTuple_Check(ctup) || PyTuple_GET_SIZE(ctup) != 11) {
+    return punt();
+  }
+  PyObject* skel_op = PyTuple_GET_ITEM(ctup, 0);
+  PyObject* s_attrs_d = PyTuple_GET_ITEM(ctup, 2);
+  PyObject* fast_attrs = PyTuple_GET_ITEM(ctup, 3);
+  PyObject* wiring = PyTuple_GET_ITEM(ctup, 4);
+  PyObject* out_avals = PyTuple_GET_ITEM(ctup, 5);
+  PyObject* out_req = PyTuple_GET_ITEM(ctup, 6);
+  PyObject* s_req = PyTuple_GET_ITEM(ctup, 7);
+  PyObject* has_inexact = PyTuple_GET_ITEM(ctup, 8);
+  PyObject* entry = PyTuple_GET_ITEM(ctup, 9);
+
+  if (skel_op != op) return miss();
+  if (fast_attrs != Py_True) return punt();  // exotic attrs: python path
+  if (!PyTuple_Check(wiring)) return punt();
+  Py_ssize_t n_in = PyTuple_GET_SIZE(wiring);
+  PyObject* tseq = PySequence_Fast(ts, "ts must be a sequence");
+  if (!tseq) return punt();
+  if (PySequence_Fast_GET_SIZE(tseq) != n_in) {
+    Py_DECREF(tseq);
+    return miss();
+  }
+  int eq = PyObject_RichCompareBool(attrs, s_attrs_d, Py_EQ);
+  if (eq < 0) {
+    Py_DECREF(tseq);
+    return punt();
+  }
+  if (!eq) {
+    Py_DECREF(tseq);
+    return miss();
+  }
+
+  // context state (fresh lists per segment; read once per record)
+  PyObject* in_ids = PyObject_GetAttr(ctx, s_in_ids);
+  PyObject* in_tensors = PyObject_GetAttr(ctx, s_in_tensors);
+  PyObject* in_vals = PyObject_GetAttr(ctx, s_in_vals);
+  PyObject* in_meta = PyObject_GetAttr(ctx, s_in_meta);
+  PyObject* in_pins = PyObject_GetAttr(ctx, s_in_pins);
+  PyObject* on_flush = PyObject_GetAttr(ctx, s_on_flush);
+  PyObject* pending = PyObject_GetAttr(ctx, s_pending_attr);
+  PyObject* sig_ops = PyObject_GetAttr(ctx, s_sig_ops);
+  if (!in_ids || !in_tensors || !in_vals || !in_meta || !in_pins ||
+      !on_flush || !pending || !sig_ops || !PyDict_Check(in_ids) ||
+      !PyList_Check(in_tensors) || !PyList_Check(in_vals) ||
+      !PyList_Check(in_meta) || !PyList_Check(in_pins) ||
+      !PyList_Check(pending) || !PyList_Check(sig_ops)) {
+    Py_XDECREF(in_ids);
+    Py_XDECREF(in_tensors);
+    Py_XDECREF(in_vals);
+    Py_XDECREF(in_meta);
+    Py_XDECREF(in_pins);
+    Py_XDECREF(on_flush);
+    Py_XDECREF(pending);
+    Py_XDECREF(sig_ops);
+    Py_DECREF(tseq);
+    return punt();
+  }
+
+  struct Cleanup {
+    std::vector<PyObject*> owned;
+    ~Cleanup() {
+      for (PyObject* o : owned) Py_XDECREF(o);
+    }
+  } cl;
+  cl.owned = {in_ids, in_tensors, in_vals, in_meta, in_pins,
+              on_flush,  pending,   sig_ops, tseq};
+
+  Py_ssize_t base_in = PyList_GET_SIZE(in_vals);
+  std::vector<PyObject*> new_ext;  // borrowed (alive via tseq/ts)
+  bool req = false;
+  bool result_miss = false;
+  bool result_punt = false;
+
+  for (Py_ssize_t i = 0; i < n_in; ++i) {
+    PyObject* t = PySequence_Fast_GET_ITEM(tseq, i);  // borrowed
+    PyObject* w = PyTuple_GET_ITEM(wiring, i);        // borrowed
+    if (t == Py_None) {
+      if (w != Py_None) {
+        result_miss = true;
+        break;
+      }
+      continue;
+    }
+    PyObject* p = PyObject_GetAttr(t, s_payload);
+    if (!p) {
+      result_punt = true;
+      break;
+    }
+    if (Py_TYPE(p) == (PyTypeObject*)g_lazyref_t) {
+      // op-ref input: must point at the same (op, slot) of THIS ctx
+      PyObject* pctx = PyObject_GetAttr(p, s_ctx);
+      PyObject* pidx = PyObject_GetAttr(p, s_op_idx);
+      PyObject* pslot = PyObject_GetAttr(p, s_slot);
+      PyObject* preq = PyObject_GetAttr(p, s_requires_grad);
+      bool ok = pctx && pidx && pslot && preq;
+      bool match = false;
+      if (ok && pctx == ctx && pidx != Py_None && w != Py_None &&
+          PyTuple_Check(w) && PyTuple_GET_SIZE(w) == 3) {
+        PyObject* w0 = PyTuple_GET_ITEM(w, 0);
+        int is_op = PyUnicode_Check(w0) &&
+                    PyUnicode_CompareWithASCIIString(w0, "op") == 0;
+        if (is_op &&
+            PyObject_RichCompareBool(PyTuple_GET_ITEM(w, 1), pidx,
+                                     Py_EQ) == 1 &&
+            PyObject_RichCompareBool(PyTuple_GET_ITEM(w, 2), pslot,
+                                     Py_EQ) == 1) {
+          match = true;
+          if (preq == Py_True) req = true;
+        }
+      }
+      Py_XDECREF(pctx);
+      Py_XDECREF(pidx);
+      Py_XDECREF(pslot);
+      Py_XDECREF(preq);
+      Py_DECREF(p);
+      if (!ok) {
+        result_punt = true;
+        break;
+      }
+      if (!match) {
+        result_miss = true;
+        break;
+      }
+      continue;
+    }
+    // tracer payload: the op runs under an enclosing jax trace and
+    // must NEVER be recorded into the fusion window — punt so the
+    // executor's slow path dispatches it inline (its own tracer scan
+    // re-detects this)
+    if (g_tracer_t && PyObject_TypeCheck(p, (PyTypeObject*)g_tracer_t)) {
+      Py_DECREF(p);
+      result_punt = true;
+      break;
+    }
+    // external input: wiring must be ("in", idx) at the index this
+    // tensor lands on, with the sealed in-signature's aval when fresh
+    if (w == Py_None || !PyTuple_Check(w) || PyTuple_GET_SIZE(w) != 2) {
+      Py_DECREF(p);
+      result_miss = true;
+      break;
+    }
+    {
+      PyObject* w0 = PyTuple_GET_ITEM(w, 0);
+      if (!PyUnicode_Check(w0) ||
+          PyUnicode_CompareWithASCIIString(w0, "in") != 0) {
+        Py_DECREF(p);
+        result_miss = true;
+        break;
+      }
+    }
+    Py_ssize_t widx = PyLong_AsSsize_t(PyTuple_GET_ITEM(w, 1));
+    if (widx < 0 && PyErr_Occurred()) {
+      Py_DECREF(p);
+      result_punt = true;
+      break;
+    }
+    PyObject* idkey = PyLong_FromVoidPtr(t);
+    if (!idkey) {
+      Py_DECREF(p);
+      result_punt = true;
+      break;
+    }
+    PyObject* idxo = PyDict_GetItem(in_ids, idkey);  // borrowed
+    Py_ssize_t idx = -1;
+    if (idxo) {
+      idx = PyLong_AsSsize_t(idxo);
+      // validate against id() reuse: the weakref at that slot must
+      // still point at THIS tensor
+      if (idx >= 0 && idx < PyList_GET_SIZE(in_tensors)) {
+        PyObject* wr = PyList_GET_ITEM(in_tensors, idx);
+        if (!PyWeakref_Check(wr)) {
+          Py_DECREF(idkey);
+          Py_DECREF(p);
+          result_punt = true;
+          break;
+        }
+        if (PyWeakref_GetObject(wr) != t) idx = -1;
+      } else {
+        idx = -1;
+      }
+    }
+    if (idx < 0) {
+      // not registered yet — maybe earlier in THIS op's operand list
+      for (size_t k = 0; k < new_ext.size(); ++k) {
+        if (new_ext[k] == t) {
+          idx = base_in + (Py_ssize_t)k;
+          break;
+        }
+      }
+    }
+    if (idx < 0) {
+      idx = base_in + (Py_ssize_t)new_ext.size();
+      // fresh registration: validate the payload aval against the
+      // sealed segment's in-signature at this index
+      if (!PyTuple_Check(in_sig) || idx >= PyTuple_GET_SIZE(in_sig)) {
+        Py_DECREF(idkey);
+        Py_DECREF(p);
+        result_miss = true;
+        break;
+      }
+      PyObject* isig = PyTuple_GET_ITEM(in_sig, idx);
+      if (!PyTuple_Check(isig) || PyTuple_GET_SIZE(isig) != 3) {
+        Py_DECREF(idkey);
+        Py_DECREF(p);
+        result_punt = true;
+        break;
+      }
+      PyObject* atom = aval_atom(p);
+      if (!atom) {
+        Py_DECREF(idkey);
+        Py_DECREF(p);
+        result_punt = true;
+        break;
+      }
+      // atom = (shape, dstr, weak); isig = (shape, dstr, weak_bool)
+      int m1 = PyObject_RichCompareBool(PyTuple_GET_ITEM(atom, 0),
+                                        PyTuple_GET_ITEM(isig, 0), Py_EQ);
+      int m2 = PyObject_RichCompareBool(PyTuple_GET_ITEM(atom, 1),
+                                        PyTuple_GET_ITEM(isig, 1), Py_EQ);
+      int w_truth = PyObject_IsTrue(PyTuple_GET_ITEM(atom, 2));
+      int s_truth = PyObject_IsTrue(PyTuple_GET_ITEM(isig, 2));
+      Py_DECREF(atom);
+      if (m1 < 0 || m2 < 0 || w_truth < 0 || s_truth < 0) {
+        Py_DECREF(idkey);
+        Py_DECREF(p);
+        result_punt = true;
+        break;
+      }
+      if (m1 != 1 || m2 != 1 || w_truth != s_truth) {
+        Py_DECREF(idkey);
+        Py_DECREF(p);
+        result_miss = true;
+        break;
+      }
+      new_ext.push_back(t);
+    }
+    Py_DECREF(idkey);
+    Py_DECREF(p);
+    if (widx != idx) {
+      result_miss = true;
+      break;
+    }
+    PyObject* sg = PyObject_GetAttr(t, s_stop_gradient);
+    if (!sg) {
+      result_punt = true;
+      break;
+    }
+    if (sg == Py_False) req = true;
+    Py_DECREF(sg);
+  }
+  if (result_punt) return punt();
+  if (result_miss) return miss();
+
+  if (has_inexact == Py_True) {
+    bool effective = false;
+    if (req) {
+      PyObject* g = PyObject_CallObject(ige, nullptr);
+      if (!g) return punt();
+      int truth = PyObject_IsTrue(g);
+      Py_DECREF(g);
+      if (truth < 0) return punt();
+      effective = truth == 1;
+    }
+    if (effective != (s_req == Py_True)) return miss();
+  }
+
+  // ---- commit (everything validated; nothing was mutated above)
+  bool pinned = on_flush != Py_None;
+  for (size_t k = 0; k < new_ext.size(); ++k) {
+    PyObject* t = new_ext[k];
+    PyObject* idkey = PyLong_FromVoidPtr(t);
+    PyObject* idxo = PyLong_FromSsize_t(base_in + (Py_ssize_t)k);
+    PyObject* wr = idkey && idxo ? PyWeakref_NewRef(t, nullptr) : nullptr;
+    PyObject* p = wr ? PyObject_GetAttr(t, s_payload) : nullptr;
+    PyObject* sg = p ? PyObject_GetAttr(t, s_stop_gradient) : nullptr;
+    PyObject* ag = sg ? PyObject_GetAttr(t, s_autograd_meta) : nullptr;
+    PyObject* iv = ag ? PyObject_GetAttr(t, s_inplace_version) : nullptr;
+    PyObject* meta = nullptr;
+    if (iv) {
+      meta = PyTuple_New(3);
+      if (meta) {
+        PyObject* nreq = sg == Py_True ? Py_False : Py_True;
+        Py_INCREF(nreq);
+        PyTuple_SET_ITEM(meta, 0, nreq);
+        Py_INCREF(ag);
+        PyTuple_SET_ITEM(meta, 1, ag);
+        Py_INCREF(iv);
+        PyTuple_SET_ITEM(meta, 2, iv);
+      }
+    }
+    bool ok = meta && PyDict_SetItem(in_ids, idkey, idxo) == 0 &&
+              PyList_Append(in_tensors, wr) == 0 &&
+              (!pinned || PyList_Append(in_pins, t) == 0) &&
+              PyList_Append(in_vals, p) == 0 &&
+              PyList_Append(in_meta, meta) == 0;
+    Py_XDECREF(idkey);
+    Py_XDECREF(idxo);
+    Py_XDECREF(wr);
+    Py_XDECREF(p);
+    Py_XDECREF(sg);
+    Py_XDECREF(ag);
+    Py_XDECREF(iv);
+    Py_XDECREF(meta);
+    if (!ok) return nullptr;  // commit failed: propagate (fatal)
+  }
+
+  Py_ssize_t op_idx = PyList_GET_SIZE(pending);
+  Py_ssize_t n_outs = PyTuple_GET_SIZE(out_avals);
+  PyObject* op_idx_o = PyLong_FromSsize_t(op_idx);
+  PyObject* out_refs = PyList_New(n_outs);
+  PyObject* outs = PyTuple_New(n_outs);
+  if (!op_idx_o || !out_refs || !outs) {
+    Py_XDECREF(op_idx_o);
+    Py_XDECREF(out_refs);
+    Py_XDECREF(outs);
+    return nullptr;
+  }
+  PyObject* zero = PyLong_FromLong(0);
+  bool ok = zero != nullptr;
+  for (Py_ssize_t slot = 0; ok && slot < n_outs; ++slot) {
+    PyObject* rg = PyTuple_GET_ITEM(out_req, slot);      // borrowed bool
+    PyObject* aval = PyTuple_GET_ITEM(out_avals, slot);  // borrowed
+    PyObject* slot_o = PyLong_FromSsize_t(slot);
+    PyObject* trefs = PyList_New(0);
+    PyObject* ref = alloc_instance(g_lazyref_t);
+    ok = slot_o && trefs && ref && set_slot(ref, s_ctx, ctx) &&
+         set_slot(ref, s_op_idx, op_idx_o) &&
+         set_slot(ref, s_slot, slot_o) && set_slot(ref, s_aval, aval) &&
+         set_slot(ref, s_requires_grad, rg) &&
+         set_slot(ref, s_trefs, trefs);
+    PyObject* meta = ok ? alloc_instance(g_agmeta_t) : nullptr;
+    ok = ok && meta && set_slot(meta, s_grad, Py_None) &&
+         set_slot(meta, s_grad_node, Py_None) &&
+         set_slot(meta, s_out_slot, zero);
+    PyObject* hooks = ok ? PyList_New(0) : nullptr;
+    ok = ok && hooks && set_slot(meta, s_hooks, hooks) &&
+         set_slot(meta, s_retain_grads, Py_False);
+    PyObject* tensor = ok ? alloc_instance(g_tensor_t) : nullptr;
+    ok = ok && tensor && set_slot(tensor, s_payload, ref) &&
+         set_slot(tensor, s_stop_gradient,
+                  rg == Py_True ? Py_False : Py_True) &&
+         set_slot(tensor, s_autograd_meta, meta) &&
+         set_slot(tensor, s_inplace_version, zero) &&
+         set_slot(tensor, s_name_attr, Py_None) &&
+         set_slot(tensor, s_persistable, Py_False) &&
+         set_slot(tensor, s_dist_attr, Py_None);
+    // ref.add_tref(tensor): the alias backref is a weakref
+    if (ok) {
+      PyObject* twr = PyWeakref_NewRef(tensor, nullptr);
+      ok = twr && PyList_Append(trefs, twr) == 0;
+      Py_XDECREF(twr);
+    }
+    if (ok) {
+      Py_INCREF(ref);
+      PyList_SET_ITEM(out_refs, slot, ref);
+      Py_INCREF(tensor);
+      PyTuple_SET_ITEM(outs, slot, tensor);
+    }
+    Py_XDECREF(slot_o);
+    Py_XDECREF(trefs);
+    Py_XDECREF(ref);
+    Py_XDECREF(meta);
+    Py_XDECREF(hooks);
+    Py_XDECREF(tensor);
+  }
+  PyObject* pop = ok ? alloc_instance(g_pending_t) : nullptr;
+  PyObject* n_outs_o = ok ? PyLong_FromSsize_t(n_outs) : nullptr;
+  ok = ok && pop && n_outs_o && set_slot(pop, s_op, op) &&
+       set_slot(pop, s_attrs, s_attrs_d) &&
+       set_slot(pop, s_wiring, wiring) &&
+       set_slot(pop, s_out_refs, out_refs) &&
+       set_slot(pop, s_n_outs, n_outs_o) &&
+       set_slot(pop, s_src, Py_None) && PyList_Append(pending, pop) == 0 &&
+       PyList_Append(sig_ops, entry) == 0;
+  Py_XDECREF(pop);
+  Py_XDECREF(n_outs_o);
+  Py_XDECREF(op_idx_o);
+  Py_XDECREF(out_refs);
+  Py_XDECREF(zero);
+  if (!ok) {
+    Py_DECREF(outs);
+    return nullptr;
+  }
+  // advance the replay cursor + per-segment / lifetime counters so the
+  // python wrapper is one call per replayed op
+  PyObject* next_pos = PyLong_FromSsize_t(pos + 1);
+  ok = next_pos && PyObject_SetAttr(ctx, s_skel_pos, next_pos) == 0;
+  Py_XDECREF(next_pos);
+  for (PyObject* ctr : {s_fast_ops, s_ops_recorded}) {
+    if (!ok) break;
+    PyObject* cur = PyObject_GetAttr(ctx, ctr);
+    ok = cur != nullptr;
+    if (ok) {
+      PyObject* inc = PyNumber_Add(cur, g_one);
+      ok = inc && PyObject_SetAttr(ctx, ctr, inc) == 0;
+      Py_XDECREF(inc);
+      Py_DECREF(cur);
+    }
+  }
+  if (!ok) {
+    Py_DECREF(outs);
+    return nullptr;
+  }
+  return outs;
+}
+
 PyMethodDef methods[] = {
     {"attrs_key", attrs_key, METH_VARARGS,
      "Canonical (name, backend, sorted attrs) executable-cache key; "
      "None if any attr value needs the python fallback."},
     {"discover", discover, METH_VARARGS,
      "Backward-engine in-degree BFS over GradNode.edges."},
+    {"sorted_attrs", sorted_attrs, METH_VARARGS,
+     "Interned attrs-only canonical key; None for exotic values."},
+    {"sig_entry", sig_entry, METH_VARARGS,
+     "Content-intern one per-op segment signature entry (pool cleared "
+     "past 65536 entries)."},
+    {"aval_cache_get", aval_cache_get, METH_VARARGS,
+     "Record-time out-aval cache probe: key built in one C pass over "
+     "interned (shape, dtype-str, weak_type) atoms."},
+    {"aval_cache_put", aval_cache_put, METH_VARARGS,
+     "Insert one out-aval tuple under the C-built key."},
+    {"aval_cache_clear", aval_cache_clear, METH_NOARGS,
+     "Drop every cached out-aval entry."},
+    {"intern_sizes", intern_sizes, METH_NOARGS,
+     "Sizes of the C-side intern pools (tests)."},
+    {"bind_types", bind_types, METH_VARARGS,
+     "Register the LazyRef/Tensor/AutogradMeta/_PendingOp classes "
+     "skel_record constructs."},
+    {"skel_record", (PyCFunction)(void (*)())skel_record, METH_FASTCALL,
+     "Trace-stable skeleton replay of one record: validate against the "
+     "retained skeleton op and mint the outputs from its cached avals. "
+     "Returns outs | None (mismatch) | NotImplemented (punt)."},
     {nullptr, nullptr, 0, nullptr}};
 
 PyModuleDef module = {PyModuleDef_HEAD_INIT, "pt_eager_core",
@@ -201,5 +972,55 @@ PyModuleDef module = {PyModuleDef_HEAD_INIT, "pt_eager_core",
 }  // namespace
 
 PyMODINIT_FUNC PyInit_pt_eager_core(void) {
+  g_dtype_str = PyDict_New();
+  g_atom_intern = PyDict_New();
+  g_aval_cache = PyDict_New();
+  g_entry_intern = PyDict_New();
+  g_attrs_intern = PyDict_New();
+  if (!g_dtype_str || !g_atom_intern || !g_aval_cache || !g_entry_intern ||
+      !g_attrs_intern) {
+    return nullptr;
+  }
+  g_one = PyLong_FromLong(1);
+  if (!g_one) return nullptr;
+  s_skel_pos = intern_str("_skel_pos");
+  s_fast_ops = intern_str("_fast_ops");
+  s_ops_recorded = intern_str("ops_recorded");
+  s_payload = intern_str("_payload");
+  s_shape = intern_str("shape");
+  s_dtype = intern_str("dtype");
+  s_weak_type = intern_str("weak_type");
+  s_stop_gradient = intern_str("_stop_gradient");
+  s_autograd_meta = intern_str("_autograd_meta");
+  s_inplace_version = intern_str("_inplace_version");
+  s_ctx = intern_str("ctx");
+  s_op_idx = intern_str("op_idx");
+  s_slot = intern_str("slot");
+  s_aval = intern_str("aval");
+  s_requires_grad = intern_str("requires_grad");
+  s_trefs = intern_str("trefs");
+  s_in_ids = intern_str("_in_ids");
+  s_in_tensors = intern_str("_in_tensors");
+  s_in_pins = intern_str("_in_pins");
+  s_in_vals = intern_str("_in_vals");
+  s_in_meta = intern_str("_in_meta");
+  s_pending_attr = intern_str("pending");
+  s_sig_ops = intern_str("_sig_ops");
+  s_on_flush = intern_str("on_flush");
+  s_grad = intern_str("grad");
+  s_grad_node = intern_str("grad_node");
+  s_out_slot = intern_str("out_slot");
+  s_hooks = intern_str("hooks");
+  s_retain_grads = intern_str("retain_grads");
+  s_name_attr = intern_str("name");
+  s_persistable = intern_str("persistable");
+  s_dist_attr = intern_str("_dist_attr");
+  s_op = intern_str("op");
+  s_attrs = intern_str("attrs");
+  s_wiring = intern_str("wiring");
+  s_out_refs = intern_str("out_refs");
+  s_n_outs = intern_str("n_outs");
+  s_src = intern_str("src");
+  s_is_lazy_ref = intern_str("_is_lazy_ref");
   return PyModule_Create(&module);
 }

@@ -96,6 +96,14 @@ _KEY_INTERN: Dict[Tuple, Tuple] = {}
 
 
 def attrs_key(attrs: Dict[str, Any]):
+    ec = _eager_core()
+    if ec is not None:
+        # one C pass: sort + intern (None = exotic values, python path).
+        # A given attrs value-class always takes the same branch, so
+        # the two intern pools never alias the same key.
+        key = ec.sorted_attrs(attrs)
+        if key is not None:
+            return key
     key = tuple(sorted((k, _hashable(v)) for k, v in attrs.items()))
     if len(_KEY_INTERN) > 8192:
         _KEY_INTERN.clear()
@@ -139,6 +147,12 @@ def _eager_core():
     if _EAGER_CORE is False:
         from . import native
         _EAGER_CORE = native.get_eager_core()
+        if _EAGER_CORE is not None \
+                and not hasattr(_EAGER_CORE, "sorted_attrs"):
+            # a stale pre-record-core build (the extension build is
+            # best-effort): the python paths stand alone instead of
+            # AttributeError-ing per dispatch
+            _EAGER_CORE = None
     return _EAGER_CORE
 
 

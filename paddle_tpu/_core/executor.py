@@ -17,7 +17,7 @@ from . import flags, lazy
 from ..observability import _state as _obs
 from .autograd import is_grad_enabled, record
 from .dispatch import eager_forward
-from .op_registry import get_op
+from .op_registry import _OPS, get_op
 from .tensor import Tensor
 
 
@@ -25,7 +25,16 @@ from .tensor import Tensor
 # full jnp.asarray device-put per dispatch otherwise (~45% of chain
 # dispatch time). jax arrays are immutable, so sharing one per distinct
 # (type, value) is safe; keyed by type so True does not alias 1.
+# _SCALAR_TENSORS additionally shares the TENSOR wrapper per key: the
+# wrapper is internal (never handed to user code), stop_gradient, and
+# its payload is never swapped — so the record hot path skips a Tensor
+# + AutogradMeta allocation per scalar operand, and a segment registers
+# each distinct scalar ONCE instead of once per dispatch. The tracer
+# fixer evicts both caches in lockstep (analysis/fixes.py).
 _SCALAR_CACHE: dict = {}
+_SCALAR_TENSORS: dict = {}
+
+_TRACER_CLS = jax.core.Tracer
 
 
 def _coerce(x):
@@ -38,18 +47,26 @@ def _coerce(x):
         # substituting a cached +0.0 for -0.0 flips e.g. 1/x to +inf
         key = (type(x), x, math.copysign(1.0, x)) \
             if isinstance(x, float) else (type(x), x)
+        t = _SCALAR_TENSORS.get(key)
+        if t is not None:
+            return t
         v = _SCALAR_CACHE.get(key)
         if v is None:
             v = jnp.asarray(x)
-            if isinstance(v, jax.core.Tracer):
+            if isinstance(v, _TRACER_CLS):
                 # inside a jax trace (to_static/vmap) array creation is
                 # staged: caching the tracer would leak it into every
                 # dispatch after the trace exits
                 return Tensor(v, stop_gradient=True)
             if len(_SCALAR_CACHE) > 4096:
                 _SCALAR_CACHE.clear()
+                _SCALAR_TENSORS.clear()
             _SCALAR_CACHE[key] = v
-        return Tensor(v, stop_gradient=True)
+        t = Tensor(v, stop_gradient=True)
+        if len(_SCALAR_TENSORS) > 4096:
+            _SCALAR_TENSORS.clear()
+        _SCALAR_TENSORS[key] = t
+        return t
     return Tensor(jnp.asarray(x), stop_gradient=True)
 
 
@@ -57,15 +74,73 @@ def apply(op_name: str, *inputs, **attrs):
     """Execute a registered op eagerly on Tensors. Returns Tensor or tuple.
     Under paddle.static (enable_static), records the op into the current
     Program instead (the ProgramDesc/PIR build path, SURVEY L9/L14)."""
-    op = get_op(op_name)
-    ts = [_coerce(x) for x in inputs]
+    op = _OPS.get(op_name)
+    if op is None:
+        op = get_op(op_name)   # raises the canonical unknown-op error
+    # coerce pass: the Tensor / cached-scalar cases inline (the common
+    # operands of the record hot path); everything else takes _coerce
+    ts = []
+    for x in inputs:
+        tx = type(x)
+        if tx is Tensor:
+            t = x
+        elif tx is float:
+            t = _SCALAR_TENSORS.get((float, x, math.copysign(1.0, x)))
+            if t is None:
+                t = _coerce(x)
+        elif tx is int or tx is bool:
+            t = _SCALAR_TENSORS.get((tx, x))
+            if t is None:
+                t = _coerce(x)
+        else:
+            t = _coerce(x)
+        ts.append(t)
+
+    # record fast path, gated at the DISPATCH level: when no dispatch
+    # interceptor is installed (_APPLY_FAST: no static recorder, no amp
+    # hook, no profiler cb, no per-op mode) and the ambient window is
+    # replaying an armed skeleton, one C call records this op — the
+    # native matcher punts (NotImplemented) on tracer payloads, exotic
+    # attrs and anything else it cannot judge, falling through to the
+    # full path below, which re-derives everything itself. This is THE
+    # native entry (ctx.record runs only the python matcher); its
+    # exclusions mirror lazy._record_fast's self-gating — keep in sync.
+    if _APPLY_FAST:
+        ctx = lazy.current_context()
+        if ctx is not None and ctx._skel_live:
+            sk = ctx._skeleton
+            if sk is None:
+                sk = ctx._select_skel(op)   # first record of a segment
+            if sk is not None and lazy._NC is not None \
+                    and sk.gen == lazy._FAST_GEN \
+                    and not flags.STATIC_CHECKS_ACTIVE \
+                    and not (lazy.PERF_SRC or _obs.COMPUTE):
+                r = lazy._NC.skel_record(ctx, sk.ctups, sk.in_sig, op,
+                                         ts, attrs, is_grad_enabled)
+                if type(r) is tuple:
+                    lazy.FAST_OPS += 1
+                    cap = ctx._max_override
+                    if len(ctx.pending) >= (lazy._MAX_SEG_OPS
+                                            if cap is None else cap):
+                        ctx.flush("segment_cap")
+                    return r if op.multi_output else r[0]
+                if r is None:
+                    ctx._skel_live = False
+
+    # the enclosing-jax-trace scan (amp casting cannot INTRODUCE a
+    # tracer into an all-concrete operand list, so scanning the
+    # pre-cast operands is equivalent to the old post-cast scan)
+    tracer = False
+    for t in ts:
+        if t is not None and isinstance(t._payload, _TRACER_CLS):
+            tracer = True
+            break
     if _static_recorder is not None:
         return _static_recorder(op_name, ts, attrs)
-    ts = _maybe_amp_cast(op_name, ts)
+    if _amp_hook is not None:
+        ts = _amp_hook(op_name, ts)
     ctx = lazy.current_context()
-    if ctx is not None and any(
-            t is not None and isinstance(t._payload, jax.core.Tracer)
-            for t in ts):
+    if ctx is not None and tracer:
         # op runs under an enclosing jax trace (to_static/sot jit body):
         # tracers must never be recorded into the fusion window — a
         # flush after that trace exits would replay dead tracers.
@@ -128,11 +203,29 @@ def apply(op_name: str, *inputs, **attrs):
 # the fusion window on the very next op.
 _PER_OP_MODE = False
 
+# One coherent gate for the dispatch-level record fast path: True iff
+# NO dispatch interceptor is installed (static recorder, amp hook,
+# profiler cb, per-op NaN/benchmark mode). Kept in sync by the four
+# setters below, so apply() pays a single global read per op.
+# (The interceptor slots are declared here — before the flag watchers
+# fire the first _sync_apply_fast — and documented at their setters.)
+_APPLY_FAST = True
+_static_recorder = None
+_profile_cb = None
+_amp_hook = None
+
+
+def _sync_apply_fast():
+    global _APPLY_FAST
+    _APPLY_FAST = (_static_recorder is None and _amp_hook is None
+                   and _profile_cb is None and not _PER_OP_MODE)
+
 
 def _sync_per_op_mode(_value=None):
     global _PER_OP_MODE
     _PER_OP_MODE = bool(flags.flag_value("FLAGS_check_nan_inf")
                         or flags.flag_value("FLAGS_benchmark"))
+    _sync_apply_fast()
 
 
 flags.watch_flag("FLAGS_check_nan_inf", _sync_per_op_mode)
@@ -142,35 +235,24 @@ flags.watch_flag("FLAGS_benchmark", _sync_per_op_mode)
 # Static-graph recorder (installed by paddle_tpu.static.enable_static):
 # when set, apply() records ops into the current Program instead of
 # executing them.
-_static_recorder = None
-
-
 def set_static_recorder(fn):
     global _static_recorder
     _static_recorder = fn
+    _sync_apply_fast()
 
 
 # Profiler instrumentation hook (host tracer RecordEvent per op; installed
 # by paddle_tpu.profiler, the eager_gen.py:326 dispatch-event analog).
-_profile_cb = None
-
-
 def set_profile_cb(fn):
     global _profile_cb
     _profile_cb = fn
+    _sync_apply_fast()
 
 
 # AMP interception is installed by paddle_tpu.amp (kept as a hook here to
-# avoid a hard dependency; see amp/auto_cast.py).
-_amp_hook = None
-
-
+# avoid a hard dependency; see amp/auto_cast.py — the hook is live only
+# while an auto_cast scope is active somewhere in the process).
 def set_amp_hook(fn):
     global _amp_hook
     _amp_hook = fn
-
-
-def _maybe_amp_cast(op_name, ts):
-    if _amp_hook is None:
-        return ts
-    return _amp_hook(op_name, ts)
+    _sync_apply_fast()

@@ -200,6 +200,18 @@ define_flag("FLAGS_executable_cache_capacity", 1024,
 define_flag("FLAGS_lazy_donate_inputs", True,
             "Donate lazy-segment input buffers whose backing tensor is "
             "dead or overwritten at flush (XLA reuses them in place).")
+define_flag("FLAGS_record_fast_path", True,
+            "Trace-stable record fast path: after a sealed segment's "
+            "signature memo proves the op stream repeats, later "
+            "iterations replay the retained op skeleton — matching "
+            "(op, attrs, input wiring) position-for-position and "
+            "skipping aval inference / cache-key construction / attrs "
+            "copying per recorded op, re-binding only external input "
+            "payloads. Any mismatch falls back to the full record path "
+            "for the rest of the segment; mesh-epoch bumps, replans, "
+            "relevant set_flags and mid-segment in-place swaps "
+            "invalidate the skeleton. Off = the exact pre-existing "
+            "per-op record behavior.")
 define_flag("FLAGS_async_flush", False,
             "Hand sealed lazy segments to a single-worker flush "
             "executor: compile+execute launch off the Python thread "

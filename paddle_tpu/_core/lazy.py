@@ -51,7 +51,12 @@ from .op_registry import OpDef
 # cache.fused_step is THE steady-state step-cache hit-rate signal.
 _SEG_CACHE: Dict[Tuple, Any] = ExecCache(stat="segment")
 _FUSED_CACHE: Dict[Tuple, Any] = ExecCache(stat="fused_step")
-_AVAL_CACHE: Dict[Tuple, Tuple] = {}
+# out-aval cache for record-time shape inference: LRU-bounded like the
+# executable caches (shape-polymorphic workloads mint unbounded keys).
+# No ExecCache stat: it is not an executable cache, so its hit/miss
+# counters live under record.aval_cache.* (counted in _out_avals) and
+# stay OUT of the derived cache_hit_rate headline.
+_AVAL_CACHE: Dict[Tuple, Tuple] = ExecCache()
 
 # Mesh epoch: a salt baked into every segment/step-cache signature.
 # Elastic re-planning (resilience/adaptive.py) bumps it after moving
@@ -104,12 +109,55 @@ def mark_cost_stale():
     COST_STALE = True
 
 
+# ---- trace-stable record fast path (FLAGS_record_fast_path).
+# A steady-state train step records the same op sequence every
+# iteration — the signature memo proves it at seal time. While proven,
+# the context retains the sealed segment's op SKELETON and replays it
+# against the incoming (op, attrs, input-wiring) stream
+# position-for-position: matching ops skip jax.eval_shape / aval-cache
+# key construction / attrs copying / sig-entry interning entirely and
+# reuse the skeleton's cached out-avals + interned entries, re-binding
+# only external input payloads. Any mismatch falls back to the full
+# record path for the rest of the segment. FAST_OPS counts replayed
+# ops process-wide (tests + bench row 17); _FAST_GEN is the skeleton
+# generation — bumping it (mesh-epoch bump / replan, relevant
+# set_flags) invalidates every armed skeleton at its next fast record.
+FAST_OPS = 0
+_FAST_PATH = True
+_FAST_GEN = 0
+
+
+def invalidate_skeletons(_value=None) -> int:
+    """Bump the skeleton generation: every context drops its armed
+    record skeleton on the next fast-record attempt (re-armed at the
+    next memo-proven seal)."""
+    global _FAST_GEN
+    _FAST_GEN += 1
+    return _FAST_GEN
+
+
+def _sync_fast_path_gate(value):
+    global _FAST_PATH
+    _FAST_PATH = bool(value)
+    invalidate_skeletons()
+
+
+_flags.watch_flag("FLAGS_record_fast_path", _sync_fast_path_gate)
+# sanitizer / provenance / segment-shape mode changes invalidate armed
+# skeletons (the fast path re-proves the stream under the new mode)
+_flags.watch_flag("FLAGS_static_checks", invalidate_skeletons)
+_flags.watch_flag("FLAGS_compute_telemetry", invalidate_skeletons)
+_flags.watch_flag("FLAGS_lazy_max_segment_ops", invalidate_skeletons)
+
+
 def bump_mesh_epoch() -> int:
     """Invalidate the compiled-segment and fused-step cache keys (the
     old entries age out of the LRU; nothing is recompiled until the
-    next flush)."""
+    next flush). Armed record skeletons are invalidated too — a replan
+    must re-prove the op stream on the new mesh."""
     global MESH_EPOCH
     MESH_EPOCH += 1
+    invalidate_skeletons()
     return MESH_EPOCH
 
 
@@ -135,11 +183,14 @@ _flags.watch_flag("FLAGS_eager_fusion", _mk_gate("_EAGER_FUSION"))
 _flags.watch_flag("FLAGS_lazy_max_segment_ops", _mk_gate("_MAX_SEG_OPS"))
 _flags.watch_flag("FLAGS_lazy_donate_inputs", _mk_gate("_DONATE_INPUTS"))
 
-# flush reasons eligible for the async pipeline: only seals where the
-# recording thread genuinely runs ahead (a cap mid-record). Reads
-# (materialize/guard exit) block on the result anyway — going async
-# there only adds a thread hop to the critical path.
-_ASYNC_REASONS = frozenset(("segment_cap",))
+# flush reasons eligible for the async pipeline: seals where the
+# recording thread genuinely runs ahead. A cap mid-record always
+# qualifies; a guard EXIT does too — the code after the `with` block
+# (or after a SOT-captured call returns) continues on pending values
+# and only blocks at a real read. Materialize reads block on the
+# result anyway — going async there only adds a thread hop to the
+# critical path — and guard_error stays synchronous (unwind path).
+_ASYNC_REASONS = frozenset(("segment_cap", "guard_exit"))
 
 # set the first time a segment is flushed asynchronously; gates the
 # resolve-scan at consumption points so the sync-only path never pays
@@ -180,11 +231,6 @@ class _CachedKey:
         return f"_CachedKey({self.sig!r})"
 
 
-# per-op signature entries interned by content: steady-state memo
-# validation compares tuples of IDENTICAL entry objects, so the
-# per-step check is n pointer compares (exact, not sampled)
-_SIG_ENTRY_INTERN: Dict[Tuple, Tuple] = {}
-
 # Hot-import bindings: record()/_lazy_tensor() run per recorded op, and
 # a function-local `from .tensor import Tensor` costs an importlib
 # round-trip per call (~190 of them per 32-op chain step in the
@@ -216,7 +262,7 @@ _WINDOW_BREAK_REASONS = frozenset(
 
 
 def _obs_flush_span(reason: str, n_ops: int, n_inputs: int, n_live: int,
-                    n_donate: int):
+                    n_donate: int, n_fast: int = 0):
     """Counters + the begun flush span. Callers gate on _OBS.ACTIVE —
     this never runs when observability, tracing, and the flight
     recorder are all off."""
@@ -230,6 +276,11 @@ def _obs_flush_span(reason: str, n_ops: int, n_inputs: int, n_live: int,
             metrics.inc("fusion.window_breaks")
             metrics.inc("fusion.window_breaks." + head)
         metrics.inc("segment.ops", n_ops)
+        if n_fast:
+            # skeleton-replayed records of this segment (counted at
+            # seal so the fast path pays zero per-op registry work);
+            # budget surfaces record.* next to the segment counters
+            metrics.inc("record.fast_ops", n_fast)
         if n_donate:
             metrics.inc("segment.donated_inputs", n_donate)
     from ..observability.spans import span
@@ -478,6 +529,62 @@ def _dstr(dt) -> str:
     return s
 
 
+# jnp.issubdtype(dt, inexact) walks the numpy type lattice (~1-2us);
+# the record hot path asks it per output — memoized per dtype object
+_INEXACT_DT: Dict[Any, bool] = {}
+
+
+def _is_inexact(dt) -> bool:
+    r = _INEXACT_DT.get(dt)
+    if r is None:
+        r = _INEXACT_DT[dt] = bool(jnp.issubdtype(dt, jnp.inexact))
+    return r
+
+
+# Native record core (csrc/eager_core.cc): interned shape/dtype atoms,
+# the aval-cache key build + lookup and the sig-entry intern in C.
+# Resolved once through dispatch's extension loader; None = the pure
+# python path (which must stand alone — the library is best-effort).
+# Bench row 17 and the fallback tests force either prong by setting
+# _NC/_NC_TRIED directly.
+_NC = None
+_NC_TRIED = False
+
+
+def _native_core():
+    global _NC, _NC_TRIED
+    _NC_TRIED = True
+    ec = dispatch._eager_core()
+    if ec is not None and hasattr(ec, "aval_cache_get"):
+        if hasattr(ec, "bind_types"):
+            from .autograd import AutogradMeta
+            from .tensor import Tensor
+            ec.bind_types(LazyRef, Tensor, AutogradMeta, _PendingOp,
+                          jax.core.Tracer)
+        _NC = ec
+    return _NC
+
+
+# per-op signature entries interned by content: steady-state memo
+# validation compares tuples of IDENTICAL entry objects, so the
+# per-step check is n pointer compares (exact, not sampled). Past
+# 65536 entries the pool is CLEARED — identity compares degrade to
+# tuple equality until repopulation, never correctness (pinned in
+# tests/test_record_fastpath.py). The native core keeps its own pool
+# with the same overflow rule.
+_SIG_ENTRY_INTERN: Dict[Tuple, Tuple] = {}
+
+
+def _intern_sig_entry(entry: Tuple) -> Tuple:
+    nc = _NC if _NC_TRIED else _native_core()
+    if nc is not None:
+        return nc.sig_entry(entry)
+    e = _SIG_ENTRY_INTERN.setdefault(entry, entry)
+    if len(_SIG_ENTRY_INTERN) > 65536:
+        _SIG_ENTRY_INTERN.clear()
+    return e
+
+
 def _aval_of(x):
     # weak_type MUST survive: python scalars are weak (x64 mode makes
     # them f64-weak) and weak+f32 promotes to f32, not f64
@@ -489,20 +596,75 @@ def _out_avals(op: OpDef, attrs, in_avals, akey=None):
     if akey is None:
         akey = dispatch.attrs_key(attrs)
     backend = jax.default_backend()
-    key = (op.name, backend, akey,
-           tuple((tuple(a.shape), _dstr(a.dtype), a.weak_type)
-                 if a is not None else None for a in in_avals))
-    hit = _AVAL_CACHE.get(key)
-    if hit is None:
-        fn = functools.partial(op.kernel_for(backend), **attrs)
-        out = jax.eval_shape(fn, *in_avals)
-        outs = out if op.multi_output else (out,)
-        hit = tuple(jax.tree_util.tree_leaves(outs))
-        if len(hit) != len(outs):
-            # nested outputs: treat as un-capturable
-            raise TypeError(f"op {op.name} has nested outputs")
+    nc = _NC if _NC_TRIED else _native_core()
+    if nc is not None:
+        # C builds the (op, backend, attrs, per-aval atom) key in one
+        # pass over interned shape/dtype atoms and probes the C-side
+        # cache; the python dict below is the standalone fallback
+        hit = nc.aval_cache_get(op.name, backend, akey, in_avals)
+        key = None
+    else:
+        key = (op.name, backend, akey,
+               tuple((tuple(a.shape), _dstr(a.dtype), a.weak_type)
+                     if a is not None else None for a in in_avals))
+        hit = _AVAL_CACHE.get(key)
+    if _OBS.METRICS:
+        # record.aval_cache.*, NOT cache.*: the derived cache_hit_rate
+        # headline sums executable caches only
+        from ..observability import metrics
+        metrics.inc("record.aval_cache.hit" if hit is not None
+                    else "record.aval_cache.miss")
+    if hit is not None:
+        return hit
+    fn = functools.partial(op.kernel_for(backend), **attrs)
+    out = jax.eval_shape(fn, *in_avals)
+    outs = out if op.multi_output else (out,)
+    hit = tuple(jax.tree_util.tree_leaves(outs))
+    if len(hit) != len(outs):
+        # nested outputs: treat as un-capturable
+        raise TypeError(f"op {op.name} has nested outputs")
+    if nc is not None:
+        # the native pool honors the same capacity flag (clear-on-
+        # overflow rather than LRU; inserts are compile-path cold)
+        nc.aval_cache_put(op.name, backend, akey, in_avals, hit,
+                          int(_flags.flag_value(
+                              "FLAGS_executable_cache_capacity")))
+    else:
         _AVAL_CACHE[key] = hit
     return hit
+
+
+def _fast_attr_safe(v) -> bool:
+    """True when an attr value is cheap AND safe to compare by dict
+    equality on the fast path (primitives and tuples thereof — the
+    same class the attrs-key intern treats as canonical). ndarrays /
+    lists / exotic values take the interned-key comparison instead."""
+    if v is None or type(v) in (bool, int, float, str, bytes):
+        return True
+    if type(v) is tuple:
+        return all(_fast_attr_safe(x) for x in v)
+    return False
+
+
+class _SkelOp:
+    """One retained op of a sealed segment's skeleton: everything the
+    fast path needs to admit a position-matching record without
+    re-deriving it (cached out-avals, interned sig entry, shared attrs
+    dict, grad flags)."""
+
+    __slots__ = ("op", "akey", "attrs", "fast_attrs", "wiring",
+                 "out_avals", "out_req", "req", "has_inexact", "entry",
+                 "n_outs", "ctup")
+
+
+class _Skeleton:
+    """The last sealed segment's op skeleton (armed only once the
+    signature memo proved the stream repeats). `in_sig` is the sealed
+    segment's external-input aval signature — the fast path validates
+    each fresh registration against it, so reused out-avals can never
+    desync from what the inputs imply."""
+
+    __slots__ = ("ops", "ctups", "in_sig", "gen")
 
 
 class CaptureContext:
@@ -531,17 +693,34 @@ class CaptureContext:
         # recorded op, so flush never re-walks the whole pending list
         self._sig_ops: List[Tuple] = []
         self._max_override = max_segment_ops
-        # steady-state signature memo: (ops_key, in_sig, live, epoch,
-        # backend, shard_sig) -> the _CachedKey handed out last flush.
-        # Validated by EXACT comparison over interned entries
+        # steady-state signature memos, one per SEGMENT SHAPE (keyed by
+        # the first interned sig entry — a real train step seals
+        # several distinct segment shapes per iteration, e.g. the
+        # fwd+bwd window and an optimizer tail, and a single slot would
+        # thrash between them): (ops_key, in_sig, live, epoch, backend,
+        # shard_sig) -> the _CachedKey handed out at that shape's last
+        # seal. Validated by EXACT comparison over interned entries
         # (identity-fast) + the mesh epoch + the ambient-mesh sharding
         # component (None without a mesh), so a replan, a mesh switch
-        # or any structural drift rebuilds.
+        # or any structural drift rebuilds. _sig_memo aliases the most
+        # recent memo (tests read its _CachedKey).
+        self._sig_memos: Dict[Any, Tuple] = {}
         self._sig_memo: Optional[Tuple] = None
         # (op_name, repr(error)) of the last record() failure — the
         # executor stashes it on the record_fallback path so the perf
         # analyzer can say WHY an op broke the window
         self._last_record_error = None
+        # trace-stable record fast path: the BANK of retained skeletons
+        # (one per memo-proven segment shape, keyed by the shape's
+        # first OpDef — the first record of a segment selects), the
+        # currently-selected skeleton, the replay cursor into it,
+        # whether the CURRENT segment is still matching, and how many
+        # of its ops were fast-replayed
+        self._skels: Dict[Any, _Skeleton] = {}
+        self._skeleton: Optional[_Skeleton] = None
+        self._skel_pos = 0
+        self._skel_live = False
+        self._fast_ops = 0
         # stats for tests / profiling
         self.segments_run = 0
         self.ops_recorded = 0
@@ -579,11 +758,230 @@ class CaptureContext:
         recorded keep the registered snapshot (eager ordering); future
         records must re-register the fresh payload, so the id mapping is
         evicted. The orphaned snapshot becomes a donation candidate at
-        flush (its backing tensor no longer aliases it)."""
+        flush (its backing tensor no longer aliases it). A mid-segment
+        swap also drops the record skeleton: the input stream is being
+        re-keyed under the replay's feet, so the fast path re-proves the
+        stream at the next sealed steady-state segment instead of
+        replaying across the mutation. (Between segments — the fused
+        optimizer write-back — there is nothing recorded and the
+        skeleton survives.)"""
         self._in_ids.pop(id(tensor), None)
+        if self.pending:
+            sk = self._skeleton
+            self._skeleton = None
+            self._skel_live = False
+            if sk is not None:
+                # evict the banked entry of the shape being replayed
+                for k in [k for k, v in self._skels.items() if v is sk]:
+                    del self._skels[k]
+
+    def _select_skel(self, op: OpDef):
+        """First record of a segment: select the banked skeleton whose
+        sealed shape starts with `op` (stale generations evict). None
+        = no candidate; this segment records through the full path."""
+        sk = self._skels.get(op)
+        if sk is not None and sk.gen != _FAST_GEN:
+            del self._skels[op]
+            sk = None
+        if sk is None:
+            self._skel_live = False
+            return None
+        self._skeleton = sk
+        return sk
+
+    def _record_fast(self, op: OpDef, ts, attrs):
+        """Trace-stable skeleton replay: admit this record by matching
+        the armed skeleton position-for-position instead of re-deriving
+        avals/keys. Returns the out-tensor tuple, or None on ANY
+        mismatch — nothing was mutated then, and the caller falls back
+        to the full record path (this segment stops replaying; the
+        skeleton re-arms or rebuilds at the next memo-proven seal).
+
+        Validation per op: same OpDef, equal attrs (dict equality for
+        primitive attrs, interned-key equality otherwise), identical
+        input wiring — op-ref inputs must point at the same (op, slot),
+        external inputs must land on the same input index with the aval
+        the sealed segment's in-signature recorded — and the same grad
+        intent. Only then are the skeleton's cached out-avals, interned
+        sig entry and shared attrs dict reused; external payloads are
+        re-bound through the normal registration machinery."""
+        global FAST_OPS
+        sk = self._skeleton
+        if sk is None:
+            sk = self._select_skel(op)
+        pos = self._skel_pos
+        if sk is None or sk.gen != _FAST_GEN or pos >= len(sk.ops) \
+                or _flags.STATIC_CHECKS_ACTIVE:
+            self._skel_live = False
+            if sk is not None and sk.gen != _FAST_GEN:
+                self._skeleton = None
+            return None
+        s = sk.ops[pos]
+        if s.op is not op or len(ts) != len(s.wiring):
+            self._skel_live = False
+            return None
+        if s.fast_attrs:
+            try:
+                if attrs != s.attrs:
+                    self._skel_live = False
+                    return None
+            except ValueError:
+                # an ndarray attr value arrived where the armed shape
+                # held primitives: dict inequality is ambiguous there —
+                # a plain mismatch, NOT an uncapturable op (the full
+                # path digests ndarray attrs via _hashable)
+                self._skel_live = False
+                return None
+        elif dispatch.attrs_key(attrs) != s.akey:
+            self._skel_live = False
+            return None
+        in_ids = self._in_ids
+        in_tensors = self._in_tensors
+        n_in = len(self._in_vals)
+        in_sig = sk.in_sig
+        new_ext = None      # fresh external registrations, commit later
+        new_ids = None
+        req = False
+        for t, w in zip(ts, s.wiring):
+            if t is None:
+                if w is not None:
+                    self._skel_live = False
+                    return None
+                continue
+            p = t._payload
+            if getattr(p, "_is_lazy_ref", False):
+                if p.ctx is self and p.op_idx is not None:
+                    if w is None or w[0] != "op" or w[1] != p.op_idx \
+                            or w[2] != p.slot:
+                        self._skel_live = False
+                        return None
+                    req = req or p.requires_grad
+                    continue
+                # foreign-context lazy value: the slow path materializes
+                self._skel_live = False
+                return None
+            if w is None or w[0] != "in":
+                self._skel_live = False
+                return None
+            idx = in_ids.get(id(t))
+            if idx is not None and in_tensors[idx]() is not t:
+                idx = None
+            if idx is None and new_ids is not None:
+                idx = new_ids.get(id(t))
+            if idx is None:
+                idx = n_in if new_ext is None else n_in + len(new_ext)
+                if idx >= len(in_sig):
+                    self._skel_live = False
+                    return None
+                isig = in_sig[idx]
+                if tuple(p.shape) != isig[0] \
+                        or _dstr(p.dtype) != isig[1] \
+                        or bool(getattr(p, "weak_type", False)) != isig[2]:
+                    self._skel_live = False
+                    return None
+                if new_ext is None:
+                    new_ext = [t]
+                    new_ids = {id(t): idx}
+                else:
+                    new_ext.append(t)
+                    new_ids[id(t)] = idx
+            if w[1] != idx:
+                self._skel_live = False
+                return None
+            req = req or not t._stop_gradient
+        if s.has_inexact and (req and _IS_GRAD_ENABLED()) != s.req:
+            # grad intent flipped (no_grad scope, stop_gradient toggle):
+            # the skeleton's out flags no longer apply
+            self._skel_live = False
+            return None
+        # ---- commit (nothing above mutated the context)
+        if new_ext is not None:
+            for t in new_ext:
+                self._input_index(t)
+        src = None
+        if PERF_SRC or _OBS.COMPUTE:
+            # provenance demanded (perf trace / compute plane): the
+            # fast path still skips aval work but captures the source
+            # line per op — diagnostics and named_scope provenance must
+            # not degrade under replay
+            from ..analysis.hooks import call_site
+            src = call_site()
+        op_idx = len(self.pending)
+        out_refs = []
+        outs = []
+        for slot in range(s.n_outs):
+            rg = s.out_req[slot]
+            ref = LazyRef.__new__(LazyRef)
+            ref.ctx = self
+            ref.op_idx = op_idx
+            ref.slot = slot
+            ref.aval = s.out_avals[slot]
+            ref.requires_grad = rg
+            ref.trefs = []
+            out_refs.append(ref)
+            outs.append(_lazy_tensor(ref, stop_gradient=not rg))
+        pop = _PendingOp.__new__(_PendingOp)
+        pop.op = op
+        pop.attrs = s.attrs
+        pop.wiring = s.wiring
+        pop.out_refs = out_refs
+        pop.n_outs = s.n_outs
+        pop.src = src
+        self.pending.append(pop)
+        self._sig_ops.append(s.entry)
+        self._skel_pos = pos + 1
+        self.ops_recorded += 1
+        self._fast_ops += 1
+        FAST_OPS += 1
+        return tuple(outs)
+
+    def _build_skeleton(self, in_sig):
+        """Retain the just-sealed segment as the replay skeleton (only
+        called once the signature memo proved the stream repeats)."""
+        ops = []
+        for pop, entry in zip(self.pending, self._sig_ops):
+            s = _SkelOp()
+            s.op = pop.op
+            s.akey = entry[1]
+            s.attrs = pop.attrs
+            s.fast_attrs = all(_fast_attr_safe(v)
+                               for v in pop.attrs.values())
+            s.wiring = pop.wiring
+            s.out_avals = tuple(r.aval for r in pop.out_refs)
+            s.out_req = tuple(r.requires_grad for r in pop.out_refs)
+            s.req = any(s.out_req)
+            s.has_inexact = any(_is_inexact(a.dtype) for a in s.out_avals)
+            s.entry = entry
+            s.n_outs = pop.n_outs
+            # flat tuple for the native matcher: one PyTuple_GET_ITEM
+            # per field instead of a slot GetAttr each
+            s.ctup = (s.op, s.akey, s.attrs, s.fast_attrs, s.wiring,
+                      s.out_avals, s.out_req, s.req, s.has_inexact,
+                      s.entry, s.n_outs)
+            ops.append(s)
+        sk = _Skeleton()
+        sk.ops = ops
+        sk.ctups = [s.ctup for s in ops]
+        sk.in_sig = in_sig
+        sk.gen = _FAST_GEN
+        self._skeleton = sk
+        if len(self._skels) > 8:
+            self._skels.clear()
+        self._skels[self.pending[0].op] = sk
 
     def record(self, op: OpDef, ts, attrs):
-        """Record one op application; returns out Tensors (lazy)."""
+        """Record one op application; returns out Tensors (lazy).
+
+        The NATIVE skeleton matcher is entered one level up, in
+        executor.apply (the only production caller) — record() itself
+        runs the python matcher, which self-gates on the sanitizer /
+        provenance modes and stands alone without the C library. The
+        two gates are contract twins: a new mode that must bypass the
+        replay belongs in _record_fast AND in apply's native gate."""
+        if self._skel_live:
+            outs = self._record_fast(op, ts, attrs)
+            if outs is not None:
+                return outs
         is_grad_enabled = _IS_GRAD_ENABLED
         if is_grad_enabled is None:
             _bind_hot_imports()
@@ -632,7 +1030,7 @@ class CaptureContext:
         out_refs = []
         outs = []
         for s, aval in enumerate(out_avals):
-            inexact = jnp.issubdtype(aval.dtype, jnp.inexact)
+            inexact = _is_inexact(aval.dtype)
             ref = LazyRef(self, op_idx, s, aval, req and inexact)
             t = _lazy_tensor(ref, stop_gradient=not (req and inexact))
             out_refs.append(ref)
@@ -664,10 +1062,7 @@ class CaptureContext:
             src = call_site()
         self.pending.append(_PendingOp(op, dict(attrs), wiring, out_refs,
                                        src))
-        entry = (op.name, akey, wiring, len(out_refs))
-        entry = _SIG_ENTRY_INTERN.setdefault(entry, entry)
-        if len(_SIG_ENTRY_INTERN) > 65536:
-            _SIG_ENTRY_INTERN.clear()
+        entry = _intern_sig_entry((op.name, akey, wiring, len(out_refs)))
         self._sig_ops.append(entry)
         self.ops_recorded += 1
         return tuple(outs)
@@ -675,8 +1070,13 @@ class CaptureContext:
     def maybe_cap_flush(self):
         """Called by the executor AFTER a successful record, outside its
         record-fallback handler, so a failing segment execution surfaces
-        instead of being swallowed as an 'uncapturable op'."""
-        if len(self.pending) >= self.max_ops:
+        instead of being swallowed as an 'uncapturable op'. Reads the
+        cap inline (not via the max_ops property) — this runs once per
+        recorded op."""
+        cap = self._max_override
+        if cap is None:
+            cap = _MAX_SEG_OPS
+        if len(self.pending) >= cap:
             self.flush("segment_cap")
 
     def _reset_segment(self):
@@ -687,6 +1087,10 @@ class CaptureContext:
         self._in_vals = []
         self._in_meta = []
         self._sig_ops = []
+        self._skel_pos = 0
+        self._skeleton = None            # selected by the next segment's
+        self._skel_live = bool(self._skels)   # first record
+        self._fast_ops = 0
 
     def _live_outputs(self, pending):
         """Lazy refs some Tensor still aliases (see _live_aliases)."""
@@ -713,7 +1117,17 @@ class CaptureContext:
         # and downstream cache lookups hash a cached int instead of
         # re-walking the whole structure every step.
         ops_key = tuple(self._sig_ops)
-        in_sig = _in_signature(in_vals)
+        sk = self._skeleton
+        if sk is not None and self._skel_live \
+                and self._skel_pos == len(sk.ops) \
+                and len(in_vals) == len(sk.in_sig):
+            # fully skeleton-replayed segment: every external
+            # registration was validated against the sealed in-sig, so
+            # the tuple is identical by construction — reuse the object
+            # (the memo compare below becomes an identity check)
+            in_sig = sk.in_sig
+        else:
+            in_sig = _in_signature(in_vals)
         live_t = tuple(live)
         backend = jax.default_backend()
         spmd = SPMD
@@ -723,17 +1137,47 @@ class CaptureContext:
             SHARD_SIG_BUILDS += 1
             shard_sig = (spmd.key,
                          tuple(spmd.spec_of(v) for v in in_vals))
-        memo = self._sig_memo
+        # per-shape memo bucket: first entry + length + last entry
+        # disambiguates shapes that share a leading op (entries are
+        # interned, so the tuple hashes cheaply). NOTE the skeleton
+        # BANK below is still keyed by the first OpDef alone — it must
+        # select before anything else is known — so two shapes sharing
+        # their first (op, attrs, wiring) entry alternate the bank slot
+        # and replay stays off for them (documented limitation; the
+        # memo/_CachedKey reuse still works per shape).
+        key0 = (self._sig_ops[0], len(self._sig_ops), self._sig_ops[-1])
+        memo = self._sig_memos.get(key0)
         if memo is not None and memo[3] == MESH_EPOCH \
                 and memo[4] == backend and memo[5] == shard_sig \
                 and memo[0] == ops_key \
                 and memo[1] == in_sig and memo[2] == live_t:
+            # the memo just proved this segment shape repeats: arm (or
+            # refresh) its record skeleton — unless the current
+            # segment fully replayed it, in which case it is exact
+            if _FAST_PATH and not _flags.STATIC_CHECKS_ACTIVE and (
+                    sk is None or sk.gen != _FAST_GEN
+                    or not (self._skel_live
+                            and self._skel_pos == len(sk.ops))):
+                self._build_skeleton(memo[1])
+            self._sig_memo = memo
             return memo[6]
+        # structural drift for THIS shape: drop its banked skeleton
+        # and re-prove before replaying it again — but only when the
+        # banked entry IS this shape (same length); a different shape
+        # that merely shares the leading op keeps its valid skeleton
+        banked = self._skels.get(self.pending[0].op)
+        if banked is not None and len(banked.ops) == len(self._sig_ops):
+            del self._skels[self.pending[0].op]
+        self._skeleton = None
         base = (backend, ops_key, in_sig, live_t, MESH_EPOCH)
         key = _CachedKey(base if shard_sig is None
                          else base + (shard_sig,))
-        self._sig_memo = (ops_key, in_sig, live_t, MESH_EPOCH, backend,
-                          shard_sig, key)
+        if len(self._sig_memos) > 8:
+            self._sig_memos.clear()
+        memo = (ops_key, in_sig, live_t, MESH_EPOCH, backend,
+                shard_sig, key)
+        self._sig_memos[key0] = memo
+        self._sig_memo = memo
         return key
 
     # ------------------------------------------------------------- flush
@@ -769,13 +1213,14 @@ class CaptureContext:
                 _segment_needs_grad(in_tensors, in_vals, live_refs, in_meta):
             donate = _donatable_inputs(in_tensors, in_vals, live_refs)
 
-        # async dispatch pipeline: a cap-sealed segment hands off to
-        # the single-worker flush executor so compile+execute leave the
-        # recording thread; live outputs become PendingValues that
-        # materialize at the next sync point. SOT capture (on_flush
-        # observer) needs concrete out tensors, so it stays synchronous.
-        if _flags.ASYNC_FLUSH_ACTIVE and reason in _ASYNC_REASONS \
-                and self.on_flush is None:
+        # async dispatch pipeline: a cap- or guard-exit-sealed segment
+        # hands off to the single-worker flush executor so
+        # compile+execute leave the recording thread; live outputs
+        # become PendingValues that materialize at the next sync
+        # point. SOT capture (on_flush observer) rides along: its
+        # note_flush accepts pending out tensors (the entry builder
+        # reads only avals/identity, never concrete values).
+        if _flags.ASYNC_FLUSH_ACTIVE and reason in _ASYNC_REASONS:
             self._flush_async(reason, pending, in_vals, in_meta,
                               in_tensors, live, live_refs, sig, donate)
             return
@@ -811,7 +1256,7 @@ class CaptureContext:
                 raise
 
         fspan = _obs_flush_span(reason, len(pending), len(in_vals),
-                                len(live), len(donate)) \
+                                len(live), len(donate), self._fast_ops) \
             if _OBS.ACTIVE else None
         dispatch.bump_exec()
         xspan = None
@@ -973,6 +1418,7 @@ class CaptureContext:
         # such programs compile unpinned (see _spmd_for_compile)
         spmd = SPMD
         spmd_pin = _spmd_for_compile(in_vals)
+        fast_n = self._fast_ops
         from . import flags
         nan_check = flags.flag_value("FLAGS_check_nan_inf")
 
@@ -1009,7 +1455,7 @@ class CaptureContext:
                         reason=reason, in_ids=in_ids)
                 fspan = _obs_flush_span(reason, len(pending),
                                         len(in_vals), len(live),
-                                        len(donate)) \
+                                        len(donate), fast_n) \
                     if _OBS.ACTIVE else None
                 run_vals = resolve_pending(in_vals)
                 dispatch.bump_exec()
@@ -1097,6 +1543,13 @@ class CaptureContext:
         self.segments_run += 1
         self._register_grad(pending, live, live_refs, out_tensors,
                             in_tensors, in_vals, sig, in_meta)
+        if self.on_flush is not None:
+            # SOT capture observer: the sealed segment's out tensors
+            # carry PENDING payloads (they materialize at the first
+            # read) — the guarded-entry builder reads only avals and
+            # payload identity, so guard-exit seals ride the pipeline
+            self.on_flush(self, reason, pending, live, live_refs,
+                          in_tensors, in_vals, sig, out_tensors)
 
     on_flush = None  # observer hook (jit/sot records segment structure)
 
@@ -1841,7 +2294,8 @@ def try_fused_backward(tensors, grad_tensors) -> bool:
             raise
 
     fspan = _obs_flush_span("backward_fused", len(pending), len(in_vals),
-                            len(live), 0) if _OBS.ACTIVE else None
+                            len(live), 0, ctx._fast_ops) \
+        if _OBS.ACTIVE else None
     sig = ctx._signature(in_vals, live)
     key = (sig, grad_in, root_k)
     runner = _FUSED_CACHE.get(key)
@@ -2030,3 +2484,5 @@ def clear_segment_cache():
     _SEG_BWD_CACHE.clear()
     _FUSED_CACHE.clear()
     _AVAL_CACHE.clear()
+    if _NC is not None:
+        _NC.aval_cache_clear()

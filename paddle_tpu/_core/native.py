@@ -228,9 +228,17 @@ _EAGER_CORE_TRIED = False
 
 def get_eager_core():
     """The eager hot-path CPython extension (csrc/eager_core.cc):
-    dispatch-key construction + backward in-degree BFS in C. Returns
-    None when unavailable (python fallbacks stay correct); set
-    PT_DISABLE_NATIVE_EAGER=1 to force the python path."""
+    dispatch-key construction, backward in-degree BFS, and the NATIVE
+    RECORD CORE — interned shape/dtype atoms, the record-time out-aval
+    cache (C key build + lookup), the sig-entry intern, and the
+    trace-stable skeleton matcher ``skel_record`` that replays one
+    recorded op per C call (lazy.py arms/validates the skeleton and
+    stands alone in pure python when this returns None). Returns None
+    when unavailable (python fallbacks stay correct); set
+    PT_DISABLE_NATIVE_EAGER=1 to force the python path. Consumers
+    cache their own resolution (dispatch._EAGER_CORE, lazy._NC) so
+    bench row 17 and the fallback tests can force either prong
+    in-process."""
     global _EAGER_CORE, _EAGER_CORE_TRIED
     if _EAGER_CORE_TRIED:
         return _EAGER_CORE

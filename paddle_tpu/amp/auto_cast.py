@@ -73,7 +73,30 @@ def _hook(op_name, tensors):
     return tensors
 
 
-set_amp_hook(_hook)
+# The executor's amp hook is installed only while at least one
+# auto_cast scope is live ANYWHERE in the process (depth-counted below):
+# outside amp, eager dispatch pays zero per-op amp work instead of a
+# thread-local read + hook call per op. Inside a scope, behavior is
+# identical to the always-installed hook (threads outside the scope see
+# state None and pass through, exactly as before).
+_HOOK_DEPTH = 0
+_HOOK_LOCK = threading.Lock()
+
+
+def _hook_enter():
+    global _HOOK_DEPTH
+    with _HOOK_LOCK:
+        _HOOK_DEPTH += 1
+        if _HOOK_DEPTH == 1:
+            set_amp_hook(_hook)
+
+
+def _hook_exit():
+    global _HOOK_DEPTH
+    with _HOOK_LOCK:
+        _HOOK_DEPTH -= 1
+        if _HOOK_DEPTH == 0:
+            set_amp_hook(None)
 
 
 class auto_cast:
@@ -103,9 +126,18 @@ class auto_cast:
         WHITE_LIST.update(self._added_w)
         BLACK_LIST.update(self._added_b)
         _STATE.amp = (self.level, self.dtype) if self.enable else None
+        # a disabled scope (`auto_cast(enable=use_amp)` with use_amp
+        # False) must not install the per-op hook — it would pay the
+        # hook call AND lose the dispatch-level record fast path for
+        # nothing (state is None, every call would pass through)
+        self._hooked = self.enable
+        if self._hooked:
+            _hook_enter()
         return self
 
     def __exit__(self, *exc):
+        if self._hooked:
+            _hook_exit()
         _STATE.amp = self._prev
         WHITE_LIST.difference_update(self._added_w)
         BLACK_LIST.difference_update(self._added_b)

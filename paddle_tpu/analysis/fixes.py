@@ -231,6 +231,9 @@ def plan_and_apply(view, report: CheckReport, ctx=None,
         from .._core import executor
         for key in scalar_keys:
             executor._SCALAR_CACHE.pop(key, None)
+            # the shared Tensor wrapper mirrors the array cache entry
+            # (it wraps the same payload) — evict both in lockstep
+            executor._SCALAR_TENSORS.pop(key, None)
     if tracer_inputs:
         # after the poisoned closure is pruned nothing reads these
         # slots; a concrete placeholder of the same aval keeps the

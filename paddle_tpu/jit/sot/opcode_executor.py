@@ -327,7 +327,12 @@ class SotSession:
         self.flushes: List[Tuple] = []
         self.inlined = 0
 
-    # lazy.CaptureContext on_flush observer
+    # lazy.CaptureContext on_flush observer. Accepts PENDING out
+    # tensors: with FLAGS_async_flush on, guard-exit (and cap) seals
+    # ride the async pipeline and the observed out/in payloads may be
+    # in-flight PendingValues — _build_entry reads only avals and
+    # payload identity, never concrete values, so entry construction
+    # needs no sync point.
     def note_flush(self, ctx, reason, pending, live, live_refs,
                    in_tensors, in_vals, sig, out_tensors):
         self.flushes.append((reason, pending, live, live_refs,

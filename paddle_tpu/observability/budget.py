@@ -243,7 +243,7 @@ def _rank(snap: Dict, wall_us: float, steps: int) -> Dict:
                      if k.startswith(("segment.", "cache.", "compiles.",
                                       "optimizer.", "sot.", "eager.",
                                       "fusion.", "comm.", "memory.",
-                                      "compute.", "io."))},
+                                      "compute.", "io.", "record."))},
         "step_cache_hit_rate": snap.get("step_cache_hit_rate"),
     }
 

@@ -87,13 +87,16 @@ def _rbin(fn):
     return op
 
 
-Tensor.__add__ = lambda s, o: add(s, o)
-Tensor.__radd__ = lambda s, o: add(s, o)
-Tensor.__sub__ = lambda s, o: subtract(s, o)
+# the hot arithmetic dunders bind the op wrappers DIRECTLY (functions
+# are descriptors, so `x + y` calls add(x, y) with no lambda frame in
+# between — one stack frame per dispatched op on the record hot path)
+Tensor.__add__ = add
+Tensor.__radd__ = add
+Tensor.__sub__ = subtract
 Tensor.__rsub__ = _rbin(subtract)
-Tensor.__mul__ = lambda s, o: multiply(s, o)
-Tensor.__rmul__ = lambda s, o: multiply(s, o)
-Tensor.__truediv__ = lambda s, o: divide(s, o)
+Tensor.__mul__ = multiply
+Tensor.__rmul__ = multiply
+Tensor.__truediv__ = divide
 Tensor.__rtruediv__ = _rbin(divide)
 Tensor.__floordiv__ = lambda s, o: floor_divide(s, o)
 Tensor.__rfloordiv__ = _rbin(floor_divide)

@@ -290,3 +290,59 @@ def test_executor_backpressure_bounds_inflight():
     assert unblocked, "submit never released after the worker drained"
     ex.drain()
     ex.shutdown()
+
+
+# -------------------------------------- guard-exit seals ride the pipe
+
+def test_guard_exit_seal_rides_async():
+    """A lazy_guard exit with async flush on seals asynchronously: the
+    out tensors carry PendingValue payloads after the `with` block and
+    materialize to the exact synchronous result at the first read."""
+    from paddle_tpu.framework import lazy_guard
+
+    def build():
+        with lazy_guard():
+            z = paddle.to_tensor(np.full((6, 6), 1.5, "float32"))
+            for _ in range(10):
+                z = z * 1.02 + 0.01
+        return z
+
+    with with_flag("FLAGS_async_flush", True):
+        z = build()
+        assert getattr(z._payload, "_is_pending_value", False), \
+            "guard-exit seal did not ride the async pipeline"
+        assert z.shape == [6, 6]            # metadata never blocks
+        got = np.asarray(z._value)
+        async_flush.drain()
+    ref = np.asarray(build()._value)
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_sot_entry_built_from_async_guard_exit():
+    """SOT's on_flush accepts pending out tensors: with async flush on,
+    the capture's guard-exit seal goes through the pipeline AND still
+    builds the guarded fast-path entry (the builder reads only avals /
+    payload identity); the replayed fast hit matches the sync result."""
+    from paddle_tpu.jit.sot import symbolic_translate
+
+    def fn(a):
+        b = a * 1.5 + 0.25
+        c = b * b
+        return c - a
+
+    x = paddle.to_tensor(np.full((4, 4), 0.5, "float32"))
+    ref = np.asarray(fn(x)._value)
+
+    sfn = symbolic_translate(fn)
+    with with_flag("FLAGS_async_flush", True):
+        out1 = sfn(x)
+        assert getattr(out1._payload, "_is_pending_value", False), \
+            "SOT capture's guard-exit seal stayed synchronous"
+        got1 = np.asarray(out1._value)
+        assert sfn.stats["captures"] == 1 and len(sfn._entries) == 1, \
+            "async guard-exit seal failed to build the guarded entry"
+        got2 = np.asarray(sfn(x)._value)
+        assert sfn.stats["fast_hits"] == 1, sfn.stats
+        async_flush.drain()
+    np.testing.assert_array_equal(got1, ref)
+    np.testing.assert_array_equal(got2, ref)

@@ -1,0 +1,450 @@
+"""Trace-stable record fast path (FLAGS_record_fast_path) + native
+record core — engagement, bit-exact parity, skeleton invalidation, and
+the intern/cache bounds.
+
+Contracts under test:
+
+- a steady-state loop ARMS the skeleton at the second identical seal
+  (the signature memo proves the stream) and replays every later
+  record through the fast path (lazy.FAST_OPS counts them);
+- results are BIT-exact vs the full record path — fast path on/off,
+  python matcher and native core, with async flush on, on the LeNet
+  train loop (losses AND params);
+- invalidation: mesh-epoch bump (what a replan does), relevant
+  set_flags mid-session, and a mid-segment in-place payload swap all
+  drop the skeleton; the stream re-proves and re-arms afterwards;
+- the pure-python prong stands alone when the native library is
+  absent, and behaves identically;
+- _AVAL_CACHE is LRU-bounded (ExecCache capacity pattern) and the
+  _SIG_ENTRY_INTERN pool clears past 65536 entries without breaking
+  equality-based reuse;
+- budget --static-diff stays an EXACT match with the fast path on
+  (skeleton-replayed ops feed the same seal counters).
+
+The suite conftest runs under FLAGS_static_checks=warn, which
+self-disables the fast path (the sanitizer needs full per-op capture);
+every engagement test here switches checks off for its window.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from conftest import with_flag
+from paddle_tpu._core import async_flush, dispatch, lazy
+from paddle_tpu._core.flags import set_flags
+
+
+@pytest.fixture
+def checks_off():
+    """The fast path self-disables under the sanitizer; these tests
+    need it live."""
+    with with_flag("FLAGS_static_checks", "off"):
+        yield
+
+
+@pytest.fixture
+def python_only():
+    """Force the pure-python prong (the native-lib-absent fallback)."""
+    nc, tried = lazy._NC, lazy._NC_TRIED
+    ec = dispatch._EAGER_CORE
+    lazy._NC, lazy._NC_TRIED = None, True
+    dispatch._EAGER_CORE = None
+    try:
+        yield
+    finally:
+        lazy._NC, lazy._NC_TRIED = nc, tried
+        dispatch._EAGER_CORE = ec
+
+
+def _chain(x, n=12):
+    y = x
+    for _ in range(n):
+        y = y * 1.01 + 0.001
+    return np.asarray(y._value)
+
+
+def test_fast_path_engages_and_matches(checks_off):
+    x = paddle.to_tensor(np.full((8, 8), 1.25, "float32"))
+    ref = _chain(x)
+    f0 = lazy.FAST_OPS
+    for _ in range(4):
+        np.testing.assert_array_equal(_chain(x), ref)
+    assert lazy.FAST_OPS > f0, "steady-state loop never replayed"
+    # a steady iteration replays EVERY op of the segment
+    f1 = lazy.FAST_OPS
+    np.testing.assert_array_equal(_chain(x), ref)
+    assert lazy.FAST_OPS - f1 == 24   # 12 * (mul + add)
+
+
+def test_flag_off_freezes_fast_path(checks_off):
+    x = paddle.to_tensor(np.full((8, 8), 1.25, "float32"))
+    for _ in range(3):
+        _chain(x)
+    with with_flag("FLAGS_record_fast_path", False):
+        f0 = lazy.FAST_OPS
+        ref = _chain(x)
+        assert lazy.FAST_OPS == f0, \
+            "FLAGS_record_fast_path=false did fast-path work"
+    # flag back on: re-proves, re-arms, matches
+    for _ in range(3):
+        np.testing.assert_array_equal(_chain(x), ref)
+
+
+def test_python_matcher_engages_without_native(checks_off, python_only):
+    x = paddle.to_tensor(np.full((8, 8), 0.75, "float32"))
+    ref = _chain(x)
+    f0 = lazy.FAST_OPS
+    for _ in range(4):
+        np.testing.assert_array_equal(_chain(x), ref)
+    assert lazy.FAST_OPS > f0, "pure-python fast path never replayed"
+
+
+def _lenet_losses_params(steps=4):
+    paddle.seed(0)
+    from paddle_tpu.vision.models import LeNet
+    model = LeNet()
+    opt = paddle.optimizer.Adam(1e-3, parameters=model.parameters())
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(8, 1, 28, 28).astype(np.float32))
+    y = paddle.to_tensor(rng.randint(0, 10, (8,)).astype(np.int64))
+    losses = []
+    for _ in range(steps):
+        loss = F.cross_entropy(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(np.asarray(loss._value).copy())
+    return losses, [np.asarray(p._value).copy()
+                    for p in model.parameters()]
+
+
+def test_lenet_parity_fast_on_off_with_async_flush(checks_off):
+    """THE acceptance parity drill: LeNet train-loop losses AND params
+    byte-equal fast-path on vs off, with the async flush pipeline on —
+    and the fast path actually engaged during the on run."""
+    with with_flag("FLAGS_async_flush", True):
+        with with_flag("FLAGS_record_fast_path", False):
+            l_off, p_off = _lenet_losses_params()
+        async_flush.drain()
+        f0 = lazy.FAST_OPS
+        l_on, p_on = _lenet_losses_params()
+        async_flush.drain()
+        assert lazy.FAST_OPS > f0, "fast path idle through the train loop"
+    assert all((a == b).all() for a, b in zip(l_off, l_on))
+    assert all((a == b).all() for a, b in zip(p_off, p_on))
+
+
+def test_lenet_parity_python_matcher(checks_off, python_only):
+    """The native-lib-absent fallback passes the same parity drill."""
+    with with_flag("FLAGS_record_fast_path", False):
+        l_off, p_off = _lenet_losses_params(steps=3)
+    f0 = lazy.FAST_OPS
+    l_on, p_on = _lenet_losses_params(steps=3)
+    assert lazy.FAST_OPS > f0
+    assert all((a == b).all() for a, b in zip(l_off, l_on))
+    assert all((a == b).all() for a, b in zip(p_off, p_on))
+
+
+# ----------------------------------------------------- invalidation
+
+def _warm_ctx(x):
+    _chain(x)
+    _chain(x)
+    ctx = lazy.current_context()
+    # the BANK holds one skeleton per proven segment shape; the first
+    # record of the next segment selects into ctx._skeleton
+    assert ctx._skels, "skeleton failed to arm"
+    return ctx
+
+
+def test_mesh_epoch_bump_invalidates(checks_off):
+    """bump_mesh_epoch (what AdaptiveTrainer's replan calls after
+    moving state to a new mesh) drops the armed skeleton; the stream
+    re-proves and re-arms."""
+    x = paddle.to_tensor(np.full((8, 8), 1.5, "float32"))
+    ctx = _warm_ctx(x)
+    ref = _chain(x)
+    lazy.bump_mesh_epoch()
+    f0 = lazy.FAST_OPS
+    np.testing.assert_array_equal(_chain(x), ref)   # records slow
+    assert lazy.FAST_OPS == f0, "replayed across a mesh-epoch bump"
+    np.testing.assert_array_equal(_chain(x), ref)   # memo re-proves
+    f1 = lazy.FAST_OPS
+    np.testing.assert_array_equal(_chain(x), ref)   # re-armed
+    assert lazy.FAST_OPS > f1
+
+
+def test_set_flags_mid_session_invalidates(checks_off):
+    """set_flags of a watched flag mid-session bumps the skeleton
+    generation — the next record drops the stale skeleton."""
+    x = paddle.to_tensor(np.full((8, 8), 2.0, "float32"))
+    ctx = _warm_ctx(x)
+    ref = _chain(x)
+    gen = lazy._FAST_GEN
+    set_flags({"FLAGS_lazy_max_segment_ops": 255})
+    try:
+        assert lazy._FAST_GEN > gen
+        f0 = lazy.FAST_OPS
+        np.testing.assert_array_equal(_chain(x), ref)
+        assert lazy.FAST_OPS == f0, "replayed across a set_flags bump"
+        _chain(x)
+        f1 = lazy.FAST_OPS
+        np.testing.assert_array_equal(_chain(x), ref)
+        assert lazy.FAST_OPS > f1, "never re-armed after set_flags"
+    finally:
+        set_flags({"FLAGS_lazy_max_segment_ops": 256})
+    del ctx
+
+
+def test_note_inplace_mid_segment_invalidates(checks_off):
+    """An in-place payload swap while ops are pending drops the
+    skeleton (the input stream is re-keyed under the replay); between
+    segments — the fused-optimizer write-back — it survives."""
+    x = paddle.to_tensor(np.full((8, 8), 1.1, "float32"))
+    ctx = _warm_ctx(x)
+    # between segments: nothing pending, the banked skeleton survives
+    t = paddle.to_tensor(np.ones((4, 4), "float32"))
+    t.set_value(np.zeros((4, 4), "float32"))
+    assert ctx._skels
+    # mid-segment: pending ops -> the replayed shape is invalidated
+    y = x * 1.01
+    assert ctx.pending, "op did not record"
+    sel = ctx._skeleton
+    assert sel is not None, "first record did not select"
+    t.set_value(np.ones((4, 4), "float32"))
+    assert ctx._skeleton is None and not ctx._skel_live
+    assert sel not in ctx._skels.values(), \
+        "banked entry of the mutated shape survived"
+    np.asarray(y._value)
+    # stream re-proves and re-arms afterwards
+    _chain(x)
+    _chain(x)
+    f1 = lazy.FAST_OPS
+    _chain(x)
+    assert lazy.FAST_OPS > f1
+
+
+def test_grad_mode_flip_falls_back_correctly(checks_off):
+    """A no_grad iteration mismatches the armed grad intent: it must
+    record correctly (slow) and grads must be exact when grad mode
+    returns."""
+    def run():
+        w = paddle.to_tensor(np.full((4, 4), 0.5, "float32"),
+                             stop_gradient=False)
+        z = w
+        for _ in range(8):
+            z = z * 1.1 + 0.1
+        z.sum().backward()
+        return np.asarray(w.grad._value).copy()
+
+    g_ref = run()
+    g2 = run()                       # armed + replayed
+    with paddle.no_grad():
+        x = paddle.to_tensor(np.full((4, 4), 0.5, "float32"))
+        v = x
+        for _ in range(8):
+            v = v * 1.1 + 0.1
+        np.asarray(v._value)         # same shapes, no grad: falls back
+    g3 = run()
+    assert (g_ref == g2).all() and (g_ref == g3).all()
+
+
+def test_shape_change_falls_back(checks_off):
+    x8 = paddle.to_tensor(np.full((8, 8), 1.25, "float32"))
+    x4 = paddle.to_tensor(np.full((4, 4), 1.25, "float32"))
+    _warm_ctx(x8)
+    ref = _chain(x4)                 # aval mismatch -> full path
+    np.testing.assert_array_equal(_chain(x4), ref)
+    ref8 = np.asarray((x8._value * 1.01 + 0.001))
+    del ref8
+
+
+# ------------------------------------------ cache / intern bounds
+
+def test_aval_cache_lru_bounded(checks_off, python_only):
+    """_AVAL_CACHE uses the ExecCache capacity pattern: distinct
+    record-time signatures evict LRU instead of growing unboundedly."""
+    lazy.clear_segment_cache()
+    with with_flag("FLAGS_executable_cache_capacity", 8):
+        for n in range(1, 14):
+            t = paddle.to_tensor(np.ones((n, 3), "float32"))
+            np.asarray((t * 2.0)._value)
+        assert len(lazy._AVAL_CACHE) <= 8, len(lazy._AVAL_CACHE)
+
+
+def test_sig_entry_intern_overflow_pinned():
+    """The 65536-entry overflow rule: the pool CLEARS (identity reuse
+    degrades to equality until repopulation — never correctness)."""
+    saved = dict(lazy._SIG_ENTRY_INTERN)
+    nc, tried = lazy._NC, lazy._NC_TRIED
+    lazy._NC, lazy._NC_TRIED = None, True   # pin the PYTHON pool
+    try:
+        lazy._SIG_ENTRY_INTERN.clear()
+        e1 = lazy._intern_sig_entry(("op_a", (), (None,), 1))
+        assert lazy._intern_sig_entry(("op_a", (), (None,), 1)) is e1
+        for i in range(65536):
+            lazy._SIG_ENTRY_INTERN[("fill", i)] = ("fill", i)
+        e2 = lazy._intern_sig_entry(("op_b", (), (None,), 1))
+        # the insert overflowed the pool: cleared, entry still valid
+        assert len(lazy._SIG_ENTRY_INTERN) == 0
+        assert e2 == ("op_b", (), (None,), 1)
+        # repopulation restores identity interning
+        e3 = lazy._intern_sig_entry(("op_b", (), (None,), 1))
+        assert lazy._intern_sig_entry(("op_b", (), (None,), 1)) is e3
+        # the pre-clear entry still compares equal (memo degrades to
+        # equality, not incorrectness)
+        assert e3 == e2 and e1 == ("op_a", (), (None,), 1)
+    finally:
+        lazy._SIG_ENTRY_INTERN.clear()
+        lazy._SIG_ENTRY_INTERN.update(saved)
+        lazy._NC, lazy._NC_TRIED = nc, tried
+
+
+def test_native_sig_entry_intern_overflow():
+    """The native pool mirrors the overflow rule."""
+    nc = lazy._NC if lazy._NC_TRIED else lazy._native_core()
+    if nc is None:
+        pytest.skip("native record core unavailable")
+    e1 = nc.sig_entry(("nat_op", (), (None,), 1))
+    assert nc.sig_entry(("nat_op", (), (None,), 1)) is e1
+    for i in range(65600):
+        nc.sig_entry(("nat_fill", i))
+    sizes = nc.intern_sizes()
+    assert sizes["sig_entry"] <= 65537, sizes
+    e2 = nc.sig_entry(("nat_op", (), (None,), 1))
+    assert e2 == ("nat_op", (), (None,), 1)
+
+
+def test_native_aval_cache_roundtrip():
+    nc = lazy._NC if lazy._NC_TRIED else lazy._native_core()
+    if nc is None:
+        pytest.skip("native record core unavailable")
+    import jax
+    a = jax.ShapeDtypeStruct((2, 3), np.dtype("float32"))
+    outs = (a,)
+    assert nc.aval_cache_get("t_op", "cpu", (), [a]) is None
+    nc.aval_cache_put("t_op", "cpu", (), [a], outs)
+    assert nc.aval_cache_get("t_op", "cpu", (), [a]) == outs
+    nc.aval_cache_clear()
+    assert nc.aval_cache_get("t_op", "cpu", (), [a]) is None
+
+
+# ------------------------------------------------- meters stay honest
+
+def test_static_diff_exact_with_fast_path(checks_off):
+    """budget --static-diff stays an EXACT match with the fast path
+    on: skeleton-replayed ops feed the same seal-reason counters the
+    static perf analyzer predicts."""
+    from paddle_tpu.observability import budget
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    opt = paddle.optimizer.Adam(1e-3, parameters=net.parameters())
+    r = np.random.RandomState(0)
+    x = paddle.to_tensor(r.randn(8, 8).astype("float32"))
+    y = paddle.to_tensor(r.randint(0, 4, (8,)).astype("int64"))
+
+    def step():
+        loss = F.cross_entropy(net(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        np.asarray(loss._value)
+
+    for _ in range(3):      # arm the skeleton before the trace
+        step()
+    diff = budget.static_diff(step, steps=3)
+    assert diff["ok"], budget.render_static_diff(diff)
+    rows = {r_["class"]: r_ for r_ in diff["rows"]}
+    assert rows["seal:backward_fused"]["static"] == 1
+    assert rows["fusion.window_breaks"]["static"] == 0
+
+
+def test_perf_src_forces_per_op_provenance(checks_off):
+    """With PERF_SRC demanded (the perf analyzer's trace mode), a
+    replayed segment still carries a source line per _PendingOp."""
+    x = paddle.to_tensor(np.full((8, 8), 1.25, "float32"))
+    _warm_ctx(x)
+    lazy.PERF_SRC += 1
+    try:
+        ctx = lazy.current_context()
+        y = x
+        for _ in range(4):
+            y = y * 1.01 + 0.001
+        assert ctx.pending and all(
+            p.src is not None for p in ctx.pending), \
+            "replayed ops lost provenance under PERF_SRC"
+        np.asarray(y._value)
+    finally:
+        lazy.PERF_SRC -= 1
+
+
+def test_fast_ops_counter_rides_budget(checks_off):
+    """record.fast_ops lands in the metrics registry at seal time when
+    observability is on (the budget's record.* rows)."""
+    from paddle_tpu.observability import metrics
+    x = paddle.to_tensor(np.full((8, 8), 1.25, "float32"))
+    _warm_ctx(x)
+    with with_flag("FLAGS_observability", True):
+        before = metrics.snapshot()["counters"].get("record.fast_ops", 0)
+        _chain(x)
+        after = metrics.snapshot()["counters"].get("record.fast_ops", 0)
+    assert after - before == 24, (before, after)
+
+
+def test_ndarray_attr_mismatch_is_miss_not_error(checks_off, python_only):
+    """An ndarray attr value arriving where the armed shape held
+    primitive attrs is a plain MISMATCH (full-path fallback) — dict
+    inequality must not surface numpy's ambiguous-truth ValueError as
+    an 'uncapturable op' window break (review finding)."""
+    x = paddle.to_tensor(np.full((8, 8), 1.25, "float32"))
+    ctx = _warm_ctx(x)
+    from paddle_tpu._core.op_registry import get_op
+    op = get_op("multiply")
+    sk = ctx._select_skel(op)
+    assert sk is not None
+    s = sk.ops[0]
+    saved = s.attrs, s.fast_attrs
+    s.attrs, s.fast_attrs = {"v": 1.0}, True
+    try:
+        r = ctx._record_fast(op, [x, x], {"v": np.zeros(3)})
+        assert r is None and not ctx._skel_live
+    finally:
+        s.attrs, s.fast_attrs = saved
+        ctx._skels.clear()
+        ctx._skeleton = None
+        ctx._skel_live = False
+
+
+def test_native_aval_cache_honors_capacity_flag(checks_off):
+    """The native aval pool bounds itself by the same capacity flag as
+    the python ExecCache (clear-on-overflow on the cold put path)."""
+    nc = lazy._NC if lazy._NC_TRIED else lazy._native_core()
+    if nc is None:
+        pytest.skip("native record core unavailable")
+    lazy.clear_segment_cache()
+    with with_flag("FLAGS_executable_cache_capacity", 8):
+        for n in range(1, 16):
+            t = paddle.to_tensor(np.ones((n, 5), "float32"))
+            np.asarray((t * 2.0)._value)
+        # clear-on-overflow: never more than cap+1 entries after a put
+        assert nc.intern_sizes()["aval_cache"] <= 9, nc.intern_sizes()
+
+
+def test_disabled_auto_cast_scope_keeps_fast_dispatch(checks_off):
+    """auto_cast(enable=False) — the common `enable=use_amp` off case —
+    must not install the per-op amp hook (it would also forfeit the
+    dispatch-level record fast path for the whole scope)."""
+    from paddle_tpu._core import executor
+    x = paddle.to_tensor(np.full((8, 8), 1.25, "float32"))
+    _warm_ctx(x)
+    assert executor._amp_hook is None
+    with paddle.amp.auto_cast(enable=False):
+        assert executor._amp_hook is None and executor._APPLY_FAST
+        f0 = lazy.FAST_OPS
+        _chain(x)
+        assert lazy.FAST_OPS > f0, "fast path lost inside a disabled scope"
+    with paddle.amp.auto_cast(level="O1"):
+        assert executor._amp_hook is not None
+    assert executor._amp_hook is None
