@@ -41,13 +41,30 @@
 //       or NotImplemented to punt to the python fast path (exotic
 //       attrs / unexpected object shapes). NOTHING is mutated unless
 //       the whole op validated.
+//   bind_drive(...) / drive_record(drv, op_name, inputs, attrs, ige) —
+//       the WHOLE-STEP driver (zero-python steady state): once the
+//       executor arms a lazy._DriveState in lazy._DRIVE_CELL, ONE
+//       fastcall per dispatched op coerces the raw operands (exact
+//       Tensors pass; python scalars resolve through the live
+//       executor._SCALAR_TENSORS wrapper cache), resolves the op from
+//       the registry, validates + commits through the same replay core
+//       as skel_record against the plan cursor held IN the drive
+//       state, and returns the final user-facing value (multi_output
+//       unwrap included). Per-op counters batch in the state and write
+//       back at retire; the driver retires itself — clearing the cell
+//       and restoring ctx._skel_pos — on plan completion, segment cap
+//       (it then calls ctx.flush("segment_cap")), a generation bump
+//       (lazy._FAST_GEN_CELL mirrors every mechanical invalidation),
+//       and ANY mismatch, which falls back to the ordinary gate.
 //
 // Plain CPython C API (no pybind per the build rules); compiled into
 // its own extension .so by _core/native.py next to libpaddle_tpu_rt.
 #define PY_SSIZE_T_CLEAN
 #include <Python.h>
+#include <structmember.h>
 
 #include <algorithm>
+#include <cmath>
 #include <vector>
 
 namespace {
@@ -70,9 +87,31 @@ constexpr Py_ssize_t kAttrsCap = 8192;
 
 PyObject* intern_str(const char* s) { return PyUnicode_InternFromString(s); }
 
+// ---- whole-step driver handles (filled by bind_drive)
+PyObject* g_drive_t = nullptr;         // lazy._DriveState
+PyObject* g_ops = nullptr;             // op_registry._OPS (live dict)
+PyObject* g_scalar_tensors = nullptr;  // executor._SCALAR_TENSORS (live)
+PyObject* g_gen_cell = nullptr;        // lazy._FAST_GEN_CELL ([gen])
+PyObject* g_drive_cell = nullptr;      // lazy._DRIVE_CELL ([state|None])
+PyObject* g_lazy_mod = nullptr;        // the lazy module (FAST_OPS)
+bool g_drive_ok = false;
+
+// resolved _DriveState slot offsets (all must resolve or the driver
+// stays off — bind_drive returns False and lazy keeps _DRIVE_OK False)
+struct DriveSlots {
+  Py_ssize_t ctx = -1, ctups = -1, in_sig = -1, in_ids = -1,
+             in_tensors = -1, in_vals = -1, in_meta = -1, in_pins = -1,
+             pending = -1, sig_ops = -1, pinned = -1, pos = -1, gen = -1,
+             cap = -1, n_driven = -1, tid = -1, sc_k = -1, sc_v = -1;
+};
+DriveSlots g_d;
+
 // interned attribute-name strings (filled at module init)
-PyObject* g_one = nullptr;  // cached small-int 1
-PyObject *s_skel_pos, *s_fast_ops, *s_ops_recorded;
+PyObject* g_one = nullptr;        // cached small-int 1
+PyObject* g_float_pos = nullptr;  // cached 1.0 / -1.0 (scalar sign keys)
+PyObject* g_float_neg = nullptr;
+PyObject *s_skel_pos, *s_fast_ops, *s_ops_recorded, *s_multi_output,
+    *s_FAST_OPS, *s_dpos, *s_dn, *s_wtag_in, *s_wtag_op;
 PyObject *s_payload, *s_shape, *s_dtype, *s_weak_type, *s_stop_gradient,
     *s_autograd_meta, *s_inplace_version, *s_ctx, *s_op_idx, *s_slot,
     *s_aval, *s_requires_grad, *s_trefs, *s_in_ids, *s_in_tensors,
@@ -80,6 +119,53 @@ PyObject *s_payload, *s_shape, *s_dtype, *s_weak_type, *s_stop_gradient,
     *s_on_flush, *s_grad, *s_grad_node, *s_out_slot, *s_hooks,
     *s_retain_grads, *s_name_attr, *s_persistable, *s_dist_attr, *s_op,
     *s_attrs, *s_wiring, *s_out_refs, *s_n_outs, *s_src, *s_is_lazy_ref;
+
+// ---- resolved __slots__ member offsets (filled by bind_types)
+//
+// The four classes skel_record reads/mints (Tensor, LazyRef,
+// AutogradMeta, _PendingOp) are all __slots__ classes, so every
+// attribute is a member descriptor with a fixed byte offset inside the
+// instance. Resolving those offsets ONCE lets the hot loop read and
+// write slots as direct pointer loads/stores instead of paying
+// PyObject_GetAttr/SetAttr's MRO lookup + descriptor dispatch per
+// attribute (~20 attr ops per minted op). Any slot that fails to
+// resolve — a monkeypatched class, a future slot rename — keeps
+// offset -1 and that ONE attribute falls back to the generic path, so
+// the optimization can never change semantics.
+struct SlotTable {
+  // Tensor
+  Py_ssize_t t_payload = -1, t_stop_gradient = -1, t_autograd_meta = -1,
+             t_inplace_version = -1, t_name = -1, t_persistable = -1,
+             t_dist_attr = -1;
+  // LazyRef
+  Py_ssize_t r_ctx = -1, r_op_idx = -1, r_slot = -1, r_aval = -1,
+             r_requires_grad = -1, r_trefs = -1;
+  // AutogradMeta
+  Py_ssize_t m_grad = -1, m_grad_node = -1, m_out_slot = -1, m_hooks = -1,
+             m_retain_grads = -1;
+  // _PendingOp
+  Py_ssize_t p_op = -1, p_attrs = -1, p_wiring = -1, p_out_refs = -1,
+             p_n_outs = -1, p_src = -1;
+};
+SlotTable g_off;
+
+// offset of one T_OBJECT_EX member descriptor, -1 = use generic attrs
+Py_ssize_t slot_offset(PyObject* type, PyObject* name) {
+  PyObject* d = PyObject_GetAttr(type, name);
+  if (!d) {
+    PyErr_Clear();
+    return -1;
+  }
+  Py_ssize_t off = -1;
+  if (Py_TYPE(d) == &PyMemberDescr_Type) {
+    PyMemberDef* m = ((PyMemberDescrObject*)d)->d_member;
+    if (m && m->type == T_OBJECT_EX && !(m->flags & READONLY)) {
+      off = m->offset;
+    }
+  }
+  Py_DECREF(d);
+  return off;
+}
 
 // value is cache-key-safe if hashable AND compares by value:
 // primitives and tuples thereof. (Lists/dicts/arrays -> python path.)
@@ -478,6 +564,30 @@ PyObject* bind_types(PyObject*, PyObject* args) {
   g_agmeta_t = ag;
   g_pending_t = po;
   g_tracer_t = tr;
+  g_off.t_payload = slot_offset(tt, s_payload);
+  g_off.t_stop_gradient = slot_offset(tt, s_stop_gradient);
+  g_off.t_autograd_meta = slot_offset(tt, s_autograd_meta);
+  g_off.t_inplace_version = slot_offset(tt, s_inplace_version);
+  g_off.t_name = slot_offset(tt, s_name_attr);
+  g_off.t_persistable = slot_offset(tt, s_persistable);
+  g_off.t_dist_attr = slot_offset(tt, s_dist_attr);
+  g_off.r_ctx = slot_offset(lr, s_ctx);
+  g_off.r_op_idx = slot_offset(lr, s_op_idx);
+  g_off.r_slot = slot_offset(lr, s_slot);
+  g_off.r_aval = slot_offset(lr, s_aval);
+  g_off.r_requires_grad = slot_offset(lr, s_requires_grad);
+  g_off.r_trefs = slot_offset(lr, s_trefs);
+  g_off.m_grad = slot_offset(ag, s_grad);
+  g_off.m_grad_node = slot_offset(ag, s_grad_node);
+  g_off.m_out_slot = slot_offset(ag, s_out_slot);
+  g_off.m_hooks = slot_offset(ag, s_hooks);
+  g_off.m_retain_grads = slot_offset(ag, s_retain_grads);
+  g_off.p_op = slot_offset(po, s_op);
+  g_off.p_attrs = slot_offset(po, s_attrs);
+  g_off.p_wiring = slot_offset(po, s_wiring);
+  g_off.p_out_refs = slot_offset(po, s_out_refs);
+  g_off.p_n_outs = slot_offset(po, s_n_outs);
+  g_off.p_src = slot_offset(po, s_src);
   Py_RETURN_NONE;
 }
 
@@ -488,9 +598,40 @@ PyObject* alloc_instance(PyObject* type) {
   return tp->tp_alloc(tp, 0);
 }
 
-// set one slot, return false on error
-bool set_slot(PyObject* obj, PyObject* name, PyObject* v) {
+// write one slot of an instance alloc'd from the EXACT bound type
+// (direct store at the resolved offset; objects come from tp_alloc so
+// unresolved slots are NULL and the generic fallback stays correct)
+bool set_slot(PyObject* obj, Py_ssize_t off, PyObject* name, PyObject* v) {
+  if (off >= 0) {
+    PyObject** addr = (PyObject**)((char*)obj + off);
+    Py_INCREF(v);
+    PyObject* old = *addr;
+    *addr = v;
+    Py_XDECREF(old);
+    return true;
+  }
   return PyObject_SetAttr(obj, name, v) == 0;
+}
+
+// read one slot at a resolved offset — the CALLER guarantees obj is an
+// exact instance of the type the offset was resolved against; an
+// unset slot (or off -1) degrades to the generic lookup. NEW ref.
+PyObject* read_slot(PyObject* obj, Py_ssize_t off, PyObject* name) {
+  if (off >= 0) {
+    PyObject* v = *(PyObject**)((char*)obj + off);
+    if (v) {
+      Py_INCREF(v);
+      return v;
+    }
+  }
+  return PyObject_GetAttr(obj, name);
+}
+
+// read a Tensor slot: offsets apply only to EXACT Tensor instances
+// (a subclass may re-slot); anything else takes the generic path
+PyObject* tensor_slot(PyObject* t, Py_ssize_t off, PyObject* name) {
+  if (Py_TYPE(t) != (PyTypeObject*)g_tensor_t) off = -1;
+  return read_slot(t, off, name);
 }
 
 // the result protocol of skel_record: nullptr = python error raised;
@@ -502,36 +643,24 @@ PyObject* punt() {
   Py_RETURN_NOTIMPLEMENTED;
 }
 
-// skel_record(ctx, ctups, in_sig, op, ts, attrs, ige) — see file
-// header. Reads and advances ctx._skel_pos itself (and bumps
-// ctx._fast_ops / ctx.ops_recorded on success) so the python wrapper
-// is one call + one result check per replayed op.
-// ctups[pos] = (op, akey, attrs, fast_attrs, wiring, out_avals,
-//               out_req, req, has_inexact, entry, n_outs).
-PyObject* skel_record(PyObject*, PyObject* const* fargs,
-                      Py_ssize_t nargs) {
-  if (nargs != 7) {
-    PyErr_SetString(PyExc_TypeError, "skel_record expects 7 arguments");
-    return nullptr;
-  }
-  PyObject* ctx = fargs[0];
-  PyObject* ctups = fargs[1];
-  PyObject* in_sig = fargs[2];
-  PyObject* op = fargs[3];
-  PyObject* ts = fargs[4];
-  PyObject* attrs = fargs[5];
-  PyObject* ige = fargs[6];
-  if (!PyList_Check(ctups) || !g_lazyref_t) return punt();
-  PyObject* pos_o = PyObject_GetAttr(ctx, s_skel_pos);
-  if (!pos_o) return punt();
-  Py_ssize_t pos = PyLong_AsSsize_t(pos_o);
-  Py_DECREF(pos_o);
-  if (pos < 0 && PyErr_Occurred()) return punt();
-  if (pos >= PyList_GET_SIZE(ctups)) return miss();
-  PyObject* ctup = PyList_GET_ITEM(ctups, pos);  // borrowed
-  if (!PyTuple_Check(ctup) || PyTuple_GET_SIZE(ctup) != 11) {
-    return punt();
-  }
+// ---- the shared replay core of skel_record / drive_record.
+//
+// Judge ONE record against `ctup` (the retained skeleton op at the
+// replay cursor) and, if admitted, register fresh external inputs and
+// mint the LazyRef/Tensor outputs + _PendingOp from the cached avals.
+// `tv` is a C array of the already-coerced operand tensors (borrowed;
+// the caller keeps them alive for the duration of the call). The
+// CALLER owns cursor advance and counters. Returns the out-tensor
+// tuple, or miss()/punt()/nullptr per the skel_record result protocol;
+// NOTHING is mutated unless the whole op validated.
+// ctup = (op, akey, attrs, fast_attrs, wiring, out_avals, out_req,
+//         req, has_inexact, entry, n_outs, multi_output).
+PyObject* replay_one(PyObject* ctx, PyObject* ctup, PyObject* in_sig,
+                     PyObject* op, PyObject* const* tv, Py_ssize_t n_ts,
+                     PyObject* attrs, PyObject* ige, PyObject* in_ids,
+                     PyObject* in_tensors, PyObject* in_vals,
+                     PyObject* in_meta, PyObject* in_pins, bool pinned,
+                     PyObject* pending, PyObject* sig_ops) {
   PyObject* skel_op = PyTuple_GET_ITEM(ctup, 0);
   PyObject* s_attrs_d = PyTuple_GET_ITEM(ctup, 2);
   PyObject* fast_attrs = PyTuple_GET_ITEM(ctup, 3);
@@ -546,66 +675,26 @@ PyObject* skel_record(PyObject*, PyObject* const* fargs,
   if (fast_attrs != Py_True) return punt();  // exotic attrs: python path
   if (!PyTuple_Check(wiring)) return punt();
   Py_ssize_t n_in = PyTuple_GET_SIZE(wiring);
-  PyObject* tseq = PySequence_Fast(ts, "ts must be a sequence");
-  if (!tseq) return punt();
-  if (PySequence_Fast_GET_SIZE(tseq) != n_in) {
-    Py_DECREF(tseq);
-    return miss();
+  if (n_ts != n_in) return miss();
+  int eq;
+  if (PyDict_CheckExact(attrs) && PyDict_CheckExact(s_attrs_d) &&
+      PyDict_GET_SIZE(attrs) == 0 && PyDict_GET_SIZE(s_attrs_d) == 0) {
+    eq = 1;  // empty-vs-empty (the common elementwise case): no compare
+  } else {
+    eq = PyObject_RichCompareBool(attrs, s_attrs_d, Py_EQ);
   }
-  int eq = PyObject_RichCompareBool(attrs, s_attrs_d, Py_EQ);
-  if (eq < 0) {
-    Py_DECREF(tseq);
-    return punt();
-  }
-  if (!eq) {
-    Py_DECREF(tseq);
-    return miss();
-  }
-
-  // context state (fresh lists per segment; read once per record)
-  PyObject* in_ids = PyObject_GetAttr(ctx, s_in_ids);
-  PyObject* in_tensors = PyObject_GetAttr(ctx, s_in_tensors);
-  PyObject* in_vals = PyObject_GetAttr(ctx, s_in_vals);
-  PyObject* in_meta = PyObject_GetAttr(ctx, s_in_meta);
-  PyObject* in_pins = PyObject_GetAttr(ctx, s_in_pins);
-  PyObject* on_flush = PyObject_GetAttr(ctx, s_on_flush);
-  PyObject* pending = PyObject_GetAttr(ctx, s_pending_attr);
-  PyObject* sig_ops = PyObject_GetAttr(ctx, s_sig_ops);
-  if (!in_ids || !in_tensors || !in_vals || !in_meta || !in_pins ||
-      !on_flush || !pending || !sig_ops || !PyDict_Check(in_ids) ||
-      !PyList_Check(in_tensors) || !PyList_Check(in_vals) ||
-      !PyList_Check(in_meta) || !PyList_Check(in_pins) ||
-      !PyList_Check(pending) || !PyList_Check(sig_ops)) {
-    Py_XDECREF(in_ids);
-    Py_XDECREF(in_tensors);
-    Py_XDECREF(in_vals);
-    Py_XDECREF(in_meta);
-    Py_XDECREF(in_pins);
-    Py_XDECREF(on_flush);
-    Py_XDECREF(pending);
-    Py_XDECREF(sig_ops);
-    Py_DECREF(tseq);
-    return punt();
-  }
-
-  struct Cleanup {
-    std::vector<PyObject*> owned;
-    ~Cleanup() {
-      for (PyObject* o : owned) Py_XDECREF(o);
-    }
-  } cl;
-  cl.owned = {in_ids, in_tensors, in_vals, in_meta, in_pins,
-              on_flush,  pending,   sig_ops, tseq};
+  if (eq < 0) return punt();
+  if (!eq) return miss();
 
   Py_ssize_t base_in = PyList_GET_SIZE(in_vals);
-  std::vector<PyObject*> new_ext;  // borrowed (alive via tseq/ts)
+  std::vector<PyObject*> new_ext;  // borrowed (alive via tv)
   bool req = false;
   bool result_miss = false;
   bool result_punt = false;
 
   for (Py_ssize_t i = 0; i < n_in; ++i) {
-    PyObject* t = PySequence_Fast_GET_ITEM(tseq, i);  // borrowed
-    PyObject* w = PyTuple_GET_ITEM(wiring, i);        // borrowed
+    PyObject* t = tv[i];                        // borrowed
+    PyObject* w = PyTuple_GET_ITEM(wiring, i);  // borrowed
     if (t == Py_None) {
       if (w != Py_None) {
         result_miss = true;
@@ -613,24 +702,28 @@ PyObject* skel_record(PyObject*, PyObject* const* fargs,
       }
       continue;
     }
-    PyObject* p = PyObject_GetAttr(t, s_payload);
+    PyObject* p = tensor_slot(t, g_off.t_payload, s_payload);
     if (!p) {
       result_punt = true;
       break;
     }
     if (Py_TYPE(p) == (PyTypeObject*)g_lazyref_t) {
       // op-ref input: must point at the same (op, slot) of THIS ctx
-      PyObject* pctx = PyObject_GetAttr(p, s_ctx);
-      PyObject* pidx = PyObject_GetAttr(p, s_op_idx);
-      PyObject* pslot = PyObject_GetAttr(p, s_slot);
-      PyObject* preq = PyObject_GetAttr(p, s_requires_grad);
+      PyObject* pctx = read_slot(p, g_off.r_ctx, s_ctx);
+      PyObject* pidx = read_slot(p, g_off.r_op_idx, s_op_idx);
+      PyObject* pslot = read_slot(p, g_off.r_slot, s_slot);
+      PyObject* preq = read_slot(p, g_off.r_requires_grad,
+                                 s_requires_grad);
       bool ok = pctx && pidx && pslot && preq;
       bool match = false;
       if (ok && pctx == ctx && pidx != Py_None && w != Py_None &&
           PyTuple_Check(w) && PyTuple_GET_SIZE(w) == 3) {
         PyObject* w0 = PyTuple_GET_ITEM(w, 0);
-        int is_op = PyUnicode_Check(w0) &&
-                    PyUnicode_CompareWithASCIIString(w0, "op") == 0;
+        // identity first: wiring tags are source literals, interned by
+        // the compiler like our s_wtag_* handles
+        int is_op = w0 == s_wtag_op ||
+                    (PyUnicode_Check(w0) &&
+                     PyUnicode_CompareWithASCIIString(w0, "op") == 0);
         if (is_op &&
             PyObject_RichCompareBool(PyTuple_GET_ITEM(w, 1), pidx,
                                      Py_EQ) == 1 &&
@@ -673,8 +766,9 @@ PyObject* skel_record(PyObject*, PyObject* const* fargs,
     }
     {
       PyObject* w0 = PyTuple_GET_ITEM(w, 0);
-      if (!PyUnicode_Check(w0) ||
-          PyUnicode_CompareWithASCIIString(w0, "in") != 0) {
+      if (w0 != s_wtag_in &&
+          (!PyUnicode_Check(w0) ||
+           PyUnicode_CompareWithASCIIString(w0, "in") != 0)) {
         Py_DECREF(p);
         result_miss = true;
         break;
@@ -772,7 +866,7 @@ PyObject* skel_record(PyObject*, PyObject* const* fargs,
       result_miss = true;
       break;
     }
-    PyObject* sg = PyObject_GetAttr(t, s_stop_gradient);
+    PyObject* sg = tensor_slot(t, g_off.t_stop_gradient, s_stop_gradient);
     if (!sg) {
       result_punt = true;
       break;
@@ -797,16 +891,19 @@ PyObject* skel_record(PyObject*, PyObject* const* fargs,
   }
 
   // ---- commit (everything validated; nothing was mutated above)
-  bool pinned = on_flush != Py_None;
   for (size_t k = 0; k < new_ext.size(); ++k) {
     PyObject* t = new_ext[k];
     PyObject* idkey = PyLong_FromVoidPtr(t);
     PyObject* idxo = PyLong_FromSsize_t(base_in + (Py_ssize_t)k);
     PyObject* wr = idkey && idxo ? PyWeakref_NewRef(t, nullptr) : nullptr;
-    PyObject* p = wr ? PyObject_GetAttr(t, s_payload) : nullptr;
-    PyObject* sg = p ? PyObject_GetAttr(t, s_stop_gradient) : nullptr;
-    PyObject* ag = sg ? PyObject_GetAttr(t, s_autograd_meta) : nullptr;
-    PyObject* iv = ag ? PyObject_GetAttr(t, s_inplace_version) : nullptr;
+    PyObject* p = wr ? tensor_slot(t, g_off.t_payload, s_payload) : nullptr;
+    PyObject* sg =
+        p ? tensor_slot(t, g_off.t_stop_gradient, s_stop_gradient) : nullptr;
+    PyObject* ag =
+        sg ? tensor_slot(t, g_off.t_autograd_meta, s_autograd_meta) : nullptr;
+    PyObject* iv = ag ? tensor_slot(t, g_off.t_inplace_version,
+                                    s_inplace_version)
+                      : nullptr;
     PyObject* meta = nullptr;
     if (iv) {
       meta = PyTuple_New(3);
@@ -855,27 +952,31 @@ PyObject* skel_record(PyObject*, PyObject* const* fargs,
     PyObject* slot_o = PyLong_FromSsize_t(slot);
     PyObject* trefs = PyList_New(0);
     PyObject* ref = alloc_instance(g_lazyref_t);
-    ok = slot_o && trefs && ref && set_slot(ref, s_ctx, ctx) &&
-         set_slot(ref, s_op_idx, op_idx_o) &&
-         set_slot(ref, s_slot, slot_o) && set_slot(ref, s_aval, aval) &&
-         set_slot(ref, s_requires_grad, rg) &&
-         set_slot(ref, s_trefs, trefs);
+    ok = slot_o && trefs && ref &&
+         set_slot(ref, g_off.r_ctx, s_ctx, ctx) &&
+         set_slot(ref, g_off.r_op_idx, s_op_idx, op_idx_o) &&
+         set_slot(ref, g_off.r_slot, s_slot, slot_o) &&
+         set_slot(ref, g_off.r_aval, s_aval, aval) &&
+         set_slot(ref, g_off.r_requires_grad, s_requires_grad, rg) &&
+         set_slot(ref, g_off.r_trefs, s_trefs, trefs);
     PyObject* meta = ok ? alloc_instance(g_agmeta_t) : nullptr;
-    ok = ok && meta && set_slot(meta, s_grad, Py_None) &&
-         set_slot(meta, s_grad_node, Py_None) &&
-         set_slot(meta, s_out_slot, zero);
+    ok = ok && meta && set_slot(meta, g_off.m_grad, s_grad, Py_None) &&
+         set_slot(meta, g_off.m_grad_node, s_grad_node, Py_None) &&
+         set_slot(meta, g_off.m_out_slot, s_out_slot, zero);
     PyObject* hooks = ok ? PyList_New(0) : nullptr;
-    ok = ok && hooks && set_slot(meta, s_hooks, hooks) &&
-         set_slot(meta, s_retain_grads, Py_False);
+    ok = ok && hooks && set_slot(meta, g_off.m_hooks, s_hooks, hooks) &&
+         set_slot(meta, g_off.m_retain_grads, s_retain_grads, Py_False);
     PyObject* tensor = ok ? alloc_instance(g_tensor_t) : nullptr;
-    ok = ok && tensor && set_slot(tensor, s_payload, ref) &&
-         set_slot(tensor, s_stop_gradient,
+    ok = ok && tensor &&
+         set_slot(tensor, g_off.t_payload, s_payload, ref) &&
+         set_slot(tensor, g_off.t_stop_gradient, s_stop_gradient,
                   rg == Py_True ? Py_False : Py_True) &&
-         set_slot(tensor, s_autograd_meta, meta) &&
-         set_slot(tensor, s_inplace_version, zero) &&
-         set_slot(tensor, s_name_attr, Py_None) &&
-         set_slot(tensor, s_persistable, Py_False) &&
-         set_slot(tensor, s_dist_attr, Py_None);
+         set_slot(tensor, g_off.t_autograd_meta, s_autograd_meta, meta) &&
+         set_slot(tensor, g_off.t_inplace_version, s_inplace_version,
+                  zero) &&
+         set_slot(tensor, g_off.t_name, s_name_attr, Py_None) &&
+         set_slot(tensor, g_off.t_persistable, s_persistable, Py_False) &&
+         set_slot(tensor, g_off.t_dist_attr, s_dist_attr, Py_None);
     // ref.add_tref(tensor): the alias backref is a weakref
     if (ok) {
       PyObject* twr = PyWeakref_NewRef(tensor, nullptr);
@@ -897,12 +998,13 @@ PyObject* skel_record(PyObject*, PyObject* const* fargs,
   }
   PyObject* pop = ok ? alloc_instance(g_pending_t) : nullptr;
   PyObject* n_outs_o = ok ? PyLong_FromSsize_t(n_outs) : nullptr;
-  ok = ok && pop && n_outs_o && set_slot(pop, s_op, op) &&
-       set_slot(pop, s_attrs, s_attrs_d) &&
-       set_slot(pop, s_wiring, wiring) &&
-       set_slot(pop, s_out_refs, out_refs) &&
-       set_slot(pop, s_n_outs, n_outs_o) &&
-       set_slot(pop, s_src, Py_None) && PyList_Append(pending, pop) == 0 &&
+  ok = ok && pop && n_outs_o && set_slot(pop, g_off.p_op, s_op, op) &&
+       set_slot(pop, g_off.p_attrs, s_attrs, s_attrs_d) &&
+       set_slot(pop, g_off.p_wiring, s_wiring, wiring) &&
+       set_slot(pop, g_off.p_out_refs, s_out_refs, out_refs) &&
+       set_slot(pop, g_off.p_n_outs, s_n_outs, n_outs_o) &&
+       set_slot(pop, g_off.p_src, s_src, Py_None) &&
+       PyList_Append(pending, pop) == 0 &&
        PyList_Append(sig_ops, entry) == 0;
   Py_XDECREF(pop);
   Py_XDECREF(n_outs_o);
@@ -913,10 +1015,78 @@ PyObject* skel_record(PyObject*, PyObject* const* fargs,
     Py_DECREF(outs);
     return nullptr;
   }
+  return outs;
+}
+
+// skel_record(ctx, ctups, in_sig, op, ts, attrs, ige) — see file
+// header. Fetches the context's segment state, delegates validation +
+// commit to replay_one, then advances ctx._skel_pos and bumps
+// ctx._fast_ops / ctx.ops_recorded itself so the python wrapper is one
+// call + one result check per replayed op.
+PyObject* skel_record(PyObject*, PyObject* const* fargs,
+                      Py_ssize_t nargs) {
+  if (nargs != 7) {
+    PyErr_SetString(PyExc_TypeError, "skel_record expects 7 arguments");
+    return nullptr;
+  }
+  PyObject* ctx = fargs[0];
+  PyObject* ctups = fargs[1];
+  PyObject* in_sig = fargs[2];
+  PyObject* op = fargs[3];
+  PyObject* ts = fargs[4];
+  PyObject* attrs = fargs[5];
+  PyObject* ige = fargs[6];
+  if (!PyList_Check(ctups) || !g_lazyref_t) return punt();
+  PyObject* pos_o = PyObject_GetAttr(ctx, s_skel_pos);
+  if (!pos_o) return punt();
+  Py_ssize_t pos = PyLong_AsSsize_t(pos_o);
+  Py_DECREF(pos_o);
+  if (pos < 0 && PyErr_Occurred()) return punt();
+  if (pos >= PyList_GET_SIZE(ctups)) return miss();
+  PyObject* ctup = PyList_GET_ITEM(ctups, pos);  // borrowed
+  if (!PyTuple_Check(ctup) || PyTuple_GET_SIZE(ctup) != 12) {
+    return punt();
+  }
+  PyObject* tseq = PySequence_Fast(ts, "ts must be a sequence");
+  if (!tseq) return punt();
+
+  // context state (fresh lists per segment; read once per record)
+  PyObject* in_ids = PyObject_GetAttr(ctx, s_in_ids);
+  PyObject* in_tensors = PyObject_GetAttr(ctx, s_in_tensors);
+  PyObject* in_vals = PyObject_GetAttr(ctx, s_in_vals);
+  PyObject* in_meta = PyObject_GetAttr(ctx, s_in_meta);
+  PyObject* in_pins = PyObject_GetAttr(ctx, s_in_pins);
+  PyObject* on_flush = PyObject_GetAttr(ctx, s_on_flush);
+  PyObject* pending = PyObject_GetAttr(ctx, s_pending_attr);
+  PyObject* sig_ops = PyObject_GetAttr(ctx, s_sig_ops);
+
+  struct Cleanup {
+    std::vector<PyObject*> owned;
+    ~Cleanup() {
+      for (PyObject* o : owned) Py_XDECREF(o);
+    }
+  } cl;
+  cl.owned = {in_ids, in_tensors, in_vals, in_meta, in_pins,
+              on_flush,  pending,   sig_ops, tseq};
+
+  if (!in_ids || !in_tensors || !in_vals || !in_meta || !in_pins ||
+      !on_flush || !pending || !sig_ops || !PyDict_Check(in_ids) ||
+      !PyList_Check(in_tensors) || !PyList_Check(in_vals) ||
+      !PyList_Check(in_meta) || !PyList_Check(in_pins) ||
+      !PyList_Check(pending) || !PyList_Check(sig_ops)) {
+    return punt();
+  }
+
+  PyObject* outs = replay_one(
+      ctx, ctup, in_sig, op, PySequence_Fast_ITEMS(tseq),
+      PySequence_Fast_GET_SIZE(tseq), attrs, ige, in_ids, in_tensors,
+      in_vals, in_meta, in_pins, on_flush != Py_None, pending, sig_ops);
+  if (!outs || !PyTuple_Check(outs)) return outs;  // error / miss / punt
+
   // advance the replay cursor + per-segment / lifetime counters so the
   // python wrapper is one call per replayed op
   PyObject* next_pos = PyLong_FromSsize_t(pos + 1);
-  ok = next_pos && PyObject_SetAttr(ctx, s_skel_pos, next_pos) == 0;
+  bool ok = next_pos && PyObject_SetAttr(ctx, s_skel_pos, next_pos) == 0;
   Py_XDECREF(next_pos);
   for (PyObject* ctr : {s_fast_ops, s_ops_recorded}) {
     if (!ok) break;
@@ -934,6 +1104,361 @@ PyObject* skel_record(PyObject*, PyObject* const* fargs,
     return nullptr;
   }
   return outs;
+}
+
+// ------------------------------------------------- whole-step driver
+
+// borrowed read of one resolved _DriveState slot (may be null if the
+// slot was never assigned — _arm_drive fills every slot before
+// publishing, so null means a foreign object and the driver bails)
+inline PyObject* dslot(PyObject* d, Py_ssize_t off) {
+  return *(PyObject**)((char*)d + off);
+}
+
+// write the driven cursor + batched counters back to the context and
+// clear the cell (disarm). Best-effort: preserves any already-raised
+// python error, swallows its own. Mirrors lazy._drive_reconcile —
+// keep the two in lockstep.
+void drive_retire(PyObject* drv) {
+  PyObject *et, *ev, *tb;
+  PyErr_Fetch(&et, &ev, &tb);
+  PyObject* ctx = dslot(drv, g_d.ctx);
+  PyObject* pos = dslot(drv, g_d.pos);
+  PyObject* nd = dslot(drv, g_d.n_driven);
+  if (ctx && pos && PyObject_SetAttr(ctx, s_skel_pos, pos) < 0) {
+    PyErr_Clear();
+  }
+  long n = 0;
+  if (nd) {
+    n = PyLong_AsLong(nd);
+    if (n == -1 && PyErr_Occurred()) {
+      PyErr_Clear();
+      n = 0;
+    }
+  }
+  if (n > 0 && ctx) {
+    PyObject* owners[3] = {ctx, ctx, g_lazy_mod};
+    PyObject* names[3] = {s_fast_ops, s_ops_recorded, s_FAST_OPS};
+    for (int i = 0; i < 3; ++i) {
+      if (!owners[i]) continue;
+      PyObject* cur = PyObject_GetAttr(owners[i], names[i]);
+      if (!cur) {
+        PyErr_Clear();
+        continue;
+      }
+      PyObject* nv = PyNumber_Add(cur, nd);
+      Py_DECREF(cur);
+      if (!nv) {
+        PyErr_Clear();
+        continue;
+      }
+      if (PyObject_SetAttr(owners[i], names[i], nv) < 0) PyErr_Clear();
+      Py_DECREF(nv);
+    }
+    PyObject* zero = PyLong_FromLong(0);
+    if (zero) {
+      set_slot(drv, g_d.n_driven, s_dn, zero);
+      Py_DECREF(zero);
+    }
+  }
+  // disarm: the cell read is the apply() prologue's only gate
+  Py_INCREF(Py_None);
+  PyList_SetItem(g_drive_cell, 0, Py_None);
+  PyErr_Restore(et, ev, tb);
+}
+
+// drive_record(drv, op_name, inputs, attrs, ige) — see file header.
+// Returns the final user-facing value (Tensor or tuple), None on a
+// mismatch/retire (fall through to the full dispatch path) or
+// NotImplemented on a punt (ditto). In every non-success case the
+// driver has already retired, EXCEPT a cross-thread call, which falls
+// through without touching the owning thread's state.
+PyObject* drive_record(PyObject*, PyObject* const* fargs,
+                       Py_ssize_t nargs) {
+  if (nargs != 5) {
+    PyErr_SetString(PyExc_TypeError, "drive_record expects 5 arguments");
+    return nullptr;
+  }
+  PyObject* drv = fargs[0];
+  PyObject* op_name = fargs[1];
+  PyObject* inputs = fargs[2];
+  PyObject* attrs = fargs[3];
+  PyObject* ige = fargs[4];
+  if (!g_drive_ok || !g_drive_cell ||
+      Py_TYPE(drv) != (PyTypeObject*)g_drive_t || !PyTuple_Check(inputs) ||
+      !PyDict_Check(attrs)) {
+    // not a drive state this build understands: disarm so apply()
+    // stops paying the prologue, fall through to the full path
+    if (g_drive_cell) {
+      Py_INCREF(Py_None);
+      PyList_SetItem(g_drive_cell, 0, Py_None);
+    }
+    Py_RETURN_NONE;
+  }
+  // thread guard: another thread's dispatch must not move this
+  // context's cursor — fall through WITHOUT retiring (the owning
+  // thread's next op continues the drive)
+  PyObject* tid = dslot(drv, g_d.tid);
+  if (!tid || !PyLong_Check(tid) ||
+      PyLong_AsUnsignedLong(tid) != PyThread_get_thread_ident()) {
+    if (PyErr_Occurred()) PyErr_Clear();
+    Py_RETURN_NONE;
+  }
+  // generation guard: mesh epoch bumps and watched-flag flips mirror
+  // into g_gen_cell — an in-flight drive retires at its very next op.
+  // Identity first (the cell holds the SAME int object the state
+  // captured while valid); value equality as the fallback.
+  PyObject* gen = dslot(drv, g_d.gen);
+  PyObject* cell_gen =
+      PyList_GET_SIZE(g_gen_cell) > 0 ? PyList_GET_ITEM(g_gen_cell, 0)
+                                      : nullptr;
+  if (gen == nullptr || cell_gen == nullptr ||
+      (gen != cell_gen &&
+       PyObject_RichCompareBool(gen, cell_gen, Py_EQ) != 1)) {
+    if (PyErr_Occurred()) PyErr_Clear();
+    drive_retire(drv);
+    Py_RETURN_NONE;
+  }
+  PyObject* ctups = dslot(drv, g_d.ctups);
+  PyObject* pos_o = dslot(drv, g_d.pos);
+  PyObject* pending = dslot(drv, g_d.pending);
+  if (!ctups || !pos_o || !pending || !PyList_Check(ctups) ||
+      !PyList_Check(pending) || !PyLong_Check(pos_o)) {
+    drive_retire(drv);
+    Py_RETURN_NONE;
+  }
+  Py_ssize_t pos = PyLong_AsSsize_t(pos_o);
+  Py_ssize_t n_ops = PyList_GET_SIZE(ctups);
+  // the cursor must mirror the segment EXACTLY: any op that reached
+  // the pending list behind the driver's back (a full-path record, a
+  // sanitizer rewrite) breaks whole-step equivalence — demote
+  if (pos <= 0 || pos >= n_ops || PyList_GET_SIZE(pending) != pos) {
+    if (PyErr_Occurred()) PyErr_Clear();
+    drive_retire(drv);
+    Py_RETURN_NONE;
+  }
+  PyObject* ctup = PyList_GET_ITEM(ctups, pos);  // borrowed
+  if (!PyTuple_Check(ctup) || PyTuple_GET_SIZE(ctup) != 12) {
+    drive_retire(drv);
+    Py_RETURN_NOTIMPLEMENTED;
+  }
+  // multi_output rides in the ctup as canonical True/False — read it
+  // BEFORE any retire/flush below can touch the skeleton
+  int multi = PyTuple_GET_ITEM(ctup, 11) == Py_True;
+  PyObject* op = PyDict_GetItem(g_ops, op_name);  // borrowed
+  if (!op) {
+    // unknown op: the full path raises the canonical error
+    drive_retire(drv);
+    Py_RETURN_NOTIMPLEMENTED;
+  }
+  if (op != PyTuple_GET_ITEM(ctup, 0)) {
+    drive_retire(drv);  // stream diverged: per-op gate judges the rest
+    Py_RETURN_NONE;
+  }
+  // C-side operand coercion (apply()'s coerce loop): exact Tensors
+  // pass; python scalars resolve through the SHARED wrapper cache —
+  // the live executor._SCALAR_TENSORS dict, so eviction can never
+  // leave a stale entry here; a cache miss or exotic operand punts to
+  // the python coerce (which also REGISTERS the scalar for next time)
+  Py_ssize_t n_in = PyTuple_GET_SIZE(inputs);
+  PyObject* sc_k = dslot(drv, g_d.sc_k);
+  PyObject* sc_v = dslot(drv, g_d.sc_v);
+  bool memo_ok = sc_k && sc_v && PyList_CheckExact(sc_k) &&
+                 PyList_CheckExact(sc_v);
+  PyObject* tv[16];
+  Py_ssize_t owned = 0;  // tv[0..owned) hold NEW refs
+  bool coerce_punt = n_in > 16;
+  for (Py_ssize_t i = 0; !coerce_punt && i < n_in; ++i) {
+    PyObject* x = PyTuple_GET_ITEM(inputs, i);
+    PyObject* t = nullptr;
+    if (Py_TYPE(x) == (PyTypeObject*)g_tensor_t || x == Py_None) {
+      t = x;
+      Py_INCREF(t);
+    } else if (PyFloat_CheckExact(x) || PyLong_CheckExact(x) ||
+               PyBool_Check(x)) {
+      // per-drive identity memo first: scalar literals keep object
+      // identity across iterations (co_consts / small-int interning),
+      // and identity implies same type+value+sign, so a hit skips the
+      // key-tuple hash probe entirely. The memo lives only as long as
+      // this drive, so it can never disagree with the in_ids indices
+      // registered through it.
+      if (memo_ok) {
+        Py_ssize_t nm = PyList_GET_SIZE(sc_k);
+        if (PyList_GET_SIZE(sc_v) < nm) nm = PyList_GET_SIZE(sc_v);
+        for (Py_ssize_t k = 0; k < nm; ++k) {
+          if (PyList_GET_ITEM(sc_k, k) == x) {
+            t = PyList_GET_ITEM(sc_v, k);
+            Py_INCREF(t);
+            break;
+          }
+        }
+      }
+      if (!t) {
+        // shared wrapper cache (the live executor._SCALAR_TENSORS):
+        // float keys carry copysign(1.0, x) so -0.0 stays distinct
+        // from +0.0 (hash-equal, division-different)
+        PyObject* key;
+        if (PyFloat_CheckExact(x)) {
+          double dv = PyFloat_AS_DOUBLE(x);
+          PyObject* sign = std::signbit(dv) ? g_float_neg : g_float_pos;
+          key = PyTuple_Pack(3, (PyObject*)&PyFloat_Type, x, sign);
+        } else {
+          key = PyTuple_Pack(2, (PyObject*)Py_TYPE(x), x);
+        }
+        t = key ? PyDict_GetItem(g_scalar_tensors, key) : nullptr;
+        Py_XDECREF(key);
+        if (t) {
+          Py_INCREF(t);
+          if (memo_ok && PyList_GET_SIZE(sc_k) < 8) {
+            if (PyList_Append(sc_k, x) < 0 ||
+                PyList_Append(sc_v, t) < 0) {
+              PyErr_Clear();  // memo is best-effort only
+            }
+          }
+        } else {
+          coerce_punt = true;  // python _coerce registers it for later
+        }
+      }
+    } else if (PyObject_TypeCheck(x, (PyTypeObject*)g_tensor_t)) {
+      t = x;  // Tensor subclass passes through, like python _coerce
+      Py_INCREF(t);
+    } else {
+      coerce_punt = true;  // ndarray / list / foreign scalar
+    }
+    if (!coerce_punt) tv[owned++] = t;
+  }
+  if (coerce_punt) {
+    for (Py_ssize_t i = 0; i < owned; ++i) Py_DECREF(tv[i]);
+    if (PyErr_Occurred()) PyErr_Clear();
+    drive_retire(drv);
+    Py_RETURN_NOTIMPLEMENTED;
+  }
+  PyObject* ctx = dslot(drv, g_d.ctx);
+  PyObject* in_sig = dslot(drv, g_d.in_sig);
+  PyObject* in_ids = dslot(drv, g_d.in_ids);
+  PyObject* in_tensors = dslot(drv, g_d.in_tensors);
+  PyObject* in_vals = dslot(drv, g_d.in_vals);
+  PyObject* in_meta = dslot(drv, g_d.in_meta);
+  PyObject* in_pins = dslot(drv, g_d.in_pins);
+  PyObject* sig_ops = dslot(drv, g_d.sig_ops);
+  PyObject* pinned_o = dslot(drv, g_d.pinned);
+  if (!ctx || !in_sig || !in_ids || !in_tensors || !in_vals || !in_meta ||
+      !in_pins || !sig_ops || !pinned_o) {
+    for (Py_ssize_t i = 0; i < owned; ++i) Py_DECREF(tv[i]);
+    drive_retire(drv);
+    Py_RETURN_NONE;
+  }
+  PyObject* outs = replay_one(ctx, ctup, in_sig, op, tv, n_in, attrs,
+                              ige, in_ids, in_tensors, in_vals, in_meta,
+                              in_pins, pinned_o == Py_True, pending,
+                              sig_ops);
+  for (Py_ssize_t i = 0; i < owned; ++i) Py_DECREF(tv[i]);
+  if (!outs) {
+    drive_retire(drv);
+    return nullptr;
+  }
+  if (!PyTuple_Check(outs)) {  // miss (None) / punt (NotImplemented)
+    drive_retire(drv);
+    return outs;
+  }
+  // committed: advance the drive cursor + the batched counter in the
+  // state (direct slot stores; ctx write-back happens once, at retire)
+  PyObject* next = PyLong_FromSsize_t(pos + 1);
+  PyObject* nd = dslot(drv, g_d.n_driven);
+  PyObject* ndn = nd ? PyNumber_Add(nd, g_one) : nullptr;
+  if (!next || !ndn) {
+    Py_XDECREF(next);
+    Py_XDECREF(ndn);
+    Py_DECREF(outs);
+    drive_retire(drv);
+    return nullptr;
+  }
+  set_slot(drv, g_d.pos, s_dpos, next);
+  set_slot(drv, g_d.n_driven, s_dn, ndn);
+  Py_DECREF(next);
+  Py_DECREF(ndn);
+  if (pos + 1 >= n_ops) {
+    // plan complete: retire; the seal happens at the next sync point
+    // (lazy._step_plan_sig prices it as segment::replay_step)
+    drive_retire(drv);
+  } else {
+    PyObject* cap_o = dslot(drv, g_d.cap);
+    Py_ssize_t cap =
+        cap_o && PyLong_Check(cap_o) ? PyLong_AsSsize_t(cap_o) : -1;
+    if (cap >= 0 && PyList_GET_SIZE(pending) >= cap) {
+      drive_retire(drv);
+      PyObject* fr =
+          PyObject_CallMethod(ctx, "flush", "(s)", "segment_cap");
+      if (!fr) {
+        Py_DECREF(outs);
+        return nullptr;
+      }
+      Py_DECREF(fr);
+    } else if (cap < 0 && PyErr_Occurred()) {
+      PyErr_Clear();
+    }
+  }
+  // unwrap per op.multi_output (the apply() tail)
+  if (multi) return outs;
+  PyObject* r0 = PyTuple_GET_ITEM(outs, 0);
+  Py_INCREF(r0);
+  Py_DECREF(outs);
+  return r0;
+}
+
+// bind_drive(_DriveState, ops, scalar_tensors, gen_cell, drive_cell,
+//            lazy_module) -> bool — register the whole-step driver's
+// handles and resolve the _DriveState slot offsets. Returns False
+// (and keeps the driver off) when any offset fails to resolve.
+PyObject* bind_drive(PyObject*, PyObject* args) {
+  PyObject *dt, *ops, *scal, *gen_cell, *drive_cell, *lazy_mod;
+  if (!PyArg_ParseTuple(args, "OO!O!O!O!O", &dt, &PyDict_Type, &ops,
+                        &PyDict_Type, &scal, &PyList_Type, &gen_cell,
+                        &PyList_Type, &drive_cell, &lazy_mod)) {
+    return nullptr;
+  }
+  Py_XDECREF(g_drive_t);
+  Py_XDECREF(g_ops);
+  Py_XDECREF(g_scalar_tensors);
+  Py_XDECREF(g_gen_cell);
+  Py_XDECREF(g_drive_cell);
+  Py_XDECREF(g_lazy_mod);
+  Py_INCREF(dt);
+  Py_INCREF(ops);
+  Py_INCREF(scal);
+  Py_INCREF(gen_cell);
+  Py_INCREF(drive_cell);
+  Py_INCREF(lazy_mod);
+  g_drive_t = dt;
+  g_ops = ops;
+  g_scalar_tensors = scal;
+  g_gen_cell = gen_cell;
+  g_drive_cell = drive_cell;
+  g_lazy_mod = lazy_mod;
+  struct Slot {
+    Py_ssize_t* off;
+    const char* name;
+  };
+  const Slot slots[] = {
+      {&g_d.ctx, "ctx"},           {&g_d.ctups, "ctups"},
+      {&g_d.in_sig, "in_sig"},     {&g_d.in_ids, "in_ids"},
+      {&g_d.in_tensors, "in_tensors"}, {&g_d.in_vals, "in_vals"},
+      {&g_d.in_meta, "in_meta"},   {&g_d.in_pins, "in_pins"},
+      {&g_d.pending, "pending"},   {&g_d.sig_ops, "sig_ops"},
+      {&g_d.pinned, "pinned"},     {&g_d.pos, "pos"},
+      {&g_d.gen, "gen"},           {&g_d.cap, "cap"},
+      {&g_d.n_driven, "n_driven"}, {&g_d.tid, "tid"},
+      {&g_d.sc_k, "sc_k"},         {&g_d.sc_v, "sc_v"}};
+  bool ok = true;
+  for (const Slot& s : slots) {
+    PyObject* name = intern_str(s.name);
+    *s.off = name ? slot_offset(dt, name) : -1;
+    Py_XDECREF(name);
+    if (*s.off < 0) ok = false;
+  }
+  g_drive_ok = ok;
+  if (ok) Py_RETURN_TRUE;
+  Py_RETURN_FALSE;
 }
 
 PyMethodDef methods[] = {
@@ -963,6 +1488,15 @@ PyMethodDef methods[] = {
      "Trace-stable skeleton replay of one record: validate against the "
      "retained skeleton op and mint the outputs from its cached avals. "
      "Returns outs | None (mismatch) | NotImplemented (punt)."},
+    {"bind_drive", bind_drive, METH_VARARGS,
+     "Register the whole-step driver's handles (_DriveState, op "
+     "registry, scalar cache, gen/drive cells, lazy module) and "
+     "resolve the _DriveState slot offsets. False = driver stays off."},
+    {"drive_record", (PyCFunction)(void (*)())drive_record, METH_FASTCALL,
+     "Whole-step driven dispatch of one op against the armed plan "
+     "cursor: C-side coercion + op resolve + replay commit in one "
+     "call. Returns the final value | None (retired, fall through) | "
+     "NotImplemented (punt, retired)."},
     {nullptr, nullptr, 0, nullptr}};
 
 PyModuleDef module = {PyModuleDef_HEAD_INIT, "pt_eager_core",
@@ -982,7 +1516,15 @@ PyMODINIT_FUNC PyInit_pt_eager_core(void) {
     return nullptr;
   }
   g_one = PyLong_FromLong(1);
-  if (!g_one) return nullptr;
+  g_float_pos = PyFloat_FromDouble(1.0);
+  g_float_neg = PyFloat_FromDouble(-1.0);
+  if (!g_one || !g_float_pos || !g_float_neg) return nullptr;
+  s_multi_output = intern_str("multi_output");
+  s_FAST_OPS = intern_str("FAST_OPS");
+  s_dpos = intern_str("pos");
+  s_dn = intern_str("n_driven");
+  s_wtag_in = intern_str("in");
+  s_wtag_op = intern_str("op");
   s_skel_pos = intern_str("_skel_pos");
   s_fast_ops = intern_str("_fast_ops");
   s_ops_recorded = intern_str("ops_recorded");

@@ -34,6 +34,14 @@ from .tensor import Tensor
 _SCALAR_CACHE: dict = {}
 _SCALAR_TENSORS: dict = {}
 
+# the whole-step driver's arm cell (lazy owns it; bound once here so
+# the disarmed prologue check is one global + one list read per op).
+# _NC_DRIVE is the native drive_record entry, installed by
+# lazy._native_core alongside _DRIVE_OK — the cell can only hold a
+# state while _DRIVE_OK is set, so a non-None cell implies a bound fn.
+_DRIVE_CELL = lazy._DRIVE_CELL
+_NC_DRIVE = None
+
 _TRACER_CLS = jax.core.Tracer
 
 
@@ -74,6 +82,17 @@ def apply(op_name: str, *inputs, **attrs):
     """Execute a registered op eagerly on Tensors. Returns Tensor or tuple.
     Under paddle.static (enable_static), records the op into the current
     Program instead (the ProgramDesc/PIR build path, SURVEY L9/L14)."""
+    # whole-step driver (zero-python steady state): while a promoted
+    # step plan is armed, ONE C call owns this dispatch end to end —
+    # coercion, op resolve, replay commit, multi_output unwrap. The
+    # disarmed cost is one list read. None/NotImplemented mean the
+    # driver retired (mismatch, plan complete, punt) and this op falls
+    # through to the ordinary path below, which re-judges it in full.
+    if _APPLY_FAST and _DRIVE_CELL[0] is not None:
+        r = _NC_DRIVE(_DRIVE_CELL[0], op_name, inputs,
+                      attrs, is_grad_enabled)
+        if r is not None and r is not NotImplemented:
+            return r
     op = _OPS.get(op_name)
     if op is None:
         op = get_op(op_name)   # raises the canonical unknown-op error
@@ -117,12 +136,28 @@ def apply(op_name: str, *inputs, **attrs):
                     and not (lazy.PERF_SRC or _obs.COMPUTE):
                 r = lazy._NC.skel_record(ctx, sk.ctups, sk.in_sig, op,
                                          ts, attrs, is_grad_enabled)
+                if r is None:
+                    # sibling-shape switch: another skeleton in this
+                    # leading-op bucket may own the divergent suffix
+                    # (skel_record mutates nothing before a mismatch,
+                    # so one retry against the sibling is safe)
+                    sk = ctx._switch_skel(op)
+                    if sk is not None:
+                        r = lazy._NC.skel_record(ctx, sk.ctups,
+                                                 sk.in_sig, op, ts,
+                                                 attrs, is_grad_enabled)
                 if type(r) is tuple:
                     lazy.FAST_OPS += 1
                     cap = ctx._max_override
                     if len(ctx.pending) >= (lazy._MAX_SEG_OPS
                                             if cap is None else cap):
                         ctx.flush("segment_cap")
+                    elif sk.plan is not None and _DRIVE_CELL[0] is None:
+                        # promoted shape: hand the REST of this segment
+                        # to the native whole-step driver (one C call
+                        # per op, no gate) — armed after the first fast
+                        # record so the drive cursor starts in sync
+                        lazy._arm_drive(ctx, sk)
                     return r if op.multi_output else r[0]
                 if r is None:
                     ctx._skel_live = False
@@ -219,6 +254,10 @@ def _sync_apply_fast():
     global _APPLY_FAST
     _APPLY_FAST = (_static_recorder is None and _amp_hook is None
                    and _profile_cb is None and not _PER_OP_MODE)
+    if not _APPLY_FAST:
+        # an interceptor changes what apply() must do per op: retire
+        # any armed whole-step drive through its context
+        lazy._drive_disarm()
 
 
 def _sync_per_op_mode(_value=None):

@@ -212,6 +212,29 @@ define_flag("FLAGS_record_fast_path", True,
             "relevant set_flags and mid-segment in-place swaps "
             "invalidate the skeleton. Off = the exact pre-existing "
             "per-op record behavior.")
+define_flag("FLAGS_step_replay_after", 3,
+            "Whole-step driver promotion threshold: after this many "
+            "consecutive clean skeleton replays of a sealed segment "
+            "(runner already cached), the seal path promotes to a "
+            "step plan — one driver call validates liveness/donation "
+            "and executes the cached executable directly, skipping "
+            "signature memo probing and flush bookkeeping; recording "
+            "itself drops per-op validation to wiring identity checks. "
+            "Any mismatch demotes that step to per-op skeleton replay "
+            "and re-arms the streak. 0 disables promotion.")
+define_flag("FLAGS_executable_cache_dir", "",
+            "Persistent compiled-executable cache directory ('' = "
+            "off): sealed-segment / fused-step / optimizer runners are "
+            "serialized (jax AOT) under an epoch-normalized signature "
+            "digest with checksum + version/backend stamps, and cache "
+            "misses consult disk before lower().compile() — process "
+            "restart, elastic re-plan and serving cold-start load "
+            "instead of recompiling. Memory/cost analyses persist "
+            "alongside so warm loads keep their meters.")
+define_flag("FLAGS_executable_cache_disk_max_mb", 512,
+            "Persistent executable cache disk budget in MB: after each "
+            "store, oldest-mtime entries are pruned until the cache "
+            "directory fits (0 = unbounded).")
 define_flag("FLAGS_async_flush", False,
             "Hand sealed lazy segments to a single-worker flush "
             "executor: compile+execute launch off the Python thread "

@@ -39,6 +39,7 @@ import numpy as np
 
 from . import dispatch
 from . import flags as _flags
+from . import persist as _persist
 from ..observability import _state as _OBS
 from .async_flush import resolve_pending
 from .cache import ExecCache
@@ -125,14 +126,22 @@ def mark_cost_stale():
 FAST_OPS = 0
 _FAST_PATH = True
 _FAST_GEN = 0
+# C mirror of _FAST_GEN + the whole-step driver's arm cell — declared
+# BEFORE the flag watchers below fire (they invalidate at import); the
+# driver itself is documented at _DriveState further down
+_FAST_GEN_CELL: list = [0]
+_DRIVE_CELL: list = [None]
+_DRIVE_OK = False
 
 
 def invalidate_skeletons(_value=None) -> int:
     """Bump the skeleton generation: every context drops its armed
     record skeleton on the next fast-record attempt (re-armed at the
-    next memo-proven seal)."""
+    next memo-proven seal). The C mirror cell retires any in-flight
+    whole-step drive at its very next op for the same events."""
     global _FAST_GEN
     _FAST_GEN += 1
+    _FAST_GEN_CELL[0] = _FAST_GEN
     return _FAST_GEN
 
 
@@ -148,6 +157,125 @@ _flags.watch_flag("FLAGS_record_fast_path", _sync_fast_path_gate)
 _flags.watch_flag("FLAGS_static_checks", invalidate_skeletons)
 _flags.watch_flag("FLAGS_compute_telemetry", invalidate_skeletons)
 _flags.watch_flag("FLAGS_lazy_max_segment_ops", invalidate_skeletons)
+
+# ---- whole-step replay promotion (FLAGS_step_replay_after). A shape
+# whose skeleton fully replays N consecutive sealed iterations gets a
+# STEP PLAN: the seal skips signature reconstruction entirely and runs
+# the cached executable under a ``segment::replay_step`` span (goodput
+# prices it as productive execute). Any structural drift, mechanical
+# invalidation (mesh epoch, watched flags, note_inplace, grad-mode
+# flip — they all break the per-op replay that feeds the plan) or a
+# live-set change demotes that shape to per-op skeleton replay and
+# re-arms the streak. REPLAY_STEPS counts driven seals process-wide
+# (bench rows 17/18 and the off-freeze assertions read it).
+REPLAY_STEPS = 0
+_STEP_REPLAY_AFTER = 3
+
+
+def _sync_step_replay_gate(value):
+    global _STEP_REPLAY_AFTER
+    _STEP_REPLAY_AFTER = int(value or 0)
+    invalidate_skeletons()
+
+
+_flags.watch_flag("FLAGS_step_replay_after", _sync_step_replay_gate)
+
+# ---- the whole-step NATIVE driver (zero-python steady state). Once a
+# shape's skeleton carries a promoted step plan, the executor gate arms
+# a _DriveState in _DRIVE_CELL after the segment's FIRST fast record:
+# from then on apply() hands each dispatch to ONE C call
+# (eager_core.drive_record) that coerces operands, validates against
+# the plan cursor and mints the outputs — no python-level gate, scalar
+# cache probe, context lookup or per-op counter write. The C side holds
+# the two mutable cells below (registered once via bind_drive):
+# _FAST_GEN_CELL mirrors _FAST_GEN, so every mechanical invalidation
+# event (mesh epoch, watched flags, step-replay flag) retires an
+# in-flight drive at its next op, and _DRIVE_CELL[0] is the armed
+# state (None = disarmed). The driver retires ITSELF on plan
+# completion, segment cap and any mismatch; _drive_reconcile writes
+# the driven cursor + batched counters back at every python re-entry
+# point that reads them (flush, segment reset, note_inplace,
+# interceptor installs via executor._sync_apply_fast). When the C
+# library is unavailable (_DRIVE_OK stays False) the bit-exact pure
+# python driver is the per-op skeleton replay + the _step_plan_sig
+# seal — same admissions, same demotions, just not one-call-per-op.
+
+
+class _DriveState:
+    """Flat per-segment view of everything drive_record touches per op,
+    one resolved slot offset away: the plan's ctups + sealed in-sig,
+    the context's CURRENT segment lists (the same objects the context
+    attributes name — the driver appends to them in place), the armed
+    generation, the owning thread and the replay cursor. `n_driven`
+    batches the per-op counters until retire/reconcile."""
+
+    __slots__ = ("ctx", "ctups", "in_sig", "in_ids", "in_tensors",
+                 "in_vals", "in_meta", "in_pins", "pending", "sig_ops",
+                 "pinned", "pos", "gen", "cap", "n_driven", "tid",
+                 "sc_k", "sc_v")
+
+
+def _arm_drive(ctx, sk):
+    """Publish a drive for the rest of the current segment (called by
+    the executor gate right after a successful fast record of a
+    plan-carrying skeleton)."""
+    if not _DRIVE_OK:
+        return
+    d = _DriveState()
+    d.ctx = ctx
+    d.ctups = sk.ctups
+    d.in_sig = sk.in_sig
+    d.in_ids = ctx._in_ids
+    d.in_tensors = ctx._in_tensors
+    d.in_vals = ctx._in_vals
+    d.in_meta = ctx._in_meta
+    d.in_pins = ctx._in_pins
+    d.pending = ctx.pending
+    d.sig_ops = ctx._sig_ops
+    d.pinned = ctx.on_flush is not None
+    d.pos = ctx._skel_pos
+    d.gen = sk.gen
+    cap = ctx._max_override
+    d.cap = _MAX_SEG_OPS if cap is None else cap
+    d.n_driven = 0
+    d.tid = _threading.get_ident()
+    # per-drive scalar memo: scalar-OBJECT identity -> wrapper tensor
+    # (literals from co_consts keep identity across iterations, so the
+    # drive's steady state skips the key-tuple hash probe per operand;
+    # the memo lives exactly as long as the drive, so it can never
+    # disagree with the in_ids registrations made through it)
+    d.sc_k = []
+    d.sc_v = []
+    _DRIVE_CELL[0] = d
+
+
+def _drive_reconcile(ctx):
+    """Write an armed drive's cursor and batched counters back to its
+    context and disarm. Idempotent with the C driver's own retire (the
+    cell is cleared first, counters are zeroed on read) — called at
+    every python re-entry point that reads _skel_pos/_fast_ops or
+    rebinds the segment lists."""
+    global FAST_OPS
+    d = _DRIVE_CELL[0]
+    if d is None or d.ctx is not ctx:
+        return
+    _DRIVE_CELL[0] = None
+    ctx._skel_pos = d.pos
+    n = d.n_driven
+    if n:
+        d.n_driven = 0
+        ctx._fast_ops += n
+        ctx.ops_recorded += n
+        FAST_OPS += n
+
+
+def _drive_disarm():
+    """Retire any armed drive through its context — interceptor
+    installs and per-op modes change what apply() must do per op, so
+    the plan's whole-step equivalence no longer holds."""
+    d = _DRIVE_CELL[0]
+    if d is not None:
+        _drive_reconcile(d.ctx)
 
 
 def bump_mesh_epoch() -> int:
@@ -289,11 +417,17 @@ def _obs_flush_span(reason: str, n_ops: int, n_inputs: int, n_live: int,
                 live=n_live, donated=n_donate).begin()
 
 
-def _obs_exec_span(compiled: bool, n_ops: int):
+def _obs_exec_span(compiled: bool, n_ops: int, driven: bool = False):
     """The compile-vs-cached-execute split under a flush span (compile
     counters are bumped at the call sites, which know WHICH cache
-    missed: compiles.segment vs compiles.fused_step)."""
+    missed: compiles.segment vs compiles.fused_step). A promoted
+    whole-step seal takes its own ``segment::replay_step`` name —
+    goodput prices it in the execute bucket, and the distinct histogram
+    is the step-driver's latency meter."""
     from ..observability.spans import span
+    if driven and not compiled:
+        return span("segment::replay_step",
+                    hist="segment.replay_step_us", ops=n_ops).begin()
     return span("segment::compile" if compiled else "segment::execute",
                 hist=("segment.compile_us" if compiled
                       else "segment.execute_us"), ops=n_ops).begin()
@@ -393,7 +527,7 @@ def _compile_segment_runner(pending, live, donate, run_vals, sig,
                        run_vals, spmd)
     if not _OBS.COMPUTE:
         mark_cost_stale()
-    if (_OBS.MEM or _OBS.COMPUTE) and not any(
+    if (_OBS.MEM or _OBS.COMPUTE or _persist.ACTIVE) and not any(
             isinstance(v, jax.core.Tracer) for v in run_vals):
         from ..observability import memory as _memtel
         with _quiet_donation_compile():
@@ -430,7 +564,7 @@ def _compile_fused_runner(pending, live, grad_in, root_k, run_vals, key,
                        (), run_vals, spmd)
     if not _OBS.COMPUTE:
         mark_cost_stale()
-    if (_OBS.MEM or _OBS.COMPUTE) and not any(
+    if (_OBS.MEM or _OBS.COMPUTE or _persist.ACTIVE) and not any(
             isinstance(v, jax.core.Tracer) for v in run_vals):
         from ..observability import memory as _memtel
         with _quiet_donation_compile():
@@ -439,6 +573,68 @@ def _compile_fused_runner(pending, live, grad_in, root_k, run_vals, key,
                                        cache=_FUSED_CACHE, key=key,
                                        n_devices=_mesh_devices(spmd))
     return jitted
+
+
+def _persist_sig(sig) -> Tuple:
+    """Disk identity of a segment signature: the raw key with its
+    MESH_EPOCH component (position 4) zeroed. The epoch salt exists to
+    re-key IN-MEMORY entries across elastic re-plans, but every
+    structural consequence of a re-plan already lives in the signature
+    (shard_sig / input avals / op stream), so two processes — or two
+    re-plan cycles landing on the same layout — share one disk entry."""
+    raw = sig.sig if isinstance(sig, _CachedKey) else tuple(sig)
+    return raw[:4] + (0,) + raw[5:]
+
+
+def _jit_factory(build_fn, donate, run_vals, spmd):
+    """Deferred jit construction for a disk-loaded runner's tracer
+    fallback. The in_shardings are resolved NOW (cheap metadata) so
+    the retained closure never pins the input BUFFERS — a pinned param
+    buffer would defeat the refcount-proof donation checks (lazy's
+    _donatable_inputs, the optimizer's _pick_update) for as long as
+    the runner lives."""
+    shardings = None
+    if spmd is not None:
+        shardings = spmd.in_shardings(run_vals)
+
+    def factory():
+        if shardings is not None:
+            return jax.jit(build_fn(), donate_argnums=donate,
+                           in_shardings=shardings)
+        return jax.jit(build_fn(), donate_argnums=donate)
+
+    return factory
+
+
+def _disk_runner(kind, norm_key, jit_factory, cache=None, key=None,
+                 stat="segment"):
+    """Consult the persistent executable cache after an in-memory miss
+    and BEFORE ``lower().compile()``. A verified hit rehydrates into a
+    runner (telemetry sidecars re-noted so warm loads keep their
+    meters) — the caller then takes the cached-execute span and bumps
+    no ``compiles.*`` counter. Callers pre-gate on ``_persist.ACTIVE``."""
+    payload = _persist.load(kind, norm_key)
+    if payload is None:
+        return None
+    runner = _persist.make_runner(payload, jit_factory)
+    if runner is None:
+        return None
+    _persist.renote(payload, stat, cache, key)
+    return runner
+
+
+def _disk_store(kind, norm_key, runner, cache=None, key=None):
+    """Persist a freshly-compiled runner's executable + sidecars. Only
+    AOT-compiled runners carry the raw Compiled (`aot_executable`);
+    with persistence active the compile helpers always take the AOT
+    path for concrete inputs, so a plain-jit runner here means tracer
+    inputs — not persistable, skip silently."""
+    if getattr(runner, "persisted", False):
+        return
+    compiled = getattr(runner, "aot_executable", None)
+    if compiled is not None:
+        _persist.store(kind, norm_key, compiled,
+                       _persist.sidecars(runner, cache, key))
 
 
 def _note_donated_inputs(in_vals, donate):
@@ -552,7 +748,7 @@ _NC_TRIED = False
 
 
 def _native_core():
-    global _NC, _NC_TRIED
+    global _NC, _NC_TRIED, _DRIVE_OK
     _NC_TRIED = True
     ec = dispatch._eager_core()
     if ec is not None and hasattr(ec, "aval_cache_get"):
@@ -561,6 +757,24 @@ def _native_core():
             from .tensor import Tensor
             ec.bind_types(LazyRef, Tensor, AutogradMeta, _PendingOp,
                           jax.core.Tracer)
+        if hasattr(ec, "bind_drive"):
+            # whole-step driver registration: the C side keeps direct
+            # handles to the op registry, the live scalar-wrapper cache
+            # (read per op — can never go stale), the two mutable cells
+            # and this module (retire writes FAST_OPS). Refuses (False)
+            # when any _DriveState slot offset fails to resolve; the
+            # driver then stays off and replay runs per-op.
+            try:
+                import sys
+                from . import executor as _executor
+                _DRIVE_OK = bool(ec.bind_drive(
+                    _DriveState, _executor._OPS,
+                    _executor._SCALAR_TENSORS, _FAST_GEN_CELL,
+                    _DRIVE_CELL, sys.modules[__name__]))
+                if _DRIVE_OK:
+                    _executor._NC_DRIVE = ec.drive_record
+            except Exception:
+                _DRIVE_OK = False
         _NC = ec
     return _NC
 
@@ -658,13 +872,17 @@ class _SkelOp:
 
 
 class _Skeleton:
-    """The last sealed segment's op skeleton (armed only once the
+    """One sealed segment shape's op skeleton (armed only once the
     signature memo proved the stream repeats). `in_sig` is the sealed
     segment's external-input aval signature — the fast path validates
     each fresh registration against it, so reused out-avals can never
-    desync from what the inputs imply."""
+    desync from what the inputs imply. `streak` counts consecutive
+    fully-replayed seals; at FLAGS_step_replay_after it promotes to a
+    whole-step `plan` — (live tuple, _CachedKey, ambient mesh) — that
+    lets the seal skip signature reconstruction entirely (the driven
+    ``segment::replay_step`` path)."""
 
-    __slots__ = ("ops", "ctups", "in_sig", "gen")
+    __slots__ = ("ops", "ctups", "in_sig", "gen", "streak", "plan")
 
 
 class CaptureContext:
@@ -711,12 +929,15 @@ class CaptureContext:
         # analyzer can say WHY an op broke the window
         self._last_record_error = None
         # trace-stable record fast path: the BANK of retained skeletons
-        # (one per memo-proven segment shape, keyed by the shape's
-        # first OpDef — the first record of a segment selects), the
-        # currently-selected skeleton, the replay cursor into it,
-        # whether the CURRENT segment is still matching, and how many
-        # of its ops were fast-replayed
-        self._skels: Dict[Any, _Skeleton] = {}
+        # — one per memo-proven segment shape, bucketed by the shape's
+        # first OpDef (the first record of a segment selects MRU-first)
+        # and keyed inside the bucket by (length, last entry) like
+        # _sig_memos, so two shapes sharing a leading op both keep
+        # valid skeletons (mid-stream divergence switches candidates,
+        # see _switch_skel) — plus the currently-selected skeleton, the
+        # replay cursor into it, whether the CURRENT segment is still
+        # matching, and how many of its ops were fast-replayed
+        self._skels: Dict[Any, Dict[Tuple, _Skeleton]] = {}
         self._skeleton: Optional[_Skeleton] = None
         self._skel_pos = 0
         self._skel_live = False
@@ -765,6 +986,8 @@ class CaptureContext:
         replaying across the mutation. (Between segments — the fused
         optimizer write-back — there is nothing recorded and the
         skeleton survives.)"""
+        if _DRIVE_CELL[0] is not None:
+            _drive_reconcile(self)
         self._in_ids.pop(id(tensor), None)
         if self.pending:
             sk = self._skeleton
@@ -772,22 +995,75 @@ class CaptureContext:
             self._skel_live = False
             if sk is not None:
                 # evict the banked entry of the shape being replayed
-                for k in [k for k, v in self._skels.items() if v is sk]:
-                    del self._skels[k]
+                for op in list(self._skels):
+                    bucket = self._skels[op]
+                    for k in [k for k, v in bucket.items() if v is sk]:
+                        del bucket[k]
+                    if not bucket:
+                        del self._skels[op]
 
     def _select_skel(self, op: OpDef):
-        """First record of a segment: select the banked skeleton whose
-        sealed shape starts with `op` (stale generations evict). None
-        = no candidate; this segment records through the full path."""
-        sk = self._skels.get(op)
-        if sk is not None and sk.gen != _FAST_GEN:
+        """First record of a segment: select the most-recently-used
+        banked skeleton whose sealed shape starts with `op` (stale
+        generations evict; a mid-stream divergence from the MRU pick
+        switches to a sibling shape, see _switch_skel). None = no
+        candidate; this segment records through the full path."""
+        bucket = self._skels.get(op)
+        while bucket:
+            k = next(reversed(bucket))
+            sk = bucket[k]
+            if sk.gen == _FAST_GEN:
+                self._skeleton = sk
+                return sk
+            del bucket[k]
+        if bucket is not None:
             del self._skels[op]
-            sk = None
-        if sk is None:
-            self._skel_live = False
+        self._skel_live = False
+        return None
+
+    def _switch_skel(self, op: OpDef):
+        """Mid-stream candidate switch: the selected skeleton just
+        mismatched at the replay cursor, but a SIBLING shape (same
+        leading OpDef, different (length, last-entry) bucket key) may
+        continue the stream — the satellite fix for two segment shapes
+        sharing their first op. A candidate is valid only when its
+        already-replayed prefix is exactly what this segment recorded:
+        identical interned entries (compared by ``==`` — the intern
+        pool may have been cleared), out-avals and grad flags, `op` at
+        the cursor, and an in-signature prefix covering every external
+        input registered so far. Returns the switched skeleton (made
+        MRU) or None — nothing was mutated by the failed match, so the
+        caller can simply retry the fast record against it."""
+        sk = self._skeleton
+        pos = self._skel_pos
+        if sk is None or not sk.ops:
             return None
-        self._skeleton = sk
-        return sk
+        bucket = self._skels.get(sk.ops[0].op)
+        if not bucket:
+            return None
+        n_reg = len(self._in_vals)
+        for k in list(reversed(bucket)):
+            c = bucket[k]
+            if c is sk or c.gen != _FAST_GEN or pos >= len(c.ops):
+                continue
+            if c.ops[pos].op is not op:
+                continue
+            if c.in_sig[:n_reg] != sk.in_sig[:n_reg]:
+                continue
+            ok = True
+            for i in range(pos):
+                a, b = c.ops[i], sk.ops[i]
+                if a.entry != b.entry or a.out_avals != b.out_avals \
+                        or a.out_req != b.out_req:
+                    ok = False
+                    break
+            if not ok:
+                continue
+            del bucket[k]           # MRU refresh
+            bucket[k] = c
+            self._skeleton = c
+            return c
+        return None
 
     def _record_fast(self, op: OpDef, ts, attrs):
         """Trace-stable skeleton replay: admit this record by matching
@@ -954,20 +1230,34 @@ class CaptureContext:
             s.entry = entry
             s.n_outs = pop.n_outs
             # flat tuple for the native matcher: one PyTuple_GET_ITEM
-            # per field instead of a slot GetAttr each
+            # per field instead of a slot GetAttr each (multi_output is
+            # canonical True/False so C judges it by identity)
             s.ctup = (s.op, s.akey, s.attrs, s.fast_attrs, s.wiring,
                       s.out_avals, s.out_req, s.req, s.has_inexact,
-                      s.entry, s.n_outs)
+                      s.entry, s.n_outs, True if s.op.multi_output
+                      else False)
             ops.append(s)
         sk = _Skeleton()
         sk.ops = ops
         sk.ctups = [s.ctup for s in ops]
         sk.in_sig = in_sig
         sk.gen = _FAST_GEN
+        sk.streak = 0
+        sk.plan = None
         self._skeleton = sk
-        if len(self._skels) > 8:
-            self._skels.clear()
-        self._skels[self.pending[0].op] = sk
+        op0 = self.pending[0].op
+        bucket = self._skels.get(op0)
+        if bucket is None:
+            if len(self._skels) > 8:
+                self._skels.clear()
+            bucket = self._skels[op0] = {}
+        # bucket key = (length, last entry), the _sig_memos scheme:
+        # same-leading-op shapes coexist instead of thrashing one slot
+        bkey = (len(ops), self._sig_ops[-1])
+        bucket.pop(bkey, None)
+        if len(bucket) > 4:
+            bucket.clear()
+        bucket[bkey] = sk
 
     def record(self, op: OpDef, ts, attrs):
         """Record one op application; returns out Tensors (lazy).
@@ -980,6 +1270,12 @@ class CaptureContext:
         replay belongs in _record_fast AND in apply's native gate."""
         if self._skel_live:
             outs = self._record_fast(op, ts, attrs)
+            if outs is None and self._skeleton is not None \
+                    and self._switch_skel(op) is not None:
+                # sibling shape continues the stream: retry once (the
+                # failed match mutated nothing)
+                self._skel_live = True
+                outs = self._record_fast(op, ts, attrs)
             if outs is not None:
                 return outs
         is_grad_enabled = _IS_GRAD_ENABLED
@@ -1080,6 +1376,8 @@ class CaptureContext:
             self.flush("segment_cap")
 
     def _reset_segment(self):
+        if _DRIVE_CELL[0] is not None:
+            _drive_reconcile(self)
         self.pending = []
         self._in_ids = {}
         self._in_tensors = []
@@ -1159,15 +1457,29 @@ class CaptureContext:
                     or not (self._skel_live
                             and self._skel_pos == len(sk.ops))):
                 self._build_skeleton(memo[1])
+            elif sk is not None and sk.gen == _FAST_GEN \
+                    and self._skel_live \
+                    and self._skel_pos == len(sk.ops):
+                # a full clean replay of the armed skeleton just
+                # re-proved: advance the whole-step promotion streak,
+                # and at the threshold seal the STEP PLAN — live set +
+                # _CachedKey + ambient mesh — so later seals of this
+                # shape skip signature reconstruction entirely
+                sk.streak += 1
+                if sk.plan is None and _STEP_REPLAY_AFTER \
+                        and sk.streak >= _STEP_REPLAY_AFTER:
+                    sk.plan = (memo[2], memo[6], SPMD)
             self._sig_memo = memo
             return memo[6]
         # structural drift for THIS shape: drop its banked skeleton
-        # and re-prove before replaying it again — but only when the
-        # banked entry IS this shape (same length); a different shape
-        # that merely shares the leading op keeps its valid skeleton
-        banked = self._skels.get(self.pending[0].op)
-        if banked is not None and len(banked.ops) == len(self._sig_ops):
-            del self._skels[self.pending[0].op]
+        # and re-prove before replaying it again — bucket keys carry
+        # (length, last entry), so a different shape that merely shares
+        # the leading op keeps its valid skeleton
+        bucket = self._skels.get(self.pending[0].op)
+        if bucket is not None:
+            bucket.pop((len(self._sig_ops), self._sig_ops[-1]), None)
+            if not bucket:
+                del self._skels[self.pending[0].op]
         self._skeleton = None
         base = (backend, ops_key, in_sig, live_t, MESH_EPOCH)
         key = _CachedKey(base if shard_sig is None
@@ -1180,8 +1492,46 @@ class CaptureContext:
         self._sig_memo = memo
         return key
 
+    def _step_plan_sig(self, live):
+        """Whole-step replay admission at seal time. Returns
+        ``(sig, True)`` when the current segment fully replayed a
+        promoted skeleton and the live set matches its sealed plan —
+        the seal then skips _signature() entirely and the execution
+        runs under ``segment::replay_step``. Returns ``(None, False)``
+        otherwise; a live-set or mesh mismatch against an armed plan
+        additionally DEMOTES the shape (streak reset, plan dropped) so
+        it re-proves through the normal path before re-promoting.
+        The mechanical invalidation events (mesh epoch, watched flags,
+        note_inplace, grad-mode flip) never reach this check: they all
+        break the per-op replay first, so `_skel_live` is already
+        False."""
+        sk = self._skeleton
+        if sk is None or sk.plan is None or not self._skel_live \
+                or self._skel_pos != len(sk.ops) \
+                or len(self._in_vals) != len(sk.in_sig):
+            return None, False
+        plan_live, plan_key, plan_spmd = sk.plan
+        if sk.gen != _FAST_GEN or tuple(live) != plan_live \
+                or SPMD is not plan_spmd:
+            sk.streak = 0
+            sk.plan = None
+            return None, False
+        global REPLAY_STEPS
+        REPLAY_STEPS += 1
+        if _OBS.METRICS:
+            from ..observability import metrics
+            metrics.inc("segment.replay_steps")
+        self._sig_memo = self._sig_memos.get(
+            (self._sig_ops[0], len(self._sig_ops), self._sig_ops[-1]))
+        return plan_key, True
+
     # ------------------------------------------------------------- flush
     def flush(self, reason: str = "materialize"):
+        if _DRIVE_CELL[0] is not None:
+            # an armed whole-step drive lags the context's cursor and
+            # counters (they are written back in batch): reconcile
+            # BEFORE anything below reads _skel_pos/_fast_ops
+            _drive_reconcile(self)
         if not self.pending:
             # nothing recorded, but clear any input registrations a
             # partially-failed record may have left behind
@@ -1195,7 +1545,9 @@ class CaptureContext:
         in_tensors = [r() for r in self._in_tensors]  # None = died
 
         live, live_refs = self._live_outputs(pending)
-        sig = self._signature(in_vals, live)
+        sig, driven = self._step_plan_sig(live)
+        if sig is None:
+            sig = self._signature(in_vals, live)
 
         # donation: an input whose backing tensor died or was overwritten
         # is dead the moment this program runs — let XLA reuse its buffer
@@ -1222,7 +1574,8 @@ class CaptureContext:
         # reads only avals/identity, never concrete values).
         if _flags.ASYNC_FLUSH_ACTIVE and reason in _ASYNC_REASONS:
             self._flush_async(reason, pending, in_vals, in_meta,
-                              in_tensors, live, live_refs, sig, donate)
+                              in_tensors, live, live_refs, sig, donate,
+                              driven)
             return
 
         # program sanitizer (paddle_tpu.analysis): one cached-gate read
@@ -1267,6 +1620,18 @@ class CaptureContext:
             if _flags.FAULT_INJECT_ACTIVE:
                 _inject_exec_oom()
             runner = _SEG_CACHE.get((sig, donate))
+            if runner is None and _persist.ACTIVE:
+                # disk consult between the in-memory miss and
+                # lower().compile(): a verified hit takes the cached-
+                # execute span below and bumps no compiles.* counter
+                runner = _disk_runner(
+                    "segment", (_persist_sig(sig), donate),
+                    _jit_factory(
+                        lambda: _build_segment_fn(pending, live),
+                        donate, run_vals, _spmd_for_compile(in_vals)),
+                    cache=_SEG_CACHE, key=(sig, donate))
+                if runner is not None:
+                    _SEG_CACHE[(sig, donate)] = runner
             # async dispatch: out_vals are in-flight futures — the host
             # returns to tracing the next ops while the device executes;
             # sync happens only at explicit .numpy()/float() reads
@@ -1287,11 +1652,14 @@ class CaptureContext:
                     pending, live, donate, run_vals, sig,
                     _spmd_for_compile(in_vals))
                 _SEG_CACHE[(sig, donate)] = runner
+                if _persist.ACTIVE:
+                    _disk_store("segment", (_persist_sig(sig), donate),
+                                runner, _SEG_CACHE, (sig, donate))
                 with _quiet_donation_compile():   # first call compiles
                     out_vals = runner(*run_vals)
             else:
                 if fspan is not None:
-                    xspan = _obs_exec_span(False, len(pending))
+                    xspan = _obs_exec_span(False, len(pending), driven)
                 out_vals = runner(*run_vals)
             if xspan is not None:
                 xspan.end()
@@ -1385,7 +1753,7 @@ class CaptureContext:
             fspan.end()
 
     def _flush_async(self, reason, pending, in_vals, in_meta, in_tensors,
-                     live, live_refs, sig, donate):
+                     live, live_refs, sig, donate, driven=False):
         """Seal the segment and hand it to the flush executor.
 
         Caller-thread work is exactly what MUST happen at eager order:
@@ -1462,6 +1830,15 @@ class CaptureContext:
                 if fault_active:
                     _inject_exec_oom()
                 runner = _SEG_CACHE.get((sig, donate))
+                if runner is None and _persist.ACTIVE:
+                    runner = _disk_runner(
+                        "segment", (_persist_sig(sig), donate),
+                        _jit_factory(
+                            lambda: _build_segment_fn(pending, live),
+                            donate, run_vals, spmd_pin),
+                        cache=_SEG_CACHE, key=(sig, donate))
+                    if runner is not None:
+                        _SEG_CACHE[(sig, donate)] = runner
                 if runner is None:
                     if fault_active:
                         from ..distributed.resilience import faults \
@@ -1476,11 +1853,16 @@ class CaptureContext:
                                                      donate, run_vals,
                                                      sig, spmd_pin)
                     _SEG_CACHE[(sig, donate)] = runner
+                    if _persist.ACTIVE:
+                        _disk_store("segment",
+                                    (_persist_sig(sig), donate),
+                                    runner, _SEG_CACHE, (sig, donate))
                     with _quiet_donation_compile():
                         out_vals = runner(*run_vals)
                 else:
                     if fspan is not None:
-                        xspan = _obs_exec_span(False, len(pending))
+                        xspan = _obs_exec_span(False, len(pending),
+                                               driven)
                     out_vals = runner(*run_vals)
                 if xspan is not None:
                     xspan.end()
@@ -2067,12 +2449,24 @@ class ReplayableSegment:
         if got != self.in_avals:
             raise _ReplayMismatch("input avals changed")
         runner = _SEG_CACHE.get((self.sig, ()))
+        if runner is None and _persist.ACTIVE:
+            runner = _disk_runner(
+                "segment", (_persist_sig(self.sig), ()),
+                _jit_factory(
+                    lambda: _build_segment_fn(self.pending, self.live),
+                    (), in_vals, self.spmd),
+                cache=_SEG_CACHE, key=(self.sig, ()))
+            if runner is not None:
+                _SEG_CACHE[(self.sig, ())] = runner
         compiled = runner is None
         if compiled:
             runner = _compile_segment_runner(self.pending, self.live, (),
                                              in_vals, self.sig,
                                              spmd=self.spmd)
             _SEG_CACHE[(self.sig, ())] = runner
+            if _persist.ACTIVE:
+                _disk_store("segment", (_persist_sig(self.sig), ()),
+                            runner, _SEG_CACHE, (self.sig, ()))
             if _OBS.METRICS:
                 from ..observability import metrics
                 metrics.inc("compiles.segment")
@@ -2296,9 +2690,21 @@ def try_fused_backward(tensors, grad_tensors) -> bool:
     fspan = _obs_flush_span("backward_fused", len(pending), len(in_vals),
                             len(live), 0, ctx._fast_ops) \
         if _OBS.ACTIVE else None
-    sig = ctx._signature(in_vals, live)
+    sig, driven = ctx._step_plan_sig(live)
+    if sig is None:
+        sig = ctx._signature(in_vals, live)
     key = (sig, grad_in, root_k)
     runner = _FUSED_CACHE.get(key)
+    if runner is None and _persist.ACTIVE:
+        run_vals = resolve_pending(in_vals) if _ASYNC_SEEN else in_vals
+        runner = _disk_runner(
+            "fused_step", (_persist_sig(sig), grad_in, root_k),
+            _jit_factory(
+                lambda: _build_fused_fn(pending, live, grad_in, root_k),
+                (), run_vals, _spmd_for_compile(in_vals)),
+            cache=_FUSED_CACHE, key=key, stat="fused_step")
+        if runner is not None:
+            _FUSED_CACHE[key] = runner
     compiled = runner is None
     if compiled and _flags.FAULT_INJECT_ACTIVE:
         # segment::compile fault site on the fused fwd+vjp path too:
@@ -2333,11 +2739,15 @@ def try_fused_backward(tensors, grad_tensors) -> bool:
                 raise oe from e
             raise
         _FUSED_CACHE[key] = runner
+        if _persist.ACTIVE:
+            _disk_store("fused_step",
+                        (_persist_sig(sig), grad_in, root_k),
+                        runner, _FUSED_CACHE, key)
         if _OBS.METRICS:
             from ..observability import metrics
             metrics.inc("compiles.fused_step")
     dispatch.bump_exec()
-    xspan = _obs_exec_span(compiled, len(pending)) \
+    xspan = _obs_exec_span(compiled, len(pending), driven) \
         if fspan is not None else None
     try:
         if run_vals is None:     # cache hit: not resolved above

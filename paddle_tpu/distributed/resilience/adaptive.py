@@ -209,6 +209,13 @@ class AdaptiveTrainer:
         self.last_event: Optional[MembershipEvent] = None
         self.last_replan_latency_s: Optional[float] = None
         self._replan_t0: Optional[float] = None
+        # persistent-executable-cache hits observed between the
+        # membership event and the first successful post-replan step:
+        # the replan's recompile-once cost shrinks to a disk load when
+        # the epoch-zeroed persist keys match (see _core/persist.py) —
+        # this makes that warm path visible per replan
+        self.last_replan_persist_hits: Optional[int] = None
+        self._replan_persist0: Optional[int] = None
 
     # ------------------------------------------------------------- misc
     def _count_params(self) -> int:
@@ -309,6 +316,7 @@ class AdaptiveTrainer:
         from ...observability import metrics
         metrics.inc("resilience.member_epochs")
         self._replan_t0 = time.perf_counter()
+        self._replan_persist0 = metrics.counter("cache.persist.hit").value
         prev_epoch, prev_members = self._last_epoch, self._members
         self._last_epoch = ev.epoch
         self._members = list(ev.members)
@@ -581,6 +589,23 @@ class AdaptiveTrainer:
             from ...observability import metrics
             metrics.observe("resilience.replan_us",
                             self.last_replan_latency_s * 1e6)
+            if self._replan_persist0 is not None:
+                # disk executables loaded instead of recompiled across
+                # this event -> first-good-step window (0 on a cold
+                # cache dir or with persistence off)
+                hits = (metrics.counter("cache.persist.hit").value
+                        - self._replan_persist0)
+                self._replan_persist0 = None
+                self.last_replan_persist_hits = hits
+                if hits:
+                    metrics.inc("resilience.replan_persist_hits", hits)
+                from ...observability import _state as _OBS
+                if _OBS.FLIGHT:
+                    from ...observability import flight
+                    flight.note("adaptive", "replan_done",
+                                latency_us=int(
+                                    self.last_replan_latency_s * 1e6),
+                                persist_hits=hits)
         if self.ckpt is not None and self._ckpt_every > 0 \
                 and self._elastic.step_index % self._ckpt_every == 0:
             self.save_checkpoint()

@@ -90,6 +90,7 @@ RING_MUTATIONS = 0
 _PREFIX_BUCKET = (
     ("segment::execute", "execute"),
     ("segment::replay_per_op", "execute"),
+    ("segment::replay_step", "execute"),
     ("optimizer::", "execute"),
     ("segment::compile", "compile"),
     ("comm::", "comm_wait"),

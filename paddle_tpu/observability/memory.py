@@ -433,6 +433,9 @@ def aot_compile(jitted, args, kwargs: Optional[Dict] = None,
 
     runner.memory_analysis_info = info
     runner.cost_analysis_info = cinfo
+    # the raw Compiled rides along so the persistent executable cache
+    # (_core/persist.py) can serialize it without re-lowering
+    runner.aot_executable = compiled
     return runner
 
 

@@ -20,6 +20,7 @@ import jax.numpy as jnp
 from .._core import dispatch as _dispatch
 from .._core import flags as _flags
 from .._core import lazy as _lazy
+from .._core import persist as _persist
 from ..observability import _state as _OBS
 from .._core.autograd import no_grad
 from .._core.tensor import Tensor
@@ -158,7 +159,10 @@ class Optimizer:
                     new_p, new_s = self._run_spmd(
                         _lazy.SPMD, fn is self._jit_update, pvals,
                         gvals, states, lr, t, wds, lr_mults)
-                elif _OBS.MEM or _OBS.COMPUTE:
+                elif _OBS.MEM or _OBS.COMPUTE or _persist.ACTIVE:
+                    # the persistent executable cache also routes
+                    # through the AOT path: a warm process loads the
+                    # fused update from disk instead of recompiling
                     new_p, new_s = self._run_analyzed(
                         fn, pvals, gvals, states, lr, t, wds, lr_mults)
                 else:
@@ -237,27 +241,47 @@ class Optimizer:
                specs, spmd.key, _lazy.MESH_EPOCH)
         cache = self.__dict__.setdefault("_spmd_updates", {})
         entry = cache.get(sig)
-        if entry is None:
-            in_sh = jax.tree_util.tree_unflatten(
-                treedef, [spmd.sharding_for(c) for c in specs])
-            out_sh = (in_sh[0], in_sh[2])
+
+        def _build_pjit():
             # pjit rejects kwargs alongside in_shardings, and wds /
             # lr_mults are part of `sig` anyway: close over them
+            in_sh = jax.tree_util.tree_unflatten(
+                treedef, [spmd.sharding_for(c) for c in specs])
             body = functools.partial(self._fused_update, wds=wds,
                                      lr_mults=lr_mults)
-            runner = jax.jit(body, in_shardings=in_sh,
-                             out_shardings=out_sh,
-                             donate_argnums=(0, 2) if donate else ())
+            return jax.jit(body, in_shardings=in_sh,
+                           out_shardings=(in_sh[0], in_sh[2]),
+                           donate_argnums=(0, 2) if donate else ())
+
+        if entry is None and _persist.ACTIVE:
+            # disk consult before pjit construction: a warm hit bumps
+            # no compiles.spmd (the jit fallback is built lazily)
+            runner = _lazy._disk_runner("optimizer_spmd",
+                                        sig[:-1] + (0,), _build_pjit,
+                                        stat="optimizer")
+            if runner is not None:
+                est = spmd.estimate_bytes(
+                    leaves,
+                    list(pvals) + jax.tree_util.tree_leaves(states),
+                    gather_only=True)
+                if len(cache) > 8:
+                    cache.clear()
+                entry = cache[sig] = (runner, est)
+        if entry is None:
+            runner = _build_pjit()
             if _OBS.METRICS:
                 from ..observability import metrics
                 metrics.inc("compiles.spmd")
             if not _OBS.COMPUTE:
                 _lazy.mark_cost_stale()
-            if _OBS.MEM or _OBS.COMPUTE:
+            if _OBS.MEM or _OBS.COMPUTE or _persist.ACTIVE:
                 from ..observability import memory as _memtel
                 runner = _memtel.aot_compile(
                     runner, args, stat="optimizer", key=sig,
                     n_devices=_lazy._mesh_devices(spmd))
+                if _persist.ACTIVE:
+                    _lazy._disk_store("optimizer_spmd", sig[:-1] + (0,),
+                                      runner)
             # compiled-comm estimate: an output replicated over an axis
             # that shards a state input is the ZeRO all-gather
             est = spmd.estimate_bytes(
@@ -297,6 +321,20 @@ class Optimizer:
                _lazy.MESH_EPOCH)
         cache = self.__dict__.setdefault("_aot_updates", {})
         compiled = cache.get(sig)
+        if compiled is None and _persist.ACTIVE:
+            # disk consult (epoch component zeroed — the layout is
+            # fully described by the rest of the signature) before
+            # lower().compile(); the jit fallback for tracer args is
+            # built lazily so a warm hit constructs nothing
+            compiled = _lazy._disk_runner(
+                "optimizer", sig[:-1] + (0,),
+                lambda: functools.partial(fn, wds=wds,
+                                          lr_mults=lr_mults),
+                stat="optimizer")
+            if compiled is not None:
+                if len(cache) > 8:
+                    cache.clear()
+                cache[sig] = compiled
         if compiled is None:
             if not _OBS.COMPUTE:
                 _lazy.mark_cost_stale()
@@ -307,6 +345,9 @@ class Optimizer:
             if len(cache) > 8:     # param-group churn guard
                 cache.clear()
             cache[sig] = compiled
+            if _persist.ACTIVE:
+                _lazy._disk_store("optimizer", sig[:-1] + (0,),
+                                  compiled)
         if _OBS.COMPUTE:
             from ..observability import compute as _comptel
             _comptel.note_execution(
