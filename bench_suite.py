@@ -157,6 +157,16 @@ Row 18 warm restart   two fresh processes share one
                                 free when FLAGS_executable_cache_dir
                                 and FLAGS_step_replay_after are off
 
+Row 19 auto-parallel planner gate   `--plan --json` subprocess ranks
+                                every dp×mp×pp factorization of world
+                                8 for the row-12 dryrun model against
+                                the static planes; asserts the pick ==
+                                the sweep's measured-best shape (dp8)
+                                and the validated winner carries zero
+                                reshard/pipeline findings; plan
+                                latency rides --diff as a ms row
+                                (down-good)
+
 (Multi-chip GPT/ERNIE hybrids need a pod; their single-chip proxies are
 bench.py's headline + the dryrun_multichip compile check.)
 
@@ -1811,6 +1821,58 @@ def bench_warm_restart():
             "rows": rows}
 
 
+def bench_plan():
+    """Row 19: the static auto-parallelism planner as a regression
+    gate. `--plan --json` records the row-12 dryrun-sweep model in a
+    subprocess and ranks EVERY dp×mp×pp factorization of world 8
+    against the static planes (propagated comm bytes, liveness peak,
+    per-chip FLOPs + pipeline bubble). The gate asserts the planner's
+    pick equals the sweep's measured-best shape (dp8 — the dp ladder
+    row 12 times is fastest at full data parallelism for this model),
+    that the validated winner carries ZERO reshard/pipeline findings,
+    and plan latency rides --diff as a ms row (down-good) so planner
+    cost creep gates too."""
+    import subprocess
+    import sys
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.analysis", "--plan",
+         "--json", "--world", "8"],
+        capture_output=True, text=True, env=env, timeout=1800)
+    lines = [ln for ln in out.stdout.splitlines() if ln.startswith("{")]
+    if out.returncode != 0 or not lines:
+        raise RuntimeError(
+            f"analysis --plan failed rc={out.returncode}: "
+            f"{out.stderr[-2000:]}")
+    payload = json.loads(lines[-1])
+    best = payload.get("best")
+    if not best or best["shape"] != [8, 1, 1]:
+        raise RuntimeError(
+            f"planner pick {best and best['shape']} != the "
+            f"measured-best dp8 of the dryrun sweep: "
+            f"{[c['desc'] for c in payload.get('candidates', ())[:4]]}")
+    assert payload["validated"], "winner skipped validation"
+    assert payload["winner_findings"] == 0, \
+        f"validated winner carries findings: {payload}"
+    n_feasible = sum(1 for c in payload["candidates"] if c["feasible"])
+    rows = [
+        {"metric": "auto-parallel plan latency (world-8 full "
+                   "dp×mp×pp factorization sweep)",
+         "value": payload["plan_ms"], "unit": "ms"},
+    ]
+    return {"metric": "auto-parallel planner gate (pick == "
+                      "measured-best dp8 on the dryrun sweep; winner "
+                      "validated through reshard+pipeline checkers, "
+                      "findings)",
+            "value": payload["winner_findings"],
+            "unit": "findings",
+            "best": best["desc"],
+            "candidates": len(payload["candidates"]),
+            "feasible": n_feasible,
+            "rows": rows}
+
+
 # ------------------------------------------------------------- diff mode
 
 def _rows_of(path: str) -> dict:
@@ -1938,7 +2000,7 @@ def main():
         return
     rows = os.environ.get(
         "BENCH_ROWS",
-        "1,2,3,4,5,6,7,8,9,10,11,12,13,14,15,16,17,18").split(",")
+        "1,2,3,4,5,6,7,8,9,10,11,12,13,14,15,16,17,18,19").split(",")
     table = {"1": bench_lenet, "2": bench_resnet50, "3": bench_bert,
              "4": bench_dispatch, "5": bench_static_checks,
              "6": bench_observability, "7": bench_resilience,
@@ -1947,7 +2009,7 @@ def main():
              "12": bench_spmd_multichip, "13": bench_perf_lint,
              "14": bench_compute, "15": bench_mem_lint,
              "16": bench_goodput, "17": bench_record_fastpath,
-             "18": bench_warm_restart}
+             "18": bench_warm_restart, "19": bench_plan}
     for r in rows:
         r = r.strip()
         out = table[r]()

@@ -56,8 +56,10 @@ from .sharding_prop import check_sharding as _check_sharding_impl
 from .mem_liveness import (CandidateMesh, analyze_liveness,
                            check_memory, plan_pod_shape,
                            step_footprint, sweep_pod_shapes)
+from .planner import (PlanCandidate, PlanReport, enumerate_mesh_shapes,
+                      plan_program, score_candidate, validate_plan)
 from . import alias_graph, dataflow, distributed_checks, fixes, hooks, \
-    mem_liveness, perf_checks, sharding_prop, sot_checks
+    mem_liveness, perf_checks, planner, sharding_prop, sot_checks
 
 __all__ = [
     "CheckReport", "Diagnostic", "StaticCheckError",
@@ -70,6 +72,8 @@ __all__ = [
     "check_sharding", "propagate_specs", "PerfRecorder", "trace_step",
     "analyze_liveness", "check_memory", "step_footprint",
     "sweep_pod_shapes", "plan_pod_shape", "CandidateMesh",
+    "plan_program", "score_candidate", "validate_plan",
+    "enumerate_mesh_shapes", "PlanReport", "PlanCandidate",
 ]
 
 

@@ -665,6 +665,47 @@ def _mem_main(args) -> int:
     return 0
 
 
+def _plan_main(args) -> int:
+    """--plan: record the dryrun sweep model (the bench row-12 shape:
+    two bias-free Linear(64,64) over [8, 32, 64] + cross-entropy) and
+    run the whole-program auto-parallelism planner over every dp×mp×pp
+    factorization of --world. Static end to end: no devices, no
+    compile — a laptop plans a pod. Exit code 0 only when a feasible
+    plan exists AND the winner validated clean through the reshard +
+    pipeline checkers."""
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu import analysis
+    from paddle_tpu._core import lazy
+
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(64, 64, bias_attr=False),
+                          nn.Linear(64, 64, bias_attr=False))
+    r = np.random.RandomState(0)
+    x = paddle.to_tensor(r.randn(8, 32, 64).astype("float32"))
+    y = paddle.to_tensor(r.randint(0, 64, (8, 32)).astype("int64"))
+    lazy.PERF_SRC += 1      # diagnostics carry file:line provenance
+    try:
+        with lazy.lazy_guard(max_segment_ops=1 << 30) as ctx:
+            F.cross_entropy(model(x), y)
+            rep = analysis.plan_program(ctx, world=args.world)
+            ctx._reset_segment()
+    finally:
+        lazy.PERF_SRC -= 1
+    print(rep.render())
+    best = rep.best()
+    winner_findings = 0 if best is None else sum(
+        1 for d in rep.diagnostics.diagnostics
+        if d.checker in ("reshard_placement", "pipeline_schedule"))
+    if args.json:
+        print(json.dumps(dict(rep.to_dict(),
+                              winner_findings=winner_findings)))
+    return 0 if (best is not None and rep.validated
+                 and winner_findings == 0) else 1
+
+
 def _maybe_reexec_for_devices(argv) -> int:
     """--perf wants the dryrun dp×mp mesh (≥4 devices). On a
     single-device host, re-exec with 8 forced CPU devices BEFORE jax
@@ -742,6 +783,16 @@ def main(argv=None) -> int:
                          "pod shapes (static liveness — no compile, no "
                          "devices); oom_risk findings gate against "
                          "FLAGS_memory_budget_bytes")
+    ap.add_argument("--plan", action="store_true",
+                    help="auto-parallelism planner: record the dryrun "
+                         "sweep model and rank every dp×mp×pp "
+                         "factorization of --world against the static "
+                         "comm/memory/FLOP planes; the winner is "
+                         "validated through the reshard + pipeline "
+                         "checkers (error mode)")
+    ap.add_argument("--world", type=int, default=8,
+                    help="world size the --plan search factorizes "
+                         "(default 8, the dryrun sweep world)")
     ap.add_argument("--mesh", default=None, metavar="DP,MP[,PP]",
                     help="restrict the --mem sweep to one candidate "
                          "shape (e.g. --mesh 4,2); default sweeps "
@@ -764,6 +815,8 @@ def main(argv=None) -> int:
         return _perf_main(args, raw_argv)
     if args.mem:
         return _mem_main(args)
+    if args.plan:
+        return _plan_main(args)
 
     global _FIX
     _FIX = bool(args.fix)

@@ -509,15 +509,19 @@ def analyze_liveness(ctx_or_view, mesh=None, train: Optional[bool] = None,
 
 def step_footprint(ctx_or_view, mesh=None, optimizer: str = "adam",
                    master_weights: bool = False,
-                   train: bool = True, note: bool = True) -> Dict:
+                   train: bool = True, note: bool = True,
+                   prop=None) -> Dict:
     """Full train-step per-device footprint of a recorded forward(+loss)
     program: the liveness peak (params + activations + cotangents +
     grads on the mirrored fwd+vjp timeline) plus the optimizer
     moments/master (sized from the grad-requiring inputs at the param
     layout) plus the compiled-temp estimate. All numbers are PER
-    DEVICE under `mesh`."""
+    DEVICE under `mesh`. A caller sweeping candidate shapes can hand
+    in the propagation pass's `PropResult` as `prop` (the
+    `analyze_liveness` passthrough) to avoid re-propagating per
+    policy variant."""
     res = analyze_liveness(ctx_or_view, mesh=mesh, train=train,
-                           note=False)
+                           note=False, prop=prop)
     # under a pp stage split a device holds only its stage's params,
     # so the per-device param/grad/optimizer bytes come from the
     # heaviest stage, not the whole model

@@ -1,0 +1,615 @@
+"""Static auto-parallelism planner: search the analysis planes, not a
+divisor list.
+
+The three static cost planes — `sharding_prop` (per-op comm bytes at an
+assumed layout), `mem_liveness` (per-device peak HBM at any
+CandidateMesh) and `op_flops` (per-op compute) — priced programs but
+never *decided* anything: the AutoTuner still searched a hand-rolled
+GPT-shaped formula space and the elastic re-planner fell back to pure
+dp on worlds its divisor ladder missed. This module turns the planes
+into the decision procedure of the 2112.02752 recipe ("End-to-end
+Adaptive Distributed Training on PaddlePaddle"), with the per-chip
+acceptance framing of the MLPerf TPU-pod work (2011.03641):
+
+- **search space** (:func:`enumerate_mesh_shapes` +
+  :func:`plan_program`): every dp×mp×pp divisor factorization of the
+  world size (6 = 1×2×3, 12 = 2×3×2, … — not just powers of two), pp
+  as a CONTIGUOUS stage split balanced over the per-op FLOP table
+  (:func:`balanced_stage_split`), per-layer TP sharding-dim choices
+  for the mp-shardable params (greedy comm-minimizing refinement),
+  and donation / remat policy toggles;
+- **scoring** (:func:`score_candidate`): one `sharding_prop.propagate`
+  sweep per (shape, TP choice) prices the collective bytes, one
+  `mem_liveness` pass prices the per-device step peak (candidates
+  over `FLAGS_memory_budget_bytes` are HARD-infeasible, carrying a
+  real ``oom_risk`` diagnostic), and the per-chip compute term rides
+  the worst pipeline stage's FLOPs with the standard `(pp-1)/micro`
+  bubble. The score is predicted seconds/step::
+
+      score = worst_stage_flops * train_mult / (dp*mp) / (chip_flops*mfu)
+                  * (1 + (pp-1)/(2*pp))
+            + (2 * fwd_comm_bytes + dp_ring_grad_bytes) / ici_bandwidth
+
+  with ``train_mult`` 3 (fwd + bwd) or 4 (remat replays the forward)
+  and ``dp_ring_grad_bytes = 2*(dp-1)/dp * grad_bytes_per_device``;
+- **one ranked PlanReport**: every candidate keeps its full score
+  breakdown and infeasibility reasons; diagnostics ride a sanitizer
+  `CheckReport` with provenance, so a rejected shape reads like any
+  other finding;
+- **winner validation** (:func:`validate_plan`): before anything
+  moves, the winning layout is driven through the sanitizer's
+  `reshard_placement` checker (replicated → planned placement for
+  every input, on a logical ProcessMesh of the planned shape) and —
+  when pp > 1 — the `pipeline_schedule` deadlock simulation, in
+  unconditional ERROR mode (the `on_world_shrink` contract: planning
+  onto a broken layout must fail loudly, `FLAGS_static_checks=off`
+  notwithstanding).
+
+Surfaces: :func:`plan_program` / ``python -m paddle_tpu.analysis
+--plan [--world N] [--json]``; `spmd.suggest_mesh_shape` delegates its
+ranking here; `resilience.adaptive.Replanner` re-plans survivors from
+the recorded program instead of collapsing to the divisor fallback.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..observability.budget import _fmt_bytes
+from .diagnostics import CheckReport
+from .mem_liveness import (_OPT_FACTORS, _assumed_mesh, _shard_factor,
+                           check_memory, CHECKER_OOM)
+from .sharding_prop import _nbytes, op_flops, propagate
+
+# how much of the activation+cotangent plane a remat policy reclaims:
+# selective rematerialization keeps the layer-boundary residuals
+# (~1/4 of the plane on the bench models) and replays the rest
+_REMAT_SAVED_FRACTION = 0.75
+# train-step compute multiples of the forward FLOPs
+_TRAIN_MULT = 3.0          # fwd + bwd (2x fwd)
+_TRAIN_MULT_REMAT = 4.0    # + one forward replay
+# per-shape cap on greedy per-param TP sharding-dim refinement trials
+# (each trial is one propagate sweep)
+_TP_REFINE_CAP = 4
+# per-hop ICI transfer latency: each micro-batch activation/cotangent
+# handoff between adjacent pipeline stages pays this floor, which is
+# what makes pipelining a 3-op toy program lose to pure dp while
+# staying noise on a real multi-second step (override via hw
+# {"ici_latency": ...})
+_ICI_LATENCY_S = 1e-6
+
+
+def _hw(overrides: Optional[Dict] = None) -> Dict:
+    """The auto-tuner's hardware model (chip_flops / ici_bandwidth /
+    mfu) — ONE set of constants for both searchers."""
+    from ..distributed.auto_tuner.cost_model import _DEFAULTS
+    hw = dict(_DEFAULTS)
+    if overrides:
+        hw.update({k: v for k, v in overrides.items() if k in hw})
+    return hw
+
+
+def enumerate_mesh_shapes(world_size: int) -> List[Tuple[int, int, int]]:
+    """Every ordered (dp, mp, pp) whose product is exactly
+    `world_size` — the full divisor factorization space, not a
+    powers-of-two ladder."""
+    from ..distributed.auto_tuner.search import factorizations
+    return factorizations(world_size)
+
+
+def balanced_stage_split(costs: Sequence[float], pp: int) -> List[int]:
+    """Contiguous split of `costs` (per-op FLOPs, program order) into
+    `pp` non-empty stages, greedily balanced: cut when the running
+    stage reaches the ideal 1/pp share, while leaving enough ops for
+    the remaining stages. Returns the pp+1 cut indices
+    (bounds[s] .. bounds[s+1] is stage s)."""
+    n = len(costs)
+    pp = max(int(pp), 1)
+    if pp > n:
+        raise ValueError(f"pp={pp} stages need at least {pp} ops, "
+                         f"got {n}")
+    if pp == 1:
+        return [0, n]
+    total = float(sum(costs)) or float(n)
+    target = total / pp
+    bounds = [0]
+    acc = 0.0
+    for j, c in enumerate(costs):
+        acc += float(c) if total != float(n) else 1.0
+        stages_left = pp - len(bounds)
+        ops_left = n - (j + 1)
+        if stages_left and acc >= target and ops_left >= stages_left:
+            bounds.append(j + 1)
+            acc = 0.0
+    while len(bounds) < pp:
+        # degenerate tail (huge last op): force unit-width stages
+        bounds.append(bounds[-1] + 1)
+    bounds.append(n)
+    return bounds
+
+
+def _per_op_flops(view) -> List[int]:
+    """The op_flops table of one recorded segment, program order."""
+    from .sharding_prop import _op_in_avals
+    pending = view.pending
+    return [op_flops(p.op.name, p.attrs,
+                     _op_in_avals(pending, view.in_vals, j),
+                     [r.aval for r in p.out_refs])
+            for j, p in enumerate(pending)]
+
+
+def _worst_stage_flops(flops: Sequence[float], bounds: List[int]) -> float:
+    return max((float(sum(flops[bounds[s]:bounds[s + 1]]))
+                for s in range(len(bounds) - 1)), default=0.0)
+
+
+def _donate_all_mask(view) -> Tuple[int, ...]:
+    """Donation-policy toggle: every non-grad input freed after its
+    last read (what `FLAGS_lazy_donate_inputs` would compute for an
+    inference-shaped segment)."""
+    out = []
+    for i in range(len(view.in_vals)):
+        req = bool(view.in_meta[i][0]) if i < len(view.in_meta) else False
+        if not req:
+            out.append(i)
+    return tuple(out)
+
+
+def _with_donate(view, donate: Tuple[int, ...]):
+    from .segment_checks import SegmentView
+    return SegmentView(view.pending, view.in_vals, view.in_tensors,
+                       view.in_meta, view.in_ids, view.live,
+                       view.live_refs, donate, view.needs_grad,
+                       ctx=view.ctx)
+
+
+def _tp_choices(view, mp: int, prop_cache: Dict, mesh_fn) -> Dict[int, int]:
+    """Greedy per-layer TP refinement: for each mp-shardable param
+    (largest first, capped), try its alternative mp-divisible sharding
+    dims and keep the one whose propagated comm bytes are lowest.
+    Returns {input index: chosen dim} for the non-default picks."""
+    if mp <= 1:
+        return {}
+    cands = []
+    for i, v in enumerate(view.in_vals):
+        req = bool(view.in_meta[i][0]) if i < len(view.in_meta) else False
+        shp = tuple(getattr(v, "shape", ()))
+        if not req or not shp:
+            continue
+        dims = [d for d in range(len(shp)) if shp[d] % mp == 0]
+        if len(dims) >= 2:
+            cands.append((int(_nbytes(v)), i, shp, dims))
+    cands.sort(reverse=True)
+    choices: Dict[int, int] = {}
+    base = prop_cache["res"].comm_total()
+    for _, i, shp, dims in cands[:_TP_REFINE_CAP]:
+        default_dim = max([d for d in range(len(shp) - 1, -1, -1)
+                           if shp[d] % mp == 0], key=lambda dd: shp[dd])
+        best_dim, best_comm = None, base
+        for d in dims:
+            if d == default_dim:
+                continue
+            mesh = mesh_fn()
+            spec = [None] * len(shp)
+            spec[d] = "mp"
+            mesh.assume(view.in_vals[i], tuple(spec))
+            res, _rep = propagate(view, mesh,
+                                  report=CheckReport("planner tp trial"))
+            if res.comm_total() < best_comm:
+                best_dim, best_comm = d, res.comm_total()
+        if best_dim is not None:
+            choices[i] = best_dim
+            mesh = mesh_fn()
+            spec = [None] * len(shp)
+            spec[best_dim] = "mp"
+            mesh.assume(view.in_vals[i], tuple(spec))
+            res, _rep = propagate(view, mesh,
+                                  report=CheckReport("planner tp pick"))
+            prop_cache["res"], prop_cache["mesh"] = res, mesh
+            base = res.comm_total()
+    return choices
+
+
+class PlanCandidate:
+    """One scored (mesh shape, policy) point of the search space."""
+
+    __slots__ = ("dp", "mp", "pp", "remat", "donate", "feasible",
+                 "reasons", "score", "breakdown", "tp_dims")
+
+    def __init__(self, dp: int, mp: int, pp: int, remat: bool,
+                 donate: bool):
+        self.dp, self.mp, self.pp = int(dp), int(mp), int(pp)
+        self.remat = bool(remat)
+        self.donate = bool(donate)
+        self.feasible = True
+        self.reasons: List[str] = []
+        self.score = float("inf")
+        self.breakdown: Dict = {}
+        self.tp_dims: Dict[int, int] = {}
+
+    @property
+    def shape(self) -> Tuple[int, int, int]:
+        return (self.dp, self.mp, self.pp)
+
+    @property
+    def desc(self) -> str:
+        pol = ("+remat" if self.remat else "") \
+            + ("+donate" if self.donate else "")
+        return f"dp{self.dp}xmp{self.mp}xpp{self.pp}{pol}"
+
+    def reject(self, reason: str) -> "PlanCandidate":
+        self.feasible = False
+        self.reasons.append(reason)
+        return self
+
+    def row(self) -> Dict:
+        return {"shape": list(self.shape), "desc": self.desc,
+                "remat": self.remat, "donate": self.donate,
+                "feasible": self.feasible, "reasons": list(self.reasons),
+                "score_s": self.score, "tp_dims": dict(self.tp_dims),
+                "breakdown": dict(self.breakdown)}
+
+
+class PlanReport:
+    """Ranked candidates + diagnostics of one planner run."""
+
+    def __init__(self, world: int, budget: int, n_ops: int):
+        self.world = int(world)
+        self.budget = int(budget)
+        self.n_ops = int(n_ops)
+        self.candidates: List[PlanCandidate] = []
+        self.diagnostics = CheckReport(
+            f"auto-parallel plan (world={world}, {n_ops} ops)")
+        self.validated = False
+        self.plan_ms: Optional[float] = None
+
+    def rank(self):
+        self.candidates.sort(
+            key=lambda c: (not c.feasible, c.score, c.mp, c.pp,
+                           c.remat, c.donate))
+
+    def best(self) -> Optional[PlanCandidate]:
+        for c in self.candidates:
+            if c.feasible:
+                return c
+        return None
+
+    def best_plan(self) -> Optional[Dict]:
+        c = self.best()
+        if c is None:
+            return None
+        return {"world_size": self.world, "dp_degree": c.dp,
+                "mp_degree": c.mp, "pp_degree": c.pp,
+                "recompute": c.remat, "donate": c.donate}
+
+    def to_dict(self) -> Dict:
+        b = self.best()
+        return {"world": self.world, "budget_bytes": self.budget,
+                "n_ops": self.n_ops,
+                "best": b.row() if b is not None else None,
+                "validated": self.validated,
+                "plan_ms": self.plan_ms,
+                "findings": len(self.diagnostics.diagnostics),
+                "oom_risk": len(self.diagnostics.by_checker(CHECKER_OOM)),
+                "candidates": [c.row() for c in self.candidates]}
+
+    def render(self, top: int = 12) -> str:
+        lines = [f"== auto-parallel plan: world={self.world}, "
+                 f"{self.n_ops} ops, "
+                 + (f"{_fmt_bytes(self.budget)}/device budget"
+                    if self.budget else "no HBM budget (memory gate "
+                    "informational)"),
+                 f"  {'candidate':<24} {'score s/step':>14} "
+                 f"{'peak/dev':>10} {'comm':>10}  verdict"]
+        for c in self.candidates[:top]:
+            bd = c.breakdown
+            verdict = "ok" if c.feasible else \
+                ("; ".join(c.reasons)[:48] or "infeasible")
+            lines.append(
+                f"  {c.desc:<24} "
+                f"{c.score:>14.3e} "
+                f"{_fmt_bytes(bd.get('total_pd_bytes', 0)):>10} "
+                f"{_fmt_bytes(bd.get('comm_bytes', 0)):>10}  {verdict}")
+        b = self.best()
+        if b is not None:
+            lines.append(f"  -> plan: {b.desc}"
+                         + (" (validated)" if self.validated else ""))
+        else:
+            lines.append("  -> no feasible plan "
+                         "(every candidate rejected)")
+        return "\n".join(lines)
+
+
+def score_candidate(view, shape: Sequence[int], *,
+                    remat: bool = False, donate: bool = False,
+                    budget: int = 0, optimizer: str = "adam",
+                    train: bool = True, hw: Optional[Dict] = None,
+                    shard_params: bool = True,
+                    report: Optional[CheckReport] = None,
+                    _prop_cache: Optional[Dict] = None) -> PlanCandidate:
+    """Score one (dp, mp, pp[, policy]) candidate against the static
+    planes. Infeasibility is structural (dp not dividing any batch
+    input, mp sharding nothing, pp deeper than the program) or
+    capacity (per-device step peak over `budget` — a real ``oom_risk``
+    diagnostic lands on `report`)."""
+    from .mem_liveness import analyze_liveness
+    shape = tuple(int(s) for s in shape) + (1,) * (3 - len(shape))
+    dp, mp, pp = shape[0], shape[1], shape[2]
+    cand = PlanCandidate(dp, mp, pp, remat, donate)
+    hw = hw if hw and "chip_flops" in hw else _hw(hw)
+    if report is None:
+        report = CheckReport("planner candidate")
+    n_ops = len(view.pending)
+
+    # ------------------------------------------------ structural gates
+    batch_ok = dp == 1
+    mp_ok = mp == 1
+    for i, v in enumerate(view.in_vals):
+        shp = tuple(getattr(v, "shape", ()))
+        if not shp:
+            continue
+        req = bool(view.in_meta[i][0]) if i < len(view.in_meta) else False
+        if not req and dp > 1 and shp[0] % dp == 0:
+            batch_ok = True
+        if req and mp > 1 and any(d % mp == 0 for d in shp):
+            mp_ok = True
+    if not batch_ok:
+        cand.reject(f"dp={dp} divides no batch input's leading dim")
+    if not mp_ok:
+        cand.reject(f"mp={mp} shards no parameter dim evenly")
+    if pp > max(n_ops, 1):
+        cand.reject(f"pp={pp} stages exceed the {n_ops}-op program")
+    if not cand.feasible:
+        return cand
+
+    # ------------------------------------- layout propagation (cached)
+    cache = _prop_cache if _prop_cache is not None else {}
+    if "res" not in cache:
+        mesh = _assumed_mesh(view, shape, shard_params=shard_params)
+        res, _rep = propagate(view, mesh,
+                              report=CheckReport("planner prop"))
+        cache["res"], cache["mesh"] = res, mesh
+        cache["tp_dims"] = _tp_choices(
+            view, mp, cache,
+            lambda: _assumed_mesh(view, shape,
+                                  shard_params=shard_params))
+    res, mesh = cache["res"], cache["mesh"]
+    cand.tp_dims = dict(cache.get("tp_dims") or {})
+
+    # ----------------------------------------------- liveness / memory
+    dview = _with_donate(view, _donate_all_mask(view)) if donate \
+        else view
+    live = analyze_liveness(dview, mesh, train=train, note=False,
+                            prop=res)
+    params = live.worst_stage_bytes_of("param")
+    grads = live.worst_stage_bytes_of("grad")
+    opt_state = params * _OPT_FACTORS.get(str(optimizer).lower(), 2)
+    acts = live.bytes_of("activation") + live.bytes_of("cotangent")
+    peak = live.peak_pd_bytes
+    if remat:
+        saved = int(live.bytes_of("activation") * _REMAT_SAVED_FRACTION)
+        peak = max(peak - saved, peak - acts, params + grads)
+    total = peak + opt_state + live.temp_pd_bytes
+    fp = {"mesh": mesh.desc, "devices": mesh.size, "train": train,
+          "params_pd_bytes": params, "grads_pd_bytes": grads,
+          "opt_state_pd_bytes": opt_state,
+          "activations_pd_bytes": acts,
+          "liveness_peak_pd_bytes": peak,
+          "temp_pd_bytes": live.temp_pd_bytes,
+          "total_pd_bytes": total, "top": live.top(8)}
+    if budget:
+        n0 = len(report.by_checker(CHECKER_OOM))
+        check_memory(view, mesh=mesh, budget=budget, report=report,
+                     train=train, optimizer=optimizer, footprint=fp,
+                     note=False)
+        if len(report.by_checker(CHECKER_OOM)) > n0:
+            cand.reject(f"oom_risk: predicted {_fmt_bytes(total)}/dev "
+                        f"over the {_fmt_bytes(budget)} budget")
+
+    # ------------------------------------------------- compute + comm
+    flops = _per_op_flops(view)
+    bounds = balanced_stage_split(flops, pp)
+    stage_flops = _worst_stage_flops(flops, bounds)
+    mult = _TRAIN_MULT_REMAT if remat else _TRAIN_MULT
+    if not train:
+        mult = 1.0
+    compute_s = stage_flops * mult / max(dp * mp, 1) \
+        / (hw["chip_flops"] * hw["mfu"])
+    bubble = (pp - 1) / (2.0 * pp) if pp > 1 else 0.0
+    comm_bytes = 2 * res.comm_total()        # fwd comm, mirrored in bwd
+    dp_comm_bytes = int(2 * (dp - 1) / dp * grads) if dp > 1 else 0
+    # pp stage-boundary traffic: every activation crossing a stage cut
+    # is sent forward and its cotangent sent back, per-device sized by
+    # its propagated spec; each micro-batch handoff also pays the ICI
+    # hop-latency floor
+    pp_comm_bytes, hop_s = 0, 0.0
+    if pp > 1:
+        axis_size = {"dp": dp, "mp": mp}
+        stage_idx = [0] * n_ops
+        for s in range(len(bounds) - 1):
+            for j in range(bounds[s], bounds[s + 1]):
+                stage_idx[j] = s
+        seen = set()
+        for k, popk in enumerate(view.pending):
+            for w in popk.wiring:
+                if w is None or w[0] != "op":
+                    continue
+                j, slot = w[1], w[2]
+                if stage_idx[j] < stage_idx[k] and (j, slot) not in seen:
+                    seen.add((j, slot))
+                    st = res.out_states.get((j, slot))
+                    nb = _nbytes(view.pending[j].out_refs[slot].aval)
+                    pp_comm_bytes += 2 * (
+                        nb // _shard_factor(st, axis_size))
+        lat = float(hw.get("ici_latency", _ICI_LATENCY_S))
+        hop_s = (pp - 1) * (2 * pp) * 2 * lat   # micro = 2*pp, fwd+bwd
+    comm_s = (comm_bytes + dp_comm_bytes + pp_comm_bytes) \
+        / hw["ici_bandwidth"] + hop_s
+    cand.score = compute_s * (1.0 + bubble) + comm_s
+    cand.breakdown = {
+        "compute_s": compute_s, "bubble": bubble, "comm_s": comm_s,
+        "comm_bytes": comm_bytes, "dp_comm_bytes": dp_comm_bytes,
+        "pp_comm_bytes": pp_comm_bytes, "pp_hop_s": hop_s,
+        "stage_flops": stage_flops, "stage_bounds": list(bounds),
+        "train_mult": mult, "total_pd_bytes": total,
+        "budget_bytes": int(budget),
+        "footprint": {k: v for k, v in fp.items() if k != "top"},
+    }
+    return cand
+
+
+def validate_plan(view, cand: PlanCandidate, world: int,
+                  prop=None, schedule: str = "1F1B",
+                  report: Optional[CheckReport] = None) -> CheckReport:
+    """Drive the winning layout through the sanitizer's distributed
+    checkers BEFORE anything moves: every input's replicated →
+    planned-placement transition through ``reshard_placement`` on a
+    logical ProcessMesh of the planned shape, and — when pp > 1 — the
+    ``pipeline_schedule`` deadlock simulation. Unconditional error
+    mode (the `on_world_shrink` contract)."""
+    from ..distributed.auto_parallel.reshard_functions import DistAttrLite
+    from ..distributed.mesh import ProcessMesh
+    from ..distributed.placements import Replicate, Shard
+    from ..observability import metrics
+    from .distributed_checks import check_pipeline_schedule, check_reshard
+    metrics.counter("sanitizer.plan_sweeps").inc()
+    if report is None:
+        report = CheckReport(
+            f"auto-parallel plan winner ({cand.desc}, world={world})")
+    dims, names = [], []
+    for name, deg in (("dp", cand.dp), ("mp", cand.mp),
+                      ("pp", cand.pp)):
+        if deg > 1:
+            dims.append(deg)
+            names.append(name)
+    if not dims:
+        dims, names = [int(world)], ["dp"]
+    mesh = ProcessMesh(np.arange(int(world)).reshape(dims), names)
+    if prop is None:
+        cmesh = _assumed_mesh(view, cand.shape)
+        for i, d in (cand.tp_dims or {}).items():
+            shp = tuple(getattr(view.in_vals[i], "shape", ()))
+            spec = [None] * len(shp)
+            spec[d] = "mp"
+            cmesh.assume(view.in_vals[i], tuple(spec))
+        prop, _rep = propagate(view, cmesh,
+                               report=CheckReport("planner validate"))
+    for i, v in enumerate(view.in_vals):
+        shp = tuple(getattr(v, "shape", ()))
+        if not shp:
+            continue
+        st = prop.in_states[i] if i < len(prop.in_states) else None
+        entries = st.entries if st is not None and st.known \
+            else (None,) * len(shp)
+        placements = []
+        for ax in names:
+            dim = next(
+                (d for d, e in enumerate(entries)
+                 if e == ax or (isinstance(e, tuple) and ax in e)),
+                None)
+            placements.append(Replicate() if dim is None else Shard(dim))
+        src = DistAttrLite(mesh, [Replicate()] * mesh.ndim)
+        dst = DistAttrLite(mesh, placements)
+        check_reshard(len(shp), src, dst, report, global_shape=shp)
+    if cand.pp > 1:
+        check_pipeline_schedule(schedule, cand.pp, 2 * cand.pp,
+                                report=report)
+    report.emit("error", stacklevel=3)
+    return report
+
+
+def plan_program(ctx_or_view, world: Optional[int] = None, *,
+                 budget: Optional[int] = None, optimizer: str = "adam",
+                 hw: Optional[Dict] = None, shard_params: bool = True,
+                 policies: Optional[Sequence[Dict]] = None,
+                 validate: bool = True) -> PlanReport:
+    """Whole-program static auto-parallelism plan for one recorded
+    segment: enumerate every dp×mp×pp factorization of `world` (plus
+    donation/remat policy toggles), score each against the sharding /
+    liveness / FLOP planes, rank, and validate the winner through the
+    sanitizer's distributed checkers (error mode) before reporting.
+
+    `world` defaults to the ambient mesh size (or the jax device
+    count); `budget` to `FLAGS_memory_budget_bytes` (0 turns the
+    memory gate informational). Returns a :class:`PlanReport`; a
+    refused winner raises `StaticCheckError`."""
+    from .._core import flags, lazy
+    from .segment_checks import SegmentView
+    t0 = time.perf_counter()
+    view = ctx_or_view if isinstance(ctx_or_view, SegmentView) \
+        else SegmentView.from_context(ctx_or_view, donate=())
+    if world is None:
+        spmd = lazy.SPMD
+        if spmd is not None and getattr(spmd, "shape", None):
+            world = int(np.prod(spmd.shape))
+        else:
+            import jax
+            world = jax.device_count()
+    if budget is None:
+        budget = int(flags.flag_value("FLAGS_memory_budget_bytes"))
+    train = bool(view.needs_grad) or any(m[0] for m in view.in_meta)
+    rep = PlanReport(world, budget, len(view.pending))
+    if policies is None:
+        policies = ({"remat": False, "donate": False},
+                    {"remat": False, "donate": True},
+                    {"remat": True, "donate": False},
+                    {"remat": True, "donate": True})
+    prop_by_shape: Dict[Tuple[int, int, int], Dict] = {}
+    for shape in enumerate_mesh_shapes(world):
+        cache = prop_by_shape.setdefault(tuple(shape), {})
+        for pol in policies:
+            rep.candidates.append(score_candidate(
+                view, shape, remat=bool(pol.get("remat")),
+                donate=bool(pol.get("donate")), budget=budget,
+                optimizer=optimizer, train=train, hw=hw,
+                shard_params=shard_params, report=rep.diagnostics,
+                _prop_cache=cache))
+    rep.rank()
+    best = rep.best()
+    if validate and best is not None:
+        cache = prop_by_shape.get(best.shape) or {}
+        # fresh report: emit("error") must judge (and on findings,
+        # raise for) the WINNER's transitions only, not re-surface
+        # every rejected candidate's accumulated oom_risk notes
+        vrep = validate_plan(view, best, world, prop=cache.get("res"))
+        rep.diagnostics.diagnostics.extend(vrep.diagnostics)
+        rep.validated = True
+    rep.plan_ms = (time.perf_counter() - t0) * 1e3
+    return rep
+
+
+def suggest_shape(view, hbm_bytes_per_device: int,
+                  shapes: Optional[Sequence[Sequence[int]]] = None,
+                  optimizer: str = "adam",
+                  shard_params: bool = True) -> Optional[Tuple[int, ...]]:
+    """`spmd.suggest_mesh_shape`'s ranking backend: score the candidate
+    shapes and return the smallest fitting one — fewest devices first
+    (pod sizing buys no more chips than the program needs), planner
+    score breaking ties. None when nothing fits; a missing budget
+    raises (a vacuous 'everything fits' answer is the OOM this pass
+    exists to prevent)."""
+    from .mem_liveness import DEFAULT_SHAPES
+    from .segment_checks import SegmentView
+    if not hbm_bytes_per_device:
+        raise ValueError(
+            "suggest_shape needs an HBM budget: pass "
+            "hbm_bytes_per_device or set FLAGS_memory_budget_bytes")
+    if not isinstance(view, SegmentView):
+        view = SegmentView.from_context(view, donate=())
+    train = bool(view.needs_grad) or any(m[0] for m in view.in_meta)
+    scored = []
+    for shape in (shapes or DEFAULT_SHAPES):
+        cand = score_candidate(
+            view, shape, budget=int(hbm_bytes_per_device),
+            optimizer=optimizer, train=train,
+            shard_params=shard_params)
+        if cand.feasible:
+            devices = int(np.prod([int(s) for s in shape]))
+            scored.append((devices, cand.score,
+                           cand.breakdown.get("total_pd_bytes", 0),
+                           tuple(int(s) for s in shape)))
+    if not scored:
+        return None
+    return min(scored)[3]

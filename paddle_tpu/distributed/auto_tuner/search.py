@@ -16,6 +16,20 @@ def degree_space(world_size: int) -> List[int]:
     return [d for d in range(1, n + 1) if n % d == 0]
 
 
+def factorizations(world_size: int):
+    """Every ordered (dp, mp, pp) triple whose product is exactly
+    `world_size` — the planner's full mesh-shape space (10 triples for
+    world 8, 18 for world 12), where the cartesian divisor grid plus
+    the product-prune visits the same set with cubic waste."""
+    n = max(int(world_size), 1)
+    out = []
+    for dp in degree_space(n):
+        rem = n // dp
+        for mp in degree_space(rem):
+            out.append((dp, mp, rem // mp))
+    return out
+
+
 class GridSearch:
     """Cartesian product of the tunable axes, pruned by feasibility."""
 

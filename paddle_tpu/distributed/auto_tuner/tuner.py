@@ -6,7 +6,7 @@ from __future__ import annotations
 from typing import Callable, Dict, List, Optional
 
 from .cost_model import estimate_memory, estimate_step_cost
-from .search import GridSearch
+from .search import GridSearch, degree_space
 
 
 class AutoTuner:
@@ -18,8 +18,10 @@ class AutoTuner:
         `max_trials` cost-model candidates are measured and re-ranked."""
         base = dict(model_config)
         base["world_size"] = world_size
-        degrees = [d for d in (1, 2, 4, 8, 16, 32, 64)
-                   if d <= world_size]
+        # every divisor of the world, not a powers-of-two ladder: a
+        # world of 6 or 12 (what rank loss actually produces) must
+        # admit 2x3-shaped configs instead of pruning to nothing
+        degrees = degree_space(world_size)
         self.search = GridSearch(
             tune_space or {"dp_degree": degrees, "mp_degree": degrees,
                            "pp_degree": degrees},

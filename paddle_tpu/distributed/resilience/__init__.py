@@ -23,4 +23,4 @@ from .retry import RetryPolicy  # noqa: F401
 from .elastic import (ElasticStep, plan_shrink,  # noqa: F401
                       shrink_world)
 from .adaptive import (AdaptiveTrainer, MembershipEvent,  # noqa: F401
-                       Replanner, mesh_for_plan)
+                       Replanner, mesh_for_plan, stage_rank_map)

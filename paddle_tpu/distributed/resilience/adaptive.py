@@ -74,26 +74,63 @@ class MembershipEvent:
 
 
 class Replanner:
-    """Survivor-feasible parallel-strategy search over the auto-tuner's
-    cost model.
+    """Survivor-feasible parallel-strategy search: the static planner
+    first, the auto-tuner's analytic formulas as the fallback tier.
 
-    The degree space is the divisors of the survivor count (pruned by
-    the tuner's own feasibility rules: product tiling, head/hidden
-    divisibility, memory fit), so the chosen dp/mp/pp always tiles a
-    realizable survivor mesh — including the flattened case where the
-    survivor count no longer factors the old mesh rank. When nothing
-    in the space survives pruning (e.g. a batch size the survivor
-    count cannot divide), the guaranteed fallback is plain data
-    parallelism over all survivors, counted under
-    `resilience.replan_fallback_plans` with a logged reason."""
+    With a `program_view` (a recorded lazy segment of the actual train
+    step) the whole-program planner (analysis/planner.py) scores every
+    dp×mp×pp factorization of the survivor count against the real
+    propagated comm bytes and liveness footprint, and its validated
+    winner is adopted under the `resilience.replan_planned` counter.
+    Without a view — or when the planner admits nothing feasible — the
+    search drops to the auto-tuner's closed-form cost model over the
+    same divisor degree space (pruned by the tuner's own feasibility
+    rules: product tiling, head/hidden divisibility, memory fit), so
+    the chosen dp/mp/pp always tiles a realizable survivor mesh —
+    including the flattened case where the survivor count no longer
+    factors the old mesh rank. When nothing in EITHER space survives
+    pruning (e.g. a batch size the survivor count cannot divide), the
+    guaranteed fallback is plain data parallelism over all survivors,
+    counted under `resilience.replan_fallback_plans` with a logged
+    reason."""
 
     def __init__(self, model_config: Optional[Dict] = None,
-                 n_params: Optional[int] = None):
+                 n_params: Optional[int] = None,
+                 program_view=None):
         self.model_config = dict(model_config or {})
         if n_params and "n_params" not in self.model_config:
             self.model_config["n_params"] = int(n_params)
+        self.program_view = program_view
+
+    def _replan_planned(self, survivor_count: int) -> Optional[Dict]:
+        """Static-planes tier: rank the survivor factorizations with
+        the whole-program planner. None (not an exception) means the
+        planner had nothing validated-feasible and the tuner tier
+        should decide."""
+        from ...analysis import planner as _planner
+        rep = _planner.plan_program(self.program_view,
+                                    world=survivor_count)
+        if rep.best() is None or not rep.validated:
+            return None
+        plan = dict(self.model_config)
+        plan.update(rep.best_plan())
+        return plan
 
     def replan(self, survivor_count: int) -> Dict:
+        if self.program_view is not None:
+            from ...observability import metrics
+            try:
+                plan = self._replan_planned(survivor_count)
+            except Exception as e:
+                import warnings
+                warnings.warn(
+                    f"adaptive re-plan: static planner failed for "
+                    f"{survivor_count} survivors ({e}); dropping to "
+                    f"the tuner tier", RuntimeWarning, stacklevel=2)
+                plan = None
+            if plan is not None:
+                metrics.inc("resilience.replan_planned")
+                return plan
         from ..auto_tuner.search import degree_space
         from ..auto_tuner.tuner import AutoTuner
         degrees = degree_space(survivor_count)
@@ -117,6 +154,21 @@ class Replanner:
                         dp_degree=survivor_count, mp_degree=1,
                         pp_degree=1)
             return plan
+
+
+def stage_rank_map(mesh) -> Dict[int, List[int]]:
+    """Pipeline stage index -> sorted process ids hosting it, derived
+    from the mesh's ``pp`` axis. A pp-free (or 1-D) mesh is one stage
+    spanning every rank. Re-derived on every adopted re-plan so the
+    stage assignment always reflects the SURVIVOR mesh, not the
+    pre-failure rank numbering."""
+    if "pp" not in mesh.dim_names:
+        return {0: sorted(int(p) for p in mesh.process_ids)}
+    axis = mesh.dim_names.index("pp")
+    arr = np.moveaxis(np.asarray(mesh.mesh), axis, 0)
+    arr = arr.reshape(arr.shape[0], -1)
+    return {s: sorted(int(r) for r in arr[s])
+            for s in range(arr.shape[0])}
 
 
 def mesh_for_plan(process_ids: Sequence[int], plan: Dict):
@@ -170,6 +222,7 @@ class AdaptiveTrainer:
 
     def __init__(self, optimizer=None, parameters: Sequence = None, *,
                  mesh=None, model_config: Optional[Dict] = None,
+                 program_view=None,
                  manager=None,
                  lost_ranks: Union[Sequence[int], Callable, None] = None,
                  pipeline: Optional[tuple] = None,
@@ -189,7 +242,8 @@ class AdaptiveTrainer:
             mesh = get_mesh()
         self.mesh = mesh
         self._replanner = Replanner(
-            model_config, n_params=self._count_params())
+            model_config, n_params=self._count_params(),
+            program_view=program_view)
         self._manager = manager
         self._members: List = []
         self._last_epoch = 0
@@ -206,6 +260,10 @@ class AdaptiveTrainer:
         self._ckpt_every = int(checkpoint_every)
         self.replans = 0
         self.last_plan: Optional[Dict] = None
+        # stage index -> sorted survivor ranks hosting it, rebuilt from
+        # the planned mesh's pp axis on every adopted re-plan (a 1-D or
+        # pp-free mesh is one stage spanning every survivor)
+        self.last_stage_map: Optional[Dict[int, List[int]]] = None
         self.last_event: Optional[MembershipEvent] = None
         self.last_replan_latency_s: Optional[float] = None
         self._replan_t0: Optional[float] = None
@@ -381,6 +439,14 @@ class AdaptiveTrainer:
                     f"re-plan onto")
             plan = self._replanner.replan(len(survivors))
             new_mesh = mesh_for_plan(survivors, plan)
+            pipeline = self._pipeline
+            if pipeline is None and "pp" in new_mesh.dim_names:
+                # a planner-chosen pp axis must pass the pipeline-
+                # schedule checker before adoption even when this
+                # trainer was never configured with a pipeline: gate
+                # the canonical 1F1B schedule at the planner's
+                # micro-batch depth (2·pp)
+                pipeline = ("1F1B", 2 * new_mesh.get_dim_size("pp"))
             state = {(p.name or f"p{i}"): p
                      for i, p in enumerate(self._params)}
             from ...analysis.diagnostics import StaticCheckError
@@ -390,7 +456,7 @@ class AdaptiveTrainer:
                 # state through the reshard registry
                 shrink_world(self.mesh, lost, state,
                              optimizer=self._opt,
-                             pipeline=self._pipeline,
+                             pipeline=pipeline,
                              target_mesh=new_mesh)
             except StaticCheckError:
                 # the sanitizer REFUSED the plan itself — reloading a
@@ -409,6 +475,7 @@ class AdaptiveTrainer:
             old_mesh = self.mesh
             self.mesh = new_mesh
             self.last_plan = plan
+            self.last_stage_map = stage_rank_map(new_mesh)
             self.replans += 1
             metrics.inc("resilience.replans")
             from .. import spmd as _spmd

@@ -421,7 +421,12 @@ def shrink_world(mesh, lost_process_ids: Sequence[int],
     if pipeline is not None:
         schedule, num_micro = pipeline[0], pipeline[1]
         num_chunks = pipeline[2] if len(pipeline) > 2 else 1
-        pipe_cfg = (schedule, new_mesh.size, num_micro, num_chunks)
+        # a planned mesh carries its pipeline depth on the pp axis —
+        # only a pipeline-flat (1-D) survivor mesh treats every rank
+        # as a stage
+        pp_size = new_mesh.get_dim_size("pp") \
+            if "pp" in new_mesh.dim_names else new_mesh.size
+        pipe_cfg = (schedule, pp_size, num_micro, num_chunks)
     from ...analysis import hooks as _sanitizer
     _sanitizer.on_world_shrink(transitions, pipe_cfg)
 

@@ -348,17 +348,22 @@ def suggest_mesh_shape(view, hbm_bytes_per_device: Optional[int] = None,
                        shapes=None, optimizer: str = "adam",
                        shard_params: bool = True
                        ) -> Optional[Tuple[int, ...]]:
-    """Plan a dp×mp(×pp) POD SHAPE from the static mem-liveness pass —
+    """Plan a dp×mp(×pp) POD SHAPE from the static analysis planes —
     the smallest candidate shape whose predicted per-device train-step
     footprint fits the HBM budget, computed without compiling or
-    touching devices (`analysis.plan_pod_shape` with the standard
-    batch-on-dp / params-on-mp assumptions). None when nothing in the
-    candidate sweep fits; `view` is the recorded forward+loss
-    program."""
+    touching devices. The ranking is the auto-parallelism planner's
+    (`analysis.planner.suggest_shape`): fewest devices first, the
+    planner's comm+compute score breaking ties among equal-size
+    fitting shapes. None when nothing in the candidate sweep fits;
+    `view` is the recorded forward+loss program."""
     from .._core.flags import flag_value
-    from ..analysis import mem_liveness as _ml
+    from ..analysis import planner as _planner
     if hbm_bytes_per_device is None:
         hbm_bytes_per_device = int(flag_value("FLAGS_memory_budget_bytes"))
-    return _ml.plan_pod_shape(view, hbm_bytes_per_device, shapes=shapes,
-                              optimizer=optimizer,
-                              shard_params=shard_params)
+    if not hbm_bytes_per_device:
+        raise ValueError(
+            "suggest_mesh_shape needs an HBM budget: pass "
+            "hbm_bytes_per_device or set FLAGS_memory_budget_bytes")
+    return _planner.suggest_shape(view, hbm_bytes_per_device,
+                                  shapes=shapes, optimizer=optimizer,
+                                  shard_params=shard_params)

@@ -1,0 +1,295 @@
+"""Static auto-parallelism planner (analysis/planner.py): full
+dp×mp×pp factorization search scored against the sharding / liveness /
+FLOP planes, HBM-budget infeasibility with real oom_risk diagnostics,
+winner validation through the reshard + pipeline checkers, and the
+adaptive-replan drill where the planner lands an mp>1 plan the
+closed-form tuner tier cannot see."""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu import analysis
+from paddle_tpu._core import lazy
+from paddle_tpu.analysis import planner
+from paddle_tpu.analysis.diagnostics import StaticCheckError
+from paddle_tpu.distributed.auto_tuner.search import factorizations
+from paddle_tpu.distributed.mesh import ProcessMesh
+from paddle_tpu.distributed.resilience import (AdaptiveTrainer,
+                                               Replanner, shrink_world,
+                                               stage_rank_map)
+from paddle_tpu.observability import metrics
+
+from conftest import with_flag
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _counter(name):
+    return metrics.counter(name).value
+
+
+def _record_view(layers=2, batch=8, seq=32, hidden=64):
+    """The dryrun-sweep program shape (two bias-free Linear(64,64) +
+    cross-entropy over [8, 32, 64]) as a persistent SegmentView."""
+    paddle.seed(0)
+    mods = [nn.Linear(hidden, hidden, bias_attr=False)
+            for _ in range(layers)]
+    model = nn.Sequential(*mods)
+    r = np.random.RandomState(0)
+    x = paddle.to_tensor(r.randn(batch, seq, hidden).astype("float32"))
+    y = paddle.to_tensor(
+        r.randint(0, hidden, (batch, seq)).astype("int64"))
+    with lazy.lazy_guard(max_segment_ops=1 << 30) as ctx:
+        F.cross_entropy(model(x), y)
+        view = analysis.SegmentView.from_context(ctx, donate=())
+        ctx._reset_segment()
+    return view
+
+
+# ------------------------------------------------------- search space
+
+def test_factorizations_cover_all_divisor_triples():
+    """The planner's mesh-shape space is EVERY ordered (dp, mp, pp)
+    triple tiling the world — including the non-power-of-two worlds
+    rank loss produces (6, 12)."""
+    f8 = factorizations(8)
+    assert len(f8) == 10
+    assert all(d * m * p == 8 for d, m, p in f8)
+    f12 = factorizations(12)
+    assert len(f12) == 18
+    assert (2, 3, 2) in f12 and (3, 2, 2) in f12
+    assert (3, 2, 1) in factorizations(6)
+    assert factorizations(1) == [(1, 1, 1)]
+
+
+def test_enumerate_mesh_shapes_matches_factorizations():
+    assert analysis.enumerate_mesh_shapes(12) == factorizations(12)
+
+
+# ------------------------------------------------- scoring / ranking
+
+def test_planner_picks_known_best_on_dryrun_sweep():
+    """World-8 sweep over the dryrun model: dp8 must beat 4x2 and
+    2x2x2 (its comm plane is a scalar loss allreduce; mp pays real
+    activation collectives, pp pays bubble + stage-crossing bytes),
+    and the winner validates clean through the sanitizer."""
+    view = _record_view()
+    rep = analysis.plan_program(view, world=8)
+    best = rep.best()
+    assert best is not None and best.shape == (8, 1, 1)
+    assert rep.validated and rep.plan_ms is not None
+    by = {c.desc: c for c in rep.candidates}
+    assert by["dp4xmp2xpp1"].score > best.score
+    assert by["dp2xmp2xpp2"].score > by["dp4xmp2xpp1"].score
+    # pp candidates price the pipeline: bubble and crossing bytes > 0
+    pp = by["dp2xmp2xpp2"].breakdown
+    assert pp["bubble"] > 0 and pp["pp_comm_bytes"] > 0
+    assert rep.best_plan() == {
+        "world_size": 8, "dp_degree": 8, "mp_degree": 1,
+        "pp_degree": 1, "recompute": False, "donate": False}
+    # pp deeper than the program is structurally infeasible
+    deep = by["dp1xmp1xpp8"]
+    assert not deep.feasible \
+        and any("stages exceed" in r for r in deep.reasons)
+
+
+def test_planner_rejects_over_budget_with_oom_diagnostic():
+    """A budget below dp8's per-device step total knocks every dp8
+    policy out with a real oom_risk diagnostic (not a silent skip),
+    and the winner moves to the 4x2 plane."""
+    view = _record_view()
+    rep = analysis.plan_program(view, world=8, budget=160_000)
+    best = rep.best()
+    assert best is not None and best.shape == (4, 2, 1)
+    dp8 = next(c for c in rep.candidates if c.desc == "dp8xmp1xpp1")
+    assert not dp8.feasible
+    assert any("oom_risk" in r for r in dp8.reasons)
+    d = rep.to_dict()
+    assert d["oom_risk"] > 0, "rejection must ride a real diagnostic"
+    assert rep.validated
+
+
+def test_planner_budget_shrink_is_monotone():
+    """Shrinking the HBM budget can only remove candidates and worsen
+    the optimum — feasible count non-increasing, best score
+    non-decreasing."""
+    view = _record_view()
+    budgets = (400_000, 200_000, 160_000, 140_000)
+    feas, scores = [], []
+    for b in budgets:
+        rep = analysis.plan_program(view, world=8, budget=b,
+                                    validate=False)
+        feas.append(sum(1 for c in rep.candidates if c.feasible))
+        best = rep.best()
+        assert best is not None, f"budget {b} lost every candidate"
+        scores.append(best.score)
+    assert feas == sorted(feas, reverse=True)
+    assert feas[0] > feas[-1], "the sweep never exercised the gate"
+    assert scores == sorted(scores)
+    # starved entirely: no feasible plan, every reason recorded
+    rep = analysis.plan_program(view, world=8, budget=60_000,
+                                validate=False)
+    assert rep.best() is None
+    assert all(not c.feasible for c in rep.candidates)
+
+
+def test_suggest_mesh_shape_delegates_to_planner():
+    """spmd.suggest_mesh_shape now ranks through the planner: the
+    smallest-device shape that fits still wins, and no budget is a
+    hard error."""
+    from paddle_tpu.distributed import spmd
+    view = _record_view()
+    shape = spmd.suggest_mesh_shape(view, 1 << 30,
+                                    shapes=[(1, 1), (4, 2)])
+    assert tuple(shape) == (1, 1)
+    with pytest.raises(ValueError):
+        spmd.suggest_mesh_shape(view, 0)
+
+
+# ------------------------------------------------- winner validation
+
+def test_validate_plan_runs_reshard_and_pipeline_checkers():
+    """validate_plan drives replicated -> planned placements through
+    reshard_placement and (pp > 1) the pipeline-schedule simulation,
+    in unconditional error mode, under the sanitizer.plan_sweeps
+    counter."""
+    view = _record_view()
+    cand = planner.score_candidate(view, (2, 1, 2))
+    assert cand.feasible
+    sweeps = _counter("sanitizer.plan_sweeps")
+    rep = planner.validate_plan(view, cand, world=4)
+    assert _counter("sanitizer.plan_sweeps") == sweeps + 1
+    assert rep.ok, rep.render()
+
+
+# ------------------------------------------ replan stage-map rebuild
+
+def test_stage_rank_map_from_pp_axis():
+    mesh = ProcessMesh(np.arange(6).reshape(3, 2), ["dp", "pp"])
+    assert stage_rank_map(mesh) == {0: [0, 2, 4], 1: [1, 3, 5]}
+    flat = ProcessMesh(np.arange(6), ["dp"])
+    assert stage_rank_map(flat) == {0: [0, 1, 2, 3, 4, 5]}
+
+
+def test_shrink_world_planned_pp_axis_sets_pipeline_depth():
+    """The pipeline re-validation on a planned mesh must read the pp
+    AXIS, not the whole survivor count: VPP with 4 micro-batches is
+    valid on the planned 3x2 (dp,pp) mesh (2 stages) but impossible
+    when every survivor is miscounted as a stage (4 % 6 != 0)."""
+    mesh = ProcessMesh(np.arange(8), ["dp"])
+    target = ProcessMesh(np.arange(6).reshape(3, 2), ["dp", "pp"])
+    out = shrink_world(mesh, [6, 7], None, pipeline=("VPP", 4, 2),
+                       target_mesh=target, set_global=False)
+    assert out is target
+    # pipeline-flat survivor mesh: every rank IS a stage, and the same
+    # schedule config is rightly refused
+    flat = ProcessMesh(np.arange(6), ["dp"])
+    with pytest.raises(StaticCheckError):
+        shrink_world(mesh, [6, 7], None, pipeline=("VPP", 4, 2),
+                     target_mesh=flat, set_global=False)
+
+
+# ------------------------------------------------ the adaptive drill
+
+def test_replan_drill_adopts_planner_mp_plan():
+    """The acceptance drill: an 8 -> 6 membership change on a program
+    the closed-form tuner tier can only describe as pure dp (no heads
+    to split, one layer) — the planner propagates through the REAL op
+    graph, lands dp3 x mp2, the sanitizer validates it, the fused step
+    recompiles exactly once, and losses stay bit-consistent with the
+    fault-free reference."""
+    cfg = {"num_heads": 1, "num_layers": 1, "global_batch_size": 12}
+    # tuner tier alone: divisibility pruning forces mp = pp = 1
+    tplan = Replanner(cfg).replan(6)
+    assert tplan["dp_degree"] == 6
+    assert tplan["mp_degree"] == 1 and tplan["pp_degree"] == 1
+
+    def _steps(model, opt, x, y, n):
+        out = []
+        for _ in range(n):
+            loss = F.cross_entropy(model(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            out.append(float(loss))
+        return out
+
+    def _setup():
+        paddle.seed(0)
+        model = nn.Linear(64, 64, bias_attr=False)
+        opt = paddle.optimizer.Adam(1e-3,
+                                    parameters=model.parameters())
+        r = np.random.RandomState(0)
+        x = paddle.to_tensor(r.randn(12, 64).astype(np.float32))
+        y = paddle.to_tensor(r.randint(0, 64, (12,)).astype(np.int64))
+        return model, opt, x, y
+
+    model, opt, x, y = _setup()
+    ref = _steps(model, opt, x, y, 5)
+
+    mesh = dist.auto_mesh(8, dim_names=["dp"])
+    dist.set_mesh(mesh)
+    try:
+        model, opt, x, y = _setup()
+        dist.shard_layer(model, mesh)
+        with lazy.lazy_guard(max_segment_ops=1 << 30) as ctx:
+            F.cross_entropy(model(x), y)
+            view = analysis.SegmentView.from_context(ctx, donate=())
+            ctx._reset_segment()
+        trainer = AdaptiveTrainer(optimizer=opt, mesh=mesh,
+                                  model_config=cfg, program_view=view,
+                                  lost_ranks=[6, 7])
+        planned = _counter("resilience.replan_planned")
+        fallbacks = _counter("resilience.replan_fallback_plans")
+        def step():
+            return _steps(model, opt, x, y, 1)[0]
+
+        with with_flag("FLAGS_observability", True):
+            losses = [trainer.run(step)]
+            compiles = _counter("compiles.fused_step")
+            with with_flag("FLAGS_fault_inject", "member::leave@1=die"):
+                losses += [trainer.run(step) for _ in range(4)]
+            # mesh-epoch re-key: ONE recompile at the first
+            # post-replan step, cache hits after
+            assert _counter("compiles.fused_step") == compiles + 1
+        np.testing.assert_allclose(losses, ref, rtol=1e-5)
+        plan = trainer.last_plan
+        assert plan["dp_degree"] == 3 and plan["mp_degree"] == 2
+        assert trainer.mesh.dim_names == ["dp", "mp"]
+        assert trainer.mesh.shape == [3, 2]
+        assert trainer.last_stage_map == {0: [0, 1, 2, 3, 4, 5]}
+        assert _counter("resilience.replan_planned") == planned + 1
+        assert _counter("resilience.replan_fallback_plans") == fallbacks
+        for p in model.parameters():
+            assert p._dist_attr.process_mesh is trainer.mesh
+        trainer.shutdown()
+    finally:
+        dist.set_mesh(None)
+
+
+# ----------------------------------------------------------- the CLI
+
+@pytest.mark.slow
+def test_plan_cli_json():
+    """`python -m paddle_tpu.analysis --plan --json` plans the dryrun
+    sweep model end to end in a subprocess: dp8 wins, validated, rc 0,
+    zero findings on the winner."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.analysis", "--plan",
+         "--json", "--world", "8"],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=600)
+    lines = [ln for ln in out.stdout.splitlines()
+             if ln.startswith("{")]
+    assert out.returncode == 0, out.stderr[-2000:]
+    payload = json.loads(lines[-1])
+    assert payload["best"]["shape"] == [8, 1, 1]
+    assert payload["validated"] and payload["winner_findings"] == 0
