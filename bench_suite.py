@@ -167,6 +167,17 @@ Row 19 auto-parallel planner gate   `--plan --json` subprocess ranks
                                 latency rides --diff as a ms row
                                 (down-good)
 
+Row 20 live monitoring plane   asserts the monitor-off path (WITH
+                                async flush on) freezes every registry
+                                counter, runs NO sampler thread and
+                                binds NO port; reports the monitor-on
+                                sampling overhead us/step on the 64-op
+                                chain under ElasticStep (step hook +
+                                sampler contention, down-good in
+                                --diff) and the /metrics scrape
+                                latency ms/scrape from the stdlib
+                                exporter (down-good)
+
 (Multi-chip GPT/ERNIE hybrids need a pod; their single-chip proxies are
 bench.py's headline + the dryrun_multichip compile check.)
 
@@ -1875,6 +1886,105 @@ def bench_plan():
 
 # ------------------------------------------------------------- diff mode
 
+def bench_monitor():
+    """Row 20: live monitoring plane. With FLAGS_monitor off (and the
+    async flush pipeline on — the hardest freeze regime) the plane must
+    be exactly free: frozen registry MUTATIONS across the workload, no
+    sampler thread, no bound port (the rows 6/10/11 gate pattern). The
+    reported value is monitor-on sampling overhead us/step on the
+    64-op chain driven through ElasticStep (so the step hook is on the
+    measured path), min-of-interleaved-rounds; the nested row is the
+    /metrics scrape latency of the stdlib exporter."""
+    import sys
+    import time as _time
+    import urllib.request
+
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.resilience import ElasticStep
+    from paddle_tpu.observability import metrics
+
+    x = paddle.to_tensor(np.ones((16, 16), "float32"))
+    chain = 32                      # 64 ops: mul + add per iteration
+    w = paddle.to_tensor(np.zeros((4, 4), "float32"))
+    opt = paddle.optimizer.SGD(0.0, parameters=[w])
+    elastic = ElasticStep(optimizer=opt)
+
+    def run():
+        def step():
+            y = x
+            for _ in range(chain):
+                y = y * 1.0001 + 0.0001
+            return y._value
+        return elastic.run(step)
+
+    # ---- off-freeze: monitor off + async flush on does ZERO work
+    paddle.set_flags({"FLAGS_monitor": False, "FLAGS_async_flush": True})
+    try:
+        _timeit(run, steps=20, warmup=10)   # prime compile/cache
+        from paddle_tpu._core import async_flush
+        async_flush.drain()
+        before = metrics.MUTATIONS
+        _timeit(run, steps=50, warmup=0)
+        async_flush.drain()
+        assert metrics.MUTATIONS == before, \
+            "FLAGS_monitor=off did registry work (must be 0)"
+        ts = sys.modules.get("paddle_tpu.observability.timeseries")
+        assert ts is None or not ts.sampler_alive(), \
+            "FLAGS_monitor=off left a sampler thread running"
+        from paddle_tpu.observability import exporter
+        assert exporter.bound_port() is None, \
+            "FLAGS_monitor=off left the exporter port bound"
+    finally:
+        paddle.set_flags({"FLAGS_async_flush": False})
+
+    # ---- sampling overhead: interleaved off/on rounds
+    def timed(on):
+        paddle.set_flags({"FLAGS_monitor": on,
+                          "FLAGS_monitor_interval_s": 0.05,
+                          "FLAGS_monitor_port": 0})
+        try:
+            return _timeit(run, steps=100, warmup=10)
+        finally:
+            paddle.set_flags({"FLAGS_monitor": False})
+
+    rounds = [(timed(False), timed(True)) for _ in range(5)]
+    off = min(r[0] for r in rounds)
+    on = min(r[1] for r in rounds)
+    overhead_us = (on - off) * 1e6
+
+    # ---- /metrics scrape latency (ephemeral loopback port)
+    from paddle_tpu.observability import exporter, timeseries
+    paddle.set_flags({"FLAGS_monitor": True,
+                      "FLAGS_monitor_interval_s": 0.05,
+                      "FLAGS_monitor_port": 0})
+    try:
+        port = exporter.start(0)
+        for _ in range(10):
+            run()
+        timeseries.sample_once({})
+        url = f"http://127.0.0.1:{port}/metrics"
+        body = urllib.request.urlopen(url, timeout=10).read()  # warm
+        assert b"# TYPE" in body, "scrape returned no typed metrics"
+        t0 = _time.perf_counter()
+        n = 20
+        for _ in range(n):
+            urllib.request.urlopen(url, timeout=10).read()
+        scrape_ms = (_time.perf_counter() - t0) / n * 1e3
+    finally:
+        paddle.set_flags({"FLAGS_monitor": False})
+
+    return {"metric": f"monitor sampling overhead ({chain * 2}-op "
+                      f"chain under ElasticStep; off = 0 mutations / "
+                      f"no thread / no port asserted)",
+            "value": round(overhead_us, 2),
+            "unit": "us/step sampling overhead",
+            "rows": [{"metric": "monitor /metrics scrape latency "
+                                "(stdlib exporter, loopback)",
+                      "value": round(scrape_ms, 2),
+                      "unit": "ms/scrape"}]}
+
+
 def _rows_of(path: str) -> dict:
     """metric -> (value, unit) extracted from one driver BENCH_*.json
     (json lines live in its 'tail' string; the headline row carries
@@ -1916,8 +2026,11 @@ def _lower_is_better(metric: str, unit: str) -> bool:
     # 'goodput %') are up-good: an efficiency drop is exactly the
     # regression those planes gate.
     first = u.split()[0] if u.split() else ""
-    if first.endswith("/op"):
-        # per-op cost (row 17's record-phase us/op legs): down-good
+    if first.endswith("/op") or first.endswith("/step") \
+            or first.endswith("/scrape"):
+        # per-op cost (row 17's record-phase us/op legs), per-step
+        # cost (row 20's sampling overhead) and per-scrape latency
+        # (row 20's exporter leg): down-good
         return True
     if first.endswith("/s") or u.startswith("x ") \
             or first in ("mfu", "gflops", "goodput"):
@@ -2000,7 +2113,8 @@ def main():
         return
     rows = os.environ.get(
         "BENCH_ROWS",
-        "1,2,3,4,5,6,7,8,9,10,11,12,13,14,15,16,17,18,19").split(",")
+        "1,2,3,4,5,6,7,8,9,10,11,12,13,14,15,16,17,18,19,20"
+        ).split(",")
     table = {"1": bench_lenet, "2": bench_resnet50, "3": bench_bert,
              "4": bench_dispatch, "5": bench_static_checks,
              "6": bench_observability, "7": bench_resilience,
@@ -2009,7 +2123,8 @@ def main():
              "12": bench_spmd_multichip, "13": bench_perf_lint,
              "14": bench_compute, "15": bench_mem_lint,
              "16": bench_goodput, "17": bench_record_fastpath,
-             "18": bench_warm_restart, "19": bench_plan}
+             "18": bench_warm_restart, "19": bench_plan,
+             "20": bench_monitor}
     for r in rows:
         r = r.strip()
         out = table[r]()

@@ -550,6 +550,46 @@ define_flag("FLAGS_flight_recorder_capacity", 512,
 define_flag("FLAGS_flight_recorder_dir", "",
             "Directory for flight-record dumps ('' = FLAGS_profiler_dir "
             "or cwd).")
+define_flag("FLAGS_monitor", False,
+            "Live monitoring plane (observability/timeseries.py): a "
+            "daemon sampler records counter rates (steps/s, tokens/s, "
+            "compiles, cache hit rate), byte/census gauges, goodput "
+            "fractions and per-step MFU into bounded per-series rings "
+            "every FLAGS_monitor_interval_s, feeding the /metrics "
+            "exporter and the online regression watchdog. Off = one "
+            "module-level check per step hook, zero registry work, no "
+            "sampler thread, no bound port (bench row 20).")
+define_flag("FLAGS_monitor_interval_s", 1.0,
+            "Monitor sampler period in seconds (each tick appends one "
+            "timestamped sample per series).")
+define_flag("FLAGS_monitor_port", 0,
+            "Monitor HTTP exporter port serving /metrics (Prometheus "
+            "text exposition), /healthz, /snapshot and "
+            "/timeseries?name=. 0 = no HTTP endpoint (sampler rings "
+            "still record for in-process readers).")
+define_flag("FLAGS_monitor_host", "127.0.0.1",
+            "Monitor exporter bind address. Loopback by default — "
+            "bind a routable interface explicitly to let an external "
+            "Prometheus scrape the job.")
+define_flag("FLAGS_monitor_ring", 512,
+            "Monitor per-series ring capacity (samples kept per "
+            "series; at the default 1 s interval ~8.5 min of trend).")
+define_flag("FLAGS_monitor_regression_factor", 1.5,
+            "Online regression watchdog: a headline series (step "
+            "duration, tokens/s, goodput fraction) deviating past "
+            "this factor from its EWMA baseline, sustained for "
+            "FLAGS_monitor_regression_steps consecutive samples, "
+            "counts monitor.regressions and leaves a flight note "
+            "with baseline-vs-current evidence.")
+define_flag("FLAGS_monitor_regression_steps", 5,
+            "Consecutive deviating samples required before the "
+            "regression watchdog fires (debounce against one-off "
+            "recompiles or input stalls).")
+define_flag("FLAGS_monitor_deep_capture_steps", 0,
+            "When > 0, a fired regression arms a one-shot deep "
+            "capture: the profiler (fused_runtime) traces the next K "
+            "steps and the trace is dumped beside the flight ring "
+            "(subject to the same rank-aware retention).")
 
 # ---- model-surface defaults
 define_flag("FLAGS_onnx_opset", 13,

@@ -197,6 +197,13 @@ class ElasticStep:
                         # rank's frame. Off = one module-attr read.
                         from ...observability import distributed as _dtel
                         _dtel.on_step(self.step_index)
+                    if _OBS.MONITOR:
+                        # live monitoring: feed the steps/s ring and
+                        # the armed deep capture (AdaptiveTrainer rides
+                        # through this inner ElasticStep, so one hook
+                        # site covers both). Off = one module-attr read.
+                        from ...observability import timeseries as _mon
+                        _mon.on_step(self.step_index)
                     if detect_t is not None:
                         self.last_recovery_s = \
                             time.perf_counter() - detect_t

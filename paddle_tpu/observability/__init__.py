@@ -86,6 +86,22 @@ def _on_goodput_flag(on):
 _flags.watch_flag("FLAGS_goodput", _on_goodput_flag)
 
 
+def _on_monitor_flag(on):
+    import sys as _sys
+    _state.set_monitor(bool(on))
+    # same laziness discipline as the goodput plane: the timeseries
+    # module (sampler thread + HTTP exporter) is only imported once the
+    # monitor is first turned ON; later flips start/stop in place
+    mod = _sys.modules.get(__name__ + ".timeseries")
+    if on:
+        from . import timeseries as mod
+    if mod is not None:
+        mod._sync(bool(on))
+
+
+_flags.watch_flag("FLAGS_monitor", _on_monitor_flag)
+
+
 def enable(flight_recorder: bool = None):
     """Turn on metrics collection (and optionally the flight recorder)."""
     f = {"FLAGS_observability": True}

@@ -1,10 +1,11 @@
 """CLI: run a small workload with telemetry on and print the stats.
 
-    python -m paddle_tpu.observability [stats|budget|merge]
+    python -m paddle_tpu.observability [stats|budget|merge|top]
         [--model chain|lenet|resnet50|gpt2] [--steps N]
         [--json] [--trace PATH] [--flight] [--async-flush]
         [--distributed] [--nranks N]
         merge <dir>
+        top [--port P | --store DIR] [--interval S] [--count N]
 
 Modes:
 
@@ -34,6 +35,13 @@ Modes:
   dumps (written by TelemetryPublisher.dump) found in <dir> into the
   same step table + overlap report, and write ``merged_trace.json``
   (one chrome-trace lane per rank, clock-rebased) next to them.
+- ``top``: a refreshing terminal table (per-rank step rate, step time,
+  MFU, goodput fraction, peak MB, straggler flag) from either a LIVE
+  monitor endpoint (``--port``/``--host`` — the ``/snapshot`` route of
+  a job running with FLAGS_monitor + FLAGS_monitor_port) or a
+  dumped-frames dir (``--store DIR`` holding ``telem_rank*.json``).
+  ``--interval`` sets the refresh period, ``--count N`` stops after N
+  renders (0 = until interrupted).
 
 `chain` is the dispatch microbench's elementwise chain — fast,
 exercises segment record/flush/cache. `lenet` runs real train steps
@@ -395,6 +403,64 @@ def _merge(args) -> int:
     return 0
 
 
+def _top_once(args) -> str:
+    """One rendered top table (store dir or live endpoint)."""
+    from paddle_tpu.observability import exporter
+
+    if args.store:
+        import glob
+
+        from paddle_tpu.observability import distributed as dtel
+        dumps = sorted(glob.glob(
+            os.path.join(args.store, "telem_rank*.json")))
+        if not dumps:
+            raise FileNotFoundError(
+                f"top: no telem_rank*.json dumps in {args.store}")
+        agg = dtel.TelemetryAggregator()
+        for p in dumps:
+            agg.add_dump(p)
+        return exporter.render_top(exporter.cluster_rows(agg),
+                                   title=args.store)
+    import urllib.request
+    url = f"http://{args.host}:{args.port}/snapshot"
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        snap = json.loads(resp.read().decode("utf-8"))
+    rows = snap.get("cluster_rows")
+    if rows is None:
+        # single-process job: one row from the monitor's newest samples
+        mon = snap.get("monitor", {})
+        s = mon.get("series_latest", {})
+        rows = [{"rank": snap.get("rank", 0),
+                 "steps_per_s": s.get("steps_per_s"),
+                 "step_time_ms": s.get("step_time_ms"),
+                 "mfu": s.get("mfu"),
+                 "goodput_frac": s.get("goodput_frac"),
+                 "peak_bytes": s.get("mem_peak_bytes"),
+                 "straggler_steps": 0}]
+    return exporter.render_top(rows, title=url)
+
+
+def _top(args) -> int:
+    import time as _time
+    n = 0
+    while True:
+        try:
+            text = _top_once(args)
+        except (OSError, FileNotFoundError) as e:
+            print(f"top: {e}", file=sys.stderr)
+            return 2
+        if args.count != 1:
+            sys.stdout.write("\x1b[2J\x1b[H")   # clear + home
+        print(text, flush=True)
+        n += 1
+        if args.count and n >= args.count:
+            return 0
+        try:
+            _time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
+
+
 def _render(snap: dict) -> str:
     lines = ["== paddle_tpu.observability stats =="]
     lines.append(f"  compiles:            {snap['compiles']}")
@@ -435,10 +501,12 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m paddle_tpu.observability")
     ap.add_argument("mode", nargs="?", default="stats",
-                    choices=("stats", "budget", "merge"),
+                    choices=("stats", "budget", "merge", "top"),
                     help="stats = registry snapshot; budget = ranked "
                          "per-step time-budget table; merge = offline "
-                         "aggregation of per-rank telemetry dumps")
+                         "aggregation of per-rank telemetry dumps; "
+                         "top = refreshing per-rank cluster table from "
+                         "a live monitor endpoint or dumped frames")
     ap.add_argument("path", nargs="?", default=None,
                     help="merge mode: directory holding "
                          "telem_rank*.json dumps")
@@ -463,6 +531,19 @@ def main(argv=None) -> int:
     ap.add_argument("--async-flush", action="store_true",
                     help="run with FLAGS_async_flush on (before/after "
                          "budget comparisons from one command)")
+    ap.add_argument("--port", type=int, default=0,
+                    help="top mode: live monitor endpoint port "
+                         "(FLAGS_monitor_port of the running job)")
+    ap.add_argument("--host", default="127.0.0.1",
+                    help="top mode: live monitor endpoint host")
+    ap.add_argument("--store", default=None, metavar="DIR",
+                    help="top mode: render from telem_rank*.json "
+                         "dumps in DIR instead of a live endpoint")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="top mode: refresh period in seconds")
+    ap.add_argument("--count", type=int, default=0,
+                    help="top mode: stop after N renders "
+                         "(0 = until interrupted)")
     ap.add_argument("--static-diff", action="store_true",
                     help="budget mode: reconcile the static perf "
                          "analyzer's predictions (one traced step, "
@@ -474,6 +555,12 @@ def main(argv=None) -> int:
 
     if args.mode == "merge":
         return _merge(args)
+    if args.mode == "top":
+        if not args.store and not args.port:
+            print("top: pass --port (live endpoint) or --store DIR "
+                  "(dumped frames)", file=sys.stderr)
+            return 2
+        return _top(args)
     if args.mode == "budget" and args.distributed:
         return _budget_distributed(args)
 

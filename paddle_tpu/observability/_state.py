@@ -19,6 +19,7 @@ DIST = False      # FLAGS_distributed_telemetry: cross-rank frame plane
 MEM = False       # FLAGS_memory_telemetry: live-buffer census + bytes
 COMPUTE = False   # FLAGS_compute_telemetry: FLOPs accounting + MFU
 GOODPUT = False   # FLAGS_goodput: wall-clock attribution ledger
+MONITOR = False   # FLAGS_monitor: live time-series sampler + exporter
 
 # The single gate hot paths read: any consumer on.
 ACTIVE = False
@@ -27,7 +28,7 @@ ACTIVE = False
 def recompute():
     global ACTIVE
     ACTIVE = METRICS or TRACE or FLIGHT or DIST or MEM or COMPUTE \
-        or GOODPUT
+        or GOODPUT or MONITOR
 
 
 def set_metrics(on: bool):
@@ -69,4 +70,10 @@ def set_compute(on: bool):
 def set_goodput(on: bool):
     global GOODPUT
     GOODPUT = bool(on)
+    recompute()
+
+
+def set_monitor(on: bool):
+    global MONITOR
+    MONITOR = bool(on)
     recompute()

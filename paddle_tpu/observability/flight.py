@@ -111,12 +111,13 @@ def _dump_dir() -> str:
             or flags.flag_value("FLAGS_profiler_dir") or ".")
 
 
-# auto-named dump files eligible for retention pruning: plain dumps
-# and OOM postmortems, tagged (group 1 = rank) or untagged. Distributed
-# postmortem reports (flight_distributed_*) and any explicit-path dump
-# never match, so retention can never eat them.
+# auto-named dump files eligible for retention pruning: plain dumps,
+# OOM postmortems, and monitor deep-capture traces (.json), tagged
+# (group 1 = rank) or untagged. Distributed postmortem reports
+# (flight_distributed_*) and any explicit-path dump never match, so
+# retention can never eat them.
 _PRUNABLE_RE = re.compile(
-    r"^flight_(?:oom_)?(?:r(\d+)_)?\d+_\d+\.txt$")
+    r"^flight_(?:oom_|trace_)?(?:r(\d+)_)?\d+_\d+\.(?:txt|json)$")
 
 
 def _prune_dumps(d: str, rank: Optional[int]):
@@ -185,6 +186,27 @@ def dump(reason: str = "", path: str = None) -> str:
     from . import metrics
     metrics.inc("flight.dumps")
     return path
+
+
+def trace_path() -> str:
+    """Auto-named path for a monitor deep-capture trace, beside the
+    text dumps and under the same rank-aware retention (call
+    prune_dumps() after writing it)."""
+    global _DUMP_SEQ
+    d = _dump_dir()
+    os.makedirs(d, exist_ok=True)
+    with _LOCK:
+        _DUMP_SEQ += 1
+        seq = _DUMP_SEQ
+    rank = _rank()
+    tag = f"r{rank}_" if rank is not None else ""
+    return os.path.join(d, f"flight_trace_{tag}{os.getpid()}_{seq}.json")
+
+
+def prune_dumps():
+    """Public retention hook for callers that write auto-named files
+    without going through dump() (the monitor's deep-capture trace)."""
+    _prune_dumps(_dump_dir(), _rank())
 
 
 def on_error(kind: str, message: str):
