@@ -178,6 +178,20 @@ Row 20 live monitoring plane   asserts the monitor-off path (WITH
                                 latency ms/scrape from the stdlib
                                 exporter (down-good)
 
+Row 21 numerics plane gate   `--numerics --json` subprocess sweeps the
+                                model zoo (lenet/resnet50/bert/gpt2
+                                under bf16 auto_cast + the gpt2 int8
+                                bucket budget) — rc and zero
+                                error-severity findings gate the row,
+                                per-model finding counts ride --diff
+                                with zero tolerance; asserts
+                                checks-off (WITH async flush on)
+                                freezes the sanitizer.diagnostics.
+                                numerics.* counters and the sweep
+                                count across a bf16 workload; reports
+                                warn-mode overhead us/op on the same
+                                chain (down-good)
+
 (Multi-chip GPT/ERNIE hybrids need a pod; their single-chip proxies are
 bench.py's headline + the dryrun_multichip compile check.)
 
@@ -1985,6 +1999,107 @@ def bench_monitor():
                       "unit": "ms/scrape"}]}
 
 
+def bench_numerics():
+    """Row 21: the numerics plane as a mechanical regression gate. The
+    --numerics CLI sweeps the model zoo under bf16 auto_cast in a
+    subprocess (exit code + zero error-severity findings gate the
+    row; per-model finding counts become zero-tolerance diff rows).
+    Off contract asserted exactly (the rows-5..11 counter technique)
+    WITH the async flush pipeline on: across a bf16 matmul+softmax
+    chain — a segment the pre-scan cannot skip — checks-off freezes
+    every sanitizer.diagnostics.numerics.* counter and the sweep
+    count. The reported value is warn-mode overhead us/op (range
+    propagation + the three segment checkers) on the same chain,
+    min-of-interleaved-rounds."""
+    import subprocess
+    import sys
+
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu._core import async_flush
+    from paddle_tpu.analysis import hooks
+    from paddle_tpu.observability import metrics
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.analysis", "--numerics",
+         "--json"],
+        capture_output=True, text=True, env=env, timeout=1800)
+    lines = [ln for ln in out.stdout.splitlines() if ln.startswith("{")]
+    if out.returncode != 0 or not lines:
+        raise RuntimeError(
+            f"analysis --numerics failed rc={out.returncode}: "
+            f"{out.stderr[-2000:]}")
+    payload = json.loads(lines[-1])
+    assert payload["errors"] == 0, \
+        f"numerics zoo sweep found error-severity findings: {payload}"
+
+    # ---- workload with a numerics surface (bf16 outputs force the
+    # propagation; matmul+softmax keeps the lattice bounded -> clean)
+    x = paddle.to_tensor(np.full((16, 16), 1.0 / 16.0, "float32"))
+    chain = 16
+
+    def run():
+        y = x.astype("bfloat16")
+        for _ in range(chain):
+            y = F.softmax(paddle.matmul(y, y))
+        return y.astype("float32")._value
+
+    n_ops = 2 * chain + 2            # casts + (matmul, softmax) * chain
+
+    # ---- off-freeze: checks off + async flush on does ZERO numerics
+    # work (no sweeps, no counters)
+    paddle.set_flags({"FLAGS_static_checks": "off",
+                      "FLAGS_async_flush": True})
+    try:
+        _timeit(run, steps=10, warmup=5)     # prime compile/cache
+        async_flush.drain()
+
+        def _numerics_counters():
+            return {k: v for k, v
+                    in metrics.snapshot()["counters"].items()
+                    if k.startswith("sanitizer.diagnostics.numerics.")}
+
+        before = _numerics_counters()
+        sweeps = hooks.segment_sweeps()
+        _timeit(run, steps=30, warmup=0)
+        async_flush.drain()
+        assert _numerics_counters() == before, \
+            "FLAGS_static_checks=off moved a numerics counter"
+        assert hooks.segment_sweeps() == sweeps, \
+            "FLAGS_static_checks=off ran a sanitizer sweep"
+    finally:
+        paddle.set_flags({"FLAGS_async_flush": False})
+
+    # ---- warn-mode overhead: interleaved off/warn rounds
+    def timed(mode):
+        paddle.set_flags({"FLAGS_static_checks": mode})
+        try:
+            return _timeit(run, steps=50, warmup=10)
+        finally:
+            paddle.set_flags({"FLAGS_static_checks": "off"})
+
+    rounds = [(timed("off"), timed("warn")) for _ in range(5)]
+    off = min(r[0] for r in rounds)
+    on = min(r[1] for r in rounds)
+    overhead_us_op = (on - off) * 1e6 / n_ops
+
+    rows = [
+        {"metric": f"numerics zoo findings ({m})",
+         "value": sum(d.get("findings", 0) for d in ds),
+         "unit": "findings"}
+        for m, ds in sorted(payload["models"].items())
+    ]
+    return {"metric": "numerics plane gate (zoo sweep under bf16 "
+                      "auto_cast + int8 bucket budget; off = frozen "
+                      "numerics counters / no sweeps asserted)",
+            "value": round(overhead_us_op, 3),
+            "unit": "us/op warn-mode overhead",
+            "zoo_findings": payload["findings"],
+            "rows": rows}
+
+
 def _rows_of(path: str) -> dict:
     """metric -> (value, unit) extracted from one driver BENCH_*.json
     (json lines live in its 'tail' string; the headline row carries
@@ -2113,7 +2228,7 @@ def main():
         return
     rows = os.environ.get(
         "BENCH_ROWS",
-        "1,2,3,4,5,6,7,8,9,10,11,12,13,14,15,16,17,18,19,20"
+        "1,2,3,4,5,6,7,8,9,10,11,12,13,14,15,16,17,18,19,20,21"
         ).split(",")
     table = {"1": bench_lenet, "2": bench_resnet50, "3": bench_bert,
              "4": bench_dispatch, "5": bench_static_checks,
@@ -2124,7 +2239,7 @@ def main():
              "14": bench_compute, "15": bench_mem_lint,
              "16": bench_goodput, "17": bench_record_fastpath,
              "18": bench_warm_restart, "19": bench_plan,
-             "20": bench_monitor}
+             "20": bench_monitor, "21": bench_numerics}
     for r in rows:
         r = r.strip()
         out = table[r]()

@@ -177,7 +177,7 @@ def eager_forward(op: OpDef, vals: Tuple, attrs: Dict[str, Any]) -> Tuple:
         jax.block_until_ready(out)
     outs = out if op.multi_output else (out,)
     if flags.flag_value("FLAGS_check_nan_inf"):
-        _check_nan_inf(op.name, outs)
+        _check_nan_inf(op.name, outs, site=True)
     return tuple(outs)
 
 
@@ -220,7 +220,7 @@ def eager_backward(op: OpDef, saved: Tuple, attrs: Dict[str, Any],
     return tuple(out)
 
 
-def _check_nan_inf(name: str, outs):
+def _check_nan_inf(name: str, outs, site: bool = False):
     # Analog of FLAGS_check_nan_inf (paddle/fluid/eager/nan_inf_utils.h:38).
     import jax.numpy as jnp
     for i, o in enumerate(outs):
@@ -228,6 +228,14 @@ def _check_nan_inf(name: str, outs):
             bad = bool(jnp.any(~jnp.isfinite(o)))
             if bad:
                 msg = f"NaN/Inf detected in output {i} of op '{name}'"
+                if site:
+                    # per-op eager scan: the dispatching user frame is
+                    # still on the stack — name the producing file:line
+                    # (trip path only; the clean scan pays nothing)
+                    from ..analysis.hooks import call_site
+                    src = call_site()
+                    if src:
+                        msg += f" @ {src}"
                 if _obs.GOODPUT:
                     # job-health anomaly regardless of the scan's
                     # raise/warn level: the goodput plane's NaN watch

@@ -122,6 +122,20 @@ def flag_value(name: str):
 # Core flags (analogs of the reference's most-used ones).
 define_flag("FLAGS_check_nan_inf", False,
             "Scan op outputs for NaN/Inf after each eager op (debug).")
+
+# Watcher-kept gate (STATIC_CHECKS_ACTIVE pattern): the lazy record
+# path captures per-op source provenance while the NaN scan is armed,
+# so a FloatingPointError names the producing op's file:line even with
+# the sanitizer off.
+NAN_CHECK_ACTIVE = False
+
+
+def _sync_nan_check_gate(value):
+    global NAN_CHECK_ACTIVE
+    NAN_CHECK_ACTIVE = bool(value)
+
+
+watch_flag("FLAGS_check_nan_inf", _sync_nan_check_gate)
 define_flag("FLAGS_call_stack_level", 1,
             "Error message verbosity: 0 brief, 1 python stack, 2 full.")
 define_flag("FLAGS_eager_compile_cache_size", 4096,
@@ -281,6 +295,21 @@ define_flag("FLAGS_dead_capture_min_bytes", 4096,
             "Dead-capture lint floor companion: minimum wasted output "
             "bytes before a dead capture below the FLOPs floor is "
             "still reported.")
+define_flag("FLAGS_numerics_seed_log2max", 4.0,
+            "Numerics plane input range seed: segment inputs are "
+            "assumed bounded by 2^this (|x| <= 16 by default — "
+            "normalized activations/params). The range lattice "
+            "(analysis/numerics.py) propagates from here; raising it "
+            "makes the overflow_risk checker more pessimistic.")
+define_flag("FLAGS_numerics_accum_k", 16384,
+            "accum_dtype lint floor: minimum reduction length K before "
+            "a matmul/reduction accumulating directly into fp16/bf16 "
+            "is flagged (sqrt(K)*eps relative error reaches ~0.5 for "
+            "bf16 at K=16384; 0 flags every low-precision reduction).")
+define_flag("FLAGS_numerics_min_snr_db", 20.0,
+            "quant_error_budget gate: minimum statically-priced "
+            "quantization SNR (dB) per gradient bucket before an "
+            "int8/fp8 collective plan passes pre-flight.")
 define_flag("FLAGS_sharding_replicated_min_bytes", 1 << 20,
             "Sharding perf lint (analysis/sharding_prop.py): minimum "
             "redundant bytes (tensor size x (mesh size - 1)) before a "

@@ -440,6 +440,33 @@ def _obs_flush_failed(reason: str, err: BaseException):
         flight.on_error("flush_failed", f"reason={reason}: {err!r}")
 
 
+def _nan_scan_segment(pending, live, out_vals, kind, in_vals=(),
+                      extra=None):
+    """FLAGS_check_nan_inf sweep over a flushed/replayed segment's live
+    outputs, blaming the producing op WITH its record-time file:line
+    provenance (_PendingOp.src, captured while checks are on) — a
+    postmortem must name where the tripping value was recorded, not
+    just which kernel emitted it. On a trip, the numerics plane's NaN
+    forensics re-runs the range propagation over the offending program
+    and attaches the ranked suspect ops to the flight dump before the
+    FloatingPointError continues up. `extra` is an optional
+    (label, values) pair swept after the live outputs (the fused
+    backward's gradient bundle)."""
+    try:
+        for (j, _s), val in zip(live, out_vals):
+            p = pending[j]
+            src = getattr(p, "src", None)
+            dispatch._check_nan_inf(
+                f"{p.op.name} ({kind}" + (f" @ {src})" if src else ")"),
+                (val,))
+        if extra is not None:
+            dispatch._check_nan_inf(extra[0], tuple(extra[1]))
+    except FloatingPointError:
+        from ..analysis import hooks as _ahooks
+        _ahooks.on_nan_trip(None, pending, list(in_vals), kind)
+        raise
+
+
 def _oom_convert(e: BaseException, where: str, mem_info=None):
     """RESOURCE_EXHAUSTED at an execute site becomes the typed
     ``base.core.ResourceExhaustedError`` carrying the memory
@@ -1175,11 +1202,11 @@ class CaptureContext:
             for t in new_ext:
                 self._input_index(t)
         src = None
-        if PERF_SRC or _OBS.COMPUTE:
-            # provenance demanded (perf trace / compute plane): the
-            # fast path still skips aval work but captures the source
-            # line per op — diagnostics and named_scope provenance must
-            # not degrade under replay
+        if PERF_SRC or _OBS.COMPUTE or _flags.NAN_CHECK_ACTIVE:
+            # provenance demanded (perf trace / compute plane / armed
+            # NaN scan): the fast path still skips aval work but
+            # captures the source line per op — diagnostics and
+            # named_scope provenance must not degrade under replay
             from ..analysis.hooks import call_site
             src = call_site()
         op_idx = len(self.pending)
@@ -1347,13 +1374,14 @@ class CaptureContext:
                     from ..analysis import alias_graph as _ag
                     for _out in outs:
                         _ag.note_view(_out, base, op.name, src)
-        elif PERF_SRC or _OBS.COMPUTE:
-            # perf tracing AND the compute telemetry plane force
-            # provenance capture even with the sanitizer off (no
-            # alias-graph work — that is the correctness sanitizer's
-            # job): perf diagnostics need the line, and the compute
-            # plane bakes it into each op's named_scope so device
-            # profiles group by paddle source
+        elif PERF_SRC or _OBS.COMPUTE or _flags.NAN_CHECK_ACTIVE:
+            # perf tracing, the compute telemetry plane AND an armed
+            # NaN scan force provenance capture even with the sanitizer
+            # off (no alias-graph work — that is the correctness
+            # sanitizer's job): perf diagnostics need the line, the
+            # compute plane bakes it into each op's named_scope so
+            # device profiles group by paddle source, and a NaN trip
+            # must name the producing op's file:line in its message
             from ..analysis.hooks import call_site
             src = call_site()
         self.pending.append(_PendingOp(op, dict(attrs), wiring, out_refs,
@@ -1730,10 +1758,8 @@ class CaptureContext:
             # recorded before the flag flipped on, nor replayed
             # segments): scan every live output, blaming its producer
             if flags.flag_value("FLAGS_check_nan_inf"):
-                for (j, _s), val in zip(live, out_vals):
-                    dispatch._check_nan_inf(
-                        f"{pending[j].op.name} (lazy segment output)",
-                        (val,))
+                _nan_scan_segment(pending, live, out_vals,
+                                  "lazy segment output", in_vals)
 
             self._register_grad(pending, live, live_refs, out_tensors,
                                 in_tensors, in_vals, sig, in_meta)
@@ -1886,10 +1912,8 @@ class CaptureContext:
                         pending, live, out_vals, sig,
                         mesh=spmd.desc if spmd is not None else None)
                 if nan_check:
-                    for (j, _s), val in zip(live, out_vals):
-                        dispatch._check_nan_inf(
-                            f"{pending[j].op.name} (lazy segment "
-                            f"output)", (val,))
+                    _nan_scan_segment(pending, live, out_vals,
+                                      "lazy segment output", in_vals)
                 for ref, val in zip(live_refs, out_vals):
                     pv = pvmap.pop(id(ref), None)
                     if pv is not None:
@@ -2483,10 +2507,8 @@ class ReplayableSegment:
             xspan.end()
         from . import flags
         if flags.flag_value("FLAGS_check_nan_inf"):
-            for (j, _s), val in zip(self.live, out_vals):
-                dispatch._check_nan_inf(
-                    f"{self.pending[j].op.name} (replayed segment output)",
-                    (val,))
+            _nan_scan_segment(self.pending, self.live, out_vals,
+                              "replayed segment output", in_vals)
         if _OBS.COMPUTE:
             from ..observability import compute as _comptel
             _comptel.count_cached(_SEG_CACHE, (self.sig, ()), "segment")
@@ -2774,10 +2796,9 @@ def try_fused_backward(tensors, grad_tensors) -> bool:
 
     if flags.flag_value("FLAGS_check_nan_inf"):
         try:
-            for (j, _s), val in zip(live, out_vals):
-                dispatch._check_nan_inf(
-                    f"{pending[j].op.name} (fused-step output)", (val,))
-            dispatch._check_nan_inf("fused-step gradients", tuple(grads))
+            _nan_scan_segment(pending, live, out_vals,
+                              "fused-step output", in_vals,
+                              extra=("fused-step gradients", grads))
         except Exception as e:
             # a NaN trip drops the consumed trace like a failed compile
             # (leaving it armed would re-execute the whole forward as a

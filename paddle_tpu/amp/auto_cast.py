@@ -51,12 +51,15 @@ def _hook(op_name, tensors):
     if level == "O0":
         return tensors
     low = dm.to_np(target)
+    # dtype reads go through _meta_aval(): the recorded aval answers
+    # without materializing, so an amp decision inside a lazy fusion
+    # window does not force the segment to flush (._value would)
     if op_name in WHITE_LIST:
         out = []
         for t in tensors:
-            if t is not None and jnp.issubdtype(t._value.dtype,
+            if t is not None and jnp.issubdtype(t._meta_aval().dtype,
                                                 jnp.floating) and \
-                    t._value.dtype != low:
+                    t._meta_aval().dtype != low:
                 from ..ops.manipulation import cast
                 t = cast(t, target)
             out.append(t)
@@ -64,8 +67,8 @@ def _hook(op_name, tensors):
     if op_name in BLACK_LIST:
         out = []
         for t in tensors:
-            if t is not None and t._value.dtype in (jnp.bfloat16,
-                                                    jnp.float16):
+            if t is not None and t._meta_aval().dtype in (jnp.bfloat16,
+                                                          jnp.float16):
                 from ..ops.manipulation import cast
                 t = cast(t, "float32")
             out.append(t)

@@ -13,6 +13,16 @@ from .._core.autograd import no_grad
 from .._core.tensor import Tensor
 
 
+def _note(kind: str, **detail):
+    """Record an AMP-bookkeeping event for the numerics plane's
+    scaler_flow checker — only while the sanitizer is on, so unchecked
+    training pays one module-attribute read per scaler call."""
+    from .._core import flags
+    if flags.STATIC_CHECKS_ACTIVE:
+        from ..analysis import numerics
+        numerics.note_scaler_event(kind, **detail)
+
+
 class GradScaler:
     def __init__(self, enable=True, init_loss_scaling=None,
                  incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=None,
@@ -41,12 +51,14 @@ class GradScaler:
     def scale(self, var):
         if not self._enable:
             return var
+        _note("scale", factor=self._scale)
         return var * self._scale
 
     @no_grad()
     def unscale_(self, optimizer):
         if not self._enable:
             return
+        _note("unscale")
         inv = 1.0 / self._scale
         found_inf = False
         for p, _ in optimizer._all_params():

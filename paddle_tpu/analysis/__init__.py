@@ -8,15 +8,17 @@ framework produces:
 - the SOT guarded fast-path cache (jit/sot)
 - distributed lowerings (reshard transitions, pipeline schedules)
 
-Eleven checkers ship: the per-program five (donation safety, in-place
-races, tracer leaks, shape/dtype drift, IR pass effect/purity) plus the
+Sixteen checkers ship: the per-program five (donation safety, in-place
+races, tracer leaks, shape/dtype drift, IR pass effect/purity), the
 cross-program wave — cross-segment donation (buffer identity threaded
 across the fused fwd+vjp+optimizer step-cache boundary), view alias
 graphs (a view of a donated/mutated base, even segments later), dead
 captures (recorded ops nobody can observe, with the wasted FLOPs/bytes),
 SOT guard soundness (never-firing and shadowed cache entries), reshard
 placement validation, and pipeline-schedule deadlock/ordering
-simulation. Surfaces:
+simulation — plus the numerics plane (numerics.py): abstract dtype +
+dynamic-range interpretation feeding overflow_risk, accum_dtype,
+cast_churn (fixable), scaler_flow and quant_error_budget. Surfaces:
 
 - `FLAGS_static_checks` = off | warn | error | fix, wired into
   `CaptureContext.flush`, `try_fused_backward`, `PassManager.run`,
@@ -58,8 +60,14 @@ from .mem_liveness import (CandidateMesh, analyze_liveness,
                            step_footprint, sweep_pod_shapes)
 from .planner import (PlanCandidate, PlanReport, enumerate_mesh_shapes,
                       plan_program, score_candidate, validate_plan)
+from .numerics import (check_accum_dtype, check_cast_churn,
+                       check_numerics_segment, check_overflow_risk,
+                       check_quant_budget, check_scaler_flow,
+                       nan_suspects, propagate_ranges, quant_bucket_plan,
+                       quant_snr_db)
 from . import alias_graph, dataflow, distributed_checks, fixes, hooks, \
-    mem_liveness, perf_checks, planner, sharding_prop, sot_checks
+    mem_liveness, numerics, perf_checks, planner, sharding_prop, \
+    sot_checks
 
 __all__ = [
     "CheckReport", "Diagnostic", "StaticCheckError",
@@ -74,6 +82,10 @@ __all__ = [
     "sweep_pod_shapes", "plan_pod_shape", "CandidateMesh",
     "plan_program", "score_candidate", "validate_plan",
     "enumerate_mesh_shapes", "PlanReport", "PlanCandidate",
+    "check_numerics_segment", "check_overflow_risk",
+    "check_accum_dtype", "check_cast_churn", "check_scaler_flow",
+    "check_quant_budget", "quant_bucket_plan", "quant_snr_db",
+    "propagate_ranges", "nan_suspects",
 ]
 
 

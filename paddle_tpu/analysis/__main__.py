@@ -706,6 +706,161 @@ def _plan_main(args) -> int:
                  and winner_findings == 0) else 1
 
 
+def _numerics_trace(build_fn, name: str, verbose: bool):
+    """Record one model forward(+loss) under amp auto_cast O1 into a
+    single capture window (the _meta_aval-based amp hook keeps the
+    whole trace in one segment) and run the numerics plane over it:
+    range propagation + overflow_risk / accum_dtype / cast_churn."""
+    import paddle_tpu as paddle
+    from paddle_tpu import analysis
+    from paddle_tpu._core import lazy
+
+    with paddle.amp.auto_cast(level="O1", dtype="bfloat16"):
+        with lazy.lazy_guard(max_segment_ops=1 << 30) as ctx:
+            out = build_fn()   # noqa: F841 (root held through the sweep)
+            view = analysis.SegmentView.from_context(ctx)
+            n_ops = len(ctx.pending)
+            report = analysis.CheckReport(
+                f"{name} numerics ({n_ops} ops under auto_cast O1)")
+            analysis.check_numerics_segment(view, report)
+            ctx._reset_segment()
+    low = sum(1 for p in view.pending for r in p.out_refs
+              if str(r.aval.dtype) in ("bfloat16", "float16"))
+    print(f"[{name}] numerics: {n_ops} ops recorded under auto_cast "
+          f"O1 (bf16), {low} low-precision output(s), "
+          f"{len(report.diagnostics)} finding(s)")
+    if verbose or not report.ok:
+        for d in report.diagnostics:
+            print("   ", d.render())
+    _note(name, report)
+    return report
+
+
+def numerics_lenet(verbose: bool):
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.vision.models import LeNet
+
+    paddle.seed(0)
+    model = LeNet()
+    r = np.random.RandomState(0)
+    x = paddle.to_tensor(r.randn(8, 1, 28, 28).astype("float32"))
+    y = paddle.to_tensor(r.randint(0, 10, (8,)).astype("int64"))
+    return [_numerics_trace(lambda: F.cross_entropy(model(x), y),
+                            "lenet", verbose)]
+
+
+def numerics_resnet50(verbose: bool):
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.vision.models import resnet50
+
+    paddle.seed(0)
+    model = resnet50()
+    model.eval()
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(2, 3, 64, 64).astype("float32"))
+    return [_numerics_trace(lambda: model(x).mean(), "resnet50",
+                            verbose)]
+
+
+def numerics_bert(verbose: bool):
+    """The bench bert trainer is pure jax (no framework segments); the
+    numerics subject is the attention arithmetic the amp rules govern —
+    scaled q@k^T, softmax, the value matmul."""
+    import numpy as np
+    import paddle_tpu as paddle
+
+    def attn_proxy():
+        r = np.random.RandomState(0)
+        q = paddle.to_tensor(r.randn(2, 8, 32).astype("float32"))
+        k = paddle.to_tensor(r.randn(2, 8, 32).astype("float32"))
+        v = paddle.to_tensor(r.randn(2, 8, 32).astype("float32"))
+        s = paddle.matmul(q, k.transpose([0, 2, 1])) * (32 ** -0.5)
+        a = paddle.nn.functional.softmax(s, axis=-1)
+        return paddle.matmul(a, v).sum()
+
+    return [_numerics_trace(attn_proxy, "bert", verbose)]
+
+
+def numerics_gpt2(verbose: bool):
+    """Miniature eager GPT under auto_cast — the AMP headline shape —
+    plus the quant_error_budget pre-flight over its parameter buckets
+    (per-bucket int8 scaling, the EQuARX gate)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import analysis
+    from paddle_tpu.models.gpt import (GPTConfig, GPTForPretraining,
+                                       GPTPretrainingCriterion)
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=512, hidden_size=64, num_layers=2,
+                    num_heads=4, dtype="float32",
+                    use_flash_attention=False,
+                    max_position_embeddings=32)
+    model = GPTForPretraining(cfg)
+    crit = GPTPretrainingCriterion()
+    r = np.random.RandomState(0)
+    x = paddle.to_tensor(r.randint(0, 512, (8, 32)).astype("int64"))
+    y = paddle.to_tensor(r.randint(0, 512, (8, 32)).astype("int64"))
+    reports = [_numerics_trace(lambda: crit(model(x), y), "gpt2",
+                               verbose)]
+
+    named = [(n, p) for n, p in model.named_parameters()]
+    buckets = analysis.quant_bucket_plan(named, bucket_numel=1 << 16)
+    qreport = analysis.check_quant_budget(buckets, fmt="int8",
+                                          per_bucket_scale=True)
+    print(f"[gpt2] quant budget: {len(buckets)} bucket(s) priced "
+          f"(int8, per-bucket scale), "
+          f"{len(qreport.diagnostics)} finding(s)")
+    if verbose or not qreport.ok:
+        for d in qreport.diagnostics:
+            print("   ", d.render())
+    _note("gpt2-quant", qreport)
+    reports.append(qreport)
+    return reports
+
+
+_NUMERICS_TABLE = {"lenet": numerics_lenet, "resnet50": numerics_resnet50,
+                   "bert": numerics_bert, "gpt2": numerics_gpt2}
+
+
+def _numerics_main(args) -> int:
+    import paddle_tpu as paddle
+    # provenance is captured at record time only when checks are on
+    paddle.set_flags({"FLAGS_static_checks": "warn"})
+    _JSON["models"] = {}
+    models = args.models if args.models is not None \
+        else ",".join(_NUMERICS_TABLE)
+    reports = []
+    for m in models.split(","):
+        m = m.strip()
+        if not m:
+            continue
+        if m not in _NUMERICS_TABLE:
+            print(f"unknown numerics model '{m}' "
+                  f"(have: {sorted(_NUMERICS_TABLE)})")
+            return 2
+        reports.extend(_NUMERICS_TABLE[m](args.verbose))
+    findings = sum(len(r.diagnostics) for r in reports)
+    errors = sum(len(r.errors) for r in reports)
+    print(f"== numerics lint: {findings} finding(s) "
+          f"({errors} error-severity) across {len(reports)} program(s)")
+    if args.json:
+        from ..observability import metrics
+        snap = metrics.snapshot()
+        print(json.dumps({
+            "findings": findings, "errors": errors,
+            "models": _JSON["models"],
+            "counters": {k: v for k, v in snap["counters"].items()
+                         if k.startswith("sanitizer.")},
+        }))
+    # the zoo's error bar is zero: warnings are informational, an
+    # error-severity numerics finding fails the sweep
+    return 0 if errors == 0 else 1
+
+
 def _maybe_reexec_for_devices(argv) -> int:
     """--perf wants the dryrun dp×mp mesh (≥4 devices). On a
     single-device host, re-exec with 8 forced CPU devices BEFORE jax
@@ -783,6 +938,14 @@ def main(argv=None) -> int:
                          "pod shapes (static liveness — no compile, no "
                          "devices); oom_risk findings gate against "
                          "FLAGS_memory_budget_bytes")
+    ap.add_argument("--numerics", action="store_true",
+                    help="numerics lint: record the model zoo (lenet,"
+                         "resnet50,bert,gpt2) under amp auto_cast O1 "
+                         "and run the precision dataflow checkers "
+                         "(overflow_risk, accum_dtype, cast_churn) "
+                         "plus the int8 quant_error_budget pre-flight "
+                         "over gpt2's parameter buckets; exit 0 = zero "
+                         "error-severity findings")
     ap.add_argument("--plan", action="store_true",
                     help="auto-parallelism planner: record the dryrun "
                          "sweep model and rank every dp×mp×pp "
@@ -817,6 +980,8 @@ def main(argv=None) -> int:
         return _mem_main(args)
     if args.plan:
         return _plan_main(args)
+    if args.numerics:
+        return _numerics_main(args)
 
     global _FIX
     _FIX = bool(args.fix)

@@ -56,7 +56,7 @@ from .diagnostics import CheckReport
 
 # checkers fixes.py knows how to repair
 FIXABLE = ("donation_safety", "view_alias", "inplace_race",
-           "dead_capture", "tracer_leak")
+           "dead_capture", "tracer_leak", "numerics.cast_churn")
 
 
 def _poison_closure(view, roots):
@@ -128,6 +128,7 @@ def plan_and_apply(view, report: CheckReport, ctx=None,
     dead_ops: List[int] = []
     scalar_keys: List = []
     tracer_inputs: set = set()
+    cast_rewires: List[Tuple[int, Tuple]] = []   # (j2, source wiring)
 
     for d in report.diagnostics:
         if d.checker not in FIXABLE:
@@ -164,6 +165,23 @@ def plan_and_apply(view, report: CheckReport, ctx=None,
                 actions.append(
                     f"prune {len(data['dead_ops'])} dead op(s) "
                     f"{names} (~{data.get('flops', 0)} FLOPs)")
+        elif d.checker == "numerics.cast_churn":
+            pair = data.get("cast_pair")
+            src = data.get("source")
+            # an aliased round-trip output would make the substitution
+            # observable (the alias's ref points at the pruned op) —
+            # report-only, the residual re-check warns it
+            if not pair or src is None or not data.get("fixable"):
+                continue
+            consumed.append(d)
+            j1, j2 = pair
+            cast_rewires.append((j2, tuple(src)))
+            for j in (j1, j2):
+                if j not in dead_ops:
+                    dead_ops.append(j)
+            actions.append(
+                f"drop redundant cast round trip (ops #{j1}, #{j2}): "
+                f"rewire consumers to the original value")
         elif d.checker == "tracer_leak":
             if "scalar_key" in data:
                 consumed.append(d)
@@ -219,6 +237,17 @@ def plan_and_apply(view, report: CheckReport, ctx=None,
         view.in_ids.pop(id(t), None)
         if ctx is not None:
             ctx.note_inplace(t)
+
+    # ---- apply: cast-churn consumer rewiring. MUST precede the prune:
+    # _prune_dead re-reads every surviving op's wiring (for both the
+    # remap and the rebuilt cache signature), so consumers pointing at
+    # the doomed cast have to point at the original value first.
+    for j2, src in cast_rewires:
+        for p in view.pending:
+            p.wiring = tuple(
+                src if (w is not None and w[0] == "op"
+                        and w[1] == j2 and w[2] == 0) else w
+                for w in p.wiring)
 
     # ---- apply: dead-capture pruning (wiring/sig/ref remap)
     if dead_ops:

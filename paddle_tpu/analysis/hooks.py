@@ -74,6 +74,7 @@ def run_segment_checkers(view, subject: str, lints: bool = False,
                                  check_tracer_leaks)
     from .alias_graph import check_view_aliases
     from .dataflow import check_cross_segment_donation
+    from .numerics import check_numerics_segment
     report = CheckReport(subject)
     check_donation_safety(view, report)
     check_inplace_races(view, report, strict=strict_inplace)
@@ -81,6 +82,7 @@ def run_segment_checkers(view, subject: str, lints: bool = False,
     check_shape_dtype(view, report)
     check_cross_segment_donation(view, report)
     check_view_aliases(view, report, strict=strict_views)
+    check_numerics_segment(view, report)
     if lints:
         check_dead_captures(view, report)
     return report
@@ -142,6 +144,55 @@ def on_segment_flush(ctx, pending, in_vals, in_meta, in_tensors,
     # would leave a phantom entry behind a failed compile/run and turn
     # a valid later program into a false cross_segment_donation error.
     return out
+
+
+# ----------------------------------------------------------- numerics
+
+def on_nan_trip(ctx, pending, in_vals, kind: str):
+    """NaN-trip forensics (lazy flush/replay/fused-step NaN scans call
+    in here just before re-raising FloatingPointError): re-run the
+    numerics propagation over the OFFENDING segment and attach the
+    ranked suspect ops to the flight dump, so the postmortem names the
+    unstable op (with its file:line provenance), not just the step.
+    Best-effort by contract — a forensics failure must never mask the
+    FloatingPointError it is annotating."""
+    try:
+        from ..observability import metrics
+        metrics.counter("sanitizer.nan_trips").inc()
+        from ..observability import _state as _obs
+        if not _obs.FLIGHT:
+            return None
+        from .numerics import nan_suspects
+        from .segment_checks import SegmentView
+        view = SegmentView(list(pending), list(in_vals),
+                           [None] * len(in_vals),
+                           [(None, None, 0)] * len(in_vals), {},
+                           [], {}, donate=())
+        suspects = nan_suspects(view)
+        from ..observability import flight
+        for rank, s in enumerate(suspects):
+            flight.note(
+                "nan_suspect", s["op_name"] or "?", rank=rank,
+                op=s["op_index"], score=s["score"],
+                src=s.get("provenance"), where=kind,
+                reason=s["reason"][:160])
+        return suspects
+    except Exception:
+        return None
+
+
+def on_scaler_step(optimizer, mode: str):
+    """optimizer.step() entry hook: check the GradScaler event window
+    accumulated since the last step (scale/unscale/clip ordering,
+    master weights) and clear it. Only called when checks are on AND
+    the window is non-empty — unscaled training never pays."""
+    from ..observability import metrics
+    metrics.counter("sanitizer.scaler_sweeps").inc()
+    from . import numerics
+    report = numerics.check_scaler_flow(optimizer)
+    numerics.clear_scaler_events()
+    report.emit("warn" if mode == "fix" else mode, stacklevel=5)
+    return report
 
 
 # ------------------------------------------------------------ perf lint

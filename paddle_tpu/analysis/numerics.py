@@ -1,0 +1,690 @@
+"""Static numerics plane: precision dataflow lint + quantization budget.
+
+Abstract interpretation of dtype + dynamic range over recorded programs
+(lazy segments, the fused fwd+vjp step, the fused optimizer update).
+Each value carries a precision state — its storage dtype (from the
+recorded aval) and a RANGE CLASS: an upper bound on log2(max|x|),
+seeded from FLAGS_numerics_seed_log2max for segment inputs and pushed
+forward through per-op transfer rules (add doubles the bound, matmul
+adds log2(K), exp exponentiates, softmax normalizes to [0,1], ...).
+The lattice is deliberately one-sided: `None` means "unknown", and the
+checkers only fire on a KNOWN bound that provably exceeds what the
+output format can represent — an unknown range is never a finding, so
+the plane adds no noise on programs it cannot reason about.
+
+Five checkers ride on the lattice (battery: hooks.run_segment_checkers,
+FLAGS_static_checks=off|warn|error|fix):
+
+  numerics.overflow_risk      exp/softmax/norm/large reductions whose
+                              propagated bound exceeds the fp16/bf16
+                              output format's finite range
+  numerics.accum_dtype        matmul/reduction accumulating >= K
+                              (FLAGS_numerics_accum_k) terms directly
+                              in a low-precision output
+  numerics.cast_churn         fp32 -> bf16 -> fp32 round trips; fix
+                              mode drops the redundant pair and
+                              re-proves the diagnostics clear
+  numerics.scaler_flow        GradScaler misuse at optimizer.step():
+                              scaled grads stepped without unscale_
+                              (missing inf-check), clip before
+                              unscale, fp16 update without master
+                              weights
+  numerics.quant_error_budget given a gradient bucket plan, statically
+                              price int8/fp8 SNR per bucket from the
+                              range estimates and flag buckets whose
+                              dynamic range exceeds the format — the
+                              pre-flight gate for quantized collectives
+
+Counters land under `sanitizer.diagnostics.numerics.*` (the dotted
+checker name IS the counter suffix), error findings hit the flight
+ring, and a NaN trip at flush re-runs the propagation over the
+offending segment to attach ranked suspect ops to the flight dump
+(`nan_suspects`).
+"""
+from __future__ import annotations
+
+import math
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .diagnostics import SEVERITY_ERROR, SEVERITY_WARNING, CheckReport
+
+CHECKER_OVERFLOW = "numerics.overflow_risk"
+CHECKER_ACCUM = "numerics.accum_dtype"
+CHECKER_CHURN = "numerics.cast_churn"
+CHECKER_SCALER = "numerics.scaler_flow"
+CHECKER_QUANT = "numerics.quant_error_budget"
+
+NUMERICS_CHECKERS = (CHECKER_OVERFLOW, CHECKER_ACCUM, CHECKER_CHURN,
+                     CHECKER_SCALER, CHECKER_QUANT)
+
+# finite-range ceiling per storage format, as log2(max finite value):
+# fp16 tops out at 65504 (~2^16) — the overflow format; bf16/fp32 share
+# the 8-bit exponent (~2^128) and only differ in mantissa
+LOW_PRECISION = ("float16", "bfloat16")
+_FMT_LOG2MAX = {"float16": math.log2(65504.0),
+                "bfloat16": 128.0, "float32": 128.0, "float64": 1024.0}
+
+# ops whose mathematical result can exceed the bound the inputs carry
+# by orders of magnitude — the overflow_risk subject set
+_MATMUL_FAMILY = ("matmul", "linear", "conv2d", "conv3d",
+                  "conv2d_transpose", "einsum_", "bmm_", "addmm_",
+                  "baddbmm_", "dot_", "sdpa", "fused_gemm_epilogue")
+_REDUCTIONS = ("sum_", "logsumexp", "cumsum_", "p_norm_", "l1_norm_",
+               "squared_l2_norm_", "trace_")
+# bounded activations / normalizers: output magnitude is a small
+# constant no matter what comes in
+_UNIT_OUTPUT = ("softmax", "log_softmax", "sigmoid", "tanh", "erf",
+                "gumbel_softmax_k", "fused_softmax_mask",
+                "fused_softmax_mask_upper_triangle", "sign", "erfinv")
+_NORMALIZERS = ("layer_norm", "rms_norm", "group_norm", "bn_apply",
+                "skip_layernorm",
+                "fused_bias_dropout_residual_layer_norm")
+# magnitude-preserving (or -shrinking) elementwise/shape/data movement:
+# the bound passes straight through
+_PASS_THROUGH = frozenset((
+    "cast", "reshape", "transpose", "expand", "squeeze", "unsqueeze",
+    "tile", "slice_", "strided_slice_", "split_", "concat_", "stack_",
+    "gather_", "gather_nd_", "getitem_", "take_op", "where_", "flip",
+    "roll_", "tril", "triu", "pad_", "broadcast_to", "assign", "clone",
+    "relu", "relu6", "abs", "neg", "maximum", "minimum", "clip",
+    "dropout", "identity", "detach", "flatten_", "moveaxis_",
+    "index_select_", "masked_fill_", "mean", "stop_gradient",
+    "gelu", "silu", "swish", "leaky_relu", "trans_layout",
+))
+_SMALL_OUTPUT_LOG2 = 4.0      # normalizers / log-family results: |x|<=16
+
+
+def _dtype_str(aval) -> str:
+    try:
+        return str(np.dtype(aval.dtype))
+    except Exception:
+        return str(getattr(aval, "dtype", "float32"))
+
+
+def _is_float(dtype_str: str) -> bool:
+    return dtype_str.startswith(("float", "bfloat"))
+
+
+def _numel(aval) -> int:
+    try:
+        return int(np.prod(aval.shape)) if len(aval.shape) else 1
+    except Exception:
+        return 1
+
+
+# ------------------------------------------------------ range propagation
+
+def _reduce_length(name: str, in_avals, out_avals) -> int:
+    """Number of terms folded into one output element: matmul-family
+    reads K from the contracted dim, reductions from the in/out element
+    ratio. Order of magnitude is all the accumulation lint needs."""
+    if name.startswith("conv") and len(in_avals) > 1 \
+            and in_avals[1] is not None:
+        w = in_avals[1]
+        return max(1, int(np.prod(w.shape[1:])) if len(w.shape) > 1
+                   else 1)
+    if name in _MATMUL_FAMILY:
+        a = in_avals[0] if in_avals else None
+        if a is not None and len(getattr(a, "shape", ())):
+            return max(1, int(a.shape[-1]))
+        return 1
+    n_in = sum(_numel(a) for a in in_avals if a is not None)
+    n_out = max(1, sum(_numel(a) for a in out_avals))
+    return max(1, n_in // n_out)
+
+
+def _transfer(name: str, attrs: dict, in_bounds: List[Optional[float]],
+              in_avals, out_avals) -> Optional[float]:
+    """One-step range transfer: upper bound on log2(max|out|) given the
+    input bounds, or None (unknown). Conservative in the SOUND
+    direction — a rule may over-estimate the bound (false alarm risk is
+    then controlled by the checker thresholds) but returning a bound
+    lower than the true maximum would hide real overflow."""
+    known = [b for b in in_bounds if b is not None]
+    b0 = in_bounds[0] if in_bounds else None
+
+    if name in _UNIT_OUTPUT:
+        return 0.0
+    if name in _NORMALIZERS or name in ("log", "log2", "log10", "log1p",
+                                        "log_softmax", "softmax_ce",
+                                        "std_", "var_", "bn_stats"):
+        # normalized / logarithmic results are numerically small
+        return _SMALL_OUTPUT_LOG2
+    if name in _PASS_THROUGH:
+        return b0
+    if name in ("add", "subtract", "lerp",
+                "fused_elementwise_add", "fused_elementwise_sub",
+                "fused_dropout_add"):
+        if len(known) == len(in_bounds) and known:
+            return max(known) + 1.0
+        return None
+    if name in ("multiply", "fused_elementwise_mul"):
+        if len(known) >= 2:
+            return known[0] + known[1]
+        return None
+    if name == "scale":
+        s = attrs.get("scale", 1.0)
+        try:
+            s = abs(float(s))
+        except (TypeError, ValueError):
+            return None
+        if b0 is None:
+            return None
+        return b0 + (math.log2(s) if s > 0 else 0.0) \
+            + (1.0 if attrs.get("bias") else 0.0)
+    if name in ("square",):
+        return None if b0 is None else 2.0 * b0
+    if name in ("sqrt",):
+        return None if b0 is None else max(0.0, b0 / 2.0)
+    if name == "exp":
+        # log2(exp(m)) = m * log2(e); m <= 2^b0
+        if b0 is None:
+            return None
+        return (2.0 ** min(b0, 64.0)) * math.log2(math.e)
+    if name in _MATMUL_FAMILY:
+        if len(known) >= 2:
+            k = _reduce_length(name, in_avals, out_avals)
+            return known[0] + known[1] + math.log2(max(k, 1))
+        return None
+    if name in _REDUCTIONS:
+        if b0 is None:
+            return None
+        k = _reduce_length(name, in_avals, out_avals)
+        return b0 + math.log2(max(k, 1))
+    # divide / reciprocal / rsqrt / pow / rng / unknown ops: no bound
+    return None
+
+
+def propagate_ranges(view, seed_log2max: Optional[float] = None
+                     ) -> Dict[Tuple, Optional[float]]:
+    """Forward dataflow pass over a SegmentView: bound[("in", i)] and
+    bound[("op", j, s)] -> log2(max|x|) upper bound or None. Inputs
+    seed at FLAGS_numerics_seed_log2max — the plane never reads
+    concrete values (that would sync the very segment it is vetting)."""
+    if seed_log2max is None:
+        from .._core import flags
+        seed_log2max = float(
+            flags.flag_value("FLAGS_numerics_seed_log2max"))
+    from .._core import lazy
+    bounds: Dict[Tuple, Optional[float]] = {}
+    for i, v in enumerate(view.in_vals):
+        aval = lazy._aval_of(v)
+        bounds[("in", i)] = (seed_log2max
+                            if _is_float(_dtype_str(aval)) else None)
+    for j, p in enumerate(view.pending):
+        in_bounds, in_avals = [], []
+        for w in p.wiring:
+            if w is None:
+                in_bounds.append(None)
+                in_avals.append(None)
+            elif w[0] == "in":
+                in_bounds.append(bounds.get(("in", w[1])))
+                in_avals.append(lazy._aval_of(view.in_vals[w[1]]))
+            else:
+                in_bounds.append(bounds.get(("op", w[1], w[2])))
+                in_avals.append(view.pending[w[1]].out_refs[w[2]].aval)
+        out_avals = [r.aval for r in p.out_refs]
+        b = _transfer(p.op.name, p.attrs, in_bounds, in_avals, out_avals)
+        for s, a in enumerate(out_avals):
+            bounds[("op", j, s)] = b if _is_float(_dtype_str(a)) else None
+    return bounds
+
+
+# ----------------------------------------------------- segment checkers
+
+def _segment_has_numerics_surface(view) -> bool:
+    """Cheap pre-scan: the lattice only pays off when the segment holds
+    low-precision floats or cast ops. A pure-fp32 segment skips the
+    propagation entirely — the flush-hook battery must stay O(ops)
+    cheap on the dominant case."""
+    for p in view.pending:
+        if p.op.name == "cast":
+            return True
+        for r in p.out_refs:
+            if _dtype_str(r.aval) in LOW_PRECISION:
+                return True
+    return False
+
+
+def check_overflow_risk(view, report: CheckReport, bounds=None):
+    """An op whose propagated range bound exceeds its low-precision
+    output format's finite ceiling WILL saturate to inf for admissible
+    inputs — the static form of the FLAGS_check_nan_inf runtime trip.
+    Only KNOWN bounds fire; an unlearnable range is never a finding."""
+    if bounds is None:
+        bounds = propagate_ranges(view)
+    for j, p in enumerate(view.pending):
+        for s, ref in enumerate(p.out_refs):
+            dt = _dtype_str(ref.aval)
+            if dt not in LOW_PRECISION:
+                continue
+            b = bounds.get(("op", j, s))
+            fmt_max = _FMT_LOG2MAX[dt]
+            if b is not None and b > fmt_max:
+                report.add(
+                    CHECKER_OVERFLOW,
+                    f"output {s} range bound 2^{b:.1f} exceeds {dt} "
+                    f"finite range (2^{fmt_max:.0f}): '{p.op.name}' "
+                    f"evaluated in {dt} without upcast saturates to "
+                    f"inf for admissible inputs",
+                    severity=SEVERITY_ERROR,
+                    hint="compute this op in float32 (amp black-list "
+                         "behavior) or rescale its inputs first",
+                    data={"bound_log2": b, "dtype": dt, "out_slot": s},
+                    **view.op_diag_fields(j))
+                break   # one finding per op, not per output slot
+
+
+def check_accum_dtype(view, report: CheckReport):
+    """A matmul/reduction folding >= FLAGS_numerics_accum_k terms
+    directly into a fp16/bf16 output loses the sum to rounding: with
+    bf16's 8-bit mantissa the random-walk relative error reaches
+    sqrt(K) * 2^-8 ~= 0.5 at K=16384. XLA matmuls DO accumulate fp32
+    internally, but the result is rounded per-op — chained reductions
+    at this K need an explicit fp32 accumulation dtype."""
+    from .._core import flags, lazy
+    k_floor = int(flags.flag_value("FLAGS_numerics_accum_k"))
+    for j, p in enumerate(view.pending):
+        name = p.op.name
+        if name not in _MATMUL_FAMILY and name not in _REDUCTIONS:
+            continue
+        out_dt = _dtype_str(p.out_refs[0].aval)
+        if out_dt not in LOW_PRECISION:
+            continue
+        in_avals = []
+        for w in p.wiring:
+            if w is None:
+                in_avals.append(None)
+            elif w[0] == "in":
+                in_avals.append(lazy._aval_of(view.in_vals[w[1]]))
+            else:
+                in_avals.append(view.pending[w[1]].out_refs[w[2]].aval)
+        out_avals = [r.aval for r in p.out_refs]
+        k = _reduce_length(name, in_avals, out_avals)
+        if k >= k_floor:
+            report.add(
+                CHECKER_ACCUM,
+                f"'{name}' accumulates {k} terms into a {out_dt} "
+                f"output (floor: {k_floor}): relative error grows as "
+                f"sqrt(K)*eps and the sum is unreliable at this K "
+                f"without fp32 accumulation",
+                severity=SEVERITY_ERROR,
+                hint="keep the accumulation in float32 and cast the "
+                     "result (amp O1 white-list ops do this per-op; "
+                     "chained reductions need an explicit upcast)",
+                data={"reduce_k": k, "dtype": out_dt},
+                **view.op_diag_fields(j))
+
+
+def _cast_target(attrs) -> Optional[str]:
+    d = attrs.get("dtype")
+    if d is None:
+        return None
+    try:
+        return str(np.dtype(d))
+    except TypeError:
+        return str(d)
+
+
+def _wiring_dtype(view, w) -> Optional[str]:
+    from .._core import lazy
+    if w is None:
+        return None
+    if w[0] == "in":
+        return _dtype_str(lazy._aval_of(view.in_vals[w[1]]))
+    return _dtype_str(view.pending[w[1]].out_refs[w[2]].aval)
+
+
+def find_cast_churn(view) -> List[Tuple[int, int, bool]]:
+    """Redundant (j1, j2, fixable) cast pairs: j2 casts j1's output
+    straight back to j1's source dtype and j1's output feeds ONLY j2
+    (and is not aliased by a live tensor). `fixable` additionally
+    requires the round-tripped output (j2, 0) to be unaliased too —
+    then rewiring j2's consumers to j1's input and pruning both is
+    observationally equivalent (modulo the precision loss being
+    removed); an aliased output is still REPORTED, just not rewritten.
+    Greedy left-to-right so chains like a->b->a->b pair
+    deterministically."""
+    live_slots = set((j, s) for j, s in view.live)
+    consumers: Dict[Tuple[int, int], List[int]] = {}
+    for j, p in enumerate(view.pending):
+        for w in p.wiring:
+            if w is not None and w[0] == "op":
+                consumers.setdefault((w[1], w[2]), []).append(j)
+    from .segment_checks import _live_meta
+    pairs, used = [], set()
+    for j2, p2 in enumerate(view.pending):
+        if p2.op.name != "cast" or j2 in used:
+            continue
+        w = p2.wiring[0] if p2.wiring else None
+        if w is None or w[0] != "op":
+            continue
+        j1 = w[1]
+        p1 = view.pending[j1]
+        if p1.op.name != "cast" or j1 in used:
+            continue
+        src_dt = _wiring_dtype(view, p1.wiring[0] if p1.wiring else None)
+        if src_dt is None or _cast_target(p2.attrs) != src_dt:
+            continue
+        # j1's intermediate must feed only j2 and must not be pinned by
+        # a live alias (the live list is (op, slot) pairs)
+        if consumers.get((j1, 0), []) != [j2]:
+            continue
+        if (j1, 0) in live_slots or _live_meta(p1.out_refs[0]):
+            continue
+        fixable = ((j2, 0) not in live_slots
+                   and not _live_meta(p2.out_refs[0]))
+        pairs.append((j1, j2, fixable))
+        used.update((j1, j2))
+    return pairs
+
+
+def check_cast_churn(view, report: CheckReport):
+    """fp32 -> bf16 -> fp32 round trips silently destroy 16 mantissa
+    bits AND pay two kernels for it; exact up-down pairs (bf16 -> fp32
+    -> bf16) waste only time. Both are mechanical to remove: fix mode
+    rewires the consumers to the original value and prunes the pair."""
+    for j1, j2, fixable in find_cast_churn(view):
+        p1 = view.pending[j1]
+        src_dt = _wiring_dtype(view, p1.wiring[0] if p1.wiring else None)
+        mid_dt = _dtype_str(p1.out_refs[0].aval)
+        lossy = (_is_float(src_dt) and _is_float(mid_dt)
+                 and mid_dt in LOW_PRECISION
+                 and src_dt not in LOW_PRECISION)
+        report.add(
+            CHECKER_CHURN,
+            f"redundant cast round trip {src_dt} -> {mid_dt} -> "
+            f"{src_dt} (ops #{j1}, #{j2})"
+            + (": the detour silently drops the value to "
+               f"{mid_dt} mantissa before widening back" if lossy
+               else ": two cast kernels with no numeric effect"),
+            severity=SEVERITY_ERROR if lossy else SEVERITY_WARNING,
+            hint="drop both casts (FLAGS_static_checks=fix prunes the "
+                 "pair and rewires the consumers)",
+            data={"cast_pair": [j1, j2], "fixable": fixable,
+                  "source": list(p1.wiring[0])
+                  if p1.wiring and p1.wiring[0] else None},
+            **view.op_diag_fields(j2))
+
+
+def check_numerics_segment(view, report: CheckReport):
+    """The battery entry point: one propagation pass feeding the three
+    segment-shaped checkers. Skips everything on segments with no
+    low-precision surface (the cheap pre-scan)."""
+    if not _segment_has_numerics_surface(view):
+        return
+    bounds = propagate_ranges(view)
+    check_overflow_risk(view, report, bounds=bounds)
+    check_accum_dtype(view, report)
+    check_cast_churn(view, report)
+
+
+# ------------------------------------------------- scaler flow tracking
+
+# Thread-local bounded window of AMP bookkeeping events ("scale",
+# "unscale", "clip", "step"), recorded by GradScaler / ClipGrad* only
+# while checks are on AND the scaler is enabled. optimizer.step()
+# consults and clears it — the window spans exactly one step.
+_TLS = threading.local()
+_WINDOW_CAP = 64
+
+
+def note_scaler_event(kind: str, **detail):
+    ev = getattr(_TLS, "events", None)
+    if ev is None:
+        ev = _TLS.events = []
+    if len(ev) < _WINDOW_CAP:
+        ev.append((kind, detail))
+
+
+def scaler_events() -> List[Tuple[str, dict]]:
+    return list(getattr(_TLS, "events", ()) or ())
+
+
+def clear_scaler_events():
+    _TLS.events = []
+
+
+def check_scaler_flow(optimizer, report: Optional[CheckReport] = None,
+                      events: Optional[List] = None) -> CheckReport:
+    """Step-time GradScaler protocol check over the event window since
+    the last optimizer.step():
+
+      * gradients were scaled but never unscaled -> the update applies
+        loss_scale-times-too-large steps AND skipped the inf check the
+        scaler exists to perform
+      * gradient clipping ran between scale() and unscale_() -> the
+        clip threshold compared against scaled magnitudes (off by the
+        loss scale)
+      * scaled fp16 training updating fp16 master-less params -> the
+        update rounds to zero for small gradients (no master weights)
+    """
+    if report is None:
+        report = CheckReport("optimizer step (scaler flow)")
+    ev = scaler_events() if events is None else list(events)
+    if not any(k == "scale" for k, _ in ev):
+        return report
+
+    unscaled = any(k == "unscale" for k, _ in ev)
+    if not unscaled:
+        report.add(
+            CHECKER_SCALER,
+            "optimizer.step() reached with scaled gradients never "
+            "unscaled: the update is off by the loss scale and the "
+            "scaler's inf/nan gate (unscale_ computes found_inf) "
+            "never ran",
+            severity=SEVERITY_ERROR,
+            hint="call scaler.step(optimizer) (which unscales and "
+                 "inf-checks) instead of optimizer.step()",
+            data={"events": [k for k, _ in ev]})
+    else:
+        # clip-before-unscale: any clip event strictly after the last
+        # scale and before the first following unscale
+        last_scale = max(i for i, (k, _) in enumerate(ev)
+                         if k == "scale")
+        try:
+            first_unscale = min(i for i, (k, _) in enumerate(ev)
+                                if k == "unscale" and i > last_scale)
+        except ValueError:
+            first_unscale = len(ev)
+        if any(k == "clip" for k, _ in ev[last_scale:first_unscale]):
+            report.add(
+                CHECKER_SCALER,
+                "gradient clipping ran before unscale_: the clip "
+                "threshold was compared against loss-scaled gradient "
+                "magnitudes (every norm is off by the scale factor)",
+                severity=SEVERITY_ERROR,
+                hint="unscale first: scaler.unscale_(optimizer); "
+                     "clip; scaler.step(optimizer)",
+                data={"events": [k for k, _ in ev]})
+
+    # fp16 update without master weights: bf16 keeps fp32's exponent
+    # and survives master-less in practice, so only fp16 (whose small
+    # gradients underflow the 10-bit mantissa step) is an error here
+    if not getattr(optimizer, "_multi_precision", False):
+        fp16_params = [
+            getattr(p, "name", None) or f"param{i}"
+            for i, p in enumerate(_optimizer_params(optimizer))
+            # dtype strs carry a namespace prefix (paddle_tpu.float16)
+            if str(getattr(p, "dtype", "")).rsplit(".", 1)[-1]
+            == "float16"]
+        if fp16_params:
+            report.add(
+                CHECKER_SCALER,
+                f"scaled fp16 training updates float16 parameter(s) "
+                f"{fp16_params[:3]} in place without master weights: "
+                f"small updates round to zero in the 10-bit mantissa",
+                severity=SEVERITY_ERROR,
+                hint="construct the optimizer with "
+                     "multi_precision=True (fp32 master copies)",
+                data={"params": fp16_params})
+    return report
+
+
+def _optimizer_params(optimizer):
+    try:
+        # _all_params() yields (param, group) pairs
+        return [p for p, _ in optimizer._all_params()]
+    except Exception:
+        return []
+
+
+# ------------------------------------------------ quantization budget
+
+def quant_bucket_plan(named_tensors, bucket_numel: int = 1 << 20
+                      ) -> List[dict]:
+    """Group (name, tensor/array) pairs into gradient-style fusion
+    buckets (greedy by element count, the dist bucketing shape) and
+    measure each bucket's max-abs / rms — the range statistics
+    check_quant_budget prices. Offline helper: it READS concrete
+    values, so it belongs in pre-flight planning, not the flush path."""
+    buckets: List[dict] = []
+    cur = {"name": None, "names": [], "numel": 0,
+           "max_abs": 0.0, "_sumsq": 0.0}
+
+    def _close():
+        if cur["numel"]:
+            b = {"name": cur["name"] or "bucket0",
+                 "names": list(cur["names"]), "numel": cur["numel"],
+                 "max_abs": cur["max_abs"],
+                 "rms": math.sqrt(cur["_sumsq"] / cur["numel"])}
+            buckets.append(b)
+        cur.update(name=None, names=[], numel=0, max_abs=0.0, _sumsq=0.0)
+
+    for name, t in named_tensors:
+        v = np.asarray(t.numpy() if hasattr(t, "numpy") else t,
+                       dtype=np.float64)
+        if cur["name"] is None:
+            cur["name"] = str(name)
+        cur["names"].append(str(name))
+        cur["numel"] += v.size
+        cur["max_abs"] = max(cur["max_abs"],
+                             float(np.max(np.abs(v))) if v.size else 0.0)
+        cur["_sumsq"] += float(np.sum(v.astype(np.float64) ** 2))
+        if cur["numel"] >= bucket_numel:
+            _close()
+    _close()
+    return buckets
+
+
+# quantization formats the budget can price: (levels per side, has
+# native dynamic range). int8 is uniform [-127, 127]; fp8 e4m3 keeps
+# ~2^17.8 of dynamic range itself so the scale only needs to land the
+# bucket inside it, but the mantissa still quantizes at ~2^-3 relative.
+_QUANT_FMTS = {"int8": {"steps": 127.0},
+               "fp8_e4m3": {"steps": 448.0 / 2.0 ** 6}}
+
+
+def quant_snr_db(max_abs: float, rms: float, fmt: str = "int8",
+                 scale: Optional[float] = None) -> float:
+    """Uniform-quantization SNR in dB for a bucket with the given range
+    stats: step q = S/steps, noise power q^2/12, signal power rms^2.
+    `scale` S defaults to the bucket's own max (per-bucket scaling);
+    pass a global max to price a shared-scale plan."""
+    spec = _QUANT_FMTS[fmt]
+    S = float(scale if scale is not None else max_abs)
+    if rms <= 0.0:
+        return float("inf")    # all-zero bucket: nothing to lose
+    if S <= 0.0:
+        return float("inf")
+    q = S / spec["steps"]
+    noise = q * q / 12.0
+    return 10.0 * math.log10((rms * rms) / noise)
+
+
+def check_quant_budget(buckets: List[dict],
+                       report: Optional[CheckReport] = None,
+                       fmt: str = "int8",
+                       per_bucket_scale: bool = True,
+                       min_snr_db: Optional[float] = None) -> CheckReport:
+    """Statically price the quantization error of a gradient bucket
+    plan BEFORE any quantized collective compiles: each bucket's SNR
+    under `fmt` must clear FLAGS_numerics_min_snr_db. A shared (global)
+    scale makes small-magnitude buckets inherit the largest bucket's
+    step size — exactly the failure this gate exists to catch; per-
+    bucket scales price each bucket against its own range."""
+    if report is None:
+        report = CheckReport(f"quant budget ({fmt}, "
+                             f"{len(buckets)} buckets)")
+    if min_snr_db is None:
+        from .._core import flags
+        min_snr_db = float(flags.flag_value("FLAGS_numerics_min_snr_db"))
+    if fmt not in _QUANT_FMTS:
+        report.add(CHECKER_QUANT,
+                   f"unknown quantization format {fmt!r} "
+                   f"(known: {sorted(_QUANT_FMTS)})",
+                   severity=SEVERITY_ERROR)
+        return report
+    global_max = max((float(b.get("max_abs", 0.0)) for b in buckets),
+                     default=0.0)
+    for i, b in enumerate(buckets):
+        name = b.get("name", f"bucket{i}")
+        max_abs = float(b.get("max_abs", 0.0))
+        rms = float(b.get("rms", 0.0))
+        scale = max_abs if per_bucket_scale else global_max
+        snr = quant_snr_db(max_abs, rms, fmt=fmt, scale=scale)
+        if snr < min_snr_db:
+            report.add(
+                CHECKER_QUANT,
+                f"bucket '{name}' ({b.get('numel', '?')} elems) prices "
+                f"{snr:.1f} dB SNR under {fmt} with "
+                f"{'per-bucket' if per_bucket_scale else 'global'} "
+                f"scale {scale:.3g} (floor: {min_snr_db:.0f} dB): its "
+                f"dynamic range exceeds what the format resolves",
+                severity=SEVERITY_ERROR,
+                hint="use per-bucket scales, or keep this bucket in "
+                     "the unquantized all-reduce path",
+                data={"bucket": name, "snr_db": snr, "scale": scale,
+                      "fmt": fmt, "rms": rms, "max_abs": max_abs})
+    return report
+
+
+# -------------------------------------------------- NaN-trip forensics
+
+# op families ranked by how often they MANUFACTURE a NaN/Inf (as
+# opposed to merely propagating one): division-like poles first, then
+# exponentials/logs, then big accumulations
+_RISK = {}
+for _n in ("divide", "rsqrt", "reciprocal", "pow", "log", "log2",
+           "log10", "log1p", "erfinv", "acos", "asin", "atanh"):
+    _RISK[_n] = 4.0
+for _n in ("exp", "logsumexp", "softmax_ce", "nll_loss_k", "bce_k",
+           "bce_logits_k", "kl_div_k", "sqrt", "std_", "var_"):
+    _RISK[_n] = 3.0
+for _n in _MATMUL_FAMILY + _REDUCTIONS + _NORMALIZERS:
+    _RISK.setdefault(_n, 1.0)
+
+
+def nan_suspects(view, limit: int = 5) -> List[dict]:
+    """Rank the segment's ops by NaN-manufacturing likelihood: op
+    family risk + low-precision output + a propagated bound that
+    exceeds the output format. The flight dump attaches this list when
+    FLAGS_check_nan_inf trips at flush, so the postmortem names the
+    unstable op (with source provenance), not just the step."""
+    try:
+        bounds = propagate_ranges(view)
+    except Exception:
+        bounds = {}
+    scored = []
+    for j, p in enumerate(view.pending):
+        score = _RISK.get(p.op.name, 0.0)
+        reasons = []
+        if score:
+            reasons.append(f"{p.op.name} can manufacture non-finites")
+        dt = _dtype_str(p.out_refs[0].aval) if p.out_refs else "?"
+        if dt in LOW_PRECISION:
+            score += 2.0
+            reasons.append(f"computes in {dt}")
+            b = bounds.get(("op", j, 0))
+            if b is not None and b > _FMT_LOG2MAX.get(dt, 128.0):
+                score += 3.0
+                reasons.append(f"range bound 2^{b:.1f} exceeds {dt}")
+        if score > 0.0:
+            f = view.op_diag_fields(j)
+            scored.append({"score": score, "reason": "; ".join(reasons),
+                           **f})
+    scored.sort(key=lambda d: (-d["score"], d["op_index"]))
+    return scored[:limit]

@@ -17,6 +17,16 @@ class ClipGradBase:
     def __call__(self, params_grads):
         raise NotImplementedError
 
+    def _note_clip(self):
+        # scaler_flow ordering evidence (numerics plane): a clip event
+        # landing between scale() and unscale_() means the threshold
+        # was compared against loss-scaled magnitudes
+        from .._core import flags
+        if flags.STATIC_CHECKS_ACTIVE:
+            from ..analysis import numerics
+            numerics.note_scaler_event("clip",
+                                       clip=type(self).__name__)
+
 
 class ClipGradByValue(ClipGradBase):
     def __init__(self, max, min=None):
@@ -25,6 +35,7 @@ class ClipGradByValue(ClipGradBase):
 
     @no_grad()
     def __call__(self, params_grads):
+        self._note_clip()
         out = []
         for p, g in params_grads:
             if g is None:
@@ -40,6 +51,7 @@ class ClipGradByNorm(ClipGradBase):
 
     @no_grad()
     def __call__(self, params_grads):
+        self._note_clip()
         out = []
         for p, g in params_grads:
             if g is None:
@@ -68,6 +80,7 @@ class ClipGradByGlobalNorm(ClipGradBase):
 
     @no_grad()
     def __call__(self, params_grads):
+        self._note_clip()
         grads = [g._value for _, g in params_grads if g is not None]
         if not grads:
             return params_grads

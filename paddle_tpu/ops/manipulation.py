@@ -41,7 +41,9 @@ register_op("cast", lambda x, dtype: x.astype(dtype))
 @tensor_method("cast")
 def cast(x, dtype):
     d = dtypes_mod.to_np(dtype)
-    if x._value.dtype == d:
+    # the no-op check reads the recorded aval, not ._value: a cast
+    # decision must not force a pending lazy segment to materialize
+    if x._meta_aval().dtype == d:
         return x
     return apply("cast", x, dtype=str(d) if d != jnp.bfloat16 else "bfloat16")
 

@@ -109,6 +109,16 @@ class Optimizer:
             metas.append(g)
         if not pairs:
             return
+        from .._core import flags as _flags
+        if _flags.STATIC_CHECKS_ACTIVE:
+            # scaler_flow: vet the GradScaler event window accumulated
+            # since the last step (missing unscale/inf-check, clip
+            # before unscale, fp16 update without master weights)
+            # BEFORE the internal clip below notes its own event
+            from ..analysis import numerics as _numerics
+            if _numerics.scaler_events():
+                from ..analysis import hooks as _hooks
+                _hooks.on_scaler_step(self, _hooks.check_mode())
         if self._grad_clip is not None:
             pairs = self._grad_clip(pairs)
         self._step_count += 1

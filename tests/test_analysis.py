@@ -1096,3 +1096,300 @@ def test_error_mode_raise_keeps_later_eager_ops_working():
                 ctx.flush()
     z = x + 1.0          # fresh work after the dropped trace
     np.testing.assert_allclose(z.numpy(), x.numpy() + 1.0, rtol=1e-6)
+
+
+# ------------------------------------------------------- numerics plane
+
+def test_overflow_risk_reported_and_error_raises():
+    """fp16 exp: with the 2^4 input seed the propagated bound is
+    2^(16*log2 e) ~ 2^23.1 — past fp16's 65504 ceiling. The static form
+    of the FLAGS_check_nan_inf runtime trip."""
+    from paddle_tpu.observability import metrics
+    x = paddle.to_tensor(np.zeros((4, 4), "float16"))
+    before = metrics.counter(
+        "sanitizer.diagnostics.numerics.overflow_risk").value
+    with lazy.lazy_guard() as ctx:
+        y = paddle.exp(x)
+        report = check_segment(ctx)
+        diags = report.by_checker("numerics.overflow_risk")
+        assert diags, report.render()
+        d = diags[0]
+        assert "range bound 2^23.1 exceeds float16 finite range (2^16)" \
+            in d.message and "saturates to inf" in d.message
+        assert d.op_name == "exp"
+        assert d.provenance and "test_analysis.py" in d.provenance
+        # error mode refuses to launch; warn mode bumps the counter
+        with _with_flag("FLAGS_static_checks", "error"):
+            with pytest.raises(StaticCheckError) as ei:
+                ctx.flush()
+        assert ei.value.report.by_checker("numerics.overflow_risk")
+        assert not ctx.pending
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        z = paddle.exp(paddle.to_tensor(np.zeros((2, 2), "float16")))
+        z.numpy()                                # warn-mode flush
+    assert metrics.counter(
+        "sanitizer.diagnostics.numerics.overflow_risk").value > before
+    del y
+
+
+def test_accum_dtype_reported_and_error_raises():
+    """A bf16 matmul folding K >= FLAGS_numerics_accum_k terms straight
+    into a bf16 output: sqrt(K)*eps swamps the 8-bit mantissa."""
+    a = paddle.to_tensor(np.ones((1, 64), "float32"))
+    b = paddle.to_tensor(np.ones((64, 1), "float32"))
+    with _with_flag("FLAGS_numerics_accum_k", 64):
+        with lazy.lazy_guard() as ctx:
+            y = paddle.matmul(a.astype("bfloat16"), b.astype("bfloat16"))
+            report = check_segment(ctx)
+            diags = report.by_checker("numerics.accum_dtype")
+            assert diags, report.render()
+            d = diags[0]
+            assert "'matmul' accumulates 64 terms into a bfloat16 " \
+                "output (floor: 64)" in d.message
+            assert d.op_name == "matmul"
+            assert d.provenance and "test_analysis.py" in d.provenance
+            with _with_flag("FLAGS_static_checks", "error"):
+                with pytest.raises(StaticCheckError):
+                    ctx.flush()
+    # above the default floor nothing fires on this tiny K
+    with lazy.lazy_guard() as ctx:
+        y2 = paddle.matmul(a.astype("bfloat16"), b.astype("bfloat16"))
+        assert check_segment(ctx).by_checker("numerics.accum_dtype") \
+            == []
+        ctx._reset_segment()
+    del y, y2
+
+
+def test_cast_churn_reported_and_fix_roundtrip():
+    """fp32 -> bf16 -> fp32 with a consumer: reported lossy (error
+    severity), and fix mode rewires the consumer to the original value,
+    prunes both casts and re-proves the report clear — the flushed
+    result is the EXACT fp32 product, bf16 rounding gone."""
+    xv = np.full((4, 4), 1.0 / 3.0, "float32")
+    x = paddle.to_tensor(xv)
+    with lazy.lazy_guard() as ctx:
+        z = x.astype("bfloat16").astype("float32") * 3.0
+        report = check_segment(ctx)
+        diags = report.by_checker("numerics.cast_churn")
+        assert diags, report.render()
+        d = diags[0]
+        assert "redundant cast round trip float32 -> bfloat16 -> " \
+            "float32 (ops #0, #1)" in d.message
+        assert "silently drops the value to bfloat16 mantissa" \
+            in d.message
+        assert d.severity == "error"          # lossy round trip
+        assert d.data["cast_pair"] == [0, 1] and d.data["fixable"]
+        # fix: both casts pruned, consumer rewired to the segment input
+        result, post = analysis.fix_segment(ctx)
+        assert any("drop redundant cast round trip" in a
+                   for a in result.actions), result.actions
+        assert post.by_checker("numerics.cast_churn") == []
+        assert len(ctx.pending) == 1          # only the multiply left
+    np.testing.assert_array_equal(z.numpy(), xv * np.float32(3.0))
+
+    # an exact bf16 -> fp32 -> bf16 round trip is only a perf warning
+    w = paddle.to_tensor(np.ones((2, 2), "float32")).astype("bfloat16")
+    w.numpy()                                  # settle the cast
+    with lazy.lazy_guard() as ctx:
+        v = w.astype("float32").astype("bfloat16") + 1.0
+        diags = check_segment(ctx).by_checker("numerics.cast_churn")
+        assert diags and diags[0].severity == "warning"
+        assert "no numeric effect" in diags[0].message
+        ctx._reset_segment()
+    del v
+
+
+def test_scaler_flow_missing_unscale_reported():
+    """optimizer.step() after scaler.scale(loss).backward() without
+    scaler.step/unscale_: the update is off by the loss scale and the
+    inf gate never ran."""
+    from paddle_tpu.amp import GradScaler
+    from paddle_tpu.analysis import numerics
+    p = paddle.to_tensor(np.ones((2, 2), "float32"))
+    p.stop_gradient = False
+    sgd = paddle.optimizer.SGD(learning_rate=0.1, parameters=[p])
+    scaler = GradScaler()
+    try:
+        loss = (p * 2.0).sum()
+        scaler.scale(loss).backward()
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            sgd.step()                         # warn mode (conftest)
+        msgs = [str(wi.message) for wi in w
+                if isinstance(wi.message, StaticCheckWarning)]
+        assert any("scaled gradients never unscaled" in m
+                   and "inf/nan gate" in m for m in msgs), msgs
+        assert numerics.scaler_events() == []  # window cleared
+        # error mode: the step refuses before touching the params
+        p.clear_gradient()
+        loss = (p * 2.0).sum()
+        scaler.scale(loss).backward()
+        with _with_flag("FLAGS_static_checks", "error"):
+            with pytest.raises(StaticCheckError) as ei:
+                sgd.step()
+        assert ei.value.report.by_checker("numerics.scaler_flow")
+    finally:
+        numerics.clear_scaler_events()
+
+
+def test_scaler_flow_clip_before_unscale_reported():
+    """A ClipGrad* invocation landing between scale() and unscale_()
+    compared its threshold against loss-scaled magnitudes."""
+    from paddle_tpu.amp import GradScaler
+    from paddle_tpu.analysis import numerics
+    from paddle_tpu.nn.clip import ClipGradByValue
+    p = paddle.to_tensor(np.ones((2, 2), "float32"))
+    p.stop_gradient = False
+    sgd = paddle.optimizer.SGD(learning_rate=0.1, parameters=[p])
+    scaler = GradScaler()
+    try:
+        loss = (p * 2.0).sum()
+        scaler.scale(loss).backward()
+        ClipGradByValue(1.0)([(p, p.grad)])    # BEFORE unscale_
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            scaler.step(sgd)                   # unscales, then steps
+        msgs = [str(wi.message) for wi in w
+                if isinstance(wi.message, StaticCheckWarning)]
+        assert any("gradient clipping ran before unscale_" in m
+                   and "off by the scale factor" in m for m in msgs), \
+            msgs
+    finally:
+        numerics.clear_scaler_events()
+
+
+def test_scaler_flow_fp16_without_master_weights_reported():
+    """Scaled fp16 training updating fp16 params in place without
+    multi_precision: small updates round to zero in the 10-bit
+    mantissa. bf16 params are excused (fp32 exponent)."""
+    from paddle_tpu.amp import GradScaler
+    from paddle_tpu.analysis import numerics
+    p = paddle.to_tensor(np.ones((2, 2), "float16"))
+    p.stop_gradient = False
+    sgd = paddle.optimizer.SGD(learning_rate=0.1, parameters=[p])
+    # small scale: the default 65536 would push the fp16 grad itself to
+    # inf and the scaler would (correctly) skip the step
+    scaler = GradScaler(init_loss_scaling=128.0)
+    try:
+        loss = (p.astype("float32") * 2.0).sum()
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")    # seeded cast churn noise
+            scaler.scale(loss).backward()
+            with warnings.catch_warnings(record=True) as w:
+                warnings.simplefilter("always")
+                scaler.step(sgd)               # proper protocol
+        msgs = [str(wi.message) for wi in w
+                if isinstance(wi.message, StaticCheckWarning)]
+        assert any("float16 parameter(s)" in m
+                   and "without master weights" in m for m in msgs), msgs
+    finally:
+        numerics.clear_scaler_events()
+
+
+def test_scaler_flow_clean_protocol_no_findings():
+    """scale -> backward -> scaler.step (which unscales + inf-checks)
+    over fp32 params: zero findings, window cleared."""
+    from paddle_tpu.amp import GradScaler
+    from paddle_tpu.analysis import numerics
+    p = paddle.to_tensor(np.ones((2, 2), "float32"))
+    p.stop_gradient = False
+    sgd = paddle.optimizer.SGD(learning_rate=0.1, parameters=[p])
+    scaler = GradScaler()
+    try:
+        loss = (p * 2.0).sum()
+        scaler.scale(loss).backward()
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            scaler.step(sgd)
+        assert not [wi for wi in w
+                    if isinstance(wi.message, StaticCheckWarning)]
+        assert numerics.scaler_events() == []
+    finally:
+        numerics.clear_scaler_events()
+
+
+def test_quant_budget_flags_bucket_then_passes_with_per_bucket_scale():
+    """Global-scale plan: the small-magnitude bucket inherits the big
+    bucket's step size and prices below the SNR floor; per-bucket
+    scales clear it. The EQuARX-style pre-flight gate."""
+    from paddle_tpu.analysis import numerics
+    buckets = numerics.quant_bucket_plan(
+        [("decoder.w", np.full((64,), 100.0, "float32")),
+         ("head.b", np.full((64,), 1e-3, "float32"))],
+        bucket_numel=64)                      # one bucket per tensor
+    assert [b["name"] for b in buckets] == ["decoder.w", "head.b"]
+    report = analysis.check_quant_budget(buckets, fmt="int8",
+                                         per_bucket_scale=False)
+    diags = report.by_checker("numerics.quant_error_budget")
+    assert len(diags) == 1, report.render()
+    d = diags[0]
+    assert "bucket 'head.b' (64 elems) prices" in d.message
+    assert "under int8 with global scale 100" in d.message
+    assert "dynamic range exceeds what the format resolves" in d.message
+    assert d.severity == "error"
+    with pytest.raises(StaticCheckError):
+        report.emit("error")
+    # per-bucket scaling re-prices each bucket against its own range
+    assert analysis.check_quant_budget(buckets, fmt="int8",
+                                       per_bucket_scale=True).ok
+    # a uniform bucket has rms == max_abs: SNR is scale-free and high
+    snr = analysis.quant_snr_db(100.0, 100.0, "int8")
+    assert snr > 40.0
+
+
+def test_numerics_clean_on_amp_linear_step():
+    """No false positives: a sane bf16 auto_cast forward records casts
+    and low-precision matmuls without tripping any numerics checker."""
+    import paddle_tpu.nn as nn
+    net = nn.Linear(8, 8)
+    x = _x((4, 8), seed=50)
+    with lazy.lazy_guard() as ctx:
+        with paddle.amp.auto_cast(level="O1", dtype="bfloat16"):
+            y = F.relu(net(x)).sum()
+        report = check_segment(ctx)
+        for checker in ("numerics.overflow_risk", "numerics.accum_dtype",
+                        "numerics.cast_churn"):
+            assert report.by_checker(checker) == [], report.render()
+        ctx._reset_segment()
+    del y
+
+
+def test_nan_trip_attaches_ranked_suspects_to_flight(tmp_path):
+    """A FLAGS_check_nan_inf trip at flush re-runs the numerics plane
+    over the offending segment: the flight dump names the suspect ops
+    (divide ranked first — it manufactures the non-finite) with their
+    file:line provenance, and the error message carries the producing
+    op's record-time source."""
+    from paddle_tpu import observability as obs
+    num = paddle.to_tensor(np.ones((4,), "float32"))
+    den = paddle.to_tensor(np.zeros((4,), "float32"))
+    with _with_flag("FLAGS_flight_recorder", True), \
+            _with_flag("FLAGS_flight_recorder_dir", str(tmp_path)):
+        with lazy.lazy_guard() as ctx:
+            q = (num / den) + 1.0             # inf manufactured here
+            with _with_flag("FLAGS_check_nan_inf", True):
+                with pytest.raises(FloatingPointError) as ei:
+                    ctx.flush()
+        msg = str(ei.value)
+        assert "divide" in msg or "add" in msg
+        assert "lazy segment output" in msg
+        assert "test_analysis.py" in msg      # _PendingOp.src landed
+        rec = obs.flight_record()
+        assert "nan_suspect" in rec
+        assert "divide" in rec
+        assert "test_analysis.py" in rec      # suspect provenance
+    del q
+
+
+def test_nan_eager_scan_names_call_site_with_sanitizer_off():
+    """Satellite: provenance survives the numerics plane being OFF —
+    the per-op eager scan captures the dispatching user frame on the
+    trip path (and only there)."""
+    x = paddle.to_tensor(np.array([1.0, np.inf], "float32"))
+    with _with_flag("FLAGS_static_checks", "off"):
+        with _with_flag("FLAGS_check_nan_inf", True):
+            with pytest.raises(FloatingPointError) as ei:
+                y = x * 2.0                   # per-op mode: eager scan
+    assert "test_analysis.py" in str(ei.value)
+    assert "multiply" in str(ei.value)
