@@ -192,6 +192,21 @@ Row 21 numerics plane gate   `--numerics --json` subprocess sweeps the
                                 warn-mode overhead us/op on the same
                                 chain (down-good)
 
+Row 22 fleet elasticity   in-process 6->8 grow drill (injected
+                                member::join, planner + sanitizer +
+                                grow_world + state broadcast publish)
+                                reports grow latency (membership ->
+                                first post-grow step, down-good) and a
+                                preempt-restore drill (preempt::notice
+                                -> immediate checkpoint -> fresh-
+                                trainer restore) reports recovery
+                                badput bounded by ONE checkpoint
+                                interval and priced in the goodput
+                                recovery bucket; faults-off leg (WITH
+                                async flush on) re-asserts the frozen
+                                resilience.* counter freeze over every
+                                NEW growth/preemption counter
+
 (Multi-chip GPT/ERNIE hybrids need a pod; their single-chip proxies are
 bench.py's headline + the dryrun_multichip compile check.)
 
@@ -2100,6 +2115,173 @@ def bench_numerics():
             "rows": rows}
 
 
+def bench_elastic_grow():
+    """Row 22: fleet elasticity. Three legs:
+
+    - faults-off freeze (WITH async flush on): an AdaptiveTrainer loop
+      wired for growth (joined_ranks set, checkpoint manager attached)
+      must keep EVERY resilience.* counter frozen — including all the
+      new growth/preemption ones (world_grows, grows, grow_bcast_*,
+      grow_joins, bcast_restores, preempt_notices, preempt_ckpts) —
+      when no event fires; the membership poll stays one module-level
+      bool.
+    - grow drill: an injected member::join grows a logical 6-mesh to 8
+      through the planner + sanitizer + grow_world + broadcast-publish
+      pipeline; the reported value is grow latency, membership change
+      -> first post-grow step (recompile priced in), down-good under
+      --diff.
+    - preempt-restore drill: FLAGS_checkpoint_interval_steps bounds
+      the interval-only badput to < interval steps; a preempt::notice
+      checkpoints IMMEDIATELY so the noticed badput is 0 steps; the
+      replacement's restore+replay wall is priced in the goodput
+      `recovery` bucket (asserted > 0) and rides --diff as ms
+      down-good."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu._core import async_flush
+    from paddle_tpu.distributed.mesh import ProcessMesh
+    from paddle_tpu.distributed.resilience import AdaptiveTrainer
+    from paddle_tpu.observability import goodput, metrics
+    from paddle_tpu.vision.models import LeNet
+
+    def build(world, **kw):
+        paddle.seed(0)
+        model = LeNet()
+        opt = paddle.optimizer.Adam(1e-3,
+                                    parameters=model.parameters())
+        rng = np.random.RandomState(0)
+        bx = paddle.to_tensor(
+            rng.randn(32, 1, 28, 28).astype(np.float32))
+        by = paddle.to_tensor(
+            rng.randint(0, 10, (32,)).astype(np.int64))
+        trainer = AdaptiveTrainer(
+            optimizer=opt,
+            mesh=ProcessMesh(list(range(world)), dim_names=["dp"]),
+            **kw)
+
+        def step():
+            loss = F.cross_entropy(model(bx), by)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss._value
+
+        return trainer, step
+
+    def res_counters():
+        return {k: v for k, v in metrics.snapshot()["counters"].items()
+                if k.startswith("resilience.")}
+
+    # ---------------- faults-off freeze over the NEW counters
+    trainer, step = build(6, joined_ranks=[6, 7])
+    paddle.set_flags({"FLAGS_async_flush": True})
+    try:
+        np.asarray(trainer.run(step))        # settle compiles
+        async_flush.drain()
+        before = res_counters()
+        _timeit(lambda: trainer.run(step), steps=5, warmup=0)
+        async_flush.drain()
+        after = res_counters()
+        assert after == before, \
+            f"faults-off growth-wired loop did resilience work: " \
+            f"{before} -> {after}"
+    finally:
+        paddle.set_flags({"FLAGS_async_flush": False})
+
+    # ---------------- grow drill: 6 -> 8 through the full pipeline
+    paddle.set_flags({"FLAGS_fault_inject": "member::join@2=die"})
+    try:
+        for _ in range(3):
+            np.asarray(trainer.run(step))
+    finally:
+        paddle.set_flags({"FLAGS_fault_inject": ""})
+    assert trainer.grows == 1 and trainer.last_grow_latency_s, \
+        "no grow measured"
+    assert trainer.mesh.size == 8
+    grow_ms = round(trainer.last_grow_latency_s * 1000.0, 2)
+
+    # ---------------- preempt-restore drill
+    interval = 3
+    kill_step = 8
+    ckpt_dir = tempfile.mkdtemp(prefix="ptxc_preempt_")
+    paddle.set_flags({"FLAGS_checkpoint_interval_steps": interval})
+    try:
+        # leg A: interval checkpoints only — lost work < one interval
+        t_a, s_a = build(8, checkpoint_dir=ckpt_dir)
+        for _ in range(kill_step):
+            np.asarray(t_a.run(s_a))         # saves at steps 3 and 6
+        t_a.shutdown()                        # "SIGKILL" at step 8
+        paddle.set_flags({"FLAGS_goodput": True})
+        try:
+            t0 = time.perf_counter()
+            goodput.recovery_begin()
+            fresh, s_f = build(8, checkpoint_dir=ckpt_dir)
+            fresh.restore_from_checkpoint()
+            badput_steps = kill_step - fresh.step_index
+            while fresh.step_index < kill_step:   # replay = badput
+                np.asarray(fresh.run(s_f))
+            goodput.recovery_end()
+            recover_ms = (time.perf_counter() - t0) * 1000.0
+            bucket = goodput.snapshot()["buckets"]["recovery"]
+            assert bucket > 0, \
+                "recovery wall not priced in the goodput bucket"
+        finally:
+            paddle.set_flags({"FLAGS_goodput": False})
+        assert 0 < badput_steps < interval, \
+            f"interval-only badput {badput_steps} not bounded by " \
+            f"the {interval}-step checkpoint interval"
+        fresh.shutdown()
+
+        # leg B: a preemption NOTICE checkpoints immediately — the
+        # replacement resumes at the kill step, zero lost steps
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+        t_b, s_b = build(8, checkpoint_dir=ckpt_dir)
+        notices = metrics.counter("resilience.preempt_notices").value
+        paddle.set_flags({"FLAGS_fault_inject":
+                          f"preempt::notice@{kill_step}=fail"})
+        try:
+            for _ in range(kill_step):
+                np.asarray(t_b.run(s_b))
+        finally:
+            paddle.set_flags({"FLAGS_fault_inject": ""})
+        assert metrics.counter("resilience.preempt_notices").value \
+            == notices + 1
+        assert t_b.preempt_checkpoints == 1
+        t_b.shutdown()
+        fresh_b, s_fb = build(8, checkpoint_dir=ckpt_dir)
+        fresh_b.restore_from_checkpoint()
+        noticed_badput = (kill_step - 1) - fresh_b.step_index
+        assert noticed_badput == 0, \
+            f"preemption notice left {noticed_badput} lost step(s)"
+        fresh_b.shutdown()
+    finally:
+        paddle.set_flags({"FLAGS_checkpoint_interval_steps": 0})
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+    trainer.shutdown()
+
+    return {"metric": "elastic grow latency (6->8 member::join, "
+                      "membership change -> first post-grow step; "
+                      "faults-off = frozen resilience.* counters over "
+                      "every growth/preemption counter, async flush "
+                      "on)",
+            "value": grow_ms,
+            "unit": "ms",
+            "grow_plan": {k: trainer.last_plan.get(k) for k in
+                          ("dp_degree", "mp_degree", "pp_degree")},
+            "interval_badput_steps": badput_steps,
+            "noticed_badput_steps": noticed_badput,
+            "checkpoint_interval_steps": interval,
+            "recovery_bucket_us": round(bucket, 1),
+            "rows": [{"metric": "preempt-restore recovery wall "
+                                "(verified-generation restore + "
+                                "replay, goodput recovery bucket)",
+                      "value": round(recover_ms, 2), "unit": "ms"}]}
+
+
 def _rows_of(path: str) -> dict:
     """metric -> (value, unit) extracted from one driver BENCH_*.json
     (json lines live in its 'tail' string; the headline row carries
@@ -2228,7 +2410,7 @@ def main():
         return
     rows = os.environ.get(
         "BENCH_ROWS",
-        "1,2,3,4,5,6,7,8,9,10,11,12,13,14,15,16,17,18,19,20,21"
+        "1,2,3,4,5,6,7,8,9,10,11,12,13,14,15,16,17,18,19,20,21,22"
         ).split(",")
     table = {"1": bench_lenet, "2": bench_resnet50, "3": bench_bert,
              "4": bench_dispatch, "5": bench_static_checks,
@@ -2239,7 +2421,8 @@ def main():
              "14": bench_compute, "15": bench_mem_lint,
              "16": bench_goodput, "17": bench_record_fastpath,
              "18": bench_warm_restart, "19": bench_plan,
-             "20": bench_monitor, "21": bench_numerics}
+             "20": bench_monitor, "21": bench_numerics,
+             "22": bench_elastic_grow}
     for r in rows:
         r = r.strip()
         out = table[r]()

@@ -375,6 +375,17 @@ define_flag("FLAGS_checkpoint_keep", 3,
             "disk (older generations pruned after each save; load "
             "auto-falls-back to the newest verified older generation "
             "when the latest fails its checksum).")
+define_flag("FLAGS_checkpoint_interval_steps", 0,
+            "AdaptiveTrainer: auto-checkpoint every N step boundaries "
+            "through the retention manager (0 = off). Bounds the "
+            "preemption-recovery badput to one interval without a "
+            "call-site convention; a trainer built with an explicit "
+            "checkpoint_every overrides the flag.")
+define_flag("FLAGS_elastic_grow_chunk_kb", 512,
+            "grow_world state broadcast: TCPStore chunk size in KiB for "
+            "the survivor->joiner state transfer (each chunk is "
+            "sha256-checksummed; the whole payload is verified before "
+            "unpickling).")
 
 # Cached module-level gate for the fault-injection hot-path hooks
 # (store ops, collectives, segment compile, elastic steps): True iff

@@ -79,9 +79,14 @@ def _measure_in_process(config: Dict, steps: int = 5,
         pm = auto_mesh(*[d for _, d in mesh_axes],
                        dim_names=[nm for nm, _ in mesh_axes])
         mesh = pm.jax_mesh()
-        init_fn, step = build_train_step(model_cfg, mesh=mesh, lr=1e-4,
-                                         remat=bool(config.get(
-                                             "recompute", True)))
+        # unroll on CPU: XLA:CPU's SPMD partitioner rejects the layer
+        # scan's transpose under mp>1 sharding (s64/s32 compare in the
+        # dynamic_update_slice index, HLO-verifier failure) — the
+        # unrolled program measures the same math
+        init_fn, step = build_train_step(
+            model_cfg, mesh=mesh, lr=1e-4,
+            remat=bool(config.get("recompute", True)),
+            unroll_layers=(jax.default_backend() != "tpu"))
         state = init_fn(0)
         gb = int(config.get("global_batch_size", max(8, dp)))
         seq = int(config.get("seq_len", 256))

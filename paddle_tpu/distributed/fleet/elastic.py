@@ -56,6 +56,7 @@ class ElasticManager:
         self.on_membership_change = on_membership_change
         self.epoch = 0
         self.members: List[str] = []
+        self._preempt_seen = 0
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
 
@@ -142,6 +143,37 @@ class ElasticManager:
         """New node: make the master aware of this node id."""
         seq = self.store.add("__elastic/announce_count", 1)
         self.store.set(f"__elastic/announce/{seq}", self.node_id)
+
+    # ----------------------------------------------------- preemption
+    def announce_preemption(self, node_id: Optional[str] = None):
+        """Publish a preemption NOTICE for `node_id` (default: this
+        node) — the cloud scheduler's grace-period signal, relayed
+        through the store so every trainer's step-boundary poll sees
+        it and checkpoints immediately (AdaptiveTrainer's
+        `preempt::notice` reaction). Same counter-then-key scheme as
+        `announce`, so notices are ordered and none is lost."""
+        seq = self.store.add("__elastic/preempt_count", 1)
+        self.store.set(f"__elastic/preempt/{seq}",
+                       node_id or self.node_id)
+        return seq
+
+    def poll_preemption(self) -> List[str]:
+        """Node ids with NEW preemption notices since the last poll
+        (empty almost always — one `add(.., 0)` probe on the shared
+        counter). Each notice is returned exactly once per manager."""
+        try:
+            cnt = self.store.add("__elastic/preempt_count", 0)
+        except Exception:
+            return []
+        out: List[str] = []
+        while self._preempt_seen < cnt:
+            raw = self._probe(
+                f"__elastic/preempt/{self._preempt_seen + 1}")
+            if raw is None:
+                break   # counter visible before key: next poll
+            self._preempt_seen += 1
+            out.append(raw.decode())
+        return out
 
     def _alive(self, node: str) -> bool:
         raw = self._probe(f"__elastic/node/{node}")

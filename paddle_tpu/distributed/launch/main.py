@@ -49,7 +49,8 @@ def _parse_args(argv=None):
                        flag_value("FLAGS_launch_max_restarts"))) or 0,
         help="relaunch the pod up to N times on worker failure "
              "(elastic manager restart behavior)")
-    p.add_argument("--elastic_mode", choices=("collapse", "shrink"),
+    p.add_argument("--elastic_mode",
+                   choices=("collapse", "shrink", "grow"),
                    default="collapse",
                    help="worker-failure policy: 'collapse' (default) "
                         "tears the pod down and restarts/propagates; "
@@ -57,11 +58,21 @@ def _parse_args(argv=None):
                         "least --min_np survive — the survivors keep "
                         "running (and re-plan via their own "
                         "ElasticManager/AdaptiveTrainer membership "
-                        "epochs) instead of being restarted")
+                        "epochs) instead of being restarted; 'grow' "
+                        "is shrink plus HOT SPARES: up to --max_np "
+                        "workers are spawned, the extras marked "
+                        "PADDLE_ELASTIC_SPARE=1 — they warm their XLA "
+                        "caches outside the mesh and are admitted by "
+                        "the ElasticManager master when a preemption "
+                        "or grow event makes room")
     p.add_argument("--min_np", type=int, default=0,
-                   help="shrink mode: minimum live workers per node; "
-                        "0 = all must survive (shrink tolerates "
+                   help="shrink/grow mode: minimum live workers per "
+                        "node; 0 = all must survive (tolerates "
                         "nothing)")
+    p.add_argument("--max_np", type=int, default=0,
+                   help="grow mode: total workers to spawn per node "
+                        "(hot spares = max_np - nproc_per_node); 0 or "
+                        "<= nproc_per_node = no spares")
     p.add_argument("script", type=str)
     p.add_argument("script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
@@ -88,11 +99,25 @@ def _worker_env(args, node_rank: int, local_rank: int, world: int,
     return env
 
 
+def _nspawn(args) -> int:
+    """Workers spawned per node: nproc_per_node, plus hot spares up to
+    --max_np in grow mode."""
+    if args.elastic_mode == "grow" and args.max_np > args.nproc_per_node:
+        return args.max_np
+    return args.nproc_per_node
+
+
 def _spawn_pod(args, node_rank: int, world: int, endpoints, epoch: int):
     os.makedirs(args.log_dir, exist_ok=True)
     procs = []
-    for lr in range(args.nproc_per_node):
+    for lr in range(_nspawn(args)):
         env = _worker_env(args, node_rank, lr, world, endpoints, epoch)
+        if lr >= args.nproc_per_node:
+            # hot spare: outside the initial mesh — the worker script
+            # gates on this env (warm caches, announce to the elastic
+            # master, wait for admission) instead of joining rank 0's
+            # initial rendezvous
+            env["PADDLE_ELASTIC_SPARE"] = "1"
         log = open(os.path.join(
             args.log_dir,
             f"workerlog.{node_rank}.{lr}.e{epoch}"), "w")
@@ -130,10 +155,11 @@ def _watch_pod(procs, master=None, epoch: int = 0, args=None):
     launcher records the loss and keeps watching, and the surviving
     trainers (who see the death through their own ElasticManager
     heartbeats) re-plan and keep training. Only dropping below min_np
-    fails the pod."""
-    shrink = args is not None and args.elastic_mode == "shrink"
+    fails the pod. Grow mode watches the same way (spares that exit
+    cleanly after admission-and-finish don't fail the pod either)."""
+    shrink = args is not None and args.elastic_mode in ("shrink", "grow")
     nproc = len(procs)
-    min_np = (args.min_np or nproc) if shrink else 0
+    min_np = (args.min_np or args.nproc_per_node) if shrink else 0
     lost = []
     last_remote_check = 0.0
     while procs:
@@ -194,10 +220,11 @@ def _node_host(master_host: str) -> str:
 def main(argv=None):
     args = _parse_args(argv)
     world = args.nnodes * args.nproc_per_node
+    nsp = _nspawn(args)   # per-node spawn count incl. hot spares
     master_ep = args.master or "127.0.0.1:6170"
     host, port = (master_ep.split(":") + ["6170"])[:2]
 
-    if world == 1:
+    if world == 1 and nsp == 1:
         # single process: exec in-place (fast path, no fork)
         endpoints = [f"{host}:{port}"]
         os.environ.update(_worker_env(args, 0, 0, 1, endpoints, 0))
@@ -222,11 +249,10 @@ def main(argv=None):
             # rerank-on-restart for free; each node advertises its OWN
             # address
             my_ep = (f"{_node_host(host)}:"
-                     f"{int(port) + 1 + args.node_rank * args.nproc_per_node}")
-            node_rank = master.register_node(epoch, my_ep,
-                                             args.nproc_per_node)
+                     f"{int(port) + 1 + args.node_rank * nsp}")
+            node_rank = master.register_node(epoch, my_ep, nsp)
             peers = master.wait_peers(epoch)
-            if any(np_ != args.nproc_per_node for _, np_ in peers):
+            if any(np_ != nsp for _, np_ in peers):
                 # rank/world arithmetic assumes a homogeneous pod; fence
                 # the exit so a peer mid-rendezvous doesn't hit a dead
                 # store
@@ -239,10 +265,12 @@ def main(argv=None):
             endpoints = global_endpoints(peers)
         else:
             node_rank = args.node_rank
+            # endpoints cover the FULL spawn set (spares included in
+            # grow mode) so an admitted spare has a real address
             endpoints = [
-                f"{host}:{int(port) + n * args.nproc_per_node + p_}"
+                f"{host}:{int(port) + n * nsp + p_}"
                 for n in range(args.nnodes)
-                for p_ in range(args.nproc_per_node)]
+                for p_ in range(nsp)]
 
         procs = _spawn_pod(args, node_rank, world, endpoints, epoch)
         try:

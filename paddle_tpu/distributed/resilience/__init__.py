@@ -8,19 +8,27 @@ existing sensors — watchdog, TCPStore rendezvous, checkpoint):
 - `ElasticStep`  step snapshot + rollback + watchdog coverage
 - `shrink_world` mesh/process-group rebuild over surviving ranks,
   sanitizer-validated before the first post-recovery step
+- `grow_world` / `growth` (growth.py)  the inverse direction: a
+  joining rank rendezvouses (`join_world`) under a new membership
+  epoch and receives state via a chunked, checksummed TCPStore
+  broadcast (`publish_state`/`receive_state`) — falling back to the
+  newest verified checkpoint when the broadcast is unusable
 - `AdaptiveTrainer` (adaptive.py)  membership-change re-PLANNING: on
-  rank loss the auto-tuner picks a survivor-feasible dp/mp/pp
+  rank loss OR join the auto-tuner picks a feasible dp/mp/pp
   strategy, the sanitizer validates it, state reshards (or reloads a
-  verified checkpoint generation) and the step cache re-keys
+  verified checkpoint generation) and the step cache re-keys;
+  preemption notices trigger an immediate verified checkpoint
 """
 from __future__ import annotations
 
 from . import faults  # noqa: F401
+from . import growth  # noqa: F401
 from . import retry  # noqa: F401
 from .faults import (CollectiveTimeout, FaultError, FaultPlan,  # noqa: F401
                      RankDeath, TransientFault)
 from .retry import RetryPolicy  # noqa: F401
-from .elastic import (ElasticStep, plan_shrink,  # noqa: F401
-                      shrink_world)
+from .elastic import (ElasticStep, grow_world, plan_grow,  # noqa: F401
+                      plan_shrink, shrink_world)
+from .growth import join_world  # noqa: F401
 from .adaptive import (AdaptiveTrainer, MembershipEvent,  # noqa: F401
                        Replanner, mesh_for_plan, stage_rank_map)

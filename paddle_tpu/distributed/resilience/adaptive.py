@@ -49,7 +49,7 @@ import numpy as np
 
 from ..._core import flags as _flags
 from .elastic import (ElasticStep, _RETRYABLE_STEP, _shrunk_placements,
-                      shrink_world)
+                      grow_world, shrink_world)
 from .faults import FaultError, RankDeath
 
 
@@ -225,6 +225,8 @@ class AdaptiveTrainer:
                  program_view=None,
                  manager=None,
                  lost_ranks: Union[Sequence[int], Callable, None] = None,
+                 joined_ranks: Union[Sequence[int], Callable,
+                                     None] = None,
                  pipeline: Optional[tuple] = None,
                  checkpoint_dir: Optional[str] = None,
                  checkpoint_every: int = 0,
@@ -252,6 +254,7 @@ class AdaptiveTrainer:
             self._last_epoch = int(m.get("epoch", 0))
             self._members = list(m.get("members", []))
         self._lost_ranks = lost_ranks
+        self._joined_ranks = joined_ranks
         self._pipeline = pipeline
         self.ckpt = None
         if checkpoint_dir:
@@ -259,6 +262,14 @@ class AdaptiveTrainer:
             self.ckpt = CheckpointManager(checkpoint_dir)
         self._ckpt_every = int(checkpoint_every)
         self.replans = 0
+        self.grows = 0
+        # membership-event latency lands in this histogram at the
+        # first post-event step: replan_us for shrink events, grow_us
+        # for adopted growth (membership change -> first post-grow
+        # step, the bench-row-22 number)
+        self._latency_hist = "resilience.replan_us"
+        self.last_grow_latency_s: Optional[float] = None
+        self.preempt_checkpoints = 0
         self.last_plan: Optional[Dict] = None
         # stage index -> sorted survivor ranks hosting it, rebuilt from
         # the planned mesh's pp axis on every adopted re-plan (a 1-D or
@@ -312,9 +323,9 @@ class AdaptiveTrainer:
 
     # ----------------------------------------------------- event intake
     def _poll_events(self):
-        """Step-boundary membership poll: injected member:: sites
-        first (deterministic drills), then the manager's published
-        epoch."""
+        """Step-boundary membership poll: injected member:: /
+        preempt:: sites first (deterministic drills), then the
+        manager's published epoch and preemption announcements."""
         if _flags.FAULT_INJECT_ACTIVE:
             from . import faults
             try:
@@ -325,10 +336,19 @@ class AdaptiveTrainer:
                     lost=self._resolve_lost(e), source="fault"))
             try:
                 faults.inject("member::join")
-            except FaultError:
+            except FaultError as e:
                 self._membership_event(MembershipEvent(
                     self._last_epoch + 1, self._members,
-                    joined=["<injected>"], source="fault"))
+                    joined=self._resolve_joined(e), source="fault"))
+            try:
+                faults.inject("preempt::notice")
+            except FaultError:
+                self._preempt_notice("fault")
+        if self._manager is not None:
+            notices = getattr(self._manager, "poll_preemption",
+                              lambda: [])()
+            for _node in notices:
+                self._preempt_notice("manager")
         if self._manager is not None:
             m = self._manager.current_membership()
             epoch = int(m.get("epoch", 0))
@@ -359,6 +379,18 @@ class AdaptiveTrainer:
             return list(self._lost_ranks)
         raise e   # cannot tell who died: propagate the death
 
+    def _resolve_joined(self, e: BaseException) -> List:
+        """WHICH process ids joined, for an injected member::join: a
+        static list or callable, symmetric with `lost_ranks`. Without
+        one the event is recorded but cannot grow the mesh (no way to
+        name the new ranks) — the pre-growth counted-not-replanned
+        behavior."""
+        if callable(self._joined_ranks):
+            return list(self._joined_ranks(e))
+        if self._joined_ranks is not None:
+            return list(self._joined_ranks)
+        return ["<injected>"]
+
     def _on_rank_death(self, e: RankDeath):
         """ElasticStep's rank-death hook: state was already restored to
         the pre-step snapshot; drop the aborted trace and re-plan for
@@ -374,6 +406,7 @@ class AdaptiveTrainer:
         from ...observability import metrics
         metrics.inc("resilience.member_epochs")
         self._replan_t0 = time.perf_counter()
+        self._latency_hist = "resilience.replan_us"
         self._replan_persist0 = metrics.counter("cache.persist.hit").value
         prev_epoch, prev_members = self._last_epoch, self._members
         self._last_epoch = ev.epoch
@@ -386,11 +419,28 @@ class AdaptiveTrainer:
                         lost=list(ev.lost), joined=list(ev.joined),
                         source=ev.source)
         if ev.joined and not ev.lost:
-            # growth needs fresh processes to host state — that is a
-            # relaunch-from-checkpoint decision above this loop; the
-            # event is recorded (epoch adopted, counter, flight) and
-            # training continues on the current plan.
-            self._replan_t0 = None
+            # join-driven GROWTH: resolve the joining node ids to
+            # process ranks and re-plan the bigger world. A join whose
+            # ranks cannot be named (an injected "<injected>" with no
+            # joined_ranks hook) is recorded (epoch adopted, counter,
+            # flight) and training continues on the current plan — the
+            # pre-growth behavior, never a guess.
+            joined = self._joined_to_ranks(ev)
+            if not joined or self.mesh is None:
+                self._replan_t0 = None
+                return
+            self._latency_hist = "resilience.grow_us"
+            try:
+                self._grow_and_apply(joined, ev, drop_inflight)
+            except BaseException:
+                # a FAILED grow must not consume the event: epoch back,
+                # so the next poll re-observes it (and the joiner's
+                # fallback stays relaunch-from-checkpoint)
+                self._last_epoch, self._members = \
+                    prev_epoch, prev_members
+                self._replan_t0 = None
+                self._latency_hist = "resilience.replan_us"
+                raise
             return
         lost = [r for r in ev.lost
                 if self.mesh is None
@@ -509,6 +559,157 @@ class AdaptiveTrainer:
         if sp is not None:
             sp.end()
 
+    # --------------------------------------------------------- the grow
+    def _joined_to_ranks(self, ev: MembershipEvent) -> List[int]:
+        """Joining node ids -> NEW process ranks: ints (or int-like
+        node ids) not already in the mesh. Non-numeric ids with no
+        `joined_ranks` hook resolve to nothing — growth needs real
+        rank numbers to extend the mesh."""
+        current = set(int(p) for p in self.mesh.process_ids) \
+            if self.mesh is not None else set()
+        out = []
+        for n in ev.joined:
+            try:
+                r = int(n)
+            except (TypeError, ValueError):
+                continue
+            if r not in current:
+                out.append(r)
+        return sorted(set(out))
+
+    def _grow_and_apply(self, joined: List[int], ev: MembershipEvent,
+                        drop_inflight: bool = False):
+        """The growth mirror of `_replan_and_apply`: quiesce, re-plan
+        the GROWN world through the same planner/tuner tiers, validate
+        through the sanitizer sweep (unconditional error mode), re-lay
+        the live state out over old+joined via `grow_world`, publish
+        the state broadcast for the joiner, re-key the step cache. One
+        recompile, absorbed by the persistent executable cache."""
+        from ...observability import _state as _OBS
+        from ...observability import metrics
+        sp = None
+        if _OBS.ACTIVE:
+            from ...observability.spans import span
+            sp = span("resilience::grow",
+                      hist="resilience.grow_apply_us",
+                      joined=list(joined), source=ev.source).begin()
+        try:
+            self._quiesce(drop=drop_inflight)
+            everyone = sorted(
+                set(int(p) for p in self.mesh.process_ids)
+                | set(joined))
+            plan = self._replanner.replan(len(everyone))
+            new_mesh = mesh_for_plan(everyone, plan)
+            pipeline = self._pipeline
+            if pipeline is None and "pp" in new_mesh.dim_names:
+                pipeline = ("1F1B", 2 * new_mesh.get_dim_size("pp"))
+            state = {(p.name or f"p{i}"): p
+                     for i, p in enumerate(self._params)}
+            from ...analysis.diagnostics import StaticCheckError
+            try:
+                # validates every transition (sanitizer, error mode)
+                # BEFORE moving data, then reshards params + optimizer
+                # state over the grown mesh
+                grow_world(self.mesh, joined, state,
+                           optimizer=self._opt,
+                           pipeline=pipeline,
+                           target_mesh=new_mesh)
+            except StaticCheckError:
+                # the sanitizer REFUSED the grown plan itself — see
+                # _replan_and_apply: never bypass validate-before-move
+                raise
+            except Exception:
+                if self.ckpt is None or self.ckpt.latest() is None:
+                    raise
+                self._adopt_layout(new_mesh)
+                self.restore_from_checkpoint()
+            old_mesh = self.mesh
+            self.mesh = new_mesh
+            self.last_plan = plan
+            self.last_stage_map = stage_rank_map(new_mesh)
+            self.grows += 1
+            metrics.inc("resilience.grows")
+            # the joiner's fast path: publish the full state under the
+            # adopted epoch so the fresh process restores without a
+            # checkpoint round-trip (failure here must not fail the
+            # survivors' grow — the joiner's fallback IS the newest
+            # verified checkpoint generation)
+            self._broadcast_state(ev.epoch)
+            from .. import spmd as _spmd
+            st = _spmd.state()
+            if st is not None and st.pmesh is old_mesh:
+                # survivors inside a `with auto_mesh(...)` block: the
+                # ambient still wraps the PRE-GROW mesh — its device
+                # set and cache-key component would pin every
+                # post-grow compile to the small world
+                _spmd.rebuild_ambient(new_mesh)
+            from ..._core import lazy
+            lazy.bump_mesh_epoch()
+            if _OBS.FLIGHT:
+                from ...observability import flight
+                flight.note("adaptive", "grow",
+                            world=len(everyone),
+                            joined=list(joined),
+                            dp=plan.get("dp_degree", 1),
+                            mp=plan.get("mp_degree", 1),
+                            pp=plan.get("pp_degree", 1))
+        except BaseException as e:
+            if sp is not None:
+                sp.end(error=e)
+            raise
+        if sp is not None:
+            sp.end()
+
+    def _broadcast_state(self, epoch: int):
+        """Best-effort survivor->joiner state publication through the
+        manager's TCPStore (growth.publish_state: chunked, sha256
+        checksummed, retry-wrapped). Sharded tensors go as HOST
+        arrays — the joiner lays them out against its own grown
+        mesh."""
+        store = getattr(self._manager, "store", None)
+        if store is None:
+            return
+        try:
+            host: Dict = {}
+            for k, v in self._full_state().items():
+                if hasattr(v, "_value"):
+                    v = np.asarray(v._value)
+                host[k] = v
+            from . import growth as _growth
+            _growth.publish_state(store, host, epoch)
+        except Exception as e:
+            import warnings
+            warnings.warn(
+                f"grow state broadcast failed ({e}); the joiner falls "
+                f"back to the newest verified checkpoint",
+                RuntimeWarning, stacklevel=2)
+
+    # ------------------------------------------------------- preemption
+    def _preempt_notice(self, source: str):
+        """React to a preemption NOTICE (injected `preempt::notice` or
+        an `ElasticManager.announce_preemption` poll): save one
+        immediate verified checkpoint through the retention manager —
+        riding the existing `ckpt::save` span, so the wall lands in
+        the goodput `ckpt_io` bucket — bounding the replacement's lost
+        work to the notice-to-kill window instead of a full
+        checkpoint interval."""
+        from ...observability import metrics
+        metrics.inc("resilience.preempt_notices")
+        from ...observability import _state as _OBS
+        if _OBS.FLIGHT:
+            from ...observability import flight
+            flight.note("adaptive", "preempt_notice", source=source,
+                        step=self._elastic.step_index)
+        if self.ckpt is None:
+            return
+        gen = self.save_checkpoint()
+        self.preempt_checkpoints += 1
+        metrics.inc("resilience.preempt_ckpts")
+        if _OBS.FLIGHT:
+            from ...observability import flight
+            flight.note("adaptive", "preempt_ckpt", generation=gen,
+                        step=self._elastic.step_index)
+
     def _adopt_layout(self, new_mesh):
         """Point every mesh-resident param at its planned placement on
         `new_mesh` WITHOUT moving data — the follow-up checkpoint load
@@ -569,7 +770,6 @@ class AdaptiveTrainer:
         corrupted-generation fallback; this applies the loaded leaves
         back to the optimizer dictionaries keyed by the LIVE param
         ids."""
-        import jax.numpy as jnp
         if self.ckpt is None:
             raise ValueError("AdaptiveTrainer has no checkpoint_dir")
         # augment_missing: a fresh optimizer has no moment entries yet,
@@ -579,6 +779,57 @@ class AdaptiveTrainer:
         st = self._full_state()
         gen = self.ckpt.load(st, generation=generation,
                              augment_missing=True)
+        self._apply_aux_state(st)
+        from ...observability import metrics
+        metrics.inc("resilience.ckpt_restores")
+        from ...observability import _state as _OBS
+        if _OBS.FLIGHT:
+            from ...observability import flight
+            flight.note("adaptive", "ckpt_restore", generation=gen)
+        return gen
+
+    def restore_from_broadcast(self, store, epoch: int, *,
+                               timeout: float = 30.0):
+        """Joining rank: receive the survivors' state broadcast for
+        the adopted growth epoch (growth.receive_state — chunked,
+        checksummed, retry-wrapped) and apply it to the live
+        model/optimizer/RNG, laying each param out against its OWN
+        current dist attr (the joiner built them on the grown mesh).
+        Raises `retry.StoreOpError` when the broadcast is missing or
+        fails verification — the caller's fallback is
+        `restore_from_checkpoint`."""
+        import jax
+        import jax.numpy as jnp
+        from . import growth as _growth
+        st = _growth.receive_state(store, epoch, timeout=timeout)
+        from ..api import placements_to_spec
+        for i, p in enumerate(self._params):
+            v = st.get(f"param::{i}")
+            if v is None:
+                continue
+            arr = jnp.asarray(v, dtype=p._value.dtype)
+            attr = getattr(p, "_dist_attr", None)
+            if attr is not None:
+                spec = placements_to_spec(attr.placements,
+                                          attr.process_mesh, arr.ndim)
+                arr = jax.device_put(
+                    arr, attr.process_mesh.named_sharding(spec))
+            p._replace_value_inplace(arr)
+        self._apply_aux_state(st)
+        from ...observability import metrics
+        metrics.inc("resilience.bcast_restores")
+        from ...observability import _state as _OBS
+        if _OBS.FLIGHT:
+            from ...observability import flight
+            flight.note("adaptive", "bcast_restore", epoch=int(epoch))
+        return st
+
+    def _apply_aux_state(self, st: Dict):
+        """Apply the non-param leaves of a loaded/received state
+        mapping — optimizer moments/master/step count, LR scheduler,
+        RNG, step index — to the live objects, keyed by param INDEX
+        (the _full_state key scheme)."""
+        import jax.numpy as jnp
         opt = self._opt
         if opt is not None:
             states: Dict = {}
@@ -613,13 +864,6 @@ class AdaptiveTrainer:
         # their original step:: site numbering and save() step metadata
         if st.get("meta::step_index") is not None:
             self._elastic.step_index = int(st["meta::step_index"])
-        from ...observability import metrics
-        metrics.inc("resilience.ckpt_restores")
-        from ...observability import _state as _OBS
-        if _OBS.FLIGHT:
-            from ...observability import flight
-            flight.note("adaptive", "ckpt_restore", generation=gen)
-        return gen
 
     # --------------------------------------------------------------- run
     def run(self, step_fn: Callable, *args, **kw):
@@ -654,8 +898,14 @@ class AdaptiveTrainer:
                 time.perf_counter() - self._replan_t0
             self._replan_t0 = None
             from ...observability import metrics
-            metrics.observe("resilience.replan_us",
+            # grow events land in resilience.grow_us (membership
+            # change -> first post-grow step), shrink/replan events in
+            # resilience.replan_us
+            metrics.observe(self._latency_hist,
                             self.last_replan_latency_s * 1e6)
+            if self._latency_hist == "resilience.grow_us":
+                self.last_grow_latency_s = self.last_replan_latency_s
+            self._latency_hist = "resilience.replan_us"
             if self._replan_persist0 is not None:
                 # disk executables loaded instead of recompiled across
                 # this event -> first-good-step window (0 on a cold
@@ -673,7 +923,12 @@ class AdaptiveTrainer:
                                 latency_us=int(
                                     self.last_replan_latency_s * 1e6),
                                 persist_hits=hits)
-        if self.ckpt is not None and self._ckpt_every > 0 \
-                and self._elastic.step_index % self._ckpt_every == 0:
+        # periodic cadence: the ctor's checkpoint_every wins; 0 falls
+        # through to FLAGS_checkpoint_interval_steps (0 = off) so the
+        # preemption badput bound is a flag, not a call-site convention
+        every = self._ckpt_every or int(
+            _flags.flag_value("FLAGS_checkpoint_interval_steps") or 0)
+        if self.ckpt is not None and every > 0 \
+                and self._elastic.step_index % every == 0:
             self.save_checkpoint()
         return out

@@ -462,3 +462,129 @@ def shrink_world(mesh, lost_process_ids: Sequence[int],
                     old=mesh.size, new=new_mesh.size,
                     lost=list(lost_process_ids), resharded=len(tensors))
     return new_mesh
+
+
+# --------------------------------------------------------- world grow
+
+def plan_grow(mesh, joined_process_ids: Sequence[int]):
+    """The grown ProcessMesh: the inverse of `plan_shrink`. Grows
+    along the FIRST mesh axis when the new world count still factors
+    over the trailing axes (dp-style capacity add keeps the mesh rank
+    and dim names); otherwise flattens to a 1-D mesh over everyone.
+    Joined ids must be disjoint from the current mesh."""
+    import numpy as np
+    from ..mesh import ProcessMesh
+    joined = sorted(set(int(r) for r in joined_process_ids))
+    current = set(int(p) for p in mesh.process_ids)
+    dup = current & set(joined)
+    if dup or not joined:
+        from ...base.core import EnforceNotMet
+        raise EnforceNotMet(
+            f"world grow needs a non-empty joining set disjoint from "
+            f"the mesh {mesh!r} (joined {joined}, already present "
+            f"{sorted(dup)})")
+    everyone = sorted(current | set(joined))
+    shape = mesh.shape
+    trailing = 1
+    for s in shape[1:]:
+        trailing *= s
+    n = len(everyone)
+    if len(shape) > 1 and trailing and n % trailing == 0 \
+            and n // trailing >= 1:
+        new_shape = [n // trailing] + shape[1:]
+        names = mesh.dim_names
+    else:
+        new_shape = [n]
+        names = [mesh.dim_names[0]]
+    return ProcessMesh(np.asarray(everyone).reshape(new_shape), names)
+
+
+def grow_world(mesh, joined_process_ids: Sequence[int],
+               state: Optional[Dict] = None, *,
+               optimizer=None,
+               pipeline: Optional[tuple] = None,
+               set_global: bool = True,
+               target_mesh=None):
+    """Rebuild the world over current + joining ranks after a
+    membership-growth event: the inverse of `shrink_world`, through
+    the SAME validate-then-move gate. Plans the grown mesh (or adopts
+    the re-planner's `target_mesh`, which must cover exactly the old
+    ranks plus `joined_process_ids`), has the sanitizer's distributed
+    checkers validate every reshard transition (and the grown
+    pipeline schedule, when `pipeline=(schedule, num_micro[, chunks])`
+    is given) in unconditional error mode BEFORE any transfer runs,
+    then re-lays-out each sharded tensor in `state` in place via the
+    reshard registry. `optimizer` state leaves and master weights
+    follow their param's new layout. Returns the new ProcessMesh.
+
+    The joining rank itself receives the resharded state separately —
+    survivor broadcast through the TCPStore (growth.py) or a
+    relaunch-from-newest-verified-checkpoint; this function is the
+    survivors' half (and, run under the single-controller model, lays
+    every shard out over the full grown device set)."""
+    t0 = time.perf_counter()
+    if target_mesh is not None:
+        everyone = set(int(p) for p in mesh.process_ids) \
+            | set(int(r) for r in joined_process_ids)
+        if set(target_mesh.process_ids) != everyone:
+            from ...base.core import EnforceNotMet
+            raise EnforceNotMet(
+                f"target_mesh {target_mesh!r} covers processes "
+                f"{sorted(target_mesh.process_ids)} but the grown "
+                f"world of {mesh!r} plus "
+                f"{sorted(set(joined_process_ids))} is "
+                f"{sorted(everyone)}")
+        new_mesh = target_mesh
+    else:
+        new_mesh = plan_grow(mesh, joined_process_ids)
+    tensors = []
+    transitions = []
+    if state:
+        from ..api import DistAttr
+        for name, t in state.items():
+            attr = getattr(t, "_dist_attr", None)
+            if attr is None or attr.process_mesh is not mesh:
+                continue
+            new_pl = _shrunk_placements(attr.placements, mesh, new_mesh,
+                                        tuple(t._value.shape))
+            dst = DistAttr(new_mesh, new_pl)
+            tensors.append((t, dst))
+            transitions.append((t._value.ndim, attr, dst,
+                                tuple(t._value.shape)))
+    pipe_cfg = None
+    if pipeline is not None:
+        schedule, num_micro = pipeline[0], pipeline[1]
+        num_chunks = pipeline[2] if len(pipeline) > 2 else 1
+        pp_size = new_mesh.get_dim_size("pp") \
+            if "pp" in new_mesh.dim_names else new_mesh.size
+        pipe_cfg = (schedule, pp_size, num_micro, num_chunks)
+    from ...analysis import hooks as _sanitizer
+    _sanitizer.on_world_shrink(transitions, pipe_cfg)
+
+    # plan validated: move the data through the reshard registry
+    from ..auto_parallel.reshard_functions import reshard_value
+    for t, dst in tensors:
+        new_val, _fn = reshard_value(
+            t._value, t._dist_attr.process_mesh,
+            t._dist_attr.placements, dst.process_mesh, dst.placements)
+        t._replace_value_inplace(new_val)
+        t._dist_attr = dst
+        if optimizer is not None:
+            _reshard_opt_state(optimizer, t, dst)
+    if set_global:
+        from ..mesh import get_mesh, set_mesh
+        if get_mesh() is mesh:
+            set_mesh(new_mesh)
+    from ...observability import metrics
+    metrics.inc("resilience.world_grows")
+    metrics.observe("resilience.grow_reshard_us",
+                    (time.perf_counter() - t0) * 1e6)
+    from ...observability import _state as _OBS
+    if _OBS.FLIGHT:
+        from ...observability import flight
+        flight.note("grow", "world",
+                    old=mesh.size, new=new_mesh.size,
+                    joined=sorted(set(int(r)
+                                      for r in joined_process_ids)),
+                    resharded=len(tensors))
+    return new_mesh

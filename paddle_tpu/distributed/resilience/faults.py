@@ -22,7 +22,13 @@ Plan grammar (semicolon- or comma-separated entries)::
   membership events ``member::leave`` / ``member::join`` polled by
   AdaptiveTrainer at every step boundary (any kind raised there is
   consumed as the event — ``member::leave@2=die`` drills a
-  deterministic rank leave that triggers a re-plan). A trailing ``*``
+  deterministic rank leave that triggers a re-plan, and
+  ``member::join@2=fail`` a deterministic join that triggers
+  join-driven growth when the trainer can resolve the joining
+  ranks). ``preempt::notice`` is polled at the same boundary: any
+  kind raised there is consumed as a preemption NOTICE — the trainer
+  checkpoints immediately (``preempt::notice@3=fail`` drills the
+  notice-driven save without killing anything). A trailing ``*``
   wildcards (``comm::*``).
 - ``@occ`` fires on the occ-th *matching occurrence* (1-based);
   omitted = the first occurrence only (so a retry of the same site

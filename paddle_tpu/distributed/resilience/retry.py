@@ -141,6 +141,8 @@ _BRINGUP = RetryPolicy(
 _COMM = RetryPolicy("comm")
 _CKPT = RetryPolicy(
     "checkpoint", retryable=DEFAULT_RETRYABLE + (OSError,))
+_GROW = RetryPolicy(
+    "grow_bcast", retryable=DEFAULT_RETRYABLE + (OSError, StoreOpError))
 
 
 def store_policy() -> RetryPolicy:
@@ -161,3 +163,11 @@ def comm_policy() -> RetryPolicy:
 def ckpt_policy() -> RetryPolicy:
     """Checkpoint file I/O."""
     return _CKPT
+
+
+def grow_policy() -> RetryPolicy:
+    """Survivor->joiner state broadcast through the TCPStore
+    (growth.py): chunk publishes and fetches re-attempt the transient
+    store class; a checksum mismatch is NOT retried here — the joiner
+    falls back to the newest verified checkpoint generation."""
+    return _GROW

@@ -324,10 +324,15 @@ def _block(x, blk, config: GPTConfig, mesh_axes, sp_sharding=None,
 
 def gpt_forward(params, tokens, config: GPTConfig, mesh_axes=None,
                 remat=True, sp_sharding=None, pp_trunk=None,
-                return_hidden=False):
+                return_hidden=False, unroll_layers=False):
     """Pure forward: tokens [B, S] int32 -> logits [B, S, V]. pp_trunk,
     when given (distributed.pipeline_compiled.pipelined_trunk), replaces
-    the layer scan with the compiled pp-axis pipeline."""
+    the layer scan with the compiled pp-axis pipeline. unroll_layers
+    replaces the layer scan with a Python loop over the stacked block
+    leaves — numerically identical, but the program carries no while
+    loop: XLA:CPU's SPMD partitioner mis-types the scan transpose's
+    dynamic_update_slice index under mp>1 sharding (s64 vs s32 compare,
+    HLO-verifier reject), so CPU measurement paths unroll."""
     b, s = tokens.shape
     x = params["wte"][tokens] + params["wpe"][:s]
     x = x.astype(jnp.dtype(config.dtype))
@@ -341,10 +346,15 @@ def gpt_forward(params, tokens, config: GPTConfig, mesh_axes=None,
         if remat:
             blk_fn = jax.checkpoint(blk_fn)
 
-        def scan_body(carry, blk):
-            return blk_fn(carry, blk), None
+        if unroll_layers:
+            for i in range(config.num_layers):
+                x = blk_fn(x, jax.tree_util.tree_map(
+                    lambda a: a[i], params["blocks"]))
+        else:
+            def scan_body(carry, blk):
+                return blk_fn(carry, blk), None
 
-        x, _ = jax.lax.scan(scan_body, x, params["blocks"])
+            x, _ = jax.lax.scan(scan_body, x, params["blocks"])
     x = _ln(x, params["lnf_g"], params["lnf_b"], config.layer_norm_eps)
     if return_hidden:
         return x
@@ -353,7 +363,8 @@ def gpt_forward(params, tokens, config: GPTConfig, mesh_axes=None,
 
 
 def gpt_loss(params, tokens, labels, config: GPTConfig, mesh_axes=None,
-             remat=True, sp_sharding=None, pp_trunk=None):
+             remat=True, sp_sharding=None, pp_trunk=None,
+             unroll_layers=False):
     """Mean LM loss. With an mp>1 mesh the head goes through
     vocab-parallel softmax-cross-entropy (mp_ops.py:77-385 analog):
     wte is vocab-sharded over mp, so the full [B, S, V] logits are never
@@ -366,12 +377,14 @@ def gpt_loss(params, tokens, labels, config: GPTConfig, mesh_axes=None,
             vocab_parallel_softmax_cross_entropy
         hidden = gpt_forward(params, tokens, config, mesh_axes, remat,
                              sp_sharding, pp_trunk=pp_trunk,
-                             return_hidden=True)
+                             return_hidden=True,
+                             unroll_layers=unroll_layers)
         loss = vocab_parallel_softmax_cross_entropy(
             hidden, params["wte"], labels, mesh_axes, axis="mp")
         return loss.mean()
     logits = gpt_forward(params, tokens, config, mesh_axes, remat,
-                         sp_sharding, pp_trunk=pp_trunk)
+                         sp_sharding, pp_trunk=pp_trunk,
+                         unroll_layers=unroll_layers)
     logits = logits.astype(jnp.float32)
     logp = jax.nn.log_softmax(logits, -1)
     picked = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
@@ -382,7 +395,8 @@ def build_train_step(config: GPTConfig, mesh: Optional[Mesh] = None,
                      lr: float = 3e-4, wd: float = 0.1, b1: float = 0.9,
                      b2: float = 0.95, zero1: bool = True,
                      seq_shard: bool = False, remat: bool = True,
-                     pp_microbatches: Optional[int] = None):
+                     pp_microbatches: Optional[int] = None,
+                     unroll_layers: bool = False):
     """Build (init_fn, step_fn) — step is ONE compiled XLA program:
     fwd + bwd (remat'd scan) + AdamW, with dp/mp/sp/ZeRO1 shardings when
     `mesh` has those axes. A 'pp' mesh axis (size>1) engages the compiled
@@ -427,7 +441,7 @@ def build_train_step(config: GPTConfig, mesh: Optional[Mesh] = None,
     def loss_fn(params, tokens, labels):
         return gpt_loss(params, tokens, labels, config, mesh_axes=mesh,
                         remat=remat, sp_sharding=sp_sharding,
-                        pp_trunk=pp_trunk)
+                        pp_trunk=pp_trunk, unroll_layers=unroll_layers)
 
     return build_adamw_train_step(
         loss_fn, functools.partial(init_gpt_params, config),

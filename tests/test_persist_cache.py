@@ -204,3 +204,70 @@ def test_inactive_without_dir(checks_off, tmp_path):
     _fresh_compile(x)
     assert not _entries(str(tmp_path))
     assert persist.load("segment", ("anything",)) is None
+
+
+# --------------------------------------- cross-process warm start
+
+_WARM_WORKER = """
+import json, os, sys
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu.observability import metrics
+
+paddle.set_flags({"FLAGS_static_checks": "off",
+                  "FLAGS_observability": True,
+                  "FLAGS_executable_cache_dir": sys.argv[1]})
+x = paddle.to_tensor(np.full((16, 16), 1.5, "float32"))
+y = x
+for _ in range(10):
+    y = y * 1.002 + 0.002
+np.asarray(y._value)
+counters = metrics.snapshot()["counters"]
+print(json.dumps(
+    {"compiles": {k: v for k, v in counters.items()
+                  if k.startswith("compiles.")
+                  and not k.startswith("compiles.bytes.")},
+     "persist": {k: v for k, v in counters.items()
+                 if k.startswith("cache.persist.")}}))
+"""
+
+
+def test_cross_process_warm_start(tmp_path):
+    """The elastic warm-start contract (joiner/hot-spare half of the
+    grow drill): a SECOND fresh process pointed at the first process's
+    FLAGS_executable_cache_dir reconstructs its executables from disk
+    — cache.persist.hit > 0 and ZERO fresh compiles.* (the persist key
+    is content-addressed over jax version + backend + MESH_EPOCH-zeroed
+    segment key, so distinct processes on one host/toolchain collide
+    on purpose)."""
+    import json
+    import subprocess
+    import sys
+
+    cache = tmp_path / "shared_cache"
+    cache.mkdir()
+    worker = tmp_path / "warm_worker.py"
+    worker.write_text(_WARM_WORKER)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+
+    def run_once(tag):
+        out = subprocess.run(
+            [sys.executable, str(worker), str(cache)],
+            capture_output=True, text=True, env=env, timeout=300)
+        assert out.returncode == 0, f"{tag}: {out.stderr[-2000:]}"
+        return json.loads([ln for ln in out.stdout.splitlines()
+                           if ln.startswith("{")][-1])
+
+    cold = run_once("cold")
+    assert sum(cold["compiles"].values()) > 0, \
+        "cold process compiled nothing — the drill proves nothing"
+    assert cold["persist"].get("cache.persist.store", 0) > 0
+
+    warm = run_once("warm")
+    assert warm["persist"].get("cache.persist.hit", 0) > 0, \
+        "second process never loaded the survivors' executables"
+    assert sum(warm["compiles"].values()) == 0, \
+        f"warm process recompiled: {warm['compiles']}"

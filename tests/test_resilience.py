@@ -940,6 +940,271 @@ def test_adaptive_falls_back_to_checkpoint_when_rollback_exhausted(
     trainer.shutdown()
 
 
+# --------------------------------------------- join-driven growth
+
+def test_plan_grow_and_grow_world_reshard():
+    """`plan_grow`/`grow_world` unit contracts: the inverse of shrink —
+    the grown mesh covers old ∪ joined, the sanitizer sweep validates
+    every transition BEFORE data moves, and a sharded tensor re-lays
+    out over the bigger world bit-exact."""
+    from paddle_tpu.distributed.resilience import grow_world, plan_grow
+
+    old = dist.ProcessMesh(list(range(6)), dim_names=["dp"])
+    grown = plan_grow(old, [6, 7])
+    assert grown.size == 8
+    # a joined set overlapping the live mesh is a caller bug
+    with pytest.raises(EnforceNotMet, match="already"):
+        plan_grow(old, [5, 6])
+    with pytest.raises(EnforceNotMet, match="empty"):
+        plan_grow(old, [])
+
+    t = dist.shard_tensor(
+        paddle.to_tensor(np.arange(96, dtype=np.float32).reshape(24, 4)),
+        old, [dist.Shard(0)])
+    sweeps = _counter("sanitizer.shrink_sweeps")
+    grows = _counter("resilience.world_grows")
+    new_mesh = grow_world(old, [6, 7], {"t": t}, set_global=False)
+    assert new_mesh.size == 8
+    assert t._dist_attr.process_mesh is new_mesh
+    assert t._dist_attr.placements[0].is_shard()
+    np.testing.assert_array_equal(
+        np.asarray(t._value),
+        np.arange(96, dtype=np.float32).reshape(24, 4))
+    assert _counter("sanitizer.shrink_sweeps") == sweeps + 1
+    assert _counter("resilience.world_grows") == grows + 1
+    # target mesh must cover the union
+    wrong = dist.ProcessMesh(list(range(7)), dim_names=["dp"])
+    with pytest.raises(EnforceNotMet, match="cover"):
+        grow_world(old, [6, 7], {}, set_global=False, target_mesh=wrong)
+
+
+def test_member_join_grows_and_recompiles_once():
+    """The growth tentpole drill, single-process: an injected
+    member::join on a 6-mesh LeNet run grows the world to 8 — the
+    planner picks an 8-feasible plan, the sanitizer sweep validates it
+    before data moves, params land on the grown mesh, the step cache
+    re-keys so the fused step recompiles exactly ONCE, and the losses
+    match the fault-free reference."""
+    ref = _plain_lenet(5)
+    mesh = dist.auto_mesh(6, dim_names=["dp"])
+    dist.set_mesh(mesh)
+    try:
+        trainer, step, model = _adaptive_lenet(mesh=mesh,
+                                               joined_ranks=[6, 7])
+        sweeps = _counter("sanitizer.shrink_sweeps")
+        grows = _counter("resilience.grows")
+        with with_flag("FLAGS_observability", True):
+            losses = [trainer.run(step)]      # warm the step cache
+            compiles = _counter("compiles.fused_step")
+            with with_flag("FLAGS_fault_inject", "member::join@1=die"):
+                losses += [trainer.run(step) for _ in range(4)]
+            # exactly ONE recompile across the grow + the 3 steps after
+            # it: the mesh-epoch re-key forces a fresh entry at the
+            # first post-grow step, which every later step hits
+            assert _counter("compiles.fused_step") == compiles + 1
+        np.testing.assert_allclose(losses, ref, rtol=1e-5)
+        assert trainer.grows == 1 and trainer.replans == 0
+        assert trainer.mesh.size == 8 and trainer.mesh is not mesh
+        assert dist.get_mesh() is trainer.mesh
+        plan = trainer.last_plan
+        assert plan["dp_degree"] * plan["mp_degree"] \
+            * plan["pp_degree"] == 8
+        for p in model.parameters():
+            assert p._dist_attr.process_mesh is trainer.mesh
+        assert _counter("sanitizer.shrink_sweeps") == sweeps + 1
+        assert _counter("resilience.grows") == grows + 1
+        # the membership->first-post-grow-step latency landed in the
+        # grow histogram, not the shrink one
+        assert trainer.last_grow_latency_s is not None \
+            and trainer.last_grow_latency_s > 0
+        trainer.shutdown()
+    finally:
+        dist.set_mesh(None)
+
+
+def test_grow_state_broadcast_roundtrip_and_corruption():
+    """The survivor->joiner state hand-off: chunked + checksummed
+    publication roundtrips exactly through a real TCPStore; a flipped
+    byte in any chunk is rejected BEFORE unpickling (StoreOpError +
+    counted), the joiner's signal to fall back to the checkpoint."""
+    from paddle_tpu.distributed.resilience import growth
+    from paddle_tpu.distributed.resilience.retry import StoreOpError
+
+    store = _local_store()
+    try:
+        state = {"w": np.arange(4096, dtype=np.float32),
+                 "step": 17, "lr": {"last_lr": 0.01}}
+        with with_flag("FLAGS_elastic_grow_chunk_kb", 4):
+            nchunks = growth.publish_state(store, state, epoch=3)
+            assert nchunks > 1, "chunking never engaged"
+            got = growth.receive_state(store, 3, timeout=5)
+        np.testing.assert_array_equal(got["w"], state["w"])
+        assert got["step"] == 17 and got["lr"] == {"last_lr": 0.01}
+
+        # corrupt one published chunk: reject, never unpickle
+        raw = store.get("__elastic/grow/3/chunk/1")
+        store.set("__elastic/grow/3/chunk/1",
+                  bytes([raw[0] ^ 0xFF]) + raw[1:])
+        rejects = _counter("resilience.grow_bcast_rejects")
+        with pytest.raises(StoreOpError, match="checksum|unusable"):
+            growth.receive_state(store, 3, timeout=5)
+        assert _counter("resilience.grow_bcast_rejects") == rejects + 1
+
+        # a missing epoch times out as StoreOpError too
+        with pytest.raises(StoreOpError):
+            growth.receive_state(store, 99, timeout=0.3)
+    finally:
+        store.close()
+
+
+def test_restore_from_broadcast_into_fresh_trainer():
+    """The joiner's fast path end-to-end: a fresh trainer (new params,
+    empty optimizer) receives the survivors' broadcast and replays the
+    next steps bit-exact — without any checkpoint on disk."""
+    ref = _plain_lenet(5)
+    trainer, step, _ = _adaptive_lenet()
+    for _ in range(3):
+        trainer.run(step)
+    store = _local_store()
+    try:
+        from paddle_tpu.distributed.resilience import growth
+        host = {}
+        for k, v in trainer._full_state().items():
+            host[k] = np.asarray(v._value) if hasattr(v, "_value") else v
+        growth.publish_state(store, host, epoch=5)
+        trainer.shutdown()
+
+        fresh, fresh_step, _ = _adaptive_lenet()
+        restores = _counter("resilience.bcast_restores")
+        fresh.restore_from_broadcast(store, 5, timeout=5)
+        assert _counter("resilience.bcast_restores") == restores + 1
+        assert fresh.step_index == 3     # counter rewound with state
+        losses = [fresh.run(fresh_step) for _ in range(2)]
+        np.testing.assert_allclose(losses, ref[3:5], rtol=1e-5)
+        fresh.shutdown()
+    finally:
+        store.close()
+
+
+def test_failed_grow_does_not_consume_epoch(monkeypatch):
+    """A join event whose grow FAILS must not be swallowed: the epoch
+    rolls back so the next poll re-observes it (and the joiner's
+    fallback stays relaunch-from-checkpoint), and the latency selector
+    resets to the shrink histogram."""
+    from paddle_tpu.distributed.resilience import MembershipEvent
+    from paddle_tpu.distributed.resilience import adaptive as adaptive_mod
+
+    mesh = dist.auto_mesh(6, dim_names=["dp"])
+    trainer, step, _ = _adaptive_lenet(mesh=mesh, joined_ranks=[6, 7])
+
+    def boom(*a, **kw):
+        raise RuntimeError("reshard died mid-growth")
+
+    monkeypatch.setattr(adaptive_mod, "grow_world", boom)
+    with pytest.raises(RuntimeError, match="mid-growth"):
+        trainer._membership_event(MembershipEvent(
+            7, [str(r) for r in range(8)], joined=[6, 7],
+            source="manager"))
+    assert trainer._last_epoch == 0 and trainer.grows == 0
+    assert trainer.mesh is mesh
+    assert trainer._latency_hist == "resilience.replan_us"
+    monkeypatch.undo()
+    # the same epoch still processes once the grow is healthy
+    trainer._membership_event(MembershipEvent(
+        7, [str(r) for r in range(8)], joined=[6, 7], source="manager"))
+    assert trainer._last_epoch == 7 and trainer.grows == 1
+    assert trainer.mesh.size == 8
+    trainer.shutdown()
+
+
+# ---------------------------------------- preemption-aware checkpoints
+
+def test_preempt_notice_checkpoints_immediately(tmp_path):
+    """An injected `preempt::notice` drives ONE immediate verified
+    checkpoint through the retention manager (counters + manifest),
+    its wall priced into the goodput `ckpt_io` bucket via the existing
+    ckpt::save span — and a replacement trainer restores onto it with
+    the lost work bounded by the notice-to-kill window."""
+    from paddle_tpu.observability import goodput
+    ref = _plain_lenet(5)
+    trainer, step, _ = _adaptive_lenet(
+        checkpoint_dir=str(tmp_path / "ck"))
+    notices = _counter("resilience.preempt_notices")
+    pckpts = _counter("resilience.preempt_ckpts")
+    with with_flag("FLAGS_goodput", True):
+        with with_flag("FLAGS_fault_inject", "preempt::notice@3=fail"):
+            losses = [trainer.run(step) for _ in range(4)]
+        assert goodput.snapshot()["buckets"]["ckpt_io"] > 0, \
+            "preemption checkpoint left no ckpt_io wall"
+    assert _counter("resilience.preempt_notices") == notices + 1
+    assert _counter("resilience.preempt_ckpts") == pckpts + 1
+    assert trainer.preempt_checkpoints == 1
+    # the notice fired at the step-3 boundary: the generation carries
+    # the post-step-2 state
+    assert trainer.ckpt.generations() == [1]
+    np.testing.assert_allclose(losses, ref[:4], rtol=1e-5)
+    trainer.shutdown()
+
+    # the preempted rank's replacement: restore + replay is bit-exact
+    fresh, fresh_step, _ = _adaptive_lenet(
+        checkpoint_dir=str(tmp_path / "ck"))
+    fresh.restore_from_checkpoint()
+    assert fresh.step_index == 2
+    replay = [fresh.run(fresh_step) for _ in range(3)]
+    np.testing.assert_allclose(replay, ref[2:5], rtol=1e-5)
+    fresh.shutdown()
+
+
+def test_manager_preemption_announcement_drives_checkpoint(tmp_path):
+    """A REAL `ElasticManager.announce_preemption` (store counter +
+    key, not a fault site) reaches the trainer's step-boundary poll:
+    each notice is seen exactly once and checkpoints immediately."""
+    store = _local_store()
+    try:
+        from paddle_tpu.distributed.fleet.elastic import ElasticManager
+        mgr = ElasticManager("0", store, heartbeat_interval=0.05)
+        other = ElasticManager("1", store, heartbeat_interval=0.05)
+        trainer, step, _ = _adaptive_lenet(
+            manager=mgr, checkpoint_dir=str(tmp_path / "ck"))
+        trainer.run(step)
+        assert trainer.preempt_checkpoints == 0
+        other.announce_preemption()      # the scheduler's grace signal
+        trainer.run(step)
+        assert trainer.preempt_checkpoints == 1
+        assert trainer.ckpt.generations() == [1]
+        trainer.run(step)                # consumed exactly once
+        assert trainer.preempt_checkpoints == 1
+        # the announcing side tagged its own node id
+        assert mgr.poll_preemption() == []   # mgr consumed them above
+        trainer.shutdown()
+        mgr.shutdown()
+        other.shutdown()
+    finally:
+        store.close()
+
+
+def test_checkpoint_interval_flag_cadence(tmp_path):
+    """Satellite: FLAGS_checkpoint_interval_steps=N auto-checkpoints
+    every N step boundaries through the retention manager without any
+    per-call-site opt-in (checkpoint_every stays 0), and the flag off
+    (default) writes nothing."""
+    trainer, step, _ = _adaptive_lenet(
+        checkpoint_dir=str(tmp_path / "ck"))
+    for _ in range(2):
+        trainer.run(step)
+    assert trainer.ckpt.generations() == []   # default 0 = off
+    with with_flag("FLAGS_checkpoint_interval_steps", 2):
+        for _ in range(4):
+            trainer.run(step)
+    # boundaries at step 4 and 6 saved; 3 and 5 did not
+    gens = trainer.ckpt.generations()
+    assert len(gens) == 2
+    manifest = json.load(open(os.path.join(
+        str(tmp_path / "ck"), "MANIFEST.json")))
+    assert [e["step"] for e in manifest["generations"]] == [4, 6]
+    trainer.shutdown()
+
+
 # ------------------------------------------- multi-process death drill
 
 _DRILL_SCRIPT = """
@@ -1151,3 +1416,250 @@ open(f"done_{rank}", "w").write("ok")
         env=env, cwd=str(tmp_path), capture_output=True, text=True,
         timeout=120)
     assert proc.returncode != 0
+
+
+# ------------------------------------------- multi-process grow drill
+
+_GROW_DRILL_SCRIPT = """
+import json, os, sys, time
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import numpy as np
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+import paddle_tpu.nn.functional as F
+from paddle_tpu.distributed.fleet.elastic import ElasticManager
+from paddle_tpu.distributed.resilience import AdaptiveTrainer, join_world
+from paddle_tpu.distributed.store import TCPStore
+from paddle_tpu.observability import metrics
+from paddle_tpu.vision.models import LeNet
+
+RANK = int(os.environ["PADDLE_TRAINER_ID"])
+WORLD = int(os.environ["PADDLE_TRAINERS_NUM"])           # active: 6
+NSPAWN = len(os.environ["PADDLE_TRAINER_ENDPOINTS"].split(","))  # 8
+SPARE = os.environ.get("PADDLE_ELASTIC_SPARE") == "1"
+STEPS, GROW_STEP = 5, 2
+
+paddle.set_flags({"FLAGS_observability": True})
+
+
+def build():
+    paddle.seed(0)
+    model = LeNet()
+    opt = paddle.optimizer.Adam(1e-3, parameters=model.parameters())
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(8, 1, 28, 28).astype(np.float32))
+    y = paddle.to_tensor(rng.randint(0, 10, (8,)).astype(np.int64))
+
+    def step():
+        loss = F.cross_entropy(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return float(loss)
+
+    return step, opt
+
+
+# Everyone (actives AND hot spares) warms the XLA cache BEFORE joining
+# the heartbeat plane: the spare's whole point is paying its compiles
+# OUTSIDE the mesh, so admission costs a reshard, not a cold start.
+warm_step, _ = build()
+warm_step()
+
+store = TCPStore(os.environ["MASTER_ADDR"],
+                 int(os.environ["MASTER_PORT"]),
+                 is_master=(RANK == 0), world_size=NSPAWN, timeout=180)
+
+if SPARE:
+    # hot spare: caches warm, OUTSIDE the mesh. Wait for the grow
+    # signal, then rendezvous through the elastic master (register +
+    # announce + admission into a published membership epoch that
+    # holds the FULL grown world) and receive the survivors' state
+    # broadcast for that epoch.
+    store.wait("go_join", 300)
+    mgr = ElasticManager(str(RANK), store, min_np=1,
+                         heartbeat_interval=0.2, node_timeout=10.0)
+    m = join_world(mgr, min_members=NSPAWN, timeout=120)
+    mesh = dist.ProcessMesh(list(range(NSPAWN)), dim_names=["dp"])
+    step, opt = build()
+    trainer = AdaptiveTrainer(optimizer=opt, mesh=mesh, manager=mgr)
+    trainer.restore_from_broadcast(store, int(m["epoch"]), timeout=120)
+    losses = [trainer.run(step) for _ in range(STEPS - trainer.step_index)]
+    out = {"rank": RANK, "spare": True, "losses": losses,
+           "epoch": int(m["epoch"]),
+           "resumed_at": STEPS - len(losses),
+           "bcast_restores":
+               metrics.counter("resilience.bcast_restores").value}
+    with open(f"result_{RANK}.json", "w") as f:
+        json.dump(out, f)
+    trainer.shutdown()
+    mgr.shutdown()
+    store.close()
+    sys.exit(0)
+
+mgr = ElasticManager(str(RANK), store, min_np=1,
+                     heartbeat_interval=0.2, node_timeout=10.0)
+mgr.register()
+if RANK == 0:
+    mgr.watch([str(r) for r in range(WORLD)])
+
+m = mgr.wait_for_members(lambda m: len(m["members"]) == WORLD,
+                         timeout=90)
+assert len(m["members"]) == WORLD, f"rendezvous failed: {m}"
+
+mesh = dist.ProcessMesh(list(range(WORLD)), dim_names=["dp"])
+step, opt = build()
+trainer = AdaptiveTrainer(optimizer=opt, mesh=mesh, manager=mgr)
+
+events = []
+_orig_event = trainer._membership_event
+def _traced_event(ev, **kw):
+    events.append({"epoch": ev.epoch, "lost": list(ev.lost),
+                   "joined": list(ev.joined), "source": ev.source})
+    return _orig_event(ev, **kw)
+trainer._membership_event = _traced_event
+
+losses = []
+compiles_pre_grow = None
+t_grow0 = None
+for s in range(1, STEPS + 1):
+    losses.append(trainer.run(step))
+    if s == GROW_STEP:
+        # steady state reached: record the compile watermark, then
+        # admit the spares. Survivors hold until the master published
+        # the FULL grown membership so every rank observes ONE epoch
+        # with both joiners (drill determinism).
+        compiles_pre_grow = \
+            metrics.counter("compiles.fused_step").value
+        t_grow0 = time.perf_counter()
+        if RANK == 0:
+            store.set("go_join", "1")
+        mgr.wait_for_members(
+            lambda m: len(m["members"]) == NSPAWN, timeout=120)
+
+out = {"rank": RANK, "spare": False, "losses": losses,
+       "grows": trainer.grows, "replans": trainer.replans,
+       "events": events, "mesh": trainer.mesh.shape,
+       "grow_latency_s": trainer.last_grow_latency_s,
+       "wall_grow_s": (time.perf_counter() - t_grow0
+                       if t_grow0 else None),
+       "compiles_post_grow":
+           metrics.counter("compiles.fused_step").value
+           - compiles_pre_grow,
+       "plan": {k: trainer.last_plan.get(k) for k in
+                ("dp_degree", "mp_degree", "pp_degree")}
+               if trainer.last_plan else None}
+with open(f"result_{RANK}.json", "w") as f:
+    json.dump(out, f)
+trainer.shutdown()
+mgr.shutdown()
+store.close()
+"""
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(420)
+def test_multiprocess_grow_drill(tmp_path):
+    """THE growth drill: the launcher (--elastic_mode grow --max_np 8)
+    spawns 6 active trainers plus 2 hot spares (PADDLE_ELASTIC_SPARE=1)
+    that warm their XLA caches OUTSIDE the mesh. After step 2 the
+    spares are admitted: they rendezvous through the elastic master
+    under a new membership epoch, every survivor grows 6->8 (planner +
+    sanitizer + grow_world) with exactly ONE post-grow recompile, and
+    the joiners restore from the survivors' TCPStore state broadcast.
+    All 8 finish step 5 with losses matching the fault-free reference
+    to rtol 1e-5."""
+    from paddle_tpu._core import native
+    if not native.get_lib():
+        pytest.skip("native lib unavailable")
+    active, nspawn = 6, 8
+    script = tmp_path / "grow_drill.py"
+    script.write_text(_GROW_DRILL_SCRIPT)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("MASTER_ADDR", None)
+    env.pop("MASTER_PORT", None)
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", str(active),
+         "--elastic_mode", "grow", "--max_np", str(nspawn),
+         "--min_np", str(active),
+         "--master", f"127.0.0.1:{_free_port()}", str(script)],
+        env=env, cwd=str(tmp_path), capture_output=True, text=True,
+        timeout=390)
+    logs = ""
+    logdir = tmp_path / "log"
+    if logdir.exists():
+        for f in sorted(os.listdir(logdir)):
+            logs += f"\n--- {f}\n" + (logdir / f).read_text()[-2000:]
+    assert proc.returncode == 0, \
+        f"launcher rc={proc.returncode}\n{proc.stderr}\n{logs}"
+
+    ref = _plain_lenet(5)
+    for r in range(active):
+        path = tmp_path / f"result_{r}.json"
+        assert path.exists(), f"active rank {r} wrote no result\n{logs}"
+        out = json.loads(path.read_text())
+        assert out["grows"] == 1 and out["replans"] == 0, (r, out)
+        assert any(set(e["joined"]) == {"6", "7"}
+                   for e in out["events"]), (r, out)
+        assert int(np.prod(out["mesh"])) == nspawn, (r, out)
+        p = out["plan"]
+        assert p["dp_degree"] * p["mp_degree"] * p["pp_degree"] \
+            == nspawn, (r, out)
+        # exactly ONE recompile from steady state through the grow to
+        # the end of the run
+        assert out["compiles_post_grow"] == 1, (r, out)
+        assert out["grow_latency_s"] and out["grow_latency_s"] > 0, \
+            (r, out)
+        assert len(out["losses"]) == 5, (r, out)
+        np.testing.assert_allclose(out["losses"], ref, rtol=1e-5,
+                                   err_msg=f"rank {r}")
+    for r in range(active, nspawn):
+        path = tmp_path / f"result_{r}.json"
+        assert path.exists(), f"spare rank {r} wrote no result\n{logs}"
+        out = json.loads(path.read_text())
+        assert out["spare"] and out["bcast_restores"] == 1, (r, out)
+        # the broadcast carried step_index=2 state: the joiner replays
+        # steps 3..5 and matches the fault-free tail
+        assert out["resumed_at"] == 2, (r, out)
+        np.testing.assert_allclose(out["losses"], ref[2:5], rtol=1e-5,
+                                   err_msg=f"spare rank {r}")
+
+
+def test_launch_grow_mode_spawns_hot_spares(tmp_path):
+    """Launcher grow-mode unit: --max_np 6 over --nproc_per_node 4
+    spawns 2 extra workers marked PADDLE_ELASTIC_SPARE=1 with REAL
+    endpoints beyond the active world; active workers see no spare
+    env; the pod exits 0 when everyone (spares included) finishes."""
+    body = """
+import os
+rank = os.environ["PADDLE_TRAINER_ID"]
+spare = os.environ.get("PADDLE_ELASTIC_SPARE", "")
+eps = os.environ["PADDLE_TRAINER_ENDPOINTS"].split(",")
+me = os.environ["PADDLE_CURRENT_ENDPOINT"]
+open(f"done_{rank}", "w").write(
+    f"{spare}|{len(eps)}|{os.environ['PADDLE_TRAINERS_NUM']}|{me}")
+"""
+    script = tmp_path / "worker.py"
+    script.write_text(body)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "4", "--elastic_mode", "grow",
+         "--max_np", "6",
+         "--master", f"127.0.0.1:{_free_port()}", str(script)],
+        env=env, cwd=str(tmp_path), capture_output=True, text=True,
+        timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    for r in range(6):
+        p = tmp_path / f"done_{r}"
+        assert p.exists(), f"worker {r} never ran"
+        spare, neps, world, me = p.read_text().split("|")
+        assert neps == "6", "endpoints must cover spares too"
+        assert world == "4", "advertised world stays the ACTIVE world"
+        assert me, f"worker {r} got no endpoint"
+        assert spare == ("1" if r >= 4 else ""), (r, spare)
